@@ -1,0 +1,93 @@
+"""paddle_tpu: a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle 3.0, built on JAX/XLA/Pallas/pjit.
+
+Layer map vs the reference (see SURVEY.md §1):
+- L0-L3 (common/PHI/kernels/C++ API)  -> jax.numpy + XLA + Pallas kernel pack
+- L4a eager autograd (GradNode graph) -> core.tensor dispatch + jax.vjp tape
+- L4b/L6 PIR/CINN                     -> jaxpr/StableHLO + XLA (not rebuilt)
+- L5 executor                          -> XLA async dispatch
+- L7 distributed C++ runtime           -> jax.distributed + XLA collectives
+- L8 python API                        -> this package
+- L9 python distributed                -> paddle_tpu.distributed
+- L10 inference                        -> paddle_tpu.inference (AOT/StableHLO)
+- L11 CLI                              -> python -m paddle_tpu.distributed.launch
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+# Paddle dtype semantics: integer tensors default to int64, floats to float32
+# (float64 allowed but opt-in). Requires x64 mode; weak-typed Python scalars
+# keep float32 compute on the hot path, so this does not degrade TPU perf.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+# -- core ---------------------------------------------------------------------
+from .core.dtypes import (  # noqa: F401
+    bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    get_default_dtype, set_default_dtype)
+from .core.tensor import (  # noqa: F401
+    Tensor, no_grad, enable_grad, is_grad_enabled, set_grad_enabled)
+from .core.flags import set_flags, get_flags  # noqa: F401
+from .core.random import seed, get_rng_state, set_rng_state  # noqa: F401
+
+# -- tensor ops (also patches Tensor methods) ---------------------------------
+from .tensor import *  # noqa: F401,F403
+from . import tensor  # noqa: F401
+
+# -- autograd -----------------------------------------------------------------
+from .autograd.backward import grad  # noqa: F401
+from . import autograd  # noqa: F401
+
+# -- device -------------------------------------------------------------------
+from . import device  # noqa: F401
+from .device import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, XPUPlace, set_device, get_device,
+    is_compiled_with_cuda, is_compiled_with_rocm, is_compiled_with_xpu)
+
+# -- subsystems ---------------------------------------------------------------
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import incubate  # noqa: F401
+
+from .framework.io import save, load  # noqa: F401
+from .framework import ParamAttr  # noqa: F401
+from .jit.api import to_static  # noqa: F401
+
+from .tensor.creation import to_tensor  # noqa: F401
+from .tensor.logic import is_tensor  # noqa: F401
+
+
+def is_compiled_with_tpu():
+    from .device import is_compiled_with_tpu as _f
+    return _f()
+
+
+def disable_static():
+    """Eager is the only authoring mode; kept for API parity."""
+    return None
+
+
+def enable_static():
+    """Static graphs are expressed via jit.to_static; this flips a marker
+    consulted by paddle_tpu.static helpers."""
+    from . import static as _s
+    _s._static_mode[0] = True
+
+
+def in_dynamic_mode():
+    from . import static as _s
+    return not _s._static_mode[0]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes=dtypes, input=input)

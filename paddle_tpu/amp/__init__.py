@@ -1,0 +1,15 @@
+"""AMP: auto_cast + GradScaler + decorate
+(reference: python/paddle/amp/auto_cast.py:462,1006; grad_scaler.py:62,657).
+
+TPU-native notes: bf16 is the native mixed-precision dtype (MXU computes
+bf16×bf16→fp32); loss scaling is a no-op for bf16 (kept functional for fp16
+parity). O1 casts per-op at eager dispatch via white/black lists — the same
+mechanism as the reference's AmpAutoCast (paddle/fluid/eager/amp_auto_cast.h)
+but implemented in the dispatch hook core/tensor.py.
+"""
+from .auto_cast import (auto_cast, amp_guard, white_list, black_list,  # noqa
+                        amp_state, decorate, is_auto_cast_enabled,
+                        get_amp_dtype)
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+__all__ = ["auto_cast", "decorate", "GradScaler", "is_auto_cast_enabled"]

@@ -1,0 +1,138 @@
+"""auto_cast implementation (reference: python/paddle/amp/auto_cast.py).
+
+The op lists mirror the reference's white/black lists
+(python/paddle/amp/amp_lists.py): matmul-class ops run in bf16/fp16, ops that
+are numerically unsafe at low precision stay fp32; everything else runs in
+whatever dtype its inputs already have.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+from ..core.dtypes import convert_dtype
+
+# ops whose inputs get cast DOWN to the amp dtype (MXU-bound ops)
+WHITE_LIST: Set[str] = {
+    "matmul", "linear", "bmm", "mv", "einsum", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "scaled_dot_product_attention", "flash_attention", "addmm", "mm",
+}
+
+# ops whose inputs get cast UP to fp32 (numerically sensitive)
+BLACK_LIST: Set[str] = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax",
+    "log_softmax", "cross_entropy", "nll_loss", "bce_with_logits",
+    "binary_cross_entropy", "mse_loss", "l1_loss", "smooth_l1_loss",
+    "kl_div", "mean", "sum", "norm", "cumsum", "pow", "rsqrt", "softplus",
+    "layer_norm", "batch_norm", "group_norm", "instance_norm", "rms_norm",
+    "sigmoid_focal_loss", "erf", "erfinv", "cosh", "sinh", "ctc_loss",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white: Set[str] = set()
+        self.custom_black: Set[str] = set()
+
+
+amp_state = _AmpState()
+
+
+def is_auto_cast_enabled() -> bool:
+    return amp_state.enabled
+
+
+def get_amp_dtype():
+    return amp_state.dtype
+
+
+def white_list() -> Set[str]:
+    return (WHITE_LIST | amp_state.custom_white) - amp_state.custom_black
+
+
+def black_list() -> Set[str]:
+    return (BLACK_LIST | amp_state.custom_black) - amp_state.custom_white
+
+
+def maybe_cast_inputs(name: str, values):
+    """Called from core.tensor.dispatch when amp is on: returns values cast
+    per the op's list membership."""
+    if not amp_state.enabled:
+        return values
+    if name in white_list():
+        tgt = amp_state.dtype
+        return tuple(
+            v.astype(tgt) if hasattr(v, "dtype") and v.dtype == jnp.float32
+            else v for v in values)
+    if name in black_list():
+        return tuple(
+            v.astype(jnp.float32) if hasattr(v, "dtype") and
+            v.dtype in (jnp.float16, jnp.bfloat16) else v for v in values)
+    return values
+
+
+class auto_cast:
+    """Context manager (reference: python/paddle/amp/auto_cast.py:462)."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        if level not in ("O0", "O1", "O2", "OD"):
+            raise ValueError(f"level must be O0/OD/O1/O2, got {level}")
+        self.enable = enable and level != "O0"
+        self.level = level
+        self.dtype = convert_dtype(dtype)
+        self.white = set(custom_white_list or [])
+        self.black = set(custom_black_list or [])
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = (amp_state.enabled, amp_state.dtype, amp_state.level,
+                       amp_state.custom_white, amp_state.custom_black)
+        amp_state.enabled = self.enable
+        amp_state.dtype = jnp.dtype(self.dtype)
+        amp_state.level = self.level
+        amp_state.custom_white = self.white
+        amp_state.custom_black = self.black
+        return self
+
+    def __exit__(self, *exc):
+        (amp_state.enabled, amp_state.dtype, amp_state.level,
+         amp_state.custom_white, amp_state.custom_black) = self._saved
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2 decoration: cast model params to the amp dtype; optimizers keep
+    fp32 master weights (reference: python/paddle/amp/auto_cast.py:1006
+    amp_decorate)."""
+    from ..nn import Layer
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        excluded = set()
+        from ..nn.layer.norm import _BatchNormBase, LayerNorm
+        ex_types = tuple(excluded_layers) if excluded_layers else \
+            (_BatchNormBase, LayerNorm)
+        for m in model_list:
+            for l in m.sublayers(include_self=True):
+                if isinstance(l, ex_types):
+                    continue
+                for pname, p in l._parameters.items():
+                    if p is not None and p.dtype == jnp.float32:
+                        p._replace_value(p._value.astype(jnp.dtype(
+                            convert_dtype(dtype))))
+    if optimizers is None:
+        return models if single_model else model_list
+    return ((models if single_model else model_list), optimizers)

@@ -1,0 +1,238 @@
+"""Eager backward engine.
+
+TPU-native equivalent of reference ``egr::RunBackward``
+(paddle/fluid/eager/backward.cc:106): a reverse-topological walk over the
+GradNode graph recorded by ``core.tensor.dispatch``. Each node's backward is a
+``jax.vjp`` closure (already XLA-compiled per-op), cotangents accumulate into
+per-(node, output-slot) holders — the analog of the reference's
+``GradTensorHolder`` — and leaves receive ``.grad``.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import GradNode, Tensor, no_grad
+
+__all__ = ["run_backward", "grad"]
+
+
+def _toposort(roots: List[GradNode]) -> List[GradNode]:
+    """Return nodes in reverse-topological order (outputs before inputs)."""
+    indegree: Dict[int, int] = defaultdict(int)
+    nodes: Dict[int, GradNode] = {}
+    stack = list(roots)
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        nodes[id(node)] = node
+        for t in node.inputs:
+            if t is not None and t._grad_node is not None:
+                indegree[id(t._grad_node)] += 1
+                stack.append(t._grad_node)
+    # Kahn's algorithm from the roots (nodes with no recorded consumers among
+    # the reachable set).
+    order: List[GradNode] = []
+    ready = [n for n in nodes.values() if indegree[id(n)] == 0]
+    while ready:
+        node = ready.pop()
+        order.append(node)
+        for t in node.inputs:
+            if t is not None and t._grad_node is not None:
+                nid = id(t._grad_node)
+                indegree[nid] -= 1
+                if indegree[nid] == 0:
+                    ready.append(nodes[nid])
+    return order
+
+
+def _accumulate(holder, key, value):
+    cur = holder.get(key)
+    holder[key] = value if cur is None else jnp.add(cur, value)
+
+
+@no_grad()
+def run_backward(tensors: List[Tensor],
+                 grad_tensors: Optional[List[Optional[Tensor]]] = None,
+                 retain_graph: bool = False):
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # (id(node), out_index) -> accumulated cotangent value
+    holders: Dict[Tuple[int, int], jax.Array] = {}
+    roots: List[GradNode] = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                f"backward() called on tensor {t.name} with stop_gradient=True")
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g_val = jnp.ones_like(t._value)
+        else:
+            g_val = jnp.asarray(g._value if isinstance(g, Tensor) else g,
+                                dtype=t._value.dtype)
+        if t._grad_node is None:
+            _leaf_accumulate(t, _apply_hooks(t, g_val))
+        else:
+            _accumulate(holders, (id(t._grad_node), t._out_index), g_val)
+            roots.append(t._grad_node)
+
+    for node in _toposort(roots):
+        cots = []
+        missing = True
+        for i in range(node.n_outputs):
+            c = holders.pop((id(node), i), None)
+            if c is not None:
+                missing = False
+            cots.append(c)
+        if missing:
+            continue  # node not on the path from the loss
+        # vjp closures need a full cotangent pytree; fill absent slots with 0.
+        cots = _fill_zeros(node, cots)
+        arg = tuple(cots) if node.n_outputs > 1 else cots[0]
+        in_grads = node.vjp_fn(arg)
+        for t, g in zip(node.inputs, in_grads):
+            if t is None or t.stop_gradient:
+                continue
+            if not _is_float_cotangent(g):
+                continue
+            g = _apply_hooks(t, g)
+            if t._grad_node is None:
+                _leaf_accumulate(t, g)
+            else:
+                _accumulate(holders, (id(t._grad_node), t._out_index), g)
+        if not retain_graph:
+            node.vjp_fn = _used_vjp
+            node.inputs = ()
+
+
+def _apply_hooks(t: Tensor, g_val):
+    for hook in t._hooks:
+        new = hook(Tensor(g_val))
+        if new is not None:
+            g_val = new._value if isinstance(new, Tensor) else new
+    return g_val
+
+
+def _used_vjp(*_):
+    raise RuntimeError(
+        "Trying to backward through the graph a second time; "
+        "call backward(retain_graph=True) if you need to.")
+
+
+def _fill_zeros(node: GradNode, cots):
+    # We don't have shapes of never-touched outputs except via the vjp's
+    # expected structure; nodes are created per-dispatch so this occurs only
+    # for multi-output ops where some outputs are unused. Shapes live on the
+    # Tensors we returned, but those may be gone — so stash nothing and rely
+    # on symbolic zeros via jnp: the cheapest safe fill is zeros_like of the
+    # known cotangents' dtype with the saved shape. GradNode keeps no shapes,
+    # so instead require at least one cotangent and fill with scalar 0 arrays
+    # broadcast by vjp. In practice jax.vjp accepts exact-shaped zeros only,
+    # so we record shapes lazily at dispatch time via n_outputs==1 fast path.
+    if node.n_outputs == 1:
+        return cots
+    shapes = getattr(node, "_out_shapes", None)
+    out = []
+    for i, c in enumerate(cots):
+        if c is None:
+            if shapes is None:
+                raise RuntimeError(
+                    f"unused output {i} of multi-output op {node.name} has no "
+                    "recorded shape for zero-fill")
+            out.append(jnp.zeros(shapes[i][0], dtype=shapes[i][1]))
+        else:
+            out.append(c)
+    return out
+
+
+def _is_float_cotangent(g) -> bool:
+    if g is None:
+        return False
+    dt = getattr(g, "dtype", None)
+    if dt is None:
+        return False
+    if str(dt).startswith("float0"):
+        return False
+    return jnp.issubdtype(dt, jnp.inexact)
+
+
+def _leaf_accumulate(t: Tensor, g_val):
+    # hooks already applied by the caller
+    if t.grad is None:
+        gt = Tensor(g_val, stop_gradient=True, name=t.name + "@GRAD")
+        gt.persistable = True
+        t.grad = gt
+    else:
+        t.grad._value = jnp.add(t.grad._value, g_val)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """``paddle.grad`` equivalent (reference: GeneralGrad,
+    paddle/fluid/eager/general_grad.h). Computes grads of ``outputs`` w.r.t.
+    ``inputs`` without touching ``.grad`` of other leaves."""
+    outputs = _as_list(outputs)
+    inputs = _as_list(inputs)
+    grad_outputs = _as_list(grad_outputs) if grad_outputs is not None else None
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.autograd.functional or "
+            "jax-level higher-order AD (jit path) instead")
+    # Save/restore leaf .grad so paddle.grad is side-effect free.
+    saved = {}
+    stack = [t._grad_node for t in outputs if t._grad_node is not None]
+    leaves = set()
+    seen = set()
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        for t in n.inputs:
+            if t is None:
+                continue
+            if t._grad_node is None:
+                leaves.add(t)
+            else:
+                stack.append(t._grad_node)
+    for t in list(leaves) + inputs:
+        saved[id(t)] = (t, t.grad)
+        t.grad = None
+    # Temporarily mark no_grad_vars
+    restored_sg = []
+    for v in (no_grad_vars or []):
+        restored_sg.append((v, v.stop_gradient))
+        v.stop_gradient = True
+    try:
+        run_backward(outputs, grad_outputs,
+                     retain_graph=bool(retain_graph))
+        results = []
+        for t in inputs:
+            if t.grad is None and not allow_unused:
+                raise RuntimeError(
+                    f"input {t.name} is unreachable from outputs "
+                    "(set allow_unused=True to get None)")
+            results.append(t.grad)
+        return results
+    finally:
+        for t, g in saved.values():
+            t.grad = g
+        for v, sg in restored_sg:
+            v.stop_gradient = sg
+
+
+def _as_list(x):
+    if x is None:
+        return None
+    return list(x) if isinstance(x, (list, tuple)) else [x]

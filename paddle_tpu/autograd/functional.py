@@ -1,0 +1,75 @@
+"""Functional higher-order AD (reference: python/paddle/autograd/ — the
+incubate jacobian/hessian/vjp/jvp APIs). Thin wrappers over jax transforms
+operating on Tensor pytrees."""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_value
+
+
+def _unwrap(x):
+    return jax.tree_util.tree_map(
+        lambda t: to_value(t) if isinstance(t, Tensor) else t, x,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap(x):
+    return jax.tree_util.tree_map(Tensor, x)
+
+
+def _pure(func):
+    def fn(*vals):
+        args = [Tensor(v, stop_gradient=True) for v in vals]
+        out = func(*args)
+        return jax.tree_util.tree_map(
+            lambda t: to_value(t) if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+    return fn
+
+
+def jacobian(func, xs, is_batched=False):
+    single = isinstance(xs, Tensor)
+    vals = [to_value(xs)] if single else [to_value(x) for x in xs]
+    jac = jax.jacrev(_pure(func), argnums=tuple(range(len(vals))))(*vals)
+    out = jax.tree_util.tree_map(Tensor, jac)
+    return out[0] if single and isinstance(out, tuple) else out
+
+
+def hessian(func, xs, is_batched=False):
+    single = isinstance(xs, Tensor)
+    vals = [to_value(xs)] if single else [to_value(x) for x in xs]
+    h = jax.hessian(_pure(func), argnums=tuple(range(len(vals))))(*vals)
+    out = jax.tree_util.tree_map(Tensor, h)
+    if single and isinstance(out, tuple):
+        out = out[0]
+        if isinstance(out, tuple):
+            out = out[0]
+    return out
+
+
+def vjp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    vals = [to_value(xs)] if single else [to_value(x) for x in xs]
+    out, vjp_fn = jax.vjp(_pure(func), *vals)
+    if v is None:
+        v = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v = _unwrap(v)
+    grads = vjp_fn(v)
+    wrapped = jax.tree_util.tree_map(Tensor, grads)
+    return _wrap(out), (wrapped[0] if single else wrapped)
+
+
+def jvp(func, xs, v=None):
+    single = isinstance(xs, Tensor)
+    vals = [to_value(xs)] if single else [to_value(x) for x in xs]
+    if v is None:
+        tangents = [jnp.ones_like(x) for x in vals]
+    else:
+        tangents = [to_value(t) for t in (([v] if single else v))]
+    out, tangent_out = jax.jvp(_pure(func), tuple(vals), tuple(tangents))
+    return _wrap(out), _wrap(tangent_out)

@@ -1,0 +1,94 @@
+"""PyLayer: user-defined autograd ops
+(reference: python/paddle/autograd/py_layer.py:268).
+
+TPU-native: the user's forward runs eagerly; a GradNode is recorded whose
+backward calls the user's ``backward`` staticmethod — exactly the reference's
+PyLayer semantics — implemented directly on the vjp-tape (no C++ ctx object;
+``PyLayerContext`` is a plain Python bag)."""
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax.numpy as jnp
+
+from ..core.tensor import GradNode, Tensor, no_grad, to_value, is_grad_enabled
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: Tuple = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = saved_tensor
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        self._non_diff = set(id(a) for a in args)
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = value
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = (outputs,) if single else tuple(outputs)
+
+        needs_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if not needs_grad:
+            return outputs
+
+        non_diff = getattr(ctx, "_non_diff", set())
+
+        def vjp_fn(cotangents):
+            cots = cotangents if isinstance(cotangents, tuple) else \
+                (cotangents,)
+            grad_in = [Tensor(c) if c is not None else None for c in cots]
+            with no_grad():
+                gi = cls.backward(ctx, *grad_in)
+            gi = (gi,) if isinstance(gi, Tensor) or gi is None else tuple(gi)
+            vals = []
+            for g in gi:
+                vals.append(to_value(g) if isinstance(g, Tensor) else g)
+            return tuple(vals)
+
+        node = GradNode(vjp_fn, tuple(tensor_inputs), len(outs),
+                        cls.__name__)
+        node._out_shapes = [(o.shape, o.dtype) for o in outs]
+        results = []
+        for i, o in enumerate(outs):
+            if id(o) in non_diff:
+                results.append(o)
+                continue
+            t = Tensor(o._value if isinstance(o, Tensor) else o,
+                       stop_gradient=False)
+            t._grad_node = node
+            t._out_index = i
+            results.append(t)
+        return results[0] if single else tuple(results)

@@ -1,0 +1,1 @@
+from . import dtypes, flags, random, tensor  # noqa: F401

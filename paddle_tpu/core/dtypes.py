@@ -1,0 +1,115 @@
+"""Dtype system.
+
+TPU-native re-design of the reference's dtype enum (reference:
+paddle/phi/common/data_type.h). Instead of a C++ enum we canonicalise onto
+numpy/jax dtypes and expose paddle-style aliases (``paddle_tpu.float32`` etc.).
+
+bfloat16 is the *first-class* training dtype on TPU (MXU-native); float64 is
+supported but discouraged (TPU emulates it slowly).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects are numpy dtype instances (jnp dtypes are numpy
+# dtypes under the hood, including the ml_dtypes extension types).
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # paddle-compat aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+_INTEGER = {uint8, int8, int16, int32, int64}
+_COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalise any dtype spec (str, np/jnp dtype, python type) to np.dtype."""
+    if dtype is None:
+        raise TypeError("dtype must not be None")
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"unsupported dtype string: {dtype!r}") from None
+    if dtype is bool:
+        return bool_
+    if dtype is int:
+        return int64
+    if dtype is float:
+        return float32
+    if dtype is complex:
+        return complex64
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype."""
+    return np.dtype(convert_dtype(dtype)).name
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in _FLOATING
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in _INTEGER
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in _COMPLEX
+
+
+_DEFAULT_DTYPE = [float32]
+
+
+def get_default_dtype() -> np.dtype:
+    """Default dtype for floating-point tensor creation (reference:
+    python/paddle/framework/framework.py get_default_dtype)."""
+    return _DEFAULT_DTYPE[0]
+
+
+def set_default_dtype(d) -> None:
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(
+            f"set_default_dtype only accepts floating dtypes, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def promote_types(a, b) -> np.dtype:
+    return np.dtype(jnp.promote_types(convert_dtype(a), convert_dtype(b)))

@@ -1,0 +1,124 @@
+"""Global flag registry.
+
+TPU-native equivalent of the reference's C++ flag system
+(reference: paddle/common/flags.h:38-107, paddle/common/flags.cc — 185 exported
+``FLAGS_*`` flags settable from env and ``paddle.set_flags``). Here flags are a
+typed Python registry seeded from the environment at import; a handful map
+straight onto XLA/JAX config knobs.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    type: type
+    help: str
+    value: Any = None
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+class FlagRegistry:
+    def __init__(self):
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name, default, help="", type=None, on_change=None):
+        t = type or builtins_type(default)
+        flag = _Flag(name=name, default=default, type=t, help=help,
+                     on_change=on_change)
+        env = os.environ.get(f"FLAGS_{name}")
+        flag.value = _parse(env, t) if env is not None else default
+        with self._lock:
+            self._flags[name] = flag
+        if on_change is not None and env is not None:
+            on_change(flag.value)
+        return flag.value
+
+    def get(self, name):
+        try:
+            return self._flags[name].value
+        except KeyError:
+            raise KeyError(f"unknown flag {name!r}") from None
+
+    def set(self, name, value):
+        with self._lock:
+            flag = self._flags.get(name)
+            if flag is None:
+                raise KeyError(f"unknown flag {name!r}")
+            flag.value = _parse(value, flag.type)
+        if flag.on_change is not None:
+            flag.on_change(flag.value)
+
+    def set_flags(self, mapping: Dict[str, Any]):
+        for k, v in mapping.items():
+            self.set(k.removeprefix("FLAGS_"), v)
+
+    def get_flags(self, names):
+        if isinstance(names, str):
+            names = [names]
+        return {f"FLAGS_{n.removeprefix('FLAGS_')}":
+                self.get(n.removeprefix("FLAGS_")) for n in names}
+
+    def all(self):
+        return {k: f.value for k, f in self._flags.items()}
+
+
+def builtins_type(v):
+    if isinstance(v, bool):
+        return bool
+    if isinstance(v, int):
+        return int
+    if isinstance(v, float):
+        return float
+    return str
+
+
+def _parse(v, t):
+    if v is None or isinstance(v, t):
+        return v
+    if t is bool:
+        if isinstance(v, str):
+            return v.lower() in ("1", "true", "yes", "on")
+        return bool(v)
+    return t(v)
+
+
+GLOBAL_FLAGS = FlagRegistry()
+
+# -- core flags (subset of reference paddle/common/flags.cc, TPU-relevant) ----
+GLOBAL_FLAGS.define("check_nan_inf", False,
+                    "scan op outputs for NaN/Inf in eager mode "
+                    "(reference: flags.cc:72-79)")
+GLOBAL_FLAGS.define("check_nan_inf_level", 0,
+                    "0: fatal on nan/inf; 1: warn; 3: collect stats only")
+GLOBAL_FLAGS.define("low_precision_op_list", 0, "log AMP casts per op")
+GLOBAL_FLAGS.define("use_fused_kernels", True,
+                    "route nn ops through Pallas fused kernels when available")
+GLOBAL_FLAGS.define("benchmark", False, "block_until_ready after each eager op")
+GLOBAL_FLAGS.define("eager_log_level", 0, "verbosity of eager dispatch logging")
+GLOBAL_FLAGS.define("allocator_strategy", "xla",
+                    "informational: HBM is owned by XLA/PjRt "
+                    "(reference auto_growth allocator is not applicable)")
+GLOBAL_FLAGS.define("embedding_deterministic", 0,
+                    "1: force deterministic embedding grad accumulation")
+GLOBAL_FLAGS.define("cudnn_deterministic", False,
+                    "compat alias: deterministic XLA ops")
+GLOBAL_FLAGS.define("collective_timeout_s", 600,
+                    "watchdog timeout for collectives (flight-recorder)")
+GLOBAL_FLAGS.define("tensor_print_max_numel", 200,
+                    "max elements printed in Tensor repr before summarising")
+
+
+def set_flags(mapping):
+    GLOBAL_FLAGS.set_flags(mapping)
+
+
+def get_flags(names):
+    return GLOBAL_FLAGS.get_flags(names)

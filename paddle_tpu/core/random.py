@@ -1,0 +1,80 @@
+"""Global RNG state.
+
+TPU-native equivalent of the reference's per-device Philox generator
+(reference: paddle/phi/core/generator.h). JAX's threefry keys are functional;
+to give users Paddle's stateful ``paddle.seed()`` API we keep a global key and
+split on every draw. The distributed RNG tree (reference:
+python/paddle/distributed/fleet/layers/mpu/random.py — per-mp-rank dropout
+seeds) is layered on top in distributed/fleet/random.py.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "default_seed"]
+
+_lock = threading.Lock()
+_DEFAULT_SEED = 34342423252  # arbitrary fixed default so runs are reproducible
+_state = {"key": jax.random.key(_DEFAULT_SEED), "seed": _DEFAULT_SEED}
+
+# When tracing (jit.to_static), draws must come from a *traced* key argument
+# so compiled programs get fresh randomness per call instead of a baked
+# constant. jit/api.py pushes a traced key here for the trace duration.
+_traced_sources = []
+
+
+class traced_key_source:
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        _traced_sources.append([self.key])
+        return self
+
+    def __exit__(self, *exc):
+        _traced_sources.pop()
+        return False
+
+
+def seed(s: int):
+    """Set the global seed (reference: paddle.seed)."""
+    with _lock:
+        _state["key"] = jax.random.key(int(s))
+        _state["seed"] = int(s)
+    return s
+
+
+def default_seed() -> int:
+    return _state["seed"]
+
+
+def next_key(n: Optional[int] = None):
+    """Split the global key, returning ``n`` subkeys (or one)."""
+    if _traced_sources:
+        src = _traced_sources[-1]
+        if n is None:
+            src[0], sub = jax.random.split(src[0])
+            return sub
+        keys = jax.random.split(src[0], n + 1)
+        src[0] = keys[0]
+        return keys[1:]
+    with _lock:
+        k = _state["key"]
+        if n is None:
+            _state["key"], sub = jax.random.split(k)
+            return sub
+        keys = jax.random.split(k, n + 1)
+        _state["key"] = keys[0]
+        return keys[1:]
+
+
+def get_rng_state():
+    return _state["key"]
+
+
+def set_rng_state(key):
+    with _lock:
+        _state["key"] = key

@@ -1,0 +1,514 @@
+"""Tensor: the user-facing array type, wrapping ``jax.Array``.
+
+TPU-native re-design of the reference's eager tensor + autograd stack:
+
+- reference ``paddle::Tensor`` (paddle/phi/api/include/tensor.h:82) becomes a
+  thin Python wrapper over an immutable ``jax.Array`` living in HBM under
+  XLA/PjRt management — there is no allocator stack to rebuild
+  (reference paddle/phi/core/memory/allocation/ is superseded by PjRt).
+- reference eager autograd (GradNode graph built by generated ``*_ad_func``s,
+  paddle/fluid/eager/grad_node_info.h:197, backward.cc:106) becomes a tape of
+  ``jax.vjp`` closures: every eager op that touches a grad-requiring tensor is
+  executed through ``jax.vjp``, which runs the primal once and returns a pure
+  backward closure. ``Tensor.backward()`` walks this graph topologically —
+  functionally identical to the reference's queue-based RunBackward, but the
+  per-op backward is XLA-compiled instead of hand-written CUDA.
+
+Mutation ops (``__setitem__``, ``add_`` etc.) rebind the wrapped array to a new
+functional value (``x.at[...].set``), which XLA turns into in-place buffer
+updates via donation where possible.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .dtypes import (convert_dtype, dtype_name, get_default_dtype,
+                     is_floating_point, is_complex)
+from .flags import GLOBAL_FLAGS
+
+__all__ = [
+    "Tensor", "to_value", "wrap", "dispatch", "no_grad", "enable_grad",
+    "is_grad_enabled", "set_grad_enabled",
+]
+
+_state = threading.local()
+
+
+def _grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled()
+
+
+def set_grad_enabled(mode: bool):
+    _state.grad_enabled = bool(mode)
+
+
+class _GradModeGuard:
+    """Context manager + decorator toggling eager grad recording
+    (reference: python/paddle/base/dygraph/base.py no_grad_)."""
+
+    def __init__(self, mode: bool):
+        self._mode = mode
+        self._stack: List[bool] = []
+
+    def __enter__(self):
+        self._stack.append(_grad_enabled())
+        set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._stack.pop())
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with _GradModeGuard(self._mode):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def no_grad():
+    return _GradModeGuard(False)
+
+
+def enable_grad():
+    return _GradModeGuard(True)
+
+
+class GradNode:
+    """One recorded eager op: holds the vjp closure plus graph edges.
+
+    Mirrors reference GradNodeBase (paddle/fluid/eager/grad_node_info.h:197):
+    ``inputs`` are the edges to upstream nodes/leaves, ``vjp_fn`` plays the
+    role of the generated ``XxxGradNode::operator()``, and the saved residuals
+    inside the closure are the TensorWrappers.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "n_outputs", "name", "_out_shapes",
+                 "__weakref__")
+
+    def __init__(self, vjp_fn, inputs: Tuple["Tensor", ...], n_outputs: int,
+                 name: str):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.n_outputs = n_outputs
+        self.name = name
+        self._out_shapes = None
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_in={len(self.inputs)}>"
+
+
+_tensor_counter = [0]
+
+
+class Tensor:
+    """Eager tensor. ``stop_gradient`` defaults to True (reference semantics:
+    only Parameters and tensors the user marks trainable flow gradients)."""
+
+    __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_out_index",
+                 "name", "persistable", "_hooks", "trainable", "__weakref__",
+                 "_pp_meta")
+
+    def __init__(self, value, dtype=None, stop_gradient: bool = True,
+                 name: Optional[str] = None, persistable: bool = False):
+        if isinstance(value, Tensor):
+            value = value._value
+        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+            np_dtype = convert_dtype(dtype) if dtype is not None else None
+            arr = np.asarray(value)
+            if np_dtype is None and arr.dtype == np.float64:
+                np_dtype = get_default_dtype()
+            if np_dtype is None and arr.dtype == np.int64:
+                np_dtype = np.dtype(np.int64)
+            value = jnp.asarray(arr, dtype=np_dtype)
+        elif dtype is not None and value.dtype != convert_dtype(dtype):
+            value = value.astype(convert_dtype(dtype))
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._grad_node: Optional[GradNode] = None
+        self._out_index = 0
+        if name is None:
+            _tensor_counter[0] += 1
+            name = f"generated_tensor_{_tensor_counter[0]}"
+        self.name = name
+        self.persistable = persistable
+        self._hooks: List[Callable] = []
+        self.trainable = not stop_gradient
+
+    # -- metadata ------------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self._value.dtype)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        from ..device import _place_of
+        return _place_of(self._value)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def numel(self) -> int:
+        return self.size
+
+    @property
+    def T(self) -> "Tensor":
+        from ..tensor.linalg import t
+        return t(self)
+
+    @property
+    def mT(self) -> "Tensor":
+        return dispatch(lambda x: jnp.swapaxes(x, -1, -2), (self,),
+                        name="mT")
+
+    # -- conversion ----------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        d = convert_dtype(dtype)
+        return dispatch(lambda x: x.astype(d), (self,), name="cast")
+
+    cast = astype
+
+    def clone(self) -> "Tensor":
+        return dispatch(lambda x: x + 0 if x.dtype != jnp.bool_ else jnp.copy(x),
+                        (self,), name="clone")
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def cpu(self) -> "Tensor":
+        dev = jax.devices("cpu")[0]
+        return Tensor(jax.device_put(self._value, dev),
+                      stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        from ..device import _parse_to
+        return _parse_to(self, *args, **kwargs)
+
+    def pin_memory(self) -> "Tensor":
+        return self  # host staging is managed by PjRt transfer manager
+
+    def contiguous(self) -> "Tensor":
+        return self  # XLA arrays have no user-visible strides
+
+    def is_contiguous(self) -> bool:
+        return True
+
+    # -- autograd ------------------------------------------------------------
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False):
+        from ..autograd.backward import run_backward
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def register_hook(self, hook: Callable) -> Callable:
+        self._hooks.append(hook)
+
+        def remove():
+            if hook in self._hooks:
+                self._hooks.remove(hook)
+
+        remove.remove = remove
+        return remove
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._value))
+        else:
+            self.grad = None
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # -- mutation (functional rebinding) -------------------------------------
+    def _replace_value(self, new_value):
+        """In-place update: rebind the wrapped array. Only legal on tensors
+        that are not interior nodes of a live tape."""
+        self._value = new_value
+        return self
+
+    def copy_(self, other, blocking: bool = True) -> "Tensor":
+        v = to_value(other)
+        return self._replace_value(jnp.asarray(v, dtype=self._value.dtype))
+
+    def set_value(self, value):
+        v = to_value(value)
+        return self._replace_value(
+            jnp.asarray(v, dtype=self._value.dtype).reshape(self._value.shape))
+
+    def fill_(self, value) -> "Tensor":
+        return self._replace_value(jnp.full_like(self._value, value))
+
+    def zero_(self) -> "Tensor":
+        return self._replace_value(jnp.zeros_like(self._value))
+
+    def scale_(self, scale: float, bias: float = 0.0) -> "Tensor":
+        return self._replace_value(self._value * scale + bias)
+
+    # -- indexing ------------------------------------------------------------
+    def __getitem__(self, idx) -> "Tensor":
+        idx = _prepare_index(idx)
+        return dispatch(lambda x: x[idx], (self,), name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _prepare_index(idx)
+        v = to_value(value)
+        if _grad_enabled() and not self.stop_gradient:
+            vt = value if isinstance(value, Tensor) else Tensor(v)
+            out = dispatch(lambda x, y: x.at[idx].set(
+                jnp.asarray(y, dtype=x.dtype)), (self, vt), name="setitem")
+            # rebind: self now points at the new tape node
+            self._value = out._value
+            self._grad_node = out._grad_node
+            self._out_index = out._out_index
+        else:
+            self._value = self._value.at[idx].set(
+                jnp.asarray(v, dtype=self._value.dtype))
+
+    # -- python protocol -----------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __index__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __repr__(self):
+        prefix = "Tensor"
+        try:
+            limit = GLOBAL_FLAGS.get("tensor_print_max_numel")
+            if isinstance(self._value, jax.core.Tracer):
+                body = repr(self._value)
+            elif self.size > limit:
+                body = (f"[{self.size} elements, "
+                        f"mean={float(jnp.mean(jnp.abs(self._value)) if self.size else 0):.4g}]")
+            else:
+                body = np.array2string(self.numpy(), separator=", ")
+        except Exception:  # tracers inside transforms
+            body = object.__repr__(self._value)
+        return (f"{prefix}(shape={self.shape}, dtype={dtype_name(self.dtype)}, "
+                f"stop_gradient={self.stop_gradient},\n       {body})")
+
+    __str__ = __repr__
+
+
+def _prepare_index(idx):
+    """Unwrap Tensors inside an indexing expression."""
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_prepare_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_prepare_index(i) for i in idx]
+    return idx
+
+
+def to_value(x):
+    """Extract the raw jax value from a Tensor (identity otherwise)."""
+    if isinstance(x, Tensor):
+        return x._value
+    return x
+
+
+def wrap(value, stop_gradient: bool = True) -> Tensor:
+    return Tensor(value, stop_gradient=stop_gradient)
+
+
+def _maybe_check_nan(name, values):
+    if not GLOBAL_FLAGS.get("check_nan_inf"):
+        return
+    for v in values:
+        if isinstance(v, jax.core.Tracer) or not jnp.issubdtype(
+                v.dtype, jnp.inexact):
+            continue
+        bad = bool(jnp.any(~jnp.isfinite(v)))
+        if bad:
+            level = GLOBAL_FLAGS.get("check_nan_inf_level")
+            msg = f"NaN/Inf detected in output of op '{name}'"
+            if level == 0:
+                raise FloatingPointError(msg)
+            import logging
+            logging.getLogger("paddle_tpu").warning(msg)
+
+
+def dispatch(fn, tensor_args: Sequence[Any], name: str = "op",
+             multi_output: bool = False, **static_kwargs):
+    """Eager op dispatch: the TPU-native analog of the generated
+    ``xxx_ad_func`` + PHI dispatch chain (reference call stack SURVEY §3.1).
+
+    ``fn`` is a pure jax function of the *positional* tensor args (raw values)
+    plus static kwargs. If grad is enabled and any input requires grad, run
+    through ``jax.vjp`` and record a GradNode; else run directly.
+    """
+    values = tuple(to_value(a) for a in tensor_args)
+    tensors = tuple(a if isinstance(a, Tensor) else None for a in tensor_args)
+
+    # AMP O1: per-op cast at dispatch (reference: eager AmpAutoCast,
+    # paddle/fluid/eager/amp_auto_cast.h)
+    from ..amp.auto_cast import amp_state, maybe_cast_inputs
+    if amp_state.enabled:
+        values = maybe_cast_inputs(name, values)
+
+    needs_grad = _grad_enabled() and any(
+        t is not None and not t.stop_gradient for t in tensors)
+
+    if static_kwargs:
+        base_fn = fn
+        fn = lambda *vals: base_fn(*vals, **static_kwargs)
+
+    if not needs_grad:
+        out_vals = fn(*values)
+        if GLOBAL_FLAGS.get("benchmark"):
+            jax.block_until_ready(out_vals)
+        outs = tuple(out_vals) if multi_output else (out_vals,)
+        _maybe_check_nan(name, [o for o in outs if isinstance(o, jax.Array)])
+        result = tuple(
+            Tensor(o, stop_gradient=True) if not isinstance(o, Tensor) else o
+            for o in outs)
+        return result if multi_output else result[0]
+
+    out_vals, vjp_fn = jax.vjp(fn, *values)
+    outs = tuple(out_vals) if multi_output else (out_vals,)
+    _maybe_check_nan(name, [o for o in outs if isinstance(o, jax.Array)])
+    node = GradNode(vjp_fn, tensors, len(outs), name)
+    if len(outs) > 1:
+        node._out_shapes = [(o.shape, o.dtype) for o in outs]
+    results = []
+    for i, o in enumerate(outs):
+        t = Tensor(o, stop_gradient=False)
+        t._grad_node = node
+        t._out_index = i
+        results.append(t)
+    if GLOBAL_FLAGS.get("benchmark"):
+        jax.block_until_ready(out_vals)
+    return tuple(results) if multi_output else results[0]
+
+
+# -- pytree registration -----------------------------------------------------
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    stop_gradient, name = aux
+    out = Tensor(children[0], stop_gradient=stop_gradient, name=name)
+    return out
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+# -- operator overloads ------------------------------------------------------
+def _binop(name, fn, reverse=False):
+    def op(self, other):
+        if isinstance(other, (list, tuple, np.ndarray)):
+            other = Tensor(other)
+        if not isinstance(other, (Tensor, int, float, bool, complex,
+                                  jax.Array, np.generic)):
+            return NotImplemented
+        a, b = (other, self) if reverse else (self, other)
+        if not isinstance(a, Tensor) and not isinstance(b, Tensor):
+            return NotImplemented
+        return dispatch(fn, (a, b), name=name)
+    return op
+
+
+Tensor.__add__ = _binop("add", lambda x, y: jnp.add(x, y))
+Tensor.__radd__ = _binop("add", lambda x, y: jnp.add(x, y), reverse=True)
+Tensor.__sub__ = _binop("subtract", lambda x, y: jnp.subtract(x, y))
+Tensor.__rsub__ = _binop("subtract", lambda x, y: jnp.subtract(x, y), True)
+Tensor.__mul__ = _binop("multiply", lambda x, y: jnp.multiply(x, y))
+Tensor.__rmul__ = _binop("multiply", lambda x, y: jnp.multiply(x, y), True)
+Tensor.__truediv__ = _binop("divide", lambda x, y: jnp.true_divide(x, y))
+Tensor.__rtruediv__ = _binop("divide", lambda x, y: jnp.true_divide(x, y), True)
+Tensor.__floordiv__ = _binop("floor_divide", lambda x, y: jnp.floor_divide(x, y))
+Tensor.__rfloordiv__ = _binop("floor_divide",
+                              lambda x, y: jnp.floor_divide(x, y), True)
+Tensor.__mod__ = _binop("remainder", lambda x, y: jnp.remainder(x, y))
+Tensor.__rmod__ = _binop("remainder", lambda x, y: jnp.remainder(x, y), True)
+Tensor.__pow__ = _binop("pow", lambda x, y: jnp.power(x, y))
+Tensor.__rpow__ = _binop("pow", lambda x, y: jnp.power(x, y), True)
+Tensor.__matmul__ = _binop("matmul", lambda x, y: jnp.matmul(x, y))
+Tensor.__rmatmul__ = _binop("matmul", lambda x, y: jnp.matmul(x, y), True)
+Tensor.__eq__ = _binop("equal", lambda x, y: jnp.equal(x, y))
+Tensor.__ne__ = _binop("not_equal", lambda x, y: jnp.not_equal(x, y))
+Tensor.__lt__ = _binop("less_than", lambda x, y: jnp.less(x, y))
+Tensor.__le__ = _binop("less_equal", lambda x, y: jnp.less_equal(x, y))
+Tensor.__gt__ = _binop("greater_than", lambda x, y: jnp.greater(x, y))
+Tensor.__ge__ = _binop("greater_equal", lambda x, y: jnp.greater_equal(x, y))
+Tensor.__and__ = _binop("bitwise_and", lambda x, y: jnp.bitwise_and(x, y))
+Tensor.__or__ = _binop("bitwise_or", lambda x, y: jnp.bitwise_or(x, y))
+Tensor.__xor__ = _binop("bitwise_xor", lambda x, y: jnp.bitwise_xor(x, y))
+Tensor.__lshift__ = _binop("lshift", lambda x, y: jnp.left_shift(x, y))
+Tensor.__rshift__ = _binop("rshift", lambda x, y: jnp.right_shift(x, y))
+Tensor.__neg__ = lambda self: dispatch(jnp.negative, (self,), name="negative")
+Tensor.__pos__ = lambda self: self
+Tensor.__abs__ = lambda self: dispatch(jnp.abs, (self,), name="abs")
+Tensor.__invert__ = lambda self: dispatch(jnp.invert, (self,), name="invert")

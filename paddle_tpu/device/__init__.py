@@ -1,0 +1,228 @@
+"""Device API.
+
+TPU-native equivalent of reference ``paddle.device``
+(python/paddle/device/__init__.py:284 set_device) and the Place hierarchy
+(paddle/phi/common/place.h). Devices come from PjRt via ``jax.devices()``;
+Places are thin named handles: ``tpu:0``, ``cpu``, ``gpu:0``.
+
+There is no stream/event API to re-expose: XLA owns scheduling (async
+dispatch + latency-hiding scheduler replace the reference's manual
+calc/comm-stream model, reference paddle/phi/core/device_context.h).
+``synchronize()`` maps to blocking on all live arrays.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Union
+
+import jax
+
+__all__ = [
+    "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "XPUPlace",
+    "set_device", "get_device", "get_all_devices", "device_count",
+    "is_compiled_with_cuda", "is_compiled_with_xpu", "is_compiled_with_rocm",
+    "is_compiled_with_tpu", "synchronize", "get_default_backend",
+]
+
+
+class Place:
+    """Named device handle (reference: phi::Place)."""
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and other.device_type == self.device_type
+                and other.device_id == self.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    @property
+    def jax_device(self):
+        plat = _BACKEND_ALIASES.get(self.device_type, self.device_type)
+        devs = [d for d in jax.devices() if d.platform == plat]
+        if not devs:  # fall back to addressable non-cpu or cpu
+            devs = jax.devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def is_gpu_place(self):
+        return self.device_type in ("gpu", "cuda")
+
+    def is_tpu_place(self):
+        return self.device_type in ("tpu", "axon")
+
+
+def CPUPlace():
+    return Place("cpu")
+
+
+def TPUPlace(device_id: int = 0):
+    return Place("tpu", device_id)
+
+
+def CUDAPlace(device_id: int = 0):
+    return Place("gpu", device_id)
+
+
+def XPUPlace(device_id: int = 0):
+    return Place("xpu", device_id)
+
+
+# the axon tunnel exposes TPUs under platform name "axon" in some builds
+_BACKEND_ALIASES = {"gpu": "cuda", "tpu": "tpu"}
+
+_current = threading.local()
+
+
+def _accelerator_platform() -> Optional[str]:
+    plats = {d.platform for d in jax.devices()}
+    for p in ("tpu", "axon", "cuda", "rocm"):
+        if p in plats:
+            return p
+    return None
+
+
+def get_default_backend() -> str:
+    p = _accelerator_platform()
+    if p in ("tpu", "axon"):
+        return "tpu"
+    if p in ("cuda", "rocm"):
+        return "gpu"
+    return "cpu"
+
+
+def set_device(device: Union[str, Place]) -> Place:
+    """reference: python/paddle/device/__init__.py:284."""
+    if isinstance(device, Place):
+        place = device
+    else:
+        device = device.lower()
+        if ":" in device:
+            kind, idx = device.split(":")
+            place = Place(kind, int(idx))
+        else:
+            place = Place(device, 0)
+    _current.place = place
+    try:
+        jax.config.update("jax_default_device", place.jax_device)
+    except Exception:
+        pass
+    return place
+
+
+def get_device() -> str:
+    place = getattr(_current, "place", None)
+    if place is None:
+        kind = get_default_backend()
+        place = Place(kind, 0)
+    if place.device_type == "cpu":
+        return "cpu"
+    return f"{place.device_type}:{place.device_id}"
+
+
+def get_current_place() -> Place:
+    place = getattr(_current, "place", None)
+    if place is None:
+        place = Place(get_default_backend(), 0)
+    return place
+
+
+def get_all_devices() -> List[str]:
+    out = []
+    for d in jax.devices():
+        kind = "tpu" if d.platform in ("tpu", "axon") else d.platform
+        out.append(f"{kind}:{d.id}")
+    return out
+
+
+def device_count(device_type: Optional[str] = None) -> int:
+    if device_type is None:
+        return jax.device_count()
+    plat = _BACKEND_ALIASES.get(device_type, device_type)
+    return len([d for d in jax.devices() if d.platform == plat
+                or (plat == "tpu" and d.platform == "axon")])
+
+
+def is_compiled_with_cuda() -> bool:
+    return any(d.platform == "cuda" for d in jax.devices())
+
+
+def is_compiled_with_rocm() -> bool:
+    return any(d.platform == "rocm" for d in jax.devices())
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+
+def synchronize(device=None):
+    """Block until all pending XLA work completes (reference:
+    paddle.device.synchronize / cudaDeviceSynchronize). XLA has no user
+    streams; effectively a fence via a trivial blocking transfer."""
+    import jax.numpy as jnp
+    jax.block_until_ready(jnp.zeros(()))
+
+
+def _place_of(value) -> Place:
+    try:
+        dev = list(value.devices())[0] if hasattr(value, "devices") else None
+    except Exception:
+        dev = None
+    if dev is None:
+        return Place("cpu")
+    kind = "tpu" if dev.platform in ("tpu", "axon") else dev.platform
+    return Place(kind, dev.id)
+
+
+def _parse_to(tensor, *args, **kwargs):
+    """Implements Tensor.to(device|dtype|tensor, ...)."""
+    from ..core.tensor import Tensor
+    from ..core.dtypes import convert_dtype
+    device = kwargs.pop("device", None)
+    dtype = kwargs.pop("dtype", None)
+    kwargs.pop("blocking", None)
+    for a in args:
+        if isinstance(a, (str, Place)):
+            try:
+                dtype = convert_dtype(a) if isinstance(a, str) else dtype
+                if dtype is not None and isinstance(a, str) and ":" not in a \
+                        and a not in ("cpu", "gpu", "tpu", "xpu"):
+                    continue
+            except (ValueError, TypeError):
+                pass
+            device = a
+        elif isinstance(a, Tensor):
+            dtype = a.dtype
+            device = a.place
+        else:
+            dtype = a
+    value = tensor._value
+    if device is not None:
+        place = set_device.__wrapped__(device) if False else (
+            device if isinstance(device, Place) else _str_to_place(device))
+        value = jax.device_put(value, place.jax_device)
+    if dtype is not None:
+        value = value.astype(convert_dtype(dtype))
+    out = Tensor(value, stop_gradient=tensor.stop_gradient)
+    return out
+
+
+def _str_to_place(device: str) -> Place:
+    device = device.lower()
+    if ":" in device:
+        kind, idx = device.split(":")
+        return Place(kind, int(idx))
+    return Place(device, 0)

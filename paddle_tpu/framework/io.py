@@ -1,0 +1,70 @@
+"""paddle.save / paddle.load equivalent
+(reference: python/paddle/framework/io.py).
+
+Serialisation format: a pickle of the object tree with Tensors replaced by
+numpy arrays plus a small header — loadable without TPU devices. Distributed
+sharded checkpointing lives in distributed/checkpoint/.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_MAGIC = b"PDTPU001"
+
+
+class _TensorPayload:
+    __slots__ = ("array", "stop_gradient", "name")
+
+    def __init__(self, array, stop_gradient, name):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj.numpy(), obj.stop_gradient, obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(obj.array, stop_gradient=obj.stop_gradient, name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            f.seek(0)  # plain pickle fallback
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,168 @@
+"""High-level Model API (reference: python/paddle/hapi/model.py:1472)."""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad
+from ..metric import Metric
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+        return self
+
+    def _compute_loss(self, outputs, labels):
+        if callable(self._loss):
+            return self._loss(outputs, labels)
+        raise RuntimeError("call prepare(loss=...) first")
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(outputs, labels))
+            metrics.append(m.accumulate())
+        return (float(loss.item()), metrics) if metrics else \
+            float(loss.item())
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(outputs, labels))
+            metrics.append(m.accumulate())
+        return (float(loss.item()), metrics) if metrics else \
+            float(loss.item())
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self.network(*inputs)
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        it = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            t0 = time.time()
+            for step, batch in enumerate(train_loader):
+                *xs, y = batch if isinstance(batch, (list, tuple)) else \
+                    (batch,)
+                res = self.train_batch(xs, y)
+                it += 1
+                if verbose and step % log_freq == 0:
+                    loss = res[0] if isinstance(res, tuple) else res
+                    print(f"Epoch {epoch + 1}/{epochs} step {step} "
+                          f"loss: {loss:.4f}")
+                if num_iters is not None and it >= num_iters:
+                    return
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+
+    @no_grad()
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            *xs, y = batch if isinstance(batch, (list, tuple)) else (batch,)
+            res = self.eval_batch(xs, y)
+            losses.append(res[0] if isinstance(res, tuple) else res)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        result = {"loss": [float(np.mean(losses))]}
+        for m in self._metrics:
+            result[m.name() if isinstance(m.name(), str) else
+                   m.name()[0]] = m.accumulate()
+        if verbose:
+            print("Eval:", result)
+        return result
+
+    @no_grad()
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for batch in loader:
+            xs = batch[:-1] if isinstance(batch, (list, tuple)) and \
+                len(batch) > 1 else (batch if isinstance(batch, (list, tuple))
+                                     else [batch])
+            outputs.append(self.predict_batch(list(xs)))
+        return outputs
+
+    def save(self, path, training=True):
+        from ..framework.io import save as psave
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as pload
+        state = pload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        if not reset_optimizer and self._optimizer is not None:
+            import os
+            if os.path.exists(path + ".pdopt"):
+                self._optimizer.set_state_dict(pload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+        return summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,101 @@
+"""Fused-op python APIs (reference: python/paddle/incubate/nn/functional/ —
+fused_rms_norm, fused_rotary_position_embedding, swiglu, fused_adamw,
+variable_length_memory_efficient_attention, block_multihead_attention, …).
+
+On TPU these route to the ops/ pack (Pallas kernels + XLA compositions)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, dispatch
+from .... import ops as _ops
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    def f(v, w, *b):
+        out = _ops.rms_norm(v, w, epsilon)
+        if b:
+            out = out + b[0]
+        return out
+    args = (_ensure(x), _ensure(norm_weight))
+    if norm_bias is not None:
+        args += (_ensure(norm_bias),)
+    out = dispatch(f, args, name="rms_norm")
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, **kw):
+    args = (_ensure(x), _ensure(norm_weight), _ensure(norm_bias))
+    return dispatch(lambda v, w, b: _ops.layer_norm(v, w, b, epsilon), args,
+                    name="layer_norm")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    from ....ops.rope import apply_rope
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        args = [_ensure(t)]
+        extra = {}
+        sin_v = sin._value if isinstance(sin, Tensor) else sin
+        cos_v = cos._value if isinstance(cos, Tensor) else cos
+        pid = position_ids._value if isinstance(position_ids, Tensor) \
+            else position_ids
+        outs.append(dispatch(
+            lambda x: apply_rope(x, sin_v, cos_v, pid,
+                                 use_neox_rotary_style),
+            (args[0],), name="fused_rope"))
+    return tuple(outs)
+
+
+def swiglu(x, y=None, name=None):
+    if y is None:
+        return dispatch(lambda v: _ops.swiglu(v), (_ensure(x),),
+                        name="swiglu")
+    return dispatch(lambda a, b: _ops.swiglu(a, b),
+                    (_ensure(x), _ensure(y)), name="swiglu")
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    import jax
+    acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "swiglu": _ops.swiglu, "geglu": None}
+
+    def f(v, *b):
+        if b:
+            v = v + b[0]
+        return acts[act_method](v)
+    args = (_ensure(x),)
+    if bias is not None:
+        args += (_ensure(bias),)
+    return dispatch(f, args, name="fused_bias_act")
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    def f(v, w, *b):
+        if transpose_weight:
+            w = w.T
+        out = v @ w
+        if b:
+            out = out + b[0]
+        return out
+    args = (_ensure(x), _ensure(weight))
+    if bias is not None:
+        args += (_ensure(bias),)
+    return dispatch(f, args, name="matmul")
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    from ....nn.functional.common import dropout
+    return dropout(x, p=p, training=training, mode=mode) + y

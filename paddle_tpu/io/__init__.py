@@ -1,0 +1,23 @@
+"""Data loading (reference: python/paddle/io/).
+
+TPU-native design: the DataLoader keeps the reference surface (Dataset,
+samplers, workers, collate) but adds device prefetch — batches are staged to
+the accelerator asynchronously so input pipeline overlaps compute, replacing
+the reference's shared-memory worker IPC + pin-memory path
+(python/paddle/io/dataloader/dataloader_iter.py:368).
+"""
+from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
+                      ComposeDataset, ChainDataset, Subset, ConcatDataset,
+                      random_split)
+from .sampler import (Sampler, SequenceSampler, RandomSampler,  # noqa: F401
+                      WeightedRandomSampler, BatchSampler,
+                      SubsetRandomSampler, DistributedBatchSampler)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "Subset", "ConcatDataset", "random_split", "Sampler",
+    "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "BatchSampler", "SubsetRandomSampler", "DistributedBatchSampler",
+    "DataLoader",
+]

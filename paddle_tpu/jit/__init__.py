@@ -1,0 +1,6 @@
+"""paddle_tpu.jit (reference: python/paddle/jit/__init__.py)."""
+from .api import (to_static, not_to_static, ignore_module,  # noqa: F401
+                  TracedFunction, enable_to_static)
+from .save_load import save, load, TranslatedLayer  # noqa: F401
+
+__all__ = ["to_static", "not_to_static", "save", "load", "enable_to_static"]

@@ -1,0 +1,279 @@
+"""jit.to_static: whole-program compilation.
+
+TPU-native re-design of the reference dy2static stack (python/paddle/jit/
+api.py:197 to_static, SOT bytecode tracer python/paddle/jit/sot/, CINN):
+instead of bytecode capture + PIR + CINN, the eager code is traced by JAX
+into ONE pure jaxpr (parameters/buffers/inputs as traced args), compiled by
+XLA, and the compiled call is recorded as a single node on the eager autograd
+tape — so ``loss.backward()`` runs the XLA-compiled backward. Guards =
+jax.jit's shape/dtype cache keys plus explicit static-arg keys.
+
+Parameter discovery: one eager "discovery" pass runs the function with a
+dispatch hook that records every persistable leaf Tensor touched (parameters
+and registered buffers) — the analog of the reference's program translator
+collecting ``Parameter`` vars.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, no_grad, to_value
+from ..core import tensor as tensor_mod
+from ..core.random import next_key, traced_key_source
+
+__all__ = ["to_static", "not_to_static", "ignore_module", "TracedFunction",
+           "enable_to_static"]
+
+_collector = threading.local()
+
+
+def _collect_hook(t: Tensor):
+    seen = getattr(_collector, "tensors", None)
+    if seen is not None and t.persistable and t._grad_node is None \
+            and id(t) not in seen:
+        seen[id(t)] = t
+
+
+# patch dispatch to surface persistable leaves during discovery
+_orig_dispatch = tensor_mod.dispatch
+
+
+def _dispatch_with_collection(fn, tensor_args, name="op", multi_output=False,
+                              **kw):
+    if getattr(_collector, "tensors", None) is not None:
+        for a in tensor_args:
+            if isinstance(a, Tensor):
+                _collect_hook(a)
+    return _orig_dispatch(fn, tensor_args, name=name,
+                          multi_output=multi_output, **kw)
+
+
+def _install_collector_patch():
+    if tensor_mod.dispatch is not _dispatch_with_collection:
+        tensor_mod.dispatch = _dispatch_with_collection
+        # rebind in modules that imported dispatch by name
+        import sys
+        for mod_name, mod in list(sys.modules.items()):
+            if mod_name.startswith("paddle_tpu") and mod is not None and \
+                    getattr(mod, "dispatch", None) is _orig_dispatch:
+                mod.dispatch = _dispatch_with_collection
+
+
+class TracedFunction:
+    """The compiled callable returned by to_static
+    (reference: StaticFunction, python/paddle/jit/dy2static/
+    program_translator.py:839)."""
+
+    def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
+                 full_graph=True, backend=None, layer=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache: Dict[Any, Tuple] = {}
+        self._params: Optional[List[Tensor]] = None
+        self._buffers: Optional[List[Tensor]] = None
+        self._enabled = True
+        functools.update_wrapper(self, fn)
+
+    # -- discovery -----------------------------------------------------------
+    def _discover(self, args, kwargs):
+        _install_collector_patch()
+        _collector.tensors = {}
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            found = _collector.tensors
+            _collector.tensors = None
+        tensors = list(found.values())
+        if self._layer is not None:
+            # deterministic order + completeness from the layer registries
+            ordered = list(dict.fromkeys(
+                list(self._layer.parameters()) +
+                list(self._layer.buffers()) + tensors))
+            tensors = ordered
+        params = [t for t in tensors if not t.stop_gradient]
+        buffers = [t for t in tensors if t.stop_gradient]
+        self._params = params
+        self._buffers = buffers
+        return out
+
+    # -- cache key -----------------------------------------------------------
+    def _key(self, args, kwargs):
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        sig = []
+        for l in leaves:
+            if isinstance(l, Tensor):
+                sig.append(("T", tuple(l.shape), str(l.dtype)))
+            elif isinstance(l, (jax.Array, np.ndarray)):
+                sig.append(("A", tuple(l.shape), str(l.dtype)))
+            else:
+                sig.append(("S", l))
+        training = getattr(self._layer, "training", None)
+        from ..amp.auto_cast import amp_state
+        return (treedef, tuple(sig), training, amp_state.enabled,
+                str(amp_state.dtype) if amp_state.enabled else "")
+
+    # -- build ---------------------------------------------------------------
+    def _build(self, args, kwargs):
+        params, buffers = self._params, self._buffers
+        fn = self._fn
+
+        # record output structure during a traced run
+        out_tree = [None]
+
+        def pure(param_vals, buffer_vals, rng_key, in_leaves, treedef):
+            saved = [t._value for t in params]
+            saved_b = [t._value for t in buffers]
+            for t, v in zip(params, param_vals):
+                t._value = v
+            for t, v in zip(buffers, buffer_vals):
+                t._value = v
+            try:
+                wrapped = [Tensor(l, stop_gradient=True)
+                           if isinstance(l, (jax.Array, jax.core.Tracer))
+                           else l for l in in_leaves]
+                a, kw = jax.tree_util.tree_unflatten(treedef, wrapped)
+                with no_grad(), traced_key_source(rng_key):
+                    out = fn(*a, **kw)
+                out_leaves, tree = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                out_tree[0] = tree
+                out_vals = [to_value(o) if isinstance(o, Tensor) else o
+                            for o in out_leaves]
+                new_buf = [t._value for t in buffers]
+                return tuple(out_vals) + tuple(new_buf)
+            finally:
+                for t, v in zip(params, saved):
+                    t._value = v
+                for t, v in zip(buffers, saved_b):
+                    t._value = v
+
+        return pure, out_tree
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        if not self._enabled or not _to_static_enabled[0]:
+            return self._fn(*args, **kwargs)
+        if self._params is None:
+            self._discover(args, kwargs)  # eager warmup defines params
+        key = self._key(args, kwargs)
+        entry = self._cache.get(key)
+        in_leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        tensor_leaf_idx = [i for i, l in enumerate(in_leaves)
+                          if isinstance(l, (Tensor, jax.Array, np.ndarray))]
+        if entry is None:
+            pure, out_tree = self._build(args, kwargs)
+
+            def flat_fn(*flat):
+                np_, nb = len(self._params), len(self._buffers)
+                param_vals = flat[:np_]
+                buffer_vals = flat[np_:np_ + nb]
+                rng_key = flat[np_ + nb]
+                tensor_in = flat[np_ + nb + 1:]
+                leaves = list(in_leaves)
+                for i, v in zip(tensor_leaf_idx, tensor_in):
+                    leaves[i] = v
+                return pure(param_vals, buffer_vals, rng_key, leaves,
+                            treedef)
+            # jit => one XLA program for the whole forward; grad-of-jit
+            # compiles the backward too (the CINN-equivalent step)
+            flat_fn = jax.jit(flat_fn)
+            entry = (flat_fn, out_tree)
+            self._cache[key] = entry
+        flat_fn, out_tree = entry
+        tensor_in = [to_value(in_leaves[i]) if isinstance(in_leaves[i], Tensor)
+                     else jnp.asarray(in_leaves[i]) for i in tensor_leaf_idx]
+        rng = next_key()
+        all_args = tuple(self._params) + tuple(self._buffers) + (
+            Tensor(rng),) + tuple(
+            in_leaves[i] if isinstance(in_leaves[i], Tensor) else
+            Tensor(jnp.asarray(in_leaves[i])) for i in tensor_leaf_idx)
+        outs = dispatch(flat_fn, all_args, name="to_static",
+                        multi_output=True)
+        n_buf = len(self._buffers)
+        out_vals = outs[:len(outs) - n_buf]
+        new_buf = outs[len(outs) - n_buf:]
+        with no_grad():
+            for t, v in zip(self._buffers, new_buf):
+                t._value = v._value
+        return jax.tree_util.tree_unflatten(out_tree[0], list(out_vals))
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def parameters(self):
+        return self._params
+
+    def concrete_program(self):
+        return self._cache
+
+    def rollback(self):
+        self._enabled = False
+        return self._fn
+
+
+_to_static_enabled = [True]
+
+
+def enable_to_static(flag: bool):
+    _to_static_enabled[0] = bool(flag)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """reference: python/paddle/jit/api.py:197."""
+    from ..nn import Layer
+
+    def decorate(obj):
+        if isinstance(obj, Layer):
+            traced = TracedFunction(obj.__call__, input_spec, build_strategy,
+                                    full_graph, backend, layer=obj)
+            obj.forward_static = traced
+            orig_call = obj.__call__
+            obj._traced = traced
+            # route layer calls through the compiled path
+            object.__setattr__(obj, "__call_traced__", traced)
+            obj.forward_original = obj.forward
+            return _LayerProxy(obj, traced)
+        return TracedFunction(obj, input_spec, build_strategy, full_graph,
+                              backend)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+class _LayerProxy:
+    """Wraps a Layer so calling it hits the compiled path while attribute
+    access falls through (mirrors reference behavior where to_static(layer)
+    returns the layer with a patched forward)."""
+
+    def __init__(self, layer, traced):
+        object.__setattr__(self, "_layer", layer)
+        object.__setattr__(self, "_traced", traced)
+
+    def __call__(self, *args, **kwargs):
+        return self._traced(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+    def __setattr__(self, name, value):
+        setattr(self._layer, name, value)
+
+
+def not_to_static(fn=None):
+    if fn is None:
+        return lambda f: f
+    return fn
+
+
+def ignore_module(modules):
+    return None

@@ -1,0 +1,122 @@
+"""jit.save / jit.load (reference: python/paddle/jit/api.py:956 save,
+pir_translated_layer.py).
+
+TPU-native format: StableHLO text of the compiled forward + a params pickle.
+A loaded ``TranslatedLayer`` replays the StableHLO module for inference (the
+reference's deploy path through PIR programs); if StableHLO export is
+unavailable for a program, falls back to re-tracing a pickled callable is NOT
+attempted — weights + spec are still saved so the model can be rebuilt.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, no_grad, to_value
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def _spec_of(v):
+    return {"shape": list(np.shape(v)), "dtype": str(np.asarray(v).dtype
+                                                     if not hasattr(v, "dtype")
+                                                     else v.dtype)}
+
+
+@no_grad()
+def save(layer, path: str, input_spec=None, **configs):
+    """Serialise forward as StableHLO + weights
+    (reference: python/paddle/jit/api.py:956)."""
+    from ..nn import Layer
+    from ..static import InputSpec
+    from .api import TracedFunction, _LayerProxy
+
+    if isinstance(layer, _LayerProxy):
+        layer = layer._layer
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    if isinstance(layer, Layer):
+        layer.eval()
+        pure_fn, params, buffers = layer.functional()
+        state = {k: np.asarray(v) for k, v in {**params, **buffers}.items()}
+        if input_spec is None:
+            raise ValueError("jit.save requires input_spec for a Layer "
+                             "(shapes must be static for AOT export)")
+        example = []
+        for spec in input_spec:
+            if isinstance(spec, InputSpec):
+                shape = [1 if (s is None or s < 0) else s for s in spec.shape]
+                example.append(jnp.zeros(shape, dtype=spec.dtype))
+            elif isinstance(spec, Tensor):
+                example.append(to_value(spec))
+            else:
+                example.append(jnp.asarray(spec))
+
+        def fwd(params, buffers, *inputs):
+            out, _ = pure_fn(params, buffers, *inputs)
+            return out
+
+        from jax import export as jax_export
+        exported = jax_export.export(jax.jit(fwd))(params, buffers, *example)
+        hlo = exported.mlir_module()
+        with open(path + ".stablehlo.mlir", "w") as f:
+            f.write(hlo)
+        meta = {
+            "format": "stablehlo",
+            "inputs": [_spec_of(e) for e in example],
+            "param_keys": list(params.keys()),
+            "buffer_keys": list(buffers.keys()),
+        }
+        with open(path + ".meta.json", "w") as f:
+            json.dump(meta, f)
+        with open(path + ".pdiparams", "wb") as f:
+            pickle.dump(state, f, protocol=4)
+        # keep the serialised Exported for exact reload
+        with open(path + ".exported", "wb") as f:
+            f.write(exported.serialize())
+        return path
+    raise TypeError("jit.save expects a Layer (functions: use "
+                    "paddle_tpu.static.export_stablehlo)")
+
+
+class TranslatedLayer:
+    """Inference-only callable rebuilt from an exported program
+    (reference: python/paddle/jit/translated_layer.py)."""
+
+    def __init__(self, exported, state, meta):
+        self._exported = exported
+        self._params = {k: jnp.asarray(state[k])
+                        for k in meta["param_keys"]}
+        self._buffers = {k: jnp.asarray(state[k])
+                         for k in meta["buffer_keys"]}
+        self._meta = meta
+        self.training = False
+
+    def __call__(self, *inputs):
+        vals = [to_value(i) if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        out = self._exported.call(self._params, self._buffers, *vals)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    def eval(self):
+        return self
+
+    def forward(self, *inputs):
+        return self(*inputs)
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    from jax import export as jax_export
+    with open(path + ".exported", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    with open(path + ".meta.json") as f:
+        meta = json.load(f)
+    return TranslatedLayer(exported, state, meta)

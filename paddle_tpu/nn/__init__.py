@@ -1,0 +1,18 @@
+"""paddle_tpu.nn (reference: python/paddle/nn/__init__.py)."""
+from . import initializer  # noqa: F401
+from . import functional  # noqa: F401
+
+from .layer.layers import (Layer, Sequential, LayerList, ParameterList,  # noqa
+                           LayerDict)
+from .layer.common import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
+                   ClipGradByGlobalNorm, clip_grad_norm_, clip_grad_value_)
+
+from . import utils  # noqa: F401

@@ -1,0 +1,16 @@
+"""paddle_tpu.nn.functional
+(reference: python/paddle/nn/functional/__init__.py)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+from .vision import *  # noqa: F401,F403
+
+from .activation import (relu, gelu, silu, swish, softmax, log_softmax,  # noqa
+                         sigmoid, tanh, swiglu)
+from .common import linear, dropout, embedding, interpolate  # noqa: F401
+from .conv import conv1d, conv2d, conv3d  # noqa: F401
+from .attention import scaled_dot_product_attention  # noqa: F401

@@ -1,0 +1,208 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+All map to jnp/jax.nn primitives; XLA fuses them into neighbouring matmuls
+(the reference needs hand-fused CUDA epilogues for this,
+paddle/phi/kernels/fusion/).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _u(name, fn):
+    def op(x, name=None):
+        return dispatch(fn, (_ensure(x),), name=op.__name__)
+    op.__name__ = name
+    return op
+
+
+relu = _u("relu", jax.nn.relu)
+relu6 = _u("relu6", jax.nn.relu6)
+sigmoid = _u("sigmoid", jax.nn.sigmoid)
+tanh = _u("tanh", jnp.tanh)
+silu = _u("silu", jax.nn.silu)
+swish = silu
+mish = _u("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+tanhshrink = _u("tanhshrink", lambda v: v - jnp.tanh(v))
+softsign = _u("softsign", jax.nn.soft_sign)
+
+
+def relu_(x, name=None):
+    x._replace_value(jax.nn.relu(x._value))
+    return x
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch(lambda v: jax.nn.gelu(v, approximate=approximate),
+                    (_ensure(x),), name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch(lambda v: jax.nn.leaky_relu(v, negative_slope),
+                    (_ensure(x),), name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch(lambda v: jax.nn.elu(v, alpha), (_ensure(x),), name="elu")
+
+
+def elu_(x, alpha=1.0, name=None):
+    x._replace_value(jax.nn.elu(x._value, alpha))
+    return x
+
+
+def celu(x, alpha=1.0, name=None):
+    return dispatch(lambda v: jax.nn.celu(v, alpha), (_ensure(x),),
+                    name="celu")
+
+
+def selu(x,
+         scale=1.0507009873554804934193349852946,
+         alpha=1.6732632423543772848170429916717, name=None):
+    return dispatch(lambda v: scale * jnp.where(
+        v > 0, v, alpha * jnp.expm1(v)), (_ensure(x),), name="selu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+    return dispatch(f, (_ensure(x), _ensure(weight)), name="prelu")
+
+
+def rrelu(x, lower=1. / 8., upper=1. / 3., training=False, name=None):
+    if training:
+        from ...core.random import next_key
+        def f(v):
+            a = jax.random.uniform(next_key(), v.shape, dtype=jnp.float32,
+                                   minval=lower, maxval=upper).astype(v.dtype)
+            return jnp.where(v >= 0, v, a * v)
+        return dispatch(f, (_ensure(x),), name="rrelu")
+    mid = (lower + upper) / 2.0
+    return dispatch(lambda v: jnp.where(v >= 0, v, mid * v), (_ensure(x),),
+                    name="rrelu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return dispatch(lambda v: jnp.clip(v, min, max), (_ensure(x),),
+                    name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0),
+                    (_ensure(x),), name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return dispatch(lambda v: jnp.where(
+        v > threshold, v - threshold,
+        jnp.where(v < -threshold, v + threshold, 0.0)),
+        (_ensure(x),), name="softshrink")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return dispatch(lambda v: jnp.clip(slope * v + offset, 0.0, 1.0),
+                    (_ensure(x),), name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return dispatch(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0,
+                    (_ensure(x),), name="hardswish")
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return dispatch(lambda v: jnp.where(
+        beta * v > threshold, v, jax.nn.softplus(beta * v) / beta),
+        (_ensure(x),), name="softplus")
+
+
+def logsigmoid(x, name=None):
+    return dispatch(jax.nn.log_sigmoid, (_ensure(x),), name="log_sigmoid")
+
+
+log_sigmoid = logsigmoid
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new_shape = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new_shape), axis=ax + 1)
+    return dispatch(f, (_ensure(x),), name="maxout")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtypes import convert_dtype
+    d = convert_dtype(dtype) if dtype else None
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.softmax(v, axis=axis)
+    return dispatch(f, (_ensure(x),), name="softmax")
+
+
+softmax_ = softmax
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core.dtypes import convert_dtype
+    d = convert_dtype(dtype) if dtype else None
+
+    def f(v):
+        if d is not None:
+            v = v.astype(d)
+        return jax.nn.log_softmax(v, axis=axis)
+    return dispatch(f, (_ensure(x),), name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core.random import next_key
+
+    def f(v):
+        g = jax.random.gumbel(next_key(), v.shape, dtype=v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return dispatch(f, (_ensure(x),), name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    return dispatch(lambda v: jax.nn.glu(v, axis=axis), (_ensure(x),),
+                    name="glu")
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU used by LLaMA MLPs (reference fused op:
+    python/paddle/incubate/nn/functional/swiglu.py). Routed to the Pallas
+    fused kernel via incubate when FLAGS_use_fused_kernels."""
+    if y is None:
+        def f(v):
+            a, b = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a) * b
+        return dispatch(f, (_ensure(x),), name="swiglu")
+    return dispatch(lambda a, b: jax.nn.silu(a) * b,
+                    (_ensure(x), _ensure(y)), name="swiglu")
+
+
+def tanh_(x, name=None):
+    x._replace_value(jnp.tanh(x._value))
+    return x

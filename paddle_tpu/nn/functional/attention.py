@@ -1,0 +1,92 @@
+"""Attention functionals.
+
+``scaled_dot_product_attention`` (reference:
+python/paddle/nn/functional/flash_attention.py) routes to the Pallas
+flash-attention kernel on TPU (ops/flash_attention.py) and to an XLA
+composition elsewhere; numerics are gated in tests.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch
+from ...core.flags import GLOBAL_FLAGS
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _sdpa_ref(q, k, v, mask, dropout_p, is_causal, training, scale=None):
+    # q,k,v: [batch, seq, heads, head_dim] (paddle flash-attn layout)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * s
+    if is_causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(causal, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and training:
+        from ...core.random import next_key
+        keep = jax.random.bernoulli(next_key(), 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Layout [batch, seqlen, num_heads, head_dim] (reference:
+    python/paddle/nn/functional/flash_attention.py:scaled_dot_product_attention)."""
+    args = [_ensure(query), _ensure(key), _ensure(value)]
+    if attn_mask is not None:
+        args.append(_ensure(attn_mask))
+
+    use_fused = (GLOBAL_FLAGS.get("use_fused_kernels") and dropout_p == 0.0)
+
+    def f(q, k, v, *m):
+        mask = m[0] if m else None
+        if use_fused and mask is None:
+            from ...ops import flash_attention as fa
+            return fa.flash_attention(q, k, v, causal=is_causal)
+        return _sdpa_ref(q, k, v, mask, dropout_p, is_causal, training)
+    return dispatch(f, tuple(args), name="scaled_dot_product_attention")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """reference: python/paddle/incubate/nn/functional (flash_attention)."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(qkv=None, **kwargs):
+    raise NotImplementedError(
+        "varlen flash attention: use scaled_dot_product_attention with an "
+        "attn_mask; segment-packed Pallas kernel tracked in ops/")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core.dtypes import convert_dtype
+
+    def f(v):
+        m = maxlen if maxlen is not None else int(v.max())
+        ar = jnp.arange(m)
+        return (ar[None, :] < v[..., None]).astype(convert_dtype(dtype))
+    return dispatch(f, (_ensure(x),), name="sequence_mask")

@@ -1,0 +1,315 @@
+"""Common functionals: linear, dropout, embedding, interpolate, pad…
+(reference: python/paddle/nn/functional/common.py)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch, to_value
+from ...core.random import next_key
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W shaped [in, out] (reference convention,
+    python/paddle/nn/functional/common.py linear). MXU hot path."""
+    if bias is None:
+        return dispatch(lambda v, w: jnp.matmul(v, w),
+                        (_ensure(x), _ensure(weight)), name="linear")
+    return dispatch(lambda v, w, b: jnp.matmul(v, w) + b,
+                    (_ensure(x), _ensure(weight), _ensure(bias)),
+                    name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return dispatch(lambda v: v * (1.0 - p), (_ensure(x),),
+                            name="dropout_infer")
+        return _ensure(x)
+    key = next_key()
+
+    def f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+    return dispatch(f, (_ensure(x),), name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _ensure(x)
+    key = next_key()
+
+    def f(v):
+        alpha = 1.6732632423543772848170429916717
+        scale = 1.0507009873554804934193349852946
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((alpha_p ** 2 * p + 1) * (1 - p))) if p < 1 else 0.
+        b = -a * alpha_p * p
+        return (jnp.where(keep, v, alpha_p) * a + b).astype(v.dtype)
+    return dispatch(f, (_ensure(x),), name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Gather rows of ``weight``; padding_idx rows get zero grad (reference:
+    python/paddle/nn/functional/input.py embedding). On TPU the gather lowers
+    to one-hot matmul or dynamic-gather as XLA sees fit."""
+    def f(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return dispatch(f, (_ensure(x), _ensure(weight)), name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return dispatch(lambda v: jax.nn.one_hot(v, num_classes,
+                                             dtype=jnp.float32),
+                    (_ensure(x),), name="one_hot")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l, *rest):
+        k = l.shape[-1]
+        if rest:
+            return (1 - epsilon) * l + epsilon * rest[0]
+        return (1 - epsilon) * l + epsilon / k
+    args = (_ensure(label),)
+    if prior_dist is not None:
+        args = args + (_ensure(prior_dist),)
+    return dispatch(f, args, name="label_smooth")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        an = jnp.sum(a * a, axis=axis)
+        bn = jnp.sum(b * b, axis=axis)
+        dot = jnp.sum(a * b, axis=axis)
+        return dot / jnp.maximum(jnp.sqrt(an * bn), eps)
+    return dispatch(f, (_ensure(x1), _ensure(x2)), name="cosine_similarity")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1,
+                       keepdims=keepdim) ** (1.0 / p)
+    return dispatch(f, (_ensure(x), _ensure(y)), name="pairwise_distance")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(v):
+        n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+    return dispatch(f, (_ensure(x),), name="normalize")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW",
+        pad_from_left_axis=True, name=None):
+    from ...tensor.manipulation import pad as _pad
+    # paddle F.pad with len(pad)==2*ndim pads all dims from left axis;
+    # otherwise pads spatial dims per data_format
+    x = _ensure(x)
+    p = list(to_value(pad)) if isinstance(pad, Tensor) else list(pad)
+    nd = x.ndim
+    if len(p) == 2 * nd and mode == "constant":
+        if pad_from_left_axis:
+            widths = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(nd)]
+        else:
+            widths = [(int(p[2 * (nd - 1 - i)]), int(p[2 * (nd - 1 - i) + 1]))
+                      for i in range(nd)]
+        return dispatch(lambda v: jnp.pad(v, widths, constant_values=value),
+                        (x,), name="pad")
+    # spatial pad: p covers last k dims (reversed pairs, torch-style) with
+    # channel placement per data_format
+    k = len(p) // 2
+    if data_format.endswith("C") and data_format.startswith("N"):
+        # NHWC-like: spatial dims are 1..nd-2
+        widths = [(0, 0)] * nd
+        for i in range(k):
+            dim = nd - 2 - i
+            widths[dim] = (int(p[2 * i]), int(p[2 * i + 1]))
+    else:  # NCHW-like: spatial dims are 2..nd-1
+        widths = [(0, 0)] * nd
+        for i in range(k):
+            dim = nd - 1 - i
+            widths[dim] = (int(p[2 * i]), int(p[2 * i + 1]))
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def f(v):
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode=jmode, constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+    return dispatch(f, (x,), name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _quad(paddings)
+    dl = _pair(dilations)
+
+    def f(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])])
+        oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = v[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                       j * dl[1]: j * dl[1] + ow * st[1]: st[1]]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # [n, c, kh*kw, oh, ow]
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return dispatch(f, (_ensure(x),), name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    os_ = _pair(output_sizes)
+    ks = _pair(kernel_sizes)
+    st = _pair(strides)
+    pd = _quad(paddings)
+    dl = _pair(dilations)
+
+    def f(v):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + pd[0] + pd[1], os_[1] + pd[2] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v = v.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), dtype=v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                out = out.at[:, :, i * dl[0]: i * dl[0] + oh * st[0]: st[0],
+                             j * dl[1]: j * dl[1] + ow * st[1]: st[1]].add(
+                    v[:, :, i, j])
+        return out[:, :, pd[0]: ph - pd[1], pd[2]: pw - pd[3]]
+    return dispatch(f, (_ensure(x),), name="fold")
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v), int(v))
+
+
+def _quad(v):
+    if isinstance(v, (list, tuple)):
+        if len(v) == 2:
+            return (int(v[0]), int(v[0]), int(v[1]), int(v[1]))
+        return tuple(int(i) for i in v)
+    return (int(v),) * 4
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """reference: python/paddle/nn/functional/common.py interpolate.
+    Uses jax.image.resize; 'nearest'/'bilinear'/'bicubic'/'trilinear'/'area'."""
+    x = _ensure(x)
+    nd = x.ndim
+    channel_last = data_format.endswith("C")
+    spatial = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+    in_spatial = [x.shape[i] for i in spatial]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy()]
+        out_spatial = [int(to_value(s)) if isinstance(s, Tensor) else int(s)
+                       for s in (size if isinstance(size, (list, tuple))
+                                 else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * len(spatial)
+        out_spatial = [int(np.floor(s * float(f)))
+                       for s, f in zip(in_spatial, sf)]
+    method = {"nearest": "nearest", "bilinear": "bilinear", "area": "linear",
+              "bicubic": "cubic", "trilinear": "trilinear",
+              "linear": "linear"}[mode.lower()]
+    if method == "trilinear":
+        method = "trilinear" if hasattr(jax.image.ResizeMethod, "TRILINEAR") \
+            else "linear"
+
+    def f(v):
+        out_shape = list(v.shape)
+        for i, d in enumerate(spatial):
+            out_shape[d] = out_spatial[i]
+        if mode.lower() in ("bilinear", "bicubic", "linear", "trilinear") \
+                and align_corners:
+            # jax.image.resize has no align_corners; emulate with map_coords
+            return _resize_align_corners(v, out_shape, spatial, mode.lower())
+        m = "linear" if method in ("bilinear", "trilinear") else method
+        return jax.image.resize(v, out_shape, method=m)
+    return dispatch(f, (x,), name="interpolate")
+
+
+def _resize_align_corners(v, out_shape, spatial, mode):
+    order = 1 if mode in ("bilinear", "linear", "trilinear") else 3
+    coords = []
+    for d in range(v.ndim):
+        n_out = out_shape[d]
+        n_in = v.shape[d]
+        if d in spatial and n_out != n_in:
+            if n_out == 1:
+                c = jnp.zeros((n_out,))
+            else:
+                c = jnp.linspace(0, n_in - 1, n_out)
+        else:
+            c = jnp.arange(n_out, dtype=jnp.float32)
+        coords.append(c)
+    grid = jnp.meshgrid(*coords, indexing="ij")
+    from jax.scipy.ndimage import map_coordinates
+    return map_coordinates(v.astype(jnp.float32), grid, order=min(order, 1)
+                           ).astype(v.dtype)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (_ensure(x1), _ensure(x2), _ensure(weight))
+    if bias is not None:
+        args += (_ensure(bias),)
+    return dispatch(f, args, name="bilinear")

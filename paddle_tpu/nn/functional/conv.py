@@ -1,0 +1,194 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py).
+
+All convs lower to ``lax.conv_general_dilated`` — XLA maps them onto the MXU
+directly (the reference needs cuDNN algo search + autotune,
+paddle/phi/kernels/gpudnn/conv_kernel.cu; XLA's conv emitter replaces that).
+Weight layout follows the reference: [out_c, in_c/groups, *spatial].
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, dispatch
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(i) for i in v)
+        if len(v) == 2 * n:  # paddle allows per-side padding
+            return tuple(int(i) for i in v)
+        return tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n, data_format):
+    """Normalise paddle padding spec to lax [(lo, hi)] * n or 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)) and padding and \
+            isinstance(padding[0], (list, tuple)):
+        # [[0,0],[0,0],[h0,h1],[w0,w1]] form: extract spatial entries
+        spatial = [p for p in padding if list(p) != [0, 0]]
+        if len(spatial) == n:
+            return [tuple(int(i) for i in p) for p in spatial]
+        idx = (2, 2 + n) if data_format.startswith("NC") else (1, 1 + n)
+        return [tuple(int(i) for i in p) for p in padding[idx[0]:idx[1]]]
+    p = _tuple(padding, n)
+    if len(p) == 2 * n:
+        return [(p[2 * i], p[2 * i + 1]) for i in range(n)]
+    return [(pi, pi) for pi in p]
+
+
+def _dims(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last \
+            else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last \
+        else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, name):
+    channel_last = data_format.endswith("C")
+    st = _tuple(stride, n)[:n]
+    dl = _tuple(dilation, n)[:n]
+    pd = _padding(padding, n, data_format)
+    lhs_spec, rhs_spec, out_spec = _dims(n, channel_last)
+    dn = lax.conv_dimension_numbers(
+        (1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, out_spec))
+
+    def f(v, w, *rest):
+        # weight arrives in paddle layout OI*; transpose to rhs_spec
+        if rhs_spec != "OI" + rhs_spec[2:]:
+            # e.g. HWIO: move O,I to the back
+            perm = [2 + i for i in range(n)] + [1, 0]
+            w = jnp.transpose(w, perm)
+        out = lax.conv_general_dilated(
+            v, w, window_strides=st, padding=pd,
+            lhs_dilation=(1,) * n, rhs_dilation=dl,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+            feature_group_count=groups,
+            preferred_element_type=jnp.float32
+            if v.dtype == jnp.bfloat16 else None)
+        if v.dtype == jnp.bfloat16:
+            out = out.astype(v.dtype)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[out_spec.index("C")] = b.size
+            out = out + b.reshape(shape)
+        return out
+    args = (_ensure(x), _ensure(weight))
+    if bias is not None:
+        args += (_ensure(bias),)
+    return dispatch(f, args, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df,
+                 "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format, output_size, name):
+    channel_last = data_format.endswith("C")
+    st = _tuple(stride, n)[:n]
+    dl = _tuple(dilation, n)[:n]
+    pd = _padding(padding, n, data_format)
+    op = _tuple(output_padding, n)[:n] if output_padding is not None \
+        else (0,) * n
+    lhs_spec, rhs_spec, out_spec = _dims(n, channel_last)
+
+    def f(v, w, *rest):
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, *spatial]
+        # grad-of-conv formulation: lhs_dilation = stride
+        if isinstance(pd, str):
+            pads = pd
+        else:
+            # transposed conv padding: k-1-p on each side (plus out padding hi)
+            pads = []
+            k = [w.shape[2 + i] for i in range(n)]
+            for i in range(n):
+                eff_k = dl[i] * (k[i] - 1) + 1
+                lo = eff_k - 1 - pd[i][0]
+                hi = eff_k - 1 - pd[i][1] + op[i]
+                pads.append((lo, hi))
+        # weight: IO* -> flip spatial, swap I/O per group
+        wt = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        if groups > 1:
+            ic = wt.shape[0]
+            ocg = wt.shape[1]
+            wt = wt.reshape((groups, ic // groups, ocg) + wt.shape[2:])
+            wt = jnp.swapaxes(wt, 1, 2)
+            wt = wt.reshape((groups * ocg, ic // groups) + wt.shape[3:])
+        else:
+            wt = jnp.swapaxes(wt, 0, 1)
+        if rhs_spec != "OI" + rhs_spec[2:]:
+            perm = [2 + i for i in range(n)] + [1, 0]
+            wt = jnp.transpose(wt, perm)
+        out = lax.conv_general_dilated(
+            v, wt, window_strides=(1,) * n, padding=pads,
+            lhs_dilation=st, rhs_dilation=dl,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+            feature_group_count=groups)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[out_spec.index("C")] = b.size
+            out = out + b.reshape(shape)
+        return out
+    args = (_ensure(x), _ensure(weight))
+    if bias is not None:
+        args += (_ensure(bias),)
+    return dispatch(f, args, name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC", "NWC") else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, df, output_size,
+                           "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size,
+                           "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size,
+                           "conv3d_transpose")

@@ -1,0 +1,372 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """reference: python/paddle/nn/functional/loss.py cross_entropy.
+    The TP vocab-sharded variant lives in distributed.fleet
+    (ParallelCrossEntropy)."""
+    args = (_ensure(input), _ensure(label))
+    if weight is not None:
+        args += (_ensure(weight),)
+
+    def f(logits, label, *w):
+        lg = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lg, axis=axis) if use_softmax else \
+            jnp.log(jnp.maximum(lg, 1e-30))
+        if soft_label or (label.ndim == logits.ndim
+                          and label.shape == logits.shape
+                          and jnp.issubdtype(label.dtype, jnp.floating)):
+            tgt = label.astype(jnp.float32)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                tgt = (1 - label_smoothing) * tgt + label_smoothing / k
+            loss = -jnp.sum(tgt * logp, axis=axis)
+            return _reduce(loss, reduction)
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis)
+        picked = jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            k = logits.shape[axis]
+            smooth = jnp.mean(logp, axis=axis)
+            picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+        loss = jnp.where(valid, -picked, 0.0)
+        if w:
+            wv = jnp.take(w[0], safe)
+            wv = jnp.where(valid, wv, 0.0)
+            loss = loss * wv
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wv), 1e-12)
+        if reduction == "mean":
+            cnt = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return jnp.sum(loss) / cnt
+        return _reduce(loss, reduction)
+    return dispatch(f, args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    args = (_ensure(input), _ensure(label))
+    if weight is not None:
+        args += (_ensure(weight),)
+
+    def f(logp, lbl, *w):
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        picked = jnp.squeeze(picked, axis=1)
+        loss = jnp.where(valid, -picked, 0.0)
+        if w:
+            wv = jnp.where(valid, jnp.take(w[0], safe), 0.0)
+            loss = loss * wv
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wv), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(valid.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+    return dispatch(f, args, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return dispatch(lambda a, b: _reduce((a - b) ** 2, reduction),
+                    (_ensure(input), _ensure(label)), name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return dispatch(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    (_ensure(input), _ensure(label)), name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return dispatch(f, (_ensure(input), _ensure(label)),
+                    name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    args = (_ensure(input), _ensure(label))
+    if weight is not None:
+        args += (_ensure(weight),)
+
+    def f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    return dispatch(f, args, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    args = (_ensure(logit), _ensure(label))
+    if weight is not None:
+        args += (_ensure(weight),)
+    if pos_weight is not None:
+        args += (_ensure(pos_weight),)
+
+    def f(z, y, *rest):
+        # numerically-stable BCE-with-logits
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        i = 0
+        pw = None
+        w = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        if pw is not None:
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    return dispatch(f, args, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logp, q):
+        if log_target:
+            loss = jnp.exp(q) * (q - logp)
+        else:
+            loss = jnp.where(q > 0, q * (jnp.log(jnp.maximum(q, 1e-30))
+                                         - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return dispatch(f, (_ensure(input), _ensure(label)), name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+    return dispatch(f, (_ensure(input), _ensure(other), _ensure(label)),
+                    name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def f(x, y):
+        loss = jnp.where(y == 1.0, x, jnp.maximum(0.0, margin - x))
+        return _reduce(loss, reduction)
+    return dispatch(f, (_ensure(input), _ensure(label)),
+                    name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return dispatch(f, (_ensure(input1), _ensure(input2), _ensure(label)),
+                    name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, -1) ** (1 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_an = jnp.minimum(d_an, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, d_ap - d_an + margin), reduction)
+    return dispatch(f, (_ensure(input), _ensure(positive), _ensure(negative)),
+                    name="triplet_margin_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return dispatch(f, (_ensure(input), _ensure(label)), name="log_loss")
+
+
+def square_error_cost(input, label):
+    return dispatch(lambda a, b: (a - b) ** 2,
+                    (_ensure(input), _ensure(label)),
+                    name="square_error_cost")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over
+    time). reference: warpctc kernel paddle/phi/kernels/gpu/warpctc_kernel.cu."""
+    args = (_ensure(log_probs), _ensure(labels), _ensure(input_lengths),
+            _ensure(label_lengths))
+
+    def f(lp, lab, in_len, lab_len):
+        # lp: [T, B, C] logits (paddle convention); make log-probs
+        lp = jax.nn.log_softmax(lp.astype(jnp.float32), axis=-1)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * lab_len.astype(jnp.int32) + 1
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        same = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, t):
+            a1 = alpha
+            a2 = jnp.concatenate([jnp.full((B, 1), neg_inf), alpha[:, :-1]],
+                                 axis=1)
+            a3 = jnp.concatenate([jnp.full((B, 2), neg_inf), alpha[:, :-2]],
+                                 axis=1)
+            a3 = jnp.where(same | (ext == blank), neg_inf, a3)
+            m = jnp.maximum(jnp.maximum(a1, a2), a3)
+            new = m + jnp.log(jnp.exp(a1 - m) + jnp.exp(a2 - m)
+                              + jnp.exp(a3 - m) + 1e-30)
+            emit = jnp.take_along_axis(lp[t], ext, axis=1)
+            new = new + emit
+            # freeze once past input length
+            new = jnp.where(t < in_len[:, None], new, alpha)
+            return new, None
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        idx_last = L - 1
+        idx_prev = jnp.maximum(L - 2, 0)
+        a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(alpha, idx_prev[:, None], axis=1)[:, 0]
+        m = jnp.maximum(a_last, a_prev)
+        ll = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m) + 1e-30)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32),
+                                               1.0))
+        return _reduce(loss, reduction)
+    return dispatch(f, args, name="ctc_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    args = (_ensure(logit), _ensure(label))
+    if normalizer is not None:
+        args += (_ensure(normalizer),)
+
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    return dispatch(f, args, name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, y):
+        y1 = jax.nn.one_hot(jnp.squeeze(y, -1), p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y1, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return dispatch(f, (_ensure(input), _ensure(label)), name="dice_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * np.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return dispatch(f, (_ensure(input), _ensure(label)),
+                    name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+        if full:
+            loss = loss + 0.5 * np.log(2 * np.pi)
+        return _reduce(loss, reduction)
+    return dispatch(f, (_ensure(input), _ensure(label), _ensure(variance)),
+                    name="gaussian_nll_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    args = (_ensure(input), _ensure(label))
+    if weight is not None:
+        args += (_ensure(weight),)
+
+    def f(x, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            loss = loss * w[0]
+        loss = jnp.mean(loss, axis=-1)
+        return _reduce(loss, reduction)
+    return dispatch(f, args, name="multi_label_soft_margin_loss")
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return dispatch(f, (_ensure(input), _ensure(label)),
+                    name="soft_margin_loss")

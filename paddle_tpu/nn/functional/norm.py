@@ -1,0 +1,221 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py).
+
+layer_norm / rms_norm route to the Pallas fused kernels on TPU when
+FLAGS_use_fused_kernels (ops/ package); the jnp compositions here are the
+reference-numerics fallback and the grad path.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch, to_value
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """reference: python/paddle/nn/functional/norm.py batch_norm.
+    In training mode also *updates* running stats in-place (buffer rebind)."""
+    x = _ensure(x)
+    rm, rv = _ensure(running_mean), _ensure(running_var)
+    ch_axis = _channel_axis(x.ndim, data_format)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # compute batch stats eagerly (outside tape) for the running update
+        def f(v, *wb):
+            mean = jnp.mean(v, axis=reduce_axes)
+            var = jnp.var(v, axis=reduce_axes)
+            out = _affine(v, mean, var, wb, ch_axis, epsilon)
+            return out, mean, var
+        args = (x,) + _wb_args(weight, bias)
+        out, mean_t, var_t = dispatch(f, args, name="batch_norm",
+                                      multi_output=True)
+        # running stat update (no grad)
+        n = int(np.prod([x.shape[i] for i in reduce_axes]))
+        unbiased = n / max(n - 1, 1)
+        rm._replace_value(momentum * rm._value +
+                          (1 - momentum) * mean_t._value.astype(rm._value.dtype))
+        rv._replace_value(momentum * rv._value +
+                          (1 - momentum) * (var_t._value * unbiased).astype(
+                              rv._value.dtype))
+        return out
+
+    def f(v, m, va, *wb):
+        return _affine(v, m, va, wb, ch_axis, epsilon)
+    args = (x, rm, rv) + _wb_args(weight, bias)
+    return dispatch(f, args, name="batch_norm")
+
+
+def _wb_args(weight, bias):
+    args = ()
+    if weight is not None:
+        args += (_ensure(weight),)
+    if bias is not None:
+        args += (_ensure(bias),)
+    return args
+
+
+def _affine(v, mean, var, wb, ch_axis, epsilon):
+    shape = [1] * v.ndim
+    shape[ch_axis] = v.shape[ch_axis]
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + epsilon).astype(v.dtype)
+    out = (v - mean.reshape(shape).astype(v.dtype)) * inv.reshape(shape)
+    i = 0
+    if len(wb) >= 1:
+        out = out * wb[0].reshape(shape)
+        i += 1
+    if len(wb) == i + 1:
+        out = out + wb[i].reshape(shape)
+    return out
+
+
+def _channel_axis(ndim, data_format):
+    return ndim - 1 if data_format.endswith("C") else 1
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    x = _ensure(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    from ...core.flags import GLOBAL_FLAGS
+    if (GLOBAL_FLAGS.get("use_fused_kernels") and weight is not None
+            and n_axes == 1):
+        from ...ops import layer_norm as fused_ln
+        args = (x, _ensure(weight)) + ((_ensure(bias),)
+                                       if bias is not None else ())
+        return dispatch(lambda v, w, *b: fused_ln(
+            v, w, b[0] if b else None, epsilon), args, name="layer_norm")
+
+    def f(v, *wb):
+        mean = jnp.mean(v.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(v.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((v.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)
+               ).astype(v.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+    args = (x,) + _wb_args(weight, bias)
+    return dispatch(f, args, name="layer_norm")
+
+
+def rms_norm(x, weight, epsilon=1e-6, name=None):
+    """RMSNorm (reference fused op:
+    python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
+    from ...core.flags import GLOBAL_FLAGS
+    if GLOBAL_FLAGS.get("use_fused_kernels"):
+        from ...ops import rms_norm as fused
+        return dispatch(lambda v, w: fused(v, w, epsilon),
+                        (_ensure(x), _ensure(weight)), name="rms_norm")
+
+    def f(v, w):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1,
+                      keepdims=True)
+        return (v.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)
+                ).astype(v.dtype) * w
+    return dispatch(f, (_ensure(x), _ensure(weight)), name="rms_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-05, data_format="NCHW", name=None):
+    x = _ensure(x)
+    ch_axis = _channel_axis(x.ndim, data_format)
+    spatial = tuple(i for i in range(x.ndim) if i not in (0, ch_axis))
+
+    def f(v, *wb):
+        mean = jnp.mean(v, axis=spatial, keepdims=True)
+        var = jnp.var(v, axis=spatial, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = (x,) + _wb_args(weight, bias)
+    return dispatch(f, args, name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _ensure(x)
+    ch_axis = _channel_axis(x.ndim, data_format)
+
+    def f(v, *wb):
+        if ch_axis != 1:
+            v_t = jnp.moveaxis(v, ch_axis, 1)
+        else:
+            v_t = v
+        n, c = v_t.shape[0], v_t.shape[1]
+        g = v_t.reshape((n, num_groups, c // num_groups) + v_t.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v_t.shape)
+        shape = [1, c] + [1] * (v_t.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if ch_axis != 1:
+            out = jnp.moveaxis(out, 1, ch_axis)
+        return out
+    args = (x,) + _wb_args(weight, bias)
+    return dispatch(f, args, name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = _ensure(x)
+    ch_axis = _channel_axis(x.ndim, data_format)
+
+    def f(v):
+        sq = jnp.square(v)
+        c = v.shape[ch_axis]
+        sq_m = jnp.moveaxis(sq, ch_axis, -1)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(sq_m, [(0, 0)] * (v.ndim - 1) + [(pad_lo, pad_hi)])
+        windows = jnp.stack([padded[..., i:i + c] for i in range(size)],
+                            axis=0).sum(0)
+        denom = (k + alpha * windows) ** beta
+        return v / jnp.moveaxis(denom, -1, ch_axis)
+    return dispatch(f, (x,), name="local_response_norm")
+
+
+def spectral_norm(weight, weight_u, weight_v, dim=0, power_iters=1,
+                  eps=1e-12, name=None):
+    def f(w, u, v):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        for _ in range(power_iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return w / sigma
+    return dispatch(f, (_ensure(weight), _ensure(weight_u), _ensure(weight_v)),
+                    name="spectral_norm")

@@ -1,0 +1,252 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py).
+All lower to lax.reduce_window."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, dispatch
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)[:n] if len(v) >= n else \
+            tuple(int(v[0]) for _ in range(n))
+    return tuple(int(v) for _ in range(n))
+
+
+def _pool_pad(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = padding if isinstance(padding, (list, tuple)) else [padding] * n
+    p = [int(i) for i in p]
+    if len(p) == n:
+        return [(i, i) for i in p]
+    if len(p) == 2 * n:
+        return [(p[2 * i], p[2 * i + 1]) for i in range(n)]
+    return [(p[0], p[0])] * n
+
+
+def _reduce_window(v, init, op, window, strides, pads, ch_last, n):
+    dims = (1,) + window + (1,) if ch_last else (1, 1) + window
+    strd = (1,) + strides + (1,) if ch_last else (1, 1) + strides
+    if isinstance(pads, str):
+        pad_cfg = pads
+    else:
+        pad_cfg = ([(0, 0)] + list(pads) + [(0, 0)]) if ch_last \
+            else [(0, 0), (0, 0)] + list(pads)
+    return lax.reduce_window(v, init, op, dims, strd, pad_cfg)
+
+
+def _max_pool(x, kernel_size, stride, padding, ceil_mode, return_mask,
+              data_format, n, name):
+    x = _ensure(x)
+    ch_last = data_format.endswith("C")
+    ks = _tuple(kernel_size, n)
+    st = _tuple(stride, n) if stride is not None else ks
+    pd = _pool_pad(padding, n)
+    if ceil_mode and not isinstance(pd, str):
+        spatial = x.shape[1:1 + n] if ch_last else x.shape[2:2 + n]
+        pd = [(lo, hi + _ceil_extra(s, k, s2, lo + hi))
+              for (lo, hi), s, k, s2 in zip(pd, spatial, ks, st)]
+
+    def f(v):
+        neg = (jnp.finfo(v.dtype).min if jnp.issubdtype(v.dtype, jnp.floating)
+               else jnp.iinfo(v.dtype).min)
+        out = _reduce_window(v, neg, lax.max, ks, st, pd, ch_last, n)
+        if not return_mask:
+            return out
+        # index pooling: argmax over the window via same-window reduce on
+        # linearised indices
+        spatial = v.shape[1:1 + n] if ch_last else v.shape[2:2 + n]
+        lin = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+        shape = ((1,) + spatial + (1,)) if ch_last else ((1, 1) + spatial)
+        lin = jnp.broadcast_to(lin.reshape(shape), v.shape)
+
+        def argmax_op(a, b):
+            av, ai = a
+            bv, bi = b
+            take_b = bv > av
+            return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+        dims = (1,) + ks + (1,) if ch_last else (1, 1) + ks
+        strd = (1,) + st + (1,) if ch_last else (1, 1) + st
+        pad_cfg = pd if isinstance(pd, str) else (
+            ([(0, 0)] + list(pd) + [(0, 0)]) if ch_last
+            else [(0, 0), (0, 0)] + list(pd))
+        vals, idx = lax.reduce_window(
+            (v, lin), (neg, jnp.asarray(-1)), argmax_op,
+            dims, strd, pad_cfg)
+        return vals, idx.astype(jnp.int32)
+    if return_mask:
+        return dispatch(f, (x,), name=name, multi_output=True)
+    return dispatch(f, (x,), name=name)
+
+
+def _ceil_extra(size, k, stride, pad_both):
+    import math
+    out_floor = (size + pad_both - k) // stride + 1
+    out_ceil = math.ceil((size + pad_both - k) / stride) + 1
+    return (out_ceil - out_floor) * stride
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC",) else "NCW"
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, return_mask,
+                     df, 1, "max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, return_mask,
+                     data_format, 2, "max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _max_pool(x, kernel_size, stride, padding, ceil_mode, return_mask,
+                     data_format, 3, "max_pool3d")
+
+
+def _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive,
+              divisor_override, data_format, n, name):
+    x = _ensure(x)
+    ch_last = data_format.endswith("C")
+    ks = _tuple(kernel_size, n)
+    st = _tuple(stride, n) if stride is not None else ks
+    pd = _pool_pad(padding, n)
+    if ceil_mode and not isinstance(pd, str):
+        spatial = x.shape[1:1 + n] if ch_last else x.shape[2:2 + n]
+        pd = [(lo, hi + _ceil_extra(s, k, s2, lo + hi))
+              for (lo, hi), s, k, s2 in zip(pd, spatial, ks, st)]
+
+    def f(v):
+        s = _reduce_window(v.astype(jnp.float32), 0.0, lax.add, ks, st, pd,
+                           ch_last, n)
+        if divisor_override:
+            return (s / divisor_override).astype(v.dtype)
+        if exclusive and not isinstance(pd, str):
+            ones = jnp.ones_like(v, dtype=jnp.float32)
+            cnt = _reduce_window(ones, 0.0, lax.add, ks, st, pd, ch_last, n)
+            return (s / cnt).astype(v.dtype)
+        return (s / float(np.prod(ks))).astype(v.dtype)
+    return dispatch(f, (x,), name=name)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NWC" if data_format in ("NLC",) else "NCW"
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive,
+                     None, df, 1, "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive,
+                     divisor_override, data_format, 2, "avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _avg_pool(x, kernel_size, stride, padding, ceil_mode, exclusive,
+                     divisor_override, data_format, 3, "avg_pool3d")
+
+
+def _adaptive_out(in_size, out_size):
+    # adaptive pooling boundaries (same math as reference's kernel)
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = -(-((np.arange(out_size) + 1) * in_size) // out_size)
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, data_format, n, op, name):
+    x = _ensure(x)
+    ch_last = data_format.endswith("C")
+    os_ = _tuple(output_size, n)
+
+    def f(v):
+        spatial_off = 1 if ch_last else 2
+        out = v
+        for d in range(n):
+            in_size = out.shape[spatial_off + d]
+            o = os_[d]
+            if o == in_size:
+                continue
+            starts, ends = _adaptive_out(in_size, o)
+            slices = []
+            for s, e in zip(starts, ends):
+                sl = jnp.take(out, jnp.arange(s, e), axis=spatial_off + d)
+                red = (jnp.max if op == "max" else jnp.mean)(
+                    sl, axis=spatial_off + d, keepdims=True)
+                slices.append(red)
+            out = jnp.concatenate(slices, axis=spatial_off + d)
+        return out
+    return dispatch(f, (x,), name=name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, "NCW", 1, "avg",
+                          "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, data_format, 2, "avg",
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, data_format, 3, "avg",
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, "NCW", 1, "max",
+                          "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, "NCHW", 2, "max",
+                          "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, "NCDHW", 3, "max",
+                          "adaptive_max_pool3d")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    x = _ensure(x)
+    p = float(norm_type)
+    ks = _tuple(kernel_size, 1)
+    st = _tuple(stride, 1) if stride is not None else ks
+    pd = _pool_pad(padding, 1)
+
+    def f(v):
+        s = _reduce_window(jnp.abs(v.astype(jnp.float32)) ** p, 0.0, lax.add,
+                           ks, st, pd, False, 1)
+        return (s ** (1.0 / p)).astype(v.dtype)
+    return dispatch(f, (x,), name="lp_pool1d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    x = _ensure(x)
+    p = float(norm_type)
+    ks = _tuple(kernel_size, 2)
+    st = _tuple(stride, 2) if stride is not None else ks
+    pd = _pool_pad(padding, 2)
+
+    def f(v):
+        s = _reduce_window(jnp.abs(v.astype(jnp.float32)) ** p, 0.0, lax.add,
+                           ks, st, pd, data_format.endswith("C"), 2)
+        return (s ** (1.0 / p)).astype(v.dtype)
+    return dispatch(f, (x,), name="lp_pool2d")

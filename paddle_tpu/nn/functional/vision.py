@@ -1,0 +1,149 @@
+"""Vision functionals (reference: python/paddle/nn/functional/vision.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = jnp.transpose(v, (0, 1, 4, 2, 5, 3))
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = jnp.transpose(v, (0, 1, 3, 2, 4, 5))
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return dispatch(f, (_ensure(x),), name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = jnp.transpose(v, (0, 1, 3, 5, 2, 4))
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = jnp.transpose(v, (0, 1, 3, 2, 4, 5))
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return dispatch(f, (_ensure(x),), name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            v = jnp.swapaxes(v, 1, 2)
+            return v.reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        v = jnp.swapaxes(v, 3, 4)
+        return v.reshape(n, h, w, c)
+    return dispatch(f, (_ensure(x),), name="channel_shuffle")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    if isinstance(out_shape, Tensor):
+        out_shape = [int(s) for s in out_shape.numpy()]
+
+    def f(th):
+        n, _, h, w = out_shape
+        if align_corners:
+            xs = jnp.linspace(-1, 1, w)
+            ys = jnp.linspace(-1, 1, h)
+        else:
+            xs = jnp.linspace(-1 + 1.0 / w, 1 - 1.0 / w, w)
+            ys = jnp.linspace(-1 + 1.0 / h, 1 - 1.0 / h, h)
+        gx, gy = jnp.meshgrid(xs, ys)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)  # [h, w, 3]
+        return jnp.einsum("hwk,njk->nhwj", base, th)
+    return dispatch(f, (_ensure(theta),), name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def f(v, g):
+        n, c, h, w = v.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            ix = (gx + 1) * (w - 1) / 2
+            iy = (gy + 1) * (h - 1) / 2
+        else:
+            ix = ((gx + 1) * w - 1) / 2
+            iy = ((gy + 1) * h - 1) / 2
+
+        def sample(img, yy, xx):
+            # img: [c, h, w]
+            if padding_mode == "border":
+                yy = jnp.clip(yy, 0, h - 1)
+                xx = jnp.clip(xx, 0, w - 1)
+            elif padding_mode == "reflection":
+                yy = jnp.abs(jnp.mod(yy, 2 * (h - 1)))
+                yy = jnp.where(yy > h - 1, 2 * (h - 1) - yy, yy)
+                xx = jnp.abs(jnp.mod(xx, 2 * (w - 1)))
+                xx = jnp.where(xx > w - 1, 2 * (w - 1) - xx, xx)
+            valid = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            out = img[:, yc, xc]
+            return jnp.where(valid[None], out, 0.0)
+
+        if mode == "nearest":
+            out = jax.vmap(lambda img, yy, xx: sample(
+                img, jnp.round(yy), jnp.round(xx)))(v, iy, ix)
+            return out
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+        wa = (x1 - ix) * (y1 - iy)
+        wb = (x1 - ix) * (iy - y0)
+        wc = (ix - x0) * (y1 - iy)
+        wd = (ix - x0) * (iy - y0)
+
+        def bil(img, yy0, xx0, yy1, xx1, wa, wb, wc, wd):
+            va = sample(img, yy0, xx0)
+            vb = sample(img, yy1, xx0)
+            vc = sample(img, yy0, xx1)
+            vd = sample(img, yy1, xx1)
+            return va * wa[None] + vb * wb[None] + vc * wc[None] + vd * wd[None]
+        out = jax.vmap(bil)(v, y0, x0, y1, x1, wa, wb, wc, wd)
+        return out
+    return dispatch(f, (_ensure(x), _ensure(grid)), name="grid_sample")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def f(v):
+        if data_format == "NHWC":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold], jnp.zeros_like(
+            v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                                 v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+    return dispatch(f, (_ensure(x),), name="temporal_shift")

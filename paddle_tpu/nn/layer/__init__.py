@@ -1,0 +1,2 @@
+from . import layers, common, activation, conv, norm, pooling, loss  # noqa
+from . import transformer, rnn  # noqa: F401

@@ -1,0 +1,548 @@
+"""``Layer``: module base class.
+
+TPU-native re-design of reference ``paddle.nn.Layer``
+(python/paddle/nn/layer/layers.py:353): same surface — parameter/buffer/
+sublayer registries, hooks, ``state_dict``/``set_state_dict``, ``train/eval``,
+``to()`` — but the parameter store is a pytree so any Layer can be
+functionalised for ``jax.jit``/``jax.grad``/``pjit`` via
+``Layer.functional()`` (used by jit.to_static and the distributed trainers).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+import jax
+
+from ...core.tensor import Tensor, no_grad, to_value
+from ...core.dtypes import convert_dtype, get_default_dtype
+from ...framework import Parameter, ParamAttr
+from .. import initializer as I
+
+__all__ = ["Layer", "Sequential", "LayerList", "ParameterList", "LayerDict"]
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, key):
+        self._hooks = hooks
+        self._key = key
+
+    def remove(self):
+        self._hooks.pop(self._key, None)
+
+
+class Layer:
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        self.training = True
+        self._dtype = convert_dtype(dtype) if dtype else get_default_dtype()
+        self._parameters: Dict[str, Optional[Parameter]] = \
+            collections.OrderedDict()
+        self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._sub_layers: Dict[str, Optional["Layer"]] = \
+            collections.OrderedDict()
+        self._forward_pre_hooks: Dict[int, Callable] = collections.OrderedDict()
+        self._forward_post_hooks: Dict[int, Callable] = \
+            collections.OrderedDict()
+        self._hook_id = [0]
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+        self._casted_dtype = None
+
+    # -- construction --------------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None,
+                         is_bias=False, default_initializer=None
+                         ) -> Optional[Parameter]:
+        """reference: layers.py create_parameter."""
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) if dtype else self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierUniform()
+        value = init(shape, dtype)
+        p = Parameter(value, name=attr.name, trainable=attr.trainable)
+        p._param_attr = attr
+        return p
+
+    def create_tensor(self, name=None, persistable=False, dtype=None):
+        import jax.numpy as jnp
+        t = Tensor(jnp.zeros((), dtype=convert_dtype(dtype)
+                             if dtype else self._dtype), name=name)
+        t.persistable = persistable
+        return t
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        object.__getattribute__(self, "_parameters")[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: Optional["Layer"]):
+        object.__getattribute__(self, "_sub_layers")[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # -- attribute routing ---------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        sublayers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning "
+                                   "parameters")
+            for registry in (sublayers, buffers):
+                if registry is not None:
+                    registry.pop(name, None)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if sublayers is None:
+                raise RuntimeError("call Layer.__init__ before assigning "
+                                   "sublayers")
+            for registry in (params, buffers):
+                if registry is not None:
+                    registry.pop(name, None)
+            sublayers[name] = value
+        elif buffers is not None and name in buffers:
+            if value is None or isinstance(value, Tensor):
+                buffers[name] = value
+            else:
+                object.__setattr__(self, name, value)
+        elif params is not None and name in params and value is None:
+            params[name] = None
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        d = self.__dict__
+        for registry in ("_parameters", "_buffers", "_sub_layers"):
+            reg = d.get(registry)
+            if reg is not None and name in reg:
+                return reg[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for registry in (self._parameters, self._buffers, self._sub_layers):
+            if name in registry:
+                del registry[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._buffers) + list(self._sub_layers)
+
+    # -- call ----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            o = hook(self, inputs, outputs)
+            if o is not None:
+                outputs = o
+        return outputs
+
+    def register_forward_pre_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id[0] += 1
+        self._forward_pre_hooks[self._hook_id[0]] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id[0])
+
+    def register_forward_post_hook(self, hook) -> HookRemoveHelper:
+        self._hook_id[0] += 1
+        self._forward_post_hooks[self._hook_id[0]] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id[0])
+
+    # -- traversal -----------------------------------------------------------
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None
+                        ) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, layer in self._sub_layers.items():
+            if layer is None or id(layer) in layers_set:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield from layer.named_sublayers(prefix=p, include_self=True,
+                                             layers_set=layers_set)
+
+    def sublayers(self, include_self=False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        seen = set()
+        for name, layer in self._sub_layers.items():
+            if layer is not None and id(layer) not in seen:
+                seen.add(id(layer))
+                yield name, layer
+
+    def named_parameters(self, prefix="", include_sublayers=True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        layers = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for lp, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{lp}.{name}" if lp else name), p
+
+    def parameters(self, include_sublayers=True) -> List[Parameter]:
+        return [p for _, p in
+                self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        layers = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for lp, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{lp}.{name}" if lp else name), b
+
+    def buffers(self, include_sublayers=True) -> List[Tensor]:
+        return [b for _, b in
+                self.named_buffers(include_sublayers=include_sublayers)]
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- modes ---------------------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # -- state ---------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True
+                   ) -> Dict[str, Tensor]:
+        dest = destination if destination is not None else \
+            collections.OrderedDict()
+        for name, p in self.named_parameters(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(
+                prefix=structured_name_prefix.rstrip("."),
+                include_sublayers=include_sublayers):
+            shortname = name.rsplit(".", 1)[-1]
+            owner = self
+            if "." in name:
+                # find owner to check persistability
+                for ln, l in self.named_sublayers(include_self=True):
+                    if ln == name.rsplit(".", 1)[0]:
+                        owner = l
+                        break
+            if shortname not in owner._non_persistable_buffer_names:
+                dest[name] = b
+        return dest
+
+    @no_grad()
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """reference: layers.py set_state_dict; returns (missing, unexpected)."""
+        own = self.state_dict()
+        missing, matched = [], set()
+        for name, target in own.items():
+            if name in state_dict:
+                src = state_dict[name]
+                v = src.numpy() if isinstance(src, Tensor) else np.asarray(src)
+                if list(v.shape) != list(target.shape):
+                    raise ValueError(
+                        f"shape mismatch for {name}: checkpoint "
+                        f"{list(v.shape)} vs layer {list(target.shape)}")
+                target._replace_value(
+                    jax.numpy.asarray(v, dtype=target._value.dtype))
+                matched.add(name)
+            else:
+                missing.append(name)
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- dtype/device movement ----------------------------------------------
+    def _transform(self, fn):
+        with no_grad():
+            for l in self.sublayers(include_self=True):
+                for k, p in list(l._parameters.items()):
+                    if p is not None:
+                        p._replace_value(fn(p._value))
+                        if p.grad is not None:
+                            p.grad._replace_value(fn(p.grad._value))
+                for k, b in list(l._buffers.items()):
+                    if b is not None:
+                        b._replace_value(fn(b._value))
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        def fn(v):
+            if device is not None:
+                from ...device import _str_to_place, Place
+                p = device if isinstance(device, Place) else \
+                    _str_to_place(str(device))
+                v = jax.device_put(v, p.jax_device)
+            if dtype is not None and jax.numpy.issubdtype(
+                    v.dtype, jax.numpy.floating):
+                v = v.astype(convert_dtype(dtype))
+            return v
+        if dtype is not None:
+            self._dtype = convert_dtype(dtype)
+        return self._transform(fn)
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # -- functionalisation (TPU-native; no reference analog needed) ----------
+    def functional(self):
+        """Return ``(pure_fn, params, buffers)`` where
+        ``pure_fn(params, buffers, *args, **kwargs) -> (out, new_buffers)``
+        is jit/grad/pjit-safe. ``params`` and ``buffers`` are flat
+        name->value dicts of raw jax arrays."""
+        param_objs = dict(self.named_parameters())
+        buffer_objs = dict(self.named_buffers())
+        params = {k: to_value(v) for k, v in param_objs.items()}
+        buffers = {k: to_value(v) for k, v in buffer_objs.items()}
+
+        def pure_fn(params, buffers, *args, **kwargs):
+            saved = {}
+            for k, obj in param_objs.items():
+                saved[k] = obj._value
+                obj._value = params[k]
+            saved_b = {}
+            for k, obj in buffer_objs.items():
+                saved_b[k] = obj._value
+                obj._value = buffers[k]
+            try:
+                wrapped = [Tensor(a, stop_gradient=True)
+                           if isinstance(a, (jax.Array, jax.core.Tracer))
+                           else a for a in args]
+                out = self(*wrapped, **kwargs)
+                new_buffers = {k: obj._value for k, obj in buffer_objs.items()}
+                out_vals = jax.tree_util.tree_map(
+                    lambda t: to_value(t) if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+                return out_vals, new_buffers
+            finally:
+                for k, obj in param_objs.items():
+                    obj._value = saved[k]
+                for k, obj in buffer_objs.items():
+                    obj._value = saved_b[k]
+
+        return pure_fn, params, buffers
+
+    def _sync_buffers(self, new_buffers):
+        for k, obj in self.named_buffers():
+            if k in new_buffers:
+                obj._value = new_buffers[k]
+
+    # -- misc ----------------------------------------------------------------
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            mod_str = repr(layer)
+            mod_str = _addindent(mod_str, 2)
+            lines.append(f"({name}): {mod_str}")
+        main = self.__class__.__name__ + "("
+        if extra:
+            main += extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+
+def _addindent(s, n):
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    return lines[0] + "\n" + "\n".join(" " * n + l for l in lines[1:])
+
+
+class Sequential(Layer):
+    """reference: python/paddle/nn/layer/container.py Sequential."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0],
+                                           collections.OrderedDict):
+            for name, layer in layers[0].items():
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                if isinstance(layer, tuple):
+                    self.add_sublayer(layer[0], layer[1])
+                else:
+                    self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        keys = list(self._sub_layers.keys())
+        return self._sub_layers[keys[idx]]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, l in enumerate(sublayers):
+                self.add_sublayer(str(i), l)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return self._sub_layers[str(idx if idx >= 0 else
+                                    idx + len(self))]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self)), layer)
+        return self
+
+    def insert(self, index, layer):
+        layers = list(self._sub_layers.values())
+        layers.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(layers):
+            self._sub_layers[str(i)] = l
+
+    def extend(self, layers):
+        for l in layers:
+            self.append(l)
+        return self
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx if idx >= 0 else idx + len(self))]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self)), parameter)
+        return self
+
+
+class LayerDict(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        items = sublayers.items() if isinstance(sublayers, dict) else sublayers
+        for k, v in items:
+            self[k] = v
+        return self
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        layer = self._sub_layers.pop(key)
+        return layer

@@ -1,0 +1,374 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native design: the time loop is a ``lax.scan`` inside one dispatch —
+XLA compiles the whole unrolled-free recurrence (the reference uses cuDNN RNN
+descriptors, paddle/phi/kernels/gpu/rnn_kernel.cu; scan is the TPU analog).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer, LayerList
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU", "BiRNN", "RNNCellBase"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...tensor.creation import full
+        b = batch_ref.shape[batch_dim_idx]
+        return full([b, self.hidden_size], init_value,
+                    dtype=dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, dtype=inputs.dtype)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out
+        out = dispatch(f, (inputs, states, self.weight_ih, self.weight_hh,
+                           self.bias_ih, self.bias_hh), name="rnn_cell")
+        return out, out
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=0, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs, dtype=inputs.dtype)
+            states = (h, h)
+        h0, c0 = states
+
+        def f(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, fgt, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            fgt = jax.nn.sigmoid(fgt)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            c_new = fgt * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        h1, c1 = dispatch(f, (inputs, h0, c0, self.weight_ih, self.weight_hh,
+                              self.bias_ih, self.bias_hh), name="lstm_cell",
+                          multi_output=True)
+        return h1, (h1, c1)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, dtype=inputs.dtype)
+
+        def f(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            return (1.0 - z) * n + z * h
+        out = dispatch(f, (inputs, states, self.weight_ih, self.weight_hh,
+                           self.bias_ih, self.bias_hh), name="gru_cell")
+        return out, out
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time
+    (reference: python/paddle/nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import flip, transpose, unbind, stack
+        x = inputs
+        if not self.time_major:
+            x = transpose(x, [1, 0, 2])
+        if self.is_reverse:
+            x = flip(x, [0])
+        T = x.shape[0]
+        states = initial_states
+        outs = []
+        for t in range(T):
+            o, states = self.cell(x[t], states)
+            outs.append(o)
+        out = stack(outs, axis=0)
+        if self.is_reverse:
+            out = flip(out, [0])
+        if not self.time_major:
+            out = transpose(out, [1, 0, 2])
+        return out, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        o_fw, s_fw = self.rnn_fw(inputs, s_fw)
+        o_bw, s_bw = self.rnn_bw(inputs, s_bw)
+        return concat([o_fw, o_bw], axis=-1), (s_fw, s_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (bi)directional RNN driven by a single lax.scan per
+    layer/direction — the whole stack compiles to one XLA loop."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirect else 1
+        self.num_directions = num_dir
+        gate_mult = {"RNN_TANH": 1, "RNN_RELU": 1, "LSTM": 4, "GRU": 3}[
+            self.MODE]
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(num_dir):
+                in_sz = input_size if layer == 0 else hidden_size * num_dir
+                suffix = "_reverse" if d == 1 else ""
+                wih = self.create_parameter([gate_mult * hidden_size, in_sz],
+                                            weight_ih_attr,
+                                            default_initializer=u)
+                whh = self.create_parameter(
+                    [gate_mult * hidden_size, hidden_size], weight_hh_attr,
+                    default_initializer=u)
+                bih = self.create_parameter([gate_mult * hidden_size],
+                                            bias_ih_attr, is_bias=True,
+                                            default_initializer=u)
+                bhh = self.create_parameter([gate_mult * hidden_size],
+                                            bias_hh_attr, is_bias=True,
+                                            default_initializer=u)
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wih)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", whh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bih)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bhh)
+                self._all_weights.append((wih, whh, bih, bhh))
+
+    def _cell_step(self, mode):
+        if mode in ("RNN_TANH", "RNN_RELU"):
+            act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+
+            def step(carry, x, wi, wh, bi, bh):
+                h = carry[0]
+                h_new = act(x @ wi.T + bi + h @ wh.T + bh)
+                return (h_new,), h_new
+            return step
+        if mode == "LSTM":
+            def step(carry, x, wi, wh, bi, bh):
+                h, c = carry
+                gates = x @ wi.T + bi + h @ wh.T + bh
+                i, f, g, o = jnp.split(gates, 4, axis=-1)
+                c_new = jax.nn.sigmoid(f) * c + \
+                    jax.nn.sigmoid(i) * jnp.tanh(g)
+                h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+                return (h_new, c_new), h_new
+            return step
+
+        def step(carry, x, wi, wh, bi, bh):  # GRU
+            h = carry[0]
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            h_new = (1.0 - z) * n + z * h
+            return (h_new,), h_new
+        return step
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.MODE
+        num_dir = self.num_directions
+        nl = self.num_layers
+        hs = self.hidden_size
+        step = self._cell_step(mode)
+        n_state = 2 if mode == "LSTM" else 1
+        weights = [w for tpl in self._all_weights for w in tpl]
+
+        init_given = initial_states is not None
+        init_tensors = []
+        if init_given:
+            init_tensors = list(initial_states) if isinstance(
+                initial_states, (tuple, list)) else [initial_states]
+
+        def f(x, *flat):
+            ws = flat[:len(weights)]
+            inits = flat[len(weights):]
+            if not self.time_major:
+                x = jnp.swapaxes(x, 0, 1)
+            T, B = x.shape[0], x.shape[1]
+            if inits:
+                init_hs = [jnp.swapaxes(i, 0, 0) for i in inits]
+            out = x
+            final_states = []
+            wi_idx = 0
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(num_dir):
+                    wi, wh, bi, bh = ws[4 * wi_idx: 4 * wi_idx + 4]
+                    wi_idx += 1
+                    if inits:
+                        carry = tuple(
+                            inits[s][layer * num_dir + d]
+                            for s in range(n_state))
+                    else:
+                        carry = tuple(
+                            jnp.zeros((B, hs), dtype=x.dtype)
+                            for _ in range(n_state))
+                    seq = jnp.flip(out, 0) if d == 1 else out
+
+                    def scan_fn(c, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                        return step(c, xt, wi, wh, bi, bh)
+                    carry, ys = jax.lax.scan(scan_fn, carry, seq)
+                    if d == 1:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                    final_states.append(carry)
+                out = jnp.concatenate(dir_outs, axis=-1) if num_dir == 2 \
+                    else dir_outs[0]
+            # final states: [n_state][num_layers*num_dir, B, hs]
+            finals = []
+            for s in range(n_state):
+                finals.append(jnp.stack([fs[s] for fs in final_states],
+                                        axis=0))
+            if not self.time_major:
+                out = jnp.swapaxes(out, 0, 1)
+            return tuple([out] + finals)
+        args = (inputs,) + tuple(weights) + tuple(init_tensors)
+        outs = dispatch(f, args, name=f"rnn_{mode.lower()}",
+                        multi_output=True)
+        out = outs[0]
+        if mode == "LSTM":
+            return out, (outs[1], outs[2])
+        return out, outs[1]
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

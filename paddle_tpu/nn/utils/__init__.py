@@ -1,0 +1,97 @@
+"""nn.utils (reference: python/paddle/nn/utils/)."""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, no_grad
+
+__all__ = ["parameters_to_vector", "vector_to_parameters", "weight_norm",
+           "remove_weight_norm", "spectral_norm"]
+
+
+@no_grad()
+def parameters_to_vector(parameters, name=None) -> Tensor:
+    return Tensor(jnp.concatenate(
+        [p._value.reshape(-1) for p in parameters]))
+
+
+@no_grad()
+def vector_to_parameters(vec: Tensor, parameters, name=None):
+    offset = 0
+    v = vec._value
+    for p in parameters:
+        n = p.size
+        p._replace_value(v[offset:offset + n].reshape(p._value.shape)
+                         .astype(p._value.dtype))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterise weight = g * v/||v|| (reference:
+    python/paddle/nn/utils/weight_norm_hook.py)."""
+    import numpy as np
+    from ...framework import Parameter
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1
+    axes = tuple(i for i in range(w.ndim) if i != (dim % w.ndim)) \
+        if dim != -1 else tuple(range(w.ndim))
+    g_val = jnp.sqrt(jnp.sum(jnp.square(w._value), axis=axes, keepdims=False))
+    g = Parameter(g_val, name=f"{name}_g")
+    v = Parameter(w._value, name=f"{name}_v")
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+
+    def compute(layer):
+        from ...core.tensor import dispatch
+        def f(gv, vv):
+            if dim == -1:
+                n = jnp.sqrt(jnp.sum(jnp.square(vv)))
+                return gv * vv / jnp.maximum(n, 1e-12)
+            n = jnp.sqrt(jnp.sum(jnp.square(vv), axis=axes, keepdims=True))
+            shape = [1] * vv.ndim
+            shape[dim % vv.ndim] = -1
+            return gv.reshape(shape) * vv / jnp.maximum(n, 1e-12)
+        return dispatch(f, (g, v), name="weight_norm")
+
+    def pre_hook(l, inputs):
+        object.__setattr__(l, name, compute(l))
+        return None
+    handle = layer.register_forward_pre_hook(pre_hook)
+    layer._weight_norm_hook = (handle, name, dim)
+    object.__setattr__(layer, name, compute(layer))
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    handle, nm, dim = layer._weight_norm_hook
+    handle.remove()
+    from ...framework import Parameter
+    w = getattr(layer, nm)
+    g = layer._parameters.pop(nm + "_g")
+    v = layer._parameters.pop(nm + "_v")
+    layer.add_parameter(nm, Parameter(w._value if isinstance(w, Tensor)
+                                      else w, name=nm))
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    from ..layer.norm import SpectralNorm as _SN
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    sn = _SN(list(w.shape), dim=dim, power_iters=n_power_iterations,
+             epsilon=eps)
+    layer.add_sublayer(name + "_sn", sn)
+    orig = layer._parameters[name]
+
+    def pre_hook(l, inputs):
+        object.__setattr__(l, name, sn(orig))
+        return None
+    layer.register_forward_pre_hook(pre_hook)
+    return layer

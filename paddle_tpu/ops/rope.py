@@ -1,0 +1,57 @@
+"""Rotary position embedding (reference CUDA kernel:
+paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu; python API
+python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py).
+
+Pure-jnp implementation: XLA fuses the elementwise rotation into adjacent
+ops, so a Pallas kernel buys nothing here — the win on TPU is avoiding
+materialised sin/cos broadcasts, which this formulation achieves.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def build_rope_cache(seq_len: int, head_dim: int, base: float = 10000.0,
+                     dtype=jnp.float32):
+    """Return (sin, cos) of shape [seq_len, head_dim//2]."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2,
+                                          dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.sin(freqs).astype(dtype), jnp.cos(freqs).astype(dtype)
+
+
+def apply_rope(x, sin=None, cos=None, position_ids=None,
+               use_neox_rotary_style=True, base=10000.0):
+    """x: [batch, seq, heads, head_dim]."""
+    b, s, h, d = x.shape
+    if sin is None or cos is None:
+        sin, cos = build_rope_cache(s, d, base=base)
+    sin = jnp.asarray(sin)
+    cos = jnp.asarray(cos)
+    if sin.ndim == 4:  # [1, s, 1, d] paddle convention: take half
+        sin = sin[0, :, 0, : d // 2] if sin.shape[-1] == d else sin[0, :, 0]
+        cos = cos[0, :, 0, : d // 2] if cos.shape[-1] == d else cos[0, :, 0]
+    if position_ids is not None:
+        sin = jnp.take(sin, position_ids, axis=0)  # [b, s, d/2]
+        cos = jnp.take(cos, position_ids, axis=0)
+        sin = sin[:, :, None, :]
+        cos = cos[:, :, None, :]
+    else:
+        sin = sin[None, :, None, :]
+        cos = cos[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    if use_neox_rotary_style:
+        x1 = xf[..., : d // 2]
+        x2 = xf[..., d // 2:]
+        out = jnp.concatenate([x1 * cos - x2 * sin,
+                               x2 * cos + x1 * sin], axis=-1)
+    else:  # GPT-J interleaved
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        out = jnp.stack([r1, r2], axis=-1).reshape(xf.shape)
+    return out.astype(x.dtype)

@@ -1,0 +1,227 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py:128).
+
+TPU-native design: each optimizer defines a pure per-parameter update rule
+``_update(param, grad, accumulators, lr) -> (new_param, new_accs)``. ``step``
+executes ALL parameter updates inside ONE jitted function with donated
+buffers, so the whole optimizer pass is a single fused XLA program — the
+analog (and usually superior) of the reference's fused multi_tensor_adam
+(paddle/phi/kernels/fusion/gpu/fused_adam_kernel.cu).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, no_grad, to_value
+from ..nn.clip import ClipGradBase
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        from .lr import LRScheduler
+        if parameters is None:
+            raise ValueError(
+                "parameters is required (pass model.parameters())")
+        if isinstance(parameters, dict):
+            raise TypeError("parameters must be a list, not dict")
+        parameters = list(parameters)
+        self._param_groups: List[Dict] = []
+        if parameters and isinstance(parameters[0], dict):
+            for g in parameters:
+                g = dict(g)
+                g.setdefault("weight_decay", weight_decay)
+                g.setdefault("learning_rate", 1.0)
+                self._param_groups.append(g)
+            self._parameter_list = [p for g in self._param_groups
+                                    for p in g["params"]]
+        else:
+            self._parameter_list = parameters
+            self._param_groups.append({"params": parameters,
+                                       "weight_decay": weight_decay,
+                                       "learning_rate": 1.0})
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._accumulators: Dict[str, Dict[int, jax.Array]] = \
+            collections.defaultdict(dict)
+        self._global_step = 0
+        self._compiled_update = None
+        self._name = name or type(self).__name__
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        from .lr import LRScheduler
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        from .lr import LRScheduler
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when learning rate is a scheduler")
+        self._learning_rate = float(value)
+        return self
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # -- accumulators --------------------------------------------------------
+    def _accumulator_names(self) -> List[str]:
+        return []
+
+    def _init_accumulator(self, name: str, p: Tensor) -> jax.Array:
+        return jnp.zeros_like(to_value(p))
+
+    def _get_accumulator(self, name: str, p: Tensor) -> jax.Array:
+        accs = self._accumulators[name]
+        if id(p) not in accs:
+            accs[id(p)] = self._init_accumulator(name, p)
+        return accs[id(p)]
+
+    # -- core update rule (pure; overridden per optimizer) -------------------
+    def _update(self, p, g, accs: Dict[str, jax.Array], lr, weight_decay,
+                master=None, step=None):
+        raise NotImplementedError
+
+    def _use_master_weights(self) -> bool:
+        return False
+
+    def _master(self, p: Tensor) -> Optional[jax.Array]:
+        if not self._use_master_weights():
+            return None
+        if to_value(p).dtype in (jnp.float16, jnp.bfloat16):
+            accs = self._accumulators["master_weight"]
+            if id(p) not in accs:
+                accs[id(p)] = to_value(p).astype(jnp.float32)
+            return accs[id(p)]
+        return None
+
+    # -- step ----------------------------------------------------------------
+    @no_grad()
+    def step(self):
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if (not p.stop_gradient and p.grad is not None)]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._apply(params_grads)
+        self._global_step += 1
+
+    minimize_step = step
+
+    def _apply(self, params_grads):
+        lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
+        names = self._accumulator_names()
+        wd_of = {}
+        lr_scale_of = {}
+        for g in self._param_groups:
+            for p in g["params"]:
+                wd_of[id(p)] = g.get("weight_decay")
+                lr_scale_of[id(p)] = g.get("learning_rate", 1.0)
+        for p, grad in params_grads:
+            if grad is None:
+                continue
+            accs = {n: self._get_accumulator(n, p) for n in names}
+            master = self._master(p)
+            attr = getattr(p, "_param_attr", None)
+            plr = lr * float(lr_scale_of.get(id(p), 1.0)) * (
+                attr.learning_rate if attr is not None else 1.0)
+            wd = wd_of.get(id(p))
+            if attr is not None and attr.regularizer is not None:
+                wd = attr.regularizer
+            step = jnp.asarray(self._global_step + 1, dtype=jnp.float32)
+            new_p, new_accs, new_master = self._jit_update(
+                to_value(p), to_value(grad), accs, plr, wd, master, step)
+            p._replace_value(new_p)
+            for n in names:
+                self._accumulators[n][id(p)] = new_accs[n]
+            if new_master is not None:
+                self._accumulators["master_weight"][id(p)] = new_master
+        self._post_apply()
+
+    def _post_apply(self):
+        pass
+
+    def _jit_update(self, p_val, g_val, accs, lr, wd, master, step):
+        # one jitted update per (optimizer, shapes); donated in/out aliasing
+        # keeps memory flat
+        wd_val = _wd_value(wd)
+        fn = self._cached_update_fn()
+        return fn(p_val, g_val, accs, lr, wd_val, master, step)
+
+    def _cached_update_fn(self):
+        if self._compiled_update is None:
+            def upd(p, g, accs, lr, wd, master, step):
+                return self._update(p, g, accs, lr, wd, master, step=step)
+            self._compiled_update = jax.jit(upd, donate_argnums=(0, 2, 5))
+        return self._compiled_update
+
+    @no_grad()
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list]
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self) -> Dict:
+        from .lr import LRScheduler
+        state = {"global_step": self._global_step, "accumulators": {}}
+        name_of = {}
+        for i, p in enumerate(self._parameter_list):
+            name_of[id(p)] = p.name or f"param_{i}"
+        for acc_name, accs in self._accumulators.items():
+            for pid, v in accs.items():
+                key = f"{name_of.get(pid, pid)}.{acc_name}"
+                state["accumulators"][key] = Tensor(v)
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        return state
+
+    def set_state_dict(self, state_dict: Dict):
+        from .lr import LRScheduler
+        self._global_step = state_dict.get("global_step", 0)
+        if "LR_Scheduler" in state_dict and \
+                isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        name_of = {}
+        for i, p in enumerate(self._parameter_list):
+            name_of[p.name or f"param_{i}"] = p
+        for key, v in state_dict.get("accumulators", {}).items():
+            pname, acc_name = key.rsplit(".", 1)
+            p = name_of.get(pname)
+            if p is not None:
+                self._accumulators[acc_name][id(p)] = to_value(
+                    v if isinstance(v, Tensor) else Tensor(v))
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.get_lr()})"
+
+
+def _wd_value(wd):
+    if wd is None:
+        return 0.0
+    if isinstance(wd, (int, float)):
+        return float(wd)
+    # L2Decay-style object
+    coeff = getattr(wd, "coeff", None)
+    if coeff is None:
+        coeff = getattr(wd, "_coeff", 0.0)
+    return float(coeff)
+
+
+def _decoupled_wd(p32, lr, wd):
+    # AdamW-style decoupled decay
+    return p32 * (1.0 - lr * wd)

@@ -1,0 +1,402 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,
+adamw,lamb,rmsprop,adagrad,adadelta,adamax,nadam,radam}.py).
+
+Update math is computed in float32 regardless of param dtype (master-weight
+semantics of the reference's multi_precision mode) and cast back.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["SGD", "Momentum", "Adam", "AdamW", "Adamax", "Adagrad",
+           "Adadelta", "RMSProp", "Lamb", "NAdam", "RAdam", "LBFGS"]
+
+
+def _f32(v):
+    return v.astype(jnp.float32)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=True,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._multi_precision = multi_precision
+
+    def _use_master_weights(self):
+        return self._multi_precision
+
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        p32 = master if master is not None else _f32(p)
+        g32 = _f32(g) + wd * p32
+        new_p32 = p32 - lr * g32
+        return new_p32.astype(p.dtype), accs, (
+            new_p32 if master is not None else None)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+        self._multi_precision = multi_precision
+
+    def _use_master_weights(self):
+        return self._multi_precision
+
+    def _accumulator_names(self):
+        return ["velocity"]
+
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        p32 = master if master is not None else _f32(p)
+        g32 = _f32(g) + wd * p32
+        v = accs["velocity"] * self._momentum + g32
+        if self._use_nesterov:
+            new_p32 = p32 - lr * (g32 + self._momentum * v)
+        else:
+            new_p32 = p32 - lr * v
+        return new_p32.astype(p.dtype), {"velocity": v}, (
+            new_p32 if master is not None else None)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._multi_precision = multi_precision
+        self._amsgrad = amsgrad
+
+    def _use_master_weights(self):
+        return self._multi_precision
+
+    def _accumulator_names(self):
+        names = ["moment1", "moment2"]
+        if self._amsgrad:
+            names.append("moment2_max")
+        return names
+
+    def _init_accumulator(self, name, p):
+        from ..core.tensor import to_value
+        return jnp.zeros(to_value(p).shape, dtype=jnp.float32)
+
+    def _coupled_wd(self) -> bool:
+        return True  # L2 into gradient (paddle Adam regularization semantics)
+
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        t = step
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        p32 = master if master is not None else _f32(p)
+        g32 = _f32(g)
+        if self._coupled_wd():
+            g32 = g32 + wd * p32
+        m = b1 * accs["moment1"] + (1 - b1) * g32
+        v = b2 * accs["moment2"] + (1 - b2) * jnp.square(g32)
+        mhat = m / (1 - b1 ** t)
+        if self._amsgrad:
+            vmax = jnp.maximum(accs["moment2_max"], v)
+            vhat = vmax / (1 - b2 ** t)
+        else:
+            vhat = v / (1 - b2 ** t)
+        new_p32 = p32 - lr * mhat / (jnp.sqrt(vhat) + eps)
+        if not self._coupled_wd():
+            new_p32 = new_p32 - lr * wd * p32
+        new_accs = {"moment1": m, "moment2": v}
+        if self._amsgrad:
+            new_accs["moment2_max"] = vmax
+        return new_p32.astype(p.dtype), new_accs, (
+            new_p32 if master is not None else None)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py).
+    Fused Pallas single-kernel variant available via
+    incubate.nn.functional.fused_adamw."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, amsgrad=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         amsgrad=amsgrad, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _coupled_wd(self):
+        return False
+
+    def _apply(self, params_grads):
+        if self._apply_decay_param_fun is not None:
+            # temporarily zero wd for excluded params via param groups
+            filtered = []
+            for p, g in params_grads:
+                if not self._apply_decay_param_fun(p.name):
+                    attr = getattr(p, "_param_attr", None)
+                    p._skip_decay = True
+                else:
+                    p._skip_decay = False
+                filtered.append((p, g))
+            params_grads = filtered
+        super()._apply(params_grads)
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _accumulator_names(self):
+        return ["moment", "inf_norm"]
+
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        t = step
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        p32, g32 = _f32(p), _f32(g)
+        g32 = g32 + wd * p32
+        m = b1 * accs["moment"] + (1 - b1) * g32
+        u = jnp.maximum(b2 * accs["inf_norm"], jnp.abs(g32))
+        new_p32 = p32 - (lr / (1 - b1 ** t)) * m / (u + eps)
+        return new_p32.astype(p.dtype), {"moment": m, "inf_norm": u}, None
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _accumulator_names(self):
+        return ["moment"]
+
+    def _init_accumulator(self, name, p):
+        from ..core.tensor import to_value
+        return jnp.full(to_value(p).shape, self._initial, dtype=jnp.float32)
+
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        p32, g32 = _f32(p), _f32(g)
+        g32 = g32 + wd * p32
+        m = accs["moment"] + jnp.square(g32)
+        new_p32 = p32 - lr * g32 / (jnp.sqrt(m) + self._epsilon)
+        return new_p32.astype(p.dtype), {"moment": m}, None
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _accumulator_names(self):
+        return ["avg_squared_grad", "avg_squared_update"]
+
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        rho, eps = self._rho, self._epsilon
+        p32, g32 = _f32(p), _f32(g)
+        g32 = g32 + wd * p32
+        sg = rho * accs["avg_squared_grad"] + (1 - rho) * jnp.square(g32)
+        upd = -jnp.sqrt((accs["avg_squared_update"] + eps) / (sg + eps)) * g32
+        su = rho * accs["avg_squared_update"] + (1 - rho) * jnp.square(upd)
+        new_p32 = p32 + lr * upd
+        return new_p32.astype(p.dtype), {"avg_squared_grad": sg,
+                                         "avg_squared_update": su}, None
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _accumulator_names(self):
+        return ["mean_square", "mean_grad", "momentum"]
+
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        rho, eps = self._rho, self._epsilon
+        p32, g32 = _f32(p), _f32(g)
+        g32 = g32 + wd * p32
+        ms = rho * accs["mean_square"] + (1 - rho) * jnp.square(g32)
+        if self._centered:
+            mg = rho * accs["mean_grad"] + (1 - rho) * g32
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            mg = accs["mean_grad"]
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * accs["momentum"] + lr * g32 / denom
+        new_p32 = p32 - mom
+        return new_p32.astype(p.dtype), {"mean_square": ms, "mean_grad": mg,
+                                         "momentum": mom}, None
+
+
+class Lamb(Optimizer):
+    """reference: python/paddle/optimizer/lamb.py."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._multi_precision = multi_precision
+
+    def _use_master_weights(self):
+        return self._multi_precision
+
+    def _accumulator_names(self):
+        return ["moment1", "moment2"]
+
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        t = step
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        p32 = master if master is not None else _f32(p)
+        g32 = _f32(g)
+        m = b1 * accs["moment1"] + (1 - b1) * g32
+        v = b2 * accs["moment2"] + (1 - b2) * jnp.square(g32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new_p32 = p32 - lr * trust * r
+        return new_p32.astype(p.dtype), {"moment1": m, "moment2": v}, (
+            new_p32 if master is not None else None)
+
+
+class NAdam(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, name=name)
+        self._momentum_decay = momentum_decay
+
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        t = step
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        psi = self._momentum_decay
+        p32, g32 = _f32(p), _f32(g)
+        g32 = g32 + wd * p32
+        mu_t = b1 * (1 - 0.5 * 0.96 ** (t * psi))
+        mu_t1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * psi))
+        m = b1 * accs["moment1"] + (1 - b1) * g32
+        v = b2 * accs["moment2"] + (1 - b2) * jnp.square(g32)
+        prod = mu_t  # running product approximated by power
+        mhat = mu_t1 * m / (1 - b1 ** (t + 1)) + \
+            (1 - mu_t) * g32 / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        new_p32 = p32 - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p32.astype(p.dtype), {"moment1": m, "moment2": v}, None
+
+
+class RAdam(Adam):
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        t = step
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        p32, g32 = _f32(p), _f32(g)
+        g32 = g32 + wd * p32
+        m = b1 * accs["moment1"] + (1 - b1) * g32
+        v = b2 * accs["moment2"] + (1 - b2) * jnp.square(g32)
+        mhat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1
+        rho_t = rho_inf - 2.0 * t * (b2 ** t) / (1 - b2 ** t)
+        lt = jnp.sqrt(1 - b2 ** t) / (jnp.sqrt(v) + eps)
+        rt = jnp.sqrt(jnp.maximum(
+            (rho_t - 4) * (rho_t - 2) * rho_inf /
+            ((rho_inf - 4) * (rho_inf - 2) * jnp.maximum(rho_t, 1e-6)), 0.0))
+        new_p32 = jnp.where(rho_t > 5.0,
+                            p32 - lr * mhat * rt * lt,
+                            p32 - lr * mhat)
+        return new_p32.astype(p.dtype), {"moment1": m, "moment2": v}, None
+
+
+class LBFGS(Optimizer):
+    """Minimal LBFGS (reference: python/paddle/optimizer/lbfgs.py); uses a
+    closure like the reference."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, history_size=100,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._max_iter = max_iter
+        self._history = []
+        self._prev_flat_grad = None
+
+    def step(self, closure=None):
+        import numpy as np
+        from ..core.tensor import Tensor, to_value
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        loss = closure()
+
+        def flat_grads():
+            return jnp.concatenate([
+                to_value(p.grad).reshape(-1).astype(jnp.float32)
+                for p in self._parameter_list if p.grad is not None])
+
+        g = flat_grads()
+        if self._prev_flat_grad is not None:
+            s = self._last_step
+            y = g - self._prev_flat_grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._history.append((s, y))
+                if len(self._history) > 100:
+                    self._history.pop(0)
+        q = g
+        alphas = []
+        for s, y in reversed(self._history):
+            rho = 1.0 / jnp.dot(y, s)
+            a = rho * jnp.dot(s, q)
+            q = q - a * y
+            alphas.append((a, rho))
+        if self._history:
+            s, y = self._history[-1]
+            q = q * (jnp.dot(s, y) / jnp.dot(y, y))
+        for (s, y), (a, rho) in zip(self._history, reversed(alphas)):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        d = -q
+        lr = self.get_lr()
+        step_vec = lr * d
+        offset = 0
+        for p in self._parameter_list:
+            if p.grad is None:
+                continue
+            n = p.size
+            upd = step_vec[offset:offset + n].reshape(p._value.shape)
+            p._replace_value((p._value.astype(jnp.float32) + upd
+                              ).astype(p._value.dtype))
+            offset += n
+        self._last_step = step_vec
+        self._prev_flat_grad = g
+        self._global_step += 1
+        return loss

@@ -1,0 +1,78 @@
+"""paddle_tpu.static: static-graph-style utilities.
+
+The reference's static mode (python/paddle/static/, Program/Executor,
+StandaloneExecutor) maps onto jit-compiled pure functions + StableHLO export;
+there is no separate Program IR to author by hand. This module provides the
+API-parity pieces that still make sense: InputSpec, an Executor facade over
+compiled callables, and StableHLO export.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor, to_value
+
+__all__ = ["InputSpec", "export_stablehlo", "Executor", "default_main_program"]
+
+_static_mode = [False]
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(list(ndarray.shape), ndarray.dtype, name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+def export_stablehlo(fn, example_args, static_argnums=()):
+    """Export a pure function to StableHLO text — the static-mode artifact
+    (the reference's CINN/PIR path emits its own IR; we emit StableHLO)."""
+    vals = jax.tree_util.tree_map(
+        lambda a: to_value(a) if isinstance(a, Tensor) else a, example_args,
+        is_leaf=lambda a: isinstance(a, Tensor))
+    lowered = jax.jit(fn, static_argnums=static_argnums).lower(*vals)
+    return lowered.as_text()
+
+
+class Executor:
+    """Facade for API parity with reference
+    python/paddle/base/executor.py:1237; runs compiled callables."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            feed = feed or {}
+            out = program(**feed)
+            return out if isinstance(out, (list, tuple)) else [out]
+        raise TypeError(
+            "paddle_tpu.static.Executor runs compiled callables "
+            "(jit.to_static functions); Program objects do not exist "
+            "in the TPU-native design — see SURVEY.md §2.6 item 5/6")
+
+
+def default_main_program():
+    raise NotImplementedError(
+        "No Program IR in the TPU-native design; author models eagerly and "
+        "compile with paddle_tpu.jit.to_static")

@@ -1,0 +1,44 @@
+"""Tensor op namespace; also patches ops onto Tensor as methods
+(reference: python/paddle/tensor/__init__.py's tensor_method_func monkey-patch
+mechanism)."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+from . import creation, linalg, logic, manipulation, math, random, search, stat
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+_METHOD_SOURCES = [math, manipulation, linalg, logic, search, stat, creation,
+                   random]
+
+# names that are attributes/properties or python-reserved on Tensor already
+_SKIP = {"Tensor", "to_tensor", "meshgrid", "broadcast_shape", "zeros",
+         "ones", "full", "empty", "arange", "linspace", "logspace", "eye",
+         "rand", "randn", "randint", "randperm", "uniform", "is_tensor",
+         "tril_indices", "triu_indices", "one_hot", "assign"}
+
+
+def _patch():
+    import types
+    for mod in _METHOD_SOURCES:
+        for name in dir(mod):
+            if name.startswith("_") or name in _SKIP:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn) or isinstance(fn, type):
+                continue
+            if getattr(fn, "__module__", "").startswith("jax"):
+                continue
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
+
+
+_patch()
+del _patch

@@ -1,0 +1,193 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..core import random as _random
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "meshgrid", "tril", "triu", "assign", "clone",
+    "complex", "polar", "tril_indices", "triu_indices", "one_hot",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(to_value(s)) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """reference: python/paddle/tensor/creation.py to_tensor."""
+    t = Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+    if place is not None:
+        from ..device import _str_to_place, Place
+        p = place if isinstance(place, Place) else _str_to_place(str(place))
+        t._value = jax.device_put(t._value, p.jax_device)
+    return t
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    dtype = convert_dtype(dtype) if dtype else get_default_dtype()
+    return Tensor(jnp.zeros(_shape(shape), dtype=dtype))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    dtype = convert_dtype(dtype) if dtype else get_default_dtype()
+    return Tensor(jnp.ones(_shape(shape), dtype=dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = np.bool_
+        elif isinstance(fill_value, int):
+            dtype = np.int64
+        else:
+            dtype = get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value,
+                           dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype=dtype)  # XLA has no uninitialised buffers
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    d = convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.zeros_like(to_value(x), dtype=d))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    d = convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.ones_like(to_value(x), dtype=d))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    d = convert_dtype(dtype) if dtype else None
+    return Tensor(jnp.full_like(to_value(x), fill_value, dtype=d))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    start = to_value(start) if isinstance(start, Tensor) else start
+    end = to_value(end) if isinstance(end, Tensor) else end
+    step = to_value(step) if isinstance(step, Tensor) else step
+    if dtype is None:
+        vals = [v for v in (start, end, step) if v is not None]
+        dtype = (get_default_dtype()
+                 if any(isinstance(v, float) or
+                        (hasattr(v, "dtype") and
+                         jnp.issubdtype(np.asarray(v).dtype, np.floating))
+                        for v in vals) else np.int64)
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    dtype = convert_dtype(dtype) if dtype else get_default_dtype()
+    return Tensor(jnp.linspace(to_value(start), to_value(stop), int(num),
+                               dtype=dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    dtype = convert_dtype(dtype) if dtype else get_default_dtype()
+    return Tensor(jnp.logspace(to_value(start), to_value(stop), int(num),
+                               base=base, dtype=dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    dtype = convert_dtype(dtype) if dtype else get_default_dtype()
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns else None,
+                          dtype=dtype))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    def f(v):
+        if v.ndim == 1 and padding_value != 0:
+            n = v.shape[0] + abs(offset)
+            out = jnp.full((n, n), padding_value, dtype=v.dtype)
+            idx = jnp.arange(v.shape[0])
+            r = idx if offset >= 0 else idx - offset
+            c = idx + offset if offset >= 0 else idx
+            return out.at[r, c].set(v)
+        return jnp.diag(v, k=offset)
+    return dispatch(f, (x,), name="diag")
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return dispatch(lambda v: jnp.diagflat(v, k=offset), (x,), name="diagflat")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = dispatch(lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")),
+                    args, name="meshgrid", multi_output=True)
+    return list(outs)
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return dispatch(lambda v: jnp.tril(v, k=diagonal), (x,), name="tril")
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return dispatch(lambda v: jnp.triu(v, k=diagonal), (x,), name="triu")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64") -> Tensor:
+    col = col if col is not None else row
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(
+        convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64") -> Tensor:
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.stack([jnp.asarray(r), jnp.asarray(c)]).astype(
+        convert_dtype(dtype)))
+
+
+def assign(x, output: Optional[Tensor] = None) -> Tensor:
+    v = to_value(x) if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is None:
+        return Tensor(v)
+    output._replace_value(jnp.asarray(v, dtype=output._value.dtype))
+    return output
+
+
+def clone(x, name=None) -> Tensor:
+    return x.clone() if isinstance(x, Tensor) else Tensor(x).clone()
+
+
+def complex(real, imag, name=None) -> Tensor:
+    return dispatch(jax.lax.complex, (real, imag), name="complex")
+
+
+def polar(abs, angle, name=None) -> Tensor:
+    return dispatch(lambda a, t: jax.lax.complex(a * jnp.cos(t),
+                                                 a * jnp.sin(t)),
+                    (abs, angle), name="polar")
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    return dispatch(
+        lambda v: jax.nn.one_hot(v, num_classes, dtype=get_default_dtype()),
+        (x,), name="one_hot")

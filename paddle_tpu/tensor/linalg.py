@@ -1,0 +1,319 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py).
+
+``matmul`` is the MXU hot path: keep operands bf16/fp32 and let XLA choose
+tiling; no cuBLAS-style handle management exists (reference
+paddle/phi/kernels/funcs/blas/ is superseded by XLA dot_general).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return dispatch(f, (_ensure(x), _ensure(y)), name="matmul")
+
+
+def dot(x, y, name=None):
+    def f(a, b):
+        return jnp.sum(a * b, axis=-1)
+    return dispatch(f, (x, _ensure(y)), name="dot")
+
+
+def bmm(x, y, name=None):
+    return dispatch(jnp.matmul, (x, _ensure(y)), name="bmm")
+
+
+def mv(x, vec, name=None):
+    return dispatch(jnp.matmul, (x, _ensure(vec)), name="mv")
+
+
+def t(input, name=None):
+    def f(v):
+        if v.ndim < 2:
+            return v
+        return v.T
+    return dispatch(f, (input,), name="t")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(v):
+        if axis is None and (p is None or p == "fro" or p == 2):
+            return jnp.sqrt(jnp.sum(jnp.real(v * jnp.conj(v))))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        pp = 2 if p is None or p == "fro" else p
+        if pp == np.inf or pp == "inf":
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if pp == -np.inf:
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if pp == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax,
+                           keepdims=keepdim)
+        if pp == 1:
+            return jnp.sum(jnp.abs(v), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(v) ** pp, axis=ax,
+                       keepdims=keepdim) ** (1.0 / pp)
+    return dispatch(f, (x,), name="norm")
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def f(v):
+        return jnp.linalg.norm(v, ord=p, axis=tuple(axis), keepdims=keepdim)
+    return dispatch(f, (x,), name="matrix_norm")
+
+
+def dist(x, y, p=2, name=None):
+    def f(a, b):
+        d = a - b
+        if p == np.inf:
+            return jnp.max(jnp.abs(d))
+        if p == -np.inf:
+            return jnp.min(jnp.abs(d))
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return dispatch(f, (x, _ensure(y)), name="dist")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return dispatch(f, (x, _ensure(y)), name="cdist")
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis of size 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return dispatch(f, (x, _ensure(y)), name="cross")
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    v = np.asarray(to_value(_ensure(x)))
+    w = np.asarray(to_value(_ensure(weights))) if weights is not None else None
+    h, e = np.histogramdd(v, bins=bins, range=ranges, density=density,
+                          weights=w)
+    return Tensor(h), [Tensor(ei) for ei in e]
+
+
+def einsum(equation, *operands):
+    tensors = tuple(_ensure(o) for o in operands)
+    return dispatch(lambda *vs: jnp.einsum(equation, *vs), tensors,
+                    name="einsum")
+
+
+# -- decompositions (jnp.linalg) ------------------------------------------
+def cholesky(x, upper=False, name=None):
+    def f(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return dispatch(f, (x,), name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        Lc = jnp.swapaxes(L, -1, -2).conj() if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lc, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(Lc, -1, -2).conj(), z, lower=False)
+    return dispatch(f, (x, _ensure(y)), name="cholesky_solve")
+
+
+def inv(x, name=None):
+    return dispatch(jnp.linalg.inv, (x,), name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return dispatch(lambda v: jnp.linalg.pinv(v, rtol=rcond,
+                                              hermitian=hermitian),
+                    (x,), name="pinv")
+
+
+def det(x, name=None):
+    return dispatch(jnp.linalg.det, (x,), name="det")
+
+
+def slogdet(x, name=None):
+    def f(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+    return dispatch(f, (x,), name="slogdet")
+
+
+def svd(x, full_matrices=False, name=None):
+    return dispatch(
+        lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)),
+        (x,), name="svd", multi_output=True)
+
+
+def svdvals(x, name=None):
+    return dispatch(lambda v: jnp.linalg.svd(v, compute_uv=False), (x,),
+                    name="svdvals")
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        return dispatch(lambda v: jnp.linalg.qr(v, mode="r"), (x,), name="qr")
+    return dispatch(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), (x,),
+                    name="qr", multi_output=True)
+
+
+def eig(x, name=None):
+    # general eig has no TPU/GPU lowering in XLA: run on CPU like the
+    # reference runs it on host for some dtypes
+    v = to_value(_ensure(x))
+    w, vec = np.linalg.eig(np.asarray(v))
+    return Tensor(w), Tensor(vec)
+
+
+def eigvals(x, name=None):
+    v = to_value(_ensure(x))
+    return Tensor(np.linalg.eigvals(np.asarray(v)))
+
+
+def eigh(x, UPLO="L", name=None):
+    return dispatch(lambda v: tuple(jnp.linalg.eigh(v,
+                                                    symmetrize_input=True)),
+                    (x,), name="eigh", multi_output=True)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return dispatch(lambda v: jnp.linalg.eigvalsh(v), (x,), name="eigvalsh")
+
+
+def matrix_power(x, n, name=None):
+    return dispatch(lambda v: jnp.linalg.matrix_power(v, n), (x,),
+                    name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    def f(v):
+        return jnp.linalg.matrix_rank(v, rtol=tol).astype(jnp.int64)
+    return dispatch(f, (x,), name="matrix_rank")
+
+
+def solve(x, y, name=None):
+    def f(a, b):
+        squeeze = b.ndim == a.ndim - 1
+        bb = b[..., None] if squeeze else b
+        out = jnp.linalg.solve(a, bb)
+        return out[..., 0] if squeeze else out
+    return dispatch(f, (x, _ensure(y)), name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return dispatch(f, (x, _ensure(y)), name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int64), sv
+    return dispatch(f, (x, _ensure(y)), name="lstsq", multi_output=True)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(v):
+        lu_mat, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_mat, (piv + 1).astype(jnp.int32)  # paddle uses 1-based pivots
+    lu_mat, piv = dispatch(f, (x,), name="lu", multi_output=True)
+    if get_infos:
+        from .creation import zeros
+        return lu_mat, piv, zeros([1], dtype="int32")
+    return lu_mat, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def f(lu_mat, piv):
+        m = lu_mat.shape[-2]
+        L = jnp.tril(lu_mat, -1) + jnp.eye(m, lu_mat.shape[-1],
+                                           dtype=lu_mat.dtype)
+        L = L[..., :, :min(lu_mat.shape[-2:])]
+        U = jnp.triu(lu_mat)[..., :min(lu_mat.shape[-2:]), :]
+        perm = jnp.arange(m)
+        def body(i, p):
+            j = piv[i] - 1
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        P = jnp.eye(m, dtype=lu_mat.dtype)[perm].T
+        return P, L, U
+    return dispatch(f, (x, _ensure(y)), name="lu_unpack", multi_output=True)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return dispatch(lambda v: jnp.corrcoef(v, rowvar=rowvar), (x,),
+                    name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def f(v):
+        return jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0)
+    return dispatch(f, (x,), name="cov")
+
+
+def multi_dot(x, name=None):
+    tensors = tuple(_ensure(t) for t in x)
+    return dispatch(lambda *vs: jnp.linalg.multi_dot(list(vs)), tensors,
+                    name="multi_dot")
+
+
+def matrix_exp(x, name=None):
+    return dispatch(jax.scipy.linalg.expm, (x,), name="matrix_exp")
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        Q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() \
+            if a.ndim > 2 else eye
+        def apply(i, Q):
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          jnp.where(jnp.arange(m) == i, 1.0, a[..., :, i]))
+            H = jnp.eye(m, dtype=a.dtype) - t[..., i] * jnp.outer(v, v)
+            return Q @ H
+        for i in range(n):
+            Q = apply(i, Q)
+        return Q[..., :, :n]
+    return dispatch(f, (x, _ensure(tau)), name="householder_product")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def f(v):
+        vv = v - jnp.mean(v, axis=-2, keepdims=True) if center else v
+        qq = q or min(6, *vv.shape[-2:])
+        U, S, Vh = jnp.linalg.svd(vv, full_matrices=False)
+        return U[..., :qq], S[..., :qq], jnp.swapaxes(Vh, -1, -2)[..., :qq]
+    return dispatch(f, (x,), name="pca_lowrank", multi_output=True)

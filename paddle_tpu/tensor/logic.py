@@ -1,0 +1,51 @@
+"""Comparison / logical / bitwise ops
+(reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return dispatch(fn, (_ensure(x), _ensure(y)), name=op.__name__)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, name=None):
+    return dispatch(jnp.logical_not, (_ensure(x),), name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return dispatch(jnp.invert, (_ensure(x),), name="bitwise_not")
+
+
+def is_empty(x, name=None):
+    return Tensor(_ensure(x).size == 0)
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

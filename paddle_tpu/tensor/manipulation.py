@@ -1,0 +1,538 @@
+"""Shape/layout manipulation ops
+(reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import builtins
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+from ..core.dtypes import convert_dtype
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _static_ints(seq):
+    out = []
+    for s in seq:
+        out.append(int(to_value(s)) if isinstance(s, Tensor) else int(s))
+    return out
+
+
+def cast(x, dtype):
+    d = convert_dtype(dtype)
+    return dispatch(lambda v: v.astype(d), (x,), name="cast")
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = _static_ints(shape.numpy())
+    else:
+        shape = _static_ints(shape)
+    return dispatch(lambda v: jnp.reshape(v, shape), (x,), name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value, x._grad_node, x._out_index = out._value, out._grad_node, out._out_index
+    return x
+
+
+def transpose(x, perm, name=None):
+    perm = _static_ints(perm)
+    return dispatch(lambda v: jnp.transpose(v, perm), (x,), name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return dispatch(lambda v: jnp.moveaxis(v, source, destination), (x,),
+                    name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return dispatch(lambda v: jnp.swapaxes(v, axis0, axis1), (x,),
+                    name="swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    axis = int(to_value(axis)) if isinstance(axis, Tensor) else int(axis)
+    tensors = tuple(_ensure(t) for t in x)
+    return dispatch(lambda *vs: jnp.concatenate(vs, axis=axis), tensors,
+                    name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = tuple(_ensure(t) for t in x)
+    return dispatch(lambda *vs: jnp.stack(vs, axis=axis), tensors,
+                    name="stack")
+
+
+def hstack(x, name=None):
+    return dispatch(lambda *vs: jnp.hstack(vs), tuple(_ensure(t) for t in x),
+                    name="hstack")
+
+
+def vstack(x, name=None):
+    return dispatch(lambda *vs: jnp.vstack(vs), tuple(_ensure(t) for t in x),
+                    name="vstack")
+
+
+def dstack(x, name=None):
+    return dispatch(lambda *vs: jnp.dstack(vs), tuple(_ensure(t) for t in x),
+                    name="dstack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(to_value(axis)) if isinstance(axis, Tensor) else int(axis)
+
+    def f(v):
+        dim = v.shape[axis]
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=axis))
+        secs = _static_ints(num_or_sections)
+        # paddle allows one -1 section
+        if -1 in secs:
+            known = sum(s for s in secs if s != -1)
+            secs = [dim - known if s == -1 else s for s in secs]
+        idx = np.cumsum(secs)[:-1]
+        return tuple(jnp.split(v, idx, axis=axis))
+    outs = dispatch(f, (x,), name="split", multi_output=True)
+    return list(outs)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def f(v):
+        return tuple(jnp.array_split(v, num_or_indices, axis=axis)
+                     if isinstance(num_or_indices, int)
+                     else jnp.split(v, _static_ints(num_or_indices), axis=axis))
+    return list(dispatch(f, (x,), name="tensor_split", multi_output=True))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unbind(input, axis=0, name=None):
+    def f(v):
+        return tuple(jnp.moveaxis(v, axis, 0))
+    return list(dispatch(f, (input,), name="unbind", multi_output=True))
+
+
+def squeeze(x, axis=None, name=None):
+    def f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = _static_ints(axis if isinstance(axis, (list, tuple)) else [axis])
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return dispatch(f, (x,), name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _static_ints(axis if isinstance(axis, (list, tuple)) else [axis])
+    def f(v):
+        out = v
+        for a in sorted([a if a >= 0 else a + out.ndim + 1 for a in axes]):
+            out = jnp.expand_dims(out, a)
+        return out
+    return dispatch(f, (x,), name="unsqueeze")
+
+
+unsqueeze_ = unsqueeze
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(v):
+        nd = v.ndim
+        if nd == 0:
+            return v.reshape(1)
+        s = start_axis % nd
+        e = stop_axis % nd
+        new_shape = (v.shape[:s] + (-1,) + v.shape[e + 1:])
+        return v.reshape(new_shape)
+    return dispatch(f, (x,), name="flatten")
+
+
+def expand(x, shape, name=None):
+    shape = _static_ints(shape.numpy() if isinstance(shape, Tensor) else shape)
+
+    def f(v):
+        tgt = list(shape)
+        # -1 means keep original dim
+        off = len(tgt) - v.ndim
+        for i, s in enumerate(tgt):
+            if s == -1:
+                tgt[i] = v.shape[i - off]
+        return jnp.broadcast_to(v, tgt)
+    return dispatch(f, (x,), name="expand")
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return dispatch(lambda v, w: jnp.broadcast_to(v, w.shape), (x, y),
+                    name="expand_as")
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = tuple(_ensure(t) for t in inputs)
+    return list(dispatch(lambda *vs: tuple(jnp.broadcast_arrays(*vs)),
+                         tensors, name="broadcast_tensors",
+                         multi_output=True))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_ints(repeat_times.numpy()
+                        if isinstance(repeat_times, Tensor) else repeat_times)
+    return dispatch(lambda v: jnp.tile(v, reps), (x,), name="tile")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return dispatch(
+            lambda v, r: jnp.repeat(v, r, axis=axis,
+                                    total_repeat_length=int(r.sum())),
+            (x, repeats), name="repeat_interleave")
+    return dispatch(lambda v: jnp.repeat(v, repeats, axis=axis), (x,),
+                    name="repeat_interleave")
+
+
+def flip(x, axis, name=None):
+    axes = _static_ints(axis if isinstance(axis, (list, tuple)) else [axis])
+    return dispatch(lambda v: jnp.flip(v, axis=axes), (x,), name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return dispatch(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), (x,),
+                    name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return dispatch(lambda v: jnp.roll(v, shifts, axis=axis), (x,),
+                    name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    axis_ = int(to_value(axis)) if isinstance(axis, Tensor) else int(axis)
+
+    def f(v, i):
+        return jnp.take(v, i.reshape(-1) if i.ndim > 1 else i, axis=axis_)
+    return dispatch(f, (x, _ensure(index)), name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(v, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return v[idx]
+    return dispatch(f, (x, _ensure(index)), name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(v, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return v.at[i].set(u)
+        # paddle semantics: zero out target rows then accumulate
+        z = v.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return dispatch(f, (x, _ensure(index), _ensure(updates)), name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._value, x._grad_node, x._out_index = out._value, out._grad_node, out._out_index
+    return x
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(v, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return v.at[idx].add(u)
+    return dispatch(f, (x, _ensure(index), _ensure(updates)),
+                    name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=_ensure(updates).dtype)
+    return scatter_nd_add(z, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return dispatch(lambda v, i: jnp.take(v, i, axis=axis),
+                    (x, _ensure(index)), name="index_select")
+
+
+def index_sample(x, index, name=None):
+    def f(v, i):
+        rows = jnp.arange(v.shape[0])[:, None]
+        return v[rows, i]
+    return dispatch(f, (x, _ensure(index)), name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(v, i, u):
+        vm = jnp.moveaxis(v, axis, 0)
+        um = jnp.moveaxis(u, axis, 0)
+        out = vm.at[i].add(um)
+        return jnp.moveaxis(out, 0, axis)
+    return dispatch(f, (x, _ensure(index), _ensure(value)), name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_tensors = tuple(_ensure(i) for i in indices)
+
+    def f(v, u, *idx):
+        if accumulate:
+            return v.at[tuple(idx)].add(u)
+        return v.at[tuple(idx)].set(u)
+    return dispatch(f, (x, _ensure(value)) + idx_tensors, name="index_put")
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(v, i):
+        vm = jnp.moveaxis(v, axis, 0)
+        out = vm.at[i].set(value)
+        return jnp.moveaxis(out, 0, axis)
+    return dispatch(f, (x, _ensure(index)), name="index_fill")
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output size — runs un-jitted (eager only), like reference's
+    # dynamic-shape ops which CINN also excludes from compilation.
+    v, m = to_value(_ensure(x)), to_value(_ensure(mask))
+    out = np.asarray(v)[np.asarray(m)]
+    res = Tensor(out)
+    return res
+
+
+def masked_fill(x, mask, value, name=None):
+    val = to_value(value) if isinstance(value, Tensor) else value
+    return dispatch(lambda v, m: jnp.where(m, jnp.asarray(val, dtype=v.dtype), v),
+                    (x, _ensure(mask)), name="masked_fill")
+
+
+def masked_scatter(x, mask, value, name=None):
+    def f(v, m, u):
+        flat_m = m.reshape(-1)
+        cnt = jnp.cumsum(flat_m) - 1
+        gathered = u.reshape(-1)[jnp.clip(cnt, 0, u.size - 1)]
+        return jnp.where(flat_m, gathered, v.reshape(-1)).reshape(v.shape)
+    return dispatch(f, (x, _ensure(mask), _ensure(value)),
+                    name="masked_scatter")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        from .search import nonzero
+        return nonzero(condition, as_tuple=True)
+    return dispatch(lambda c, a, b: jnp.where(c, a, b),
+                    (_ensure(condition), _ensure(x), _ensure(y)), name="where")
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    x._value = out._value
+    return x
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes = _static_ints(axes)
+    starts = _static_ints(starts.numpy() if isinstance(starts, Tensor) else starts)
+    ends = _static_ints(ends.numpy() if isinstance(ends, Tensor) else ends)
+
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins.slice(s, e)
+        return v[tuple(idx)]
+    return dispatch(f, (input,), name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes = _static_ints(axes)
+    starts, ends, strides = (_static_ints(starts), _static_ints(ends),
+                             _static_ints(strides))
+
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = builtins.slice(s, e, st)
+        return v[tuple(idx)]
+    return dispatch(f, (x,), name="strided_slice")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return dispatch(lambda v, i: jnp.take_along_axis(v, i, axis=axis),
+                    (arr, _ensure(indices)), name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    def f(v, i, u):
+        u = jnp.broadcast_to(u, i.shape).astype(v.dtype)
+        dims = [jnp.arange(s).reshape([-1 if k == d else 1
+                                       for k in range(i.ndim)])
+                for d, s in enumerate(i.shape)]
+        idx = tuple(i if d == axis % v.ndim else
+                    jnp.broadcast_to(dims[d], i.shape)
+                    for d in range(v.ndim))
+        if reduce == "assign":
+            return v.at[idx].set(u)
+        if reduce == "add":
+            return v.at[idx].add(u)
+        if reduce in ("mul", "multiply"):
+            return v.at[idx].multiply(u)
+        if reduce == "amax":
+            return v.at[idx].max(u)
+        if reduce == "amin":
+            return v.at[idx].min(u)
+        raise ValueError(f"unknown reduce {reduce}")
+    return dispatch(f, (arr, _ensure(indices), _ensure(values)),
+                    name="put_along_axis")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic output shape — eager/numpy path (reference marks unique as
+    # dynamic-shape too)
+    v = np.asarray(to_value(_ensure(x)))
+    res = np.unique(v, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    outs = [Tensor(res[0])]
+    for r in res[1:]:
+        outs.append(Tensor(r.astype(np.int64)))
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    v = np.asarray(to_value(_ensure(x)))
+    if axis is None:
+        v = v.reshape(-1)
+        keep = np.concatenate([[True], v[1:] != v[:-1]])
+    else:
+        sub = np.moveaxis(v, axis, 0)
+        keep = np.concatenate(
+            [[True], np.any(sub[1:] != sub[:-1],
+                            axis=tuple(range(1, sub.ndim)))])
+        out = np.moveaxis(np.moveaxis(v, axis, 0)[keep], 0, axis)
+        outs = [Tensor(out)]
+        if return_inverse:
+            outs.append(Tensor(np.cumsum(keep) - 1))
+        if return_counts:
+            idx = np.nonzero(keep)[0]
+            outs.append(Tensor(np.diff(np.append(idx, len(keep)))))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    out = v[keep]
+    outs = [Tensor(out)]
+    if return_inverse:
+        outs.append(Tensor((np.cumsum(keep) - 1).astype(np.int64)))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        outs.append(Tensor(np.diff(np.append(idx, len(keep))).astype(np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = _static_ints(pad.numpy())
+    else:
+        pad = _static_ints(pad)
+
+    def f(v):
+        nd = v.ndim
+        if len(pad) == 2 * nd:
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle NCHW convention: pad applies to last len(pad)//2 spatial
+            # dims in reverse order (like torch F.pad)
+            k = len(pad) // 2
+            widths = [(0, 0)] * (nd - k)
+            for i in range(k):
+                widths.append((pad[2 * (k - 1 - i)], pad[2 * (k - 1 - i) + 1]))
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, widths, mode=jmode, constant_values=value)
+        return jnp.pad(v, widths, mode=jmode)
+    return dispatch(f, (x,), name="pad")
+
+
+def numel(x, name=None):
+    return Tensor(np.int64(_ensure(x).size))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(v):
+        size = index_num // nshards
+        shard = v // size
+        return jnp.where(shard == shard_id, v % size, ignore_value)
+    return dispatch(f, (input,), name="shard_index")
+
+
+def as_complex(x, name=None):
+    return dispatch(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), (x,),
+                    name="as_complex")
+
+
+def as_real(x, name=None):
+    return dispatch(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+                    (x,), name="as_real")
+
+
+def tensordot(x, y, axes=2, name=None):
+    return dispatch(lambda a, b: jnp.tensordot(a, b, axes=axes), (x, _ensure(y)),
+                    name="tensordot")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shape = _static_ints(shape.numpy() if isinstance(shape, Tensor) else shape)
+    if offsets is None:
+        offsets = [0] * len(shape)
+    offsets = _static_ints(offsets.numpy()
+                           if isinstance(offsets, Tensor) else offsets)
+
+    def f(v):
+        idx = tuple(builtins.slice(o, o + (s if s != -1 else v.shape[i] - o))
+                    for i, (o, s) in enumerate(zip(offsets, shape)))
+        return v[idx]
+    return dispatch(f, (x,), name="crop")
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = convert_dtype(shape_or_dtype)
+    return dispatch(lambda v: v.view(d), (x,), name="view")
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [dispatch(jnp.atleast_1d, (_ensure(i),), name="atleast_1d")
+            for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [dispatch(jnp.atleast_2d, (_ensure(i),), name="atleast_2d")
+            for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [dispatch(jnp.atleast_3d, (_ensure(i),), name="atleast_3d")
+            for i in inputs]
+    return outs[0] if len(outs) == 1 else outs

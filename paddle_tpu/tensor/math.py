@@ -1,0 +1,439 @@
+"""Elementwise & reduction math ops (reference: python/paddle/tensor/math.py).
+
+Every op here is a thin eager wrapper over a pure jnp function routed through
+``core.tensor.dispatch`` — the dispatch plays the role of the reference's
+generated ``xxx_ad_func`` + PHI kernel selection (SURVEY §3.1); XLA fuses the
+elementwise chains that the reference implements as hand-fused CUDA kernels.
+"""
+from __future__ import annotations
+
+import builtins
+from typing import Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+from ..core.dtypes import convert_dtype, get_default_dtype
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _unary(name, fn):
+    def op(x, name=None):
+        return dispatch(fn, (x,), name=name or op.__name__)
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Elementwise ``{name}`` (reference: paddle.{name})."
+    return op
+
+
+def _binary(name, fn):
+    def op(x, y, name=None):
+        return dispatch(fn, (x, y), name=op.__name__)
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Elementwise ``{name}`` (reference: paddle.{name})."
+    return op
+
+
+# -- unary ----------------------------------------------------------------
+abs = _unary("abs", jnp.abs)
+acos = _unary("acos", jnp.arccos)
+acosh = _unary("acosh", jnp.arccosh)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+ceil = _unary("ceil", jnp.ceil)
+conj = _unary("conj", jnp.conj)
+cos = _unary("cos", jnp.cos)
+cosh = _unary("cosh", jnp.cosh)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+floor = _unary("floor", jnp.floor)
+frac = _unary("frac", lambda v: v - jnp.trunc(v))
+i0 = _unary("i0", jax.scipy.special.i0)
+i0e = _unary("i0e", jax.scipy.special.i0e)
+i1 = _unary("i1", jax.scipy.special.i1)
+i1e = _unary("i1e", jax.scipy.special.i1e)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+log = _unary("log", jnp.log)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+log2 = _unary("log2", jnp.log2)
+neg = _unary("neg", jnp.negative)
+reciprocal = _unary("reciprocal", jnp.reciprocal)
+round = _unary("round", jnp.round)
+rsqrt = _unary("rsqrt", jax.lax.rsqrt)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+sign = _unary("sign", jnp.sign)
+sgn = _unary("sgn", lambda v: jnp.where(v == 0, 0, v / jnp.abs(v))
+             if jnp.iscomplexobj(v) else jnp.sign(v))
+sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+tan = _unary("tan", jnp.tan)
+tanh = _unary("tanh", jnp.tanh)
+trunc = _unary("trunc", jnp.trunc)
+angle = _unary("angle", jnp.angle)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+exponent = _unary("exponent", lambda v: jnp.floor(jnp.log2(jnp.abs(v))))
+
+# -- binary ---------------------------------------------------------------
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.true_divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.remainder)
+remainder = mod
+floor_mod = mod
+pow = _binary("pow", jnp.power)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+heaviside = _binary("heaviside", jnp.heaviside)
+hypot = _binary("hypot", jnp.hypot)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+ldexp = _binary("ldexp", jnp.ldexp)
+gammaincc = _binary("gammaincc", jax.scipy.special.gammaincc)
+gammainc = _binary("gammainc", jax.scipy.special.gammainc)
+polygamma = _binary("polygamma", lambda n, x: jax.scipy.special.polygamma(
+    n.astype(jnp.int32), x))
+inner_mul = None
+
+
+def divide_no_nan(x, y, name=None):
+    return dispatch(lambda a, b: jnp.where(b == 0, 0.0, a / jnp.where(
+        b == 0, 1.0, b)), (x, y), name="divide_no_nan")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(v, s, b):
+        out = v * s + b if bias_after_scale else (v + b) * s
+        return out.astype(v.dtype)
+    s = to_value(scale) if isinstance(scale, Tensor) else scale
+    out = dispatch(lambda v: f(v, s, bias), (x,), name="scale")
+    if act:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = to_value(min) if isinstance(min, Tensor) else min
+    mx = to_value(max) if isinstance(max, Tensor) else max
+    return dispatch(lambda v: jnp.clip(v, mn, mx), (x,), name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return dispatch(lambda a, b, w: a + w * (b - a), (x, y, weight),
+                        name="lerp")
+    return dispatch(lambda a, b: a + weight * (b - a), (x, y), name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return dispatch(lambda v: scale_b * jnp.tanh(scale_a * v), (x,),
+                    name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *ins):
+        stacked = jnp.stack(ins, axis=0)  # [n, batch, ...]
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+    return dispatch(f, (index, *inputs), name="multiplex")
+
+
+# -- ternary / fused ------------------------------------------------------
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return dispatch(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+                    (input, x, y), name="addmm")
+
+
+def inner(x, y, name=None):
+    return dispatch(jnp.inner, (x, y), name="inner")
+
+
+def outer(x, y, name=None):
+    return dispatch(lambda a, b: jnp.outer(a, b), (x, y), name="outer")
+
+
+def kron(x, y, name=None):
+    return dispatch(jnp.kron, (x, y), name="kron")
+
+
+# -- reductions -----------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy()
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, fn, int_promote=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _axis(axis)
+        d = convert_dtype(dtype) if dtype else None
+
+        def f(v):
+            out = fn(v, axis=ax, keepdims=keepdim)
+            if d is not None:
+                out = out.astype(d)
+            elif int_promote and jnp.issubdtype(v.dtype, jnp.integer):
+                out = out.astype(jnp.int64)
+            return out
+        return dispatch(f, (x,), name=op.__name__)
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+sum = _reduce("sum", jnp.sum, int_promote=True)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod, int_promote=True)
+nansum = _reduce("nansum", jnp.nansum, int_promote=True)
+nanmean = _reduce("nanmean", jnp.nanmean)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+all = _reduce("all", jnp.all)
+any = _reduce("any", jnp.any)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return dispatch(lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return dispatch(lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim),
+                    (x,), name="min")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return dispatch(lambda v: jax.scipy.special.logsumexp(
+        v, axis=_axis(axis), keepdims=keepdim), (x,), name="logsumexp")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return dispatch(lambda v: jnp.count_nonzero(
+        v, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64),
+        (x,), name="count_nonzero")
+
+
+# -- scans ----------------------------------------------------------------
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = convert_dtype(dtype) if dtype else None
+
+    def f(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v, dtype=d)
+        return jnp.cumsum(v, axis=int(axis), dtype=d)
+    return dispatch(f, (x,), name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = convert_dtype(dtype) if dtype else None
+
+    def f(v):
+        if dim is None:
+            return jnp.cumprod(v.reshape(-1), dtype=d)
+        return jnp.cumprod(v, axis=int(dim), dtype=d)
+    return dispatch(f, (x,), name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        vals = jax.lax.associative_scan(jnp.maximum, vv, axis=ax)
+        n = vv.shape[ax]
+        idx = jnp.arange(n).reshape([-1 if i == ax % vv.ndim else 1
+                                     for i in range(vv.ndim)])
+        idx = jnp.broadcast_to(idx, vv.shape)
+
+        def step(carry, cur):
+            cv, ci = carry
+            nv, ni = cur
+            take = nv > cv
+            return (jnp.where(take, nv, cv), jnp.where(take, ni, ci))
+        vv_m = jnp.moveaxis(vv, ax, 0)
+        idx_m = jnp.moveaxis(idx, ax, 0)
+        (fv, fi) = jax.lax.scan(
+            lambda c, cur: (step(c, cur), step(c, cur)),
+            (vv_m[0], idx_m[0]), (vv_m[1:], idx_m[1:]))[1]
+        out_v = jnp.concatenate([vv_m[:1], fv], axis=0)
+        out_i = jnp.concatenate([idx_m[:1], fi], axis=0)
+        return (jnp.moveaxis(out_v, 0, ax),
+                jnp.moveaxis(out_i, 0, ax).astype(convert_dtype(dtype)))
+    return dispatch(f, (x,), name="cummax", multi_output=True)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    vals, idx = cummax(dispatch(jnp.negative, (x,), name="neg"),
+                       axis=axis, dtype=dtype)
+    return dispatch(jnp.negative, (vals,), name="neg"), idx
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(v):
+        ax = 0 if axis is None else int(axis)
+        vv = v.reshape(-1) if axis is None else v
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=ax)
+    return dispatch(f, (x,), name="logcumsumexp")
+
+
+# -- checks ---------------------------------------------------------------
+isnan = _unary("isnan", jnp.isnan)
+isinf = _unary("isinf", jnp.isinf)
+isfinite = _unary("isfinite", jnp.isfinite)
+isneginf = _unary("isneginf", jnp.isneginf)
+isposinf = _unary("isposinf", jnp.isposinf)
+isreal = _unary("isreal", jnp.isreal)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return dispatch(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan),
+                    (x, y), name="isclose")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return dispatch(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                              equal_nan=equal_nan),
+                    (x, y), name="allclose")
+
+
+def equal_all(x, y, name=None):
+    return dispatch(lambda a, b: jnp.array_equal(a, b), (x, y),
+                    name="equal_all")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return dispatch(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                             neginf=neginf),
+                    (x,), name="nan_to_num")
+
+
+# -- misc -----------------------------------------------------------------
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch(lambda v: jnp.trace(v, offset=offset, axis1=axis1,
+                                        axis2=axis2), (x,), name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                           axis2=axis2), (x,),
+                    name="diagonal")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    tensors = [x]
+    has_pre = isinstance(prepend, Tensor) or prepend is not None
+    if prepend is not None:
+        tensors.append(_ensure(prepend))
+    if append is not None:
+        tensors.append(_ensure(append))
+
+    def f(v, *rest):
+        i = 0
+        pre = app = None
+        if prepend is not None:
+            pre = rest[i]; i += 1
+        if append is not None:
+            app = rest[i]
+        return jnp.diff(v, n=n, axis=axis, prepend=pre, append=app)
+    return dispatch(f, tuple(tensors), name="diff")
+
+
+def rad2deg(x, name=None):
+    return dispatch(jnp.rad2deg, (x,), name="rad2deg")
+
+
+def deg2rad(x, name=None):
+    return dispatch(jnp.deg2rad, (x,), name="deg2rad")
+
+
+def gcd(x, y, name=None):
+    return dispatch(jnp.gcd, (x, y), name="gcd")
+
+
+def lcm(x, y, name=None):
+    return dispatch(jnp.lcm, (x, y), name="lcm")
+
+
+def take(x, index, mode="raise", name=None):
+    def f(v, i):
+        flat = v.reshape(-1)
+        if mode == "wrap":
+            i = jnp.mod(i, flat.shape[0])
+        elif mode == "clip":
+            i = jnp.clip(i, 0, flat.shape[0] - 1)
+        else:
+            i = jnp.where(i < 0, i + flat.shape[0], i)
+        return flat[i]
+    return dispatch(f, (x, _ensure(index)), name="take")
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def increment(x, value=1.0, name=None):
+    x._replace_value(x._value + value)
+    return x
+
+
+def frexp(x, name=None):
+    return dispatch(lambda v: jnp.frexp(v), (x,), name="frexp",
+                    multi_output=True)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return dispatch(lambda v: jnp.vander(v, N=n, increasing=increasing),
+                    (x,), name="vander")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def f(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (
+            jnp.min(v), jnp.max(v))
+        h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64)
+    return dispatch(f, (input,), name="histogram")
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is not None:
+        return dispatch(lambda v, w: jnp.bincount(v, w, minlength=minlength),
+                        (x, _ensure(weights)), name="bincount")
+    return dispatch(lambda v: jnp.bincount(v, minlength=minlength), (x,),
+                    name="bincount")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(v):
+        dims = tuple(i for i in builtins.range(v.ndim) if i != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=dims, keepdims=True) ** (1. / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+    return dispatch(f, (x,), name="renorm")

@@ -1,0 +1,174 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py).
+
+Stateful Paddle-style API over JAX functional PRNG: every call splits the
+global key managed by ``core.random`` (reference per-device Philox generator,
+paddle/phi/core/generator.h).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+from ..core.dtypes import convert_dtype, get_default_dtype
+from ..core.random import next_key
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(to_value(s)) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def rand(shape, dtype=None, name=None) -> Tensor:
+    d = convert_dtype(dtype) if dtype else get_default_dtype()
+    return Tensor(jax.random.uniform(next_key(), _shape(shape), dtype=d))
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    d = convert_dtype(dtype) if dtype else get_default_dtype()
+    return Tensor(jax.random.normal(next_key(), _shape(shape), dtype=d))
+
+
+def standard_normal(shape, dtype=None, name=None) -> Tensor:
+    return randn(shape, dtype=dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = to_value(mean) if isinstance(mean, Tensor) else mean
+        s = to_value(std) if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(
+            np.shape(m), np.shape(s)) if shape is None else _shape(shape)
+        d = m.dtype if hasattr(m, "dtype") else get_default_dtype()
+        return Tensor(jax.random.normal(next_key(), out_shape,
+                                        dtype=d) * s + m)
+    d = get_default_dtype()
+    return Tensor(jax.random.normal(next_key(), _shape(shape or [1]),
+                                    dtype=d) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    d = convert_dtype(dtype) if dtype else get_default_dtype()
+    key = jax.random.key(seed) if seed else next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=d,
+                                     minval=min, maxval=max))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = jax.random.key(seed) if seed else next_key()
+    x._replace_value(jax.random.uniform(
+        key, tuple(x.shape), dtype=x._value.dtype, minval=min, maxval=max))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None) -> Tensor:
+    x._replace_value(jax.random.normal(
+        next_key(), tuple(x.shape), dtype=x._value.dtype) * std + mean)
+    return x
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    d = convert_dtype(dtype)
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high
+                                     ).astype(d))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
+    d = convert_dtype(dtype) if dtype else _ensure_dtype(x)
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), tuple(np.shape(to_value(x))),
+                                     low, high).astype(d))
+
+
+def _ensure_dtype(x):
+    return np.dtype(to_value(x).dtype)
+
+
+def randperm(n, dtype="int64", name=None) -> Tensor:
+    return Tensor(jax.random.permutation(next_key(), n).astype(
+        convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    v = to_value(x if isinstance(x, Tensor) else Tensor(x))
+    logits = jnp.log(jnp.maximum(v, 1e-30))
+    if replacement:
+        out = jax.random.categorical(next_key(), logits,
+                                     shape=(v.shape[:-1] + (num_samples,))
+                                     if v.ndim > 1 else (num_samples,),
+                                     axis=-1)
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(next_key(), v.shape, dtype=jnp.float32)
+        scores = jnp.where(v > 0, logits + g, -jnp.inf)
+        out = jax.lax.top_k(scores, num_samples)[1]
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    v = to_value(x if isinstance(x, Tensor) else Tensor(x))
+    return Tensor(jax.random.bernoulli(next_key(), v).astype(v.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None) -> Tensor:
+    x._replace_value(jax.random.bernoulli(
+        next_key(), p, tuple(x.shape)).astype(x._value.dtype))
+    return x
+
+
+def poisson(x, name=None) -> Tensor:
+    v = to_value(x if isinstance(x, Tensor) else Tensor(x))
+    return Tensor(jax.random.poisson(next_key(), v).astype(v.dtype))
+
+
+def binomial(count, prob, name=None) -> Tensor:
+    c = to_value(count if isinstance(count, Tensor) else Tensor(count))
+    p = to_value(prob if isinstance(prob, Tensor) else Tensor(prob))
+    return Tensor(jax.random.binomial(next_key(), c.astype(jnp.float32),
+                                      p).astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None) -> Tensor:
+    u = jax.random.uniform(next_key(), tuple(x.shape),
+                           dtype=x._value.dtype)
+    x._replace_value(-jnp.log1p(-u) / lam)
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None) -> Tensor:
+    x._replace_value(loc + scale * jax.random.cauchy(
+        next_key(), tuple(x.shape), dtype=x._value.dtype))
+    return x
+
+
+def geometric_(x, probs, name=None) -> Tensor:
+    u = jax.random.uniform(next_key(), tuple(x.shape), dtype=jnp.float32)
+    x._replace_value((jnp.ceil(jnp.log1p(-u) / jnp.log1p(-probs))).astype(
+        x._value.dtype))
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None) -> Tensor:
+    x._replace_value(jnp.exp(jax.random.normal(
+        next_key(), tuple(x.shape), dtype=x._value.dtype) * std + mean))
+    return x
+
+
+def rand_like(x, dtype=None, name=None) -> Tensor:
+    v = to_value(x)
+    d = convert_dtype(dtype) if dtype else v.dtype
+    return Tensor(jax.random.uniform(next_key(), v.shape, dtype=d))
+
+
+def randn_like(x, dtype=None, name=None) -> Tensor:
+    v = to_value(x)
+    d = convert_dtype(dtype) if dtype else v.dtype
+    return Tensor(jax.random.normal(next_key(), v.shape, dtype=d))

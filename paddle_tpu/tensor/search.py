@@ -1,0 +1,149 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtypes import convert_dtype
+    d = convert_dtype(dtype)
+
+    def f(v):
+        out = jnp.argmax(v if axis is not None else v.reshape(-1),
+                         axis=axis, keepdims=keepdim and axis is not None)
+        return out.astype(d)
+    return dispatch(f, (x,), name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtypes import convert_dtype
+    d = convert_dtype(dtype)
+
+    def f(v):
+        out = jnp.argmin(v if axis is not None else v.reshape(-1),
+                         axis=axis, keepdims=keepdim and axis is not None)
+        return out.astype(d)
+    return dispatch(f, (x,), name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        idx = jnp.argsort(v, axis=axis, stable=stable or descending,
+                          descending=descending)
+        return idx.astype(jnp.int64)
+    return dispatch(f, (x,), name="argsort")
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(v):
+        out = jnp.sort(v, axis=axis, stable=stable, descending=descending)
+        return out
+    return dispatch(f, (x,), name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def f(v):
+        ax = -1 if axis is None else axis
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            nvals, idx = jax.lax.top_k(-vm, k)
+            vals = -nvals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx, -1, ax).astype(jnp.int64))
+    return dispatch(f, (x,), name="topk", multi_output=True)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    def f(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side)
+                           )(s.reshape(-1, s.shape[-1]),
+                             v.reshape(-1, v.shape[-1]))
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return dispatch(f, (_ensure(sorted_sequence), _ensure(values)),
+                    name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic output shape — eager numpy path
+    v = np.asarray(to_value(_ensure(x)))
+    idx = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int64)) for i in idx)
+    return Tensor(np.stack(idx, axis=-1).astype(np.int64))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(v):
+        vals = jnp.sort(v, axis=axis)
+        idxs = jnp.argsort(v, axis=axis, stable=True)
+        val = jnp.take(vals, k - 1, axis=axis)
+        idx = jnp.take(idxs, k - 1, axis=axis)
+        if keepdim:
+            val = jnp.expand_dims(val, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return val, idx.astype(jnp.int64)
+    return dispatch(f, (x,), name="kthvalue", multi_output=True)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(v):
+        vm = jnp.moveaxis(v, axis, -1)
+        n = vm.shape[-1]
+        s = jnp.sort(vm, axis=-1)
+        si = jnp.argsort(vm, axis=-1, stable=True)
+        # count run lengths in sorted order
+        eq = (s[..., 1:] == s[..., :-1])
+        # run id per element
+        run_id = jnp.concatenate(
+            [jnp.zeros(vm.shape[:-1] + (1,), jnp.int32),
+             jnp.cumsum(~eq, axis=-1, dtype=jnp.int32)], axis=-1)
+        counts = jax.nn.one_hot(run_id, n, dtype=jnp.int32).sum(-2)
+        cnt_per_elem = jnp.take_along_axis(counts, run_id, axis=-1)
+        best = jnp.argmax(cnt_per_elem, axis=-1)  # first max = smallest value
+        # paddle returns the LAST occurrence index of the mode value
+        mode_val = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
+        is_mode = vm == mode_val[..., None]
+        last_idx = jnp.max(jnp.where(is_mode, jnp.arange(n), -1), axis=-1)
+        if keepdim:
+            return (jnp.expand_dims(mode_val, axis),
+                    jnp.expand_dims(last_idx, axis).astype(jnp.int64))
+        return mode_val, last_idx.astype(jnp.int64)
+    return dispatch(f, (x,), name="mode", multi_output=True)
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+    return _is(x, index)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def where(condition, x=None, y=None, name=None):
+    from .manipulation import where as _w
+    return _w(condition, x, y)

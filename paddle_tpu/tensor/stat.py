@@ -1,0 +1,66 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return dispatch(lambda v: jnp.std(v, axis=_ax(axis),
+                                      ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), (x,), name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return dispatch(lambda v: jnp.var(v, axis=_ax(axis),
+                                      ddof=1 if unbiased else 0,
+                                      keepdims=keepdim), (x,), name="var")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    def f(v):
+        if mode == "avg":
+            return jnp.median(v, axis=_ax(axis), keepdims=keepdim)
+        # mode == 'min': lower of the two middle values, like paddle
+        ax = _ax(axis)
+        if ax is None:
+            s = jnp.sort(v.reshape(-1))
+            out = s[(s.shape[0] - 1) // 2]
+            return out.reshape((1,) * v.ndim) if keepdim else out
+        s = jnp.sort(v, axis=ax)
+        idx = (v.shape[ax] - 1) // 2
+        out = jnp.take(s, idx, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+    return dispatch(f, (x,), name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return dispatch(lambda v: jnp.nanmedian(v, axis=_ax(axis),
+                                            keepdims=keepdim), (x,),
+                    name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    def f(v):
+        return jnp.quantile(v, jnp.asarray(q), axis=_ax(axis),
+                            keepdims=keepdim, method=interpolation)
+    return dispatch(f, (x,), name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    def f(v):
+        return jnp.nanquantile(v, jnp.asarray(q), axis=_ax(axis),
+                               keepdims=keepdim, method=interpolation)
+    return dispatch(f, (x,), name="nanquantile")

@@ -1,0 +1,143 @@
+"""Synthetic + file-backed datasets (reference: python/paddle/vision/datasets/
+— MNIST/Cifar/ImageFolder download from servers; here: zero-egress synthetic
+fixtures with the same interfaces, plus ImageFolder over local files)."""
+from __future__ import annotations
+
+import os
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["FakeData", "MNIST", "Cifar10", "ImageFolder", "DatasetFolder"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic images for benchmarks/tests."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, dtype=np.float32, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.standard_normal(self.image_shape).astype(self.dtype)
+        label = np.int64(rng.randint(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    """Local-file MNIST (idx format) or synthetic fallback when files are
+    absent (zero-egress environment)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        self._synthetic = image_path is None or not os.path.exists(
+            str(image_path))
+        if self._synthetic:
+            self._fake = FakeData(60000 if mode == "train" else 10000,
+                                  (1, 28, 28), 10)
+        else:
+            self.images = _read_idx(image_path)
+            self.labels = _read_idx(label_path)
+
+    def __getitem__(self, idx):
+        if self._synthetic:
+            img, label = self._fake[idx]
+        else:
+            img, label = self.images[idx][None], np.int64(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        if self._synthetic:
+            return len(self._fake)
+        return len(self.images)
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        self._fake = FakeData(50000 if mode == "train" else 10000,
+                              (3, 32, 32), 10)
+
+    def __getitem__(self, idx):
+        img, label = self._fake[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self._fake)
+
+
+def _read_idx(path):
+    with open(path, "rb") as f:
+        data = f.read()
+    magic = int.from_bytes(data[2:3], "big")
+    ndim = data[3]
+    dims = [int.from_bytes(data[4 + 4 * i: 8 + 4 * i], "big")
+            for i in range(ndim)]
+    arr = np.frombuffer(data, dtype=np.uint8, offset=4 + 4 * ndim)
+    return arr.reshape(dims)
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or _default_loader
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            d = os.path.join(root, c)
+            for fname in sorted(os.listdir(d)):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(d, fname),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    pass
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError as e:
+        raise RuntimeError(
+            "PIL unavailable; use .npy images or pass a custom loader") from e

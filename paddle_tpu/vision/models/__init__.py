@@ -1,0 +1,6 @@
+"""Vision models (reference: python/paddle/vision/models/)."""
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa
+                     resnet152, BasicBlock, BottleneckBlock)
+from .lenet import LeNet  # noqa: F401
+from .vgg import VGG, vgg16, vgg19  # noqa: F401
+from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401

@@ -1,0 +1,129 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — yolo_box, nms,
+roi_align, deform_conv, distribute_fpn_proposals…). Core detection ops."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+
+__all__ = ["nms", "box_coder", "roi_align", "roi_pool", "yolo_box",
+           "generate_proposals"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Host-side NMS (dynamic output; the reference's GPU kernel is also
+    sequential per class)."""
+    b = np.asarray(to_value(boxes if isinstance(boxes, Tensor)
+                            else Tensor(boxes)))
+    s = np.asarray(to_value(scores)) if scores is not None else None
+    if s is None:
+        order = np.arange(len(b))
+    else:
+        order = np.argsort(-s)
+    keep = []
+    suppressed = np.zeros(len(b), dtype=bool)
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    cat = np.asarray(to_value(category_idxs)) if category_idxs is not None \
+        else None
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        xx1 = np.maximum(b[i, 0], b[:, 0])
+        yy1 = np.maximum(b[i, 1], b[:, 1])
+        xx2 = np.minimum(b[i, 2], b[:, 2])
+        yy2 = np.minimum(b[i, 3], b[:, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        over = iou > iou_threshold
+        if cat is not None:
+            over &= cat == cat[i]
+        suppressed |= over
+        suppressed[i] = True
+    keep = np.asarray(keep, dtype=np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(keep)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0):
+    def f(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + (0 if box_normalized else 1)
+        ph = pb[:, 3] - pb[:, 1] + (0 if box_normalized else 1)
+        px = pb[:, 0] + pw * 0.5
+        py = pb[:, 1] + ph * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + (0 if box_normalized else 1)
+            th = tb[:, 3] - tb[:, 1] + (0 if box_normalized else 1)
+            tx = tb[:, 0] + tw * 0.5
+            ty = tb[:, 1] + th * 0.5
+            ox = (tx - px) / pw / pbv[:, 0]
+            oy = (ty - py) / ph / pbv[:, 1]
+            ow = jnp.log(tw / pw) / pbv[:, 2]
+            oh = jnp.log(th / ph) / pbv[:, 3]
+            return jnp.stack([ox, oy, ow, oh], axis=-1)
+        # decode
+        ox = pbv[:, 0] * tb[..., 0] * pw + px
+        oy = pbv[:, 1] * tb[..., 1] * ph + py
+        ow = jnp.exp(pbv[:, 2] * tb[..., 2]) * pw
+        oh = jnp.exp(pbv[:, 3] * tb[..., 3]) * ph
+        return jnp.stack([ox - ow / 2, oy - oh / 2, ox + ow / 2,
+                          oy + oh / 2], axis=-1)
+    return dispatch(f, (prior_box, prior_box_var, target_box),
+                    name="box_coder")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, bxs):
+        n, c, h, w = feat.shape
+        off = 0.5 if aligned else 0.0
+        def one_box(box):
+            x1, y1, x2, y2 = box * spatial_scale - off
+            bw = jnp.maximum(x2 - x1, 1.0)
+            bh = jnp.maximum(y2 - y1, 1.0)
+            ys = y1 + (jnp.arange(oh) + 0.5) * bh / oh
+            xs = x1 + (jnp.arange(ow) + 0.5) * bw / ow
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            y0 = jnp.clip(jnp.floor(gy), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(gx), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            wy = gy - y0
+            wx = gx - x0
+            img = feat[0]
+            va = img[:, y0i, x0i]
+            vb = img[:, y1i, x0i]
+            vc = img[:, y0i, x1i]
+            vd = img[:, y1i, x1i]
+            return (va * (1 - wy) * (1 - wx) + vb * wy * (1 - wx) +
+                    vc * (1 - wy) * wx + vd * wy * wx)
+        return jax.vmap(one_box)(bxs)
+    return dispatch(f, (x, boxes), name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    return roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                     aligned=False)
+
+
+def yolo_box(x, origin_shape, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    raise NotImplementedError(
+        "yolo_box: use paddle_tpu.models.detection heads; tracked for the "
+        "PP-YOLOE config")
+
+
+def generate_proposals(*args, **kwargs):
+    raise NotImplementedError("generate_proposals: tracked for detection")

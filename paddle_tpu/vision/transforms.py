@@ -1,0 +1,228 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/).
+Numpy-based, HWC uint8/float inputs like the reference's cv2 backend."""
+from __future__ import annotations
+
+import numbers
+import random as pyrandom
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
+           "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
+           "Transpose", "RandomResizedCrop", "Pad", "to_tensor", "normalize",
+           "resize", "hflip", "vflip", "center_crop", "crop"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+def to_tensor(img, data_format="CHW"):
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+def _interp_resize(arr, h, w):
+    # bilinear via jax.image on host numpy (no cv2/PIL dependency)
+    import jax
+    out = jax.image.resize(np.asarray(arr, np.float32),
+                           (h, w) + arr.shape[2:], method="bilinear")
+    return np.asarray(out)
+
+
+def resize(img, size, interpolation="bilinear"):
+    arr = np.asarray(img)
+    if isinstance(size, int):
+        h, w = arr.shape[:2]
+        if h < w:
+            nh, nw = size, int(size * w / h)
+        else:
+            nh, nw = int(size * h / w), size
+    else:
+        nh, nw = size
+    out = _interp_resize(arr, nh, nw)
+    if arr.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    return out
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = np.asarray(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    i = max((h - th) // 2, 0)
+    j = max((w - tw) // 2, 0)
+    return crop(arr, i, j, th, tw)
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else size
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            p = self.padding
+            if isinstance(p, int):
+                p = (p, p, p, p)
+            arr = np.pad(arr, ((p[1], p[3]), (p[0], p[2])) +
+                         (((0, 0),) if arr.ndim == 3 else ()))
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        if h == th and w == tw:
+            return arr
+        i = pyrandom.randint(0, h - th)
+        j = pyrandom.randint(0, w - tw)
+        return crop(arr, i, j, th, tw)
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def __call__(self, img):
+        import math
+        arr = np.asarray(img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * pyrandom.uniform(*self.scale)
+            log_ratio = (math.log(self.ratio[0]), math.log(self.ratio[1]))
+            ar = math.exp(pyrandom.uniform(*log_ratio))
+            nw = int(round(math.sqrt(target_area * ar)))
+            nh = int(round(math.sqrt(target_area / ar)))
+            if 0 < nw <= w and 0 < nh <= h:
+                i = pyrandom.randint(0, h - nh)
+                j = pyrandom.randint(0, w - nw)
+                return resize(crop(arr, i, j, nh, nw), self.size)
+        return resize(center_crop(arr, min(h, w)), self.size)
+
+
+def hflip(img):
+    return np.asarray(img)[:, ::-1]
+
+
+def vflip(img):
+    return np.asarray(img)[::-1]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return hflip(img)
+        return np.asarray(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if pyrandom.random() < self.prob:
+            return vflip(img)
+        return np.asarray(img)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        p = self.padding
+        if isinstance(p, int):
+            p = (p, p, p, p)
+        pads = ((p[1], p[3]), (p[0], p[2]))
+        if arr.ndim == 3:
+            pads = pads + ((0, 0),)
+        return np.pad(arr, pads, constant_values=self.fill)

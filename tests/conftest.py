@@ -1,0 +1,29 @@
+"""Test config: force an 8-device virtual CPU mesh (the reference tests
+multi-rank on one host the same way — SURVEY.md §4 'fake backend' pattern;
+here the CPU PjRt device stands in for TPU chips).
+
+Note: the axon sitecustomize imports jax before conftest runs, so
+JAX_PLATFORMS env is already latched — must go through jax.config.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    np.random.seed(2024)
+    yield

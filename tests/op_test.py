@@ -1,0 +1,79 @@
+"""OpTest-style numeric harness.
+
+TPU-native analog of the reference's OpTest (test/legacy_test/op_test.py:418):
+- check_output: compare an op against a NumPy reference with per-dtype
+  tolerances (op_test.py:2143 check_output semantics);
+- check_grad: finite-difference vs analytic gradients
+  (op_test.py:3075 check_grad semantics).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+# per-dtype atol/rtol (mirrors test/white_list/op_threshold_white_list.py)
+TOLERANCES = {
+    "float64": dict(atol=1e-10, rtol=1e-8),
+    "float32": dict(atol=1e-5, rtol=1e-5),
+    "bfloat16": dict(atol=1e-1, rtol=2e-2),
+    "float16": dict(atol=1e-2, rtol=1e-3),
+}
+
+
+def check_output(op_fn: Callable, np_fn: Callable, inputs: Sequence,
+                 dtype="float32", atol=None, rtol=None, **op_kwargs):
+    tol = dict(TOLERANCES.get(str(dtype), TOLERANCES["float32"]))
+    if atol is not None:
+        tol["atol"] = atol
+    if rtol is not None:
+        tol["rtol"] = rtol
+    tensors = [paddle.to_tensor(np.asarray(i)) if not isinstance(i, Tensor)
+               else i for i in inputs]
+    got = op_fn(*tensors, **op_kwargs)
+    want = np_fn(*[np.asarray(i) for i in inputs])
+    if isinstance(got, (tuple, list)):
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g.numpy(), np.float64),
+                                       np.asarray(w, np.float64), **tol)
+    else:
+        np.testing.assert_allclose(np.asarray(got.numpy(), np.float64),
+                                   np.asarray(want, np.float64), **tol)
+
+
+def check_grad(op_fn: Callable, inputs: Sequence, input_idx: int = 0,
+               eps: float = 1e-3, atol: float = 1e-2, rtol: float = 1e-2,
+               reduce_to_scalar=True, **op_kwargs):
+    """Finite-difference gradient check on float64 for stability."""
+    arrays = [np.asarray(i, dtype=np.float64) for i in inputs]
+
+    def scalar_fn(*arrs):
+        ts = [paddle.to_tensor(a) for a in arrs]
+        ts[input_idx].stop_gradient = False
+        out = op_fn(*ts, **op_kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return ts[input_idx], out.sum() if reduce_to_scalar else out
+
+    # analytic
+    t, loss = scalar_fn(*arrays)
+    loss.backward()
+    analytic = t.grad.numpy().astype(np.float64)
+
+    # numeric
+    x = arrays[input_idx]
+    numeric = np.zeros_like(x)
+    flat = x.reshape(-1)
+    num_flat = numeric.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        _, lp = scalar_fn(*arrays)
+        flat[i] = orig - eps
+        _, lm = scalar_fn(*arrays)
+        flat[i] = orig
+        num_flat[i] = (float(lp.item()) - float(lm.item())) / (2 * eps)
+    np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=rtol)

@@ -1,0 +1,149 @@
+"""Eager autograd engine tests (reference parity: paddle/fluid/eager/
+backward.cc semantics — accumulation, hooks, retain_graph, paddle.grad)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad
+
+
+class TestBackward:
+    def test_scalar_chain(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = x * x + 2 * x  # dy/dx = 2x + 2 = 8
+        y.backward()
+        assert abs(float(x.grad.item()) - 8.0) < 1e-6
+
+    def test_fan_out_accumulation(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        a = x * 3
+        b = x * 4
+        (a + b).backward()
+        assert abs(float(x.grad.item()) - 7.0) < 1e-6
+
+    def test_grad_accumulates_across_backwards(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        assert abs(float(x.grad.item()) - 5.0) < 1e-6
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x.detach() * 2
+        assert y.stop_gradient
+        z = (x * 2).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_non_scalar_needs_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y.backward(paddle.to_tensor([1.0, 1.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        assert abs(float(x.grad.item()) - 8.0) < 1e-6
+
+    def test_double_backward_raises(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_hooks(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(float(g.item()))
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).backward()
+        assert seen == [3.0]
+        assert abs(float(x.grad.item()) - 6.0) < 1e-6
+
+    def test_multi_output_partial_use(self):
+        x = paddle.to_tensor(np.random.randn(4, 6).astype(np.float32),
+                             stop_gradient=False)
+        parts = paddle.split(x, 2, axis=1)
+        parts[0].sum().backward()
+        g = x.grad.numpy()
+        assert g[:, :3].sum() == 12.0 and g[:, 3:].sum() == 0.0
+
+    def test_matmul_grad_numeric(self):
+        a = np.random.randn(3, 4)
+        b = np.random.randn(4, 2)
+        check_grad(paddle.matmul, [a, b], input_idx=0)
+        check_grad(paddle.matmul, [a, b], input_idx=1)
+
+    def test_elementwise_grads_numeric(self):
+        x = np.random.rand(3, 3) + 0.5
+        check_grad(paddle.exp, [x])
+        check_grad(paddle.log, [x])
+        check_grad(paddle.tanh, [x])
+        check_grad(lambda t: paddle.nn.functional.softmax(t, axis=-1), [x])
+
+
+class TestPaddleGrad:
+    def test_grad_api(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        assert abs(float(gx.item()) - 4.0) < 1e-6
+        assert x.grad is None  # side-effect free
+
+    def test_grad_unused_allowed(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        z = paddle.to_tensor(1.0, stop_gradient=False)
+        y = x * 2
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 2
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+class TestFunctionalAD:
+    def test_jacobian(self):
+        from paddle_tpu.autograd import jacobian
+        x = paddle.to_tensor([1.0, 2.0])
+        J = jacobian(lambda t: t * t, x)
+        np.testing.assert_allclose(np.diag(J.numpy()), [2.0, 4.0])
+
+    def test_vjp_jvp(self):
+        from paddle_tpu.autograd import vjp, jvp
+        x = paddle.to_tensor([1.0, 2.0])
+        out, g = vjp(lambda t: (t * t).sum(), x)
+        np.testing.assert_allclose(g.numpy(), [2.0, 4.0])

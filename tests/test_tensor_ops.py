@@ -1,0 +1,306 @@
+"""Op parity vs numpy (OpTest analog; reference test strategy SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_output, check_grad
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        t = paddle.to_tensor([1, 2, 3])
+        assert t.dtype == np.int64
+        t = paddle.to_tensor([1.0, 2.0])
+        assert t.dtype == np.float32
+
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        assert (paddle.full([2], 7).numpy() == 7).all()
+
+    def test_arange_linspace_eye(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(),
+                                      np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3,
+                                      dtype=np.float32))
+
+    def test_like_family(self):
+        x = paddle.randn([3, 4])
+        assert paddle.zeros_like(x).shape == [3, 4]
+        assert (paddle.full_like(x, 2.5).numpy() == 2.5).all()
+
+    def test_tril_triu_diag(self):
+        a = np.arange(16, dtype=np.float32).reshape(4, 4)
+        check_output(paddle.tril, np.tril, [a])
+        check_output(paddle.triu, np.triu, [a])
+        check_output(paddle.diag, np.diag, [np.arange(4., dtype=np.float32)])
+
+
+class TestMath:
+    @pytest.mark.parametrize("name,np_fn", [
+        ("exp", np.exp), ("log", lambda x: np.log(np.abs(x) + 1)),
+        ("sqrt", lambda x: np.sqrt(np.abs(x))), ("abs", np.abs),
+        ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh),
+        ("floor", np.floor), ("ceil", np.ceil), ("round", np.round),
+        ("sign", np.sign), ("square", np.square),
+    ])
+    def test_unary(self, name, np_fn):
+        # XLA's vectorized transcendentals differ from libm at ~1e-4 rel
+        tol = dict(atol=5e-4, rtol=5e-4)
+        x = np.random.randn(3, 4).astype(np.float32)
+        if name == "log":
+            arg = np.abs(x) + 1
+            check_output(getattr(paddle, name), np.log, [arg], **tol)
+        elif name == "sqrt":
+            check_output(getattr(paddle, name), np.sqrt, [np.abs(x)], **tol)
+        else:
+            check_output(getattr(paddle, name), np_fn, [x], **tol)
+
+    @pytest.mark.parametrize("name,np_fn", [
+        ("add", np.add), ("subtract", np.subtract),
+        ("multiply", np.multiply), ("divide", np.divide),
+        ("maximum", np.maximum), ("minimum", np.minimum),
+        ("atan2", np.arctan2),
+    ])
+    def test_binary(self, name, np_fn):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(3, 4).astype(np.float32) + 2.0
+        check_output(getattr(paddle, name), np_fn, [a, b])
+
+    def test_reductions(self):
+        x = np.random.randn(3, 4, 5).astype(np.float32)
+        check_output(paddle.sum, np.sum, [x])
+        check_output(lambda t: paddle.sum(t, axis=1),
+                     lambda a: a.sum(axis=1), [x])
+        check_output(lambda t: paddle.mean(t, axis=[0, 2], keepdim=True),
+                     lambda a: a.mean(axis=(0, 2), keepdims=True), [x])
+        check_output(paddle.max, np.max, [x])
+        check_output(lambda t: paddle.prod(t, axis=-1),
+                     lambda a: a.prod(axis=-1), [x])
+
+    def test_cumsum_cumprod(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        check_output(lambda t: paddle.cumsum(t, axis=1),
+                     lambda a: np.cumsum(a, axis=1), [x])
+        check_output(lambda t: paddle.cumprod(t, dim=0),
+                     lambda a: np.cumprod(a, axis=0), [x])
+
+    def test_clip_lerp(self):
+        x = np.random.randn(4, 4).astype(np.float32)
+        check_output(lambda t: paddle.clip(t, -0.5, 0.5),
+                     lambda a: np.clip(a, -0.5, 0.5), [x])
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as np_lse
+        x = np.random.randn(3, 5).astype(np.float32)
+        check_output(lambda t: paddle.logsumexp(t, axis=1),
+                     lambda a: np_lse(a, axis=1), [x])
+
+    def test_operators(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 4.0])
+        np.testing.assert_allclose((a + b).numpy(), [4, 6])
+        np.testing.assert_allclose((a - 1).numpy(), [0, 1])
+        np.testing.assert_allclose((2 * a).numpy(), [2, 4])
+        np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+        np.testing.assert_allclose((-a).numpy(), [-1, -2])
+        assert (a < b).numpy().all()
+
+    def test_allclose_isnan(self):
+        x = paddle.to_tensor([1.0, np.nan, np.inf])
+        np.testing.assert_array_equal(paddle.isnan(x).numpy(),
+                                      [False, True, False])
+        np.testing.assert_array_equal(paddle.isinf(x).numpy(),
+                                      [False, False, True])
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        check_output(paddle.matmul, np.matmul, [a, b])
+
+    def test_matmul_transpose(self):
+        a = np.random.randn(4, 3).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        check_output(lambda x, y: paddle.matmul(x, y, transpose_x=True),
+                     lambda x, y: x.T @ y, [a, b])
+
+    def test_batched_matmul(self):
+        a = np.random.randn(2, 3, 4).astype(np.float32)
+        b = np.random.randn(2, 4, 5).astype(np.float32)
+        check_output(paddle.bmm, np.matmul, [a, b])
+
+    def test_norm_det_inv(self):
+        a = np.random.randn(3, 3).astype(np.float32)
+        a = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+        check_output(paddle.linalg.det, np.linalg.det, [a], atol=1e-4)
+        check_output(paddle.linalg.inv, np.linalg.inv, [a], atol=1e-4)
+        check_output(lambda t: paddle.norm(t),
+                     lambda x: np.linalg.norm(x), [a], atol=1e-4)
+
+    def test_einsum(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        b = np.random.randn(4, 5).astype(np.float32)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                            paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_svd_qr(self):
+        a = np.random.randn(4, 3).astype(np.float32)
+        u, s, vh = paddle.linalg.svd(paddle.to_tensor(a))
+        rec = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(rec, a, atol=1e-4)
+        q, r = paddle.linalg.qr(paddle.to_tensor(a))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), a, atol=1e-4)
+
+    def test_solve(self):
+        a = np.random.randn(3, 3).astype(np.float32) + 3 * np.eye(
+            3, dtype=np.float32)
+        b = np.random.randn(3, 2).astype(np.float32)
+        check_output(paddle.linalg.solve, np.linalg.solve, [a, b], atol=1e-4)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        check_output(lambda t: paddle.reshape(t, [4, 6]),
+                     lambda a: a.reshape(4, 6), [x])
+        check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                     lambda a: a.transpose(2, 0, 1), [x])
+
+    def test_concat_stack_split(self):
+        a = np.random.randn(2, 3).astype(np.float32)
+        b = np.random.randn(2, 3).astype(np.float32)
+        out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_allclose(out.numpy(), np.concatenate([a, b]))
+        out = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=1)
+        np.testing.assert_allclose(out.numpy(), np.stack([a, b], axis=1))
+        parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+        assert len(parts) == 3 and parts[0].shape == [2, 1]
+        parts = paddle.split(paddle.to_tensor(a), [1, -1], axis=1)
+        assert parts[1].shape == [2, 2]
+
+    def test_squeeze_unsqueeze_flatten(self):
+        x = np.random.randn(2, 1, 3).astype(np.float32)
+        assert paddle.squeeze(paddle.to_tensor(x), axis=1).shape == [2, 3]
+        assert paddle.unsqueeze(paddle.to_tensor(x), [0]).shape == [1, 2, 1, 3]
+        assert paddle.flatten(paddle.to_tensor(x)).shape == [6]
+
+    def test_gather_scatter(self):
+        x = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.array([0, 2])
+        out = paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx))
+        np.testing.assert_allclose(out.numpy(), x[idx])
+        upd = np.ones((2, 3), np.float32)
+        out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        want = x.copy()
+        want[idx] = 1.0
+        np.testing.assert_allclose(out.numpy(), want)
+
+    def test_where_masked(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        y = np.zeros((3, 4), np.float32)
+        cond = x > 0
+        out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x),
+                           paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), np.where(cond, x, y))
+        ms = paddle.masked_select(paddle.to_tensor(x),
+                                  paddle.to_tensor(cond))
+        np.testing.assert_allclose(ms.numpy(), x[cond])
+
+    def test_indexing(self):
+        x = paddle.to_tensor(np.arange(24, dtype=np.float32
+                                       ).reshape(4, 6))
+        np.testing.assert_allclose(x[1].numpy(), np.arange(6, 12))
+        np.testing.assert_allclose(x[:, 2].numpy(), [2, 8, 14, 20])
+        np.testing.assert_allclose(x[1:3, ::2].shape, [2, 3])
+        x[0] = 0.0
+        assert x.numpy()[0].sum() == 0
+
+    def test_pad_tile_flip(self):
+        x = np.random.randn(2, 3).astype(np.float32)
+        out = paddle.tile(paddle.to_tensor(x), [2, 1])
+        np.testing.assert_allclose(out.numpy(), np.tile(x, (2, 1)))
+        out = paddle.flip(paddle.to_tensor(x), [0])
+        np.testing.assert_allclose(out.numpy(), x[::-1])
+
+    def test_unique(self):
+        x = np.array([3, 1, 2, 1, 3])
+        out = paddle.unique(paddle.to_tensor(x))
+        np.testing.assert_array_equal(out.numpy(), [1, 2, 3])
+
+
+class TestSearch:
+    def test_argmax_argsort(self):
+        x = np.random.randn(3, 5).astype(np.float32)
+        check_output(lambda t: paddle.argmax(t, axis=1),
+                     lambda a: np.argmax(a, axis=1), [x])
+        check_output(lambda t: paddle.argsort(t, axis=1),
+                     lambda a: np.argsort(a, axis=1, kind="stable"), [x])
+        check_output(lambda t: paddle.sort(t, axis=1),
+                     lambda a: np.sort(a, axis=1), [x])
+
+    def test_topk(self):
+        x = np.random.randn(4, 10).astype(np.float32)
+        vals, idx = paddle.topk(paddle.to_tensor(x), k=3, axis=1)
+        want = np.sort(x, axis=1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), want, rtol=1e-6)
+
+    def test_nonzero_searchsorted(self):
+        x = np.array([0.0, 1.5, 0.0, 2.0], np.float32)
+        idx = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_array_equal(idx.numpy().ravel(), [1, 3])
+        s = np.array([1.0, 3.0, 5.0], np.float32)
+        v = np.array([2.0, 4.0], np.float32)
+        out = paddle.searchsorted(paddle.to_tensor(s), paddle.to_tensor(v))
+        np.testing.assert_array_equal(out.numpy(), [1, 2])
+
+
+class TestStat:
+    def test_std_var_median(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        check_output(lambda t: paddle.std(t, axis=1),
+                     lambda a: a.std(axis=1, ddof=1), [x])
+        check_output(lambda t: paddle.var(t, axis=0, unbiased=False),
+                     lambda a: a.var(axis=0), [x])
+        check_output(paddle.median, np.median, [x])
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([3, 4])
+        paddle.seed(7)
+        b = paddle.randn([3, 4])
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        assert paddle.rand([2, 2]).shape == [2, 2]
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(10))
+
+    def test_bernoulli_multinomial(self):
+        probs = paddle.full([1000], 0.3)
+        draws = paddle.bernoulli(probs)
+        assert 0.2 < float(draws.numpy().mean()) < 0.4
+        m = paddle.multinomial(paddle.to_tensor([0.1, 0.0, 0.9]), 50,
+                               replacement=True)
+        vals = set(m.numpy().tolist())
+        assert 1 not in vals
+
+
+class TestDtype:
+    def test_cast(self):
+        x = paddle.to_tensor([1.7, 2.3])
+        assert x.astype("int32").dtype == np.int32
+        assert x.astype(paddle.bfloat16).dtype == paddle.bfloat16
+
+    def test_promotion(self):
+        a = paddle.to_tensor([1, 2])  # int64
+        b = paddle.to_tensor([0.5, 0.5])
+        assert (a + b).dtype == np.float32
