@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Benchmark entry for the driver: prints ONE JSON line.
+
+Config 1 of BASELINE.md: ResNet-50 ImageNet-shape training throughput on one
+chip (imgs/sec/chip), bf16 autocast, whole-step compiled. vs_baseline compares
+against the public A100 MLPerf-class number (~2500 imgs/s/chip fp16) since the
+reference publishes no in-tree numbers (BASELINE.md).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def bench_resnet50(steps=20, batch=128):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    paddle.seed(0)
+    net = resnet50(num_classes=1000)
+    net.train()
+    opt = paddle.optimizer.Momentum(0.1, parameters=net.parameters())
+    compiled = paddle.jit.to_static(net)
+
+    x = paddle.to_tensor(np.random.randn(batch, 3, 224, 224)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.randint(0, 1000, batch))
+
+    def step():
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            loss = F.cross_entropy(compiled(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    # warmup (compile)
+    loss = step()
+    jax.block_until_ready(loss._value)
+    loss = step()
+    jax.block_until_ready(loss._value)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step()
+    jax.block_until_ready(loss._value)
+    dt = time.perf_counter() - t0
+    imgs_per_sec = steps * batch / dt
+    return imgs_per_sec, float(np.asarray(loss._value, np.float32))
+
+
+def main():
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    try:
+        ips, loss = bench_resnet50(steps=steps, batch=batch)
+        baseline_a100 = 2500.0  # public fp16 A100 ResNet-50 train imgs/s
+        print(json.dumps({
+            "metric": "resnet50_train_imgs_per_sec_per_chip",
+            "value": round(ips, 2),
+            "unit": "imgs/sec/chip",
+            "vs_baseline": round(ips / baseline_a100, 4),
+        }))
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({
+            "metric": "resnet50_train_imgs_per_sec_per_chip",
+            "value": 0.0, "unit": "imgs/sec/chip", "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:400],
+        }))
+        sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -98,7 +98,9 @@ def run_backward(tensors: List[Tensor],
             continue  # node not on the path from the loss
         # vjp closures need a full cotangent pytree; fill absent slots with 0.
         cots = _fill_zeros(node, cots)
-        arg = tuple(cots) if node.n_outputs > 1 else cots[0]
+        arg = tuple(cots) if (node.n_outputs > 1 or
+                              getattr(node, "tuple_output", False)) \
+            else cots[0]
         in_grads = node.vjp_fn(arg)
         for t, g in zip(node.inputs, in_grads):
             if t is None or t.stop_gradient:
@@ -130,17 +132,10 @@ def _used_vjp(*_):
 
 
 def _fill_zeros(node: GradNode, cots):
-    # We don't have shapes of never-touched outputs except via the vjp's
-    # expected structure; nodes are created per-dispatch so this occurs only
-    # for multi-output ops where some outputs are unused. Shapes live on the
-    # Tensors we returned, but those may be gone — so stash nothing and rely
-    # on symbolic zeros via jnp: the cheapest safe fill is zeros_like of the
-    # known cotangents' dtype with the saved shape. GradNode keeps no shapes,
-    # so instead require at least one cotangent and fill with scalar 0 arrays
-    # broadcast by vjp. In practice jax.vjp accepts exact-shaped zeros only,
-    # so we record shapes lazily at dispatch time via n_outputs==1 fast path.
-    if node.n_outputs == 1:
-        return cots
+    """Fill unused-output slots with zeros and cast cotangents to each
+    output's recorded dtype (an AMP boundary can hand a float32 cotangent to
+    a bf16-output node — the reference handles this inside its generated
+    GradNodes the same way)."""
     shapes = getattr(node, "_out_shapes", None)
     out = []
     for i, c in enumerate(cots):
@@ -151,6 +146,10 @@ def _fill_zeros(node: GradNode, cots):
                     "recorded shape for zero-fill")
             out.append(jnp.zeros(shapes[i][0], dtype=shapes[i][1]))
         else:
+            if shapes is not None and hasattr(c, "dtype") and \
+                    c.dtype != shapes[i][1] and \
+                    jnp.issubdtype(shapes[i][1], jnp.inexact):
+                c = c.astype(shapes[i][1])
             out.append(c)
     return out
 

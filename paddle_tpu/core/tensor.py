@@ -97,15 +97,16 @@ class GradNode:
     """
 
     __slots__ = ("vjp_fn", "inputs", "n_outputs", "name", "_out_shapes",
-                 "__weakref__")
+                 "tuple_output", "__weakref__")
 
     def __init__(self, vjp_fn, inputs: Tuple["Tensor", ...], n_outputs: int,
-                 name: str):
+                 name: str, tuple_output: bool = False):
         self.vjp_fn = vjp_fn
         self.inputs = inputs
         self.n_outputs = n_outputs
         self.name = name
         self._out_shapes = None
+        self.tuple_output = tuple_output
 
     def __repr__(self):
         return f"<GradNode {self.name} n_in={len(self.inputs)}>"
@@ -437,9 +438,9 @@ def dispatch(fn, tensor_args: Sequence[Any], name: str = "op",
     out_vals, vjp_fn = jax.vjp(fn, *values)
     outs = tuple(out_vals) if multi_output else (out_vals,)
     _maybe_check_nan(name, [o for o in outs if isinstance(o, jax.Array)])
-    node = GradNode(vjp_fn, tensors, len(outs), name)
-    if len(outs) > 1:
-        node._out_shapes = [(o.shape, o.dtype) for o in outs]
+    node = GradNode(vjp_fn, tensors, len(outs), name,
+                    tuple_output=multi_output)
+    node._out_shapes = [(o.shape, o.dtype) for o in outs]
     results = []
     for i, o in enumerate(outs):
         t = Tensor(o, stop_gradient=False)

@@ -75,15 +75,13 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
             # e.g. HWIO: move O,I to the back
             perm = [2 + i for i in range(n)] + [1, 0]
             w = jnp.transpose(w, perm)
+        # no preferred_element_type: the TPU MXU accumulates bf16 convs in
+        # fp32 natively, and mixed preferred dtypes break the transpose rule
         out = lax.conv_general_dilated(
             v, w, window_strides=st, padding=pd,
             lhs_dilation=(1,) * n, rhs_dilation=dl,
             dimension_numbers=(lhs_spec, rhs_spec, out_spec),
-            feature_group_count=groups,
-            preferred_element_type=jnp.float32
-            if v.dtype == jnp.bfloat16 else None)
-        if v.dtype == jnp.bfloat16:
-            out = out.astype(v.dtype)
+            feature_group_count=groups)
         if rest:
             b = rest[0]
             shape = [1] * out.ndim

@@ -57,7 +57,9 @@ def _max_pool(x, kernel_size, stride, padding, ceil_mode, return_mask,
               for (lo, hi), s, k, s2 in zip(pd, spatial, ks, st)]
 
     def f(v):
-        neg = (jnp.finfo(v.dtype).min if jnp.issubdtype(v.dtype, jnp.floating)
+        # -inf init => JAX recognises the max-pool pattern and provides the
+        # reverse-mode rule (finfo.min would block autodiff)
+        neg = (-jnp.inf if jnp.issubdtype(v.dtype, jnp.floating)
                else jnp.iinfo(v.dtype).min)
         out = _reduce_window(v, neg, lax.max, ks, st, pd, ch_last, n)
         if not return_mask:

@@ -1,0 +1,101 @@
+"""End-to-end model training (BASELINE config 1 slice: ResNet on one device;
+reference analog: test/legacy_test model-level tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _tiny_batch(n=8, c=10, hw=32):
+    paddle.seed(3)
+    x = paddle.randn([n, 3, hw, hw])
+    y = paddle.to_tensor(np.random.randint(0, c, n))
+    return x, y
+
+
+class TestResNetE2E:
+    def test_resnet18_forward_shapes(self):
+        from paddle_tpu.vision.models import resnet18
+        net = resnet18(num_classes=10)
+        net.eval()
+        out = net(paddle.randn([2, 3, 64, 64]))
+        assert out.shape == [2, 10]
+
+    def test_resnet_train_step_eager(self):
+        from paddle_tpu.vision.models import ResNet, BasicBlock
+        net = ResNet(BasicBlock, 18, num_classes=10)
+        net.train()
+        opt = paddle.optimizer.Momentum(0.05,
+                                        parameters=net.parameters())
+        x, y = _tiny_batch()
+        losses = []
+        for _ in range(4):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        assert losses[-1] < losses[0]
+
+    def test_resnet_train_step_compiled(self):
+        from paddle_tpu.vision.models import ResNet, BasicBlock
+        net = ResNet(BasicBlock, 18, num_classes=10)
+        net.train()
+        compiled = paddle.jit.to_static(net)
+        opt = paddle.optimizer.Momentum(0.05, parameters=net.parameters())
+        x, y = _tiny_batch()
+        losses = []
+        for _ in range(4):
+            loss = F.cross_entropy(compiled(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        assert losses[-1] < losses[0]
+
+    def test_lenet_mnist_pipeline(self):
+        from paddle_tpu.vision.models import LeNet
+        from paddle_tpu.vision.datasets import FakeData
+        from paddle_tpu.io import DataLoader
+        net = LeNet()
+        opt = paddle.optimizer.Adam(0.001, parameters=net.parameters())
+        ds = FakeData(size=16, image_shape=(1, 28, 28), num_classes=10)
+        loader = DataLoader(ds, batch_size=8)
+        for img, label in loader:
+            loss = F.cross_entropy(net(img), label)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert np.isfinite(float(loss.item()))
+
+    def test_hapi_model_fit(self):
+        from paddle_tpu.vision.models import LeNet
+        from paddle_tpu.vision.datasets import FakeData
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.metric import Accuracy
+        net = LeNet()
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.Adam(0.001,
+                                            parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(), metrics=[Accuracy()])
+        ds = FakeData(size=16, image_shape=(1, 28, 28), num_classes=10)
+        model.fit(ds, batch_size=8, epochs=1, verbose=0)
+        res = model.evaluate(ds, batch_size=8, verbose=0)
+        assert "loss" in res
+
+    def test_amp_training(self):
+        from paddle_tpu.vision.models import LeNet
+        net = LeNet()
+        opt = paddle.optimizer.Adam(0.001, parameters=net.parameters())
+        scaler = paddle.amp.GradScaler()
+        x = paddle.randn([4, 1, 28, 28])
+        y = paddle.to_tensor(np.random.randint(0, 10, 4))
+        with paddle.amp.auto_cast(level="O1"):
+            loss = F.cross_entropy(net(x), y)
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        assert np.isfinite(float(loss.item()))

@@ -1,0 +1,113 @@
+"""to_static / compiled-graph tests (reference analog: test/dygraph_to_static/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestToStatic:
+    def test_function_parity(self):
+        @paddle.jit.to_static
+        def f(x, y):
+            return paddle.matmul(x, y) + 1.0
+
+        a = paddle.randn([3, 4])
+        b = paddle.randn([4, 5])
+        want = a.numpy() @ b.numpy() + 1.0
+        np.testing.assert_allclose(f(a, b).numpy(), want, rtol=1e-5,
+                                   atol=1e-5)
+        # second call hits cache
+        np.testing.assert_allclose(f(a, b).numpy(), want, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_layer_parity_and_grad(self):
+        net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 2))
+        x = paddle.randn([8, 4])
+        eager_out = net(x).numpy()
+        snet = paddle.jit.to_static(net)
+        out = snet(x)
+        np.testing.assert_allclose(out.numpy(), eager_out, rtol=1e-5,
+                                   atol=1e-5)
+        # grads flow through the compiled region
+        loss = out.sum()
+        loss.backward()
+        for p in net.parameters():
+            assert p.grad is not None
+
+    def test_compiled_training_matches_eager(self):
+        paddle.seed(1)
+        net_e = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        paddle.seed(1)
+        net_s = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+        np.testing.assert_allclose(net_e[0].weight.numpy(),
+                                   net_s[0].weight.numpy())
+        opt_e = paddle.optimizer.SGD(0.1, parameters=net_e.parameters(),
+                                     multi_precision=False)
+        opt_s = paddle.optimizer.SGD(0.1, parameters=net_s.parameters(),
+                                     multi_precision=False)
+        compiled = paddle.jit.to_static(net_s)
+        x = paddle.randn([16, 4])
+        y = paddle.randn([16, 1])
+        for _ in range(3):
+            le = F.mse_loss(net_e(x), y)
+            le.backward()
+            opt_e.step()
+            opt_e.clear_grad()
+            ls = F.mse_loss(compiled(x), y)
+            ls.backward()
+            opt_s.step()
+            opt_s.clear_grad()
+        np.testing.assert_allclose(net_e[0].weight.numpy(),
+                                   net_s[0].weight.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_buffer_updates_propagate(self):
+        net = nn.Sequential(nn.Conv2D(1, 2, 3), nn.BatchNorm2D(2))
+        compiled = paddle.jit.to_static(net)
+        bn = net[1]
+        m0 = bn._mean.numpy().copy()
+        compiled(paddle.randn([4, 1, 6, 6]) + 3.0)
+        assert not np.allclose(m0, bn._mean.numpy())
+
+    def test_shape_recompile(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x):
+            calls.append(1)
+            return x * 2
+
+        f(paddle.randn([2, 3]))
+        n1 = len(calls)
+        f(paddle.randn([2, 3]))  # cached: no retrace
+        assert len(calls) == n1
+        f(paddle.randn([4, 3]))  # new shape: retrace
+        assert len(calls) > n1
+
+    def test_dropout_varies_under_jit(self):
+        d = nn.Dropout(0.5)
+        d.train()
+        f = paddle.jit.to_static(lambda x: d(x))
+        x = paddle.ones([64, 64])
+        a = f(x).numpy()
+        b = f(x).numpy()
+        assert not np.allclose(a, b)
+
+    def test_static_export_stablehlo(self):
+        import jax.numpy as jnp
+        txt = paddle.static.export_stablehlo(
+            lambda x: jnp.tanh(x) * 2, (paddle.randn([2, 2]),))
+        assert "stablehlo" in txt or "mhlo" in txt or "tanh" in txt
+
+    def test_jit_save_load(self, tmp_path):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        net.eval()
+        path = str(tmp_path / "m")
+        from paddle_tpu.static import InputSpec
+        paddle.jit.save(net, path, input_spec=[InputSpec([1, 4])])
+        loaded = paddle.jit.load(path)
+        x = paddle.randn([1, 4])
+        np.testing.assert_allclose(loaded(x).numpy(), net(x).numpy(),
+                                   rtol=1e-5, atol=1e-6)

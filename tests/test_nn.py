@@ -1,0 +1,417 @@
+"""nn layer tests (reference analog: test/legacy_test/test_layers.py etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerBase:
+    def test_parameter_registry(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert "fc1.weight" in names and "fc2.bias" in names
+        assert len(net.parameters()) == 4
+        assert len(net.sublayers()) == 2
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(3, 5), nn.ReLU(), nn.Linear(5, 2))
+        sd = net.state_dict()
+        net2 = nn.Sequential(nn.Linear(3, 5), nn.ReLU(), nn.Linear(5, 2))
+        missing, unexpected = net2.set_state_dict(sd)
+        assert not missing and not unexpected
+        x = paddle.randn([2, 3])
+        np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(),
+                                   rtol=1e-6)
+
+    def test_save_load(self, tmp_path):
+        net = nn.Linear(3, 2)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(net.state_dict(), path)
+        loaded = paddle.load(path)
+        net2 = nn.Linear(3, 2)
+        net2.set_state_dict(loaded)
+        np.testing.assert_allclose(net.weight.numpy(), net2.weight.numpy())
+
+    def test_train_eval_mode(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        d.eval()
+        np.testing.assert_allclose(d(x).numpy(), x.numpy())
+        d.train()
+        out = d(x)
+        assert (out.numpy() == 0).any()
+
+    def test_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda l, i, o: calls.append(1))
+        lin(paddle.randn([1, 2]))
+        assert calls == [1]
+        h.remove()
+        lin(paddle.randn([1, 2]))
+        assert calls == [1]
+
+    def test_to_dtype(self):
+        lin = nn.Linear(2, 2)
+        lin.to(dtype="bfloat16")
+        assert lin.weight.dtype == paddle.bfloat16
+
+
+class TestCoreLayers:
+    def test_linear_numeric(self):
+        lin = nn.Linear(3, 4)
+        x = np.random.randn(5, 3).astype(np.float32)
+        want = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(lin(paddle.to_tensor(x)).numpy(), want,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_conv2d_vs_naive(self):
+        conv = nn.Conv2D(2, 3, 3, padding=1)
+        x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+        out = conv(paddle.to_tensor(x))
+        assert out.shape == [1, 3, 5, 5]
+        # check against explicit correlation
+        w = conv.weight.numpy()
+        b = conv.bias.numpy()
+        xp = np.pad(x, [(0, 0), (0, 0), (1, 1), (1, 1)])
+        want = np.zeros((1, 3, 5, 5), np.float32)
+        for oc in range(3):
+            for i in range(5):
+                for j in range(5):
+                    want[0, oc, i, j] = np.sum(
+                        xp[0, :, i:i + 3, j:j + 3] * w[oc]) + b[oc]
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_stride_groups(self):
+        conv = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+        out = conv(paddle.randn([2, 4, 8, 8]))
+        assert out.shape == [2, 8, 4, 4]
+
+    def test_conv_transpose(self):
+        conv = nn.Conv2DTranspose(3, 5, 4, stride=2, padding=1)
+        out = conv(paddle.randn([1, 3, 8, 8]))
+        assert out.shape == [1, 5, 16, 16]
+
+    def test_batchnorm_stats(self):
+        bn = nn.BatchNorm2D(3, momentum=0.9)
+        x = paddle.randn([4, 3, 8, 8]) * 2 + 1
+        bn.train()
+        out = bn(x)
+        m = out.numpy().mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [4, 3, 8, 8]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(16)
+        x = paddle.randn([2, 4, 16]) * 3 + 5
+        out = ln(x).numpy()
+        np.testing.assert_allclose(out.mean(-1), np.zeros((2, 4)),
+                                   atol=1e-4)
+        np.testing.assert_allclose(out.std(-1), np.ones((2, 4)), atol=1e-2)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = paddle.randn([2, 8])
+        out = rn(x).numpy()
+        xf = x.numpy()
+        want = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
+
+    def test_groupnorm_embedding(self):
+        gn = nn.GroupNorm(2, 4)
+        assert gn(paddle.randn([2, 4, 3, 3])).shape == [2, 4, 3, 3]
+        emb = nn.Embedding(10, 6, padding_idx=0)
+        out = emb(paddle.to_tensor([[1, 0, 3]]))
+        assert out.shape == [1, 3, 6]
+        assert np.allclose(out.numpy()[0, 1], 0)
+
+    def test_pools(self):
+        x = paddle.to_tensor(np.arange(16, dtype=np.float32
+                                       ).reshape(1, 1, 4, 4))
+        mp = nn.MaxPool2D(2, 2)(x)
+        np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+        ap = nn.AvgPool2D(2, 2)(x)
+        np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5],
+                                                      [10.5, 12.5]])
+        aap = nn.AdaptiveAvgPool2D(1)(x)
+        np.testing.assert_allclose(aap.numpy()[0, 0, 0, 0], 7.5)
+
+    def test_activations(self):
+        x = paddle.to_tensor([-1.0, 0.0, 2.0])
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+        assert nn.GELU()(x).shape == [3]
+        out = F.softmax(x)
+        np.testing.assert_allclose(out.numpy().sum(), 1.0, rtol=1e-6)
+
+    def test_rnn_lstm_gru(self):
+        for cls, states in [(nn.SimpleRNN, 1), (nn.LSTM, 2), (nn.GRU, 1)]:
+            m = cls(4, 8, num_layers=2)
+            out, st = m(paddle.randn([3, 5, 4]))
+            assert out.shape == [3, 5, 8]
+            if states == 2:
+                assert st[0].shape == [2, 3, 8]
+            else:
+                assert st.shape == [2, 3, 8]
+
+    def test_bidirectional_lstm(self):
+        m = nn.LSTM(4, 8, direction="bidirect")
+        out, (h, c) = m(paddle.randn([2, 5, 4]))
+        assert out.shape == [2, 5, 16]
+        assert h.shape == [2, 2, 8]
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        q = paddle.randn([2, 6, 16])
+        out = mha(q, q, q)
+        assert out.shape == [2, 6, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        out = enc(paddle.randn([2, 6, 16]))
+        assert out.shape == [2, 6, 16]
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = np.random.randn(4, 10).astype(np.float32)
+        labels = np.array([1, 3, 5, 9])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels))
+        # numpy reference
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(float(loss.item()), want, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([1, -100, 2, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels), ignore_index=-100)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        want = -np.log(p[[0, 2], [1, 2]]).mean()
+        np.testing.assert_allclose(float(loss.item()), want, rtol=1e-5)
+
+    def test_soft_label_and_smoothing(self):
+        logits = paddle.randn([3, 6])
+        soft = F.softmax(paddle.randn([3, 6]))
+        loss = F.cross_entropy(logits, soft, soft_label=True)
+        assert loss.size == 1
+        loss2 = F.cross_entropy(logits, paddle.to_tensor([0, 1, 2]),
+                                label_smoothing=0.1)
+        assert loss2.size == 1
+
+    def test_mse_l1_bce(self):
+        a = paddle.randn([4, 3])
+        b = paddle.randn([4, 3])
+        np.testing.assert_allclose(
+            float(F.mse_loss(a, b).item()),
+            ((a.numpy() - b.numpy()) ** 2).mean(), rtol=1e-5)
+        p = paddle.nn.functional.sigmoid(a)
+        y = paddle.to_tensor((np.random.rand(4, 3) > 0.5
+                              ).astype(np.float32))
+        l1 = F.binary_cross_entropy(p, y)
+        l2 = F.binary_cross_entropy_with_logits(a, y)
+        np.testing.assert_allclose(float(l1.item()), float(l2.item()),
+                                   rtol=1e-4)
+
+    def test_kl_smooth_l1(self):
+        logp = F.log_softmax(paddle.randn([3, 5]))
+        q = F.softmax(paddle.randn([3, 5]))
+        assert F.kl_div(logp, q).size == 1
+        assert F.smooth_l1_loss(paddle.randn([3]), paddle.randn([3])).size == 1
+
+    def test_ctc_loss_runs(self):
+        T, B, C, S = 12, 2, 6, 4
+        logits = paddle.randn([T, B, C])
+        labels = paddle.to_tensor(
+            np.random.randint(1, C, (B, S)).astype(np.int32))
+        loss = F.ctc_loss(logits, labels,
+                          paddle.to_tensor(np.full(B, T, np.int64)),
+                          paddle.to_tensor(np.full(B, S, np.int64)))
+        assert np.isfinite(float(loss.item()))
+
+
+class TestGradFlow:
+    def test_mlp_training_reduces_loss(self):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 1))
+        opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+        x = paddle.randn([64, 8])
+        w = paddle.randn([8, 1])
+        y = paddle.matmul(x, w)
+        losses = []
+        for _ in range(60):
+            pred = net(x)
+            loss = F.mse_loss(pred, y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        assert losses[-1] < losses[0] * 0.15, losses[::10]
+
+    def test_conv_bn_backward(self):
+        net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8),
+                            nn.ReLU(), nn.Conv2D(8, 4, 1))
+        out = net(paddle.randn([2, 3, 8, 8]))
+        out.mean().backward()
+        for p in net.parameters():
+            assert p.grad is not None, p.name
+
+    def test_weight_decay_and_clip(self):
+        lin = nn.Linear(4, 4)
+        clip = nn.ClipGradByGlobalNorm(0.1)
+        opt = paddle.optimizer.Momentum(0.1, parameters=lin.parameters(),
+                                        weight_decay=0.01, grad_clip=clip)
+        (lin(paddle.randn([8, 4])) ** 2).sum().backward()
+        opt.step()
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("cls,kw", [
+        ("SGD", {}), ("Momentum", {}), ("Adam", {}), ("AdamW", {}),
+        ("Adagrad", {"learning_rate": 0.01}),
+        ("RMSProp", {"learning_rate": 0.01}),
+        ("Adamax", {}), ("Adadelta", {}), ("Lamb", {}), ("NAdam", {}),
+        ("RAdam", {}),
+    ])
+    def test_step_changes_params(self, cls, kw):
+        lin = nn.Linear(3, 3)
+        kw.setdefault("learning_rate", 0.05)
+        opt = getattr(paddle.optimizer, cls)(parameters=lin.parameters(),
+                                             **kw)
+        (lin(paddle.randn([4, 3])) ** 2).sum().backward()
+        w0 = lin.weight.numpy().copy()
+        opt.step()
+        assert not np.allclose(w0, lin.weight.numpy())
+
+    def test_adam_matches_reference_formula(self):
+        p0 = np.array([1.0, -2.0], np.float32)
+        g = np.array([0.5, 0.25], np.float32)
+        lin_p = paddle.framework.Parameter(p0.copy())
+        opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[lin_p],
+                                    multi_precision=False)
+        lin_p.grad = paddle.to_tensor(g)
+        opt.step()
+        m = 0.1 * g
+        v = 0.001 * g * g
+        mhat = m / (1 - 0.9)
+        vhat = v / (1 - 0.999)
+        want = p0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(lin_p.numpy(), want, rtol=1e-5)
+
+    def test_lr_scheduler(self):
+        lin = nn.Linear(2, 2)
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        opt = paddle.optimizer.SGD(sched, parameters=lin.parameters())
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sched.step()
+        sched.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+    def test_warmup(self):
+        s = paddle.optimizer.lr.LinearWarmup(0.1, 10, 0.0, 0.1)
+        vals = []
+        for _ in range(12):
+            vals.append(s())
+            s.step()
+        assert vals[0] == 0.0 and abs(vals[5] - 0.05) < 1e-9
+        assert abs(vals[11] - 0.1) < 1e-9
+
+    def test_optimizer_state_roundtrip(self):
+        lin = nn.Linear(2, 2)
+        opt = paddle.optimizer.Adam(parameters=lin.parameters())
+        (lin(paddle.randn([2, 2]))).sum().backward()
+        opt.step()
+        state = opt.state_dict()
+        opt2 = paddle.optimizer.Adam(parameters=lin.parameters())
+        opt2.set_state_dict(state)
+        assert opt2._global_step == opt._global_step
+
+
+class TestAMP:
+    def test_autocast_casts_matmul(self):
+        a = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = paddle.matmul(a, a)
+            assert out.dtype == paddle.bfloat16
+            s = paddle.nn.functional.softmax(out)
+            assert s.dtype == np.float32  # black list promotes
+        out2 = paddle.matmul(a, a)
+        assert out2.dtype == np.float32
+
+    def test_grad_scaler(self):
+        lin = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        loss = (lin(paddle.randn([2, 4])) ** 2).mean()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        g = lin.weight.grad
+        assert g is not None
+
+    def test_scaler_skips_on_inf(self):
+        lin = nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(0.1, parameters=lin.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=64.0)
+        lin.weight.grad = paddle.to_tensor(
+            np.full((2, 2), np.inf, np.float32))
+        lin.bias.grad = paddle.to_tensor(np.zeros(2, np.float32))
+        w0 = lin.weight.numpy().copy()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(lin.weight.numpy(), w0)
+        assert scaler.get_loss_scaling() == 32.0
+
+
+class TestDataLoader:
+    def test_basic_iteration(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        x = paddle.randn([20, 3])
+        y = paddle.arange(20)
+        ds = TensorDataset([x, y])
+        loader = DataLoader(ds, batch_size=6, drop_last=False)
+        batches = list(loader)
+        assert len(batches) == 4
+        assert batches[0][0].shape == [6, 3]
+        assert batches[-1][0].shape == [2, 3]
+
+    def test_shuffle_and_workers(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.datasets import FakeData
+        ds = FakeData(size=32, image_shape=(3, 8, 8), num_classes=4)
+        loader = DataLoader(ds, batch_size=8, shuffle=True, num_workers=2)
+        seen = 0
+        for img, lab in loader:
+            assert img.shape == [8, 3, 8, 8]
+            seen += 8
+        assert seen == 32
+
+    def test_distributed_sampler_shards(self):
+        from paddle_tpu.io import DistributedBatchSampler
+        from paddle_tpu.vision.datasets import FakeData
+        ds = FakeData(size=20, image_shape=(1,), num_classes=2)
+        s0 = DistributedBatchSampler(ds, 5, num_replicas=2, rank=0)
+        s1 = DistributedBatchSampler(ds, 5, num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == len(i1) == 10
+        assert not set(i0) & set(i1)
