@@ -42,8 +42,6 @@ def bench_resnet50(steps=20, batch=128):
     # warmup (compile)
     loss = step()
     jax.block_until_ready(loss._value)
-    loss = step()
-    jax.block_until_ready(loss._value)
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -56,23 +54,29 @@ def bench_resnet50(steps=20, batch=128):
 
 def main():
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    try:
-        ips, loss = bench_resnet50(steps=steps, batch=batch)
-        baseline_a100 = 2500.0  # public fp16 A100 ResNet-50 train imgs/s
-        print(json.dumps({
-            "metric": "resnet50_train_imgs_per_sec_per_chip",
-            "value": round(ips, 2),
-            "unit": "imgs/sec/chip",
-            "vs_baseline": round(ips / baseline_a100, 4),
-        }))
-    except Exception as e:  # noqa: BLE001
-        print(json.dumps({
-            "metric": "resnet50_train_imgs_per_sec_per_chip",
-            "value": 0.0, "unit": "imgs/sec/chip", "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}"[:400],
-        }))
-        sys.exit(0)
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
+    err = None
+    for b in (batch, batch // 2, batch // 4):
+        if b < 1:
+            break
+        try:
+            ips, loss = bench_resnet50(steps=steps, batch=b)
+            baseline_a100 = 2500.0  # public fp16 A100 ResNet-50 train imgs/s
+            print(json.dumps({
+                "metric": "resnet50_train_imgs_per_sec_per_chip",
+                "value": round(ips, 2),
+                "unit": "imgs/sec/chip",
+                "vs_baseline": round(ips / baseline_a100, 4),
+            }))
+            return
+        except Exception as e:  # noqa: BLE001
+            err = e
+    print(json.dumps({
+        "metric": "resnet50_train_imgs_per_sec_per_chip",
+        "value": 0.0, "unit": "imgs/sec/chip", "vs_baseline": 0.0,
+        "error": f"{type(err).__name__}: {err}"[:400],
+    }))
+    sys.exit(0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,38 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/).
+
+Layering (SURVEY.md §2.3/§2.4):
+- env/launch: JAX coordination service replaces TCPStore rendezvous
+- collective: XLA collectives replace ProcessGroup/NCCL
+- topology/fleet: manual hybrid parallel (dp/mp/pp/sharding/sep) over a Mesh
+- auto_parallel: GSPMD semi-auto sharding (ProcessMesh/shard_tensor/reshard)
+- checkpoint: sharded save/load with reshard-on-load
+"""
+from .env import (init_parallel_env, get_rank, get_world_size,  # noqa
+                  ParallelEnv, is_initialized)
+from .collective import (ReduceOp, all_reduce, all_gather,  # noqa
+                         all_gather_object, reduce, reduce_scatter,
+                         broadcast, scatter, all_to_all, alltoall,
+                         alltoall_single, send, recv, isend, irecv, barrier,
+                         new_group, get_group, wait, stream,
+                         broadcast_object_list)
+from .parallel import DataParallel  # noqa: F401
+from .topology import (HybridCommunicateGroup, CommunicateTopology,  # noqa
+                       get_hybrid_communicate_group,
+                       set_hybrid_communicate_group, ParallelMode)
+from .auto_parallel import (ProcessMesh, Shard, Replicate, Partial,  # noqa
+                            shard_tensor, reshard, shard_layer,
+                            shard_optimizer, dtensor_from_local,
+                            dtensor_to_local, unshard_dtensor, get_mesh,
+                            set_mesh, shard_dataloader)
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+    "ReduceOp", "all_reduce", "all_gather", "reduce", "reduce_scatter",
+    "broadcast", "scatter", "all_to_all", "send", "recv", "barrier",
+    "new_group", "DataParallel", "fleet", "ProcessMesh", "Shard",
+    "Replicate", "Partial", "shard_tensor", "reshard", "shard_layer",
+    "shard_optimizer", "save_state_dict", "load_state_dict",
+]

@@ -1,0 +1,4 @@
+from .api import (ProcessMesh, Shard, Replicate, Partial, shard_tensor,  # noqa
+                  reshard, shard_layer, shard_optimizer, dtensor_from_local,
+                  dtensor_to_local, unshard_dtensor, get_mesh, set_mesh,
+                  to_placements, shard_dataloader)

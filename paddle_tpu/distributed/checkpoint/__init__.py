@@ -1,0 +1,2 @@
+from .save_load import (save_state_dict, load_state_dict,  # noqa
+                        LocalTensorMetadata, Metadata)

@@ -1,0 +1,99 @@
+"""Distributed environment.
+
+TPU-native re-design of the reference's process bootstrap:
+- reference TCPStore rendezvous (paddle/phi/core/distributed/store/
+  tcp_store.h:121) + ProcessGroupNCCL init → JAX coordination service
+  (``jax.distributed.initialize``), which brings up the PjRt distributed
+  runtime over ICI/DCN;
+- env contract mirrors the reference launcher's
+  (``PADDLE_TRAINER_ID``/``PADDLE_TRAINERS_NUM``/``PADDLE_MASTER``), mapped
+  onto the JAX coordinator address.
+
+On a single host (or single-controller TPU pod slice) no init is needed —
+``jax.devices()`` already spans the slice.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = [False]
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None,
+                      local_device_ids=None):
+    """reference: python/paddle/distributed/parallel.py:978
+    init_parallel_env."""
+    if _initialized[0]:
+        return ParallelEnv()
+    addr = coordinator_address or os.environ.get("PADDLE_MASTER") \
+        or os.environ.get("MASTER_ADDR")
+    nproc = num_processes if num_processes is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = process_id if process_id is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if addr and nproc > 1:
+        port = os.environ.get("MASTER_PORT")
+        if port and ":" not in addr:
+            addr = f"{addr}:{port}"
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nproc, process_id=pid,
+                                   local_device_ids=local_device_ids)
+    _initialized[0] = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized[0]
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    return jax.device_count()
+
+
+class ParallelEnv:
+    """reference: python/paddle/distributed/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return int(os.environ.get("PADDLE_LOCAL_RANK", str(self.rank)))
+
+    @property
+    def device_id(self) -> int:
+        return jax.local_devices()[0].id
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def dev_id(self) -> int:
+        return self.device_id

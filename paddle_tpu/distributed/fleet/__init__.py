@@ -1,0 +1,39 @@
+"""paddle_tpu.distributed.fleet (reference: python/paddle/distributed/fleet/).
+
+The manual hybrid-parallel stack: topology + TP layers + pipeline engine +
+ZeRO sharding + DataParallel, orchestrated by ``fleet.init`` /
+``distributed_model`` / ``distributed_optimizer``
+(reference fleet/fleet.py:218, fleet/model.py:33, fleet.py:1448).
+"""
+from .fleet import (init, distributed_model, distributed_optimizer,  # noqa
+                    DistributedStrategy, get_hybrid_communicate_group,
+                    worker_num, worker_index)
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa
+                        RowParallelLinear, ParallelCrossEntropy)
+from . import random  # noqa: F401
+
+# paddle-compat: fleet.meta_parallel namespace
+from . import mp_layers as _mp
+
+
+class meta_parallel:
+    VocabParallelEmbedding = _mp.VocabParallelEmbedding
+    ColumnParallelLinear = _mp.ColumnParallelLinear
+    RowParallelLinear = _mp.RowParallelLinear
+    ParallelCrossEntropy = _mp.ParallelCrossEntropy
+
+    @staticmethod
+    def get_rng_state_tracker():
+        from .random import get_rng_state_tracker
+        return get_rng_state_tracker()
+
+
+def __getattr__(name):
+    if name in ("PipelineLayer", "LayerDesc", "SharedLayerDesc",
+                "PipelineParallel"):
+        from . import pipeline_parallel as pp
+        return getattr(pp, name)
+    if name in ("DygraphShardingOptimizer", "group_sharded_parallel"):
+        from . import sharding
+        return getattr(sharding, name)
+    raise AttributeError(name)

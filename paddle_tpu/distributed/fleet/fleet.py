@@ -1,0 +1,111 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py:218
+``Fleet.init``; model wrap fleet/model.py:33; optimizer wrap fleet.py:1448).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import jax
+
+from ..env import init_parallel_env, get_rank, get_world_size
+from ..topology import (HybridCommunicateGroup, CommunicateTopology,
+                        set_hybrid_communicate_group,
+                        get_hybrid_communicate_group)
+
+__all__ = ["init", "distributed_model", "distributed_optimizer",
+           "DistributedStrategy", "worker_num", "worker_index"]
+
+
+class DistributedStrategy:
+    """Strategy bag (reference: fleet/base/distributed_strategy.py:284 —
+    protobuf-backed there; a plain attribute bag here with the same knobs).
+    """
+
+    def __init__(self):
+        self.hybrid_configs: Dict = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+            "mp_configs": {}, "pp_configs": {},
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class _FleetState:
+    def __init__(self):
+        self.strategy: Optional[DistributedStrategy] = None
+        self.initialized = False
+
+
+_state = _FleetState()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """reference: fleet/fleet.py:218."""
+    strategy = strategy or DistributedStrategy()
+    _state.strategy = strategy
+    init_parallel_env()
+    hc = strategy.hybrid_configs
+    hcg = HybridCommunicateGroup(
+        dp_degree=hc.get("dp_degree", 1),
+        mp_degree=hc.get("mp_degree", 1),
+        pp_degree=hc.get("pp_degree", 1),
+        sharding_degree=hc.get("sharding_degree", 1),
+        sep_degree=hc.get("sep_degree", 1))
+    set_hybrid_communicate_group(hcg)
+    _state.initialized = True
+    return hcg
+
+
+def worker_num():
+    return get_world_size()
+
+
+def worker_index():
+    return get_rank()
+
+
+def distributed_model(model):
+    """reference: fleet/model.py:33. Wraps per active strategy:
+    pp>1 → PipelineParallel engine; else DataParallel semantics (params
+    replicated, data sharded on dp — grad psum comes from GSPMD)."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError("call fleet.init() first")
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from .pipeline_parallel import PipelineParallel
+        accumulate = 1
+        if _state.strategy is not None:
+            accumulate = _state.strategy.pipeline_configs.get(
+                "accumulate_steps", 1)
+        return PipelineParallel(model, hcg, accumulate_steps=accumulate)
+    from ..parallel import DataParallel
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """reference: fleet.py:1448 → HybridParallelOptimizer
+    (fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:275).
+    """
+    from .hybrid_parallel_optimizer import HybridParallelOptimizer
+    hcg = get_hybrid_communicate_group()
+    return HybridParallelOptimizer(optimizer, hcg,
+                                   _state.strategy or DistributedStrategy())

@@ -1,0 +1,165 @@
+"""Tensor-(model-)parallel layers.
+
+TPU-native re-design of reference fleet mpu layers
+(python/paddle/distributed/fleet/layers/mpu/mp_layers.py:
+VocabParallelEmbedding:49, ColumnParallelLinear:336, RowParallelLinear:543,
+ParallelCrossEntropy:744).
+
+Design: weights are created with a NamedSharding over the ``mp`` mesh axis;
+forward computes the plain math plus ``with_sharding_constraint`` hints.
+GSPMD then partitions the matmuls and inserts the identity/allreduce pairs
+that the reference implements manually as PyLayers in mp_ops.py — including
+the deferred-allreduce trick of Row-after-Column (XLA sees the whole graph
+and elides the intermediate gather automatically).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor, dispatch, to_value
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+from ..topology import get_hybrid_communicate_group
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
+           "RowParallelLinear", "ParallelCrossEntropy"]
+
+
+def _mp_mesh():
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        raise RuntimeError(
+            "call fleet.init with a hybrid strategy (mp_degree>1) first")
+    return hcg.mesh
+
+
+def _put(param, spec):
+    mesh = _mp_mesh()
+    param._replace_value(jax.device_put(
+        param._value, NamedSharding(mesh, spec)))
+    return param
+
+
+def _constraint(v, spec):
+    try:
+        return jax.lax.with_sharding_constraint(
+            v, NamedSharding(_mp_mesh(), spec))
+    except Exception:
+        return v
+
+
+class VocabParallelEmbedding(Layer):
+    """Vocab dim sharded over mp (reference: mp_layers.py:49)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        _put(self.weight, P("mp", None))
+
+    def forward(self, x):
+        def f(ids, w):
+            out = jnp.take(w, ids, axis=0)
+            return _constraint(out, P(None, None, None))
+        return dispatch(f, (x, self.weight), name="vocab_parallel_embedding")
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] sharded on out (reference: mp_layers.py:336)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        _put(self.weight, P(None, "mp"))
+        if has_bias is None or has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            _put(self.bias, P("mp"))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        def f(v, w, *b):
+            out = v @ w
+            if b:
+                out = out + b[0]
+            if self.gather_output:
+                out = _constraint(out, P(*([None] * out.ndim)))
+            else:
+                out = _constraint(out, P(*([None] * (out.ndim - 1)), "mp"))
+            return out
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None
+                                   else ())
+        return dispatch(f, args, name="column_parallel_linear")
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] sharded on in; input arrives mp-sharded
+    (reference: mp_layers.py:543)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        _put(self.weight, P("mp", None))
+        if has_bias:
+            self.bias = self.create_parameter([out_features], is_bias=True)
+            _put(self.bias, P())
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        def f(v, w, *b):
+            if self.input_is_parallel:
+                v = _constraint(v, P(*([None] * (v.ndim - 1)), "mp"))
+            out = v @ w  # GSPMD: partial-sum then allreduce
+            out = _constraint(out, P(*([None] * out.ndim)))
+            if b:
+                out = out + b[0]
+            return out
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None
+                                   else ())
+        return dispatch(f, args, name="row_parallel_linear")
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-sharded softmax-CE (reference: mp_layers.py:744). The logits'
+    vocab dim is mp-sharded; GSPMD partitions the log-softmax reduction
+    (the two allreduces of max and sumexp the reference codes by hand in
+    c_softmax_with_cross_entropy)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        def f(logits, lbl):
+            lg = logits.astype(jnp.float32)
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            lbl_ = lbl
+            if lbl_.ndim == logits.ndim:
+                lbl_ = lbl_[..., 0]
+            valid = lbl_ != self.ignore_index
+            safe = jnp.where(valid, lbl_, 0)
+            picked = jnp.take_along_axis(logp, safe[..., None].astype(
+                jnp.int32), axis=-1)[..., 0]
+            loss = jnp.where(valid, -picked, 0.0)
+            return loss[..., None]
+        return dispatch(f, (input, label), name="parallel_cross_entropy")

@@ -1,0 +1,87 @@
+"""Distributed RNG tree (reference:
+python/paddle/distributed/fleet/layers/mpu/random.py — per-mp-rank seeds so
+TP-sharded dropout masks differ across ranks while DP ranks agree).
+
+TPU-native: JAX keys are functional, so 'seed states' are named base keys;
+``rng_state(name)`` folds the mesh axis index in when used inside shard_map
+so each mp shard draws a distinct stream — same semantics, no mutable
+per-device Philox state to manage.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+
+from ...core import random as core_random
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "determinate_seed"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, jax.Array] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        key = self.states_[name]
+        # inside shard_map: decorrelate across mp shards
+        try:
+            idx = jax.lax.axis_index("mp")
+            key = jax.random.fold_in(key, idx)
+        except NameError:
+            pass
+        except Exception:
+            pass
+        with core_random.traced_key_source(key):
+            yield
+        # advance the stored key so successive scopes draw fresh streams
+        self.states_[name] = jax.random.split(self.states_[name])[0]
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    """reference: random.py model_parallel_random_seed."""
+    from ..topology import get_hybrid_communicate_group
+    import random as pyrandom
+    seed = seed or (pyrandom.randint(0, 1 << 30))
+    global_seed = seed
+    local_seed = seed + 1024
+    _tracker.reset()
+    core_random.seed(global_seed)
+    _tracker.add(MODEL_PARALLEL_RNG, local_seed)
+
+
+def determinate_seed(name):
+    return core_random.default_seed()

@@ -1,0 +1,91 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py:219).
+
+TPU-native design: there is no EagerReducer
+(paddle/fluid/distributed/collective/reducer.h:88 — bucketed grad fusion +
+async NCCL allreduce overlapped with backward). With global arrays on a
+mesh, the batch dim is dp-sharded and parameters are replicated; every
+gradient contraction over the batch dim *is* a psum that GSPMD inserts and
+XLA's latency-hiding scheduler overlaps with the backward — the reducer's
+entire machinery is the compiler's job here.
+
+DataParallel therefore: (a) replicates parameters onto the mesh, (b) shards
+inputs on dp at __call__, (c) is transparent for everything else.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, to_value
+from ..nn.layer.layers import Layer
+from .env import init_parallel_env, get_rank, get_world_size  # noqa: F401
+from .topology import get_hybrid_communicate_group
+
+__all__ = ["DataParallel", "ParallelEnv", "init_parallel_env"]
+
+from .env import ParallelEnv  # noqa: E402
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        hcg = get_hybrid_communicate_group()
+        self._mesh = hcg.mesh if hcg is not None else None
+        if self._mesh is not None and "dp" in self._mesh.shape:
+            repl = NamedSharding(self._mesh, P())
+            for p in layers.parameters():
+                v = to_value(p)
+                if hasattr(v, "sharding") and isinstance(
+                        v.sharding, NamedSharding):
+                    continue  # keep TP shardings
+                p._replace_value(jax.device_put(v, repl))
+
+    def _shard_input(self, t: Tensor) -> Tensor:
+        if self._mesh is None or "dp" not in self._mesh.shape:
+            return t
+        v = to_value(t)
+        if v.ndim == 0:
+            return t
+        spec = P("dp", *([None] * (v.ndim - 1)))
+        t._value = jax.device_put(v, NamedSharding(self._mesh, spec))
+        return t
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_input(i) if isinstance(i, Tensor) else i
+                       for i in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    # delegate the Layer surface
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, **kwargs):
+        return self._layers.set_state_dict(state_dict, **kwargs)
+
+    def train(self):
+        self._layers.train()
+        self.training = True
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        self.training = False
+        return self
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def scale_loss(self, loss):
+        return loss

@@ -1,0 +1,268 @@
+"""Hybrid-parallel topology.
+
+TPU-native re-design of reference ``HybridCommunicateGroup``
+(python/paddle/distributed/fleet/base/topology.py:189; axis order
+[pp, dp, sharding, sep, mp] at topology.py:298). Here the topology IS a
+``jax.sharding.Mesh``: axes are laid out so the fastest-varying axes (mp,
+sep) map to physically-adjacent devices and ride ICI, while dp/pp ride the
+outer interconnect — the same placement logic the reference implements by
+rank arithmetic over NCCL communicators.
+
+Groups are lightweight views (axis name + ranks); collectives inside
+shard_map reference the axis name, GSPMD paths just use the Mesh.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "ParallelMode",
+           "get_hybrid_communicate_group", "set_hybrid_communicate_group"]
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class CommGroup:
+    """Stands in for the reference's ProcessGroup handle: a named mesh axis
+    restricted to the caller's coordinates on the other axes."""
+
+    def __init__(self, axis_name: str, ranks: List[int], rank: int):
+        self.axis_name = axis_name
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self._rank = rank
+
+    def get_group_rank(self, global_rank: int) -> int:
+        try:
+            return self.ranks.index(global_rank)
+        except ValueError:
+            return -1
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def id(self):
+        return self.axis_name
+
+    def __repr__(self):
+        return (f"CommGroup(axis={self.axis_name}, nranks={self.nranks}, "
+                f"rank={self._rank})")
+
+
+class CommunicateTopology:
+    """reference: topology.py:77 CommunicateTopology."""
+
+    def __init__(self, hybrid_group_names=("pipe", "data", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        shape = tuple(self._dims)
+        self._world = list(itertools.product(*[range(d) for d in shape]))
+        self._coord_of = {i: c for i, c in enumerate(self._world)}
+        self._rank_of = {c: i for i, c in enumerate(self._world)}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._rank_of[coord]
+
+    def get_coord(self, rank: int):
+        return self._coord_of[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._coord_of.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        axis = self._parallel_names.index(axis_name)
+        groups = {}
+        for r, c in self._coord_of.items():
+            key = c[:axis] + c[axis + 1:]
+            groups.setdefault(key, []).append(r)
+        return [sorted(v) for _, v in sorted(groups.items())]
+
+
+# mesh axis order: slowest-varying (DCN-friendly) first, ICI-adjacent last
+_AXIS_ORDER = ["pp", "dp", "sharding", "sep", "mp"]
+_NAME_MAP = {"pipe": "pp", "data": "dp", "sharding": "sharding",
+             "sep": "sep", "model": "mp"}
+
+
+class HybridCommunicateGroup:
+    """reference: topology.py:189. Owns the jax Mesh for all parallel APIs."""
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
+                 sep_degree=1, devices=None):
+        if topology is not None:
+            dims = {_NAME_MAP[n]: topology.get_dim(n)
+                    for n in topology.get_hybrid_group_names()}
+            dp_degree = dims.get("dp", 1)
+            mp_degree = dims.get("mp", 1)
+            pp_degree = dims.get("pp", 1)
+            sharding_degree = dims.get("sharding", 1)
+            sep_degree = dims.get("sep", 1)
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+        self._sep_degree = sep_degree
+        total = dp_degree * mp_degree * pp_degree * sharding_degree * \
+            sep_degree
+        if devices is None:
+            devices = jax.devices()
+        if total > len(devices):
+            raise ValueError(
+                f"topology needs {total} devices, only {len(devices)} "
+                "available")
+        dev_array = np.array(devices[:total]).reshape(
+            pp_degree, dp_degree, sharding_degree, sep_degree, mp_degree)
+        self.mesh = Mesh(dev_array, axis_names=tuple(_AXIS_ORDER))
+        self._topo = CommunicateTopology(
+            ["pipe", "data", "sharding", "sep", "model"],
+            [pp_degree, dp_degree, sharding_degree, sep_degree, mp_degree])
+        self.global_rank = jax.process_index()
+        self.nranks = total
+
+    # -- degrees -------------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # -- ranks (meaningful in multi-process runs; 0 on single controller) ----
+    def _axis_rank(self, name):
+        coord = self._topo.get_coord(min(self.global_rank,
+                                         self.nranks - 1))
+        return coord[["pipe", "data", "sharding", "sep",
+                      "model"].index(name)]
+
+    def get_data_parallel_rank(self):
+        return self._axis_rank("data")
+
+    def get_model_parallel_rank(self):
+        return self._axis_rank("model")
+
+    def get_stage_id(self):
+        return self._axis_rank("pipe")
+
+    def get_sharding_parallel_rank(self):
+        return self._axis_rank("sharding")
+
+    def get_sep_parallel_rank(self):
+        return self._axis_rank("sep")
+
+    # -- groups --------------------------------------------------------------
+    def _group(self, topo_name, mesh_axis) -> CommGroup:
+        rank = min(self.global_rank, self.nranks - 1)
+        coord = self._topo.get_coord(rank)
+        idx = ["pipe", "data", "sharding", "sep", "model"].index(topo_name)
+        ranks = [r for r in range(self.nranks)
+                 if self._topo.get_coord(r)[:idx] + self._topo.get_coord(r)[
+                     idx + 1:] == coord[:idx] + coord[idx + 1:]]
+        return CommGroup(mesh_axis, ranks, coord[idx])
+
+    def get_data_parallel_group(self):
+        return self._group("data", "dp")
+
+    def get_model_parallel_group(self):
+        return self._group("model", "mp")
+
+    def get_pipe_parallel_group(self):
+        return self._group("pipe", "pp")
+
+    def get_sharding_parallel_group(self):
+        return self._group("sharding", "sharding")
+
+    def get_sep_parallel_group(self):
+        return self._group("sep", "sep")
+
+    def get_check_parallel_group(self, sharding=False):
+        return self.get_model_parallel_group()
+
+    def get_data_parallel_group_src_rank(self):
+        return self.get_data_parallel_group().ranks[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self.get_model_parallel_group().ranks[0]
+
+    # pipeline neighbours (reference: topology.py is_first_stage etc.)
+    @property
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    @property
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        coord = list(self._topo.get_coord(self.global_rank))
+        coord[0] = stage_id
+        return self._topo.get_rank(pipe=coord[0], data=coord[1],
+                                   sharding=coord[2], sep=coord[3],
+                                   model=coord[4])
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+
+_global_hcg: List[Optional[HybridCommunicateGroup]] = [None]
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    _global_hcg[0] = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _global_hcg[0]
