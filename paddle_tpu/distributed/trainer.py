@@ -1,0 +1,189 @@
+"""Sharded functional trainer — the Fleet-equivalent hot path.
+
+Builds ONE pjit-compiled train step for a functional model (params pytree +
+loss fn) over a named mesh with the full hybrid-parallel layout:
+- dp: batch data parallel (outermost, DCN-friendly)
+- fsdp: ZeRO-3 parameter/grad/state sharding (reference group_sharded
+  stage-3 semantics, group_sharded_stage3.py:85 — here GSPMD inserts the
+  gather-on-use / reduce-scatter-on-grad and XLA overlaps them)
+- tp: Megatron tensor parallel (reference mp_layers.py)
+- sp: sequence/context parallel on the activation seq dim (reference sep
+  axis, topology.py:77)
+
+The optimizer is a functional AdamW with fp32 master weights + moments,
+all sharded like their params (stage-1/2 are the same code with params
+replicated). This is the train loop the reference builds out of
+HybridParallelOptimizer + DygraphShardingOptimizer + EagerReducer + manual
+comm groups — here it is ~200 lines because the compiler owns comm.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["MeshConfig", "make_mesh", "TrainState", "Trainer"]
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+
+    @property
+    def total(self):
+        return self.dp * self.fsdp * self.tp * self.sp * self.pp
+
+
+def make_mesh(cfg: MeshConfig, devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if cfg.total > len(devices):
+        raise ValueError(f"need {cfg.total} devices, have {len(devices)}")
+    arr = np.array(devices[:cfg.total]).reshape(
+        cfg.pp, cfg.dp, cfg.fsdp, cfg.sp, cfg.tp)
+    return Mesh(arr, axis_names=("pp", "dp", "fsdp", "sp", "tp"))
+
+
+class TrainState:
+    """params (model dtype) + fp32 master/moments, all mesh-sharded."""
+
+    def __init__(self, params, master, mu, nu, step):
+        self.params = params
+        self.master = master
+        self.mu = mu
+        self.nu = nu
+        self.step = step
+
+    def tree(self):
+        return (self.params, self.master, self.mu, self.nu, self.step)
+
+    @staticmethod
+    def from_tree(t):
+        return TrainState(*t)
+
+
+def _adamw_update(grads, state: Tuple, lr, b1=0.9, b2=0.95, eps=1e-8,
+                  wd=0.1, grad_clip=1.0):
+    params, master, mu, nu, step = state
+    step = step + 1
+    gnorm_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                   for g in jax.tree_util.tree_leaves(grads))
+    gnorm = jnp.sqrt(gnorm_sq)
+    scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12)) \
+        if grad_clip else 1.0
+
+    def upd(g, m, mu_i, nu_i):
+        g32 = g.astype(jnp.float32) * scale
+        mu_n = b1 * mu_i + (1 - b1) * g32
+        nu_n = b2 * nu_i + (1 - b2) * jnp.square(g32)
+        mhat = mu_n / (1 - b1 ** step)
+        vhat = nu_n / (1 - b2 ** step)
+        m_n = m * (1.0 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return m_n, mu_n, nu_n
+
+    flat_g = jax.tree_util.tree_leaves(grads)
+    flat_m = jax.tree_util.tree_leaves(master)
+    flat_mu = jax.tree_util.tree_leaves(mu)
+    flat_nu = jax.tree_util.tree_leaves(nu)
+    treedef = jax.tree_util.tree_structure(grads)
+    new_m, new_mu, new_nu = [], [], []
+    for g, m, mi, ni in zip(flat_g, flat_m, flat_mu, flat_nu):
+        a, b, c = upd(g, m, mi, ni)
+        new_m.append(a)
+        new_mu.append(b)
+        new_nu.append(c)
+    master_n = jax.tree_util.tree_unflatten(treedef, new_m)
+    mu_n = jax.tree_util.tree_unflatten(treedef, new_mu)
+    nu_n = jax.tree_util.tree_unflatten(treedef, new_nu)
+    params_n = jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype), master_n, params)
+    return (params_n, master_n, mu_n, nu_n, step), gnorm
+
+
+class Trainer:
+    def __init__(self, loss_fn: Callable, mesh: Mesh,
+                 param_specs, data_spec=P(("dp", "fsdp"), "sp"),
+                 lr=3e-4, b1=0.9, b2=0.95, weight_decay=0.1,
+                 grad_clip=1.0, accumulate_steps: int = 1,
+                 donate: bool = True):
+        """loss_fn(params, *batch) -> scalar. param_specs: pytree of
+        PartitionSpec matching params."""
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.param_specs = param_specs
+        self.data_spec = data_spec
+        self.lr = lr
+        self.hp = dict(b1=b1, b2=b2, wd=weight_decay, grad_clip=grad_clip)
+        self.accumulate_steps = accumulate_steps
+        self._step_fn = None
+        self._donate = donate
+
+    # -- state init ----------------------------------------------------------
+    def init_state(self, params) -> TrainState:
+        shard = lambda tree: jax.tree_util.tree_map(
+            lambda v, s: jax.device_put(v, NamedSharding(self.mesh, s)),
+            tree, self.param_specs)
+        params = shard(params)
+        # copy=True: when params are already fp32, astype would alias the
+        # same buffer and double-donation breaks Execute()
+        master = jax.tree_util.tree_map(
+            lambda v: jnp.array(v, dtype=jnp.float32, copy=True), params)
+        master = shard(master)
+        mu = jax.tree_util.tree_map(jnp.zeros_like, master)
+        nu = jax.tree_util.tree_map(jnp.zeros_like, master)
+        step = jnp.zeros((), jnp.int32)
+        return TrainState(params, master, mu, nu, step)
+
+    # -- compiled step -------------------------------------------------------
+    def _build(self):
+        hp = self.hp
+
+        def step_fn(state_tree, lr, *batch):
+            params = state_tree[0]
+
+            def loss_of(p, *b):
+                return self.loss_fn(p, *b)
+
+            if self.accumulate_steps > 1:
+                # micro-batch gradient accumulation via scan over the
+                # leading accumulation axis
+                def micro(carry, mb):
+                    loss, g = jax.value_and_grad(loss_of)(params, *mb)
+                    acc_loss, acc_g = carry
+                    return (acc_loss + loss,
+                            jax.tree_util.tree_map(jnp.add, acc_g, g)), None
+                zero_g = jax.tree_util.tree_map(
+                    lambda v: jnp.zeros(v.shape, jnp.float32), params)
+                (tot_loss, grads), _ = jax.lax.scan(
+                    micro, (jnp.zeros((), jnp.float32), zero_g), batch)
+                n = self.accumulate_steps
+                loss = tot_loss / n
+                grads = jax.tree_util.tree_map(lambda g: g / n, grads)
+            else:
+                loss, grads = jax.value_and_grad(loss_of)(params, *batch)
+            new_state, gnorm = _adamw_update(
+                grads, state_tree, lr, b1=hp["b1"], b2=hp["b2"],
+                eps=1e-8, wd=hp["wd"], grad_clip=hp["grad_clip"])
+            return new_state, {"loss": loss, "grad_norm": gnorm}
+
+        donate = (0,) if self._donate else ()
+        self._step_fn = jax.jit(step_fn, donate_argnums=donate)
+
+    def step(self, state: TrainState, *batch) -> Tuple[TrainState, Dict]:
+        if self._step_fn is None:
+            self._build()
+        batch = tuple(
+            jax.device_put(b, NamedSharding(self.mesh, self.data_spec))
+            if hasattr(b, "ndim") and b.ndim >= 2 else b for b in batch)
+        with self.mesh:
+            new_tree, metrics = self._step_fn(state.tree(),
+                                              jnp.float32(self.lr), *batch)
+        return TrainState.from_tree(new_tree), metrics

@@ -1,0 +1,4 @@
+"""Model zoo (reference: PaddleNLP-style model families built on the
+framework; in-repo reference models python/paddle/vision/models plus the
+incubate transformer stack)."""
+from . import llama  # noqa: F401
