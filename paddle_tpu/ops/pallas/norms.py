@@ -1,0 +1,103 @@
+"""Fused normalization Pallas kernels.
+
+TPU-native replacement for the reference fused norm CUDA kernels
+(paddle/phi/kernels/fusion/gpu/fused_rms_norm* via
+python/paddle/incubate/nn/functional/fused_rms_norm.py). One VMEM pass:
+load row block, compute the fp32 moment, scale, write — saving the extra
+HBM round-trip XLA sometimes emits for the two-pass formulation.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _rms_fwd_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (x * inv).astype(o_ref.dtype) * w_ref[:]
+
+
+def _rms_rows(x):
+    n = int(np.prod(x.shape[:-1]))
+    return x.reshape(n, x.shape[-1])
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm_pallas(x, weight, epsilon=1e-6):
+    return _rms_fwd(x, weight, epsilon)[0]
+
+
+def _rms_fwd(x, weight, epsilon):
+    orig_shape = x.shape
+    d = x.shape[-1]
+    x2 = _rms_rows(x)
+    n = x2.shape[0]
+    block = min(512, n) if n % min(512, n) == 0 else n
+    out = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=epsilon),
+        grid=(pl.cdiv(n, block),),
+        in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        interpret=_interpret(),
+    )(x2, weight)
+    return out.reshape(orig_shape), (x, weight)
+
+
+def _rms_bwd(epsilon, res, g):
+    x, weight = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    wf = weight.astype(jnp.float32)
+    d = x.shape[-1]
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + epsilon)
+    xhat = xf * inv
+    dw = jnp.sum(gf * xhat,
+                 axis=tuple(range(x.ndim - 1))).astype(weight.dtype)
+    gw = gf * wf
+    dx = inv * (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True))
+    return dx.astype(x.dtype), dw
+
+
+rms_norm_pallas.defvjp(lambda x, w, eps: _rms_fwd(x, w, eps), _rms_bwd)
+
+
+# -- fused layer_norm -------------------------------------------------------
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
+    xhat = (x - mean) * jax.lax.rsqrt(var + eps)
+    o_ref[:] = xhat.astype(o_ref.dtype) * w_ref[:] + b_ref[:]
+
+
+def layer_norm_pallas(x, weight, bias, epsilon=1e-5):
+    orig_shape = x.shape
+    d = x.shape[-1]
+    x2 = _rms_rows(x)
+    n = x2.shape[0]
+    block = min(512, n) if n % min(512, n) == 0 else n
+    out = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=epsilon),
+        grid=(pl.cdiv(n, block),),
+        in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,)),
+                  pl.BlockSpec((d,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        interpret=_interpret(),
+    )(x2, weight, bias)
+    return out.reshape(orig_shape)

@@ -1,0 +1,137 @@
+"""Pallas kernel numerics vs XLA references (interpret mode on CPU; the
+same kernels compile to Mosaic on TPU). Gate per SURVEY.md §7 step 5."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.flash_attention import _ref_attention
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_pallas
+from paddle_tpu.ops.pallas.norms import rms_norm_pallas, layer_norm_pallas
+from paddle_tpu.ops import rms_norm_ref, layer_norm_ref
+from paddle_tpu.ops.rope import apply_rope, build_rope_cache
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_fwd_matches_ref(self, causal):
+        rng = np.random.RandomState(0)
+        b, s, h, d = 2, 128, 2, 64
+        q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        o = flash_attention_pallas(q, k, v, causal=causal)
+        ref = _ref_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_bwd_matches_ref(self):
+        rng = np.random.RandomState(1)
+        b, s, h, d = 1, 128, 2, 64
+        q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        k = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        v = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+
+        def f(q, k, v):
+            return jnp.sum(flash_attention_pallas(q, k, v, causal=True) ** 2)
+
+        def g(q, k, v):
+            return jnp.sum(_ref_attention(q, k, v, causal=True) ** 2)
+
+        gp = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=5e-5, rtol=5e-4)
+
+    def test_uneven_seq_multiblock(self):
+        rng = np.random.RandomState(2)
+        b, s, h, d = 1, 1024, 1, 64  # 2 blocks of 512
+        q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+        o = flash_attention_pallas(q, q, q, causal=True)
+        ref = _ref_attention(q, q, q, causal=True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   atol=3e-5, rtol=3e-5)
+
+
+class TestNorms:
+    def test_rms_norm_fwd_bwd(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(4, 64, 128), jnp.float32)
+        w = jnp.asarray(rng.rand(128) + 0.5, jnp.float32)
+        out = rms_norm_pallas(x, w)
+        ref = rms_norm_ref(x, w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+        g1 = jax.grad(lambda x, w: jnp.sum(rms_norm_pallas(x, w) ** 2),
+                      argnums=(0, 1))(x, w)
+        g2 = jax.grad(lambda x, w: jnp.sum(rms_norm_ref(x, w) ** 2),
+                      argnums=(0, 1))(x, w)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_layer_norm(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 128), jnp.float32)
+        w = jnp.asarray(rng.rand(128) + 0.5, jnp.float32)
+        b = jnp.asarray(rng.randn(128), jnp.float32)
+        out = layer_norm_pallas(x, w, b)
+        ref = layer_norm_ref(x, w, b)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 16, 4, 64), jnp.float32)
+        out = apply_rope(x)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(out), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1), rtol=1e-5)
+
+    def test_relative_property(self):
+        """<rope(q,m), rope(k,n)> depends only on m-n."""
+        rng = np.random.RandomState(0)
+        d = 32
+        q = jnp.asarray(rng.randn(1, 1, 1, d), jnp.float32)
+        k = jnp.asarray(rng.randn(1, 1, 1, d), jnp.float32)
+        sin, cos = build_rope_cache(64, d)
+
+        def at(x, pos):
+            return apply_rope(x, sin, cos,
+                              position_ids=jnp.asarray([[pos]]))[0, 0, 0]
+
+        d1 = float(jnp.dot(at(q, 5), at(k, 3)))
+        d2 = float(jnp.dot(at(q, 12), at(k, 10)))
+        assert abs(d1 - d2) < 1e-3
+
+    def test_position_ids_gather(self):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(1, 4, 2, 32), jnp.float32)
+        full = apply_rope(x)
+        pid = apply_rope(x, position_ids=jnp.asarray([[0, 1, 2, 3]]))
+        np.testing.assert_allclose(np.asarray(full), np.asarray(pid),
+                                   atol=1e-6)
+
+
+class TestFusedAdamW:
+    def test_matches_formula(self):
+        from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
+        rng = np.random.RandomState(0)
+        n = 256
+        p = jnp.asarray(rng.randn(n), jnp.float32)
+        g = jnp.asarray(rng.randn(n), jnp.float32)
+        m = jnp.zeros(n, jnp.float32)
+        v = jnp.zeros(n, jnp.float32)
+        p2, m2, v2 = fused_adamw(p, g, m, v, lr=0.1, step=1.0,
+                                 weight_decay=0.01)
+        m_ref = 0.1 * np.asarray(g)
+        v_ref = 0.001 * np.asarray(g) ** 2
+        mhat = m_ref / (1 - 0.9)
+        vhat = v_ref / (1 - 0.999)
+        p_ref = np.asarray(p) * (1 - 0.1 * 0.01) - \
+            0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(np.asarray(p2), p_ref, rtol=1e-5,
+                                   atol=1e-6)
