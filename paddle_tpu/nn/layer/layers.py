@@ -280,8 +280,12 @@ class Layer:
                     raise ValueError(
                         f"shape mismatch for {name}: checkpoint "
                         f"{list(v.shape)} vs layer {list(target.shape)}")
-                target._replace_value(
-                    jax.numpy.asarray(v, dtype=target._value.dtype))
+                new_v = jax.numpy.asarray(v, dtype=target._value.dtype)
+                # preserve the target's device/sharding (TP/PP placement)
+                old = target._value
+                if isinstance(old, jax.Array) and hasattr(old, "sharding"):
+                    new_v = jax.device_put(new_v, old.sharding)
+                target._replace_value(new_v)
                 matched.add(name)
             else:
                 missing.append(name)

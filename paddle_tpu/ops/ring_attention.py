@@ -1,0 +1,132 @@
+"""Ring attention: context parallelism over the sequence axis.
+
+The reference snapshot has NO ring/blockwise CP (verified in SURVEY.md §5 —
+only Megatron-SP and all-to-all SEP). This implements blockwise ring
+attention (Liu et al.) TPU-natively and *exceeds* reference capability for
+>128k contexts:
+
+Inside ``shard_map`` over the ``sp`` axis each shard holds its local
+Q/K/V block. We iterate ``sp`` times: accumulate online-softmax partial
+attention against the resident KV block, then ``lax.ppermute`` the KV pair
+to the next neighbour — the permute rides ICI and overlaps the next
+block's compute under XLA's scheduler.
+
+Also provided: ``ulysses_attention`` — DeepSpeed-Ulysses-style all-to-all
+head redistribution (the reference's `sep` semantics,
+fleet/meta_parallel/segment_parallel.py) as a shard_map wrapper.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_local", "ulysses_attention"]
+
+
+def _block_attend(q, k, v, scale, causal_mask):
+    """Partial logits for one KV block: returns (m, l, o_unnorm)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal_mask is not None:
+        s = jnp.where(causal_mask, s, -1e30)
+    m = jnp.max(s, axis=-1)  # [b,h,q]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return m, l, o
+
+
+def ring_attention_local(q, k, v, axis_name: str = "sp", causal=True,
+                         scale=None):
+    """Per-shard body (call inside shard_map). q,k,v: [b, s_local, h, d]."""
+    b, sl, h, d = q.shape
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    sc = scale if scale is not None else 1.0 / (d ** 0.5)
+    perm = [(i, (i - 1) % n) for i in range(n)]  # kv ring: shift left
+
+    q_pos = my * sl + jnp.arange(sl)
+
+    def step(carry, i):
+        k_blk, v_blk, m_acc, l_acc, o_acc = carry
+        src = (my + i) % n  # which shard's kv we hold at step i
+        if causal:
+            k_pos = src * sl + jnp.arange(sl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            mask = mask[None, None, :, :]
+        else:
+            mask = None
+        m_b, l_b, o_b = _block_attend(q, k_blk, v_blk, sc, mask)
+        m_new = jnp.maximum(m_acc, m_b)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_b - m_new)
+        l_new = alpha * l_acc + beta * l_b
+        o_new = o_acc * jnp.moveaxis(alpha, 1, -1)[..., None] + \
+            o_b * jnp.moveaxis(beta, 1, -1)[..., None]
+        # rotate kv to neighbour (ICI hop), overlapped with next compute
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, m_new, l_new, o_new), None
+
+    m0 = jnp.full((b, h, sl), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, sl), jnp.float32)
+    o0 = jnp.zeros((b, sl, h, d), jnp.float32)
+    (k_f, v_f, m_f, l_f, o_f), _ = jax.lax.scan(
+        step, (k, v, m0, l0, o0), jnp.arange(n))
+    l_safe = jnp.where(l_f == 0.0, 1.0, l_f)
+    out = o_f / jnp.moveaxis(l_safe, 1, -1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp", causal=True,
+                   scale=None):
+    """Global entry: q,k,v [b, s, h, d] sharded (or shardable) on seq.
+    Runs the ring under shard_map over ``axis_name``."""
+    from jax import shard_map
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                      causal=True, scale=None):
+    """All-to-all head redistribution (reference `sep` semantics): seq-
+    sharded → head-sharded via all_to_all, full-sequence attention per
+    head group, then back."""
+    from jax import shard_map
+
+    def local(q, k, v):
+        # [b, s_local, h, d] -> a2a -> [b, s, h_local, d]
+        n = jax.lax.axis_size(axis_name)
+
+        def a2a_fwd(x):
+            b, sl, h, d = x.shape
+            x = x.reshape(b, sl, n, h // n, d)
+            x = jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                   concat_axis=1, tiled=False)
+            return x.reshape(b, sl * n, h // n, d)
+
+        def a2a_bwd(x):
+            b, s, hl, d = x.shape
+            x = x.reshape(b, n, s // n, hl, d)
+            x = jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                   concat_axis=3, tiled=False)
+            return x.reshape(b, s // n, hl * n, d)
+
+        qg, kg, vg = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
+        from .flash_attention import _ref_attention
+        og = _ref_attention(qg, kg, vg, causal=causal, scale=scale)
+        return a2a_bwd(og)
+
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_rep=False)
+    return fn(q, k, v)

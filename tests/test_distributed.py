@@ -109,7 +109,7 @@ class TestCollectivesInShardMap:
         return Mesh(np.array(jax.devices()[:8]), axis_names=("dp",))
 
     def test_all_reduce_psum(self):
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         mesh = self._mesh()
         x = jnp.arange(8.0)
 
@@ -123,7 +123,7 @@ class TestCollectivesInShardMap:
         np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
 
     def test_all_gather(self):
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         mesh = self._mesh()
         x = jnp.arange(8.0)
 
@@ -137,7 +137,7 @@ class TestCollectivesInShardMap:
         assert out.shape == (64,)
 
     def test_reduce_scatter(self):
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
         mesh = self._mesh()
         x = jnp.ones((64,))
 
