@@ -92,7 +92,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp", causal=True,
         functools.partial(ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_rep=False)
+        check_vma=False)
     return fn(q, k, v)
 
 
@@ -128,5 +128,5 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
 
     spec = P(None, axis_name, None, None)
     fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_rep=False)
+                   out_specs=spec, check_vma=False)
     return fn(q, k, v)
