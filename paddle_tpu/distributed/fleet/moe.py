@@ -47,12 +47,16 @@ def _gate_logits_to_dispatch(logits, top_k, capacity, key=None):
 
     dispatch_t = jnp.zeros((T, E, capacity), jnp.float32)
     combine_t = jnp.zeros((T, E, capacity), jnp.float32)
+    # per-expert queue offsets: choice k's positions start after every
+    # token enqueued by choices < k, so a top-1 and a top-2 assignment to
+    # the same expert never share a capacity slot (gshard semantics)
+    counts = jnp.zeros((E,), jnp.int32)
     for k in range(top_k):
         e_k = experts[:, k]  # [T]
         onehot = jax.nn.one_hot(e_k, E, dtype=jnp.int32)  # [T, E]
         # position of each token within its expert's queue
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1  # [T, E]
-        pos_t = jnp.sum(pos * onehot, axis=-1)  # [T]
+        pos = (jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]) * onehot
+        pos_t = jnp.sum(pos, axis=-1)  # [T]
         keep = pos_t < capacity
         pos_c = jnp.clip(pos_t, 0, capacity - 1)
         oh_cap = jax.nn.one_hot(pos_c, capacity, dtype=jnp.float32)
@@ -60,6 +64,7 @@ def _gate_logits_to_dispatch(logits, top_k, capacity, key=None):
                    oh_cap[:, None, :]) * keep[:, None, None]
         dispatch_t = dispatch_t + contrib
         combine_t = combine_t + contrib * gates[:, k][:, None, None]
+        counts = counts + jnp.sum(onehot, axis=0)
     return dispatch_t, combine_t, aux
 
 
@@ -110,6 +115,12 @@ class MoELayer(Layer):
                  capacity_factor=1.25, ep_axis="mp", activation=jax.nn.silu,
                  group=None, recompute_interval=0):
         super().__init__()
+        if recompute_interval:
+            import warnings
+            warnings.warn(
+                "MoELayer recompute_interval is not implemented on the TPU "
+                "path (XLA rematerializes under jit); running without "
+                "recompute", stacklevel=2)
         self.num_experts = num_experts
         gate_cls = {"gshard": GShardGate, "switch": SwitchGate}[gate] \
             if isinstance(gate, str) else gate

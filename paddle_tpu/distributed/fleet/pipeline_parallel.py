@@ -23,6 +23,7 @@ independent XLA programs on disjoint devices and run concurrently.
 from __future__ import annotations
 
 import re
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -83,7 +84,16 @@ def _segment_by_layer(descs, num_parts, layername) -> List[int]:
                      pat.search(d.layer_class.__name__)) or
                (isinstance(d, Layer) and pat.search(type(d).__name__))
                else 0 for d in descs]
-    total = sum(weights) or len(descs)
+    if sum(weights) == 0:
+        if num_parts == 1:
+            return [0, len(descs)]  # single stage holds everything anyway
+        names = [type(d).__name__ if isinstance(d, Layer) else
+                 getattr(getattr(d, "layer_class", None), "__name__", str(d))
+                 for d in descs]
+        raise ValueError(
+            f"seg_method 'layer:{layername}' matched no layer class names "
+            f"in {names}; refusing to place the whole model on stage 0")
+    total = sum(weights)
     per = total / num_parts
     bounds = [0]
     acc = 0
@@ -99,6 +109,25 @@ def _segment_by_layer(descs, num_parts, layername) -> List[int]:
     return bounds
 
 
+def _restrict_sharding(value, sub_mesh):
+    """Map ``value``'s sharding onto a pp-stage submesh: keep the spec
+    entries whose axes (mp/dp/sep/...) exist there, replicate otherwise."""
+    def restrict(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in sub_mesh.shape)
+            return kept if kept else None
+        return entry if entry in sub_mesh.shape else None
+
+    cur = getattr(value, "sharding", None)
+    if isinstance(cur, NamedSharding):
+        spec = P(*[restrict(e) for e in cur.spec])
+    else:
+        spec = P()
+    return NamedSharding(sub_mesh, spec)
+
+
 class PipelineLayer(Layer):
     """reference: pp_layers.py:258. Owns all stages (single controller);
     ``forward`` runs stages in order with inter-stage transfers."""
@@ -107,6 +136,16 @@ class PipelineLayer(Layer):
                  loss_fn=None, seg_method="uniform", num_virtual_pipeline_stages=None,
                  recompute_interval=0, **kwargs):
         super().__init__()
+        if num_virtual_pipeline_stages not in (None, 1):
+            warnings.warn(
+                "num_virtual_pipeline_stages (interleaved/VPP schedule) is "
+                "not implemented on the TPU path; falling back to plain "
+                "1F1B", stacklevel=2)
+        if recompute_interval:
+            warnings.warn(
+                "PipelineLayer recompute_interval is not implemented on "
+                "the TPU path (XLA rematerializes under jit); running "
+                "without recompute", stacklevel=2)
         self._loss_fn = loss_fn
         self._topo = topology
         if num_stages is None and topology is not None:
@@ -132,6 +171,10 @@ class PipelineLayer(Layer):
                 isinstance(l, Layer)]
         self._all = LayerList(flat)
         self.run_function = [l for st in self._stage_layers for l in st]
+        # stage layout is fixed at construction: build each stage's submesh
+        # once, not per micro-batch on the 1F1B hot path
+        self._submeshes = [self._stage_submesh(s)
+                           for s in range(self._num_stages)]
         self._place_stages()
 
     def _build(self, d):
@@ -146,8 +189,7 @@ class PipelineLayer(Layer):
             return d.build_layer()
         return d  # already a Layer or callable
 
-    def _stage_devices(self, s):
-        """Devices of pp-stage s (all other axes flattened)."""
+    def _hybrid_mesh(self):
         hcg_mesh = getattr(self._topo, "mesh", None)
         if hcg_mesh is None:
             from ..topology import get_hybrid_communicate_group
@@ -155,22 +197,33 @@ class PipelineLayer(Layer):
             if hcg is None:
                 return None
             hcg_mesh = hcg.mesh
-        if "pp" not in hcg_mesh.shape or hcg_mesh.shape["pp"] < 2:
+        return hcg_mesh
+
+    def _stage_submesh(self, s):
+        """Mesh over stage s's devices, keeping the non-pp axes (pp is
+        axis 0 of the hybrid mesh — topology.py builds
+        [pp, dp, sharding, sep, mp])."""
+        hcg_mesh = self._hybrid_mesh()
+        if hcg_mesh is None or "pp" not in hcg_mesh.shape or \
+                hcg_mesh.shape["pp"] < 2:
             return None
-        return hcg_mesh.devices[s % hcg_mesh.shape["pp"]].reshape(-1)
+        from jax.sharding import Mesh
+        names = tuple(n for n in hcg_mesh.axis_names if n != "pp")
+        return Mesh(hcg_mesh.devices[s % hcg_mesh.shape["pp"]], names)
 
     def _place_stages(self):
         with no_grad():
             for s, stage in enumerate(self._stage_layers):
-                devs = self._stage_devices(s)
-                if devs is None:
+                sub = self._submeshes[s]
+                if sub is None:
                     continue
-                dev = devs[0] if len(devs) == 1 else devs[0]
                 for l in stage:
                     if not isinstance(l, Layer):
                         continue
                     for p in l.parameters():
-                        p._replace_value(jax.device_put(to_value(p), dev))
+                        v = to_value(p)
+                        p._replace_value(jax.device_put(
+                            v, _restrict_sharding(v, sub)))
                         p._pp_meta = s
 
     def stage_of(self, layer_index: int) -> int:
@@ -186,13 +239,15 @@ class PipelineLayer(Layer):
     def forward(self, x):
         from ...core.tensor import dispatch as _dispatch
         for s, stage in enumerate(self._stage_layers):
-            devs = self._stage_devices(s)
-            if devs is not None and isinstance(x, Tensor) and s > 0:
+            sub = self._submeshes[s]
+            if sub is not None and isinstance(x, Tensor) and s > 0:
                 # p2p send/recv: a differentiable device transfer — the
                 # cotangent rides the reverse hop in backward (the
-                # reference's recv_backward, p2p_communication.py)
-                dev = devs[0]
-                x = _dispatch(lambda v: jax.device_put(v, dev), (x,),
+                # reference's recv_backward, p2p_communication.py).
+                # The activation keeps its dp/mp/sep sharding across the
+                # hop; only the pp placement changes.
+                sh = _restrict_sharding(to_value(x), sub)
+                x = _dispatch(lambda v: jax.device_put(v, sh), (x,),
                               name="pp_send_recv")
             for l in stage:
                 x = l(x)

@@ -51,7 +51,7 @@ def ring_attention_local(q, k, v, axis_name: str = "sp", causal=True,
 
     q_pos = my * sl + jnp.arange(sl)
 
-    def step(carry, i):
+    def attend(carry, i):
         k_blk, v_blk, m_acc, l_acc, o_acc = carry
         src = (my + i) % n  # which shard's kv we hold at step i
         if causal:
@@ -67,6 +67,10 @@ def ring_attention_local(q, k, v, axis_name: str = "sp", causal=True,
         l_new = alpha * l_acc + beta * l_b
         o_new = o_acc * jnp.moveaxis(alpha, 1, -1)[..., None] + \
             o_b * jnp.moveaxis(beta, 1, -1)[..., None]
+        return k_blk, v_blk, m_new, l_new, o_new
+
+    def step(carry, i):
+        k_blk, v_blk, m_new, l_new, o_new = attend(carry, i)
         # rotate kv to neighbour (ICI hop), overlapped with next compute
         k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
@@ -75,8 +79,11 @@ def ring_attention_local(q, k, v, axis_name: str = "sp", causal=True,
     m0 = jnp.full((b, h, sl), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, sl), jnp.float32)
     o0 = jnp.zeros((b, sl, h, d), jnp.float32)
-    (k_f, v_f, m_f, l_f, o_f), _ = jax.lax.scan(
-        step, (k, v, m0, l0, o0), jnp.arange(n))
+    # n-1 rotations suffice: the last block attends without passing KV on
+    # (the n-th ppermute would be a pure wasted ICI hop — collectives are
+    # not dead-code-eliminated inside scan)
+    carry, _ = jax.lax.scan(step, (k, v, m0, l0, o0), jnp.arange(n - 1))
+    _, _, m_f, l_f, o_f = attend(carry, n - 1)
     l_safe = jnp.where(l_f == 0.0, 1.0, l_f)
     out = o_f / jnp.moveaxis(l_safe, 1, -1)[..., None]
     return out.astype(q.dtype)
@@ -122,8 +129,10 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
             return x.reshape(b, s // n, hl * n, d)
 
         qg, kg, vg = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
-        from .flash_attention import _ref_attention
-        og = _ref_attention(qg, kg, vg, causal=causal, scale=scale)
+        # public entry: pallas flash kernel on TPU (O(s) memory over the
+        # full global sequence), jnp reference fallback elsewhere
+        from .flash_attention import flash_attention
+        og = flash_attention(qg, kg, vg, causal=causal, scale=scale)
         return a2a_bwd(og)
 
     spec = P(None, axis_name, None, None)
