@@ -399,8 +399,33 @@ def _maybe_check_nan(name, values):
             logging.getLogger("paddle_tpu").warning(msg)
 
 
+# Set by the profiler's host tracer (paddle_tpu/profiler): when non-None,
+# every eager dispatch records an Operator event (reference: RecordEvent
+# emitted inside generated ad_funcs, eager_gen.py).
+_OP_TRACER = [None]
+
+
+def set_op_tracer(tracer):
+    _OP_TRACER[0] = tracer
+
+
 def dispatch(fn, tensor_args: Sequence[Any], name: str = "op",
              multi_output: bool = False, **static_kwargs):
+    tracer = _OP_TRACER[0]
+    if tracer is None:
+        return _dispatch_impl(fn, tensor_args, name, multi_output,
+                              **static_kwargs)
+    import time as _time
+    t0 = _time.perf_counter_ns()
+    try:
+        return _dispatch_impl(fn, tensor_args, name, multi_output,
+                              **static_kwargs)
+    finally:
+        tracer.add_event(name, t0, _time.perf_counter_ns())
+
+
+def _dispatch_impl(fn, tensor_args: Sequence[Any], name: str = "op",
+                   multi_output: bool = False, **static_kwargs):
     """Eager op dispatch: the TPU-native analog of the generated
     ``xxx_ad_func`` + PHI dispatch chain (reference call stack SURVEY §3.1).
 

@@ -1,0 +1,14 @@
+"""paddle_tpu.profiler — unified profiler (reference:
+python/paddle/profiler/). Host tracer + XLA/TPU XPlane device traces."""
+from .profiler import (Profiler, ProfilerState, ProfilerTarget,
+                       make_scheduler, export_chrome_tracing, export_protobuf)
+from .record_event import (RecordEvent, TracerEventType, load_profiler_result,
+                           get_host_tracer)
+from .timer import benchmark, Benchmark
+from .statistics import build_summary, event_type_summary
+
+__all__ = [
+    "Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
+    "export_chrome_tracing", "export_protobuf", "RecordEvent",
+    "TracerEventType", "load_profiler_result", "benchmark", "Benchmark",
+]
