@@ -27,6 +27,11 @@ from .auto_parallel import (ProcessMesh, Shard, Replicate, Partial,  # noqa
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from .store import TCPStore, TCPStoreServer  # noqa: F401
+from .flight_recorder import (enable_flight_recorder,  # noqa: F401
+                              disable_flight_recorder,
+                              get_flight_recorder)
+from . import launch  # noqa: F401
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",

@@ -298,3 +298,68 @@ class stream:
     scatter = staticmethod(scatter)
     send = staticmethod(send)
     recv = staticmethod(recv)
+
+
+# -- flight recorder instrumentation ------------------------------------------
+# Every collective entering through this module is logged to the flight
+# recorder ring buffer when enabled (reference: comm_task_manager.cc records
+# each NCCL task for hang diagnosis; see flight_recorder.py).
+_fr_depth = __import__("threading").local()
+
+
+def _instrument(fn):
+    import functools
+    import inspect
+
+    sig = inspect.signature(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        from .flight_recorder import get_flight_recorder
+        rec = get_flight_recorder()
+        # re-entrancy guard: collectives implemented atop other collectives
+        # (e.g. reduce -> all_reduce) record one logical entry
+        if not rec.enabled or getattr(_fr_depth, "n", 0) > 0:
+            return fn(*args, **kwargs)
+        try:
+            bound = sig.bind(*args, **kwargs).arguments
+        except TypeError:
+            bound = dict(kwargs)
+        group = bound.get("group")
+        try:
+            ax = _axis(group) if group is not None else None
+        except Exception:
+            ax = None
+        # first tensor-valued argument is the payload (skips tensor_list
+        # outputs, int ranks, ReduceOp strings)
+        v = None
+        for val in bound.values():
+            cand = val._value if isinstance(val, Tensor) else val
+            if hasattr(cand, "shape") and hasattr(cand, "dtype"):
+                v = cand
+                break
+        task = rec.begin(fn.__name__, ax, getattr(v, "shape", ()) or (),
+                         getattr(v, "dtype", ""))
+        _fr_depth.n = 1
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _fr_depth.n = 0
+            rec.end(task)
+    return wrapper
+
+
+for _n in ("all_reduce", "all_gather", "reduce", "reduce_scatter",
+           "broadcast", "scatter", "all_to_all", "alltoall_single",
+           "send", "recv", "barrier"):
+    if _n in globals():
+        globals()[_n] = _instrument(globals()[_n])
+alltoall = all_to_all  # keep the alias on the instrumented version
+del _n
+
+
+# rebind stream.* to the instrumented versions
+for _n in ("all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "all_to_all", "scatter", "send", "recv"):
+    setattr(stream, _n, staticmethod(globals()[_n]))
+del _n

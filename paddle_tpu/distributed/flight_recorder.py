@@ -1,0 +1,147 @@
+"""Collective flight recorder + hang watchdog.
+
+TPU-native analog of the reference NCCL flight recorder
+(paddle/phi/core/distributed/comm_task_manager.cc + nccl_comm_task.cc):
+records every collective issued through paddle_tpu.distributed with a
+sequence number, op name, group axis and tensor shape in a bounded ring
+buffer; a watchdog thread dumps still-pending entries when one exceeds the
+timeout — the classic tool for diagnosing desynced ranks (rank A entered
+allreduce #1234, rank B never did).
+
+On TPU the collectives execute inside XLA programs, so "pending" means the
+host-side dispatch has not returned/blocked-until-ready; a stuck XLA
+collective (ICI/DCN partner missing) shows up exactly there.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, asdict
+from typing import Optional
+
+
+@dataclass
+class CommTask:
+    seq: int
+    op: str
+    axis: Optional[str]
+    shape: tuple
+    dtype: str
+    start_ts: float
+    end_ts: Optional[float] = None
+
+    @property
+    def pending(self) -> bool:
+        return self.end_ts is None
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 1024,
+                 timeout: float = 600.0,
+                 dump_path: Optional[str] = None):
+        self.capacity = capacity
+        self.timeout = timeout
+        self.dump_path = dump_path
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.enabled = False
+        self._watchdog: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._dumped = False
+
+    # -- recording ----------------------------------------------------------
+    def begin(self, op: str, axis, shape, dtype) -> Optional[CommTask]:
+        if not self.enabled:
+            return None
+        with self._lock:
+            self._seq += 1
+            task = CommTask(self._seq, op, axis, tuple(shape), str(dtype),
+                            time.time())
+            self._ring.append(task)
+        return task
+
+    def end(self, task: Optional[CommTask]):
+        if task is not None:
+            task.end_ts = time.time()
+
+    # -- watchdog -----------------------------------------------------------
+    def start_watchdog(self):
+        if self._watchdog is not None:
+            return
+        self._stop_evt.clear()
+        self._watchdog = threading.Thread(target=self._watch, daemon=True)
+        self._watchdog.start()
+
+    def stop_watchdog(self):
+        self._stop_evt.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
+            self._watchdog = None
+
+    def _watch(self):
+        while not self._stop_evt.wait(min(self.timeout / 4, 5.0)):
+            now = time.time()
+            with self._lock:
+                stuck = [t for t in self._ring
+                         if t.pending and now - t.start_ts > self.timeout]
+            if stuck and not self._dumped:
+                self.dump(reason=f"collective pending > {self.timeout}s")
+                self._dumped = True
+
+    # -- dump ---------------------------------------------------------------
+    def dump(self, reason: str = "manual") -> str:
+        with self._lock:
+            entries = [asdict(t) for t in self._ring]
+        report = {
+            "reason": reason,
+            "pid": os.getpid(),
+            "rank": os.environ.get("PADDLE_TRAINER_ID", "0"),
+            "time": time.time(),
+            "entries": entries,
+        }
+        text = json.dumps(report, indent=1)
+        path = self.dump_path
+        if path:
+            with open(path, "w") as f:
+                f.write(text)
+        else:
+            sys.stderr.write(f"[flight-recorder] {reason}\n{text}\n")
+        return text
+
+    def tasks(self):
+        with self._lock:
+            return list(self._ring)
+
+
+_RECORDER = FlightRecorder()
+
+
+def get_flight_recorder() -> FlightRecorder:
+    return _RECORDER
+
+
+def enable_flight_recorder(timeout: float = 600.0,
+                           dump_path: Optional[str] = None,
+                           capacity: int = 1024):
+    """Turn on collective recording + the hang watchdog.
+
+    reference: FLAGS_enable_async_trace / comm_task_manager enablement.
+    """
+    _RECORDER.timeout = timeout
+    _RECORDER.dump_path = dump_path
+    _RECORDER._ring = deque(maxlen=capacity)
+    _RECORDER.capacity = capacity
+    _RECORDER.enabled = True
+    _RECORDER._dumped = False
+    _RECORDER.start_watchdog()
+    return _RECORDER
+
+
+def disable_flight_recorder():
+    _RECORDER.enabled = False
+    _RECORDER.stop_watchdog()

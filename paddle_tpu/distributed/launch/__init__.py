@@ -1,0 +1,4 @@
+from .main import launch, build_parser
+from .controller import Controller, JobSpec
+
+__all__ = ["launch", "build_parser", "Controller", "JobSpec"]

@@ -1,0 +1,220 @@
+"""Process controller for the launcher.
+
+TPU-native analog of the reference collective controller
+(python/paddle/distributed/launch/controllers/collective.py + master.py):
+node 0 runs the TCPStore master; every node registers, gets its rank
+assignment, spawns local trainer processes with the env contract, and
+watches them. Elastic restart (reference: fleet/elastic/manager.py:126
+ElasticManager) is a bounded relaunch loop with heartbeat-based peer
+failure detection through the store.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..store import TCPStore, TCPStoreServer
+
+HEARTBEAT_INTERVAL = 5.0
+HEARTBEAT_STALE = 30.0
+
+
+@dataclass
+class JobSpec:
+    script: str
+    script_args: List[str] = field(default_factory=list)
+    nproc_per_node: int = 1
+    nnodes: int = 1
+    node_rank: int = 0
+    master: Optional[str] = None          # "host:port" (None → run server)
+    log_dir: str = "log"
+    elastic_retries: int = 0
+    module: bool = False                  # python -m script
+
+
+class ProcContext:
+    def __init__(self, rank: int, local_rank: int, proc: subprocess.Popen,
+                 log_path: str, log_file=None):
+        self.rank = rank
+        self.local_rank = local_rank
+        self.proc = proc
+        self.log_path = log_path
+        self.log_file = log_file
+
+    def close_log(self):
+        if self.log_file is not None:
+            try:
+                self.log_file.close()
+            except OSError:
+                pass
+            self.log_file = None
+
+
+class Controller:
+    def __init__(self, spec: JobSpec):
+        self.spec = spec
+        self.server: Optional[TCPStoreServer] = None
+        self.store: Optional[TCPStore] = None
+        self.procs: List[ProcContext] = []
+        self._job_id = [0]
+
+    # -- rendezvous ---------------------------------------------------------
+    def _setup_master(self):
+        spec = self.spec
+        if spec.master is None or spec.node_rank == 0:
+            host, port = "127.0.0.1", 0
+            if spec.master:
+                host, p = spec.master.split(":")
+                port = int(p)
+            self.server = TCPStoreServer(port=port)
+            master_host = host if host != "0.0.0.0" else "127.0.0.1"
+            self.master_addr = f"{master_host}:{self.server.port}"
+        else:
+            self.master_addr = spec.master
+        host, port = self.master_addr.rsplit(":", 1)
+        self.store = TCPStore(host, int(port))
+        # register node, barrier until all nodes present
+        self.store.set(f"node/{spec.node_rank}",
+                       f"{spec.nproc_per_node}")
+        if spec.nnodes > 1:
+            self.store.barrier("launch_nodes", spec.nnodes, timeout=300.0)
+
+    # -- spawn --------------------------------------------------------------
+    def _build_env(self, rank: int, local_rank: int) -> Dict[str, str]:
+        spec = self.spec
+        world = spec.nnodes * spec.nproc_per_node
+        env = dict(os.environ)
+        # env contract mirrors the reference launcher's
+        # (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER,
+        # launch/controllers/collective.py)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_MASTER": self.master_addr,
+            "MASTER_ADDR": self.master_addr.rsplit(":", 1)[0],
+            "MASTER_PORT": self.master_addr.rsplit(":", 1)[1],
+            "PADDLE_JOB_ID": str(self._job_id[0]),
+        })
+        return env
+
+    def _spawn_all(self):
+        spec = self.spec
+        os.makedirs(spec.log_dir, exist_ok=True)
+        self.procs = []
+        for local_rank in range(spec.nproc_per_node):
+            rank = spec.node_rank * spec.nproc_per_node + local_rank
+            log_path = os.path.join(spec.log_dir,
+                                    f"workerlog.{rank}")
+            cmd = [sys.executable]
+            if spec.module:
+                cmd += ["-m", spec.script]
+            else:
+                cmd += [spec.script]
+            cmd += spec.script_args
+            logf = open(log_path, "ab")
+            proc = subprocess.Popen(
+                cmd, env=self._build_env(rank, local_rank),
+                stdout=logf, stderr=subprocess.STDOUT)
+            self.procs.append(ProcContext(rank, local_rank, proc, log_path,
+                                          logf))
+
+    def _kill_all(self):
+        for pc in self.procs:
+            if pc.proc.poll() is None:
+                pc.proc.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for pc in self.procs:
+            if pc.proc.poll() is None:
+                try:
+                    pc.proc.wait(max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    pc.proc.kill()
+            pc.close_log()
+
+    # -- watch / elastic ----------------------------------------------------
+    def _heartbeat(self):
+        self.store.set(f"heartbeat/{self.spec.node_rank}",
+                       str(time.time()))
+
+    def _peer_failure(self) -> Optional[int]:
+        """Heartbeat staleness check over the store (reference: elastic
+        manager's etcd watch). Returns a failed node rank or None."""
+        if self.spec.nnodes <= 1:
+            return None
+        now = time.time()
+        for node in range(self.spec.nnodes):
+            if node == self.spec.node_rank:
+                continue
+            val = self.store.get(f"heartbeat/{node}")
+            if val is not None and now - float(val) > HEARTBEAT_STALE:
+                return node
+        return None
+
+    def watch(self) -> int:
+        """Run until all local procs exit. Returns exit code. On a local
+        proc failure (or stale peer heartbeat) kills the pod; with
+        elastic_retries left, respawns with a new job id."""
+        retries = self.spec.elastic_retries
+        while True:
+            code = self._watch_once()
+            if code == 0:
+                return 0
+            if retries <= 0:
+                return code
+            retries -= 1
+            self._job_id[0] += 1
+            sys.stderr.write(
+                f"[launch] pod failed (exit {code}); elastic restart "
+                f"{self._job_id[0]} ({retries} retries left)\n")
+            self._kill_all()
+            self._spawn_all()
+
+    def _watch_once(self) -> int:
+        last_hb = 0.0
+        last_peer_check = time.time()
+        while True:
+            now = time.time()
+            if now - last_hb > HEARTBEAT_INTERVAL:
+                self._heartbeat()
+                last_hb = now
+            codes = [pc.proc.poll() for pc in self.procs]
+            if any(c is not None and c != 0 for c in codes):
+                bad = next(pc for pc, c in zip(self.procs, codes)
+                           if c is not None and c != 0)
+                sys.stderr.write(
+                    f"[launch] rank {bad.rank} exited with "
+                    f"{bad.proc.returncode}; see {bad.log_path}\n")
+                self._kill_all()
+                return bad.proc.returncode or 1
+            if all(c == 0 for c in codes):
+                return 0
+            if now - last_peer_check < HEARTBEAT_INTERVAL:
+                time.sleep(0.2)
+                continue
+            last_peer_check = now
+            peer = self._peer_failure()
+            if peer is not None:
+                sys.stderr.write(f"[launch] node {peer} heartbeat stale; "
+                                 f"tearing down local pod\n")
+                self._kill_all()
+                return 1
+            time.sleep(0.2)
+
+    # -- entry --------------------------------------------------------------
+    def run(self) -> int:
+        self._setup_master()
+        self._spawn_all()
+        try:
+            return self.watch()
+        finally:
+            self._kill_all()
+            if self.store:
+                self.store.close()
+            if self.server:
+                self.server.close()

@@ -1,0 +1,52 @@
+"""``python -m paddle_tpu.distributed.launch`` CLI.
+
+reference: python/paddle/distributed/launch/main.py:23 — spawns trainer
+processes per node, sets the env contract, watches and (optionally
+elastically) restarts them. On TPU each process typically owns a host's
+chips; intra-host parallelism is device-level via the mesh, so
+``--nproc_per_node`` defaults to 1 (vs per-GPU procs in the reference).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from .controller import Controller, JobSpec
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch distributed paddle_tpu training.")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of nodes (hosts)")
+    p.add_argument("--node_rank", type=int, default=0,
+                   help="rank of this node")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="trainer processes per node")
+    p.add_argument("--master", type=str, default=None,
+                   help="master endpoint host:port (node 0 serves it)")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--elastic_retries", type=int, default=0,
+                   help="max elastic pod restarts on failure")
+    p.add_argument("--module", "-m", action="store_true",
+                   help="run script as a python module")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def launch(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    spec = JobSpec(script=args.script, script_args=args.script_args,
+                   nproc_per_node=args.nproc_per_node, nnodes=args.nnodes,
+                   node_rank=args.node_rank, master=args.master,
+                   log_dir=args.log_dir,
+                   elastic_retries=args.elastic_retries,
+                   module=args.module)
+    return Controller(spec).run()
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

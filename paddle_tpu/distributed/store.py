@@ -1,0 +1,222 @@
+"""TCPStore: socket key-value rendezvous with wait/barrier.
+
+TPU-native analog of the reference store
+(paddle/phi/core/distributed/store/tcp_store.h:121, tcp_utils.cc): the
+launcher master runs the server; workers use it for bootstrap metadata,
+heartbeats and barriers. The JAX coordination service handles PjRt-level
+rendezvous; this store covers the *launcher/elastic* control plane the
+reference uses TCPStore/etcd for.
+
+Wire protocol (newline-free, length-prefixed): one request per
+connection-message:  u32 len | verb(3) | u16 klen | key | payload.
+Verbs: SET, GET, ADD, DEL, WAI (wait-for-key), BAR (barrier), LST (list
+keys with prefix). Kept dead simple so the C++ implementation
+(csrc/tcp_store.cc) can speak it identically.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def _pack(verb: bytes, key: bytes, payload: bytes = b"") -> bytes:
+    body = verb + struct.pack("!H", len(key)) + key + payload
+    return struct.pack("!I", len(body)) + body
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("store connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket):
+    (ln,) = struct.unpack("!I", _recv_exact(sock, 4))
+    body = _recv_exact(sock, ln)
+    verb = body[:3]
+    (klen,) = struct.unpack("!H", body[3:5])
+    key = body[5:5 + klen]
+    payload = body[5 + klen:]
+    return verb, key, payload
+
+
+class TCPStoreServer:
+    """Master-side store. Runs a thread per connection; in-memory dict."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._kv: Dict[bytes, bytes] = {}
+        self._cv = threading.Condition()
+        self._barrier_count: Dict[bytes, int] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn: socket.socket):
+        try:
+            while True:
+                verb, key, payload = _recv_msg(conn)
+                if verb == b"SET":
+                    with self._cv:
+                        self._kv[key] = payload
+                        self._cv.notify_all()
+                    conn.sendall(_pack(b"OK_", b""))
+                elif verb == b"GET":
+                    with self._cv:
+                        val = self._kv.get(key)
+                    if val is None:
+                        conn.sendall(_pack(b"NO_", b""))
+                    else:
+                        conn.sendall(_pack(b"OK_", b"", val))
+                elif verb == b"ADD":
+                    delta = struct.unpack("!q", payload)[0]
+                    with self._cv:
+                        cur = int(self._kv.get(key, b"0"))
+                        cur += delta
+                        self._kv[key] = str(cur).encode()
+                        self._cv.notify_all()
+                    conn.sendall(_pack(b"OK_", b"",
+                                       struct.pack("!q", cur)))
+                elif verb == b"DEL":
+                    with self._cv:
+                        self._kv.pop(key, None)
+                        self._cv.notify_all()
+                    conn.sendall(_pack(b"OK_", b""))
+                elif verb == b"WAI":
+                    timeout = struct.unpack("!d", payload)[0]
+                    deadline = time.time() + timeout
+                    ok = True
+                    with self._cv:
+                        while key not in self._kv:
+                            remaining = deadline - time.time()
+                            if remaining <= 0 or not self._cv.wait(
+                                    min(remaining, 1.0)):
+                                if time.time() >= deadline:
+                                    ok = False
+                                    break
+                    conn.sendall(_pack(b"OK_" if ok else b"TMO", b""))
+                elif verb == b"BAR":
+                    world, timeout = struct.unpack("!id", payload)
+                    with self._cv:
+                        self._barrier_count[key] = \
+                            self._barrier_count.get(key, 0) + 1
+                        target = ((self._barrier_count[key] + world - 1)
+                                  // world) * world
+                        deadline = time.time() + timeout
+                        ok = True
+                        while self._barrier_count[key] < target:
+                            remaining = deadline - time.time()
+                            if remaining <= 0:
+                                ok = False
+                                break
+                            self._cv.wait(min(remaining, 1.0))
+                        self._cv.notify_all()
+                    conn.sendall(_pack(b"OK_" if ok else b"TMO", b""))
+                elif verb == b"LST":
+                    with self._cv:
+                        keys = [k for k in self._kv if k.startswith(key)]
+                    conn.sendall(_pack(b"OK_", b"", b"\x00".join(keys)))
+                else:
+                    conn.sendall(_pack(b"ERR", b""))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def close(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TCPStore:
+    """Client. reference: tcp_store.h TCPStore::{set,get,add,wait,barrier}."""
+
+    def __init__(self, host: str, port: int, timeout: float = 60.0,
+                 retries: int = 60):
+        self.host, self.port, self.timeout = host, port, timeout
+        last = None
+        for _ in range(retries):
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=timeout)
+                break
+            except OSError as e:
+                last = e
+                time.sleep(0.5)
+        else:
+            raise ConnectionError(
+                f"cannot reach store at {host}:{port}: {last}")
+        self._lock = threading.Lock()
+
+    def _rpc(self, verb: bytes, key: str, payload: bytes = b""):
+        with self._lock:
+            self._sock.sendall(_pack(verb, key.encode(), payload))
+            old = self._sock.gettimeout()
+            try:
+                self._sock.settimeout(None)
+                return _recv_msg(self._sock)
+            finally:
+                self._sock.settimeout(old)
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        self._rpc(b"SET", key, value)
+
+    def get(self, key: str) -> Optional[bytes]:
+        verb, _, payload = self._rpc(b"GET", key)
+        return payload if verb == b"OK_" else None
+
+    def add(self, key: str, delta: int = 1) -> int:
+        _, _, payload = self._rpc(b"ADD", key, struct.pack("!q", delta))
+        return struct.unpack("!q", payload)[0]
+
+    def delete(self, key: str) -> None:
+        self._rpc(b"DEL", key)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> None:
+        t = timeout if timeout is not None else self.timeout
+        verb, _, _ = self._rpc(b"WAI", key, struct.pack("!d", t))
+        if verb != b"OK_":
+            raise TimeoutError(f"wait for key '{key}' timed out after {t}s")
+
+    def barrier(self, key: str, world_size: int,
+                timeout: Optional[float] = None) -> None:
+        t = timeout if timeout is not None else self.timeout
+        verb, _, _ = self._rpc(b"BAR", key,
+                               struct.pack("!id", world_size, t))
+        if verb != b"OK_":
+            raise TimeoutError(f"barrier '{key}' timed out after {t}s")
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        _, _, payload = self._rpc(b"LST", prefix)
+        return [k.decode() for k in payload.split(b"\x00") if k]
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

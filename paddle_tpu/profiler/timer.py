@@ -11,27 +11,29 @@ from typing import Optional
 
 
 class _MovingAvg:
-    """reference: timer.py TimeAverager."""
+    """Windowed moving average (reference: timer.py TimeAverager)."""
 
     def __init__(self, window: int = 100):
         self.window = window
         self.reset()
 
     def reset(self):
-        self._total = 0.0
-        self._count = 0
-        self._samples = 0
+        from collections import deque
+        self._records = deque(maxlen=self.window)
 
     def record(self, seconds: float, num_samples: int = 0):
-        self._total += seconds
-        self._count += 1
-        self._samples += num_samples
+        self._records.append((seconds, num_samples))
 
     def get_average(self) -> float:
-        return self._total / self._count if self._count else 0.0
+        if not self._records:
+            return 0.0
+        return sum(s for s, _ in self._records) / len(self._records)
 
     def get_ips_average(self) -> float:
-        return self._samples / self._total if self._total > 0 else 0.0
+        total = sum(s for s, _ in self._records)
+        if total <= 0:
+            return 0.0
+        return sum(n for _, n in self._records) / total
 
 
 class Benchmark:

@@ -1,0 +1,175 @@
+"""Launcher / store / flight-recorder tests.
+
+Reference test model: the new-style distributed tests shell out to the real
+launcher (test/collective/test_communication_api_base.py:64 —
+`python -m paddle.distributed.launch --devices …`), so the production
+rendezvous path is exercised. Same here, on CPU.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.store import TCPStore, TCPStoreServer
+from paddle_tpu.distributed.flight_recorder import (
+    enable_flight_recorder, disable_flight_recorder, get_flight_recorder)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- TCPStore ---------------------------------------------------------------
+def test_store_set_get_add_delete():
+    srv = TCPStoreServer()
+    c = TCPStore("127.0.0.1", srv.port)
+    c.set("k", "v1")
+    assert c.get("k") == b"v1"
+    assert c.get("missing") is None
+    assert c.add("ctr", 3) == 3
+    assert c.add("ctr", 2) == 5
+    c.delete("k")
+    assert c.get("k") is None
+    assert sorted(c.list_keys("")) == ["ctr"]
+    c.close()
+    srv.close()
+
+
+def test_store_wait_and_barrier_two_clients():
+    srv = TCPStoreServer()
+
+    def worker():
+        c = TCPStore("127.0.0.1", srv.port)
+        c.wait("go", timeout=10.0)
+        c.barrier("b0", 2, timeout=10.0)
+        c.set("done", "1")
+        c.close()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    main = TCPStore("127.0.0.1", srv.port)
+    time.sleep(0.2)
+    main.set("go", "1")
+    main.barrier("b0", 2, timeout=10.0)
+    main.wait("done", timeout=10.0)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    with pytest.raises(TimeoutError):
+        main.wait("never", timeout=0.3)
+    main.close()
+    srv.close()
+
+
+# -- launcher end-to-end ----------------------------------------------------
+WORKER_OK = textwrap.dedent("""
+    import json, os, sys
+    out = os.environ["TEST_OUT_DIR"]
+    rank = os.environ["PADDLE_TRAINER_ID"]
+    info = {k: os.environ.get(k) for k in
+            ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM", "PADDLE_LOCAL_RANK",
+             "PADDLE_MASTER", "PADDLE_JOB_ID")}
+    with open(os.path.join(out, f"rank{rank}.json"), "w") as f:
+        json.dump(info, f)
+""")
+
+WORKER_ELASTIC = textwrap.dedent("""
+    import os, sys
+    # fail on the first job incarnation, succeed after elastic restart
+    if os.environ["PADDLE_JOB_ID"] == "0":
+        sys.exit(3)
+    open(os.path.join(os.environ["TEST_OUT_DIR"],
+         "ok" + os.environ["PADDLE_TRAINER_ID"]), "w").write("1")
+""")
+
+
+def _run_launch(tmp_path, worker_src, extra_args, env_extra=None):
+    script = tmp_path / "worker.py"
+    script.write_text(worker_src)
+    env = dict(os.environ, TEST_OUT_DIR=str(tmp_path),
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--log_dir", str(tmp_path / "log")] + extra_args + [str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+
+
+def test_launch_spawns_ranks_with_env(tmp_path):
+    res = _run_launch(tmp_path, WORKER_OK, ["--nproc_per_node", "2"])
+    assert res.returncode == 0, res.stderr
+    infos = {}
+    for r in (0, 1):
+        with open(tmp_path / f"rank{r}.json") as f:
+            infos[r] = json.load(f)
+    assert infos[0]["PADDLE_TRAINERS_NUM"] == "2"
+    assert infos[1]["PADDLE_TRAINER_ID"] == "1"
+    assert infos[0]["PADDLE_MASTER"].startswith("127.0.0.1:")
+
+
+def test_launch_elastic_restart(tmp_path):
+    res = _run_launch(tmp_path, WORKER_ELASTIC,
+                      ["--nproc_per_node", "2", "--elastic_retries", "2"])
+    assert res.returncode == 0, res.stderr
+    assert (tmp_path / "ok0").exists() and (tmp_path / "ok1").exists()
+    assert "elastic restart" in res.stderr
+
+
+def test_launch_failure_propagates(tmp_path):
+    res = _run_launch(tmp_path, "import sys; sys.exit(7)", [])
+    assert res.returncode == 7
+
+
+# -- flight recorder --------------------------------------------------------
+def test_flight_recorder_records_and_dumps(tmp_path):
+    import paddle_tpu.distributed as dist
+    dump = tmp_path / "fr.json"
+    rec = enable_flight_recorder(timeout=3600.0, dump_path=str(dump))
+    try:
+        t = paddle.to_tensor(np.ones((4,), np.float32))
+        dist.all_reduce(t)
+        dist.broadcast(t, src=0)
+        tasks = rec.tasks()
+        assert len(tasks) == 2
+        assert tasks[0].op == "all_reduce"
+        assert tasks[0].shape == (4,)
+        assert not tasks[0].pending
+        rec.dump(reason="test")
+        report = json.loads(dump.read_text())
+        assert report["reason"] == "test"
+        assert len(report["entries"]) == 2
+        # reduce is built on all_reduce: must record ONE logical entry
+        dist.reduce(t, dst=0)
+        assert [x.op for x in rec.tasks()].count("reduce") == 1
+        assert "all_reduce" not in [x.op for x in rec.tasks()[2:]]
+        # group passed positionally still records the axis
+        from paddle_tpu.distributed.topology import CommGroup
+        dist.all_reduce(t, dist.ReduceOp.SUM, CommGroup("mp", [0], 0))
+        assert rec.tasks()[-1].axis == "mp"
+        # alltoall alias is instrumented; payload tensor shape is captured
+        o1 = paddle.to_tensor(np.zeros((2,), np.float32))
+        o2 = paddle.to_tensor(np.zeros((2,), np.float32))
+        i1 = paddle.to_tensor(np.ones((2,), np.float32))
+        i2 = paddle.to_tensor(np.ones((2,), np.float32))
+        dist.alltoall([o1, o2], [i1, i2])
+        assert rec.tasks()[-1].op == "all_to_all"
+        out_lists = [paddle.to_tensor(np.zeros((3,), np.float32))]
+        dist.all_gather(out_lists, paddle.to_tensor(
+            np.ones((3,), np.float32)))
+        assert rec.tasks()[-1].shape == (3,)
+    finally:
+        disable_flight_recorder()
+
+
+def test_flight_recorder_disabled_no_overhead():
+    import paddle_tpu.distributed as dist
+    rec = get_flight_recorder()
+    assert not rec.enabled
+    t = paddle.to_tensor(np.ones((2,), np.float32))
+    dist.all_reduce(t)   # should not record
+    assert all(x.op != "all_reduce" or x.end_ts for x in rec.tasks())
