@@ -1,0 +1,284 @@
+// Shared-memory ring queue for multiprocess data loading.
+//
+// TPU-native analog of the reference DataLoader's shared-memory tensor IPC
+// (python/paddle/io/dataloader/worker.py + paddle/fluid/memory/allocation/
+// mmap_allocator.cc): worker processes push length-prefixed blobs (pickled
+// numpy batches) into a POSIX shm ring buffer; the parent pops them without
+// per-batch pipe copies or pickling through a socket.
+//
+// Layout:  Header | ring bytes.  Records are u64 length + payload, wrapping
+// contiguously (a record never splits: if it doesn't fit before the end the
+// writer leaves a skip marker and restarts at offset 0).
+//
+// Synchronization: process-shared robust pthread mutex + two condvars.
+// Multi-producer / multi-consumer safe; the dataloader uses N producers and
+// one consumer.
+//
+// C ABI (ctypes-loaded from paddle_tpu/core/native.py):
+//   shmq_create(name, capacity)  -> handle  (unlinks pre-existing name)
+//   shmq_open(name)              -> handle
+//   shmq_push(h, data, len, timeout_ms) -> 0 | -1 timeout | -2 error
+//   shmq_pop(h, buf, buflen, timeout_ms) -> nbytes | -1 timeout | -2 error
+//                                           | -3 buffer too small (size kept)
+//   shmq_next_size(h, timeout_ms) -> size of next record | -1 | -2
+//   shmq_close(h), shmq_unlink(name)
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <new>
+#include <pthread.h>
+#include <string>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kSkipMarker = ~0ull;
+
+struct Header {
+  pthread_mutex_t mutex;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+  uint64_t capacity;   // ring bytes
+  uint64_t head;       // read offset
+  uint64_t tail;       // write offset
+  uint64_t used;       // bytes in use (records incl. length prefixes + skips)
+  uint64_t count;      // number of records
+  uint32_t magic;
+};
+
+constexpr uint32_t kMagic = 0x50545351;  // "PTSQ"
+
+struct Handle {
+  Header* hdr;
+  uint8_t* ring;
+  size_t total_size;
+  std::string name;
+};
+
+void abs_deadline(struct timespec* ts, int timeout_ms) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+// Robust-mutex-aware lock: recovers state consistency if a worker died
+// holding the lock (reference failure mode: dataloader worker killed by OOM
+// — the parent must not hang).
+int lock(Header* h) {
+  int rc = pthread_mutex_lock(&h->mutex);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mutex);
+    return 0;
+  }
+  return rc;
+}
+
+uint64_t contiguous_space(const Header* h) {
+  // free bytes from tail to ring end (or to head if head > tail)
+  if (h->used == h->capacity) return 0;
+  if (h->tail >= h->head && h->used > 0)
+    return h->capacity - h->tail;
+  if (h->used == 0) return h->capacity - h->tail;
+  return h->head - h->tail;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* shmq_create(const char* name, uint64_t capacity) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  size_t total = sizeof(Header) + capacity;
+  if (ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) {
+    shm_unlink(name);
+    return nullptr;
+  }
+  auto* hdr = static_cast<Header*>(mem);
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&hdr->mutex, &ma);
+  pthread_mutexattr_destroy(&ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&hdr->not_empty, &ca);
+  pthread_cond_init(&hdr->not_full, &ca);
+  pthread_condattr_destroy(&ca);
+  hdr->capacity = capacity;
+  hdr->head = hdr->tail = hdr->used = hdr->count = 0;
+  hdr->magic = kMagic;
+  auto* h = new Handle{hdr, reinterpret_cast<uint8_t*>(hdr + 1), total,
+                       name};
+  return h;
+}
+
+void* shmq_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                   PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* hdr = static_cast<Header*>(mem);
+  if (hdr->magic != kMagic) {
+    munmap(mem, static_cast<size_t>(st.st_size));
+    return nullptr;
+  }
+  auto* h = new Handle{hdr, reinterpret_cast<uint8_t*>(hdr + 1),
+                       static_cast<size_t>(st.st_size), name};
+  return h;
+}
+
+int shmq_push(void* hv, const void* data, uint64_t len, int timeout_ms) {
+  auto* h = static_cast<Handle*>(hv);
+  Header* q = h->hdr;
+  uint64_t need = 8 + len;
+  if (need > q->capacity) return -2;
+  struct timespec ts;
+  abs_deadline(&ts, timeout_ms);
+  if (lock(q) != 0) return -2;
+  while (true) {
+    // ensure a contiguous slot; wrap with a skip marker if tail-end space
+    // is too small but total free space suffices
+    uint64_t tail_space = contiguous_space(q);
+    uint64_t free_total = q->capacity - q->used;
+    if (need <= tail_space) break;
+    if (q->tail >= q->head && free_total - tail_space >= need &&
+        tail_space >= 8) {
+      // write skip marker, wrap to 0
+      memcpy(h->ring + q->tail, &kSkipMarker, 8);
+      q->used += tail_space;
+      q->tail = 0;
+      continue;
+    }
+    if (q->tail >= q->head && free_total - tail_space >= need &&
+        tail_space < 8) {
+      // unusable sliver at the end: absorb it without a marker
+      q->used += tail_space;
+      q->tail = 0;
+      continue;
+    }
+    int rc = pthread_cond_timedwait(&q->not_full, &q->mutex, &ts);
+    if (rc == ETIMEDOUT) {
+      pthread_mutex_unlock(&q->mutex);
+      return -1;
+    }
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&q->mutex);
+  }
+  memcpy(h->ring + q->tail, &len, 8);
+  memcpy(h->ring + q->tail + 8, data, len);
+  q->tail = (q->tail + need) % q->capacity;
+  q->used += need;
+  q->count += 1;
+  pthread_cond_signal(&q->not_empty);
+  pthread_mutex_unlock(&q->mutex);
+  return 0;
+}
+
+static int wait_nonempty(Header* q, struct timespec* ts) {
+  while (q->count == 0) {
+    int rc = pthread_cond_timedwait(&q->not_empty, &q->mutex, ts);
+    if (rc == ETIMEDOUT) return -1;
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&q->mutex);
+  }
+  return 0;
+}
+
+static void skip_markers(Handle* h) {
+  Header* q = h->hdr;
+  if (q->capacity - q->head < 8) {
+    // absorbed sliver at ring end (too small for a marker)
+    q->used -= q->capacity - q->head;
+    q->head = 0;
+    return;
+  }
+  uint64_t len;
+  memcpy(&len, h->ring + q->head, 8);
+  if (len == kSkipMarker) {
+    q->used -= q->capacity - q->head;
+    q->head = 0;
+  }
+}
+
+int64_t shmq_next_size(void* hv, int timeout_ms) {
+  auto* h = static_cast<Handle*>(hv);
+  Header* q = h->hdr;
+  struct timespec ts;
+  abs_deadline(&ts, timeout_ms);
+  if (lock(q) != 0) return -2;
+  if (wait_nonempty(q, &ts) != 0) {
+    pthread_mutex_unlock(&q->mutex);
+    return -1;
+  }
+  skip_markers(h);
+  uint64_t len;
+  memcpy(&len, h->ring + q->head, 8);
+  pthread_mutex_unlock(&q->mutex);
+  return static_cast<int64_t>(len);
+}
+
+int64_t shmq_pop(void* hv, void* buf, uint64_t buflen, int timeout_ms) {
+  auto* h = static_cast<Handle*>(hv);
+  Header* q = h->hdr;
+  struct timespec ts;
+  abs_deadline(&ts, timeout_ms);
+  if (lock(q) != 0) return -2;
+  if (wait_nonempty(q, &ts) != 0) {
+    pthread_mutex_unlock(&q->mutex);
+    return -1;
+  }
+  skip_markers(h);
+  uint64_t len;
+  memcpy(&len, h->ring + q->head, 8);
+  if (len > buflen) {
+    pthread_mutex_unlock(&q->mutex);
+    return -3;
+  }
+  memcpy(buf, h->ring + q->head + 8, len);
+  q->head = (q->head + 8 + len) % q->capacity;
+  q->used -= 8 + len;
+  q->count -= 1;
+  pthread_cond_broadcast(&q->not_full);  // may unblock several producers
+  pthread_mutex_unlock(&q->mutex);
+  return static_cast<int64_t>(len);
+}
+
+uint64_t shmq_count(void* hv) {
+  auto* h = static_cast<Handle*>(hv);
+  return h->hdr->count;
+}
+
+void shmq_close(void* hv) {
+  auto* h = static_cast<Handle*>(hv);
+  munmap(h->hdr, h->total_size);
+  delete h;
+}
+
+void shmq_unlink(const char* name) { shm_unlink(name); }
+
+}  // extern "C"

@@ -1,0 +1,421 @@
+// Native TCPStore server.
+//
+// TPU-native analog of the reference C++ store
+// (paddle/phi/core/distributed/store/tcp_store.h:121, tcp_utils.cc): a
+// single-threaded poll(2) event loop serving the launcher/elastic control
+// plane. Speaks the exact wire protocol of the Python client in
+// paddle_tpu/distributed/store.py:
+//   request:  u32 len | verb(3 bytes) | u16 klen | key | payload
+//   response: same framing, verbs OK_/NO_/TMO/ERR
+// Verbs: SET GET ADD DEL WAI(wait key, f64 timeout) BAR(i32 world, f64
+// timeout) LST(prefix). WAI/BAR park the connection instead of blocking a
+// thread — that is the point of the native server: thousands of waiting
+// ranks cost no threads.
+//
+// Exposed as a C ABI (pts_server_start/port/stop) loaded via ctypes from
+// paddle_tpu/core/native.py.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <fcntl.h>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <map>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+double now_sec() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+struct Conn {
+  std::string in;    // bytes received, not yet consumed
+  std::string out;   // bytes pending write
+  // parked waiter state
+  bool dead = false;
+  bool waiting = false;
+  bool is_barrier = false;
+  std::string wait_key;
+  double deadline = 0.0;
+  int64_t barrier_target = 0;
+};
+
+std::string pack(const char* verb, const std::string& payload = "") {
+  std::string body;
+  body.reserve(5 + payload.size());
+  body.append(verb, 3);
+  uint16_t klen = 0;
+  uint16_t nklen = htons(klen);
+  body.append(reinterpret_cast<char*>(&nklen), 2);
+  body += payload;
+  uint32_t len = htonl(static_cast<uint32_t>(body.size()));
+  std::string msg(reinterpret_cast<char*>(&len), 4);
+  msg += body;
+  return msg;
+}
+
+class Server {
+ public:
+  Server(const char* host, int port)
+      : host_(host ? host : ""), port_(port) {}
+
+  bool start() {
+    listen_fd_ = socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    fcntl(listen_fd_, F_SETFL, fcntl(listen_fd_, F_GETFL, 0) | O_NONBLOCK);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    if (!host_.empty() && host_ != "0.0.0.0" &&
+        inet_pton(AF_INET, host_.c_str(), &addr.sin_addr) != 1) {
+      close(listen_fd_);
+      return false;
+    }
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+        listen(listen_fd_, 512) != 0) {
+      close(listen_fd_);
+      return false;
+    }
+    socklen_t alen = sizeof(addr);
+    getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &alen);
+    port_ = ntohs(addr.sin_port);
+    if (pipe(stop_pipe_) != 0) {
+      close(listen_fd_);
+      return false;
+    }
+    thread_ = std::thread([this] { loop(); });
+    return true;
+  }
+
+  int port() const { return port_; }
+
+  void stop() {
+    char b = 1;
+    ssize_t r = write(stop_pipe_[1], &b, 1);
+    (void)r;
+    if (thread_.joinable()) thread_.join();
+    close(stop_pipe_[0]);
+    close(stop_pipe_[1]);
+  }
+
+ private:
+  void loop() {
+    while (true) {
+      std::vector<pollfd> pfds;
+      pfds.push_back({listen_fd_, POLLIN, 0});
+      pfds.push_back({stop_pipe_[0], POLLIN, 0});
+      for (auto& kv : conns_) {
+        short ev = POLLIN;
+        if (!kv.second.out.empty()) ev |= POLLOUT;
+        pfds.push_back({kv.first, ev, 0});
+      }
+      int timeout_ms = next_deadline_ms();
+      int n = poll(pfds.data(), pfds.size(), timeout_ms);
+      if (n < 0 && errno != EINTR) break;
+      if (pfds[1].revents & POLLIN) break;  // stop requested
+      if (pfds[0].revents & POLLIN) accept_conn();
+      for (size_t i = 2; i < pfds.size(); ++i) {
+        int fd = pfds[i].fd;
+        auto it = conns_.find(fd);
+        if (it == conns_.end()) continue;
+        if (pfds[i].revents & (POLLERR | POLLHUP)) {
+          it->second.dead = true;
+          continue;
+        }
+        if (pfds[i].revents & POLLIN) {
+          if (!read_some(fd, it->second)) {
+            it->second.dead = true;
+            continue;
+          }
+          consume(fd, it->second);
+        }
+        if (pfds[i].revents & POLLOUT) flush(fd, it->second);
+      }
+      expire_waiters();
+      sweep_dead();
+    }
+    for (auto& kv : conns_) close(kv.first);
+    conns_.clear();
+    close(listen_fd_);
+  }
+
+  int next_deadline_ms() {
+    double best = -1;
+    for (auto& kv : conns_) {
+      if (kv.second.waiting) {
+        double d = kv.second.deadline - now_sec();
+        if (best < 0 || d < best) best = d;
+      }
+    }
+    if (best < 0) return 1000;
+    if (best <= 0) return 0;
+    int ms = static_cast<int>(best * 1000) + 1;
+    return ms > 1000 ? 1000 : ms;
+  }
+
+  void accept_conn() {
+    while (true) {
+      int fd = accept4(listen_fd_, nullptr, nullptr, SOCK_NONBLOCK);
+      if (fd < 0) return;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      conns_.emplace(fd, Conn{});
+    }
+  }
+
+  bool read_some(int fd, Conn& c) {
+    char buf[65536];
+    while (true) {
+      ssize_t n = recv(fd, buf, sizeof(buf), 0);
+      if (n > 0) {
+        c.in.append(buf, static_cast<size_t>(n));
+        if (static_cast<size_t>(n) < sizeof(buf)) return true;
+      } else if (n == 0) {
+        return false;
+      } else {
+        return errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR;
+      }
+    }
+  }
+
+  // Never erases from conns_ (callers may be iterating it); hard write
+  // errors set c.dead and the poll loop sweeps.
+  void flush(int fd, Conn& c) {
+    while (!c.out.empty()) {
+      ssize_t n = send(fd, c.out.data(), c.out.size(), MSG_NOSIGNAL);
+      if (n > 0) {
+        c.out.erase(0, static_cast<size_t>(n));
+      } else {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        c.dead = true;
+        return;
+      }
+    }
+  }
+
+  void sweep_dead() {
+    for (auto it = conns_.begin(); it != conns_.end();) {
+      if (it->second.dead) {
+        close(it->first);
+        it = conns_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+  }
+
+  void reply(int fd, Conn& c, const char* verb,
+             const std::string& payload = "") {
+    c.out += pack(verb, payload);
+    flush(fd, c);
+  }
+
+  void consume(int fd, Conn& c) {
+    while (true) {
+      if (c.in.size() < 4) return;
+      uint32_t blen;
+      memcpy(&blen, c.in.data(), 4);
+      blen = ntohl(blen);
+      if (c.in.size() < 4 + blen) return;
+      std::string body = c.in.substr(4, blen);
+      c.in.erase(0, 4 + blen);
+      if (body.size() < 5) {
+        reply(fd, c, "ERR");
+        continue;
+      }
+      std::string verb = body.substr(0, 3);
+      uint16_t klen;
+      memcpy(&klen, body.data() + 3, 2);
+      klen = ntohs(klen);
+      if (body.size() < 5u + klen) {
+        reply(fd, c, "ERR");
+        continue;
+      }
+      std::string key = body.substr(5, klen);
+      std::string payload = body.substr(5 + klen);
+      handle(fd, c, verb, key, payload);
+      if (c.dead) return;
+    }
+  }
+
+  void handle(int fd, Conn& c, const std::string& verb,
+              const std::string& key, const std::string& payload) {
+    if (verb == "SET") {
+      kv_[key] = payload;
+      reply(fd, c, "OK_");
+      wake_key_waiters(key);
+    } else if (verb == "GET") {
+      auto it = kv_.find(key);
+      if (it == kv_.end())
+        reply(fd, c, "NO_");
+      else
+        reply(fd, c, "OK_", it->second);
+    } else if (verb == "ADD") {
+      if (payload.size() != 8) {
+        reply(fd, c, "ERR");
+        return;
+      }
+      int64_t delta;
+      memcpy(&delta, payload.data(), 8);
+      delta = static_cast<int64_t>(be64toh(static_cast<uint64_t>(delta)));
+      int64_t cur = 0;
+      auto it = kv_.find(key);
+      if (it != kv_.end()) cur = strtoll(it->second.c_str(), nullptr, 10);
+      cur += delta;
+      kv_[key] = std::to_string(cur);
+      uint64_t be = htobe64(static_cast<uint64_t>(cur));
+      reply(fd, c, "OK_",
+            std::string(reinterpret_cast<char*>(&be), 8));
+      wake_key_waiters(key);
+    } else if (verb == "DEL") {
+      kv_.erase(key);
+      reply(fd, c, "OK_");
+    } else if (verb == "WAI") {
+      if (payload.size() != 8) {
+        reply(fd, c, "ERR");
+        return;
+      }
+      double timeout = read_be_double(payload.data());
+      if (kv_.count(key)) {
+        reply(fd, c, "OK_");
+        return;
+      }
+      c.waiting = true;
+      c.is_barrier = false;
+      c.wait_key = key;
+      c.deadline = now_sec() + timeout;
+    } else if (verb == "BAR") {
+      if (payload.size() != 12) {
+        reply(fd, c, "ERR");
+        return;
+      }
+      int32_t world;
+      memcpy(&world, payload.data(), 4);
+      world = static_cast<int32_t>(ntohl(static_cast<uint32_t>(world)));
+      double timeout = read_be_double(payload.data() + 4);
+      if (world <= 0) {
+        reply(fd, c, "ERR");
+        return;
+      }
+      int64_t count = ++barrier_count_[key];
+      int64_t target = ((count + world - 1) / world) * world;
+      if (count >= target) {
+        reply(fd, c, "OK_");
+        wake_barrier_waiters(key);
+        return;
+      }
+      c.waiting = true;
+      c.is_barrier = true;
+      c.wait_key = key;
+      c.deadline = now_sec() + timeout;
+      c.barrier_target = target;
+    } else if (verb == "LST") {
+      std::string joined;
+      for (auto& e : kv_) {
+        if (e.first.compare(0, key.size(), key) == 0) {
+          if (!joined.empty()) joined += '\0';
+          joined += e.first;
+        }
+      }
+      reply(fd, c, "OK_", joined);
+    } else {
+      reply(fd, c, "ERR");
+    }
+  }
+
+  static double read_be_double(const char* p) {
+    uint64_t u;
+    memcpy(&u, p, 8);
+    u = be64toh(u);
+    double d;
+    memcpy(&d, &u, 8);
+    return d;
+  }
+
+  void wake_key_waiters(const std::string& key) {
+    for (auto& kvp : conns_) {
+      Conn& c = kvp.second;
+      if (c.waiting && !c.is_barrier && c.wait_key == key) {
+        c.waiting = false;
+        reply(kvp.first, c, "OK_");
+      }
+    }
+  }
+
+  void wake_barrier_waiters(const std::string& key) {
+    int64_t count = barrier_count_[key];
+    for (auto& kvp : conns_) {
+      Conn& c = kvp.second;
+      if (c.waiting && c.is_barrier && c.wait_key == key &&
+          count >= c.barrier_target) {
+        c.waiting = false;
+        reply(kvp.first, c, "OK_");
+      }
+    }
+  }
+
+  void expire_waiters() {
+    double t = now_sec();
+    for (auto& kvp : conns_) {
+      Conn& c = kvp.second;
+      if (c.waiting && t >= c.deadline) {
+        c.waiting = false;
+        // roll back a timed-out barrier arrival so retries can complete
+        // the barrier (otherwise the key stays phase-shifted forever)
+        if (c.is_barrier) barrier_count_[c.wait_key] -= 1;
+        reply(kvp.first, c, "TMO");
+      }
+    }
+  }
+
+  std::string host_;
+  int port_;
+  int listen_fd_ = -1;
+  int stop_pipe_[2] = {-1, -1};
+  std::thread thread_;
+  std::unordered_map<int, Conn> conns_;
+  std::map<std::string, std::string> kv_;  // ordered for LST prefix scans
+  std::unordered_map<std::string, int64_t> barrier_count_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pts_server_start(const char* host, int port) {
+  auto* s = new Server(host, port);
+  if (!s->start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int pts_server_port(void* h) {
+  return h ? static_cast<Server*>(h)->port() : -1;
+}
+
+void pts_server_stop(void* h) {
+  if (!h) return;
+  auto* s = static_cast<Server*>(h);
+  s->stop();
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,184 @@
+"""Native (C++) runtime loader.
+
+The reference keeps its runtime (store, allocator, data feed) in C++
+(SURVEY §2.6); paddle_tpu does the same for the pieces XLA doesn't own:
+the TCPStore control-plane server (csrc/tcp_store.cc) and the
+shared-memory dataloader queue (csrc/shm_queue.cc). They're compiled on
+first use with g++ into a cached .so and bound via ctypes (no pybind11 in
+this toolchain). Every native feature has a pure-Python fallback, so a
+missing compiler never breaks the framework — set
+``PADDLE_TPU_DISABLE_NATIVE=1`` to force the fallbacks.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SOURCES = ("tcp_store.cc", "shm_queue.cc")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _source_digest() -> str:
+    h = hashlib.sha256()
+    for s in _SOURCES:
+        with open(os.path.join(_CSRC, s), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def _build_dir() -> str:
+    d = os.path.join(_CSRC, "build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    """Compile (if needed) and dlopen the native runtime. Returns None when
+    unavailable; callers must fall back to Python implementations."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PADDLE_TPU_DISABLE_NATIVE") == "1":
+            return None
+        try:
+            so = os.path.join(_build_dir(),
+                              f"libpaddle_tpu_native_{_source_digest()}.so")
+            if not os.path.exists(so):
+                srcs = [os.path.join(_CSRC, s) for s in _SOURCES]
+                tmp = so + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                     "-o", tmp] + srcs + ["-lpthread", "-lrt"],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)   # atomic vs concurrent builders
+            lib = ctypes.CDLL(so)
+            _declare(lib)
+            _lib = lib
+        except Exception as e:  # noqa: BLE001 — any failure → fallback
+            sys.stderr.write(f"[paddle_tpu] native runtime unavailable "
+                             f"({type(e).__name__}); using Python "
+                             f"fallbacks\n")
+            _lib = None
+        return _lib
+
+
+def _declare(lib: ctypes.CDLL):
+    lib.pts_server_start.restype = ctypes.c_void_p
+    lib.pts_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.pts_server_port.restype = ctypes.c_int
+    lib.pts_server_port.argtypes = [ctypes.c_void_p]
+    lib.pts_server_stop.restype = None
+    lib.pts_server_stop.argtypes = [ctypes.c_void_p]
+
+    lib.shmq_create.restype = ctypes.c_void_p
+    lib.shmq_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shmq_open.restype = ctypes.c_void_p
+    lib.shmq_open.argtypes = [ctypes.c_char_p]
+    lib.shmq_push.restype = ctypes.c_int
+    lib.shmq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_uint64, ctypes.c_int]
+    lib.shmq_next_size.restype = ctypes.c_int64
+    lib.shmq_next_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.shmq_pop.restype = ctypes.c_int64
+    lib.shmq_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                             ctypes.c_uint64, ctypes.c_int]
+    lib.shmq_count.restype = ctypes.c_uint64
+    lib.shmq_count.argtypes = [ctypes.c_void_p]
+    lib.shmq_close.restype = None
+    lib.shmq_close.argtypes = [ctypes.c_void_p]
+    lib.shmq_unlink.restype = None
+    lib.shmq_unlink.argtypes = [ctypes.c_char_p]
+
+
+class SharedMemoryQueue:
+    """Python view over the native shm ring queue. Pickled-blob transport
+    for multiprocess DataLoader workers (reference: the shared-memory path
+    of python/paddle/io/dataloader/worker.py)."""
+
+    def __init__(self, name: str, capacity: int = 64 << 20,
+                 create: bool = True):
+        self._lib = load_native()
+        if self._lib is None:
+            raise RuntimeError("native runtime unavailable")
+        self.name = name.encode()
+        self._owner = create
+        if create:
+            self._h = self._lib.shmq_create(self.name, capacity)
+        else:
+            self._h = self._lib.shmq_open(self.name)
+        if not self._h:
+            raise RuntimeError(f"shmq_{'create' if create else 'open'} "
+                               f"failed for {name}")
+
+    def put(self, data: bytes, timeout: float = 60.0) -> None:
+        rc = self._lib.shmq_push(self._h, data, len(data),
+                                 int(timeout * 1000))
+        if rc == -1:
+            raise TimeoutError("shm queue full")
+        if rc != 0:
+            raise RuntimeError(f"shmq_push failed ({rc})")
+
+    def get(self, timeout: float = 60.0) -> bytes:
+        size = self._lib.shmq_next_size(self._h, int(timeout * 1000))
+        if size == -1:
+            raise TimeoutError("shm queue empty")
+        if size < 0:
+            raise RuntimeError(f"shmq_next_size failed ({size})")
+        buf = ctypes.create_string_buffer(int(size))
+        n = self._lib.shmq_pop(self._h, buf, size, int(timeout * 1000))
+        if n < 0:
+            raise RuntimeError(f"shmq_pop failed ({n})")
+        return buf.raw[:n]
+
+    def qsize(self) -> int:
+        return int(self._lib.shmq_count(self._h))
+
+    def close(self):
+        if self._h:
+            self._lib.shmq_close(self._h)
+            self._h = None
+        if self._owner:
+            self._lib.shmq_unlink(self.name)
+
+    def __getstate__(self):
+        return {"name": self.name.decode(),
+                "capacity": 0, "owner": False}
+
+    def __setstate__(self, state):
+        self._lib = load_native()
+        if self._lib is None:
+            raise RuntimeError("native runtime unavailable in subprocess")
+        self.name = state["name"].encode()
+        self._owner = False
+        self._h = self._lib.shmq_open(self.name)
+        if not self._h:
+            raise RuntimeError(f"shmq_open failed for {state['name']}")
+
+
+def native_store_server(port: int = 0, host: str = "0.0.0.0"):
+    """Start the C++ TCPStore server; returns (handle, port) or None."""
+    lib = load_native()
+    if lib is None:
+        return None
+    h = lib.pts_server_start(host.encode(), port)
+    if not h:
+        return None
+    return h, lib.pts_server_port(h)
+
+
+def native_store_stop(handle):
+    lib = load_native()
+    if lib is not None and handle:
+        lib.pts_server_stop(handle)

@@ -324,7 +324,10 @@ def _instrument(fn):
         try:
             bound = sig.bind(*args, **kwargs).arguments
         except TypeError:
-            bound = dict(kwargs)
+            # degenerate call that won't bind: still scan positionals for
+            # the payload tensor so the record keeps shape/dtype
+            bound = {f"arg{i}": a for i, a in enumerate(args)}
+            bound.update(kwargs)
         group = bound.get("group")
         try:
             ax = _axis(group) if group is not None else None

@@ -52,7 +52,7 @@ class FlightRecorder:
         self.enabled = False
         self._watchdog: Optional[threading.Thread] = None
         self._stop_evt = threading.Event()
-        self._dumped = False
+        self._reported_seqs: set = set()
 
     # -- recording ----------------------------------------------------------
     def begin(self, op: str, axis, shape, dtype) -> Optional[CommTask]:
@@ -89,9 +89,12 @@ class FlightRecorder:
             with self._lock:
                 stuck = [t for t in self._ring
                          if t.pending and now - t.start_ts > self.timeout]
-            if stuck and not self._dumped:
+            # dump whenever a NEW collective gets stuck — an early slow-but-
+            # completing op must not suppress the report for a later hang
+            fresh = [t for t in stuck if t.seq not in self._reported_seqs]
+            if fresh:
                 self.dump(reason=f"collective pending > {self.timeout}s")
-                self._dumped = True
+                self._reported_seqs.update(t.seq for t in stuck)
 
     # -- dump ---------------------------------------------------------------
     def dump(self, reason: str = "manual") -> str:
@@ -137,7 +140,7 @@ def enable_flight_recorder(timeout: float = 600.0,
     _RECORDER._ring = deque(maxlen=capacity)
     _RECORDER.capacity = capacity
     _RECORDER.enabled = True
-    _RECORDER._dumped = False
+    _RECORDER._reported_seqs.clear()
     _RECORDER.start_watchdog()
     return _RECORDER
 

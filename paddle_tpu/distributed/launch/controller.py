@@ -139,8 +139,14 @@ class Controller:
 
     # -- watch / elastic ----------------------------------------------------
     def _heartbeat(self):
-        self.store.set(f"heartbeat/{self.spec.node_rank}",
-                       str(time.time()))
+        try:
+            self.store.set(f"heartbeat/{self.spec.node_rank}",
+                           str(time.time()))
+        except (ConnectionError, OSError):
+            # master gone mid-run; peers keep watching their local procs —
+            # a genuinely dead pod is caught by the job-level timeout, and
+            # a master that merely finished first must not crash us
+            pass
 
     def _peer_failure(self) -> Optional[int]:
         """Heartbeat staleness check over the store (reference: elastic
@@ -148,12 +154,15 @@ class Controller:
         if self.spec.nnodes <= 1:
             return None
         now = time.time()
-        for node in range(self.spec.nnodes):
-            if node == self.spec.node_rank:
-                continue
-            val = self.store.get(f"heartbeat/{node}")
-            if val is not None and now - float(val) > HEARTBEAT_STALE:
-                return node
+        try:
+            for node in range(self.spec.nnodes):
+                if node == self.spec.node_rank:
+                    continue
+                val = self.store.get(f"heartbeat/{node}")
+                if val is not None and now - float(val) > HEARTBEAT_STALE:
+                    return node
+        except (ConnectionError, OSError):
+            return None
         return None
 
     def watch(self) -> int:
@@ -210,10 +219,34 @@ class Controller:
     def run(self) -> int:
         self._setup_master()
         self._spawn_all()
+        code = 1
         try:
-            return self.watch()
+            code = self.watch()
+            return code
         finally:
             self._kill_all()
+            self._graceful_store_shutdown(code)
+
+    def _graceful_store_shutdown(self, code: int):
+        """Node 0 owns the store server; it must outlive the other nodes'
+        controllers (rank-dependent finish skew would otherwise crash
+        still-running peers with connection errors)."""
+        spec = self.spec
+        try:
+            if self.store and spec.nnodes > 1:
+                self.store.set(f"exit/{spec.node_rank}", str(code))
+                if self.server is not None:
+                    deadline = time.time() + 300
+                    while time.time() < deadline:
+                        done = sum(
+                            1 for n in range(spec.nnodes)
+                            if self.store.get(f"exit/{n}") is not None)
+                        if done >= spec.nnodes:
+                            break
+                        time.sleep(0.5)
+        except (ConnectionError, OSError):
+            pass
+        finally:
             if self.store:
                 self.store.close()
             if self.server:

@@ -47,8 +47,8 @@ def _recv_msg(sock: socket.socket):
     return verb, key, payload
 
 
-class TCPStoreServer:
-    """Master-side store. Runs a thread per connection; in-memory dict."""
+class _PyTCPStoreServer:
+    """Pure-Python fallback server. Runs a thread per connection."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0):
         self._kv: Dict[bytes, bytes] = {}
@@ -128,6 +128,10 @@ class TCPStoreServer:
                             remaining = deadline - time.time()
                             if remaining <= 0:
                                 ok = False
+                                # roll back our arrival so a retry can
+                                # complete the barrier instead of the key
+                                # staying phase-shifted forever
+                                self._barrier_count[key] -= 1
                                 break
                             self._cv.wait(min(remaining, 1.0))
                         self._cv.notify_all()
@@ -151,6 +155,43 @@ class TCPStoreServer:
             pass
 
 
+class TCPStoreServer:
+    """Master-side store server.
+
+    Prefers the native poll-loop server (csrc/tcp_store.cc — waiting ranks
+    park on the event loop, no thread per connection, matching the
+    reference's C++ MasterDaemon in tcp_utils.cc); falls back to the
+    Python thread-per-connection implementation. Both speak the same wire
+    protocol, so TCPStore clients can't tell them apart.
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 backend: str = "auto"):
+        self._native_handle = None
+        self._py = None
+        self.backend = "python"
+        if backend in ("auto", "native"):
+            from ..core.native import native_store_server
+            res = native_store_server(port, host=host)
+            if res is not None:
+                self._native_handle, self.port = res
+                self.backend = "native"
+                return
+            if backend == "native":
+                raise RuntimeError("native store server unavailable")
+        self._py = _PyTCPStoreServer(host, port)
+        self.port = self._py.port
+
+    def close(self):
+        if self._native_handle is not None:
+            from ..core.native import native_store_stop
+            native_store_stop(self._native_handle)
+            self._native_handle = None
+        if self._py is not None:
+            self._py.close()
+            self._py = None
+
+
 class TCPStore:
     """Client. reference: tcp_store.h TCPStore::{set,get,add,wait,barrier}."""
 
@@ -171,13 +212,22 @@ class TCPStore:
                 f"cannot reach store at {host}:{port}: {last}")
         self._lock = threading.Lock()
 
-    def _rpc(self, verb: bytes, key: str, payload: bytes = b""):
+    def _rpc(self, verb: bytes, key: str, payload: bytes = b"",
+             response_timeout: Optional[float] = None):
+        """One request/response. ``response_timeout`` bounds how long we
+        wait for the reply — a dead master (power loss, partition: no
+        FIN/RST) must surface as an error, not an infinite block, or the
+        elastic failure detection above this can never fire."""
         with self._lock:
             self._sock.sendall(_pack(verb, key.encode(), payload))
             old = self._sock.gettimeout()
             try:
-                self._sock.settimeout(None)
+                self._sock.settimeout(response_timeout or self.timeout)
                 return _recv_msg(self._sock)
+            except socket.timeout as e:
+                raise ConnectionError(
+                    f"store at {self.host}:{self.port} did not respond "
+                    f"within {response_timeout or self.timeout}s") from e
             finally:
                 self._sock.settimeout(old)
 
@@ -199,7 +249,8 @@ class TCPStore:
 
     def wait(self, key: str, timeout: Optional[float] = None) -> None:
         t = timeout if timeout is not None else self.timeout
-        verb, _, _ = self._rpc(b"WAI", key, struct.pack("!d", t))
+        verb, _, _ = self._rpc(b"WAI", key, struct.pack("!d", t),
+                               response_timeout=t + 30.0)
         if verb != b"OK_":
             raise TimeoutError(f"wait for key '{key}' timed out after {t}s")
 
@@ -207,7 +258,8 @@ class TCPStore:
                 timeout: Optional[float] = None) -> None:
         t = timeout if timeout is not None else self.timeout
         verb, _, _ = self._rpc(b"BAR", key,
-                               struct.pack("!id", world_size, t))
+                               struct.pack("!id", world_size, t),
+                               response_timeout=t + 30.0)
         if verb != b"OK_":
             raise TimeoutError(f"barrier '{key}' timed out after {t}s")
 

@@ -16,6 +16,7 @@ import collections
 import itertools
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, List, Optional
 
@@ -94,6 +95,151 @@ class _PrefetchIter:
         return self
 
 
+def _worker_loop(dataset, collate_fn, task_q, result_q, use_shm,
+                 worker_init_fn, worker_id):
+    """Subprocess worker (reference: python/paddle/io/dataloader/worker.py
+    _worker_loop): pulls (batch_idx, indices) tasks, pushes collated numpy
+    batches back — through the native shared-memory ring queue
+    (csrc/shm_queue.cc) when available, else a multiprocessing.Queue.
+    Workers never touch jax; device_put happens in the parent."""
+    import pickle
+    import traceback
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        bidx, indices = task
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            msg = (bidx, "ok", batch)
+        except Exception:  # noqa: BLE001 — propagate to parent
+            msg = (bidx, "exc", traceback.format_exc())
+        if use_shm:
+            result_q.put(pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL))
+        else:
+            result_q.put(msg)
+
+
+class _ProcessPoolIter:
+    """Multiprocess prefetch iterator with batch reordering (reference:
+    dataloader_iter.py _DataLoaderIterMultiProcess)."""
+
+    def __init__(self, loader, index_iter):
+        import multiprocessing as mp
+        import os
+        self.loader = loader
+        self.index_iter = index_iter
+        ctx = mp.get_context("fork")
+        self.task_q = ctx.Queue()
+        self.result_shm = None
+        if loader.use_shared_memory:
+            try:
+                from ..core.native import SharedMemoryQueue
+                name = f"/ptq_dl_{os.getpid()}_{id(self) & 0xFFFFFF:x}"
+                self.result_shm = SharedMemoryQueue(name,
+                                                    capacity=256 << 20)
+            except Exception:
+                self.result_shm = None
+        self.use_shm = self.result_shm is not None
+        self.result_q = self.result_shm if self.use_shm else ctx.Queue()
+        self.workers = [
+            ctx.Process(target=_worker_loop,
+                        args=(loader.dataset, loader.collate_fn,
+                              self.task_q, self.result_q, self.use_shm,
+                              loader.worker_init_fn, i),
+                        daemon=True)
+            for i in range(loader.num_workers)]
+        for w in self.workers:
+            w.start()
+        self.buffer = {}
+        self.next_idx = 0
+        self.sent_idx = 0
+        self.exhausted = False
+        self.prefetch = max(loader.prefetch_factor, 1) * loader.num_workers
+        # paddle semantics: timeout=0 means no limit; worker death is
+        # detected by liveness polling, not by the timeout
+        self.timeout = loader.timeout if loader.timeout else None
+        self._fill()
+
+    def _fill(self):
+        while not self.exhausted and \
+                self.sent_idx - self.next_idx < self.prefetch:
+            try:
+                indices = next(self.index_iter)
+            except StopIteration:
+                self.exhausted = True
+                return
+            self.task_q.put((self.sent_idx, indices))
+            self.sent_idx += 1
+
+    def _recv(self):
+        """Blocking receive in short slices, checking worker liveness each
+        slice (reference: dataloader_iter.py _thread_monitor + worker
+        watchdog): a worker killed mid-batch (OOM) raises a clear error
+        instead of an opaque queue timeout."""
+        import pickle
+        import queue as _queue
+        deadline = (time.time() + self.timeout) if self.timeout else None
+        while True:
+            try:
+                if self.use_shm:
+                    return pickle.loads(self.result_q.get(timeout=5.0))
+                return self.result_q.get(timeout=5.0)
+            except (TimeoutError, _queue.Empty):
+                dead = [w for w in self.workers
+                        if not w.is_alive() and w.exitcode not in (0, None)]
+                if dead:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker (pid {dead[0].pid}) exited "
+                        f"unexpectedly with code {dead[0].exitcode} — "
+                        f"likely killed (OOM?)") from None
+                if deadline and time.time() > deadline:
+                    self._shutdown()
+                    raise TimeoutError(
+                        f"DataLoader batch not produced within "
+                        f"{self.timeout}s (workers alive)") from None
+
+    def __next__(self):
+        if self.next_idx >= self.sent_idx and self.exhausted:
+            self._shutdown()
+            raise StopIteration
+        while self.next_idx not in self.buffer:
+            bidx, status, payload = self._recv()
+            if status == "exc":
+                self._shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker failed for batch {bidx}:\n{payload}")
+            self.buffer[bidx] = payload
+        batch = self.buffer.pop(self.next_idx)
+        self.next_idx += 1
+        self._fill()
+        return self.loader._to_device(batch)
+
+    def _shutdown(self):
+        for _ in self.workers:
+            self.task_q.put(None)
+        for w in self.workers:
+            w.join(timeout=5)
+            if w.is_alive():
+                w.terminate()
+        if self.result_shm is not None:
+            self.result_shm.close()
+            self.result_shm = None
+
+    def __del__(self):
+        try:
+            if any(w.is_alive() for w in self.workers):
+                self._shutdown()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    def __iter__(self):
+        return self
+
+
 class _IterableDatasetIter:
     def __init__(self, loader):
         self.loader = loader
@@ -120,7 +266,7 @@ class DataLoader:
                  num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
                  worker_init_fn=None, persistent_workers=False,
-                 prefetch_to_device=True):
+                 prefetch_to_device=True, worker_type="thread"):
         self.dataset = dataset
         self.batch_size = batch_size
         self.shuffle = shuffle
@@ -128,6 +274,13 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        # "thread" (default: zero-copy into device_put, fine for numpy-light
+        # pipelines) or "process" (reference behavior: subprocess workers +
+        # shared-memory IPC, for GIL-heavy transforms)
+        self.worker_type = worker_type
         self.prefetch_to_device = prefetch_to_device
         self.return_list = return_list
         self._is_iterable = isinstance(dataset, IterableDataset)
@@ -159,6 +312,8 @@ class DataLoader:
     def __iter__(self):
         if self._is_iterable:
             return _IterableDatasetIter(self)
+        if self.worker_type == "process" and self.num_workers > 0:
+            return _ProcessPoolIter(self, iter(self.batch_sampler))
         return _PrefetchIter(self, iter(self.batch_sampler))
 
     def __len__(self):
