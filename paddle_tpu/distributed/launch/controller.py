@@ -160,6 +160,10 @@ class Controller:
                     continue
                 val = self.store.get(f"heartbeat/{node}")
                 if val is not None and now - float(val) > HEARTBEAT_STALE:
+                    # a cleanly-finished node stops heartbeating but is not
+                    # a failure — it left an exit/{n} marker
+                    if self.store.get(f"exit/{node}") is not None:
+                        continue
                     return node
         except (ConnectionError, OSError):
             return None

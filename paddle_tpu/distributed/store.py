@@ -219,17 +219,26 @@ class TCPStore:
         FIN/RST) must surface as an error, not an infinite block, or the
         elastic failure detection above this can never fire."""
         with self._lock:
+            if self._sock is None:
+                self._sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.timeout)
             self._sock.sendall(_pack(verb, key.encode(), payload))
             old = self._sock.gettimeout()
             try:
                 self._sock.settimeout(response_timeout or self.timeout)
                 return _recv_msg(self._sock)
             except socket.timeout as e:
+                # the request is still in flight — a late reply would desync
+                # every subsequent request/response pair, so drop the
+                # connection; the next RPC reconnects with a clean stream
+                self._sock.close()
+                self._sock = None
                 raise ConnectionError(
                     f"store at {self.host}:{self.port} did not respond "
                     f"within {response_timeout or self.timeout}s") from e
             finally:
-                self._sock.settimeout(old)
+                if self._sock is not None:
+                    self._sock.settimeout(old)
 
     def set(self, key: str, value) -> None:
         if isinstance(value, str):
@@ -269,6 +278,8 @@ class TCPStore:
 
     def close(self):
         try:
-            self._sock.close()
+            if self._sock is not None:
+                self._sock.close()
         except OSError:
             pass
+        self._sock = None

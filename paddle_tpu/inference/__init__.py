@@ -1,0 +1,11 @@
+"""paddle_tpu.inference — deploy path (reference:
+paddle/fluid/inference/ AnalysisPredictor/AnalysisConfig + the
+fused-transformer serving kernels). StableHLO artifacts + XLA AOT compile
+replace the pass pipeline; paged attention + the jitted generate loop
+replace the CUDA decode kernels."""
+from .predictor import Config, Predictor, create_predictor
+from .generation import (GenerationConfig, generate, cached_forward,
+                         init_cache, sample_token)
+
+__all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
+           "generate", "cached_forward", "init_cache", "sample_token"]

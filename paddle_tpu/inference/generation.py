@@ -1,0 +1,177 @@
+"""Autoregressive generation with a KV cache.
+
+TPU-native redesign of the reference's fused-transformer decode path
+(paddle/phi/kernels/fusion/gpu/fused_multi_transformer_kernel.cu +
+masked_multihead_attention — per-step CUDA kernels over a growing cache):
+here prefill and decode are two jitted programs with static shapes; the
+decode loop is a ``lax.scan`` over steps carrying the cache, so the whole
+generation runs as ONE XLA program — no per-token host round trips.
+
+Cache layout: [L, B, T_max, KV, hd] stacked on the layer axis to match the
+model's scanned layer params (models/llama.py).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models import llama as _llama
+from ..ops.rope import build_rope_cache, apply_rope
+
+
+@dataclass
+class GenerationConfig:
+    """reference: python/paddle/... generation knobs of
+    paddlenlp-style generate(); the sampling surface of the serving path."""
+
+    max_new_tokens: int = 64
+    temperature: float = 1.0
+    top_k: int = 0            # 0 = disabled
+    top_p: float = 1.0        # 1.0 = disabled
+    eos_token_id: int = -1    # -1 = never stop early
+    greedy: bool = False
+
+
+def init_cache(cfg: _llama.LlamaConfig, batch: int, max_len: int,
+               dtype=None):
+    dtype = dtype or cfg.dtype
+    L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    shape = (L, batch, max_len, KV, hd)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def _cached_layer(lp, x, sin, cos, cfg, kc, vc, pos):
+    """Decoder block over S new tokens at absolute position ``pos``,
+    reading/writing the cache. kc/vc: [B, T, KV, hd]."""
+    from ..ops import rms_norm as fused_rms_norm, swiglu as fused_swiglu
+
+    H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    b, s, _ = x.shape
+    T = kc.shape[1]
+    h = fused_rms_norm(x, lp["input_norm"].astype(x.dtype),
+                       cfg.rms_norm_eps)
+    q = (h @ lp["q_proj"]).reshape(b, s, H, hd)
+    k = (h @ lp["k_proj"]).reshape(b, s, KV, hd)
+    v = (h @ lp["v_proj"]).reshape(b, s, KV, hd)
+    q = apply_rope(q, sin, cos)
+    k = apply_rope(k, sin, cos)
+    kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
+
+    rep = H // KV
+    kk = _llama._repeat_kv(kc, rep)    # [B, T, H, hd]
+    vv = _llama._repeat_kv(vc, rep)
+    scale = 1.0 / math.sqrt(hd)
+    scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                        kk.astype(jnp.float32)) * scale
+    # causal over absolute positions: query i at pos+i sees keys <= pos+i
+    t_idx = jnp.arange(T)[None, None, None, :]
+    q_idx = pos + jnp.arange(s)[None, None, :, None]
+    scores = jnp.where(t_idx <= q_idx, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bhst,bthd->bshd", probs, vv.astype(jnp.float32))
+    attn = attn.astype(x.dtype).reshape(b, s, H * hd)
+    x = x + attn @ lp["o_proj"]
+    h = fused_rms_norm(x, lp["post_norm"].astype(x.dtype), cfg.rms_norm_eps)
+    ff = fused_swiglu(h @ lp["gate_proj"], h @ lp["up_proj"])
+    x = x + ff @ lp["down_proj"]
+    return x, kc, vc
+
+
+def cached_forward(params: Dict, tokens, cfg: _llama.LlamaConfig,
+                   k_cache, v_cache, pos):
+    """Forward over S tokens starting at absolute position ``pos``.
+    Returns (logits [B, S, V], k_cache, v_cache)."""
+    x = jnp.take(params["embed_tokens"], tokens, axis=0)
+    T = k_cache.shape[2]
+    sin_full, cos_full = build_rope_cache(T, cfg.head_dim,
+                                          base=cfg.rope_theta)
+    s = tokens.shape[1]
+    sin = jax.lax.dynamic_slice_in_dim(sin_full, pos, s, axis=0)
+    cos = jax.lax.dynamic_slice_in_dim(cos_full, pos, s, axis=0)
+
+    def scan_fn(carry, xs):
+        lp, kc, vc = xs
+        x, kc, vc = _cached_layer(lp, carry, sin, cos, cfg, kc, vc, pos)
+        return x, (kc, vc)
+
+    from ..ops import rms_norm as fused_rms_norm
+    x, (k_cache, v_cache) = jax.lax.scan(
+        scan_fn, x, (params["layers"], k_cache, v_cache))
+    x = fused_rms_norm(x, params["final_norm"].astype(x.dtype),
+                       cfg.rms_norm_eps)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed_tokens"].T
+    return x @ head, k_cache, v_cache
+
+
+def sample_token(logits, key, gen: GenerationConfig):
+    """[B, V] → [B] next tokens. Greedy / temperature / top-k / top-p."""
+    logits = logits.astype(jnp.float32)
+    if gen.greedy or gen.temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(gen.temperature, 1e-6)
+    if gen.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -gen.top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if gen.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep smallest set with cumulative prob >= top_p (always keep top-1)
+        cutoff_idx = jnp.sum(cum < gen.top_p, axis=-1)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[:, None],
+                                     axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(params: Dict, input_ids, cfg: _llama.LlamaConfig,
+             gen: Optional[GenerationConfig] = None,
+             seed: int = 0) -> jax.Array:
+    """Greedy/sampling generation. input_ids [B, S_in] → [B, S_in + N].
+
+    One jitted program: prefill, then a lax.scan of N decode steps. The
+    reference's serving loop launches per-token kernels; on TPU the whole
+    loop compiles once and the cache is donated between steps.
+    """
+    gen = gen or GenerationConfig()
+    B, S = input_ids.shape
+    T = S + gen.max_new_tokens
+
+    @partial(jax.jit, static_argnums=())
+    def run(params, input_ids, key):
+        k_cache, v_cache = init_cache(cfg, B, T)
+        logits, k_cache, v_cache = cached_forward(
+            params, input_ids, cfg, k_cache, v_cache, 0)
+        first = sample_token(logits[:, -1], key, gen)
+        done0 = (first == gen.eos_token_id)
+
+        def step(carry, i):
+            tok, kc, vc, key, done = carry
+            key, sub = jax.random.split(key)
+            logits, kc, vc = cached_forward(
+                params, tok[:, None], cfg, kc, vc, S + i)
+            nxt = sample_token(logits[:, -1], sub, gen)
+            nxt = jnp.where(done, gen.eos_token_id, nxt)
+            done = done | (nxt == gen.eos_token_id)
+            return (nxt, kc, vc, key, done), tok
+
+        # step i feeds carry token and emits it as ys[i]; with carry
+        # starting at `first`, ys == [first, g1, …, g_{N-1}] — exactly the
+        # N generated tokens (the final carry token is the N+1-th, unused)
+        _, toks = jax.lax.scan(
+            step, (first, k_cache, v_cache, key, done0),
+            jnp.arange(gen.max_new_tokens))
+        return jnp.concatenate([input_ids, toks.transpose(1, 0)], axis=1)
+
+    key = jax.random.key(seed)
+    return run(params, input_ids, key)

@@ -1,0 +1,151 @@
+"""Inference predictor.
+
+TPU-native analog of the reference inference engine
+(paddle/fluid/inference/api/analysis_predictor.h:101 AnalysisPredictor +
+AnalysisConfig): instead of a pass-pipeline over a ProgramDesc and a
+TensorRT/ONNX bridge, the deploy artifact is a serialized StableHLO module
+(written by paddle_tpu.jit.save) AOT-compiled by XLA at load. The
+IR-optimization slot (paddle_pass_builder.cc) is XLA itself.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_value
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """reference: paddle/fluid/inference/api/analysis_config.cc. Keeps the
+    commonly-used surface; GPU/TensorRT/MKLDNN knobs map to no-ops or their
+    XLA equivalents."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # paddle convention: Config("path/model") with side files
+        self._model_prefix = prog_file
+        self._device = "tpu"
+        self._memory_optim = True
+        self._profile = False
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self._model_prefix = prog_file
+
+    def model_path(self) -> Optional[str]:
+        return self._model_prefix
+
+    # -- device selection ---------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"   # deploy device on this framework is the TPU
+
+    def enable_tpu(self):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def use_gpu(self) -> bool:
+        return self._device == "tpu"
+
+    # -- parity no-ops (XLA owns these) ------------------------------------
+    def switch_ir_optim(self, flag=True):
+        pass
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def enable_profile(self):
+        self._profile = True
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        pass   # TensorRT slot: XLA AOT compile fills this role
+
+    def summary(self) -> str:
+        return (f"Config(model={self._model_prefix}, device={self._device}, "
+                f"memory_optim={self._memory_optim})")
+
+
+class Predictor:
+    """reference: AnalysisPredictor — run() over named input/output handles.
+
+    Wraps a TranslatedLayer (deserialized StableHLO) and AOT-compiles it on
+    first run. Input buffers are donated where shapes allow, so repeated
+    run() calls reuse HBM.
+    """
+
+    def __init__(self, config: Config):
+        from ..jit.save_load import load
+        self.config = config
+        path = config.model_path()
+        if path is None:
+            raise ValueError("Config has no model path")
+        self._layer = load(path)
+        meta = self._layer._meta
+        self._input_specs = meta["inputs"]
+        self._input_names = [f"x{i}" for i in range(len(self._input_specs))]
+        self._feeds: Dict[str, np.ndarray] = {}
+        self._outputs: List[jax.Array] = []
+
+    # -- handle API ---------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> "_IOHandle":
+        return _IOHandle(self, name, is_input=True)
+
+    def get_output_names(self) -> List[str]:
+        return [f"out{i}" for i in range(len(self._outputs) or 1)]
+
+    def get_output_handle(self, name: str) -> "_IOHandle":
+        return _IOHandle(self, name, is_input=False)
+
+    def run(self, inputs: Optional[Sequence] = None):
+        """Positional-run (paddle 2.x style) or handle-feed run."""
+        if inputs is not None:
+            vals = [to_value(x) if isinstance(x, Tensor) else jnp.asarray(x)
+                    for x in inputs]
+        else:
+            vals = [jnp.asarray(self._feeds[n]) for n in self._input_names]
+        out = self._layer(*vals)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        self._outputs = [to_value(o) for o in out]
+        return [Tensor(o) for o in self._outputs]
+
+
+class _IOHandle:
+    """reference: ZeroCopyTensor — named in/out buffer view."""
+
+    def __init__(self, predictor: Predictor, name: str, is_input: bool):
+        self._p = predictor
+        self._name = name
+        self._is_input = is_input
+
+    def reshape(self, shape):
+        pass   # shapes are taken from the fed array
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        if not self._is_input:
+            raise RuntimeError("cannot feed an output handle")
+        self._p._feeds[self._name] = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        idx = int(self._name.replace("out", "") or 0)
+        return np.asarray(self._p._outputs[idx])
+
+    def shape(self):
+        if self._is_input:
+            return list(np.shape(self._p._feeds.get(self._name, ())))
+        idx = int(self._name.replace("out", "") or 0)
+        return list(self._p._outputs[idx].shape)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """reference: paddle_infer::CreatePredictor."""
+    return Predictor(config)

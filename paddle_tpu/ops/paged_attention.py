@@ -1,0 +1,114 @@
+"""Paged KV-cache attention for serving.
+
+TPU-native redesign of the reference's paged-attention inference kernels
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu and
+block_attn.h — "block multi-head attention" with a paged KV cache): the KV
+cache lives in a pool of fixed-size blocks; each sequence owns a list of
+block ids (its block table), so cache memory is allocated in O(block_size)
+units instead of max_seq_len per sequence.
+
+Layout choices for TPU:
+- pools are [num_blocks, block_size, KV_heads, head_dim] so a block gather
+  (jnp.take on axis 0) is a contiguous HBM read and the trailing
+  [head_dim] axis stays lane-aligned (128) for the MXU/VPU;
+- decode attention is one fused einsum over the gathered blocks — XLA fuses
+  the gather + QK^T + softmax + PV chain; block_tables make the gather
+  bounded by max_blocks_per_seq, not the pool size.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
+                           scale: Optional[float] = None):
+    """Single-step decode attention over a paged cache.
+
+    q:            [B, H, hd]     query for the current position
+    k_pool/v_pool:[N, BS, KV, hd] physical block pools
+    block_tables: [B, MB] int32  physical block id per logical block
+    seq_lens:     [B]    int32   valid tokens per sequence (incl. current)
+    returns       [B, H, hd]
+    """
+    B, H, hd = q.shape
+    N, BS, KV, _ = k_pool.shape
+    MB = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+
+    # gather each sequence's blocks: [B, MB, BS, KV, hd] → [B, T, KV, hd]
+    k = jnp.take(k_pool, block_tables, axis=0).reshape(B, MB * BS, KV, hd)
+    v = jnp.take(v_pool, block_tables, axis=0).reshape(B, MB * BS, KV, hd)
+    rep = H // KV
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    scores = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    T = MB * BS
+    mask = jnp.arange(T)[None, None, :] < seq_lens[:, None, None]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bht,bthd->bhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def write_to_pool(k_pool, v_pool, block_tables, seq_lens, k_new, v_new):
+    """Append one token's K/V per sequence into the paged pools.
+
+    k_new/v_new: [B, KV, hd] for the token at position seq_lens[b] (0-based
+    position == current length before append). Returns updated pools.
+    """
+    B = k_new.shape[0]
+    BS = k_pool.shape[1]
+    pos = seq_lens                       # position to write
+    blk_idx = pos // BS                  # logical block
+    offset = pos % BS
+    phys = jnp.take_along_axis(block_tables, blk_idx[:, None],
+                               axis=1)[:, 0]          # [B]
+    k_pool = k_pool.at[phys, offset].set(k_new)
+    v_pool = v_pool.at[phys, offset].set(v_new)
+    return k_pool, v_pool
+
+
+class BlockManager:
+    """Host-side physical block allocator (reference: the block-table
+    bookkeeping AnalysisPredictor does around block_multihead_attention).
+    Not jitted — runs in the serving loop between steps."""
+
+    def __init__(self, num_blocks: int, block_size: int,
+                 max_blocks_per_seq: int):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.free = list(range(num_blocks - 1, -1, -1))
+        self.tables = {}            # seq_id -> list of physical block ids
+
+    def allocate(self, seq_id: int, num_tokens: int):
+        need = (num_tokens + self.block_size - 1) // self.block_size
+        table = self.tables.setdefault(seq_id, [])
+        while len(table) < need:
+            if not self.free:
+                raise RuntimeError("KV cache pool exhausted")
+            table.append(self.free.pop())
+        return table
+
+    def append_token(self, seq_id: int, cur_len: int):
+        """Ensure capacity for one more token; returns the table."""
+        return self.allocate(seq_id, cur_len + 1)
+
+    def release(self, seq_id: int):
+        for b in self.tables.pop(seq_id, []):
+            self.free.append(b)
+
+    def table_array(self, seq_ids) -> np.ndarray:
+        out = np.zeros((len(seq_ids), self.max_blocks_per_seq), np.int32)
+        for i, sid in enumerate(seq_ids):
+            t = self.tables.get(sid, [])
+            out[i, :len(t)] = t
+        return out

@@ -1,0 +1,25 @@
+"""Shared Pallas helpers."""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def no_x64(fn):
+    """Trace ``fn`` with x64 disabled.
+
+    paddle_tpu enables jax_enable_x64 globally for Paddle's int64/float64
+    dtype parity, but under x64 Mosaic emits i64 scalars in the kernel
+    wrapper that the TPU backend fails to legalize ("func.return (i32,
+    i64)" — 32-bit SREGs on v5e). Kernel inputs are all <=32-bit, so
+    tracing the pallas_call under x64=False is semantics-preserving and
+    makes the kernels compile on real chips.
+    """
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if jax.config.jax_enable_x64:
+            with jax.enable_x64(False):
+                return fn(*args, **kwargs)
+        return fn(*args, **kwargs)
+    return wrapper

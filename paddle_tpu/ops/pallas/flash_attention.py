@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._util import no_x64
+
 DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
 
@@ -89,6 +91,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, :] = (m_scr[:] + jnp.log(l_safe))[:, 0]
 
 
+@no_x64
 def _fwd(q, k, v, scale, causal):
     """q,k,v: [bh, s, d] fp32/bf16 → (o [bh, sq, d], lse [bh, sq])."""
     bh, sq, d = q.shape
@@ -107,11 +110,16 @@ def _fwd(q, k, v, scale, causal):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            # lse rides as (bh, 1, sq) with a squeezed bh block: Mosaic
+            # requires the block's last two dims to be (8,128)-divisible or
+            # equal to the array dims — (1, bq) vs (1, sq) satisfies that,
+            # (1, bq) vs (bh, sq) does not (splash-attention uses the same
+            # trick for its logsumexp output)
+            pl.BlockSpec((None, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -120,7 +128,7 @@ def _fwd(q, k, v, scale, causal):
         ],
         interpret=_interpret(),
     )(q, k, v)
-    return o, lse
+    return o, lse.reshape(bh, sq)
 
 
 # ---------------------------------------------------------------------------
@@ -214,6 +222,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, :, :] = dv_scr[:].astype(dv_ref.dtype)
 
 
+@no_x64
 def _bwd(scale, causal, res, do):
     q, k, v, o, lse = res
     bh, sq, d = q.shape
@@ -221,6 +230,10 @@ def _bwd(scale, causal, res, do):
     bq, bk = _block_sizes(sq, sk)
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)  # [bh, sq]
+    # (bh, 1, sq) layout for row statistics — see the lse out_spec note in
+    # _fwd
+    lse3 = lse.reshape(bh, 1, sq)
+    delta3 = delta.reshape(bh, 1, sq)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk),
@@ -230,14 +243,14 @@ def _bwd(scale, causal, res, do):
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((None, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((None, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta3)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk),
@@ -247,8 +260,8 @@ def _bwd(scale, causal, res, do):
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((None, 1, bq), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((None, 1, bq), lambda b, j, i: (b, 0, i)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
@@ -261,7 +274,7 @@ def _bwd(scale, causal, res, do):
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta3)
     return dq, dk, dv
 
 

@@ -16,6 +16,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._util import no_x64
+
 
 def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
@@ -39,6 +41,7 @@ def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, step_ref,
     v_out[:] = v_n
 
 
+@no_x64
 def fused_adamw(param, grad, moment1, moment2, lr, step,
                 beta1=0.9, beta2=0.999, epsilon=1e-8, weight_decay=0.01):
     """All tensors 1-D (flatten+concat upstream); lr/step scalars."""

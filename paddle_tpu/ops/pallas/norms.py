@@ -16,6 +16,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._util import no_x64
+
 
 def _interpret() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
@@ -25,7 +27,7 @@ def _rms_fwd_kernel(x_ref, w_ref, o_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)
     ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     inv = jax.lax.rsqrt(ms + eps)
-    o_ref[:] = (x * inv).astype(o_ref.dtype) * w_ref[:]
+    o_ref[:] = (x * inv).astype(o_ref.dtype) * w_ref[0, :]
 
 
 def _rms_rows(x):
@@ -38,6 +40,7 @@ def rms_norm_pallas(x, weight, epsilon=1e-6):
     return _rms_fwd(x, weight, epsilon)[0]
 
 
+@no_x64
 def _rms_fwd(x, weight, epsilon):
     orig_shape = x.shape
     d = x.shape[-1]
@@ -47,12 +50,14 @@ def _rms_fwd(x, weight, epsilon):
     out = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=epsilon),
         grid=(pl.cdiv(n, block),),
+        # weight rides as a (1, d) block: Mosaic requires >=2-D blocks with
+        # lane-aligned trailing dims; 1-D specs fail to legalize
         in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
-                  pl.BlockSpec((d,), lambda i: (0,))],
+                  pl.BlockSpec((1, d), lambda i: (0, 0))],
         out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
         interpret=_interpret(),
-    )(x2, weight)
+    )(x2, weight.reshape(1, d))
     return out.reshape(orig_shape), (x, weight)
 
 
@@ -81,9 +86,10 @@ def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
     mean = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=-1, keepdims=True)
     xhat = (x - mean) * jax.lax.rsqrt(var + eps)
-    o_ref[:] = xhat.astype(o_ref.dtype) * w_ref[:] + b_ref[:]
+    o_ref[:] = xhat.astype(o_ref.dtype) * w_ref[0, :] + b_ref[0, :]
 
 
+@no_x64
 def layer_norm_pallas(x, weight, bias, epsilon=1e-5):
     orig_shape = x.shape
     d = x.shape[-1]
@@ -94,10 +100,10 @@ def layer_norm_pallas(x, weight, bias, epsilon=1e-5):
         functools.partial(_ln_fwd_kernel, eps=epsilon),
         grid=(pl.cdiv(n, block),),
         in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
-                  pl.BlockSpec((d,), lambda i: (0,)),
-                  pl.BlockSpec((d,), lambda i: (0,))],
+                  pl.BlockSpec((1, d), lambda i: (0, 0)),
+                  pl.BlockSpec((1, d), lambda i: (0, 0))],
         out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
         interpret=_interpret(),
-    )(x2, weight, bias)
+    )(x2, weight.reshape(1, d), bias.reshape(1, d))
     return out.reshape(orig_shape)
