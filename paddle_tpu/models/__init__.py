@@ -2,3 +2,6 @@
 framework; in-repo reference models python/paddle/vision/models plus the
 incubate transformer stack)."""
 from . import llama  # noqa: F401
+from . import gpt  # noqa: F401
+from . import bert  # noqa: F401
+from . import vit  # noqa: F401

@@ -1,0 +1,70 @@
+"""Shared model-zoo helpers: mesh-axis resolution, masked cross entropy,
+the pre-norm transformer block, and init utilities."""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import layer_norm as fused_layer_norm
+from ..ops.flash_attention import flash_attention
+
+
+def resolve_mesh_axes(mesh: Mesh) -> Tuple[Optional[str], Optional[str]]:
+    """(fsdp, tp) axis names, honoring paddle-convention fallbacks
+    ('sharding' for fsdp, 'mp' for tp) like llama.param_shardings."""
+    have = set(mesh.axis_names)
+    fsdp = "fsdp" if "fsdp" in have else ("sharding"
+                                          if "sharding" in have else None)
+    tp = "tp" if "tp" in have else ("mp" if "mp" in have else None)
+    return fsdp, tp
+
+
+def spec_fn(mesh: Mesh):
+    """Returns s(*names) building a PartitionSpec restricted to mesh axes."""
+    have = set(mesh.axis_names)
+
+    def s(*names):
+        return P(*[n if n in have or n is None else None for n in names])
+
+    return s
+
+
+def normal_init(key, shape, std=0.02, dtype=jnp.bfloat16):
+    return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+
+def masked_cross_entropy(logits, labels) -> jax.Array:
+    """Token cross entropy in fp32; negative labels are ignored.
+    Shared by llama/gpt/bert losses (reference:
+    c_softmax_with_cross_entropy semantics with ignore_index)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    n = jnp.maximum(jnp.sum(valid), 1)
+    return -jnp.sum(jnp.where(valid, picked, 0.0)) / n
+
+
+def prenorm_block(lp, x, *, num_heads, head_dim, eps, causal):
+    """Pre-norm transformer block (GPT/ViT convention): LN → QKV →
+    flash attention → proj residual; LN → GELU MLP residual.
+    Layer params: ln1_w/b, qkv(+_b), proj(+_b), ln2_w/b, fc(+_b),
+    fc_out(+_b)."""
+    b, s, D = x.shape
+    h = fused_layer_norm(x, lp["ln1_w"].astype(x.dtype),
+                         lp["ln1_b"].astype(x.dtype), eps)
+    qkv = h @ lp["qkv"] + lp["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, num_heads, head_dim)
+    k = k.reshape(b, s, num_heads, head_dim)
+    v = v.reshape(b, s, num_heads, head_dim)
+    attn = flash_attention(q, k, v, causal=causal).reshape(b, s, D)
+    x = x + attn @ lp["proj"] + lp["proj_b"]
+    h = fused_layer_norm(x, lp["ln2_w"].astype(x.dtype),
+                         lp["ln2_b"].astype(x.dtype), eps)
+    ff = jax.nn.gelu(h @ lp["fc"] + lp["fc_b"])
+    x = x + ff @ lp["fc_out"] + lp["fc_out_b"]
+    return x

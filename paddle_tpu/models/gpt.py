@@ -1,0 +1,123 @@
+"""GPT-2/3-style decoder LM.
+
+Reference capability: the fleet GPT configs under
+test/collective/fleet/hybrid_strategy (the reference's standard
+hybrid-parallel benchmark model family) and python/paddle/incubate fused
+transformer blocks. Same TPU-first structure as models/llama.py: stacked
+layer params scanned by lax.scan, flash attention, sharding specs keyed on
+{fsdp, tp} mesh axes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import layer_norm as fused_layer_norm
+from ._common import (resolve_mesh_axes, spec_fn, normal_init,
+                      masked_cross_entropy, prenorm_block)
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 1024
+    layer_norm_epsilon: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+GPT_TINY = GPTConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
+                     num_hidden_layers=2, num_attention_heads=4,
+                     max_position_embeddings=128)
+
+
+def init_params(cfg: GPTConfig, key=None, dtype=None) -> Dict:
+    dtype = dtype or cfg.dtype
+    key = key if key is not None else jax.random.key(0)
+    D, F, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    L = cfg.num_hidden_layers
+    k = jax.random.split(key, 8)
+
+    def nrm(kk, shape):
+        return normal_init(kk, shape, dtype=dtype)
+
+    return {
+        "wte": nrm(k[0], (V, D)),
+        "wpe": nrm(k[1], (cfg.max_position_embeddings, D)),
+        "layers": {
+            "ln1_w": jnp.ones((L, D), jnp.float32),
+            "ln1_b": jnp.zeros((L, D), jnp.float32),
+            "qkv": nrm(k[2], (L, D, 3 * D)),
+            "qkv_b": jnp.zeros((L, 3 * D), dtype),
+            "proj": nrm(k[3], (L, D, D)),
+            "proj_b": jnp.zeros((L, D), dtype),
+            "ln2_w": jnp.ones((L, D), jnp.float32),
+            "ln2_b": jnp.zeros((L, D), jnp.float32),
+            "fc": nrm(k[4], (L, D, F)),
+            "fc_b": jnp.zeros((L, F), dtype),
+            "fc_out": nrm(k[5], (L, F, D)),
+            "fc_out_b": jnp.zeros((L, D), dtype),
+        },
+        "ln_f_w": jnp.ones((D,), jnp.float32),
+        "ln_f_b": jnp.zeros((D,), jnp.float32),
+    }
+
+
+def param_shardings(mesh: Mesh, cfg: GPTConfig) -> Dict:
+    fsdp, tp = resolve_mesh_axes(mesh)
+    s = spec_fn(mesh)
+
+    return {
+        "wte": s(tp, fsdp),
+        "wpe": s(None, fsdp),
+        "layers": {
+            "ln1_w": s(None, None), "ln1_b": s(None, None),
+            "qkv": s(None, fsdp, tp), "qkv_b": s(None, tp),
+            "proj": s(None, tp, fsdp), "proj_b": s(None, None),
+            "ln2_w": s(None, None), "ln2_b": s(None, None),
+            "fc": s(None, fsdp, tp), "fc_b": s(None, tp),
+            "fc_out": s(None, tp, fsdp), "fc_out_b": s(None, None),
+        },
+        "ln_f_w": s(None), "ln_f_b": s(None),
+    }
+
+
+def _block(lp, x, cfg: GPTConfig):
+    return prenorm_block(lp, x, num_heads=cfg.num_attention_heads,
+                         head_dim=cfg.head_dim,
+                         eps=cfg.layer_norm_epsilon, causal=True)
+
+
+def forward(params: Dict, tokens, cfg: GPTConfig) -> jax.Array:
+    b, s = tokens.shape
+    x = jnp.take(params["wte"], tokens, axis=0) + \
+        params["wpe"][:s][None, :, :]
+    body = partial(_block, cfg=cfg)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(carry, lp):
+        return body(lp, carry), None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    x = fused_layer_norm(x, params["ln_f_w"].astype(x.dtype),
+                         params["ln_f_b"].astype(x.dtype),
+                         cfg.layer_norm_epsilon)
+    return x @ params["wte"].T   # tied embeddings (GPT-2 convention)
+
+
+def loss_fn(params: Dict, tokens, labels, cfg: GPTConfig) -> jax.Array:
+    return masked_cross_entropy(forward(params, tokens, cfg), labels)

@@ -196,13 +196,8 @@ def forward(params: Dict, tokens, cfg: LlamaConfig,
 def loss_fn(params: Dict, tokens, labels, cfg: LlamaConfig) -> jax.Array:
     """Next-token cross entropy in fp32 (vocab-sharded logits stay sharded
     through the log-softmax under GSPMD)."""
-    logits = forward(params, tokens, cfg).astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    valid = labels >= 0
-    safe = jnp.where(valid, labels, 0)
-    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
-    n = jnp.maximum(jnp.sum(valid), 1)
-    return -jnp.sum(jnp.where(valid, picked, 0.0)) / n
+    from ._common import masked_cross_entropy
+    return masked_cross_entropy(forward(params, tokens, cfg), labels)
 
 
 def build_forward(cfg: LlamaConfig, key=None):

@@ -1,0 +1,145 @@
+"""Vision Transformer.
+
+Reference capability: python/paddle/vision models family (the reference
+ships CNN backbones in paddle.vision and ViT via PaddleClas configs built
+on paddle.nn). TPU-first: patchify as a single strided conv
+(lax.conv_general_dilated maps straight onto the MXU), scanned encoder
+layers, flash attention over patch tokens.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import layer_norm as fused_layer_norm
+from ._common import (resolve_mesh_axes, spec_fn, normal_init,
+                      prenorm_block)
+
+
+@dataclasses.dataclass
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_channels: int = 3
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    num_classes: int = 1000
+    layer_norm_epsilon: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_patches(self):
+        return (self.image_size // self.patch_size) ** 2
+
+
+VIT_TINY = ViTConfig(image_size=32, patch_size=8, hidden_size=64,
+                     intermediate_size=128, num_hidden_layers=2,
+                     num_attention_heads=4, num_classes=10)
+
+
+def init_params(cfg: ViTConfig, key=None, dtype=None) -> Dict:
+    dtype = dtype or cfg.dtype
+    key = key if key is not None else jax.random.key(0)
+    D, F = cfg.hidden_size, cfg.intermediate_size
+    L = cfg.num_hidden_layers
+    P_, C = cfg.patch_size, cfg.num_channels
+    k = jax.random.split(key, 8)
+
+    def nrm(kk, shape):
+        return normal_init(kk, shape, dtype=dtype)
+
+    return {
+        "patch_w": nrm(k[0], (D, C, P_, P_)),     # OIHW conv kernel
+        "patch_b": jnp.zeros((D,), dtype),
+        "cls": nrm(k[1], (1, 1, D)),
+        "pos_emb": nrm(k[2], (cfg.num_patches + 1, D)),
+        "layers": {
+            "ln1_w": jnp.ones((L, D), jnp.float32),
+            "ln1_b": jnp.zeros((L, D), jnp.float32),
+            "qkv": nrm(k[3], (L, D, 3 * D)),
+            "qkv_b": jnp.zeros((L, 3 * D), dtype),
+            "proj": nrm(k[4], (L, D, D)),
+            "proj_b": jnp.zeros((L, D), dtype),
+            "ln2_w": jnp.ones((L, D), jnp.float32),
+            "ln2_b": jnp.zeros((L, D), jnp.float32),
+            "fc": nrm(k[5], (L, D, F)),
+            "fc_b": jnp.zeros((L, F), dtype),
+            "fc_out": nrm(k[6], (L, F, D)),
+            "fc_out_b": jnp.zeros((L, D), dtype),
+        },
+        "ln_f_w": jnp.ones((D,), jnp.float32),
+        "ln_f_b": jnp.zeros((D,), jnp.float32),
+        "head_w": nrm(k[7], (D, cfg.num_classes)),
+        "head_b": jnp.zeros((cfg.num_classes,), dtype),
+    }
+
+
+def param_shardings(mesh: Mesh, cfg: ViTConfig) -> Dict:
+    fsdp, tp = resolve_mesh_axes(mesh)
+    s = spec_fn(mesh)
+
+    return {
+        "patch_w": s(tp, None, None, None), "patch_b": s(tp),
+        "cls": s(None, None, None), "pos_emb": s(None, fsdp),
+        "layers": {
+            "ln1_w": s(None, None), "ln1_b": s(None, None),
+            "qkv": s(None, fsdp, tp), "qkv_b": s(None, tp),
+            "proj": s(None, tp, fsdp), "proj_b": s(None, None),
+            "ln2_w": s(None, None), "ln2_b": s(None, None),
+            "fc": s(None, fsdp, tp), "fc_b": s(None, tp),
+            "fc_out": s(None, tp, fsdp), "fc_out_b": s(None, None),
+        },
+        "ln_f_w": s(None), "ln_f_b": s(None),
+        "head_w": s(fsdp, tp), "head_b": s(tp),
+    }
+
+
+def _block(lp, x, cfg: ViTConfig):
+    return prenorm_block(lp, x, num_heads=cfg.num_attention_heads,
+                         head_dim=cfg.head_dim,
+                         eps=cfg.layer_norm_epsilon, causal=False)
+
+
+def forward(params: Dict, images, cfg: ViTConfig) -> jax.Array:
+    """images [B, C, H, W] → logits [B, num_classes]."""
+    x = jax.lax.conv_general_dilated(
+        images.astype(params["patch_w"].dtype), params["patch_w"],
+        window_strides=(cfg.patch_size, cfg.patch_size), padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    b, D, gh, gw = x.shape
+    x = x.reshape(b, D, gh * gw).transpose(0, 2, 1) + params["patch_b"]
+    cls = jnp.broadcast_to(params["cls"].astype(x.dtype), (b, 1, D))
+    x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_emb"][None]
+
+    body = partial(_block, cfg=cfg)
+    if cfg.remat:
+        body = jax.checkpoint(body)
+
+    def scan_fn(carry, lp):
+        return body(lp, carry), None
+
+    x, _ = jax.lax.scan(scan_fn, x, params["layers"])
+    x = fused_layer_norm(x, params["ln_f_w"].astype(x.dtype),
+                         params["ln_f_b"].astype(x.dtype),
+                         cfg.layer_norm_epsilon)
+    return x[:, 0] @ params["head_w"] + params["head_b"]
+
+
+def loss_fn(params: Dict, images, labels, cfg: ViTConfig) -> jax.Array:
+    logits = forward(params, images, cfg).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return -jnp.mean(picked)
