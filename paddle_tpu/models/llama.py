@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.rope import build_rope_cache, apply_rope
+from ._common import masked_cross_entropy as _masked_cross_entropy
 from ..ops import rms_norm as fused_rms_norm, swiglu as fused_swiglu
 from ..ops.flash_attention import flash_attention
 
@@ -196,8 +197,7 @@ def forward(params: Dict, tokens, cfg: LlamaConfig,
 def loss_fn(params: Dict, tokens, labels, cfg: LlamaConfig) -> jax.Array:
     """Next-token cross entropy in fp32 (vocab-sharded logits stay sharded
     through the log-softmax under GSPMD)."""
-    from ._common import masked_cross_entropy
-    return masked_cross_entropy(forward(params, tokens, cfg), labels)
+    return _masked_cross_entropy(forward(params, tokens, cfg), labels)
 
 
 def build_forward(cfg: LlamaConfig, key=None):

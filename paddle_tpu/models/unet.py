@@ -1,0 +1,266 @@
+"""Stable-Diffusion-style UNet with cross-attention (BASELINE config 6).
+
+Reference capability: ppdiffusers UNet2DConditionModel running on the
+reference's CINN static path; here the whole denoise step jit-compiles to
+one XLA program (the CINN-slot is XLA itself, SURVEY §2.6 item 7).
+TPU notes: GroupNorm+SiLU+conv chains fuse in XLA; attention blocks use the
+flash kernel over flattened spatial tokens; keep channel counts multiples
+of 128 at the attention levels for MXU tiling.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, List, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..ops.flash_attention import flash_attention
+
+
+@dataclasses.dataclass
+class UNetConfig:
+    in_channels: int = 4
+    out_channels: int = 4
+    model_channels: int = 320
+    channel_mult: Tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 2
+    attention_levels: Tuple[int, ...] = (1, 2, 3)   # levels with attention
+    num_heads: int = 8
+    context_dim: int = 768           # text-encoder hidden size
+    groups: int = 32
+
+
+UNET_TINY = UNetConfig(model_channels=32, channel_mult=(1, 2),
+                       num_res_blocks=1, attention_levels=(1,),
+                       num_heads=2, context_dim=32, groups=8)
+
+
+def timestep_embedding(t, dim):
+    """Sinusoidal timestep embedding (DDPM convention)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(10000.0) * jnp.arange(half) / half)
+    args = jnp.asarray(t)[:, None].astype(jnp.float32) * freqs[None]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+class ResBlock(nn.Layer):
+    def __init__(self, in_ch, out_ch, time_dim, groups):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(min(groups, in_ch), in_ch)
+        self.conv1 = nn.Conv2D(in_ch, out_ch, 3, padding=1)
+        self.time_proj = nn.Linear(time_dim, out_ch)
+        self.norm2 = nn.GroupNorm(min(groups, out_ch), out_ch)
+        self.conv2 = nn.Conv2D(out_ch, out_ch, 3, padding=1)
+        self.act = nn.Silu()
+        self.skip = (nn.Conv2D(in_ch, out_ch, 1)
+                     if in_ch != out_ch else None)
+
+    def forward(self, x, temb):
+        h = self.conv1(self.act(self.norm1(x)))
+        h = h + self.time_proj(self.act(temb))[:, :, None, None]
+        h = self.conv2(self.act(self.norm2(h)))
+        return h + (self.skip(x) if self.skip is not None else x)
+
+
+class SpatialTransformer(nn.Layer):
+    """Self-attn + cross-attn + GEGLU ff over flattened spatial tokens
+    (the ppdiffusers BasicTransformerBlock shape)."""
+
+    def __init__(self, channels, num_heads, context_dim):
+        super().__init__()
+        self.norm_in = nn.GroupNorm(min(32, channels), channels)
+        self.proj_in = nn.Conv2D(channels, channels, 1)
+        self.ln1 = nn.LayerNorm(channels)
+        self.self_q = nn.Linear(channels, channels, bias_attr=False)
+        self.self_k = nn.Linear(channels, channels, bias_attr=False)
+        self.self_v = nn.Linear(channels, channels, bias_attr=False)
+        self.self_o = nn.Linear(channels, channels)
+        self.ln2 = nn.LayerNorm(channels)
+        self.cross_q = nn.Linear(channels, channels, bias_attr=False)
+        self.cross_k = nn.Linear(context_dim, channels, bias_attr=False)
+        self.cross_v = nn.Linear(context_dim, channels, bias_attr=False)
+        self.cross_o = nn.Linear(channels, channels)
+        self.ln3 = nn.LayerNorm(channels)
+        self.ff1 = nn.Linear(channels, channels * 8)     # GEGLU: 2*4x
+        self.ff2 = nn.Linear(channels * 4, channels)
+        self.proj_out = nn.Conv2D(channels, channels, 1)
+        self.num_heads = num_heads
+        self.channels = channels
+
+    def _attend(self, q, k, v):
+        # pass the ORIGINAL Tensors to dispatch (rewrapping raw values
+        # would detach the tape and freeze the QKV projections); reshapes
+        # happen inside the traced fn
+        H = self.num_heads
+        b, sq, C = q.shape
+        sk = k.shape[1]
+        hd = C // H
+        from ..core.tensor import dispatch
+        return dispatch(
+            lambda qq, kk, vy: flash_attention(
+                qq.reshape(b, sq, H, hd), kk.reshape(b, sk, H, hd),
+                vy.reshape(b, sk, H, hd), causal=False).reshape(b, sq, C),
+            (q, k, v), name="attention")
+
+    def forward(self, x, context):
+        b, c, h, w = x.shape
+        residual = x
+        hx = self.proj_in(self.norm_in(x))
+        tokens = hx.transpose([0, 2, 3, 1]).reshape([b, h * w, c])
+        t = self.ln1(tokens)
+        tokens = tokens + self.self_o(
+            self._attend(self.self_q(t), self.self_k(t), self.self_v(t)))
+        t = self.ln2(tokens)
+        tokens = tokens + self.cross_o(
+            self._attend(self.cross_q(t), self.cross_k(context),
+                         self.cross_v(context)))
+        t = self.ln3(tokens)
+        ff = self.ff1(t)
+        gate, val = ff.chunk(2, axis=-1)
+        from ..nn import functional as F
+        tokens = tokens + self.ff2(F.gelu(gate) * val)
+        hx = tokens.reshape([b, h, w, c]).transpose([0, 3, 1, 2])
+        return residual + self.proj_out(hx)
+
+
+class Downsample(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2D(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.conv(x)
+
+
+class Upsample2x(nn.Layer):
+    def __init__(self, ch):
+        super().__init__()
+        self.up = nn.Upsample(scale_factor=2, mode="nearest")
+        self.conv = nn.Conv2D(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(self.up(x))
+
+
+class UNetModel(nn.Layer):
+    """reference: ppdiffusers UNet2DConditionModel (conditioned denoiser
+    eps = f(x_t, t, text_context))."""
+
+    def __init__(self, cfg: UNetConfig = UNET_TINY):
+        super().__init__()
+        self.cfg = cfg
+        ch = cfg.model_channels
+        time_dim = ch * 4
+        self.time_mlp1 = nn.Linear(ch, time_dim)
+        self.time_mlp2 = nn.Linear(time_dim, time_dim)
+        self.act = nn.Silu()
+        self.conv_in = nn.Conv2D(cfg.in_channels, ch, 3, padding=1)
+
+        # down path
+        self.down_blocks = nn.LayerList()
+        self.down_attns = nn.LayerList()
+        self.downsamplers = nn.LayerList()
+        chans = [ch]
+        cur = ch
+        for level, mult in enumerate(cfg.channel_mult):
+            out_ch = ch * mult
+            blocks = nn.LayerList()
+            attns = nn.LayerList()
+            for _ in range(cfg.num_res_blocks):
+                blocks.append(ResBlock(cur, out_ch, time_dim, cfg.groups))
+                attns.append(SpatialTransformer(out_ch, cfg.num_heads,
+                                                cfg.context_dim)
+                             if level in cfg.attention_levels else None)
+                cur = out_ch
+                chans.append(cur)
+            self.down_blocks.append(blocks)
+            self.down_attns.append(attns)
+            if level != len(cfg.channel_mult) - 1:
+                self.downsamplers.append(Downsample(cur))
+                chans.append(cur)
+            else:
+                self.downsamplers.append(None)
+
+        # middle
+        self.mid_res1 = ResBlock(cur, cur, time_dim, cfg.groups)
+        self.mid_attn = SpatialTransformer(cur, cfg.num_heads,
+                                           cfg.context_dim)
+        self.mid_res2 = ResBlock(cur, cur, time_dim, cfg.groups)
+
+        # up path
+        self.up_blocks = nn.LayerList()
+        self.up_attns = nn.LayerList()
+        self.upsamplers = nn.LayerList()
+        for level, mult in reversed(list(enumerate(cfg.channel_mult))):
+            out_ch = ch * mult
+            blocks = nn.LayerList()
+            attns = nn.LayerList()
+            for _ in range(cfg.num_res_blocks + 1):
+                skip_ch = chans.pop()
+                blocks.append(ResBlock(cur + skip_ch, out_ch, time_dim,
+                                       cfg.groups))
+                attns.append(SpatialTransformer(out_ch, cfg.num_heads,
+                                                cfg.context_dim)
+                             if level in cfg.attention_levels else None)
+                cur = out_ch
+            self.up_blocks.append(blocks)
+            self.up_attns.append(attns)
+            self.upsamplers.append(Upsample2x(cur) if level != 0 else None)
+
+        self.norm_out = nn.GroupNorm(min(cfg.groups, cur), cur)
+        self.conv_out = nn.Conv2D(cur, cfg.out_channels, 3, padding=1)
+
+    def forward(self, x, timesteps, context):
+        cfg = self.cfg
+        temb = Tensor(timestep_embedding(
+            timesteps._value if isinstance(timesteps, Tensor) else timesteps,
+            cfg.model_channels))
+        temb = self.time_mlp2(self.act(self.time_mlp1(temb)))
+
+        h = self.conv_in(x)
+        skips = [h]
+        for blocks, attns, down in zip(self.down_blocks, self.down_attns,
+                                       self.downsamplers):
+            for blk, attn in zip(blocks, attns):
+                h = blk(h, temb)
+                if attn is not None:
+                    h = attn(h, context)
+                skips.append(h)
+            if down is not None:
+                h = down(h)
+                skips.append(h)
+
+        h = self.mid_res1(h, temb)
+        h = self.mid_attn(h, context)
+        h = self.mid_res2(h, temb)
+
+        from ..tensor.manipulation import concat
+        for blocks, attns, up in zip(self.up_blocks, self.up_attns,
+                                     self.upsamplers):
+            for blk, attn in zip(blocks, attns):
+                h = concat([h, skips.pop()], axis=1)
+                h = blk(h, temb)
+                if attn is not None:
+                    h = attn(h, context)
+            if up is not None:
+                h = up(h)
+
+        return self.conv_out(self.act(self.norm_out(h)))
+
+
+def ddim_step(unet, x_t, t, t_prev, context, alphas_cumprod):
+    """One DDIM denoise step x_t → x_{t_prev} (eta=0).
+    alphas_cumprod: [T] numpy/jax array of the scheduler's ᾱ."""
+    eps = unet(x_t, jnp.full((x_t.shape[0],), t, jnp.int32), context)
+    eps_v = eps._value if isinstance(eps, Tensor) else eps
+    x_v = x_t._value if isinstance(x_t, Tensor) else x_t
+    a_t = alphas_cumprod[t]
+    a_prev = alphas_cumprod[t_prev] if t_prev >= 0 else jnp.asarray(1.0)
+    x0 = (x_v - jnp.sqrt(1 - a_t) * eps_v) / jnp.sqrt(a_t)
+    x_prev = jnp.sqrt(a_prev) * x0 + jnp.sqrt(1 - a_prev) * eps_v
+    return Tensor(x_prev.astype(x_v.dtype))   # keep model dtype under x64

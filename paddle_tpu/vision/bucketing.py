@@ -1,0 +1,75 @@
+"""Shape bucketing for dynamic-size inputs on a static-shape compiler.
+
+Reference context: PP-YOLOE (BASELINE config 5) trains/serves with
+dynamic-shape convs on GPU. XLA compiles one program per shape, so the
+TPU-native policy (SURVEY §7 hard part (d)) is: quantize input sizes to a
+small bucket set, pad up to the chosen bucket, and reuse the cached
+executable — unbounded dynamic shapes become O(#buckets) compiles.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ShapeBucketer", "DEFAULT_DET_BUCKETS"]
+
+# multi-scale training sizes used by the PP-YOLOE family configs
+DEFAULT_DET_BUCKETS = (320, 416, 512, 608, 640, 768)
+
+
+class ShapeBucketer:
+    """Pads images up to the smallest bucket that fits.
+
+    Buckets are square sides by default (detection convention) or explicit
+    (h, w) pairs. Returns the padded batch plus per-image scale/pad info so
+    predictions can be mapped back to original coordinates.
+    """
+
+    def __init__(self, buckets: Iterable = DEFAULT_DET_BUCKETS,
+                 pad_value: float = 114.0 / 255.0):
+        norm: List[Tuple[int, int]] = []
+        for b in buckets:
+            if isinstance(b, (tuple, list)):
+                norm.append((int(b[0]), int(b[1])))
+            else:
+                norm.append((int(b), int(b)))
+        self.buckets = sorted(norm, key=lambda hw: hw[0] * hw[1])
+        self.pad_value = pad_value
+
+    def choose(self, h: int, w: int) -> Tuple[int, int]:
+        for bh, bw in self.buckets:
+            if h <= bh and w <= bw:
+                return bh, bw
+        return self.buckets[-1]
+
+    def pad_image(self, img: np.ndarray, target: Tuple[int, int] = None):
+        """img [C, H, W] → (padded [C, BH, BW], scale, (pad_h, pad_w)).
+        If the image exceeds every bucket it is scaled down first.
+        ``target`` overrides bucket choice (used by pad_batch)."""
+        c, h, w = img.shape
+        bh, bw = target if target is not None else self.choose(h, w)
+        scale = min(bh / h, bw / w, 1.0)
+        if scale < 1.0:
+            nh, nw = int(h * scale), int(w * scale)
+            ys = (np.arange(nh) / scale).astype(np.int64).clip(0, h - 1)
+            xs = (np.arange(nw) / scale).astype(np.int64).clip(0, w - 1)
+            img = img[:, ys][:, :, xs]
+            h, w = nh, nw
+        out = np.full((c, bh, bw), self.pad_value, img.dtype)
+        out[:, :h, :w] = img
+        return out, scale, (bh - h, bw - w)
+
+    def pad_batch(self, images: Sequence[np.ndarray]):
+        """List of [C, H, W] → single padded batch at the max bucket among
+        the batch; returns (batch [N,C,BH,BW], scales [N], pads [N,2])."""
+        chosen = [self.choose(im.shape[1], im.shape[2]) for im in images]
+        bh = max(c[0] for c in chosen)
+        bw = max(c[1] for c in chosen)
+        outs, scales, pads = [], [], []
+        for im in images:
+            o, s, p = self.pad_image(im, target=(bh, bw))
+            outs.append(o)
+            scales.append(s)
+            pads.append(p)
+        return np.stack(outs), np.asarray(scales), np.asarray(pads)

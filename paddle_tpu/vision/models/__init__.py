@@ -4,3 +4,5 @@ from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa
 from .lenet import LeNet  # noqa: F401
 from .vgg import VGG, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .ppyoloe import (PPYOLOE, ppyoloe_s, ppyoloe_tiny,  # noqa: F401
+                      multiclass_nms)

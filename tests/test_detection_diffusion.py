@@ -1,0 +1,141 @@
+"""PP-YOLOE detector (BASELINE config 5) and Stable-Diffusion UNet
+(config 6) tests."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models.unet import (UNetModel, UNET_TINY, UNetConfig,
+                                    ddim_step, timestep_embedding)
+from paddle_tpu.vision.models.ppyoloe import ppyoloe_tiny, multiclass_nms
+from paddle_tpu.vision.bucketing import ShapeBucketer
+
+
+# -- UNet -------------------------------------------------------------------
+def test_unet_forward_backward():
+    net = UNetModel(UNET_TINY)
+    x = paddle.to_tensor(np.random.randn(2, 4, 16, 16).astype(np.float32))
+    t = paddle.to_tensor(np.array([10, 500], np.int32))
+    ctx = paddle.to_tensor(np.random.randn(2, 8, 32).astype(np.float32))
+    out = net(x, t, ctx)
+    assert list(out.shape) == [2, 4, 16, 16]
+    loss = (out * out).mean()
+    loss.backward()
+    g = net.parameters()[0].grad
+    assert g is not None and np.isfinite(np.asarray(g.numpy())).all()
+
+
+def test_unet_attention_qkv_receive_gradients():
+    """Regression: _attend must keep the tape attached — QKV projections
+    previously got no grad (frozen at init)."""
+    net = UNetModel(UNET_TINY)
+    x = paddle.to_tensor(np.random.randn(1, 4, 16, 16).astype(np.float32))
+    t = paddle.to_tensor(np.array([10], np.int32))
+    ctx = paddle.to_tensor(np.random.randn(1, 8, 32).astype(np.float32))
+    (net(x, t, ctx) ** 2).mean().backward()
+    for name in ("self_q", "self_k", "self_v", "cross_q", "cross_k",
+                 "cross_v"):
+        w = getattr(net.mid_attn, name).weight
+        assert w.grad is not None, f"{name} has no grad"
+        assert float(np.abs(np.asarray(w.grad.numpy())).sum()) > 0, name
+
+
+def test_unet_context_conditioning_matters():
+    net = UNetModel(UNET_TINY)
+    net.eval()
+    x = paddle.to_tensor(np.random.randn(1, 4, 16, 16).astype(np.float32))
+    t = paddle.to_tensor(np.array([100], np.int32))
+    c1 = paddle.to_tensor(np.zeros((1, 8, 32), np.float32))
+    c2 = paddle.to_tensor(np.ones((1, 8, 32), np.float32))
+    o1 = net(x, t, c1).numpy()
+    o2 = net(x, t, c2).numpy()
+    assert not np.allclose(o1, o2)   # cross-attn actually conditions
+
+
+def test_timestep_embedding_distinct():
+    e = timestep_embedding(jnp.array([0, 1, 500]), 32)
+    assert e.shape == (3, 32)
+    assert not np.allclose(np.asarray(e[0]), np.asarray(e[2]))
+
+
+def test_ddim_chain_finite():
+    net = UNetModel(UNET_TINY)
+    net.eval()
+    ac = jnp.linspace(0.999, 0.01, 1000)
+    x = paddle.to_tensor(np.random.randn(1, 4, 16, 16).astype(np.float32))
+    ctx = paddle.to_tensor(np.random.randn(1, 8, 32).astype(np.float32))
+    with paddle.no_grad():
+        for t, tp in [(900, 600), (600, 300), (300, -1)]:
+            x = ddim_step(net, x, t, tp, ctx, ac)
+    assert np.isfinite(np.asarray(x.numpy())).all()
+
+
+# -- PP-YOLOE ---------------------------------------------------------------
+def test_ppyoloe_forward_shapes():
+    net = ppyoloe_tiny(num_classes=4)
+    net.eval()
+    x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype(np.float32))
+    scores, boxes = net(x)
+    A = 8 * 8 + 4 * 4 + 2 * 2
+    assert list(scores.shape) == [1, A, 4]
+    assert list(boxes.shape) == [1, A, 4]
+    s = np.asarray(scores.numpy())
+    assert (s >= 0).all() and (s <= 1).all()   # sigmoid scores
+
+
+def test_ppyoloe_grad_flows_to_backbone():
+    net = ppyoloe_tiny(num_classes=2)
+    x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype(np.float32))
+    scores, boxes = net(x)
+    (scores.sum() + boxes.sum() * 0.001).backward()
+    stem_w = net.backbone.stem[0].conv.weight
+    assert stem_w.grad is not None
+    assert float(np.abs(np.asarray(stem_w.grad.numpy())).sum()) > 0
+
+
+def test_ppyoloe_bucketed_shapes_compile_once_each():
+    """Two different buckets → two compiles; same bucket reuses (the
+    static-shape policy for dynamic-shape detection)."""
+    net = ppyoloe_tiny(num_classes=2)
+    net.eval()
+    b = ShapeBucketer(buckets=(64, 96))
+    imgs = [np.random.randn(3, 50, 60).astype(np.float32),
+            np.random.randn(3, 80, 90).astype(np.float32),
+            np.random.randn(3, 33, 64).astype(np.float32)]
+    seen = set()
+    for im in imgs:
+        padded, scale, pad = b.pad_image(im)
+        seen.add(padded.shape)
+        scores, boxes = net(paddle.to_tensor(padded[None]))
+        assert np.isfinite(np.asarray(scores.numpy())).all()
+    assert seen == {(3, 64, 64), (3, 96, 96)}
+
+
+def test_nms_suppresses_overlaps():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    sc = np.zeros((3, 2), np.float32)
+    sc[:, 0] = scores
+    dets = multiclass_nms(sc, boxes, score_threshold=0.5,
+                          iou_threshold=0.5)
+    # second box overlaps first → suppressed; distinct box kept
+    assert dets.shape == (2, 6)
+    assert set(dets[:, 0]) == {0.0}
+    assert 0.9 in dets[:, 1] and 0.7 in dets[:, 1]
+    # same boxes in DIFFERENT classes are not cross-suppressed
+    sc2 = np.zeros((3, 2), np.float32)
+    sc2[0, 0] = 0.9
+    sc2[1, 1] = 0.8
+    dets2 = multiclass_nms(sc2, boxes, score_threshold=0.5,
+                           iou_threshold=0.5)
+    assert dets2.shape == (2, 6)
+
+
+def test_bucketer_oversize_downscales():
+    b = ShapeBucketer(buckets=(64,))
+    img = np.random.randn(3, 100, 200).astype(np.float32)
+    padded, scale, pad = b.pad_image(img)
+    assert padded.shape == (3, 64, 64)
+    assert scale == pytest.approx(64 / 200)
