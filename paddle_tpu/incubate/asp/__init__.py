@@ -1,0 +1,113 @@
+"""Automatic SParsity (2:4 structured sparsity).
+
+reference: python/paddle/incubate/asp/ — create 2:4 masks
+(utils.py create_mask / check_mask_2d), prune_model, and the
+mask-preserving optimizer decoration so pruned weights stay zero through
+training. On TPU there is no sparse-tensor-core analog today, so the
+mask enforces the sparsity *pattern* (model-compression capability
+parity); compute runs dense.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, to_value
+from ... import nn
+
+__all__ = ["create_mask", "check_mask_1d", "prune_model", "decorate",
+           "reset_excluded_layers", "set_excluded_layers"]
+
+_EXCLUDED: set = set()
+
+
+def create_mask(weight, n: int = 2, m: int = 4) -> np.ndarray:
+    """n:m mask along the LAST axis: keep the n largest |w| of every
+    group of m (reference: asp/utils.py get_mask_1d)."""
+    v = np.asarray(to_value(weight))
+    orig_shape = v.shape
+    last = orig_shape[-1]
+    pad = (-last) % m
+    if pad:
+        v = np.concatenate(
+            [v, np.zeros(orig_shape[:-1] + (pad,), v.dtype)], axis=-1)
+    groups = v.reshape(-1, m)
+    order = np.argsort(-np.abs(groups), axis=1)
+    mask = np.zeros_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[:, :n], True, axis=1)
+    mask = mask.reshape(v.shape)
+    if pad:
+        mask = mask[..., :last]
+    return mask
+
+
+def check_mask_1d(mat, n: int = 2, m: int = 4) -> bool:
+    """True iff every group of m along the last axis has ≤ n nonzeros
+    (reference: asp/utils.py check_mask_1d)."""
+    v = np.asarray(to_value(mat))
+    last = v.shape[-1]
+    pad = (-last) % m
+    if pad:
+        v = np.concatenate(
+            [v, np.zeros(v.shape[:-1] + (pad,), v.dtype)], axis=-1)
+    groups = (v.reshape(-1, m) != 0).sum(axis=1)
+    return bool((groups <= n).all())
+
+
+def set_excluded_layers(layer_names: List[str]):
+    _EXCLUDED.update(layer_names)
+
+
+def reset_excluded_layers():
+    _EXCLUDED.clear()
+
+
+def _prunable(name: str, layer) -> bool:
+    return isinstance(layer, nn.Linear) and name not in _EXCLUDED
+
+
+def prune_model(model: nn.Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d") -> Dict[str, np.ndarray]:
+    """Apply n:m masks to every prunable layer's weight in place; returns
+    {layer_name: mask} (reference: asp/asp.py prune_model)."""
+    masks: Dict[str, np.ndarray] = {}
+    for name, layer in model.named_sublayers():
+        if not _prunable(name, layer):
+            continue
+        mask = create_mask(layer.weight, n, m)
+        layer.weight._value = layer.weight._value * jnp.asarray(
+            mask, layer.weight._value.dtype)
+        masks[name] = mask
+    model._asp_masks = masks
+    return masks
+
+
+def decorate(optimizer, model: Optional[nn.Layer] = None):
+    """Wrap optimizer.step to re-apply masks after each update, so pruned
+    weights stay pruned (reference: asp/asp.py decorate + OptimizerWithSparsityGuarantee)."""
+
+    # resolve (layer, mask) pairs once — layer identity is static after
+    # prune_model, and per-step named_sublayers() traversal is hot-path
+    # overhead
+    pairs = []
+    if model is not None and hasattr(model, "_asp_masks"):
+        by_name = dict(model.named_sublayers())
+        pairs = [(by_name[n], m) for n, m in model._asp_masks.items()
+                 if n in by_name]
+
+    class _ASPOptimizer:
+        def __init__(self, opt):
+            self._opt = opt
+
+        def __getattr__(self, item):
+            return getattr(self._opt, item)
+
+        def step(self):
+            self._opt.step()
+            for layer, mask in pairs:
+                layer.weight._value = layer.weight._value * jnp.asarray(
+                    mask, layer.weight._value.dtype)
+
+    return _ASPOptimizer(optimizer)

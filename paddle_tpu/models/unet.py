@@ -217,9 +217,9 @@ class UNetModel(nn.Layer):
 
     def forward(self, x, timesteps, context):
         cfg = self.cfg
-        temb = Tensor(timestep_embedding(
-            timesteps._value if isinstance(timesteps, Tensor) else timesteps,
-            cfg.model_channels))
+        from ..core.tensor import to_value
+        temb = Tensor(timestep_embedding(to_value(timesteps),
+                                         cfg.model_channels))
         temb = self.time_mlp2(self.act(self.time_mlp1(temb)))
 
         h = self.conv_in(x)
@@ -257,8 +257,9 @@ def ddim_step(unet, x_t, t, t_prev, context, alphas_cumprod):
     """One DDIM denoise step x_t → x_{t_prev} (eta=0).
     alphas_cumprod: [T] numpy/jax array of the scheduler's ᾱ."""
     eps = unet(x_t, jnp.full((x_t.shape[0],), t, jnp.int32), context)
-    eps_v = eps._value if isinstance(eps, Tensor) else eps
-    x_v = x_t._value if isinstance(x_t, Tensor) else x_t
+    from ..core.tensor import to_value
+    eps_v = to_value(eps)
+    x_v = to_value(x_t)
     a_t = alphas_cumprod[t]
     a_prev = alphas_cumprod[t_prev] if t_prev >= 0 else jnp.asarray(1.0)
     x0 = (x_v - jnp.sqrt(1 - a_t) * eps_v) / jnp.sqrt(a_t)

@@ -1,0 +1,24 @@
+"""paddle_tpu.quantization — QAT / PTQ framework.
+
+reference: python/paddle/quantization/ (QuantConfig at config.py, QAT at
+qat.py, PTQ at ptq.py, observers/, quanters/). Flow parity:
+  QAT:  config → qat.quantize(model) wraps layers with fake quanters →
+        train → qat.convert(model) bakes int8 weights + scales
+  PTQ:  config → ptq.quantize(model) inserts observers → run calibration
+        batches → ptq.convert(model) → int8 deploy layers
+On TPU the deploy path runs int8×int8→int32 dot_generals on the MXU.
+"""
+from .observers import (BaseObserver, AbsmaxObserver,
+                        MovingAverageAbsmaxObserver,
+                        PerChannelAbsmaxObserver, PercentileObserver)
+from .quanters import (fake_quant, FakeQuanterWithAbsMax, quantize_to_int8,
+                       int8_matmul)
+from .qat import QAT, PTQ, QuantConfig, QuantedLinear, Int8Linear
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ", "QuantedLinear", "Int8Linear",
+    "BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+    "PerChannelAbsmaxObserver", "PercentileObserver",
+    "fake_quant", "FakeQuanterWithAbsMax", "quantize_to_int8",
+    "int8_matmul",
+]

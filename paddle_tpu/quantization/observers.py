@@ -1,0 +1,111 @@
+"""Calibration observers.
+
+reference: python/paddle/quantization/observers/ (AbsmaxObserver,
+AVGObserver, HistObserver…) — collect activation/weight statistics during
+calibration and produce quantization scales.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_value
+
+__all__ = ["BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
+           "PerChannelAbsmaxObserver", "PercentileObserver"]
+
+
+class BaseObserver:
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self.qmax = float(2 ** (quant_bits - 1) - 1)
+
+    def observe(self, x) -> None:
+        raise NotImplementedError
+
+    def scale(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def __call__(self, x):
+        self.observe(x)
+        return x
+
+
+class AbsmaxObserver(BaseObserver):
+    """scale = max |x| seen / qmax."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self._absmax = 0.0
+
+    def observe(self, x):
+        v = np.asarray(to_value(x))
+        self._absmax = max(self._absmax, float(np.abs(v).max(initial=0.0)))
+
+    def scale(self):
+        return np.float32(max(self._absmax, 1e-8) / self.qmax)
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    """EMA of per-batch absmax (reference: AVGObserver / moving-average
+    absmax used for activations in QAT)."""
+
+    def __init__(self, quant_bits: int = 8, momentum: float = 0.9):
+        super().__init__(quant_bits)
+        self.momentum = momentum
+        self._state: Optional[float] = None
+
+    def observe(self, x):
+        v = float(np.abs(np.asarray(to_value(x))).max(initial=0.0))
+        if self._state is None:
+            self._state = v
+        else:
+            self._state = self.momentum * self._state + \
+                (1 - self.momentum) * v
+
+    def scale(self):
+        return np.float32(max(self._state or 0.0, 1e-8) / self.qmax)
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    """Per-output-channel absmax (weights). ``axis`` is the channel dim."""
+
+    def __init__(self, quant_bits: int = 8, axis: int = -1):
+        super().__init__(quant_bits)
+        self.axis = axis
+        self._absmax: Optional[np.ndarray] = None
+
+    def observe(self, x):
+        v = np.abs(np.asarray(to_value(x)))
+        reduce_axes = tuple(i for i in range(v.ndim)
+                            if i != (self.axis % v.ndim))
+        cur = v.max(axis=reduce_axes)
+        self._absmax = cur if self._absmax is None else \
+            np.maximum(self._absmax, cur)
+
+    def scale(self):
+        return (np.maximum(self._absmax, 1e-8) / self.qmax
+                ).astype(np.float32)
+
+
+class PercentileObserver(BaseObserver):
+    """Clip to the p-th percentile of |x| (reference: HistObserver's
+    percentile mode) — robust to activation outliers."""
+
+    def __init__(self, quant_bits: int = 8, percentile: float = 99.99):
+        super().__init__(quant_bits)
+        self.percentile = percentile
+        self._samples = []
+
+    def observe(self, x):
+        v = np.abs(np.asarray(to_value(x))).ravel()
+        if v.size > 4096:   # subsample to bound memory
+            v = np.random.default_rng(0).choice(v, 4096, replace=False)
+        self._samples.append(v)
+
+    def scale(self):
+        allv = np.concatenate(self._samples) if self._samples else \
+            np.zeros(1)
+        p = np.percentile(allv, self.percentile)
+        return np.float32(max(p, 1e-8) / self.qmax)

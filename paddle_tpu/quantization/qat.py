@@ -1,0 +1,189 @@
+"""QAT / PTQ drivers and quantized layer wrappers.
+
+reference: python/paddle/quantization/{config.py QuantConfig, qat.py QAT,
+ptq.py PTQ} and nn/quant/ QuantedLinear.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor, dispatch
+from .observers import AbsmaxObserver, PerChannelAbsmaxObserver
+from .quanters import (FakeQuanterWithAbsMax, fake_quant, quantize_to_int8,
+                       int8_matmul)
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "QuantedLinear", "Int8Linear"]
+
+
+class QuantConfig:
+    """reference: quantization/config.py — which layers get which
+    activation/weight quanters. ``activation_observer`` is the PTQ
+    calibration observer factory (QAT uses the quanter factories)."""
+
+    def __init__(self, activation=None, weight=None, quant_bits: int = 8,
+                 activation_observer=None):
+        self.activation_factory = activation or \
+            (lambda: FakeQuanterWithAbsMax(quant_bits))
+        self.weight_factory = weight or \
+            (lambda: FakeQuanterWithAbsMax(quant_bits))
+        self.activation_observer_factory = activation_observer or \
+            (lambda: AbsmaxObserver(quant_bits))
+        self.quant_bits = quant_bits
+        self.types = (nn.Linear,)
+
+    def add_type_config(self, types, activation=None, weight=None):
+        self.types = tuple(set(self.types) | set(types))   # additive
+        if activation is not None:
+            self.activation_factory = activation
+        if weight is not None:
+            self.weight_factory = weight
+
+
+class QuantedLinear(nn.Layer):
+    """Linear with fake-quantized activations + weights (QAT training
+    wrapper; reference: nn/quant/qat/linear.py QuantedLinear)."""
+
+    def __init__(self, layer: nn.Layer, cfg: QuantConfig):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self.act_quanter = cfg.activation_factory()
+        self.weight_quanter = cfg.weight_factory()
+
+    def forward(self, x):
+        # Layer.train()/eval() toggles self.training; propagate to the
+        # quanters so inference stops mutating calibration statistics
+        self.act_quanter.training = self.training
+        self.weight_quanter.training = self.training
+        xq = self.act_quanter(x)
+        wq = self.weight_quanter(self.weight)
+        out = xq @ wq
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class Int8Linear(nn.Layer):
+    """Deploy-time int8 linear: weights stored int8 per-channel, int32 MXU
+    accumulate, fp rescale (reference deploy path: quantized inference via
+    the int8 GEMM kernels)."""
+
+    def __init__(self, w_int8: np.ndarray, w_scale: np.ndarray,
+                 act_scale: float, bias: Optional[Tensor]):
+        super().__init__()
+        self._w = jnp.asarray(w_int8)
+        self._w_scale = jnp.asarray(w_scale.reshape(-1))
+        self._act_scale = float(act_scale)
+        self.bias = bias
+
+    def forward(self, x):
+        def f(v, *b):
+            xq = jnp.clip(jnp.round(v / self._act_scale), -128, 127
+                          ).astype(jnp.int8)
+            out = int8_matmul(xq, self._w, self._act_scale, self._w_scale)
+            out = out.astype(v.dtype)
+            if b:
+                out = out + b[0]
+            return out
+        args = (x,) if self.bias is None else (x, self.bias)
+        return dispatch(f, args, name="int8_linear")
+
+
+class QAT:
+    """reference: quantization/qat.py class QAT."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._swap(model)
+        return model
+
+    def _swap(self, layer: nn.Layer):
+        for name, child in list(layer.named_children()):
+            if isinstance(child, self.config.types):
+                if not isinstance(child, nn.Linear):
+                    raise NotImplementedError(
+                        f"QAT wrapping for {type(child).__name__} is not "
+                        f"implemented (only Linear); remove it from "
+                        f"QuantConfig.types")
+                setattr(layer, name, QuantedLinear(child, self.config))
+            else:
+                self._swap(child)
+
+    def convert(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        """Bake trained fake-quant scales into real int8 layers."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._convert(model)
+        return model
+
+    def _convert(self, layer: nn.Layer):
+        for name, child in list(layer.named_children()):
+            if isinstance(child, QuantedLinear):
+                w_int8, w_scale = quantize_to_int8(child.weight, axis=-1)
+                act_scale = float(child.act_quanter.observer.scale())
+                setattr(layer, name,
+                        Int8Linear(w_int8, w_scale, act_scale, child.bias))
+            else:
+                self._convert(child)
+
+
+class _ObservedLinear(nn.Layer):
+    def __init__(self, layer: nn.Layer, cfg: QuantConfig):
+        super().__init__()
+        self.inner = layer
+        self.act_observer = cfg.activation_observer_factory()
+
+    def forward(self, x):
+        self.act_observer.observe(x)
+        return self.inner(x)
+
+
+class PTQ:
+    """reference: quantization/ptq.py class PTQ — post-training: observe
+    activations on calibration data, then convert."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._insert(model)
+        return model
+
+    def _insert(self, layer: nn.Layer):
+        for name, child in list(layer.named_children()):
+            if isinstance(child, self.config.types):
+                if not isinstance(child, nn.Linear):
+                    raise NotImplementedError(
+                        f"PTQ wrapping for {type(child).__name__} is not "
+                        f"implemented (only Linear)")
+                setattr(layer, name, _ObservedLinear(child, self.config))
+            else:
+                self._insert(child)
+
+    def convert(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._convert(model)
+        return model
+
+    def _convert(self, layer: nn.Layer):
+        for name, child in list(layer.named_children()):
+            if isinstance(child, _ObservedLinear):
+                inner = child.inner
+                w_int8, w_scale = quantize_to_int8(inner.weight, axis=-1)
+                act_scale = float(child.act_observer.scale())
+                setattr(layer, name,
+                        Int8Linear(w_int8, w_scale, act_scale, inner.bias))
+            else:
+                self._convert(child)

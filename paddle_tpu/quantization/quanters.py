@@ -1,0 +1,72 @@
+"""Fake quanters (QAT) and real int8 helpers.
+
+reference: python/paddle/quantization/quanters/abs_max.py
+FakeQuanterWithAbsMaxObserver — simulate int8 rounding in fp during
+training with a straight-through estimator. On TPU the STE is the
+``x + stop_gradient(q(x) - x)`` identity, which XLA folds into the fused
+graph; real int8 matmuls use preferred_element_type=int32 on the MXU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+
+__all__ = ["fake_quant", "FakeQuanterWithAbsMax", "quantize_to_int8",
+           "int8_matmul"]
+
+
+def _fake_quant_value(x, scale, qmax):
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+    dq = q * scale
+    # straight-through estimator: identity gradient through the rounding
+    return x + jax.lax.stop_gradient(dq - x)
+
+
+def fake_quant(x, scale, quant_bits: int = 8):
+    """Differentiable fake quantization of a Tensor/array."""
+    qmax = float(2 ** (quant_bits - 1) - 1)
+    s = jnp.asarray(scale)
+    if isinstance(x, Tensor):
+        return dispatch(lambda v: _fake_quant_value(v, s, qmax), (x,),
+                        name="fake_quantize")
+    return _fake_quant_value(jnp.asarray(x), s, qmax)
+
+
+class FakeQuanterWithAbsMax:
+    """Stateful QAT quanter: tracks moving absmax, fake-quants forward.
+    reference: quanters/abs_max.py FakeQuanterWithAbsMaxObserver."""
+
+    def __init__(self, quant_bits: int = 8, momentum: float = 0.9):
+        from .observers import MovingAverageAbsmaxObserver
+        self.bits = quant_bits
+        self.observer = MovingAverageAbsmaxObserver(quant_bits, momentum)
+        self.training = True
+
+    def __call__(self, x):
+        if self.training:
+            self.observer.observe(x)
+        return fake_quant(x, self.observer.scale(), self.bits)
+
+
+def quantize_to_int8(w, axis: int = -1):
+    """Real per-channel int8 quantization → (w_int8, scale[float32])."""
+    v = np.asarray(to_value(w))
+    reduce_axes = tuple(i for i in range(v.ndim) if i != (axis % v.ndim))
+    absmax = np.abs(v).max(axis=reduce_axes, keepdims=True)
+    scale = np.maximum(absmax, 1e-8) / 127.0
+    q = np.clip(np.round(v / scale), -128, 127).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def int8_matmul(x_int8, w_int8, x_scale, w_scale):
+    """int8 × int8 → int32 accumulate on the MXU, then rescale to fp32.
+    (reference capability: the fp8/int8 GEMM path in
+    paddle/phi/kernels/fusion/fp8_gemm + cutlass epilogues)."""
+    acc = jax.lax.dot_general(
+        x_int8, w_int8, (((x_int8.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * jnp.asarray(x_scale) * \
+        jnp.asarray(w_scale).reshape((1,) * (acc.ndim - 1) + (-1,))

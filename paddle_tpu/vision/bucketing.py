@@ -51,10 +51,10 @@ class ShapeBucketer:
         bh, bw = target if target is not None else self.choose(h, w)
         scale = min(bh / h, bw / w, 1.0)
         if scale < 1.0:
+            from .transforms import resize
             nh, nw = int(h * scale), int(w * scale)
-            ys = (np.arange(nh) / scale).astype(np.int64).clip(0, h - 1)
-            xs = (np.arange(nw) / scale).astype(np.int64).clip(0, w - 1)
-            img = img[:, ys][:, :, xs]
+            img = resize(img.transpose(1, 2, 0), (nh, nw)) \
+                .transpose(2, 0, 1).astype(img.dtype)
             h, w = nh, nw
         out = np.full((c, bh, bw), self.pad_value, img.dtype)
         out[:, :h, :w] = img

@@ -1,0 +1,179 @@
+"""Quantization (QAT/PTQ) + ASP 2:4 sparsity tests (reference test model:
+test/quantization/ + test/asp/)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (QuantConfig, QAT, PTQ, QuantedLinear,
+                                     Int8Linear, AbsmaxObserver,
+                                     MovingAverageAbsmaxObserver,
+                                     PerChannelAbsmaxObserver, fake_quant,
+                                     quantize_to_int8, int8_matmul)
+from paddle_tpu.incubate import asp
+
+
+# -- observers --------------------------------------------------------------
+def test_absmax_observer_scale():
+    ob = AbsmaxObserver(8)
+    ob.observe(np.array([1.0, -3.0, 2.0]))
+    ob.observe(np.array([0.5, -1.0]))
+    assert ob.scale() == pytest.approx(3.0 / 127.0)
+
+
+def test_per_channel_observer():
+    ob = PerChannelAbsmaxObserver(8, axis=-1)
+    ob.observe(np.array([[1.0, -4.0], [2.0, 0.5]]))
+    np.testing.assert_allclose(ob.scale(),
+                               np.array([2.0, 4.0]) / 127.0, rtol=1e-6)
+
+
+def test_moving_average_observer():
+    ob = MovingAverageAbsmaxObserver(8, momentum=0.5)
+    ob.observe(np.array([2.0]))
+    ob.observe(np.array([4.0]))
+    assert ob.scale() == pytest.approx(3.0 / 127.0)   # 0.5*2 + 0.5*4
+
+
+# -- fake quant / int8 ------------------------------------------------------
+def test_fake_quant_roundtrip_error_bound():
+    x = paddle.to_tensor(np.linspace(-1, 1, 256).astype(np.float32))
+    scale = 1.0 / 127.0
+    q = fake_quant(x, scale)
+    err = np.abs(q.numpy() - x.numpy())
+    assert err.max() <= scale / 2 + 1e-6
+
+
+def test_fake_quant_ste_gradient():
+    x = paddle.to_tensor(np.array([0.3, -0.7], np.float32))
+    x.stop_gradient = False
+    y = fake_quant(x, 1.0 / 127.0)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(2), rtol=1e-6)
+
+
+def test_int8_matmul_close_to_fp():
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    w = rng.randn(16, 4).astype(np.float32)
+    w_q, w_scale = quantize_to_int8(w, axis=-1)
+    x_scale = np.abs(x).max() / 127.0
+    x_q = np.clip(np.round(x / x_scale), -128, 127).astype(np.int8)
+    out = int8_matmul(jnp.asarray(x_q), jnp.asarray(w_q), x_scale,
+                      w_scale.reshape(-1))
+    ref = x @ w
+    rel = np.abs(np.asarray(out) - ref).max() / np.abs(ref).max()
+    assert rel < 0.05
+
+
+# -- QAT --------------------------------------------------------------------
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+
+
+def test_qat_quantize_swaps_and_trains():
+    net = _mlp()
+    qat = QAT(QuantConfig())
+    net = qat.quantize(net)
+    assert isinstance(net[0], QuantedLinear)
+    assert isinstance(net[2], QuantedLinear)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(4, 4)
+                         .astype(np.float32))
+    opt = paddle.optimizer.SGD(0.05, parameters=net.parameters())
+    losses = []
+    for _ in range(15):
+        loss = nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_qat_convert_to_int8_close():
+    net = _mlp()
+    qat = QAT(QuantConfig())
+    qnet = qat.quantize(net)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 8)
+                         .astype(np.float32))
+    with paddle.no_grad():
+        qnet.train()
+        _ = qnet(x)          # calibrate activation observers (train mode)
+        qnet.eval()
+        ref = qnet(x).numpy()
+        # eval mode must NOT mutate calibration stats (regression)
+        s0 = float(qnet[0].act_quanter.observer.scale())
+        _ = qnet(x * 100.0)
+        assert float(qnet[0].act_quanter.observer.scale()) == s0
+    inet = qat.convert(qnet)
+    assert isinstance(inet[0], Int8Linear)
+    with paddle.no_grad():
+        out = inet(x).numpy()
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.1
+
+
+# -- PTQ --------------------------------------------------------------------
+def test_ptq_calibrate_and_convert():
+    net = _mlp()
+    net.eval()
+    with paddle.no_grad():
+        x = paddle.to_tensor(np.random.RandomState(0).randn(32, 8)
+                             .astype(np.float32))
+        ref = net(x).numpy()
+        ptq = PTQ()
+        onet = ptq.quantize(net, inplace=False)
+        for i in range(4):
+            _ = onet(x)
+        inet = ptq.convert(onet)
+        out = inet(x).numpy()
+    assert isinstance(inet[0], Int8Linear)
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.1
+
+
+# -- ASP 2:4 ----------------------------------------------------------------
+def test_create_mask_2_4_pattern():
+    w = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    mask = asp.create_mask(w, 2, 4)
+    assert mask.shape == w.shape
+    groups = mask.reshape(-1, 4).sum(axis=1)
+    assert (groups == 2).all()
+    # keeps the largest two per group
+    g0 = np.abs(w.reshape(-1, 4)[0])
+    kept = np.where(mask.reshape(-1, 4)[0])[0]
+    assert set(kept) == set(np.argsort(-g0)[:2])
+
+
+def test_check_mask_1d():
+    ok = np.array([[1, 0, 2, 0], [0, 3, 0, 4]], np.float32)
+    bad = np.array([[1, 2, 3, 0]], np.float32)
+    assert asp.check_mask_1d(ok, 2, 4)
+    assert not asp.check_mask_1d(bad, 2, 4)
+
+
+def test_prune_model_and_decorated_optimizer_keeps_sparsity():
+    net = _mlp()
+    masks = asp.prune_model(net, 2, 4)
+    assert len(masks) == 2
+    for _, layer in net.named_sublayers():
+        if isinstance(layer, nn.Linear):
+            assert asp.check_mask_1d(layer.weight.numpy(), 2, 4)
+    opt = asp.decorate(paddle.optimizer.SGD(0.1,
+                                            parameters=net.parameters()),
+                       model=net)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(4, 4)
+                         .astype(np.float32))
+    for _ in range(3):
+        loss = nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    for _, layer in net.named_sublayers():
+        if isinstance(layer, nn.Linear):
+            assert asp.check_mask_1d(layer.weight.numpy(), 2, 4)
