@@ -58,6 +58,8 @@ from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
 from . import inference  # noqa: F401
 from . import quantization  # noqa: F401
+from . import sparse  # noqa: F401
+from . import geometric  # noqa: F401
 from . import vision  # noqa: F401
 from . import incubate  # noqa: F401
 

@@ -1,0 +1,157 @@
+"""paddle_tpu.geometric — graph learning ops.
+
+reference: python/paddle/geometric/ (message_passing/send_recv.py
+send_u_recv / send_ue_recv / segment_* , sampling/neighbors.py
+sample_neighbors). TPU-native: message passing is gather (by edge source)
++ segment-reduce (by edge destination) — both static-shape XLA ops;
+neighbor sampling is host-side (data-dependent sizes belong off-device).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+
+__all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
+           "send_u_recv", "send_ue_recv", "sample_neighbors"]
+
+
+def _seg(reduce_fn, data, segment_ids, num_segments, name):
+    ids = jnp.asarray(to_value(segment_ids), jnp.int32)
+    n = int(num_segments) if num_segments is not None else \
+        int(np.asarray(ids).max()) + 1
+    data = data if isinstance(data, Tensor) else Tensor(data)
+    # through dispatch so the op records a GradNode (gradients flow back
+    # into upstream layers of a GNN)
+    return dispatch(lambda d: reduce_fn(d, ids, num_segments=n), (data,),
+                    name=name)
+
+
+def segment_sum(data, segment_ids, num_segments=None):
+    """reference: geometric/math.py segment_sum."""
+    return _seg(jax.ops.segment_sum, data, segment_ids, num_segments,
+                "segment_sum")
+
+
+def segment_mean(data, segment_ids, num_segments=None):
+    ids = jnp.asarray(to_value(segment_ids), jnp.int32)
+    nd = np.ndim(to_value(data))
+    n = int(num_segments) if num_segments is not None else \
+        int(np.asarray(ids).max()) + 1
+
+    def f(d):
+        total = jax.ops.segment_sum(d, ids, num_segments=n)
+        count = jax.ops.segment_sum(jnp.ones(d.shape[:1], d.dtype), ids,
+                                    num_segments=n)
+        return total / jnp.maximum(count, 1)[(...,) + (None,) * (nd - 1)]
+
+    data = data if isinstance(data, Tensor) else Tensor(data)
+    return dispatch(f, (data,), name="segment_mean")
+
+
+def segment_max(data, segment_ids, num_segments=None):
+    return _seg(jax.ops.segment_max, data, segment_ids, num_segments,
+                "segment_max")
+
+
+def segment_min(data, segment_ids, num_segments=None):
+    return _seg(jax.ops.segment_min, data, segment_ids, num_segments,
+                "segment_min")
+
+
+_REDUCERS = {"sum": jax.ops.segment_sum, "mean": None,
+             "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None):
+    """Gather messages from edge sources, reduce at destinations.
+    reference: geometric/message_passing/send_recv.py send_u_recv."""
+    src = jnp.asarray(to_value(src_index), jnp.int32)
+    dst = jnp.asarray(to_value(dst_index), jnp.int32)
+    n = int(out_size) if out_size is not None else np.shape(to_value(x))[0]
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    if reduce_op == "mean":
+        return segment_mean(
+            dispatch(lambda v: jnp.take(v, src, axis=0), (x,),
+                     name="gather"), dst, n)
+    fn = _REDUCERS.get(reduce_op)
+    if fn is None:
+        raise ValueError(f"unsupported reduce_op {reduce_op}")
+
+    def f(v):
+        out = fn(jnp.take(v, src, axis=0), dst, num_segments=n)
+        if reduce_op in ("max", "min"):
+            # empty segments produce ±inf in jax; paddle semantics: 0
+            out = jnp.where(jnp.isfinite(out), out, 0)
+        return out
+
+    return dispatch(f, (x,), name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None):
+    """Node features combined with edge features along edges.
+    reference: send_recv.py send_ue_recv (message_op add/sub/mul/div)."""
+    src = jnp.asarray(to_value(src_index), jnp.int32)
+    dst = jnp.asarray(to_value(dst_index), jnp.int32)
+    n = int(out_size) if out_size is not None else np.shape(to_value(x))[0]
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError(f"unsupported message_op {message_op}")
+    if reduce_op != "mean" and _REDUCERS.get(reduce_op) is None:
+        raise ValueError(f"unsupported reduce_op {reduce_op}")
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    y = y if isinstance(y, Tensor) else Tensor(y)
+
+    def msg(v, ev):
+        m = jnp.take(v, src, axis=0)
+        return {"add": m + ev, "sub": m - ev, "mul": m * ev,
+                "div": m / ev}[message_op]
+
+    if reduce_op == "mean":
+        msgs = dispatch(msg, (x, y), name="send_ue")
+        return segment_mean(msgs, dst, n)
+
+    def f(v, ev):
+        out = _REDUCERS[reduce_op](msg(v, ev), dst, num_segments=n)
+        if reduce_op in ("max", "min"):
+            out = jnp.where(jnp.isfinite(out), out, 0)
+        return out
+
+    return dispatch(f, (x, y), name="send_ue_recv")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False,
+                     perm_buffer=None):
+    """Uniform neighbor sampling from a CSC graph — host-side (dynamic
+    output sizes; reference: geometric/sampling/neighbors.py)."""
+    rowv = np.asarray(to_value(row)).ravel()
+    colptrv = np.asarray(to_value(colptr)).ravel()
+    nodes = np.asarray(to_value(input_nodes)).ravel()
+    eids_v = np.asarray(to_value(eids)).ravel() if eids is not None \
+        else None
+    rng = np.random.default_rng()
+    out_neighbors, out_counts, out_eids = [], [], []
+    for nd in nodes:
+        beg, end = int(colptrv[nd]), int(colptrv[nd + 1])
+        neigh = rowv[beg:end]
+        ids = eids_v[beg:end] if eids_v is not None \
+            else np.arange(beg, end)
+        if 0 <= sample_size < len(neigh):
+            pick = rng.choice(len(neigh), sample_size, replace=False)
+            neigh = neigh[pick]
+            ids = ids[pick]
+        out_neighbors.append(neigh)
+        out_counts.append(len(neigh))
+        out_eids.append(ids)
+    neighbors = Tensor(np.concatenate(out_neighbors)
+                       if out_neighbors else np.zeros(0, rowv.dtype))
+    counts = Tensor(np.asarray(out_counts, np.int64))
+    if return_eids:
+        return neighbors, counts, Tensor(np.concatenate(out_eids))
+    return neighbors, counts

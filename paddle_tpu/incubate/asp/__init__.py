@@ -21,6 +21,10 @@ __all__ = ["create_mask", "check_mask_1d", "prune_model", "decorate",
            "reset_excluded_layers", "set_excluded_layers"]
 
 _EXCLUDED: set = set()
+# models registered by prune_model; decorate(optimizer) with no explicit
+# model re-applies masks for all of them (reference: asp.py keeps a global
+# workspace of supported layers/masks)
+_PRUNED_MODELS: List = []
 
 
 def create_mask(weight, n: int = 2, m: int = 4) -> np.ndarray:
@@ -81,6 +85,7 @@ def prune_model(model: nn.Layer, n: int = 2, m: int = 4,
             mask, layer.weight._value.dtype)
         masks[name] = mask
     model._asp_masks = masks
+    _PRUNED_MODELS.append(model)
     return masks
 
 
@@ -88,14 +93,27 @@ def decorate(optimizer, model: Optional[nn.Layer] = None):
     """Wrap optimizer.step to re-apply masks after each update, so pruned
     weights stay pruned (reference: asp/asp.py decorate + OptimizerWithSparsityGuarantee)."""
 
-    # resolve (layer, mask) pairs once — layer identity is static after
-    # prune_model, and per-step named_sublayers() traversal is hot-path
-    # overhead
-    pairs = []
-    if model is not None and hasattr(model, "_asp_masks"):
-        by_name = dict(model.named_sublayers())
-        pairs = [(by_name[n], m) for n, m in model._asp_masks.items()
-                 if n in by_name]
+    # (layer, mask) pairs resolved lazily and cached per mask-dict
+    # identity: decorate() may legally be called BEFORE prune_model
+    # (the reference's documented order), and per-step named_sublayers()
+    # traversal would be hot-path overhead
+    cache = {"key": None, "pairs": []}
+
+    def resolve():
+        models = [model] if model is not None else list(_PRUNED_MODELS)
+        key = tuple(id(getattr(m, "_asp_masks", None)) for m in models)
+        if cache["key"] != key:
+            pairs = []
+            for m in models:
+                masks = getattr(m, "_asp_masks", None)
+                if not masks:
+                    continue
+                by_name = dict(m.named_sublayers())
+                pairs += [(by_name[n], msk) for n, msk in masks.items()
+                          if n in by_name]
+            cache["key"] = key
+            cache["pairs"] = pairs
+        return cache["pairs"]
 
     class _ASPOptimizer:
         def __init__(self, opt):
@@ -106,7 +124,7 @@ def decorate(optimizer, model: Optional[nn.Layer] = None):
 
         def step(self):
             self._opt.step()
-            for layer, mask in pairs:
+            for layer, mask in resolve():
                 layer.weight._value = layer.weight._value * jnp.asarray(
                     mask, layer.weight._value.dtype)
 

@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import nn
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, to_value
 from ..ops.flash_attention import flash_attention
 
 
@@ -217,7 +217,6 @@ class UNetModel(nn.Layer):
 
     def forward(self, x, timesteps, context):
         cfg = self.cfg
-        from ..core.tensor import to_value
         temb = Tensor(timestep_embedding(to_value(timesteps),
                                          cfg.model_channels))
         temb = self.time_mlp2(self.act(self.time_mlp1(temb)))
@@ -257,7 +256,6 @@ def ddim_step(unet, x_t, t, t_prev, context, alphas_cumprod):
     """One DDIM denoise step x_t → x_{t_prev} (eta=0).
     alphas_cumprod: [T] numpy/jax array of the scheduler's ᾱ."""
     eps = unet(x_t, jnp.full((x_t.shape[0],), t, jnp.int32), context)
-    from ..core.tensor import to_value
     eps_v = to_value(eps)
     x_v = to_value(x_t)
     a_t = alphas_cumprod[t]

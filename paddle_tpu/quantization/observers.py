@@ -85,6 +85,10 @@ class PerChannelAbsmaxObserver(BaseObserver):
             np.maximum(self._absmax, cur)
 
     def scale(self):
+        if self._absmax is None:
+            raise RuntimeError(
+                "PerChannelAbsmaxObserver.scale() called before any "
+                "observe() — this layer received no calibration data")
         return (np.maximum(self._absmax, 1e-8) / self.qmax
                 ).astype(np.float32)
 

@@ -1,0 +1,226 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors + sparse functional ops.
+
+reference: python/paddle/sparse/ (creation.py sparse_coo_tensor /
+sparse_csr_tensor, unary/binary ops, nn.functional relu/matmul) backed by
+C++ SparseCooTensor/SparseCsrTensor (paddle/phi/core/sparse_coo_tensor.h).
+
+TPU-native stance: there are no sparse tensor cores on TPU; sparse compute
+lowers to gather + segment-sum scatter-adds, which XLA handles well when
+nnz is static. The value/index arrays are plain jax arrays, so all ops jit
+and differentiate (w.r.t. values).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "to_sparse_coo", "add", "multiply",
+           "matmul", "relu", "transpose", "is_same_shape", "masked_matmul"]
+
+
+class SparseCooTensor:
+    """COO: indices [ndim, nnz] int, values [nnz, ...], dense shape."""
+
+    def __init__(self, indices, values, shape, coalesced: bool = False):
+        self._indices = jnp.asarray(to_value(indices), jnp.int32)
+        self._values = jnp.asarray(to_value(values))
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = coalesced
+
+    # -- paddle API surface -------------------------------------------------
+    def indices(self) -> Tensor:
+        return Tensor(self._indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._values)
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._indices.shape[1])
+
+    def to_dense(self) -> Tensor:
+        dense = jnp.zeros(self._shape + self._values.shape[1:],
+                          self._values.dtype)
+        idx = tuple(self._indices[i] for i in range(len(self._shape)))
+        return Tensor(dense.at[idx].add(self._values))
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Merge duplicate coordinates (sum values), sort row-major."""
+        flat = np.ravel_multi_index(
+            tuple(np.asarray(self._indices)), self._shape)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        vals = jax.ops.segment_sum(self._values, jnp.asarray(inv),
+                                   num_segments=len(uniq))
+        new_idx = np.stack(np.unravel_index(uniq, self._shape)) \
+            .astype(np.int32)
+        return SparseCooTensor(new_idx, vals, self._shape, coalesced=True)
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        assert len(self._shape) == 2, "CSR requires 2-D"
+        coo = self if self._coalesced else self.coalesce()
+        rows = np.asarray(coo._indices[0])
+        crows = np.zeros(self._shape[0] + 1, np.int32)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        return SparseCsrTensor(crows, coo._indices[1], coo._values,
+                               self._shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self._values.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR: crows [rows+1], cols [nnz], values [nnz]."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(to_value(crows), jnp.int32)
+        self._cols = jnp.asarray(to_value(cols), jnp.int32)
+        self._values = jnp.asarray(to_value(values))
+        self._shape = tuple(int(s) for s in shape)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return Tensor(self._values)
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._cols.shape[0])
+
+    def _row_indices(self) -> jnp.ndarray:
+        counts = np.diff(np.asarray(self._crows))
+        return jnp.asarray(np.repeat(np.arange(self._shape[0]), counts),
+                           jnp.int32)
+
+    def to_dense(self) -> Tensor:
+        rows = self._row_indices()
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        return Tensor(dense.at[rows, self._cols].add(self._values))
+
+    def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
+        rows = self._row_indices()
+        idx = jnp.stack([rows, self._cols])
+        return SparseCooTensor(idx, self._values, self._shape,
+                               coalesced=True)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self._values.dtype})")
+
+
+# -- creation ----------------------------------------------------------------
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseCooTensor:
+    """reference: python/paddle/sparse/creation.py sparse_coo_tensor."""
+    idx = np.asarray(to_value(indices))
+    vals = to_value(values)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape) -> SparseCsrTensor:
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def to_sparse_coo(x, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+    """Dense Tensor → COO (reference: Tensor.to_sparse_coo). With
+    sparse_dim < ndim, values are the dense slices over trailing dims and
+    coordinates are deduplicated."""
+    v = np.asarray(to_value(x))
+    nd = sparse_dim or v.ndim
+    if nd == v.ndim:
+        idx = np.stack(np.nonzero(v)).astype(np.int32)
+    else:
+        # a coordinate is nonzero if ANY element of its trailing slice is
+        reduced = np.abs(v).sum(axis=tuple(range(nd, v.ndim)))
+        idx = np.stack(np.nonzero(reduced)).astype(np.int32)
+    vals = v[tuple(idx)]
+    return SparseCooTensor(idx, vals, v.shape[:nd], coalesced=True)
+
+
+# -- functional ops -----------------------------------------------------------
+def _ew(op, x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
+    """Elementwise on aligned COO (coalesce + dense fallback for
+    mismatched patterns)."""
+    xc, yc = x.coalesce(), y.coalesce()
+    if (xc.nnz == yc.nnz and
+            bool(jnp.all(xc._indices == yc._indices))):
+        return SparseCooTensor(xc._indices, op(xc._values, yc._values),
+                               xc._shape, coalesced=True)
+    dense = op(xc.to_dense()._value, yc.to_dense()._value)
+    return to_sparse_coo(Tensor(dense))
+
+
+def add(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
+    return _ew(jnp.add, x, y)
+
+
+def multiply(x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
+    return _ew(jnp.multiply, x, y)
+
+
+def relu(x: SparseCooTensor) -> SparseCooTensor:
+    return SparseCooTensor(x._indices, jnp.maximum(x._values, 0),
+                           x._shape, x._coalesced)
+
+
+def transpose(x: SparseCooTensor, perm: Sequence[int]) -> SparseCooTensor:
+    idx = x._indices[jnp.asarray(perm)]
+    shape = tuple(x._shape[p] for p in perm)
+    return SparseCooTensor(idx, x._values, shape)
+
+
+def matmul(x, y) -> Tensor:
+    """sparse [M, K] @ dense [K, N] → dense [M, N] via gather +
+    segment-sum (the TPU-native SpMM: scatter-add lowered by XLA)."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    assert isinstance(x, SparseCooTensor) and len(x._shape) == 2
+    rows, cols = x._indices[0], x._indices[1]
+    vals, m = x._values, x._shape[0]
+    y = y if isinstance(y, Tensor) else Tensor(y)
+
+    def f(yv):
+        partial = vals[:, None] * jnp.take(yv, cols, axis=0)   # [nnz, N]
+        return jax.ops.segment_sum(partial, rows, num_segments=m)
+
+    # through dispatch: gradients flow into the dense operand
+    return dispatch(f, (y,), name="sparse_matmul")
+
+
+def masked_matmul(x, y, mask: SparseCooTensor) -> SparseCooTensor:
+    """dense @ dense evaluated only at mask's coordinates (SDDMM;
+    reference: paddle.sparse.masked_matmul)."""
+    rows, cols = mask._indices[0], mask._indices[1]
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    y = y if isinstance(y, Tensor) else Tensor(y)
+    vals = dispatch(
+        lambda xv, yv: jnp.einsum("nk,nk->n", jnp.take(xv, rows, axis=0),
+                                  jnp.take(yv.T, cols, axis=0)),
+        (x, y), name="masked_matmul")
+    return SparseCooTensor(mask._indices, vals._value, mask._shape,
+                           coalesced=mask._coalesced)
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)

@@ -155,6 +155,28 @@ def test_check_mask_1d():
     assert not asp.check_mask_1d(bad, 2, 4)
 
 
+def test_decorate_before_prune_still_enforces_masks():
+    """Regression: the reference's documented order is decorate() first,
+    prune_model() second — masks must still be re-applied."""
+    net = _mlp()
+    opt = asp.decorate(paddle.optimizer.SGD(0.1,
+                                            parameters=net.parameters()),
+                       model=net)
+    asp.prune_model(net, 2, 4)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype(np.float32))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(4, 4)
+                         .astype(np.float32))
+    for _ in range(2):
+        loss = nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    for _, layer in net.named_sublayers():
+        if isinstance(layer, nn.Linear):
+            assert asp.check_mask_1d(layer.weight.numpy(), 2, 4)
+
+
 def test_prune_model_and_decorated_optimizer_keeps_sparsity():
     net = _mlp()
     masks = asp.prune_model(net, 2, 4)
