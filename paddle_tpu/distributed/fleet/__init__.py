@@ -30,9 +30,13 @@ class meta_parallel:
 
 def __getattr__(name):
     if name in ("PipelineLayer", "LayerDesc", "SharedLayerDesc",
-                "PipelineParallel"):
+                "PipelineParallel", "PipelineParallelWithInterleave",
+                "ZeroBubblePipelineParallel"):
         from . import pipeline_parallel as pp
         return getattr(pp, name)
+    if name in ("WeightGradStore", "zb_linear"):
+        from . import zero_bubble
+        return getattr(zero_bubble, name)
     if name in ("DygraphShardingOptimizer", "group_sharded_parallel"):
         from . import sharding
         return getattr(sharding, name)

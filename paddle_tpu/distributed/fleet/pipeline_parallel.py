@@ -15,10 +15,11 @@ exactly while the micro-batch is in flight (the schedule's memory
 guarantee), and XLA's async dispatch overlaps stage compute without manual
 comm streams.
 
-Zero-bubble-style dW/dX splitting (reference
-pipeline_zero_bubble.py:62) is not needed at this granularity: backward for
-micro-batch i on stage s and forward for micro-batch i+1 on stage s+1 are
-independent XLA programs on disjoint devices and run concurrently.
+Zero-bubble (ZB-H1, reference pipeline_zero_bubble.py:62) is implemented
+via the dW/dX split in zero_bubble.py: ZeroBubblePipelineParallel defers
+every linear's weight gradient into a WeightGradStore and computes them in
+the drain phase. Interleaved VPP (PipelineParallelWithInterleave) maps
+round-robin model chunks onto stages.
 """
 from __future__ import annotations
 
@@ -35,7 +36,8 @@ from ...nn.layer.layers import Layer, LayerList, Sequential
 from ..topology import HybridCommunicateGroup
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
-           "PipelineParallel"]
+           "PipelineParallel", "PipelineParallelWithInterleave",
+           "ZeroBubblePipelineParallel"]
 
 
 class LayerDesc:
@@ -136,11 +138,6 @@ class PipelineLayer(Layer):
                  loss_fn=None, seg_method="uniform", num_virtual_pipeline_stages=None,
                  recompute_interval=0, **kwargs):
         super().__init__()
-        if num_virtual_pipeline_stages not in (None, 1):
-            warnings.warn(
-                "num_virtual_pipeline_stages (interleaved/VPP schedule) is "
-                "not implemented on the TPU path; falling back to plain "
-                "1F1B", stacklevel=2)
         if recompute_interval:
             warnings.warn(
                 "PipelineLayer recompute_interval is not implemented on "
@@ -151,17 +148,23 @@ class PipelineLayer(Layer):
         if num_stages is None and topology is not None:
             num_stages = topology.get_dim("pipe")
         self._num_stages = num_stages or 1
+        # VPP/interleave (reference: PipelineParallelWithInterleave:1308):
+        # with v virtual stages, the model is cut into num_stages*v chunks
+        # and chunk c lives on stage c % num_stages — each device hosts v
+        # non-contiguous model chunks, shrinking the warmup bubble by ~v.
+        self._vpp = num_virtual_pipeline_stages or 1
+        self._num_chunks = self._num_stages * self._vpp
         descs = list(layers)
         if isinstance(seg_method, str) and seg_method.startswith("layer:"):
-            bounds = _segment_by_layer(descs, self._num_stages,
+            bounds = _segment_by_layer(descs, self._num_chunks,
                                        seg_method.split("layer:")[1])
         else:
-            bounds = _segment_uniform(len(descs), self._num_stages)
+            bounds = _segment_uniform(len(descs), self._num_chunks)
         self.segment_parts = bounds
         self._shared: Dict[str, Layer] = {}
-        self._stage_layers: List[List[Layer]] = []
+        self._stage_layers: List[List[Layer]] = []   # per CHUNK
         self.run_function: List[Layer] = []
-        for s in range(self._num_stages):
+        for s in range(self._num_chunks):
             built = []
             for d in descs[bounds[s]:bounds[s + 1]]:
                 layer = self._build(d)
@@ -173,8 +176,8 @@ class PipelineLayer(Layer):
         self.run_function = [l for st in self._stage_layers for l in st]
         # stage layout is fixed at construction: build each stage's submesh
         # once, not per micro-batch on the 1F1B hot path
-        self._submeshes = [self._stage_submesh(s)
-                           for s in range(self._num_stages)]
+        self._submeshes = [self._stage_submesh(c % self._num_stages)
+                           for c in range(self._num_chunks)]
         self._place_stages()
 
     def _build(self, d):
@@ -226,15 +229,26 @@ class PipelineLayer(Layer):
                             v, _restrict_sharding(v, sub)))
                         p._pp_meta = s
 
+    def chunk_of(self, layer_index: int) -> int:
+        for c in range(self._num_chunks):
+            if self.segment_parts[c] <= layer_index < \
+                    self.segment_parts[c + 1]:
+                return c
+        return self._num_chunks - 1
+
     def stage_of(self, layer_index: int) -> int:
-        for s in range(self._num_stages):
-            if self.segment_parts[s] <= layer_index < \
-                    self.segment_parts[s + 1]:
-                return s
-        return self._num_stages - 1
+        return self.chunk_of(layer_index) % self._num_stages
 
     def get_stage_layers(self, stage: int) -> List[Layer]:
-        return self._stage_layers[stage]
+        """All layers hosted on ``stage`` (its v chunks, in order)."""
+        out: List[Layer] = []
+        for c in range(self._num_chunks):
+            if c % self._num_stages == stage:
+                out.extend(self._stage_layers[c])
+        return out
+
+    def get_chunk_layers(self, chunk: int) -> List[Layer]:
+        return self._stage_layers[chunk]
 
     def forward(self, x):
         from ...core.tensor import dispatch as _dispatch
@@ -369,3 +383,41 @@ class PipelineParallel(Layer):
 
     def set_state_dict(self, sd, **k):
         return self._layers.set_state_dict(sd, **k)
+
+
+class PipelineParallelWithInterleave(PipelineParallel):
+    """Interleaved-VPP engine (reference: pipeline_parallel.py:1308).
+
+    Requires a PipelineLayer built with num_virtual_pipeline_stages > 1:
+    each stage hosts v round-robin model chunks, so the per-micro-batch
+    dependency chain alternates stages v times — the warmup bubble shrinks
+    ~v× on real multi-stage hardware. In this single-controller engine the
+    micro-batch schedule is the same 1F1B order (XLA's async dispatch
+    overlaps the independent chunk programs); what VPP changes is the
+    placement (chunk→stage round robin) and the hop pattern, which this
+    layer's forward already performs per chunk."""
+
+    def __init__(self, layers, hcg=None, strategy=None,
+                 accumulate_steps: int = 1):
+        super().__init__(layers, hcg, strategy, accumulate_steps)
+        if getattr(layers, "_vpp", 1) < 2:
+            warnings.warn(
+                "PipelineParallelWithInterleave over a PipelineLayer with "
+                "num_virtual_pipeline_stages<2 degenerates to plain 1F1B",
+                stacklevel=2)
+
+
+class ZeroBubblePipelineParallel(PipelineParallel):
+    """Zero-bubble (ZB-H1) engine (reference:
+    pipeline_zero_bubble.py:62): backward is split into the critical dX
+    chain (runs in schedule order) and deferred dW computations that fill
+    the drain bubble — see zero_bubble.WeightGradStore."""
+
+    def forward_backward_pipeline(self, data, scaler=None):
+        from .zero_bubble import WeightGradStore
+        store = WeightGradStore()
+        with store:
+            loss = super().forward_backward_pipeline(data, scaler)
+        # drain phase: compute all deferred dW/db (the bubble filler)
+        store.flush()
+        return loss

@@ -1,0 +1,111 @@
+"""Zero-bubble pipeline support: deferred weight gradients (dW/dX split).
+
+TPU-native redesign of the reference zero-bubble schedule
+(python/paddle/distributed/passes/pipeline_scheduler_pass/
+pipeline_zero_bubble.py:62 — ZB-H1 splits matmul_grad into dX and dW so
+the critical dX chain unblocks upstream stages immediately and dW fills
+the drain bubble).
+
+Mechanism here: while a ``WeightGradStore`` is active, ``F.linear`` routes
+through :func:`zb_linear`, whose GradNode backward computes ONLY dX (the
+weight is closed over as a constant) and parks ``(x, gy)`` in the store.
+``flush()`` later computes every deferred dW/db — scheduled into the
+pipeline's drain phase, exactly the ZB-H1 placement.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import GradNode, Tensor, to_value
+
+__all__ = ["WeightGradStore", "zb_linear", "weight_grad_store_enabled"]
+
+
+class WeightGradStore:
+    """Parking lot for deferred weight-gradient computations
+    (reference: the W-queue of the zero-bubble scheduler)."""
+
+    _active: Optional["WeightGradStore"] = None
+
+    def __init__(self):
+        self._entries: List[Tuple[Tensor, Optional[Tensor], jax.Array,
+                                  jax.Array]] = []
+
+    # -- context ------------------------------------------------------------
+    def __enter__(self):
+        WeightGradStore._active = self
+        return self
+
+    def __exit__(self, *exc):
+        WeightGradStore._active = None
+        return False
+
+    @classmethod
+    def active(cls) -> Optional["WeightGradStore"]:
+        return cls._active
+
+    # -- deferral -----------------------------------------------------------
+    def put(self, weight: Tensor, bias: Optional[Tensor], x_val, gy):
+        self._entries.append((weight, bias, x_val, gy))
+
+    def __len__(self):
+        return len(self._entries)
+
+    def flush(self):
+        """Compute and accumulate all deferred dW/db (the bubble filler)."""
+        from ...autograd.backward import _leaf_accumulate
+        entries, self._entries = self._entries, []
+        for weight, bias, x_val, gy in entries:
+            # collapse leading (batch/seq) dims: dW = x^T @ gy
+            k_in = x_val.shape[-1]
+            k_out = gy.shape[-1]
+            x2 = x_val.reshape(-1, k_in)
+            g2 = gy.reshape(-1, k_out)
+            dW = jax.lax.dot_general(
+                x2, g2, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(x_val.dtype)
+            if not weight.stop_gradient:
+                _leaf_accumulate(weight, dW)
+            if bias is not None and not bias.stop_gradient:
+                _leaf_accumulate(bias, g2.sum(axis=0).astype(gy.dtype))
+
+
+def weight_grad_store_enabled() -> bool:
+    return WeightGradStore._active is not None
+
+
+def zb_linear(x, weight: Tensor, bias: Optional[Tensor] = None):
+    """Linear whose backward yields only dX; dW/db parked in the active
+    WeightGradStore (the dW/dX split of pipeline_zero_bubble.py)."""
+    store = WeightGradStore.active()
+    assert store is not None
+    x_t = x if isinstance(x, Tensor) else Tensor(x)
+    x_val = to_value(x_t)
+    w_val = to_value(weight)
+    b_val = to_value(bias) if bias is not None else None
+
+    def fwd(xv):
+        out = jnp.matmul(xv, w_val)
+        return out + b_val if b_val is not None else out
+
+    out_val, vjp_fn = jax.vjp(fwd, x_val)
+
+    needs_grad = (not x_t.stop_gradient) or (not weight.stop_gradient) or \
+        (bias is not None and not bias.stop_gradient)
+    if not needs_grad:
+        return Tensor(out_val, stop_gradient=True)
+
+    def vjp_store(gy):
+        store.put(weight, bias, x_val, gy)
+        return vjp_fn(gy)        # (dX,) — the critical-path gradient
+
+    node = GradNode(vjp_store, (None if x_t.stop_gradient else x_t,), 1,
+                    "zb_linear")
+    node._out_shapes = [(out_val.shape, out_val.dtype)]
+    out = Tensor(out_val, stop_gradient=False)
+    out._grad_node = node
+    out._out_index = 0
+    return out

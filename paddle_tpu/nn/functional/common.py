@@ -18,7 +18,15 @@ def _ensure(x):
 
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b with W shaped [in, out] (reference convention,
-    python/paddle/nn/functional/common.py linear). MXU hot path."""
+    python/paddle/nn/functional/common.py linear). MXU hot path.
+
+    Under an active zero-bubble WeightGradStore, routes through zb_linear
+    (backward computes only dX; dW is deferred into the pipeline bubble —
+    reference pipeline_zero_bubble.py dW/dX split)."""
+    import sys
+    zb = sys.modules.get("paddle_tpu.distributed.fleet.zero_bubble")
+    if zb is not None and zb.weight_grad_store_enabled():
+        return zb.zb_linear(x, weight, bias)
     if bias is None:
         return dispatch(lambda v, w: jnp.matmul(v, w),
                         (_ensure(x), _ensure(weight)), name="linear")
