@@ -1,7 +1,19 @@
 """Multichip dryrun: compile + run ONE full LLaMA training step over an
 n-device mesh with real dp/fsdp/tp/sp shardings (driver contract
-``__graft_entry__.dryrun_multichip``)."""
+``__graft_entry__.dryrun_multichip``).
+
+Device resolution is defensive: the driver environment may expose a single
+real TPU (or a broken/mismatched TPU client) while asking for an N-device
+mesh. In that case we force the virtual CPU platform — the same
+``--xla_force_host_platform_device_count`` trick ``tests/conftest.py`` uses
+(the reference tests multi-rank on one host the same way, SURVEY.md §4).
+Note the env vars may be latched by an early jax import, so we also go
+through ``jax.config``.
+"""
 from __future__ import annotations
+
+import os
+import re
 
 import numpy as np
 import jax
@@ -11,6 +23,71 @@ from jax.sharding import PartitionSpec as P
 from ..models.llama import (LlamaConfig, init_params, loss_fn,
                             param_shardings)
 from .trainer import MeshConfig, Trainer, make_mesh
+
+
+def _ensure_host_device_flag(n: int) -> None:
+    """Set --xla_force_host_platform_device_count>=n BEFORE any backend is
+    instantiated (jax.devices() creates every registered backend, including
+    CPU, so this must run first). An inherited smaller count is raised to n;
+    a larger one is kept."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    elif int(m.group(1)) < n:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"--xla_force_host_platform_device_count={n}")
+
+
+def _force_cpu_devices(n: int):
+    """Switch jax to the CPU platform with >= n virtual devices.
+
+    Mutates process-global state (JAX_PLATFORMS env, jax_platforms config,
+    Pallas interpret override); callers are expected to restore it —
+    ``run_dryrun`` does, via try/finally.
+    """
+    _ensure_host_device_flag(n)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        # Works even when jax was imported earlier with another platform,
+        # as long as no CPU backend has been instantiated yet.
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    devices = jax.devices("cpu")
+    if len(devices) < n:
+        raise RuntimeError(
+            f"virtual CPU mesh has {len(devices)} devices < {n}; the CPU "
+            "backend was initialized before "
+            "--xla_force_host_platform_device_count could take effect")
+    # If another backend was initialized first, jax.default_backend() keeps
+    # reporting it, so the Pallas auto interpret check would compile Mosaic
+    # for these CPU devices. Force interpreter mode explicitly.
+    from ..ops.pallas._util import set_force_interpret
+    set_force_interpret(True)
+    return devices[:n]
+
+
+def resolve_devices(n: int):
+    """Return ``(devices, fallback_reason)``: n usable devices, preferring
+    the default backend but never trusting it — it must (a) exist, (b) have
+    >= n devices, and (c) actually execute a program (a listed-but-broken
+    TPU client fails here). Otherwise fall back to a forced virtual CPU
+    mesh; ``fallback_reason`` says why (None when the default backend is
+    used)."""
+    _ensure_host_device_flag(n)  # before jax.devices() instantiates CPU
+    reason = None
+    try:
+        devices = jax.devices()
+        if len(devices) >= n:
+            probe = jax.device_put(jnp.zeros((), jnp.float32), devices[0])
+            jax.block_until_ready(probe + 1.0)
+            return devices[:n], None
+        reason = f"default backend has {len(devices)} device(s) < {n}"
+    except Exception as e:  # noqa: BLE001 — any backend failure → fallback
+        reason = f"default backend unusable: {type(e).__name__}: {e}"
+    return _force_cpu_devices(n), reason
 
 
 def _factor(n: int):
@@ -28,32 +105,65 @@ def _factor(n: int):
 
 
 def run_dryrun(n_devices: int) -> None:
+    from ..ops.pallas import _util as pallas_util
+
+    prev_env = os.environ.get("JAX_PLATFORMS")
+    prev_cfg = jax.config.jax_platforms
+    prev_interp = pallas_util._FORCE_INTERPRET
+    try:
+        _run_dryrun(n_devices)
+    finally:
+        # _force_cpu_devices may have redirected the whole process to the
+        # CPU platform + Pallas interpreter; restore so later code (or
+        # subprocesses inheriting the env) still sees the real accelerator.
+        pallas_util.set_force_interpret(prev_interp)
+        if prev_env is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev_env
+        try:
+            jax.config.update("jax_platforms", prev_cfg)
+        except Exception:
+            pass
+
+
+def _run_dryrun(n_devices: int) -> None:
     cfg = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
                       num_hidden_layers=2, num_attention_heads=4,
                       num_key_value_heads=2, max_position_embeddings=64,
                       dtype=jnp.float32, remat=True)
     mc = _factor(n_devices)
-    mesh = make_mesh(mc, devices=jax.devices()[:n_devices])
-    params = init_params(cfg, jax.random.key(0))
-    specs = param_shardings(mesh, cfg)
+    devices, fallback = resolve_devices(n_devices)
+    if fallback is not None:
+        print(f"dryrun_multichip: virtual-CPU fallback ({fallback})")
+    mesh = make_mesh(mc, devices=devices)
+    # Pin uncommitted arrays (param init, host->device asarray) to the
+    # resolved devices: after a CPU fallback the *default* backend can still
+    # be the broken accelerator, and placing anything there would reproduce
+    # the crash the fallback exists to avoid.
+    with jax.default_device(devices[0]):
+        params = init_params(cfg, jax.random.key(0))
+        specs = param_shardings(mesh, cfg)
 
-    def loss(params, tokens, labels):
-        return loss_fn(params, tokens, labels, cfg)
+        def loss(params, tokens, labels):
+            return loss_fn(params, tokens, labels, cfg)
 
-    trainer = Trainer(loss, mesh, specs,
-                      data_spec=P(("dp", "fsdp"), "sp"), lr=1e-3)
-    state = trainer.init_state(params)
-    B = max(mc.dp * mc.fsdp, 1) * 2
-    S = max(mc.sp, 1) * 16
-    rng = np.random.RandomState(0)
-    tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)),
-                         dtype=jnp.int32)
-    labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)),
-                         dtype=jnp.int32)
-    state, metrics = trainer.step(state, tokens, labels)
-    jax.block_until_ready(metrics["loss"])
+        trainer = Trainer(loss, mesh, specs,
+                          data_spec=P(("dp", "fsdp"), "sp"), lr=1e-3)
+        state = trainer.init_state(params)
+        B = max(mc.dp * mc.fsdp, 1) * 2
+        S = max(mc.sp, 1) * 16
+        rng = np.random.RandomState(0)
+        tokens = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)),
+                             dtype=jnp.int32)
+        labels = jnp.asarray(rng.randint(0, cfg.vocab_size, (B, S)),
+                             dtype=jnp.int32)
+        state, metrics = trainer.step(state, tokens, labels)
+        jax.block_until_ready(metrics["loss"])
     loss0 = float(metrics["loss"])
     assert np.isfinite(loss0), f"non-finite loss {loss0}"
+    from ..ops.pallas._util import interpret_mode
     print(f"dryrun_multichip ok: n={n_devices} mesh="
-          f"{dict(mesh.shape)} loss={loss0:.4f} "
+          f"{dict(mesh.shape)} platform={devices[0].platform} "
+          f"pallas_interpret={interpret_mode()} loss={loss0:.4f} "
           f"grad_norm={float(metrics['grad_norm']):.4f}")

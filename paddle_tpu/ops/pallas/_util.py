@@ -6,6 +6,27 @@ import functools
 import jax
 
 
+# Process-wide override for Pallas interpret mode. None = auto (off-TPU →
+# interpret). distributed/dryrun.py sets this to True when it falls back to a
+# virtual CPU mesh after the TPU backend was already initialized (in that
+# state jax.default_backend() still reports "tpu" even though every array
+# lives on CPU devices, so the per-kernel auto check would wrongly compile
+# Mosaic for CPU).
+_FORCE_INTERPRET: bool | None = None
+
+
+def set_force_interpret(value: bool | None) -> None:
+    global _FORCE_INTERPRET
+    _FORCE_INTERPRET = value
+
+
+def interpret_mode() -> bool:
+    """Whether pallas_call sites should run in interpreter mode."""
+    if _FORCE_INTERPRET is not None:
+        return _FORCE_INTERPRET
+    return jax.default_backend() not in ("tpu", "axon")
+
+
 def no_x64(fn):
     """Trace ``fn`` with x64 disabled.
 

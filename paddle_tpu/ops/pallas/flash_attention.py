@@ -24,14 +24,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._util import no_x64
+from ._util import interpret_mode as _interpret, no_x64
 
 DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
-
-
-def _interpret() -> bool:
-    # run kernels in interpreter mode off-TPU (CPU tests)
-    return jax.default_backend() not in ("tpu", "axon")
 
 
 def _block_sizes(sq, sk):

@@ -16,11 +16,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._util import no_x64
-
-
-def _interpret() -> bool:
-    return jax.default_backend() not in ("tpu", "axon")
+from ._util import interpret_mode as _interpret, no_x64
 
 
 def _rms_fwd_kernel(x_ref, w_ref, o_ref, *, eps):
