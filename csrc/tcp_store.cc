@@ -212,6 +212,16 @@ class Server {
   void sweep_dead() {
     for (auto it = conns_.begin(); it != conns_.end();) {
       if (it->second.dead) {
+        // a conn that dies while parked on a barrier must roll back its
+        // arrival, like a timeout does — otherwise the key stays
+        // phase-shifted and a later barrier releases a participant early
+        Conn& c = it->second;
+        if (c.waiting && c.is_barrier) {
+          auto b = barrier_count_.find(c.wait_key);
+          if (b != barrier_count_.end() && --(b->second) <= 0) {
+            barrier_count_.erase(b);
+          }
+        }
         close(it->first);
         it = conns_.erase(it);
       } else {
@@ -378,7 +388,12 @@ class Server {
         c.waiting = false;
         // roll back a timed-out barrier arrival so retries can complete
         // the barrier (otherwise the key stays phase-shifted forever)
-        if (c.is_barrier) barrier_count_[c.wait_key] -= 1;
+        if (c.is_barrier) {
+          auto b = barrier_count_.find(c.wait_key);
+          if (b != barrier_count_.end() && --(b->second) <= 0) {
+            barrier_count_.erase(b);
+          }
+        }
         reply(kvp.first, c, "TMO");
       }
     }

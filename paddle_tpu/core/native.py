@@ -131,16 +131,23 @@ class SharedMemoryQueue:
             raise RuntimeError(f"shmq_push failed ({rc})")
 
     def get(self, timeout: float = 60.0) -> bytes:
-        size = self._lib.shmq_next_size(self._h, int(timeout * 1000))
-        if size == -1:
-            raise TimeoutError("shm queue empty")
-        if size < 0:
-            raise RuntimeError(f"shmq_next_size failed ({size})")
-        buf = ctypes.create_string_buffer(int(size))
-        n = self._lib.shmq_pop(self._h, buf, size, int(timeout * 1000))
-        if n < 0:
-            raise RuntimeError(f"shmq_pop failed ({n})")
-        return buf.raw[:n]
+        # next_size + pop is not atomic: with multiple consumers another
+        # process can pop in between, so pop may return -3 (buffer too
+        # small for a different record) — re-query the size and retry.
+        for _ in range(64):
+            size = self._lib.shmq_next_size(self._h, int(timeout * 1000))
+            if size == -1:
+                raise TimeoutError("shm queue empty")
+            if size < 0:
+                raise RuntimeError(f"shmq_next_size failed ({size})")
+            buf = ctypes.create_string_buffer(int(size))
+            n = self._lib.shmq_pop(self._h, buf, size, int(timeout * 1000))
+            if n == -3:
+                continue
+            if n < 0:
+                raise RuntimeError(f"shmq_pop failed ({n})")
+            return buf.raw[:n]
+        raise RuntimeError("shmq_pop: persistent size race (-3)")
 
     def qsize(self) -> int:
         return int(self._lib.shmq_count(self._h))

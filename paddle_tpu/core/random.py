@@ -18,7 +18,17 @@ __all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "default_seed"]
 
 _lock = threading.Lock()
 _DEFAULT_SEED = 34342423252  # arbitrary fixed default so runs are reproducible
-_state = {"key": jax.random.key(_DEFAULT_SEED), "seed": _DEFAULT_SEED}
+# key is created lazily: materializing it here would touch the default
+# backend at `import paddle_tpu` time, making the import fail/hang when the
+# accelerator is broken (the library must import device-free).
+_state = {"key": None, "seed": _DEFAULT_SEED}
+
+
+def _global_key():
+    k = _state["key"]
+    if k is None:
+        k = _state["key"] = jax.random.key(_state["seed"])
+    return k
 
 # When tracing (jit.to_static), draws must come from a *traced* key argument
 # so compiled programs get fresh randomness per call instead of a baked
@@ -62,7 +72,7 @@ def next_key(n: Optional[int] = None):
         src[0] = keys[0]
         return keys[1:]
     with _lock:
-        k = _state["key"]
+        k = _global_key()
         if n is None:
             _state["key"], sub = jax.random.split(k)
             return sub
@@ -72,7 +82,8 @@ def next_key(n: Optional[int] = None):
 
 
 def get_rng_state():
-    return _state["key"]
+    with _lock:
+        return _global_key()
 
 
 def set_rng_state(key):

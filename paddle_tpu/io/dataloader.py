@@ -131,7 +131,40 @@ class _ProcessPoolIter:
         import os
         self.loader = loader
         self.index_iter = index_iter
-        ctx = mp.get_context("fork")
+        # forkserver by default: forking a process that already holds
+        # XLA/JAX runtime threads is a known deadlock source (CPython 3.12
+        # warns on it). Unpicklable worker args (e.g. closures in tests)
+        # fall back to fork; PADDLE_TPU_DATALOADER_START_METHOD overrides.
+        method = getattr(loader, "_mp_start_method", None)
+        if method is None:
+            method = os.environ.get("PADDLE_TPU_DATALOADER_START_METHOD")
+        if method is None:
+            import io as _io
+            import pickle as _pkl
+            probed = (loader.dataset, loader.collate_fn,
+                      getattr(loader, "worker_init_fn", None))
+            # anything living in __main__ pickles by reference but forces
+            # the forkserver child to re-import (re-execute) the training
+            # script — only safe under fork
+            in_main = any(
+                getattr(type(o), "__module__", None) == "__main__" or
+                getattr(o, "__module__", None) == "__main__"
+                for o in probed if o is not None)
+            try:
+                # probe into a null sink — no materialized copy of a
+                # potentially multi-GB in-memory dataset
+                class _Null(_io.RawIOBase):
+                    def write(self, b):
+                        return len(b)
+                _pkl.Pickler(_Null(), _pkl.HIGHEST_PROTOCOL).dump(probed)
+                method = "fork" if in_main else "forkserver"
+            except Exception:
+                method = "fork"
+            loader._mp_start_method = method  # probe once per loader
+        try:
+            ctx = mp.get_context(method)
+        except ValueError:
+            ctx = mp.get_context("fork")
         self.task_q = ctx.Queue()
         self.result_shm = None
         if loader.use_shared_memory:

@@ -2,5 +2,7 @@
 from .api import (to_static, not_to_static, ignore_module,  # noqa: F401
                   TracedFunction, enable_to_static)
 from .save_load import save, load, TranslatedLayer  # noqa: F401
+from .train_step import train_step, TrainStep  # noqa: F401
 
-__all__ = ["to_static", "not_to_static", "save", "load", "enable_to_static"]
+__all__ = ["to_static", "not_to_static", "save", "load", "enable_to_static",
+           "train_step", "TrainStep"]

@@ -59,17 +59,28 @@ def _dims(n, channel_last):
         else ("NCDHW", "OIDHW", "NCDHW")
 
 
+import os as _os
+
+# Internally compute channel-first convs in channels-last layout (transpose
+# in/out; XLA cancels back-to-back transposes between conv layers). On TPU
+# the MXU wants the channel dim minor-most — this is the analog of the
+# reference's cuDNN NHWC autotune choice (paddle/phi/kernels/gpudnn/).
+_INTERNAL_CHANNELS_LAST = _os.environ.get(
+    "PADDLE_TPU_CONV_CHANNELS_LAST", "1") not in ("0", "false", "False")
+
+
 def _conv(x, weight, bias, stride, padding, dilation, groups, n,
           data_format, name):
     channel_last = data_format.endswith("C")
     st = _tuple(stride, n)[:n]
     dl = _tuple(dilation, n)[:n]
     pd = _padding(padding, n, data_format)
-    lhs_spec, rhs_spec, out_spec = _dims(n, channel_last)
-    dn = lax.conv_dimension_numbers(
-        (1,) * (n + 2), (1,) * (n + 2), (lhs_spec, rhs_spec, out_spec))
+    to_nhwc = _INTERNAL_CHANNELS_LAST and not channel_last
+    lhs_spec, rhs_spec, out_spec = _dims(n, channel_last or to_nhwc)
 
     def f(v, w, *rest):
+        if to_nhwc:
+            v = jnp.transpose(v, (0,) + tuple(range(2, n + 2)) + (1,))
         # weight arrives in paddle layout OI*; transpose to rhs_spec
         if rhs_spec != "OI" + rhs_spec[2:]:
             # e.g. HWIO: move O,I to the back
@@ -87,6 +98,8 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n,
             shape = [1] * out.ndim
             shape[out_spec.index("C")] = b.size
             out = out + b.reshape(shape)
+        if to_nhwc:
+            out = jnp.transpose(out, (0, n + 1) + tuple(range(1, n + 1)))
         return out
     args = (_ensure(x), _ensure(weight))
     if bias is not None:

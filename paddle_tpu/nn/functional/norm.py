@@ -31,11 +31,17 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     use_batch_stats = training and not use_global_stats
 
     if use_batch_stats:
-        # compute batch stats eagerly (outside tape) for the running update
+        # compute batch stats eagerly (outside tape) for the running update.
+        # Stats accumulate in fp32 regardless of activation dtype; the data
+        # path stays in the input dtype — the TPU analog of cuDNN's fused BN
+        # (bf16 in/out, fp32 statistics). Two-pass mean/var: the one-pass
+        # E[x^2]-E[x]^2 form catastrophically cancels when |mean| >> std.
         def f(v, *wb):
-            mean = jnp.mean(v, axis=reduce_axes)
-            var = jnp.var(v, axis=reduce_axes)
-            out = _affine(v, mean, var, wb, ch_axis, epsilon)
+            v32 = v.astype(jnp.float32)
+            mean = jnp.mean(v32, axis=reduce_axes)
+            var = jnp.var(v32, axis=reduce_axes)
+            out = _affine(v, mean, var, wb, ch_axis, epsilon,
+                          weight is not None, bias is not None)
             return out, mean, var
         args = (x,) + _wb_args(weight, bias)
         out, mean_t, var_t = dispatch(f, args, name="batch_norm",
@@ -51,7 +57,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         return out
 
     def f(v, m, va, *wb):
-        return _affine(v, m, va, wb, ch_axis, epsilon)
+        return _affine(v, m, va, wb, ch_axis, epsilon,
+                       weight is not None, bias is not None)
     args = (x, rm, rv) + _wb_args(weight, bias)
     return dispatch(f, args, name="batch_norm")
 
@@ -65,18 +72,25 @@ def _wb_args(weight, bias):
     return args
 
 
-def _affine(v, mean, var, wb, ch_axis, epsilon):
+def _affine(v, mean, var, wb, ch_axis, epsilon, has_weight, has_bias):
+    """y = x*scale + shift with the per-channel scalars folded in fp32 and
+    the (large) activation math done in the activation dtype — no whole-
+    tensor fp32 round trip. ``wb`` holds (weight?, bias?) per the explicit
+    presence flags (a lone bias must not be taken for the weight)."""
     shape = [1] * v.ndim
     shape[ch_axis] = v.shape[ch_axis]
-    inv = jax.lax.rsqrt(var.astype(jnp.float32) + epsilon).astype(v.dtype)
-    out = (v - mean.reshape(shape).astype(v.dtype)) * inv.reshape(shape)
+    mean32 = mean.astype(jnp.float32)
+    inv = jax.lax.rsqrt(var.astype(jnp.float32) + epsilon)
+    scale = inv
     i = 0
-    if len(wb) >= 1:
-        out = out * wb[0].reshape(shape)
+    if has_weight:
+        scale = scale * wb[i].astype(jnp.float32)
         i += 1
-    if len(wb) == i + 1:
-        out = out + wb[i].reshape(shape)
-    return out
+    shift = -mean32 * scale
+    if has_bias:
+        shift = shift + wb[i].astype(jnp.float32)
+    return (v * scale.reshape(shape).astype(v.dtype)
+            + shift.reshape(shape).astype(v.dtype))
 
 
 def _channel_axis(ndim, data_format):
@@ -107,10 +121,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
                ).astype(v.dtype)
         i = 0
         if weight is not None:
-            out = out * wb[i]
+            out = out * wb[i].astype(out.dtype)
             i += 1
         if bias is not None:
-            out = out + wb[i]
+            out = out + wb[i].astype(out.dtype)
         return out
     args = (x,) + _wb_args(weight, bias)
     return dispatch(f, args, name="layer_norm")
@@ -141,17 +155,19 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
     spatial = tuple(i for i in range(x.ndim) if i not in (0, ch_axis))
 
     def f(v, *wb):
-        mean = jnp.mean(v, axis=spatial, keepdims=True)
-        var = jnp.var(v, axis=spatial, keepdims=True)
-        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        # fp32 statistics, activation-dtype data path (see batch_norm)
+        v32 = v.astype(jnp.float32)
+        mean = jnp.mean(v32, axis=spatial, keepdims=True)
+        var = jnp.var(v32, axis=spatial, keepdims=True)
+        out = ((v32 - mean) * jax.lax.rsqrt(var + eps)).astype(v.dtype)
         shape = [1] * v.ndim
         shape[ch_axis] = v.shape[ch_axis]
         i = 0
         if weight is not None:
-            out = out * wb[i].reshape(shape)
+            out = out * wb[i].reshape(shape).astype(out.dtype)
             i += 1
         if bias is not None:
-            out = out + wb[i].reshape(shape)
+            out = out + wb[i].reshape(shape).astype(out.dtype)
         return out
     args = (x,) + _wb_args(weight, bias)
     return dispatch(f, args, name="instance_norm")
@@ -170,16 +186,19 @@ def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
         n, c = v_t.shape[0], v_t.shape[1]
         g = v_t.reshape((n, num_groups, c // num_groups) + v_t.shape[2:])
         axes = tuple(range(2, g.ndim))
-        mean = jnp.mean(g, axis=axes, keepdims=True)
-        var = jnp.var(g, axis=axes, keepdims=True)
-        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v_t.shape)
+        # fp32 statistics, activation-dtype data path (see batch_norm)
+        g32 = g.astype(jnp.float32)
+        mean = jnp.mean(g32, axis=axes, keepdims=True)
+        var = jnp.var(g32, axis=axes, keepdims=True)
+        out = ((g32 - mean) * jax.lax.rsqrt(var + epsilon)
+               ).astype(v.dtype).reshape(v_t.shape)
         shape = [1, c] + [1] * (v_t.ndim - 2)
         i = 0
         if weight is not None:
-            out = out * wb[i].reshape(shape)
+            out = out * wb[i].reshape(shape).astype(out.dtype)
             i += 1
         if bias is not None:
-            out = out + wb[i].reshape(shape)
+            out = out + wb[i].reshape(shape).astype(out.dtype)
         if ch_axis != 1:
             out = jnp.moveaxis(out, 1, ch_axis)
         return out

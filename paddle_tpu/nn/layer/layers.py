@@ -22,6 +22,9 @@ from .. import initializer as I
 
 __all__ = ["Layer", "Sequential", "LayerList", "ParameterList", "LayerDict"]
 
+# per-name-scope instance counters for paddle-style parameter names
+_scope_counters: Dict[str, int] = {}
+
 
 class HookRemoveHelper:
     def __init__(self, hooks, key):
@@ -62,9 +65,25 @@ class Layer:
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         value = init(shape, dtype)
-        p = Parameter(value, name=attr.name, trainable=attr.trainable)
+        p = Parameter(value, name=attr.name or self._auto_param_name(is_bias),
+                      trainable=attr.trainable)
         p._param_attr = attr
         return p
+
+    def _auto_param_name(self, is_bias: bool) -> str:
+        """Paddle-style default name "linear_0.w_0" / "linear_0.b_0" so
+        name-based policies (AdamW apply_decay_param_fun, need_clip
+        filters) have something meaningful to match on (reference:
+        unique_name generator in python/paddle/base/unique_name.py)."""
+        scope = self._name_scope
+        idx = getattr(self, "_unique_scope_idx", None)
+        if idx is None:
+            idx = _scope_counters[scope] = _scope_counters.get(scope, -1) + 1
+            self._unique_scope_idx = idx
+        kind = "b" if is_bias else "w"
+        k = f"_n_{kind}"
+        n = self.__dict__[k] = self.__dict__.get(k, -1) + 1
+        return f"{scope}_{idx}.{kind}_{n}"
 
     def create_tensor(self, name=None, persistable=False, dtype=None):
         import jax.numpy as jnp

@@ -52,9 +52,12 @@ def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
                         k.astype(jnp.float32)) * scale
     T = MB * BS
     mask = jnp.arange(T)[None, None, :] < seq_lens[:, None, None]
-    scores = jnp.where(mask, scores, -jnp.inf)
+    # finite mask value: a padding slot with seq_len 0 would otherwise get
+    # an all--inf row and softmax NaN; zero its output instead
+    scores = jnp.where(mask, scores, jnp.float32(-1e30))
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bht,bthd->bhd", probs, v.astype(jnp.float32))
+    out = jnp.where(seq_lens[:, None, None] > 0, out, 0.0)
     return out.astype(q.dtype)
 
 

@@ -108,59 +108,140 @@ class Optimizer:
     def step(self):
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if (not p.stop_gradient and p.grad is not None)]
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
         self._apply(params_grads)
         self._global_step += 1
 
     minimize_step = step
 
-    def _apply(self, params_grads):
-        lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
-        names = self._accumulator_names()
-        wd_of = {}
-        lr_scale_of = {}
+    def _group_maps(self):
+        """id(param) -> (group wd, group lr scale), built once per call."""
+        wd_of, lr_scale_of = {}, {}
         for g in self._param_groups:
-            for p in g["params"]:
-                wd_of[id(p)] = g.get("weight_decay")
-                lr_scale_of[id(p)] = g.get("learning_rate", 1.0)
-        for p, grad in params_grads:
-            if grad is None:
-                continue
-            accs = {n: self._get_accumulator(n, p) for n in names}
-            master = self._master(p)
-            attr = getattr(p, "_param_attr", None)
-            plr = lr * float(lr_scale_of.get(id(p), 1.0)) * (
-                attr.learning_rate if attr is not None else 1.0)
-            wd = wd_of.get(id(p))
-            if attr is not None and attr.regularizer is not None:
-                wd = attr.regularizer
-            step = jnp.asarray(self._global_step + 1, dtype=jnp.float32)
-            new_p, new_accs, new_master = self._jit_update(
-                to_value(p), to_value(grad), accs, plr, wd, master, step)
-            p._replace_value(new_p)
+            for q in g["params"]:
+                wd_of[id(q)] = g.get("weight_decay")
+                lr_scale_of[id(q)] = g.get("learning_rate", 1.0)
+        return wd_of, lr_scale_of
+
+    def _param_meta(self, p, maps=None) -> Tuple[float, float, bool]:
+        """Static (lr_scale, wd, need_clip) for one parameter."""
+        wd_of, lr_scale_of = maps if maps is not None else self._group_maps()
+        attr = getattr(p, "_param_attr", None)
+        lr_scale = float(lr_scale_of.get(id(p), 1.0)) * (
+            attr.learning_rate if attr is not None else 1.0)
+        wd = wd_of.get(id(p))
+        if attr is not None and attr.regularizer is not None:
+            wd = attr.regularizer
+        wd = _wd_value(wd)
+        # AdamW(apply_decay_param_fun=...) must hold on every update path
+        # (fused _apply AND jit.train_step, which reads metas directly)
+        adpf = getattr(self, "_apply_decay_param_fun", None)
+        if adpf is not None and not adpf(p.name):
+            wd = 0.0
+        need_clip = getattr(attr, "need_clip", True) if attr is not None \
+            else True
+        return lr_scale, wd, need_clip
+
+    def _clip_mode(self):
+        """In-program clip spec for the known clip strategies, or a callable
+        for custom ones (applied eagerly before the fused program)."""
+        from ..nn.clip import (ClipGradByGlobalNorm, ClipGradByNorm,
+                               ClipGradByValue)
+        c = self._grad_clip
+        if c is None:
+            return None
+        if type(c) is ClipGradByGlobalNorm:
+            return ("global", c.clip_norm)
+        if type(c) is ClipGradByNorm:
+            return ("norm", c.clip_norm)
+        if type(c) is ClipGradByValue:
+            return ("value", (c.min, c.max))
+        return ("eager", c)
+
+    def _apply(self, params_grads):
+        """Apply ALL parameter updates (and grad clip) in one jitted,
+        donated XLA program — the TPU analog of the reference's fused
+        multi_tensor optimizer kernels."""
+        params_grads = [(p, g) for p, g in params_grads if g is not None]
+        if not params_grads:
+            self._post_apply()
+            return
+        clip = self._clip_mode()
+        if clip is not None and clip[0] == "eager":
+            params_grads = [(p, g) for p, g in clip[1](params_grads)
+                            if g is not None]
+            clip = None
+        names = self._accumulator_names()
+        params = [p for p, _ in params_grads]
+        maps = self._group_maps()
+        metas = [self._param_meta(p, maps) for p in params]
+        masters = [self._master(p) for p in params]
+        has_master = tuple(m is not None for m in masters)
+        key = (tuple((tuple(p.shape), str(to_value(p).dtype)) for p in params),
+               tuple(metas), has_master, clip, len(names))
+        fn = self._fused_cache_get(key, metas, has_master, clip, names)
+
+        p_vals = tuple(to_value(p) for p in params)
+        g_vals = tuple(to_value(g) for _, g in params_grads)
+        acc_vals = {n: tuple(self._get_accumulator(n, p) for p in params)
+                    for n in names}
+        master_vals = tuple(m for m in masters if m is not None)
+        lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
+        step = jnp.asarray(self._global_step + 1, dtype=jnp.float32)
+
+        new_ps, new_accs, new_masters = fn(p_vals, g_vals, acc_vals,
+                                           master_vals, lr, step)
+        mi = 0
+        for i, p in enumerate(params):
+            p._replace_value(new_ps[i])
             for n in names:
-                self._accumulators[n][id(p)] = new_accs[n]
-            if new_master is not None:
-                self._accumulators["master_weight"][id(p)] = new_master
+                self._accumulators[n][id(p)] = new_accs[n][i]
+            if has_master[i]:
+                self._accumulators["master_weight"][id(p)] = new_masters[mi]
+                mi += 1
         self._post_apply()
 
     def _post_apply(self):
         pass
 
-    def _jit_update(self, p_val, g_val, accs, lr, wd, master, step):
-        # one jitted update per (optimizer, shapes); donated in/out aliasing
-        # keeps memory flat
-        wd_val = _wd_value(wd)
-        fn = self._cached_update_fn()
-        return fn(p_val, g_val, accs, lr, wd_val, master, step)
-
-    def _cached_update_fn(self):
+    def _fused_cache_get(self, key, metas, has_master, clip, names):
         if self._compiled_update is None:
-            def upd(p, g, accs, lr, wd, master, step):
-                return self._update(p, g, accs, lr, wd, master, step=step)
-            self._compiled_update = jax.jit(upd, donate_argnums=(0, 2, 5))
-        return self._compiled_update
+            self._compiled_update = {}
+        fn = self._compiled_update.get(key)
+        if fn is not None:
+            return fn
+        fn = jax.jit(self._build_fused(metas, has_master, clip, names),
+                     donate_argnums=(0, 2, 3))
+        self._compiled_update[key] = fn
+        return fn
+
+    def _build_fused(self, metas, has_master, clip, names):
+        """Build the pure whole-list update: clip -> per-param rule."""
+        update = self._update
+
+        def fused(p_vals, g_vals, acc_vals, master_vals, lr, step):
+            g_vals = _clip_grads(g_vals, metas, clip)
+            new_ps, new_masters = [], []
+            new_accs = {n: [] for n in names}
+            mi = 0
+            for i, (p, g) in enumerate(zip(p_vals, g_vals)):
+                lr_scale, wd, _ = metas[i]
+                accs = {n: acc_vals[n][i] for n in names}
+                master = None
+                if has_master[i]:
+                    master = master_vals[mi]
+                    mi += 1
+                np_, na, nm = update(p, g, accs, lr * lr_scale, wd,
+                                     master, step=step)
+                new_ps.append(np_)
+                for n in names:
+                    new_accs[n].append(na[n])
+                if nm is not None:
+                    new_masters.append(nm)
+            return (tuple(new_ps),
+                    {n: tuple(v) for n, v in new_accs.items()},
+                    tuple(new_masters))
+
+        return fused
 
     @no_grad()
     def clear_grad(self, set_to_zero=False):
@@ -179,9 +260,7 @@ class Optimizer:
     def state_dict(self) -> Dict:
         from .lr import LRScheduler
         state = {"global_step": self._global_step, "accumulators": {}}
-        name_of = {}
-        for i, p in enumerate(self._parameter_list):
-            name_of[id(p)] = p.name or f"param_{i}"
+        name_of = _unique_param_names(self._parameter_list)
         for acc_name, accs in self._accumulators.items():
             for pid, v in accs.items():
                 key = f"{name_of.get(pid, pid)}.{acc_name}"
@@ -196,18 +275,41 @@ class Optimizer:
         if "LR_Scheduler" in state_dict and \
                 isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
-        name_of = {}
-        for i, p in enumerate(self._parameter_list):
-            name_of[p.name or f"param_{i}"] = p
+        name_of = {n: p for n, p in zip(
+            _unique_param_names(self._parameter_list).values(),
+            self._parameter_list)}
+        dropped = []
         for key, v in state_dict.get("accumulators", {}).items():
             pname, acc_name = key.rsplit(".", 1)
             p = name_of.get(pname)
             if p is not None:
                 self._accumulators[acc_name][id(p)] = to_value(
                     v if isinstance(v, Tensor) else Tensor(v))
+            else:
+                dropped.append(key)
+        if dropped:
+            import warnings
+            warnings.warn(
+                f"Optimizer.set_state_dict: {len(dropped)} accumulator "
+                f"entries matched no current parameter name and were "
+                f"dropped (e.g. {dropped[0]!r}) — optimizer state for "
+                "those parameters restarts from zero", stacklevel=2)
 
     def __repr__(self):
         return f"{type(self).__name__}(lr={self.get_lr()})"
+
+
+def _unique_param_names(params):
+    """id(p) -> checkpoint key, in parameter order. Uses p.name but
+    deduplicates collisions (e.g. deepcopied layers share auto names) with
+    a deterministic '#k' suffix so save/load round-trips stay aligned."""
+    out, seen = {}, {}
+    for i, p in enumerate(params):
+        base = p.name or f"param_{i}"
+        k = seen.get(base, 0)
+        seen[base] = k + 1
+        out[id(p)] = base if k == 0 else f"{base}#{k}"
+    return out
 
 
 def _wd_value(wd):
@@ -225,3 +327,35 @@ def _wd_value(wd):
 def _decoupled_wd(p32, lr, wd):
     # AdamW-style decoupled decay
     return p32 * (1.0 - lr * wd)
+
+
+def _clip_grads(g_vals, metas, clip):
+    """Traced gradient clipping over the flat grad list (one program with
+    the update — no separate dispatches). metas[i][2] = need_clip."""
+    if clip is None:
+        return g_vals
+    mode, arg = clip
+    if mode == "value":
+        lo, hi = arg
+        return tuple(
+            jnp.clip(g, lo, hi) if metas[i][2] else g
+            for i, g in enumerate(g_vals))
+    if mode == "norm":
+        out = []
+        for i, g in enumerate(g_vals):
+            if not metas[i][2]:
+                out.append(g)
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(arg / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((g * scale).astype(g.dtype))
+        return tuple(out)
+    # global norm
+    sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+          for i, g in enumerate(g_vals) if metas[i][2]]
+    if not sq:
+        return g_vals
+    gnorm = jnp.sqrt(sum(sq))
+    scale = jnp.minimum(arg / jnp.maximum(gnorm, 1e-12), 1.0)
+    return tuple((g * scale).astype(g.dtype) if metas[i][2] else g
+                 for i, g in enumerate(g_vals))

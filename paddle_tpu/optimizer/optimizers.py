@@ -141,19 +141,9 @@ class AdamW(Adam):
     def _coupled_wd(self):
         return False
 
-    def _apply(self, params_grads):
-        if self._apply_decay_param_fun is not None:
-            # temporarily zero wd for excluded params via param groups
-            filtered = []
-            for p, g in params_grads:
-                if not self._apply_decay_param_fun(p.name):
-                    attr = getattr(p, "_param_attr", None)
-                    p._skip_decay = True
-                else:
-                    p._skip_decay = False
-                filtered.append((p, g))
-            params_grads = filtered
-        super()._apply(params_grads)
+    # weight-decay exclusion via apply_decay_param_fun is handled in
+    # Optimizer._param_meta so it holds on both the fused _apply path and
+    # the jit.train_step path
 
 class Adamax(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,

@@ -42,8 +42,14 @@ class AbsmaxObserver(BaseObserver):
     def observe(self, x):
         v = np.asarray(to_value(x))
         self._absmax = max(self._absmax, float(np.abs(v).max(initial=0.0)))
+        self._observed = True
 
     def scale(self):
+        if not getattr(self, "_observed", False):
+            raise RuntimeError(
+                "AbsmaxObserver.scale() called before any data was "
+                "observed — run calibration batches through the layer "
+                "before convert()")
         return np.float32(max(self._absmax, 1e-8) / self.qmax)
 
 
@@ -65,7 +71,12 @@ class MovingAverageAbsmaxObserver(BaseObserver):
                 (1 - self.momentum) * v
 
     def scale(self):
-        return np.float32(max(self._state or 0.0, 1e-8) / self.qmax)
+        if self._state is None:
+            raise RuntimeError(
+                "MovingAverageAbsmaxObserver.scale() called before any "
+                "data was observed — run calibration batches through the "
+                "layer before convert()")
+        return np.float32(max(self._state, 1e-8) / self.qmax)
 
 
 class PerChannelAbsmaxObserver(BaseObserver):

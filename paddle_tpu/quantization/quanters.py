@@ -48,6 +48,10 @@ class FakeQuanterWithAbsMax:
     def __call__(self, x):
         if self.training:
             self.observer.observe(x)
+        if self.observer._state is None:
+            # eval-mode forward before any calibration/training batch:
+            # pass through rather than fake-quant with a garbage scale
+            return x
         return fake_quant(x, self.observer.scale(), self.bits)
 
 
