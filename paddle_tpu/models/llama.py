@@ -132,14 +132,6 @@ def param_shardings(mesh: Mesh, cfg: LlamaConfig) -> Dict:
     return specs
 
 
-def _repeat_kv(x, n):
-    if n == 1:
-        return x
-    b, s, kv, d = x.shape
-    return jnp.broadcast_to(x[:, :, :, None, :], (b, s, kv, n, d)).reshape(
-        b, s, kv * n, d)
-
-
 def _decoder_layer(layer_params, x, sin, cos, cfg: LlamaConfig,
                    attn_mask=None):
     """One decoder block on [B, S, D]."""
@@ -153,8 +145,7 @@ def _decoder_layer(layer_params, x, sin, cos, cfg: LlamaConfig,
     v = (h @ layer_params["v_proj"]).reshape(b, s, KV, hd)
     q = apply_rope(q, sin, cos)
     kk = apply_rope(kk, sin, cos)
-    kk = _repeat_kv(kk, H // KV)
-    v = _repeat_kv(v, H // KV)
+    # GQA handled natively by the kernel (KV heads indexed, not repeated)
     attn = flash_attention(q, kk, v, causal=True)
     attn = attn.reshape(b, s, H * hd)
     x = x + attn @ layer_params["o_proj"]
@@ -259,8 +250,6 @@ def _lazy_layer_api():
                 sin, cos = build_rope_cache(s, hd, base=cfg.rope_theta)
                 qv = apply_rope(qv, sin, cos)
                 kv = apply_rope(kv, sin, cos)
-                kv = _repeat_kv(kv, H // KV)
-                vv = _repeat_kv(vv, H // KV)
                 return flash_attention(qv, kv, vv, causal=True)
             out = dispatch(rope_and_attend, (q, k, v), name="llama_attention")
             out = reshape(out, [b, s, H * hd])

@@ -76,10 +76,57 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
-def flash_attn_unpadded(qkv=None, **kwargs):
-    raise NotImplementedError(
-        "varlen flash attention: use scaled_dot_product_attention with an "
-        "attn_mask; segment-packed Pallas kernel tracked in ops/")
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen (packed) flash attention.
+
+    reference: python/paddle/nn/functional/flash_attention.py
+    flash_attn_unpadded → paddle/phi/kernels/gpu/flash_attn_kernel.cu:137
+    (cu_seqlens varlen kernel). TPU-native: the packed [total, heads, dim]
+    tensors are treated as one batch-1 sequence and per-token segment ids
+    derived from ``cu_seqlens`` confine attention (and causality) to each
+    original sequence inside the Pallas kernel — no unpack/pad round trip.
+
+    query/key/value: [total_q|total_k, num_heads, head_dim];
+    cu_seqlens_q/k: [batch+1] int32 cumulative sequence lengths.
+
+    ``causal=True`` requires cu_seqlens_q == cu_seqlens_k: the kernel
+    masks on packed positions, which is only the per-sequence causal mask
+    when queries and keys share the packing (bottom-right-aligned causal
+    for cross-length q/k is not implemented).
+    """
+    from ...ops.flash_attention import (flash_attention as _fa,
+                                        segment_ids_from_cu_seqlens)
+    if causal:
+        import numpy as _np
+        cq_v, ck_v = cu_seqlens_q, cu_seqlens_k
+        cq_a = getattr(cq_v, "_value", cq_v)
+        ck_a = getattr(ck_v, "_value", ck_v)
+        try:
+            same = (_np.asarray(cq_a).shape == _np.asarray(ck_a).shape and
+                    bool((_np.asarray(cq_a) == _np.asarray(ck_a)).all()))
+        except Exception:
+            same = True  # traced values: trust the caller
+        if not same:
+            raise NotImplementedError(
+                "flash_attn_unpadded(causal=True) requires "
+                "cu_seqlens_q == cu_seqlens_k (self-attention packing)")
+
+    def f(q, k, v, cq, ck):
+        tq, tk = q.shape[0], k.shape[0]
+        seg_q = segment_ids_from_cu_seqlens(cq, tq)[None]
+        seg_k = segment_ids_from_cu_seqlens(ck, tk)[None]
+        out = _fa(q[None], k[None], v[None], causal=causal, scale=scale,
+                  segment_ids=seg_q, kv_segment_ids=seg_k)
+        return out[0]
+
+    args = tuple(_ensure(a) for a in
+                 (query, key, value, cu_seqlens_q, cu_seqlens_k))
+    out = dispatch(f, args, name="flash_attn_unpadded")
+    return out, None  # softmax is never returned (fused kernel)
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):

@@ -1,11 +1,13 @@
 """Flash attention.
 
 reference: paddle/phi/kernels/gpu/flash_attn_kernel.cu:517 (dynload of the
-flash-attn CUDA library). TPU-native: a Pallas kernel (ops/pallas/
-flash_attention.py) with the blockwise online-softmax algorithm; this module
+flash-attn CUDA library; varlen path at :137). TPU-native: a Pallas kernel
+(ops/pallas/flash_attention.py) with the blockwise online-softmax algorithm,
+native GQA, segment-id (varlen) masking and additive bias; this module
 routes to it on TPU and to a fused-friendly jnp composition elsewhere.
 
 Layout: [batch, seq, heads, head_dim] (paddle flash-attn convention).
+K/V may carry fewer heads than Q (GQA) on both paths.
 """
 from __future__ import annotations
 
@@ -15,25 +17,61 @@ import jax
 import jax.numpy as jnp
 
 
-def _ref_attention(q, k, v, causal=False, scale=None):
+def _ref_attention(q, k, v, causal=False, scale=None, bias=None,
+                   segment_ids=None, kv_segment_ids=None):
     d = q.shape[-1]
+    h, kvh = q.shape[2], k.shape[2]
+    if kvh != h:
+        k = jnp.repeat(k, h // kvh, axis=2)
+        v = jnp.repeat(v, h // kvh, axis=2)
     s = scale if scale is not None else 1.0 / (d ** 0.5)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * s
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    ql, kl = logits.shape[-2], logits.shape[-1]
+    mask = jnp.ones((ql, kl), bool)
     if causal:
-        ql, kl = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((ql, kl), bool), k=kl - ql)
-        logits = jnp.where(mask, logits, -1e30)
+        mask = jnp.tril(mask, k=kl - ql)
+    mask = mask[None, None]
+    if segment_ids is not None:
+        kv_seg = kv_segment_ids if kv_segment_ids is not None \
+            else segment_ids
+        mask = mask & (segment_ids[:, None, :, None] ==
+                       kv_seg[:, None, None, :])
+    logits = jnp.where(mask, logits, -1e30)
     p = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    # rows with no valid key (segment padding) must yield 0, not uniform avg
+    if segment_ids is not None:
+        any_valid = jnp.any(mask, axis=-1)  # [b, h|1, q]
+        out = jnp.where(jnp.swapaxes(any_valid, 1, 2)[..., None], out, 0.0)
     return out.astype(q.dtype)
 
 
-def flash_attention(q, k, v, causal=False, scale=None):
+def flash_attention(q, k, v, causal=False, scale=None, bias=None,
+                    segment_ids=None, kv_segment_ids=None, bias_grad=False):
+    if bias is not None and not bias_grad:
+        bias = jax.lax.stop_gradient(bias)
     if jax.default_backend() in ("tpu", "axon"):
         try:
             from .pallas.flash_attention import flash_attention_pallas
-            return flash_attention_pallas(q, k, v, causal=causal, scale=scale)
+            return flash_attention_pallas(
+                q, k, v, causal=causal, scale=scale, bias=bias,
+                segment_ids=segment_ids, kv_segment_ids=kv_segment_ids,
+                bias_grad=bias_grad)
         except Exception:
             pass
-    return _ref_attention(q, k, v, causal=causal, scale=scale)
+    return _ref_attention(q, k, v, causal=causal, scale=scale, bias=bias,
+                          segment_ids=segment_ids,
+                          kv_segment_ids=kv_segment_ids)
+
+
+def segment_ids_from_cu_seqlens(cu_seqlens, total: int):
+    """[n+1] cumulative lengths -> [total] int32 segment ids; positions past
+    cu_seqlens[-1] get id -1 (masked against every real segment)."""
+    pos = jnp.arange(total, dtype=jnp.int32)
+    seg = jnp.searchsorted(jnp.asarray(cu_seqlens, jnp.int32), pos,
+                           side="right").astype(jnp.int32) - 1
+    n = cu_seqlens.shape[0] - 1
+    return jnp.where(seg >= n, -1, seg)

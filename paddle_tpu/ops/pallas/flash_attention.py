@@ -2,16 +2,35 @@
 
 TPU-native replacement for the reference's flash-attn CUDA dynload
 (paddle/phi/kernels/gpu/flash_attn_kernel.cu:517 → phi::dynload::
-flash_attn_fwd): blockwise online-softmax attention tiled for VMEM, with a
-custom_vjp whose backward is also a Pallas kernel pair (dq pass + dkv pass).
+flash_attn_fwd, varlen path at :137): blockwise online-softmax attention
+tiled for VMEM, with a custom_vjp whose backward is also a Pallas kernel
+pair (dq pass + dkv pass).
+
+Capabilities beyond the round-1 kernel:
+- native GQA: K/V carry ``kvh < h`` heads; the kernel indexes the KV head
+  for each Q head via the BlockSpec index map instead of materializing
+  ``repeat_kv`` copies (saves group× KV HBM traffic).
+- segment ids (varlen/packed sequences): attention is confined to equal
+  segment ids; combined with causal this gives per-sequence causal masks
+  for packed batches — the TPU analog of the reference's cu_seqlens
+  varlen kernel.
+- optional additive bias [b|1, h|1, sq, sk] (ALiBi, relative-position);
+  constant by default — pass ``bias_grad=True`` for a learned bias
+  (dbias from the dq pass costs a full [b*h, sq, sk] fp32 HBM write in
+  backward, so it is opt-in).
+- causal block pruning: K/V block fetches above the diagonal are clamped
+  to the diagonal block in the index map, so Mosaic's revisit-elision
+  skips the copy — fully-masked blocks cost neither compute (pl.when)
+  nor HBM reads (~2× fwd speedup for causal).
 
 Layout: public API takes [batch, seq, heads, head_dim] (paddle flash-attn
-convention) and transposes to [batch, heads, seq, head_dim] internally so
-(seq, head_dim) are the trailing MXU-tiled dims.
+convention) and transposes to [batch*heads, seq, head_dim] internally so
+(seq, head_dim) are the trailing MXU-tiled dims. Row statistics (lse,
+delta) ride in a (bh, 1, sq) layout — Mosaic wants the last two block
+dims (8,128)-divisible or equal to the array dims.
 
 Block sizes default to (512, 512) on the sequence dims — multiples of the
-bf16 (16, 128) tile; causal masking skips fully-masked K blocks via the
-grid order and in-block iota masks.
+bf16 (16, 128) tile.
 """
 from __future__ import annotations
 
@@ -35,11 +54,41 @@ def _block_sizes(sq, sk):
     return bq, bk
 
 
+def _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k):
+    """Apply causal/segment masks to a [bq, bk] score block. Returns
+    (masked scores, valid bool mask or None). The valid mask must also
+    zero the probabilities (p = exp(s - m)): with every score at
+    DEFAULT_MASK_VALUE the row max equals it and exp(s - m) would be 1
+    everywhere — a fully-masked row would silently return the mean of V
+    (and leak garbage into dk/dv in backward)."""
+    m = None
+    if causal:
+        q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        m = q_pos >= k_pos
+    if seg_q is not None:
+        same = seg_q[:, None] == seg_k[None, :]
+        m = same if m is None else (m & same)
+    if m is None:
+        return s, None
+    return jnp.where(m, s, DEFAULT_MASK_VALUE), m
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk, sk):
+def _fwd_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias):
+    i = 3
+    bias_ref = seg_q_ref = seg_k_ref = None
+    q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
+    if has_bias:
+        bias_ref = refs[i]
+        i += 1
+    if has_seg:
+        seg_q_ref, seg_k_ref = refs[i], refs[i + 1]
+        i += 2
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[i:i + 5]
+
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -51,7 +100,6 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     run = True
     if causal:
-        # skip blocks strictly above the diagonal
         run = (ki * bk) <= (qi * bq + bq - 1)
 
     @pl.when(run)
@@ -62,14 +110,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        if has_bias:
+            s = s + bias_ref[0, :, :].astype(jnp.float32)
+        seg_q = seg_q_ref[0, :] if has_seg else None
+        seg_k = seg_k_ref[0, :] if has_seg else None
+        s, valid = _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k)
         m_prev = m_scr[:]
         m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)  # [bq, bk]
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -86,30 +137,82 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0, :] = (m_scr[:] + jnp.log(l_safe))[:, 0]
 
 
+def _kv_index(h, kvh, causal, bq, bk):
+    """K/V BlockSpec index map: GQA head folding + causal diagonal clamp
+    (clamped repeats elide the HBM copy — Mosaic only issues a copy when
+    the block index changes)."""
+    groups = h // kvh
+
+    def idx(b, i, j):
+        kb = (b // h) * kvh + (b % h) // groups
+        if causal:
+            j = jnp.minimum(j, (i * bq + bq - 1) // bk)
+        return (kb, j, 0)
+
+    return idx
+
+
+def _bias_index(h, bias_b, bias_h, b_total, causal, bq, bk, clamp):
+    def idx(b, i, j):
+        bi = 0 if bias_b == 1 else b // h
+        hi = 0 if bias_h == 1 else b % h
+        if causal and clamp:
+            j = jnp.minimum(j, (i * bq + bq - 1) // bk)
+        return (bi * bias_h + hi, i, j)
+
+    return idx
+
+
+def _seg_specs(h, bq, bk, causal, clamp_k=True):
+    def q_idx(b, i, j):
+        return (b // h, 0, i)
+
+    def k_idx(b, i, j):
+        if causal and clamp_k:
+            j = jnp.minimum(j, (i * bq + bq - 1) // bk)
+        return (b // h, 0, j)
+
+    return (pl.BlockSpec((None, 1, bq), q_idx),
+            pl.BlockSpec((None, 1, bk), k_idx))
+
+
 @no_x64
-def _fwd(q, k, v, scale, causal):
-    """q,k,v: [bh, s, d] fp32/bf16 → (o [bh, sq, d], lse [bh, sq])."""
+def _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta):
+    """q: [bh, sq, d]; k/v: [bkvh, sk, d] → (o [bh, sq, d], lse [bh, sq]).
+    bias: [bias_bh, sq, sk] or None; seg_q/seg_k: [b, 1, s] int32 or None.
+    meta = (h, kvh, bias_b, bias_h, bias_grad) — static geometry."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk)
+    h, kvh, bias_b, bias_h, _ = meta
     grid = (bh, pl.cdiv(sq, bq), pl.cdiv(sk, bk))
+    has_bias, has_seg = bias is not None, seg_q is not None
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk)),
+        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk)),
+    ]
+    args = [q, k, v]
+    if has_bias:
+        in_specs.append(pl.BlockSpec(
+            (1, bq, bk),
+            _bias_index(h, bias_b, bias_h, bh, causal, bq, bk, True)))
+        args.append(bias)
+    if has_seg:
+        sq_spec, sk_spec = _seg_specs(h, bq, bk, causal)
+        in_specs += [sq_spec, sk_spec]
+        args += [seg_q, seg_k]
+
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, sk=sk)
+                               bq=bq, bk=bk, has_seg=has_seg,
+                               has_bias=has_bias)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            # lse rides as (bh, 1, sq) with a squeezed bh block: Mosaic
-            # requires the block's last two dims to be (8,128)-divisible or
-            # equal to the array dims — (1, bq) vs (1, sq) satisfies that,
-            # (1, bq) vs (bh, sq) does not (splash-attention uses the same
-            # trick for its logsumexp output)
             pl.BlockSpec((None, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
@@ -122,15 +225,32 @@ def _fwd(q, k, v, scale, causal):
             pltpu.VMEM((bq, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*args)
     return o, lse.reshape(bh, sq)
 
 
 # ---------------------------------------------------------------------------
 # backward
 # ---------------------------------------------------------------------------
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_scr, *, scale, causal, bq, bk):
+def _bwd_dq_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
+                   has_dbias):
+    i = 3
+    bias_ref = seg_q_ref = seg_k_ref = None
+    q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
+    if has_bias:
+        bias_ref = refs[i]
+        i += 1
+    if has_seg:
+        seg_q_ref, seg_k_ref = refs[i], refs[i + 1]
+        i += 2
+    do_ref, lse_ref, delta_ref = refs[i:i + 3]
+    i += 3
+    if has_dbias:
+        dq_ref, dbias_ref, dq_scr = refs[i:i + 3]
+    else:
+        dq_ref, dq_scr = refs[i:i + 2]
+        dbias_ref = None
+
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -148,35 +268,61 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, :, :]
         v = v_ref[0, :, :]
         do = do_ref[0, :, :].astype(jnp.float32)
-        lse = lse_ref[0, :][:, None]
-        delta = delta_ref[0, :][:, None]
+        lse = lse_ref[0, 0, :][:, None]
+        delta = delta_ref[0, 0, :][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        if has_bias:
+            s = s + bias_ref[0, :, :].astype(jnp.float32)
+        seg_q = seg_q_ref[0, :] if has_seg else None
+        seg_k = seg_k_ref[0, :] if has_seg else None
+        s, valid = _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k)
         p = jnp.exp(s - lse)  # [bq, bk]
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta) * scale
+        ds = p * (dp - delta)  # dbias (pre-scale)
+        if dbias_ref is not None:
+            dbias_ref[0, :, :] = ds.astype(dbias_ref.dtype)
+        ds = ds * scale
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(jnp.logical_not(run))
+        def _skipped():
+            if dbias_ref is not None:
+                dbias_ref[0, :, :] = jnp.zeros(
+                    dbias_ref.shape[1:], dbias_ref.dtype)
 
     @pl.when(ki == pl.num_programs(2) - 1)
     def _finish():
         dq_ref[0, :, :] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    bq, bk):
-    ki = pl.program_id(1)
-    qi = pl.program_id(2)
+def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, groups, has_seg,
+                    has_bias):
+    i = 3
+    bias_ref = seg_q_ref = seg_k_ref = None
+    q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
+    if has_bias:
+        bias_ref = refs[i]
+        i += 1
+    if has_seg:
+        seg_q_ref, seg_k_ref = refs[i], refs[i + 1]
+        i += 2
+    do_ref, lse_ref, delta_ref = refs[i:i + 3]
+    i += 3
+    dk_ref, dv_ref, dk_scr, dv_scr = refs[i:i + 4]
 
-    @pl.when(qi == 0)
+    ki = pl.program_id(1)
+    t = pl.program_id(2)          # t = g * nq + qi
+    qi = t % nq
+
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -191,15 +337,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, :, :]
         v = v_ref[0, :, :]
         do = do_ref[0, :, :].astype(jnp.float32)
-        lse = lse_ref[0, :][:, None]
-        delta = delta_ref[0, :][:, None]
+        lse = lse_ref[0, 0, :][:, None]
+        delta = delta_ref[0, 0, :][:, None]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(q_pos >= k_pos, s, DEFAULT_MASK_VALUE)
+        if has_bias:
+            s = s + bias_ref[0, :, :].astype(jnp.float32)
+        seg_q = seg_q_ref[0, :] if has_seg else None
+        seg_k = seg_k_ref[0, :] if has_seg else None
+        s, valid = _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k)
         p = jnp.exp(s - lse)  # [bq, bk]
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
         dv_scr[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -211,88 +360,223 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(qi == pl.num_programs(2) - 1)
+    @pl.when(t == pl.num_programs(2) - 1)
     def _finish():
         dk_ref[0, :, :] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0, :, :] = dv_scr[:].astype(dv_ref.dtype)
 
 
 @no_x64
-def _bwd(scale, causal, res, do):
-    q, k, v, o, lse = res
+def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
     bh, sq, d = q.shape
-    sk = k.shape[1]
+    bkvh, sk, _ = k.shape
     bq, bk = _block_sizes(sq, sk)
+    h, kvh, bias_b, bias_h, bias_grad = meta
+    groups = h // kvh
+    has_bias, has_seg = bias is not None, seg_q is not None
+    has_dbias = has_bias and bias_grad
+    nq, nk = pl.cdiv(sq, bq), pl.cdiv(sk, bk)
+
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)  # [bh, sq]
-    # (bh, 1, sq) layout for row statistics — see the lse out_spec note in
-    # _fwd
     lse3 = lse.reshape(bh, 1, sq)
     delta3 = delta.reshape(bh, 1, sq)
-    dq = pl.pallas_call(
+
+    # ---- dq (+ dbias) pass: grid (bh, nq, nk) --------------------------
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk)),
+        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk)),
+    ]
+    args = [q, k, v]
+    if has_bias:
+        # dbias needs every (i, j) block written -> no clamping then
+        in_specs.append(pl.BlockSpec(
+            (1, bq, bk),
+            _bias_index(h, bias_b, bias_h, bh, causal, bq, bk,
+                        not has_dbias)))
+        args.append(bias)
+    if has_seg:
+        sq_spec, sk_spec = _seg_specs(h, bq, bk, causal)
+        in_specs += [sq_spec, sk_spec]
+        args += [seg_q, seg_k]
+    in_specs += [
+        pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+    ]
+    args += [do, lse3, delta3]
+
+    out_specs = [pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bh, sq, d), q.dtype)]
+    if has_dbias:
+        out_specs.append(pl.BlockSpec((1, bq, bk),
+                                      lambda b, i, j: (b, i, j)))
+        out_shape.append(jax.ShapeDtypeStruct((bh, sq, sk), jnp.float32))
+
+    res = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk),
-        grid=(bh, pl.cdiv(sq, bq), pl.cdiv(sk, bk)),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((None, 1, bq), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((None, 1, bq), lambda b, i, j: (b, 0, i)),
-        ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                          bq=bq, bk=bk, has_seg=has_seg, has_bias=has_bias,
+                          has_dbias=has_dbias),
+        grid=(bh, nq, nk),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse3, delta3)
+    )(*args)
+    if has_dbias:
+        dq, dbias_full = res
+    else:
+        (dq,) = res if isinstance(res, (tuple, list)) else (res,)
+        dbias_full = None
+
+    # ---- dkv pass: grid (bkvh, nk, groups*nq) --------------------------
+    def q_row(b, j, t):
+        g = t // nq
+        i = t % nq
+        if causal:
+            i = jnp.maximum(i, (j * bk) // bq)
+        return ((b // kvh) * h + (b % kvh) * groups + g, i, 0)
+
+    def stat_row(b, j, t):
+        g = t // nq
+        i = t % nq
+        if causal:
+            i = jnp.maximum(i, (j * bk) // bq)
+        return ((b // kvh) * h + (b % kvh) * groups + g, 0, i)
+
+    def kv_idx(b, j, t):
+        return (b, j, 0)
+
+    in_specs2 = [
+        pl.BlockSpec((1, bq, d), q_row),
+        pl.BlockSpec((1, bk, d), kv_idx),
+        pl.BlockSpec((1, bk, d), kv_idx),
+    ]
+    args2 = [q, k, v]
+    if has_bias:
+        def bias_idx(b, j, t):
+            g = t // nq
+            i = t % nq
+            if causal:
+                i = jnp.maximum(i, (j * bk) // bq)
+            hq = (b % kvh) * groups + g
+            bi = 0 if bias_b == 1 else b // kvh
+            hi = 0 if bias_h == 1 else hq
+            return (bi * bias_h + hi, i, j)
+        in_specs2.append(pl.BlockSpec((1, bq, bk), bias_idx))
+        args2.append(bias)
+    if has_seg:
+        def seg_q_idx(b, j, t):
+            i = t % nq
+            if causal:
+                i = jnp.maximum(i, (j * bk) // bq)
+            return (b // kvh, 0, i)
+
+        def seg_k_idx(b, j, t):
+            return (b // kvh, 0, j)
+        in_specs2 += [pl.BlockSpec((None, 1, bq), seg_q_idx),
+                      pl.BlockSpec((None, 1, bk), seg_k_idx)]
+        args2 += [seg_q, seg_k]
+    in_specs2 += [
+        pl.BlockSpec((1, bq, d), q_row),
+        pl.BlockSpec((1, 1, bq), stat_row),
+        pl.BlockSpec((1, 1, bq), stat_row),
+    ]
+    args2 += [do, lse3, delta3]
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk),
-        grid=(bh, pl.cdiv(sk, bk), pl.cdiv(sq, bq)),
-        in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((None, 1, bq), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((None, 1, bq), lambda b, j, i: (b, 0, i)),
-        ],
+                          bq=bq, bk=bk, nq=nq, groups=groups,
+                          has_seg=has_seg, has_bias=has_bias),
+        grid=(bkvh, nk, groups * nq),
+        in_specs=in_specs2,
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j, t: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((bkvh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bkvh, sk, d), v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse3, delta3)
-    return dq, dk, dv
+    )(*args2)
+    return dq, dk, dv, dbias_full
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_bhsd(q, k, v, scale, causal):
-    o, _ = _fwd(q, k, v, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _flash(q, k, v, bias, seg_q, seg_k, scale, causal, meta):
+    o, _ = _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal):
-    o, lse = _fwd(q, k, v, scale, causal)
-    return o, (q, k, v, o, lse)
+def _flash_fwd_rule(q, k, v, bias, seg_q, seg_k, scale, causal, meta):
+    o, lse = _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta)
+    return o, (q, k, v, bias, seg_q, seg_k, o, lse)
 
 
-_flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
+def _flash_bwd_rule(scale, causal, meta, res, do):
+    q, k, v, bias, seg_q, seg_k, o, lse = res
+    dq, dk, dv, dbias_full = _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse,
+                                       do, scale, causal, meta)
+    dbias = None
+    if dbias_full is not None:
+        dbias = dbias_full
+        bh = q.shape[0]
+        h, kvh, bias_b, bias_h, _ = meta
+        b = bh // h
+        dbias = dbias.reshape(b, h, q.shape[1], k.shape[1])
+        if bias_h == 1:
+            dbias = dbias.sum(axis=1, keepdims=True)
+        if bias_b == 1:
+            dbias = dbias.sum(axis=0, keepdims=True)
+        dbias = dbias.reshape(bias_b * bias_h, q.shape[1], k.shape[1]) \
+            .astype(bias.dtype)
+    return dq, dk, dv, dbias, None, None
 
 
-def flash_attention_pallas(q, k, v, causal=False, scale=None):
-    """Public API: [batch, seq, heads, head_dim] (paddle layout)."""
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_pallas(q, k, v, causal=False, scale=None, bias=None,
+                           segment_ids=None, kv_segment_ids=None,
+                           bias_grad=False):
+    """Public API, paddle layout [batch, seq, heads, head_dim].
+
+    - GQA: ``k``/``v`` may carry fewer heads than ``q`` (h % kvh == 0).
+    - ``bias``: additive logits bias, [b|1, h|1, sq, sk]. Treated as a
+      CONSTANT unless ``bias_grad=True``: the backward for a learned bias
+      materializes a full [b*h, sq, sk] fp32 dbias in HBM, so it is
+      opt-in; with the default, the bias cotangent is symbolically zero.
+    - ``segment_ids`` / ``kv_segment_ids``: [b, sq] / [b, sk] int32;
+      attention is confined to equal ids (packed varlen batches).
+    """
     b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    sk = k.shape[1]
+    assert h % kvh == 0, f"query heads {h} not a multiple of kv heads {kvh}"
     s = scale if scale is not None else 1.0 / (d ** 0.5)
+
     qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
-    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, k.shape[1], d)
-    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, v.shape[1], d)
-    o = _flash_bhsd(qt, kt, vt, s, causal)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * kvh, sk, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * kvh, sk, d)
+
+    bias_arg = None
+    bias_b = bias_h = 1
+    if bias is not None:
+        assert bias.ndim == 4, "bias must be [b|1, h|1, sq, sk]"
+        bias_b, bias_h = bias.shape[0], bias.shape[1]
+        bias_arg = bias.reshape(bias_b * bias_h, sq, sk)
+    seg_q_arg = seg_k_arg = None
+    if segment_ids is not None:
+        seg_q_arg = jnp.asarray(segment_ids, jnp.int32).reshape(b, 1, sq)
+        kv_seg = kv_segment_ids if kv_segment_ids is not None \
+            else segment_ids
+        seg_k_arg = jnp.asarray(kv_seg, jnp.int32).reshape(b, 1, sk)
+
+    meta = (h, kvh, bias_b, bias_h, bool(bias_grad))
+    o = _flash(qt, kt, vt, bias_arg, seg_q_arg, seg_k_arg, s, causal, meta)
     return jnp.swapaxes(o.reshape(b, h, sq, d), 1, 2)
