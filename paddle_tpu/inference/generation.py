@@ -37,6 +37,15 @@ class GenerationConfig:
     greedy: bool = False
 
 
+def _repeat_kv(x, n):
+    """[B, T, KV, hd] -> [B, T, KV*n, hd] (dense-cache GQA expansion)."""
+    if n == 1:
+        return x
+    b, t, kv, hd = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, t, kv, n, hd)) \
+        .reshape(b, t, kv * n, hd)
+
+
 def init_cache(cfg: _llama.LlamaConfig, batch: int, max_len: int,
                dtype=None):
     dtype = dtype or cfg.dtype
@@ -66,8 +75,8 @@ def _cached_layer(lp, x, sin, cos, cfg, kc, vc, pos):
     vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, pos, 0, 0))
 
     rep = H // KV
-    kk = _llama._repeat_kv(kc, rep)    # [B, T, H, hd]
-    vv = _llama._repeat_kv(vc, rep)
+    kk = _repeat_kv(kc, rep)    # [B, T, H, hd]
+    vv = _repeat_kv(vc, rep)
     scale = 1.0 / math.sqrt(hd)
     scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
                         kk.astype(jnp.float32)) * scale
@@ -175,3 +184,138 @@ def generate(params: Dict, input_ids, cfg: _llama.LlamaConfig,
 
     key = jax.random.key(seed)
     return run(params, input_ids, key)
+
+
+# ---------------------------------------------------------------------------
+# Paged-KV serving path
+# ---------------------------------------------------------------------------
+def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
+                       seq_lens):
+    """One decode token per sequence over paged pools.
+
+    tok: [B] int32 current tokens; k_pools/v_pools: [L, N, BS, KV, hd];
+    block_tables: [B, MB]; seq_lens: [B] lengths INCLUDING the current
+    token's position (i.e. the new token is written at seq_lens, and
+    attention runs over seq_lens+1 tokens).
+    Returns (logits [B, V], k_pools, v_pools).
+    """
+    from ..ops import rms_norm as fused_rms_norm, swiglu as fused_swiglu
+    from ..ops.paged_attention import paged_attention_decode, write_to_pool
+
+    H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    B = tok.shape[0]
+    x = jnp.take(params["embed_tokens"], tok, axis=0)  # [B, D]
+    pos_ids = seq_lens[:, None]  # [B, 1] rope position per sequence
+    # one rope table for all layers/steps (XLA hoists it as a constant)
+    sin, cos = build_rope_cache(cfg.max_position_embeddings,
+                                cfg.head_dim, base=cfg.rope_theta)
+
+    def layer(x, xs):
+        lp, kp, vp = xs
+        h = fused_rms_norm(x[:, None], lp["input_norm"].astype(x.dtype),
+                           cfg.rms_norm_eps)[:, 0]
+        q = (h @ lp["q_proj"]).reshape(B, 1, H, hd)
+        k = (h @ lp["k_proj"]).reshape(B, 1, KV, hd)
+        v = (h @ lp["v_proj"]).reshape(B, 1, KV, hd)
+        q = apply_rope(q, sin, cos, position_ids=pos_ids)
+        k = apply_rope(k, sin, cos, position_ids=pos_ids)
+        kp, vp = write_to_pool(kp, vp, block_tables, seq_lens,
+                               k[:, 0].astype(kp.dtype),
+                               v[:, 0].astype(vp.dtype))
+        attn = paged_attention_decode(q[:, 0], kp, vp, block_tables,
+                                      seq_lens + 1)
+        x = x + attn.reshape(B, H * hd).astype(x.dtype) @ lp["o_proj"]
+        h = fused_rms_norm(x[:, None], lp["post_norm"].astype(x.dtype),
+                           cfg.rms_norm_eps)[:, 0]
+        ff = fused_swiglu(h @ lp["gate_proj"], h @ lp["up_proj"])
+        x = x + ff @ lp["down_proj"]
+        return x, (kp, vp)
+
+    x, (k_pools, v_pools) = jax.lax.scan(
+        layer, x, (params["layers"], k_pools, v_pools))
+    x = fused_rms_norm(x[:, None], params["final_norm"].astype(x.dtype),
+                       cfg.rms_norm_eps)[:, 0]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed_tokens"].T
+    return x @ head, k_pools, v_pools
+
+
+def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
+                   gen: Optional[GenerationConfig] = None,
+                   block_size: int = 16, seed: int = 0):
+    """vLLM-style serving loop over a paged KV cache.
+
+    Prefill runs through the dense-cache path, the dense cache is repacked
+    into block pools, then each decode step is one jitted program using
+    the Pallas paged-attention kernel (block-table-driven page streaming).
+    The host owns page allocation (BlockManager) between steps — the
+    reference's AnalysisPredictor does the same bookkeeping around
+    block_multihead_attention.
+    """
+    import numpy as np
+    from ..ops.paged_attention import BlockManager
+
+    gen = gen or GenerationConfig()
+    B, S = input_ids.shape
+    T = S + gen.max_new_tokens
+    if T > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt+max_new_tokens = {T} exceeds max_position_embeddings "
+            f"= {cfg.max_position_embeddings} (rope table bound)")
+    L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    BS = block_size
+    MB = -(-T // BS)
+    num_blocks = B * MB + 1
+
+    # prefill with the dense cache, then repack into pools
+    k_cache, v_cache = init_cache(cfg, B, T)
+    logits, k_cache, v_cache = cached_forward(
+        params, input_ids, cfg, k_cache, v_cache, 0)
+
+    mgr = BlockManager(num_blocks, BS, MB)
+    for sid in range(B):
+        # allocate the whole generation upfront: the jitted step uses a
+        # static table, and unallocated slots would default to page 0 and
+        # collide across sequences
+        mgr.allocate(sid, T)
+    tables = mgr.table_array(range(B))
+
+    pool_shape = (L, num_blocks, BS, KV, hd)
+    k_pools = jnp.zeros(pool_shape, k_cache.dtype)
+    v_pools = jnp.zeros(pool_shape, v_cache.dtype)
+    # dense [L, B, T, KV, hd] -> pages
+    pad = MB * BS - T
+    kc = jnp.pad(k_cache, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+    vc = jnp.pad(v_cache, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+    kc = kc.reshape(L, B, MB, BS, KV, hd)
+    vc = vc.reshape(L, B, MB, BS, KV, hd)
+    flat_tables = jnp.asarray(tables.reshape(-1), jnp.int32)
+    k_pools = k_pools.at[:, flat_tables].set(
+        kc.reshape(L, B * MB, BS, KV, hd))
+    v_pools = v_pools.at[:, flat_tables].set(
+        vc.reshape(L, B * MB, BS, KV, hd))
+
+    step_fn = jax.jit(partial(_paged_decode_step, cfg=cfg))
+
+    key = jax.random.key(seed)
+    tok = sample_token(logits[:, -1], key, gen)
+    done = tok == gen.eos_token_id
+    out = [np.asarray(tok)]
+    seq_lens = jnp.full((B,), S, jnp.int32)
+    bt = jnp.asarray(tables, jnp.int32)
+    for i in range(gen.max_new_tokens - 1):
+        key, sub = jax.random.split(key)
+        logits, k_pools, v_pools = step_fn(
+            params, tok, k_pools=k_pools, v_pools=v_pools,
+            block_tables=bt, seq_lens=seq_lens)
+        nxt = sample_token(logits, sub, gen)
+        nxt = jnp.where(done, gen.eos_token_id, nxt)
+        done = done | (nxt == gen.eos_token_id)
+        tok = nxt
+        seq_lens = seq_lens + 1
+        out.append(np.asarray(tok))
+    toks = jnp.asarray(np.stack(out, axis=1))
+    return jnp.concatenate([input_ids, toks], axis=1)

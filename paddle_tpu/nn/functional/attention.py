@@ -100,6 +100,10 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     """
     from ...ops.flash_attention import (flash_attention as _fa,
                                         segment_ids_from_cu_seqlens)
+    if dropout and dropout > 0.0 and training:
+        raise NotImplementedError(
+            "flash_attn_unpadded: attention dropout is not supported by "
+            "the fused TPU kernel")
     if causal:
         import numpy as _np
         cq_v, ck_v = cu_seqlens_q, cu_seqlens_k

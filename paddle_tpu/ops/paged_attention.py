@@ -34,7 +34,25 @@ def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
     block_tables: [B, MB] int32  physical block id per logical block
     seq_lens:     [B]    int32   valid tokens per sequence (incl. current)
     returns       [B, H, hd]
+
+    On TPU this routes to the Pallas kernel (ops/pallas/paged_attention.py)
+    that streams pages through VMEM via scalar-prefetched block tables; the
+    gather+einsum below is the reference-numerics fallback.
     """
+    if jax.default_backend() in ("tpu", "axon"):
+        try:
+            from .pallas.paged_attention import paged_attention_decode_pallas
+            return paged_attention_decode_pallas(
+                q, k_pool, v_pool, block_tables, seq_lens, scale=scale)
+        except Exception:
+            pass
+    return paged_attention_decode_xla(q, k_pool, v_pool, block_tables,
+                                      seq_lens, scale=scale)
+
+
+def paged_attention_decode_xla(q, k_pool, v_pool, block_tables, seq_lens,
+                               scale: Optional[float] = None):
+    """Gather+einsum reference path (always XLA, any backend)."""
     B, H, hd = q.shape
     N, BS, KV, _ = k_pool.shape
     MB = block_tables.shape[1]

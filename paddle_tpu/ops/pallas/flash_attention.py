@@ -54,7 +54,7 @@ def _block_sizes(sq, sk):
     return bq, bk
 
 
-def _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k):
+def _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k, off=0):
     """Apply causal/segment masks to a [bq, bk] score block. Returns
     (masked scores, valid bool mask or None). The valid mask must also
     zero the probabilities (p = exp(s - m)): with every score at
@@ -63,9 +63,11 @@ def _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k):
     (and leak garbage into dk/dv in backward)."""
     m = None
     if causal:
+        # bottom-right aligned (FlashAttention-2 convention, matches the
+        # _ref_attention fallback): query row r attends keys <= r + sk - sq
         q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         k_pos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        m = q_pos >= k_pos
+        m = (q_pos + off) >= k_pos
     if seg_q is not None:
         same = seg_q[:, None] == seg_k[None, :]
         m = same if m is None else (m & same)
@@ -77,7 +79,8 @@ def _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k):
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
-def _fwd_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias):
+def _fwd_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
+                off):
     i = 3
     bias_ref = seg_q_ref = seg_k_ref = None
     q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
@@ -100,7 +103,7 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias):
 
     run = True
     if causal:
-        run = (ki * bk) <= (qi * bq + bq - 1)
+        run = (ki * bk) <= (qi * bq + bq - 1 + off)
 
     @pl.when(run)
     def _body():
@@ -114,7 +117,7 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias):
             s = s + bias_ref[0, :, :].astype(jnp.float32)
         seg_q = seg_q_ref[0, :] if has_seg else None
         seg_k = seg_k_ref[0, :] if has_seg else None
-        s, valid = _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k)
+        s, valid = _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k, off)
         m_prev = m_scr[:]
         m_cur = jnp.max(s, axis=1, keepdims=True)  # [bq, 1]
         m_new = jnp.maximum(m_prev, m_cur)
@@ -137,7 +140,7 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias):
         lse_ref[0, :] = (m_scr[:] + jnp.log(l_safe))[:, 0]
 
 
-def _kv_index(h, kvh, causal, bq, bk):
+def _kv_index(h, kvh, causal, bq, bk, off=0):
     """K/V BlockSpec index map: GQA head folding + causal diagonal clamp
     (clamped repeats elide the HBM copy — Mosaic only issues a copy when
     the block index changes)."""
@@ -146,30 +149,30 @@ def _kv_index(h, kvh, causal, bq, bk):
     def idx(b, i, j):
         kb = (b // h) * kvh + (b % h) // groups
         if causal:
-            j = jnp.minimum(j, (i * bq + bq - 1) // bk)
+            j = jnp.minimum(j, (i * bq + bq - 1 + off) // bk)
         return (kb, j, 0)
 
     return idx
 
 
-def _bias_index(h, bias_b, bias_h, b_total, causal, bq, bk, clamp):
+def _bias_index(h, bias_b, bias_h, b_total, causal, bq, bk, clamp, off=0):
     def idx(b, i, j):
         bi = 0 if bias_b == 1 else b // h
         hi = 0 if bias_h == 1 else b % h
         if causal and clamp:
-            j = jnp.minimum(j, (i * bq + bq - 1) // bk)
+            j = jnp.minimum(j, (i * bq + bq - 1 + off) // bk)
         return (bi * bias_h + hi, i, j)
 
     return idx
 
 
-def _seg_specs(h, bq, bk, causal, clamp_k=True):
+def _seg_specs(h, bq, bk, causal, clamp_k=True, off=0):
     def q_idx(b, i, j):
         return (b // h, 0, i)
 
     def k_idx(b, i, j):
         if causal and clamp_k:
-            j = jnp.minimum(j, (i * bq + bq - 1) // bk)
+            j = jnp.minimum(j, (i * bq + bq - 1 + off) // bk)
         return (b // h, 0, j)
 
     return (pl.BlockSpec((None, 1, bq), q_idx),
@@ -185,28 +188,29 @@ def _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta):
     sk = k.shape[1]
     bq, bk = _block_sizes(sq, sk)
     h, kvh, bias_b, bias_h, _ = meta
+    off = sk - sq
     grid = (bh, pl.cdiv(sq, bq), pl.cdiv(sk, bk))
     has_bias, has_seg = bias is not None, seg_q is not None
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk)),
-        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk)),
+        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk, off)),
+        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk, off)),
     ]
     args = [q, k, v]
     if has_bias:
         in_specs.append(pl.BlockSpec(
             (1, bq, bk),
-            _bias_index(h, bias_b, bias_h, bh, causal, bq, bk, True)))
+            _bias_index(h, bias_b, bias_h, bh, causal, bq, bk, True, off)))
         args.append(bias)
     if has_seg:
-        sq_spec, sk_spec = _seg_specs(h, bq, bk, causal)
+        sq_spec, sk_spec = _seg_specs(h, bq, bk, causal, off=off)
         in_specs += [sq_spec, sk_spec]
         args += [seg_q, seg_k]
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, has_seg=has_seg,
-                               has_bias=has_bias)
+                               has_bias=has_bias, off=off)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -233,7 +237,7 @@ def _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta):
 # backward
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
-                   has_dbias):
+                   has_dbias, off):
     i = 3
     bias_ref = seg_q_ref = seg_k_ref = None
     q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
@@ -260,7 +264,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
 
     run = True
     if causal:
-        run = (ki * bk) <= (qi * bq + bq - 1)
+        run = (ki * bk) <= (qi * bq + bq - 1 + off)
 
     @pl.when(run)
     def _body():
@@ -276,7 +280,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
             s = s + bias_ref[0, :, :].astype(jnp.float32)
         seg_q = seg_q_ref[0, :] if has_seg else None
         seg_k = seg_k_ref[0, :] if has_seg else None
-        s, valid = _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k)
+        s, valid = _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k, off)
         p = jnp.exp(s - lse)  # [bq, bk]
         if valid is not None:
             p = jnp.where(valid, p, 0.0)
@@ -304,7 +308,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, groups, has_seg,
-                    has_bias):
+                    has_bias, off):
     i = 3
     bias_ref = seg_q_ref = seg_k_ref = None
     q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
@@ -329,7 +333,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, groups, has_seg,
 
     run = True
     if causal:
-        run = (qi * bq + bq - 1) >= (ki * bk)
+        run = (qi * bq + bq - 1 + off) >= (ki * bk)
 
     @pl.when(run)
     def _body():
@@ -345,7 +349,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, groups, has_seg,
             s = s + bias_ref[0, :, :].astype(jnp.float32)
         seg_q = seg_q_ref[0, :] if has_seg else None
         seg_k = seg_k_ref[0, :] if has_seg else None
-        s, valid = _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k)
+        s, valid = _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k, off)
         p = jnp.exp(s - lse)  # [bq, bk]
         if valid is not None:
             p = jnp.where(valid, p, 0.0)
@@ -372,6 +376,7 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
     bkvh, sk, _ = k.shape
     bq, bk = _block_sizes(sq, sk)
     h, kvh, bias_b, bias_h, bias_grad = meta
+    off = sk - sq
     groups = h // kvh
     has_bias, has_seg = bias is not None, seg_q is not None
     has_dbias = has_bias and bias_grad
@@ -385,8 +390,8 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
     # ---- dq (+ dbias) pass: grid (bh, nq, nk) --------------------------
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk)),
-        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk)),
+        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk, off)),
+        pl.BlockSpec((1, bk, d), _kv_index(h, kvh, causal, bq, bk, off)),
     ]
     args = [q, k, v]
     if has_bias:
@@ -394,10 +399,10 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
         in_specs.append(pl.BlockSpec(
             (1, bq, bk),
             _bias_index(h, bias_b, bias_h, bh, causal, bq, bk,
-                        not has_dbias)))
+                        not has_dbias, off)))
         args.append(bias)
     if has_seg:
-        sq_spec, sk_spec = _seg_specs(h, bq, bk, causal)
+        sq_spec, sk_spec = _seg_specs(h, bq, bk, causal, off=off)
         in_specs += [sq_spec, sk_spec]
         args += [seg_q, seg_k]
     in_specs += [
@@ -417,7 +422,7 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
     res = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, has_seg=has_seg, has_bias=has_bias,
-                          has_dbias=has_dbias),
+                          has_dbias=has_dbias, off=off),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -436,14 +441,14 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
         g = t // nq
         i = t % nq
         if causal:
-            i = jnp.maximum(i, (j * bk) // bq)
+            i = jnp.maximum(i, (j * bk - off) // bq)
         return ((b // kvh) * h + (b % kvh) * groups + g, i, 0)
 
     def stat_row(b, j, t):
         g = t // nq
         i = t % nq
         if causal:
-            i = jnp.maximum(i, (j * bk) // bq)
+            i = jnp.maximum(i, (j * bk - off) // bq)
         return ((b // kvh) * h + (b % kvh) * groups + g, 0, i)
 
     def kv_idx(b, j, t):
@@ -460,7 +465,7 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
             g = t // nq
             i = t % nq
             if causal:
-                i = jnp.maximum(i, (j * bk) // bq)
+                i = jnp.maximum(i, (j * bk - off) // bq)
             hq = (b % kvh) * groups + g
             bi = 0 if bias_b == 1 else b // kvh
             hi = 0 if bias_h == 1 else hq
@@ -471,7 +476,7 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
         def seg_q_idx(b, j, t):
             i = t % nq
             if causal:
-                i = jnp.maximum(i, (j * bk) // bq)
+                i = jnp.maximum(i, (j * bk - off) // bq)
             return (b // kvh, 0, i)
 
         def seg_k_idx(b, j, t):
@@ -489,7 +494,7 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, groups=groups,
-                          has_seg=has_seg, has_bias=has_bias),
+                          has_seg=has_seg, has_bias=has_bias, off=off),
         grid=(bkvh, nk, groups * nq),
         in_specs=in_specs2,
         out_specs=[

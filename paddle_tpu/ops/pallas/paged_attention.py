@@ -1,0 +1,135 @@
+"""Pallas paged-attention decode kernel.
+
+TPU-native replacement for the reference's fused paged KV-cache decode
+kernel (paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
+/ block_attn.h). The XLA composition in ops/paged_attention.py gathers
+``[B, MB*BS, KV, hd]`` K/V into HBM every step; this kernel instead streams
+each sequence's pages through VMEM directly from the pool:
+
+- ``block_tables`` and ``seq_lens`` ride as SCALAR PREFETCH operands
+  (PrefetchScalarGridSpec), so the K/V BlockSpec index maps dereference
+  the page table on the fly — the pool is the kernel input, no gather.
+- grid = (B, MB): pages of one sequence stream sequentially with the
+  usual double-buffered pipeline; online softmax (m/l/acc scratch) makes
+  the reduction exact across pages.
+- pages at/after a sequence's length are skipped (pl.when) AND their
+  fetch is clamped to the sequence's last valid page, so Mosaic's
+  revisit-elision skips the HBM copy.
+- GQA-aware: per KV head, the ``group`` query heads attend the same page
+  (one [g, BS] matmul per KV head per page).
+
+The per-sequence work is proportional to its real length in pages, not
+MB, and the only HBM traffic is one read of the live pages.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._util import interpret_mode as _interpret, no_x64
+
+
+def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, bs, kv, groups):
+    b = pl.program_id(0)
+    mi = pl.program_id(1)
+    seq_len = len_ref[b]
+
+    @pl.when(mi == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    @pl.when(mi * bs < seq_len)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)          # [H, hd]
+        k = k_ref[0].astype(jnp.float32)          # [BS, KV, hd]
+        v = v_ref[0].astype(jnp.float32)
+        # token validity within this page
+        tok = mi * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)[0]
+        valid = tok < seq_len                     # [BS]
+        h = q.shape[0]
+        s_rows = []
+        for kvh in range(kv):
+            qg = q[kvh * groups:(kvh + 1) * groups, :]     # [g, hd]
+            kk = k[:, kvh, :]                              # [BS, hd]
+            s_rows.append(jax.lax.dot_general(
+                qg, kk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))       # [g, BS]
+        s = jnp.concatenate(s_rows, axis=0) * scale        # [H, BS]
+        s = jnp.where(valid[None, :], s, -jnp.inf)
+        m_prev = m_scr[:]                                  # [H, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # fully-invalid page cannot happen (guarded by pl.when), but a
+        # page can still be all -inf only if seq_len <= mi*bs — excluded
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid[None, :], p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        pv_rows = []
+        for kvh in range(kv):
+            pg = p[kvh * groups:(kvh + 1) * groups, :]     # [g, BS]
+            vv = v[:, kvh, :]                              # [BS, hd]
+            pv_rows.append(jax.lax.dot_general(
+                pg, vv, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))       # [g, hd]
+        pv = jnp.concatenate(pv_rows, axis=0)              # [H, hd]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+
+    @pl.when(mi == pl.num_programs(1) - 1)
+    def _finish():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+@no_x64
+def paged_attention_decode_pallas(q, k_pool, v_pool, block_tables,
+                                  seq_lens, scale=None):
+    """q: [B, H, hd]; pools: [N, BS, KV, hd]; block_tables: [B, MB] int32;
+    seq_lens: [B] int32 → [B, H, hd]. seq_len 0 slots return 0."""
+    B, H, hd = q.shape
+    N, BS, KV, _ = k_pool.shape
+    MB = block_tables.shape[1]
+    groups = H // KV
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+
+    def kv_index(b, mi, bt_ref, len_ref):
+        # clamp dead pages to the sequence's last live page so the copy
+        # is elided; also keeps garbage table entries out of the fetch
+        last = jnp.maximum(len_ref[b] - 1, 0) // BS
+        page = bt_ref[b, jnp.minimum(mi, last)]
+        return (page, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, MB),
+        in_specs=[
+            pl.BlockSpec((1, H, hd), lambda b, mi, bt, ln: (b, 0, 0)),
+            pl.BlockSpec((1, BS, KV, hd), kv_index),
+            pl.BlockSpec((1, BS, KV, hd), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, H, hd), lambda b, mi, bt, ln: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, bs=BS, kv=KV,
+                          groups=groups),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        interpret=_interpret(),
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(seq_lens, jnp.int32), q, k_pool, v_pool)
+    return out
