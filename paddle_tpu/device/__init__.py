@@ -22,6 +22,8 @@ __all__ = [
     "set_device", "get_device", "get_all_devices", "device_count",
     "is_compiled_with_cuda", "is_compiled_with_xpu", "is_compiled_with_rocm",
     "is_compiled_with_tpu", "synchronize", "get_default_backend",
+    "memory_stats", "memory_allocated", "max_memory_allocated",
+    "max_memory_reserved", "memory_reserved",
 ]
 
 
@@ -226,3 +228,61 @@ def _str_to_place(device: str) -> Place:
         kind, idx = device.split(":")
         return Place(kind, int(idx))
     return Place(device, 0)
+
+
+# ---------------------------------------------------------------------------
+# Memory stats (reference: paddle/phi/core/memory/stats.h +
+# paddle.device.cuda.max_memory_allocated — here backed by PjRt's
+# per-device memory_stats())
+# ---------------------------------------------------------------------------
+def memory_stats(device=None) -> dict:
+    """Raw PjRt allocator statistics for one device (bytes). Keys follow
+    PjRt ("bytes_in_use", "peak_bytes_in_use", "largest_alloc_size",
+    "bytes_limit", ...); returns {} when the backend exposes none."""
+    d = _resolve(device)
+    try:
+        return dict(d.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (reference:
+    paddle.device.cuda.memory_allocated)."""
+    return int(memory_stats(device).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    """Peak bytes allocated on the device (reference:
+    paddle.device.cuda.max_memory_allocated)."""
+    return int(memory_stats(device).get("peak_bytes_in_use", 0))
+
+
+def max_memory_reserved(device=None) -> int:
+    """Peak bytes reserved by the allocator pool; PjRt reports the
+    reservation limit under bytes_limit/bytes_reserved."""
+    s = memory_stats(device)
+    return int(s.get("peak_bytes_reserved", s.get("bytes_reserved", 0)))
+
+
+def memory_reserved(device=None) -> int:
+    s = memory_stats(device)
+    return int(s.get("bytes_reserved", s.get("bytes_limit", 0)))
+
+
+def _resolve(device):
+    if device is None:
+        return jax.local_devices()[0]
+    if isinstance(device, Place):
+        plat = {"gpu": "cuda"}.get(device.device_type, device.device_type)
+        devs = [d for d in jax.local_devices() if d.platform == plat]
+        return devs[device.device_id] if devs else jax.local_devices()[0]
+    if isinstance(device, int):
+        return jax.local_devices()[device]
+    if isinstance(device, str):
+        name, _, idx = device.partition(":")
+        plat = {"gpu": "cuda"}.get(name, name)
+        devs = [d for d in jax.local_devices() if d.platform == plat] \
+            or jax.local_devices()
+        return devs[int(idx) if idx else 0]
+    return device

@@ -1,2 +1,2 @@
 from .save_load import (save_state_dict, load_state_dict,  # noqa
-                        LocalTensorMetadata, Metadata)
+                        wait_async_save, LocalTensorMetadata, Metadata)

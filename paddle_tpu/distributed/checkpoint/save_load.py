@@ -27,8 +27,8 @@ import jax
 
 from ...core.tensor import Tensor, to_value
 
-__all__ = ["save_state_dict", "load_state_dict", "LocalTensorMetadata",
-           "Metadata"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
+           "LocalTensorMetadata", "Metadata"]
 
 
 @dataclass
@@ -58,9 +58,34 @@ def _flatten_state(state_dict, prefix=""):
     return flat
 
 
+_async_state = {"thread": None, "error": None}
+
+
+def wait_async_save():
+    """Block until a pending async checkpoint write completes; re-raises
+    any exception the writer thread hit (a failed async save must not be
+    indistinguishable from success)."""
+    t = _async_state["thread"]
+    if t is not None:
+        t.join()
+        _async_state["thread"] = None
+    err = _async_state["error"]
+    if err is not None:
+        _async_state["error"] = None
+        raise RuntimeError("async checkpoint save failed") from err
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
-    """reference: checkpoint/save_state_dict.py:135."""
+    """reference: checkpoint/save_state_dict.py:135.
+
+    ``async_save=True``: device arrays are snapshotted to host immediately
+    (the copy-on-write point — training may overwrite the device buffers
+    right after this returns) and the disk write happens on a background
+    thread; a second save (or ``wait_async_save()``) joins the previous
+    write first. Reference capability: flex_checkpoint "flash device
+    save" (SURVEY.md §5 checkpoint tier 3).
+    """
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     flat = _flatten_state(state_dict)
@@ -95,20 +120,39 @@ def save_state_dict(state_dict, path, process_group=None,
                 tuple(arrays[arr_key].shape), str(arrays[arr_key].dtype),
                 f"{rank}_0.distcp.npz", arr_key))
         meta.shards[key] = shard_list
-    np.savez(os.path.join(path, f"{rank}_0.distcp.npz"), **arrays)
-    if rank == coordinator_rank:
-        meta_json = {
-            "global_shapes": {k: list(v)
-                              for k, v in meta.global_shapes.items()},
-            "shards": {k: [{"global_offset": list(s.global_offset),
-                            "local_shape": list(s.local_shape),
-                            "dtype": s.dtype, "file": s.file,
-                            "key_in_file": s.key_in_file}
-                           for s in v]
-                       for k, v in meta.shards.items()},
-        }
-        with open(os.path.join(path, "metadata.json"), "w") as f:
-            json.dump(meta_json, f)
+
+    def _write():
+        np.savez(os.path.join(path, f"{rank}_0.distcp.npz"), **arrays)
+        if rank == coordinator_rank:
+            meta_json = {
+                "global_shapes": {k: list(v)
+                                  for k, v in meta.global_shapes.items()},
+                "shards": {k: [{"global_offset": list(s.global_offset),
+                                "local_shape": list(s.local_shape),
+                                "dtype": s.dtype, "file": s.file,
+                                "key_in_file": s.key_in_file}
+                               for s in v]
+                           for k, v in meta.shards.items()},
+            }
+            with open(os.path.join(path, "metadata.json"), "w") as f:
+                json.dump(meta_json, f)
+
+    if async_save:
+        import threading
+        wait_async_save()  # one in-flight write at a time (raises on error)
+
+        def _guarded():
+            try:
+                _write()
+            except BaseException as e:  # noqa: BLE001 — surfaced on join
+                _async_state["error"] = e
+
+        # non-daemon: interpreter exit must not truncate the write
+        th = threading.Thread(target=_guarded, name="distcp-async-save")
+        th.start()
+        _async_state["thread"] = th
+    else:
+        _write()
 
 
 def _read_metadata(path) -> Metadata:
@@ -148,27 +192,69 @@ def _load_file(path, fname, cache):
     return cache[fname]
 
 
+def _assemble_slice(path, meta: Metadata, key: str, index, files_cache
+                    ) -> np.ndarray:
+    """Assemble ONLY the target slice ``index`` (tuple of slices into the
+    global shape) from the saved shards overlapping it — the reference's
+    ReadItem plan (load_state_dict.py:43): peak host memory is one target
+    shard plus one saved shard, never the full global array."""
+    gshape = meta.global_shapes[key]
+    tgt = [(sl.start or 0,
+            sl.stop if sl.stop is not None else gshape[d])
+           for d, sl in enumerate(index)]
+    tgt_shape = tuple(hi - lo for lo, hi in tgt)
+    shards = meta.shards[key]
+    out = np.zeros(tgt_shape, dtype=np.dtype(shards[0].dtype))
+    for s in shards:
+        src, dst = [], []
+        empty = False
+        for d, (t_lo, t_hi) in enumerate(tgt):
+            s_lo = s.global_offset[d]
+            s_hi = s_lo + s.local_shape[d]
+            lo, hi = max(t_lo, s_lo), min(t_hi, s_hi)
+            if lo >= hi:
+                empty = True
+                break
+            src.append(slice(lo - s_lo, hi - s_lo))
+            dst.append(slice(lo - t_lo, hi - t_lo))
+        if empty:
+            continue
+        data = _load_file(path, s.file, files_cache)[s.key_in_file]
+        out[tuple(dst)] = data[tuple(src)]
+    return out
+
+
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None,
                     offload=False) -> None:
     """reference: checkpoint/load_state_dict.py:526 — in-place load into
     ``state_dict`` tensors, resharding saved shards onto each target
-    tensor's current sharding."""
+    tensor's current sharding. Sharded targets stream per-shard slices
+    (``jax.make_array_from_callback``) instead of assembling the full
+    global array on host."""
+    wait_async_save()  # a pending async write must land first
     meta = _read_metadata(path)
     flat = _flatten_state(state_dict)
     files_cache: Dict[str, object] = {}
     for key, target in flat.items():
         if key not in meta.shards:
             continue
-        full = _assemble(path, meta, key, files_cache)
         if isinstance(target, Tensor):
             v = to_value(target)
-            arr = full.astype(np.dtype(v.dtype)) if hasattr(v, "dtype") \
-                else full
+            gshape = meta.global_shapes[key]
             if hasattr(v, "sharding") and isinstance(
-                    v.sharding, jax.sharding.NamedSharding):
-                target._replace_value(jax.device_put(arr, v.sharding))
+                    v.sharding, jax.sharding.NamedSharding) and \
+                    tuple(v.shape) == tuple(gshape):
+                dt = np.dtype(v.dtype)
+                arr = jax.make_array_from_callback(
+                    tuple(gshape), v.sharding,
+                    lambda idx, _k=key: _assemble_slice(
+                        path, meta, _k, idx, files_cache).astype(dt))
+                target._replace_value(arr)
             else:
+                full = _assemble(path, meta, key, files_cache)
+                arr = full.astype(np.dtype(v.dtype)) \
+                    if hasattr(v, "dtype") else full
                 target._replace_value(jax.numpy.asarray(arr))
         else:
-            state_dict[key] = full
+            state_dict[key] = _assemble(path, meta, key, files_cache)

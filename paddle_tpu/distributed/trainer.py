@@ -172,13 +172,24 @@ class Trainer:
             new_state, gnorm = _adamw_update(
                 grads, state_tree, lr, b1=hp["b1"], b2=hp["b2"],
                 eps=1e-8, wd=hp["wd"], grad_clip=hp["grad_clip"])
-            return new_state, {"loss": loss, "grad_norm": gnorm}
+            metrics = {"loss": loss, "grad_norm": gnorm}
+            if nan_check:
+                # FLAGS_check_nan_inf inside the compiled hybrid-parallel
+                # step (loss + grad-norm covers every grad contribution)
+                metrics["finite"] = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+            return new_state, metrics
 
-        donate = (0,) if self._donate else ()
+        from ..core.flags import GLOBAL_FLAGS
+        nan_check = bool(GLOBAL_FLAGS.get("check_nan_inf"))
+        # no donation in nan-check mode: on failure the caller's pre-step
+        # state must survive the raise (donated inputs are invalidated)
+        donate = (0,) if self._donate and not nan_check else ()
+        self._step_nan = nan_check
         self._step_fn = jax.jit(step_fn, donate_argnums=donate)
 
     def step(self, state: TrainState, *batch) -> Tuple[TrainState, Dict]:
-        if self._step_fn is None:
+        from ..core.flags import GLOBAL_FLAGS
+        if self._step_fn is None or                 self._step_nan != bool(GLOBAL_FLAGS.get("check_nan_inf")):
             self._build()
         batch = tuple(
             jax.device_put(b, NamedSharding(self.mesh, self.data_spec))
@@ -186,4 +197,8 @@ class Trainer:
         with self.mesh:
             new_tree, metrics = self._step_fn(state.tree(),
                                               jnp.float32(self.lr), *batch)
+        if "finite" in metrics and not bool(metrics.pop("finite")):
+            raise FloatingPointError(
+                "check_nan_inf: non-finite loss/grad_norm in compiled "
+                f"train step (loss={float(metrics['loss'])})")
         return TrainState.from_tree(new_tree), metrics

@@ -298,7 +298,13 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     v_pools = v_pools.at[:, flat_tables].set(
         vc.reshape(L, B * MB, BS, KV, hd))
 
-    step_fn = jax.jit(partial(_paged_decode_step, cfg=cfg))
+    # donate the pools: the .at[].set page writes alias in place instead
+    # of copying the whole [L, N, BS, KV, hd] pool every token
+    def _step(params, tok, k_pools, v_pools, block_tables, seq_lens):
+        return _paged_decode_step(params, tok, cfg, k_pools, v_pools,
+                                  block_tables, seq_lens)
+
+    step_fn = jax.jit(_step, donate_argnums=(2, 3))
 
     key = jax.random.key(seed)
     tok = sample_token(logits[:, -1], key, gen)
@@ -309,8 +315,7 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     for i in range(gen.max_new_tokens - 1):
         key, sub = jax.random.split(key)
         logits, k_pools, v_pools = step_fn(
-            params, tok, k_pools=k_pools, v_pools=v_pools,
-            block_tables=bt, seq_lens=seq_lens)
+            params, tok, k_pools, v_pools, bt, seq_lens)
         nxt = sample_token(logits, sub, gen)
         nxt = jnp.where(done, gen.eos_token_id, nxt)
         done = done | (nxt == gen.eos_token_id)

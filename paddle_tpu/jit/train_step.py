@@ -92,7 +92,7 @@ class TrainStep:
         return auto_cast(enable=True, level=self._amp_level,
                          dtype=self._amp_dtype)
 
-    def _build(self, n_inputs, n_labels):
+    def _build(self, n_inputs, n_labels, nan_check=False):
         pure_fn, loss_fn = self._pure_fn, self._loss_fn
         metas, acc_names = self._metas, self._acc_names
         has_master, clip = self._has_master, self._clip
@@ -129,9 +129,24 @@ class TrainStep:
                 p_vals, g_vals, acc_vals, slots["masters"], lr, step)
             new_trainable = dict(zip(names, new_ps))
             new_slots = {"accs": new_accs, "masters": new_masters}
+            if nan_check:
+                # FLAGS_check_nan_inf inside the compiled program: finite
+                # flags for loss, every gradient and every updated param
+                # (reference checks post-kernel in the interpreter too,
+                # framework/new_executor/nan_inf_utils.cc)
+                watched = {"loss": loss}
+                watched.update({f"grad:{k}": g
+                                for k, g in zip(names, g_vals)})
+                watched.update({f"param:{k}": p
+                                for k, p in new_trainable.items()})
+                finite = jnp.stack([jnp.isfinite(v).all()
+                                    for v in watched.values()])
+                return loss, new_trainable, new_slots, new_buf, finite
             return loss, new_trainable, new_slots, new_buf
 
-        donate = (0, 1, 2) if self._donate else ()
+        # no donation in nan-check mode: on failure the pre-step state must
+        # survive (donated inputs would be invalidated)
+        donate = (0, 1, 2) if self._donate and not nan_check else ()
         return jax.jit(step_fn, donate_argnums=donate)
 
     # -- state gather (fresh every call: reference reads, no device work) ----
@@ -158,19 +173,40 @@ class TrainStep:
                        for x in _as_tuple(inputs))
         labels = tuple(to_value(x) if isinstance(x, Tensor) else jnp.asarray(x)
                        for x in _as_tuple(labels))
-        key = (len(inputs), len(labels),
+        from ..core.flags import GLOBAL_FLAGS
+        nan_check = bool(GLOBAL_FLAGS.get("check_nan_inf"))
+        key = (len(inputs), len(labels), nan_check,
                tuple((x.shape, str(x.dtype)) for x in inputs + labels))
         fn = self._compiled.get(key)
         if fn is None:
-            fn = self._build(len(inputs), len(labels))
+            fn = self._build(len(inputs), len(labels), nan_check=nan_check)
             self._compiled[key] = fn
         trainable, slots, buffers, frozen = self._gather_state()
         lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
         step = jnp.asarray(self._opt._global_step + 1, jnp.float32)
         rng = next_key()
-        loss, self._trainable, self._slots, self._buffers = fn(
-            trainable, slots, buffers, frozen, lr, step, rng,
-            *inputs, *labels)
+        out = fn(trainable, slots, buffers, frozen, lr, step, rng,
+                 *inputs, *labels)
+        if nan_check:
+            loss, self._trainable, self._slots, self._buffers, finite = out
+            import numpy as np
+            ok = np.asarray(finite)
+            if not ok.all():
+                watched = (["loss"] +
+                           [f"grad:{k}" for k in self._train_names] +
+                           [f"param:{k}" for k in self._train_names])
+                bad = [n for n, o in zip(watched, ok) if not o]
+                msg = (f"check_nan_inf: non-finite values in compiled train "
+                       f"step: {bad[:8]}{'...' if len(bad) > 8 else ''}")
+                if GLOBAL_FLAGS.get("check_nan_inf_level") >= 1:
+                    import warnings
+                    warnings.warn(msg, stacklevel=2)
+                else:
+                    # pre-step state is intact (no donation in this mode):
+                    # drop the poisoned update and fail loudly
+                    raise FloatingPointError(msg)
+        else:
+            loss, self._trainable, self._slots, self._buffers = out
         self._opt._global_step += 1
         self._writeback()
         return Tensor(loss, stop_gradient=True)

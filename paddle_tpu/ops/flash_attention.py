@@ -60,8 +60,11 @@ def flash_attention(q, k, v, causal=False, scale=None, bias=None,
                 q, k, v, causal=causal, scale=scale, bias=bias,
                 segment_ids=segment_ids, kv_segment_ids=kv_segment_ids,
                 bias_grad=bias_grad)
-        except Exception:
+        except ImportError:
             pass
+        except Exception as e:  # noqa: BLE001
+            from .paged_attention import _warn_fallback
+            _warn_fallback("flash_attention", e)
     return _ref_attention(q, k, v, causal=causal, scale=scale, bias=bias,
                           segment_ids=segment_ids,
                           kv_segment_ids=kv_segment_ids)

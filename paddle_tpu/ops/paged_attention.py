@@ -44,10 +44,25 @@ def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
             from .pallas.paged_attention import paged_attention_decode_pallas
             return paged_attention_decode_pallas(
                 q, k_pool, v_pool, block_tables, seq_lens, scale=scale)
-        except Exception:
+        except ImportError:
             pass
+        except Exception as e:  # noqa: BLE001
+            _warn_fallback("paged_attention_decode", e)
     return paged_attention_decode_xla(q, k_pool, v_pool, block_tables,
                                       seq_lens, scale=scale)
+
+
+_warned_fallbacks = set()
+
+
+def _warn_fallback(name, e):
+    """A real kernel defect must not silently become the slow XLA path."""
+    if name not in _warned_fallbacks:
+        _warned_fallbacks.add(name)
+        import warnings
+        warnings.warn(f"{name}: Pallas kernel failed "
+                      f"({type(e).__name__}: {e}); falling back to the XLA "
+                      "composition", stacklevel=3)
 
 
 def paged_attention_decode_xla(q, k_pool, v_pool, block_tables, seq_lens,

@@ -149,7 +149,7 @@ def _kv_index(h, kvh, causal, bq, bk, off=0):
     def idx(b, i, j):
         kb = (b // h) * kvh + (b % h) // groups
         if causal:
-            j = jnp.minimum(j, (i * bq + bq - 1 + off) // bk)
+            j = jnp.clip((i * bq + bq - 1 + off) // bk, 0, j)
         return (kb, j, 0)
 
     return idx
@@ -160,7 +160,7 @@ def _bias_index(h, bias_b, bias_h, b_total, causal, bq, bk, clamp, off=0):
         bi = 0 if bias_b == 1 else b // h
         hi = 0 if bias_h == 1 else b % h
         if causal and clamp:
-            j = jnp.minimum(j, (i * bq + bq - 1 + off) // bk)
+            j = jnp.clip((i * bq + bq - 1 + off) // bk, 0, j)
         return (bi * bias_h + hi, i, j)
 
     return idx
@@ -172,7 +172,7 @@ def _seg_specs(h, bq, bk, causal, clamp_k=True, off=0):
 
     def k_idx(b, i, j):
         if causal and clamp_k:
-            j = jnp.minimum(j, (i * bq + bq - 1 + off) // bk)
+            j = jnp.clip((i * bq + bq - 1 + off) // bk, 0, j)
         return (b // h, 0, j)
 
     return (pl.BlockSpec((None, 1, bq), q_idx),

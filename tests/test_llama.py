@@ -140,3 +140,28 @@ class TestDryrun:
     def test_dryrun_sizes(self, n):
         from paddle_tpu.distributed.dryrun import run_dryrun
         run_dryrun(n)
+
+
+def test_trainer_nan_watch():
+    """check_nan_inf catches non-finite loss inside the compiled
+    hybrid-parallel step."""
+    import jax.numpy as jnp
+    from paddle_tpu.core.flags import GLOBAL_FLAGS
+    from paddle_tpu.models.llama import init_params, param_shardings
+
+    mesh = make_mesh(MeshConfig())
+    params = init_params(CFG, jax.random.PRNGKey(0))
+
+    def poisoned(p, t, l):
+        return loss_fn(p, t, l, CFG) + jnp.log(jnp.float32(-1.0))
+
+    GLOBAL_FLAGS.set("check_nan_inf", True)
+    try:
+        tr = Trainer(poisoned, mesh, param_shardings(mesh, CFG), lr=1e-4)
+        state = tr.init_state(params)
+        toks = jnp.zeros((2, 16), jnp.int32)
+        import pytest as _pytest
+        with _pytest.raises(FloatingPointError, match="check_nan_inf"):
+            tr.step(state, toks, toks)
+    finally:
+        GLOBAL_FLAGS.set("check_nan_inf", False)
