@@ -42,3 +42,96 @@ def _patch():
 
 _patch()
 del _patch
+
+
+# ---------------------------------------------------------------------------
+# Inplace variants (reference: the generated ``op_`` siblings in
+# python/paddle/tensor/* — here one mechanical wrapper: run the op, rebind
+# the tensor's value/tape node in place)
+# ---------------------------------------------------------------------------
+_INPLACE_BASES = [
+    "add", "addmm", "bitwise_and", "bitwise_invert", "bitwise_left_shift",
+    "bitwise_not", "bitwise_or", "bitwise_right_shift", "bitwise_xor",
+    "cast", "clip", "copysign", "cumprod", "cumsum", "digamma", "divide",
+    "equal", "erfinv", "fill_diagonal_tensor", "flatten", "floor_divide",
+    "frac", "gammainc", "gammaincc", "gammaln", "gcd", "greater_equal",
+    "greater_than", "hypot", "i0", "index_add", "index_fill", "index_put",
+    "lcm", "ldexp", "lerp", "less", "less_equal", "less_than", "lgamma",
+    "log", "log10", "log1p", "log2", "logical_and", "logical_not",
+    "logical_or", "logical_xor", "logit", "masked_fill", "masked_scatter",
+    "multigammaln", "multiply", "nan_to_num", "neg", "not_equal",
+    "polygamma", "pow", "put_along_axis", "remainder", "renorm", "round",
+    "sinc", "squeeze", "subtract", "t", "tanh", "transpose", "tril",
+    "triu", "trunc", "unsqueeze",
+]
+
+
+def _make_inplace(base_fn, name):
+    def inplace(x, *args, **kwargs):
+        out = base_fn(x, *args, **kwargs)
+        x._value = out._value
+        x._grad_node = out._grad_node
+        x._out_index = out._out_index
+        return x
+    inplace.__name__ = name
+    inplace.__doc__ = f"Inplace variant of ``{base_fn.__name__}``."
+    return inplace
+
+
+def _gen_inplace():
+    g = globals()
+    for base in _INPLACE_BASES:
+        name = base + "_"
+        fn = g.get(base) or getattr(Tensor, base, None)
+        if fn is None or name in g:
+            continue
+        wrapper = _make_inplace(fn, name)
+        g[name] = wrapper
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, wrapper)
+
+
+_gen_inplace()
+del _gen_inplace
+
+
+def zero_(x):
+    """Fill with zeros in place (delegates to Tensor.zero_)."""
+    return x.zero_()
+
+
+def fill_(x, value):
+    """Fill with a scalar in place (delegates to Tensor.fill_)."""
+    return x.fill_(value)
+
+
+def set_(x, source=None, shape=None, stride=None, offset=0):
+    """Rebind x's storage to ``source`` (reference: manipulation.py set_)."""
+    from ..core.tensor import to_value
+    import jax.numpy as jnp
+    if source is None:
+        x._value = jnp.zeros((0,), to_value(x).dtype)
+    else:
+        v = to_value(source if isinstance(source, Tensor)
+                     else Tensor(source))
+        if shape is not None:
+            v = v.reshape(shape)
+        x._value = v
+    x._grad_node = None
+    return x
+
+
+def gaussian_(x, mean=0.0, std=1.0, seed=0, name=None):
+    """Fill with N(mean, std) samples in place (reference: random.py)."""
+    import jax.random as jr
+    from ..core.random import next_key
+    from ..core.tensor import to_value
+    v = to_value(x)
+    key = jr.key(seed) if seed else next_key()
+    return x._replace_value(jr.normal(key, v.shape, v.dtype) * std + mean)
+
+
+for _n in ("zero_", "fill_", "set_", "gaussian_"):
+    if not hasattr(Tensor, _n):
+        setattr(Tensor, _n, globals()[_n])
+del _n

@@ -191,3 +191,9 @@ def one_hot(x, num_classes, name=None) -> Tensor:
     return dispatch(
         lambda v: jax.nn.one_hot(v, num_classes, dtype=get_default_dtype()),
         (x,), name="one_hot")
+
+
+# -- round-2 breadth ops ----------------------------------------------------
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """reference: tensor/creation.py fill_constant (legacy-compatible)."""
+    return full(shape, value, dtype=dtype)

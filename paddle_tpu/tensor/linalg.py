@@ -8,6 +8,8 @@ from __future__ import annotations
 
 from typing import Optional, Sequence, Union
 
+import builtins
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -317,3 +319,72 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         U, S, Vh = jnp.linalg.svd(vv, full_matrices=False)
         return U[..., :qq], S[..., :qq], jnp.swapaxes(Vh, -1, -2)[..., :qq]
     return dispatch(f, (x,), name="pca_lowrank", multi_output=True)
+
+
+# -- round-2 breadth ops (reference: python/paddle/tensor/linalg.py) --------
+def inverse(x, name=None):
+    return dispatch(lambda v: jnp.linalg.inv(v), (_ensure(x),),
+                    name="inverse")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """reference: linalg.py cholesky_inverse: inverse of A from its
+    Cholesky factor."""
+    def f(v):
+        a = v @ v.T if not upper else v.T @ v
+        return jnp.linalg.inv(a)
+    return dispatch(f, (_ensure(x),), name="cholesky_inverse")
+
+
+def cond(x, p=None, name=None):
+    """reference: linalg.py cond (matrix condition number)."""
+    def f(v):
+        return jnp.linalg.cond(v, p=p)
+    return dispatch(f, (_ensure(x),), name="cond")
+
+
+def ormqr(input, tau, other, left=True, transpose=False, name=None):
+    """reference: linalg.py ormqr — multiply ``other`` by Q built from the
+    Householder reflectors (input, tau). Batched inputs vmap over the
+    leading axis."""
+    def core(a, t, c):
+        m = a.shape[0]
+        k = t.shape[0]
+        eye = jnp.eye(m, dtype=a.dtype)
+        Q = eye
+        for i in range(k):
+            v = jnp.where(jnp.arange(m) > i, a[:, i], 0.0)
+            v = v.at[i].set(1.0)
+            H = eye - t[i] * jnp.outer(v, v)
+            Q = Q @ H
+        Qm = Q.T if transpose else Q
+        return Qm @ c if left else c @ Qm
+
+    def f(a, t, c):
+        if a.ndim == 2:
+            return core(a, t, c)
+        return jax.vmap(core)(a, t, c)
+    return dispatch(f, (_ensure(input), _ensure(tau), _ensure(other)),
+                    name="ormqr")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """reference: linalg.py svd_lowrank (randomized SVD)."""
+    from ..core.random import next_key
+
+    key = next_key()
+
+    def f(v, *rest):
+        a = v - rest[0] if rest else v
+        m, n = a.shape[-2], a.shape[-1]
+        r = builtins.min(q, m, n)
+        g = jax.random.normal(key, a.shape[:-2] + (n, r), jnp.float32)
+        y = a @ g.astype(a.dtype)
+        for _ in range(niter):
+            y = a @ (a.swapaxes(-2, -1) @ y)
+        qb, _ = jnp.linalg.qr(y)
+        b = qb.swapaxes(-2, -1) @ a
+        u, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        return qb @ u, s, vt.swapaxes(-2, -1)
+    args = (_ensure(x),) + ((_ensure(M),) if M is not None else ())
+    return dispatch(f, args, name="svd_lowrank", multi_output=True)

@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor, dispatch
+from ..core.tensor import Tensor, dispatch, to_value
 
 
 def _ensure(x):
@@ -49,3 +49,24 @@ def is_empty(x, name=None):
 
 def is_tensor(x):
     return isinstance(x, Tensor)
+
+
+# -- round-2 breadth ops ----------------------------------------------------
+def is_complex(x):
+    return jnp.issubdtype(to_value(_ensure(x)).dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(to_value(_ensure(x)).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(to_value(_ensure(x)).dtype, jnp.integer)
+
+
+def less(x, y, name=None):
+    return less_than(x, y)
+
+
+def bitwise_invert(x, out=None, name=None):
+    return bitwise_not(x)

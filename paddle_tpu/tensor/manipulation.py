@@ -536,3 +536,304 @@ def atleast_3d(*inputs, name=None):
     outs = [dispatch(jnp.atleast_3d, (_ensure(i),), name="atleast_3d")
             for i in inputs]
     return outs[0] if len(outs) == 1 else outs
+
+
+# -- round-2 breadth ops (reference: python/paddle/tensor/manipulation.py) --
+def block_diag(inputs, name=None):
+    """reference: manipulation.py block_diag."""
+    mats = [_ensure(x) for x in inputs]
+
+    def f(*vs):
+        vs = [jnp.atleast_2d(v) for v in vs]
+        rows = builtins.sum(v.shape[0] for v in vs)
+        cols = builtins.sum(v.shape[1] for v in vs)
+        out = jnp.zeros((rows, cols), jnp.result_type(*vs))
+        r = c = 0
+        for v in vs:
+            out = jax.lax.dynamic_update_slice(out, v.astype(out.dtype),
+                                               (r, c))
+            r += v.shape[0]
+            c += v.shape[1]
+        return out
+    return dispatch(f, tuple(mats), name="block_diag")
+
+
+def cartesian_prod(x, name=None):
+    """reference: manipulation.py cartesian_prod (list of 1-D tensors)."""
+    ts = [_ensure(t) for t in x]
+
+    def f(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    if len(ts) == 1:
+        return dispatch(lambda v: v.reshape(-1), tuple(ts),
+                        name="cartesian_prod")
+    return dispatch(f, tuple(ts), name="cartesian_prod")
+
+
+def column_stack(x, name=None):
+    ts = [_ensure(t) for t in x]
+
+    def f(*vs):
+        vs = [v[:, None] if v.ndim == 1 else v for v in vs]
+        return jnp.concatenate(vs, axis=1)
+    return dispatch(f, tuple(ts), name="column_stack")
+
+
+def row_stack(x, name=None):
+    ts = [_ensure(t) for t in x]
+    return dispatch(lambda *vs: jnp.vstack(vs), tuple(ts), name="row_stack")
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    """reference: manipulation.py combinations (1-D input)."""
+    import itertools
+    n = _ensure(x).shape[0]
+    idx = list(itertools.combinations_with_replacement(range(n), r)
+               if with_replacement else itertools.combinations(range(n), r))
+    idx_arr = np.asarray(idx, np.int32).reshape(-1, r) if idx else \
+        np.zeros((0, r), np.int32)
+    return dispatch(lambda v: v[idx_arr], (_ensure(x),), name="combinations")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    """reference: manipulation.py diag_embed — last dim becomes a diagonal
+    of a new square matrix placed on (dim1, dim2)."""
+    x = _ensure(input)
+
+    def f(v):
+        n = v.shape[-1] + builtins.abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        i = jnp.arange(v.shape[-1])
+        rows = i - builtins.min(offset, 0)
+        cols = i + builtins.max(offset, 0)
+        out = base.at[..., rows, cols].set(v)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
+        # move the two new trailing axes to (dim1, dim2)
+        order = list(range(nd - 2))
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+    return dispatch(f, (x,), name="diag_embed")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """reference: manipulation.py diagonal_scatter."""
+    def f(v, src):
+        nd = v.ndim
+        a1, a2 = axis1 % nd, axis2 % nd
+        v_m = jnp.moveaxis(v, (a1, a2), (nd - 2, nd - 1))
+        n = builtins.min(v_m.shape[-2] - builtins.max(-offset, 0),
+                         v_m.shape[-1] - builtins.max(offset, 0))
+        i = jnp.arange(n)
+        rows = i + builtins.max(-offset, 0)
+        cols = i + builtins.max(offset, 0)
+        src_m = jnp.moveaxis(src, -1, -1) if src.ndim else src
+        out = v_m.at[..., rows, cols].set(src_m.astype(v.dtype))
+        return jnp.moveaxis(out, (nd - 2, nd - 1), (a1, a2))
+    return dispatch(f, (_ensure(x), _ensure(y)), name="diagonal_scatter")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """reference: manipulation.py select_scatter."""
+    def f(v, src):
+        idx = [builtins.slice(None)] * v.ndim
+        idx[axis] = index
+        return v.at[tuple(idx)].set(src.astype(v.dtype))
+    return dispatch(f, (_ensure(x), _ensure(values)), name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """reference: manipulation.py slice_scatter."""
+    def f(v, src):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(int(st), int(en), int(sd))
+        return v.at[tuple(idx)].set(src.astype(v.dtype))
+    return dispatch(f, (_ensure(x), _ensure(value)), name="slice_scatter")
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = _ensure(x)
+    axis = 0 if x.ndim == 1 else 1
+    return split_like_numpy(x, num_or_indices, axis, "hsplit")
+
+
+def vsplit(x, num_or_indices, name=None):
+    return split_like_numpy(_ensure(x), num_or_indices, 0, "vsplit")
+
+
+def dsplit(x, num_or_indices, name=None):
+    return split_like_numpy(_ensure(x), num_or_indices, 2, "dsplit")
+
+
+def split_like_numpy(x, num_or_indices, axis, opname):
+    n = x.shape[axis]
+    if isinstance(num_or_indices, int):
+        if n % num_or_indices != 0:
+            raise ValueError(
+                f"{opname}: axis size {n} is not divisible into "
+                f"{num_or_indices} equal sections")
+        cuts = [n // num_or_indices * i
+                for i in range(1, num_or_indices)]
+    else:
+        cuts = list(num_or_indices)
+    bounds = [0] + [int(c) for c in cuts] + [n]
+    outs = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        def f(v, lo=lo, hi=hi):
+            idx = [builtins.slice(None)] * v.ndim
+            idx[axis] = builtins.slice(lo, hi)
+            return v[tuple(idx)]
+        outs.append(dispatch(f, (x,), name=opname))
+    return outs
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """reference: manipulation.py fill_diagonal_tensor."""
+    return diagonal_scatter(x, y, offset=offset, axis1=dim1, axis2=dim2)
+
+
+def unflatten(x, axis, shape, name=None):
+    """reference: manipulation.py unflatten."""
+    def f(v):
+        ax = axis % v.ndim
+        tgt = list(shape)
+        if -1 in tgt:
+            known = int(np.prod([s for s in tgt if s != -1]))
+            tgt[tgt.index(-1)] = v.shape[ax] // builtins.max(known, 1)
+        return v.reshape(v.shape[:ax] + tuple(tgt) + v.shape[ax + 1:])
+    return dispatch(f, (_ensure(x),), name="unflatten")
+
+
+def unfold(x, axis, size, step, name=None):
+    """reference: manipulation.py unfold (sliding windows on one axis)."""
+    def f(v):
+        ax = axis % v.ndim
+        n = (v.shape[ax] - size) // step + 1
+        starts = jnp.arange(n) * step
+        idx = starts[:, None] + jnp.arange(size)[None, :]   # [n, size]
+        out = jnp.take(v, idx.reshape(-1), axis=ax)
+        out = out.reshape(v.shape[:ax] + (n, size) + v.shape[ax + 1:])
+        return jnp.moveaxis(out, ax + 1, -1)
+    return dispatch(f, (_ensure(x),), name="unfold")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """reference: manipulation.py unstack."""
+    x = _ensure(x)
+    n = num if num is not None else x.shape[axis]
+    outs = []
+    for i in range(n):
+        def f(v, i=i):
+            return jnp.take(v, i, axis=axis)
+        outs.append(dispatch(f, (x,), name="unstack"))
+    return outs
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """reference: manipulation.py as_strided (element strides on the
+    flattened array)."""
+    def f(v):
+        flat = v.reshape(-1)
+        idx = jnp.full((), offset, jnp.int32)
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in shape],
+                             indexing="ij") if shape else []
+        lin = offset
+        for g, st in zip(grids, stride):
+            lin = lin + g * st
+        return flat[lin] if shape else flat[offset]
+    return dispatch(f, (_ensure(x),), name="as_strided")
+
+
+def matrix_transpose(x, name=None):
+    return dispatch(lambda v: jnp.swapaxes(v, -2, -1), (_ensure(x),),
+                    name="matrix_transpose")
+
+
+def rank(input, name=None):
+    return dispatch(lambda v: jnp.asarray(v.ndim, jnp.int32),
+                    (_ensure(input),), name="rank")
+
+
+def rearrange(tensor, pattern, **axes_lengths):
+    """einops-style rearrange (reference: manipulation.py rearrange)."""
+    import einops
+
+    def f(v):
+        return einops.rearrange(v, pattern, **axes_lengths)
+    return dispatch(f, (_ensure(tensor),), name="rearrange")
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(v, idx):
+        moved = jnp.moveaxis(v, axis, 0)
+        moved = moved.at[idx].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+    return dispatch(f, (_ensure(x), _ensure(index)), name="index_fill")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    """reference: manipulation.py index_put."""
+    args = (_ensure(x),) + tuple(_ensure(i) for i in indices) + \
+        (_ensure(value),)
+
+    def f(v, *rest):
+        idx, val = rest[:-1], rest[-1]
+        if accumulate:
+            return v.at[idx].add(val.astype(v.dtype))
+        return v.at[idx].set(val.astype(v.dtype))
+    return dispatch(f, args, name="index_put")
+
+
+def masked_scatter(x, mask, value, name=None):
+    """reference: manipulation.py masked_scatter — fill masked positions
+    with consecutive elements of value."""
+    def f(v, m, src):
+        m = jnp.broadcast_to(m, v.shape)
+        flat_src = src.reshape(-1)
+        # k-th True position takes flat_src[k]
+        order = jnp.cumsum(m.reshape(-1)) - 1
+        gathered = flat_src[jnp.clip(order, 0, flat_src.shape[0] - 1)]
+        return jnp.where(m, gathered.reshape(v.shape).astype(v.dtype), v)
+    return dispatch(f, (_ensure(x), _ensure(mask), _ensure(value)),
+                    name="masked_scatter")
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """Inplace scalar diagonal fill (reference: manipulation.py
+    fill_diagonal_)."""
+    def f(v):
+        if v.ndim == 2 and wrap:
+            m, n = v.shape
+            i = jnp.arange(m)
+            rows = i
+            cols = (i + offset) % n if wrap else i + offset
+            ok = jnp.ones_like(rows, bool) if wrap else \
+                (cols >= 0) & (cols < n)
+            return v.at[rows[ok], cols[ok]].set(value) if not wrap else \
+                v.at[rows, cols].set(value)
+        n = builtins.min(v.shape[-2] - builtins.max(-offset, 0),
+                         v.shape[-1] - builtins.max(offset, 0))
+        i = jnp.arange(n)
+        return v.at[..., i + builtins.max(-offset, 0),
+                    i + builtins.max(offset, 0)].set(value)
+    out = dispatch(f, (_ensure(x),), name="fill_diagonal_")
+    x._value, x._grad_node, x._out_index = \
+        out._value, out._grad_node, out._out_index
+    return x
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
+    """Concat/stack a list of tensors, returning (tensor, sizes)
+    (reference: manipulation.py tensor_array_to_tensor:63)."""
+    ts = [_ensure(t) for t in input]
+    sizes = np.asarray([t.shape[axis] if not use_stack and t.ndim > axis
+                        else 1 for t in ts], np.int32)
+
+    def f(*vs):
+        return jnp.stack(vs, axis=axis) if use_stack \
+            else jnp.concatenate(vs, axis=axis)
+    return dispatch(f, tuple(ts), name="tensor_array_to_tensor"), \
+        Tensor(jnp.asarray(sizes), stop_gradient=True)

@@ -437,3 +437,129 @@ def renorm(x, p, axis, max_norm, name=None):
         factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
         return v * factor
     return dispatch(f, (x,), name="renorm")
+
+
+# -- round-2 breadth ops (reference: python/paddle/tensor/math.py) ----------
+def gammaln(x, name=None):
+    return dispatch(lambda v: jax.lax.lgamma(v.astype(jnp.float32)
+                                             if v.dtype in (jnp.int32,
+                                                            jnp.int64)
+                                             else v), (_ensure(x),),
+                    name="gammaln")
+
+
+def multigammaln(x, p, name=None):
+    """reference: math.py multigammaln."""
+    def f(v):
+        v = v.astype(jnp.float32) if not jnp.issubdtype(v.dtype,
+                                                        jnp.floating) else v
+        c = 0.25 * p * (p - 1) * np.log(np.pi).astype(np.float32)
+        out = c
+        for i in range(p):
+            out = out + jax.lax.lgamma(v - 0.5 * i)
+        return out
+    return dispatch(f, (_ensure(x),), name="multigammaln")
+
+
+def sinc(x, name=None):
+    return dispatch(lambda v: jnp.sinc(v), (_ensure(x),), name="sinc")
+
+
+def signbit(x, name=None):
+    return dispatch(lambda v: jnp.signbit(v), (_ensure(x),), name="signbit")
+
+
+def logit(x, eps=None, name=None):
+    def f(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1.0 - eps)
+        return jnp.log(v) - jnp.log1p(-v)
+    return dispatch(f, (_ensure(x),), name="logit")
+
+
+def negative(x, name=None):
+    return dispatch(lambda v: -v, (_ensure(x),), name="negative")
+
+
+def positive(x, name=None):
+    return dispatch(lambda v: +v, (_ensure(x),), name="positive")
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return dispatch(lambda v, t: jnp.isin(v, t, invert=invert),
+                    (_ensure(x), _ensure(test_x)), name="isin")
+
+
+def add_n(inputs, name=None):
+    """reference: math.py add_n (sum of a tensor list)."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    ts = [_ensure(t) for t in inputs]
+
+    def f(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+    return dispatch(f, tuple(ts), name="add_n")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """reference: math.py trapezoid."""
+    args = (_ensure(y),) + ((_ensure(x),) if x is not None else ())
+
+    def f(yv, *rest):
+        if rest:
+            return jnp.trapezoid(yv, rest[0], axis=axis)
+        return jnp.trapezoid(yv, dx=dx if dx is not None else 1.0, axis=axis)
+    return dispatch(f, args, name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """reference: math.py cumulative_trapezoid."""
+    args = (_ensure(y),) + ((_ensure(x),) if x is not None else ())
+
+    def f(yv, *rest):
+        y1 = jnp.moveaxis(yv, axis, -1)
+        left, right = y1[..., :-1], y1[..., 1:]
+        if rest:
+            xv = jnp.moveaxis(rest[0], axis, -1) if rest[0].ndim > 1 \
+                else rest[0]
+            d = jnp.diff(xv, axis=-1)
+        else:
+            d = dx if dx is not None else 1.0
+        steps = (left + right) * 0.5 * d
+        out = jnp.cumsum(steps, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+    return dispatch(f, args, name="cumulative_trapezoid")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    return dispatch(lambda a, b: jnp.sum(a * b, axis=axis),
+                    (_ensure(x), _ensure(y)), name="vecdot")
+
+
+def mm(input, mat2, name=None):
+    from .linalg import matmul
+    return matmul(input, mat2)
+
+
+def ldexp(x, y, name=None):
+    return dispatch(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)),
+                    (_ensure(x), _ensure(y)), name="ldexp")
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    """reference: math.py histogram_bin_edges."""
+    def f(v):
+        lo, hi = builtins.min(min, max), builtins.max(min, max)
+        if lo == 0 and hi == 0:
+            lo_v, hi_v = jnp.min(v), jnp.max(v)
+        else:
+            lo_v = jnp.asarray(lo, jnp.float32)
+            hi_v = jnp.asarray(hi, jnp.float32)
+        same = hi_v == lo_v
+        lo_v = jnp.where(same, lo_v - 0.5, lo_v)
+        hi_v = jnp.where(same, hi_v + 0.5, hi_v)
+        return lo_v + (hi_v - lo_v) * jnp.arange(bins + 1) / bins
+    return dispatch(f, (_ensure(input),), name="histogram_bin_edges")

@@ -88,6 +88,10 @@ def randint_like(x, low=0, high=None, dtype=None, name=None) -> Tensor:
                                      low, high).astype(d))
 
 
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
 def _ensure_dtype(x):
     return np.dtype(to_value(x).dtype)
 
@@ -172,3 +176,32 @@ def randn_like(x, dtype=None, name=None) -> Tensor:
     v = to_value(x)
     d = convert_dtype(dtype) if dtype else v.dtype
     return Tensor(jax.random.normal(next_key(), v.shape, dtype=d))
+
+
+# -- round-2 breadth ops (reference: python/paddle/tensor/random.py) --------
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    """reference: random.py gaussian."""
+    from ..core.random import next_key
+    from ..core.dtypes import convert_dtype, get_default_dtype
+    d = convert_dtype(dtype) if dtype else get_default_dtype()
+    key = jax.random.key(seed) if seed else next_key()
+    v = jax.random.normal(key, tuple(shape), d) * std + mean
+    return Tensor(v, stop_gradient=True)
+
+
+def standard_gamma(x, name=None):
+    """reference: random.py standard_gamma — gamma(alpha=x) samples."""
+    from ..core.random import next_key
+    key = next_key()
+    return dispatch(lambda v: jax.random.gamma(key, v), (_ensure(x),),
+                    name="standard_gamma")
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    """reference: random.py log_normal."""
+    from ..core.random import next_key
+    from ..core.dtypes import convert_dtype, get_default_dtype
+    d = convert_dtype(dtype) if dtype else get_default_dtype()
+    v = jnp.exp(jax.random.normal(next_key(), tuple(shape or ()), d)
+                * std + mean)
+    return Tensor(v, stop_gradient=True)

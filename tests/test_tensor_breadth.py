@@ -1,0 +1,284 @@
+"""OpTest-style parity tests for the round-2 breadth ops: each op runs
+against a numpy reference at fp32 (and bf16 where meaningful) tolerances —
+the spirit of reference test/legacy_test/op_test.py:418 check_output.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(0)
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def n(x):
+    return np.asarray(x._value if hasattr(x, "_value") else x)
+
+
+class TestManipulationBreadth:
+    def test_block_diag(self):
+        a, b = RNG.randn(2, 3).astype(np.float32), \
+            RNG.randn(3, 1).astype(np.float32)
+        out = n(paddle.block_diag([t(a), t(b)]))
+        ref = np.zeros((5, 4), np.float32)
+        ref[:2, :3] = a
+        ref[2:, 3:] = b
+        np.testing.assert_allclose(out, ref)
+
+    def test_cartesian_prod(self):
+        a = np.asarray([1, 2, 3], np.int32)
+        b = np.asarray([4, 5], np.int32)
+        out = n(paddle.cartesian_prod([t(a), t(b)]))
+        ref = np.asarray([[x, y] for x in a for y in b], np.int32)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_column_row_stack(self):
+        a, b = RNG.randn(4).astype(np.float32), \
+            RNG.randn(4).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.column_stack([t(a), t(b)])),
+                                   np.column_stack([a, b]))
+        np.testing.assert_allclose(n(paddle.row_stack([t(a), t(b)])),
+                                   np.vstack([a, b]))
+
+    def test_combinations(self):
+        a = np.asarray([1, 2, 3, 4], np.int32)
+        import itertools
+        out = n(paddle.combinations(t(a), 2))
+        ref = np.asarray(list(itertools.combinations(a, 2)), np.int32)
+        np.testing.assert_array_equal(out, ref)
+
+    def test_diag_embed(self):
+        a = RNG.randn(2, 3).astype(np.float32)
+        out = n(paddle.diag_embed(t(a)))
+        ref = np.stack([np.diag(r) for r in a])
+        np.testing.assert_allclose(out, ref)
+
+    def test_diagonal_scatter(self):
+        a = RNG.randn(4, 4).astype(np.float32)
+        d = RNG.randn(4).astype(np.float32)
+        out = n(paddle.diagonal_scatter(t(a), t(d)))
+        ref = a.copy()
+        np.fill_diagonal(ref, d)
+        np.testing.assert_allclose(out, ref)
+
+    def test_select_scatter(self):
+        a = RNG.randn(3, 4).astype(np.float32)
+        v = RNG.randn(4).astype(np.float32)
+        out = n(paddle.select_scatter(t(a), t(v), axis=0, index=1))
+        ref = a.copy()
+        ref[1] = v
+        np.testing.assert_allclose(out, ref)
+
+    def test_slice_scatter(self):
+        a = np.zeros((8, 6), np.float32)
+        v = np.ones((2, 6), np.float32)
+        out = n(paddle.slice_scatter(t(a), t(v), axes=[0], starts=[2],
+                                     ends=[6], strides=[2]))
+        ref = a.copy()
+        ref[2:6:2] = v
+        np.testing.assert_allclose(out, ref)
+
+    @pytest.mark.parametrize("fn,axis", [("hsplit", 1), ("vsplit", 0),
+                                         ("dsplit", 2)])
+    def test_splits(self, fn, axis):
+        a = RNG.randn(4, 4, 4).astype(np.float32)
+        outs = getattr(paddle, fn)(t(a), 2)
+        refs = np.split(a, 2, axis=axis)
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(n(o), r)
+
+    def test_unflatten(self):
+        a = RNG.randn(2, 12).astype(np.float32)
+        out = n(paddle.unflatten(t(a), 1, [3, -1]))
+        np.testing.assert_allclose(out, a.reshape(2, 3, 4))
+
+    def test_unfold(self):
+        a = np.arange(9).astype(np.float32)
+        out = n(paddle.unfold(t(a), 0, 2, 4))
+        ref = np.stack([a[0:2], a[4:6], a[8:9].repeat(2)[:2]])[:2]
+        # windows at starts 0, 4 (start 8 would overrun)
+        np.testing.assert_allclose(out, np.stack([a[0:2], a[4:6]]))
+
+    def test_unstack(self):
+        a = RNG.randn(3, 4).astype(np.float32)
+        outs = paddle.unstack(t(a), axis=0)
+        assert len(outs) == 3
+        for i, o in enumerate(outs):
+            np.testing.assert_allclose(n(o), a[i])
+
+    def test_as_strided(self):
+        a = np.arange(12).astype(np.float32)
+        out = n(paddle.as_strided(t(a), [3, 2], [4, 1]))
+        ref = np.lib.stride_tricks.as_strided(a, (3, 2), (16, 4)).copy()
+        np.testing.assert_allclose(out, ref)
+
+    def test_matrix_transpose_rank(self):
+        a = RNG.randn(2, 3, 4).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.matrix_transpose(t(a))),
+                                   a.swapaxes(-2, -1))
+        assert int(n(paddle.rank(t(a)))) == 3
+
+    def test_masked_scatter(self):
+        a = np.zeros(6, np.float32)
+        m = np.asarray([1, 0, 1, 1, 0, 0], bool)
+        v = np.asarray([7., 8., 9.], np.float32)
+        out = n(paddle.masked_scatter(t(a), t(m), t(v)))
+        ref = a.copy()
+        ref[m] = v
+        np.testing.assert_allclose(out, ref)
+
+    def test_index_fill_and_put(self):
+        a = RNG.randn(4, 3).astype(np.float32)
+        out = n(paddle.index_fill(t(a), t(np.asarray([0, 2])), 0, -1.0))
+        ref = a.copy()
+        ref[[0, 2]] = -1.0
+        np.testing.assert_allclose(out, ref)
+        out2 = n(paddle.index_put(t(a), (t(np.asarray([1, 3])),),
+                                  t(np.asarray([[9.] * 3, [8.] * 3],
+                                               np.float32))))
+        ref2 = a.copy()
+        ref2[[1, 3]] = [[9.] * 3, [8.] * 3]
+        np.testing.assert_allclose(out2, ref2)
+
+    def test_fill_diagonal_(self):
+        a = RNG.randn(4, 4).astype(np.float32)
+        x = t(a)
+        paddle.tensor.fill_diagonal_(x, 5.0)
+        ref = a.copy()
+        np.fill_diagonal(ref, 5.0)
+        np.testing.assert_allclose(n(x), ref)
+
+    def test_tensor_array_to_tensor(self):
+        a = RNG.randn(2, 3).astype(np.float32)
+        b = RNG.randn(2, 2).astype(np.float32)
+        out, sizes = paddle.tensor.tensor_array_to_tensor([t(a), t(b)],
+                                                          axis=1)
+        np.testing.assert_allclose(n(out), np.concatenate([a, b], axis=1))
+        np.testing.assert_array_equal(n(sizes), [3, 2])
+
+
+class TestMathBreadth:
+    def test_gammaln_multigammaln(self):
+        from scipy import special  # available via jax's scipy dep? guard
+        a = np.asarray([0.5, 1.5, 3.0], np.float32)
+        np.testing.assert_allclose(n(paddle.gammaln(t(a))),
+                                   special.gammaln(a), rtol=1e-5)
+        np.testing.assert_allclose(n(paddle.multigammaln(t(a + 2), 2)),
+                                   special.multigammaln(a + 2, 2),
+                                   rtol=1e-5)
+
+    def test_small_elementwise(self):
+        a = RNG.randn(8).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.sinc(t(a))), np.sinc(a),
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(n(paddle.signbit(t(a))),
+                                      np.signbit(a))
+        np.testing.assert_allclose(n(paddle.negative(t(a))), -a)
+        np.testing.assert_allclose(n(paddle.positive(t(a))), a)
+        p = np.clip(np.abs(a), 0.01, 0.99)
+        np.testing.assert_allclose(n(paddle.logit(t(p))),
+                                   np.log(p / (1 - p)), rtol=1e-4)
+
+    def test_isin(self):
+        a = np.asarray([1, 2, 3, 4], np.int32)
+        tst = np.asarray([2, 4], np.int32)
+        np.testing.assert_array_equal(n(paddle.isin(t(a), t(tst))),
+                                      np.isin(a, tst))
+
+    def test_add_n(self):
+        xs = [RNG.randn(3, 3).astype(np.float32) for _ in range(3)]
+        np.testing.assert_allclose(n(paddle.add_n([t(x) for x in xs])),
+                                   sum(xs), rtol=1e-6)
+
+    def test_trapezoid(self):
+        y = RNG.rand(16).astype(np.float32)
+        x = np.sort(RNG.rand(16).astype(np.float32))
+        np.testing.assert_allclose(n(paddle.trapezoid(t(y), t(x))),
+                                   np.trapezoid(y, x), rtol=1e-5)
+        out = n(paddle.cumulative_trapezoid(t(y), t(x)))
+        ref = np.cumsum((y[:-1] + y[1:]) * 0.5 * np.diff(x))
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_vecdot_mm_ldexp(self):
+        a = RNG.randn(3, 4).astype(np.float32)
+        b = RNG.randn(3, 4).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.vecdot(t(a), t(b))),
+                                   (a * b).sum(-1), rtol=1e-5)
+        m = RNG.randn(4, 2).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.mm(t(a), t(m))), a @ m,
+                                   rtol=1e-5)
+        e = np.asarray([1, 2, 3], np.int32)
+        np.testing.assert_allclose(
+            n(paddle.tensor.ldexp(t(np.asarray([1., 1., 1.], np.float32)),
+                                  t(e))), np.ldexp([1., 1., 1.], e))
+
+    def test_histogram_bin_edges(self):
+        a = RNG.rand(32).astype(np.float32)
+        out = n(paddle.histogram_bin_edges(t(a), bins=8))
+        ref = np.histogram_bin_edges(a, bins=8)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+class TestLinalgBreadth:
+    def test_inverse_cond(self):
+        a = RNG.randn(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+        np.testing.assert_allclose(n(paddle.inverse(t(a))),
+                                   np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(float(n(paddle.tensor.cond(t(a)))),
+                                   np.linalg.cond(a), rtol=1e-3)
+
+    def test_cholesky_inverse(self):
+        a = RNG.randn(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        L = np.linalg.cholesky(spd)
+        out = n(paddle.tensor.cholesky_inverse(t(L)))
+        np.testing.assert_allclose(out, np.linalg.inv(spd), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_svd_lowrank(self):
+        a = (RNG.randn(8, 3) @ RNG.randn(3, 6)).astype(np.float32)
+        u, s, v = paddle.tensor.svd_lowrank(t(a), q=3)
+        rec = n(u) * n(s)[None, :] @ n(v).T
+        np.testing.assert_allclose(rec, a, atol=1e-3)
+
+
+class TestInplaceAndTypes:
+    def test_generated_inplace(self):
+        a = RNG.randn(4).astype(np.float32)
+        x = t(a)
+        r = x.tanh_()
+        assert r is x
+        np.testing.assert_allclose(n(x), np.tanh(a), rtol=1e-6)
+        x2 = t(a)
+        x2.add_(t(np.ones(4, np.float32)))
+        np.testing.assert_allclose(n(x2), a + 1)
+
+    def test_zero_fill_set(self):
+        x = t(RNG.randn(3).astype(np.float32))
+        x.zero_()
+        np.testing.assert_allclose(n(x), np.zeros(3))
+        x.fill_(2.5)
+        np.testing.assert_allclose(n(x), np.full(3, 2.5))
+        x.set_(t(np.arange(6, dtype=np.float32)), shape=(2, 3))
+        assert n(x).shape == (2, 3)
+
+    def test_type_predicates(self):
+        assert paddle.is_floating_point(t(np.zeros(2, np.float32)))
+        assert not paddle.is_floating_point(t(np.zeros(2, np.int32)))
+        assert paddle.is_integer(t(np.zeros(2, np.int32)))
+        assert not paddle.is_complex(t(np.zeros(2, np.float32)))
+
+    def test_random_breadth(self):
+        g = paddle.tensor.gaussian([1000], mean=2.0, std=0.5)
+        assert abs(float(n(g).mean()) - 2.0) < 0.1
+        sg = paddle.tensor.standard_gamma(t(np.full(1000, 3.0, np.float32)))
+        assert abs(float(n(sg).mean()) - 3.0) < 0.3
+        ln = paddle.tensor.log_normal(mean=0.0, std=0.25, shape=[1000])
+        assert abs(float(np.log(n(ln)).mean())) < 0.1
+        x = t(np.zeros(1000, np.float32))
+        x.gaussian_(mean=1.0, std=0.1)
+        assert abs(float(n(x).mean()) - 1.0) < 0.05
