@@ -85,6 +85,50 @@ def _apply(tensor, new_value):
     return new_value
 
 
+def _multiprocess() -> bool:
+    """True when this is a real multi-process run (launcher +
+    jax.distributed) — eager collectives must then actually communicate,
+    not compute the single-controller identity."""
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def _reject_eager_subgroup(group, opname):
+    """Eager sub-group collectives in multi-process mode would silently
+    compute the single-controller identity on purely local values — wrong
+    results with no error. Fail loudly until sub-group comm lands."""
+    if group is not None and _multiprocess():
+        raise NotImplementedError(
+            f"{opname}: eager collectives over an explicit sub-group are "
+            "not supported in multi-process mode — run the collective "
+            "inside a shard_map/jit (traced path) or use the default "
+            "world group (group=None)")
+
+
+def _world_stacked(v):
+    """Each process contributes its local ``v``; returns the replicated
+    [world, ...] stack (one cross-process all-gather). The communication
+    layer of every eager collective in multi-process mode."""
+    from jax.sharding import Mesh
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("world",))
+    local = np.asarray(v)[None]
+    if jax.local_device_count() > 1:
+        # one contribution per local device (all identical)
+        local = np.broadcast_to(local, (jax.local_device_count(),)
+                                + local.shape[1:])
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("world")), local)
+    out = jax.jit(lambda a: a,
+                  out_shardings=NamedSharding(mesh, P()))(arr)
+    stacked = jnp.asarray(out.addressable_data(0))
+    if jax.local_device_count() > 1:
+        stacked = stacked[::jax.local_device_count()]
+    return stacked
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """reference: python/paddle/distributed/communication/all_reduce.py."""
     v = to_value(tensor)
@@ -100,6 +144,20 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             out = jax.lax.pmean(v, ax)
         else:
             out = jnp.exp(jax.lax.psum(jnp.log(v), ax))
+        return _apply(tensor, out)
+    _reject_eager_subgroup(group, "all_reduce")
+    if _multiprocess() and group is None:
+        stacked = _world_stacked(v)
+        if op == ReduceOp.SUM:
+            out = stacked.sum(axis=0)
+        elif op == ReduceOp.MAX:
+            out = stacked.max(axis=0)
+        elif op == ReduceOp.MIN:
+            out = stacked.min(axis=0)
+        elif op == ReduceOp.AVG:
+            out = stacked.mean(axis=0)
+        else:
+            out = stacked.prod(axis=0)
         return _apply(tensor, out)
     # eager on replicated global array: SUM multiplies by group size
     n = group.nranks if group is not None else _default_world(ax)
@@ -132,6 +190,15 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
                 tensor_list.append(Tensor(gathered[i]))
             return _Task(gathered)
         return gathered
+    _reject_eager_subgroup(group, "all_gather")
+    if _multiprocess() and group is None:
+        stacked = _world_stacked(v)
+        if isinstance(tensor_list, list):
+            tensor_list.clear()
+            for i in range(stacked.shape[0]):
+                tensor_list.append(Tensor(stacked[i]))
+            return _Task(stacked)
+        return stacked
     n = group.nranks if group is not None else _default_world(ax)
     if isinstance(tensor_list, list):
         tensor_list.clear()
@@ -164,6 +231,14 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
         out = jax.lax.psum_scatter(src, ax, scatter_dimension=0,
                                    tiled=True)
         return _apply(tensor, out)
+    _reject_eager_subgroup(group, "reduce_scatter")
+    if _multiprocess() and group is None:
+        stacked = _world_stacked(src)          # [world, N, ...]
+        total = stacked.sum(axis=0)
+        n = stacked.shape[0]
+        per = total.shape[0] // n
+        r = jax.process_index()
+        return _apply(tensor, total[r * per:(r + 1) * per])
     n = group.nranks if group is not None else _default_world(ax)
     out = (src * n)[: src.shape[0] // n]
     return _apply(tensor, out)
@@ -189,6 +264,18 @@ def broadcast_object_list(object_list, src=0, group=None):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _axis(group)
     v = to_value(tensor)
+    if _multiprocess() and group is None and not _in_trace(v):
+        # every rank must join the collective — non-src ranks pass
+        # tensor_list=None in the paddle convention, so they contribute
+        # a zero buffer of the right shape
+        from jax.experimental import multihost_utils
+        if tensor_list is not None:
+            stacked = jnp.stack([to_value(t) for t in tensor_list])
+        else:
+            stacked = jnp.zeros((jax.process_count(),) + v.shape, v.dtype)
+        stacked = multihost_utils.broadcast_one_to_all(
+            stacked, is_source=jax.process_index() == src)
+        return _apply(tensor, stacked[jax.process_index()])
     if tensor_list is None:
         return _apply(tensor, v)
     stacked = jnp.stack([to_value(t) for t in tensor_list])

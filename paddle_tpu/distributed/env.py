@@ -30,7 +30,8 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
     init_parallel_env."""
     if _initialized[0]:
         return ParallelEnv()
-    addr = coordinator_address or os.environ.get("PADDLE_MASTER") \
+    paddle_master = os.environ.get("PADDLE_MASTER")
+    addr = coordinator_address or paddle_master \
         or os.environ.get("MASTER_ADDR")
     nproc = num_processes if num_processes is not None else int(
         os.environ.get("PADDLE_TRAINERS_NUM", "1"))
@@ -40,6 +41,24 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
         port = os.environ.get("MASTER_PORT")
         if port and ":" not in addr:
             addr = f"{addr}:{port}"
+        if coordinator_address is None and addr == paddle_master:
+            # PADDLE_MASTER is the launcher's TCPStore (control plane);
+            # the JAX coordination service gets the next port. Explicit
+            # coordinator_address / MASTER_ADDR setups are used verbatim.
+            host, _, p = addr.rpartition(":")
+            if p.isdigit():
+                addr = f"{host}:{int(p) + 1}"
+        plat = (jax.config.jax_platforms or
+                os.environ.get("JAX_PLATFORMS", ""))
+        if "cpu" in str(plat):
+            # CPU multi-process collectives need the gloo transport
+            # (checked via config, NOT default_backend(): backends must
+            # not be instantiated before jax.distributed.initialize)
+            try:
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+            except Exception:
+                pass
         jax.distributed.initialize(coordinator_address=addr,
                                    num_processes=nproc, process_id=pid,
                                    local_device_ids=local_device_ids)

@@ -10,6 +10,7 @@ from .fleet import (init, distributed_model, distributed_optimizer,  # noqa
                     worker_num, worker_index)
 from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa
                         RowParallelLinear, ParallelCrossEntropy)
+from .pp_compiled import CompiledPipeline, pipeline_microbatch  # noqa
 from . import random  # noqa: F401
 
 # paddle-compat: fleet.meta_parallel namespace

@@ -1,0 +1,115 @@
+"""Compiled pipeline parallelism: the whole schedule is ONE XLA program.
+
+The reference's pipeline engines drive micro-batches from Python
+(fleet/meta_parallel/pipeline_parallel.py:684 1F1B loop; the static-graph
+schedules are interpreter passes, pipeline_scheduler_pass/*.py). On TPU the
+idiomatic form is SPMD: ``shard_map`` over the ``pp`` mesh axis runs the
+SAME staged program on every device, a ``lax.scan`` over schedule ticks
+drives the micro-batches, and ``lax.ppermute`` shifts activations to the
+next stage over ICI. XLA compiles the entire schedule (forward AND backward
+— jax AD differentiates through scan+ppermute, so the backward pipeline
+runs in the reverse direction automatically) with its latency-hiding
+scheduler overlapping the permutes with compute — the overlap the eager
+engine could only approximate with async dispatch.
+
+Schedule shape: T = M + S - 1 ticks (M micro-batches, S stages). At tick t
+stage s processes micro-batch (t - s); out-of-range ticks are pipeline
+bubbles (computed uniformly, masked from outputs — SPMD requires uniform
+programs). This is the GPipe dataflow; combined with jax.checkpoint on the
+stage body it has the classic activation-memory profile, and the eager
+1F1B/ZB engines (pipeline_parallel.py) remain the fine-grained-memory
+debug path.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["CompiledPipeline", "pipeline_microbatch"]
+
+
+def pipeline_microbatch(batch, num_microbatches: int):
+    """[B, ...] -> [M, B/M, ...] micro-batch split."""
+    def split(v):
+        b = v.shape[0]
+        assert b % num_microbatches == 0, \
+            f"batch {b} not divisible by {num_microbatches} microbatches"
+        return v.reshape((num_microbatches, b // num_microbatches)
+                         + v.shape[1:])
+    return jax.tree_util.tree_map(split, batch)
+
+
+class CompiledPipeline:
+    """Run ``stage_fn`` as an S-stage compiled pipeline.
+
+    stage_fn(stage_params, x) -> y must be uniform across stages (the
+    reference's PipelineLayer segments a homogeneous LayerDesc list the
+    same way, pp_layers.py:258). ``stage_params`` leaves carry a leading
+    [S] axis sharded over the ``pp`` mesh axis; embedding/head stay
+    outside the pipeline (replicated), exactly like shared-embedding
+    placement in the reference.
+
+    __call__(params, x) with x micro-batched [M, mb, ...] returns the
+    last stage's outputs [M, mb, ...], replicated across pp.
+    """
+
+    def __init__(self, stage_fn: Callable, mesh: Mesh,
+                 num_microbatches: int, axis: str = "pp",
+                 remat: bool = True):
+        self.stage_fn = stage_fn
+        self.mesh = mesh
+        self.axis = axis
+        self.num_stages = mesh.shape[axis]
+        self.num_microbatches = num_microbatches
+        self.remat = remat
+
+    def __call__(self, stage_params, x):
+        S = self.num_stages
+        M = self.num_microbatches
+        T = M + S - 1
+        axis = self.axis
+        body = self.stage_fn
+        if self.remat:
+            body = jax.checkpoint(body)
+
+        def device_prog(params_local, x_local):
+            # params_local leaves: [1, ...] (this stage's slice)
+            my = jax.tree_util.tree_map(lambda p: p[0], params_local)
+            s = jax.lax.axis_index(axis)
+            buf0 = jnp.zeros_like(x_local[0])
+
+            def tick(buf, t):
+                mb = t - s
+                x_in = jnp.where(s == 0,
+                                 x_local[jnp.clip(t, 0, M - 1)], buf)
+                y = body(my, x_in)
+                # shift to the next stage; the last stage's y falls off
+                # (no wraparound pair (S-1, 0))
+                sent = jax.lax.ppermute(
+                    y, axis, [(i, i + 1) for i in range(S - 1)])
+                valid = (mb >= 0) & (mb < M) & (s == S - 1)
+                out = jnp.where(valid, y, jnp.zeros_like(y))
+                return sent, out
+
+            _, outs = jax.lax.scan(tick, buf0, jnp.arange(T))
+            # last stage: tick t holds micro-batch t-(S-1); other stages
+            # contributed zeros — psum broadcasts the real outputs
+            y = outs[S - 1:]
+            return jax.lax.psum(y, axis)
+
+        spec_p = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+        kwargs = dict(mesh=self.mesh, in_specs=(spec_p, P()),
+                      out_specs=P())
+        try:
+            fn = shard_map(device_prog, check_rep=False, **kwargs)
+        except TypeError:  # jax >= 0.8 renamed the replication check
+            fn = shard_map(device_prog, check_vma=False, **kwargs)
+        return fn(stage_params, x)

@@ -106,7 +106,8 @@ def fill_(x, value):
 
 
 def set_(x, source=None, shape=None, stride=None, offset=0):
-    """Rebind x's storage to ``source`` (reference: manipulation.py set_)."""
+    """Rebind x's storage to ``source``, optionally as a strided window
+    (reference: manipulation.py set_)."""
     from ..core.tensor import to_value
     import jax.numpy as jnp
     if source is None:
@@ -114,7 +115,17 @@ def set_(x, source=None, shape=None, stride=None, offset=0):
     else:
         v = to_value(source if isinstance(source, Tensor)
                      else Tensor(source))
-        if shape is not None:
+        if stride is not None:
+            if shape is None:
+                raise ValueError("set_ with stride requires shape")
+            flat = v.reshape(-1)
+            grids = jnp.meshgrid(*[jnp.arange(s) for s in shape],
+                                 indexing="ij")
+            lin = offset
+            for g, st in zip(grids, stride):
+                lin = lin + g * st
+            v = flat[lin]
+        elif shape is not None:
             v = v.reshape(shape)
         x._value = v
     x._grad_node = None

@@ -610,11 +610,8 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
         out = base.at[..., rows, cols].set(v)
         nd = out.ndim
         d1, d2 = dim1 % nd, dim2 % nd
-        perm = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
         # move the two new trailing axes to (dim1, dim2)
-        order = list(range(nd - 2))
-        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
-        return out
+        return jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
     return dispatch(f, (x,), name="diag_embed")
 
 
@@ -629,8 +626,7 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
         i = jnp.arange(n)
         rows = i + builtins.max(-offset, 0)
         cols = i + builtins.max(offset, 0)
-        src_m = jnp.moveaxis(src, -1, -1) if src.ndim else src
-        out = v_m.at[..., rows, cols].set(src_m.astype(v.dtype))
+        out = v_m.at[..., rows, cols].set(src.astype(v.dtype))
         return jnp.moveaxis(out, (nd - 2, nd - 1), (a1, a2))
     return dispatch(f, (_ensure(x), _ensure(y)), name="diagonal_scatter")
 
@@ -737,7 +733,6 @@ def as_strided(x, shape, stride, offset=0, name=None):
     flattened array)."""
     def f(v):
         flat = v.reshape(-1)
-        idx = jnp.full((), offset, jnp.int32)
         grids = jnp.meshgrid(*[jnp.arange(s) for s in shape],
                              indexing="ij") if shape else []
         lin = offset
@@ -787,37 +782,30 @@ def index_put(x, indices, value, accumulate=False, name=None):
     return dispatch(f, args, name="index_put")
 
 
-def masked_scatter(x, mask, value, name=None):
-    """reference: manipulation.py masked_scatter — fill masked positions
-    with consecutive elements of value."""
-    def f(v, m, src):
-        m = jnp.broadcast_to(m, v.shape)
-        flat_src = src.reshape(-1)
-        # k-th True position takes flat_src[k]
-        order = jnp.cumsum(m.reshape(-1)) - 1
-        gathered = flat_src[jnp.clip(order, 0, flat_src.shape[0] - 1)]
-        return jnp.where(m, gathered.reshape(v.shape).astype(v.dtype), v)
-    return dispatch(f, (_ensure(x), _ensure(mask), _ensure(value)),
-                    name="masked_scatter")
+# (masked_scatter already defined above — reference semantics: fill masked
+# positions with consecutive elements of value)
 
 
 def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
-    """Inplace scalar diagonal fill (reference: manipulation.py
-    fill_diagonal_)."""
+    """Inplace scalar diagonal fill — numpy fill_diagonal semantics
+    (reference: manipulation.py fill_diagonal_). ndim > 2 requires all
+    dims equal and fills the single multi-axis diagonal x[i, i, ..., i];
+    wrap (2-D) restarts the diagonal after each (n+1)-row block."""
     def f(v):
-        if v.ndim == 2 and wrap:
-            m, n = v.shape
-            i = jnp.arange(m)
-            rows = i
-            cols = (i + offset) % n if wrap else i + offset
-            ok = jnp.ones_like(rows, bool) if wrap else \
-                (cols >= 0) & (cols < n)
-            return v.at[rows[ok], cols[ok]].set(value) if not wrap else \
-                v.at[rows, cols].set(value)
-        n = builtins.min(v.shape[-2] - builtins.max(-offset, 0),
-                         v.shape[-1] - builtins.max(offset, 0))
-        i = jnp.arange(n)
-        return v.at[..., i + builtins.max(-offset, 0),
+        if v.ndim > 2:
+            if builtins.len(set(v.shape)) != 1:
+                raise ValueError(
+                    "fill_diagonal_ on ndim>2 requires equal dims")
+            i = jnp.arange(v.shape[0])
+            return v.at[tuple([i] * v.ndim)].set(value)
+        m, n = v.shape
+        if wrap:
+            flat = jnp.arange(0, m * n, n + 1)
+            return v.reshape(-1).at[flat].set(value).reshape(m, n)
+        k = builtins.min(m - builtins.max(-offset, 0),
+                         n - builtins.max(offset, 0))
+        i = jnp.arange(builtins.max(k, 0))
+        return v.at[i + builtins.max(-offset, 0),
                     i + builtins.max(offset, 0)].set(value)
     out = dispatch(f, (_ensure(x),), name="fill_diagonal_")
     x._value, x._grad_node, x._out_index = \

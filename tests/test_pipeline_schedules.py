@@ -154,3 +154,101 @@ def test_zb_linear_input_stop_gradient_still_defers_dw():
     assert lin.weight.grad is not None
     np.testing.assert_allclose(lin.weight.grad.numpy(),
                                np.full((3, 2), 2.0), rtol=1e-6)
+
+
+# -- compiled pipeline (shard_map + scan + ppermute; SURVEY §7 hard part a) --
+class TestCompiledPipeline:
+    def _setup(self, S=4, M=8, D=16, mb=4, seed=0):
+        import jax
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.fleet.pp_compiled import CompiledPipeline
+        mesh = Mesh(np.array(jax.devices()[:S]), ("pp",))
+        rng = np.random.RandomState(seed)
+        W = jnp.asarray(rng.randn(S, 2, D, D) * 0.1, jnp.float32)
+        B = jnp.asarray(rng.randn(S, 2, D) * 0.1, jnp.float32)
+
+        def stage_fn(p, x):
+            w, b = p
+            for i in range(2):
+                x = jnp.tanh(x @ w[i] + b[i])
+            return x
+
+        pipe = CompiledPipeline(stage_fn, mesh, num_microbatches=M)
+        x = jnp.asarray(rng.randn(M, mb, D), jnp.float32)
+        y = jnp.asarray(rng.randn(M, mb, D), jnp.float32)
+        return pipe, stage_fn, mesh, (W, B), x, y, S
+
+    def test_fwd_bwd_matches_sequential(self):
+        import jax
+        pipe, stage_fn, mesh, params, x, y_tgt, S = self._setup()
+
+        def loss_pipe(params, x, y_tgt):
+            return jnp.mean((pipe(params, x) - y_tgt) ** 2)
+
+        def loss_seq(params, x, y_tgt):
+            W, B = params
+
+            def fwd(v):
+                for s in range(S):
+                    v = stage_fn((W[s], B[s]), v)
+                return v
+            return jnp.mean((jax.vmap(fwd)(x) - y_tgt) ** 2)
+
+        with mesh:
+            lp, gp = jax.jit(jax.value_and_grad(loss_pipe))(params, x,
+                                                            y_tgt)
+        ls, gs = jax.jit(jax.value_and_grad(loss_seq))(params, x, y_tgt)
+        assert abs(float(lp) - float(ls)) < 1e-6
+        for a, b in zip(jax.tree_util.tree_leaves(gp),
+                        jax.tree_util.tree_leaves(gs)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+    def test_trains(self):
+        import jax
+        pipe, _, mesh, params, x, y_tgt, _ = self._setup()
+
+        @jax.jit
+        def step(params, x, y_tgt):
+            def loss(p):
+                return jnp.mean((pipe(p, x) - y_tgt) ** 2)
+            l, g = jax.value_and_grad(loss)(params)
+            return l, jax.tree_util.tree_map(lambda p, gg: p - 0.5 * gg,
+                                             params, g)
+
+        with mesh:
+            losses = []
+            for _ in range(5):
+                l, params = step(params, x, y_tgt)
+                losses.append(float(l))
+        assert losses[-1] < losses[0]
+
+    def test_pp_with_dp_axis(self):
+        """pp pipeline composed with a dp axis on a 2x4 mesh."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        from paddle_tpu.distributed.fleet.pp_compiled import CompiledPipeline
+        S, M, D, mb = 4, 4, 8, 8
+        devs = np.array(jax.devices()[:8]).reshape(2, S)
+        mesh = Mesh(devs, ("dp", "pp"))
+        rng = np.random.RandomState(1)
+        W = jnp.asarray(rng.randn(S, 1, D, D) * 0.1, jnp.float32)
+        B = jnp.asarray(rng.randn(S, 1, D) * 0.1, jnp.float32)
+
+        def stage_fn(p, x):
+            w, b = p
+            return jnp.tanh(x @ w[0] + b[0])
+
+        pipe = CompiledPipeline(stage_fn, mesh, num_microbatches=M)
+        x = jnp.asarray(rng.randn(M, mb, D), jnp.float32)
+
+        def fwd_seq(v):
+            for s in range(S):
+                v = stage_fn((W[s], B[s]), v)
+            return v
+
+        with mesh:
+            y = jax.jit(lambda p, x: pipe(p, x))((W, B), x)
+        ref = jax.vmap(fwd_seq)(x)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   atol=1e-5)
