@@ -54,6 +54,10 @@ from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import static  # noqa: F401
+from . import utils  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
+from . import onnx  # noqa: F401
 from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
 from . import inference  # noqa: F401

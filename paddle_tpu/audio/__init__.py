@@ -1,0 +1,3 @@
+"""paddle_tpu.audio (reference: python/paddle/audio)."""
+from . import backends, features, functional  # noqa: F401
+from .backends import load, save, info  # noqa: F401

@@ -1,0 +1,76 @@
+"""Audio IO backends (reference: python/paddle/audio/backends/ —
+wave_backend.py). PCM16/PCM8/float32 WAV via the stdlib wave module; no
+external soundfile dependency."""
+from __future__ import annotations
+
+import wave
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def info(filepath: str):
+    """reference: wave_backend.py info."""
+    with wave.open(filepath, "rb") as f:
+        class AudioInfo:
+            sample_rate = f.getframerate()
+            num_frames = f.getnframes()
+            num_channels = f.getnchannels()
+            bits_per_sample = f.getsampwidth() * 8
+        return AudioInfo()
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[np.ndarray, int]:
+    """reference: wave_backend.py load → (waveform, sample_rate)."""
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    wav = data.T if channels_first else data
+    return wav, sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16) -> None:
+    """reference: wave_backend.py save."""
+    data = np.asarray(getattr(src, "numpy", lambda: src)())
+    if channels_first:
+        data = data.T
+    if data.ndim == 1:
+        data = data[:, None]
+    if data.dtype.kind == "f":
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * (2 ** (bits_per_sample - 1) - 1)).astype(
+            {8: np.uint8, 16: np.int16, 32: np.int32}[bits_per_sample])
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(bits_per_sample // 8)
+        f.setframerate(sample_rate)
+        f.writeframes(data.tobytes())
+
+
+def get_current_backend() -> str:
+    return "wave_backend"
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            "only the stdlib wave backend is available (soundfile is not "
+            "installed in this environment)")

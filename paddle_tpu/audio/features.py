@@ -1,0 +1,116 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py:
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC).
+
+STFT is framed matmul-friendly jnp: frame -> window -> rfft; everything
+compiles into the surrounding model under jit.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..nn import Layer
+from ..core.tensor import Tensor, dispatch
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft_power(v, n_fft, hop, win, center, power):
+    if center:
+        pad = n_fft // 2
+        v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                    mode="reflect")
+    n_frames = 1 + (v.shape[-1] - n_fft) // hop
+    idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
+    frames = v[..., idx] * win            # [..., T, n_fft]
+    spec = jnp.fft.rfft(frames, axis=-1)  # [..., T, F]
+    mag = jnp.abs(spec) ** power
+    return jnp.swapaxes(mag, -1, -2)      # [..., F, T] paddle layout
+
+
+class Spectrogram(Layer):
+    """reference: audio/features/layers.py Spectrogram."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        win = AF.get_window(window, self.win_length)
+        if self.win_length < n_fft:   # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            win = jnp.pad(win, (lpad, n_fft - self.win_length - lpad))
+        self._window = win
+
+    def forward(self, x):
+        n_fft, hop, win = self.n_fft, self.hop_length, self._window
+        center, power = self.center, self.power
+        return dispatch(
+            lambda v: _stft_power(v, n_fft, hop, win, center, power),
+            (x if isinstance(x, Tensor) else Tensor(x),),
+            name="spectrogram")
+
+
+class MelSpectrogram(Layer):
+    """reference: audio/features/layers.py MelSpectrogram."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 n_mels: int = 64, f_min: float = 50.0,
+                 f_max: Optional[float] = None, htk: bool = False,
+                 norm: str = "slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center)
+        self._fbank = AF.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+        fb = self._fbank
+        return dispatch(lambda s: jnp.einsum("mf,...ft->...mt", fb, s),
+                        (spec,), name="mel_spectrogram")
+
+
+class LogMelSpectrogram(Layer):
+    """reference: audio/features/layers.py LogMelSpectrogram."""
+
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 **mel_kwargs):
+        super().__init__()
+        self._mel = MelSpectrogram(sr=sr, **mel_kwargs)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        mel = self._mel(x)
+        rv, am, td = self.ref_value, self.amin, self.top_db
+        return dispatch(lambda m: AF.power_to_db(m, rv, am, td), (mel,),
+                        name="log_mel_spectrogram")
+
+
+class MFCC(Layer):
+    """reference: audio/features/layers.py MFCC."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40,
+                 norm: str = "ortho", **logmel_kwargs):
+        super().__init__()
+        n_mels = logmel_kwargs.get("n_mels", 64)
+        self._logmel = LogMelSpectrogram(sr=sr, **logmel_kwargs)
+        self._dct = AF.create_dct(n_mfcc, n_mels, norm)
+
+    def forward(self, x):
+        logmel = self._logmel(x)
+        dct = self._dct
+        return dispatch(lambda m: jnp.einsum("mk,...mt->...kt", dct, m),
+                        (logmel,), name="mfcc")

@@ -1,0 +1,118 @@
+"""Audio DSP functionals (reference: python/paddle/audio/functional/).
+
+All pure jnp — window/filterbank construction happens on host at layer
+build time; the STFT/mel/dct pipeline compiles into the model graph.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """reference: audio/functional/functional.py hz_to_mel."""
+    freq = jnp.asarray(freq, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + freq / 700.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(freq >= min_log_hz,
+                     min_log_mel + jnp.log(freq / min_log_hz) / logstep,
+                     mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    mel = jnp.asarray(mel, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)),
+                     freqs)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    return mel_to_hz(jnp.linspace(lo, hi, n_mels), htk)
+
+
+def fft_frequencies(sr: int, n_fft: int):
+    return jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney"):
+    """[n_mels, 1 + n_fft//2] mel filterbank (reference:
+    audio/functional/functional.py compute_fbank_matrix)."""
+    f_max = f_max if f_max is not None else float(sr) / 2
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return weights
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """reference: audio/functional/functional.py power_to_db."""
+    x = jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho"):
+    """[n_mels, n_mfcc] DCT-II matrix (reference: create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * jnp.where(k == 0, 1.0 / math.sqrt(n_mels),
+                              math.sqrt(2.0 / n_mels))
+    else:
+        dct = dct * 2.0
+    return dct
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """hann/hamming/blackman/boxcar windows (reference: window.py)."""
+    n = win_length
+    m = n if fftbins else n - 1
+    i = np.arange(n, dtype=np.float32)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * i / m)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * i / m)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * i / m)
+             + 0.08 * np.cos(4 * np.pi * i / m))
+    elif window in ("boxcar", "rect", "rectangular"):
+        w = np.ones(n, np.float32)
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return jnp.asarray(w, jnp.float32)

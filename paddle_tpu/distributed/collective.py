@@ -107,13 +107,26 @@ def _reject_eager_subgroup(group, opname):
             "world group (group=None)")
 
 
+_world_state = {"mesh": None, "gather": None}
+
+
 def _world_stacked(v):
     """Each process contributes its local ``v``; returns the replicated
     [world, ...] stack (one cross-process all-gather). The communication
-    layer of every eager collective in multi-process mode."""
+    layer of every eager collective in multi-process mode. The mesh and
+    the jitted gather are built once per process (the device set is
+    fixed), so repeated calls — one per gradient in a DP loop — hit the
+    jit cache instead of retracing."""
     from jax.sharding import Mesh
-    devs = np.array(jax.devices())
-    mesh = Mesh(devs, ("world",))
+    if _world_state["mesh"] is None:
+        _world_state["mesh"] = Mesh(np.array(jax.devices()), ("world",))
+
+        def _identity(a):
+            return a
+        _world_state["gather"] = jax.jit(
+            _identity,
+            out_shardings=NamedSharding(_world_state["mesh"], P()))
+    mesh = _world_state["mesh"]
     local = np.asarray(v)[None]
     if jax.local_device_count() > 1:
         # one contribution per local device (all identical)
@@ -121,8 +134,7 @@ def _world_stacked(v):
                                 + local.shape[1:])
     arr = jax.make_array_from_process_local_data(
         NamedSharding(mesh, P("world")), local)
-    out = jax.jit(lambda a: a,
-                  out_shardings=NamedSharding(mesh, P()))(arr)
+    out = _world_state["gather"](arr)
     stacked = jnp.asarray(out.addressable_data(0))
     if jax.local_device_count() > 1:
         stacked = stacked[::jax.local_device_count()]
@@ -264,6 +276,8 @@ def broadcast_object_list(object_list, src=0, group=None):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _axis(group)
     v = to_value(tensor)
+    if not _in_trace(v):
+        _reject_eager_subgroup(group, "scatter")
     if _multiprocess() and group is None and not _in_trace(v):
         # every rank must join the collective — non-src ranks pass
         # tensor_list=None in the paddle convention, so they contribute
@@ -298,6 +312,17 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         for i in range(out.shape[0]):
             out_tensor_list.append(Tensor(out[i]))
         return _Task(out)
+    if vals and not _in_trace(vals[0]):
+        _reject_eager_subgroup(group, "all_to_all")
+    if _multiprocess() and group is None and vals:
+        # rank r's output j is rank j's input r: one world gather of the
+        # stacked inputs, then index [j, my_rank]
+        all_in = _world_stacked(jnp.stack(vals))   # [world, world, ...]
+        r = jax.process_index()
+        out_tensor_list.clear()
+        for j in range(all_in.shape[0]):
+            out_tensor_list.append(Tensor(all_in[j, r]))
+        return _Task(all_in)
     out_tensor_list.clear()
     out_tensor_list.extend([Tensor(v) for v in vals])
     return _Task(None)

@@ -1,0 +1,134 @@
+"""Megatron-style sequence-parallel layers.
+
+TPU-native re-design of reference
+python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+(ColumnSequenceParallelLinear:429, RowSequenceParallelLinear:564,
+ScatterOp/GatherOp, register_sequence_parallel_allreduce_hooks:192).
+
+Reference semantics: activations between TP blocks are sharded on the
+SEQUENCE dim across the mp group; the Column linear all-gathers the
+sequence before its matmul, the Row linear reduce-scatters after — the
+allreduce of plain TP is split into all-gather + reduce-scatter, halving
+peak activation memory.
+
+Here the same dataflow is expressed as sharding constraints: inputs are
+constrained seq-sharded over ``mp``, the matmul inputs/outputs get the
+gathered / seq-sharded specs, and GSPMD inserts exactly the all-gather /
+reduce-scatter pair (XLA's partitioner performs the same allreduce
+split). The explicit classes exist for reference API parity; under the
+semi-auto Trainer the same layout falls out of the sp axis specs.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor, dispatch
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+from .mp_layers import _mp_mesh, _put, _constraint
+
+__all__ = ["ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "ScatterOp", "GatherOp", "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+class ScatterOp:
+    """Split activations along seq across mp (reference: ScatterOp
+    PyLayer). [s, b, h] -> seq-sharded."""
+
+    @staticmethod
+    def apply(x):
+        return dispatch(lambda v: _constraint(v, P("mp", None, None)),
+                        (x,), name="sp_scatter")
+
+
+class GatherOp:
+    """Re-gather seq-sharded activations (reference: GatherOp)."""
+
+    @staticmethod
+    def apply(x):
+        return dispatch(lambda v: _constraint(v, P(None, None, None)),
+                        (x,), name="sp_gather")
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """reference: sequence_parallel_utils.py:429 — input [s/mp, b, in]
+    (seq-sharded), weight [in, out/mp]; all-gather(seq) then matmul."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        _put(self.weight, P(None, "mp"))
+        self.bias = self.create_parameter(
+            [out_features], attr=None, is_bias=True) if has_bias else None
+        if self.bias is not None:
+            _put(self.bias, P("mp"))
+        self.gather_output = gather_output
+
+    def forward(self, x):
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None
+                                   else ())
+
+        def f(v, w, *b):
+            # in: seq-sharded; gather seq for the matmul (GSPMD inserts
+            # the all-gather), keep out column-sharded over mp
+            v = _constraint(v, P("mp", None, None))
+            v = _constraint(v, P(None, None, None))
+            out = v @ w
+            if b:
+                out = out + b[0]
+            return _constraint(out, P(None, None, "mp"))
+        return dispatch(f, args, name="column_sequence_parallel_linear")
+
+
+class RowSequenceParallelLinear(Layer):
+    """reference: sequence_parallel_utils.py:564 — weight [in/mp, out];
+    matmul then reduce-scatter onto the seq dim."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        _put(self.weight, P("mp", None))
+        self.bias = self.create_parameter(
+            [out_features], attr=None, is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        args = (x, self.weight) + ((self.bias,) if self.bias is not None
+                                   else ())
+
+        def f(v, w, *b):
+            v = _constraint(v, P(None, None, "mp"))
+            out = v @ w            # partial sums over mp
+            # reduce-scatter: output seq-sharded over mp (GSPMD lowers
+            # the psum+scatter pair)
+            out = _constraint(out, P("mp", None, None))
+            if b:
+                out = out + b[0]
+            return out
+        return dispatch(f, args, name="row_sequence_parallel_linear")
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """reference: sequence_parallel_utils.py — tag params (norms) whose
+    grads need the mp allreduce under SP."""
+    param._sequence_parallel = True
+    return param
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse=False):
+    """reference: sequence_parallel_utils.py:192. Under GSPMD the grad
+    allreduce for sequence-parallel params is inserted by the partitioner
+    (their sharding is replicated over mp while activations are
+    seq-sharded), so the hook registration is a no-op kept for API
+    parity."""
+    return model

@@ -172,7 +172,10 @@ class Controller:
     def watch(self) -> int:
         """Run until all local procs exit. Returns exit code. On a local
         proc failure (or stale peer heartbeat) kills the pod; with
-        elastic_retries left, respawns with a new job id."""
+        elastic_retries left, respawns with a new job id — and when
+        elastic membership is enabled (PADDLE_ELASTIC_MIN/MAX), resolves
+        the surviving node set first and re-ranks this node (reference:
+        fleet/elastic/manager.py scale-in/out + re-rank)."""
         retries = self.spec.elastic_retries
         while True:
             code = self._watch_once()
@@ -182,11 +185,42 @@ class Controller:
                 return code
             retries -= 1
             self._job_id[0] += 1
+            self._elastic_resolve()
             sys.stderr.write(
                 f"[launch] pod failed (exit {code}); elastic restart "
-                f"{self._job_id[0]} ({retries} retries left)\n")
+                f"{self._job_id[0]} ({retries} retries left, "
+                f"node_rank={self.spec.node_rank}/"
+                f"{self.spec.nnodes})\n")
             self._kill_all()
             self._spawn_all()
+
+    def _elastic_resolve(self):
+        """Re-resolve membership/rank from the store when scale bounds
+        are configured; a scale-in/out changes nnodes + node_rank for the
+        next incarnation (trainer state absorbs it via checkpoint
+        reshard-on-load)."""
+        lo = os.environ.get("PADDLE_ELASTIC_MIN")
+        if lo is None or self.store is None:
+            return
+        from .elastic import ElasticManager
+        if getattr(self, "_elastic", None) is None:
+            self._elastic = ElasticManager(
+                self.store,
+                node_id=f"{self.spec.node_rank:06d}-init",
+                min_nodes=int(lo),
+                max_nodes=int(os.environ.get("PADDLE_ELASTIC_MAX", "0")))
+            self._elastic.register()
+        try:
+            nnodes, rank = self._elastic.resolve()
+            if (nnodes, rank) != (self.spec.nnodes, self.spec.node_rank):
+                sys.stderr.write(
+                    f"[launch] elastic re-rank: nodes {self.spec.nnodes}"
+                    f"->{nnodes}, node_rank {self.spec.node_rank}->"
+                    f"{rank}\n")
+            self.spec.nnodes = nnodes
+            self.spec.node_rank = rank
+        except TimeoutError as e:
+            sys.stderr.write(f"[launch] elastic resolve failed: {e}\n")
 
     def _watch_once(self) -> int:
         last_hb = 0.0

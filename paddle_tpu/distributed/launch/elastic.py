@@ -1,0 +1,108 @@
+"""Elastic membership + re-rank over the TCPStore.
+
+TPU-native analog of the reference ElasticManager
+(python/paddle/distributed/fleet/elastic/manager.py:126): the reference
+keeps an etcd registry with heartbeat leases, watches for node
+join/leave, and re-ranks survivors by hostname order before relaunch.
+Here the launcher's TCPStore is the registry (no etcd dependency):
+
+- every node writes ``elastic/node/<node_id>`` with a heartbeat
+  timestamp; a node whose heartbeat goes stale has left (scale-in), a
+  new key is a join (scale-out);
+- membership is the sorted list of live node ids — deterministic
+  ``node_id``-ordered re-rank, the exact analog of the reference's
+  hostname-ordered ``_match`` / rank reassignment;
+- ``resolve()`` returns (nnodes, node_rank) for the next incarnation;
+  the launcher respawns its trainers with the new world spec
+  (PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID change across restarts, and
+  checkpoint reshard-on-load absorbs the topology change).
+
+Scale bounds mirror the reference's ``--np N:M`` contract: a membership
+outside [min_nodes, max_nodes] keeps waiting instead of relaunching.
+"""
+from __future__ import annotations
+
+import socket
+import time
+import uuid
+from typing import List, Optional, Tuple
+
+HEARTBEAT_TTL = 30.0
+
+
+class ElasticManager:
+    def __init__(self, store, node_id: Optional[str] = None,
+                 min_nodes: int = 1, max_nodes: int = 0,
+                 heartbeat_ttl: float = HEARTBEAT_TTL):
+        self.store = store
+        self.node_id = node_id or f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
+        self.min_nodes = min_nodes
+        self.max_nodes = max_nodes or 10 ** 9
+        self.ttl = heartbeat_ttl
+        self._last_membership: List[str] = []
+
+    # -- registry ------------------------------------------------------------
+    def register(self):
+        self.heartbeat()
+        return self.node_id
+
+    def heartbeat(self):
+        self.store.set(f"elastic/node/{self.node_id}", str(time.time()))
+
+    def leave(self):
+        self.store.set(f"elastic/node/{self.node_id}", "0")
+
+    def membership(self) -> List[str]:
+        """Live node ids (fresh heartbeat), sorted — the rank order."""
+        ids = []
+        now = time.time()
+        for key in self._list_nodes():
+            val = self.store.get(f"elastic/node/{key}")
+            try:
+                ts = float(val)
+            except (TypeError, ValueError):
+                continue
+            if now - ts <= self.ttl:
+                ids.append(key)
+        return sorted(ids)
+
+    def _list_nodes(self) -> List[str]:
+        if hasattr(self.store, "list_keys"):
+            keys = self.store.list_keys("elastic/node/")
+        else:
+            keys = [k for k in getattr(self.store, "keys", lambda: [])()
+                    if k.startswith("elastic/node/")]
+        return [k.split("/", 2)[2] for k in keys]
+
+    # -- scale detection + re-rank ------------------------------------------
+    def changed(self) -> bool:
+        return self.membership() != self._last_membership
+
+    def resolve(self, timeout: float = 120.0) -> Tuple[int, int]:
+        """Wait for a stable in-bounds membership; returns
+        (nnodes, node_rank) with ranks assigned by sorted node id
+        (reference: manager.py hostname-ordered re-rank)."""
+        deadline = time.time() + timeout
+        while True:
+            self.heartbeat()
+            live = self.membership()
+            if self.min_nodes <= len(live) <= self.max_nodes \
+                    and self.node_id in live:
+                # require two consecutive identical views (settled)
+                time.sleep(0.2)
+                if self.membership() == live:
+                    self._last_membership = live
+                    return len(live), live.index(self.node_id)
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"elastic membership did not settle in bounds "
+                    f"[{self.min_nodes}, {self.max_nodes}]: {live}")
+            time.sleep(1.0)
+
+    def scale_event(self) -> Optional[str]:
+        """None | 'scale_in' | 'scale_out' vs the last resolved view."""
+        live = self.membership()
+        if live == self._last_membership:
+            return None
+        return ("scale_in" if len(live) < len(self._last_membership)
+                else "scale_out")

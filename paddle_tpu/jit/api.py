@@ -189,6 +189,8 @@ class TracedFunction:
             entry = (flat_fn, out_tree)
             self._cache[key] = entry
         flat_fn, out_tree = entry
+        if flat_fn == "eager":
+            return self._fn(*args, **kwargs)
         tensor_in = [to_value(in_leaves[i]) if isinstance(in_leaves[i], Tensor)
                      else jnp.asarray(in_leaves[i]) for i in tensor_leaf_idx]
         rng = next_key()
@@ -196,8 +198,25 @@ class TracedFunction:
             Tensor(rng),) + tuple(
             in_leaves[i] if isinstance(in_leaves[i], Tensor) else
             Tensor(jnp.asarray(in_leaves[i])) for i in tensor_leaf_idx)
-        outs = dispatch(flat_fn, all_args, name="to_static",
-                        multi_output=True)
+        try:
+            outs = dispatch(flat_fn, all_args, name="to_static",
+                            multi_output=True)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError) as e:
+            # graph break: tensor-dependent Python control flow cannot be
+            # traced — fall back to eager for this signature, like the
+            # reference SOT's guard-failure fallback
+            # (python/paddle/jit/sot/translate.py graph break semantics)
+            import warnings
+            warnings.warn(
+                f"to_static: graph break ({type(e).__name__}) — falling "
+                "back to eager execution for this call signature. Use "
+                "paddle.where/lax.cond-style ops to keep the graph whole.",
+                stacklevel=2)
+            self._cache[key] = ("eager", out_tree)
+            return self._fn(*args, **kwargs)
         n_buf = len(self._buffers)
         out_vals = outs[:len(outs) - n_buf]
         new_buf = outs[len(outs) - n_buf:]

@@ -1,0 +1,34 @@
+"""paddle_tpu.onnx (reference: python/paddle/onnx/export.py, which shells
+out to paddle2onnx).
+
+This environment ships no ``onnx``/converter package, so true .onnx
+serialization is gated; ``export`` still produces a portable serialized
+model — the StableHLO program + weights that ``paddle.jit.save`` emits
+(StableHLO is the interchange format of the XLA ecosystem, playing the
+role .onnx plays for the reference's deployment path).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """reference: python/paddle/onnx/export.py export."""
+    try:
+        import onnx  # noqa: F401
+        raise NotImplementedError(
+            "onnx is importable but no StableHLO->ONNX converter is "
+            "bundled; use the StableHLO artifact from paddle.jit.save "
+            "for deployment")
+    except ImportError:
+        pass
+    from ..jit import save as jit_save
+    jit_save(layer, path, input_spec=input_spec)
+    import warnings
+    warnings.warn(
+        f"onnx package unavailable — exported StableHLO + weights to "
+        f"{path}* instead (loadable via paddle.jit.load / any StableHLO "
+        "runtime)", stacklevel=2)
+    return path

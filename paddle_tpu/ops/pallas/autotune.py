@@ -1,0 +1,125 @@
+"""Kernel autotune: runtime config selection + persistent cache.
+
+TPU-native analog of the reference's kernel autotuner
+(paddle/phi/kernels/autotune/auto_tune_base.h + cache.h +
+switch_autotune.cc): a kernel exposes candidate configs (Pallas block
+sizes); the first execution of a given shape-key times each candidate on
+the real device and caches the winner — in memory and on disk
+(~/.cache/paddle_tpu/autotune.json), so later processes skip the sweep.
+
+Off by default (FLAGS_kernel_autotune / env FLAGS_kernel_autotune=1):
+each sweep costs one compile per candidate. Disabled automatically in
+Pallas interpret mode (CPU tests) where timings are meaningless.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+
+from ...core.flags import GLOBAL_FLAGS
+from ._util import interpret_mode
+
+GLOBAL_FLAGS.define("kernel_autotune", False,
+                    "sweep Pallas kernel configs per shape and cache the "
+                    "fastest (reference: phi/kernels/autotune)")
+
+_CACHE_PATH = os.path.join(
+    os.path.expanduser(os.environ.get("PADDLE_TPU_CACHE_DIR",
+                                      "~/.cache/paddle_tpu")),
+    "autotune.json")
+
+
+class AutotuneCache:
+    def __init__(self, path: str = _CACHE_PATH):
+        self._path = path
+        self._mem: Dict[str, Any] = {}
+        self._loaded = False
+        self._lock = threading.Lock()
+
+    def _load(self):
+        if self._loaded:
+            return
+        self._loaded = True
+        try:
+            with open(self._path) as f:
+                self._mem.update(json.load(f))
+        except (OSError, ValueError):
+            pass
+
+    def get(self, key: str):
+        with self._lock:
+            self._load()
+            return self._mem.get(key)
+
+    def put(self, key: str, value):
+        with self._lock:
+            self._load()
+            self._mem[key] = value
+            try:
+                os.makedirs(os.path.dirname(self._path), exist_ok=True)
+                tmp = self._path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(self._mem, f)
+                os.replace(tmp, self._path)
+            except OSError:
+                pass  # disk cache is best-effort
+
+
+_cache = AutotuneCache()
+
+
+def _sync(x):
+    """Host-transfer sync (block_until_ready alone does not synchronize
+    through the axon tunnel)."""
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    np.asarray(jax.device_get(leaf)).ravel()[:1]
+
+
+def autotune(op_name: str, key: Tuple, candidates: Sequence[Any],
+             build: Callable[[Any], Callable], args: Tuple,
+             warmup: int = 1, iters: int = 3):
+    """Pick the fastest candidate config for (op_name, key).
+
+    ``build(config) -> fn``; fn(*args) is timed. Returns the winning
+    config. With autotune disabled (or in interpret mode) returns
+    ``candidates[0]`` without sweeping.
+    """
+    if not candidates:
+        raise ValueError("no candidate configs")
+    if len(candidates) == 1 or interpret_mode() or \
+            not GLOBAL_FLAGS.get("kernel_autotune"):
+        return candidates[0]
+    ck = f"{op_name}|{key}"
+    hit = _cache.get(ck)
+    if hit is not None:
+        # stored as index into the candidate list (configs are static)
+        idx = int(hit)
+        if 0 <= idx < len(candidates):
+            return candidates[idx]
+    best_i, best_t = 0, float("inf")
+    for i, cfg in enumerate(candidates):
+        try:
+            fn = build(cfg)
+            for _ in range(warmup):
+                _sync(fn(*args))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            _sync(out)
+            dt = (time.perf_counter() - t0) / iters
+        except Exception:
+            continue  # config invalid for this shape — skip
+        if dt < best_t:
+            best_i, best_t = i, dt
+    if best_t == float("inf"):
+        # every candidate failed (bad shapes / transient OOM): fall back
+        # to the default WITHOUT poisoning the persistent cache
+        return candidates[0]
+    _cache.put(ck, best_i)
+    return candidates[best_i]

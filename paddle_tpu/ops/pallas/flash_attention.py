@@ -48,7 +48,9 @@ from ._util import interpret_mode as _interpret, no_x64
 DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
 
-def _block_sizes(sq, sk):
+def _block_sizes(sq, sk, override=None):
+    if override is not None:
+        return min(override[0], sq), min(override[1], sk)
     bq = min(512, sq)
     bk = min(512, sk)
     return bq, bk
@@ -186,8 +188,9 @@ def _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta):
     meta = (h, kvh, bias_b, bias_h, bias_grad) — static geometry."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    bq, bk = _block_sizes(sq, sk)
-    h, kvh, bias_b, bias_h, _ = meta
+    h, kvh, bias_b, bias_h, _, blocks = (meta if len(meta) == 6
+                                         else meta + (None,))
+    bq, bk = _block_sizes(sq, sk, blocks)
     off = sk - sq
     grid = (bh, pl.cdiv(sq, bq), pl.cdiv(sk, bk))
     has_bias, has_seg = bias is not None, seg_q is not None
@@ -374,8 +377,9 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, groups, has_seg,
 def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
     bh, sq, d = q.shape
     bkvh, sk, _ = k.shape
-    bq, bk = _block_sizes(sq, sk)
-    h, kvh, bias_b, bias_h, bias_grad = meta
+    h, kvh, bias_b, bias_h, bias_grad, blocks = (meta if len(meta) == 6
+                                                 else meta + (None,))
+    bq, bk = _block_sizes(sq, sk, blocks)
     off = sk - sq
     groups = h // kvh
     has_bias, has_seg = bias is not None, seg_q is not None
@@ -531,7 +535,7 @@ def _flash_bwd_rule(scale, causal, meta, res, do):
     if dbias_full is not None:
         dbias = dbias_full
         bh = q.shape[0]
-        h, kvh, bias_b, bias_h, _ = meta
+        h, kvh, bias_b, bias_h = meta[0], meta[1], meta[2], meta[3]
         b = bh // h
         dbias = dbias.reshape(b, h, q.shape[1], k.shape[1])
         if bias_h == 1:
@@ -582,6 +586,44 @@ def flash_attention_pallas(q, k, v, causal=False, scale=None, bias=None,
             else segment_ids
         seg_k_arg = jnp.asarray(kv_seg, jnp.int32).reshape(b, 1, sk)
 
-    meta = (h, kvh, bias_b, bias_h, bool(bias_grad))
+    blocks = _tuned_blocks(qt, kt, vt, bias_arg, seg_q_arg, seg_k_arg,
+                           s, causal, (h, kvh, bias_b, bias_h))
+    meta = (h, kvh, bias_b, bias_h, bool(bias_grad), blocks)
     o = _flash(qt, kt, vt, bias_arg, seg_q_arg, seg_k_arg, s, causal, meta)
     return jnp.swapaxes(o.reshape(b, h, sq, d), 1, 2)
+
+
+_BLOCK_CANDIDATES = ((512, 512), (256, 512), (512, 256), (1024, 512),
+                     (256, 1024))
+
+
+def _tuned_blocks(qt, kt, vt, bias_arg, seg_q, seg_k, s, causal, geom):
+    """Autotuned (bq, bk) for this shape (reference:
+    phi/kernels/autotune/auto_tune_base.h). Eager calls with
+    FLAGS_kernel_autotune sweep the candidates; traced calls reuse the
+    persistent cache (tuning cannot run while tracing)."""
+    from .autotune import autotune, _cache, GLOBAL_FLAGS, interpret_mode
+    bh, sq, d = qt.shape
+    sk = kt.shape[1]
+    if sq < 1024 and sk < 1024:
+        return None  # single/double block — nothing to tune
+    key = (bh, sq, sk, kt.shape[0], d, causal, str(qt.dtype),
+           bias_arg is not None, seg_q is not None)
+    ck = f"flash_attention|{key}"
+    if isinstance(qt, jax.core.Tracer) or interpret_mode() or             not GLOBAL_FLAGS.get("kernel_autotune"):
+        hit = _cache.get(ck) if GLOBAL_FLAGS.get("kernel_autotune") else None
+        if hit is not None and 0 <= int(hit) < len(_BLOCK_CANDIDATES):
+            return _BLOCK_CANDIDATES[int(hit)]
+        return None
+
+    def build(cfg):
+        meta = geom + (False, cfg)
+
+        def run(q_, k_, v_):
+            o, _ = _fwd(q_, k_, v_, bias_arg, seg_q, seg_k, s, causal,
+                        meta)
+            return o
+        return run
+
+    return autotune("flash_attention", key, list(_BLOCK_CANDIDATES),
+                    build, (qt, kt, vt))

@@ -160,12 +160,47 @@ class Optimizer:
     def _apply(self, params_grads):
         """Apply ALL parameter updates (and grad clip) in one jitted,
         donated XLA program — the TPU analog of the reference's fused
-        multi_tensor optimizer kernels."""
+        multi_tensor optimizer kernels. Parameters living on different
+        devices (eager pipeline stages) fuse per device group."""
         params_grads = [(p, g) for p, g in params_grads if g is not None]
         if not params_grads:
             self._post_apply()
             return
-        clip = self._clip_mode()
+        groups = {}
+        for p, g in params_grads:
+            v = to_value(p)
+            key = tuple(sorted(d.id for d in getattr(v, "devices",
+                                                     lambda: [])())) \
+                if hasattr(v, "devices") else ()
+            groups.setdefault(key, []).append((p, g))
+        if len(groups) > 1:
+            # global-norm (and custom) clipping couples ALL grads — apply
+            # it eagerly across groups first, then update per group
+            clip = self._clip_mode()
+            if clip is not None and clip[0] in ("global", "eager"):
+                params_grads = [(p, g)
+                                for p, g in self._grad_clip(params_grads)
+                                if g is not None]
+                groups = {}
+                for p, g in params_grads:
+                    v = to_value(p)
+                    key = tuple(sorted(
+                        d.id for d in getattr(v, "devices",
+                                              lambda: [])())) \
+                        if hasattr(v, "devices") else ()
+                    groups.setdefault(key, []).append((p, g))
+                for pg in groups.values():
+                    self._apply_group(pg, clip_override=False)
+            else:
+                for pg in groups.values():
+                    self._apply_group(pg)
+            self._post_apply()
+            return
+        self._apply_group(params_grads)
+        self._post_apply()
+
+    def _apply_group(self, params_grads, clip_override=None):
+        clip = self._clip_mode() if clip_override is None else None
         if clip is not None and clip[0] == "eager":
             params_grads = [(p, g) for p, g in clip[1](params_grads)
                             if g is not None]
@@ -198,7 +233,6 @@ class Optimizer:
             if has_master[i]:
                 self._accumulators["master_weight"][id(p)] = new_masters[mi]
                 mi += 1
-        self._post_apply()
 
     def _post_apply(self):
         pass

@@ -118,13 +118,8 @@ def set_(x, source=None, shape=None, stride=None, offset=0):
         if stride is not None:
             if shape is None:
                 raise ValueError("set_ with stride requires shape")
-            flat = v.reshape(-1)
-            grids = jnp.meshgrid(*[jnp.arange(s) for s in shape],
-                                 indexing="ij")
-            lin = offset
-            for g, st in zip(grids, stride):
-                lin = lin + g * st
-            v = flat[lin]
+            from .manipulation import as_strided
+            v = to_value(as_strided(Tensor(v), shape, stride, offset))
         elif shape is not None:
             v = v.reshape(shape)
         x._value = v

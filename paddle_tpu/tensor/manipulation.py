@@ -319,11 +319,15 @@ def masked_fill(x, mask, value, name=None):
 
 
 def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions with consecutive elements of ``value``
+    (reference: manipulation.py masked_scatter; mask broadcasts to x)."""
     def f(v, m, u):
+        m = jnp.broadcast_to(m, v.shape)
         flat_m = m.reshape(-1)
         cnt = jnp.cumsum(flat_m) - 1
         gathered = u.reshape(-1)[jnp.clip(cnt, 0, u.size - 1)]
-        return jnp.where(flat_m, gathered, v.reshape(-1)).reshape(v.shape)
+        return jnp.where(flat_m, gathered.astype(v.dtype),
+                         v.reshape(-1)).reshape(v.shape)
     return dispatch(f, (x, _ensure(mask), _ensure(value)),
                     name="masked_scatter")
 

@@ -1,0 +1,11 @@
+"""paddle_tpu.text (reference: python/paddle/text/__init__.py).
+
+The reference module = NLP datasets (download-backed) + ViterbiDecoder.
+The decoder is implemented natively (lax.scan over time steps); datasets
+are the same API surface but require local files (this environment has no
+egress — pass ``data_file`` explicitly).
+"""
+from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
+from .datasets import Imdb, UCIHousing  # noqa: F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "UCIHousing"]

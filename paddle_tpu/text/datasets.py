@@ -1,0 +1,81 @@
+"""Text datasets (reference: python/paddle/text/datasets/). The reference
+downloads corpora at first use; this environment has no egress, so the
+datasets take explicit local ``data_file`` paths and otherwise raise with
+instructions. The Dataset protocol (len/getitem) matches the reference."""
+from __future__ import annotations
+
+import os
+import tarfile
+from typing import Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "UCIHousing"]
+
+
+class UCIHousing(Dataset):
+    """reference: text/datasets/uci_housing.py — 13 features + price."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train"):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "UCIHousing needs a local copy of housing.data "
+                "(no download in this environment); pass data_file=")
+        raw = np.loadtxt(data_file).astype(np.float32)
+        feats, target = raw[:, :-1], raw[:, -1:]
+        feats = (feats - feats.mean(0)) / (feats.std(0) + 1e-8)
+        split = int(len(raw) * 0.8)
+        if mode == "train":
+            self.data = list(zip(feats[:split], target[:split]))
+        else:
+            self.data = list(zip(feats[split:], target[split:]))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class Imdb(Dataset):
+    """reference: text/datasets/imdb.py — sentiment classification over
+    the aclImdb tarball."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "Imdb needs a local aclImdb_v1.tar.gz "
+                "(no download in this environment); pass data_file=")
+        self.docs, self.labels = [], []
+        import re
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        freq = {}
+        texts = []
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                g = pat.match(m.name)
+                if not g:
+                    continue
+                txt = tf.extractfile(m).read().decode(
+                    "utf-8", "ignore").lower().split()
+                texts.append((txt, 0 if g.group(1) == "pos" else 1))
+                for w in txt:
+                    freq[w] = freq.get(w, 0) + 1
+        words = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))]
+        self.word_idx = {w: i for i, w in enumerate(words[:cutoff])}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        for txt, lab in texts:
+            self.docs.append(np.asarray(
+                [self.word_idx.get(w, unk) for w in txt], np.int64))
+            self.labels.append(lab)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], int(self.labels[i])

@@ -1,0 +1,71 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py →
+phi viterbi_decode kernel): max-score path through a CRF transition
+matrix, as a lax.scan over time steps."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch
+from ..nn import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """potentials [B, T, N], transition [N, N], lengths [B] →
+    (scores [B], paths [B, T])."""
+    def f(emit, trans, lens):
+        B, T, N = emit.shape
+        # tags N-2/N-1 are BOS/EOS (reference convention): the first
+        # step transitions out of BOS, the last into EOS
+        alpha0 = emit[:, 0] + (trans[N - 2] if include_bos_eos_tag
+                               else 0.0)
+
+        def step(carry, t):
+            alpha, hist_dummy = carry
+            scores = alpha[:, :, None] + trans[None]    # [B, N, N]
+            best_prev = jnp.argmax(scores, axis=1)      # [B, N]
+            best_score = jnp.max(scores, axis=1) + emit[:, t]
+            keep = (t < lens)[:, None]
+            alpha_new = jnp.where(keep, best_score, alpha)
+            return (alpha_new, 0), jnp.where(keep, best_prev,
+                                             jnp.arange(N)[None])
+
+        (alpha, _), history = jax.lax.scan(
+            step, (alpha0, 0), jnp.arange(1, T))
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, N - 1][None]
+        last_tag = jnp.argmax(alpha, axis=-1)           # [B]
+        score = jnp.max(alpha, axis=-1)
+
+        # backtrace: history[i] maps step-(i+1) tags to their best
+        # predecessor at step i, so emitting `prev` yields tags[0..T-2]
+        def back(tag, bp):
+            prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+            return prev, prev
+
+        init = last_tag
+        _, path_rev = jax.lax.scan(back, init, history, reverse=True)
+        paths = jnp.concatenate([path_rev, init[None]], axis=0)  # [T, B]
+        return score, jnp.swapaxes(paths, 0, 1).astype(jnp.int64)
+
+    args = tuple(a if isinstance(a, Tensor) else Tensor(a)
+                 for a in (potentials, transition_params, lengths))
+    return dispatch(f, args, name="viterbi_decode", multi_output=True)
+
+
+class ViterbiDecoder(Layer):
+    """reference: text/viterbi_decode.py ViterbiDecoder layer."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,16 @@
+"""paddle_tpu.utils (reference: python/paddle/utils)."""
+from . import cpp_extension  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+
+
+def run_check():
+    """reference: paddle.utils.run_check — sanity-check the install."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    y = (x @ x).numpy()
+    assert float(y.sum()) == 8.0
+    print(f"paddle_tpu is installed successfully! "
+          f"backend={jax.default_backend()}, "
+          f"devices={jax.device_count()}")

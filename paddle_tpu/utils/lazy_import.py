@@ -1,0 +1,11 @@
+"""reference: python/paddle/utils/lazy_import.py try_import."""
+import importlib
+
+
+def try_import(module_name, err_msg=None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed"
+        ) from e

@@ -1,0 +1,106 @@
+"""Audio feature / text decoder parity tests (reference: test/legacy_test
+audio feature tests + test_viterbi_decode_op)."""
+import math
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import functional as AF
+from paddle_tpu.audio.features import (Spectrogram, MelSpectrogram,
+                                       LogMelSpectrogram, MFCC)
+
+
+def test_hz_mel_roundtrip():
+    f = jnp.asarray([0.0, 440.0, 4000.0, 8000.0])
+    np.testing.assert_allclose(np.asarray(AF.mel_to_hz(AF.hz_to_mel(f))),
+                               np.asarray(f), rtol=1e-4, atol=1e-2)
+
+
+def test_fbank_matrix_shape_and_partition():
+    fb = AF.compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40)
+    assert fb.shape == (40, 257)
+    assert float(fb.min()) >= 0.0
+
+
+def test_spectrogram_parseval_sine():
+    # a pure sine concentrates energy at its bin
+    sr, n_fft = 16000, 512
+    t = np.arange(sr, dtype=np.float32) / sr
+    freq = 1000.0
+    x = paddle.to_tensor(np.sin(2 * np.pi * freq * t)[None])
+    spec = Spectrogram(n_fft=n_fft, hop_length=256)(x)
+    s = np.asarray(spec._value)[0]          # [F, T]
+    peak_bin = int(s.mean(axis=1).argmax())
+    expect = round(freq * n_fft / sr)
+    assert abs(peak_bin - expect) <= 1
+
+
+def test_mel_mfcc_shapes():
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 16000).astype(np.float32))
+    mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert np.asarray(mel._value).shape[:2] == (2, 40)
+    logmel = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+    assert np.isfinite(np.asarray(logmel._value)).all()
+    mfcc = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+    assert np.asarray(mfcc._value).shape[:2] == (2, 13)
+
+
+def test_wav_roundtrip(tmp_path):
+    from paddle_tpu.audio import backends
+    sr = 8000
+    x = (np.sin(np.linspace(0, 40 * np.pi, sr)) * 0.5).astype(np.float32)
+    f = str(tmp_path / "t.wav")
+    backends.save(f, x[None], sr)
+    y, sr2 = backends.load(f)
+    assert sr2 == sr
+    np.testing.assert_allclose(y[0], x, atol=2e-4)
+    inf = backends.info(f)
+    assert inf.sample_rate == sr and inf.num_channels == 1
+
+
+def _viterbi_ref(emit, trans, length, with_tags):
+    # brute-force best path for one sequence
+    import itertools
+    N = emit.shape[1]
+    real = N - 2 if with_tags else N
+    best, best_path = -1e30, None
+    for path in itertools.product(range(real), repeat=length):
+        s = emit[0, path[0]]
+        if with_tags:
+            s += trans[N - 2, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emit[t, path[t]]
+        if with_tags:
+            s += trans[path[-1], N - 1]
+        if s > best:
+            best, best_path = s, path
+    return best, best_path
+
+
+def test_viterbi_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, N = 2, 4, 5  # N includes BOS/EOS
+    emit = rng.randn(B, T, N).astype(np.float32)
+    # exclude BOS/EOS from emission competition
+    emit[:, :, N - 2:] = -1e4
+    trans = rng.randn(N, N).astype(np.float32)
+    lens = np.asarray([T, T], np.int64)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(emit), paddle.to_tensor(trans),
+        paddle.to_tensor(lens))
+    for b in range(B):
+        ref_s, ref_p = _viterbi_ref(emit[b], trans, T, True)
+        assert abs(float(np.asarray(scores._value)[b]) - ref_s) < 1e-3
+        np.testing.assert_array_equal(np.asarray(paths._value)[b],
+                                      ref_p)
+
+
+def test_text_datasets_require_local_files():
+    with pytest.raises(RuntimeError, match="data_file"):
+        paddle.text.UCIHousing()
+    with pytest.raises(RuntimeError, match="data_file"):
+        paddle.text.Imdb()

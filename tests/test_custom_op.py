@@ -1,0 +1,79 @@
+"""Custom C++ op extension tests (reference capability:
+paddle/fluid/framework/custom_operator.cc + test/custom_op/)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.cpp_extension import load_inline
+
+RELU_SRC = r"""
+#include <cstdint>
+extern "C" void my_relu(const void** ins, const int64_t* shp,
+                        const int32_t* rk, int n_in, void** outs) {
+    const float* x = (const float*) ins[0];
+    float* y = (float*) outs[0];
+    int64_t n = 1;
+    for (int d = 0; d < rk[0]; ++d) n *= shp[d];
+    for (int64_t i = 0; i < n; ++i) y[i] = x[i] > 0.f ? x[i] : 0.f;
+}
+"""
+
+ADDMUL_SRC = r"""
+#include <cstdint>
+extern "C" void add_and_mul(const void** ins, const int64_t* shp,
+                            const int32_t* rk, int n_in, void** outs) {
+    const float* a = (const float*) ins[0];
+    const float* b = (const float*) ins[1];
+    float* s = (float*) outs[0];
+    float* m = (float*) outs[1];
+    int64_t n = 1;
+    for (int d = 0; d < rk[0]; ++d) n *= shp[d];
+    for (int64_t i = 0; i < n; ++i) { s[i] = a[i] + b[i]; m[i] = a[i] * b[i]; }
+}
+"""
+
+
+def test_custom_relu_eager_and_jit():
+    op = load_inline("my_relu", RELU_SRC, out_shape_fn=lambda s: s)
+    x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    out = op(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out._value), np.maximum(x, 0))
+
+    # inside a compiled program (pure_callback staging)
+    import jax
+    f = jax.jit(lambda v: op(paddle.to_tensor(v))._value * 2)
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(x))),
+                               np.maximum(x, 0) * 2)
+
+
+def test_custom_multi_output():
+    op = load_inline("add_and_mul", ADDMUL_SRC,
+                     out_shape_fn=lambda a, b: [a, a], num_outputs=2)
+    rng = np.random.RandomState(1)
+    a, b = rng.randn(6).astype(np.float32), rng.randn(6).astype(np.float32)
+    s, m = op(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(np.asarray(s._value), a + b, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(m._value), a * b, rtol=1e-6)
+
+
+def test_custom_op_with_vjp():
+    def relu_vjp(saved, g):
+        (x,) = saved
+        return (jnp.where(x > 0, g, 0.0),)
+
+    op = load_inline("my_relu", RELU_SRC, out_shape_fn=lambda s: s,
+                     vjp=relu_vjp)
+    x = paddle.to_tensor(np.asarray([-1.0, 2.0, -3.0, 4.0], np.float32))
+    x.stop_gradient = False
+    y = op(x)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               [0.0, 1.0, 0.0, 1.0])
+
+
+def test_build_cache():
+    from paddle_tpu.utils.cpp_extension import _compile
+    so1 = _compile([RELU_SRC], "my_relu")
+    so2 = _compile([RELU_SRC], "my_relu")
+    assert so1 == so2

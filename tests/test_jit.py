@@ -151,3 +151,27 @@ class TestNanWatchCompiled:
         # CPU PjRt may expose no stats; the API must still answer ints
         assert isinstance(paddle.device.max_memory_allocated(), int)
         assert isinstance(paddle.device.memory_allocated(), int)
+
+
+def test_to_static_graph_break_fallback():
+    """Tensor-dependent Python control flow falls back to eager (the
+    reference SOT's graph-break semantics) instead of erroring."""
+    import warnings
+    import paddle_tpu as paddle
+
+    @paddle.jit.to_static
+    def f(x):
+        if float(x.sum()) > 0:    # concretizes a tracer -> graph break
+            return x * 2
+        return x - 1
+
+    x = paddle.to_tensor(np.ones((3,), np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = f(x)
+        assert any("graph break" in str(e.message) for e in w)
+    np.testing.assert_allclose(np.asarray(out._value), 2 * np.ones(3))
+    # second call with same signature: straight to eager, correct value
+    y = paddle.to_tensor(-np.ones((3,), np.float32))
+    out2 = f(y)
+    np.testing.assert_allclose(np.asarray(out2._value), -2 * np.ones(3))

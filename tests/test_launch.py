@@ -173,3 +173,67 @@ def test_flight_recorder_disabled_no_overhead():
     t = paddle.to_tensor(np.ones((2,), np.float32))
     dist.all_reduce(t)   # should not record
     assert all(x.op != "all_reduce" or x.end_ts for x in rec.tasks())
+
+
+class TestElasticManager:
+    """Membership + re-rank over the store (reference:
+    fleet/elastic/manager.py:126; test pattern:
+    test_fleet_elastic_manager.py with a mocked registry)."""
+
+    def _store(self):
+        from paddle_tpu.distributed.store import TCPStoreServer, TCPStore
+        srv = TCPStoreServer(port=0)
+        return srv, TCPStore("127.0.0.1", srv.port)
+
+    def test_membership_and_rerank(self):
+        from paddle_tpu.distributed.launch.elastic import ElasticManager
+        srv, store = self._store()
+        try:
+            a = ElasticManager(store, node_id="hostB", min_nodes=1)
+            b = ElasticManager(store, node_id="hostA", min_nodes=1)
+            a.register()
+            b.register()
+            # rank order is sorted node id: hostA=0, hostB=1
+            n, r = a.resolve(timeout=10)
+            assert (n, r) == (2, 1)
+            n, r = b.resolve(timeout=10)
+            assert (n, r) == (2, 0)
+        finally:
+            srv.close()
+
+    def test_scale_in_detection_and_rerank(self):
+        import time as _t
+        from paddle_tpu.distributed.launch.elastic import ElasticManager
+        srv, store = self._store()
+        try:
+            a = ElasticManager(store, node_id="n0", min_nodes=1,
+                               heartbeat_ttl=0.6)
+            b = ElasticManager(store, node_id="n1", min_nodes=1,
+                               heartbeat_ttl=0.6)
+            a.register()
+            b.register()
+            assert a.resolve(timeout=10) == (2, 0)
+            # n1 leaves (stops heartbeating)
+            b.leave()
+            _t.sleep(0.1)
+            assert a.scale_event() == "scale_in"
+            n, r = a.resolve(timeout=10)
+            assert (n, r) == (1, 0)
+            # n1 rejoins -> scale_out
+            b.heartbeat()
+            assert a.scale_event() == "scale_out"
+            assert a.resolve(timeout=10) == (2, 0)
+        finally:
+            srv.close()
+
+    def test_bounds_block_resolution(self):
+        from paddle_tpu.distributed.launch.elastic import ElasticManager
+        srv, store = self._store()
+        try:
+            a = ElasticManager(store, node_id="solo", min_nodes=2)
+            a.register()
+            import pytest as _pytest
+            with _pytest.raises(TimeoutError):
+                a.resolve(timeout=1.5)
+        finally:
+            srv.close()

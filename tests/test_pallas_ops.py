@@ -238,3 +238,40 @@ class TestFlashAttentionExtended:
         gk_r = jax.grad(lr)(k)
         np.testing.assert_allclose(np.asarray(gk_p), np.asarray(gk_r),
                                    atol=2e-4)
+
+
+class TestAutotune:
+    def test_autotune_sweeps_and_caches(self, tmp_path, monkeypatch):
+        from paddle_tpu.ops.pallas import autotune as at
+        cache = at.AutotuneCache(str(tmp_path / "tune.json"))
+        monkeypatch.setattr(at, "_cache", cache)
+        from paddle_tpu.core.flags import GLOBAL_FLAGS
+        GLOBAL_FLAGS.set("kernel_autotune", True)
+        calls = []
+
+        def build(cfg):
+            def fn(x):
+                calls.append(cfg)
+                import time
+                time.sleep(0.02 if cfg == "slow" else 0.0)
+                return x + 1
+            return fn
+
+        import paddle_tpu.ops.pallas._util as u
+        prev = u._FORCE_INTERPRET
+        u.set_force_interpret(False)  # autotune is a no-op in interpret mode
+        try:
+            cfg = at.autotune("toy", (4,), ["slow", "fast"], build,
+                              (jnp.ones(4),), warmup=1, iters=2)
+            assert cfg == "fast"
+            calls.clear()
+            # second lookup: cache hit, no sweep
+            cfg2 = at.autotune("toy", (4,), ["slow", "fast"], build,
+                               (jnp.ones(4),))
+            assert cfg2 == "fast" and not calls
+            # persistent across instances
+            cache2 = at.AutotuneCache(str(tmp_path / "tune.json"))
+            assert cache2.get("toy|(4,)") == 1
+        finally:
+            u.set_force_interpret(prev)
+            GLOBAL_FLAGS.set("kernel_autotune", False)

@@ -201,3 +201,43 @@ class TestRingAttention:
             _ref_attention(q, q, q, causal=True) ** 2))(q)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                    atol=1e-4, rtol=1e-3)
+
+
+@pytest.fixture
+def mp_hcg():
+    from paddle_tpu.distributed import fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 4,
+                               "pp_degree": 1, "sharding_degree": 2,
+                               "sep_degree": 1}
+    h = fleet.init(is_collective=True, strategy=strategy)
+    yield h
+    dist.set_hybrid_communicate_group(None)
+
+
+class TestSequenceParallelLayers:
+    """Explicit Megatron-SP API (reference:
+    fleet/utils/sequence_parallel_utils.py:429,564)."""
+
+    def test_column_row_sp_roundtrip(self, mp_hcg):
+        import numpy as np
+        from paddle_tpu.distributed.fleet.sequence_parallel_utils import (
+            ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+            ScatterOp, GatherOp)
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        col = ColumnSequenceParallelLinear(16, 32, has_bias=True)
+        row = RowSequenceParallelLinear(32, 16, has_bias=True)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(8, 2, 16).astype(np.float32))
+        h = ScatterOp.apply(x)
+        h = col(h)
+        h = row(h)
+        out = GatherOp.apply(h)
+        # numerics == plain two-layer MLP with the same weights
+        ref = (np.asarray(x._value) @ np.asarray(col.weight._value)
+               + np.asarray(col.bias._value))
+        ref = ref @ np.asarray(row.weight._value) + \
+            np.asarray(row.bias._value)
+        np.testing.assert_allclose(np.asarray(out._value), ref,
+                                   rtol=1e-4, atol=1e-5)
