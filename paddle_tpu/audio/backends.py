@@ -31,7 +31,12 @@ def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
         f.setpos(frame_offset)
         n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
         raw = f.readframes(n)
-    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    try:
+        dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    except KeyError:
+        raise ValueError(
+            f"load: unsupported WAV sample width {width * 8}-bit "
+            f"(supported: 8/16/32-bit PCM)") from None
     data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
     if normalize:
         if width == 1:
@@ -52,8 +57,13 @@ def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
         data = data[:, None]
     if data.dtype.kind == "f":
         data = np.clip(data, -1.0, 1.0)
-        data = (data * (2 ** (bits_per_sample - 1) - 1)).astype(
-            {8: np.uint8, 16: np.int16, 32: np.int32}[bits_per_sample])
+        scaled = data * (2 ** (bits_per_sample - 1) - 1)
+        if bits_per_sample == 8:
+            # WAV 8-bit PCM is unsigned with a 128 midpoint (load() applies
+            # the inverse (x-128)/128)
+            data = (scaled + 128.0).astype(np.uint8)
+        else:
+            data = scaled.astype({16: np.int16, 32: np.int32}[bits_per_sample])
     with wave.open(filepath, "wb") as f:
         f.setnchannels(data.shape[1])
         f.setsampwidth(bits_per_sample // 8)

@@ -17,11 +17,11 @@ from . import functional as AF
 __all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
 
 
-def _stft_power(v, n_fft, hop, win, center, power):
+def _stft_power(v, n_fft, hop, win, center, power, pad_mode="reflect"):
     if center:
         pad = n_fft // 2
         v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
-                    mode="reflect")
+                    mode=pad_mode)
     n_frames = 1 + (v.shape[-1] - n_fft) // hop
     idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
     frames = v[..., idx] * win            # [..., T, n_fft]
@@ -43,6 +43,7 @@ class Spectrogram(Layer):
         self.win_length = win_length or n_fft
         self.power = power
         self.center = center
+        self.pad_mode = pad_mode
         win = AF.get_window(window, self.win_length)
         if self.win_length < n_fft:   # center-pad window to n_fft
             lpad = (n_fft - self.win_length) // 2
@@ -51,9 +52,10 @@ class Spectrogram(Layer):
 
     def forward(self, x):
         n_fft, hop, win = self.n_fft, self.hop_length, self._window
-        center, power = self.center, self.power
+        center, power, pad_mode = self.center, self.power, self.pad_mode
         return dispatch(
-            lambda v: _stft_power(v, n_fft, hop, win, center, power),
+            lambda v: _stft_power(v, n_fft, hop, win, center, power,
+                                  pad_mode=pad_mode),
             (x if isinstance(x, Tensor) else Tensor(x),),
             name="spectrogram")
 
@@ -65,12 +67,13 @@ class MelSpectrogram(Layer):
                  hop_length: Optional[int] = None,
                  win_length: Optional[int] = None, window: str = "hann",
                  power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect",
                  n_mels: int = 64, f_min: float = 50.0,
                  f_max: Optional[float] = None, htk: bool = False,
                  norm: str = "slaney", dtype: str = "float32"):
         super().__init__()
         self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
-                                        window, power, center)
+                                        window, power, center, pad_mode)
         self._fbank = AF.compute_fbank_matrix(
             sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
             htk=htk, norm=norm)

@@ -75,6 +75,8 @@ class ColumnSequenceParallelLinear(Layer):
         args = (x, self.weight) + ((self.bias,) if self.bias is not None
                                    else ())
 
+        gather_output = self.gather_output
+
         def f(v, w, *b):
             # in: seq-sharded; gather seq for the matmul (GSPMD inserts
             # the all-gather), keep out column-sharded over mp
@@ -83,7 +85,11 @@ class ColumnSequenceParallelLinear(Layer):
             out = v @ w
             if b:
                 out = out + b[0]
-            return _constraint(out, P(None, None, "mp"))
+            # gather_output: replicate (all-gather over mp) like the
+            # reference's gather-output branch; else keep column-sharded
+            out_spec = P(None, None, None) if gather_output \
+                else P(None, None, "mp")
+            return _constraint(out, out_spec)
         return dispatch(f, args, name="column_sequence_parallel_linear")
 
 

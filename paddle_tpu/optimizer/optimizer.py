@@ -166,13 +166,17 @@ class Optimizer:
         if not params_grads:
             self._post_apply()
             return
-        groups = {}
-        for p, g in params_grads:
-            v = to_value(p)
-            key = tuple(sorted(d.id for d in getattr(v, "devices",
-                                                     lambda: [])())) \
-                if hasattr(v, "devices") else ()
-            groups.setdefault(key, []).append((p, g))
+
+        def group_by_device(pgs):
+            out = {}
+            for p, g in pgs:
+                v = to_value(p)
+                key = tuple(sorted(d.id for d in v.devices())) \
+                    if hasattr(v, "devices") else ()
+                out.setdefault(key, []).append((p, g))
+            return out
+
+        groups = group_by_device(params_grads)
         if len(groups) > 1:
             # global-norm (and custom) clipping couples ALL grads — apply
             # it eagerly across groups first, then update per group
@@ -181,14 +185,7 @@ class Optimizer:
                 params_grads = [(p, g)
                                 for p, g in self._grad_clip(params_grads)
                                 if g is not None]
-                groups = {}
-                for p, g in params_grads:
-                    v = to_value(p)
-                    key = tuple(sorted(
-                        d.id for d in getattr(v, "devices",
-                                              lambda: [])())) \
-                        if hasattr(v, "devices") else ()
-                    groups.setdefault(key, []).append((p, g))
+                groups = group_by_device(params_grads)
                 for pg in groups.values():
                     self._apply_group(pg, clip_override=False)
             else:

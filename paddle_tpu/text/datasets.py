@@ -64,9 +64,12 @@ class Imdb(Dataset):
                 texts.append((txt, 0 if g.group(1) == "pos" else 1))
                 for w in txt:
                     freq[w] = freq.get(w, 0) + 1
+        # cutoff is a minimum-frequency threshold (reference imdb.py:135
+        # keeps words with freq > cutoff), not a vocabulary size
         words = [w for w, c in sorted(freq.items(),
-                                      key=lambda kv: (-kv[1], kv[0]))]
-        self.word_idx = {w: i for i, w in enumerate(words[:cutoff])}
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if freq[w] > cutoff]
+        self.word_idx = {w: i for i, w in enumerate(words)}
         self.word_idx["<unk>"] = len(self.word_idx)
         unk = self.word_idx["<unk>"]
         for txt, lab in texts:

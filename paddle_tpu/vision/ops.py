@@ -117,13 +117,174 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
                      aligned=False)
 
 
-def yolo_box(x, origin_shape, anchors, class_num, conf_thresh,
-             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
              iou_aware=False, iou_aware_factor=0.5):
-    raise NotImplementedError(
-        "yolo_box: use paddle_tpu.models.detection heads; tracked for the "
-        "PP-YOLOE config")
+    """Decode YOLOv3 head output into detection boxes + class scores.
+
+    Vectorized XLA re-expression of the reference's per-cell loop
+    (paddle/phi/kernels/cpu/yolo_box_kernel.cc:70-130,
+    funcs/yolo_box_util.h GetYoloBox/CalcDetectionBox/CalcLabelScore).
+
+    x:        [N, C, H, W], C = A*(5+class_num) (+A iou maps leading if
+              ``iou_aware``, per GetEntryIndex's an_num offset)
+    img_size: [N, 2] int32 (height, width)
+    Returns (boxes [N, A*H*W, 4], scores [N, A*H*W, class_num]); entries
+    whose objectness is below ``conf_thresh`` are zeroed like the
+    reference's memset-0 + ``continue``.
+    """
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)  # [A, (w,h)]
+    a_num = an.shape[0]
+    scale = float(scale_x_y)
+    bias = -0.5 * (scale - 1.0)
+
+    def f(v, imgs):
+        n, c, h, w = v.shape
+        in_h, in_w = downsample_ratio * h, downsample_ratio * w
+        if iou_aware:
+            iou = jax.nn.sigmoid(v[:, :a_num].astype(jnp.float32))
+            v = v[:, a_num:]
+        v = v.reshape(n, a_num, 5 + class_num, h, w).astype(jnp.float32)
+        img_h = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        img_w = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        cx = (gx + jax.nn.sigmoid(v[:, :, 0]) * scale + bias) * img_w / w
+        cy = (gy + jax.nn.sigmoid(v[:, :, 1]) * scale + bias) * img_h / h
+        aw = an[:, 0][None, :, None, None]
+        ah = an[:, 1][None, :, None, None]
+        bw = jnp.exp(v[:, :, 2]) * aw * img_w / in_w
+        bh = jnp.exp(v[:, :, 3]) * ah * img_h / in_h
+        conf = jax.nn.sigmoid(v[:, :, 4])
+        if iou_aware:
+            conf = (conf ** (1.0 - iou_aware_factor)) * \
+                (iou ** iou_aware_factor)
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2, cy + bh / 2], axis=-1)
+        if clip_bbox:
+            lim = jnp.stack([img_w, img_h, img_w, img_h],
+                            axis=-1) - 1.0  # [n,1,1,1,4]
+            boxes = jnp.clip(boxes, 0.0, jnp.maximum(lim, 0.0))
+        valid = conf >= conf_thresh  # [n, A, h, w]
+        boxes = jnp.where(valid[..., None], boxes, 0.0)
+        # scores = conf * sigmoid(class logits), zeroed when below thresh
+        cls = jax.nn.sigmoid(v[:, :, 5:])  # [n, A, cls, h, w]
+        scores = jnp.where(valid[:, :, None], conf[:, :, None] * cls, 0.0)
+        boxes = boxes.reshape(n, a_num * h * w, 4)
+        scores = jnp.moveaxis(scores, 2, -1).reshape(
+            n, a_num * h * w, class_num)
+        return boxes, scores
+
+    return dispatch(f, (x, img_size), name="yolo_box", multi_output=True)
 
 
-def generate_proposals(*args, **kwargs):
-    raise NotImplementedError("generate_proposals: tracked for detection")
+_BBOX_CLIP = float(np.log(1000.0 / 16.0))
+
+
+def _adaptive_nms(boxes, scores, thresh, eta, top_k):
+    """NMS with the reference's adaptive threshold decay: after each kept
+    box, thresh *= eta while thresh > 0.5 (nms_util.h:160-182)."""
+    order = np.argsort(-scores)
+    areas = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+    keep = []
+    adaptive = float(thresh)
+    suppressed = np.zeros(len(boxes), dtype=bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        if top_k is not None and len(keep) >= top_k:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[:, 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[:, 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[:, 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[:, 3])
+        inter = np.maximum(0, xx2 - xx1) * np.maximum(0, yy2 - yy1)
+        iou = inter / np.maximum(areas[i] + areas - inter, 1e-10)
+        suppressed |= iou > adaptive
+        suppressed[i] = True
+        if adaptive > 0.5:
+            adaptive *= eta
+    return np.asarray(keep, dtype=np.int64)
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference:
+    paddle/phi/kernels/cpu/generate_proposals_kernel.cc — BoxCoder,
+    ClipTiledBoxes, FilterBoxes, NMS). Decode + clip run vectorized under
+    XLA; top-k selection and NMS are host-side (dynamic output sizes,
+    same as the reference's sequential NMS).
+
+    scores       [N, A, H, W], bbox_deltas [N, 4A, H, W],
+    img_size     [N, 2] (h, w), anchors/variances [H, W, A, 4].
+    Returns (rpn_rois [R, 4], rpn_roi_probs [R, 1][, rois_num [N]]).
+    """
+    sc = np.asarray(to_value(scores if isinstance(scores, Tensor)
+                             else Tensor(scores)), np.float32)
+    bd = np.asarray(to_value(bbox_deltas if isinstance(bbox_deltas, Tensor)
+                             else Tensor(bbox_deltas)), np.float32)
+    ims = np.asarray(to_value(img_size if isinstance(img_size, Tensor)
+                              else Tensor(img_size)), np.float32)
+    anc = np.asarray(to_value(anchors if isinstance(anchors, Tensor)
+                              else Tensor(anchors)), np.float32).reshape(-1, 4)
+    var = np.asarray(to_value(variances if isinstance(variances, Tensor)
+                              else Tensor(variances)),
+                     np.float32).reshape(-1, 4)
+    n = sc.shape[0]
+    off = 1.0 if pixel_offset else 0.0
+    # [N, A, H, W] -> [N, H*W*A]; deltas [N, 4A, H, W] -> [N, H*W*A, 4]
+    sc = sc.transpose(0, 2, 3, 1).reshape(n, -1)
+    bd = bd.transpose(0, 2, 3, 1).reshape(n, -1, 4)
+
+    all_rois, all_probs, rois_num = [], [], []
+    for i in range(n):
+        s_i, d_i = sc[i], bd[i]
+        k = min(pre_nms_top_n, len(s_i)) if pre_nms_top_n > 0 else len(s_i)
+        order = np.argsort(-s_i)[:k]
+        s_i, d_i, anc_i, var_i = s_i[order], d_i[order], anc[order], var[order]
+        # BoxCoder decode_center_size with per-anchor variances
+        aw = anc_i[:, 2] - anc_i[:, 0] + off
+        ah = anc_i[:, 3] - anc_i[:, 1] + off
+        acx = anc_i[:, 0] + 0.5 * aw
+        acy = anc_i[:, 1] + 0.5 * ah
+        cx = var_i[:, 0] * d_i[:, 0] * aw + acx
+        cy = var_i[:, 1] * d_i[:, 1] * ah + acy
+        bw = np.exp(np.minimum(var_i[:, 2] * d_i[:, 2], _BBOX_CLIP)) * aw
+        bh = np.exp(np.minimum(var_i[:, 3] * d_i[:, 3], _BBOX_CLIP)) * ah
+        props = np.stack([cx - bw / 2, cy - bh / 2,
+                          cx + bw / 2 - off, cy + bh / 2 - off], axis=-1)
+        im_h, im_w = ims[i, 0], ims[i, 1]
+        props[:, 0::2] = np.clip(props[:, 0::2], 0, im_w - off)
+        props[:, 1::2] = np.clip(props[:, 1::2], 0, im_h - off)
+        ms = max(float(min_size), 1.0)
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        keep = (ws >= ms) & (hs >= ms)
+        if pixel_offset:
+            xc = props[:, 0] + ws / 2
+            yc = props[:, 1] + hs / 2
+            keep &= (xc <= im_w) & (yc <= im_h)
+        props, s_i = props[keep], s_i[keep]
+        if len(props):
+            if eta < 1.0:
+                kept = _adaptive_nms(props, s_i, nms_thresh, eta,
+                                     post_nms_top_n)
+            else:
+                kept = np.asarray(nms(Tensor(props), nms_thresh,
+                                      scores=Tensor(s_i),
+                                      top_k=post_nms_top_n))
+            props, s_i = props[kept], s_i[kept]
+        all_rois.append(props)
+        all_probs.append(s_i[:, None])
+        rois_num.append(len(props))
+
+    rois = Tensor(np.concatenate(all_rois) if all_rois
+                  else np.zeros((0, 4), np.float32))
+    probs = Tensor(np.concatenate(all_probs) if all_probs
+                   else np.zeros((0, 1), np.float32))
+    if return_rois_num:
+        return rois, probs, Tensor(np.asarray(rois_num, np.int32))
+    return rois, probs

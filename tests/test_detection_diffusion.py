@@ -139,3 +139,100 @@ def test_bucketer_oversize_downscales():
     padded, scale, pad = b.pad_image(img)
     assert padded.shape == (3, 64, 64)
     assert scale == pytest.approx(64 / 200)
+
+
+# -- yolo_box / generate_proposals ------------------------------------------
+def test_yolo_box_matches_reference_loop():
+    """Vectorized yolo_box vs a direct numpy port of the reference kernel
+    (paddle/phi/kernels/cpu/yolo_box_kernel.cc)."""
+    from paddle_tpu.vision.ops import yolo_box
+
+    rng = np.random.RandomState(0)
+    N, A, cls, H, W = 2, 3, 5, 4, 4
+    anchors = [10, 13, 16, 30, 33, 23]
+    x = rng.randn(N, A * (5 + cls), H, W).astype(np.float32)
+    img = np.array([[416, 416], [320, 480]], np.int32)
+    scale, bias = 1.2, -0.5 * (1.2 - 1)
+    bx, sc = yolo_box(paddle.to_tensor(x), paddle.to_tensor(img), anchors,
+                      cls, 0.3, 32, clip_bbox=True, scale_x_y=scale)
+    bx, sc = np.asarray(bx), np.asarray(sc)
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    xr = x.reshape(N, A, 5 + cls, H, W)
+    boxes_ref = np.zeros((N, A * H * W, 4), np.float32)
+    scores_ref = np.zeros((N, A * H * W, cls), np.float32)
+    stride = H * W
+    for i in range(N):
+        ih, iw = img[i]
+        for j in range(A):
+            for k in range(H):
+                for l in range(W):
+                    conf = sig(xr[i, j, 4, k, l])
+                    if conf < 0.3:
+                        continue
+                    b0 = (l + sig(xr[i, j, 0, k, l]) * scale + bias) * iw / W
+                    b1 = (k + sig(xr[i, j, 1, k, l]) * scale + bias) * ih / H
+                    b2 = np.exp(xr[i, j, 2, k, l]) * anchors[2*j] * iw / (32*W)
+                    b3 = np.exp(xr[i, j, 3, k, l]) * anchors[2*j+1] * ih / (32*H)
+                    bi = j * stride + k * W + l
+                    bb = [b0-b2/2, b1-b3/2, b0+b2/2, b1+b3/2]
+                    bb[0] = max(bb[0], 0)
+                    bb[1] = max(bb[1], 0)
+                    bb[2] = min(bb[2], iw - 1)
+                    bb[3] = min(bb[3], ih - 1)
+                    boxes_ref[i, bi] = bb
+                    scores_ref[i, bi] = conf * sig(xr[i, j, 5:, k, l])
+    np.testing.assert_allclose(bx, boxes_ref, atol=1e-4)
+    np.testing.assert_allclose(sc, scores_ref, atol=1e-5)
+
+
+def test_yolo_box_iou_aware():
+    from paddle_tpu.vision.ops import yolo_box
+
+    rng = np.random.RandomState(1)
+    N, A, cls, H, W = 1, 2, 3, 2, 2
+    anchors = [10, 13, 16, 30]
+    x = rng.randn(N, A * (6 + cls), H, W).astype(np.float32)
+    img = np.array([[64, 64]], np.int32)
+    bx, sc = yolo_box(paddle.to_tensor(x), paddle.to_tensor(img), anchors,
+                      cls, 0.0, 32, iou_aware=True, iou_aware_factor=0.4)
+    # conf = sigmoid(obj)^0.6 * sigmoid(iou)^0.4; iou maps are the A leading
+    # channels (GetEntryIndex an_num offset)
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    iou = sig(x[:, :A].reshape(N, A, H, W))
+    rest = x[:, A:].reshape(N, A, 6 + cls - 1, H, W)
+    conf = sig(rest[:, :, 4]) ** 0.6 * iou ** 0.4
+    s0 = conf[..., None] * np.moveaxis(sig(rest[:, :, 5:]), 2, -1)
+    np.testing.assert_allclose(np.asarray(sc).reshape(N, A, H, W, cls),
+                               s0.reshape(N, A, H, W, cls), atol=1e-5)
+
+
+def test_generate_proposals_shapes_and_order():
+    from paddle_tpu.vision.ops import generate_proposals
+
+    rng = np.random.RandomState(2)
+    Hh, Ww, Aa = 8, 8, 3
+    scores = rng.rand(2, Aa, Hh, Ww).astype(np.float32)
+    deltas = (rng.randn(2, 4 * Aa, Hh, Ww) * 0.1).astype(np.float32)
+    anc = (rng.rand(Hh, Ww, Aa, 4) * 50).astype(np.float32)
+    anc[..., 2:] += anc[..., :2] + 10
+    var = np.ones((Hh, Ww, Aa, 4), np.float32)
+    rois, probs, num = generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[64., 64.], [48., 56.]], np.float32)),
+        paddle.to_tensor(anc), paddle.to_tensor(var),
+        pre_nms_top_n=50, post_nms_top_n=10, return_rois_num=True)
+    rois, probs, num = np.asarray(rois), np.asarray(probs), np.asarray(num)
+    assert rois.shape[1] == 4 and probs.shape[1] == 1
+    assert num.sum() == rois.shape[0] and (num <= 10).all()
+    # per-image probs sorted descending (NMS keeps score order)
+    o = 0
+    for n_i in num:
+        p = probs[o:o + n_i, 0]
+        assert (np.diff(p) <= 1e-6).all()
+        o += n_i
+    # boxes clipped to image
+    assert (rois >= 0).all()
