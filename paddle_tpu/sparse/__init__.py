@@ -21,7 +21,16 @@ from ..core.tensor import Tensor, dispatch, to_value
 
 __all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
            "sparse_csr_tensor", "to_sparse_coo", "add", "multiply",
-           "matmul", "relu", "transpose", "is_same_shape", "masked_matmul"]
+           "matmul", "relu", "transpose", "is_same_shape", "masked_matmul",
+           # unary (value-wise, pattern-preserving)
+           "sin", "tan", "asin", "atan", "sinh", "asinh", "atanh", "tanh",
+           "square", "sqrt", "log1p", "pow", "neg", "abs", "rad2deg",
+           "deg2rad", "expm1", "isnan", "cast", "coalesce", "relu6",
+           "leaky_relu", "softmax",
+           # binary / multiary
+           "subtract", "divide", "mv", "mask_as", "addmm",
+           # shape / reduction
+           "sum", "reshape", "slice", "nn"]
 
 
 class SparseCooTensor:
@@ -161,7 +170,14 @@ def to_sparse_coo(x, sparse_dim: Optional[int] = None) -> SparseCooTensor:
 # -- functional ops -----------------------------------------------------------
 def _ew(op, x: SparseCooTensor, y: SparseCooTensor) -> SparseCooTensor:
     """Elementwise on aligned COO (coalesce + dense fallback for
-    mismatched patterns)."""
+    mismatched patterns). CSR inputs round-trip through COO."""
+    if isinstance(x, SparseCsrTensor) and isinstance(y, SparseCsrTensor):
+        out = _ew(op, x.to_sparse_coo(), y.to_sparse_coo())
+        return out.to_sparse_csr()
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if isinstance(y, SparseCsrTensor):
+        y = y.to_sparse_coo()
     xc, yc = x.coalesce(), y.coalesce()
     if (xc.nnz == yc.nnz and
             bool(jnp.all(xc._indices == yc._indices))):
@@ -224,3 +240,232 @@ def masked_matmul(x, y, mask: SparseCooTensor) -> SparseCooTensor:
 
 def is_same_shape(x, y) -> bool:
     return tuple(x.shape) == tuple(y.shape)
+
+
+# -- unary ops (apply to stored values, pattern preserved) -------------------
+# reference: python/paddle/sparse/unary.py + sparse_ops.yaml — each op maps
+# the stored values; zero entries stay implicit, so only zero-preserving ops
+# are registered there and the same set is mirrored here.
+def _unary(fn):
+    def op(x, *args, **kwargs):
+        name = kwargs.pop("name", None)  # noqa: F841 (API parity)
+        vals = fn(x._values, *args, **kwargs)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+        return SparseCooTensor(x._indices, vals, x._shape, x._coalesced)
+    return op
+
+
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+tanh = _unary(jnp.tanh)
+square = _unary(jnp.square)
+sqrt = _unary(jnp.sqrt)
+log1p = _unary(jnp.log1p)
+neg = _unary(jnp.negative)
+abs = _unary(jnp.abs)  # noqa: A001 (paddle.sparse.abs shadows builtin)
+rad2deg = _unary(jnp.rad2deg)
+deg2rad = _unary(jnp.deg2rad)
+expm1 = _unary(jnp.expm1)
+isnan = _unary(jnp.isnan)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..core.dtypes import as_jax_dtype
+    vals = x._values if value_dtype is None else \
+        x._values.astype(as_jax_dtype(value_dtype))
+    if isinstance(x, SparseCsrTensor):
+        crows, cols = x._crows, x._cols
+        if index_dtype is not None:
+            it = as_jax_dtype(index_dtype)
+            crows, cols = crows.astype(it), cols.astype(it)
+        return SparseCsrTensor(crows, cols, vals, x._shape)
+    idx = x._indices if index_dtype is None else \
+        x._indices.astype(as_jax_dtype(index_dtype))
+    return SparseCooTensor(idx, vals, x._shape, x._coalesced)
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def relu6(x, name=None):
+    return _unary(lambda v: jnp.clip(v, 0.0, 6.0))(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary(
+        lambda v: jnp.where(v >= 0, v, negative_slope * v))(x)
+
+
+def softmax(x, axis=-1, name=None):
+    """Sparse softmax over the stored entries of the last axis only
+    (reference: sparse/nn/functional/activation.py softmax — implicit
+    zeros do NOT participate)."""
+    if axis not in (-1, len(x.shape) - 1):
+        raise ValueError("sparse softmax only supports the last axis")
+    if isinstance(x, SparseCsrTensor):
+        rows = x._row_indices()
+        n_rows = x._shape[0]
+        mx = jax.ops.segment_max(x._values, rows, num_segments=n_rows)
+        e = jnp.exp(x._values - mx[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        return SparseCsrTensor(x._crows, x._cols, e / denom[rows], x._shape)
+    xc = x if x._coalesced else x.coalesce()
+    # group key: all dims except the last
+    if len(xc._shape) == 1:
+        seg = jnp.zeros(xc.nnz, jnp.int32)
+        n_seg = 1
+    else:
+        dims = np.asarray(xc._shape[:-1])
+        mult = np.concatenate([np.cumprod(dims[::-1])[-2::-1], [1]])
+        seg = jnp.asarray(
+            (np.asarray(xc._indices[:-1]).T @ mult).astype(np.int32))
+        n_seg = int(np.prod(dims))
+    mx = jax.ops.segment_max(xc._values, seg, num_segments=n_seg)
+    e = jnp.exp(xc._values - mx[seg])
+    denom = jax.ops.segment_sum(e, seg, num_segments=n_seg)
+    return SparseCooTensor(xc._indices, e / denom[seg], xc._shape, True)
+
+
+# -- binary / multiary --------------------------------------------------------
+def subtract(x, y, name=None):
+    return _ew(jnp.subtract, x, y)
+
+
+def divide(x, y, name=None):
+    return _ew(jnp.divide, x, y)
+
+
+def mv(x, vec, name=None):
+    """sparse [M, K] @ dense [K] → dense [M] (reference: sparse/binary.py
+    mv; SpMV as gather + segment-sum)."""
+    v = vec if isinstance(vec, Tensor) else Tensor(vec)
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    rows, cols, vals, m = x._indices[0], x._indices[1], x._values, \
+        x._shape[0]
+    return dispatch(
+        lambda vv: jax.ops.segment_sum(vals * jnp.take(vv, cols), rows,
+                                       num_segments=m),
+        (v,), name="sparse_mv")
+
+
+def mask_as(x, mask, name=None):
+    """Take dense ``x`` at ``mask``'s sparsity pattern (reference:
+    sparse/binary.py mask_as)."""
+    xv = to_value(x if isinstance(x, Tensor) else Tensor(x))
+    if isinstance(mask, SparseCsrTensor):
+        rows = mask._row_indices()
+        vals = xv[rows, mask._cols]
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask._shape)
+    vals = xv[tuple(mask._indices[i] for i in range(mask._indices.shape[0]))]
+    return SparseCooTensor(mask._indices, vals, mask._shape,
+                           mask._coalesced)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta * input + alpha * (x @ y) — x sparse, input/y dense
+    (reference: sparse/multiary.py addmm)."""
+    prod = matmul(x, y)
+    inp = input if isinstance(input, Tensor) else Tensor(input)
+    return dispatch(lambda a, b: beta * a + alpha * b, (inp, prod),
+                    name="sparse_addmm")
+
+
+# -- shape / reduction --------------------------------------------------------
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """reference: sparse/unary.py sum — axis=None → 0-d dense Tensor;
+    otherwise a sparse tensor reduced over ``axis``."""
+    is_csr = isinstance(x, SparseCsrTensor)
+    coo = x.to_sparse_coo() if is_csr else x.coalesce()
+    vals = coo._values if dtype is None else coo._values.astype(dtype)
+    if axis is None:
+        total = vals.sum()
+        if keepdim:
+            nd = len(coo._shape)
+            return SparseCooTensor(np.zeros((nd, 1), np.int32),
+                                   total[None], (1,) * nd, True)
+        return Tensor(total)
+    nd = len(coo._shape)
+    ax = axis + nd if axis < 0 else axis
+    keep_dims = [i for i in range(nd) if i != ax]
+    if not keep_dims:
+        out = SparseCooTensor(np.zeros((1, 1), np.int32),
+                              vals.sum()[None], (1,), True)
+        return out if keepdim else Tensor(vals.sum())
+    idx = np.asarray(coo._indices)[keep_dims]
+    shape = tuple(coo._shape[i] for i in keep_dims)
+    flat = np.ravel_multi_index(tuple(idx), shape) if idx.size else \
+        np.zeros(0, np.int64)
+    uniq, inv = np.unique(flat, return_inverse=True)
+    if len(uniq) == 0:
+        new_idx = np.zeros((len(keep_dims), 0), np.int32)
+        out_vals = vals[:0]
+    else:
+        out_vals = jax.ops.segment_sum(vals, jnp.asarray(inv),
+                                       num_segments=len(uniq))
+        new_idx = np.stack(np.unravel_index(uniq, shape)).astype(np.int32)
+    if keepdim:
+        ones = np.zeros((1, new_idx.shape[1]), np.int32)
+        new_idx = np.concatenate(
+            [new_idx[:ax], ones, new_idx[ax:]], axis=0)
+        shape = shape[:ax] + (1,) + shape[ax:]
+    out = SparseCooTensor(new_idx, out_vals, shape, True)
+    return out.to_sparse_csr() if is_csr and len(shape) == 2 else out
+
+
+def reshape(x, shape, name=None):
+    """reference: sparse/unary.py reshape — remap COO coordinates through
+    the flat index (values untouched)."""
+    is_csr = isinstance(x, SparseCsrTensor)
+    coo = x.to_sparse_coo() if is_csr else x
+    shape = list(shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[shape.index(-1)] = int(np.prod(coo._shape)) // known
+    shape = tuple(shape)
+    if int(np.prod(shape)) != int(np.prod(coo._shape)):
+        raise ValueError(f"reshape: cannot reshape {coo._shape} -> {shape}")
+    flat = np.ravel_multi_index(tuple(np.asarray(coo._indices)),
+                                coo._shape) if coo.nnz else \
+        np.zeros(0, np.int64)
+    new_idx = np.stack(np.unravel_index(flat, shape)).astype(np.int32) \
+        if coo.nnz else np.zeros((len(shape), 0), np.int32)
+    out = SparseCooTensor(new_idx, coo._values, shape, coo._coalesced)
+    return out.to_sparse_csr() if is_csr and len(shape) == 2 else out
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """reference: sparse/unary.py slice — keep entries inside the window,
+    shift coordinates to the new origin."""
+    is_csr = isinstance(x, SparseCsrTensor)
+    coo = x.to_sparse_coo() if is_csr else x
+    idx = np.asarray(coo._indices)
+    shape = list(coo._shape)
+    keep = np.ones(idx.shape[1], bool)
+    offsets = np.zeros(len(shape), np.int64)
+    for ax, s, e in zip(axes, starts, ends):
+        ax = ax + len(shape) if ax < 0 else ax
+        s = max(s + shape[ax], 0) if s < 0 else min(s, shape[ax])
+        e = max(e + shape[ax], 0) if e < 0 else min(e, shape[ax])
+        keep &= (idx[ax] >= s) & (idx[ax] < e)
+        offsets[ax] = s
+        shape[ax] = max(e - s, 0)
+    new_idx = (idx[:, keep] - offsets[:, None]).astype(np.int32)
+    vals = coo._values[jnp.asarray(np.nonzero(keep)[0])] if keep.any() \
+        else coo._values[:0]
+    out = SparseCooTensor(new_idx, vals, tuple(shape), coo._coalesced)
+    return out.to_sparse_csr() if is_csr and len(shape) == 2 else out
+
+
+from . import nn  # noqa: E402,F401  (paddle.sparse.nn parity)

@@ -1,0 +1,192 @@
+"""paddle.sparse.nn parity — sparse layers over sparse.nn.functional
+(reference: python/paddle/sparse/nn/layer/)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, to_value
+from ...nn.layer.layers import Layer
+from ...nn import initializer as I
+from .. import SparseCooTensor, SparseCsrTensor
+from . import functional as F
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "Conv2D", "Conv3D",
+           "SubmConv2D", "SubmConv3D", "MaxPool3D", "BatchNorm",
+           "SyncBatchNorm", "functional"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, n, subm,
+                 stride=1, padding=0, dilation=1, groups=1,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format=None):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * n
+        self._n = n
+        self._subm = subm
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        # paddle sparse conv weight layout: [*kernel, Cin/groups, Cout]
+        self.weight = self.create_parameter(
+            list(kernel_size) + [in_channels // groups, out_channels],
+            attr=weight_attr, default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        fn = {(2, False): F.conv2d, (2, True): F.subm_conv2d,
+              (3, False): F.conv3d, (3, True): F.subm_conv3d}[
+                  (self._n, self._subm)]
+        return fn(x, self.weight, self.bias, stride=self.stride,
+                  padding=self.padding, dilation=self.dilation,
+                  groups=self.groups)
+
+
+class Conv2D(_ConvNd):
+    """reference: sparse/nn/layer/conv.py Conv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, False,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, True,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, False,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class SubmConv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, True,
+                         stride, padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)
+
+
+class BatchNorm(Layer):
+    """Sparse batch norm: normalizes the value matrix over nnz per channel
+    (reference: sparse/nn/layer/norm.py BatchNorm — 'distribution of the
+    active sites')."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
+        self.register_buffer("_variance", Tensor(jnp.ones(num_features)))
+
+    def forward(self, x):
+        vals = x._values
+        use_stats = self.use_global_stats
+        if use_stats is None:
+            use_stats = not self.training
+        if use_stats:
+            mean = to_value(self._mean)
+            var = to_value(self._variance)
+        else:
+            mean = vals.mean(axis=0)
+            var = vals.var(axis=0)
+            m = self.momentum
+            self._mean._value = m * to_value(self._mean) + (1 - m) * mean
+            self._variance._value = (m * to_value(self._variance) +
+                                     (1 - m) * var)
+        w, b = to_value(self.weight), to_value(self.bias)
+        out = (vals - mean) / jnp.sqrt(var + self.epsilon) * w + b
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols, out, x._shape)
+        return SparseCooTensor(x._indices, out, x._shape, x._coalesced)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica sparse BN. Under GSPMD the value matrix is already a
+    global view, so the normal BatchNorm statistics ARE the synchronized
+    statistics (reference needs an explicit allreduce,
+    sparse/nn/layer/norm.py SyncBatchNorm)."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(layer, cls):
+            out = cls(int(to_value(layer.weight).shape[0]),
+                      momentum=layer.momentum, epsilon=layer.epsilon)
+            out.weight = layer.weight
+            out.bias = layer.bias
+            out._mean = layer._mean
+            out._variance = layer._variance
+            return out
+        for name, sub in list(layer.named_children()):
+            setattr(layer, name, cls.convert_sync_batchnorm(sub))
+        return layer

@@ -1,0 +1,240 @@
+"""paddle.sparse.nn.functional parity — sparse conv / pool / activation /
+attention (reference: python/paddle/sparse/nn/functional/).
+
+TPU-native stance: sparse convolution is re-expressed as the classic
+gather-GEMM-scatter formulation — for each kernel offset, match input
+coordinates to output coordinates on the host (nnz is host-known), then
+one gathered matmul per offset accumulated with segment-sum. Every matmul
+is dense and MXU-shaped; only the index plumbing is sparse. The reference
+runs the same algorithm with hash tables on GPU
+(paddle/phi/kernels/sparse/gpu/conv_kernel.cu).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, to_value
+from .. import (SparseCooTensor, SparseCsrTensor, leaky_relu, relu, relu6,
+                softmax)
+
+__all__ = ["conv2d", "conv3d", "subm_conv2d", "subm_conv3d", "max_pool3d",
+           "relu", "relu6", "leaky_relu", "softmax", "attention"]
+
+
+def _tuplize(v, n):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_nd(x: SparseCooTensor, weight, bias, stride, padding, dilation,
+             groups, subm: bool, n: int):
+    """Shared N-D sparse conv. x: COO with indices [n+1, nnz] (batch +
+    spatial), values [nnz, Cin]; weight [*kernel, Cin, Cout] (paddle
+    sparse layout, python/paddle/sparse/nn/layer/conv.py)."""
+    if groups != 1:
+        raise NotImplementedError("sparse conv: groups > 1 not supported")
+    w = jnp.asarray(to_value(weight))
+    kernel = tuple(int(k) for k in w.shape[:n])
+    cin, cout = int(w.shape[n]), int(w.shape[n + 1])
+    stride = _tuplize(stride, n)
+    padding = _tuplize(padding, n)
+    dilation = _tuplize(dilation, n)
+
+    coo = x if x._coalesced else x.coalesce()
+    idx = np.asarray(coo._indices)          # [1+n, nnz]
+    vals = coo._values                      # [nnz, cin]
+    assert vals.ndim == 2 and vals.shape[1] == cin, \
+        f"values [{vals.shape}] vs weight Cin {cin}"
+    batch = idx[0]
+    coords = idx[1:].T                      # [nnz, n] spatial
+    spatial = coo._shape[1:n + 1]
+    out_spatial = tuple(
+        (spatial[d] + 2 * padding[d] -
+         dilation[d] * (kernel[d] - 1) - 1) // stride[d] + 1
+        for d in range(n))
+
+    offs = np.stack(np.meshgrid(*[np.arange(k) for k in kernel],
+                                indexing="ij"), -1).reshape(-1, n)
+
+    # one pass per kernel offset: out*stride = in + pad - off*dilation;
+    # collect (input row, output site) pairs, discovering output sites on
+    # the fly for the standard conv
+    if subm:
+        if any(s != 1 for s in stride):
+            raise ValueError(
+                "submanifold sparse conv requires stride=1 (output sites "
+                "are the input sites)")
+        out_key = {(batch[i],) + tuple(coords[i]): i
+                   for i in range(len(batch))}
+        sites = None  # fixed: output coords = input coords
+        out_sp = spatial
+    else:
+        out_key = {}
+        sites = []
+        out_sp = out_spatial
+
+    pairs = []  # (offset index, rows_in list, rows_out list)
+    for oi, off in enumerate(offs):
+        num = coords + np.asarray(padding) - off * np.asarray(dilation)
+        ok = (num % np.asarray(stride) == 0).all(1)
+        out_c = num // np.asarray(stride)
+        ok &= ((out_c >= 0) & (out_c < np.asarray(out_sp))).all(1)
+        rows_in, rows_out = [], []
+        for i in np.nonzero(ok)[0]:
+            key = (batch[i],) + tuple(out_c[i])
+            j = out_key.get(key)
+            if j is None:
+                if sites is None:   # subm: only existing sites count
+                    continue
+                j = out_key[key] = len(sites)
+                sites.append(key)
+            rows_in.append(i)
+            rows_out.append(j)
+        if rows_in:
+            pairs.append((oi, rows_in, rows_out))
+
+    if subm:
+        out_idx = idx
+        n_out = len(batch)
+        out_shape = coo._shape[:n + 1] + (cout,)
+    else:
+        n_out = len(sites)
+        out_idx = np.asarray(sites, np.int64).T.reshape(n + 1, -1) \
+            .astype(np.int32) if n_out else np.zeros((n + 1, 0), np.int32)
+        out_shape = (coo._shape[0],) + out_spatial + (cout,)
+
+    out_vals = jnp.zeros((n_out, cout), vals.dtype)
+    w_flat = w.reshape(-1, cin, cout)
+    for oi, rows_in, rows_out in pairs:
+        gathered = vals[jnp.asarray(rows_in)]           # [m, cin]
+        contrib = gathered @ w_flat[oi]                 # [m, cout] (MXU)
+        out_vals = out_vals.at[jnp.asarray(rows_out)].add(contrib)
+
+    if bias is not None:
+        out_vals = out_vals + jnp.asarray(to_value(bias))
+    return SparseCooTensor(out_idx, out_vals, out_shape[:-1], True), \
+        out_shape
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """reference: sparse/nn/functional/conv.py conv3d (gather-GEMM-scatter
+    vs the reference's GPU hash-table kernel)."""
+    out, _ = _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                      subm=False, n=3)
+    return out
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    out, _ = _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                      subm=True, n=3)
+    return out
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    out, _ = _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                      subm=False, n=2)
+    return out
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    out, _ = _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                      subm=True, n=2)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC", name=None):
+    """reference: sparse/nn/functional/pooling.py max_pool3d — window max
+    over active sites only (segment-max per output site)."""
+    n = 3
+    kernel = _tuplize(kernel_size, n)
+    stride = _tuplize(stride if stride is not None else kernel_size, n)
+    padding = _tuplize(padding, n)
+
+    coo = x if x._coalesced else x.coalesce()
+    idx = np.asarray(coo._indices)
+    vals = coo._values
+    batch = idx[0]
+    coords = idx[1:].T
+    spatial = coo._shape[1:n + 1]
+    out_spatial = tuple(
+        (spatial[d] + 2 * padding[d] - kernel[d]) // stride[d] + 1
+        for d in range(n))
+
+    out_key = {}
+    sites, rows_in, rows_out = [], [], []
+    offs = np.stack(np.meshgrid(*[np.arange(k) for k in kernel],
+                                indexing="ij"), -1).reshape(-1, n)
+    for off in offs:
+        num = coords + np.asarray(padding) - off
+        ok = (num % np.asarray(stride) == 0).all(1)
+        out_c = num // np.asarray(stride)
+        ok &= ((out_c >= 0) & (out_c < np.asarray(out_spatial))).all(1)
+        for i in np.nonzero(ok)[0]:
+            key = (batch[i],) + tuple(out_c[i])
+            j = out_key.get(key)
+            if j is None:
+                j = out_key[key] = len(sites)
+                sites.append(key)
+            rows_in.append(i)
+            rows_out.append(j)
+    n_out = len(sites)
+    if n_out == 0:
+        out_idx = np.zeros((n + 1, 0), np.int32)
+        out_vals = vals[:0]
+    else:
+        out_idx = np.asarray(sites, np.int64).T.astype(np.int32)
+        out_vals = jax.ops.segment_max(
+            vals[jnp.asarray(rows_in)], jnp.asarray(rows_out),
+            num_segments=n_out)
+    return SparseCooTensor(out_idx, out_vals,
+                           (coo._shape[0],) + out_spatial, True)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """reference: sparse/nn/functional/transformer.py attention — QK^T
+    evaluated ONLY at sparse_mask's coordinates (SDDMM), sparse softmax,
+    then SpMM with V. q/k/v: [B, H, S, D] dense; sparse_mask: CSR
+    [B*H, S, S] pattern."""
+    q = jnp.asarray(to_value(query))
+    k = jnp.asarray(to_value(key))
+    v = jnp.asarray(to_value(value))
+    B, H, S, D = q.shape
+    if isinstance(sparse_mask, SparseCsrTensor):
+        coo = sparse_mask.to_sparse_coo()
+    else:
+        coo = sparse_mask.coalesce()
+    idx = np.asarray(coo._indices)        # [3, nnz]: (bh, row, col)
+    bh, rows, cols = (jnp.asarray(idx[0]), jnp.asarray(idx[1]),
+                      jnp.asarray(idx[2]))
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    scores = jnp.einsum("nd,nd->n", qf[bh, rows], kf[bh, cols]) / \
+        jnp.sqrt(jnp.asarray(D, q.dtype))
+    if key_padding_mask is not None:
+        kpm = jnp.asarray(to_value(key_padding_mask))  # [B, S]
+        scores = scores + kpm[bh // H, cols]
+    if attn_mask is not None:
+        am = jnp.asarray(to_value(attn_mask))          # [S, S]
+        scores = scores + am[rows, cols]
+    # segment softmax per (bh, row)
+    seg = bh * S + rows
+    n_seg = B * H * S
+    mx = jax.ops.segment_max(scores, seg, num_segments=n_seg)
+    e = jnp.exp(scores - mx[seg])
+    denom = jax.ops.segment_sum(e, seg, num_segments=n_seg)
+    p = e / jnp.maximum(denom[seg], 1e-20)
+    out = jax.ops.segment_sum(p[:, None] * vf[bh, cols], seg,
+                              num_segments=n_seg)     # [B*H*S, D]
+    return Tensor(out.reshape(B, H, S, D))

@@ -192,3 +192,213 @@ def test_gcn_layer_end_to_end():
     agg = geometric.send_u_recv(x, src, dst, "mean", out_size=n)
     assert agg.numpy().shape == (n, n)
     assert np.isfinite(agg.numpy()).all()
+
+
+# -- sparse op-surface expansion (round 2) ----------------------------------
+class TestSparseUnaryBinary:
+    def _coo(self, rng, shape=(4, 6), density=0.4):
+        d = (rng.randn(*shape) * (rng.rand(*shape) < density)) \
+            .astype(np.float32)
+        return d, sparse.to_sparse_coo(paddle.to_tensor(d))
+
+    def test_unary_value_ops(self):
+        rng = np.random.RandomState(0)
+        d, s = self._coo(rng)
+        for name, ref in [("sin", np.sin), ("tanh", np.tanh),
+                          ("square", np.square), ("expm1", np.expm1),
+                          ("abs", np.abs), ("neg", np.negative),
+                          ("rad2deg", np.rad2deg),
+                          ("relu6", lambda v: np.clip(v, 0, 6))]:
+            out = getattr(sparse, name)(s).to_dense()
+            np.testing.assert_allclose(np.asarray(out), ref(d), atol=1e-5,
+                                       err_msg=name)
+
+    def test_unary_preserves_csr_layout(self):
+        rng = np.random.RandomState(1)
+        d, s = self._coo(rng)
+        csr = s.to_sparse_csr()
+        out = sparse.tanh(csr)
+        assert isinstance(out, sparse.SparseCsrTensor)
+        np.testing.assert_allclose(np.asarray(out.to_dense()),
+                                   np.tanh(d), atol=1e-5)
+
+    def test_softmax_active_entries_only(self):
+        rng = np.random.RandomState(2)
+        d, s = self._coo(rng)
+        dd = np.asarray(sparse.softmax(s).to_dense())
+        for r in range(d.shape[0]):
+            nz = d[r] != 0
+            if nz.sum():
+                e = np.exp(d[r][nz] - d[r][nz].max())
+                np.testing.assert_allclose(dd[r][nz], e / e.sum(),
+                                           atol=1e-5)
+        # CSR path agrees
+        dd2 = np.asarray(sparse.softmax(s.to_sparse_csr()).to_dense())
+        np.testing.assert_allclose(dd2, dd, atol=1e-6)
+
+    def test_sum_axes(self):
+        rng = np.random.RandomState(3)
+        d, s = self._coo(rng)
+        assert abs(float(np.asarray(sparse.sum(s))) - d.sum()) < 1e-4
+        np.testing.assert_allclose(
+            np.asarray(sparse.sum(s, axis=0).to_dense()), d.sum(0),
+            atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(sparse.sum(s, axis=1, keepdim=True).to_dense()),
+            d.sum(1, keepdims=True), atol=1e-5)
+
+    def test_reshape_slice_mask_mv_addmm(self):
+        rng = np.random.RandomState(4)
+        d, s = self._coo(rng)
+        np.testing.assert_allclose(
+            np.asarray(sparse.reshape(s, [2, 12]).to_dense()),
+            d.reshape(2, 12), atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sparse.slice(s, [0, 1], [1, 2], [3, 5]).to_dense()),
+            d[1:3, 2:5], atol=1e-6)
+        m = sparse.mask_as(paddle.to_tensor(np.ones((4, 6), np.float32)), s)
+        assert m.nnz == s.coalesce().nnz
+        vec = rng.randn(6).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sparse.mv(s, paddle.to_tensor(vec))), d @ vec,
+            atol=1e-4)
+        inp = rng.randn(4, 3).astype(np.float32)
+        y = rng.randn(6, 3).astype(np.float32)
+        am = sparse.addmm(paddle.to_tensor(inp), s, paddle.to_tensor(y),
+                          beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(np.asarray(am), 0.5 * inp + 2 * (d @ y),
+                                   atol=1e-4)
+
+    def test_subtract_divide_same_pattern(self):
+        rng = np.random.RandomState(5)
+        d, s = self._coo(rng)
+        s2 = sparse.mask_as(paddle.to_tensor((d * 3).astype(np.float32)), s)
+        np.testing.assert_allclose(
+            np.asarray(sparse.subtract(s2, s).to_dense()), d * 2, atol=1e-5)
+
+
+class TestSparseConvPool:
+    def test_conv2d_matches_dense_at_active_sites(self):
+        import jax.numpy as jnp
+        from jax import lax
+        import paddle_tpu.sparse.nn.functional as SF
+
+        rng = np.random.RandomState(1)
+        N, H, W, C, Co, K = 2, 6, 6, 3, 5, 3
+        mask = rng.rand(N, H, W) > 0.7
+        dense = (rng.randn(N, H, W, C) * mask[..., None]).astype(np.float32)
+        idx = np.stack(np.nonzero(mask)).astype(np.int32)
+        x = sparse.SparseCooTensor(idx, dense[tuple(idx)], (N, H, W),
+                                   coalesced=True)
+        w = (rng.randn(K, K, C, Co) * 0.1).astype(np.float32)
+        b = (rng.randn(Co) * 0.1).astype(np.float32)
+        for stride in (1, 2):
+            out = SF.conv2d(x, w, b if stride == 1 else None,
+                            stride=stride, padding=1)
+            ref = np.asarray(lax.conv_general_dilated(
+                jnp.asarray(dense), jnp.asarray(w), (stride, stride),
+                [(1, 1), (1, 1)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC")))
+            if stride == 1:
+                ref = ref + b
+            od = np.asarray(out.to_dense())
+            oi = np.asarray(out._indices)
+            for t in range(oi.shape[1]):
+                n, h, wx = oi[:, t]
+                np.testing.assert_allclose(od[n, h, wx], ref[n, h, wx],
+                                           atol=1e-4)
+
+    def test_subm_conv_preserves_pattern(self):
+        import paddle_tpu.sparse.nn.functional as SF
+        rng = np.random.RandomState(2)
+        mask = rng.rand(1, 5, 5) > 0.6
+        dense = (rng.randn(1, 5, 5, 2) * mask[..., None]).astype(np.float32)
+        idx = np.stack(np.nonzero(mask)).astype(np.int32)
+        x = sparse.SparseCooTensor(idx, dense[tuple(idx)], (1, 5, 5),
+                                   coalesced=True)
+        w = rng.randn(3, 3, 2, 4).astype(np.float32)
+        out = SF.subm_conv2d(x, w, padding=1)
+        assert np.asarray(out._indices).shape == idx.shape
+
+    def test_max_pool3d(self):
+        import paddle_tpu.sparse.nn.functional as SF
+        rng = np.random.RandomState(3)
+        N, D, H, W, C = 2, 4, 4, 4, 3
+        m = rng.rand(N, D, H, W) > 0.6
+        dn = (rng.randn(N, D, H, W, C) * m[..., None]).astype(np.float32)
+        i3 = np.stack(np.nonzero(m)).astype(np.int32)
+        x = sparse.SparseCooTensor(i3, dn[tuple(i3)], (N, D, H, W),
+                                   coalesced=True)
+        p = SF.max_pool3d(x, 2, 2)
+        pi = np.asarray(p._indices)
+        pv = np.asarray(p.values().numpy())
+        for t in range(pi.shape[1]):
+            n, dz, h, wx = pi[:, t]
+            win = dn[n, dz*2:dz*2+2, h*2:h*2+2, wx*2:wx*2+2]
+            winm = m[n, dz*2:dz*2+2, h*2:h*2+2, wx*2:wx*2+2]
+            np.testing.assert_allclose(pv[t], win[winm].max(axis=0),
+                                       atol=1e-5)
+
+    def test_layer_chain_and_batchnorm(self):
+        import paddle_tpu.sparse.nn as snn
+        rng = np.random.RandomState(4)
+        m = rng.rand(2, 4, 4, 4) > 0.6
+        dn = (rng.randn(2, 4, 4, 4, 3) * m[..., None]).astype(np.float32)
+        i3 = np.stack(np.nonzero(m)).astype(np.int32)
+        x = sparse.SparseCooTensor(i3, dn[tuple(i3)], (2, 4, 4, 4),
+                                   coalesced=True)
+        conv = snn.SubmConv3D(3, 8, 3, padding=1)
+        bn = snn.BatchNorm(8)
+        bn.train()
+        out = snn.ReLU()(bn(conv(x)))
+        v = np.asarray(out.values().numpy())
+        assert v.min() >= 0 and v.shape[1] == 8
+        # eval path uses running stats
+        bn.eval()
+        out2 = bn(conv(x))
+        assert np.asarray(out2.values().numpy()).shape == v.shape
+        # convert_sync_batchnorm
+        sync = snn.SyncBatchNorm.convert_sync_batchnorm(bn)
+        assert isinstance(sync, snn.SyncBatchNorm)
+
+
+class TestSparseAttention:
+    def test_full_mask_matches_dense(self):
+        import paddle_tpu.sparse.nn.functional as SF
+        rng = np.random.RandomState(5)
+        B, Hh, S, Dd = 2, 2, 8, 4
+        q, k, v = (rng.randn(B, Hh, S, Dd).astype(np.float32)
+                   for _ in range(3))
+        ii = np.stack(np.meshgrid(np.arange(B * Hh), np.arange(S),
+                                  np.arange(S), indexing="ij"), 0) \
+            .reshape(3, -1).astype(np.int32)
+        mask = sparse.SparseCooTensor(ii, np.ones(ii.shape[1], np.float32),
+                                      (B * Hh, S, S), coalesced=True)
+        out = np.asarray(SF.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            mask).numpy())
+        att = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(Dd)
+        att = np.exp(att - att.max(-1, keepdims=True))
+        att /= att.sum(-1, keepdims=True)
+        ref = np.einsum("bhst,bhtd->bhsd", att, v)
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_causal_mask_zeroes_future(self):
+        import paddle_tpu.sparse.nn.functional as SF
+        rng = np.random.RandomState(6)
+        B, Hh, S, Dd = 1, 1, 6, 4
+        q, k, v = (rng.randn(B, Hh, S, Dd).astype(np.float32)
+                   for _ in range(3))
+        rows, cols = np.tril_indices(S)
+        ii = np.stack([np.zeros_like(rows), rows, cols]).astype(np.int32)
+        mask = sparse.SparseCooTensor(ii, np.ones(len(rows), np.float32),
+                                      (1, S, S), coalesced=True)
+        out = np.asarray(SF.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            mask).numpy())
+        att = np.einsum("bhsd,bhtd->bhst", q, k) / np.sqrt(Dd)
+        att = np.where(np.tril(np.ones((S, S))) > 0, att, -np.inf)
+        att = np.exp(att - att.max(-1, keepdims=True))
+        att /= att.sum(-1, keepdims=True)
+        ref = np.einsum("bhst,bhtd->bhsd", att, v)
+        np.testing.assert_allclose(out, ref, atol=1e-4)
