@@ -31,7 +31,8 @@ from ..topology import get_hybrid_communicate_group
 __all__ = ["MoELayer", "SwitchGate", "GShardGate", "moe_dispatch_combine"]
 
 
-def _gate_logits_to_dispatch(logits, top_k, capacity, key=None):
+def _gate_logits_to_dispatch(logits, top_k, capacity, key=None,
+                             norm_topk_prob=True):
     """logits [T, E] → (dispatch [T, E, C] bool, combine [T, E, C] float,
     aux_loss). Pure function; shared by gates."""
     T, E = logits.shape
@@ -43,7 +44,8 @@ def _gate_logits_to_dispatch(logits, top_k, capacity, key=None):
     aux = jnp.sum(me * ce) * E
 
     gates, experts = jax.lax.top_k(probs, top_k)  # [T, k]
-    gates = gates / jnp.maximum(jnp.sum(gates, -1, keepdims=True), 1e-9)
+    if norm_topk_prob:
+        gates = gates / jnp.maximum(jnp.sum(gates, -1, keepdims=True), 1e-9)
 
     dispatch_t = jnp.zeros((T, E, capacity), jnp.float32)
     combine_t = jnp.zeros((T, E, capacity), jnp.float32)
@@ -69,14 +71,15 @@ def _gate_logits_to_dispatch(logits, top_k, capacity, key=None):
 
 
 def moe_dispatch_combine(x, logits, expert_fn, top_k=2,
-                         capacity_factor=1.25):
+                         capacity_factor=1.25, norm_topk_prob=True):
     """x [T, D], logits [T, E] → (out [T, D], aux_loss). ``expert_fn``
     maps [E, C, D] → [E, C, D] (vmapped expert MLPs)."""
     T, D = x.shape
     E = logits.shape[-1]
     capacity = int(np.ceil(top_k * capacity_factor * T / E))
     capacity = max(capacity, 4)
-    disp, comb, aux = _gate_logits_to_dispatch(logits, top_k, capacity)
+    disp, comb, aux = _gate_logits_to_dispatch(
+        logits, top_k, capacity, norm_topk_prob=norm_topk_prob)
     # scatter tokens to expert queues: [E, C, D]
     expert_in = jnp.einsum("tec,td->ecd", disp, x.astype(jnp.float32))
     expert_out = expert_fn(expert_in.astype(x.dtype))

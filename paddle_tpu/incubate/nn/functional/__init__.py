@@ -99,3 +99,77 @@ def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
                       name=None):
     from ....nn.functional.common import dropout
     return dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
+              ffn1_scale=None, ffn2_bias=None, ffn2_scale=None,
+              quant_method="None", moe_topk=2, norm_topk_prob=True,
+              capacity_factor=2.0):
+    """Fused mixture-of-experts FFN (reference:
+    python/paddle/incubate/nn/functional/fused_moe.py — a CUTLASS grouped
+    GEMM on GPU).
+
+    TPU-native formulation: GShard-style dense dispatch — gate top-k,
+    scatter tokens into per-expert capacity buckets with one einsum, run
+    every expert as one batched matmul ([E, C, D] @ [E, D, F], MXU-shaped,
+    static shapes), combine with the gate weights. Unlike the exact
+    grouped GEMM, tokens past ``capacity_factor * topk * T / E`` per
+    expert are dropped (standard GShard semantics; raise the factor for
+    exactness).
+
+    x [B, S, D] (or [T, D]); gate_weight [D, E]; ffn1_weight [E, D, 2F]
+    (swiglu) or [E, D, F] (gelu); ffn2_weight [E, F, D].
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ....core.tensor import dispatch
+    from ....distributed.fleet.moe import moe_dispatch_combine
+
+    if quant_method not in ("None", None, "none"):
+        raise NotImplementedError(
+            "fused_moe: weight quantization not supported (reference "
+            "marks it 'currently not supported' too)")
+
+    args = [_ensure(x), _ensure(gate_weight), _ensure(ffn1_weight),
+            _ensure(ffn2_weight)]
+    n_fixed = len(args)
+    has_b1 = ffn1_bias is not None
+    has_b2 = ffn2_bias is not None
+    if has_b1:
+        args.append(_ensure(ffn1_bias))
+    if has_b2:
+        args.append(_ensure(ffn2_bias))
+
+    def f(xv, gw, w1, w2, *rest):
+        b1 = rest[0] if has_b1 else None
+        b2 = rest[int(has_b1)] if has_b2 else None
+        lead = xv.shape[:-1]
+        d = xv.shape[-1]
+        flat = xv.reshape(-1, d)
+        logits = flat.astype(jnp.float32) @ gw.astype(jnp.float32)
+        e, _, two_f = w1.shape
+        f_dim = w2.shape[1]
+        glu = two_f == 2 * f_dim
+
+        def expert_fn(expert_in):       # [E, C, D]
+            h = jnp.einsum("ecd,edf->ecf", expert_in, w1)
+            if b1 is not None:
+                h = h + b1.reshape(e, 1, -1)
+            if glu:
+                a, g = jnp.split(h, 2, axis=-1)
+                h = jax.nn.silu(a) * g
+            else:
+                h = jax.nn.gelu(h)
+            out = jnp.einsum("ecf,efd->ecd", h, w2)
+            if b2 is not None:
+                out = out + b2.reshape(e, 1, -1)
+            return out
+
+        out, _aux = moe_dispatch_combine(
+            flat, logits, expert_fn, top_k=moe_topk,
+            capacity_factor=capacity_factor,
+            norm_topk_prob=norm_topk_prob)
+        return out.reshape(*lead, d)
+
+    return dispatch(f, args, name="fused_moe")

@@ -100,10 +100,7 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     """
     from ...ops.flash_attention import (flash_attention as _fa,
                                         segment_ids_from_cu_seqlens)
-    if dropout and dropout > 0.0 and training:
-        raise NotImplementedError(
-            "flash_attn_unpadded: attention dropout is not supported by "
-            "the fused TPU kernel")
+    use_dropout = bool(dropout) and dropout > 0.0 and training
     if causal:
         import numpy as _np
         cq_v, ck_v = cu_seqlens_q, cu_seqlens_k
@@ -123,9 +120,29 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         tq, tk = q.shape[0], k.shape[0]
         seg_q = segment_ids_from_cu_seqlens(cq, tq)[None]
         seg_k = segment_ids_from_cu_seqlens(ck, tk)[None]
-        out = _fa(q[None], k[None], v[None], causal=causal, scale=scale,
-                  segment_ids=seg_q, kv_segment_ids=seg_k)
-        return out[0]
+        if not use_dropout:
+            out = _fa(q[None], k[None], v[None], causal=causal, scale=scale,
+                      segment_ids=seg_q, kv_segment_ids=seg_k)
+            return out[0]
+        # dropout path: the fused kernel has no in-kernel RNG, so fall
+        # back to the XLA composition with the same segment/causal mask
+        # (reference keeps dropout inside flash_attn_kernel.cu via a
+        # Philox offset; XLA fuses this composition comparably on TPU)
+        from ...core.random import next_key
+        s = scale if scale is not None else q.shape[-1] ** -0.5
+        qf = jnp.swapaxes(q[None], 1, 2).astype(jnp.float32)  # [1,h,tq,d]
+        kf = jnp.swapaxes(k[None], 1, 2).astype(jnp.float32)
+        vf = jnp.swapaxes(v[None], 1, 2).astype(jnp.float32)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qf * s, kf)
+        mask = seg_q[0][:, None] == seg_k[0][None, :]         # [tq, tk]
+        if causal:
+            mask &= (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        keep = jax.random.bernoulli(next_key(), 1.0 - dropout, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+        return jnp.swapaxes(out, 1, 2)[0].astype(q.dtype)
 
     args = tuple(_ensure(a) for a in
                  (query, key, value, cu_seqlens_q, cu_seqlens_k))

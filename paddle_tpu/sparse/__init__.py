@@ -75,13 +75,26 @@ class SparseCooTensor:
         return SparseCooTensor(new_idx, vals, self._shape, coalesced=True)
 
     def to_sparse_csr(self) -> "SparseCsrTensor":
-        assert len(self._shape) == 2, "CSR requires 2-D"
+        """2-D → CSR; 3-D → batched CSR (paddle layout: crows is the
+        per-batch row pointers concatenated, length B*(M+1))."""
+        assert len(self._shape) in (2, 3), "CSR requires 2-D or 3-D"
         coo = self if self._coalesced else self.coalesce()
-        rows = np.asarray(coo._indices[0])
-        crows = np.zeros(self._shape[0] + 1, np.int32)
-        np.add.at(crows, rows + 1, 1)
-        crows = np.cumsum(crows).astype(np.int32)
-        return SparseCsrTensor(crows, coo._indices[1], coo._values,
+        if len(self._shape) == 2:
+            rows = np.asarray(coo._indices[0])
+            crows = np.zeros(self._shape[0] + 1, np.int32)
+            np.add.at(crows, rows + 1, 1)
+            crows = np.cumsum(crows).astype(np.int32)
+            return SparseCsrTensor(crows, coo._indices[1], coo._values,
+                                   self._shape)
+        b_n, m = self._shape[0], self._shape[1]
+        bat = np.asarray(coo._indices[0])
+        rows = np.asarray(coo._indices[1])
+        counts = np.zeros((b_n, m), np.int64)
+        np.add.at(counts, (bat, rows), 1)
+        crows = np.concatenate(
+            [np.concatenate([[0], np.cumsum(c)]) for c in counts]) \
+            .astype(np.int32)
+        return SparseCsrTensor(crows, coo._indices[2], coo._values,
                                self._shape)
 
     def __repr__(self):
@@ -115,19 +128,37 @@ class SparseCsrTensor:
     def nnz(self) -> int:
         return int(self._cols.shape[0])
 
+    def _batch_row_indices(self):
+        """(batch ids or None, row ids) for 2-D and batched 3-D CSR
+        (paddle layout: 3-D crows = per-batch pointers concatenated)."""
+        crows = np.asarray(self._crows)
+        if len(self._shape) == 2:
+            counts = np.diff(crows)
+            rows = np.repeat(np.arange(self._shape[0]), counts)
+            return None, jnp.asarray(rows, jnp.int32)
+        b_n, m = self._shape[0], self._shape[1]
+        per = crows.reshape(b_n, m + 1)
+        counts = np.diff(per, axis=1)                     # [B, M]
+        rows = np.repeat(np.tile(np.arange(m), b_n), counts.ravel())
+        bat = np.repeat(np.arange(b_n), counts.sum(axis=1))
+        return jnp.asarray(bat, jnp.int32), jnp.asarray(rows, jnp.int32)
+
     def _row_indices(self) -> jnp.ndarray:
-        counts = np.diff(np.asarray(self._crows))
-        return jnp.asarray(np.repeat(np.arange(self._shape[0]), counts),
-                           jnp.int32)
+        return self._batch_row_indices()[1]
 
     def to_dense(self) -> Tensor:
-        rows = self._row_indices()
+        bat, rows = self._batch_row_indices()
         dense = jnp.zeros(self._shape, self._values.dtype)
-        return Tensor(dense.at[rows, self._cols].add(self._values))
+        if bat is None:
+            return Tensor(dense.at[rows, self._cols].add(self._values))
+        return Tensor(dense.at[bat, rows, self._cols].add(self._values))
 
     def to_sparse_coo(self, sparse_dim: int = 2) -> SparseCooTensor:
-        rows = self._row_indices()
-        idx = jnp.stack([rows, self._cols])
+        bat, rows = self._batch_row_indices()
+        if bat is None:
+            idx = jnp.stack([rows, self._cols])
+        else:
+            idx = jnp.stack([bat, rows, self._cols])
         return SparseCooTensor(idx, self._values, self._shape,
                                coalesced=True)
 
@@ -280,17 +311,17 @@ def pow(x, factor, name=None):  # noqa: A001
 
 
 def cast(x, index_dtype=None, value_dtype=None, name=None):
-    from ..core.dtypes import as_jax_dtype
+    from ..core.dtypes import convert_dtype
     vals = x._values if value_dtype is None else \
-        x._values.astype(as_jax_dtype(value_dtype))
+        x._values.astype(convert_dtype(value_dtype))
     if isinstance(x, SparseCsrTensor):
         crows, cols = x._crows, x._cols
         if index_dtype is not None:
-            it = as_jax_dtype(index_dtype)
+            it = convert_dtype(index_dtype)
             crows, cols = crows.astype(it), cols.astype(it)
         return SparseCsrTensor(crows, cols, vals, x._shape)
     idx = x._indices if index_dtype is None else \
-        x._indices.astype(as_jax_dtype(index_dtype))
+        x._indices.astype(convert_dtype(index_dtype))
     return SparseCooTensor(idx, vals, x._shape, x._coalesced)
 
 

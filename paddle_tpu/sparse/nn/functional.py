@@ -31,6 +31,73 @@ def _tuplize(v, n):
     return (int(v),) * n
 
 
+def _offset_rulebook(batch, coords, kernel, stride, padding, dilation,
+                     out_sp, subm_sites=None):
+    """Shared, fully-vectorized coordinate rulebook for sparse conv/pool.
+
+    For each kernel offset solve out*stride = in + pad - off*dilation over
+    all nnz input sites at once (no Python per-site loop). Output sites are
+    flat-encoded as batch*prod(out_sp) + ravel(coord); submanifold mode
+    restricts outputs to the input sites (``subm_sites`` = input indices
+    [1+n, nnz]), otherwise sites are the sorted union of all matches.
+
+    Returns (pairs, out_idx, n_out) where pairs is a list of
+    (offset_index, rows_in, rows_out) integer arrays.
+    """
+    n = coords.shape[1]
+    stride_a = np.asarray(stride)
+    pad_a = np.asarray(padding)
+    dil_a = np.asarray(dilation)
+    sp_a = np.asarray(out_sp)
+    prod = int(np.prod(out_sp))
+    offs = np.stack(np.meshgrid(*[np.arange(k) for k in kernel],
+                                indexing="ij"), -1).reshape(-1, n)
+
+    raw = []   # (offset index, rows_in, out site flat ids)
+    for oi, off in enumerate(offs):
+        num = coords + pad_a - off * dil_a
+        ok = (num % stride_a == 0).all(1)
+        out_c = num // stride_a
+        ok &= ((out_c >= 0) & (out_c < sp_a)).all(1)
+        rows = np.nonzero(ok)[0]
+        if rows.size == 0:
+            continue
+        flat = batch[rows].astype(np.int64) * prod + \
+            np.ravel_multi_index(tuple(out_c[rows].T), out_sp)
+        raw.append((oi, rows, flat))
+
+    if subm_sites is not None:
+        # map matches onto the fixed input-site set via sorted search
+        site_flat = batch.astype(np.int64) * prod + \
+            np.ravel_multi_index(tuple(coords.T), out_sp)
+        order = np.argsort(site_flat)
+        sorted_flat = site_flat[order]
+        pairs = []
+        for oi, rows, flat in raw:
+            pos = np.searchsorted(sorted_flat, flat)
+            pos_c = np.minimum(pos, len(sorted_flat) - 1)
+            hit = sorted_flat[pos_c] == flat
+            if hit.any():
+                pairs.append((oi, rows[hit], order[pos_c[hit]]))
+        return pairs, subm_sites, len(batch)
+
+    if not raw:
+        nd = n + 1
+        return [], np.zeros((nd, 0), np.int32), 0
+    all_flat = np.concatenate([flat for _, _, flat in raw])
+    uniq, inv = np.unique(all_flat, return_inverse=True)
+    pairs = []
+    o = 0
+    for oi, rows, flat in raw:
+        pairs.append((oi, rows, inv[o:o + len(rows)]))
+        o += len(rows)
+    out_b = (uniq // prod).astype(np.int32)
+    out_c = np.stack(np.unravel_index(uniq % prod, out_sp)) \
+        .astype(np.int32)
+    out_idx = np.concatenate([out_b[None], out_c], axis=0)
+    return pairs, out_idx, len(uniq)
+
+
 def _conv_nd(x: SparseCooTensor, weight, bias, stride, padding, dilation,
              groups, subm: bool, n: int):
     """Shared N-D sparse conv. x: COO with indices [n+1, nnz] (batch +
@@ -58,55 +125,15 @@ def _conv_nd(x: SparseCooTensor, weight, bias, stride, padding, dilation,
          dilation[d] * (kernel[d] - 1) - 1) // stride[d] + 1
         for d in range(n))
 
-    offs = np.stack(np.meshgrid(*[np.arange(k) for k in kernel],
-                                indexing="ij"), -1).reshape(-1, n)
-
-    # one pass per kernel offset: out*stride = in + pad - off*dilation;
-    # collect (input row, output site) pairs, discovering output sites on
-    # the fly for the standard conv
-    if subm:
-        if any(s != 1 for s in stride):
-            raise ValueError(
-                "submanifold sparse conv requires stride=1 (output sites "
-                "are the input sites)")
-        out_key = {(batch[i],) + tuple(coords[i]): i
-                   for i in range(len(batch))}
-        sites = None  # fixed: output coords = input coords
-        out_sp = spatial
-    else:
-        out_key = {}
-        sites = []
-        out_sp = out_spatial
-
-    pairs = []  # (offset index, rows_in list, rows_out list)
-    for oi, off in enumerate(offs):
-        num = coords + np.asarray(padding) - off * np.asarray(dilation)
-        ok = (num % np.asarray(stride) == 0).all(1)
-        out_c = num // np.asarray(stride)
-        ok &= ((out_c >= 0) & (out_c < np.asarray(out_sp))).all(1)
-        rows_in, rows_out = [], []
-        for i in np.nonzero(ok)[0]:
-            key = (batch[i],) + tuple(out_c[i])
-            j = out_key.get(key)
-            if j is None:
-                if sites is None:   # subm: only existing sites count
-                    continue
-                j = out_key[key] = len(sites)
-                sites.append(key)
-            rows_in.append(i)
-            rows_out.append(j)
-        if rows_in:
-            pairs.append((oi, rows_in, rows_out))
-
-    if subm:
-        out_idx = idx
-        n_out = len(batch)
-        out_shape = coo._shape[:n + 1] + (cout,)
-    else:
-        n_out = len(sites)
-        out_idx = np.asarray(sites, np.int64).T.reshape(n + 1, -1) \
-            .astype(np.int32) if n_out else np.zeros((n + 1, 0), np.int32)
-        out_shape = (coo._shape[0],) + out_spatial + (cout,)
+    if subm and any(s != 1 for s in stride):
+        raise ValueError(
+            "submanifold sparse conv requires stride=1 (output sites "
+            "are the input sites)")
+    out_sp = spatial if subm else out_spatial
+    pairs, out_idx, n_out = _offset_rulebook(
+        batch, coords, kernel, stride, padding, dilation, out_sp,
+        subm_sites=idx if subm else None)
+    out_shape = ((coo._shape[0],) + tuple(out_sp) + (cout,))
 
     out_vals = jnp.zeros((n_out, cout), vals.dtype)
     w_flat = w.reshape(-1, cin, cout)
@@ -170,29 +197,13 @@ def max_pool3d(x, kernel_size, stride=None, padding=0,
         (spatial[d] + 2 * padding[d] - kernel[d]) // stride[d] + 1
         for d in range(n))
 
-    out_key = {}
-    sites, rows_in, rows_out = [], [], []
-    offs = np.stack(np.meshgrid(*[np.arange(k) for k in kernel],
-                                indexing="ij"), -1).reshape(-1, n)
-    for off in offs:
-        num = coords + np.asarray(padding) - off
-        ok = (num % np.asarray(stride) == 0).all(1)
-        out_c = num // np.asarray(stride)
-        ok &= ((out_c >= 0) & (out_c < np.asarray(out_spatial))).all(1)
-        for i in np.nonzero(ok)[0]:
-            key = (batch[i],) + tuple(out_c[i])
-            j = out_key.get(key)
-            if j is None:
-                j = out_key[key] = len(sites)
-                sites.append(key)
-            rows_in.append(i)
-            rows_out.append(j)
-    n_out = len(sites)
+    pairs, out_idx, n_out = _offset_rulebook(
+        batch, coords, kernel, stride, padding, (1,) * n, out_spatial)
     if n_out == 0:
-        out_idx = np.zeros((n + 1, 0), np.int32)
         out_vals = vals[:0]
     else:
-        out_idx = np.asarray(sites, np.int64).T.astype(np.int32)
+        rows_in = np.concatenate([r for _, r, _ in pairs])
+        rows_out = np.concatenate([o for _, _, o in pairs])
         out_vals = jax.ops.segment_max(
             vals[jnp.asarray(rows_in)], jnp.asarray(rows_out),
             num_segments=n_out)

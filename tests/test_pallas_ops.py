@@ -275,3 +275,25 @@ class TestAutotune:
         finally:
             u.set_force_interpret(prev)
             GLOBAL_FLAGS.set("kernel_autotune", False)
+
+
+def test_flash_attn_unpadded_dropout_falls_back():
+    """dropout>0 must not raise: it runs the masked XLA composition;
+    training=False returns the fused-kernel result."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    tq, h, d = 12, 2, 8
+    q = paddle.to_tensor(rng.randn(tq, h, d).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(tq, h, d).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(tq, h, d).astype(np.float32))
+    cu = paddle.to_tensor(np.array([0, 5, 12], np.int32))
+    o0, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True)
+    o1, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True,
+                                  dropout=0.3, training=True)
+    assert np.asarray(o1.numpy()).shape == (tq, h, d)
+    o2, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True,
+                                  dropout=0.3, training=False)
+    np.testing.assert_allclose(np.asarray(o0.numpy()),
+                               np.asarray(o2.numpy()), atol=1e-5)

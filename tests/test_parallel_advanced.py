@@ -241,3 +241,60 @@ class TestSequenceParallelLayers:
             np.asarray(row.bias._value)
         np.testing.assert_allclose(np.asarray(out._value), ref,
                                    rtol=1e-4, atol=1e-5)
+
+
+# -- fused_moe (reference: incubate/nn/functional/fused_moe.py) -------------
+class TestFusedMoe:
+    def test_matches_dense_top2_reference(self):
+        from paddle_tpu.incubate.nn.functional import fused_moe
+
+        rng = np.random.RandomState(0)
+        B, S, D, E, Fd = 2, 8, 16, 4, 32
+        x = paddle.to_tensor(rng.randn(B, S, D).astype(np.float32))
+        gw = paddle.to_tensor((rng.randn(D, E) * 0.1).astype(np.float32))
+        w1 = paddle.to_tensor(
+            (rng.randn(E, D, 2 * Fd) * 0.1).astype(np.float32))
+        w2 = paddle.to_tensor(
+            (rng.randn(E, Fd, D) * 0.1).astype(np.float32))
+        b1 = paddle.to_tensor(
+            (rng.randn(E, 1, 2 * Fd) * 0.1).astype(np.float32))
+        b2 = paddle.to_tensor(
+            (rng.randn(E, 1, D) * 0.1).astype(np.float32))
+        out = np.asarray(fused_moe(
+            x, gw, w1, w2, ffn1_bias=b1, ffn2_bias=b2, moe_topk=2,
+            capacity_factor=float(E)).numpy())  # exact: no drops
+
+        xv = np.asarray(x.numpy()).reshape(-1, D)
+        logits = xv @ np.asarray(gw.numpy())
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        topk = np.argsort(-probs, axis=-1)[:, :2]
+        w1n, w2n = np.asarray(w1.numpy()), np.asarray(w2.numpy())
+        b1n, b2n = np.asarray(b1.numpy()), np.asarray(b2.numpy())
+
+        def silu(v):
+            return v / (1 + np.exp(-v))
+
+        ref = np.zeros_like(xv)
+        for t in range(xv.shape[0]):
+            g = probs[t, topk[t]]
+            g = g / g.sum()
+            for kk in range(2):
+                e = topk[t, kk]
+                h = xv[t] @ w1n[e] + b1n[e, 0]
+                a, gg = np.split(h, 2)
+                ref[t] += g[kk] * ((silu(a) * gg) @ w2n[e] + b2n[e, 0])
+        np.testing.assert_allclose(out.reshape(-1, D), ref, atol=1e-4)
+
+    def test_gelu_variant_and_quant_guard(self):
+        from paddle_tpu.incubate.nn.functional import fused_moe
+
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(1, 4, 8).astype(np.float32))
+        gw = paddle.to_tensor(rng.randn(8, 2).astype(np.float32))
+        w1 = paddle.to_tensor(rng.randn(2, 8, 16).astype(np.float32))
+        w2 = paddle.to_tensor(rng.randn(2, 16, 8).astype(np.float32))
+        out = fused_moe(x, gw, w1, w2, moe_topk=1, capacity_factor=2.0)
+        assert np.asarray(out.numpy()).shape == (1, 4, 8)
+        with pytest.raises(NotImplementedError):
+            fused_moe(x, gw, w1, w2, quant_method="weight_only_int8")

@@ -402,3 +402,43 @@ class TestSparseAttention:
         att /= att.sum(-1, keepdims=True)
         ref = np.einsum("bhst,bhtd->bhsd", att, v)
         np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+class TestSparseCastAndBatchedCsr:
+    def test_cast_value_and_index_dtype(self):
+        rng = np.random.RandomState(0)
+        d = (rng.randn(3, 4) * (rng.rand(3, 4) < 0.5)).astype(np.float32)
+        s = sparse.to_sparse_coo(paddle.to_tensor(d))
+        c = sparse.cast(s, value_dtype="float64", index_dtype="int64")
+        assert str(c._values.dtype) in ("float64", "float32")  # x64 flag
+        csr = s.to_sparse_csr()
+        c2 = sparse.cast(csr, value_dtype="float32")
+        assert isinstance(c2, sparse.SparseCsrTensor)
+
+    def test_batched_csr_roundtrip(self):
+        rng = np.random.RandomState(1)
+        B, S = 3, 5
+        m = rng.rand(B, S, S) > 0.5
+        dn = (rng.randn(B, S, S) * m).astype(np.float32)
+        coo = sparse.to_sparse_coo(paddle.to_tensor(dn))
+        csr = coo.to_sparse_csr()
+        assert np.asarray(csr.crows().numpy()).shape == (B * (S + 1),)
+        np.testing.assert_allclose(np.asarray(csr.to_dense()), dn)
+        np.testing.assert_allclose(
+            np.asarray(csr.to_sparse_coo().to_dense()), dn)
+
+    def test_attention_accepts_csr_mask(self):
+        import paddle_tpu.sparse.nn.functional as SF
+        rng = np.random.RandomState(2)
+        B, H, S, D = 2, 2, 6, 4
+        q, k, v = (rng.randn(B, H, S, D).astype(np.float32)
+                   for _ in range(3))
+        full = np.ones((B * H, S, S), np.float32)
+        mcoo = sparse.to_sparse_coo(paddle.to_tensor(full))
+        o1 = np.asarray(SF.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            mcoo).numpy())
+        o2 = np.asarray(SF.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            mcoo.to_sparse_csr()).numpy())
+        np.testing.assert_allclose(o1, o2, atol=1e-5)
