@@ -424,6 +424,13 @@ def dispatch(fn, tensor_args: Sequence[Any], name: str = "op",
         tracer.add_event(name, t0, _time.perf_counter_ns())
 
 
+# static.Program recorder hook: when a Program is being built under
+# static.program_guard, every dispatched op is appended to it so the
+# Program can be replayed with new feed values (the TPU-native analog of
+# ProgramDesc building, reference python/paddle/base/framework.py Program)
+_PROGRAM_RECORDER = [None]
+
+
 def _dispatch_impl(fn, tensor_args: Sequence[Any], name: str = "op",
                    multi_output: bool = False, **static_kwargs):
     """Eager op dispatch: the TPU-native analog of the generated
@@ -458,6 +465,9 @@ def _dispatch_impl(fn, tensor_args: Sequence[Any], name: str = "op",
         result = tuple(
             Tensor(o, stop_gradient=True) if not isinstance(o, Tensor) else o
             for o in outs)
+        if _PROGRAM_RECORDER[0] is not None:
+            _PROGRAM_RECORDER[0]._record(name, fn, tensor_args, values,
+                                         result, multi_output)
         return result if multi_output else result[0]
 
     out_vals, vjp_fn = jax.vjp(fn, *values)
@@ -474,6 +484,9 @@ def _dispatch_impl(fn, tensor_args: Sequence[Any], name: str = "op",
         results.append(t)
     if GLOBAL_FLAGS.get("benchmark"):
         jax.block_until_ready(out_vals)
+    if _PROGRAM_RECORDER[0] is not None:
+        _PROGRAM_RECORDER[0]._record(name, fn, tensor_args, values,
+                                     tuple(results), multi_output)
     return tuple(results) if multi_output else results[0]
 
 

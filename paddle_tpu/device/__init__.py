@@ -286,3 +286,166 @@ def _resolve(device):
             or jax.local_devices()
         return devs[int(idx) if idx else 0]
     return device
+
+
+# -- Stream / Event (reference: python/paddle/device/__init__.py Stream,
+# Event, current_stream, stream_guard; paddle/phi/core/device_context.h) --
+#
+# TPU-native semantics: XLA owns the hardware queues — every dispatch is
+# async on ONE compute stream per device, and the latency-hiding scheduler
+# replaces the reference's manual calc/comm stream split. This surface
+# keeps the reference API contract (record/query/synchronize/wait
+# ordering) with the XLA execution model underneath: a Stream is a named
+# handle on a device's dispatch queue; an Event records a completion
+# marker (a token array enqueued at record time) whose readiness tracks
+# everything dispatched before it.
+class Event:
+    """reference: paddle.device.Event / cuda.Event."""
+
+    def __init__(self, device=None, enable_timing: bool = False,
+                 blocking: bool = False, interprocess: bool = False):
+        self._device = _resolve_stream_device(device)
+        self._arrays = None
+        self._t_record = None
+        self._t_done = None
+        self.enable_timing = enable_timing
+
+    def record(self, stream: "Stream" = None) -> None:
+        """Mark a point behind all work dispatched so far: capture the
+        arrays currently live on the device — their readiness implies
+        every computation enqueued before this point has completed (a
+        host-to-device token would ride the DMA path and NOT be ordered
+        behind compute)."""
+        import time as _time
+        dev = stream._device if stream is not None else self._device
+        self._arrays = [a for a in jax.live_arrays()
+                        if dev in getattr(a, "devices", lambda: set())()]
+        self._t_record = _time.perf_counter()
+        self._t_done = None
+
+    def query(self) -> bool:
+        """True if all work recorded before the event has completed."""
+        if self._arrays is None:
+            return True
+        live = [a for a in self._arrays if not a.is_deleted()]
+        try:
+            return all(bool(a.is_ready()) for a in live)
+        except AttributeError:  # older jax: block (conservative)
+            self.synchronize()
+            return True
+
+    def synchronize(self) -> None:
+        import time as _time
+        if self._arrays is not None:
+            for a in self._arrays:
+                if not a.is_deleted():
+                    a.block_until_ready()
+            if self._t_done is None:
+                self._t_done = _time.perf_counter()
+
+    def elapsed_time(self, end_event: "Event") -> float:
+        """Milliseconds between two recorded+completed events. Host clock
+        (XLA exposes no device timestamps): measured as completion-time
+        delta when observed in order, falling back to the record-time
+        delta if the end event was synchronized out of order."""
+        if not (self.enable_timing and end_event.enable_timing):
+            raise RuntimeError(
+                "elapsed_time requires both events created with "
+                "Event(enable_timing=True)")
+        if self._arrays is None or end_event._arrays is None:
+            raise RuntimeError(
+                "elapsed_time: both events must be record()ed first")
+        self.synchronize()
+        end_event.synchronize()
+        dt = end_event._t_done - self._t_done
+        if dt <= 0.0:
+            dt = max(end_event._t_record - self._t_record, 0.0)
+        return dt * 1000.0
+
+
+class Stream:
+    """reference: paddle.device.Stream / cuda.Stream.
+
+    XLA schedules one compute stream per device; extra Streams are
+    ordering handles — work dispatched 'on' any stream of a device joins
+    that device's queue, so wait_event/wait_stream reduce to event
+    synchronization (the cross-stream overlap the reference manages by
+    hand is done by XLA's latency-hiding scheduler instead)."""
+
+    def __init__(self, device=None, priority: int = 2, blocking: bool =
+                 False):
+        self._device = _resolve_stream_device(device)
+        self.priority = priority
+
+    @property
+    def device(self):
+        return self._device
+
+    def synchronize(self) -> None:
+        """Block until everything dispatched on this device completes."""
+        e = Event(self._device)
+        e.record(self)
+        e.synchronize()
+
+    def record_event(self, event: Event = None) -> Event:
+        event = event or Event(self._device)
+        event.record(self)
+        return event
+
+    def wait_event(self, event: Event) -> None:
+        """Order subsequent host dispatch after ``event`` (single XLA
+        queue per device: completion wait gives the same ordering)."""
+        event.synchronize()
+
+    def wait_stream(self, stream: "Stream") -> None:
+        stream.synchronize()
+
+    def __eq__(self, other):
+        return (isinstance(other, Stream) and
+                self._device == other._device)
+
+
+def _resolve_stream_device(device=None):
+    """Stream/Event device resolution — the shared ``_resolve`` helper
+    (platform-filtered, exact-index) accepting jax Devices verbatim."""
+    return _resolve(device)
+
+
+_CURRENT_STREAM: dict = {}
+
+
+def current_stream(device=None) -> Stream:
+    """reference: paddle.device.current_stream."""
+    dev = _resolve_stream_device(device)
+    key = getattr(dev, "id", 0)
+    if key not in _CURRENT_STREAM:
+        _CURRENT_STREAM[key] = Stream(dev)
+    return _CURRENT_STREAM[key]
+
+
+def set_stream(stream: Stream) -> Stream:
+    """reference: paddle.device.set_stream."""
+    key = getattr(stream._device, "id", 0)
+    prev = current_stream(stream._device)
+    _CURRENT_STREAM[key] = stream
+    return prev
+
+
+class stream_guard:
+    """reference: paddle.device.stream_guard context manager."""
+
+    def __init__(self, stream: Stream):
+        self._stream = stream
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+        return False
+
+
+__all__ += ["Stream", "Event", "current_stream", "set_stream",
+            "stream_guard"]

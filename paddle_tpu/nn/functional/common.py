@@ -42,9 +42,12 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return dispatch(lambda v: v * (1.0 - p), (_ensure(x),),
                             name="dropout_infer")
         return _ensure(x)
-    key = next_key()
 
     def f(v):
+        # key drawn INSIDE the dispatched fn: static.Program replay and
+        # to_static re-trace then re-draw per run instead of baking the
+        # record-time mask as a constant
+        key = next_key()
         shape = list(v.shape)
         if axis is not None:
             axes = [axis] if isinstance(axis, int) else list(axis)
@@ -69,9 +72,9 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return _ensure(x)
-    key = next_key()
 
     def f(v):
+        key = next_key()
         alpha = 1.6732632423543772848170429916717
         scale = 1.0507009873554804934193349852946
         alpha_p = -alpha * scale

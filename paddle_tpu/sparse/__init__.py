@@ -345,12 +345,17 @@ def softmax(x, axis=-1, name=None):
     if axis not in (-1, len(x.shape) - 1):
         raise ValueError("sparse softmax only supports the last axis")
     if isinstance(x, SparseCsrTensor):
-        rows = x._row_indices()
-        n_rows = x._shape[0]
-        mx = jax.ops.segment_max(x._values, rows, num_segments=n_rows)
-        e = jnp.exp(x._values - mx[rows])
-        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
-        return SparseCsrTensor(x._crows, x._cols, e / denom[rows], x._shape)
+        bat, rows = x._batch_row_indices()
+        if bat is None:
+            seg, n_seg = rows, x._shape[0]
+        else:  # batched 3-D CSR: segment per (batch, row)
+            m = x._shape[1]
+            seg = bat * m + rows
+            n_seg = x._shape[0] * m
+        mx = jax.ops.segment_max(x._values, seg, num_segments=n_seg)
+        e = jnp.exp(x._values - mx[seg])
+        denom = jax.ops.segment_sum(e, seg, num_segments=n_seg)
+        return SparseCsrTensor(x._crows, x._cols, e / denom[seg], x._shape)
     xc = x if x._coalesced else x.coalesce()
     # group key: all dims except the last
     if len(xc._shape) == 1:
@@ -396,8 +401,9 @@ def mask_as(x, mask, name=None):
     sparse/binary.py mask_as)."""
     xv = to_value(x if isinstance(x, Tensor) else Tensor(x))
     if isinstance(mask, SparseCsrTensor):
-        rows = mask._row_indices()
-        vals = xv[rows, mask._cols]
+        bat, rows = mask._batch_row_indices()
+        vals = xv[rows, mask._cols] if bat is None \
+            else xv[bat, rows, mask._cols]
         return SparseCsrTensor(mask._crows, mask._cols, vals, mask._shape)
     vals = xv[tuple(mask._indices[i] for i in range(mask._indices.shape[0]))]
     return SparseCooTensor(mask._indices, vals, mask._shape,

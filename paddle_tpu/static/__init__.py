@@ -17,7 +17,9 @@ import jax.numpy as jnp
 from ..core.dtypes import convert_dtype
 from ..core.tensor import Tensor, to_value
 
-__all__ = ["InputSpec", "export_stablehlo", "Executor", "default_main_program"]
+__all__ = ["InputSpec", "export_stablehlo", "Executor",
+           "Program", "program_guard", "data",
+           "default_main_program", "default_startup_program", "nn"]
 
 _static_mode = [False]
 
@@ -54,25 +56,272 @@ def export_stablehlo(fn, example_args, static_argnums=()):
     return lowered.as_text()
 
 
+class Program:
+    """Recorded op-stream program (reference:
+    python/paddle/base/framework.py Program:5890 + ProgramDesc).
+
+    TPU-native design: instead of a hand-built ProgramDesc IR, ops
+    dispatched while this Program is active (under ``program_guard``) are
+    recorded as (pure fn, input slots, output slots) and the whole stream
+    is replayed as ONE ``jax.jit`` program per feed-shape signature at
+    ``Executor.run`` — the recorded stream IS the Program, jaxpr/XLA is
+    the IR (SURVEY §2.6 items 5/6). Parameters and other tensors created
+    at build time enter as captured externals, so ``exe.run(startup)`` is
+    a no-op retained for script parity."""
+
+    def __init__(self):
+        self._ops = []            # (name, fn, in_slots, out_ids, multi)
+        self._placeholders = {}   # feed name -> tensor id
+        self._externals = {}      # tensor id -> initial jax value
+        self._produced = set()    # tensor ids written by recorded ops
+        self._cache = {}          # feed signature -> compiled replay
+        self._keep = []           # strong refs: slot ids must not be
+        #                           reused by the allocator (id() identity)
+
+    # -- recording (called from core.tensor._dispatch_impl) -----------------
+    def _record(self, name, fn, tensor_args, values, results, multi):
+        in_slots = []
+        for a, v in zip(tensor_args, values):
+            if isinstance(a, Tensor):
+                tid = id(a)
+                if (tid not in self._produced and
+                        tid not in self._externals and
+                        tid not in self._placeholders.values()):
+                    self._externals[tid] = to_value(a)
+                in_slots.append(("var", tid))
+            else:
+                in_slots.append(("const", v))
+        out_ids = tuple(id(t) for t in results)
+        self._produced.update(out_ids)
+        self._ops.append((name, fn, tuple(in_slots), out_ids, multi))
+        self._keep.extend(a for a in tensor_args if isinstance(a, Tensor))
+        self._keep.extend(results)
+        self._cache.clear()
+
+    def _register_data(self, name, tensor):
+        self._placeholders[name] = id(tensor)
+        self._keep.append(tensor)
+        self._cache.clear()
+
+    # -- replay --------------------------------------------------------------
+    def _build_replay(self):
+        ops = list(self._ops)
+        ph_ids = list(self._placeholders.values())
+        ext_ids = list(self._externals.keys())
+
+        def replay(feed_vals, ext_vals, rng, fetch_ids):
+            from ..core.random import traced_key_source
+            env = dict(zip(ph_ids, feed_vals))
+            env.update(zip(ext_ids, ext_vals))
+            # thread a fresh per-run key: ops drawing randomness via
+            # next_key() (dropout, …) get a new mask every Executor.run
+            # instead of the key frozen at record time (reference static
+            # graphs reseed per run too)
+            with traced_key_source(rng):
+                for name, fn, in_slots, out_ids, multi in ops:
+                    args = [env[s] if kind == "var" else s
+                            for kind, s in in_slots]
+                    out = fn(*args)
+                    outs = tuple(out) if multi else (out,)
+                    for oid, o in zip(out_ids, outs):
+                        env[oid] = o
+            return [env[i] for i in fetch_ids]
+        return replay
+
+    def run(self, feed, fetch_list):
+        feed = feed or {}
+        missing = [n for n in self._placeholders if n not in feed]
+        if missing:
+            raise ValueError(f"Executor.run: missing feed entries "
+                             f"{missing}")
+        feed_vals = tuple(
+            jnp.asarray(to_value(feed[n]) if isinstance(feed[n], Tensor)
+                        else feed[n]) for n in self._placeholders)
+        fetch_list = fetch_list or []
+        fetch_ids = tuple(id(t) for t in fetch_list)
+        for t in fetch_list:
+            tid = id(t)
+            if tid not in self._produced and \
+                    tid not in self._placeholders.values() and \
+                    tid not in self._externals:
+                raise ValueError(
+                    "fetch target was not produced by this Program")
+        sig = (tuple((v.shape, str(v.dtype)) for v in feed_vals), fetch_ids)
+        compiled = self._cache.get(sig)
+        if compiled is None:
+            replay = self._build_replay()
+            compiled = jax.jit(
+                lambda fv, ev, rng: replay(fv, ev, rng, fetch_ids))
+            self._cache[sig] = compiled
+        ext_vals = tuple(self._externals.values())
+        from ..core.random import next_key
+        outs = compiled(feed_vals, ext_vals, next_key())
+        return [np.asarray(o) for o in outs]
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+        out = Program()
+        out._ops = list(self._ops)
+        out._placeholders = dict(self._placeholders)
+        out._externals = dict(self._externals)
+        out._produced = set(self._produced)
+        out._keep = list(self._keep)
+        return out
+
+    def __repr__(self):
+        return (f"Program(ops={len(self._ops)}, "
+                f"placeholders={list(self._placeholders)}, "
+                f"externals={len(self._externals)})")
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program() -> Program:
+    """reference: python/paddle/base/framework.py default_main_program."""
+    return _default_main[0]
+
+
+def default_startup_program() -> Program:
+    return _default_startup[0]
+
+
+class program_guard:
+    """reference: python/paddle/static/__init__.py program_guard — route
+    op recording (and ``static.data`` registration) to ``main``."""
+
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self._main = main_program
+        self._startup = startup_program
+        self._prev = None
+        self._prev_defaults = None
+
+    def __enter__(self):
+        from ..core import tensor as _ct
+        self._prev = _ct._PROGRAM_RECORDER[0]
+        _ct._PROGRAM_RECORDER[0] = self._main
+        self._prev_defaults = (_default_main[0], _default_startup[0])
+        _default_main[0] = self._main
+        if self._startup is not None:
+            _default_startup[0] = self._startup
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import tensor as _ct
+        _ct._PROGRAM_RECORDER[0] = self._prev
+        _default_main[0], _default_startup[0] = self._prev_defaults
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """reference: python/paddle/static/input.py data — a feedable
+    placeholder. Returns a Tensor carrying a zero example value (None
+    dims become 1); real shapes come from the feed at run time."""
+    concrete = [1 if (s is None or int(s) < 0) else int(s) for s in shape]
+    t = Tensor(jnp.zeros(tuple(concrete), convert_dtype(dtype)),
+               stop_gradient=True, name=name)
+    prog = default_main_program()
+    prog._register_data(name, t)
+    return t
+
+
 class Executor:
-    """Facade for API parity with reference
-    python/paddle/base/executor.py:1237; runs compiled callables."""
+    """reference python/paddle/base/executor.py:1237 — runs recorded
+    Programs (one jitted replay per feed signature) and, for
+    backward-compat with round-1 scripts, plain compiled callables."""
 
     def __init__(self, place=None):
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if isinstance(program, Program):
+            return program.run(feed, fetch_list)
+        if program is None:
+            return default_main_program().run(feed, fetch_list)
         if callable(program):
             feed = feed or {}
             out = program(**feed)
             return out if isinstance(out, (list, tuple)) else [out]
-        raise TypeError(
-            "paddle_tpu.static.Executor runs compiled callables "
-            "(jit.to_static functions); Program objects do not exist "
-            "in the TPU-native design — see SURVEY.md §2.6 item 5/6")
+        raise TypeError(f"Executor.run: unsupported program {program!r}")
 
 
-def default_main_program():
-    raise NotImplementedError(
-        "No Program IR in the TPU-native design; author models eagerly and "
-        "compile with paddle_tpu.jit.to_static")
+class _StaticNN:
+    """paddle.static.nn facade (reference: python/paddle/static/nn/) —
+    layer builders that create parameters at build time (recorded as
+    Program externals) and dispatch ops that record into the active
+    Program."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+           activation=None, name=None):
+        from ..nn import initializer as I
+
+        in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+        w = Tensor(I.XavierUniform()((in_dim, size), x.dtype),
+                   stop_gradient=False, name=(name or "fc") + ".w")
+        b = None
+        if bias_attr is not False:
+            b = Tensor(jnp.zeros((size,), x.dtype), stop_gradient=False,
+                       name=(name or "fc") + ".b")
+        from ..core.tensor import dispatch
+
+        def f(v, wv, *bv):
+            lead = v.shape[:num_flatten_dims]
+            out = v.reshape(*lead, -1) @ wv
+            if bv:
+                out = out + bv[0]
+            if activation == "relu":
+                out = jnp.maximum(out, 0)
+            elif activation == "tanh":
+                out = jnp.tanh(out)
+            elif activation == "sigmoid":
+                out = jax.nn.sigmoid(out)
+            return out
+
+        args = (x, w) + ((b,) if b is not None else ())
+        return dispatch(f, args, name="static_fc")
+
+    @staticmethod
+    def embedding(input, size, padding_idx=None, weight_attr=None,
+                  name=None):
+        from ..nn import initializer as I
+        from ..core.tensor import dispatch
+
+        w = Tensor(I.XavierUniform()((size[0], size[1]), "float32"),
+                   stop_gradient=False, name=(name or "emb") + ".w")
+
+        def f(ids, wv):
+            out = jnp.take(wv, ids.astype(jnp.int32), axis=0)
+            if padding_idx is not None:
+                out = jnp.where(
+                    (ids == padding_idx)[..., None], 0.0, out)
+            return out
+        return dispatch(f, (input, w), name="static_embedding")
+
+    @staticmethod
+    def batch_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                   data_layout="NCHW", name=None):
+        from ..core.tensor import dispatch
+        c_axis = 1 if data_layout == "NCHW" else -1
+        c = input.shape[c_axis]
+        scale = Tensor(jnp.ones((c,)), stop_gradient=False)
+        bias = Tensor(jnp.zeros((c,)), stop_gradient=False)
+
+        def f(v, s, b):
+            axes = tuple(i for i in range(v.ndim)
+                         if i != (c_axis % v.ndim))
+            mean = v.mean(axis=axes, keepdims=True)
+            var = v.var(axis=axes, keepdims=True)
+            shape = [1] * v.ndim
+            shape[c_axis % v.ndim] = c
+            return ((v - mean) / jnp.sqrt(var + epsilon) *
+                    s.reshape(shape) + b.reshape(shape))
+        return dispatch(f, (input, scale, bias), name="static_batch_norm")
+
+
+nn = _StaticNN()

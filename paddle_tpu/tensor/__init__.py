@@ -6,6 +6,8 @@ from __future__ import annotations
 from ..core.tensor import Tensor
 
 from . import creation, linalg, logic, manipulation, math, random, search, stat
+from . import array
+from .array import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403

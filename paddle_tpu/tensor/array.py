@@ -1,0 +1,69 @@
+"""TensorArray API (reference: python/paddle/tensor/array.py
+array_length/array_read/array_write/create_array over the C++
+TensorArray variant, paddle/phi/core/tensor_array.h).
+
+TPU-native stance: in eager JAX there is no graph-resident array
+variable — a TensorArray is a plain Python list of Tensors, which also
+traces cleanly under ``to_static`` when indices are Python ints (the
+dynamic-index static-graph case is served by ``lax.scan`` carries
+instead, per SURVEY §2.6(12): jax tracing replaces bytecode capture).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_value
+
+__all__ = ["array_length", "array_read", "array_write", "create_array"]
+
+
+def _as_int(i) -> int:
+    if isinstance(i, Tensor):
+        return int(np.asarray(to_value(i)))
+    return int(i)
+
+
+def array_length(array: List[Tensor]):
+    """reference: array.py:43."""
+    if not isinstance(array, list):
+        raise TypeError("array_length: expected a TensorArray (list)")
+    return Tensor(np.asarray(len(array), np.int64))
+
+
+def array_read(array: List[Tensor], i):
+    """reference: array.py:110 — read array[i]."""
+    idx = _as_int(i)
+    if idx >= len(array):
+        raise IndexError(
+            f"array_read: index {idx} out of range (len {len(array)})")
+    return array[idx]
+
+
+def array_write(x, i, array: Optional[List[Tensor]] = None):
+    """reference: array.py:206 — write x to array[i], growing the array
+    as needed; returns the array."""
+    idx = _as_int(i)
+    if array is None:
+        array = []
+    if not isinstance(array, list):
+        raise TypeError("array_write: expected a TensorArray (list)")
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    if idx < len(array):
+        array[idx] = x
+    elif idx == len(array):
+        array.append(x)
+    else:
+        raise IndexError(
+            f"array_write: index {idx} skips elements (len {len(array)})")
+    return array
+
+
+def create_array(dtype: str = "float32", initialized_list=None):
+    """reference: array.py:309 — new TensorArray, optionally seeded."""
+    out: List[Tensor] = []
+    if initialized_list is not None:
+        for v in initialized_list:
+            out.append(v if isinstance(v, Tensor) else Tensor(v))
+    return out

@@ -372,9 +372,8 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
     """reference: linalg.py svd_lowrank (randomized SVD)."""
     from ..core.random import next_key
 
-    key = next_key()
-
     def f(v, *rest):
+        key = next_key()
         a = v - rest[0] if rest else v
         m, n = a.shape[-2], a.shape[-1]
         r = builtins.min(q, m, n)

@@ -175,3 +175,93 @@ def test_to_static_graph_break_fallback():
     y = paddle.to_tensor(-np.ones((3,), np.float32))
     out2 = f(y)
     np.testing.assert_allclose(np.asarray(out2._value), -2 * np.ones(3))
+
+
+# -- static.Program facade (reference: base/framework.py Program,
+# base/executor.py Executor) ------------------------------------------------
+class TestStaticProgram:
+    def test_build_run_refeed(self):
+        from paddle_tpu import static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 8], "float32")
+            h = static.nn.fc(x, 16, activation="relu")
+            y = static.nn.fc(h, 4)
+            loss = (y * y).mean()
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        a1 = rng.randn(5, 8).astype(np.float32)
+        out1, l1 = exe.run(main, feed={"x": a1}, fetch_list=[y, loss])
+        assert out1.shape == (5, 4) and np.isfinite(l1).all()
+        # different batch size hits a fresh jit cache entry
+        out2, = exe.run(main, feed={"x": rng.randn(3, 8)
+                                    .astype(np.float32)}, fetch_list=[y])
+        assert out2.shape == (3, 4)
+        # determinism + clone
+        out1b, = exe.run(main, feed={"x": a1}, fetch_list=[y])
+        np.testing.assert_allclose(out1, out1b)
+        out1c, = exe.run(main.clone(), feed={"x": a1}, fetch_list=[y])
+        np.testing.assert_allclose(out1, out1c)
+
+    def test_missing_feed_and_bad_fetch_raise(self):
+        from paddle_tpu import static
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("inp", [None, 2], "float32")
+            y = x + 1.0
+        exe = static.Executor()
+        with pytest.raises(ValueError):
+            exe.run(main, feed={}, fetch_list=[y])
+        stranger = paddle.to_tensor(np.zeros(2, np.float32))
+        with pytest.raises(ValueError):
+            exe.run(main, feed={"inp": np.zeros((1, 2), np.float32)},
+                    fetch_list=[stranger])
+
+    def test_embedding_and_batch_norm_builders(self):
+        from paddle_tpu import static
+
+        main = static.Program()
+        with static.program_guard(main):
+            ids = static.data("ids", [None, 4], "int64")
+            emb = static.nn.embedding(ids, size=(10, 6))
+            img = static.data("img", [None, 3, 4, 4], "float32")
+            bn = static.nn.batch_norm(img)
+        exe = static.Executor()
+        e, b = exe.run(main, feed={
+            "ids": np.zeros((2, 4), np.int64),
+            "img": np.random.randn(2, 3, 4, 4).astype(np.float32)},
+            fetch_list=[emb, bn])
+        assert e.shape == (2, 4, 6) and b.shape == (2, 3, 4, 4)
+
+    def test_recording_does_not_leak_outside_guard(self):
+        from paddle_tpu import static
+        from paddle_tpu.core import tensor as _ct
+
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], "float32")
+            _ = x * 3.0
+        n_ops = len(main._ops)
+        _ = paddle.to_tensor([1.0]) + 1.0   # outside: not recorded
+        assert len(main._ops) == n_ops
+        assert _ct._PROGRAM_RECORDER[0] is None
+
+
+def test_static_program_redraws_dropout_each_run():
+    """reference static graphs draw a fresh seed per Executor.run; the
+    recorded replay must NOT bake the record-time mask."""
+    from paddle_tpu import static
+    import paddle_tpu.nn.functional as F
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 64], "float32")
+        y = F.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    feed = {"x": np.ones((4, 64), np.float32)}
+    a = exe.run(main, feed=feed, fetch_list=[y])[0]
+    b = exe.run(main, feed=feed, fetch_list=[y])[0]
+    assert not np.allclose(a, b)

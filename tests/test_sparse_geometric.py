@@ -442,3 +442,18 @@ class TestSparseCastAndBatchedCsr:
             paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
             mcoo.to_sparse_csr()).numpy())
         np.testing.assert_allclose(o1, o2, atol=1e-5)
+
+
+def test_batched_csr_softmax_and_mask_as_match_coo():
+    rng = np.random.RandomState(7)
+    B, S = 2, 4
+    m = rng.rand(B, S, S) > 0.4
+    dn = (rng.randn(B, S, S) * m).astype(np.float32)
+    coo = sparse.to_sparse_coo(paddle.to_tensor(dn))
+    csr = coo.to_sparse_csr()
+    np.testing.assert_allclose(
+        np.asarray(sparse.softmax(csr).to_dense()),
+        np.asarray(sparse.softmax(coo).to_dense()), atol=1e-5)
+    mk = sparse.mask_as(paddle.to_tensor(dn * 7), csr)
+    np.testing.assert_allclose(np.asarray(mk.to_dense()), dn * 7,
+                               atol=1e-5)

@@ -304,3 +304,58 @@ class TestDtype:
         a = paddle.to_tensor([1, 2])  # int64
         b = paddle.to_tensor([0.5, 0.5])
         assert (a + b).dtype == np.float32
+
+
+# -- device Stream/Event API (reference: python/paddle/device Stream/Event)
+class TestStreamEvent:
+    def test_event_record_query_sync(self):
+        from paddle_tpu import device as D
+        import jax.numpy as jnp
+
+        e1 = D.Event(enable_timing=True)
+        e1.record()
+        _ = jnp.ones((256, 256)) @ jnp.ones((256, 256))
+        e2 = D.Event(enable_timing=True)
+        e2.record()
+        e2.synchronize()
+        assert e2.query()
+        assert e1.elapsed_time(e2) >= 0.0
+
+    def test_stream_guard_swaps_current(self):
+        from paddle_tpu import device as D
+
+        base = D.current_stream()
+        s2 = D.Stream()
+        with D.stream_guard(s2):
+            assert D.current_stream() is s2
+        assert D.current_stream() is base
+        s2.wait_stream(base)
+        base.synchronize()
+
+
+class TestTensorArray:
+    """reference: python/paddle/tensor/array.py."""
+
+    def test_write_read_length(self):
+        arr = paddle.tensor.create_array()
+        arr = paddle.tensor.array_write(paddle.to_tensor([1.0, 2.0]),
+                                        paddle.to_tensor(0), arr)
+        arr = paddle.tensor.array_write(paddle.to_tensor([3.0, 4.0]), 1,
+                                        arr)
+        assert int(paddle.tensor.array_length(arr)) == 2
+        np.testing.assert_allclose(
+            np.asarray(paddle.tensor.array_read(arr, 1).numpy()), [3, 4])
+        # overwrite
+        arr = paddle.tensor.array_write(paddle.to_tensor([9.0, 9.0]), 0,
+                                        arr)
+        np.testing.assert_allclose(
+            np.asarray(paddle.tensor.array_read(arr, 0).numpy()), [9, 9])
+        with pytest.raises(IndexError):
+            paddle.tensor.array_write(paddle.to_tensor([0.0]), 5, arr)
+
+    def test_stack_roundtrip(self):
+        from paddle_tpu.tensor.manipulation import tensor_array_to_tensor
+        arr = paddle.tensor.create_array(
+            initialized_list=[np.ones(3, np.float32) * i for i in range(4)])
+        out, _ = tensor_array_to_tensor(arr, axis=0, use_stack=True)
+        assert np.asarray(out.numpy()).shape == (4, 3)
