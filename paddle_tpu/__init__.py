@@ -66,6 +66,11 @@ from . import sparse  # noqa: F401
 from . import geometric  # noqa: F401
 from . import vision  # noqa: F401
 from . import incubate  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import distribution  # noqa: F401
+from .batch import batch  # noqa: F401
 
 from .framework.io import save, load  # noqa: F401
 from .framework import ParamAttr  # noqa: F401

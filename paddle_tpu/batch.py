@@ -1,0 +1,23 @@
+"""paddle.batch parity (reference: python/paddle/batch.py) — wrap a
+sample reader into a mini-batch reader."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """reference: batch.py batch — group a sample generator into lists
+    of ``batch_size`` samples."""
+    if batch_size <= 0:
+        raise ValueError("batch_size must be a positive integer")
+
+    def batch_reader():
+        b = []
+        for sample in reader():
+            b.append(sample)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader

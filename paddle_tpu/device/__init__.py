@@ -399,10 +399,9 @@ class Stream:
 
     def wait_stream(self, stream: "Stream") -> None:
         stream.synchronize()
-
-    def __eq__(self, other):
-        return (isinstance(other, Stream) and
-                self._device == other._device)
+    # identity equality/hash (reference streams compare by handle):
+    # distinct Stream objects are distinct ordering handles even on the
+    # same device, and instances stay usable as dict/set keys
 
 
 def _resolve_stream_device(device=None):
@@ -417,17 +416,22 @@ _CURRENT_STREAM: dict = {}
 def current_stream(device=None) -> Stream:
     """reference: paddle.device.current_stream."""
     dev = _resolve_stream_device(device)
-    key = getattr(dev, "id", 0)
+    key = _stream_key(dev)
     if key not in _CURRENT_STREAM:
         _CURRENT_STREAM[key] = Stream(dev)
     return _CURRENT_STREAM[key]
 
 
+def _stream_key(dev):
+    # jax device ids are only unique per backend — cpu:0 and tpu:0 both
+    # have id 0, so the platform must be part of the key
+    return (getattr(dev, "platform", "?"), getattr(dev, "id", 0))
+
+
 def set_stream(stream: Stream) -> Stream:
     """reference: paddle.device.set_stream."""
-    key = getattr(stream._device, "id", 0)
     prev = current_stream(stream._device)
-    _CURRENT_STREAM[key] = stream
+    _CURRENT_STREAM[_stream_key(stream._device)] = stream
     return prev
 
 

@@ -1,0 +1,174 @@
+"""paddle.fft parity (reference: python/paddle/fft.py — fft_c2c/r2c/c2r
+kernels under paddle/phi/kernels/funcs/fft.h, cuFFT on GPU).
+
+TPU-native: jnp.fft lowers to XLA's FFT HLO, which the TPU backend
+executes natively — no custom kernels needed. All functions dispatch
+through the eager tape, so they differentiate and record into
+static.Program like every other op.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, dispatch, to_value
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = (None, "backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"fft norm must be one of {_NORMS[1:]}, got {norm!r}")
+    return norm or "backward"
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _op1(jfn, x, n, axis, norm, name):
+    norm = _check_norm(norm)
+    return dispatch(lambda v: jfn(v, n=n, axis=axis, norm=norm),
+                    (_ensure(x),), name=name)
+
+
+def _opn(jfn, x, s, axes, norm, name):
+    norm = _check_norm(norm)
+    if s is not None:
+        s = tuple(int(v) for v in s)
+    if axes is not None:
+        axes = tuple(int(a) for a in axes)
+    return dispatch(lambda v: jfn(v, s=s, axes=axes, norm=norm),
+                    (_ensure(x),), name=name)
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    """reference: fft.py:169."""
+    return _op1(jnp.fft.fft, x, n, axis, norm, "fft")
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1(jnp.fft.ifft, x, n, axis, norm, "ifft")
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1(jnp.fft.rfft, x, n, axis, norm, "rfft")
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1(jnp.fft.irfft, x, n, axis, norm, "irfft")
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1(jnp.fft.hfft, x, n, axis, norm, "hfft")
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _op1(jnp.fft.ihfft, x, n, axis, norm, "ihfft")
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    """reference: fft.py:521."""
+    return _opn(jnp.fft.fftn, x, s, axes, norm, "fftn")
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _opn(jnp.fft.ifftn, x, s, axes, norm, "ifftn")
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _opn(jnp.fft.rfftn, x, s, axes, norm, "rfftn")
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _opn(jnp.fft.irfftn, x, s, axes, norm, "irfftn")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _check_norm(norm)
+
+    def f(v):
+        # hermitian-input nd FFT: conj-ifftn then real part scaling is
+        # handled by the 1-d hfft along the last axis after ifftn over
+        # the leading axes (numpy has no hfftn either)
+        ax = axes if axes is not None else tuple(range(v.ndim))
+        lead, last = ax[:-1], ax[-1]
+        if lead:
+            v = jnp.fft.ifftn(v, axes=lead, norm="forward" if norm ==
+                              "backward" else ("backward" if norm ==
+                                               "forward" else "ortho"))
+        n_last = None if s is None else s[-1]
+        return jnp.fft.hfft(v, n=n_last, axis=last, norm=norm)
+    return dispatch(f, (_ensure(x),), name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    norm = _check_norm(norm)
+
+    def f(v):
+        ax = axes if axes is not None else tuple(range(v.ndim))
+        lead, last = ax[:-1], ax[-1]
+        n_last = None if s is None else s[-1]
+        out = jnp.fft.ihfft(v, n=n_last, axis=last, norm=norm)
+        if lead:
+            out = jnp.fft.fftn(out, axes=lead, norm="forward" if norm ==
+                               "backward" else ("backward" if norm ==
+                                                "forward" else "ortho"))
+        return out
+    return dispatch(f, (_ensure(x),), name="ihfftn")
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn(jnp.fft.fft2, x, s, axes, norm, "fft2")
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn(jnp.fft.ifft2, x, s, axes, norm, "ifft2")
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn(jnp.fft.rfft2, x, s, axes, norm, "rfft2")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _opn(jnp.fft.irfft2, x, s, axes, norm, "irfft2")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm)
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    """reference: fft.py:1341."""
+    from .core.dtypes import convert_dtype
+    return Tensor(jnp.fft.fftfreq(int(n), d=float(d))
+                  .astype(convert_dtype(dtype)))
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    from .core.dtypes import convert_dtype
+    return Tensor(jnp.fft.rfftfreq(int(n), d=float(d))
+                  .astype(convert_dtype(dtype)))
+
+
+def fftshift(x, axes=None, name=None):
+    return dispatch(lambda v: jnp.fft.fftshift(v, axes=axes),
+                    (_ensure(x),), name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return dispatch(lambda v: jnp.fft.ifftshift(v, axes=axes),
+                    (_ensure(x),), name="ifftshift")

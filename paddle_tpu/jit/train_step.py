@@ -69,6 +69,9 @@ class TrainStep:
         objs = [self._param_objs[k] for k in self._train_names]
         maps = opt._group_maps()
         self._metas = [opt._param_meta(p, maps) for p in objs]
+        # L1Decay adds coeff*sign(p) to the grad inside the fused program
+        # (the L2 slot in metas is 0 for L1 — see Optimizer._l1_coeff)
+        self._l1 = tuple(opt._l1_coeff(p, maps) for p in objs)
         self._acc_names = opt._accumulator_names()
         masters = [opt._master(p) for p in objs]
         self._has_master = tuple(m is not None for m in masters)
@@ -124,6 +127,10 @@ class TrainStep:
 
             g_vals = tuple(grads[k] for k in names)
             p_vals = tuple(trainable[k] for k in names)
+            if any(self._l1):
+                g_vals = tuple(
+                    g + c * jnp.sign(p.astype(g.dtype)) if c else g
+                    for g, p, c in zip(g_vals, p_vals, self._l1))
             acc_vals = slots["accs"]
             new_ps, new_accs, new_masters = opt_update(
                 p_vals, g_vals, acc_vals, slots["masters"], lr, step)

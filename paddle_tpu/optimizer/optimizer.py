@@ -108,8 +108,36 @@ class Optimizer:
     def step(self):
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if (not p.stop_gradient and p.grad is not None)]
+        params_grads = self._apply_l1_regularizers(params_grads)
         self._apply(params_grads)
         self._global_step += 1
+
+    def _l1_coeff(self, p, maps=None) -> float:
+        """L1Decay coefficient for one param — ParamAttr regularizer wins
+        over group/optimizer-level weight_decay (same precedence as the
+        L2 path in _param_meta). 0.0 when no L1 applies."""
+        attr = getattr(p, "_param_attr", None)
+        reg = attr.regularizer if attr is not None else None
+        if reg is None:
+            wd_of, _ = maps if maps is not None else self._group_maps()
+            reg = wd_of.get(id(p))
+        if reg is not None and _is_l1(reg):
+            return float(getattr(reg, "coeff", 0.0))
+        return 0.0
+
+    def _apply_l1_regularizers(self, params_grads):
+        """L1Decay (reference: python/paddle/regularizer.py) adds
+        coeff*sign(p) to the gradient; L2 folds into the fused update."""
+        maps = self._group_maps()
+        out = []
+        for p, g in params_grads:
+            coeff = self._l1_coeff(p, maps)
+            if coeff:
+                from ..regularizer import L1Decay
+                g = Tensor(L1Decay(coeff)(to_value(p), to_value(g)),
+                           stop_gradient=True)
+            out.append((p, g))
+        return out
 
     minimize_step = step
 
@@ -348,11 +376,19 @@ def _wd_value(wd):
         return 0.0
     if isinstance(wd, (int, float)):
         return float(wd)
+    if _is_l1(wd):
+        # L1Decay adds coeff*sign(p) to the GRADIENT (done eagerly in
+        # Optimizer.step), not coeff*p — must not ride the L2 slot
+        return 0.0
     # L2Decay-style object
     coeff = getattr(wd, "coeff", None)
     if coeff is None:
         coeff = getattr(wd, "_coeff", 0.0)
     return float(coeff)
+
+
+def _is_l1(wd):
+    return type(wd).__name__.startswith("L1")
 
 
 def _decoupled_wd(p32, lr, wd):

@@ -1,0 +1,46 @@
+"""paddle.regularizer parity (reference: python/paddle/regularizer.py —
+L1Decay/L2Decay appended to gradients by the optimizer).
+
+In the TPU-native optimizer the decay folds into the fused update: the
+Optimizer reads ``param.regularizer`` (or its own ``weight_decay``) and
+adds coef * sign(p) (L1) or coef * p (L2) to the gradient before the
+update rule.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    """Base class (reference: regularizer.py WeightDecayRegularizer)."""
+
+    def __call__(self, param, grad):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(param) (reference: regularizer.py L1Decay)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param, grad):
+        return grad + self.coeff * jnp.sign(param)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param (reference: regularizer.py L2Decay)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param, grad):
+        return grad + self.coeff * param
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"

@@ -1,0 +1,146 @@
+"""paddle.signal parity (reference: python/paddle/signal.py — frame,
+overlap_add, stft, istft). Pure XLA: framing is a gather, overlap-add a
+segment scatter-add, the DFTs ride jnp.fft.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, dispatch, to_value
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """reference: signal.py:42 — slice into overlapping frames.
+    [..., seq] -> [..., frame_length, num_frames] (axis=-1)."""
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+
+    def f(v):
+        n = v.shape[-1] if axis in (-1, v.ndim - 1) else v.shape[0]
+        if frame_length > n:
+            raise ValueError(
+                f"frame_length {frame_length} > sequence length {n}")
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        if axis in (-1, v.ndim - 1):
+            out = v[..., idx]                    # [..., num, frame_length]
+            return jnp.swapaxes(out, -1, -2)     # [..., frame_length, num]
+        out = v[idx]                             # [num, frame_length, ...]
+        return jnp.swapaxes(out, 0, 1)           # [frame_length, num, ...]
+    return dispatch(f, (_ensure(x),), name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """reference: signal.py:167 — inverse of frame.
+    [..., frame_length, num_frames] -> [..., seq] (axis=-1)."""
+
+    def f(v):
+        if axis in (-1, v.ndim - 1):
+            fl, num = v.shape[-2], v.shape[-1]
+            frames = jnp.swapaxes(v, -1, -2)     # [..., num, fl]
+            n = fl + hop_length * (num - 1)
+            pos = (jnp.arange(num) * hop_length)[:, None] + \
+                jnp.arange(fl)[None, :]          # [num, fl]
+            out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+            return out.at[..., pos].add(frames)
+        fl, num = v.shape[0], v.shape[1]
+        frames = jnp.swapaxes(v, 0, 1)           # [num, fl, ...]
+        n = fl + hop_length * (num - 1)
+        out = jnp.zeros((n,) + v.shape[2:], v.dtype)
+        pos = (jnp.arange(num) * hop_length)[:, None] + \
+            jnp.arange(fl)[None, :]
+        return out.at[pos].add(frames)
+    return dispatch(f, (_ensure(x),), name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """reference: signal.py:272 — [..., seq] ->
+    [..., n_fft//2+1 | n_fft, num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        wv = jnp.asarray(to_value(_ensure(window)))
+    else:
+        wv = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:   # center-pad window
+        lp = (n_fft - win_length) // 2
+        wv = jnp.pad(wv, (lp, n_fft - win_length - lp))
+
+    def f(v):
+        is_complex = jnp.iscomplexobj(v)
+        if onesided and is_complex:
+            raise ValueError("onesided=True requires a real input")
+        if center:
+            pad = n_fft // 2
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        num = 1 + (v.shape[-1] - n_fft) // hop_length
+        idx = (jnp.arange(num) * hop_length)[:, None] + \
+            jnp.arange(n_fft)[None, :]
+        frames = v[..., idx] * wv                # [..., num, n_fft]
+        if onesided:
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        return jnp.swapaxes(spec, -1, -2)        # [..., freq, num]
+    return dispatch(f, (_ensure(x),), name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """reference: signal.py:449 — least-squares overlap-add inverse of
+    ``stft`` (window-squared normalized)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        wv = jnp.asarray(to_value(_ensure(window)))
+    else:
+        wv = jnp.ones((win_length,), jnp.float32)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        wv = jnp.pad(wv, (lp, n_fft - win_length - lp))
+
+    def f(v):
+        spec = jnp.swapaxes(v, -1, -2)           # [..., num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, n=n_fft, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * wv
+        num = frames.shape[-2]
+        n = n_fft + hop_length * (num - 1)
+        pos = (jnp.arange(num) * hop_length)[:, None] + \
+            jnp.arange(n_fft)[None, :]
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        out = out.at[..., pos].add(frames)
+        # window-envelope normalization (least-squares NOLA)
+        env = jnp.zeros((n,), wv.dtype).at[pos.reshape(-1)].add(
+            jnp.tile(wv * wv, num))
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            if out.shape[-1] < length:  # dropped partial tail frame
+                out = jnp.pad(out, [(0, 0)] * (out.ndim - 1) +
+                              [(0, length - out.shape[-1])])
+            out = out[..., :length]
+        return out
+    return dispatch(f, (_ensure(x),), name="istft")

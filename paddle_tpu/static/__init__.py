@@ -87,7 +87,10 @@ class Program:
                 if (tid not in self._produced and
                         tid not in self._externals and
                         tid not in self._placeholders.values()):
-                    self._externals[tid] = to_value(a)
+                    # keep the live Tensor (not a value snapshot): later
+                    # in-place updates (set_value, state-dict load) must
+                    # be visible to replay
+                    self._externals[tid] = a
                 in_slots.append(("var", tid))
             else:
                 in_slots.append(("const", v))
@@ -153,7 +156,7 @@ class Program:
             compiled = jax.jit(
                 lambda fv, ev, rng: replay(fv, ev, rng, fetch_ids))
             self._cache[sig] = compiled
-        ext_vals = tuple(self._externals.values())
+        ext_vals = tuple(to_value(t) for t in self._externals.values())
         from ..core.random import next_key
         outs = compiled(feed_vals, ext_vals, next_key())
         return [np.asarray(o) for o in outs]
@@ -162,7 +165,6 @@ class Program:
         return self
 
     def clone(self, for_test=False):
-        import copy
         out = Program()
         out._ops = list(self._ops)
         out._placeholders = dict(self._placeholders)
