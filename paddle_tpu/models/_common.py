@@ -48,6 +48,53 @@ def masked_cross_entropy(logits, labels) -> jax.Array:
     return -jnp.sum(jnp.where(valid, picked, 0.0)) / n
 
 
+def fused_linear_cross_entropy(hidden, head, labels,
+                               chunk_size: int = 1024) -> jax.Array:
+    """Chunked lm-head + cross entropy that never materializes the full
+    [T, V] logits (Liger-kernel style, arXiv:2410.10989): a lax.scan over
+    token chunks computes logits [chunk, V] in fp32, reduces them to
+    per-token (logsumexp, picked-logit) scalars, and the rematerialized
+    backward recomputes each chunk — peak activation memory drops from
+    O(T*V) to O(chunk*V). Semantics identical to
+    ``masked_cross_entropy(hidden @ head, labels)``.
+
+    hidden [..., D] (any leading shape), head [D, V], labels [...] int
+    (negative = ignore).
+    """
+    d = hidden.shape[-1]
+    flat = hidden.reshape(-1, d)
+    lab = labels.reshape(-1)
+    t = flat.shape[0]
+    c = min(chunk_size, t)
+    n_chunks = -(-t // c)
+    pad = n_chunks * c - t
+    if pad:
+        flat = jnp.pad(flat, ((0, pad), (0, 0)))
+        lab = jnp.pad(lab, (0, pad), constant_values=-1)
+    flat = flat.reshape(n_chunks, c, d)
+    lab = lab.reshape(n_chunks, c)
+
+    @jax.checkpoint
+    def chunk_ce(x_c, l_c):
+        logits = (x_c @ head).astype(jnp.float32)     # [c, V] — the only
+        lse = jax.scipy.special.logsumexp(logits, -1)  # [c]   live chunk
+        valid = l_c >= 0
+        safe = jnp.where(valid, l_c, 0)
+        picked = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+        ce = jnp.where(valid, lse - picked, 0.0)
+        return jnp.sum(ce), jnp.sum(valid).astype(jnp.float32)
+
+    def scan_fn(carry, xs):
+        s, n = carry
+        cs, cn = chunk_ce(*xs)
+        return (s + cs, n + cn), None
+
+    (total, count), _ = jax.lax.scan(
+        scan_fn, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (flat, lab))
+    return total / jnp.maximum(count, 1.0)
+
+
 def prenorm_block(lp, x, *, num_heads, head_dim, eps, causal):
     """Pre-norm transformer block (GPT/ViT convention): LN → QKV →
     flash attention → proj residual; LN → GELU MLP residual.

@@ -157,11 +157,12 @@ def _decoder_layer(layer_params, x, sin, cos, cfg: LlamaConfig,
     return x
 
 
-def forward(params: Dict, tokens, cfg: LlamaConfig,
-            positions=None) -> jax.Array:
-    """Logits for [B, S] int tokens. Layer loop is a lax.scan over the
-    stacked layer params (single compiled block; PP slicing reuses the same
-    body)."""
+def forward_hidden(params: Dict, tokens, cfg: LlamaConfig,
+                   positions=None) -> jax.Array:
+    """Final-norm hidden states [B, S, D]. Layer loop is a lax.scan over
+    the stacked layer params (single compiled block; PP slicing reuses
+    the same body). The fused loss applies the lm head in chunks instead
+    of materializing [B, S, V] logits."""
     x = jnp.take(params["embed_tokens"], tokens, axis=0)
     sin, cos = build_rope_cache(tokens.shape[1], cfg.head_dim,
                                 base=cfg.rope_theta)
@@ -177,8 +178,14 @@ def forward(params: Dict, tokens, cfg: LlamaConfig,
         return body(layer_params, carry), None
 
     x, _ = jax.lax.scan(scan_fn, x, params["layers"])
-    x = fused_rms_norm(x, params["final_norm"].astype(x.dtype),
-                       cfg.rms_norm_eps)
+    return fused_rms_norm(x, params["final_norm"].astype(x.dtype),
+                          cfg.rms_norm_eps)
+
+
+def forward(params: Dict, tokens, cfg: LlamaConfig,
+            positions=None) -> jax.Array:
+    """Logits for [B, S] int tokens (hidden states @ lm head)."""
+    x = forward_hidden(params, tokens, cfg, positions)
     head = params.get("lm_head")
     if head is None:
         head = params["embed_tokens"].T
@@ -186,9 +193,16 @@ def forward(params: Dict, tokens, cfg: LlamaConfig,
 
 
 def loss_fn(params: Dict, tokens, labels, cfg: LlamaConfig) -> jax.Array:
-    """Next-token cross entropy in fp32 (vocab-sharded logits stay sharded
-    through the log-softmax under GSPMD)."""
-    return _masked_cross_entropy(forward(params, tokens, cfg), labels)
+    """Next-token cross entropy in fp32 via the chunked fused
+    lm-head+CE — full [B, S, V] logits are never materialized (the
+    reference's fused c_softmax_with_cross_entropy has the same goal for
+    vocab-sharded logits; here chunking also caps HBM)."""
+    from ._common import fused_linear_cross_entropy
+    hidden = forward_hidden(params, tokens, cfg)
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed_tokens"].T
+    return fused_linear_cross_entropy(hidden, head, labels)
 
 
 def build_forward(cfg: LlamaConfig, key=None):
