@@ -141,6 +141,51 @@ class TestDryrun:
         from paddle_tpu.distributed.dryrun import run_dryrun
         run_dryrun(n)
 
+    @pytest.fixture
+    def _restore_platform_state(self):
+        """resolve_devices(force_cpu=False) may mutate process globals
+        (JAX_PLATFORMS, jax_platforms config, Pallas force-interpret) when
+        it falls back; restore them so later tests see clean state."""
+        import os
+        import jax
+        from paddle_tpu.ops.pallas import _util as pallas_util
+        prev_env = os.environ.get("JAX_PLATFORMS")
+        prev_cfg = jax.config.jax_platforms
+        prev_interp = pallas_util._FORCE_INTERPRET
+        yield
+        pallas_util.set_force_interpret(prev_interp)
+        if prev_env is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev_env
+        try:
+            jax.config.update("jax_platforms", prev_cfg)
+        except Exception:
+            pass
+
+    def test_resolve_devices_probe_path(self, _restore_platform_state):
+        """force_cpu=False probes the default backend in a subprocess.
+        The child re-runs sitecustomize, so its default platform (and
+        health) is the machine's real accelerator — which may legitimately
+        be wedged. Either way the call must return n devices promptly:
+        default backend when the probe passes, CPU fallback otherwise."""
+        from paddle_tpu.distributed.dryrun import resolve_devices
+        devices, reason = resolve_devices(2, force_cpu=False,
+                                          probe_timeout=10.0)
+        assert len(devices) == 2
+        if reason is not None:  # probe failed -> must be the CPU fallback
+            assert all(d.platform == "cpu" for d in devices)
+
+    def test_resolve_devices_probe_timeout_falls_back(
+            self, _restore_platform_state):
+        """A hung/slow probe (simulated with a tiny timeout) must not hang
+        the caller — it falls back to the forced virtual CPU mesh."""
+        from paddle_tpu.distributed.dryrun import resolve_devices
+        devices, reason = resolve_devices(2, force_cpu=False,
+                                          probe_timeout=0.01)
+        assert reason is not None and len(devices) == 2
+        assert all(d.platform == "cpu" for d in devices)
+
 
 def test_trainer_nan_watch():
     """check_nan_inf catches non-finite loss inside the compiled
