@@ -7,8 +7,13 @@ Measures BASELINE.md configs on the one real chip:
 - config 3 (north star): LLaMA-style causal LM training tokens/sec/chip +
   MFU via the functional sharded Trainer (largest config that fits one
   chip; MFU is chip-count-invariant so it is comparable to the A100 bar).
-- BENCH_FULL=1 additionally measures config 2 (BERT-base MLM step),
-  config 4 (ERNIE fused-transformer decode), and config 6 (SD-UNet step).
+- By default also measures config 2 (BERT-base MLM step), config 4
+  (ERNIE fused-transformer decode), config 6 (SD-UNet step), and a
+  Pallas-kernel validation pack (compiled-on-chip numerics + microbench
+  vs the XLA composition). BENCH_FAST=1 limits the run to
+  probe+resnet+llama. BENCH_BUDGET bounds total wall clock (default
+  5400s); partial results are persisted to BENCH_PARTIAL.json after
+  every config.
 
 vs_baseline for config 1 compares against the public A100 MLPerf-class
 number (~2500 imgs/s/chip fp16); for config 3 the bar is 50-55% MFU
@@ -222,6 +227,191 @@ def bench_sd_unet(steps=8, batch=4):
             "batch": batch}
 
 
+def bench_kernels():
+    """VERDICT round-2 item: run the Pallas pack COMPILED on the real chip
+    (not interpret mode) — numerics vs the XLA composition plus a
+    microbench of each. On a non-TPU backend (interpret mode) shapes are
+    shrunk and timing skipped: the numbers would mean nothing."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas._util import interpret_mode
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention_pallas
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_attention_decode_pallas)
+    from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
+    from paddle_tpu.ops.pallas.norms import rms_norm_pallas
+
+    interp = interpret_mode()
+    res = {"interpret": bool(interp),
+           "platform": jax.devices()[0].platform, "cases": {}}
+    key = jax.random.PRNGKey(0)
+
+    def timed(fn, *args, steps=20):
+        out = jax.block_until_ready(fn(*args))  # compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / steps * 1e6  # us
+
+    def record(name, pallas_fn, ref_fn, *args, tol):
+        try:
+            got = np.asarray(jax.block_until_ready(pallas_fn(*args)),
+                             np.float32)
+            want = np.asarray(jax.block_until_ready(ref_fn(*args)),
+                              np.float32)
+            err = float(np.max(np.abs(got - want)))
+            case = {"max_err": round(err, 5), "ok": err < tol}
+            if not interp:
+                us_p = timed(pallas_fn, *args)
+                us_x = timed(ref_fn, *args)
+                case.update(us_pallas=round(us_p, 1), us_xla=round(us_x, 1),
+                            speedup=round(us_x / us_p, 3))
+            res["cases"][name] = case
+        except Exception as e:  # noqa: BLE001 — record, keep going
+            res["cases"][name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+
+    # ---- flash attention (causal, GQA, varlen, bias) + backward --------
+    B, S, H, KVH, D = (4, 2048, 16, 8, 128) if not interp \
+        else (1, 256, 4, 2, 64)
+    qk = jax.random.split(key, 8)
+    q = jax.random.normal(qk[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(qk[1], (B, S, KVH, D), jnp.bfloat16)
+    v = jax.random.normal(qk[2], (B, S, KVH, D), jnp.bfloat16)
+
+    def ref_attn(q, k, v, causal=True, bias=None, seg=None):
+        kr = jnp.repeat(k, q.shape[2] // k.shape[2], axis=2)
+        vr = jnp.repeat(v, q.shape[2] // v.shape[2], axis=2)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       kr.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+        if bias is not None:
+            s = s + bias
+        if causal:
+            m = jnp.tril(jnp.ones((q.shape[1], kr.shape[1]), bool))
+            s = jnp.where(m[None, None], s, -jnp.inf)
+        if seg is not None:
+            m = seg[:, None, :, None] == seg[:, None, None, :]
+            s = jnp.where(m, s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(jnp.isfinite(jnp.max(s, -1, keepdims=True)), p, 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", p,
+                          vr.astype(jnp.float32)).astype(q.dtype)
+
+    record("flash_causal_gqa",
+           jax.jit(lambda q, k, v: flash_attention_pallas(q, k, v,
+                                                          causal=True)),
+           jax.jit(lambda q, k, v: ref_attn(q, k, v, causal=True)),
+           q, k, v, tol=3e-2)
+
+    seg = jnp.concatenate([jnp.zeros((B, S // 2), jnp.int32),
+                           jnp.ones((B, S - S // 2), jnp.int32)], axis=1)
+    record("flash_varlen_seg",
+           jax.jit(lambda q, k, v: flash_attention_pallas(
+               q, k, v, causal=True, segment_ids=seg)),
+           jax.jit(lambda q, k, v: ref_attn(q, k, v, causal=True, seg=seg)),
+           q, k, v, tol=3e-2)
+
+    bias = jax.random.normal(qk[3], (1, H, S, S), jnp.float32) * 0.1
+    record("flash_bias",
+           jax.jit(lambda q, k, v: flash_attention_pallas(
+               q, k, v, causal=False, bias=bias)),
+           jax.jit(lambda q, k, v: ref_attn(q, k, v, causal=False,
+                                            bias=bias)),
+           q, k, v, tol=3e-2)
+
+    def loss_p(q, k, v):
+        return flash_attention_pallas(q, k, v, causal=True).astype(
+            jnp.float32).sum()
+
+    def loss_r(q, k, v):
+        return ref_attn(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    record("flash_bwd_dq",
+           jax.jit(lambda q, k, v: jax.grad(loss_p, 0)(q, k, v)),
+           jax.jit(lambda q, k, v: jax.grad(loss_r, 0)(q, k, v)),
+           q, k, v, tol=6e-2)
+    record("flash_bwd_dk",
+           jax.jit(lambda q, k, v: jax.grad(loss_p, 1)(q, k, v)),
+           jax.jit(lambda q, k, v: jax.grad(loss_r, 1)(q, k, v)),
+           q, k, v, tol=6e-2)
+
+    # ---- paged-attention decode (incl. a seq_len=0 slot) ---------------
+    PB, PH, PKV, PD, BS = (16, 16, 16, 128, 16) if not interp \
+        else (4, 4, 4, 64, 8)
+    NPAGES, MAXB = PB * 8, 8
+    kp = jax.random.normal(qk[4], (NPAGES, BS, PKV, PD), jnp.bfloat16)
+    vp = jax.random.normal(qk[5], (NPAGES, BS, PKV, PD), jnp.bfloat16)
+    dq = jax.random.normal(qk[6], (PB, PH, PD), jnp.bfloat16)
+    rng = np.random.RandomState(0)
+    tables = jnp.asarray(
+        rng.permutation(NPAGES)[:PB * MAXB].reshape(PB, MAXB), jnp.int32)
+    lens = rng.randint(1, BS * MAXB, (PB,)).astype(np.int32)
+    lens[0] = 0  # the untested-on-hardware edge from the verdict
+    lens = jnp.asarray(lens)
+
+    def ref_paged(dq, kp, vp):
+        # Jittable mask-based composition (so the timed comparison is
+        # Pallas kernel vs real XLA program, not Python dispatch): gather
+        # every table page, mask positions >= seq_len.
+        kk = kp[tables].reshape(PB, MAXB * BS, PKV, PD)
+        vv = vp[tables].reshape(PB, MAXB * BS, PKV, PD)
+        kk = jnp.repeat(kk, PH // PKV, 2).astype(jnp.float32)
+        vv = jnp.repeat(vv, PH // PKV, 2).astype(jnp.float32)
+        s = jnp.einsum("bhd,bkhd->bhk", dq.astype(jnp.float32),
+                       kk) / np.sqrt(PD)
+        live = jnp.arange(MAXB * BS)[None, :] < lens[:, None]
+        s = jnp.where(live[:, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        p = jnp.where(lens[:, None, None] > 0, p, 0.0)  # len=0 -> zeros
+        return jnp.einsum("bhk,bkhd->bhd", p, vv).astype(dq.dtype)
+
+    record("paged_decode",
+           jax.jit(lambda dq, kp, vp: paged_attention_decode_pallas(
+               dq, kp, vp, tables, lens)),
+           jax.jit(ref_paged),
+           dq, kp, vp, tol=3e-2)
+
+    # ---- fused adamw ---------------------------------------------------
+    N = 131072 * 32 if not interp else 4096
+    p0 = jax.random.normal(qk[7], (N,), jnp.float32)
+    g0 = jax.random.normal(qk[0], (N,), jnp.float32) * 0.01
+    m0 = jnp.zeros((N,), jnp.float32)
+    v0 = jnp.zeros((N,), jnp.float32)
+
+    def ref_adamw(p, g, m, v):
+        b1, b2, eps, wd, lr, step = 0.9, 0.999, 1e-8, 0.01, 1e-3, 1.0
+        m2 = b1 * m + (1 - b1) * g
+        v2 = b2 * v + (1 - b2) * g * g
+        mh = m2 / (1 - b1 ** step)
+        vh = v2 / (1 - b2 ** step)
+        p2 = p - lr * (mh / (jnp.sqrt(vh) + eps) + wd * p)
+        return p2, m2, v2
+
+    record("fused_adamw",
+           jax.jit(lambda p, g, m, v: fused_adamw(p, g, m, v, 1e-3, 1.0)[0]),
+           jax.jit(lambda p, g, m, v: ref_adamw(p, g, m, v)[0]),
+           p0, g0, m0, v0, tol=1e-5)
+
+    # ---- rms norm ------------------------------------------------------
+    X = jax.random.normal(qk[1], (8192, 4096) if not interp else (64, 256),
+                          jnp.bfloat16)
+    W = jnp.ones((X.shape[-1],), jnp.bfloat16)
+
+    def ref_rms(x, w):
+        xf = x.astype(jnp.float32)
+        return (xf * jax.lax.rsqrt(
+            jnp.mean(xf * xf, -1, keepdims=True) + 1e-6)
+            * w.astype(jnp.float32)).astype(x.dtype)
+
+    record("rms_norm", jax.jit(rms_norm_pallas), jax.jit(ref_rms),
+           X, W, tol=3e-2)
+
+    n_ok = sum(1 for c in res["cases"].values() if c.get("ok"))
+    res.update(metric="pallas_kernels_ok", value=n_ok,
+               unit=f"of {len(res['cases'])} kernels", )
+    return res
+
+
 CONFIGS = {
     "probe": bench_probe,
     "resnet50": bench_resnet50,
@@ -229,6 +419,7 @@ CONFIGS = {
     "bert": bench_bert,
     "ernie_infer": bench_ernie_infer,
     "sd_unet": bench_sd_unet,
+    "kernels": bench_kernels,
 }
 
 
@@ -284,7 +475,8 @@ def _spawn(name, timeout):
             capture_output=True, text=True, timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
-        return {"error": f"timeout after {timeout}s (tunnel hang?)"}
+        return {"error": f"timeout after {timeout}s (tunnel wedge or "
+                         f"config too slow for its budget)"}
     for line in reversed(p.stdout.strip().splitlines() or [""]):
         line = line.strip()
         if line.startswith("{"):
@@ -297,34 +489,88 @@ def _spawn(name, timeout):
 
 
 def main():
+    """Round-2 lesson (VERDICT weak #1): one wedged probe must not erase
+    the whole round's perf signal. So: retry the probe with backoff, still
+    attempt resnet50 once even if every probe fails (the wedge may clear;
+    the child's own timeout protects the parent), keep every config inside
+    a global deadline budget, and persist partial results after each
+    config so a killed parent still leaves evidence on disk."""
+    t_start = time.time()
+    budget = float(os.environ.get("BENCH_BUDGET", "5400"))
+    deadline = t_start + budget
     out = {"metric": "resnet50_train_imgs_per_sec_per_chip",
            "value": 0.0, "unit": "imgs/sec/chip", "vs_baseline": 0.0}
+    partial = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_PARTIAL.json")
 
-    probe_t = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
-    probe = _spawn("probe", probe_t)
-    if "error" in probe:
+    def save_partial():
+        try:
+            with open(partial, "w") as f:
+                json.dump(out, f)
+        except OSError:
+            pass
+
+    def left():
+        return deadline - time.time()
+
+    # -- probe, with retries + backoff ----------------------------------
+    probe_t = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+    probe_ok = False
+    for i in range(attempts):
+        if left() < 60:
+            break
+        probe = _spawn("probe", max(min(probe_t, int(left())), 30))
+        if "error" not in probe:
+            probe_ok = True
+            out.pop("device_error", None)
+            break
         out["device_error"] = probe["error"]
-        print(json.dumps(out))
-        return
+        save_partial()
+        if i < attempts - 1 and left() > 300:
+            time.sleep(min(60 * (i + 1), 120))
 
-    r = _spawn("resnet50", int(os.environ.get("BENCH_RESNET_TIMEOUT",
-                                              "1800")))
+    def run_cfg(name, timeout):
+        if left() < 90:
+            return {"error": "skipped (bench budget exhausted)"}
+        return _spawn(name, max(min(timeout, int(left())), 60))
+
+    # -- config 1: always attempted, even when the probe failed ---------
+    resnet_t = int(os.environ.get("BENCH_RESNET_TIMEOUT", "1800"))
+    r = run_cfg("resnet50", resnet_t if probe_ok else min(resnet_t, 600))
     if "error" in r:
         out["resnet_error"] = r["error"]
     else:
         out.update(r)
+        probe_ok = True  # tunnel works after all — run the rest fully
+        out.pop("device_error", None)
+    save_partial()
 
-    r = _spawn("llama", int(os.environ.get("BENCH_LLAMA_TIMEOUT", "1500")))
+    if not probe_ok:
+        # One last probe before burning timeouts on the remaining configs.
+        if left() > 240:
+            time.sleep(60)
+            probe_ok = "error" not in _spawn("probe", 120)
+            if probe_ok:
+                out.pop("device_error", None)
+    if not probe_ok:
+        print(json.dumps(out))
+        return
+
+    # -- config 3 (north star) ------------------------------------------
+    r = run_cfg("llama", int(os.environ.get("BENCH_LLAMA_TIMEOUT", "1500")))
     if "error" in r:
         out["llama_error"] = r["error"]
     else:
         out["llama"] = r
+    save_partial()
 
-    if os.environ.get("BENCH_FULL", "0") not in ("0", "", "false"):
-        for name in ("bert", "ernie_infer", "sd_unet"):
-            r = _spawn(name, int(os.environ.get("BENCH_EXTRA_TIMEOUT",
-                                                "900")))
-            out[name] = r
+    # -- kernels validation + configs 2/4/6, on by default --------------
+    if os.environ.get("BENCH_FAST", "0") in ("0", "", "false"):
+        extra_t = int(os.environ.get("BENCH_EXTRA_TIMEOUT", "900"))
+        for name in ("kernels", "ernie_infer", "sd_unet", "bert"):
+            out[name] = run_cfg(name, extra_t)
+            save_partial()
 
     print(json.dumps(out))
 
