@@ -121,7 +121,8 @@ class Tensor:
 
     __slots__ = ("_value", "stop_gradient", "grad", "_grad_node", "_out_index",
                  "name", "persistable", "_hooks", "trainable", "__weakref__",
-                 "_pp_meta", "_dist_info", "_param_attr", "_skip_decay")
+                 "_pp_meta", "_dist_info", "_param_attr", "_skip_decay",
+                 "_declared_shape")
 
     def __init__(self, value, dtype=None, stop_gradient: bool = True,
                  name: Optional[str] = None, persistable: bool = False):

@@ -70,16 +70,46 @@ def _gate_logits_to_dispatch(logits, top_k, capacity, key=None,
     return dispatch_t, combine_t, aux
 
 
+_CAPACITY_DROP_WARNED = False
+
+
+def _warn_capacity_drop(drop_rate):
+    global _CAPACITY_DROP_WARNED
+    rate = float(drop_rate)
+    if rate > 0.0 and not _CAPACITY_DROP_WARNED:
+        _CAPACITY_DROP_WARNED = True
+        import warnings
+        warnings.warn(
+            f"moe capacity dispatch dropped {rate:.1%} of routed tokens "
+            "(GShard semantics: tokens past capacity_factor*topk*T/E per "
+            "expert are dropped). The reference grouped-GEMM computes all "
+            "routed tokens exactly; raise capacity_factor for exactness. "
+            "This warning fires once per process.", stacklevel=2)
+
+
 def moe_dispatch_combine(x, logits, expert_fn, top_k=2,
-                         capacity_factor=1.25, norm_topk_prob=True):
+                         capacity_factor=1.25, norm_topk_prob=True,
+                         warn_on_drop=False):
     """x [T, D], logits [T, E] → (out [T, D], aux_loss). ``expert_fn``
-    maps [E, C, D] → [E, C, D] (vmapped expert MLPs)."""
+    maps [E, C, D] → [E, C, D] (vmapped expert MLPs).
+
+    ``warn_on_drop`` surfaces (once per process, via a debug callback
+    inside the compiled program) when capacity overflow actually drops
+    routed tokens — results then differ from the reference's exact
+    grouped GEMM at skewed routing."""
     T, D = x.shape
     E = logits.shape[-1]
     capacity = int(np.ceil(top_k * capacity_factor * T / E))
     capacity = max(capacity, 4)
     disp, comb, aux = _gate_logits_to_dispatch(
         logits, top_k, capacity, norm_topk_prob=norm_topk_prob)
+    # Trace-time gate: once the process has warned, newly traced programs
+    # skip the reduction + host callback entirely (already-compiled
+    # programs keep a no-op callback — the latch makes it cheap).
+    if warn_on_drop and not _CAPACITY_DROP_WARNED:
+        kept = jnp.sum(disp.astype(jnp.float32))
+        drop_rate = 1.0 - kept / float(T * top_k)
+        jax.debug.callback(_warn_capacity_drop, drop_rate)
     # scatter tokens to expert queues: [E, C, D]
     expert_in = jnp.einsum("tec,td->ecd", disp, x.astype(jnp.float32))
     expert_out = expert_fn(expert_in.astype(x.dtype))

@@ -185,15 +185,27 @@ class Bernoulli(Distribution):
 
 
 class Categorical(Distribution):
-    """reference: distribution/categorical.py (logits)."""
+    """reference: distribution/categorical.py (logits).
+
+    Parity note: the reference is deliberately inconsistent and we
+    reproduce it exactly — ``probs``/``log_prob`` normalize the raw
+    input LINEARLY (``logits / sum(logits)``, categorical.py:148-149,
+    so non-negative weights behave like unnormalized probabilities),
+    while ``sample``/``entropy``/``kl_divergence`` go through softmax
+    (``_logits_to_probs``, distribution.py:296)."""
 
     def __init__(self, logits, name=None):
         self.logits = _v(logits)
         super().__init__(self.logits.shape[:-1])
 
-    @property
-    def probs(self):
-        return _t(jax.nn.softmax(self.logits, axis=-1))
+    def _linear_probs(self):
+        return self.logits / jnp.sum(self.logits, axis=-1, keepdims=True)
+
+    def probs(self, value):
+        v = _v(value).astype(jnp.int32)
+        p = self._linear_probs()
+        p = jnp.broadcast_to(p, v.shape + p.shape[-1:])
+        return _t(jnp.take_along_axis(p, v[..., None], axis=-1)[..., 0])
 
     def sample(self, shape=()):
         out = jax.random.categorical(next_key(), self.logits,
@@ -201,14 +213,12 @@ class Categorical(Distribution):
         return _t(out.astype(jnp.int64))
 
     def log_prob(self, value):
-        v = _v(value).astype(jnp.int32)
-        logp = jax.nn.log_softmax(self.logits, axis=-1)
-        logp = jnp.broadcast_to(logp, v.shape + logp.shape[-1:])
-        return _t(jnp.take_along_axis(logp, v[..., None],
-                                      axis=-1)[..., 0])
+        return _t(jnp.log(_v(self.probs(value))))
 
     def probabilities(self):
-        return self.probs
+        """Full softmax probability vector (no reference counterpart;
+        kept for the sampling-side semantics)."""
+        return _t(jax.nn.softmax(self.logits, axis=-1))
 
     def entropy(self):
         logp = jax.nn.log_softmax(self.logits, axis=-1)
@@ -736,13 +746,21 @@ def register_kl(type_p, type_q):
 
 def kl_divergence(p: Distribution, q: Distribution):
     """reference: distribution/kl.py kl_divergence — registry dispatch
-    with MRO fallback."""
+    selecting the MOST SPECIFIC registered (type_p, type_q) pair (by MRO
+    distance, lexicographic), so a subclass handler registered after a
+    parent pair is not shadowed by insertion order."""
+    mro_p, mro_q = type(p).__mro__, type(q).__mro__
+    best, best_key = None, None
     for (tp, tq), fn in _KL_REGISTRY.items():
         if isinstance(p, tp) and isinstance(q, tq):
-            return fn(p, q)
-    raise NotImplementedError(
-        f"kl_divergence not registered for "
-        f"({type(p).__name__}, {type(q).__name__})")
+            key = (mro_p.index(tp), mro_q.index(tq))
+            if best_key is None or key < best_key:
+                best, best_key = fn, key
+    if best is None:
+        raise NotImplementedError(
+            f"kl_divergence not registered for "
+            f"({type(p).__name__}, {type(q).__name__})")
+    return best(p, q)
 
 
 @register_kl(Normal, Normal)

@@ -169,7 +169,7 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
         out, _aux = moe_dispatch_combine(
             flat, logits, expert_fn, top_k=moe_topk,
             capacity_factor=capacity_factor,
-            norm_topk_prob=norm_topk_prob)
+            norm_topk_prob=norm_topk_prob, warn_on_drop=True)
         return out.reshape(*lead, d)
 
     return dispatch(f, args, name="fused_moe")

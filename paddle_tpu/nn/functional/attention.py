@@ -15,6 +15,11 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor, dispatch
 from ...core.flags import GLOBAL_FLAGS
 
+# flash_attn_unpadded dropout fallback: query-block size for the chunked
+# score materialization, and the once-per-process warning latch.
+_DROPOUT_CHUNK = 512
+_DROPOUT_FALLBACK_WARNED = False
+
 
 def _ensure(x):
     return x if isinstance(x, Tensor) else Tensor(x)
@@ -116,6 +121,19 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                 "flash_attn_unpadded(causal=True) requires "
                 "cu_seqlens_q == cu_seqlens_k (self-attention packing)")
 
+    if use_dropout:
+        global _DROPOUT_FALLBACK_WARNED
+        if not _DROPOUT_FALLBACK_WARNED:
+            _DROPOUT_FALLBACK_WARNED = True
+            import warnings
+            warnings.warn(
+                "flash_attn_unpadded with dropout falls back to a chunked "
+                "XLA composition (the fused kernel has no in-kernel RNG): "
+                "scores are materialized per query block of "
+                f"{_DROPOUT_CHUNK} rows instead of fully fused. Expect "
+                "lower throughput than dropout=0. This warning fires once "
+                "per process.", stacklevel=2)
+
     def f(q, k, v, cq, ck):
         tq, tk = q.shape[0], k.shape[0]
         seg_q = segment_ids_from_cu_seqlens(cq, tq)[None]
@@ -127,22 +145,43 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
         # dropout path: the fused kernel has no in-kernel RNG, so fall
         # back to the XLA composition with the same segment/causal mask
         # (reference keeps dropout inside flash_attn_kernel.cu via a
-        # Philox offset; XLA fuses this composition comparably on TPU)
+        # Philox offset). Chunked over query blocks so peak memory is
+        # O(heads * chunk * tk) fp32, not the full [tq, tk] score matrix.
         from ...core.random import next_key
         s = scale if scale is not None else q.shape[-1] ** -0.5
-        qf = jnp.swapaxes(q[None], 1, 2).astype(jnp.float32)  # [1,h,tq,d]
-        kf = jnp.swapaxes(k[None], 1, 2).astype(jnp.float32)
-        vf = jnp.swapaxes(v[None], 1, 2).astype(jnp.float32)
-        logits = jnp.einsum("bhqd,bhkd->bhqk", qf * s, kf)
-        mask = seg_q[0][:, None] == seg_k[0][None, :]         # [tq, tk]
-        if causal:
-            mask &= (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])
-        logits = jnp.where(mask[None, None], logits, -1e30)
-        p = jax.nn.softmax(logits, axis=-1)
-        keep = jax.random.bernoulli(next_key(), 1.0 - dropout, p.shape)
-        p = jnp.where(keep, p / (1.0 - dropout), 0.0)
-        out = jnp.einsum("bhqk,bhkd->bhqd", p, vf)
-        return jnp.swapaxes(out, 1, 2)[0].astype(q.dtype)
+        h, d = q.shape[1], q.shape[2]
+        kf = jnp.swapaxes(k, 0, 1).astype(jnp.float32)        # [h, tk, d]
+        vf = jnp.swapaxes(v, 0, 1).astype(jnp.float32)
+        bq = min(_DROPOUT_CHUNK, tq)
+        pad = (-tq) % bq
+        nq = (tq + pad) // bq
+        # Padded rows carry segment id -1 (matches nothing, seg ids >= 0):
+        # their logits are all -1e30 -> softmax is uniform (finite, no
+        # NaN) and the rows are sliced off below.
+        qp = jnp.pad(jnp.swapaxes(q, 0, 1).astype(jnp.float32) * s,
+                     ((0, 0), (0, pad), (0, 0)))              # [h, tqp, d]
+        segq = jnp.pad(seg_q[0], (0, pad), constant_values=-1)
+        qc = qp.reshape(h, nq, bq, d).transpose(1, 0, 2, 3)   # [nq,h,bq,d]
+        segc = segq.reshape(nq, bq)
+        posc = jnp.arange(nq * bq).reshape(nq, bq)
+        keys = jax.random.split(next_key(), nq)
+        kpos = jnp.arange(tk)
+
+        def one_chunk(_, xs):
+            qi, sgi, pi, ki = xs
+            lg = jnp.einsum("hqd,hkd->hqk", qi, kf)
+            m = sgi[:, None] == seg_k[0][None, :]
+            if causal:
+                m &= pi[:, None] >= kpos[None, :]
+            lg = jnp.where(m[None], lg, -1e30)
+            p = jax.nn.softmax(lg, axis=-1)
+            keep = jax.random.bernoulli(ki, 1.0 - dropout, p.shape)
+            p = jnp.where(keep, p / (1.0 - dropout), 0.0)
+            return None, jnp.einsum("hqk,hkd->hqd", p, vf)
+
+        _, outc = jax.lax.scan(one_chunk, None, (qc, segc, posc, keys))
+        out = outc.transpose(0, 2, 1, 3).reshape(nq * bq, h, d)[:tq]
+        return out.astype(q.dtype)
 
     args = tuple(_ensure(a) for a in
                  (query, key, value, cu_seqlens_q, cu_seqlens_k))

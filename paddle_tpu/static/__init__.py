@@ -227,6 +227,11 @@ def data(name: str, shape, dtype="float32", lod_level=0):
     concrete = [1 if (s is None or int(s) < 0) else int(s) for s in shape]
     t = Tensor(jnp.zeros(tuple(concrete), convert_dtype(dtype)),
                stop_gradient=True, name=name)
+    # Remember which dims were declared dynamic (None/-1): build-time
+    # consumers like static.nn.fc must not silently size weights off the
+    # placeholder's stand-in 1s.
+    t._declared_shape = tuple(
+        None if (s is None or int(s) < 0) else int(s) for s in shape)
     prog = default_main_program()
     prog._register_data(name, t)
     return t
@@ -252,6 +257,27 @@ class Executor:
         raise TypeError(f"Executor.run: unsupported program {program!r}")
 
 
+def _declared_dims(x):
+    """Build-time dims of ``x`` for sizing parameters, honoring the shape
+    DECLARED in static.data (where None/-1 dims were stood in by 1).
+    Raises if the consumer would silently size a parameter off a stand-in.
+
+    Limitation (documented): the declared shape lives only on the raw
+    placeholder; tensors derived through ops fall back to their concrete
+    example shape, so declare dims consumed by parameter-creating
+    builders directly on the placeholder they are applied to."""
+    declared = getattr(x, "_declared_shape", None)
+    return list(declared if declared is not None else x.shape)
+
+
+def _reject_dynamic(dims, what):
+    if any(d is None or (isinstance(d, int) and d < 0) for d in dims):
+        raise ValueError(
+            f"{what}: dims {dims} contain a dynamic (None/-1) dimension, "
+            "so the parameter size cannot be derived at build time; "
+            "declare those dims concretely in static.data")
+
+
 class _StaticNN:
     """paddle.static.nn facade (reference: python/paddle/static/nn/) —
     layer builders that create parameters at build time (recorded as
@@ -263,7 +289,10 @@ class _StaticNN:
            activation=None, name=None):
         from ..nn import initializer as I
 
-        in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+        feat_dims = _declared_dims(x)[num_flatten_dims:]
+        _reject_dynamic(feat_dims, "static.nn.fc feature dims "
+                                   f"(shape[{num_flatten_dims}:])")
+        in_dim = int(np.prod(feat_dims))
         w = Tensor(I.XavierUniform()((in_dim, size), x.dtype),
                    stop_gradient=False, name=(name or "fc") + ".w")
         b = None
@@ -310,7 +339,10 @@ class _StaticNN:
                    data_layout="NCHW", name=None):
         from ..core.tensor import dispatch
         c_axis = 1 if data_layout == "NCHW" else -1
-        c = input.shape[c_axis]
+        dims = _declared_dims(input)
+        _reject_dynamic([dims[c_axis]],
+                        "static.nn.batch_norm channel dim")
+        c = int(dims[c_axis])
         scale = Tensor(jnp.ones((c,)), stop_gradient=False)
         bias = Tensor(jnp.zeros((c,)), stop_gradient=False)
 

@@ -108,10 +108,16 @@ class TestDistributions:
         assert abs(kl - (np.log(2) + 2 / 8 - 0.5)) < 1e-5
 
     def test_categorical_and_bernoulli(self):
-        c = dist.Categorical(np.log(np.array([0.2, 0.3, 0.5], np.float32)))
+        # probs/log_prob normalize linearly (reference
+        # categorical.py:148-149): weights [2,3,5] -> p = [.2,.3,.5]
+        c = dist.Categorical(np.array([2.0, 3.0, 5.0], np.float32))
         lp = np.asarray(c.log_prob(paddle.to_tensor(np.array([2]))).numpy())
         assert abs(np.exp(lp[0]) - 0.5) < 1e-5
-        ent = float(np.asarray(c.entropy().numpy()))
+        pr = np.asarray(c.probs(paddle.to_tensor(np.array([0, 1]))).numpy())
+        np.testing.assert_allclose(pr, [0.2, 0.3], atol=1e-6)
+        # entropy/sample go through softmax (reference _logits_to_probs)
+        c2 = dist.Categorical(np.log(np.array([0.2, 0.3, 0.5], np.float32)))
+        ent = float(np.asarray(c2.entropy().numpy()))
         ref = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
         assert abs(ent - ref) < 1e-5
         b = dist.Bernoulli(np.array(0.25, np.float32))
@@ -173,6 +179,28 @@ class TestDistributions:
         with pytest.raises(NotImplementedError):
             dist.kl_divergence(dist.Normal(0.0, 1.0),
                                dist.Gamma(1.0, 1.0))
+
+    def test_kl_most_specific_dispatch(self):
+        """A subclass handler registered AFTER the parent pair must win
+        (reference kl.py dispatches most-specific, not insertion order)."""
+        from paddle_tpu.distribution import register_kl, _KL_REGISTRY
+
+        class _MyNormal(dist.Normal):
+            pass
+
+        @register_kl(_MyNormal, dist.Normal)
+        def _kl_mynormal(p, q):  # noqa: ARG001
+            return "subclass-handler"
+
+        try:
+            p = _MyNormal(0.0, 1.0)
+            q = dist.Normal(1.0, 2.0)
+            assert dist.kl_divergence(p, q) == "subclass-handler"
+            # plain Normal pair still routes to the generic handler
+            got = dist.kl_divergence(dist.Normal(0.0, 1.0), q)
+            assert got != "subclass-handler"
+        finally:
+            _KL_REGISTRY.pop((_MyNormal, dist.Normal), None)
 
 
 class TestRegularizerAndBatch:
@@ -268,3 +296,20 @@ def test_program_replay_sees_inplace_weight_updates():
     wt._value = wt._value * 0.0
     b = exe.run(main, feed=feed, fetch_list=[y])[0]
     assert not np.allclose(a, 0) and np.allclose(b, 0)
+
+
+def test_static_fc_rejects_dynamic_feature_dim():
+    """ADVICE round-2: a None feature dim would silently size the weight
+    off the placeholder's stand-in 1 — must raise at build time."""
+    import pytest
+    from paddle_tpu import static
+
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, None, 4], "float32")
+        with pytest.raises(ValueError, match="dynamic"):
+            static.nn.fc(x, 2)  # feature dims = shape[1:] = (None, 4)
+        # batch-only dynamism stays fine
+        x2 = static.data("x2", [None, 4], "float32")
+        y = static.nn.fc(x2, 2, bias_attr=False)
+    assert y is not None

@@ -297,3 +297,34 @@ def test_flash_attn_unpadded_dropout_falls_back():
                                   dropout=0.3, training=False)
     np.testing.assert_allclose(np.asarray(o0.numpy()),
                                np.asarray(o2.numpy()), atol=1e-5)
+
+
+def test_flash_attn_unpadded_dropout_chunked_and_warns(monkeypatch):
+    """The dropout fallback is chunked over query blocks (bounded memory)
+    and warns once per process. With a vanishing dropout rate the chunked
+    composition must match the fused no-dropout kernel."""
+    import warnings
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.nn.functional import attention as attn_mod
+
+    monkeypatch.setattr(attn_mod, "_DROPOUT_CHUNK", 4)  # force nq=3 chunks
+    monkeypatch.setattr(attn_mod, "_DROPOUT_FALLBACK_WARNED", False)
+    rng = np.random.RandomState(1)
+    tq, h, d = 12, 2, 8
+    q = paddle.to_tensor(rng.randn(tq, h, d).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(tq, h, d).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(tq, h, d).astype(np.float32))
+    cu = paddle.to_tensor(np.array([0, 5, 12], np.int32))
+    o0, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True)
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        o1, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True,
+                                      dropout=1e-9, training=True)
+        o2, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True,
+                                      dropout=1e-9, training=True)
+    msgs = [str(w.message) for w in rec if "chunked" in str(w.message)]
+    assert len(msgs) == 1  # once per process, not per call
+    np.testing.assert_allclose(np.asarray(o0.numpy()),
+                               np.asarray(o1.numpy()), atol=1e-4)
+    assert np.asarray(o2.numpy()).shape == (tq, h, d)

@@ -298,3 +298,28 @@ class TestFusedMoe:
         assert np.asarray(out.numpy()).shape == (1, 4, 8)
         with pytest.raises(NotImplementedError):
             fused_moe(x, gw, w1, w2, quant_method="weight_only_int8")
+
+    def test_capacity_drop_warns_once(self, monkeypatch):
+        """ADVICE round-2: silent token drops past expert capacity must
+        warn (the reference grouped GEMM computes all routed tokens)."""
+        import warnings
+        from paddle_tpu.distributed.fleet import moe as moe_mod
+        from paddle_tpu.incubate.nn.functional import fused_moe
+
+        monkeypatch.setattr(moe_mod, "_CAPACITY_DROP_WARNED", False)
+        rng = np.random.RandomState(2)
+        # All tokens route to one expert; capacity_factor keeps only a few
+        x = paddle.to_tensor(np.ones((1, 32, 8), np.float32))
+        gw = np.zeros((8, 4), np.float32)
+        gw[:, 0] = 1.0  # expert 0 dominates every token
+        gw = paddle.to_tensor(gw)
+        w1 = paddle.to_tensor(rng.randn(4, 8, 16).astype(np.float32))
+        w2 = paddle.to_tensor(rng.randn(4, 16, 8).astype(np.float32))
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            out = fused_moe(x, gw, w1, w2, moe_topk=1,
+                            capacity_factor=0.25)
+            np.asarray(out.numpy())
+            jax.effects_barrier()  # debug callbacks are async-delivered
+        msgs = [str(w.message) for w in rec if "dropped" in str(w.message)]
+        assert len(msgs) == 1
