@@ -10,7 +10,8 @@ from .fleet import (init, distributed_model, distributed_optimizer,  # noqa
                     worker_num, worker_index)
 from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa
                         RowParallelLinear, ParallelCrossEntropy)
-from .pp_compiled import CompiledPipeline, pipeline_microbatch  # noqa
+from .pp_compiled import (CompiledPipeline, Compiled1F1B,  # noqa
+                          pipeline_microbatch)
 from . import sequence_parallel_utils  # noqa: F401
 from . import random  # noqa: F401
 

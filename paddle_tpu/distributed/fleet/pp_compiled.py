@@ -33,7 +33,7 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-__all__ = ["CompiledPipeline", "pipeline_microbatch"]
+__all__ = ["CompiledPipeline", "Compiled1F1B", "pipeline_microbatch"]
 
 
 def pipeline_microbatch(batch, num_microbatches: int):
@@ -113,3 +113,168 @@ class CompiledPipeline:
         except TypeError:  # jax >= 0.8 renamed the replication check
             fn = shard_map(device_prog, check_vma=False, **kwargs)
         return fn(stage_params, x)
+
+
+class Compiled1F1B:
+    """Compiled 1F1B pipeline schedule: forward AND backward interleaved
+    in ONE scanned XLA program (reference eager loop:
+    fleet/meta_parallel/pipeline_parallel.py:684; static-graph pass:
+    pipeline_scheduler_pass/pipeline_1f1b.py).
+
+    Schedule (full-tick form, T = M + 2S - 2 ticks): stage ``s`` runs the
+    forward of micro-batch ``m`` at tick ``s + m`` (the GPipe wave) and
+    its backward at tick ``2S - 2 - s + m`` — the backward wave starts at
+    the last stage the tick after its first forward and flows back over
+    ICI. Every tick each stage computes one (masked) F slot and one
+    (masked) B slot; ``lax.ppermute`` shifts activations forward and
+    input-cotangents backward.
+
+    Memory is the point: only the stage INPUTS of in-flight micro-batches
+    are stashed, in a ring buffer of K = min(M, 2S-1) slots, and the
+    backward slot recomputes its forward under ``jax.vjp`` (per-microbatch
+    rematerialization). Peak live activation state is O(S), independent of
+    M — versus the compiled GPipe form, where jax AD through the scan
+    keeps O(M + S) tick residuals alive. AD never sees the scan: each
+    tick takes explicit vjps, so the schedule IS the backward.
+
+    ``split_dw=True`` reproduces the zero-bubble dW/dX split
+    (zero_bubble.py WeightGradStore; reference
+    pipeline_scheduler_pass/pipeline_zero_bubble.py:62 ZB-H1): the B slot
+    computes ONLY dX (unblocking the predecessor stage), while (x, dy)
+    are queued and the parameter gradient is computed in a deferred W
+    slot one tick later (T + 1 ticks total). In this SPMD-uniform masked
+    formulation every tick costs the same wall-clock on every stage, so —
+    unlike the eager engine, where ZB fills real idle bubbles — the split
+    does not change the tick count; it is implemented for schedule parity
+    and for the cases where the W slot's matmuls overlap better under
+    XLA's scheduler.
+
+    Contract: ``stage_fn(stage_params, x) -> y`` uniform across stages
+    with y.shape == x.shape (same as CompiledPipeline); ``loss_fn(y,
+    label) -> scalar`` is applied per micro-batch at the last stage and
+    averaged over micro-batches.
+
+    ``loss_and_grads(stage_params, x, labels)`` with x/labels
+    micro-batched ``[M, mb, ...]`` returns ``(loss, grads)`` with grads
+    shaped like ``stage_params`` (leading [S] axis sharded over ``pp``).
+    """
+
+    def __init__(self, stage_fn: Callable, loss_fn: Callable, mesh: Mesh,
+                 num_microbatches: int, axis: str = "pp",
+                 split_dw: bool = False):
+        self.stage_fn = stage_fn
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.axis = axis
+        self.num_stages = mesh.shape[axis]
+        self.num_microbatches = num_microbatches
+        self.split_dw = split_dw
+
+    def loss_and_grads(self, stage_params, x, labels):
+        S = self.num_stages
+        M = self.num_microbatches
+        for name, v in (("x", x), ("labels", labels)):
+            lead = jax.tree_util.tree_leaves(v)[0].shape[0]
+            if lead != M:
+                raise ValueError(
+                    f"Compiled1F1B: {name} leading dim {lead} != "
+                    f"num_microbatches {M}; split with "
+                    "pipeline_microbatch(batch, M) first")
+        axis = self.axis
+        body = self.stage_fn
+        loss_fn = self.loss_fn
+        split_dw = self.split_dw
+        K = min(M, 2 * S - 1)          # max in-flight micro-batches
+        T = M + 2 * S - 2 + (1 if split_dw else 0)
+
+        def device_prog(params_local, x_local, y_local):
+            my = jax.tree_util.tree_map(lambda p: p[0], params_local)
+            s = jax.lax.axis_index(axis)
+            mb_x = x_local[0]           # [mb, ...] activation template
+            act0 = jnp.zeros_like(mb_x)
+            dy0 = jnp.zeros_like(mb_x)  # y.shape == x.shape contract
+            stash0 = jnp.zeros((K,) + mb_x.shape, mb_x.dtype)
+            grads0 = jax.tree_util.tree_map(jnp.zeros_like, my)
+            # deferred-W queue: (x, dy) of the previous tick's B slot
+            wq0 = (jnp.zeros_like(mb_x), jnp.zeros_like(mb_x),
+                   jnp.asarray(False))
+
+            def fwd_x(p, xx):
+                return body(p, xx)
+
+            def tick(carry, t):
+                act_in, dy_in, stash, grads, loss_acc, wq = carry
+
+                # ---- F slot: micro-batch t - s --------------------------
+                m_f = t - s
+                valid_f = (m_f >= 0) & (m_f < M)
+                m_f_c = jnp.clip(m_f, 0, M - 1)
+                x_f = jnp.where(s == 0, x_local[m_f_c], act_in)
+                y_f = body(my, x_f)
+                slot_f = jnp.mod(m_f_c, K)
+                stash = stash.at[slot_f].set(
+                    jnp.where(valid_f, x_f, stash[slot_f]))
+
+                # ---- B slot: micro-batch t - (2S - 2 - s) ---------------
+                m_b = t - (2 * S - 2 - s)
+                valid_b = (m_b >= 0) & (m_b < M)
+                m_b_c = jnp.clip(m_b, 0, M - 1)
+                x_b = stash[jnp.mod(m_b_c, K)]
+                label_b = y_local[m_b_c]
+                if split_dw:
+                    y_b, vjp_x = jax.vjp(lambda xx: body(my, xx), x_b)
+                else:
+                    y_b, vjp_body = jax.vjp(fwd_x, my, x_b)
+                loss_b, vjp_loss = jax.vjp(
+                    lambda yy: loss_fn(yy, label_b), y_b)
+                (dy_loss,) = vjp_loss(
+                    jnp.asarray(1.0 / M, jnp.result_type(loss_b)))
+                dy = jnp.where(s == S - 1, dy_loss.astype(dy_in.dtype),
+                               dy_in)
+                if split_dw:
+                    # dX now (unblocks stage s-1); (x, dy) queued for the
+                    # deferred W slot — WeightGradStore.put semantics.
+                    (dx,) = vjp_x(dy)
+                    # ---- W slot: flush the PREVIOUS tick's queue --------
+                    wx, wdy, wvalid = wq
+                    _, vjp_w = jax.vjp(lambda p: body(p, wx), my)
+                    (dp,) = vjp_w(wdy)
+                    gmask = wvalid
+                    wq = (jnp.where(valid_b, x_b, wx),
+                          jnp.where(valid_b, dy, wdy),
+                          valid_b)
+                else:
+                    dp, dx = vjp_body(dy)
+                    gmask = valid_b
+                grads = jax.tree_util.tree_map(
+                    lambda g, d: g + jnp.where(gmask, d, 0.0), grads, dp)
+                loss_acc = loss_acc + jnp.where(
+                    valid_b & (s == S - 1), loss_b, 0.0)
+
+                # ---- shifts: activations up, cotangents down ------------
+                act_out = jax.lax.ppermute(
+                    jnp.where(valid_f, y_f, 0.0), axis,
+                    [(i, i + 1) for i in range(S - 1)])
+                dy_out = jax.lax.ppermute(
+                    jnp.where(valid_b, dx, 0.0), axis,
+                    [(i, i - 1) for i in range(1, S)])
+                return (act_out, dy_out, stash, grads, loss_acc, wq), None
+
+            carry0 = (act0, dy0, stash0, grads0,
+                      jnp.asarray(0.0, jnp.float32), wq0)
+            carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+            _, _, _, grads, loss_acc, _ = carry
+            # loss lives on the last stage (others contributed 0); the
+            # accumulator summed M per-microbatch losses -> average
+            loss = jax.lax.psum(loss_acc, axis) / M
+            grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+            return loss, grads
+
+        spec_p = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
+        kwargs = dict(mesh=self.mesh, in_specs=(spec_p, P(), P()),
+                      out_specs=(P(), spec_p))
+        try:
+            fn = shard_map(device_prog, check_rep=False, **kwargs)
+        except TypeError:  # jax >= 0.8 renamed the replication check
+            fn = shard_map(device_prog, check_vma=False, **kwargs)
+        return fn(stage_params, x, labels)

@@ -223,6 +223,117 @@ class TestCompiledPipeline:
                 losses.append(float(l))
         assert losses[-1] < losses[0]
 
+    def test_1f1b_matches_sequential(self):
+        """Compiled 1F1B (manual vjp ticks, no AD through the scan) must
+        produce the same loss/grads as the jitted sequential model."""
+        import jax
+        from paddle_tpu.distributed.fleet.pp_compiled import Compiled1F1B
+        pipe, stage_fn, mesh, params, x, y_tgt, S = self._setup()
+
+        def loss_fn(y, label):
+            return jnp.mean((y - label) ** 2)
+
+        fifo = Compiled1F1B(stage_fn, loss_fn, mesh, num_microbatches=8)
+        with mesh:
+            lp, gp = jax.jit(fifo.loss_and_grads)(params, x, y_tgt)
+
+        def loss_seq(params, x, y_tgt):
+            W, B = params
+
+            def fwd(v):
+                for s in range(S):
+                    v = stage_fn((W[s], B[s]), v)
+                return v
+            per_mb = jax.vmap(
+                lambda xv, yv: loss_fn(fwd(xv), yv))(x, y_tgt)
+            return jnp.mean(per_mb)
+
+        ls, gs = jax.jit(jax.value_and_grad(loss_seq))(params, x, y_tgt)
+        assert abs(float(lp) - float(ls)) < 1e-6
+        for a, b in zip(jax.tree_util.tree_leaves(gp),
+                        jax.tree_util.tree_leaves(gs)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+    def test_1f1b_split_dw_matches(self):
+        """ZB dW/dX split (deferred W slot) computes identical grads."""
+        import jax
+        from paddle_tpu.distributed.fleet.pp_compiled import Compiled1F1B
+        pipe, stage_fn, mesh, params, x, y_tgt, S = self._setup()
+
+        def loss_fn(y, label):
+            return jnp.mean((y - label) ** 2)
+
+        plain = Compiled1F1B(stage_fn, loss_fn, mesh, num_microbatches=8)
+        zb = Compiled1F1B(stage_fn, loss_fn, mesh, num_microbatches=8,
+                          split_dw=True)
+        with mesh:
+            l0, g0 = jax.jit(plain.loss_and_grads)(params, x, y_tgt)
+            l1, g1 = jax.jit(zb.loss_and_grads)(params, x, y_tgt)
+        assert abs(float(l0) - float(l1)) < 1e-6
+        for a, b in zip(jax.tree_util.tree_leaves(g0),
+                        jax.tree_util.tree_leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+
+    def test_1f1b_trains(self):
+        import jax
+        from paddle_tpu.distributed.fleet.pp_compiled import Compiled1F1B
+        pipe, stage_fn, mesh, params, x, y_tgt, S = self._setup()
+
+        def loss_fn(y, label):
+            return jnp.mean((y - label) ** 2)
+
+        fifo = Compiled1F1B(stage_fn, loss_fn, mesh, num_microbatches=8)
+
+        @jax.jit
+        def step(params, x, y):
+            l, g = fifo.loss_and_grads(params, x, y)
+            return l, jax.tree_util.tree_map(
+                lambda p, gg: p - 0.5 * gg, params, g)
+
+        with mesh:
+            losses = []
+            for _ in range(5):
+                l, params = step(params, x, y_tgt)
+                losses.append(float(l))
+        assert losses[-1] < losses[0]
+
+    def test_1f1b_activation_memory_below_gpipe(self):
+        """VERDICT round-2 #5 'done' criterion: at M=8 the 1F1B program's
+        peak live activation state must be measurably below compiled
+        GPipe's. Compare XLA's own accounting (temp buffer bytes) of the
+        two compiled loss+grad programs; skip if this backend's
+        memory_analysis is unavailable."""
+        import jax
+        from paddle_tpu.distributed.fleet.pp_compiled import Compiled1F1B
+        M = 8
+        pipe, stage_fn, mesh, params, x, y_tgt, S = self._setup(
+            S=4, M=M, D=64, mb=16)
+
+        def loss_fn(y, label):
+            return jnp.mean((y - label) ** 2)
+
+        fifo = Compiled1F1B(stage_fn, loss_fn, mesh, num_microbatches=M)
+
+        def gpipe_loss(params, x, y):
+            return jnp.mean(jax.vmap(loss_fn)(pipe(params, x), y))
+
+        with mesh:
+            c_1f1b = jax.jit(fifo.loss_and_grads).lower(
+                params, x, y_tgt).compile()
+            c_gpipe = jax.jit(jax.value_and_grad(gpipe_loss)).lower(
+                params, x, y_tgt).compile()
+        try:
+            m1 = c_1f1b.memory_analysis()
+            m2 = c_gpipe.memory_analysis()
+            t1, t2 = m1.temp_size_in_bytes, m2.temp_size_in_bytes
+        except Exception:
+            pytest.skip("memory_analysis unavailable on this backend")
+        if not t1 or not t2:
+            pytest.skip("backend reports zero temp sizes")
+        assert t1 < t2, f"1f1b temp {t1} not below gpipe temp {t2}"
+
     def test_pp_with_dp_axis(self):
         """pp pipeline composed with a dp axis on a 2x4 mesh."""
         import jax
