@@ -95,50 +95,85 @@ def _multiprocess() -> bool:
         return False
 
 
-def _reject_eager_subgroup(group, opname):
-    """Eager sub-group collectives in multi-process mode would silently
-    compute the single-controller identity on purely local values — wrong
-    results with no error. Fail loudly until sub-group comm lands."""
-    if group is not None and _multiprocess():
-        raise NotImplementedError(
-            f"{opname}: eager collectives over an explicit sub-group are "
-            "not supported in multi-process mode — run the collective "
-            "inside a shard_map/jit (traced path) or use the default "
-            "world group (group=None)")
+def _group_ranks(group):
+    """Explicit global process ranks of ``group``, or None when the group
+    is (equivalent to) the world group."""
+    if group is None:
+        return None
+    ranks = getattr(group, "ranks", None)
+    if not ranks:
+        return None
+    world = max(jax.process_count(), 1)
+    if list(ranks) == list(range(world)):
+        return None
+    return tuple(int(r) for r in ranks)
 
 
-_world_state = {"mesh": None, "gather": None}
+# per-group comm state: ranks tuple (None = world) -> (mesh, jitted gather)
+_group_state = {}
 
 
-def _world_stacked(v):
-    """Each process contributes its local ``v``; returns the replicated
-    [world, ...] stack (one cross-process all-gather). The communication
-    layer of every eager collective in multi-process mode. The mesh and
-    the jitted gather are built once per process (the device set is
-    fixed), so repeated calls — one per gradient in a DP loop — hit the
-    jit cache instead of retracing."""
+def _stacked(v, ranks=None):
+    """Each member process contributes its local ``v``; returns the
+    replicated [n_ranks, ...] stack (one cross-process all-gather over the
+    member processes' devices). The communication layer of every eager
+    collective in multi-process mode — sub-groups get a sub-mesh built
+    from their global ranks (reference new_group semantics,
+    python/paddle/distributed/collective.py:195). Must be called by every
+    member process (and only members); mesh + jitted gather are cached
+    per group so per-gradient DP loops hit the jit cache."""
     from jax.sharding import Mesh
-    if _world_state["mesh"] is None:
-        _world_state["mesh"] = Mesh(np.array(jax.devices()), ("world",))
+    key = tuple(ranks) if ranks is not None else None
+    st = _group_state.get(key)
+    if st is None:
+        if ranks is None:
+            devs = np.array(jax.devices())
+        else:
+            by_proc = {}
+            for d in jax.devices():
+                by_proc.setdefault(d.process_index, []).append(d)
+            missing = [r for r in ranks if r not in by_proc]
+            if missing:
+                raise ValueError(
+                    f"group ranks {missing} have no devices (world size "
+                    f"{jax.process_count()})")
+            devs = np.array([d for r in ranks for d in by_proc[r]])
+        mesh = Mesh(devs, ("grp",))
 
         def _identity(a):
             return a
-        _world_state["gather"] = jax.jit(
-            _identity,
-            out_shardings=NamedSharding(_world_state["mesh"], P()))
-    mesh = _world_state["mesh"]
+        gather = jax.jit(_identity, out_shardings=NamedSharding(mesh, P()))
+        _group_state[key] = st = (mesh, gather)
+    mesh, gather = st
     local = np.asarray(v)[None]
     if jax.local_device_count() > 1:
         # one contribution per local device (all identical)
         local = np.broadcast_to(local, (jax.local_device_count(),)
                                 + local.shape[1:])
     arr = jax.make_array_from_process_local_data(
-        NamedSharding(mesh, P("world")), local)
-    out = _world_state["gather"](arr)
+        NamedSharding(mesh, P("grp")), local)
+    out = gather(arr)
     stacked = jnp.asarray(out.addressable_data(0))
     if jax.local_device_count() > 1:
         stacked = stacked[::jax.local_device_count()]
     return stacked
+
+
+def _world_stacked(v):
+    return _stacked(v, None)
+
+
+def _eager_mp_group(group):
+    """For an eager multi-process collective: returns ``(participate,
+    ranks, pos)`` — whether this process is a member, the group's explicit
+    ranks (None = world), and this process's position in the group."""
+    ranks = _group_ranks(group)
+    me = jax.process_index()
+    if ranks is None:
+        return True, None, me
+    if me not in ranks:
+        return False, ranks, -1
+    return True, ranks, ranks.index(me)
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -157,9 +192,11 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         else:
             out = jnp.exp(jax.lax.psum(jnp.log(v), ax))
         return _apply(tensor, out)
-    _reject_eager_subgroup(group, "all_reduce")
-    if _multiprocess() and group is None:
-        stacked = _world_stacked(v)
+    if _multiprocess():
+        participate, ranks, _ = _eager_mp_group(group)
+        if not participate:
+            return _apply(tensor, v)  # non-member: collective is not ours
+        stacked = _stacked(v, ranks)
         if op == ReduceOp.SUM:
             out = stacked.sum(axis=0)
         elif op == ReduceOp.MAX:
@@ -202,15 +239,19 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
                 tensor_list.append(Tensor(gathered[i]))
             return _Task(gathered)
         return gathered
-    _reject_eager_subgroup(group, "all_gather")
-    if _multiprocess() and group is None:
-        stacked = _world_stacked(v)
+    if _multiprocess():
+        participate, ranks, _ = _eager_mp_group(group)
+        if participate:
+            stacked = _stacked(v, ranks)
+            if isinstance(tensor_list, list):
+                tensor_list.clear()
+                for i in range(stacked.shape[0]):
+                    tensor_list.append(Tensor(stacked[i]))
+                return _Task(stacked)
+            return stacked
         if isinstance(tensor_list, list):
-            tensor_list.clear()
-            for i in range(stacked.shape[0]):
-                tensor_list.append(Tensor(stacked[i]))
-            return _Task(stacked)
-        return stacked
+            return _Task(v)  # non-member: leave outputs untouched
+        return v
     n = group.nranks if group is not None else _default_world(ax)
     if isinstance(tensor_list, list):
         tensor_list.clear()
@@ -243,14 +284,24 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
         out = jax.lax.psum_scatter(src, ax, scatter_dimension=0,
                                    tiled=True)
         return _apply(tensor, out)
-    _reject_eager_subgroup(group, "reduce_scatter")
-    if _multiprocess() and group is None:
-        stacked = _world_stacked(src)          # [world, N, ...]
-        total = stacked.sum(axis=0)
+    if _multiprocess():
+        participate, ranks, pos = _eager_mp_group(group)
+        if not participate:
+            return _apply(tensor, to_value(tensor))
+        stacked = _stacked(src, ranks)         # [n_ranks, N, ...]
+        if op == ReduceOp.SUM:
+            total = stacked.sum(axis=0)
+        elif op == ReduceOp.MAX:
+            total = stacked.max(axis=0)
+        elif op == ReduceOp.MIN:
+            total = stacked.min(axis=0)
+        elif op == ReduceOp.AVG:
+            total = stacked.mean(axis=0)
+        else:
+            total = stacked.prod(axis=0)
         n = stacked.shape[0]
         per = total.shape[0] // n
-        r = jax.process_index()
-        return _apply(tensor, total[r * per:(r + 1) * per])
+        return _apply(tensor, total[pos * per:(pos + 1) * per])
     n = group.nranks if group is not None else _default_world(ax)
     out = (src * n)[: src.shape[0] // n]
     return _apply(tensor, out)
@@ -259,9 +310,19 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
 def broadcast(tensor, src=0, group=None, sync_op=True):
     """Inside SPMD traces broadcast is the identity on the replicated value
     (all ranks compute it); cross-process eager broadcast uses the
-    coordination service via multihost_utils."""
+    coordination service via multihost_utils (world) or the group gather
+    path (sub-group; ``src`` is a GLOBAL rank, reference convention)."""
     v = to_value(tensor)
     if not _in_trace(v) and jax.process_count() > 1:
+        participate, ranks, _ = _eager_mp_group(group)
+        if ranks is not None:
+            if not participate:
+                return _apply(tensor, v)
+            if src not in ranks:
+                raise ValueError(
+                    f"broadcast: src rank {src} not in group {ranks}")
+            stacked = _stacked(v, ranks)
+            return _apply(tensor, stacked[ranks.index(src)])
         from jax.experimental import multihost_utils
         out = multihost_utils.broadcast_one_to_all(
             v, is_source=jax.process_index() == src)
@@ -276,17 +337,25 @@ def broadcast_object_list(object_list, src=0, group=None):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     ax = _axis(group)
     v = to_value(tensor)
-    if not _in_trace(v):
-        _reject_eager_subgroup(group, "scatter")
-    if _multiprocess() and group is None and not _in_trace(v):
-        # every rank must join the collective — non-src ranks pass
+    if _multiprocess() and not _in_trace(v):
+        participate, ranks, pos = _eager_mp_group(group)
+        if not participate:
+            return _apply(tensor, v)
+        n = len(ranks) if ranks is not None else jax.process_count()
+        # every member must join the collective — non-src ranks pass
         # tensor_list=None in the paddle convention, so they contribute
         # a zero buffer of the right shape
-        from jax.experimental import multihost_utils
         if tensor_list is not None:
             stacked = jnp.stack([to_value(t) for t in tensor_list])
         else:
-            stacked = jnp.zeros((jax.process_count(),) + v.shape, v.dtype)
+            stacked = jnp.zeros((n,) + v.shape, v.dtype)
+        if ranks is not None:
+            if src not in ranks:
+                raise ValueError(
+                    f"scatter: src rank {src} not in group {ranks}")
+            gathered = _stacked(stacked, ranks)  # [n, n, ...]
+            return _apply(tensor, gathered[ranks.index(src), pos])
+        from jax.experimental import multihost_utils
         stacked = multihost_utils.broadcast_one_to_all(
             stacked, is_source=jax.process_index() == src)
         return _apply(tensor, stacked[jax.process_index()])
@@ -312,17 +381,19 @@ def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
         for i in range(out.shape[0]):
             out_tensor_list.append(Tensor(out[i]))
         return _Task(out)
-    if vals and not _in_trace(vals[0]):
-        _reject_eager_subgroup(group, "all_to_all")
-    if _multiprocess() and group is None and vals:
-        # rank r's output j is rank j's input r: one world gather of the
-        # stacked inputs, then index [j, my_rank]
-        all_in = _world_stacked(jnp.stack(vals))   # [world, world, ...]
-        r = jax.process_index()
+    if _multiprocess() and vals:
+        participate, ranks, pos = _eager_mp_group(group)
+        if participate:
+            # rank r's output j is rank j's input r: one group gather of
+            # the stacked inputs, then index [j, my_position]
+            all_in = _stacked(jnp.stack(vals), ranks)  # [n, n, ...]
+            out_tensor_list.clear()
+            for j in range(all_in.shape[0]):
+                out_tensor_list.append(Tensor(all_in[j, pos]))
+            return _Task(all_in)
         out_tensor_list.clear()
-        for j in range(all_in.shape[0]):
-            out_tensor_list.append(Tensor(all_in[j, r]))
-        return _Task(all_in)
+        out_tensor_list.extend([Tensor(x) for x in vals])
+        return _Task(None)
     out_tensor_list.clear()
     out_tensor_list.extend([Tensor(v) for v in vals])
     return _Task(None)
@@ -392,10 +463,16 @@ def get_group(gid=0):
 
 def new_group(ranks=None, backend=None, timeout=None):
     """reference: python/paddle/distributed/collective.py:195. Returns a
-    CommGroup view; mesh-axis based (ranks arg kept for API parity)."""
-    ranks = ranks if ranks is not None else list(range(
+    CommGroup over the given GLOBAL ranks; in multi-process mode eager
+    collectives over the group really communicate between exactly those
+    processes (sub-mesh gather path, ``_stacked``)."""
+    ranks = list(ranks) if ranks is not None else list(range(
         max(jax.process_count(), 1)))
-    return CommGroup("dp", ranks, 0)
+    try:
+        rank = ranks.index(jax.process_index())
+    except Exception:  # not a member, or jax.distributed not initialized
+        rank = -1  # CommGroup.get_group_rank's non-member sentinel
+    return CommGroup("dp", ranks, rank)
 
 
 class stream:

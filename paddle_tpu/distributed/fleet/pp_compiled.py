@@ -140,14 +140,13 @@ class Compiled1F1B:
     ``split_dw=True`` reproduces the zero-bubble dW/dX split
     (zero_bubble.py WeightGradStore; reference
     pipeline_scheduler_pass/pipeline_zero_bubble.py:62 ZB-H1): the B slot
-    computes ONLY dX (unblocking the predecessor stage), while (x, dy)
-    are queued and the parameter gradient is computed in a deferred W
-    slot one tick later (T + 1 ticks total). In this SPMD-uniform masked
-    formulation every tick costs the same wall-clock on every stage, so —
-    unlike the eager engine, where ZB fills real idle bubbles — the split
-    does not change the tick count; it is implemented for schedule parity
-    and for the cases where the W slot's matmuls overlap better under
-    XLA's scheduler.
+    sends dX back immediately while the parameter-grad ACCUMULATION is
+    queued and flushed in a deferred W slot one tick later (T + 1 ticks
+    total; the W slot shares the B slot's vjp, so no extra forward is
+    recomputed). In this SPMD-uniform masked formulation every tick costs
+    the same wall-clock on every stage, so — unlike the eager engine,
+    where ZB fills real idle bubbles — the split does not change the tick
+    count; it is implemented for schedule parity.
 
     Contract: ``stage_fn(stage_params, x) -> y`` uniform across stages
     with y.shape == x.shape (same as CompiledPipeline); ``loss_fn(y,
@@ -195,8 +194,8 @@ class Compiled1F1B:
             dy0 = jnp.zeros_like(mb_x)  # y.shape == x.shape contract
             stash0 = jnp.zeros((K,) + mb_x.shape, mb_x.dtype)
             grads0 = jax.tree_util.tree_map(jnp.zeros_like, my)
-            # deferred-W queue: (x, dy) of the previous tick's B slot
-            wq0 = (jnp.zeros_like(mb_x), jnp.zeros_like(mb_x),
+            # deferred-W queue: the previous tick's B-slot dW pytree
+            wq0 = (jax.tree_util.tree_map(jnp.zeros_like, my),
                    jnp.asarray(False))
 
             def fwd_x(p, xx):
@@ -221,31 +220,27 @@ class Compiled1F1B:
                 m_b_c = jnp.clip(m_b, 0, M - 1)
                 x_b = stash[jnp.mod(m_b_c, K)]
                 label_b = y_local[m_b_c]
-                if split_dw:
-                    y_b, vjp_x = jax.vjp(lambda xx: body(my, xx), x_b)
-                else:
-                    y_b, vjp_body = jax.vjp(fwd_x, my, x_b)
+                y_b, vjp_body = jax.vjp(fwd_x, my, x_b)
                 loss_b, vjp_loss = jax.vjp(
                     lambda yy: loss_fn(yy, label_b), y_b)
                 (dy_loss,) = vjp_loss(
                     jnp.asarray(1.0 / M, jnp.result_type(loss_b)))
                 dy = jnp.where(s == S - 1, dy_loss.astype(dy_in.dtype),
                                dy_in)
+                dp_now, dx = vjp_body(dy)
                 if split_dw:
-                    # dX now (unblocks stage s-1); (x, dy) queued for the
-                    # deferred W slot — WeightGradStore.put semantics.
-                    (dx,) = vjp_x(dy)
-                    # ---- W slot: flush the PREVIOUS tick's queue --------
-                    wx, wdy, wvalid = wq
-                    _, vjp_w = jax.vjp(lambda p: body(p, wx), my)
-                    (dp,) = vjp_w(wdy)
-                    gmask = wvalid
-                    wq = (jnp.where(valid_b, x_b, wx),
-                          jnp.where(valid_b, dy, wdy),
-                          valid_b)
+                    # dX flows back this tick; the parameter-grad
+                    # ACCUMULATION is deferred one tick
+                    # (WeightGradStore.put/flush semantics) without
+                    # re-running the stage forward a third time — in this
+                    # masked SPMD form the W slot shares the B slot's vjp.
+                    wdp, wvalid = wq
+                    dp, gmask = wdp, wvalid
+                    wq = (jax.tree_util.tree_map(
+                        lambda new, old: jnp.where(valid_b, new, old),
+                        dp_now, wdp), valid_b)
                 else:
-                    dp, dx = vjp_body(dy)
-                    gmask = valid_b
+                    dp, gmask = dp_now, valid_b
                 grads = jax.tree_util.tree_map(
                     lambda g, d: g + jnp.where(gmask, d, 0.0), grads, dp)
                 loss_acc = loss_acc + jnp.where(
