@@ -83,6 +83,7 @@ class TestAutoParallel:
         out = lin(paddle.randn([2, 8]))
         assert out.shape == [2, 16]
 
+    @pytest.mark.slow
     def test_shard_optimizer_states(self):
         mesh = dist.ProcessMesh(np.arange(8), dim_names=["x"])
         lin = nn.Linear(8, 8)

@@ -124,6 +124,7 @@ class TestDistributions:
         s = np.asarray(b.sample((20000,)).numpy())
         assert abs(s.mean() - 0.25) < 0.02
 
+    @pytest.mark.slow
     def test_gamma_beta_dirichlet(self):
         g = dist.Gamma(2.0, 0.5)
         gs = np.asarray(g.sample((20000,)).numpy())

@@ -27,6 +27,7 @@ def params():
     return llama.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
 
 
+@pytest.mark.slow
 def test_cached_forward_matches_uncached(params):
     B, S = 2, 10
     toks = jax.random.randint(jax.random.key(1), (B, S), 0, CFG.vocab_size)
@@ -37,6 +38,7 @@ def test_cached_forward_matches_uncached(params):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_incremental_decode_matches_full_forward(params):
     """Prefill S tokens then decode one-by-one must equal the full
     forward over the whole sequence (the KV cache correctness check)."""
@@ -59,6 +61,7 @@ def test_incremental_decode_matches_full_forward(params):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_generate_greedy_shape_and_determinism(params):
     B, S = 2, 5
     toks = jax.random.randint(jax.random.key(3), (B, S), 0, CFG.vocab_size)
@@ -70,6 +73,7 @@ def test_generate_greedy_shape_and_determinism(params):
     assert np.array_equal(np.asarray(out1[:, :S]), np.asarray(toks))
 
 
+@pytest.mark.slow
 def test_generate_greedy_matches_stepwise_argmax(params):
     """Greedy generate must equal manual argmax rollout through the
     uncached forward (ground truth)."""
@@ -85,6 +89,7 @@ def test_generate_greedy_matches_stepwise_argmax(params):
     assert np.array_equal(np.asarray(out), np.asarray(cur))
 
 
+@pytest.mark.slow
 def test_generate_eos_padding(params):
     B, S = 1, 4
     toks = jax.random.randint(jax.random.key(5), (B, S), 0, CFG.vocab_size)
@@ -99,6 +104,7 @@ def test_generate_eos_padding(params):
         out2[0, S] == first and (out2[0, S + 1:] == first).all())
 
 
+@pytest.mark.slow
 def test_sampling_topk_topp_valid(params):
     B, S = 2, 4
     toks = jax.random.randint(jax.random.key(6), (B, S), 0, CFG.vocab_size)
@@ -119,6 +125,7 @@ def _dense_decode_ref(q, k, v, seq_lens):
     return jnp.einsum("bht,bthd->bhd", jax.nn.softmax(scores, -1), v)
 
 
+@pytest.mark.slow
 def test_paged_attention_matches_dense():
     B, H, KV, hd, BS, MB = 2, 4, 2, 16, 4, 3
     N = 8   # physical blocks in pool
@@ -238,6 +245,7 @@ def test_paged_pallas_kernel_matches_fallback():
     assert float(jnp.abs(out[2]).max()) == 0.0  # seq_len 0 slot
 
 
+@pytest.mark.slow
 def test_generate_paged_matches_dense_greedy():
     """vLLM-style paged serving loop == dense-cache generation."""
     from paddle_tpu.inference.generation import generate_paged

@@ -63,6 +63,7 @@ class TestToStatic:
                                    net_s[0].weight.numpy(), rtol=1e-4,
                                    atol=1e-5)
 
+    @pytest.mark.slow
     def test_buffer_updates_propagate(self):
         net = nn.Sequential(nn.Conv2D(1, 2, 3), nn.BatchNorm2D(2))
         compiled = paddle.jit.to_static(net)

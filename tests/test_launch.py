@@ -16,6 +16,8 @@ import time
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as paddle
 from paddle_tpu.distributed.store import TCPStore, TCPStoreServer
 from paddle_tpu.distributed.flight_recorder import (

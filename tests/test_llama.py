@@ -18,6 +18,7 @@ CFG = LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
 
 
 class TestFunctionalLlama:
+    @pytest.mark.slow
     def test_forward_shape_and_finite(self):
         params = init_params(CFG, jax.random.key(0))
         tokens = jnp.zeros((2, 8), jnp.int32)
@@ -37,12 +38,14 @@ class TestFunctionalLlama:
         np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], atol=1e-5)
         assert not np.allclose(l1[0, -1], l2[0, -1])
 
+    @pytest.mark.slow
     def test_gqa_matches_full_heads_shape(self):
         cfg_full = LlamaConfig(**{**CFG.__dict__, "num_key_value_heads": 4})
         params = init_params(cfg_full, jax.random.key(0))
         logits = forward(params, jnp.zeros((1, 4), jnp.int32), cfg_full)
         assert logits.shape == (1, 4, 128)
 
+    @pytest.mark.slow
     def test_loss_decreases_under_training(self):
         params = init_params(CFG, jax.random.key(0))
         mesh = make_mesh(MeshConfig(), devices=jax.devices()[:1])
@@ -59,6 +62,7 @@ class TestFunctionalLlama:
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0], losses
 
+    @pytest.mark.slow
     def test_remat_same_loss(self):
         cfg_r = LlamaConfig(**{**CFG.__dict__, "remat": True})
         params = init_params(CFG, jax.random.key(0))
@@ -70,6 +74,7 @@ class TestFunctionalLlama:
 
 
 class TestShardedLlama:
+    @pytest.mark.slow
     def test_sharded_matches_single_device(self):
         """The SPMD-partitioned step must equal the single-device step."""
         params = init_params(CFG, jax.random.key(0))
@@ -105,6 +110,7 @@ class TestShardedLlama:
         specs = param_shardings(mesh, CFG)
         jax.tree_util.tree_map(lambda p, s: None, params, specs)  # same tree
 
+    @pytest.mark.slow
     def test_grad_accumulation(self):
         params = init_params(CFG, jax.random.key(0))
         mesh = make_mesh(MeshConfig(), devices=jax.devices()[:1])
@@ -120,6 +126,7 @@ class TestShardedLlama:
 
 
 class TestLlamaLayerAPI:
+    @pytest.mark.slow
     def test_layer_model_forward_backward(self):
         from paddle_tpu.models.llama import LlamaForCausalLM
         cfg = LlamaConfig(vocab_size=64, hidden_size=32,
@@ -136,6 +143,7 @@ class TestLlamaLayerAPI:
 
 
 class TestDryrun:
+    @pytest.mark.slow
     @pytest.mark.parametrize("n", [1, 2, 4, 8])
     def test_dryrun_sizes(self, n):
         from paddle_tpu.distributed.dryrun import run_dryrun
@@ -163,6 +171,7 @@ class TestDryrun:
         except Exception:
             pass
 
+    @pytest.mark.slow
     def test_resolve_devices_probe_path(self, _restore_platform_state):
         """force_cpu=False probes the default backend in a subprocess.
         The child re-runs sitecustomize, so its default platform (and
@@ -187,6 +196,7 @@ class TestDryrun:
         assert all(d.platform == "cpu" for d in devices)
 
 
+@pytest.mark.slow
 def test_trainer_nan_watch():
     """check_nan_inf catches non-finite loss inside the compiled
     hybrid-parallel step."""
@@ -212,6 +222,7 @@ def test_trainer_nan_watch():
         GLOBAL_FLAGS.set("check_nan_inf", False)
 
 
+@pytest.mark.slow
 def test_fused_linear_cross_entropy_matches_unfused():
     """Chunked lm-head+CE (Liger-style) must match the materialized
     logits path in value and gradient."""

@@ -3,6 +3,8 @@ descent, sharded compile on the virtual mesh (reference test model:
 dygraph model-level parity tests + hybrid_strategy e2e configs)."""
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P

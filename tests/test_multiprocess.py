@@ -14,6 +14,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "collective_worker.py")
 SUBGROUP_WORKER = os.path.join(REPO, "tests", "subgroup_worker.py")

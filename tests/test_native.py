@@ -152,6 +152,7 @@ class _SquareDataset:
         return np.full((4,), i, np.float32), np.int64(i)
 
 
+@pytest.mark.slow
 def test_dataloader_process_workers():
     from paddle_tpu.io import DataLoader
     ds = _SquareDataset(32)
@@ -182,6 +183,7 @@ def test_dataloader_worker_death_detected():
             pass
 
 
+@pytest.mark.slow
 def test_dataloader_user_timeout_honored():
     from paddle_tpu.io import DataLoader
 

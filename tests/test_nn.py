@@ -94,16 +94,19 @@ class TestCoreLayers:
                         xp[0, :, i:i + 3, j:j + 3] * w[oc]) + b[oc]
         np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow
     def test_conv2d_stride_groups(self):
         conv = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
         out = conv(paddle.randn([2, 4, 8, 8]))
         assert out.shape == [2, 8, 4, 4]
 
+    @pytest.mark.slow
     def test_conv_transpose(self):
         conv = nn.Conv2DTranspose(3, 5, 4, stride=2, padding=1)
         out = conv(paddle.randn([1, 3, 8, 8]))
         assert out.shape == [1, 5, 16, 16]
 
+    @pytest.mark.slow
     def test_batchnorm_stats(self):
         bn = nn.BatchNorm2D(3, momentum=0.9)
         x = paddle.randn([4, 3, 8, 8]) * 2 + 1
@@ -132,6 +135,7 @@ class TestCoreLayers:
         want = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-6)
         np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.slow
     def test_groupnorm_embedding(self):
         gn = nn.GroupNorm(2, 4)
         assert gn(paddle.randn([2, 4, 3, 3])).shape == [2, 4, 3, 3]
@@ -158,6 +162,7 @@ class TestCoreLayers:
         out = F.softmax(x)
         np.testing.assert_allclose(out.numpy().sum(), 1.0, rtol=1e-6)
 
+    @pytest.mark.slow
     def test_rnn_lstm_gru(self):
         for cls, states in [(nn.SimpleRNN, 1), (nn.LSTM, 2), (nn.GRU, 1)]:
             m = cls(4, 8, num_layers=2)
@@ -168,6 +173,7 @@ class TestCoreLayers:
             else:
                 assert st.shape == [2, 3, 8]
 
+    @pytest.mark.slow
     def test_bidirectional_lstm(self):
         m = nn.LSTM(4, 8, direction="bidirect")
         out, (h, c) = m(paddle.randn([2, 5, 4]))
@@ -238,6 +244,7 @@ class TestLosses:
         assert F.kl_div(logp, q).size == 1
         assert F.smooth_l1_loss(paddle.randn([3]), paddle.randn([3])).size == 1
 
+    @pytest.mark.slow
     def test_ctc_loss_runs(self):
         T, B, C, S = 12, 2, 6, 4
         logits = paddle.randn([T, B, C])
@@ -250,6 +257,7 @@ class TestLosses:
 
 
 class TestGradFlow:
+    @pytest.mark.slow
     def test_mlp_training_reduces_loss(self):
         paddle.seed(0)
         net = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 1))
@@ -267,6 +275,7 @@ class TestGradFlow:
             losses.append(float(loss.item()))
         assert losses[-1] < losses[0] * 0.15, losses[::10]
 
+    @pytest.mark.slow
     def test_conv_bn_backward(self):
         net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8),
                             nn.ReLU(), nn.Conv2D(8, 4, 1))

@@ -25,6 +25,7 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_bwd_matches_ref(self):
         rng = np.random.RandomState(1)
         b, s, h, d = 1, 128, 2, 64
@@ -55,6 +56,7 @@ class TestFlashAttention:
 
 
 class TestNorms:
+    @pytest.mark.slow
     def test_rms_norm_fwd_bwd(self):
         rng = np.random.RandomState(0)
         x = jnp.asarray(rng.randn(4, 64, 128), jnp.float32)
@@ -148,6 +150,7 @@ class TestFlashAttentionExtended:
         v = jnp.asarray(rng.randn(b, s, kvh, d), jnp.float32)
         return q, k, v
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("causal", [False, True])
     def test_gqa_matches_ref(self, causal):
         q, k, v = self._qkv(kvh=1)
@@ -156,6 +159,7 @@ class TestFlashAttentionExtended:
         np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_bias_fwd_bwd(self):
         q, k, v = self._qkv(h=2, kvh=2, s=128)
         rng = np.random.RandomState(3)
@@ -178,6 +182,7 @@ class TestFlashAttentionExtended:
                                        np.asarray(b_) / scale,
                                        atol=2e-5)
 
+    @pytest.mark.slow
     def test_segment_ids_block_cross_attention(self):
         q, k, v = self._qkv(h=2, kvh=2, s=256, seed=5)
         seg = jnp.asarray(
@@ -188,6 +193,7 @@ class TestFlashAttentionExtended:
         np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_flash_attn_unpadded(self):
         import paddle_tpu as paddle
         import paddle_tpu.nn.functional as F
@@ -211,6 +217,7 @@ class TestFlashAttentionExtended:
                                        atol=2e-5, rtol=2e-5)
 
 
+    @pytest.mark.slow
     def test_fully_masked_rows_zero(self):
         # a query whose segment id matches no key must output 0 (not the
         # mean of V) and contribute nothing to dk/dv
@@ -277,6 +284,7 @@ class TestAutotune:
             GLOBAL_FLAGS.set("kernel_autotune", False)
 
 
+@pytest.mark.slow
 def test_flash_attn_unpadded_dropout_falls_back():
     """dropout>0 must not raise: it runs the masked XLA composition;
     training=False returns the fused-kernel result."""
@@ -299,6 +307,7 @@ def test_flash_attn_unpadded_dropout_falls_back():
                                np.asarray(o2.numpy()), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_flash_attn_unpadded_dropout_chunked_and_warns(monkeypatch):
     """The dropout fallback is chunked over query blocks (bounded memory)
     and warns once per process. With a vanishing dropout rate the chunked

@@ -37,6 +37,7 @@ class TestPipeline:
         assert pl_.segment_parts == [0, 2, 4, 6, 8]
         assert len(pl_.get_stage_layers(0)) == 2
 
+    @pytest.mark.slow
     def test_pipeline_matches_plain(self, pp_hcg):
         """PP training must produce the same params as the plain model."""
         from paddle_tpu.distributed.fleet.pipeline_parallel import \
@@ -105,6 +106,7 @@ class TestPipeline:
 
 
 class TestMoE:
+    @pytest.mark.slow
     def test_moe_forward_backward(self):
         from paddle_tpu.distributed.fleet.moe import MoELayer
         moe = MoELayer(d_model=16, d_hidden=32, num_experts=4,
@@ -117,6 +119,7 @@ class TestMoE:
         assert moe.w_in.grad is not None
         assert moe.gate.weight.grad is not None
 
+    @pytest.mark.slow
     def test_switch_gate_top1(self):
         from paddle_tpu.distributed.fleet.moe import MoELayer
         moe = MoELayer(d_model=8, d_hidden=16, num_experts=2,
@@ -124,6 +127,7 @@ class TestMoE:
         out = moe(paddle.randn([4, 8]))
         assert out.shape == [4, 8]
 
+    @pytest.mark.slow
     def test_capacity_drops_tokens(self):
         from paddle_tpu.distributed.fleet.moe import moe_dispatch_combine
         # all tokens to one expert with tiny capacity: most get dropped
@@ -139,6 +143,7 @@ class TestMoE:
         kept = np.count_nonzero(np.asarray(out).sum(-1))
         assert kept < T  # capacity limit enforced
 
+    @pytest.mark.slow
     def test_moe_expert_sharding(self):
         from paddle_tpu.distributed import fleet
         strategy = fleet.DistributedStrategy()
@@ -161,6 +166,7 @@ class TestRingAttention:
     def _mesh(self):
         return Mesh(np.array(jax.devices()[:4]), axis_names=("sp",))
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("causal", [False, True])
     def test_matches_full_attention(self, causal):
         from paddle_tpu.ops.ring_attention import ring_attention
@@ -188,6 +194,7 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_ring_grad(self):
         from paddle_tpu.ops.ring_attention import ring_attention
         from paddle_tpu.ops.flash_attention import _ref_attention
@@ -245,6 +252,7 @@ class TestSequenceParallelLayers:
 
 # -- fused_moe (reference: incubate/nn/functional/fused_moe.py) -------------
 class TestFusedMoe:
+    @pytest.mark.slow
     def test_matches_dense_top2_reference(self):
         from paddle_tpu.incubate.nn.functional import fused_moe
 

@@ -62,6 +62,7 @@ def test_zb_linear_matches_plain_linear_grads():
     assert len(store) == 0
 
 
+@pytest.mark.slow
 def test_zb_pipeline_grads_match_plain_pipeline():
     paddle.seed(7)
     pl1 = PipelineLayer(_descs(), num_stages=2, loss_fn=_mse)
@@ -178,6 +179,7 @@ class TestCompiledPipeline:
         y = jnp.asarray(rng.randn(M, mb, D), jnp.float32)
         return pipe, stage_fn, mesh, (W, B), x, y, S
 
+    @pytest.mark.slow
     def test_fwd_bwd_matches_sequential(self):
         import jax
         pipe, stage_fn, mesh, params, x, y_tgt, S = self._setup()
@@ -204,6 +206,7 @@ class TestCompiledPipeline:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5)
 
+    @pytest.mark.slow
     def test_trains(self):
         import jax
         pipe, _, mesh, params, x, y_tgt, _ = self._setup()
@@ -299,6 +302,7 @@ class TestCompiledPipeline:
                 losses.append(float(l))
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_1f1b_activation_memory_below_gpipe(self):
         """VERDICT round-2 #5 'done' criterion: at M=8 the 1F1B program's
         peak live activation state must be measurably below compiled

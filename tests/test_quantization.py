@@ -72,6 +72,7 @@ def _mlp():
     return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
 
 
+@pytest.mark.slow
 def test_qat_quantize_swaps_and_trains():
     net = _mlp()
     qat = QAT(QuantConfig())

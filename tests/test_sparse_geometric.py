@@ -278,6 +278,7 @@ class TestSparseUnaryBinary:
 
 
 class TestSparseConvPool:
+    @pytest.mark.slow
     def test_conv2d_matches_dense_at_active_sites(self):
         import jax.numpy as jnp
         from jax import lax
@@ -339,6 +340,7 @@ class TestSparseConvPool:
             np.testing.assert_allclose(pv[t], win[winm].max(axis=0),
                                        atol=1e-5)
 
+    @pytest.mark.slow
     def test_layer_chain_and_batchnorm(self):
         import paddle_tpu.sparse.nn as snn
         rng = np.random.RandomState(4)

@@ -240,7 +240,11 @@ class TestLinalgBreadth:
                                    atol=1e-4)
 
     def test_svd_lowrank(self):
-        a = (RNG.randn(8, 3) @ RNG.randn(3, 6)).astype(np.float32)
+        # own generator: the shared module RNG's state depends on which
+        # tests ran before on this xdist worker, and reconstruction
+        # tolerance is draw-dependent
+        rng = np.random.RandomState(7)
+        a = (rng.randn(8, 3) @ rng.randn(3, 6)).astype(np.float32)
         u, s, v = paddle.tensor.svd_lowrank(t(a), q=3)
         rec = n(u) * n(s)[None, :] @ n(v).T
         np.testing.assert_allclose(rec, a, atol=1e-3)
@@ -272,6 +276,7 @@ class TestInplaceAndTypes:
         assert paddle.is_integer(t(np.zeros(2, np.int32)))
         assert not paddle.is_complex(t(np.zeros(2, np.float32)))
 
+    @pytest.mark.slow
     def test_random_breadth(self):
         g = paddle.tensor.gaussian([1000], mean=2.0, std=0.5)
         assert abs(float(n(g).mean()) - 2.0) < 0.1
