@@ -33,6 +33,19 @@ class Config:
         self._device = "tpu"
         self._memory_optim = True
         self._profile = False
+        # AnalysisConfig::SetOptimCacheDir analog: where serialized XLA
+        # executables live. None = "<model>.xcache" next to the model.
+        self._optim_cache_dir: Optional[str] = None
+        self._aot_cache = True
+
+    def set_optim_cache_dir(self, opt_cache_dir: str):
+        """reference: analysis_config.cc SetOptimCacheDir — here the cache
+        holds serialized XLA executables, so a process restart skips
+        compilation entirely."""
+        self._optim_cache_dir = opt_cache_dir
+
+    def enable_aot_executable_cache(self, flag=True):
+        self._aot_cache = flag
 
     def set_model(self, prog_file: str, params_file: Optional[str] = None):
         self._model_prefix = prog_file
@@ -91,6 +104,119 @@ class Predictor:
         self._input_names = [f"x{i}" for i in range(len(self._input_specs))]
         self._feeds: Dict[str, np.ndarray] = {}
         self._outputs: List[jax.Array] = []
+        self._exec_cache: Dict[tuple, object] = {}
+        self._cache_dir = None
+        if config._aot_cache:
+            self._cache_dir = (config._optim_cache_dir
+                               or path + ".xcache")
+        # model identity for the cache key: a stale executable from an
+        # older export must never be reused
+        self._model_fingerprint = self._fingerprint(path)
+        # observability: True when the LAST run() executed a deserialized
+        # executable (restart-no-recompile verified by tests)
+        self.last_run_from_cache = False
+
+    @staticmethod
+    def _fingerprint(path: str) -> str:
+        import hashlib
+        h = hashlib.sha256()
+        for suffix in (".exported", ".pdiparams"):
+            try:
+                with open(path + suffix, "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                pass
+        return h.hexdigest()[:16]
+
+    @staticmethod
+    def _sig(vals) -> tuple:
+        return tuple((tuple(v.shape), str(v.dtype)) for v in vals)
+
+    def _cache_file(self, sig) -> Optional[str]:
+        if self._cache_dir is None:
+            return None
+        import hashlib
+        dev = jax.devices()[0]
+        # compilation configuration is part of the key: an executable
+        # compiled under different XLA/JAX options must not be reused
+        # (jax's own persistent cache hashes compile options the same way)
+        compile_cfg = (os.environ.get("XLA_FLAGS", ""),
+                       bool(jax.config.jax_enable_x64),
+                       str(jax.config.jax_default_matmul_precision))
+        key = hashlib.sha256(repr((
+            jax.__version__, dev.platform,
+            getattr(dev, "device_kind", ""), jax.device_count(),
+            compile_cfg, sig)).encode()).hexdigest()[:32]
+        # fingerprint prefixes the filename so stale-model entries are
+        # identifiable for pruning
+        return os.path.join(self._cache_dir,
+                            f"{self._model_fingerprint}-{key}.pdexec")
+
+    def _prune_stale(self):
+        """Drop entries from other model exports (their fingerprint prefix
+        no longer matches); best-effort, runs on cache miss."""
+        try:
+            for name in os.listdir(self._cache_dir):
+                if name.endswith(".pdexec") and \
+                        not name.startswith(self._model_fingerprint + "-"):
+                    os.remove(os.path.join(self._cache_dir, name))
+        except OSError:
+            pass
+
+    def _invalidate(self, sig):
+        self._exec_cache.pop(sig, None)
+        fpath = self._cache_file(sig)
+        if fpath:
+            try:
+                os.remove(fpath)
+            except OSError:
+                pass
+
+    def _compile(self, vals):
+        layer = self._layer
+
+        def call(params, buffers, *xs):
+            return layer._exported.call(params, buffers, *xs)
+
+        return jax.jit(call).lower(layer._params, layer._buffers,
+                                   *vals).compile()
+
+    def _executable(self, vals):
+        """AOT executable for this input signature: in-memory cache, then
+        the serialized on-disk cache (restart skips compilation; reference
+        analysis_predictor.h:101 keeps the optimized program the same
+        way), then a fresh XLA compile that repopulates both."""
+        sig = self._sig(vals)
+        hit = self._exec_cache.get(sig)
+        if hit is not None:
+            return hit
+        fpath = self._cache_file(sig)
+        if fpath and os.path.exists(fpath):
+            try:
+                import pickle
+                from jax.experimental import serialize_executable as se
+                with open(fpath, "rb") as f:
+                    ser, in_tree, out_tree = pickle.load(f)
+                exe = se.deserialize_and_load(ser, in_tree, out_tree)
+                self._exec_cache[sig] = (exe, True)
+                return exe, True
+            except Exception:
+                pass  # stale/foreign cache entry: recompile below
+        exe = self._compile(vals)
+        if fpath:
+            try:
+                import pickle
+                from jax.experimental import serialize_executable as se
+                os.makedirs(self._cache_dir, exist_ok=True)
+                self._prune_stale()
+                tmp = fpath + f".tmp{os.getpid()}"
+                with open(tmp, "wb") as f:
+                    pickle.dump(se.serialize(exe), f)
+                os.replace(tmp, fpath)
+            except Exception:
+                pass  # caching is best-effort; serving must not break
+        self._exec_cache[sig] = (exe, False)
+        return exe, False
 
     # -- handle API ---------------------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -112,10 +238,27 @@ class Predictor:
                     for x in inputs]
         else:
             vals = [jnp.asarray(self._feeds[n]) for n in self._input_names]
-        out = self._layer(*vals)
+        exe, from_cache = self._executable(vals)
+        try:
+            out = exe(self._layer._params, self._layer._buffers, *vals)
+            if from_cache:
+                # dispatch is async: force any runtime failure of the
+                # deserialized executable to surface INSIDE this try so
+                # the recovery below can actually run
+                jax.block_until_ready(out)
+        except Exception:
+            if not from_cache:
+                raise
+            # a deserialized executable can be incompatible with the live
+            # device topology (e.g. different chip count) in ways only
+            # execution reveals — recompile fresh and overwrite the entry
+            self._invalidate(self._sig(vals))
+            exe, from_cache = self._executable(vals)
+            out = exe(self._layer._params, self._layer._buffers, *vals)
+        self.last_run_from_cache = from_cache
         if not isinstance(out, (tuple, list)):
             out = (out,)
-        self._outputs = [to_value(o) for o in out]
+        self._outputs = [to_value(o) for o in jax.tree_util.tree_leaves(out)]
         return [Tensor(o) for o in self._outputs]
 
 
