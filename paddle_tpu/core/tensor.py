@@ -276,6 +276,8 @@ class Tensor:
     def _replace_value(self, new_value):
         """In-place update: rebind the wrapped array. Only legal on tensors
         that are not interior nodes of a live tape."""
+        if _SEGMENT_RECORDER[0] is not None:
+            _SEGMENT_RECORDER[0].on_mutation(self)
         self._value = new_value
         return self
 
@@ -305,6 +307,8 @@ class Tensor:
     def __setitem__(self, idx, value):
         idx = _prepare_index(idx)
         v = to_value(value)
+        if _SEGMENT_RECORDER[0] is not None:
+            _SEGMENT_RECORDER[0].on_mutation(self)
         if _grad_enabled() and not self.stop_gradient:
             vt = value if isinstance(value, Tensor) else Tensor(v)
             out = dispatch(lambda x, y: x.at[idx].set(
@@ -431,6 +435,11 @@ def dispatch(fn, tensor_args: Sequence[Any], name: str = "op",
 # ProgramDesc building, reference python/paddle/base/framework.py Program)
 _PROGRAM_RECORDER = [None]
 
+# SOT segment recorder hook (jit/sot.py): active while a graph-broken
+# to_static function records its eager op stream for segmented replay
+# (reference: python/paddle/jit/sot/translate.py subgraph capture)
+_SEGMENT_RECORDER = [None]
+
 
 def _dispatch_impl(fn, tensor_args: Sequence[Any], name: str = "op",
                    multi_output: bool = False, **static_kwargs):
@@ -469,6 +478,9 @@ def _dispatch_impl(fn, tensor_args: Sequence[Any], name: str = "op",
         if _PROGRAM_RECORDER[0] is not None:
             _PROGRAM_RECORDER[0]._record(name, fn, tensor_args, values,
                                          result, multi_output)
+        if _SEGMENT_RECORDER[0] is not None:
+            _SEGMENT_RECORDER[0]._record(name, fn, tensor_args, values,
+                                         result, multi_output)
         return result if multi_output else result[0]
 
     out_vals, vjp_fn = jax.vjp(fn, *values)
@@ -487,6 +499,9 @@ def _dispatch_impl(fn, tensor_args: Sequence[Any], name: str = "op",
         jax.block_until_ready(out_vals)
     if _PROGRAM_RECORDER[0] is not None:
         _PROGRAM_RECORDER[0]._record(name, fn, tensor_args, values,
+                                     tuple(results), multi_output)
+    if _SEGMENT_RECORDER[0] is not None:
+        _SEGMENT_RECORDER[0]._record(name, fn, tensor_args, values,
                                      tuple(results), multi_output)
     return tuple(results) if multi_output else results[0]
 

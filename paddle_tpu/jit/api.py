@@ -12,6 +12,12 @@ Parameter discovery: one eager "discovery" pass runs the function with a
 dispatch hook that records every persistable leaf Tensor touched (parameters
 and registered buffers) — the analog of the reference's program translator
 collecting ``Parameter`` vars.
+
+Known limit: a NON-persistable closure tensor (e.g. a module-level flag
+created with to_tensor) is a trace-time constant on the whole-graph path —
+its value is baked into the compiled program, like any Python closure
+constant. The SOT segmented path (sot.py, taken on graph break) guards
+such tensors instead.
 """
 from __future__ import annotations
 
@@ -191,6 +197,8 @@ class TracedFunction:
         flat_fn, out_tree = entry
         if flat_fn == "eager":
             return self._fn(*args, **kwargs)
+        if flat_fn == "sot":
+            return out_tree(*args, **kwargs)  # (tag, SegmentedFunction)
         tensor_in = [to_value(in_leaves[i]) if isinstance(in_leaves[i], Tensor)
                      else jnp.asarray(in_leaves[i]) for i in tensor_leaf_idx]
         rng = next_key()
@@ -206,17 +214,21 @@ class TracedFunction:
                 jax.errors.TracerArrayConversionError,
                 jax.errors.TracerIntegerConversionError) as e:
             # graph break: tensor-dependent Python control flow cannot be
-            # traced — fall back to eager for this signature, like the
-            # reference SOT's guard-failure fallback
-            # (python/paddle/jit/sot/translate.py graph break semantics)
+            # traced as ONE program — switch this signature to SOT-style
+            # segmented execution: compiled subgraphs around the breaking
+            # construct, guarded on the consumed scalar outcomes
+            # (reference: python/paddle/jit/sot/translate.py:37)
             import warnings
             warnings.warn(
-                f"to_static: graph break ({type(e).__name__}) — falling "
-                "back to eager execution for this call signature. Use "
+                f"to_static: graph break ({type(e).__name__}) — switching "
+                "to segmented (SOT-style) execution for this call "
+                "signature: subgraphs around the break stay compiled. Use "
                 "paddle.where/lax.cond-style ops to keep the graph whole.",
                 stacklevel=2)
-            self._cache[key] = ("eager", out_tree)
-            return self._fn(*args, **kwargs)
+            from .sot import SegmentedFunction
+            seg = SegmentedFunction(self._fn)
+            self._cache[key] = ("sot", seg)
+            return seg(*args, **kwargs)
         n_buf = len(self._buffers)
         out_vals = outs[:len(outs) - n_buf]
         new_buf = outs[len(outs) - n_buf:]

@@ -1,0 +1,394 @@
+"""SOT-parity subgraph compilation for graph breaks.
+
+Reference: python/paddle/jit/sot/translate.py:37 + eval_frame.c:392 —
+Paddle's SOT rewrites bytecode so the parts of a function BETWEEN
+data-dependent constructs still run as compiled subgraphs, guarded for
+re-entry. The TPU-native equivalent here needs no bytecode: eager ops
+already funnel through ``core.tensor.dispatch``, so on the first call we
+RECORD the dispatched op stream while the function runs eagerly, close a
+segment whenever Python consumes a concrete scalar from a Tensor
+(``__bool__`` / ``__int__`` / ``__float__`` / ``item()`` — the breaking
+constructs), and on later calls replay each segment as ONE jitted XLA
+program. Each consumed scalar becomes a GUARD: its replayed value must
+match the recorded outcome (the control-flow path), else the recording
+is invalidated and that call re-records eagerly. Shape/dtype guards are
+the caller's cache key (jit/api.py ``TracedFunction._key``).
+
+Replayed segments enter the autograd tape as one node each (dispatch +
+jax.vjp), so ``loss.backward()`` after a segmented forward runs
+XLA-compiled backward programs too.
+
+Known limits (fall back to per-call eager, never wrong results):
+- Python-level side effects inside the function (in-place buffer value
+  assignment, appending to external lists) are not replayed; a recording
+  that mutated externals is marked replay-unsafe at record time.
+- ``.numpy()`` / ``__array__`` consumption of an in-flight tensor is a
+  full-array guard we do not attempt; the recording is replay-unsafe.
+- A guard that flips every call degenerates to eager + recording
+  overhead (same complexity class as reference SOT guard churn).
+- ``float(t)`` / ``t.item()`` guard on the EXACT value, so any input or
+  parameter change re-records — matching reference SOT's treatment of
+  ``.item()`` as a constant-guard. Prefer ``if t > c:`` (a bool
+  consumption): the guard is then the branch OUTCOME, which stays stable
+  across parameter updates, so training loops keep replaying.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+from ..core import tensor as tensor_mod
+from ..core.random import next_key, traced_key_source
+
+__all__ = ["SegmentedFunction"]
+
+
+class _Op:
+    __slots__ = ("name", "fn", "in_slots", "out_ids", "multi", "amp")
+
+    def __init__(self, name, fn, in_slots, out_ids, multi, amp):
+        self.name = name
+        self.fn = fn
+        self.in_slots = in_slots
+        self.out_ids = out_ids
+        self.multi = multi
+        self.amp = amp
+
+
+class _Guard:
+    """A recorded scalar consumption: replay must reproduce ``outcome``."""
+    __slots__ = ("tid", "kind", "outcome", "args")
+
+    def __init__(self, tid, kind, outcome, args=()):
+        self.tid = tid
+        self.kind = kind
+        self.outcome = outcome
+        self.args = args
+
+
+class _Recorder:
+    """Active while the user function runs eagerly; mirrors
+    static.Program's dispatch recording (core/tensor.py
+    ``_SEGMENT_RECORDER``) plus scalar-consumption events."""
+
+    def __init__(self):
+        self.events: List[Any] = []     # _Op | _Guard interleaved
+        self.produced: set = set()
+        self.externals: Dict[int, Tensor] = {}
+        self.ext_snapshot: Dict[int, Any] = {}   # _value at capture time
+        self.keep: List[Tensor] = []    # id() identity must not be reused
+        self.replay_safe = True
+        self.input_ids: List[int] = []
+
+    def _record(self, name, fn, tensor_args, values, results, multi):
+        from ..amp.auto_cast import amp_state
+        in_slots = []
+        for a, v in zip(tensor_args, values):
+            if isinstance(a, Tensor):
+                tid = id(a)
+                if tid not in self.produced and tid not in self.externals \
+                        and tid not in self.input_ids:
+                    self.externals[tid] = a
+                    self.ext_snapshot[tid] = a._value
+                in_slots.append(("var", tid))
+            else:
+                in_slots.append(("const", v))
+        out_ids = tuple(id(t) for t in results)
+        self.produced.update(out_ids)
+        self.events.append(_Op(name, fn, tuple(in_slots), out_ids, multi,
+                               bool(amp_state.enabled)))
+        self.keep.extend(a for a in tensor_args if isinstance(a, Tensor))
+        self.keep.extend(results)
+
+    def on_scalar(self, tensor, kind, outcome, args=()):
+        tid = id(tensor)
+        if tid not in self.produced and tid not in self.externals and \
+                tid not in self.input_ids:
+            # a tensor the recording has not seen as an op input yet
+            # (e.g. a module-level flag consumed before any use): capture
+            # it as an external so the guard still protects the control
+            # path when its value changes between calls
+            self.externals[tid] = tensor
+            self.ext_snapshot[tid] = tensor._value
+        self.events.append(_Guard(tid, kind, outcome, args))
+
+    def on_mutation(self, tensor):
+        """Any Python-level in-place mutation (set_value/fill_/zero_/
+        __setitem__/_replace_value) during recording: side effects do not
+        replay, so the whole recording is replay-unsafe. Conservative by
+        design; raw ``t._value = x`` assignments that bypass these entry
+        points are caught by the external-snapshot backstop only if the
+        tensor was read first."""
+        self.replay_safe = False
+
+    def mark_unsafe(self):
+        self.replay_safe = False
+
+
+# -- scalar-consumption hooks -------------------------------------------------
+# Installed once; ~zero cost when no recorder is active.
+_ACTIVE: List[Optional[_Recorder]] = [None]
+_HOOKED = [False]
+
+
+_IN_HOOK = [False]
+
+
+def _install_scalar_hooks():
+    if _HOOKED[0]:
+        return
+    _HOOKED[0] = True
+
+    def wrap(method_name, kind, cast):
+        orig = getattr(Tensor, method_name)
+
+        def wrapped(self, *a, **kw):
+            rec = _ACTIVE[0]
+            if rec is None or _IN_HOOK[0]:
+                return orig(self, *a, **kw)
+            # reentrancy guard: item()/__float__ call numpy() internally;
+            # only the OUTERMOST consumption is the break event
+            _IN_HOOK[0] = True
+            try:
+                out = orig(self, *a, **kw)
+            finally:
+                _IN_HOOK[0] = False
+            if kind == "array":
+                rec.mark_unsafe()
+            else:
+                rec.on_scalar(self, kind, cast(out), args=a)
+            return out
+        wrapped.__name__ = method_name
+        setattr(Tensor, method_name, wrapped)
+
+    wrap("__bool__", "bool", bool)
+    wrap("__int__", "int", int)
+    wrap("__float__", "float", float)
+    wrap("item", "item", lambda v: v)
+    wrap("numpy", "array", None)
+
+
+class _Segment:
+    __slots__ = ("ops", "in_ids", "consts", "out_ids", "compiled")
+
+    def __init__(self, ops, in_ids, out_ids):
+        self.ops = ops
+        self.in_ids = in_ids
+        self.out_ids = out_ids
+        self.compiled = None
+
+    def fn(self):
+        if self.compiled is not None:
+            return self.compiled
+        ops, in_ids, out_ids = self.ops, self.in_ids, self.out_ids
+        from ..amp.auto_cast import maybe_cast_inputs
+
+        def seg_fn(rng_key, *in_vals):
+            env = dict(zip(in_ids, in_vals))
+            # ops drawing randomness (dropout, …) call next_key() inside
+            # their recorded fn; thread a per-call key ARGUMENT so the
+            # jitted program doesn't bake the key as a retrace-forcing
+            # constant (same design as static.Program._build_replay)
+            with traced_key_source(rng_key):
+                for op in ops:
+                    args = tuple(env[s] if kind == "var" else s
+                                 for kind, s in op.in_slots)
+                    if op.amp:
+                        args = maybe_cast_inputs(op.name, args)
+                    out = op.fn(*args)
+                    outs = tuple(out) if op.multi else (out,)
+                    for oid, o in zip(op.out_ids, outs):
+                        env[oid] = o
+            return tuple(env[i] for i in out_ids)
+
+        self.compiled = jax.jit(seg_fn)
+        return self.compiled
+
+
+class SegmentedFunction:
+    """One (function, signature) pair executed SOT-style.
+
+    First call (and any call after a guard mismatch): records while
+    running eagerly. Later calls: replays compiled segments + guards.
+    ``stats`` reports (ops_total, ops_compiled) of the last replayed
+    call for observability/tests."""
+
+    def __init__(self, fn: Callable):
+        self._fn = fn
+        self._plan = None           # list[_Segment | _Guard]
+        self._out_tree = None
+        self._out_slots = None      # ("var", tid) | ("const", leaf)
+        self._keep = None
+        self._externals = None
+        self._input_ids = None
+        self._never_replay = False  # recording proved replay-unsafe
+        self.last_was_replay = False
+        self.stats = (0, 0)
+        _install_scalar_hooks()
+
+    # -- recording -----------------------------------------------------------
+    def _record_call(self, args, kwargs):
+        rec = _Recorder()
+        in_leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        leaf_pos = []
+        in_snap = {}
+        for i, l in enumerate(in_leaves):
+            if isinstance(l, Tensor):
+                rec.input_ids.append(id(l))
+                leaf_pos.append(i)
+                in_snap[id(l)] = l._value
+        prev = _ACTIVE[0]
+        prev_rec = tensor_mod._SEGMENT_RECORDER[0]
+        _ACTIVE[0] = rec
+        tensor_mod._SEGMENT_RECORDER[0] = rec
+        try:
+            out = self._fn(*args, **kwargs)
+        finally:
+            _ACTIVE[0] = prev
+            tensor_mod._SEGMENT_RECORDER[0] = prev_rec
+        # replay-unsafe if the call mutated any captured external's or
+        # input's value (Python-level side effects do not replay)
+        for tid, t in rec.externals.items():
+            if t._value is not rec.ext_snapshot.get(tid, t._value):
+                rec.mark_unsafe()
+                break
+        if rec.replay_safe:
+            for i in leaf_pos:
+                l = in_leaves[i]
+                if l._value is not in_snap[id(l)]:
+                    rec.mark_unsafe()
+                    break
+        if rec.replay_safe:
+            self._finalize(rec, out, leaf_pos)
+        else:
+            self._plan = None
+            self._never_replay = True
+        return out
+
+    def _finalize(self, rec, out, leaf_pos):
+        out_leaves, out_tree = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, Tensor))
+        out_slots = []
+        for l in out_leaves:
+            if isinstance(l, Tensor):
+                tid = id(l)
+                if tid in rec.produced or tid in rec.externals or \
+                        tid in rec.input_ids:
+                    out_slots.append(("var", tid))
+                else:
+                    out_slots.append(("const", l))
+            else:
+                out_slots.append(("const", l))
+
+        # split events into segments at guard boundaries
+        segments_ops: List[List[_Op]] = [[]]
+        plan_shape: List[Any] = []
+        for ev in rec.events:
+            if isinstance(ev, _Op):
+                segments_ops[-1].append(ev)
+            else:
+                plan_shape.append(("seg", segments_ops[-1]))
+                plan_shape.append(("guard", ev))
+                segments_ops.append([])
+        plan_shape.append(("seg", segments_ops[-1]))
+
+        # ids needed after each segment: later var-slots, guards, outputs
+        needed_after: List[set] = []
+        future: set = set(tid for k, tid in out_slots if k == "var")
+        for kind, payload in reversed(plan_shape):
+            if kind == "guard":
+                future = future | {payload.tid}
+            else:
+                needed_after.append(set(future))
+                for op in payload:
+                    for sk, sv in op.in_slots:
+                        if sk == "var":
+                            future.add(sv)
+        needed_after.reverse()
+
+        plan: List[Any] = []
+        seg_i = 0
+        for kind, payload in plan_shape:
+            if kind == "guard":
+                plan.append(payload)
+                continue
+            ops = payload
+            produced_here = set()
+            for op in ops:
+                produced_here.update(op.out_ids)
+            in_ids = []
+            for op in ops:
+                for sk, sv in op.in_slots:
+                    if sk == "var" and sv not in produced_here and \
+                            sv not in in_ids:
+                        in_ids.append(sv)
+            out_ids = sorted(produced_here & needed_after[seg_i])
+            seg_i += 1
+            if ops or out_ids:
+                plan.append(_Segment(ops, in_ids, tuple(out_ids)))
+        self._plan = plan
+        self._out_tree = out_tree
+        self._out_slots = out_slots
+        # rec.keep pinned intermediates only to stop id() reuse DURING
+        # recording; after finalize the plan's tids are purely symbolic
+        # (replay populates env from input positions, externals, and
+        # segment outputs), so drop them to free the activations
+        self._keep = None
+        self._externals = rec.externals
+        self._input_ids = list(rec.input_ids)
+        self._leaf_pos = leaf_pos
+
+    # -- replay --------------------------------------------------------------
+    def _replay(self, args, kwargs):
+        in_leaves, _ = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        env: Dict[int, Tensor] = {}
+        for rec_id, pos in zip(self._input_ids, self._leaf_pos):
+            env[rec_id] = in_leaves[pos]
+        for tid, t in self._externals.items():
+            env[tid] = t
+
+        ops_total = sum(len(p.ops) for p in self._plan
+                        if isinstance(p, _Segment))
+        for step in self._plan:
+            if isinstance(step, _Guard):
+                t = env[step.tid]
+                val = t.numpy()
+                got = {"bool": lambda: bool(val),
+                       "int": lambda: int(val),
+                       "float": lambda: float(val),
+                       "item": lambda: val.item(*step.args)}[step.kind]()
+                if got != step.outcome:
+                    return None  # control path diverged
+                continue
+            if not step.ops and not step.out_ids:
+                continue
+            seg_in = (Tensor(next_key()),) + tuple(
+                env[i] for i in step.in_ids)
+            outs = dispatch(step.fn(), seg_in, name="sot_segment",
+                            multi_output=True)
+            for oid, o in zip(step.out_ids, outs):
+                env[oid] = o
+        out_leaves = [env[s] if k == "var" else s
+                      for k, s in self._out_slots]
+        self.stats = (ops_total + sum(
+            1 for p in self._plan if isinstance(p, _Guard)), ops_total)
+        return jax.tree_util.tree_unflatten(self._out_tree, out_leaves)
+
+    def __call__(self, *args, **kwargs):
+        if self._never_replay:
+            self.last_was_replay = False
+            return self._fn(*args, **kwargs)
+        if self._plan is not None:
+            out = self._replay(args, kwargs)
+            if out is not None:
+                self.last_was_replay = True
+                return out
+            self._plan = None  # guard mismatch: re-record this call
+        self.last_was_replay = False
+        return self._record_call(args, kwargs)

@@ -36,24 +36,30 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         # path stays in the input dtype — the TPU analog of cuDNN's fused BN
         # (bf16 in/out, fp32 statistics). Two-pass mean/var: the one-pass
         # E[x^2]-E[x]^2 form catastrophically cancels when |mean| >> std.
-        def f(v, *wb):
+        n = int(np.prod([x.shape[i] for i in reduce_axes]))
+        unbiased = n / max(n - 1, 1)
+
+        # rm/rv enter through dispatch so (a) to_static's discovery pass
+        # registers them as buffers (save/restore on an aborted trace —
+        # otherwise a failed whole-graph trace leaks tracers into the
+        # running stats) and (b) the SOT segment recorder captures them
+        # as externals whose mutation marks the recording replay-unsafe
+        def f(v, rmv, rvv, *wb):
             v32 = v.astype(jnp.float32)
             mean = jnp.mean(v32, axis=reduce_axes)
             var = jnp.var(v32, axis=reduce_axes)
             out = _affine(v, mean, var, wb, ch_axis, epsilon,
                           weight is not None, bias is not None)
-            return out, mean, var
-        args = (x,) + _wb_args(weight, bias)
-        out, mean_t, var_t = dispatch(f, args, name="batch_norm",
-                                      multi_output=True)
-        # running stat update (no grad)
-        n = int(np.prod([x.shape[i] for i in reduce_axes]))
-        unbiased = n / max(n - 1, 1)
-        rm._replace_value(momentum * rm._value +
-                          (1 - momentum) * mean_t._value.astype(rm._value.dtype))
-        rv._replace_value(momentum * rv._value +
-                          (1 - momentum) * (var_t._value * unbiased).astype(
-                              rv._value.dtype))
+            new_rm = momentum * rmv + (1 - momentum) * mean.astype(rmv.dtype)
+            new_rv = momentum * rvv + \
+                (1 - momentum) * (var * unbiased).astype(rvv.dtype)
+            return out, new_rm, new_rv
+        args = (x, rm, rv) + _wb_args(weight, bias)
+        out, new_rm, new_rv = dispatch(f, args, name="batch_norm",
+                                       multi_output=True)
+        # running stat update (no grad; buffer rebind)
+        rm._replace_value(new_rm._value)
+        rv._replace_value(new_rv._value)
         return out
 
     def f(v, m, va, *wb):

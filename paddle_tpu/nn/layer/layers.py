@@ -103,6 +103,10 @@ class Layer:
     def register_buffer(self, name: str, tensor: Optional[Tensor],
                         persistable: bool = True):
         self._buffers[name] = tensor
+        if tensor is not None:
+            # mark the tensor itself (reference: buffers are persistable
+            # Variables) — to_static's discovery pass keys on this flag
+            tensor.persistable = persistable
         if not persistable:
             self._non_persistable_buffer_names.add(name)
         return tensor

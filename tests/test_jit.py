@@ -172,10 +172,195 @@ def test_to_static_graph_break_fallback():
         out = f(x)
         assert any("graph break" in str(e.message) for e in w)
     np.testing.assert_allclose(np.asarray(out._value), 2 * np.ones(3))
-    # second call with same signature: straight to eager, correct value
+    # second call with same signature but flipped branch: still correct
     y = paddle.to_tensor(-np.ones((3,), np.float32))
     out2 = f(y)
     np.testing.assert_allclose(np.asarray(out2._value), -2 * np.ones(3))
+
+
+class TestSOTSegments:
+    """SOT-parity segmented execution around graph breaks (VERDICT
+    round-2 #8; reference python/paddle/jit/sot/translate.py:37)."""
+
+    def _make(self, n_layers=10):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        paddle.seed(3)
+        layers = []
+        for _ in range(n_layers):
+            layers += [nn.Linear(8, 8), nn.Tanh()]
+        net = nn.Sequential(*layers)
+
+        @paddle.jit.to_static
+        def f(x):
+            h = net(x)
+            if float(h.mean()) > 0:    # the one data-dependent branch
+                h = h * 2.0
+            else:
+                h = h - 1.0
+            return net(h)
+
+        def ref(x):
+            h = net(x)
+            if float(np.asarray(h.numpy()).mean()) > 0:
+                h = h * 2.0
+            else:
+                h = h - 1.0
+            return np.asarray(net(h).numpy())
+
+        return f, ref
+
+    def _seg_entry(self, f, x):
+        entry = f._cache[f._key((x,), {})]
+        assert entry[0] == "sot", entry
+        return entry[1]
+
+    def test_segments_stay_compiled_90pct(self):
+        import warnings
+        import paddle_tpu as paddle
+        f, ref = self._make()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                             .astype(np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f(x)                       # break -> record
+            out = f(x)                 # replay compiled segments
+        seg = self._seg_entry(f, x)
+        assert seg.last_was_replay
+        total, compiled = seg.stats
+        assert total >= 20             # a real model, not a toy
+        assert compiled / total >= 0.9, (compiled, total)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref(x),
+                                   rtol=2e-5, atol=2e-6)
+
+    def test_guard_flip_rerecords_then_replays(self):
+        import warnings
+        import paddle_tpu as paddle
+        f, ref = self._make(4)
+        # big positive vs big negative input flips the branch
+        xp = paddle.to_tensor(np.full((4, 8), 2.0, np.float32))
+        xn = paddle.to_tensor(np.full((4, 8), -2.0, np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f(xp)                      # record path A
+            assert self._seg_entry(f, xp).last_was_replay is False
+            f(xp)
+            assert self._seg_entry(f, xp).last_was_replay is True
+            out_n = f(xn)              # guard mismatch -> re-record
+            assert self._seg_entry(f, xn).last_was_replay is False
+            np.testing.assert_allclose(np.asarray(out_n.numpy()), ref(xn),
+                                       rtol=2e-5, atol=2e-6)
+            out_n2 = f(xn)             # new path replays
+            assert self._seg_entry(f, xn).last_was_replay is True
+            np.testing.assert_allclose(np.asarray(out_n2.numpy()), ref(xn),
+                                       rtol=2e-5, atol=2e-6)
+
+    def test_backward_through_segments_matches_eager(self):
+        import warnings
+        import paddle_tpu as paddle
+        f, _ = self._make(4)
+        xv = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            x0 = paddle.to_tensor(xv)
+            f(x0)                      # record
+            x1 = paddle.to_tensor(xv)
+            x1.stop_gradient = False
+            out = f(x1)                # replayed segments on the tape
+            out.sum().backward()
+        seg = self._seg_entry(f, x1)
+        assert seg.last_was_replay
+        # eager reference
+        x2 = paddle.to_tensor(xv)
+        x2.stop_gradient = False
+        out2 = f._fn(x2)
+        out2.sum().backward()
+        np.testing.assert_allclose(np.asarray(x1.grad.numpy()),
+                                   np.asarray(x2.grad.numpy()),
+                                   rtol=2e-5, atol=1e-6)
+
+    def test_module_level_flag_is_guarded(self):
+        """A tensor consumed as a scalar before any op sees it must still
+        be guarded: changing it between calls must not replay the stale
+        control path."""
+        import warnings
+        import paddle_tpu as paddle
+        flag = paddle.to_tensor(np.float32(1.0))
+
+        @paddle.jit.to_static
+        def h(x):
+            if x.sum() > -1e30:     # genuine break -> SOT path
+                x = x * 1.0
+            if float(flag) > 0:     # unknown-to-recorder consumption
+                return x * 2.0
+            return x - 1.0
+
+        x = paddle.to_tensor(np.ones((3,), np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            h(x)                                 # record path A
+            out_a = h(x)                         # replay path A
+            np.testing.assert_allclose(np.asarray(out_a.numpy()),
+                                       2 * np.ones(3))
+            flag.set_value(paddle.to_tensor(np.float32(-1.0)))
+            out_b = h(x)                         # guard must catch this
+            np.testing.assert_allclose(np.asarray(out_b.numpy()),
+                                       np.zeros(3))
+
+    def test_input_inplace_mutation_falls_back(self):
+        """A function mutating its argument in place must not be replayed
+        (the mutation would be skipped)."""
+        import warnings
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static
+        def h(x):
+            x[0] = 0.0
+            if x.sum() > -1e30:
+                return x * 2.0
+            return x
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for _ in range(3):
+                x = paddle.to_tensor(np.ones((3,), np.float32))
+                out = h(x)
+                np.testing.assert_allclose(np.asarray(out.numpy()),
+                                           [0.0, 2.0, 2.0])
+                np.testing.assert_allclose(np.asarray(x.numpy()),
+                                           [0.0, 1.0, 1.0])
+        entry = h._cache[h._key((x,), {})]
+        assert entry[0] == "sot" and entry[1]._never_replay
+
+    def test_external_mutation_falls_back_to_eager(self):
+        """A call that mutates captured state (BN running stats in train
+        mode) must not be replayed — side effects don't replay."""
+        import warnings
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        bn = nn.BatchNorm1D(8)
+        bn.train()
+
+        @paddle.jit.to_static
+        def g(x):
+            h = bn(x)
+            if float(h.sum()) > -1e30:   # always-true break
+                return h * 1.0
+            return h
+
+        x = paddle.to_tensor(np.random.RandomState(2).randn(4, 8)
+                             .astype(np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            g(x)
+            m1 = np.asarray(bn._mean.numpy()).copy()
+            g(x)
+            m2 = np.asarray(bn._mean.numpy()).copy()
+        entry = g._cache[g._key((x,), {})]
+        assert entry[0] == "sot" and entry[1]._never_replay
+        # running stats kept updating because both calls ran eagerly
+        assert not np.allclose(m1, m2)
 
 
 # -- static.Program facade (reference: base/framework.py Program,
