@@ -1,0 +1,232 @@
+"""Framework-level parity utilities (round-3 long-tail pass).
+
+reference homes: python/paddle/framework/dtype.py (finfo/iinfo),
+python/paddle/tensor/to_string.py (set_printoptions),
+python/paddle/utils/dlpack.py, python/paddle/device/cuda/random (rng
+state), python/paddle/hapi/dynamic_flops.py (flops).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dtypes import convert_dtype
+from ..core.tensor import Tensor, to_value
+
+__all__ = ["finfo", "iinfo", "set_printoptions", "to_dlpack",
+           "from_dlpack", "get_cuda_rng_state", "set_cuda_rng_state",
+           "disable_signal_handler", "check_shape", "flops",
+           "create_tensor", "create_parameter", "resize_", "reverse"]
+
+
+class finfo:
+    """reference: python/paddle/framework/dtype.py finfo."""
+
+    def __init__(self, dtype):
+        # jnp.finfo handles the ml_dtypes family (bfloat16, fp8) that
+        # np.finfo rejects
+        info = jnp.finfo(convert_dtype(dtype))
+        self.dtype = str(info.dtype)
+        self.eps = float(info.eps)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+        self.bits = int(info.bits)
+
+
+class iinfo:
+    """reference: python/paddle/framework/dtype.py iinfo."""
+
+    def __init__(self, dtype):
+        info = jnp.iinfo(convert_dtype(dtype))
+        self.dtype = str(info.dtype)
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """reference: tensor/to_string.py set_printoptions — Tensor repr goes
+    through numpy, so numpy's printoptions are the single knob."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def to_dlpack(x):
+    """reference: utils/dlpack.py to_dlpack. Returns the underlying
+    array as a DLPack-protocol object (carries __dlpack__ /
+    __dlpack_device__) rather than a bare capsule: that is what modern
+    consumers (torch.from_dlpack, np.from_dlpack, jnp.from_dlpack)
+    accept, and the export stays zero-copy where the backend allows."""
+    return to_value(x)
+
+
+def from_dlpack(ext):
+    """Accepts any DLPack-protocol object (incl. to_dlpack output,
+    torch/numpy arrays)."""
+    return Tensor(jnp.from_dlpack(ext))
+
+
+def get_cuda_rng_state():
+    """Device RNG state parity (reference device/cuda/random): here the
+    framework RNG is the jax key held by core.random."""
+    from ..core import random as _r
+    return [_r.get_rng_state()]
+
+
+def set_cuda_rng_state(state):
+    from ..core import random as _r
+    if isinstance(state, (list, tuple)):
+        state = state[0]
+    _r.set_rng_state(state)
+
+
+def disable_signal_handler():
+    """reference: paddle.disable_signal_handler — the native fault
+    handlers it removes are not installed here; kept for script parity."""
+    return None
+
+
+def check_shape(shape):
+    """Build-time shape validation (reference utils: every dim must be a
+    positive integer, -1 (infer) or None (dynamic)). Accepts numpy ints
+    (shapes routinely carry them); rejects bools."""
+    import numbers
+    for d in shape:
+        if d is None:
+            continue
+        if isinstance(d, numbers.Integral) and not isinstance(d, bool) \
+                and (d > 0 or d == -1):
+            continue
+        raise ValueError(f"invalid shape dimension {d!r} in {shape!r}")
+    return list(shape)
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    """reference: tensor/creation.py create_tensor — an (empty) tensor
+    variable to be written later."""
+    t = Tensor(jnp.zeros((0,), convert_dtype(dtype)), name=name)
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference: tensor/creation.py create_parameter. ``attr`` (a
+    ParamAttr / initializer / name string) takes precedence for
+    initializer, name, and trainability."""
+    from ..framework import Parameter, ParamAttr
+    from ..nn import initializer as I
+    attr = ParamAttr._to_attr(attr)
+    init = (attr.initializer if attr and attr.initializer is not None
+            else default_initializer) or (
+        I.Constant(0.0) if is_bias else I.XavierUniform())
+    value = init(tuple(shape), convert_dtype(dtype))
+    p = Parameter(value, name=(attr.name if attr and attr.name else name))
+    if attr and not attr.trainable:
+        p.stop_gradient = True
+        p.trainable = False
+    return p
+
+
+def resize_(x, shape):
+    """In-place resize: keep the leading flat data, zero-fill growth
+    (reference Tensor.resize_ semantics)."""
+    n = int(np.prod(shape))
+    flat = to_value(x).reshape(-1)
+    if n <= flat.shape[0]:
+        new = flat[:n].reshape(shape)
+    else:
+        new = jnp.concatenate(
+            [flat, jnp.zeros((n - flat.shape[0],), flat.dtype)]
+        ).reshape(shape)
+    return x._replace_value(new)
+
+
+def reverse(x, axis, name=None):
+    """reference: the legacy paddle.reverse — alias of flip."""
+    from ..tensor.manipulation import flip
+    return flip(x, axis)
+
+
+# -- model FLOPs counter ------------------------------------------------------
+def flops(net, input_size=None, custom_ops=None, print_detail=False,
+          inputs=None):
+    """Analytic FLOPs of a Layer (reference:
+    python/paddle/hapi/dynamic_flops.py). Counts multiply-adds as 2 ops
+    for the matmul-bearing layers and measures activations by running one
+    forward with shape-recording hooks."""
+    from ..nn import Layer
+
+    if not isinstance(net, Layer):
+        raise TypeError("flops expects a paddle.nn.Layer")
+    counts = {"total": 0}
+    details = []
+    hooks = []
+
+    def count(layer, x, out):
+        import paddle_tpu.nn as nn
+        xin = x[0] if isinstance(x, (tuple, list)) else x
+        n_in = int(np.prod(xin.shape)) if hasattr(xin, "shape") else 0
+        f = 0
+        if custom_ops and type(layer) in custom_ops:
+            f = int(custom_ops[type(layer)](layer, x, out))
+        elif isinstance(layer, nn.Linear):
+            f = 2 * n_in * layer.weight.shape[-1]
+        elif isinstance(layer, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+            w = layer.weight
+            k_elems = int(np.prod(w.shape[1:]))  # cin/groups * k...
+            out_elems = int(np.prod(out.shape[1:]))
+            f = 2 * out_elems * k_elems
+        elif isinstance(layer, (nn.BatchNorm1D, nn.BatchNorm2D,
+                                nn.BatchNorm3D, nn.LayerNorm)):
+            f = 2 * n_in
+        elif isinstance(layer, (nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh)):
+            f = n_in
+        if f:
+            counts["total"] += f
+            details.append((type(layer).__name__, f))
+
+    def attach(layer):
+        # a custom_ops entry claims the whole (possibly composite) layer:
+        # hook it and do not descend, so the user's formula replaces the
+        # built-in per-leaf counts
+        if custom_ops and type(layer) in custom_ops:
+            hooks.append(layer.register_forward_post_hook(count))
+            return
+        if not list(layer.children()):
+            hooks.append(layer.register_forward_post_hook(count))
+        for sub in layer.children():
+            attach(sub)
+
+    attach(net)
+    try:
+        if inputs is None:
+            if input_size is None:
+                raise ValueError("flops: pass input_size or inputs")
+            inputs = (Tensor(jnp.zeros(tuple(input_size), jnp.float32)),)
+        elif not isinstance(inputs, (tuple, list)):
+            inputs = (inputs,)
+        net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        for name, f in details:
+            print(f"{name:>16}: {f:,}")
+        print(f"Total FLOPs: {counts['total']:,}")
+    return counts["total"]

@@ -324,3 +324,26 @@ def bilinear(x1, x2, weight, bias=None, name=None):
     if bias is not None:
         args += (_ensure(bias),)
     return dispatch(f, args, name="bilinear")
+
+
+def pdist(x, p=2.0, name=None):
+    """Pairwise p-norm distance between row vectors (reference:
+    python/paddle/nn/functional/distance.py:119 — upper-triangle flat
+    output of length N(N-1)/2)."""
+    def f(v):
+        assert v.ndim == 2, "pdist: x must be 2-D"
+        n = v.shape[0]
+        # gather only the N(N-1)/2 unique pairs up front — half the
+        # compute and peak memory of the full N x N x D difference
+        iu, ju = jnp.triu_indices(n, k=1)
+        diff = jnp.abs(v[iu] - v[ju])              # [n(n-1)/2, D]
+        if p == 0:
+            return jnp.sum((diff != 0).astype(v.dtype), axis=-1)
+        if p == float("inf"):
+            return jnp.max(diff, axis=-1)
+        # stable p-norm: factor out the row max so diff**p can't
+        # overflow for large values
+        m = jnp.max(diff, axis=-1, keepdims=True)
+        safe = jnp.where(m > 0, diff / jnp.where(m > 0, m, 1), 0.0)
+        return m[..., 0] * jnp.sum(safe ** p, axis=-1) ** (1.0 / p)
+    return dispatch(f, (_ensure(x),), name="pdist")

@@ -65,6 +65,11 @@ _INPLACE_BASES = [
     "polygamma", "pow", "put_along_axis", "remainder", "renorm", "round",
     "sinc", "squeeze", "subtract", "t", "tanh", "transpose", "tril",
     "triu", "trunc", "unsqueeze",
+    # trig/exponential pack (reference generated op_ siblings, round 3)
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil",
+    "cos", "cosh", "erf", "exp", "expm1", "floor", "floor_mod", "mod",
+    "reciprocal", "rsqrt", "sigmoid", "sin", "sinh", "sqrt", "square",
+    "tan",
 ]
 
 

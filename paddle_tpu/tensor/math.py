@@ -563,3 +563,32 @@ def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
         hi_v = jnp.where(same, hi_v + 0.5, hi_v)
         return lo_v + (hi_v - lo_v) * jnp.arange(bins + 1) / bins
     return dispatch(f, (_ensure(input),), name="histogram_bin_edges")
+
+
+def reduce_as(x, target, name=None):
+    """Sum ``x`` down to the shape of ``target`` (reference:
+    python/paddle/tensor/math.py:1644 reduce_as — the sum-over-broadcast
+    axes op, i.e. the transpose of broadcasting)."""
+    tgt_shape = tuple(to_value(target).shape) if not isinstance(
+        target, (tuple, list)) else tuple(target)
+
+    def f(v):
+        extra = v.ndim - len(tgt_shape)
+        if extra < 0:
+            raise ValueError(
+                f"reduce_as: x rank {v.ndim} < target rank "
+                f"{len(tgt_shape)}")
+        axes = tuple(range(extra)) + tuple(
+            extra + i for i, (sx, st) in enumerate(
+                zip(v.shape[extra:], tgt_shape)) if st == 1 and sx != 1)
+        out = jnp.sum(v, axis=axes, keepdims=False) if axes else v
+        out = out.reshape(tgt_shape)
+        if v.dtype in (jnp.bool_, jnp.int32):
+            out = out.astype(jnp.int64)
+        return out
+    return dispatch(f, (_ensure(x),), name="reduce_as")
+
+
+def broadcast_shape(x_shape, y_shape):
+    """reference: python/paddle/tensor/manipulation.py broadcast_shape."""
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))

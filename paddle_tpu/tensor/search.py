@@ -147,3 +147,66 @@ def masked_select(x, mask, name=None):
 def where(condition, x=None, y=None, name=None):
     from .manipulation import where as _w
     return _w(condition, x, y)
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
+                   mode="truncated", return_top=False, name=None):
+    """Nucleus sampling (reference: python/paddle/tensor/search.py:1402 —
+    a fused CUDA kernel there; one fused XLA program here).
+
+    x: [B, V] PROBABILITIES (post-softmax, reference contract); ps: [B]
+    or [B, 1] cumulative-probability cutoffs. Returns ``(value, ids)``
+    each [B, 1]: the sampled token's probability and index. ``k > 0``
+    additionally caps the nucleus at the top-k tokens; ``threshold``
+    drops tokens below an absolute probability floor; ``seed >= 0`` (or
+    per-batch ``topp_seed`` [B] ints) makes the draw reproducible;
+    ``mode`` matches the reference ("truncated" renormalizes inside the
+    nucleus, "non-truncated" keeps raw probabilities for the draw)."""
+    import jax as _jax
+    from ..core.random import next_key
+
+    if seed is not None and seed >= 0:
+        base_key = _jax.random.key(int(seed))
+    else:
+        base_key = next_key()
+    thr = None if threshold is None else to_value(_ensure(threshold))
+    seeds = None if topp_seed is None else to_value(_ensure(topp_seed))
+
+    def f(probs, cutoff):
+        B, V = probs.shape
+        cut = cutoff.reshape(B, 1).astype(jnp.float32)
+        p = probs.astype(jnp.float32)
+        order = jnp.argsort(-p, axis=-1)
+        sorted_p = jnp.take_along_axis(p, order, axis=-1)
+        csum = jnp.cumsum(sorted_p, axis=-1)
+        # keep tokens whose PRECEDING mass is < cutoff (always >= 1 token)
+        keep = (csum - sorted_p) < cut
+        if k and k > 0:
+            keep = keep & (jnp.arange(V)[None, :] < k)
+        if thr is not None:
+            keep = keep & (sorted_p >= jnp.reshape(thr, (-1, 1)))
+        keep = keep.at[:, 0].set(True)
+        draw_p = jnp.where(keep, sorted_p, 0.0)
+        if mode == "truncated":
+            draw_p = draw_p / jnp.sum(draw_p, axis=-1, keepdims=True)
+        logits = jnp.log(jnp.clip(draw_p, 1e-38, None))
+        if seeds is not None:
+            keys = _jax.vmap(
+                lambda s: _jax.random.fold_in(base_key, s))(
+                    jnp.reshape(seeds, (-1,)).astype(jnp.uint32))
+            choice = _jax.vmap(
+                lambda kk, lg: _jax.random.categorical(kk, lg))(
+                    keys, logits)                             # [B]
+        else:
+            choice = _jax.random.categorical(base_key, logits, axis=-1)
+        ids = jnp.take_along_axis(order, choice[:, None], axis=-1)
+        val = jnp.take_along_axis(p, ids, axis=-1).astype(probs.dtype)
+        ids = ids.astype(jnp.int64)
+        if return_top:
+            top_val = sorted_p[:, :1].astype(probs.dtype)
+            top_ids = order[:, :1].astype(jnp.int64)
+            return val, ids, top_val, top_ids
+        return val, ids
+
+    args = (_ensure(x), _ensure(ps))
+    return dispatch(f, args, name="top_p_sampling", multi_output=True)

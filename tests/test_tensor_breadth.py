@@ -287,3 +287,122 @@ class TestInplaceAndTypes:
         x = t(np.zeros(1000, np.float32))
         x.gaussian_(mean=1.0, std=0.1)
         assert abs(float(n(x).mean()) - 1.0) < 0.05
+
+
+class TestRound3LongTail:
+    """Round-3 long-tail additions (reference: tensor/math.py reduce_as,
+    tensor/search.py top_p_sampling, nn/functional/distance.py pdist,
+    framework/dtype.py finfo/iinfo, generated inplace op_ siblings)."""
+
+    def test_inplace_trig_pack(self):
+        rng = np.random.RandomState(3)
+        for name, ref in [("sqrt_", np.sqrt), ("exp_", np.exp),
+                          ("sin_", np.sin), ("cos_", np.cos),
+                          ("floor_", np.floor), ("ceil_", np.ceil),
+                          ("abs_", np.abs), ("tan_", np.tan),
+                          ("sigmoid_", lambda v: 1 / (1 + np.exp(-v))),
+                          ("rsqrt_", lambda v: 1 / np.sqrt(v)),
+                          ("reciprocal_", lambda v: 1 / v),
+                          ("square_", np.square)]:
+            a = np.abs(rng.randn(5).astype(np.float32)) + 0.5
+            x = t(a.copy())
+            r = getattr(x, name)()
+            assert r is x, name
+            np.testing.assert_allclose(n(x), ref(a), rtol=1e-5,
+                                       err_msg=name)
+
+    def test_reduce_as_matches_broadcast_transpose(self):
+        x = t(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+        tgt = t(np.zeros((3, 1), np.float32))
+        out = paddle.reduce_as(x, tgt)
+        np.testing.assert_allclose(
+            n(out), np.arange(24).reshape(2, 3, 4).sum((0, 2),
+                                                       keepdims=True)[0])
+        # int32 promotes to int64 (reference dtype rule)
+        xi = t(np.ones((2, 3), np.int32))
+        got = paddle.reduce_as(xi, t(np.zeros((3,), np.int32)))
+        assert "int64" in str(got.dtype)
+
+    def test_top_p_sampling_nucleus(self):
+        paddle.seed(0)
+        probs = t(np.array([[0.5, 0.3, 0.15, 0.05]] * 64, np.float32))
+        val, ids = paddle.top_p_sampling(
+            probs, t(np.full((64,), 0.75, np.float32)))
+        i = n(ids).ravel()
+        assert set(i.tolist()) <= {0, 1}          # nucleus = {0.5, 0.3}
+        assert len(set(i.tolist())) == 2          # actually samples both
+        np.testing.assert_allclose(
+            n(val).ravel(), np.where(i == 0, 0.5, 0.3), rtol=1e-6)
+        # k cap: top-1 only
+        _, ids1 = paddle.top_p_sampling(
+            probs, t(np.full((64,), 0.99, np.float32)), k=1)
+        assert set(n(ids1).ravel().tolist()) == {0}
+
+    def test_pdist_matches_scipy_form(self):
+        rng = np.random.RandomState(1)
+        a = rng.randn(5, 3).astype(np.float32)
+        got = n(paddle.pdist(t(a)))
+        want = []
+        for i in range(5):
+            for j in range(i + 1, 5):
+                want.append(np.linalg.norm(a[i] - a[j]))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+        inf_d = n(paddle.pdist(t(a), p=float("inf")))
+        want_inf = [np.abs(a[i] - a[j]).max()
+                    for i in range(5) for j in range(i + 1, 5)]
+        np.testing.assert_allclose(inf_d, want_inf, rtol=1e-5)
+
+    def test_finfo_iinfo_constants(self):
+        assert paddle.finfo("float32").eps == np.finfo(np.float32).eps
+        assert paddle.finfo("bfloat16").bits == 16
+        assert paddle.iinfo("int16").max == 32767
+        assert paddle.pi == np.pi and paddle.inf == np.inf
+        assert paddle.newaxis is None and np.isnan(paddle.nan)
+
+    def test_dlpack_roundtrip_and_torch_interop(self):
+        a = np.arange(6, dtype=np.float32)
+        back = paddle.from_dlpack(paddle.to_dlpack(t(a)))
+        np.testing.assert_allclose(n(back), a)
+        import torch
+        np.testing.assert_allclose(
+            n(paddle.from_dlpack(torch.arange(4, dtype=torch.float32))),
+            [0, 1, 2, 3])
+
+    def test_resize_reverse_create(self):
+        x = t(np.arange(6, dtype=np.float32))
+        x.resize_([2, 4])
+        np.testing.assert_allclose(n(x), [[0, 1, 2, 3], [4, 5, 0, 0]])
+        x.resize_([3])
+        np.testing.assert_allclose(n(x), [0, 1, 2])
+        np.testing.assert_allclose(
+            n(paddle.reverse(t(np.array([1., 2.], np.float32)), 0)),
+            [2, 1])
+        p = paddle.create_parameter([3, 3], "float32")
+        assert not p.stop_gradient and list(p.shape) == [3, 3]
+        ct = paddle.create_tensor("int32")
+        assert "int32" in str(ct.dtype)
+
+    def test_rng_state_shape_guard_misc(self):
+        st = paddle.get_cuda_rng_state()
+        paddle.set_cuda_rng_state(st)
+        assert paddle.check_shape([2, -1, None]) == [2, -1, None]
+        with pytest.raises(ValueError):
+            paddle.check_shape([2, 0])
+        paddle.disable_signal_handler()
+        assert paddle.broadcast_shape([2, 1, 4], [3, 1]) == [2, 3, 4]
+
+    def test_flops_counts_linear_and_conv(self):
+        import paddle_tpu.nn as nn
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        assert paddle.flops(net, input_size=[1, 8]) == \
+            2 * 8 * 16 + 16 + 2 * 16 * 2
+        conv = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1))
+        f = paddle.flops(conv, input_size=[1, 3, 8, 8])
+        assert f == 2 * (8 * 8 * 8) * (3 * 3 * 3)
+
+    def test_stft_istft_methods(self):
+        sig = np.random.RandomState(0).randn(256).astype(np.float32)
+        S = t(sig).stft(n_fft=64, hop_length=16)
+        back = S.istft(n_fft=64, hop_length=16, length=256)
+        err = np.abs(n(back) - sig)[32:-32].max()
+        assert err < 1e-3
