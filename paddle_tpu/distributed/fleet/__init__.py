@@ -11,7 +11,7 @@ from .fleet import (init, distributed_model, distributed_optimizer,  # noqa
 from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa
                         RowParallelLinear, ParallelCrossEntropy)
 from .pp_compiled import (CompiledPipeline, Compiled1F1B,  # noqa
-                          pipeline_microbatch)
+                          CompiledInterleaved, pipeline_microbatch)
 from . import sequence_parallel_utils  # noqa: F401
 from . import random  # noqa: F401
 

@@ -33,7 +33,18 @@ try:
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
-__all__ = ["CompiledPipeline", "Compiled1F1B", "pipeline_microbatch"]
+__all__ = ["CompiledPipeline", "Compiled1F1B", "CompiledInterleaved",
+           "pipeline_microbatch"]
+
+
+def _shard_map_norep(fn, mesh, in_specs, out_specs):
+    """shard_map without the replication check, across the jax rename
+    (check_rep -> check_vma); single home for the compatibility shim."""
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return shard_map(fn, check_rep=False, **kwargs)
+    except TypeError:  # jax >= 0.8 renamed the replication check
+        return shard_map(fn, check_vma=False, **kwargs)
 
 
 def pipeline_microbatch(batch, num_microbatches: int):
@@ -106,12 +117,7 @@ class CompiledPipeline:
             return jax.lax.psum(y, axis)
 
         spec_p = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-        kwargs = dict(mesh=self.mesh, in_specs=(spec_p, P()),
-                      out_specs=P())
-        try:
-            fn = shard_map(device_prog, check_rep=False, **kwargs)
-        except TypeError:  # jax >= 0.8 renamed the replication check
-            fn = shard_map(device_prog, check_vma=False, **kwargs)
+        fn = _shard_map_norep(device_prog, self.mesh, (spec_p, P()), P())
         return fn(stage_params, x)
 
 
@@ -266,10 +272,168 @@ class Compiled1F1B:
             return loss, grads
 
         spec_p = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-        kwargs = dict(mesh=self.mesh, in_specs=(spec_p, P(), P()),
-                      out_specs=(P(), spec_p))
-        try:
-            fn = shard_map(device_prog, check_rep=False, **kwargs)
-        except TypeError:  # jax >= 0.8 renamed the replication check
-            fn = shard_map(device_prog, check_vma=False, **kwargs)
+        fn = _shard_map_norep(device_prog, self.mesh, (spec_p, P(), P()),
+                              (P(), spec_p))
         return fn(stage_params, x, labels)
+
+
+class CompiledInterleaved:
+    """Compiled interleaved (virtual-pipeline) schedule: V chunks per
+    physical stage, the whole forward+backward as ONE scanned XLA
+    program (reference eager engine:
+    fleet/meta_parallel/pipeline_parallel.py:1308
+    PipelineParallelWithInterleave; static pass:
+    pipeline_scheduler_pass/pipeline_vpp.py).
+
+    The L = V*S virtual chunks form a depth-L pipeline; chunk ``c`` lives
+    on physical stage ``c % S`` in local slot ``c // S`` (the reference's
+    round-robin placement, pp_layers.py chunk_of). The full-tick wave
+    runs F(c, m) at tick ``c + m`` and B(c, m) at tick ``2L - 2 - c + m``
+    (T = M + 2L - 2 ticks): each tick every device computes its V
+    (masked) F slots and V (masked) B slots, so VPP's smaller per-chunk
+    bubbles come at the standard cost of V chunk computations per tick.
+    Activations hop chunk c -> c+1 over a RING ppermute — a neighbor
+    shift for intra-stage boundaries and a wraparound (S-1 -> 0) hop when
+    a micro-batch finishes chunk column cV and re-enters at the first
+    stage; cotangents ride the reverse ring. Per-chunk ring stashes of
+    the chunk INPUTS (K = min(M, 2L-1) slots each) + per-microbatch vjp
+    recompute keep activation memory O(V * L) rather than O(V * M).
+
+    Contract: ``chunk_fn(chunk_params, x) -> y`` uniform across chunks
+    with y.shape == x.shape; ``chunk_params`` leaves carry a leading
+    [S, V] axis pair — [s, v] is the slice of chunk ``v*S + s`` — with
+    the [S] axis sharded over ``pp``. ``loss_fn(y, label) -> scalar``
+    applies per micro-batch after the LAST chunk, averaged over M.
+
+    ``loss_and_grads(params, x, labels)`` with x/labels [M, mb, ...]
+    returns ``(loss, grads)`` shaped like ``params``.
+    """
+
+    def __init__(self, chunk_fn: Callable, loss_fn: Callable, mesh: Mesh,
+                 num_microbatches: int, num_chunks: int, axis: str = "pp"):
+        self.chunk_fn = chunk_fn
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.axis = axis
+        self.num_stages = mesh.shape[axis]
+        self.num_microbatches = num_microbatches
+        self.num_chunks = num_chunks        # V, per stage
+
+    def loss_and_grads(self, params, x, labels):
+        S = self.num_stages
+        V = self.num_chunks
+        M = self.num_microbatches
+        L = V * S
+        axis = self.axis
+        body = self.chunk_fn
+        loss_fn = self.loss_fn
+        K = min(M, 2 * L - 1)
+        T = M + 2 * L - 2
+        for name, v in (("x", x), ("labels", labels)):
+            lead = jax.tree_util.tree_leaves(v)[0].shape[0]
+            if lead != M:
+                raise ValueError(
+                    f"CompiledInterleaved: {name} leading dim {lead} != "
+                    f"num_microbatches {M}")
+
+        ring_fwd = [(i, (i + 1) % S) for i in range(S)]
+        ring_bwd = [(i, (i - 1) % S) for i in range(S)]
+
+        def device_prog(params_local, x_local, y_local):
+            # params_local leaves: [1, V, ...] -> my V chunk slices
+            my = jax.tree_util.tree_map(lambda p: p[0], params_local)
+            s = jax.lax.axis_index(axis)
+            mb_x = x_local[0]
+            # per-local-chunk incoming activation / cotangent buffers
+            act0 = jnp.zeros((V,) + mb_x.shape, mb_x.dtype)
+            dy0 = jnp.zeros((V,) + mb_x.shape, mb_x.dtype)
+            stash0 = jnp.zeros((V, K) + mb_x.shape, mb_x.dtype)
+            grads0 = jax.tree_util.tree_map(jnp.zeros_like, my)
+
+            def chunk_param(v):
+                return jax.tree_util.tree_map(lambda p: p[v], my)
+
+            def tick(carry, t):
+                act_in, dy_in, stash, grads, loss_acc = carry
+                # ---- F slots: chunk c = v*S + s processes m = t - c ----
+                send_f = jnp.zeros((V,) + mb_x.shape, mb_x.dtype)
+                new_stash = stash
+                for v in range(V):
+                    c = v * S + s              # traced scalar
+                    m_f = t - c
+                    valid_f = (m_f >= 0) & (m_f < M)
+                    m_f_c = jnp.clip(m_f, 0, M - 1)
+                    # chunk 0 input comes from the feed; others from the
+                    # ring buffer filled by the previous tick's permute
+                    x_f = jnp.where((s == 0) & (v == 0),
+                                    x_local[m_f_c], act_in[v])
+                    y_f = body(chunk_param(v), x_f)
+                    slot = jnp.mod(m_f_c, K)
+                    new_stash = new_stash.at[v, slot].set(
+                        jnp.where(valid_f, x_f, new_stash[v, slot]))
+                    send_f = send_f.at[v].set(
+                        jnp.where(valid_f, y_f, 0.0))
+                # ---- B slots: chunk c processes m = t - (2L - 2 - c) ---
+                send_b = jnp.zeros((V,) + mb_x.shape, mb_x.dtype)
+                loss_add = jnp.asarray(0.0, jnp.float32)
+                for v in range(V):
+                    c = v * S + s
+                    m_b = t - (2 * L - 2 - c)
+                    valid_b = (m_b >= 0) & (m_b < M)
+                    m_b_c = jnp.clip(m_b, 0, M - 1)
+                    # read the stash updated THIS tick: the last chunk's
+                    # backward lands on the same tick as its forward
+                    x_b = new_stash[v, jnp.mod(m_b_c, K)]
+                    label_b = y_local[m_b_c]
+                    pv = chunk_param(v)
+                    y_b, vjp_body = jax.vjp(
+                        lambda p, xx: body(p, xx), pv, x_b)
+                    loss_b, vjp_loss = jax.vjp(
+                        lambda yy: loss_fn(yy, label_b), y_b)
+                    (dy_loss,) = vjp_loss(
+                        jnp.asarray(1.0 / M, jnp.result_type(loss_b)))
+                    is_last = (s == S - 1) & (v == V - 1)
+                    dy = jnp.where(is_last, dy_loss.astype(dy_in.dtype),
+                                   dy_in[v])
+                    dp, dx = vjp_body(dy)
+                    grads = jax.tree_util.tree_map(
+                        lambda g, d, _v=v: g.at[_v].add(
+                            jnp.where(valid_b, d, 0.0)),
+                        grads, dp)
+                    loss_add = loss_add + jnp.where(
+                        valid_b & is_last, loss_b.astype(jnp.float32), 0.0)
+                    send_b = send_b.at[v].set(jnp.where(valid_b, dx, 0.0))
+
+                # ---- ring shifts --------------------------------------
+                # F: chunk c=vS+s -> c+1. For s < S-1 the receiver is
+                # (s+1, same v); for s == S-1 it is (0, v+1) — i.e. after
+                # the ring hop, the wrapped payload must move up one
+                # local-chunk slot on the receiving device.
+                moved_f = jax.lax.ppermute(send_f, axis, ring_fwd)
+                # on stage 0 the arrival from S-1 belongs to slot v+1
+                shifted_f = jnp.concatenate(
+                    [jnp.zeros((1,) + mb_x.shape, mb_x.dtype),
+                     moved_f[:-1]], axis=0)
+                act_next = jnp.where(s == 0, shifted_f, moved_f)
+                # B: chunk c -> c-1: reverse ring; on stage S-1 the
+                # arrival from stage 0 belongs to slot v-1
+                moved_b = jax.lax.ppermute(send_b, axis, ring_bwd)
+                shifted_b = jnp.concatenate(
+                    [moved_b[1:],
+                     jnp.zeros((1,) + mb_x.shape, mb_x.dtype)], axis=0)
+                dy_next = jnp.where(s == S - 1, shifted_b, moved_b)
+                return (act_next, dy_next, new_stash, grads,
+                        loss_acc + loss_add), None
+
+            carry0 = (act0, dy0, stash0, grads0,
+                      jnp.asarray(0.0, jnp.float32))
+            carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
+            _, _, _, grads, loss_acc = carry
+            loss = jax.lax.psum(loss_acc, axis) / M
+            grads = jax.tree_util.tree_map(lambda g: g[None], grads)
+            return loss, grads
+
+        spec_p = jax.tree_util.tree_map(lambda _: P(axis), params)
+        fn = _shard_map_norep(device_prog, self.mesh, (spec_p, P(), P()),
+                              (P(), spec_p))
+        return fn(params, x, labels)

@@ -62,7 +62,14 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
     if linewidth is not None:
         kw["linewidth"] = linewidth
     if sci_mode is not None:
-        kw["suppress"] = not sci_mode
+        if sci_mode:
+            # numpy has no force-scientific flag; install a formatter
+            prec = precision if precision is not None else 8
+            kw["formatter"] = {"float_kind": (
+                lambda v: np.format_float_scientific(v, precision=prec))}
+        else:
+            kw["suppress"] = True
+            kw["formatter"] = None
     np.set_printoptions(**kw)
 
 

@@ -160,8 +160,10 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
     additionally caps the nucleus at the top-k tokens; ``threshold``
     drops tokens below an absolute probability floor; ``seed >= 0`` (or
     per-batch ``topp_seed`` [B] ints) makes the draw reproducible;
-    ``mode`` matches the reference ("truncated" renormalizes inside the
-    nucleus, "non-truncated" keeps raw probabilities for the draw)."""
+    ``mode`` matches the reference doc: "truncated" samples from the
+    renormalized nucleus; "non-truncated" does NOT truncate at ps — it
+    samples from the full distribution (threshold/k filters, when given,
+    still apply)."""
     import jax as _jax
     from ..core.random import next_key
 
@@ -181,14 +183,14 @@ def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1, k=0,
         csum = jnp.cumsum(sorted_p, axis=-1)
         # keep tokens whose PRECEDING mass is < cutoff (always >= 1 token)
         keep = (csum - sorted_p) < cut
+        if mode != "truncated":
+            keep = jnp.ones_like(keep)   # no nucleus cutoff
         if k and k > 0:
             keep = keep & (jnp.arange(V)[None, :] < k)
         if thr is not None:
             keep = keep & (sorted_p >= jnp.reshape(thr, (-1, 1)))
         keep = keep.at[:, 0].set(True)
         draw_p = jnp.where(keep, sorted_p, 0.0)
-        if mode == "truncated":
-            draw_p = draw_p / jnp.sum(draw_p, axis=-1, keepdims=True)
         logits = jnp.log(jnp.clip(draw_p, 1e-38, None))
         if seeds is not None:
             keys = _jax.vmap(
