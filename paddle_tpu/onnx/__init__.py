@@ -1,34 +1,80 @@
-"""paddle_tpu.onnx (reference: python/paddle/onnx/export.py, which shells
-out to paddle2onnx).
+"""paddle_tpu.onnx: real ONNX export.
 
-This environment ships no ``onnx``/converter package, so true .onnx
-serialization is gated; ``export`` still produces a portable serialized
-model — the StableHLO program + weights that ``paddle.jit.save`` emits
-(StableHLO is the interchange format of the XLA ecosystem, playing the
-role .onnx plays for the reference's deployment path).
+reference: python/paddle/onnx/export.py (shells out to paddle2onnx, a
+ProgramDesc -> ONNX translator). Here the converter is first-party:
+``export`` traces the layer to a jaxpr (parameters closed over as
+constants -> graph initializers) and ``exporter.jaxpr_to_onnx`` maps jax
+primitives onto ONNX opset-17 ops. The schema bindings are vendored
+(onnx.proto), so no external onnx package is needed to WRITE models;
+the serialized file uses upstream field numbers and loads in
+onnx/onnxruntime. ``runner.run_model`` is a bundled numpy evaluator used
+by tests for numeric verification.
 """
 from __future__ import annotations
-
-import os
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """reference: python/paddle/onnx/export.py export."""
+def export(layer, path, input_spec=None, opset_version=17, **configs):
+    """reference: python/paddle/onnx/export.py export — writes
+    ``path + '.onnx'`` and returns that filename.
+
+    ``layer``: a Layer (uses ``.functional()``) or a plain callable over
+    Tensors. ``input_spec``: list of InputSpec / example arrays fixing
+    the traced input shapes (None dims are exported at 1)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor, to_value
+    from ..nn import Layer
+    from ..static import InputSpec
+    from .exporter import jaxpr_to_onnx
+
+    if not 13 <= opset_version <= 17:
+        raise ValueError(
+            f"opset_version {opset_version} unsupported: the exporter "
+            "emits opset-13+ op forms (ReduceSum with axes input, "
+            "Einsum) and declares opset 17; pass 13..17")
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec")
+    example = []
+    for spec in input_spec:
+        if isinstance(spec, InputSpec):
+            shape = [1 if (s is None or int(s) < 0) else int(s)
+                     for s in spec.shape]
+            example.append(jnp.zeros(tuple(shape), spec.dtype))
+        elif isinstance(spec, Tensor):
+            example.append(to_value(spec))
+        else:
+            example.append(jnp.asarray(spec))
+
+    was_training = False
+    if isinstance(layer, Layer):
+        # export traces inference behavior; restore the caller's mode
+        # after tracing (pure_fn reads layer state at trace time)
+        was_training = layer.training
+        layer.eval()
+        pure_fn, params, buffers = layer.functional()
+
+        def fn(*xs):
+            out, _ = pure_fn(params, buffers, *xs)
+            return out
+    else:
+        def fn(*xs):
+            out = layer(*tuple(Tensor(x) for x in xs))
+            return jax.tree_util.tree_map(
+                lambda o: to_value(o) if isinstance(o, Tensor) else o, out,
+                is_leaf=lambda o: isinstance(o, Tensor))
+
     try:
-        import onnx  # noqa: F401
-        raise NotImplementedError(
-            "onnx is importable but no StableHLO->ONNX converter is "
-            "bundled; use the StableHLO artifact from paddle.jit.save "
-            "for deployment")
-    except ImportError:
-        pass
-    from ..jit import save as jit_save
-    jit_save(layer, path, input_spec=input_spec)
-    import warnings
-    warnings.warn(
-        f"onnx package unavailable — exported StableHLO + weights to "
-        f"{path}* instead (loadable via paddle.jit.load / any StableHLO "
-        "runtime)", stacklevel=2)
-    return path
+        closed = jax.make_jaxpr(fn)(*example)
+    finally:
+        if was_training:
+            layer.train()
+    input_names = [f"x{i}" for i in range(len(example))]
+    model = jaxpr_to_onnx(closed, input_names,
+                          graph_name=type(layer).__name__)
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return out_path

@@ -1,0 +1,573 @@
+"""jaxpr -> ONNX converter.
+
+The reference delegates ONNX export to paddle2onnx (a ProgramDesc ->
+ONNX graph translator, python/paddle/onnx/export.py). Here the source IR
+is the jaxpr of the traced function: constants (parameters, folded
+subexpressions) become graph initializers, jax primitives map to ONNX
+ops via the handler table below, and anything not reachable from the
+graph inputs is constant-folded by evaluating the primitive eagerly.
+
+Emitted opset: 17 (Einsum needs >= 12; ReduceSum-with-axes-input needs
+>= 13). The schema bindings are vendored (onnx.proto / onnx_pb2.py) —
+serialized models carry upstream field numbers, so onnx/onnxruntime can
+load them; tests verify numerics with the bundled numpy runner
+(runner.py) since onnxruntime is not shipped in this environment.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jex_core
+
+from . import onnx_pb2 as ox
+
+OPSET = 17
+
+_DTYPE_MAP = {
+    "float32": ox.TensorProto.FLOAT, "float64": ox.TensorProto.DOUBLE,
+    "float16": ox.TensorProto.FLOAT16, "bfloat16": ox.TensorProto.BFLOAT16,
+    "int64": ox.TensorProto.INT64, "int32": ox.TensorProto.INT32,
+    "int16": ox.TensorProto.INT16, "int8": ox.TensorProto.INT8,
+    "uint8": ox.TensorProto.UINT8, "uint32": ox.TensorProto.UINT32,
+    "uint64": ox.TensorProto.UINT64, "bool": ox.TensorProto.BOOL,
+}
+
+
+class UnsupportedOp(NotImplementedError):
+    pass
+
+
+def _onnx_dtype(dt) -> int:
+    name = str(np.dtype(dt)) if not str(dt).startswith("bfloat") \
+        else "bfloat16"
+    try:
+        return _DTYPE_MAP[name]
+    except KeyError:
+        raise UnsupportedOp(f"dtype {dt} has no ONNX mapping")
+
+
+def _tensor_proto(name: str, arr: np.ndarray) -> "ox.TensorProto":
+    arr = np.asarray(arr)
+    if str(arr.dtype) == "bfloat16":
+        raw = arr.view(np.uint16).tobytes()
+        dt = ox.TensorProto.BFLOAT16
+    else:
+        raw = np.ascontiguousarray(arr).tobytes()
+        dt = _onnx_dtype(arr.dtype)
+    return ox.TensorProto(name=name, dims=list(arr.shape), data_type=dt,
+                          raw_data=raw)
+
+
+def _value_info(name: str, shape, dt) -> "ox.ValueInfoProto":
+    vi = ox.ValueInfoProto(name=name)
+    vi.type.tensor_type.elem_type = _onnx_dtype(dt)
+    for d in shape:
+        vi.type.tensor_type.shape.dim.add(dim_value=int(d))
+    return vi
+
+
+class _Graph:
+    """Accumulates nodes/initializers with unique naming."""
+
+    def __init__(self):
+        self.nodes = []
+        self.initializers = {}
+        self._n = 0
+
+    def fresh(self, hint="t"):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def const(self, arr, hint="const"):
+        name = self.fresh(hint)
+        self.initializers[name] = np.asarray(arr)
+        return name
+
+    def node(self, op_type, inputs, n_out=1, **attrs):
+        outs = [self.fresh(op_type.lower()) for _ in range(n_out)]
+        n = ox.NodeProto(op_type=op_type, input=list(inputs), output=outs,
+                         name=self.fresh(op_type))
+        for k, v in attrs.items():
+            a = n.attribute.add(name=k)
+            if isinstance(v, int):
+                a.type = ox.AttributeProto.INT
+                a.i = v
+            elif isinstance(v, float):
+                a.type = ox.AttributeProto.FLOAT
+                a.f = v
+            elif isinstance(v, str):
+                a.type = ox.AttributeProto.STRING
+                a.s = v.encode()
+            elif isinstance(v, (list, tuple)) and all(
+                    isinstance(e, int) for e in v):
+                a.type = ox.AttributeProto.INTS
+                a.ints.extend(v)
+            else:
+                raise UnsupportedOp(f"attr {k}={v!r}")
+        self.nodes.append(n)
+        return outs[0] if n_out == 1 else outs
+
+
+# -- primitive handlers -------------------------------------------------------
+# handler(graph, in_names, in_avals, out_avals, params) -> out_name(s)
+_HANDLERS = {}
+
+
+def _register(*names):
+    def deco(fn):
+        for n in names:
+            _HANDLERS[n] = fn
+        return fn
+    return deco
+
+
+_ELEMENTWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow", "neg": "Neg",
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+    "erf": "Erf", "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign",
+    "floor": "Floor", "ceil": "Ceil", "round": "Round",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan", "asin": "Asin",
+    "acos": "Acos", "atan": "Atan", "sinh": "Sinh", "cosh": "Cosh",
+    "asinh": "Asinh", "acosh": "Acosh", "atanh": "Atanh",
+    "not": "Not", "and": "And", "or": "Or", "xor": "Xor",
+    "eq": "Equal", "lt": "Less", "le": "LessOrEqual", "gt": "Greater",
+    "ge": "GreaterOrEqual", "is_finite": "IsInf",
+}
+
+for _p, _o in _ELEMENTWISE.items():
+    if _p == "is_finite":
+        continue
+
+    def _mk(op):
+        def h(g, ins, iav, oav, params):
+            return g.node(op, ins)
+        return h
+    _HANDLERS[_p] = _mk(_o)
+
+
+@_register("ne")
+def _ne(g, ins, iav, oav, params):
+    return g.node("Not", [g.node("Equal", ins)])
+
+
+@_register("is_finite")
+def _isfinite(g, ins, iav, oav, params):
+    # finite = not(isinf) and not(isnan)
+    ninf = g.node("Not", [g.node("IsInf", ins)])
+    nnan = g.node("Not", [g.node("IsNaN", ins)])
+    return g.node("And", [ninf, nnan])
+
+
+@_register("rsqrt")
+def _rsqrt(g, ins, iav, oav, params):
+    return g.node("Reciprocal", [g.node("Sqrt", ins)])
+
+
+@_register("erfc")
+def _erfc(g, ins, iav, oav, params):
+    one = g.const(np.ones((), np.dtype(iav[0].dtype)), "one")
+    return g.node("Sub", [one, g.node("Erf", ins)])
+
+
+@_register("log1p")
+def _log1p(g, ins, iav, oav, params):
+    one = g.const(np.ones((), np.dtype(iav[0].dtype)), "one")
+    return g.node("Log", [g.node("Add", [ins[0], one])])
+
+
+@_register("expm1")
+def _expm1(g, ins, iav, oav, params):
+    one = g.const(np.ones((), np.dtype(iav[0].dtype)), "one")
+    return g.node("Sub", [g.node("Exp", ins), one])
+
+
+@_register("square")
+def _square(g, ins, iav, oav, params):
+    return g.node("Mul", [ins[0], ins[0]])
+
+
+@_register("integer_pow")
+def _ipow(g, ins, iav, oav, params):
+    y = g.const(np.asarray(params["y"], np.dtype(iav[0].dtype)))
+    return g.node("Pow", [ins[0], y])
+
+
+@_register("clamp")
+def _clamp(g, ins, iav, oav, params):
+    # jax clamp(min, x, max)
+    return g.node("Clip", [ins[1], ins[0], ins[2]])
+
+
+@_register("select_n")
+def _select(g, ins, iav, oav, params):
+    if len(ins) != 3:
+        raise UnsupportedOp("select_n with >2 cases")
+    # select_n(pred, on_false, on_true); Where(cond, X=true, Y=false)
+    return g.node("Where", [ins[0], ins[2], ins[1]])
+
+
+@_register("convert_element_type")
+def _cast(g, ins, iav, oav, params):
+    return g.node("Cast", ins, to=int(_onnx_dtype(params["new_dtype"])))
+
+
+@_register("stop_gradient", "copy")
+def _identity(g, ins, iav, oav, params):
+    return g.node("Identity", ins)
+
+
+@_register("reshape")
+def _reshape(g, ins, iav, oav, params):
+    shp = g.const(np.asarray(params["new_sizes"], np.int64), "shape")
+    return g.node("Reshape", [ins[0], shp])
+
+
+@_register("squeeze")
+def _squeeze(g, ins, iav, oav, params):
+    shp = g.const(np.asarray(oav[0].shape, np.int64), "shape")
+    return g.node("Reshape", [ins[0], shp])
+
+
+@_register("expand_dims")
+def _expand_dims(g, ins, iav, oav, params):
+    shp = g.const(np.asarray(oav[0].shape, np.int64), "shape")
+    return g.node("Reshape", [ins[0], shp])
+
+
+@_register("transpose")
+def _transpose(g, ins, iav, oav, params):
+    return g.node("Transpose", ins,
+                  perm=[int(p) for p in params["permutation"]])
+
+
+@_register("broadcast_in_dim")
+def _broadcast(g, ins, iav, oav, params):
+    shape = params["shape"]
+    bdims = params["broadcast_dimensions"]
+    # place source dims into a rank-len(shape) 1-filled frame, then Expand
+    frame = [1] * len(shape)
+    for src_i, dst_i in enumerate(bdims):
+        frame[dst_i] = iav[0].shape[src_i]
+    cur = ins[0]
+    if list(iav[0].shape) != frame:
+        shp = g.const(np.asarray(frame, np.int64), "shape")
+        cur = g.node("Reshape", [cur, shp])
+    tgt = g.const(np.asarray(shape, np.int64), "shape")
+    return g.node("Expand", [cur, tgt])
+
+
+@_register("concatenate")
+def _concat(g, ins, iav, oav, params):
+    return g.node("Concat", ins, axis=int(params["dimension"]))
+
+
+@_register("slice")
+def _slice(g, ins, iav, oav, params):
+    starts = g.const(np.asarray(params["start_indices"], np.int64))
+    ends = g.const(np.asarray(params["limit_indices"], np.int64))
+    axes = g.const(np.arange(len(params["start_indices"]), dtype=np.int64))
+    strides = params.get("strides") or [1] * len(params["start_indices"])
+    steps = g.const(np.asarray(strides, np.int64))
+    return g.node("Slice", [ins[0], starts, ends, axes, steps])
+
+
+@_register("rev")
+def _rev(g, ins, iav, oav, params):
+    dims = list(params["dimensions"])
+    starts = g.const(np.asarray([-1] * len(dims), np.int64))
+    ends = g.const(np.asarray([np.iinfo(np.int64).min] * len(dims),
+                              np.int64))
+    axes = g.const(np.asarray(dims, np.int64))
+    steps = g.const(np.asarray([-1] * len(dims), np.int64))
+    return g.node("Slice", [ins[0], starts, ends, axes, steps])
+
+
+@_register("reduce_sum")
+def _reduce_sum(g, ins, iav, oav, params):
+    axes = g.const(np.asarray(params["axes"], np.int64), "axes")
+    return g.node("ReduceSum", [ins[0], axes], keepdims=0)
+
+
+def _axes_attr_reduce(op):
+    def h(g, ins, iav, oav, params):
+        return g.node(op, ins, axes=[int(a) for a in params["axes"]],
+                      keepdims=0)
+    return h
+
+
+_HANDLERS["reduce_max"] = _axes_attr_reduce("ReduceMax")
+_HANDLERS["reduce_min"] = _axes_attr_reduce("ReduceMin")
+_HANDLERS["reduce_prod"] = _axes_attr_reduce("ReduceProd")
+
+
+@_register("argmax", "argmin")
+def _argminmax(g, ins, iav, oav, params):
+    op = "ArgMax" if params.get("_prim", "argmax") == "argmax" else "ArgMin"
+    (axis,) = params["axes"]
+    out = g.node(op, ins, axis=int(axis), keepdims=0)
+    want = _onnx_dtype(params["index_dtype"])
+    if want != ox.TensorProto.INT64:
+        out = g.node("Cast", [out], to=int(want))
+    return out
+
+
+@_register("cumsum")
+def _cumsum(g, ins, iav, oav, params):
+    ax = g.const(np.asarray(params["axis"], np.int64))
+    return g.node("CumSum", [ins[0], ax],
+                  reverse=int(bool(params.get("reverse", False))))
+
+
+@_register("dot_general")
+def _dot_general(g, ins, iav, oav, params):
+    (lc, rc), (lb, rb) = params["dimension_numbers"]
+    lrank, rrank = len(iav[0].shape), len(iav[1].shape)
+    letters = iter("abcdefghijklmnopqrstuvwxyz")
+    lhs = [None] * lrank
+    rhs = [None] * rrank
+    out = []
+    for li, ri in zip(lb, rb):                    # batch dims (shared)
+        c = next(letters)
+        lhs[li] = rhs[ri] = c
+        out.append(c)
+    for li, ri in zip(lc, rc):                    # contracting (shared)
+        c = next(letters)
+        lhs[li] = rhs[ri] = c
+    for i in range(lrank):                        # lhs free
+        if lhs[i] is None:
+            lhs[i] = next(letters)
+            out.append(lhs[i])
+    for i in range(rrank):                        # rhs free
+        if rhs[i] is None:
+            rhs[i] = next(letters)
+            out.append(rhs[i])
+    eq = f"{''.join(lhs)},{''.join(rhs)}->{''.join(out)}"
+    return g.node("Einsum", ins, equation=eq)
+
+
+@_register("conv_general_dilated")
+def _conv(g, ins, iav, oav, params):
+    dn = params["dimension_numbers"]
+    lhs_spec, rhs_spec, out_spec = dn
+    nd = len(iav[0].shape) - 2
+    if any(d != 1 for d in params["lhs_dilation"]):
+        raise UnsupportedOp("transposed/dilated-input conv")
+    if params.get("batch_group_count", 1) != 1:
+        raise UnsupportedOp("conv with batch_group_count != 1")
+    # specs give, for each component (N/C or O/I, then spatial...), its
+    # dim index in the respective tensor. Transpose perm semantics:
+    # out[k] = in[perm[k]], so normalizing to NC<sp>/OI<sp> uses the
+    # spec ITSELF as the perm.
+    x = ins[0]
+    if list(lhs_spec) != list(range(nd + 2)):
+        x = g.node("Transpose", [x], perm=[int(p) for p in lhs_spec])
+    w = ins[1]
+    if list(rhs_spec) != list(range(nd + 2)):
+        w = g.node("Transpose", [w], perm=[int(p) for p in rhs_spec])
+    pads_lo = [int(p[0]) for p in params["padding"]]
+    pads_hi = [int(p[1]) for p in params["padding"]]
+    y = g.node("Conv", [x, w],
+               strides=[int(s) for s in params["window_strides"]],
+               pads=pads_lo + pads_hi,
+               dilations=[int(d) for d in params["rhs_dilation"]],
+               group=int(params["feature_group_count"]))
+    if list(out_spec) != list(range(nd + 2)):
+        # y is NC<sp>; component k must land at dim out_spec[k], i.e.
+        # perm[out_spec[k]] = k — the inverse permutation of out_spec
+        y = g.node("Transpose", [y],
+                   perm=[int(p) for p in np.argsort(out_spec)])
+    return y
+
+
+@_register("gather")
+def _gather(g, ins, iav, oav, params):
+    # support the take/embedding pattern: gather along ONE operand axis
+    # with full slices on every other axis
+    dn = params["dimension_numbers"]
+    operand = iav[0]
+    slice_sizes = params["slice_sizes"]
+    collapsed = list(dn.collapsed_slice_dims)
+    start_map = list(dn.start_index_map)
+    if len(start_map) != 1 or collapsed != start_map:
+        raise UnsupportedOp("gather pattern beyond single-axis take")
+    axis = start_map[0]
+    for i, s in enumerate(slice_sizes):
+        if i != axis and s != operand.shape[i]:
+            raise UnsupportedOp("gather with partial slices")
+    if slice_sizes[axis] != 1:
+        raise UnsupportedOp("gather with slice span > 1")
+    # indices carry a trailing singleton index-vector dim: drop it
+    idx_aval = iav[1]
+    idx = ins[1]
+    if idx_aval.shape and idx_aval.shape[-1] == 1:
+        shp = g.const(np.asarray(idx_aval.shape[:-1], np.int64), "shape")
+        idx = g.node("Reshape", [idx, shp])
+    return g.node("Gather", [ins[0], idx], axis=int(axis))
+
+
+def _check_window_undilated(params):
+    for key in ("base_dilation", "window_dilation"):
+        if any(d != 1 for d in params.get(key) or ()):
+            raise UnsupportedOp(f"reduce_window with {key} != 1")
+
+
+@_register("reduce_window_max")
+def _maxpool(g, ins, iav, oav, params):
+    _check_window_undilated(params)
+    wd = list(params["window_dimensions"])
+    ws = list(params["window_strides"])
+    pad = params["padding"]
+    if wd[0] != 1 or wd[1] != 1 or ws[0] != 1 or ws[1] != 1:
+        raise UnsupportedOp("windowed reduce over non-spatial dims")
+    sp = len(wd) - 2
+    pads_lo = [int(p[0]) for p in pad[2:]]
+    pads_hi = [int(p[1]) for p in pad[2:]]
+    if any(p != (0, 0) for p in pad[:2]):
+        raise UnsupportedOp("padding on batch/channel dims")
+    return g.node("MaxPool", ins, kernel_shape=[int(k) for k in wd[2:]],
+                  strides=[int(s) for s in ws[2:]],
+                  pads=pads_lo + pads_hi)
+
+
+@_register("reduce_window_sum")
+def _sumpool(g, ins, iav, oav, params):
+    _check_window_undilated(params)
+    wd = list(params["window_dimensions"])
+    ws = list(params["window_strides"])
+    pad = params["padding"]
+    if wd[0] != 1 or wd[1] != 1 or ws[0] != 1 or ws[1] != 1:
+        raise UnsupportedOp("windowed reduce over non-spatial dims")
+    if any(p != (0, 0) for p in pad[:2]):
+        raise UnsupportedOp("padding on batch/channel dims")
+    pads_lo = [int(p[0]) for p in pad[2:]]
+    pads_hi = [int(p[1]) for p in pad[2:]]
+    # sum pool = AveragePool(count_include_pad) * window_size
+    y = g.node("AveragePool", ins,
+               kernel_shape=[int(k) for k in wd[2:]],
+               strides=[int(s) for s in ws[2:]],
+               pads=pads_lo + pads_hi, count_include_pad=1)
+    size = float(np.prod(wd[2:]))
+    c = g.const(np.asarray(size, np.dtype(iav[0].dtype)))
+    return g.node("Mul", [y, c])
+
+
+@_register("pad")
+def _pad(g, ins, iav, oav, params):
+    cfg = params["padding_config"]
+    if any(interior != 0 for _, _, interior in cfg):
+        raise UnsupportedOp("interior padding")
+    los = [int(lo) for lo, _, _ in cfg]
+    his = [int(hi) for _, hi, _ in cfg]
+    if any(v < 0 for v in los + his):
+        raise UnsupportedOp("negative padding")
+    pads = g.const(np.asarray(los + his, np.int64))
+    return g.node("Pad", [ins[0], pads, ins[1]])
+
+
+# -- the conversion driver ----------------------------------------------------
+_INLINE_CALLS = {"pjit", "jit", "closed_call", "custom_jvp_call",
+                 "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+                 "checkpoint", "custom_jvp_call_jaxpr"}
+
+
+def jaxpr_to_onnx(closed_jaxpr, input_names, graph_name="paddle_tpu"):
+    """Convert a ClosedJaxpr to a ModelProto. ``input_names`` label the
+    jaxpr invars (the graph inputs); constvars become initializers and
+    every eqn unreachable from the inputs is folded eagerly."""
+    g = _Graph()
+    jaxpr = closed_jaxpr.jaxpr
+    env = {}            # var -> ("sym", name) | ("const", ndarray)
+
+    for var, val in zip(jaxpr.constvars, closed_jaxpr.consts):
+        env[var] = ("const", np.asarray(val))
+    if len(input_names) != len(jaxpr.invars):
+        raise ValueError(f"{len(jaxpr.invars)} graph inputs, "
+                         f"{len(input_names)} names")
+    for var, name in zip(jaxpr.invars, input_names):
+        env[var] = ("sym", name)
+
+    def read(atom):
+        if isinstance(atom, jex_core.Literal):
+            return ("const", np.asarray(atom.val))
+        return env[atom]
+
+    def as_name(entry, aval, var=None):
+        kind, v = entry
+        if kind == "sym":
+            return v
+        name = g.const(np.asarray(v, np.dtype(aval.dtype)), "w")
+        if var is not None:
+            # a constvar referenced by N eqns must serialize ONCE, not N
+            # weight copies; flip the env entry to the materialized name
+            env[var] = ("sym", name)
+        return name
+
+    def walk(jaxpr_inner, consts_inner):
+        for var, val in zip(jaxpr_inner.constvars, consts_inner):
+            env[var] = ("const", np.asarray(val))
+        for eqn in jaxpr_inner.eqns:
+            prim = eqn.primitive.name
+            entries = [read(a) for a in eqn.invars]
+            if prim in _INLINE_CALLS:
+                sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+                if hasattr(sub, "jaxpr"):        # ClosedJaxpr
+                    sub_consts = sub.consts
+                    sub = sub.jaxpr
+                else:
+                    sub_consts = ()
+                for v_in, entry in zip(sub.invars, entries):
+                    env[v_in] = entry
+                walk(sub, sub_consts)
+                for v_out, v_sub in zip(eqn.outvars, sub.outvars):
+                    env[v_out] = read(v_sub)
+                continue
+            if all(k == "const" for k, _ in entries):
+                vals = [jnp.asarray(v) for _, v in entries]
+                out = eqn.primitive.bind(*vals, **eqn.params)
+                outs = out if eqn.primitive.multiple_results else [out]
+                for v, o in zip(eqn.outvars, outs):
+                    env[v] = ("const", np.asarray(o))
+                continue
+            handler = _HANDLERS.get(prim)
+            if handler is None:
+                raise UnsupportedOp(
+                    f"primitive '{prim}' has no ONNX mapping")
+            in_names = [as_name(e, a.aval,
+                                var=None if isinstance(a, jex_core.Literal)
+                                else a)
+                        for e, a in zip(entries, eqn.invars)]
+            in_avals = [a.aval for a in eqn.invars]
+            out_avals = [v.aval for v in eqn.outvars]
+            params = dict(eqn.params)
+            if prim in ("argmax", "argmin"):
+                params["_prim"] = prim
+            res = handler(g, in_names, in_avals, out_avals, params)
+            results = res if isinstance(res, list) else [res]
+            for v, name in zip(eqn.outvars, results):
+                env[v] = ("sym", name)
+
+    walk(jaxpr, closed_jaxpr.consts)
+
+    model = ox.ModelProto(ir_version=8, producer_name="paddle_tpu",
+                          producer_version="0.3")
+    model.opset_import.add(domain="", version=OPSET)
+    graph = model.graph
+    graph.name = graph_name
+    for var, name in zip(jaxpr.invars, input_names):
+        graph.input.append(_value_info(name, var.aval.shape,
+                                       var.aval.dtype))
+    out_names = []
+    for i, var in enumerate(jaxpr.outvars):
+        entry = read(var)
+        name = as_name(entry, var.aval)
+        if entry[0] == "const" or name in out_names:
+            name = g.node("Identity", [name])
+        out_names.append(name)
+        graph.output.append(_value_info(name, var.aval.shape,
+                                        var.aval.dtype))
+    graph.node.extend(g.nodes)
+    for name, arr in g.initializers.items():
+        graph.initializer.append(_tensor_proto(name, arr))
+    return model

@@ -1,0 +1,256 @@
+"""Minimal numpy evaluator for the ONNX subset the exporter emits.
+
+onnxruntime is not shipped in this environment, so tests verify exported
+models by executing them here and comparing against the original jax
+function. Semantics follow the ONNX operator spec (opset 17) for exactly
+the ops in exporter._HANDLERS.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from . import onnx_pb2 as ox
+
+_NP_DTYPES = {
+    ox.TensorProto.FLOAT: np.float32, ox.TensorProto.DOUBLE: np.float64,
+    ox.TensorProto.FLOAT16: np.float16, ox.TensorProto.INT64: np.int64,
+    ox.TensorProto.INT32: np.int32, ox.TensorProto.INT16: np.int16,
+    ox.TensorProto.INT8: np.int8, ox.TensorProto.UINT8: np.uint8,
+    ox.TensorProto.UINT32: np.uint32, ox.TensorProto.UINT64: np.uint64,
+    ox.TensorProto.BOOL: np.bool_,
+}
+
+
+def tensor_to_numpy(tp: "ox.TensorProto") -> np.ndarray:
+    if tp.data_type == ox.TensorProto.BFLOAT16:
+        import ml_dtypes
+        arr = np.frombuffer(tp.raw_data, np.uint16).view(ml_dtypes.bfloat16)
+    else:
+        arr = np.frombuffer(tp.raw_data, _NP_DTYPES[tp.data_type])
+    return arr.reshape(list(tp.dims)).copy()
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == ox.AttributeProto.INT:
+            out[a.name] = int(a.i)
+        elif a.type == ox.AttributeProto.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == ox.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == ox.AttributeProto.INTS:
+            out[a.name] = [int(v) for v in a.ints]
+    return out
+
+
+def _pool(x, kernel, strides, pads, mode, count_include_pad=False):
+    sp = len(kernel)
+    lo, hi = pads[:sp], pads[sp:]
+    pad_width = [(0, 0), (0, 0)] + [(lo[i], hi[i]) for i in range(sp)]
+    fill = 0.0 if (mode == "avg" and count_include_pad) else (
+        -np.inf if mode == "max" else np.nan)
+    xp = np.pad(x.astype(np.float64), pad_width, constant_values=fill)
+    win = np.lib.stride_tricks.sliding_window_view(
+        xp, tuple(kernel), axis=tuple(range(2, 2 + sp)))
+    idx = (slice(None), slice(None)) + tuple(
+        slice(None, None, s) for s in strides)
+    win = win[idx]
+    red_axes = tuple(range(win.ndim - sp, win.ndim))
+    if mode == "max":
+        out = win.max(axis=red_axes)
+    else:
+        out = np.nanmean(win, axis=red_axes) if not count_include_pad \
+            else win.mean(axis=red_axes)
+    return out.astype(x.dtype)
+
+
+def run_model(model: "ox.ModelProto", feeds: dict) -> list:
+    env = dict(feeds)
+    for init in model.graph.initializer:
+        env[init.name] = tensor_to_numpy(init)
+
+    def conv(x, w, at):
+        group = at.get("group", 1)
+        strides = at["strides"]
+        dil = at.get("dilations", [1] * len(strides))
+        sp = len(strides)
+        lo, hi = at["pads"][:sp], at["pads"][sp:]
+        xp = np.pad(x, [(0, 0), (0, 0)] + [(lo[i], hi[i])
+                                           for i in range(sp)])
+        N, C = xp.shape[0], xp.shape[1]
+        O = w.shape[0]
+        kernel = w.shape[2:]
+        eff_k = [dil[i] * (kernel[i] - 1) + 1 for i in range(sp)]
+        out_sp = [(xp.shape[2 + i] - eff_k[i]) // strides[i] + 1
+                  for i in range(sp)]
+        out = np.zeros((N, O) + tuple(out_sp), np.float64)
+        cin_g = C // group
+        o_g = O // group
+        # im2col per group
+        for gi in range(group):
+            xg = xp[:, gi * cin_g:(gi + 1) * cin_g]
+            wg = w[gi * o_g:(gi + 1) * o_g]
+            win = np.lib.stride_tricks.sliding_window_view(
+                xg, tuple(eff_k), axis=tuple(range(2, 2 + sp)))
+            idx = (slice(None), slice(None)) + tuple(
+                slice(None, None, strides[i]) for i in range(sp)) + tuple(
+                slice(None, None, dil[i]) for i in range(sp))
+            win = win[idx]            # [N, Cg, *out_sp, *kernel]
+            o_label = 2 + 2 * sp     # einsum int labels must be < 52
+            out[:, gi * o_g:(gi + 1) * o_g] = np.einsum(
+                win, [0, 1] + list(range(2, 2 + sp))
+                + list(range(2 + sp, 2 + 2 * sp)),
+                wg, [o_label, 1] + list(range(2 + sp, 2 + 2 * sp)),
+                [0, o_label] + list(range(2, 2 + sp)))
+        return out.astype(x.dtype)
+
+    for node in model.graph.node:
+        ins = [env[i] for i in node.input]
+        at = _attrs(node)
+        op = node.op_type
+        if op == "Add":
+            out = ins[0] + ins[1]
+        elif op == "Sub":
+            out = ins[0] - ins[1]
+        elif op == "Mul":
+            out = ins[0] * ins[1]
+        elif op == "Div":
+            out = ins[0] / ins[1]
+        elif op == "Max":
+            out = np.maximum(ins[0], ins[1])
+        elif op == "Min":
+            out = np.minimum(ins[0], ins[1])
+        elif op == "Pow":
+            out = np.power(ins[0], ins[1]).astype(ins[0].dtype)
+        elif op == "Neg":
+            out = -ins[0]
+        elif op == "Exp":
+            out = np.exp(ins[0])
+        elif op == "Log":
+            out = np.log(ins[0])
+        elif op == "Tanh":
+            out = np.tanh(ins[0])
+        elif op == "Sigmoid":
+            out = 1.0 / (1.0 + np.exp(-ins[0].astype(np.float64)))
+            out = out.astype(ins[0].dtype)
+        elif op == "Erf":
+            out = np.vectorize(math.erf)(
+                ins[0].astype(np.float64)).astype(ins[0].dtype)
+        elif op == "Sqrt":
+            out = np.sqrt(ins[0])
+        elif op == "Reciprocal":
+            out = 1.0 / ins[0]
+        elif op == "Abs":
+            out = np.abs(ins[0])
+        elif op == "Sign":
+            out = np.sign(ins[0])
+        elif op == "Floor":
+            out = np.floor(ins[0])
+        elif op == "Ceil":
+            out = np.ceil(ins[0])
+        elif op == "Round":
+            out = np.round(ins[0])
+        elif op in ("Sin", "Cos", "Tan", "Arcsin", "Arccos", "Arctan",
+                    "Sinh", "Cosh", "Arcsinh", "Arccosh", "Arctanh"):
+            out = getattr(np, op.lower())(ins[0])
+        elif op in ("Asin", "Acos", "Atan", "Asinh", "Acosh", "Atanh"):
+            out = getattr(np, "arc" + op[1:].lower())(ins[0])
+        elif op == "Not":
+            out = ~ins[0]
+        elif op == "And":
+            out = ins[0] & ins[1]
+        elif op == "Or":
+            out = ins[0] | ins[1]
+        elif op == "Xor":
+            out = ins[0] ^ ins[1]
+        elif op == "Equal":
+            out = ins[0] == ins[1]
+        elif op == "Less":
+            out = ins[0] < ins[1]
+        elif op == "LessOrEqual":
+            out = ins[0] <= ins[1]
+        elif op == "Greater":
+            out = ins[0] > ins[1]
+        elif op == "GreaterOrEqual":
+            out = ins[0] >= ins[1]
+        elif op == "IsInf":
+            out = np.isinf(ins[0])
+        elif op == "IsNaN":
+            out = np.isnan(ins[0])
+        elif op == "Where":
+            out = np.where(ins[0], ins[1], ins[2])
+        elif op == "Clip":
+            out = np.clip(ins[0], ins[1], ins[2])
+        elif op == "Cast":
+            out = ins[0].astype(_NP_DTYPES[at["to"]])
+        elif op == "Identity":
+            out = ins[0]
+        elif op == "Reshape":
+            out = ins[0].reshape([int(d) for d in ins[1]])
+        elif op == "Transpose":
+            out = np.transpose(ins[0], at["perm"])
+        elif op == "Expand":
+            out = np.broadcast_to(
+                ins[0], np.broadcast_shapes(ins[0].shape,
+                                            tuple(int(d) for d in ins[1])))
+        elif op == "Concat":
+            out = np.concatenate(ins, axis=at["axis"])
+        elif op == "Slice":
+            starts, ends, axes, steps = (ins[1].tolist(), ins[2].tolist(),
+                                         ins[3].tolist(), ins[4].tolist())
+            sl = [slice(None)] * ins[0].ndim
+            for s, e, a, st in zip(starts, ends, axes, steps):
+                e = None if (st < 0 and e == np.iinfo(np.int64).min) else e
+                sl[a] = slice(s, e, st)
+            out = ins[0][tuple(sl)]
+        elif op == "ReduceSum":
+            out = ins[0].sum(axis=tuple(int(a) for a in ins[1]),
+                             keepdims=bool(at.get("keepdims", 1)))
+        elif op == "ReduceMax":
+            out = ins[0].max(axis=tuple(at["axes"]),
+                             keepdims=bool(at.get("keepdims", 1)))
+        elif op == "ReduceMin":
+            out = ins[0].min(axis=tuple(at["axes"]),
+                             keepdims=bool(at.get("keepdims", 1)))
+        elif op == "ReduceProd":
+            out = ins[0].prod(axis=tuple(at["axes"]),
+                              keepdims=bool(at.get("keepdims", 1)))
+        elif op == "ArgMax":
+            out = np.argmax(ins[0], axis=at["axis"]).astype(np.int64)
+        elif op == "ArgMin":
+            out = np.argmin(ins[0], axis=at["axis"]).astype(np.int64)
+        elif op == "CumSum":
+            ax = int(ins[1])
+            if at.get("reverse", 0):
+                out = np.flip(np.cumsum(np.flip(ins[0], ax), axis=ax), ax)
+            else:
+                out = np.cumsum(ins[0], axis=ax)
+        elif op == "Einsum":
+            out = np.einsum(at["equation"], *ins)
+        elif op == "Gather":
+            out = np.take(ins[0], ins[1].astype(np.int64),
+                          axis=at.get("axis", 0))
+        elif op == "Conv":
+            out = conv(ins[0], ins[1], at)
+        elif op == "MaxPool":
+            out = _pool(ins[0], at["kernel_shape"], at["strides"],
+                        at.get("pads", [0] * 2 * len(at["kernel_shape"])),
+                        "max")
+        elif op == "AveragePool":
+            out = _pool(ins[0], at["kernel_shape"], at["strides"],
+                        at.get("pads", [0] * 2 * len(at["kernel_shape"])),
+                        "avg",
+                        count_include_pad=bool(at.get("count_include_pad",
+                                                      0)))
+        elif op == "Pad":
+            cfg = ins[1].tolist()
+            nd = ins[0].ndim
+            out = np.pad(ins[0], [(cfg[i], cfg[nd + i]) for i in range(nd)],
+                         constant_values=ins[2] if len(ins) > 2 else 0)
+        else:
+            raise NotImplementedError(f"runner: op {op}")
+        env[node.output[0]] = np.asarray(out)
+    return [env[o.name] for o in model.graph.output]
