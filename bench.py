@@ -239,7 +239,8 @@ def bench_kernels():
     from paddle_tpu.ops.pallas.paged_attention import (
         paged_attention_decode_pallas)
     from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
-    from paddle_tpu.ops.pallas.norms import rms_norm_pallas
+    from paddle_tpu.ops.pallas.norms import (layer_norm_pallas,
+                                             rms_norm_pallas)
 
     interp = interpret_mode()
     res = {"interpret": bool(interp),
@@ -406,6 +407,23 @@ def bench_kernels():
     record("rms_norm", jax.jit(rms_norm_pallas), jax.jit(ref_rms),
            X, W, tol=3e-2)
 
+    LW = jax.random.normal(qk[2], (X.shape[-1],), jnp.bfloat16)
+    LB = jax.random.normal(qk[3], (X.shape[-1],), jnp.bfloat16)
+
+    def ref_ln(x, w, b):
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + 1e-5)
+                * w.astype(jnp.float32)
+                + b.astype(jnp.float32)).astype(x.dtype)
+
+    # random weight/bias exercise the affine path; outputs of magnitude
+    # ~4-8 differ from the reference by 1-2 bf16 ulps (f32 op order), so
+    # the tolerance is 2 ulps at that magnitude
+    record("layer_norm", jax.jit(layer_norm_pallas), jax.jit(ref_ln),
+           X, LW, LB, tol=6.5e-2)
+
     n_ok = sum(1 for c in res["cases"].values() if c.get("ok"))
     res.update(metric="pallas_kernels_ok", value=n_ok,
                unit=f"of {len(res['cases'])} kernels", )
@@ -469,10 +487,17 @@ def _run_child(name):
 def _spawn(name, timeout):
     """Run one config in a subprocess; return its parsed JSON or an error
     dict. Never raises, never hangs past `timeout`."""
+    env = dict(os.environ)
+    # sweep Pallas block configs on the chip; the winner persists in
+    # ~/.cache/paddle_tpu/autotune.json, so the sweep cost is paid once
+    # across all child configs (BENCH_AUTOTUNE=0 opts out)
+    if os.environ.get("BENCH_AUTOTUNE", "1").lower() not in (
+            "0", "false", "no"):
+        env.setdefault("FLAGS_kernel_autotune", "1")
     try:
         p = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--config", name],
-            capture_output=True, text=True, timeout=timeout,
+            capture_output=True, text=True, timeout=timeout, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         return {"error": f"timeout after {timeout}s (tunnel wedge or "

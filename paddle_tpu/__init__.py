@@ -126,6 +126,10 @@ e = _np.e
 inf = _np.inf
 nan = _np.nan
 newaxis = None
+# dtype sentinels with no dense-kernel backing (reference
+# framework/dtype.py:67 maps them to VarDesc.VarType entries)
+pstring = "pstring"
+raw = "raw"
 
 
 def _patch_round3_methods():
