@@ -327,6 +327,19 @@ def bench_kernels():
     def loss_r(q, k, v):
         return ref_attn(q, k, v, causal=True).astype(jnp.float32).sum()
 
+    seed_dp = jnp.asarray(7, jnp.uint32)
+
+    def ref_attn_dropout(q, k, v):
+        from paddle_tpu.ops.flash_attention import _ref_attention
+        return _ref_attention(q, k, v, causal=True, dropout_rate=0.2,
+                              dropout_seed=seed_dp)
+
+    record("flash_dropout",
+           jax.jit(lambda q, k, v: flash_attention_pallas(
+               q, k, v, causal=True, dropout_rate=0.2,
+               dropout_seed=seed_dp)),
+           jax.jit(ref_attn_dropout), q, k, v, tol=3e-2)
+
     record("flash_bwd_dq",
            jax.jit(lambda q, k, v: jax.grad(loss_p, 0)(q, k, v)),
            jax.jit(lambda q, k, v: jax.grad(loss_r, 0)(q, k, v)),

@@ -15,12 +15,6 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor, dispatch
 from ...core.flags import GLOBAL_FLAGS
 
-# flash_attn_unpadded dropout fallback: query-block size for the chunked
-# score materialization, and the once-per-process warning latch.
-_DROPOUT_CHUNK = 512
-_DROPOUT_FALLBACK_WARNED = False
-
-
 def _ensure(x):
     return x if isinstance(x, Tensor) else Tensor(x)
 
@@ -59,13 +53,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if attn_mask is not None:
         args.append(_ensure(attn_mask))
 
-    use_fused = (GLOBAL_FLAGS.get("use_fused_kernels") and dropout_p == 0.0)
+    use_fused = GLOBAL_FLAGS.get("use_fused_kernels")
+    rate = dropout_p if (dropout_p and training) else 0.0
 
     def f(q, k, v, *m):
         mask = m[0] if m else None
         if use_fused and mask is None:
+            # dropout rides in-kernel (position-keyed hash mask)
             from ...ops import flash_attention as fa
-            return fa.flash_attention(q, k, v, causal=is_causal)
+            return fa.flash_attention(q, k, v, causal=is_causal,
+                                      dropout_rate=rate)
         return _sdpa_ref(q, k, v, mask, dropout_p, is_causal, training)
     return dispatch(f, tuple(args), name="scaled_dot_product_attention")
 
@@ -121,67 +118,18 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                 "flash_attn_unpadded(causal=True) requires "
                 "cu_seqlens_q == cu_seqlens_k (self-attention packing)")
 
-    if use_dropout:
-        global _DROPOUT_FALLBACK_WARNED
-        if not _DROPOUT_FALLBACK_WARNED:
-            _DROPOUT_FALLBACK_WARNED = True
-            import warnings
-            warnings.warn(
-                "flash_attn_unpadded with dropout falls back to a chunked "
-                "XLA composition (the fused kernel has no in-kernel RNG): "
-                "scores are materialized per query block of "
-                f"{_DROPOUT_CHUNK} rows instead of fully fused. Expect "
-                "lower throughput than dropout=0. This warning fires once "
-                "per process.", stacklevel=2)
-
     def f(q, k, v, cq, ck):
         tq, tk = q.shape[0], k.shape[0]
         seg_q = segment_ids_from_cu_seqlens(cq, tq)[None]
         seg_k = segment_ids_from_cu_seqlens(ck, tk)[None]
-        if not use_dropout:
-            out = _fa(q[None], k[None], v[None], causal=causal, scale=scale,
-                      segment_ids=seg_q, kv_segment_ids=seg_k)
-            return out[0]
-        # dropout path: the fused kernel has no in-kernel RNG, so fall
-        # back to the XLA composition with the same segment/causal mask
-        # (reference keeps dropout inside flash_attn_kernel.cu via a
-        # Philox offset). Chunked over query blocks so peak memory is
-        # O(heads * chunk * tk) fp32, not the full [tq, tk] score matrix.
-        from ...core.random import next_key
-        s = scale if scale is not None else q.shape[-1] ** -0.5
-        h, d = q.shape[1], q.shape[2]
-        kf = jnp.swapaxes(k, 0, 1).astype(jnp.float32)        # [h, tk, d]
-        vf = jnp.swapaxes(v, 0, 1).astype(jnp.float32)
-        bq = min(_DROPOUT_CHUNK, tq)
-        pad = (-tq) % bq
-        nq = (tq + pad) // bq
-        # Padded rows carry segment id -1 (matches nothing, seg ids >= 0):
-        # their logits are all -1e30 -> softmax is uniform (finite, no
-        # NaN) and the rows are sliced off below.
-        qp = jnp.pad(jnp.swapaxes(q, 0, 1).astype(jnp.float32) * s,
-                     ((0, 0), (0, pad), (0, 0)))              # [h, tqp, d]
-        segq = jnp.pad(seg_q[0], (0, pad), constant_values=-1)
-        qc = qp.reshape(h, nq, bq, d).transpose(1, 0, 2, 3)   # [nq,h,bq,d]
-        segc = segq.reshape(nq, bq)
-        posc = jnp.arange(nq * bq).reshape(nq, bq)
-        keys = jax.random.split(next_key(), nq)
-        kpos = jnp.arange(tk)
-
-        def one_chunk(_, xs):
-            qi, sgi, pi, ki = xs
-            lg = jnp.einsum("hqd,hkd->hqk", qi, kf)
-            m = sgi[:, None] == seg_k[0][None, :]
-            if causal:
-                m &= pi[:, None] >= kpos[None, :]
-            lg = jnp.where(m[None], lg, -1e30)
-            p = jax.nn.softmax(lg, axis=-1)
-            keep = jax.random.bernoulli(ki, 1.0 - dropout, p.shape)
-            p = jnp.where(keep, p / (1.0 - dropout), 0.0)
-            return None, jnp.einsum("hqk,hkd->hqd", p, vf)
-
-        _, outc = jax.lax.scan(one_chunk, None, (qc, segc, posc, keys))
-        out = outc.transpose(0, 2, 1, 3).reshape(nq * bq, h, d)[:tq]
-        return out.astype(q.dtype)
+        # dropout rides INSIDE the fused kernel (position-keyed hash
+        # mask regenerated by the backward kernels, reference
+        # flash_attn_kernel.cu Philox path); 0 disables it statically
+        rate = dropout if use_dropout else 0.0
+        out = _fa(q[None], k[None], v[None], causal=causal, scale=scale,
+                  segment_ids=seg_q, kv_segment_ids=seg_k,
+                  dropout_rate=rate)
+        return out[0]
 
     args = tuple(_ensure(a) for a in
                  (query, key, value, cu_seqlens_q, cu_seqlens_k))

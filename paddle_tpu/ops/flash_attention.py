@@ -18,7 +18,8 @@ import jax.numpy as jnp
 
 
 def _ref_attention(q, k, v, causal=False, scale=None, bias=None,
-                   segment_ids=None, kv_segment_ids=None):
+                   segment_ids=None, kv_segment_ids=None,
+                   dropout_rate=0.0, dropout_seed=None):
     d = q.shape[-1]
     h, kvh = q.shape[2], k.shape[2]
     if kvh != h:
@@ -41,6 +42,18 @@ def _ref_attention(q, k, v, causal=False, scale=None, bias=None,
                        kv_seg[:, None, None, :])
     logits = jnp.where(mask, logits, -1e30)
     p = jax.nn.softmax(logits, axis=-1)
+    if dropout_rate and dropout_rate > 0.0:
+        # EXACT same position-keyed hash mask as the Pallas kernels (one
+        # "block" spanning the full matrix), so ref and kernel agree
+        # bit-for-mask under a shared seed
+        from .pallas.flash_attention import _dropout_keep
+        b = q.shape[0]
+        seed = jnp.asarray(dropout_seed, jnp.uint32)
+        bh = jnp.arange(b * h, dtype=jnp.int32)
+        keep = jax.vmap(lambda i: _dropout_keep(
+            seed, i, jnp.int32(0), jnp.int32(0), ql, kl,
+            float(dropout_rate)))(bh).reshape(b, h, ql, kl)
+        p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
     # rows with no valid key (segment padding) must yield 0, not uniform avg
     if segment_ids is not None:
@@ -50,16 +63,24 @@ def _ref_attention(q, k, v, causal=False, scale=None, bias=None,
 
 
 def flash_attention(q, k, v, causal=False, scale=None, bias=None,
-                    segment_ids=None, kv_segment_ids=None, bias_grad=False):
+                    segment_ids=None, kv_segment_ids=None, bias_grad=False,
+                    dropout_rate=0.0, dropout_seed=None):
     if bias is not None and not bias_grad:
         bias = jax.lax.stop_gradient(bias)
+    if dropout_rate and dropout_rate > 0.0 and dropout_seed is None:
+        # draw once here so the pallas path and any ref fallback of the
+        # SAME call share one seed
+        from ..core.random import next_key
+        dropout_seed = jax.random.randint(
+            next_key(), (), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
     if jax.default_backend() in ("tpu", "axon"):
         try:
             from .pallas.flash_attention import flash_attention_pallas
             return flash_attention_pallas(
                 q, k, v, causal=causal, scale=scale, bias=bias,
                 segment_ids=segment_ids, kv_segment_ids=kv_segment_ids,
-                bias_grad=bias_grad)
+                bias_grad=bias_grad, dropout_rate=dropout_rate,
+                dropout_seed=dropout_seed)
         except ImportError:
             pass
         except Exception as e:  # noqa: BLE001
@@ -67,7 +88,9 @@ def flash_attention(q, k, v, causal=False, scale=None, bias=None,
             _warn_fallback("flash_attention", e)
     return _ref_attention(q, k, v, causal=causal, scale=scale, bias=bias,
                           segment_ids=segment_ids,
-                          kv_segment_ids=kv_segment_ids)
+                          kv_segment_ids=kv_segment_ids,
+                          dropout_rate=dropout_rate,
+                          dropout_seed=dropout_seed)
 
 
 def segment_ids_from_cu_seqlens(cu_seqlens, total: int):

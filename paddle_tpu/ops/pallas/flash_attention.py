@@ -81,10 +81,36 @@ def _mask(s, qi, ki, bq, bk, causal, seg_q, seg_k, off=0):
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
+def _dropout_keep(seed, qbh, qi, ki, bq, bk, rate):
+    """[bq, bk] keep mask from a counter-based hash (murmur3 finalizer)
+    of the ABSOLUTE (query-head, q position, k position) coordinates.
+
+    The forward and BOTH backward kernels regenerate the identical mask
+    from the same (seed, coordinates) — no cross-kernel RNG state, and
+    unlike pltpu.prng_* it also runs in interpret mode on CPU. The
+    per-element dropout decision is position-keyed, so it is invariant
+    to block-size autotuning."""
+    qpos = (qi * bq
+            + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0))
+    kpos = (ki * bk
+            + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1))
+    x = (qpos.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         ^ kpos.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+         ^ (seed.astype(jnp.uint32)
+            + qbh.astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D)))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    u = (x >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+    return u >= rate
+
+
 def _fwd_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
-                off):
+                off, dropout=0.0):
     i = 3
-    bias_ref = seg_q_ref = seg_k_ref = None
+    bias_ref = seg_q_ref = seg_k_ref = seed_ref = None
     q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
     if has_bias:
         bias_ref = refs[i]
@@ -92,9 +118,13 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
     if has_seg:
         seg_q_ref, seg_k_ref = refs[i], refs[i + 1]
         i += 2
+    if dropout > 0.0:
+        seed_ref = refs[i]
+        i += 1
     o_ref, lse_ref, m_scr, l_scr, acc_scr = refs[i:i + 5]
 
-    qi = pl.program_id(1)
+    bh_id = pl.program_id(0)   # hoisted: program_id is not legal inside
+    qi = pl.program_id(1)      # the pl.when branch in interpret mode
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -127,9 +157,16 @@ def _fwd_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
         if valid is not None:
             p = jnp.where(valid, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+        # normalizer uses PRE-dropout probabilities (dropout applies
+        # after softmax, reference flash_attn_kernel.cu semantics)
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        p_acc = p
+        if dropout > 0.0:
+            keep = _dropout_keep(seed_ref[0], bh_id, qi, ki,
+                                 bq, bk, dropout)
+            p_acc = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout))
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p_acc.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = m_new
         l_scr[:] = l_new
@@ -181,15 +218,24 @@ def _seg_specs(h, bq, bk, causal, clamp_k=True, off=0):
             pl.BlockSpec((None, 1, bk), k_idx))
 
 
+def _unpack_meta(meta):
+    """meta = (h, kvh, bias_b, bias_h, bias_grad[, blocks[, dropout]])
+    -> (h, kvh, bias_b, bias_h, blocks, dropout)."""
+    h, kvh, bias_b, bias_h = meta[0], meta[1], meta[2], meta[3]
+    blocks = meta[5] if len(meta) >= 6 else None
+    dropout = meta[6] if len(meta) >= 7 else 0.0
+    return h, kvh, bias_b, bias_h, blocks, dropout
+
+
 @no_x64
-def _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta):
+def _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta, seed=None):
     """q: [bh, sq, d]; k/v: [bkvh, sk, d] → (o [bh, sq, d], lse [bh, sq]).
     bias: [bias_bh, sq, sk] or None; seg_q/seg_k: [b, 1, s] int32 or None.
-    meta = (h, kvh, bias_b, bias_h, bias_grad) — static geometry."""
+    meta = (h, kvh, bias_b, bias_h, bias_grad[, blocks[, dropout]]) —
+    static geometry; ``seed`` [1] uint32 feeds the in-kernel dropout."""
     bh, sq, d = q.shape
     sk = k.shape[1]
-    h, kvh, bias_b, bias_h, _, blocks = (meta if len(meta) == 6
-                                         else meta + (None,))
+    h, kvh, bias_b, bias_h, blocks, dropout = _unpack_meta(meta)
     bq, bk = _block_sizes(sq, sk, blocks)
     off = sk - sq
     grid = (bh, pl.cdiv(sq, bq), pl.cdiv(sk, bk))
@@ -210,10 +256,13 @@ def _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta):
         sq_spec, sk_spec = _seg_specs(h, bq, bk, causal, off=off)
         in_specs += [sq_spec, sk_spec]
         args += [seg_q, seg_k]
+    if dropout > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, has_seg=has_seg,
-                               has_bias=has_bias, off=off)
+                               has_bias=has_bias, off=off, dropout=dropout)
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -240,9 +289,9 @@ def _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta):
 # backward
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
-                   has_dbias, off):
+                   has_dbias, off, dropout=0.0):
     i = 3
-    bias_ref = seg_q_ref = seg_k_ref = None
+    bias_ref = seg_q_ref = seg_k_ref = seed_ref = None
     q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
     if has_bias:
         bias_ref = refs[i]
@@ -250,6 +299,9 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
     if has_seg:
         seg_q_ref, seg_k_ref = refs[i], refs[i + 1]
         i += 2
+    if dropout > 0.0:
+        seed_ref = refs[i]
+        i += 1
     do_ref, lse_ref, delta_ref = refs[i:i + 3]
     i += 3
     if has_dbias:
@@ -258,6 +310,7 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
         dq_ref, dq_scr = refs[i:i + 2]
         dbias_ref = None
 
+    bh_id = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -290,6 +343,12 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            # O = (D o P) V with D = keep/(1-r): dP = D o (dO V^T); the
+            # delta trick still holds since rowsum(P o dP) = rowsum(dO o O)
+            keep = _dropout_keep(seed_ref[0], bh_id, qi, ki,
+                                 bq, bk, dropout)
+            dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout))
         ds = p * (dp - delta)  # dbias (pre-scale)
         if dbias_ref is not None:
             dbias_ref[0, :, :] = ds.astype(dbias_ref.dtype)
@@ -311,9 +370,9 @@ def _bwd_dq_kernel(*refs, scale, causal, bq, bk, has_seg, has_bias,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, groups, has_seg,
-                    has_bias, off):
+                    has_bias, off, dropout=0.0, h=0, kvh=0):
     i = 3
-    bias_ref = seg_q_ref = seg_k_ref = None
+    bias_ref = seg_q_ref = seg_k_ref = seed_ref = None
     q_ref, k_ref, v_ref = refs[0], refs[1], refs[2]
     if has_bias:
         bias_ref = refs[i]
@@ -321,10 +380,14 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, groups, has_seg,
     if has_seg:
         seg_q_ref, seg_k_ref = refs[i], refs[i + 1]
         i += 2
+    if dropout > 0.0:
+        seed_ref = refs[i]
+        i += 1
     do_ref, lse_ref, delta_ref = refs[i:i + 3]
     i += 3
     dk_ref, dv_ref, dk_scr, dv_scr = refs[i:i + 4]
 
+    bkv_id = pl.program_id(0)
     ki = pl.program_id(1)
     t = pl.program_id(2)          # t = g * nq + qi
     qi = t % nq
@@ -356,12 +419,22 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, groups, has_seg,
         p = jnp.exp(s - lse)  # [bq, bk]
         if valid is not None:
             p = jnp.where(valid, p, 0.0)
-        dv_scr[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        p_v = p
         dp = jax.lax.dot_general(do, v.astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            # same mask as the forward: query-head index reconstructed
+            # from the kv-head grid (bkv_id over B*kvh, group g = t // nq)
+            qbh = (bkv_id // kvh) * h + (bkv_id % kvh) * groups + t // nq
+            keep = _dropout_keep(seed_ref[0], qbh, qi, ki, bq, bk,
+                                 dropout)
+            inv = 1.0 / (1.0 - dropout)
+            p_v = jnp.where(keep, p, 0.0) * inv   # dV sees D o P
+            dp = jnp.where(keep, dp, 0.0) * inv   # dP = D o (dO V^T)
+        dv_scr[:] += jax.lax.dot_general(
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale  # [bq, bk]
         dk_scr[:] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -374,11 +447,12 @@ def _bwd_dkv_kernel(*refs, scale, causal, bq, bk, nq, groups, has_seg,
 
 
 @no_x64
-def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
+def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal,
+              meta, seed=None):
     bh, sq, d = q.shape
     bkvh, sk, _ = k.shape
-    h, kvh, bias_b, bias_h, bias_grad, blocks = (meta if len(meta) == 6
-                                                 else meta + (None,))
+    h, kvh, bias_b, bias_h, blocks, dropout = _unpack_meta(meta)
+    bias_grad = meta[4]
     bq, bk = _block_sizes(sq, sk, blocks)
     off = sk - sq
     groups = h // kvh
@@ -409,6 +483,9 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
         sq_spec, sk_spec = _seg_specs(h, bq, bk, causal, off=off)
         in_specs += [sq_spec, sk_spec]
         args += [seg_q, seg_k]
+    if dropout > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
     in_specs += [
         pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
@@ -426,7 +503,7 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
     res = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, has_seg=has_seg, has_bias=has_bias,
-                          has_dbias=has_dbias, off=off),
+                          has_dbias=has_dbias, off=off, dropout=dropout),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -488,6 +565,9 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
         in_specs2 += [pl.BlockSpec((None, 1, bq), seg_q_idx),
                       pl.BlockSpec((None, 1, bk), seg_k_idx)]
         args2 += [seg_q, seg_k]
+    if dropout > 0.0:
+        in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args2.append(seed)
     in_specs2 += [
         pl.BlockSpec((1, bq, d), q_row),
         pl.BlockSpec((1, 1, bq), stat_row),
@@ -498,7 +578,8 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, groups=groups,
-                          has_seg=has_seg, has_bias=has_bias, off=off),
+                          has_seg=has_seg, has_bias=has_bias, off=off,
+                          dropout=dropout, h=h, kvh=kvh),
         grid=(bkvh, nk, groups * nq),
         in_specs=in_specs2,
         out_specs=[
@@ -516,21 +597,24 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal, meta):
     return dq, dk, dv, dbias_full
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
-def _flash(q, k, v, bias, seg_q, seg_k, scale, causal, meta):
-    o, _ = _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def _flash(q, k, v, bias, seg_q, seg_k, seed, scale, causal, meta):
+    o, _ = _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta,
+                seed=seed)
     return o
 
 
-def _flash_fwd_rule(q, k, v, bias, seg_q, seg_k, scale, causal, meta):
-    o, lse = _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta)
-    return o, (q, k, v, bias, seg_q, seg_k, o, lse)
+def _flash_fwd_rule(q, k, v, bias, seg_q, seg_k, seed, scale, causal,
+                    meta):
+    o, lse = _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta,
+                  seed=seed)
+    return o, (q, k, v, bias, seg_q, seg_k, seed, o, lse)
 
 
 def _flash_bwd_rule(scale, causal, meta, res, do):
-    q, k, v, bias, seg_q, seg_k, o, lse = res
+    q, k, v, bias, seg_q, seg_k, seed, o, lse = res
     dq, dk, dv, dbias_full = _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse,
-                                       do, scale, causal, meta)
+                                       do, scale, causal, meta, seed=seed)
     dbias = None
     if dbias_full is not None:
         dbias = dbias_full
@@ -544,7 +628,7 @@ def _flash_bwd_rule(scale, causal, meta, res, do):
             dbias = dbias.sum(axis=0, keepdims=True)
         dbias = dbias.reshape(bias_b * bias_h, q.shape[1], k.shape[1]) \
             .astype(bias.dtype)
-    return dq, dk, dv, dbias, None, None
+    return dq, dk, dv, dbias, None, None, None  # segs + seed: no grads
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -552,7 +636,8 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention_pallas(q, k, v, causal=False, scale=None, bias=None,
                            segment_ids=None, kv_segment_ids=None,
-                           bias_grad=False):
+                           bias_grad=False, dropout_rate=0.0,
+                           dropout_seed=None):
     """Public API, paddle layout [batch, seq, heads, head_dim].
 
     - GQA: ``k``/``v`` may carry fewer heads than ``q`` (h % kvh == 0).
@@ -562,6 +647,11 @@ def flash_attention_pallas(q, k, v, causal=False, scale=None, bias=None,
       opt-in; with the default, the bias cotangent is symbolically zero.
     - ``segment_ids`` / ``kv_segment_ids``: [b, sq] / [b, sk] int32;
       attention is confined to equal ids (packed varlen batches).
+    - ``dropout_rate`` > 0: IN-KERNEL attention dropout after softmax
+      (reference flash_attn_kernel.cu Philox path): the keep mask is a
+      counter-based hash of absolute positions regenerated identically
+      by the backward kernels, seeded by ``dropout_seed`` (uint32
+      scalar; drawn from the framework RNG when None).
     """
     b, sq, h, d = q.shape
     kvh = k.shape[2]
@@ -588,8 +678,18 @@ def flash_attention_pallas(q, k, v, causal=False, scale=None, bias=None,
 
     blocks = _tuned_blocks(qt, kt, vt, bias_arg, seg_q_arg, seg_k_arg,
                            s, causal, (h, kvh, bias_b, bias_h))
-    meta = (h, kvh, bias_b, bias_h, bool(bias_grad), blocks)
-    o = _flash(qt, kt, vt, bias_arg, seg_q_arg, seg_k_arg, s, causal, meta)
+    rate = float(dropout_rate)
+    seed_arg = None
+    if rate > 0.0:
+        if dropout_seed is None:
+            from ...core.random import next_key
+            dropout_seed = jax.random.randint(
+                next_key(), (), 0, jnp.iinfo(jnp.int32).max,
+                dtype=jnp.int32)
+        seed_arg = jnp.asarray(dropout_seed, jnp.uint32).reshape(1)
+    meta = (h, kvh, bias_b, bias_h, bool(bias_grad), blocks, rate)
+    o = _flash(qt, kt, vt, bias_arg, seg_q_arg, seg_k_arg, seed_arg,
+               s, causal, meta)
     return jnp.swapaxes(o.reshape(b, h, sq, d), 1, 2)
 
 

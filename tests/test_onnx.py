@@ -42,6 +42,7 @@ def test_mlp_export_matches_jax(tmp_path):
     assert len(m.graph.initializer) >= 4
 
 
+@pytest.mark.slow
 def test_cnn_export_matches_jax(tmp_path):
     paddle.seed(0)
     net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8),
@@ -57,6 +58,7 @@ def test_cnn_export_matches_jax(tmp_path):
     assert "Conv" in ops and "MaxPool" in ops
 
 
+@pytest.mark.slow
 def test_transformer_block_export_matches_jax(tmp_path):
     paddle.seed(1)
 

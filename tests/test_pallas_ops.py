@@ -285,9 +285,10 @@ class TestAutotune:
 
 
 @pytest.mark.slow
-def test_flash_attn_unpadded_dropout_falls_back():
-    """dropout>0 must not raise: it runs the masked XLA composition;
-    training=False returns the fused-kernel result."""
+@pytest.mark.slow
+def test_flash_attn_unpadded_dropout_in_kernel():
+    """dropout>0 rides inside the fused kernel (position-keyed hash
+    mask); training=False returns the no-dropout fused result."""
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
 
@@ -301,39 +302,126 @@ def test_flash_attn_unpadded_dropout_falls_back():
     o1, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True,
                                   dropout=0.3, training=True)
     assert np.asarray(o1.numpy()).shape == (tq, h, d)
+    assert not np.allclose(np.asarray(o0.numpy()), np.asarray(o1.numpy()))
     o2, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True,
                                   dropout=0.3, training=False)
     np.testing.assert_allclose(np.asarray(o0.numpy()),
                                np.asarray(o2.numpy()), atol=1e-5)
+    # deterministic under the framework seed; varies across seeds
+    paddle.seed(123)
+    a, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True,
+                                 dropout=0.3, training=True)
+    paddle.seed(123)
+    b, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True,
+                                 dropout=0.3, training=True)
+    np.testing.assert_allclose(np.asarray(a.numpy()),
+                               np.asarray(b.numpy()))
 
 
-@pytest.mark.slow
-def test_flash_attn_unpadded_dropout_chunked_and_warns(monkeypatch):
-    """The dropout fallback is chunked over query blocks (bounded memory)
-    and warns once per process. With a vanishing dropout rate the chunked
-    composition must match the fused no-dropout kernel."""
-    import warnings
-    import paddle_tpu as paddle
-    import paddle_tpu.nn.functional as F
-    from paddle_tpu.nn.functional import attention as attn_mod
+class TestFlashDropout:
+    """In-kernel attention dropout (VERDICT round-2 §2: 'in-kernel
+    dropout RNG still missing'). The keep mask is a counter-based hash
+    of absolute positions, so the forward and both backward kernels —
+    and a full-matrix jnp reference — regenerate it identically."""
 
-    monkeypatch.setattr(attn_mod, "_DROPOUT_CHUNK", 4)  # force nq=3 chunks
-    monkeypatch.setattr(attn_mod, "_DROPOUT_FALLBACK_WARNED", False)
-    rng = np.random.RandomState(1)
-    tq, h, d = 12, 2, 8
-    q = paddle.to_tensor(rng.randn(tq, h, d).astype(np.float32))
-    k = paddle.to_tensor(rng.randn(tq, h, d).astype(np.float32))
-    v = paddle.to_tensor(rng.randn(tq, h, d).astype(np.float32))
-    cu = paddle.to_tensor(np.array([0, 5, 12], np.int32))
-    o0, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True)
-    with warnings.catch_warnings(record=True) as rec:
-        warnings.simplefilter("always")
-        o1, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True,
-                                      dropout=1e-9, training=True)
-        o2, _ = F.flash_attn_unpadded(q, k, v, cu, cu, causal=True,
-                                      dropout=1e-9, training=True)
-    msgs = [str(w.message) for w in rec if "chunked" in str(w.message)]
-    assert len(msgs) == 1  # once per process, not per call
-    np.testing.assert_allclose(np.asarray(o0.numpy()),
-                               np.asarray(o1.numpy()), atol=1e-4)
-    assert np.asarray(o2.numpy()).shape == (tq, h, d)
+    def _qkv(self, B=2, S=128, H=4, KVH=2, D=64):
+        import jax.numpy as jnp
+        rng = np.random.RandomState(0)
+        return (jnp.asarray(rng.randn(B, S, H, D), jnp.float32),
+                jnp.asarray(rng.randn(B, S, KVH, D), jnp.float32),
+                jnp.asarray(rng.randn(B, S, KVH, D), jnp.float32))
+
+    def _ref(self, q, k, v, seed, rate):
+        # the production full-matrix composition IS the reference — one
+        # copy of the hash/GQA layout to keep bit-identical
+        return _ref_attention(q, k, v, causal=True, dropout_rate=rate,
+                              dropout_seed=seed)
+
+    @pytest.mark.slow
+    def test_dropout_with_segment_ids_matches_reference(self):
+        """Varlen (segment-id) masking and in-kernel dropout compose —
+        the actual flash_attn_unpadded training path on TPU."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention_pallas)
+        q, k, v = self._qkv(B=2, S=128, H=2, KVH=2)
+        seg = jnp.concatenate([jnp.zeros((2, 64), jnp.int32),
+                               jnp.ones((2, 64), jnp.int32)], axis=1)
+        seed = jnp.asarray(11, jnp.uint32)
+        o_k = flash_attention_pallas(q, k, v, causal=True,
+                                     segment_ids=seg, dropout_rate=0.3,
+                                     dropout_seed=seed)
+        o_r = _ref_attention(q, k, v, causal=True, segment_ids=seg,
+                             dropout_rate=0.3, dropout_seed=seed)
+        np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_r),
+                                   rtol=2e-5, atol=2e-5)
+        # grads through the varlen+dropout kernel match the composition
+        import jax as _jax
+
+        def lk(q, k, v):
+            return (flash_attention_pallas(
+                q, k, v, causal=True, segment_ids=seg, dropout_rate=0.3,
+                dropout_seed=seed).astype(jnp.float32) ** 2).sum()
+
+        def lr(q, k, v):
+            return (_ref_attention(
+                q, k, v, causal=True, segment_ids=seg, dropout_rate=0.3,
+                dropout_seed=seed).astype(jnp.float32) ** 2).sum()
+
+        gk = _jax.grad(lk, (0, 1, 2))(q, k, v)
+        gr = _jax.grad(lr, (0, 1, 2))(q, k, v)
+        for a, b, name in zip(gk, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4, err_msg=name)
+
+    @pytest.mark.slow
+    def test_fwd_and_grads_match_exact_mask_reference(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention_pallas)
+        q, k, v = self._qkv()
+        seed = jnp.asarray(77, jnp.uint32)
+        rate = 0.3
+        o_k = flash_attention_pallas(q, k, v, causal=True,
+                                     dropout_rate=rate, dropout_seed=seed)
+        o_r = self._ref(q, k, v, seed, rate)
+        np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_r),
+                                   rtol=2e-5, atol=2e-5)
+
+        def lk(q, k, v):
+            return (flash_attention_pallas(
+                q, k, v, causal=True, dropout_rate=rate,
+                dropout_seed=seed).astype(jnp.float32) ** 2).sum()
+
+        def lr(q, k, v):
+            return (self._ref(q, k, v, seed, rate)
+                    .astype(jnp.float32) ** 2).sum()
+
+        gk = jax.grad(lk, (0, 1, 2))(q, k, v)
+        gr = jax.grad(lr, (0, 1, 2))(q, k, v)
+        for a, b, name in zip(gk, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4, err_msg=name)
+
+    @pytest.mark.slow
+    def test_deterministic_and_mean_preserving(self):
+        import jax.numpy as jnp
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention_pallas)
+        q, k, v = self._qkv(B=1, S=128, H=2, KVH=1)
+        o0 = np.asarray(flash_attention_pallas(q, k, v, causal=True))
+        seed = jnp.asarray(5, jnp.uint32)
+        a = np.asarray(flash_attention_pallas(
+            q, k, v, causal=True, dropout_rate=0.3, dropout_seed=seed))
+        b = np.asarray(flash_attention_pallas(
+            q, k, v, causal=True, dropout_rate=0.3, dropout_seed=seed))
+        np.testing.assert_array_equal(a, b)
+        acc = np.zeros_like(o0)
+        N = 24
+        for i in range(N):
+            acc += np.asarray(flash_attention_pallas(
+                q, k, v, causal=True, dropout_rate=0.3,
+                dropout_seed=jnp.asarray(100 + i, jnp.uint32)))
+        err = np.abs(acc / N - o0).mean() / (np.abs(o0).mean() + 1e-9)
+        assert err < 0.15, err
