@@ -310,7 +310,8 @@ class CompiledInterleaved:
     """
 
     def __init__(self, chunk_fn: Callable, loss_fn: Callable, mesh: Mesh,
-                 num_microbatches: int, num_chunks: int, axis: str = "pp"):
+                 num_microbatches: int, num_chunks: int, axis: str = "pp",
+                 split_dw: bool = False):
         self.chunk_fn = chunk_fn
         self.loss_fn = loss_fn
         self.mesh = mesh
@@ -318,6 +319,10 @@ class CompiledInterleaved:
         self.num_stages = mesh.shape[axis]
         self.num_microbatches = num_microbatches
         self.num_chunks = num_chunks        # V, per stage
+        # zero-bubble dW/dX split, same semantics as Compiled1F1B: the B
+        # slot's parameter-grad ACCUMULATION is deferred one tick
+        # (WeightGradStore put/flush); grads are identical
+        self.split_dw = split_dw
 
     def loss_and_grads(self, params, x, labels):
         S = self.num_stages
@@ -327,8 +332,9 @@ class CompiledInterleaved:
         axis = self.axis
         body = self.chunk_fn
         loss_fn = self.loss_fn
+        split_dw = self.split_dw
         K = min(M, 2 * L - 1)
-        T = M + 2 * L - 2
+        T = M + 2 * L - 2 + (1 if split_dw else 0)
         for name, v in (("x", x), ("labels", labels)):
             lead = jax.tree_util.tree_leaves(v)[0].shape[0]
             if lead != M:
@@ -349,12 +355,20 @@ class CompiledInterleaved:
             dy0 = jnp.zeros((V,) + mb_x.shape, mb_x.dtype)
             stash0 = jnp.zeros((V, K) + mb_x.shape, mb_x.dtype)
             grads0 = jax.tree_util.tree_map(jnp.zeros_like, my)
+            # per-chunk deferred-W queues (previous tick's dW + validity);
+            # only carried when the split is on — dead carry state would
+            # otherwise ride through every default trace
+            wq0 = (([jax.tree_util.tree_map(
+                         lambda p: jnp.zeros_like(p[v]), my)
+                     for v in range(V)],
+                    jnp.zeros((V,), bool)) if split_dw else ())
 
             def chunk_param(v):
                 return jax.tree_util.tree_map(lambda p: p[v], my)
 
             def tick(carry, t):
-                act_in, dy_in, stash, grads, loss_acc = carry
+                act_in, dy_in, stash, grads, loss_acc, wq = carry
+                wq_grads, wq_valid = wq if split_dw else (None, None)
                 # ---- F slots: chunk c = v*S + s processes m = t - c ----
                 send_f = jnp.zeros((V,) + mb_x.shape, mb_x.dtype)
                 new_stash = stash
@@ -396,9 +410,18 @@ class CompiledInterleaved:
                     dy = jnp.where(is_last, dy_loss.astype(dy_in.dtype),
                                    dy_in[v])
                     dp, dx = vjp_body(dy)
+                    if split_dw:
+                        acc_dp, acc_mask = wq_grads[v], wq_valid[v]
+                        wq_grads[v] = jax.tree_util.tree_map(
+                            lambda new, old: jnp.where(valid_b, new, old),
+                            dp, acc_dp)
+                        wq_valid = wq_valid.at[v].set(valid_b)
+                        dp, gmask = acc_dp, acc_mask
+                    else:
+                        gmask = valid_b
                     grads = jax.tree_util.tree_map(
                         lambda g, d, _v=v: g.at[_v].add(
-                            jnp.where(valid_b, d, 0.0)),
+                            jnp.where(gmask, d, 0.0)),
                         grads, dp)
                     loss_add = loss_add + jnp.where(
                         valid_b & is_last, loss_b.astype(jnp.float32), 0.0)
@@ -422,13 +445,14 @@ class CompiledInterleaved:
                     [moved_b[1:],
                      jnp.zeros((1,) + mb_x.shape, mb_x.dtype)], axis=0)
                 dy_next = jnp.where(s == S - 1, shifted_b, moved_b)
+                wq_out = (wq_grads, wq_valid) if split_dw else ()
                 return (act_next, dy_next, new_stash, grads,
-                        loss_acc + loss_add), None
+                        loss_acc + loss_add, wq_out), None
 
             carry0 = (act0, dy0, stash0, grads0,
-                      jnp.asarray(0.0, jnp.float32))
+                      jnp.asarray(0.0, jnp.float32), wq0)
             carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
-            _, _, _, grads, loss_acc = carry
+            _, _, _, grads, loss_acc, _ = carry
             loss = jax.lax.psum(loss_acc, axis) / M
             grads = jax.tree_util.tree_map(lambda g: g[None], grads)
             return loss, grads
