@@ -206,3 +206,10 @@ def swiglu(x, y=None, name=None):
 def tanh_(x, name=None):
     x._replace_value(jnp.tanh(x._value))
     return x
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    """reference: ops.yaml thresholded_relu — x where x > threshold,
+    else ``value``."""
+    return dispatch(lambda v: jnp.where(v > threshold, v, value),
+                    (_ensure(x),), name="thresholded_relu")

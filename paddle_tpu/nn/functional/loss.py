@@ -370,3 +370,23 @@ def soft_margin_loss(input, label, reduction="mean", name=None):
         return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
     return dispatch(f, (_ensure(input), _ensure(label)),
                     name="soft_margin_loss")
+
+
+def hinge_loss(input, label, name=None):
+    """reference: ops.yaml hinge_loss / funcs/eigen/loss.cc:112 —
+    elementwise max(0, 1 - pred * (2*label - 1)); labels in {0, 1}."""
+    return dispatch(
+        lambda x, y: jnp.maximum(0.0, 1.0 - x * (2.0 * y - 1.0)),
+        (_ensure(input), _ensure(label)), name="hinge_loss")
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """reference: ops.yaml huber_loss — quadratic within +-delta, linear
+    outside."""
+    def f(x, y):
+        d = jnp.abs(x - y)
+        quad = 0.5 * d * d
+        lin = delta * (d - 0.5 * delta)
+        out = jnp.where(d <= delta, quad, lin)
+        return _reduce(out, reduction)
+    return dispatch(f, (_ensure(input), _ensure(label)), name="huber_loss")

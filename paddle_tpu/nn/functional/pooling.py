@@ -82,7 +82,8 @@ def _max_pool(x, kernel_size, stride, padding, ceil_mode, return_mask,
             ([(0, 0)] + list(pd) + [(0, 0)]) if ch_last
             else [(0, 0), (0, 0)] + list(pd))
         vals, idx = lax.reduce_window(
-            (v, lin), (neg, jnp.asarray(-1)), argmax_op,
+            (v, lin), (jnp.asarray(neg, v.dtype),
+                       jnp.asarray(-1, lin.dtype)), argmax_op,
             dims, strd, pad_cfg)
         return vals, idx.astype(jnp.int32)
     if return_mask:
@@ -252,3 +253,53 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
                            ks, st, pd, data_format.endswith("C"), 2)
         return (s ** (1.0 / p)).astype(v.dtype)
     return dispatch(f, (x,), name="lp_pool2d")
+
+
+def _max_unpool(x, indices, kernel_size, stride, padding, output_size,
+                data_format, n, name):
+    """Scatter pooled values back to the positions recorded by
+    ``return_mask`` (reference: ops.yaml unpool/unpool3d). ``indices``
+    are flat per-(N, C) spatial indices, exactly what max_poolNd
+    returns."""
+    x = _ensure(x)
+    idx = _ensure(indices)
+    if data_format.endswith("C"):
+        raise NotImplementedError("max_unpool: channels-last unsupported")
+    ks = _tuple(kernel_size, n)
+    st = _tuple(stride, n) if stride is not None else ks
+    pd = _tuple(padding, n)
+    if output_size is None:
+        spatial = x.shape[2:2 + n]
+        output_size = tuple((s - 1) * st[i] - 2 * pd[i] + ks[i]
+                            for i, s in enumerate(spatial))
+    else:
+        output_size = tuple(output_size)[-n:]
+
+    def f(v, iv):
+        N, C = v.shape[0], v.shape[1]
+        flat = v.reshape(N, C, -1)
+        ifl = iv.reshape(N, C, -1).astype(jnp.int32)
+        hw = int(np.prod(output_size))
+        out = jnp.zeros((N, C, hw), v.dtype)
+        out = out.at[jnp.arange(N)[:, None, None],
+                     jnp.arange(C)[None, :, None], ifl].set(flat)
+        return out.reshape((N, C) + output_size)
+    return dispatch(f, (x, idx), name=name)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, data_format, 1, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, data_format, 2, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool(x, indices, kernel_size, stride, padding,
+                       output_size, data_format, 3, "max_unpool3d")

@@ -8,8 +8,13 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor, dispatch, to_value
 
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
 __all__ = ["nms", "box_coder", "roi_align", "roi_pool", "yolo_box",
-           "generate_proposals"]
+           "generate_proposals", "prior_box", "matrix_nms",
+           "multiclass_nms", "distribute_fpn_proposals", "psroi_pool"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
@@ -288,3 +293,264 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     if return_rois_num:
         return rois, probs, Tensor(np.asarray(rois_num, np.int32))
     return rois, probs
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes (reference: ops.yaml prior_box /
+    phi/kernels/impl/prior_box_kernel_impl.h). Returns (boxes, variances)
+    each [H, W, num_priors, 4] in normalized xmin/ymin/xmax/ymax."""
+    feat = to_value(_ensure(input))
+    img = to_value(_ensure(image))
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(img.shape[2]), int(img.shape[3])
+    step_w = steps[0] if steps[0] > 0 else iw / fw
+    step_h = steps[1] if steps[1] > 0 else ih / fh
+    min_sizes = list(min_sizes)
+    max_sizes = list(max_sizes) if max_sizes else []
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    wh = []  # (w, h) per prior, reference ordering
+    for mi, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            wh.append((ms, ms))
+            if max_sizes:
+                big = np.sqrt(ms * max_sizes[mi])
+                wh.append((big, big))
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                wh.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                wh.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                big = np.sqrt(ms * max_sizes[mi])
+                wh.append((big, big))
+    wh = np.asarray(wh, np.float32)                 # [P, 2]
+    P = wh.shape[0]
+    cx = (np.arange(fw, dtype=np.float32) + offset) * step_w
+    cy = (np.arange(fh, dtype=np.float32) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)                  # [H, W]
+    boxes = np.zeros((fh, fw, P, 4), np.float32)
+    boxes[..., 0] = (cxg[..., None] - wh[None, None, :, 0] / 2) / iw
+    boxes[..., 1] = (cyg[..., None] - wh[None, None, :, 1] / 2) / ih
+    boxes[..., 2] = (cxg[..., None] + wh[None, None, :, 0] / 2) / iw
+    boxes[..., 3] = (cyg[..., None] + wh[None, None, :, 1] / 2) / ih
+    if clip:
+        boxes = np.clip(boxes, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          boxes.shape).copy()
+    return Tensor(jnp.asarray(boxes)), Tensor(jnp.asarray(var))
+
+
+def _iou_matrix(b, normalized=True):
+    off = 0.0 if normalized else 1.0   # pixel boxes are inclusive
+    area = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    lt = np.maximum(b[:, None, :2], b[None, :, :2])
+    rb = np.minimum(b[:, None, 2:], b[None, :, 2:])
+    whi = np.clip(rb - lt + off, 0, None)
+    inter = whi[..., 0] * whi[..., 1]
+    return inter / np.clip(area[:, None] + area[None, :] - inter, 1e-10,
+                           None)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0,
+               normalized=True, return_index=False, return_rois_num=True,
+               name=None):
+    """Matrix NMS (reference: ops.yaml matrix_nms, SOLOv2 paper): decay
+    every box's score by its overlap with higher-scoring kept boxes —
+    parallel, no sequential suppression. Host-side (dynamic output),
+    like the reference's CPU kernel."""
+    bb = np.asarray(to_value(_ensure(bboxes)))   # [N, M, 4]
+    sc = np.asarray(to_value(_ensure(scores)))   # [N, C, M]
+    outs, indices, nums = [], [], []
+    for n in range(bb.shape[0]):
+        dets = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            keep = np.where(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])]
+            if nms_top_k is not None and nms_top_k > 0:
+                order = order[:nms_top_k]
+            b, ss = bb[n][order], s[order]
+            iou = _iou_matrix(b, normalized)
+            iou = np.triu(iou, 1)                # pairwise w/ higher-scored
+            iou_cmax = iou.max(axis=0)           # suppressor i's own max
+            # SOLOv2 eq: decay_j = min_i f(iou_ij) / f(iou_cmax_i) — the
+            # denominator compensates by the SUPPRESSOR's overlap
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - iou_cmax[:, None] ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou) / np.clip(1 - iou_cmax[:, None],
+                                             1e-10, None)).min(axis=0)
+            decay = np.minimum(decay, 1.0)  # zero-overlap rows give >1
+            ds = ss * decay
+            m = ds >= post_threshold
+            for i in np.where(m)[0]:
+                dets.append((c, ds[i], b[i], order[i]))
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k is not None and keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        outs.append(np.asarray(
+            [[c, s2] + list(bx) for c, s2, bx, _ in dets], np.float32)
+            .reshape(-1, 6))
+        indices.append(np.asarray(
+            [n * bb.shape[1] + i for _, _, _, i in dets], np.int32))
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(outs, 0) if outs else
+                             np.zeros((0, 6), np.float32)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.concatenate(indices)
+                                      if indices else
+                                      np.zeros((0,), np.int32))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
+                   keep_top_k=100, nms_threshold=0.3, normalized=True,
+                   nms_eta=1.0, background_label=0, return_index=False,
+                   return_rois_num=True, rois_num=None, name=None):
+    """reference: ops.yaml multiclass_nms3 — per-class greedy NMS then
+    global keep_top_k. Host-side (dynamic output)."""
+    bb = np.asarray(to_value(_ensure(bboxes)))   # [N, M, 4]
+    sc = np.asarray(to_value(_ensure(scores)))   # [N, C, M]
+    outs, indices, nums = [], [], []
+    for n in range(bb.shape[0]):
+        dets = []
+        for c in range(sc.shape[1]):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            keep = np.where(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])]
+            if nms_top_k is not None and nms_top_k > 0:
+                order = order[:nms_top_k]
+            b, ss = bb[n][order], s[order]
+            iou = _iou_matrix(b, normalized)
+            kept = []
+            thr = nms_threshold
+            for i in range(len(order)):
+                if all(iou[i, j] <= thr for j in kept):
+                    kept.append(i)
+                    if nms_eta < 1.0 and thr > 0.5:
+                        thr *= nms_eta
+            for i in kept:
+                dets.append((c, ss[i], b[i], order[i]))
+        dets.sort(key=lambda d: -d[1])
+        if keep_top_k is not None and keep_top_k > 0:
+            dets = dets[:keep_top_k]
+        outs.append(np.asarray(
+            [[c, s2] + list(bx) for c, s2, bx, _ in dets], np.float32)
+            .reshape(-1, 6))
+        indices.append(np.asarray(
+            [n * bb.shape[1] + i for _, _, _, i in dets], np.int32))
+        nums.append(len(dets))
+    out = Tensor(jnp.asarray(np.concatenate(outs, 0) if outs else
+                             np.zeros((0, 6), np.float32)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.concatenate(indices)
+                                      if indices else
+                                      np.zeros((0,), np.int32))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """reference: ops.yaml distribute_fpn_proposals — assign each RoI to
+    an FPN level by sqrt(area) (FPN paper eq. 1). Host-side."""
+    rois = np.asarray(to_value(_ensure(fpn_rois)))
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.clip(w * h, 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    if rois_num is not None:
+        counts = np.asarray(to_value(_ensure(rois_num))).astype(np.int64)
+        img_of = np.repeat(np.arange(len(counts)), counts)
+    else:
+        counts = np.asarray([len(rois)], np.int64)
+        img_of = np.zeros(len(rois), np.int64)
+    multi_rois, restore = [], np.zeros(len(rois), np.int64)
+    nums_per_level = []
+    pos = 0
+    for level in range(min_level, max_level + 1):
+        idx = np.where(lvl == level)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        # per-IMAGE counts at this level (reference returns [batch]-shaped
+        # rois_num per level so downstream splits stay per image)
+        nums_per_level.append(np.asarray(
+            [(img_of[idx] == b).sum() for b in range(len(counts))],
+            np.int32))
+        restore[idx] = np.arange(pos, pos + len(idx))
+        pos += len(idx)
+    return (multi_rois, Tensor(jnp.asarray(restore.reshape(-1, 1))),
+            [Tensor(jnp.asarray(n)) for n in nums_per_level])
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: ops.yaml psroi_pool,
+    R-FCN): channel block (i, j) average-pools bin (i, j) only."""
+    xv = to_value(_ensure(x))
+    bv = np.asarray(to_value(_ensure(boxes)))
+    if isinstance(output_size, int):
+        oh = ow = output_size
+    else:
+        oh, ow = output_size
+    C = xv.shape[1]
+    assert C % (oh * ow) == 0, \
+        f"channels {C} not divisible by output_size^2 {oh * ow}"
+    oc = C // (oh * ow)
+    nums = np.asarray(to_value(_ensure(boxes_num))).tolist()
+    batch_of = np.repeat(np.arange(len(nums)), nums)
+
+    def f(v):
+        outs = []
+        for r, b in enumerate(bv):
+            n = int(batch_of[r])
+            x1, y1, x2, y2 = [float(t) * spatial_scale for t in b]
+            rh = max(y2 - y1, 0.1) / oh
+            rw = max(x2 - x1, 0.1) / ow
+            bins = []
+            for i in range(oh):
+                for j in range(ow):
+                    hs = int(np.floor(y1 + i * rh))
+                    he = max(int(np.ceil(y1 + (i + 1) * rh)), hs + 1)
+                    ws = int(np.floor(x1 + j * rw))
+                    we = max(int(np.ceil(x1 + (j + 1) * rw)), ws + 1)
+                    hs = int(np.clip(hs, 0, v.shape[2] - 1))
+                    ws = int(np.clip(ws, 0, v.shape[3] - 1))
+                    he = int(np.clip(he, hs + 1, v.shape[2]))
+                    we = int(np.clip(we, ws + 1, v.shape[3]))
+                    ch = jnp.arange(oc) * (oh * ow) + i * ow + j
+                    bins.append(jnp.mean(
+                        v[n, ch, hs:he, ws:we], axis=(1, 2)))
+            outs.append(jnp.stack(bins, 1).reshape(oc, oh, ow))
+        return jnp.stack(outs) if outs else \
+            jnp.zeros((0, oc, oh, ow), v.dtype)
+    return dispatch(f, (_ensure(x),), name="psroi_pool")

@@ -238,3 +238,116 @@ def test_generate_proposals_shapes_and_order():
         o += n_i
     # boxes clipped to image
     assert (rois >= 0).all()
+
+
+class TestDetectionPostprocess:
+    """Round-3 detection long tail (reference: ops.yaml prior_box,
+    matrix_nms, multiclass_nms3, distribute_fpn_proposals, psroi_pool)."""
+
+    def test_prior_box_geometry(self):
+        from paddle_tpu.vision.ops import prior_box
+        feat = paddle.to_tensor(np.zeros((1, 8, 2, 2), np.float32))
+        img = paddle.to_tensor(np.zeros((1, 3, 16, 16), np.float32))
+        boxes, var = prior_box(feat, img, min_sizes=[4.0], max_sizes=[8.0],
+                               aspect_ratios=[2.0], flip=True, clip=True)
+        b = np.asarray(boxes.numpy())
+        assert b.shape == (2, 2, 4, 4)
+        # first prior at cell (0,0): square min_size centered at 4px
+        np.testing.assert_allclose(b[0, 0, 0], [2/16, 2/16, 6/16, 6/16],
+                                   atol=1e-6)
+        # default ordering: aspect priors first, max_size square LAST
+        s = np.sqrt(32) / 2
+        np.testing.assert_allclose(
+            b[0, 0, 3], [(4-s)/16, (4-s)/16, (4+s)/16, (4+s)/16], atol=1e-6)
+        assert (b >= 0).all() and (b <= 1).all()
+        v = np.asarray(var.numpy())
+        np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2])
+
+    def _overlap_case(self):
+        bb = paddle.to_tensor(np.array(
+            [[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+            np.float32))
+        sc = paddle.to_tensor(np.array(
+            [[[0.0, 0.0, 0.0], [0.9, 0.85, 0.8]]], np.float32))
+        return bb, sc
+
+    def test_multiclass_nms_suppresses_overlap(self):
+        from paddle_tpu.vision.ops import multiclass_nms
+        bb, sc = self._overlap_case()
+        out, nums = multiclass_nms(bb, sc, score_threshold=0.1,
+                                   nms_threshold=0.5, background_label=0)
+        o = np.asarray(out.numpy())
+        assert int(np.asarray(nums.numpy())[0]) == 2
+        np.testing.assert_allclose(sorted(o[:, 1]), [0.8, 0.9])
+
+    def test_matrix_nms_decays_overlap(self):
+        from paddle_tpu.vision.ops import matrix_nms
+        bb, sc = self._overlap_case()
+        out, nums = matrix_nms(bb, sc, score_threshold=0.1)
+        o = np.asarray(out.numpy())
+        assert o.shape[0] == 3
+        scores = sorted(o[:, 1], reverse=True)
+        assert scores[0] == pytest.approx(0.9)      # top box untouched
+        assert scores[-1] < 0.5                     # overlap decayed
+        # distinct box keeps its score
+        assert any(abs(s - 0.8) < 1e-6 for s in scores)
+
+    def test_distribute_fpn_proposals_levels(self):
+        from paddle_tpu.vision.ops import distribute_fpn_proposals
+        rois = paddle.to_tensor(np.array(
+            [[0, 0, 16, 16], [0, 0, 200, 200], [0, 0, 450, 450]],
+            np.float32))
+        multi, restore, nums = distribute_fpn_proposals(rois, 2, 5, 4, 224)
+        counts = [int(np.asarray(n.numpy())[0]) for n in nums]
+        assert sum(counts) == 3 and len(multi) == 4
+        # sqrt(area)=16 -> level 2 (clipped); 200 -> floor(log2(200/224))
+        # + 4 = 3; 450 -> 5
+        assert counts == [1, 1, 0, 1]
+        # restore index is a permutation
+        r = np.asarray(restore.numpy()).ravel()
+        assert sorted(r.tolist()) == [0, 1, 2]
+
+    def test_psroi_pool_position_sensitive(self):
+        from paddle_tpu.vision.ops import psroi_pool
+        # input channel k constant at value k; reference layout
+        # (cpu/psroi_pool_kernel.cc:151): output channel c at bin (i, j)
+        # reads input channel c*(oh*ow) + i*ow + j
+        x = np.zeros((1, 8, 4, 4), np.float32)
+        for k in range(8):
+            x[0, k] = k
+        out = psroi_pool(paddle.to_tensor(x),
+                         paddle.to_tensor(np.array([[0, 0, 4, 4]],
+                                                   np.float32)),
+                         paddle.to_tensor(np.array([1], np.int32)), 2)
+        o = np.asarray(out.numpy())                 # [1, 2, 2, 2]
+        for c in range(2):
+            for i in range(2):
+                for j in range(2):
+                    np.testing.assert_allclose(o[0, c, i, j],
+                                               c * 4 + i * 2 + j)
+
+
+def test_unpool_and_small_losses():
+    import paddle_tpu.nn.functional as F
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    p, idx = F.max_pool2d(x, 2, 2, return_mask=True)
+    up = np.asarray(F.max_unpool2d(p, idx, 2, 2).numpy())
+    ref = np.zeros((1, 1, 4, 4), np.float32)
+    for v, i in zip(np.asarray(p.numpy()).ravel(),
+                    np.asarray(idx.numpy()).ravel()):
+        ref[0, 0, i // 4, i % 4] = v
+    np.testing.assert_allclose(up, ref)
+
+    np.testing.assert_allclose(
+        np.asarray(F.thresholded_relu(paddle.to_tensor(
+            np.array([-1.0, 0.5, 2.0], np.float32))).numpy()), [0, 0, 2])
+    np.testing.assert_allclose(
+        np.asarray(F.hinge_loss(
+            paddle.to_tensor(np.array([0.5, -2.0], np.float32)),
+            paddle.to_tensor(np.array([1.0, -1.0], np.float32))).numpy()),
+        [0.5, 0.0])
+    np.testing.assert_allclose(
+        np.asarray(F.huber_loss(
+            paddle.to_tensor(np.array([0.0, 3.0], np.float32)),
+            paddle.to_tensor(np.array([0.5, 0.0], np.float32)),
+            delta=1.0, reduction="none").numpy()), [0.125, 2.5])
