@@ -14,7 +14,7 @@ def _ensure(x):
 
 __all__ = ["nms", "box_coder", "roi_align", "roi_pool", "yolo_box",
            "generate_proposals", "prior_box", "matrix_nms",
-           "multiclass_nms", "distribute_fpn_proposals", "psroi_pool"]
+           "multiclass_nms", "distribute_fpn_proposals", "psroi_pool", "deform_conv2d"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
@@ -414,14 +414,14 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
         nums.append(len(dets))
     out = Tensor(jnp.asarray(np.concatenate(outs, 0) if outs else
                              np.zeros((0, 6), np.float32)))
-    res = [out]
-    if return_index:
-        res.append(Tensor(jnp.asarray(np.concatenate(indices)
-                                      if indices else
-                                      np.zeros((0,), np.int32))))
-    if return_rois_num:
-        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
-    return tuple(res) if len(res) > 1 else out
+    # reference return shape (vision/ops.py:2590): (out, rois_num, index)
+    # with None placeholders when not requested
+    idx_t = Tensor(jnp.asarray(np.concatenate(indices) if indices else
+                               np.zeros((0,), np.int32))) \
+        if return_index else None
+    num_t = Tensor(jnp.asarray(np.asarray(nums, np.int32))) \
+        if return_rois_num else None
+    return out, num_t, idx_t
 
 
 def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
@@ -429,9 +429,25 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
                    nms_eta=1.0, background_label=0, return_index=False,
                    return_rois_num=True, rois_num=None, name=None):
     """reference: ops.yaml multiclass_nms3 — per-class greedy NMS then
-    global keep_top_k. Host-side (dynamic output)."""
-    bb = np.asarray(to_value(_ensure(bboxes)))   # [N, M, 4]
-    sc = np.asarray(to_value(_ensure(scores)))   # [N, C, M]
+    global keep_top_k. Host-side (dynamic output). Returns
+    ``(out, rois_num, index)`` with None placeholders, matching
+    matrix_nms. ``rois_num`` input selects the LoD form: bboxes [M, 4] /
+    scores [M, C] concatenated over images with per-image counts."""
+    bb = np.asarray(to_value(_ensure(bboxes)))
+    sc = np.asarray(to_value(_ensure(scores)))
+    if rois_num is not None:
+        counts = np.asarray(to_value(_ensure(rois_num))).astype(np.int64)
+        splits = np.cumsum(counts)[:-1]
+        bb_list = np.split(bb, splits, axis=0)       # [Mi, 4] each
+        sc_list = [p.T for p in np.split(sc, splits, axis=0)]  # [C, Mi]
+        m_max = max((b.shape[0] for b in bb_list), default=0)
+        padded_bb = np.zeros((len(bb_list), m_max, 4), bb.dtype)
+        padded_sc = np.full((len(bb_list), sc.shape[1], m_max),
+                            -np.inf, sc.dtype)
+        for i, (b, p) in enumerate(zip(bb_list, sc_list)):
+            padded_bb[i, :b.shape[0]] = b
+            padded_sc[i, :, :b.shape[0]] = p
+        bb, sc = padded_bb, padded_sc
     outs, indices, nums = [], [], []
     for n in range(bb.shape[0]):
         dets = []
@@ -467,14 +483,12 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=1000,
         nums.append(len(dets))
     out = Tensor(jnp.asarray(np.concatenate(outs, 0) if outs else
                              np.zeros((0, 6), np.float32)))
-    res = [out]
-    if return_index:
-        res.append(Tensor(jnp.asarray(np.concatenate(indices)
-                                      if indices else
-                                      np.zeros((0,), np.int32))))
-    if return_rois_num:
-        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
-    return tuple(res) if len(res) > 1 else out
+    idx_t = Tensor(jnp.asarray(np.concatenate(indices) if indices else
+                               np.zeros((0,), np.int32))) \
+        if return_index else None
+    num_t = Tensor(jnp.asarray(np.asarray(nums, np.int32))) \
+        if return_rois_num else None
+    return out, num_t, idx_t
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
@@ -539,18 +553,119 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             bins = []
             for i in range(oh):
                 for j in range(ow):
-                    hs = int(np.floor(y1 + i * rh))
-                    he = max(int(np.ceil(y1 + (i + 1) * rh)), hs + 1)
-                    ws = int(np.floor(x1 + j * rw))
-                    we = max(int(np.ceil(x1 + (j + 1) * rw)), ws + 1)
-                    hs = int(np.clip(hs, 0, v.shape[2] - 1))
-                    ws = int(np.clip(ws, 0, v.shape[3] - 1))
-                    he = int(np.clip(he, hs + 1, v.shape[2]))
-                    we = int(np.clip(we, ws + 1, v.shape[3]))
+                    hs = int(np.clip(np.floor(y1 + i * rh),
+                                     0, v.shape[2]))
+                    he = int(np.clip(np.ceil(y1 + (i + 1) * rh),
+                                     0, v.shape[2]))
+                    ws = int(np.clip(np.floor(x1 + j * rw),
+                                     0, v.shape[3]))
+                    we = int(np.clip(np.ceil(x1 + (j + 1) * rw),
+                                     0, v.shape[3]))
                     ch = jnp.arange(oc) * (oh * ow) + i * ow + j
-                    bins.append(jnp.mean(
-                        v[n, ch, hs:he, ws:we], axis=(1, 2)))
+                    if he <= hs or we <= ws:
+                        # reference is_empty bin -> zeros, not border avg
+                        bins.append(jnp.zeros((oc,), v.dtype))
+                    else:
+                        bins.append(jnp.mean(
+                            v[n, ch, hs:he, ws:we], axis=(1, 2)))
             outs.append(jnp.stack(bins, 1).reshape(oc, oh, ow))
         return jnp.stack(outs) if outs else \
             jnp.zeros((0, oc, oh, ow), v.dtype)
     return dispatch(f, (_ensure(x),), name="psroi_pool")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference: ops.yaml deformable_conv,
+    phi/kernels/funcs/deformable_conv_functor.cc:55-90).
+
+    x [N, Cin, H, W]; offset [N, 2*dg*kh*kw, Ho, Wo] with per-group
+    channel 2*(i*kw+j) the H-offset and +1 the W-offset (reference
+    layout); optional ``mask`` [N, dg*kh*kw, Ho, Wo] makes it v2
+    (modulated). Bilinear sampling with zeros outside the image; the
+    whole op is one gather+einsum XLA program."""
+    x = _ensure(x)
+    offset = _ensure(offset)
+    weight = _ensure(weight)
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    dh, dw = (dilation, dilation) if isinstance(dilation, int) else dilation
+    args = [x, offset, weight]
+    has_mask = mask is not None
+    if has_mask:
+        args.append(_ensure(mask))
+    has_bias = bias is not None
+    if has_bias:
+        args.append(_ensure(bias))
+
+    def f(xv, ov, wv, *rest):
+        mv = rest[0] if has_mask else None
+        bv = rest[int(has_mask)] if has_bias else None
+        N, Cin, H, W = xv.shape
+        Cout, cin_g, kh, kw = wv.shape
+        dg = deformable_groups
+        K = kh * kw
+        exp_h = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        exp_w = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        if ov.shape[1] != 2 * dg * K or ov.shape[2:] != (exp_h, exp_w):
+            raise ValueError(
+                f"deform_conv2d: offset shape {ov.shape} != expected "
+                f"[N, {2 * dg * K}, {exp_h}, {exp_w}] for this geometry")
+        if mv is not None and (mv.shape[1] != dg * K
+                               or mv.shape[2:] != (exp_h, exp_w)):
+            raise ValueError(
+                f"deform_conv2d: mask shape {mv.shape} != expected "
+                f"[N, {dg * K}, {exp_h}, {exp_w}]")
+        Ho, Wo = exp_h, exp_w
+        ov = ov.reshape(N, dg, K, 2, Ho, Wo).astype(jnp.float32)
+        off_h, off_w = ov[:, :, :, 0], ov[:, :, :, 1]   # [N, dg, K, Ho, Wo]
+        base_h = (jnp.arange(Ho) * sh - ph)[None, None, None, :, None]
+        base_w = (jnp.arange(Wo) * sw - pw)[None, None, None, None, :]
+        ker_h = (jnp.arange(kh) * dh).repeat(kw).reshape(1, 1, K, 1, 1)
+        ker_w = jnp.tile(jnp.arange(kw) * dw, kh).reshape(1, 1, K, 1, 1)
+        py = base_h + ker_h + off_h                      # [N, dg, K, Ho, Wo]
+        px = base_w + ker_w + off_w
+        inside = (py > -1) & (px > -1) & (py < H) & (px < W)
+        y0 = jnp.floor(py)
+        x0 = jnp.floor(px)
+        wy = (py - y0).astype(jnp.float32)
+        wx = (px - x0).astype(jnp.float32)
+
+        def tap(yy, xx):
+            ok = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+            yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            # gather per (N, dg): each input channel uses its group's grid
+            cpg = Cin // dg
+            xg = xv.reshape(N, dg, cpg, H, W).astype(jnp.float32)
+            flat = yc * W + xc                           # [N, dg, K, Ho, Wo]
+            # size-1 channel dim of the index broadcasts in
+            # take_along_axis — no cpg-fold index materialization
+            g = jnp.take_along_axis(
+                xg.reshape(N, dg, cpg, H * W)[:, :, :, None, :],
+                flat.reshape(N, dg, 1, K * Ho * Wo)[:, :, :, :, None],
+                axis=-1)[..., 0].reshape(N, dg, cpg, K, Ho, Wo)
+            return jnp.where(ok[:, :, None], g, 0.0)
+
+        val = (tap(y0, x0) * ((1 - wy) * (1 - wx))[:, :, None]
+               + tap(y0 + 1, x0) * (wy * (1 - wx))[:, :, None]
+               + tap(y0, x0 + 1) * ((1 - wy) * wx)[:, :, None]
+               + tap(y0 + 1, x0 + 1) * (wy * wx)[:, :, None])
+        val = jnp.where(inside[:, :, None], val, 0.0)
+        if mv is not None:
+            m = mv.reshape(N, dg, 1, K, Ho, Wo).astype(jnp.float32)
+            val = val * m
+        val = val.reshape(N, Cin, K, Ho, Wo)
+        # grouped conv over sampled patches
+        cpg2 = Cin // groups
+        opg = Cout // groups
+        val = val.reshape(N, groups, cpg2, K, Ho, Wo)
+        wg = wv.reshape(groups, opg, cin_g, K).astype(jnp.float32)
+        out = jnp.einsum("ngckhw,gock->ngohw", val, wg)
+        out = out.reshape(N, Cout, Ho, Wo)
+        if bv is not None:
+            out = out + bv.reshape(1, Cout, 1, 1)
+        return out.astype(xv.dtype)
+
+    return dispatch(f, tuple(args), name="deform_conv2d")

@@ -274,8 +274,10 @@ class TestDetectionPostprocess:
     def test_multiclass_nms_suppresses_overlap(self):
         from paddle_tpu.vision.ops import multiclass_nms
         bb, sc = self._overlap_case()
-        out, nums = multiclass_nms(bb, sc, score_threshold=0.1,
-                                   nms_threshold=0.5, background_label=0)
+        out, nums, idx = multiclass_nms(bb, sc, score_threshold=0.1,
+                                        nms_threshold=0.5,
+                                        background_label=0)
+        assert idx is None          # reference None placeholder
         o = np.asarray(out.numpy())
         assert int(np.asarray(nums.numpy())[0]) == 2
         np.testing.assert_allclose(sorted(o[:, 1]), [0.8, 0.9])
@@ -283,7 +285,7 @@ class TestDetectionPostprocess:
     def test_matrix_nms_decays_overlap(self):
         from paddle_tpu.vision.ops import matrix_nms
         bb, sc = self._overlap_case()
-        out, nums = matrix_nms(bb, sc, score_threshold=0.1)
+        out, nums, _ = matrix_nms(bb, sc, score_threshold=0.1)
         o = np.asarray(out.numpy())
         assert o.shape[0] == 3
         scores = sorted(o[:, 1], reverse=True)
@@ -351,3 +353,67 @@ def test_unpool_and_small_losses():
             paddle.to_tensor(np.array([0.0, 3.0], np.float32)),
             paddle.to_tensor(np.array([0.5, 0.0], np.float32)),
             delta=1.0, reduction="none").numpy()), [0.125, 2.5])
+
+
+class TestDeformConv2d:
+    """reference: ops.yaml deformable_conv (v1/v2), offset layout per
+    funcs/deformable_conv_functor.cc:72-76."""
+
+    def _data(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 4, 8, 8).astype(np.float32)
+        w = (rng.randn(6, 4, 3, 3) * 0.1).astype(np.float32)
+        return rng, x, w
+
+    def test_zero_offset_equals_conv2d(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.vision.ops import deform_conv2d
+        rng, x, w = self._data()
+        off0 = np.zeros((2, 18, 8, 8), np.float32)
+        out = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off0),
+                            paddle.to_tensor(w), stride=1, padding=1)
+        ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                       stride=1, padding=1)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(ref.numpy()), atol=1e-4)
+
+    def test_integer_w_offset_shifts_sampling(self):
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu.vision.ops import deform_conv2d
+        rng, x, w = self._data()
+        off = np.zeros((2, 18, 8, 8), np.float32)
+        off[:, 1::2] = 1.0       # odd channels = W offsets (reference)
+        out = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(w), stride=1, padding=1)
+        xs = np.zeros_like(x)
+        xs[..., :-1] = x[..., 1:]
+        ref = F.conv2d(paddle.to_tensor(xs), paddle.to_tensor(w),
+                       stride=1, padding=1)
+        np.testing.assert_allclose(
+            np.asarray(out.numpy())[..., 1:-2],
+            np.asarray(ref.numpy())[..., 1:-2], atol=1e-4)
+
+    def test_mask_modulates_and_grads_flow(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+        import paddle_tpu.nn.functional as F
+        rng, x, w = self._data()
+        off0 = np.zeros((2, 18, 8, 8), np.float32)
+        mh = np.full((2, 9, 8, 8), 0.5, np.float32)
+        out = deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off0),
+                            paddle.to_tensor(w), stride=1, padding=1,
+                            mask=paddle.to_tensor(mh))
+        ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                       stride=1, padding=1)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   0.5 * np.asarray(ref.numpy()),
+                                   atol=1e-4)
+        xt = paddle.to_tensor(x)
+        xt.stop_gradient = False
+        off_f = (rng.rand(2, 36, 8, 8).astype(np.float32) - 0.5)
+        w2 = (rng.randn(8, 2, 3, 3) * 0.1).astype(np.float32)
+        o2 = deform_conv2d(xt, paddle.to_tensor(off_f),
+                           paddle.to_tensor(w2), stride=1, padding=1,
+                           deformable_groups=2, groups=2)
+        o2.sum().backward()
+        g = np.asarray(xt.grad.numpy())
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
