@@ -303,3 +303,85 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCDHW", output_size=None, name=None):
     return _max_unpool(x, indices, kernel_size, stride, padding,
                        output_size, data_format, 3, "max_unpool3d")
+
+
+def _fractional_bins(in_size, out_size, u, pool_size):
+    """Start/end indices per output cell (reference funcs/pooling.h
+    FractionalRationalU/StartIndex/EndIndex)."""
+    alpha = in_size / out_size
+    if pool_size and pool_size > 0:
+        uu = u
+    else:
+        base = in_size // out_size
+        u_max1 = (base + 2) / alpha - 1
+        u_max2 = (in_size + 1 - base) / alpha - (out_size - 1)
+        uu = u * min(u_max1, u_max2)
+    bins = []
+    off = int(uu * alpha)
+    for i in range(out_size):
+        s = int((i + uu) * alpha) - off
+        if pool_size and pool_size > 0:
+            e = s + pool_size
+        else:
+            e = int((i + 1 + uu) * alpha) - off
+        s = max(0, min(s, in_size - 1))
+        e = max(s + 1, min(e, in_size))
+        bins.append((s, e))
+    return bins
+
+
+def _fractional_max_pool(x, output_size, kernel_size, random_u,
+                         return_mask, n, name):
+    x = _ensure(x)
+    if isinstance(output_size, int):
+        output_size = (output_size,) * n
+    ks = (None,) * n if kernel_size is None else (
+        (kernel_size,) * n if isinstance(kernel_size, int)
+        else tuple(kernel_size))
+    if random_u is None:
+        from ...core.random import next_key
+        import jax as _jax
+        random_u = float(_jax.random.uniform(next_key(), ()))
+    assert 0.0 < random_u < 1.0, "random_u must be in (0, 1)"
+    spatial = x.shape[2:2 + n]
+    axes_bins = [
+        _fractional_bins(spatial[a], output_size[a], random_u,
+                         ks[a] or 0) for a in range(n)]
+
+    def f(v):
+        lin = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+        outs, idxs = [], []
+        import itertools
+        for cells in itertools.product(*[range(o) for o in output_size]):
+            sl = (Ellipsis,) + tuple(
+                slice(*axes_bins[a][cells[a]]) for a in range(n))
+            patch = v[sl].reshape(v.shape[:2] + (-1,))
+            outs.append(jnp.max(patch, axis=-1))
+            if return_mask:
+                win = lin[tuple(slice(*axes_bins[a][cells[a]])
+                                for a in range(n))].reshape(-1)
+                idxs.append(win[jnp.argmax(patch, axis=-1)])
+        out = jnp.stack(outs, -1).reshape(v.shape[:2] + tuple(output_size))
+        if not return_mask:
+            return out
+        idx = jnp.stack(idxs, -1).reshape(
+            v.shape[:2] + tuple(output_size)).astype(jnp.int32)
+        return out, idx
+    if return_mask:
+        return dispatch(f, (x,), name=name, multi_output=True)
+    return dispatch(f, (x,), name=name)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """reference: ops.yaml fractional_max_pool2d (funcs/pooling.h
+    fractional index math); ``random_u`` fixes the pseudo-random grid,
+    else one is drawn from the framework RNG."""
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 2, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool(x, output_size, kernel_size, random_u,
+                                return_mask, 3, "fractional_max_pool3d")

@@ -1,5 +1,6 @@
 """paddle_tpu.optimizer (reference: python/paddle/optimizer/__init__.py)."""
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import (SGD, Momentum, Adam, AdamW, Adamax, Adagrad,  # noqa
-                         Adadelta, RMSProp, Lamb, NAdam, RAdam, LBFGS)
+                         Adadelta, RMSProp, Lamb, NAdam, RAdam, LBFGS,
+                         ASGD, Rprop)
 from . import lr  # noqa: F401

@@ -390,3 +390,91 @@ class LBFGS(Optimizer):
         self._prev_flat_grad = g
         self._global_step += 1
         return loss
+
+
+class ASGD(Optimizer):
+    """reference: python/paddle/optimizer/asgd.py — SAG-style averaged
+    gradient: d = d - y_i + g; y_i = g; x -= lr * (d / min(m+1, n) +
+    wd * x), with i = m % batch_num cycling over per-batch gradient
+    slots."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        assert batch_num >= 1
+        self._batch_num = int(batch_num)
+        self._multi_precision = multi_precision
+
+    def _use_master_weights(self):
+        return self._multi_precision
+
+    def _accumulator_names(self):
+        return ["d", "ys"]
+
+    def _init_accumulator(self, name, p):
+        from ..core.tensor import to_value
+        v = to_value(p)
+        if name == "ys":
+            return jnp.zeros((self._batch_num,) + v.shape, jnp.float32)
+        return jnp.zeros(v.shape, jnp.float32)
+
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        n = self._batch_num
+        m = step - 1                      # step is 1-based
+        i = jnp.mod(m, n)
+        p32 = master if master is not None else _f32(p)
+        g32 = _f32(g)
+        y_i = accs["ys"][i] if n > 1 else accs["ys"][0]
+        d = accs["d"] - y_i + g32
+        ys = accs["ys"].at[i].set(g32)
+        denom = jnp.minimum(jnp.asarray(m + 1, jnp.float32), float(n))
+        new_p32 = p32 - lr * (d / denom + wd * p32)
+        return new_p32.astype(p.dtype), {"d": d, "ys": ys}, (
+            new_p32 if master is not None else None)
+
+
+class Rprop(Optimizer):
+    """reference: python/paddle/optimizer/rprop.py +
+    phi/kernels/cpu/rprop_kernel.cc — resilient backprop: per-weight
+    step sizes grown by eta+ on gradient sign agreement, shrunk by eta-
+    on sign flip (and that step's gradient zeroed), clipped to
+    learning_rate_range; update is -sign(g) * step."""
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+        self._init_lr = learning_rate
+        self._multi_precision = multi_precision
+
+    def _use_master_weights(self):
+        return self._multi_precision
+
+    def _accumulator_names(self):
+        return ["prev", "step_size"]
+
+    def _init_accumulator(self, name, p):
+        from ..core.tensor import to_value
+        v = to_value(p)
+        if name == "step_size":
+            return jnp.full(v.shape, float(self._init_lr), jnp.float32)
+        return jnp.zeros(v.shape, jnp.float32)
+
+    def _update(self, p, g, accs, lr, wd, master=None, step=None):
+        p32 = master if master is not None else _f32(p)
+        g32 = _f32(g)
+        prod = g32 * accs["prev"]
+        eta = jnp.where(prod > 0, self._eta_pos,
+                        jnp.where(prod < 0, self._eta_neg, 1.0))
+        g_eff = jnp.where(prod < 0, 0.0, g32)   # sign flip: skip step
+        step_size = jnp.clip(accs["step_size"] * eta,
+                             self._lr_min, self._lr_max)
+        new_p32 = p32 - jnp.sign(g_eff) * step_size
+        return new_p32.astype(p.dtype), {"prev": g_eff,
+                                         "step_size": step_size}, (
+            new_p32 if master is not None else None)

@@ -829,3 +829,29 @@ def tensor_array_to_tensor(input, axis=1, use_stack=False, name=None):
             else jnp.concatenate(vs, axis=axis)
     return dispatch(f, tuple(ts), name="tensor_array_to_tensor"), \
         Tensor(jnp.asarray(sizes), stop_gradient=True)
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search back-trace (reference:
+    phi/kernels/cpu/gather_tree_kernel.cc): out[T-1] = ids[T-1]; walking
+    backward, each step reads ids at the parent beam of the step below.
+    ids/parents: [max_time, batch, beam]."""
+    def f(iv, pv):
+        T = iv.shape[0]
+
+        def step(parent, t):
+            # parent: [batch, beam] beam index to read at step t
+            row = jnp.take_along_axis(iv[t], parent, axis=-1)
+            new_parent = jnp.take_along_axis(pv[t], parent, axis=-1)
+            return new_parent, row
+
+        beam0 = jnp.broadcast_to(
+            jnp.arange(iv.shape[2], dtype=iv.dtype)[None, :],
+            iv.shape[1:])
+        last = iv[T - 1]
+        parent = jnp.take_along_axis(pv[T - 1], beam0, axis=-1)
+        _, rows = jax.lax.scan(step, parent,
+                               jnp.arange(T - 2, -1, -1))
+        return jnp.concatenate([jnp.flip(rows, 0), last[None]], axis=0)
+    return dispatch(f, (_ensure(ids), _ensure(parents)),
+                    name="gather_tree")

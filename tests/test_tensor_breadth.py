@@ -406,3 +406,93 @@ class TestRound3LongTail:
         back = S.istft(n_fft=64, hop_length=16, length=256)
         err = np.abs(n(back) - sig)[32:-32].max()
         assert err < 1e-3
+
+
+class TestRound3Extras:
+    """gather_tree, fractional pooling, ASGD/Rprop optimizers
+    (reference: gather_tree_kernel.cc, funcs/pooling.h fractional index
+    math, optimizer/asgd.py, cpu/rprop_kernel.cc)."""
+
+    def test_gather_tree_matches_reference_loop(self):
+        from paddle_tpu.tensor.manipulation import gather_tree
+        rng = np.random.RandomState(0)
+        T, B, W = 5, 3, 4
+        ids = rng.randint(0, 9, (T, B, W)).astype(np.int64)
+        par = rng.randint(0, W, (T, B, W)).astype(np.int64)
+        out = n(gather_tree(t(ids), t(par)))
+        ref = np.zeros_like(ids)
+        for b in range(B):
+            for w in range(W):
+                ref[T - 1, b, w] = ids[T - 1, b, w]
+                parent = par[T - 1, b, w]
+                for s in range(T - 2, -1, -1):
+                    ref[s, b, w] = ids[s, b, parent]
+                    parent = par[s, b, parent]
+        np.testing.assert_array_equal(out, ref)
+
+    def test_fractional_max_pool(self):
+        import paddle_tpu.nn.functional as F
+        x = RNG.randn(2, 3, 9, 9).astype(np.float32)
+        o1 = n(F.fractional_max_pool2d(t(x), 4, random_u=0.3))
+        o2 = n(F.fractional_max_pool2d(t(x), 4, random_u=0.3))
+        np.testing.assert_array_equal(o1, o2)     # u fixes the grid
+        assert o1.shape == (2, 3, 4, 4)
+        ov, om = F.fractional_max_pool2d(t(x), 4, random_u=0.3,
+                                         return_mask=True)
+        ov, om = n(ov), n(om)
+        flat = x.reshape(2, 3, 81)
+        np.testing.assert_allclose(
+            np.take_along_axis(flat, om.reshape(2, 3, -1),
+                               -1).reshape(ov.shape), ov)
+        # kernel_size form uses u directly
+        ok = n(F.fractional_max_pool2d(t(x), 4, kernel_size=2,
+                                       random_u=0.7))
+        assert ok.shape == (2, 3, 4, 4)
+
+    def test_asgd_batchnum1_is_sgd_with_decay(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        lin = nn.Linear(4, 2)
+        w0 = n(lin.weight).copy()
+        opt = paddle.optimizer.ASGD(0.1, parameters=lin.parameters())
+        x = t(np.ones((2, 4), np.float32))
+        out = lin(x)
+        out.sum().backward()
+        g = n(lin.weight.grad)
+        opt.step()
+        np.testing.assert_allclose(n(lin.weight), w0 - 0.1 * g, atol=1e-6)
+
+    def test_rprop_sign_adaptation(self):
+        import paddle_tpu.nn as nn
+        paddle.seed(0)
+        lin = nn.Linear(2, 1, bias_attr=False)
+        opt = paddle.optimizer.Rprop(0.01, parameters=lin.parameters(),
+                                     etas=(0.5, 1.2))
+        x = t(np.ones((1, 2), np.float32))
+        w_hist = [n(lin.weight).copy()]
+        for _ in range(3):
+            lin(x).sum().backward()   # constant positive gradient
+            opt.step()
+            opt.clear_grad()
+            w_hist.append(n(lin.weight).copy())
+        d1 = np.abs(w_hist[1] - w_hist[0])
+        d2 = np.abs(w_hist[2] - w_hist[1])
+        d3 = np.abs(w_hist[3] - w_hist[2])
+        np.testing.assert_allclose(d1, 0.01, atol=1e-6)  # initial step
+        np.testing.assert_allclose(d2, 0.012, atol=1e-6)  # * eta+
+        np.testing.assert_allclose(d3, 0.0144, atol=1e-6)
+        # loss decreases on a quadratic with sign flips handled
+        paddle.seed(1)
+        lin2 = nn.Linear(4, 1)
+        opt2 = paddle.optimizer.Rprop(0.01, parameters=lin2.parameters())
+        xv = t(RNG.randn(16, 4).astype(np.float32))
+        yv = t(RNG.randn(16, 1).astype(np.float32))
+        import paddle_tpu.nn.functional as F
+        losses = []
+        for _ in range(20):
+            loss = F.mse_loss(lin2(xv), yv)
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
