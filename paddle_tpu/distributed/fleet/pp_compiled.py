@@ -162,11 +162,18 @@ class Compiled1F1B:
     ``loss_and_grads(stage_params, x, labels)`` with x/labels
     micro-batched ``[M, mb, ...]`` returns ``(loss, grads)`` with grads
     shaped like ``stage_params`` (leading [S] axis sharded over ``pp``).
+
+    ``data_axis`` enables hybrid pp x dp (reference
+    HybridCommunicateGroup pp+dp orchestration, topology.py): the
+    per-microbatch batch dim (dim 1 of x/labels) is sharded over that
+    mesh axis, every dp shard runs the full 1F1B schedule on its slice,
+    and grads/loss are averaged over ``data_axis`` in-graph (the
+    compiled analogue of the reference's EagerReducer allreduce).
     """
 
     def __init__(self, stage_fn: Callable, loss_fn: Callable, mesh: Mesh,
                  num_microbatches: int, axis: str = "pp",
-                 split_dw: bool = False):
+                 split_dw: bool = False, data_axis: str | None = None):
         self.stage_fn = stage_fn
         self.loss_fn = loss_fn
         self.mesh = mesh
@@ -174,6 +181,7 @@ class Compiled1F1B:
         self.num_stages = mesh.shape[axis]
         self.num_microbatches = num_microbatches
         self.split_dw = split_dw
+        self.data_axis = data_axis
 
     def loss_and_grads(self, stage_params, x, labels):
         S = self.num_stages
@@ -268,12 +276,20 @@ class Compiled1F1B:
             # loss lives on the last stage (others contributed 0); the
             # accumulator summed M per-microbatch losses -> average
             loss = jax.lax.psum(loss_acc, axis) / M
+            if self.data_axis is not None:
+                # per-shard loss_fn already averaged over its mb slice, so
+                # the global loss/grads are the dp-mean of shard values
+                n_dp = jax.lax.psum(1, self.data_axis)
+                loss = jax.lax.psum(loss, self.data_axis) / n_dp
+                grads = jax.tree_util.tree_map(
+                    lambda g: jax.lax.psum(g, self.data_axis) / n_dp, grads)
             grads = jax.tree_util.tree_map(lambda g: g[None], grads)
             return loss, grads
 
         spec_p = jax.tree_util.tree_map(lambda _: P(axis), stage_params)
-        fn = _shard_map_norep(device_prog, self.mesh, (spec_p, P(), P()),
-                              (P(), spec_p))
+        spec_x = P(None, self.data_axis) if self.data_axis else P()
+        fn = _shard_map_norep(device_prog, self.mesh,
+                              (spec_p, spec_x, spec_x), (P(), spec_p))
         return fn(stage_params, x, labels)
 
 

@@ -423,7 +423,8 @@ class ASGD(Optimizer):
     def _update(self, p, g, accs, lr, wd, master=None, step=None):
         n = self._batch_num
         m = step - 1                      # step is 1-based
-        i = jnp.mod(m, n)
+        # base Optimizer passes step as float32; an indexer must be integer
+        i = jnp.mod(m, n).astype(jnp.int32)
         p32 = master if master is not None else _f32(p)
         g32 = _f32(g)
         y_i = accs["ys"][i] if n > 1 else accs["ys"][0]

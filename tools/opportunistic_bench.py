@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Session-long opportunistic TPU bench capture.
+
+The axon TPU tunnel wedges unpredictably (observed: ``jax.devices()``
+hangs forever in client init), and waiting for the driver's single
+end-of-round ``bench.py`` run to coincide with a healthy tunnel has
+failed for two rounds straight. This prober runs for the whole session:
+
+- every ``OPP_INTERVAL`` seconds it attempts the cheap device probe in a
+  subprocess with a hard timeout (the wedge cannot be timed out
+  in-process — client init blocks in C++);
+- every attempt is appended to ``BENCH_PROBE_LOG.jsonl`` with a
+  timestamp, so even a dead-all-day tunnel leaves evidence;
+- the first time a probe succeeds it runs the full bench pack (resnet,
+  llama-MFU, Pallas kernels compiled on chip, ernie decode, SD-UNet,
+  BERT) config by config, persisting ``BENCH_OPPORTUNISTIC.json`` after
+  every config so a mid-capture wedge still leaves the configs that
+  finished;
+- if the tunnel dies mid-pack, the remaining configs stay pending and
+  capture resumes at the next healthy probe;
+- ``bench.py`` serves the freshest captured result (flagged with its
+  age) whenever its own live probe fails.
+
+Run detached:  nohup python tools/opportunistic_bench.py &
+"""
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402  (repo-root bench.py; only uses _spawn)
+
+LOG = os.path.join(ROOT, "BENCH_PROBE_LOG.jsonl")
+OUT = os.path.join(ROOT, "BENCH_OPPORTUNISTIC.json")
+
+# (config, timeout_sec, max_attempts)
+PACK = [
+    ("resnet50", 1500, 3),
+    ("llama", 1500, 3),
+    ("kernels", 1200, 3),
+    ("ernie_infer", 900, 2),
+    ("sd_unet", 900, 2),
+    ("bert", 900, 2),
+]
+
+
+def log(rec):
+    rec = dict(rec, t=round(time.time(), 1),
+               iso=time.strftime("%Y-%m-%dT%H:%M:%S"))
+    with open(LOG, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    f2 = sys.stdout
+    print(json.dumps(rec), file=f2, flush=True)
+
+
+def load_results():
+    try:
+        with open(OUT) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def save_results(res):
+    res["t"] = round(time.time(), 1)
+    res["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(res, f, indent=1)
+    os.replace(tmp, OUT)
+
+
+def main():
+    budget = float(os.environ.get("OPP_TOTAL_HOURS", "11")) * 3600
+    interval = float(os.environ.get("OPP_INTERVAL", "180"))
+    probe_timeout = int(os.environ.get("OPP_PROBE_TIMEOUT", "150"))
+    t0 = time.time()
+
+    results = load_results()
+    attempts = {name: 0 for name, _, _ in PACK}
+    pending = [name for name, _, _ in PACK
+               if not (isinstance(results.get(name), dict)
+                       and "error" not in results[name])]
+    n_probe = 0
+    log({"event": "start", "pending": pending})
+
+    while time.time() - t0 < budget:
+        n_probe += 1
+        r = bench._spawn("probe", probe_timeout)
+        ok = "error" not in r
+        log({"event": "probe", "n": n_probe, "ok": ok,
+             **({"device": r.get("device")} if ok
+                else {"error": r.get("error", "")[:160]})})
+        if not ok:
+            time.sleep(interval)
+            continue
+
+        if not pending:
+            log({"event": "done", "probes": n_probe})
+            return 0
+
+        name = pending[0]
+        timeout = next(t for n, t, _ in PACK if n == name)
+        max_att = next(m for n, _, m in PACK if n == name)
+        attempts[name] += 1
+        t_cfg = time.time()
+        r = bench._spawn(name, timeout)
+        ok_cfg = "error" not in r
+        log({"event": "config", "name": name, "ok": ok_cfg,
+             "secs": round(time.time() - t_cfg, 1),
+             "attempt": attempts[name],
+             **({} if ok_cfg else {"error": r.get("error", "")[:200]})})
+        if ok_cfg or attempts[name] >= max_att:
+            results[name] = r
+            results[name + "_iso"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            save_results(results)
+            pending.pop(0)
+        # on failure below max attempts: re-probe first (the tunnel may
+        # have wedged mid-config), then retry
+        if not pending:
+            log({"event": "pack_complete", "probes": n_probe})
+            return 0
+
+    log({"event": "gave_up", "probes": n_probe, "pending": pending})
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
