@@ -1,0 +1,240 @@
+"""Device-side (jit-able) NMS family.
+
+The reference runs NMS on device (paddle/phi/kernels/gpu/nms_kernel.cu;
+multiclass_nms3 in ops.yaml). The host-side implementations in
+``vision/ops.py`` keep the reference's dynamic-output API, but a dynamic
+output can't live inside an XLA program, so detection models paid a
+host round-trip per image. These fixed-size variants are the TPU-native
+form: top-k pre-selection, a padded greedy suppression loop via
+``lax.fori_loop`` over the score-sorted candidates, and mask-and-count
+outputs (pad index = -1, invalid rows zeroed) so the whole detector —
+backbone to final detections — compiles as ONE jit program.
+
+Conventions shared by all functions here:
+- outputs are padded to a static ``max_out``/``keep_top_k`` with a
+  count of valid rows; the caller slices ``out[:num]`` on host when a
+  dynamic result is wanted;
+- score order is descending and ties break toward the lower index
+  (jax.lax.top_k semantics), matching ``np.argsort(-s)`` up to ties.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["nms_padded", "multiclass_nms_padded", "matrix_nms_padded",
+           "ppyoloe_postprocess"]
+
+
+def _iou_matrix(b, normalized=True):
+    off = 0.0 if normalized else 1.0   # pixel boxes are inclusive
+    area = (b[:, 2] - b[:, 0] + off) * (b[:, 3] - b[:, 1] + off)
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    whi = jnp.clip(rb - lt + off, 0, None)
+    inter = whi[..., 0] * whi[..., 1]
+    return inter / jnp.clip(area[:, None] + area[None, :] - inter,
+                            1e-10, None)
+
+
+def _greedy_keep(iou, valid, thr0, eta=1.0, same_cat=None):
+    """Greedy suppression over score-DESC-sorted candidates.
+
+    iou [N, N]; valid [N] bool; returns kept [N] bool. Sequential in the
+    candidate index (as greedy NMS fundamentally is) but each step is a
+    vector op, so the scan compiles to N fused VPU steps — no host trip.
+    ``eta`` reproduces the reference's adaptive threshold (nms_eta<1
+    shrinks thr after each kept box once thr > 0.5).
+    """
+    n = iou.shape[0]
+
+    def body(i, carry):
+        kept, thr = carry
+        row = iou[i]
+        if same_cat is not None:
+            row = jnp.where(same_cat[i], row, 0.0)
+        overlap = jnp.any((row > thr) & kept)
+        keep_i = valid[i] & ~overlap
+        kept = kept.at[i].set(keep_i)
+        if eta < 1.0:
+            thr = jnp.where(keep_i & (thr > 0.5), thr * eta, thr)
+        return kept, thr
+
+    kept0 = jnp.zeros((n,), bool)
+    kept, _ = lax.fori_loop(0, n, body, (kept0, jnp.asarray(thr0,
+                                                            jnp.float32)))
+    return kept
+
+
+@partial(jax.jit, static_argnames=("max_out", "normalized", "pre_top_k"))
+def nms_padded(boxes, scores, iou_threshold=0.3, category_idxs=None,
+               score_threshold=None, max_out=256, normalized=True,
+               pre_top_k=None):
+    """Jit-able single-image NMS (device analogue of ``vision.ops.nms``).
+
+    boxes [M, 4], scores [M] (required — device form always sorts),
+    optional category_idxs [M] for per-class suppression. Returns
+    ``(keep [max_out] int32, num int32)``: indices into the INPUT boxes,
+    -1 padded past ``num``; ``num <= max_out`` (extra survivors beyond
+    ``max_out`` are dropped, like the host path's ``top_k=``).
+
+    ``pre_top_k`` caps the suppression to the top-scoring candidates
+    before the IoU matrix is built — both memory (pre_top_k^2) and the
+    sequential loop length are bounded by it. Default: all M boxes
+    (exact host parity).
+    """
+    m = boxes.shape[0]
+    k = min(max_out if max_out is not None else m, m)
+    n_cand = min(pre_top_k, m) if pre_top_k else m
+    s = scores.astype(jnp.float32)
+    valid = jnp.isfinite(s)
+    if score_threshold is not None:
+        valid &= s > score_threshold
+    top_s, order = lax.top_k(jnp.where(valid, s, -jnp.inf), n_cand)
+    b = boxes[order]
+    iou = _iou_matrix(b, normalized)
+    same_cat = None
+    if category_idxs is not None:
+        c = category_idxs[order]
+        same_cat = c[:, None] == c[None, :]
+    kept = _greedy_keep(iou, jnp.isfinite(top_s), iou_threshold,
+                        same_cat=same_cat)
+    # compact kept indices to the front, in score order
+    rank_s = jnp.where(kept, top_s, -jnp.inf)
+    _, sel = lax.top_k(rank_s, min(k, n_cand))
+    sel_valid = kept[sel]
+    keep_idx = jnp.where(sel_valid, order[sel], -1).astype(jnp.int32)
+    if keep_idx.shape[0] < k:
+        keep_idx = jnp.pad(keep_idx, (0, k - keep_idx.shape[0]),
+                           constant_values=-1)
+    num = jnp.minimum(jnp.sum(kept), k).astype(jnp.int32)
+    return keep_idx, num
+
+
+def _per_class_greedy(b_img, s_img, score_threshold, nms_top_k,
+                      nms_threshold, nms_eta, normalized):
+    """One image, one class: s_img [M]. Returns (scores [K1], box_idx
+    [K1]) with suppressed/invalid entries at -inf, K1 = min(nms_top_k, M)."""
+    m = s_img.shape[0]
+    k1 = min(nms_top_k, m) if nms_top_k and nms_top_k > 0 else m
+    valid = s_img > score_threshold
+    top_s, order = lax.top_k(jnp.where(valid, s_img, -jnp.inf), k1)
+    b = b_img[order]
+    kept = _greedy_keep(_iou_matrix(b, normalized), jnp.isfinite(top_s),
+                        nms_threshold, eta=nms_eta)
+    return jnp.where(kept, top_s, -jnp.inf), order
+
+
+def _per_class_matrix(b_img, s_img, score_threshold, nms_top_k,
+                      post_threshold, use_gaussian, gaussian_sigma,
+                      normalized):
+    """Matrix NMS decay for one image/class (SOLOv2 eq.; mirrors the
+    host path in vision/ops.py matrix_nms). Fully parallel."""
+    m = s_img.shape[0]
+    k1 = min(nms_top_k, m) if nms_top_k and nms_top_k > 0 else m
+    valid = s_img > score_threshold
+    top_s, order = lax.top_k(jnp.where(valid, s_img, -jnp.inf), k1)
+    vmask = jnp.isfinite(top_s)
+    b = b_img[order]
+    iou = _iou_matrix(b, normalized)
+    iou = jnp.triu(iou, 1) * (vmask[:, None] & vmask[None, :])
+    iou_cmax = iou.max(axis=0)
+    if use_gaussian:
+        decay = jnp.exp(-(iou ** 2 - iou_cmax[:, None] ** 2)
+                        / gaussian_sigma).min(axis=0)
+    else:
+        decay = ((1 - iou) / jnp.clip(1 - iou_cmax[:, None], 1e-10,
+                                      None)).min(axis=0)
+    ds = top_s * jnp.minimum(decay, 1.0)
+    ds = jnp.where(vmask & (ds >= post_threshold), ds, -jnp.inf)
+    return ds, order
+
+
+def _gather_dets(bb, per_class, keep_top_k, background_label):
+    """Shared tail for the multiclass variants: per_class (scores [C, K1],
+    box_idx [C, K1]) -> (out [keep_top_k, 6], index [keep_top_k],
+    num). Class ``background_label`` is excluded."""
+    sc, order = per_class
+    C, K1 = sc.shape
+    if background_label is not None and 0 <= background_label < C:
+        sc = sc.at[background_label].set(-jnp.inf)
+    flat_s = sc.reshape(-1)
+    kk = min(keep_top_k, flat_s.shape[0]) if keep_top_k and keep_top_k > 0 \
+        else flat_s.shape[0]
+    top_s, flat_i = lax.top_k(flat_s, kk)
+    cls = (flat_i // K1).astype(jnp.float32)
+    box_i = order.reshape(-1)[flat_i]
+    fin = jnp.isfinite(top_s)
+    rows = jnp.concatenate(
+        [jnp.where(fin, cls, 0.0)[:, None],
+         jnp.where(fin, top_s, 0.0)[:, None],
+         jnp.where(fin[:, None], bb[box_i], 0.0)], axis=1)
+    index = jnp.where(fin, box_i, -1).astype(jnp.int32)
+    return rows, index, jnp.sum(fin).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=(
+    "nms_top_k", "keep_top_k", "normalized", "nms_eta",
+    "background_label"))
+def multiclass_nms_padded(bboxes, scores, score_threshold=0.05,
+                          nms_top_k=1000, keep_top_k=100,
+                          nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                          background_label=0):
+    """Device multiclass_nms3: bboxes [B, M, 4], scores [B, C, M] ->
+    (out [B, keep_top_k, 6] (cls, score, x1..y2; zero rows past num),
+    index [B, keep_top_k] int32 into the flattened [B*M] boxes (-1 pad),
+    nums [B] int32). Reference: ops.yaml multiclass_nms3 /
+    phi/kernels/fusion/gpu multiclass nms; host analogue
+    vision/ops.py:multiclass_nms.
+    """
+    def one_img(b_img, s_img):
+        per = jax.vmap(lambda s: _per_class_greedy(
+            b_img, s, score_threshold, nms_top_k, nms_threshold, nms_eta,
+            normalized))(s_img)
+        return _gather_dets(b_img, per, keep_top_k, background_label)
+
+    out, index, nums = jax.vmap(one_img)(bboxes, scores)
+    m = bboxes.shape[1]
+    offs = (jnp.arange(bboxes.shape[0], dtype=jnp.int32) * m)[:, None]
+    index = jnp.where(index >= 0, index + offs, -1)
+    return out, index, nums
+
+
+@partial(jax.jit, static_argnames=(
+    "nms_top_k", "keep_top_k", "use_gaussian", "background_label",
+    "normalized"))
+def matrix_nms_padded(bboxes, scores, score_threshold, post_threshold=0.0,
+                      nms_top_k=400, keep_top_k=200, use_gaussian=False,
+                      gaussian_sigma=2.0, background_label=0,
+                      normalized=True):
+    """Device matrix NMS (SOLOv2 decay; host analogue
+    vision/ops.py:matrix_nms). Same padded returns as
+    multiclass_nms_padded."""
+    def one_img(b_img, s_img):
+        per = jax.vmap(lambda s: _per_class_matrix(
+            b_img, s, score_threshold, nms_top_k, post_threshold,
+            use_gaussian, gaussian_sigma, normalized))(s_img)
+        return _gather_dets(b_img, per, keep_top_k, background_label)
+
+    out, index, nums = jax.vmap(one_img)(bboxes, scores)
+    m = bboxes.shape[1]
+    offs = (jnp.arange(bboxes.shape[0], dtype=jnp.int32) * m)[:, None]
+    index = jnp.where(index >= 0, index + offs, -1)
+    return out, index, nums
+
+
+def ppyoloe_postprocess(cls_scores, boxes, score_threshold=0.25,
+                        iou_threshold=0.6, max_dets=100, nms_top_k=1000):
+    """PP-YOLOE post-processing entirely on device: cls_scores [B, A, C],
+    boxes [B, A, 4] -> (dets [B, max_dets, 6], nums [B]). Composable
+    under an outer jit with the model forward (BASELINE config 5: no
+    host round-trip in the detect path)."""
+    out, _, nums = multiclass_nms_padded(
+        boxes, jnp.swapaxes(cls_scores, 1, 2),
+        score_threshold=score_threshold, nms_top_k=nms_top_k,
+        keep_top_k=max_dets, nms_threshold=iou_threshold,
+        background_label=-1)
+    return out, nums

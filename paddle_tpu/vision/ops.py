@@ -7,6 +7,8 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor, dispatch, to_value
+from .nms_device import (matrix_nms_padded, multiclass_nms_padded,
+                         nms_padded)
 
 
 def _ensure(x):
@@ -14,7 +16,9 @@ def _ensure(x):
 
 __all__ = ["nms", "box_coder", "roi_align", "roi_pool", "yolo_box",
            "generate_proposals", "prior_box", "matrix_nms",
-           "multiclass_nms", "distribute_fpn_proposals", "psroi_pool", "deform_conv2d"]
+           "multiclass_nms", "distribute_fpn_proposals", "psroi_pool",
+           "deform_conv2d", "nms_padded", "multiclass_nms_padded",
+           "matrix_nms_padded"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,

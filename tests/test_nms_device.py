@@ -1,0 +1,191 @@
+"""Device-side (jit-able) NMS family vs the host reference
+implementations (reference: phi/kernels/gpu/nms_kernel.cu,
+ops.yaml multiclass_nms3 / matrix_nms)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.vision import ops as vops
+from paddle_tpu.vision.nms_device import (matrix_nms_padded,
+                                          multiclass_nms_padded, nms_padded)
+
+def _rand_boxes(m, scale=40.0, seed=0):
+    r = np.random.RandomState(seed)
+    xy = r.rand(m, 2) * scale
+    wh = r.rand(m, 2) * 12 + 0.5
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+class TestNmsPadded:
+    def test_matches_host_nms(self):
+        b = _rand_boxes(64, seed=7)
+        s = np.random.RandomState(8).rand(64).astype(np.float32)
+        keep_host = np.asarray(vops.nms(b, iou_threshold=0.4,
+                                        scores=s).numpy())
+        keep_dev, num = nms_padded(jnp.asarray(b), jnp.asarray(s),
+                                   iou_threshold=0.4, max_out=64)
+        keep_dev = np.asarray(keep_dev)[:int(num)]
+        np.testing.assert_array_equal(keep_dev, keep_host)
+
+    def test_categories_suppress_within_class_only(self):
+        b = _rand_boxes(48, seed=9)
+        s = np.random.RandomState(10).rand(48).astype(np.float32)
+        cat = np.random.RandomState(11).randint(0, 3, 48)
+        keep_host = np.asarray(vops.nms(b, iou_threshold=0.3, scores=s,
+                                        category_idxs=cat).numpy())
+        keep_dev, num = nms_padded(jnp.asarray(b), jnp.asarray(s),
+                                   iou_threshold=0.3,
+                                   category_idxs=jnp.asarray(cat),
+                                   max_out=48)
+        np.testing.assert_array_equal(np.asarray(keep_dev)[:int(num)],
+                                      keep_host)
+
+    def test_top_k_and_padding(self):
+        b = _rand_boxes(32, seed=12)
+        s = np.random.RandomState(13).rand(32).astype(np.float32)
+        keep, num = nms_padded(jnp.asarray(b), jnp.asarray(s),
+                               iou_threshold=0.99, max_out=8)
+        assert keep.shape == (8,)
+        # iou 0.99 keeps nearly everything -> survivors overflow max_out;
+        # num is clamped to the slots actually returned
+        assert int(num) == 8
+        assert (np.asarray(keep) >= 0).all()
+
+    def test_pre_top_k_bounds_candidates(self):
+        b = _rand_boxes(64, seed=17)
+        s = np.random.RandomState(18).rand(64).astype(np.float32)
+        # pre_top_k == M is exact; smaller pre_top_k considers only the
+        # top-scored candidates (host analogue: nms_top_k pre-selection)
+        full, n_full = nms_padded(jnp.asarray(b), jnp.asarray(s),
+                                  iou_threshold=0.4, max_out=64,
+                                  pre_top_k=64)
+        capped, n_cap = nms_padded(jnp.asarray(b), jnp.asarray(s),
+                                   iou_threshold=0.4, max_out=64,
+                                   pre_top_k=16)
+        assert int(n_cap) <= 16
+        kept_full = set(np.asarray(full)[:int(n_full)].tolist())
+        kept_cap = np.asarray(capped)[:int(n_cap)].tolist()
+        top16 = set(np.argsort(-s)[:16].tolist())
+        assert set(kept_cap) <= top16
+        # candidates surviving in the capped run also survive the full run
+        assert set(kept_cap) <= kept_full
+
+    def test_score_threshold(self):
+        b = _rand_boxes(16, seed=14)
+        s = np.linspace(0, 1, 16).astype(np.float32)
+        keep, num = nms_padded(jnp.asarray(b), jnp.asarray(s),
+                               iou_threshold=1.0, score_threshold=0.5,
+                               max_out=16)
+        kept = np.asarray(keep)[:int(num)]
+        assert (s[kept] > 0.5).all()
+
+    def test_works_under_outer_jit(self):
+        b = jnp.asarray(_rand_boxes(16, seed=15))
+        s = jnp.asarray(np.random.RandomState(16).rand(16), jnp.float32)
+
+        @jax.jit
+        def f(b, s):
+            keep, num = nms_padded(b, s, iou_threshold=0.4, max_out=16)
+            return keep, num
+
+        keep, num = f(b, s)
+        assert int(num) > 0
+
+
+def _mc_host_as_sets(out, nums, index):
+    """(cls, score, idx) tuples per image from the host return."""
+    out = np.asarray(out.numpy()).reshape(-1, 6)
+    nums = np.asarray(nums.numpy())
+    index = np.asarray(index.numpy())
+    res, p = [], 0
+    for n in nums:
+        rows = out[p:p + n]
+        idx = index[p:p + n]
+        res.append(sorted((int(r[0]), round(float(r[1]), 5), int(i))
+                          for r, i in zip(rows, idx)))
+        p += n
+    return res
+
+
+def _mc_dev_as_sets(out, nums, index):
+    out, nums, index = map(np.asarray, (out, nums, index))
+    res = []
+    for b in range(out.shape[0]):
+        n = int(nums[b])
+        res.append(sorted((int(out[b, i, 0]), round(float(out[b, i, 1]), 5),
+                           int(index[b, i])) for i in range(n)))
+    return res
+
+
+class TestMulticlassNmsPadded:
+    def _data(self, B=2, M=40, C=4, seed=21):
+        r = np.random.RandomState(seed)
+        bb = np.stack([_rand_boxes(M, seed=seed + i) for i in range(B)])
+        sc = r.rand(B, C, M).astype(np.float32)
+        return bb, sc
+
+    def test_matches_host(self):
+        bb, sc = self._data()
+        host = vops.multiclass_nms(bb, sc, score_threshold=0.3,
+                                   nms_top_k=20, keep_top_k=12,
+                                   nms_threshold=0.45, return_index=True)
+        dev = multiclass_nms_padded(jnp.asarray(bb), jnp.asarray(sc),
+                                    score_threshold=0.3, nms_top_k=20,
+                                    keep_top_k=12, nms_threshold=0.45)
+        assert _mc_host_as_sets(host[0], host[1], host[2]) == \
+            _mc_dev_as_sets(dev[0], dev[2], dev[1])
+
+    def test_adaptive_eta_matches_host(self):
+        bb, sc = self._data(seed=31)
+        host = vops.multiclass_nms(bb, sc, score_threshold=0.2,
+                                   nms_top_k=30, keep_top_k=16,
+                                   nms_threshold=0.7, nms_eta=0.9,
+                                   return_index=True)
+        dev = multiclass_nms_padded(jnp.asarray(bb), jnp.asarray(sc),
+                                    score_threshold=0.2, nms_top_k=30,
+                                    keep_top_k=16, nms_threshold=0.7,
+                                    nms_eta=0.9)
+        assert _mc_host_as_sets(host[0], host[1], host[2]) == \
+            _mc_dev_as_sets(dev[0], dev[2], dev[1])
+
+    def test_background_label_excluded(self):
+        bb, sc = self._data(seed=41)
+        sc[:, 0, :] = 0.99  # background class would dominate
+        dev = multiclass_nms_padded(jnp.asarray(bb), jnp.asarray(sc),
+                                    score_threshold=0.3, keep_top_k=10,
+                                    background_label=0)
+        out, nums = np.asarray(dev[0]), np.asarray(dev[2])
+        for b in range(out.shape[0]):
+            assert (out[b, :nums[b], 0] != 0).all()
+
+    def test_no_candidates_gives_zero(self):
+        bb, sc = self._data(seed=51)
+        dev = multiclass_nms_padded(jnp.asarray(bb), jnp.asarray(sc),
+                                    score_threshold=2.0, keep_top_k=10)
+        assert (np.asarray(dev[2]) == 0).all()
+        assert (np.asarray(dev[0]) == 0).all()
+        assert (np.asarray(dev[1]) == -1).all()
+
+
+class TestMatrixNmsPadded:
+    def _data(self, B=2, M=32, C=3, seed=61):
+        r = np.random.RandomState(seed)
+        bb = np.stack([_rand_boxes(M, seed=seed + i) for i in range(B)])
+        sc = r.rand(B, C, M).astype(np.float32)
+        return bb, sc
+
+    @pytest.mark.parametrize("gauss", [False, True])
+    def test_matches_host(self, gauss):
+        bb, sc = self._data(seed=61 + int(gauss))
+        host = vops.matrix_nms(bb, sc, score_threshold=0.4,
+                               post_threshold=0.2, nms_top_k=20,
+                               keep_top_k=10, use_gaussian=gauss,
+                               return_index=True)
+        dev = matrix_nms_padded(jnp.asarray(bb), jnp.asarray(sc),
+                                score_threshold=0.4, post_threshold=0.2,
+                                nms_top_k=20, keep_top_k=10,
+                                use_gaussian=gauss)
+        assert _mc_host_as_sets(host[0], host[1], host[2]) == \
+            _mc_dev_as_sets(dev[0], dev[2], dev[1])
