@@ -440,6 +440,54 @@ _PROGRAM_RECORDER = [None]
 # (reference: python/paddle/jit/sot/translate.py subgraph capture)
 _SEGMENT_RECORDER = [None]
 
+# control-flow closure capture (static/control_flow.py): while a branch
+# closure runs its discovery pass, every dispatched op reports its input
+# tensors so cond/while_loop can lift closure-captured externals into
+# explicit lax.cond/while operands. A stack — nested cond/while capture
+# into every enclosing recorder.
+_CAPTURE_RECORDERS: list = []
+
+
+class _ClosureCapture:
+    """Collects tensors read (but not produced) inside a region."""
+
+    def __init__(self):
+        self.external = {}   # id -> Tensor, insertion-ordered
+        self.produced = set()
+
+    def on_op(self, in_tensors, out_tensors):
+        for t in in_tensors:
+            if t is not None and id(t) not in self.produced:
+                self.external.setdefault(id(t), t)
+        self.produced.update(id(t) for t in out_tensors)
+
+    def __enter__(self):
+        _CAPTURE_RECORDERS.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _CAPTURE_RECORDERS.remove(self)
+
+
+class _pure_region:
+    """Run ops without program/segment recording and without autograd —
+    used while control-flow re-traces a branch closure inside lax.cond /
+    lax.while_loop (the outer dispatched op owns recording and AD)."""
+
+    def __enter__(self):
+        self._p = _PROGRAM_RECORDER[0]
+        self._s = _SEGMENT_RECORDER[0]
+        _PROGRAM_RECORDER[0] = None
+        _SEGMENT_RECORDER[0] = None
+        self._g = _grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        _PROGRAM_RECORDER[0] = self._p
+        _SEGMENT_RECORDER[0] = self._s
+        set_grad_enabled(self._g)
+
 
 def _dispatch_impl(fn, tensor_args: Sequence[Any], name: str = "op",
                    multi_output: bool = False, **static_kwargs):
@@ -452,6 +500,10 @@ def _dispatch_impl(fn, tensor_args: Sequence[Any], name: str = "op",
     """
     values = tuple(to_value(a) for a in tensor_args)
     tensors = tuple(a if isinstance(a, Tensor) else None for a in tensor_args)
+
+    if _CAPTURE_RECORDERS:
+        for _rec in _CAPTURE_RECORDERS:
+            _rec.on_op(tensors, ())
 
     # AMP O1: per-op cast at dispatch (reference: eager AmpAutoCast,
     # paddle/fluid/eager/amp_auto_cast.h)
@@ -475,6 +527,9 @@ def _dispatch_impl(fn, tensor_args: Sequence[Any], name: str = "op",
         result = tuple(
             Tensor(o, stop_gradient=True) if not isinstance(o, Tensor) else o
             for o in outs)
+        if _CAPTURE_RECORDERS:
+            for _rec in _CAPTURE_RECORDERS:
+                _rec.on_op((), result)
         if _PROGRAM_RECORDER[0] is not None:
             _PROGRAM_RECORDER[0]._record(name, fn, tensor_args, values,
                                          result, multi_output)
@@ -497,6 +552,9 @@ def _dispatch_impl(fn, tensor_args: Sequence[Any], name: str = "op",
         results.append(t)
     if GLOBAL_FLAGS.get("benchmark"):
         jax.block_until_ready(out_vals)
+    if _CAPTURE_RECORDERS:
+        for _rec in _CAPTURE_RECORDERS:
+            _rec.on_op((), results)
     if _PROGRAM_RECORDER[0] is not None:
         _PROGRAM_RECORDER[0]._record(name, fn, tensor_args, values,
                                      tuple(results), multi_output)

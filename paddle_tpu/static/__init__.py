@@ -21,6 +21,8 @@ __all__ = ["InputSpec", "export_stablehlo", "Executor",
            "Program", "program_guard", "data",
            "default_main_program", "default_startup_program", "nn"]
 
+from . import control_flow  # noqa: E402  (circular-free: uses core only)
+
 _static_mode = [False]
 
 
@@ -359,3 +361,15 @@ class _StaticNN:
 
 
 nn = _StaticNN()
+
+# control-flow API on the facade (reference: paddle.static.nn.cond /
+# while_loop / case / switch_case live in static/nn/control_flow.py)
+from .control_flow import (Assert, case, cond, switch_case,  # noqa: E402
+                           while_loop)
+
+nn.cond = cond
+nn.while_loop = while_loop
+nn.case = case
+nn.switch_case = switch_case
+nn.Assert = Assert
+nn.control_flow = control_flow

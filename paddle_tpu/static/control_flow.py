@@ -1,0 +1,382 @@
+"""Static control-flow API (reference:
+python/paddle/static/nn/control_flow.py — cond:1637, while_loop:755,
+case:1062, switch_case:1185, Assert:59).
+
+TPU-native design: the reference builds ConditionalBlock / While ops
+with sub-blocks in ProgramDesc; here a control-flow call becomes ONE
+dispatched op whose pure function lowers to ``lax.cond`` /
+``lax.while_loop`` / ``lax.switch``. Branch closures are
+*functionalized*: a discovery pass runs each branch once eagerly (the
+analogue of the reference's build-time block construction) while a
+dispatch-level capture recorder lifts every closure-captured external
+Tensor into an explicit operand, so the op records into the static
+Program, replays under jit with fed values, and — for ``cond`` /
+``switch_case`` — differentiates through ``lax.cond``'s native vjp.
+
+Like dygraph mode in the reference, a concrete (non-traced) predicate
+outside Program recording short-circuits to plain Python control flow.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import (Tensor, _CAPTURE_RECORDERS, _ClosureCapture,
+                           _PROGRAM_RECORDER, _SEGMENT_RECORDER,
+                           _pure_region, dispatch, to_value)
+
+__all__ = ["cond", "while_loop", "case", "switch_case", "Assert"]
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def _recording() -> bool:
+    return (_PROGRAM_RECORDER[0] is not None
+            or _SEGMENT_RECORDER[0] is not None)
+
+
+def _must_lower() -> bool:
+    """True when a concrete predicate may NOT short-circuit to Python:
+    while recording a Program/segment, and also while an enclosing
+    control-flow op runs its discovery pass (_CAPTURE_RECORDERS active) —
+    a nested cond that short-circuits there would bake its build-time
+    predicate into the outer lowered op instead of lifting it as an
+    operand."""
+    return _recording() or bool(_CAPTURE_RECORDERS)
+
+
+def _concrete(v) -> bool:
+    return not isinstance(v, jax.core.Tracer)
+
+
+def _flatten_out(out):
+    """Branch output -> (flat jax values, treedef). Tensors are leaves."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        out, is_leaf=_is_tensor_leaf)
+    vals = [to_value(x) if isinstance(x, Tensor) else jnp.asarray(x)
+            for x in leaves]
+    return vals, treedef
+
+
+def _discover(fn: Callable, args: Sequence = ()):
+    """Discovery pass: run ``fn`` once eagerly, collecting the external
+    tensors its closure reads (the reference's build-time sub-block
+    construction also executes the callable once, control_flow.py:1769)."""
+    cap = _ClosureCapture()
+    with cap, _pure_region():
+        out = fn(*args)
+    # tensors returned untouched (identity branches, `lambda: x`) never
+    # pass through dispatch — lift them as externals too, or their
+    # build-time values would be baked into the lowered op as constants
+    for t in jax.tree_util.tree_leaves(out, is_leaf=_is_tensor_leaf):
+        if isinstance(t, Tensor) and id(t) not in cap.produced:
+            cap.external.setdefault(id(t), t)
+    vals, treedef = _flatten_out(out)
+    return list(cap.external.values()), out, vals, treedef
+
+
+def _rebound(fn: Callable, captured: List[Tensor]):
+    """Pure re-trace of a branch closure: temporarily swap each captured
+    Tensor's value for the traced operand (Layer.functional's trick,
+    nn/layer/layers.py:366), run under _pure_region, restore."""
+
+    def run(cvals, *args):
+        saved = [t._value for t in captured]
+        for t, v in zip(captured, cvals):
+            t._value = v
+        try:
+            with _pure_region():
+                out = fn(*args)
+            # flatten BEFORE restoring: identity outputs (`lambda: x`)
+            # are the captured tensors themselves — reading them after
+            # the restore would bake the build-time value in
+            return _flatten_out(out)[0]
+        finally:
+            for t, s in zip(captured, saved):
+                t._value = s
+
+    return run
+
+
+def _check_same_structure(td_a, td_b, vals_a, vals_b, what):
+    if td_a != td_b:
+        raise ValueError(
+            f"{what}: branches returned different structures: "
+            f"{td_a} vs {td_b}")
+    for i, (a, b) in enumerate(zip(vals_a, vals_b)):
+        sa, sb = jnp.shape(a), jnp.shape(b)
+        da, db = jnp.result_type(a), jnp.result_type(b)
+        if sa != sb or da != db:
+            raise ValueError(
+                f"{what}: output {i} mismatches between branches: "
+                f"{sa}/{da} vs {sb}/{db} (the reference requires "
+                "identical shape and dtype per output)")
+
+
+def _wrap_outputs(out_tensors, treedef):
+    """Re-nest dispatched output Tensors into the branch structure."""
+    return jax.tree_util.tree_unflatten(treedef, list(out_tensors))
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name=None,
+         return_names=None):
+    """reference: python/paddle/static/nn/control_flow.py:1637.
+
+    Both branches must return the same nest of shapes/dtypes. With a
+    concrete predicate outside recording, runs plain Python (dygraph
+    semantics, including autograd through the taken branch); otherwise
+    lowers to one ``lax.cond`` op over the union of both branches'
+    captured externals (differentiable via lax.cond's vjp).
+    """
+    if true_fn is None and false_fn is None:
+        return None
+    true_fn = true_fn or (lambda: None)
+    false_fn = false_fn or (lambda: None)
+    pred_t = pred if isinstance(pred, Tensor) else Tensor(pred)
+    pv = to_value(pred_t)
+    if _concrete(pv) and not _must_lower():
+        return true_fn() if bool(np.asarray(pv)) else false_fn()
+
+    cap_t, out_t, vals_t, td_t = _discover(true_fn)
+    cap_f, out_f, vals_f, td_f = _discover(false_fn)
+    _check_same_structure(td_t, td_f, vals_t, vals_f, "cond")
+    if not vals_t:
+        # side-effect-free empty branches: nothing to select
+        return out_t
+    captured = list({id(t): t for t in cap_t + cap_f}.values())
+    n_cap = len(captured)
+    run_t = _rebound(true_fn, captured)
+    run_f = _rebound(false_fn, captured)
+
+    def pure(pv, *cvals):
+        return tuple(lax.cond(
+            jnp.reshape(pv, ()).astype(bool),
+            lambda cv: tuple(run_t(cv)),
+            lambda cv: tuple(run_f(cv)),
+            cvals[:n_cap]))
+
+    outs = dispatch(pure, (pred_t, *captured), name="cond",
+                    multi_output=True)
+    return _wrap_outputs(outs, td_t)
+
+
+def case(pred_fn_pairs: Sequence[Tuple[Any, Callable]],
+         default: Optional[Callable] = None, name=None):
+    """reference: control_flow.py:1062 — first true predicate wins;
+    ``default`` (or the last pair's fn) runs when none is true."""
+    if not pred_fn_pairs:
+        raise ValueError("case: pred_fn_pairs must be non-empty")
+    pairs = list(pred_fn_pairs)
+    for p, f in pairs:
+        if not callable(f):
+            raise TypeError("case: each pair must be (pred, callable)")
+    if default is None:
+        *pairs, (_, default) = pairs  # reference: last fn is the default
+
+    def build(i):
+        if i == len(pairs):
+            return default
+        p, f = pairs[i]
+        return lambda: cond(p, f, build(i + 1))
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name=None):
+    """reference: control_flow.py:1185. ``branch_fns`` is a dict
+    {int: fn} or a sequence of fns / (int, fn) pairs; out-of-range
+    indices take ``default``. Lowers to ``lax.switch``."""
+    if isinstance(branch_fns, dict):
+        keyed = sorted(branch_fns.items())
+    else:
+        fns = list(branch_fns)
+        if fns and isinstance(fns[0], (tuple, list)):
+            keyed = sorted((int(k), f) for k, f in fns)
+        else:
+            keyed = list(enumerate(fns))
+    if not keyed:
+        raise ValueError("switch_case: branch_fns must be non-empty")
+    keys = [k for k, _ in keyed]
+    if len(set(keys)) != len(keys):
+        raise ValueError(f"switch_case: duplicate branch keys {keys}")
+    if default is None:
+        default = keyed[-1][1]   # reference: falls back to the last branch
+
+    idx_t = branch_index if isinstance(branch_index, Tensor) \
+        else Tensor(np.asarray(branch_index, np.int64))
+    iv = to_value(idx_t)
+    if _concrete(iv) and not _must_lower():
+        i = int(np.asarray(iv))
+        return dict(keyed).get(i, default)()
+
+    discos = [_discover(f) for _, f in keyed] + [_discover(default)]
+    td0, vals0 = discos[0][3], discos[0][2]
+    for d in discos[1:]:
+        _check_same_structure(td0, d[3], vals0, d[2], "switch_case")
+    if not vals0:
+        return discos[0][1]
+    captured = list({id(t): t
+                     for d in discos for t in d[0]}.values())
+    n_cap = len(captured)
+    runs = [_rebound(f, captured) for _, f in keyed] \
+        + [_rebound(default, captured)]
+
+    # map the sparse keys onto dense lax.switch branch slots; unmatched
+    # indices select the default slot (the last one)
+    keys_arr = jnp.asarray(keys, jnp.int32)
+
+    def pure(iv, *cvals):
+        i = jnp.reshape(iv, ()).astype(jnp.int32)
+        slot = jnp.argmax(keys_arr == i)
+        slot = jnp.where(jnp.any(keys_arr == i), slot, len(runs) - 1)
+        return tuple(lax.switch(
+            slot, [(lambda cv, r=r: tuple(r(cv))) for r in runs],
+            cvals[:n_cap]))
+
+    outs = dispatch(pure, (idx_t, *captured), name="switch_case",
+                    multi_output=True)
+    return _wrap_outputs(outs, td0)
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test=False, name=None):
+    """reference: control_flow.py:755. ``loop_vars`` is the explicit
+    carried nest (as in the reference); ``cond``/``body`` take the loop
+    vars positionally. Concrete predicate outside recording runs a
+    Python loop (dygraph semantics, autograd-capable); otherwise one
+    ``lax.while_loop`` op over (carry, captured externals). Reverse-mode
+    AD through the compiled form is not defined (XLA while has no
+    transpose); use the eager path or ``lax.scan``-style APIs to train
+    through loops."""
+    if not loop_vars:
+        raise ValueError("while_loop: loop_vars must be non-empty")
+    loop_vars = list(loop_vars)
+    # evaluate the path-deciding initial predicate inside _pure_region so
+    # it is never recorded as dead ops in an active Program
+    with _pure_region():
+        first = cond(*loop_vars)
+    fv = to_value(first if isinstance(first, Tensor) else Tensor(first))
+    if _concrete(fv) and not _must_lower():
+        carry = loop_vars
+        going = bool(np.asarray(fv))
+        while going:
+            out = body(*carry)
+            carry = list(out) if isinstance(out, (tuple, list)) else [out]
+            if len(carry) != len(loop_vars):
+                raise ValueError(
+                    "while_loop: body returned a different number of "
+                    f"loop vars ({len(carry)} vs {len(loop_vars)})")
+            nxt = cond(*carry)
+            going = bool(np.asarray(to_value(
+                nxt if isinstance(nxt, Tensor) else Tensor(nxt))))
+        return tuple(carry) if len(carry) > 1 else carry[0]
+
+    carry_vals, carry_td = _flatten_out(loop_vars)
+    n_carry = len(carry_vals)
+
+    def wrap_carry(cvals):
+        leaves = [Tensor(v, stop_gradient=True) for v in cvals]
+        return jax.tree_util.tree_unflatten(carry_td, leaves)
+
+    # discovery over BOTH closures for the external set
+    cap_c = _ClosureCapture()
+    cap_b = _ClosureCapture()
+    with cap_c, _pure_region():
+        cond(*loop_vars)
+    with cap_b, _pure_region():
+        out0 = body(*loop_vars)
+    vals0, td0 = _flatten_out(
+        list(out0) if isinstance(out0, (tuple, list)) else [out0])
+    _check_same_structure(carry_td, td0, carry_vals, vals0, "while_loop")
+    loop_ids = {id(t) for t in jax.tree_util.tree_leaves(
+        loop_vars, is_leaf=_is_tensor_leaf) if isinstance(t, Tensor)}
+    captured = list({id(t): t
+                     for t in (list(cap_c.external.values())
+                               + list(cap_b.external.values()))
+                     if id(t) not in loop_ids}.values())
+
+    def run_closure(fn):
+        def run(cvals, carry_flat):
+            saved = [t._value for t in captured]
+            for t, v in zip(captured, cvals):
+                t._value = v
+            try:
+                with _pure_region():
+                    out = fn(*wrap_carry(carry_flat))
+                # flatten BEFORE the restore (identity outputs of
+                # captured externals would otherwise bake build values)
+                out = list(out) if isinstance(out, (tuple, list)) \
+                    else [out]
+                return _flatten_out(out)[0]
+            finally:
+                for t, s in zip(captured, saved):
+                    t._value = s
+        return run
+
+    run_cond = run_closure(cond)
+    run_body = run_closure(body)
+
+    def pure(*vals):
+        carry0 = tuple(vals[:n_carry])
+        cvals = tuple(vals[n_carry:])
+
+        def c(carry):
+            (r,) = run_cond(cvals, list(carry))
+            return jnp.reshape(r, ()).astype(bool)
+
+        def b(carry):
+            return tuple(run_body(cvals, list(carry)))
+
+        return lax.while_loop(c, b, carry0)
+
+    carry_tensors = [v if isinstance(v, Tensor) else Tensor(v)
+                     for v in jax.tree_util.tree_leaves(
+                         loop_vars, is_leaf=_is_tensor_leaf)]
+    outs = dispatch(pure, (*carry_tensors, *captured), name="while_loop",
+                    multi_output=True)
+    result = jax.tree_util.tree_unflatten(carry_td, list(outs))
+    return tuple(result) if len(result) > 1 else result[0]
+
+
+def Assert(cond_v, data=None, summarize=20, name=None):
+    """reference: control_flow.py:59 — abort when the condition is
+    false, printing up to ``summarize`` elements of each tensor in
+    ``data``. Concrete conditions check on host; traced conditions
+    check via a host callback (async, like the reference's Assert op
+    running on stream)."""
+    t = cond_v if isinstance(cond_v, Tensor) else Tensor(cond_v)
+    v = to_value(t)
+
+    def _fmt():
+        parts = []
+        for d in (data or ()):
+            arr = np.asarray(to_value(d if isinstance(d, Tensor)
+                                      else Tensor(d))).ravel()[:summarize]
+            parts.append(str(arr))
+        return ", ".join(parts)
+
+    if _concrete(v):
+        if not bool(np.asarray(v).all()):
+            raise AssertionError(
+                f"Assert failed{': ' + _fmt() if data else ''}")
+        return None
+
+    def _check(ok, *dvals):
+        if not bool(np.asarray(ok).all()):
+            shown = ", ".join(str(np.asarray(d).ravel()[:summarize])
+                              for d in dvals)
+            raise AssertionError(
+                f"Assert failed{': ' + shown if dvals else ''}")
+
+    jax.debug.callback(_check, v, *[to_value(d if isinstance(d, Tensor)
+                                             else Tensor(d))
+                                    for d in (data or ())])
+    return None

@@ -1,0 +1,262 @@
+"""Static control-flow API (reference:
+python/paddle/static/nn/control_flow.py cond/while_loop/case/switch_case)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+# -- eager (dygraph semantics: concrete predicate -> Python control flow) ---
+class TestEagerCond:
+    def test_takes_branch(self):
+        a = paddle.to_tensor(np.float32(3.0))
+        b = paddle.to_tensor(np.float32(5.0))
+        out = static.nn.cond(a < b, lambda: a + b, lambda: a - b)
+        assert float(out.numpy()) == 8.0
+        out = static.nn.cond(a > b, lambda: a + b, lambda: a - b)
+        assert float(out.numpy()) == -2.0
+
+    def test_grad_through_taken_branch(self):
+        x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        out = static.nn.cond(x > 0, lambda: x * x, lambda: -x)
+        out.backward()
+        assert float(x.grad.numpy()) == 4.0
+
+    def test_nest_outputs(self):
+        x = paddle.to_tensor(np.float32(1.0))
+        out = static.nn.cond(x > 0,
+                             lambda: (x + 1, [x * 2, x * 3]),
+                             lambda: (x - 1, [x * 4, x * 5]))
+        assert float(out[0].numpy()) == 2.0
+        assert float(out[1][1].numpy()) == 3.0
+
+
+class TestEagerWhile:
+    def test_sum_loop(self):
+        i = paddle.to_tensor(np.float32(0.0))
+        s = paddle.to_tensor(np.float32(0.0))
+        i_out, s_out = static.nn.while_loop(
+            lambda i, s: i < 10, lambda i, s: (i + 1, s + i), [i, s])
+        assert float(i_out.numpy()) == 10.0
+        assert float(s_out.numpy()) == 45.0
+
+    def test_grad_through_eager_loop(self):
+        x = paddle.to_tensor(np.float32(1.5), stop_gradient=False)
+        i = paddle.to_tensor(np.int32(0))
+        # y = x^(2^3) via repeated squaring in a python-driven loop
+        i_out, y = static.nn.while_loop(
+            lambda i, y: i < 3, lambda i, y: (i + 1, y * y), [i, x])
+        y.backward()
+        expect = 8 * 1.5 ** 7
+        np.testing.assert_allclose(float(x.grad.numpy()), expect,
+                                   rtol=1e-5)
+
+
+class TestEagerSwitchCase:
+    def test_dict_and_default(self):
+        x = paddle.to_tensor(np.float32(10.0))
+        fns = {1: lambda: x + 1, 3: lambda: x + 3}
+        out = static.nn.switch_case(paddle.to_tensor(np.int64(3)), fns,
+                                    default=lambda: x)
+        assert float(out.numpy()) == 13.0
+        out = static.nn.switch_case(paddle.to_tensor(np.int64(7)), fns,
+                                    default=lambda: x)
+        assert float(out.numpy()) == 10.0
+
+    def test_case_first_true_wins(self):
+        x = paddle.to_tensor(np.float32(2.0))
+        out = static.nn.case(
+            [(x > 10, lambda: x * 10), (x > 1, lambda: x * 2)],
+            default=lambda: x)
+        assert float(out.numpy()) == 4.0
+        out = static.nn.case(
+            [(x > 10, lambda: x * 10), (x > 5, lambda: x * 2)],
+            default=lambda: x - 1)
+        assert float(out.numpy()) == 1.0
+
+
+# -- static Program recording: one lax.cond/while op, replayed with feeds ---
+class TestProgramControlFlow:
+    def test_cond_replays_both_branches(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1], "float32")
+            big = static.nn.cond(x.sum() > 10.0,
+                                 lambda: x * 2.0, lambda: x - 1.0)
+        exe = static.Executor()
+        out, = exe.run(main, feed={"x": np.array([20.0], np.float32)},
+                       fetch_list=[big])
+        assert out[0] == 40.0
+        out, = exe.run(main, feed={"x": np.array([3.0], np.float32)},
+                       fetch_list=[big])
+        assert out[0] == 2.0
+
+    def test_cond_captures_parameters(self):
+        main = static.Program()
+        w = paddle.to_tensor(np.float32(7.0))
+        with static.program_guard(main):
+            x = static.data("x", [1], "float32")
+            y = static.nn.cond(x.sum() > 0.0,
+                               lambda: x * w, lambda: x / w)
+        exe = static.Executor()
+        out, = exe.run(main, feed={"x": np.array([2.0], np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(out[0], 14.0)
+        out, = exe.run(main, feed={"x": np.array([-7.0], np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(out[0], -1.0)
+
+    def test_cond_grad_through_lax_cond(self):
+        """Recording mode forces the lax.cond lowering even with a
+        concrete predicate; grads must flow to captured externals."""
+        main = static.Program()
+        w = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+        x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        with static.program_guard(main):
+            y = static.nn.cond(x > 0, lambda: x * w * w, lambda: x)
+        y.backward()
+        assert float(w.grad.numpy()) == 12.0   # d/dw (x w^2) = 2xw
+        assert float(x.grad.numpy()) == 9.0    # w^2
+
+    def test_while_loop_replay(self):
+        main = static.Program()
+        with static.program_guard(main):
+            n = static.data("n", [1], "float32")
+            i = paddle.zeros([1])
+            s = paddle.zeros([1])
+            i_o, s_o = static.nn.while_loop(
+                lambda i, s: (i < n).all(), lambda i, s: (i + 1, s + i),
+                [i, s])
+        exe = static.Executor()
+        out, = exe.run(main, feed={"n": np.array([5.0], np.float32)},
+                       fetch_list=[s_o])
+        assert out[0] == 10.0
+        out, = exe.run(main, feed={"n": np.array([11.0], np.float32)},
+                       fetch_list=[s_o])
+        assert out[0] == 55.0
+
+    def test_switch_case_replay(self):
+        main = static.Program()
+        with static.program_guard(main):
+            idx = static.data("i", [1], "int64")
+            x = static.data("x", [1], "float32")
+            y = static.nn.switch_case(
+                idx.sum(), {0: lambda: x + 100.0, 2: lambda: x * 3.0},
+                default=lambda: x * 0.0)
+        exe = static.Executor()
+        feed = {"x": np.array([4.0], np.float32)}
+        out, = exe.run(main, feed={**feed, "i": np.array([0], np.int64)},
+                       fetch_list=[y])
+        assert out[0] == 104.0
+        out, = exe.run(main, feed={**feed, "i": np.array([2], np.int64)},
+                       fetch_list=[y])
+        assert out[0] == 12.0
+        out, = exe.run(main, feed={**feed, "i": np.array([9], np.int64)},
+                       fetch_list=[y])
+        assert out[0] == 0.0
+
+
+# -- inside to_static (traced predicate -> lax lowering) --------------------
+class TestToStaticControlFlow:
+    def test_cond_in_to_static(self):
+        @paddle.jit.to_static
+        def f(x):
+            return static.nn.cond(x.sum() > 0,
+                                  lambda: x * 2.0, lambda: x - 1.0)
+
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        b = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+        np.testing.assert_allclose(f(a).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(f(b).numpy(), [-2.0, -3.0])
+
+    def test_while_in_to_static(self):
+        @paddle.jit.to_static
+        def f(n):
+            i = paddle.zeros([])
+            s = paddle.zeros([])
+            _, s = static.nn.while_loop(
+                lambda i, s: i < n.sum(), lambda i, s: (i + 1, s + i),
+                [i, s])
+            return s
+
+        assert float(f(paddle.to_tensor(np.float32(4.0))).numpy()) == 6.0
+        assert float(f(paddle.to_tensor(np.float32(6.0))).numpy()) == 15.0
+
+
+class TestReviewRegressions:
+    def test_nested_case_predicates_follow_feed(self):
+        """Nested cond predicates must be lifted as operands, not baked
+        at build-time values (review finding: case under program_guard
+        always took the build-time inner branch)."""
+        main = static.Program()
+        with static.program_guard(main):
+            a = static.data("a", [1], "float32")
+            y = static.nn.case(
+                [(a.sum() > 10.0, lambda: a * 10.0),
+                 (a.sum() > 1.0, lambda: a * 2.0)],
+                default=lambda: a * 0.0)
+        exe = static.Executor()
+        out, = exe.run(main, feed={"a": np.array([5.0], np.float32)},
+                       fetch_list=[y])
+        assert out[0] == 10.0   # inner branch: 5 > 1
+        out, = exe.run(main, feed={"a": np.array([20.0], np.float32)},
+                       fetch_list=[y])
+        assert out[0] == 200.0
+        out, = exe.run(main, feed={"a": np.array([0.5], np.float32)},
+                       fetch_list=[y])
+        assert out[0] == 0.0
+
+    def test_identity_branch_returns_fed_value(self):
+        """A branch returning a captured tensor untouched must still see
+        the fed value on replay (review finding: baked constant)."""
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1], "float32")
+            y = static.data("y", [1], "float32")
+            out = static.nn.cond(x.sum() > 0.0, lambda: x, lambda: y)
+        exe = static.Executor()
+        o, = exe.run(main, feed={"x": np.array([5.0], np.float32),
+                                 "y": np.array([-3.0], np.float32)},
+                     fetch_list=[out])
+        assert o[0] == 5.0
+        o, = exe.run(main, feed={"x": np.array([-5.0], np.float32),
+                                 "y": np.array([-3.0], np.float32)},
+                     fetch_list=[out])
+        assert o[0] == -3.0
+
+    def test_while_records_no_dead_predicate_ops(self):
+        """The path-deciding initial predicate evaluation must not be
+        recorded into the Program (review finding: dead ops replayed
+        every run)."""
+        main = static.Program()
+        with static.program_guard(main):
+            n = static.data("n", [1], "float32")
+            i = paddle.zeros([1])
+            static.nn.while_loop(lambda i: (i < n).all(),
+                                 lambda i: i + 1.0, [i])
+        names = [op[0] for op in main._ops]
+        assert names.count("while_loop") == 1
+        assert all(nm == "while_loop" or nm in ("zeros", "full")
+                   for nm in names), names
+
+
+class TestMisc:
+    def test_structure_mismatch_raises(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1], "float32")
+            with pytest.raises(ValueError, match="branches"):
+                static.nn.cond(x.sum() > 0, lambda: (x, x), lambda: x)
+
+    def test_assert_eager(self):
+        x = paddle.to_tensor(np.float32(1.0))
+        static.nn.Assert(x > 0)  # passes
+        with pytest.raises(AssertionError):
+            static.nn.Assert(x < 0, data=[x])
+
+    def test_case_validates(self):
+        with pytest.raises(ValueError):
+            static.nn.case([])
