@@ -107,6 +107,18 @@ def summary(net, input_size=None, dtypes=None, input=None):
     return _summary(net, input_size, dtypes=dtypes, input=input)
 
 
+def __getattr__(name):
+    # lazy top-level hapi surface (reference: paddle.Model,
+    # paddle.callbacks) without importing hapi at package import time
+    if name == "Model":
+        from .hapi import Model as _m
+        return _m
+    if name == "callbacks":
+        from .hapi import callbacks as _c
+        return _c
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
 # -- round-3 long-tail parity -------------------------------------------------
 from .framework.extras import (finfo, iinfo, set_printoptions,  # noqa: F401
                                to_dlpack, from_dlpack,

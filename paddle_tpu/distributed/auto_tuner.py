@@ -1,0 +1,221 @@
+"""Distributed auto-tuner (reference:
+python/paddle/distributed/auto_tuner/tuner.py AutoTuner:21,
+prune.py prune_by_mp/pp/mbs/sharding, recorder.py HistoryRecorder).
+
+Searches the hybrid-parallel configuration space — mesh axes (dp, fsdp,
+tp, sp, pp) x micro-batch — for the fastest train step. TPU-native
+form: a candidate is a ``MeshConfig`` + micro_batch, pruned by
+divisibility/topology rules, measured by actually running a few steps
+of the target train step (the reference launches whole subprocess jobs;
+under XLA one process can build every mesh variant, so measurement is a
+compile + timed steps in-process), recorded to a JSONL history sorted
+by the metric.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .trainer import MeshConfig
+
+__all__ = ["AutoTuner", "Recorder", "default_candidates"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def default_candidates(num_devices: int,
+                       max_tp: Optional[int] = None,
+                       max_pp: int = 1,
+                       micro_batches=(1, 2, 4),
+                       num_heads: Optional[int] = None,
+                       global_batch: Optional[int] = None) -> List[Dict]:
+    """All factorizations dp*fsdp*tp*sp*pp == num_devices with pruning
+    (reference prune.py semantics, re-stated for a TPU mesh):
+
+    - prune_by_mp: tp must divide the attention head count;
+    - prune_by_pp: pp bounded by max_pp (pipeline needs enough layers);
+    - prune_by_mbs: micro_batch must divide the per-data-shard batch;
+    - degenerate sp on a 1-device data axis is allowed (sequence
+      sharding is orthogonal), but tp*sp is capped at num_devices.
+    """
+    out = []
+    for dp, fsdp, tp, sp, pp in itertools.product(
+            _divisors(num_devices), repeat=5):
+        if dp * fsdp * tp * sp * pp != num_devices:
+            continue
+        if max_tp is not None and tp > max_tp:
+            continue
+        if num_heads is not None and num_heads % tp != 0:
+            continue   # prune_by_mp: heads must split evenly
+        if pp > max_pp:
+            continue   # prune_by_pp
+        for mb in micro_batches:
+            if global_batch is not None:
+                shard = global_batch // max(dp * fsdp, 1)
+                if shard == 0 or shard % mb != 0:
+                    continue   # prune_by_mbs
+            out.append({"dp": dp, "fsdp": fsdp, "tp": tp, "sp": sp,
+                        "pp": pp, "micro_batch": mb})
+    return out
+
+
+class Recorder:
+    """reference recorder.py HistoryRecorder — append per-config
+    results, sort by metric, persist/load a history file."""
+
+    def __init__(self, metric: str = "step_time", maximize: bool = False):
+        self.metric = metric
+        self.maximize = maximize
+        self.history: List[Dict] = []
+
+    def add(self, cfg: Dict, result: Dict):
+        self.history.append({**cfg, **result})
+
+    def sorted(self):
+        def key(rec):
+            v = rec.get(self.metric)
+            if v is None or not np.isfinite(v):
+                return np.inf          # failed configs sort last
+            return -v if self.maximize else v
+        return sorted(self.history, key=key)
+
+    def best(self):
+        s = self.sorted()
+        if not s:
+            return None
+        top = s[0]
+        v = top.get(self.metric)
+        return top if v is not None and np.isfinite(v) else None
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            for rec in self.history:
+                f.write(json.dumps(
+                    {k: (v if not isinstance(v, np.generic) else v.item())
+                     for k, v in rec.items()}) + "\n")
+
+    def load(self, path: str):
+        if os.path.exists(path):
+            with open(path) as f:
+                self.history = [json.loads(line)
+                                for line in f if line.strip()]
+        return self
+
+
+class AutoTuner:
+    """Search driver.
+
+    ``run_fn(cfg) -> dict`` builds + measures one candidate and returns
+    at least ``{metric: value}``; raise to mark the config infeasible
+    (recorded with ``error``; an OOM-style failure also history-prunes
+    every candidate with the same model-parallel product and a larger
+    micro_batch, reference prune_by_mbs_history).
+    """
+
+    def __init__(self, run_fn: Callable[[Dict], Dict],
+                 candidates: Optional[List[Dict]] = None,
+                 num_devices: Optional[int] = None,
+                 metric: str = "step_time", maximize: bool = False,
+                 history_path: Optional[str] = None, verbose: bool = True,
+                 **candidate_kwargs):
+        if candidates is None:
+            if num_devices is None:
+                raise ValueError("pass candidates= or num_devices=")
+            candidates = default_candidates(num_devices,
+                                            **candidate_kwargs)
+        self.run_fn = run_fn
+        self.candidates = list(candidates)
+        self.recorder = Recorder(metric, maximize)
+        self.metric = metric
+        self.history_path = history_path
+        self.verbose = verbose
+
+    _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "MemoryError", "out of memory",
+                    "oom", "OOM", "Allocation failure")
+
+    def _history_pruned(self, cfg: Dict) -> Optional[str]:
+        for rec in self.recorder.history:
+            err = rec.get("error")
+            # only capacity failures generalize to bigger configs
+            # (reference prune_by_mbs_history scopes to OOM); a shape or
+            # compile bug at one point must not hide the whole family
+            if err is None or not any(m in err for m in self._OOM_MARKERS):
+                continue
+            same_model_parallel = all(
+                rec.get(k) == cfg.get(k) for k in ("tp", "sp", "pp"))
+            if same_model_parallel and \
+                    cfg.get("micro_batch", 1) >= rec.get("micro_batch", 1) \
+                    and cfg.get("dp", 1) * cfg.get("fsdp", 1) <= \
+                    rec.get("dp", 1) * rec.get("fsdp", 1):
+                return (f"pruned by history: {rec['error'][:80]} at "
+                        f"mb={rec.get('micro_batch')}")
+        return None
+
+    def tune(self, max_trials: Optional[int] = None) -> Optional[Dict]:
+        trials = 0
+        for cfg in self.candidates:
+            if max_trials is not None and trials >= max_trials:
+                break
+            reason = self._history_pruned(cfg)
+            if reason is not None:
+                if self.verbose:
+                    print(f"auto_tuner skip {cfg}: {reason}")
+                continue
+            trials += 1
+            t0 = time.time()
+            try:
+                result = self.run_fn(dict(cfg))
+            except Exception as e:  # noqa: BLE001 — infeasible candidate
+                result = {"error": f"{type(e).__name__}: {e}"[:200]}
+            result.setdefault("measure_time", round(time.time() - t0, 3))
+            self.recorder.add(cfg, result)
+            if self.verbose:
+                shown = result.get(self.metric, result.get("error"))
+                print(f"auto_tuner trial {cfg} -> {self.metric}={shown}")
+            if self.history_path:
+                self.recorder.save(self.history_path)
+        return self.recorder.best()
+
+    @staticmethod
+    def mesh_config(cfg: Dict) -> MeshConfig:
+        return MeshConfig(dp=cfg.get("dp", 1), fsdp=cfg.get("fsdp", 1),
+                          tp=cfg.get("tp", 1), sp=cfg.get("sp", 1),
+                          pp=cfg.get("pp", 1))
+
+
+def trainer_run_fn(loss_fn, init_params_fn, shardings_fn,
+                   make_batch, steps: int = 3, lr: float = 1e-3,
+                   devices=None):
+    """Build a ``run_fn`` measuring the functional Trainer: one warmup
+    (compile) step + ``steps`` timed steps on the candidate mesh.
+
+    ``shardings_fn(mesh) -> param shardings``; ``make_batch(cfg) ->
+    (tokens, labels)`` sized for the candidate (micro_batch x data
+    shards)."""
+    import jax
+    from .trainer import Trainer, make_mesh
+
+    def run(cfg):
+        mc = AutoTuner.mesh_config(cfg)
+        mesh = make_mesh(mc, devices=devices)
+        params = init_params_fn()
+        tr = Trainer(loss_fn, mesh, shardings_fn(mesh), lr=lr)
+        state = tr.init_state(params)
+        tokens, labels = make_batch(cfg)
+        state, m = tr.step(state, tokens, labels)
+        jax.block_until_ready(m["loss"])       # compile + warmup
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = tr.step(state, tokens, labels)
+        jax.block_until_ready(m["loss"])
+        dt = (time.perf_counter() - t0) / steps
+        return {"step_time": dt, "loss": float(m["loss"])}
+
+    return run

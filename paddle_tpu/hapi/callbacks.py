@@ -1,0 +1,394 @@
+"""hapi callback machinery (reference: python/paddle/hapi/callbacks.py —
+Callback:177, CallbackList:98, ProgBarLogger:365, ModelCheckpoint:637,
+LRScheduler:710, EarlyStopping:814, VisualDL:977, ReduceLROnPlateau:1274).
+
+Implemented from the reference's observable behavior: Model.fit drives
+``config_callbacks`` -> CallbackList and each callback hooks the
+train/eval/predict lifecycle. VisualDL's writer dependency is not in
+this image, so the class logs scalars to a JSONL file with the same
+call shape (gate, not stub — the data is real and greppable).
+"""
+from __future__ import annotations
+
+import json
+import numbers
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Callback", "CallbackList", "config_callbacks", "ProgBarLogger",
+           "ModelCheckpoint", "LRScheduler", "EarlyStopping", "VisualDL",
+           "ReduceLROnPlateau"]
+
+
+class Callback:
+    """Base class; subclasses override any subset of the hooks."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    # lifecycle hooks, all optional -------------------------------------
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_predict_begin(self, logs=None):
+        pass
+
+    def on_predict_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+    def on_predict_batch_begin(self, step, logs=None):
+        pass
+
+    def on_predict_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[Sequence[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, callback):
+        self.callbacks.append(callback)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def on_begin(self, mode, logs=None):
+        self._call(f"on_{mode}_begin", logs)
+
+    def on_end(self, mode, logs=None):
+        self._call(f"on_{mode}_end", logs)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._call("on_epoch_begin", epoch, logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._call("on_epoch_end", epoch, logs)
+
+    def on_batch_begin(self, mode, step, logs=None):
+        self._call(f"on_{mode}_batch_begin", step, logs)
+
+    def on_batch_end(self, mode, step, logs=None):
+        self._call(f"on_{mode}_batch_end", step, logs)
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None,
+                     epochs=None, steps=None, log_freq=2, verbose=2,
+                     save_freq=1, save_dir=None, metrics=None,
+                     mode="train"):
+    """reference callbacks.py:55 — normalize the user list and install
+    the default ProgBarLogger/ModelCheckpoint when absent."""
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + cbks
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks = cbks + [LRScheduler()]
+    if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks = cbks + [ModelCheckpoint(save_freq, save_dir)]
+    lst = CallbackList(cbks)
+    lst.set_model(model)
+    lst.set_params({
+        "batch_size": batch_size, "epochs": epochs, "steps": steps,
+        "verbose": verbose, "metrics": list(metrics or ["loss"]),
+    })
+    return lst
+
+
+def _scalar(v):
+    if isinstance(v, (list, tuple, np.ndarray)):
+        arr = np.asarray(v).ravel()
+        return float(arr[0]) if arr.size else 0.0
+    if isinstance(v, numbers.Number):
+        return float(v)
+    return v
+
+
+class ProgBarLogger(Callback):
+    """reference callbacks.py:365 — periodic stdout logging of loss,
+    metrics and throughput."""
+
+    def __init__(self, log_freq: int = 1, verbose: int = 2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self.epochs = None
+        self.steps = None
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = self.params.get("steps")
+        self._t0 = time.time()
+        self._seen = 0
+
+    def _line(self, step, logs, mode):
+        logs = logs or {}
+        items = [f"{k}: {_scalar(v):.4f}" if isinstance(
+            _scalar(v), float) else f"{k}: {v}"
+            for k, v in logs.items() if k not in ("batch_size",)]
+        head = f"Epoch {self.epoch + 1}/{self.epochs}" \
+            if mode == "train" and self.epochs else mode.capitalize()
+        tot = f"/{self.steps}" if self.steps else ""
+        dt = time.time() - self._t0
+        ips = self._seen / dt if dt > 0 else 0.0
+        print(f"{head} step {step + 1}{tot} - " + ", ".join(items)
+              + (f" - {ips:.1f} samples/sec" if self._seen else ""))
+
+    def on_train_batch_end(self, step, logs=None):
+        self._seen += (logs or {}).get("batch_size", 0)
+        if self.verbose and step % self.log_freq == 0:
+            self._line(step, logs, "train")
+
+    def on_eval_begin(self, logs=None):
+        self.epoch = 0
+        self.steps = None   # train steps/epoch is the wrong denominator
+        self._t0 = time.time()
+        self._seen = 0
+
+    def on_eval_batch_end(self, step, logs=None):
+        self._seen += (logs or {}).get("batch_size", 0)
+        if self.verbose > 1 and step % self.log_freq == 0:
+            self._line(step, logs, "eval")
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            items = [f"{k}: {_scalar(v)}" for k, v in (logs or {}).items()]
+            print("Eval done - " + ", ".join(items))
+
+
+class ModelCheckpoint(Callback):
+    """reference callbacks.py:637 — save every ``save_freq`` epochs to
+    ``save_dir/{epoch}`` and to ``save_dir/final`` at train end."""
+
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and self.save_dir and \
+                epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            print(f"save checkpoint at {os.path.abspath(path)}")
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None and self.save_dir:
+            path = os.path.join(self.save_dir, "final")
+            print(f"save checkpoint at {os.path.abspath(path)}")
+            self.model.save(path)
+
+
+class LRScheduler(Callback):
+    """reference callbacks.py:710 — step the optimizer's LR scheduler
+    each train batch (``by_step``) and/or each epoch (``by_epoch``)."""
+
+    def __init__(self, by_step: bool = True, by_epoch: bool = False):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError(
+                "by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as Sched
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class _MonitorMixin:
+    def _init_monitor(self, monitor, mode, min_delta):
+        self.monitor = monitor
+        self.min_delta = abs(min_delta)
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.best_value = -np.inf if mode == "max" else np.inf
+
+    def _monitored(self, logs):
+        v = (logs or {}).get(self.monitor)
+        return None if v is None else _scalar(v)
+
+    def _improved(self, v):
+        if self.mode == "max":
+            return v > self.best_value + self.min_delta
+        return v < self.best_value - self.min_delta
+
+
+class EarlyStopping(Callback, _MonitorMixin):
+    """reference callbacks.py:814 — watch an eval metric; stop training
+    after ``patience`` non-improving evals, optionally saving the best
+    model (``save_dir/best_model``) and restoring nothing (parity)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self._init_monitor(monitor, mode, min_delta)
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.save_dir = None        # set by Model.fit from its save_dir
+        self.wait_epoch = 0
+        self.stopped_epoch = 0
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+
+    def on_eval_end(self, logs=None):
+        v = self._monitored(logs)
+        if v is None:
+            return
+        if self._improved(v):
+            self.best_value = v
+            self.wait_epoch = 0
+            if self.save_best_model and self.save_dir and \
+                    self.model is not None:
+                self.model.save(os.path.join(self.save_dir, "best_model"))
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch > self.patience:
+            self.model.stop_training = True
+            if self.verbose:
+                print(f"EarlyStopping: stop at best {self.monitor} = "
+                      f"{self.best_value}")
+
+
+class ReduceLROnPlateau(Callback, _MonitorMixin):
+    """reference callbacks.py:1274 — multiply the LR by ``factor`` after
+    ``patience`` non-improving evals; floors at ``min_lr``."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        super().__init__()
+        self._init_monitor(monitor, mode, min_delta)
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.cooldown_counter = 0
+        self.wait = 0
+
+    def on_eval_end(self, logs=None):
+        v = self._monitored(logs)
+        if v is None:
+            return
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._improved(v):
+            self.best_value = v
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is not None:
+                    old = opt.get_lr()
+                    new = max(old * self.factor, self.min_lr)
+                    if old - new > 1e-12:
+                        opt.set_lr(new)
+                        if self.verbose:
+                            print(f"ReduceLROnPlateau: lr {old:.2e} -> "
+                                  f"{new:.2e}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """reference callbacks.py:977. The visualdl writer isn't in this
+    image; scalars are appended to ``<log_dir>/scalars.jsonl`` with the
+    same tag layout ({mode}/{metric}) so dashboards can ingest them."""
+
+    def __init__(self, log_dir: str = "./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = {"train": 0, "eval": 0}
+
+    def _write(self, mode, logs):
+        os.makedirs(self.log_dir, exist_ok=True)
+        rec = {f"{mode}/{k}": _scalar(v) for k, v in (logs or {}).items()
+               if isinstance(_scalar(v), float)}
+        if not rec:
+            return
+        rec["step"] = self._step[mode]
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step["train"] += 1
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._step["eval"] += 1
+        self._write("eval", logs)

@@ -69,80 +69,131 @@ class Model:
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         return self.network(*inputs)
 
+    def _metric_names(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend([n] if isinstance(n, str) else list(n))
+        return names
+
+    def _make_loader(self, data, batch_size, shuffle=False, drop_last=False,
+                     num_workers=0):
+        from ..io import DataLoader, Dataset
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              drop_last=drop_last, num_workers=num_workers)
+        return data
+
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None):
-        from ..io import DataLoader, Dataset
-        if isinstance(train_data, Dataset):
-            train_loader = DataLoader(train_data, batch_size=batch_size,
-                                      shuffle=shuffle, drop_last=drop_last,
-                                      num_workers=num_workers)
-        else:
-            train_loader = train_data
+        """reference hapi/model.py Model.fit: drives the callback
+        lifecycle (hapi/callbacks.py config_callbacks) around the
+        train/eval loops; EarlyStopping sets ``stop_training``."""
+        from .callbacks import EarlyStopping, config_callbacks
+        train_loader = self._make_loader(train_data, batch_size, shuffle,
+                                         drop_last, num_workers)
+        steps = len(train_loader) if hasattr(train_loader, "__len__") \
+            else None
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=self._metric_names())
+        for c in cbks:
+            if isinstance(c, EarlyStopping) and c.save_dir is None:
+                c.save_dir = save_dir
+        self.stop_training = False
+        cbks.on_begin("train")
         it = 0
+        hit_iters = False
         for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
-            t0 = time.time()
+            logs = None
             for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step)
                 *xs, y = batch if isinstance(batch, (list, tuple)) else \
                     (batch,)
                 res = self.train_batch(xs, y)
+                loss, mvals = res if isinstance(res, tuple) else (res, [])
+                try:
+                    bs = int(np.asarray(y).shape[0])
+                except (IndexError, TypeError):
+                    bs = batch_size   # scalar/0-d labels: fall back
+                logs = {"loss": loss, "batch_size": bs}
+                for m, v in zip(self._metrics, mvals):
+                    n = m.name()
+                    logs[n if isinstance(n, str) else n[0]] = v
+                cbks.on_batch_end("train", step, logs)
                 it += 1
-                if verbose and step % log_freq == 0:
-                    loss = res[0] if isinstance(res, tuple) else res
-                    print(f"Epoch {epoch + 1}/{epochs} step {step} "
-                          f"loss: {loss:.4f}")
                 if num_iters is not None and it >= num_iters:
-                    return
+                    hit_iters = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if hit_iters:   # bounded run: skip eval, stop now (parity
+                break       # with the pre-callback immediate return)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=verbose)
-            if save_dir is not None and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/epoch_{epoch}")
+                              verbose=verbose, callbacks=cbks)
+            if self.stop_training:
+                break
+        cbks.on_end("train")
 
     @no_grad()
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):
-        from ..io import DataLoader, Dataset
-        if isinstance(eval_data, Dataset):
-            loader = DataLoader(eval_data, batch_size=batch_size,
-                                num_workers=num_workers)
+        from .callbacks import CallbackList, config_callbacks
+        loader = self._make_loader(eval_data, batch_size,
+                                   num_workers=num_workers)
+        if isinstance(callbacks, CallbackList):
+            cbks = callbacks   # fit() passes its configured list through
         else:
-            loader = eval_data
+            cbks = config_callbacks(callbacks, model=self,
+                                    batch_size=batch_size, log_freq=log_freq,
+                                    verbose=verbose,
+                                    metrics=self._metric_names())
         for m in self._metrics:
             m.reset()
+        cbks.on_begin("eval")
         losses = []
         for step, batch in enumerate(loader):
+            cbks.on_batch_begin("eval", step)
             *xs, y = batch if isinstance(batch, (list, tuple)) else (batch,)
             res = self.eval_batch(xs, y)
-            losses.append(res[0] if isinstance(res, tuple) else res)
+            loss = res[0] if isinstance(res, tuple) else res
+            losses.append(loss)
+            cbks.on_batch_end("eval", step, {"loss": loss,
+                                             "batch_size": batch_size})
             if num_iters is not None and step + 1 >= num_iters:
                 break
         result = {"loss": [float(np.mean(losses))]}
         for m in self._metrics:
             result[m.name() if isinstance(m.name(), str) else
                    m.name()[0]] = m.accumulate()
-        if verbose:
-            print("Eval:", result)
+        cbks.on_end("eval", result)
         return result
 
     @no_grad()
     def predict(self, test_data, batch_size=1, num_workers=0,
                 stack_outputs=False, verbose=1, callbacks=None):
-        from ..io import DataLoader, Dataset
-        if isinstance(test_data, Dataset):
-            loader = DataLoader(test_data, batch_size=batch_size,
-                                num_workers=num_workers)
-        else:
-            loader = test_data
+        from .callbacks import config_callbacks
+        loader = self._make_loader(test_data, batch_size,
+                                   num_workers=num_workers)
+        cbks = config_callbacks(callbacks, model=self,
+                                batch_size=batch_size, verbose=0)
+        cbks.on_begin("predict")
         outputs = []
-        for batch in loader:
+        for step, batch in enumerate(loader):
+            cbks.on_batch_begin("predict", step)
             xs = batch[:-1] if isinstance(batch, (list, tuple)) and \
                 len(batch) > 1 else (batch if isinstance(batch, (list, tuple))
                                      else [batch])
             outputs.append(self.predict_batch(list(xs)))
+            cbks.on_batch_end("predict", step)
+        cbks.on_end("predict")
         return outputs
 
     def save(self, path, training=True):

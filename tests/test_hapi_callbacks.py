@@ -1,0 +1,183 @@
+"""hapi callback machinery (reference: python/paddle/hapi/callbacks.py)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Model
+from paddle_tpu.hapi.callbacks import (Callback, EarlyStopping, LRScheduler,
+                                       ModelCheckpoint, ProgBarLogger,
+                                       ReduceLROnPlateau, VisualDL,
+                                       config_callbacks)
+
+
+def _small_model(lr=0.05):
+    net = nn.Linear(4, 2)
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(lr, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    return model
+
+
+def _dataset(n=16):
+    from paddle_tpu.io import Dataset
+
+    class DS(Dataset):
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            r = np.random.RandomState(i)
+            x = r.randn(4).astype(np.float32)
+            return x, np.int64(i % 2)
+
+    return DS()
+
+
+class TestLifecycle:
+    def test_hooks_fire_in_order(self):
+        events = []
+
+        class Spy(Callback):
+            def on_train_begin(self, logs=None):
+                events.append("train_begin")
+
+            def on_epoch_begin(self, epoch, logs=None):
+                events.append(f"epoch_begin{epoch}")
+
+            def on_train_batch_end(self, step, logs=None):
+                events.append("batch")
+                assert "loss" in logs
+
+            def on_epoch_end(self, epoch, logs=None):
+                events.append(f"epoch_end{epoch}")
+
+            def on_eval_begin(self, logs=None):
+                events.append("eval_begin")
+
+            def on_eval_end(self, logs=None):
+                events.append("eval_end")
+                assert "loss" in logs
+
+            def on_train_end(self, logs=None):
+                events.append("train_end")
+
+        m = _small_model()
+        m.fit(_dataset(8), eval_data=_dataset(8), batch_size=4, epochs=2,
+              verbose=0, callbacks=[Spy()], shuffle=False)
+        assert events[0] == "train_begin" and events[-1] == "train_end"
+        assert events.count("epoch_begin0") == 1
+        assert events.count("batch") == 4     # 2 epochs x 2 steps
+        assert events.count("eval_begin") == 2
+
+    def test_progbar_prints(self, capsys):
+        m = _small_model()
+        m.fit(_dataset(8), batch_size=4, epochs=1, verbose=2, log_freq=1,
+              callbacks=[ProgBarLogger(log_freq=1, verbose=2)],
+              shuffle=False)
+        out = capsys.readouterr().out
+        assert "Epoch 1/1" in out and "loss" in out
+
+
+class TestModelCheckpoint:
+    def test_saves_epochs_and_final(self, tmp_path):
+        m = _small_model()
+        m.fit(_dataset(8), batch_size=4, epochs=2, verbose=0,
+              save_dir=str(tmp_path), shuffle=False)
+        assert (tmp_path / "0.pdparams").exists()
+        assert (tmp_path / "1.pdparams").exists()
+        assert (tmp_path / "final.pdparams").exists()
+
+
+class TestEarlyStopping:
+    def test_stops_on_plateau(self, tmp_path):
+        m = _small_model(lr=0.0)  # nothing improves with lr=0
+        es = EarlyStopping(monitor="loss", patience=1, verbose=0,
+                           min_delta=0.0)
+        epochs_run = []
+
+        class Spy(Callback):
+            def on_epoch_begin(self, epoch, logs=None):
+                epochs_run.append(epoch)
+
+        m.fit(_dataset(8), eval_data=_dataset(8), batch_size=4, epochs=10,
+              verbose=0, callbacks=[es, Spy()], save_dir=str(tmp_path),
+              shuffle=False)
+        # first eval sets best; evals 2 and 3 don't improve -> stop
+        assert len(epochs_run) <= 4
+        assert m.stop_training
+        assert (tmp_path / "best_model.pdparams").exists()
+
+    def test_improvement_resets_patience(self):
+        m = _small_model(lr=0.2)  # actually trains: loss improves
+        es = EarlyStopping(monitor="loss", patience=2, verbose=0)
+        m.fit(_dataset(16), eval_data=_dataset(16), batch_size=4, epochs=3,
+              verbose=0, callbacks=[es], shuffle=False)
+        assert np.isfinite(es.best_value)
+
+
+class TestReduceLROnPlateau:
+    def test_lr_halves_on_stall(self):
+        m = _small_model(lr=0.08)
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=1,
+                               verbose=0, min_delta=10.0)  # huge delta
+        m.fit(_dataset(8), eval_data=_dataset(8), batch_size=4, epochs=3,
+              verbose=0, callbacks=[cb], shuffle=False)
+        # min_delta=10 means "never improved": epochs 2..3 each stall
+        assert m._optimizer.get_lr() == pytest.approx(0.08 * 0.5 * 0.5)
+
+    def test_missing_monitor_is_noop(self):
+        m = _small_model(lr=0.05)
+        cb = ReduceLROnPlateau(monitor="no_such_metric", factor=0.5,
+                               patience=0, verbose=0)
+        m.fit(_dataset(8), eval_data=_dataset(8), batch_size=4, epochs=2,
+              verbose=0, callbacks=[cb], shuffle=False)
+        assert m._optimizer.get_lr() == pytest.approx(0.05)
+
+
+class TestLRSchedulerCallback:
+    def test_steps_scheduler_per_batch(self):
+        from paddle_tpu.optimizer.lr import StepDecay
+        net = nn.Linear(4, 2)
+        sched = StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(sched,
+                                           parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        model.fit(_dataset(8), batch_size=4, epochs=1, verbose=0,
+                  shuffle=False)   # default LRScheduler callback by_step
+        # 2 batches -> one decay boundary crossed
+        assert model._optimizer.get_lr() == pytest.approx(0.05)
+
+
+class TestVisualDL:
+    def test_writes_scalars(self, tmp_path):
+        m = _small_model()
+        m.fit(_dataset(8), eval_data=_dataset(8), batch_size=4, epochs=1,
+              verbose=0, callbacks=[VisualDL(log_dir=str(tmp_path))],
+              shuffle=False)
+        path = tmp_path / "scalars.jsonl"
+        assert path.exists()
+        recs = [json.loads(line) for line in path.read_text().splitlines()]
+        assert any("train/loss" in r for r in recs)
+        assert any("eval/loss" in r for r in recs)
+
+
+class TestConfig:
+    def test_defaults_installed(self):
+        cbks = config_callbacks(None, model=None, verbose=1,
+                                save_dir="/tmp/x")
+        kinds = [type(c) for c in cbks]
+        assert ProgBarLogger in kinds
+        assert LRScheduler in kinds
+        assert ModelCheckpoint in kinds
+
+    def test_user_progbar_not_duplicated(self):
+        user = ProgBarLogger(5)
+        cbks = config_callbacks([user], model=None, verbose=1)
+        assert sum(isinstance(c, ProgBarLogger) for c in cbks.callbacks) == 1
