@@ -44,8 +44,9 @@ from . import autograd  # noqa: F401
 # -- device -------------------------------------------------------------------
 from . import device  # noqa: F401
 from .device import (  # noqa: F401
-    CPUPlace, CUDAPlace, TPUPlace, XPUPlace, set_device, get_device,
-    is_compiled_with_cuda, is_compiled_with_rocm, is_compiled_with_xpu)
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, XPUPlace, set_device,
+    get_device, is_compiled_with_cuda, is_compiled_with_rocm,
+    is_compiled_with_xpu)
 
 # -- subsystems ---------------------------------------------------------------
 from . import nn  # noqa: F401
@@ -108,15 +109,40 @@ def summary(net, input_size=None, dtypes=None, input=None):
 
 
 def __getattr__(name):
-    # lazy top-level hapi surface (reference: paddle.Model,
-    # paddle.callbacks) without importing hapi at package import time
+    # lazy top-level surfaces (reference: paddle.Model, paddle.callbacks,
+    # paddle.DataParallel) without importing them at package import time
     if name == "Model":
         from .hapi import Model as _m
         return _m
     if name == "callbacks":
         from .hapi import callbacks as _c
         return _c
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel as _dp
+        return _dp
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+# reference: paddle.dtype is the datatype class usable in isinstance /
+# constructor position; jax dtypes ARE numpy dtypes here
+import numpy as _np_dtype_mod  # noqa: E402
+dtype = _np_dtype_mod.dtype
+
+from .framework import LazyGuard  # noqa: F401, E402
+
+
+def shape(x):
+    """reference: paddle.shape — runtime shape as an int32 tensor."""
+    from .core.tensor import Tensor, to_value
+    import numpy as np
+    return Tensor(np.asarray(np.shape(to_value(x)), np.int32))
+
+
+def tolist(x):
+    """reference: paddle.tolist."""
+    from .core.tensor import to_value
+    import numpy as np
+    return np.asarray(to_value(x)).tolist()
 
 
 # -- round-3 long-tail parity -------------------------------------------------

@@ -19,6 +19,7 @@ import jax
 
 __all__ = [
     "Place", "CPUPlace", "TPUPlace", "CUDAPlace", "XPUPlace",
+    "CUDAPinnedPlace",
     "set_device", "get_device", "get_all_devices", "device_count",
     "is_compiled_with_cuda", "is_compiled_with_xpu", "is_compiled_with_rocm",
     "is_compiled_with_tpu", "synchronize", "get_default_backend",
@@ -77,6 +78,13 @@ def CUDAPlace(device_id: int = 0):
 
 def XPUPlace(device_id: int = 0):
     return Place("xpu", device_id)
+
+
+def CUDAPinnedPlace():
+    """reference: phi::CUDAPinnedPlace — page-locked host staging memory.
+    Under PjRt, host staging is managed by the runtime; this is the
+    host-memory Place handle."""
+    return Place("cpu_pinned")
 
 
 # the axon tunnel exposes TPUs under platform name "axon" in some builds

@@ -18,8 +18,40 @@ class Parameter(Tensor):
                          name=name, persistable=True)
         self.trainable = trainable
 
+    def initialize(self):
+        """Materialize a LazyGuard-deferred parameter (reference:
+        EagerParamBase.initialize, nn/initializer/lazy_init.py). No-op
+        for eagerly-created parameters."""
+        spec = getattr(self, "_lazy_spec", None)
+        if spec is not None:
+            init, shape, dtype = spec
+            self._value = init(shape, dtype)
+            self._lazy_spec = None
+
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()
+
+
+# LazyGuard state: while active, Layer.create_parameter skips running
+# initializers (the construct-time cost LazyGuard exists to avoid) and
+# stashes the spec for Parameter.initialize().
+_LAZY_INIT = [False]
+
+
+class LazyGuard:
+    """reference: python/paddle/nn/initializer/lazy_init.py:99 LazyGuard.
+    Under the guard, layer construction defers parameter initialization;
+    call ``param.initialize()`` (or just start training — any in-place
+    load also works) to materialize. TPU note: the placeholder is an XLA
+    zeros buffer, so the guard avoids initializer compute and RNG draws
+    rather than allocation."""
+
+    def __enter__(self):
+        _LAZY_INIT[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        _LAZY_INIT[0] = False
 
 
 class ParamAttr:

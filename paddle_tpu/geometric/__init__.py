@@ -17,7 +17,9 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, dispatch, to_value
 
 __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
-           "send_u_recv", "send_ue_recv", "sample_neighbors"]
+           "send_u_recv", "send_ue_recv", "sample_neighbors",
+           "weighted_sample_neighbors", "reindex_graph",
+           "reindex_heter_graph", "graph_khop_sampler"]
 
 
 def _seg(reduce_fn, data, segment_ids, num_segments, name):
@@ -155,3 +157,168 @@ def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
     if return_eids:
         return neighbors, counts, Tensor(np.concatenate(out_eids))
     return neighbors, counts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size: int = -1, eids=None,
+                              return_eids: bool = False, name=None):
+    """Weighted neighbor sampling from a CSC graph: selection probability
+    proportional to edge weight, without replacement (A-Res reservoir
+    keys: k_i = u_i^(1/w_i), take the top-k). Host-side like
+    sample_neighbors (dynamic output sizes belong off-device).
+    reference: geometric/sampling/neighbors.py weighted_sample_neighbors.
+    """
+    rowv = np.asarray(to_value(row)).ravel()
+    colptrv = np.asarray(to_value(colptr)).ravel()
+    wv = np.asarray(to_value(edge_weight)).ravel().astype(np.float64)
+    nodes = np.asarray(to_value(input_nodes)).ravel()
+    eids_v = np.asarray(to_value(eids)).ravel() if eids is not None else None
+    rng = np.random.default_rng()
+    out_neighbors, out_counts, out_eids = [], [], []
+    for nd in nodes:
+        beg, end = int(colptrv[nd]), int(colptrv[nd + 1])
+        neigh = rowv[beg:end]
+        w = wv[beg:end]
+        ids = eids_v[beg:end] if eids_v is not None else np.arange(beg, end)
+        if 0 <= sample_size < len(neigh):
+            # exponential-sort trick == weighted sampling w/o replacement
+            keys = rng.exponential(1.0, len(neigh)) / np.maximum(w, 1e-30)
+            pick = np.argsort(keys)[:sample_size]
+            neigh, ids = neigh[pick], ids[pick]
+        out_neighbors.append(neigh)
+        out_counts.append(len(neigh))
+        out_eids.append(ids)
+    neighbors = Tensor(np.concatenate(out_neighbors)
+                       if out_neighbors else np.zeros(0, rowv.dtype))
+    counts = Tensor(np.asarray(out_counts, np.int64))
+    if return_eids:
+        return neighbors, counts, Tensor(np.concatenate(out_eids)
+                                         if out_eids
+                                         else np.zeros(0, np.int64))
+    return neighbors, counts
+
+
+def _reindex(xv, neigh_list, count_list, centers_list=None):
+    """Shared hashtable pass: out_nodes = x then first-appearance unique
+    neighbors; edges are (reindexed neighbor -> reindexed center).
+
+    ``centers_list`` gives each layer's center node IDS (khop layers
+    beyond the first); default: every layer's centers are ``xv``.
+    Centers must already be present in the mapping when their layer is
+    processed (khop adds each layer's neighbors before using them as
+    the next layer's centers)."""
+    mapping = {int(v): i for i, v in enumerate(xv)}
+    out_nodes = list(xv)
+    src_lists, dst_lists = [], []
+    if centers_list is None:
+        centers_list = [xv] * len(neigh_list)
+    for centers, neigh, cnt in zip(centers_list, neigh_list, count_list):
+        src, dst = [], []
+        pos = 0
+        for center, c in zip(centers, cnt):
+            ci = mapping[int(center)]
+            for v in neigh[pos:pos + int(c)]:
+                v = int(v)
+                if v not in mapping:
+                    mapping[v] = len(out_nodes)
+                    out_nodes.append(v)
+                src.append(mapping[v])
+                dst.append(ci)
+            pos += int(c)
+        src_lists.append(src)
+        dst_lists.append(dst)
+    return src_lists, dst_lists, out_nodes, mapping
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, name=None):
+    """reference: geometric/reindex.py:34 reindex_graph — renumber the
+    sampled subgraph from 0 with the input nodes first; returns
+    (reindex_src, reindex_dst, out_nodes)."""
+    xv = np.asarray(to_value(x)).ravel()
+    nv = np.asarray(to_value(neighbors)).ravel()
+    cv = np.asarray(to_value(count)).ravel()
+    src, dst, out_nodes, _ = _reindex(xv, [nv], [cv])
+    return (Tensor(np.asarray(src[0], xv.dtype)),
+            Tensor(np.asarray(dst[0], xv.dtype)),
+            Tensor(np.asarray(out_nodes, xv.dtype)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """reference: geometric/reindex.py:153 — multi-edge-type reindex
+    over ONE shared hashtable; per-type edges are concatenated in
+    order. Returns (reindex_src, reindex_dst, out_nodes)."""
+    xv = np.asarray(to_value(x)).ravel()
+    neighs = [np.asarray(to_value(n)).ravel() for n in neighbors]
+    cnts = [np.asarray(to_value(c)).ravel() for c in count]
+    src, dst, out_nodes, _ = _reindex(xv, neighs, cnts)
+    flat_src = [s for lst in src for s in lst]
+    flat_dst = [d for lst in dst for d in lst]
+    return (Tensor(np.asarray(flat_src, xv.dtype)),
+            Tensor(np.asarray(flat_dst, xv.dtype)),
+            Tensor(np.asarray(out_nodes, xv.dtype)))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """reference: incubate/operators/graph_khop_sampler.py:63 — k layers
+    of neighbor sampling with a final subgraph reindex. Returns
+    (edge_src, edge_dst, sample_index, reindex_nodes[, edge_eids])."""
+    rowv = np.asarray(to_value(row)).ravel()
+    colptrv = np.asarray(to_value(colptr)).ravel()
+    nodes0 = np.asarray(to_value(input_nodes)).ravel()
+    eids_v = np.asarray(to_value(sorted_eids)).ravel() \
+        if sorted_eids is not None else None
+    rng = np.random.default_rng()
+
+    frontier = nodes0
+    all_centers, all_neighbors, all_counts, all_eids = [], [], [], []
+    seen = set(int(v) for v in nodes0)
+    for size in sample_sizes:
+        neighs, cnts, layer_eids = [], [], []
+        for nd in frontier:
+            beg, end = int(colptrv[nd]), int(colptrv[nd + 1])
+            neigh = rowv[beg:end]
+            ids = eids_v[beg:end] if eids_v is not None \
+                else np.arange(beg, end)
+            if 0 <= size < len(neigh):
+                pick = rng.choice(len(neigh), size, replace=False)
+                neigh, ids = neigh[pick], ids[pick]
+            neighs.append(neigh)
+            cnts.append(len(neigh))
+            layer_eids.append(ids)
+        layer_neigh = np.concatenate(neighs) if neighs \
+            else np.zeros(0, rowv.dtype)
+        all_centers.append(frontier)
+        all_neighbors.append(layer_neigh)
+        all_counts.append(np.asarray(cnts, np.int64))
+        all_eids.append(np.concatenate(layer_eids) if layer_eids
+                        else np.zeros(0, np.int64))
+        # de-duplicate WITHIN the layer too: a node reached from several
+        # parents must be expanded once, not once per parent
+        nxt = []
+        for v in layer_neigh:
+            v = int(v)
+            if v not in seen:
+                seen.add(v)
+                nxt.append(v)
+        frontier = np.asarray(nxt, rowv.dtype)
+        if len(frontier) == 0:
+            break
+
+    # one shared reindex over every layer's (centers, neighbors)
+    src_lists, dst_lists, uniq, mapping = _reindex(
+        nodes0, all_neighbors, all_counts, centers_list=all_centers)
+    srcs = [s for lst in src_lists for s in lst]
+    dsts = [d for lst in dst_lists for d in lst]
+    edge_src = Tensor(np.asarray(srcs, rowv.dtype).reshape(-1, 1))
+    edge_dst = Tensor(np.asarray(dsts, rowv.dtype).reshape(-1, 1))
+    sample_index = Tensor(np.asarray(uniq, rowv.dtype))
+    reindex_nodes = Tensor(np.asarray(
+        [mapping[int(v)] for v in nodes0], rowv.dtype))
+    if return_eids:
+        return (edge_src, edge_dst, sample_index, reindex_nodes,
+                Tensor(np.concatenate(all_eids) if all_eids
+                       else np.zeros(0, np.int64)))
+    return edge_src, edge_dst, sample_index, reindex_nodes

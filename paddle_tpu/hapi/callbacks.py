@@ -353,7 +353,9 @@ class ReduceLROnPlateau(Callback, _MonitorMixin):
             self.wait += 1
             if self.wait >= self.patience:
                 opt = getattr(self.model, "_optimizer", None)
-                if opt is not None:
+                from ..optimizer.lr import LRScheduler as Sched
+                if opt is not None and not isinstance(
+                        getattr(opt, "_learning_rate", None), Sched):
                     old = opt.get_lr()
                     new = max(old * self.factor, self.min_lr)
                     if old - new > 1e-12:
@@ -361,6 +363,10 @@ class ReduceLROnPlateau(Callback, _MonitorMixin):
                         if self.verbose:
                             print(f"ReduceLROnPlateau: lr {old:.2e} -> "
                                   f"{new:.2e}")
+                elif opt is not None and self.verbose:
+                    # reference warns and skips for scheduler-driven LR
+                    print("ReduceLROnPlateau: learning rate is a "
+                          "scheduler; skipping adjustment")
                 self.cooldown_counter = self.cooldown
                 self.wait = 0
 

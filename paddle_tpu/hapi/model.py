@@ -126,7 +126,11 @@ class Model:
                 logs = {"loss": loss, "batch_size": bs}
                 for m, v in zip(self._metrics, mvals):
                     n = m.name()
-                    logs[n if isinstance(n, str) else n[0]] = v
+                    if isinstance(n, str):
+                        logs[n] = v
+                    else:   # multi-name metric (e.g. acc_top1/acc_top5)
+                        for nm, vv in zip(n, np.ravel(v)):
+                            logs[nm] = vv
                 cbks.on_batch_end("train", step, logs)
                 it += 1
                 if num_iters is not None and it >= num_iters:

@@ -64,9 +64,19 @@ class Layer:
         init = attr.initializer or default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
-        value = init(shape, dtype)
-        p = Parameter(value, name=attr.name or self._auto_param_name(is_bias),
-                      trainable=attr.trainable)
+        from ...framework import _LAZY_INIT
+        if _LAZY_INIT[0]:
+            # LazyGuard active: defer the initializer (its compute + RNG
+            # draw); Parameter.initialize() materializes later
+            import jax.numpy as jnp
+            p = Parameter(jnp.zeros(tuple(shape), dtype),
+                          name=attr.name or self._auto_param_name(is_bias),
+                          trainable=attr.trainable)
+            p._lazy_spec = (init, shape, dtype)
+        else:
+            p = Parameter(init(shape, dtype),
+                          name=attr.name or self._auto_param_name(is_bias),
+                          trainable=attr.trainable)
         p._param_attr = attr
         return p
 

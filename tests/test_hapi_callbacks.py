@@ -130,6 +130,23 @@ class TestReduceLROnPlateau:
         # min_delta=10 means "never improved": epochs 2..3 each stall
         assert m._optimizer.get_lr() == pytest.approx(0.08 * 0.5 * 0.5)
 
+    def test_scheduler_lr_skipped_gracefully(self):
+        """Review regression: a scheduler-driven LR must not crash fit;
+        the callback warns and skips (reference behavior)."""
+        from paddle_tpu.optimizer.lr import StepDecay
+        net = nn.Linear(4, 2)
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.SGD(
+                StepDecay(learning_rate=0.1, step_size=100),
+                parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss())
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=0,
+                               verbose=0, min_delta=10.0)
+        model.fit(_dataset(8), eval_data=_dataset(8), batch_size=4,
+                  epochs=2, verbose=0, callbacks=[cb], shuffle=False)
+        assert model._optimizer.get_lr() == pytest.approx(0.1)
+
     def test_missing_monitor_is_noop(self):
         m = _small_model(lr=0.05)
         cb = ReduceLROnPlateau(monitor="no_such_metric", factor=0.5,

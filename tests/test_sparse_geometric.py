@@ -148,6 +148,87 @@ def test_sample_neighbors():
     assert int(neigh2.numpy()[0]) in (1, 2)
 
 
+def test_weighted_sample_neighbors_respects_weights():
+    # node 0 has neighbors {1, 2}: weight(edge to 1) >> weight(edge to 2)
+    row = np.array([1, 2, 0, 0, 1])
+    colptr = np.array([0, 2, 3, 5])
+    w = np.array([1e6, 1e-6, 1.0, 1.0, 1.0], np.float32)
+    hits = 0
+    for _ in range(20):
+        neigh, counts = geometric.weighted_sample_neighbors(
+            row, colptr, w, np.array([0]), sample_size=1)
+        assert list(counts.numpy()) == [1]
+        hits += int(neigh.numpy()[0] == 1)
+    assert hits >= 18    # p(pick 2) ~ 1e-12 per draw
+
+    # sample_size=-1 returns everything + eids
+    neigh, counts, eids = geometric.weighted_sample_neighbors(
+        row, colptr, w, np.array([0, 2]), return_eids=True,
+        eids=np.arange(5))
+    assert list(counts.numpy()) == [2, 2]
+    assert set(eids.numpy().tolist()) == {0, 1, 3, 4}
+
+
+def test_reindex_graph_reference_example():
+    """Exact example from the reference docstring (reindex.py:51)."""
+    x = np.array([0, 1, 2], np.int64)
+    neighbors = np.array([8, 9, 0, 4, 7, 6, 7], np.int64)
+    count = np.array([2, 3, 2], np.int32)
+    src, dst, out_nodes = geometric.reindex_graph(x, neighbors, count)
+    np.testing.assert_array_equal(src.numpy(), [3, 4, 0, 5, 6, 7, 6])
+    np.testing.assert_array_equal(dst.numpy(), [0, 0, 1, 1, 1, 2, 2])
+    np.testing.assert_array_equal(out_nodes.numpy(),
+                                  [0, 1, 2, 8, 9, 4, 7, 6])
+
+
+def test_reindex_heter_graph_reference_example():
+    """Exact example from the reference docstring (reindex.py:170)."""
+    x = np.array([0, 1, 2], np.int64)
+    na = np.array([8, 9, 0, 4, 7, 6, 7], np.int64)
+    ca = np.array([2, 3, 2], np.int32)
+    nb = np.array([0, 2, 3, 5, 1], np.int64)
+    cb = np.array([1, 3, 1], np.int32)
+    src, dst, out_nodes = geometric.reindex_heter_graph(
+        x, [na, nb], [ca, cb])
+    np.testing.assert_array_equal(
+        src.numpy(), [3, 4, 0, 5, 6, 7, 6, 0, 2, 8, 9, 1])
+    np.testing.assert_array_equal(
+        dst.numpy(), [0, 0, 1, 1, 1, 2, 2, 0, 1, 1, 1, 2])
+    np.testing.assert_array_equal(out_nodes.numpy(),
+                                  [0, 1, 2, 8, 9, 4, 7, 6, 3, 5])
+
+
+def test_graph_khop_sampler_two_layers():
+    # chain graph in CSC: 0 <- 1 <- 2 <- 3 (node i's neighbor is i+1)
+    row = np.array([1, 2, 3], np.int64)
+    colptr = np.array([0, 1, 2, 3, 3], np.int64)
+    src, dst, sample_index, reindex_nodes = geometric.graph_khop_sampler(
+        row, colptr, np.array([0], np.int64), sample_sizes=[1, 1])
+    # layer 1: 0 <- 1; layer 2: 1 <- 2
+    np.testing.assert_array_equal(sample_index.numpy(), [0, 1, 2])
+    np.testing.assert_array_equal(reindex_nodes.numpy(), [0])
+    assert src.numpy().shape == (2, 1)
+    np.testing.assert_array_equal(src.numpy().ravel(), [1, 2])
+    np.testing.assert_array_equal(dst.numpy().ravel(), [0, 1])
+    # eids path
+    *_, eids = geometric.graph_khop_sampler(
+        row, colptr, np.array([0], np.int64), sample_sizes=[1, 1],
+        sorted_eids=np.arange(3), return_eids=True)
+    np.testing.assert_array_equal(np.sort(eids.numpy().ravel()), [0, 1])
+
+
+def test_graph_khop_sampler_diamond_no_duplicate_expansion():
+    """Review regression: a node reached from multiple parents in one
+    layer must be expanded once, not once per parent."""
+    row = np.array([2, 2, 3], np.int64)
+    colptr = np.array([0, 1, 2, 3, 3], np.int64)
+    src, dst, sample_index, _ = geometric.graph_khop_sampler(
+        row, colptr, np.array([0, 1], np.int64), sample_sizes=[-1, -1])
+    np.testing.assert_array_equal(src.numpy().ravel(), [2, 2, 3])
+    np.testing.assert_array_equal(dst.numpy().ravel(), [0, 1, 2])
+    np.testing.assert_array_equal(sample_index.numpy(), [0, 1, 2, 3])
+
+
 def test_message_passing_gradients_flow():
     """Regression: geometric/sparse ops must record GradNodes so upstream
     layers train."""

@@ -496,3 +496,49 @@ class TestRound3Extras:
             opt2.clear_grad()
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+class TestRound4Parity:
+    def test_api_parity_registries_diff_clean(self):
+        """Round-3 verdict Next #9: the measured diff against the
+        reference's tensor_method_func registry and paddle.__all__ must
+        stay closed (tools/check_api_parity.py is the living list)."""
+        import subprocess
+        import sys
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if not os.path.isdir("/root/reference/python/paddle"):
+            pytest.skip("reference checkout not available")
+        p = subprocess.run(
+            [sys.executable, os.path.join(root, "tools",
+                                          "check_api_parity.py")],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert p.returncode == 0, p.stdout + p.stderr
+
+    def test_lazy_guard_defers_initializer(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import LazyGuard
+        paddle.seed(7)
+        with LazyGuard():
+            lin = nn.Linear(16, 16)
+        # deferred: the placeholder is zeros, spec is stashed
+        assert float(np.abs(lin.weight.numpy()).sum()) == 0.0
+        assert lin.weight._lazy_spec is not None
+        lin.weight.initialize()
+        lin.bias.initialize()
+        assert lin.weight._lazy_spec is None
+        assert float(np.abs(lin.weight.numpy()).sum()) > 0  # materialized
+        # eager construction unaffected
+        lin2 = nn.Linear(4, 4)
+        assert getattr(lin2.weight, "_lazy_spec", None) is None
+        assert float(np.abs(lin2.weight.numpy()).sum()) > 0
+
+    def test_top_level_shape_tolist_dtype_places(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        np.testing.assert_array_equal(paddle.shape(x).numpy(), [2, 3])
+        assert paddle.tolist(x) == [[0.0, 1.0, 2.0], [3.0, 4.0, 5.0]]
+        assert paddle.dtype("float32") == np.float32
+        p = paddle.CUDAPinnedPlace()
+        assert "pinned" in repr(p)
+        assert paddle.DataParallel is not None
