@@ -67,17 +67,36 @@ class Config:
         return self._device == "tpu"
 
     # -- parity no-ops (XLA owns these) ------------------------------------
+    # The reference's AnalysisConfig drives a hand-built pass pipeline
+    # (paddle/fluid/inference/api/paddle_pass_builder.cc): IR fusion
+    # passes, TensorRT subgraph capture, memory reuse planning. Under
+    # this framework the whole model is ONE XLA program, and XLA's own
+    # pipeline does those jobs (fusion, layout assignment, buffer
+    # sharing, AOT executable caching) — so these knobs have nothing to
+    # configure. They warn once instead of silently no-oping so ported
+    # serving code gets a signal.
+    @staticmethod
+    def _warn_noop(knob, why):
+        import warnings
+        warnings.warn(
+            f"inference.Config.{knob} has no effect on paddle_tpu: {why}",
+            stacklevel=3)
+
     def switch_ir_optim(self, flag=True):
-        pass
+        self._warn_noop("switch_ir_optim",
+                        "XLA always runs its optimization pipeline")
 
     def enable_memory_optim(self, flag=True):
         self._memory_optim = flag
+        self._warn_noop("enable_memory_optim",
+                        "XLA buffer assignment plans memory reuse")
 
     def enable_profile(self):
         self._profile = True
 
     def enable_tensorrt_engine(self, *a, **kw):
-        pass   # TensorRT slot: XLA AOT compile fills this role
+        self._warn_noop("enable_tensorrt_engine",
+                        "the XLA AOT-compiled executable fills this role")
 
     def summary(self) -> str:
         return (f"Config(model={self._model_prefix}, device={self._device}, "
@@ -112,6 +131,9 @@ class Predictor:
         # model identity for the cache key: a stale executable from an
         # older export must never be reused
         self._model_fingerprint = self._fingerprint(path)
+        import hashlib
+        self._model_path_key = hashlib.sha256(
+            os.path.abspath(path).encode()).hexdigest()[:16]
         # observability: True when the LAST run() executed a deserialized
         # executable (restart-no-recompile verified by tests)
         self.last_run_from_cache = False
@@ -147,18 +169,31 @@ class Predictor:
             jax.__version__, dev.platform,
             getattr(dev, "device_kind", ""), jax.device_count(),
             compile_cfg, sig)).encode()).hexdigest()[:32]
-        # fingerprint prefixes the filename so stale-model entries are
-        # identifiable for pruning
-        return os.path.join(self._cache_dir,
+        # per-model-path subdirectory: two Predictors sharing one
+        # set_optim_cache_dir must not evict each other's executables;
+        # the content fingerprint stays in the filename so a re-export
+        # at the same path is identifiable as stale
+        return os.path.join(self._cache_dir, self._model_path_key,
                             f"{self._model_fingerprint}-{key}.pdexec")
 
     def _prune_stale(self):
-        """Drop entries from other model exports (their fingerprint prefix
-        no longer matches); best-effort, runs on cache miss."""
+        """Drop THIS model path's entries from previous exports (their
+        content fingerprint no longer matches); best-effort, on cache
+        miss. Other models' subdirectories are never touched. Legacy
+        flat-layout entries with this model's fingerprint (pre-subdir
+        cache versions) are cleaned up too."""
+        sub = os.path.join(self._cache_dir, self._model_path_key)
+        try:
+            for name in os.listdir(sub):
+                if name.endswith(".pdexec") and \
+                        not name.startswith(self._model_fingerprint + "-"):
+                    os.remove(os.path.join(sub, name))
+        except OSError:
+            pass
         try:
             for name in os.listdir(self._cache_dir):
                 if name.endswith(".pdexec") and \
-                        not name.startswith(self._model_fingerprint + "-"):
+                        name.startswith(self._model_fingerprint + "-"):
                     os.remove(os.path.join(self._cache_dir, name))
         except OSError:
             pass
@@ -207,7 +242,7 @@ class Predictor:
             try:
                 import pickle
                 from jax.experimental import serialize_executable as se
-                os.makedirs(self._cache_dir, exist_ok=True)
+                os.makedirs(os.path.dirname(fpath), exist_ok=True)
                 self._prune_stale()
                 tmp = fpath + f".tmp{os.getpid()}"
                 with open(tmp, "wb") as f:

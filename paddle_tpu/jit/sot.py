@@ -374,7 +374,12 @@ class SegmentedFunction:
                             multi_output=True)
             for oid, o in zip(step.out_ids, outs):
                 env[oid] = o
-        out_leaves = [env[s] if k == "var" else s
+        # const slots return a FRESH Tensor per replay: handing out the
+        # recorded object would let a caller's in-place mutation corrupt
+        # every later replay of this signature
+        out_leaves = [env[s] if k == "var" else
+                      (Tensor(s._value, stop_gradient=s.stop_gradient)
+                       if isinstance(s, Tensor) else s)
                       for k, s in self._out_slots]
         self.stats = (ops_total + sum(
             1 for p in self._plan if isinstance(p, _Guard)), ops_total)

@@ -73,7 +73,8 @@ def export(layer, path, input_spec=None, opset_version=17, **configs):
             layer.train()
     input_names = [f"x{i}" for i in range(len(example))]
     model = jaxpr_to_onnx(closed, input_names,
-                          graph_name=type(layer).__name__)
+                          graph_name=type(layer).__name__,
+                          opset_version=opset_version)
     out_path = path if path.endswith(".onnx") else path + ".onnx"
     with open(out_path, "wb") as f:
         f.write(model.SerializeToString())

@@ -472,7 +472,8 @@ _INLINE_CALLS = {"pjit", "jit", "closed_call", "custom_jvp_call",
                  "checkpoint", "custom_jvp_call_jaxpr"}
 
 
-def jaxpr_to_onnx(closed_jaxpr, input_names, graph_name="paddle_tpu"):
+def jaxpr_to_onnx(closed_jaxpr, input_names, graph_name="paddle_tpu",
+                  opset_version=OPSET):
     """Convert a ClosedJaxpr to a ModelProto. ``input_names`` label the
     jaxpr invars (the graph inputs); constvars become initializers and
     every eqn unreachable from the inputs is folded eagerly."""
@@ -511,7 +512,13 @@ def jaxpr_to_onnx(closed_jaxpr, input_names, graph_name="paddle_tpu"):
             prim = eqn.primitive.name
             entries = [read(a) for a in eqn.invars]
             if prim in _INLINE_CALLS:
-                sub = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+                sub = (eqn.params.get("jaxpr")
+                       or eqn.params.get("call_jaxpr")
+                       or eqn.params.get("fun_jaxpr"))
+                if sub is None:
+                    raise UnsupportedOp(
+                        f"call primitive {prim} with no recognizable "
+                        f"jaxpr param (keys: {sorted(eqn.params)})")
                 if hasattr(sub, "jaxpr"):        # ClosedJaxpr
                     sub_consts = sub.consts
                     sub = sub.jaxpr
@@ -552,7 +559,9 @@ def jaxpr_to_onnx(closed_jaxpr, input_names, graph_name="paddle_tpu"):
 
     model = ox.ModelProto(ir_version=8, producer_name="paddle_tpu",
                           producer_version="0.3")
-    model.opset_import.add(domain="", version=OPSET)
+    # the emitted op forms are opset-13 compatible, so declaring the
+    # caller's requested opset (13..17) is sound
+    model.opset_import.add(domain="", version=int(opset_version))
     graph = model.graph
     graph.name = graph_name
     for var, name in zip(jaxpr.invars, input_names):

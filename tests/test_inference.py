@@ -324,3 +324,45 @@ def test_generate_paged_matches_dense_greedy():
     dense = generate(params, ids, cfg, g)
     paged = generate_paged(params, ids, cfg, g, block_size=4)
     np.testing.assert_array_equal(np.asarray(dense), np.asarray(paged))
+
+
+@pytest.mark.slow
+def test_shared_cache_dir_two_models_no_eviction(tmp_path):
+    """Advisor fix: two Predictors sharing one set_optim_cache_dir get
+    per-model-path subdirectories and must not evict each other."""
+    import os
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.inference import Config, create_predictor
+
+    cache = str(tmp_path / "shared_cache")
+    paths = []
+    for i, width in enumerate((8, 16)):
+        net = nn.Sequential(nn.Linear(4, width), nn.ReLU(),
+                            nn.Linear(width, 2))
+        net.eval()
+        p = str(tmp_path / f"model{i}")
+        paddle.jit.save(net, p, input_spec=[InputSpec([1, 4], "float32")])
+        paths.append(p)
+
+    x = np.random.RandomState(0).randn(1, 4).astype(np.float32)
+    preds = []
+    for p in paths:
+        cfg = Config(p)
+        cfg.set_optim_cache_dir(cache)
+        pr = create_predictor(cfg)
+        pr.run([paddle.to_tensor(x)])
+        preds.append(pr)
+
+    def count_pdexec():
+        n = 0
+        for root, _, files in os.walk(cache):
+            n += sum(f.endswith(".pdexec") for f in files)
+        return n
+
+    n_after_both = count_pdexec()
+    assert n_after_both >= 2   # both models' executables coexist
+    # pruning model 0's stale entries must not touch model 1's subdir
+    preds[0]._prune_stale()
+    preds[1]._prune_stale()
+    assert count_pdexec() == n_after_both

@@ -451,3 +451,25 @@ def test_static_program_redraws_dropout_each_run():
     a = exe.run(main, feed=feed, fetch_list=[y])[0]
     b = exe.run(main, feed=feed, fetch_list=[y])[0]
     assert not np.allclose(a, b)
+
+
+def test_sot_const_output_not_aliased_across_replays():
+    """Advisor fix: a const output slot must hand out a FRESH Tensor per
+    replay; mutating the returned tensor in place must not corrupt
+    later replays of the same signature."""
+    import paddle_tpu as paddle
+    captured = paddle.to_tensor(np.array([10.0, 20.0], np.float32))
+
+    @paddle.jit.to_static
+    def f(x):
+        if float(x.sum()) > 0:   # graph break -> SOT recording
+            pass
+        return captured          # const output slot (untouched passthrough)
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    f(x)          # recording pass (returns the user's own tensor)
+    out2 = f(x)   # replayed: must be a fresh wrapper
+    out2.set_value(paddle.to_tensor(np.array([-1.0, -1.0], np.float32)))
+    out3 = f(x)   # mutation of a replayed output must not leak
+    assert out3 is not out2
+    np.testing.assert_allclose(out3.numpy(), [10.0, 20.0])

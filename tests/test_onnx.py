@@ -141,3 +141,21 @@ def test_serialized_bytes_parse_standalone(tmp_path):
     assert m.producer_name == "paddle_tpu"
     assert m.graph.input[0].type.tensor_type.shape.dim[1].dim_value == 4
     assert m.SerializeToString() == raw
+
+
+def test_opset_version_threaded_to_model(tmp_path):
+    """Advisor fix: a model requested at opset 13 must declare 13 in
+    opset_import (the emitted op forms are 13-compatible)."""
+    from paddle_tpu import onnx as ponnx
+    net = nn.Linear(4, 2)
+    p = ponnx.export(net, str(tmp_path / "m13"),
+                     input_spec=[InputSpec([1, 4], "float32")],
+                     opset_version=13)
+    m = ox.ModelProto()
+    with open(p, "rb") as f:
+        m.ParseFromString(f.read())
+    assert m.opset_import[0].version == 13
+    with pytest.raises(ValueError):
+        ponnx.export(net, str(tmp_path / "bad"),
+                     input_spec=[InputSpec([1, 4], "float32")],
+                     opset_version=12)
