@@ -1,0 +1,246 @@
+// Native BERT tokenizer (reference:
+// paddle/fluid/operators/string/faster_tokenizer_op.cc — BasicTokenizer +
+// WordPieceTokenizer + BertTokenizer::Encode). Re-implemented from the
+// observable contract: (vocab, text[, text_pair]) -> (input_ids,
+// segment_ids) with do_lower_case, max_seq_len, pad_to_max_seq_len.
+//
+// C API only (ctypes binding, no pybind11 in this image). UTF-8 aware:
+// codepoint iteration, CJK chars split as single tokens, unicode
+// whitespace/punct/control classes over the common ranges, ASCII +
+// Latin-1 lowercasing (the reference links full ICU-style tables; the
+// ranges here cover the vocab encodings the tests exercise).
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Tokenizer {
+  std::unordered_map<std::string, int32_t> vocab;
+  bool lower;
+  int32_t unk = -1, cls = -1, sep = -1, pad = 0;
+};
+
+// -- UTF-8 ------------------------------------------------------------------
+// decode one codepoint at p (advances i); invalid bytes yield U+FFFD
+uint32_t decode(const unsigned char* p, size_t n, size_t& i) {
+  unsigned char c = p[i];
+  if (c < 0x80) { i += 1; return c; }
+  if ((c >> 5) == 0x6 && i + 1 < n) {
+    uint32_t cp = ((c & 0x1F) << 6) | (p[i + 1] & 0x3F);
+    i += 2; return cp;
+  }
+  if ((c >> 4) == 0xE && i + 2 < n) {
+    uint32_t cp = ((c & 0x0F) << 12) | ((p[i + 1] & 0x3F) << 6) |
+                  (p[i + 2] & 0x3F);
+    i += 3; return cp;
+  }
+  if ((c >> 3) == 0x1E && i + 3 < n) {
+    uint32_t cp = ((c & 0x07) << 18) | ((p[i + 1] & 0x3F) << 12) |
+                  ((p[i + 2] & 0x3F) << 6) | (p[i + 3] & 0x3F);
+    i += 4; return cp;
+  }
+  i += 1; return 0xFFFD;
+}
+
+void encode_utf8(uint32_t cp, std::string& out) {
+  if (cp < 0x80) { out.push_back(char(cp)); }
+  else if (cp < 0x800) {
+    out.push_back(char(0xC0 | (cp >> 6)));
+    out.push_back(char(0x80 | (cp & 0x3F)));
+  } else if (cp < 0x10000) {
+    out.push_back(char(0xE0 | (cp >> 12)));
+    out.push_back(char(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(char(0x80 | (cp & 0x3F)));
+  } else {
+    out.push_back(char(0xF0 | (cp >> 18)));
+    out.push_back(char(0x80 | ((cp >> 12) & 0x3F)));
+    out.push_back(char(0x80 | ((cp >> 6) & 0x3F)));
+    out.push_back(char(0x80 | (cp & 0x3F)));
+  }
+}
+
+bool is_whitespace(uint32_t c) {
+  return c == ' ' || c == '\t' || c == '\n' || c == '\r' || c == 0x00A0 ||
+         (c >= 0x2000 && c <= 0x200A) || c == 0x202F || c == 0x205F ||
+         c == 0x3000;
+}
+
+bool is_control(uint32_t c) {
+  if (c == '\t' || c == '\n' || c == '\r') return false;
+  return c < 0x20 || (c >= 0x7F && c < 0xA0) || c == 0x200B || c == 0xFEFF;
+}
+
+bool is_punct(uint32_t c) {
+  // ASCII punctuation blocks (BERT treats all non-alnum ASCII as punct)
+  if ((c >= 33 && c <= 47) || (c >= 58 && c <= 64) ||
+      (c >= 91 && c <= 96) || (c >= 123 && c <= 126)) return true;
+  // general/supplemental punctuation, CJK symbols, full/half-width forms
+  return (c >= 0x2000 && c <= 0x206F) || (c >= 0x3000 && c <= 0x303F) ||
+         (c >= 0xFE30 && c <= 0xFE4F) || (c >= 0xFF00 && c <= 0xFF0F) ||
+         (c >= 0xFF1A && c <= 0xFF20) || (c >= 0xFF3B && c <= 0xFF40) ||
+         (c >= 0xFF5B && c <= 0xFF65);
+}
+
+bool is_cjk(uint32_t c) {
+  return (c >= 0x4E00 && c <= 0x9FFF) || (c >= 0x3400 && c <= 0x4DBF) ||
+         (c >= 0x20000 && c <= 0x2A6DF) || (c >= 0x2A700 && c <= 0x2B73F) ||
+         (c >= 0x2B740 && c <= 0x2B81F) || (c >= 0x2B820 && c <= 0x2CEAF) ||
+         (c >= 0xF900 && c <= 0xFAFF) || (c >= 0x2F800 && c <= 0x2FA1F);
+}
+
+uint32_t to_lower(uint32_t c) {
+  if (c >= 'A' && c <= 'Z') return c + 32;
+  // Latin-1 supplement + Latin extended-A (even/odd pairing)
+  if (c >= 0xC0 && c <= 0xDE && c != 0xD7) return c + 0x20;
+  if (c >= 0x100 && c <= 0x177 && (c % 2 == 0)) return c + 1;
+  if (c >= 0x391 && c <= 0x3A9) return c + 0x20;   // Greek
+  if (c >= 0x410 && c <= 0x42F) return c + 0x20;   // Cyrillic
+  return c;
+}
+
+// basic tokenize: clean, lowercase, split on whitespace/punct/CJK
+std::vector<std::string> basic_tokenize(const Tokenizer& tk,
+                                        const char* text) {
+  const unsigned char* p = reinterpret_cast<const unsigned char*>(text);
+  size_t n = std::strlen(text);
+  std::vector<std::string> out;
+  std::string cur;
+  auto flush = [&]() { if (!cur.empty()) { out.push_back(cur); cur.clear(); } };
+  for (size_t i = 0; i < n;) {
+    uint32_t cp = decode(p, n, i);
+    if (cp == 0 || cp == 0xFFFD || is_control(cp)) continue;
+    if (tk.lower) cp = to_lower(cp);
+    if (is_whitespace(cp)) { flush(); continue; }
+    if (is_punct(cp) || is_cjk(cp)) {
+      flush();
+      std::string one;
+      encode_utf8(cp, one);
+      out.push_back(one);
+      continue;
+    }
+    encode_utf8(cp, cur);
+  }
+  flush();
+  return out;
+}
+
+// wordpiece greedy longest-match (reference WordPieceTokenizer::Tokenize)
+void wordpiece(const Tokenizer& tk, const std::string& word,
+               std::vector<int32_t>& ids) {
+  if (word.size() > 100) { ids.push_back(tk.unk); return; }
+  size_t start = 0;
+  std::vector<int32_t> pieces;
+  while (start < word.size()) {
+    size_t end = word.size();
+    int32_t cur_id = -1;
+    while (start < end) {
+      std::string sub = (start > 0 ? "##" : "") +
+                        word.substr(start, end - start);
+      auto it = tk.vocab.find(sub);
+      if (it != tk.vocab.end()) { cur_id = it->second; break; }
+      // back off one UTF-8 codepoint, not one byte
+      do { --end; } while (end > start &&
+                           (word[end] & 0xC0) == 0x80);
+    }
+    if (cur_id < 0) { ids.assign(1, tk.unk); return; }
+    pieces.push_back(cur_id);
+    start = end;
+  }
+  ids.insert(ids.end(), pieces.begin(), pieces.end());
+}
+
+void tokenize_to_ids(const Tokenizer& tk, const char* text,
+                     std::vector<int32_t>& ids) {
+  for (const auto& w : basic_tokenize(tk, text)) wordpiece(tk, w, ids);
+}
+
+}  // namespace
+
+extern "C" {
+
+// vocab_blob: '\n'-separated tokens, id = line index.
+void* ptk_create(const char* vocab_blob, int do_lower_case) {
+  auto* tk = new Tokenizer();
+  tk->lower = do_lower_case != 0;
+  const char* p = vocab_blob;
+  int32_t id = 0;
+  while (*p) {
+    const char* e = std::strchr(p, '\n');
+    size_t len = e ? size_t(e - p) : std::strlen(p);
+    if (len > 0) tk->vocab.emplace(std::string(p, len), id);
+    ++id;
+    if (!e) break;
+    p = e + 1;
+  }
+  auto find = [&](const char* s) {
+    auto it = tk->vocab.find(s);
+    return it == tk->vocab.end() ? -1 : it->second;
+  };
+  tk->unk = find("[UNK]");
+  tk->cls = find("[CLS]");
+  tk->sep = find("[SEP]");
+  int32_t pad = find("[PAD]");
+  tk->pad = pad < 0 ? 0 : pad;
+  if (tk->unk < 0) { delete tk; return nullptr; }  // UNK is mandatory
+  return tk;
+}
+
+void ptk_destroy(void* h) { delete static_cast<Tokenizer*>(h); }
+
+// Encode a batch. pairs may be null. Outputs are [n, max_seq_len] int32
+// row-major; out_lens[n] gets the unpadded length. When pad_to_max is 0
+// the caller still passes max_seq_len-strided buffers; tail stays pad.
+// Returns 0 on success.
+int ptk_encode(void* h, const char** texts, const char** pairs, int n,
+               int max_seq_len, int pad_to_max, int32_t* input_ids,
+               int32_t* segment_ids, int32_t* out_lens) {
+  auto* tk = static_cast<Tokenizer*>(h);
+  if (tk->cls < 0 || tk->sep < 0) return -2;  // encode needs [CLS]/[SEP]
+  for (int b = 0; b < n; ++b) {
+    std::vector<int32_t> a_ids, b_ids;
+    tokenize_to_ids(*tk, texts[b], a_ids);
+    if (pairs && pairs[b]) tokenize_to_ids(*tk, pairs[b], b_ids);
+    const bool has_pair = pairs && pairs[b];
+    // truncate longest-first to fit specials (reference
+    // BertTokenizer::TruncateSequence longest_first strategy);
+    // SIGNED budget: max_seq_len smaller than the specials alone must
+    // fail cleanly, not wrap and overflow the caller's buffer
+    long budget = long(max_seq_len) - (has_pair ? 3 : 2);
+    if (budget < 0) return -3;
+    while (long(a_ids.size() + b_ids.size()) > budget) {
+      if (a_ids.size() >= b_ids.size()) a_ids.pop_back();
+      else b_ids.pop_back();
+    }
+    int32_t* row_i = input_ids + size_t(b) * max_seq_len;
+    int32_t* row_s = segment_ids + size_t(b) * max_seq_len;
+    for (int j = 0; j < max_seq_len; ++j) { row_i[j] = tk->pad; row_s[j] = 0; }
+    int k = 0;
+    row_i[k++] = tk->cls;
+    for (int32_t id : a_ids) row_i[k++] = id;
+    row_i[k++] = tk->sep;
+    if (has_pair) {
+      int seg1_start = k;
+      for (int32_t id : b_ids) row_i[k++] = id;
+      row_i[k++] = tk->sep;
+      for (int j = seg1_start; j < k; ++j) row_s[j] = 1;
+    }
+    out_lens[b] = k;
+    (void)pad_to_max;
+  }
+  return 0;
+}
+
+// single-text tokenize (no specials): fills up to cap ids, returns count
+int ptk_tokenize(void* h, const char* text, int32_t* ids_out, int cap) {
+  auto* tk = static_cast<Tokenizer*>(h);
+  std::vector<int32_t> ids;
+  tokenize_to_ids(*tk, text, ids);
+  int m = int(ids.size()) < cap ? int(ids.size()) : cap;
+  for (int i = 0; i < m; ++i) ids_out[i] = ids[i];
+  return int(ids.size());
+}
+
+}  // extern "C"

@@ -21,7 +21,7 @@ from typing import Optional
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "csrc")
-_SOURCES = ("tcp_store.cc", "shm_queue.cc")
+_SOURCES = ("tcp_store.cc", "shm_queue.cc", "tokenizer.cc")
 
 _lock = threading.Lock()
 _lib = None
@@ -100,6 +100,21 @@ def _declare(lib: ctypes.CDLL):
     lib.shmq_close.argtypes = [ctypes.c_void_p]
     lib.shmq_unlink.restype = None
     lib.shmq_unlink.argtypes = [ctypes.c_char_p]
+
+    lib.ptk_create.restype = ctypes.c_void_p
+    lib.ptk_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.ptk_destroy.restype = None
+    lib.ptk_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptk_encode.restype = ctypes.c_int
+    lib.ptk_encode.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+    lib.ptk_tokenize.restype = ctypes.c_int
+    lib.ptk_tokenize.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.POINTER(ctypes.c_int32),
+                                 ctypes.c_int]
 
 
 class SharedMemoryQueue:

@@ -173,3 +173,7 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
         return out.reshape(*lead, d)
 
     return dispatch(f, args, name="fused_moe")
+
+
+from .fp8 import (quantize_fp8, dequantize_fp8, fp8_gemm,  # noqa: F401,E402
+                  fp8_linear)

@@ -1,0 +1,88 @@
+"""FP8 path (reference: paddle/phi/kernels/fusion/fp8_gemm/ — CUTLASS
+fp8 GEMM with per-tensor scales and fused epilogues; exposed via
+incubate fused ops).
+
+TPU-native form: newer TPU generations execute fp8 matmuls on the MXU
+directly; under XLA that is ``lax.dot_general`` on float8_e4m3fn /
+float8_e5m2 operands with ``preferred_element_type`` carrying the
+accumulator dtype. The pattern is the standard per-tensor dynamic
+scaling recipe: quantize each operand to fp8 with its own scale,
+multiply in fp8, rescale the accumulator once.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....core.tensor import Tensor, dispatch, to_value
+
+__all__ = ["quantize_fp8", "dequantize_fp8", "fp8_gemm", "fp8_linear"]
+
+_FP8 = {"e4m3": jnp.float8_e4m3fn, "e5m2": jnp.float8_e5m2}
+_FP8_MAX = {"e4m3": 448.0, "e5m2": 57344.0}
+
+
+def _fmt(format):
+    if format not in _FP8:
+        raise ValueError(f"fp8 format must be e4m3 or e5m2, got {format}")
+    return _FP8[format], _FP8_MAX[format]
+
+
+def quantize_fp8(x, scale=None, format="e4m3"):
+    """Per-tensor quantize to fp8. scale=None computes the dynamic
+    per-tensor scale amax/fp8_max (the reference's delayed-scaling
+    counterpart is an amax history; per-call amax is the static-graph
+    equivalent). Returns ``(x_fp8, scale)``; ``x ~= x_fp8 * scale``."""
+    dt, fmax = _fmt(format)
+    x = x if isinstance(x, Tensor) else Tensor(x)
+
+    def f(v):
+        v32 = v.astype(jnp.float32)
+        s = (jnp.max(jnp.abs(v32)) / fmax if scale is None
+             else jnp.asarray(scale, jnp.float32))
+        s = jnp.maximum(s, 1e-12)
+        q = jnp.clip(v32 / s, -fmax, fmax).astype(dt)
+        return q, s
+
+    return dispatch(f, (x,), name="quantize_fp8", multi_output=True)
+
+
+def dequantize_fp8(x_fp8, scale):
+    x_fp8 = x_fp8 if isinstance(x_fp8, Tensor) else Tensor(x_fp8)
+    scale = scale if isinstance(scale, Tensor) else Tensor(scale)
+    return dispatch(lambda q, s: q.astype(jnp.float32) * s,
+                    (x_fp8, scale), name="dequantize_fp8")
+
+
+def fp8_gemm(x_fp8, x_scale, w_fp8, w_scale, bias=None,
+             transpose_w=False, out_dtype="bfloat16"):
+    """fp8 x fp8 -> out_dtype matmul with one accumulator rescale
+    (reference fp8_gemm fused epilogue: alpha = sx*sw, beta-bias)."""
+    args = [t if isinstance(t, Tensor) else Tensor(t)
+            for t in (x_fp8, x_scale, w_fp8, w_scale)]
+    if bias is not None:
+        args.append(bias if isinstance(bias, Tensor) else Tensor(bias))
+    odt = jnp.dtype(out_dtype)
+
+    def f(q, sx, w, sw, *b):
+        if transpose_w:
+            w = w.T
+        acc = lax.dot_general(
+            q, w, (((q.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        out = acc * (sx * sw)
+        if b:
+            out = out + b[0].astype(jnp.float32)
+        return out.astype(odt)
+
+    return dispatch(f, tuple(args), name="fp8_gemm")
+
+
+def fp8_linear(x, weight, bias=None, format="e4m3", out_dtype="bfloat16"):
+    """Dynamic-scaling fp8 linear: quantize x and weight per-tensor,
+    multiply in fp8 on the MXU, rescale once. Gradients flow via the
+    straight-through pattern of the quantize ops' vjp."""
+    xq, sx = quantize_fp8(x, format=format)
+    wq, sw = quantize_fp8(weight, format=format)
+    return fp8_gemm(xq, sx, wq, sw, bias=bias, out_dtype=out_dtype)

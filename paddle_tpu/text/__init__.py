@@ -7,5 +7,9 @@ egress — pass ``data_file`` explicitly).
 """
 from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
 from .datasets import Imdb, UCIHousing  # noqa: F401
+from .tokenizer import FasterTokenizer  # noqa: F401
+from . import strings_ops as strings  # noqa: F401
+from .strings_ops import StringTensor  # noqa: F401
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "UCIHousing"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "UCIHousing",
+           "FasterTokenizer", "StringTensor", "strings"]

@@ -1,0 +1,90 @@
+"""String tensor variant (reference: paddle/phi/core/string_tensor.h
+StringTensor over pstring; kernels paddle/phi/kernels/strings/ —
+strings_empty, strings_lower, strings_upper with utf8 flag).
+
+TPU-native position: strings never reach the accelerator; StringTensor
+is a host container (numpy object array) whose ops mirror the phi
+strings kernel pack, and whose consumers (FasterTokenizer) hand off
+device-ready integer arrays.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "lower", "upper"]
+
+
+class StringTensor:
+    """Host string tensor: shape + UTF-8 string elements."""
+
+    def __init__(self, data: Union[np.ndarray, Sequence, str],
+                 name: str = None):
+        if isinstance(data, str):
+            data = [data]
+        arr = np.asarray(data, dtype=object)
+        bad = [x for x in arr.ravel() if not isinstance(x, str)]
+        if bad:
+            raise TypeError(f"StringTensor elements must be str, got "
+                            f"{type(bad[0]).__name__}")
+        self._data = arr
+        self.name = name or "string_tensor"
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __repr__(self):
+        return (f"StringTensor(shape={self.shape}, "
+                f"data={self._data.tolist()!r})")
+
+    def __eq__(self, other):
+        if isinstance(other, StringTensor):
+            if self._data.shape != other._data.shape:
+                return False    # never broadcast string comparisons
+            return bool((self._data == other._data).all())
+        return NotImplemented
+
+    __hash__ = None   # mutable container, like Tensor: not hashable
+
+
+def empty(shape) -> StringTensor:
+    """reference: strings_empty_kernel — uninitialized (here: empty)
+    string tensor of the given shape."""
+    arr = np.full(tuple(shape), "", dtype=object)
+    return StringTensor(arr)
+
+
+def _map(x: StringTensor, fn) -> StringTensor:
+    out = np.asarray([fn(s) for s in x.numpy().ravel()],
+                     dtype=object).reshape(x.numpy().shape)
+    return StringTensor(out)
+
+
+def lower(x: StringTensor, use_utf8_encoding: bool = True) -> StringTensor:
+    """reference: strings_lower_upper_kernel StringLower. With
+    use_utf8_encoding=False only ASCII letters fold (the reference's
+    charcases_flag fast path)."""
+    if use_utf8_encoding:
+        return _map(x, str.lower)
+    return _map(x, lambda s: "".join(
+        chr(ord(c) + 32) if "A" <= c <= "Z" else c for c in s))
+
+
+def upper(x: StringTensor, use_utf8_encoding: bool = True) -> StringTensor:
+    """reference: strings_lower_upper_kernel StringUpper."""
+    if use_utf8_encoding:
+        return _map(x, str.upper)
+    return _map(x, lambda s: "".join(
+        chr(ord(c) - 32) if "a" <= c <= "z" else c for c in s))

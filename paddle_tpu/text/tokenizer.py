@@ -1,0 +1,254 @@
+"""FasterTokenizer (reference:
+paddle/fluid/operators/string/faster_tokenizer_op.cc — in-graph BERT
+tokenization: (Vocab, Text[, TextPair]) -> (InputIds, SegmentIds) with
+do_lower_case / max_seq_len / pad_to_max_seq_len attributes).
+
+TPU-native split: tokenization is host-side string work (it cannot run
+on the MXU), so the hot path is the NATIVE C++ tokenizer
+(csrc/tokenizer.cc, ctypes-bound) and the arrays it emits are
+device-ready int32 batches. A pure-Python implementation of the same
+basic+wordpiece algorithm backs it when the compiler is unavailable
+(PADDLE_TPU_DISABLE_NATIVE=1).
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.native import load_native
+
+__all__ = ["FasterTokenizer"]
+
+
+def _to_text_list(x) -> List[str]:
+    if isinstance(x, str):
+        return [x]
+    if isinstance(x, (list, tuple)):
+        return [str(s) for s in x]
+    from .strings_ops import StringTensor
+    if isinstance(x, StringTensor):
+        return [str(s) for s in np.asarray(x.numpy()).ravel()]
+    raise TypeError(f"expected str/list[str]/StringTensor, got {type(x)}")
+
+
+# ---------------------------------------------------------------------------
+# pure-Python fallback. Mirrors csrc/tokenizer.cc in its character
+# classes and limits — the two backends must emit identical ids for the
+# same input, so the fallback deliberately reimplements the native
+# code's explicit unicode ranges rather than Python's richer
+# unicodedata classes. Keep the two in lockstep when editing either.
+# ---------------------------------------------------------------------------
+def _is_ws(cp):
+    return cp in (0x20, 0x09, 0x0A, 0x0D, 0x00A0, 0x202F, 0x205F,
+                  0x3000) or 0x2000 <= cp <= 0x200A
+
+
+def _is_ctrl(cp):
+    if cp in (0x09, 0x0A, 0x0D):
+        return False
+    return cp < 0x20 or (0x7F <= cp < 0xA0) or cp in (0x200B, 0xFEFF)
+
+
+def _is_punct(cp):
+    if (33 <= cp <= 47) or (58 <= cp <= 64) or (91 <= cp <= 96) or \
+            (123 <= cp <= 126):
+        return True
+    return (0x2000 <= cp <= 0x206F) or (0x3000 <= cp <= 0x303F) or \
+        (0xFE30 <= cp <= 0xFE4F) or (0xFF00 <= cp <= 0xFF0F) or \
+        (0xFF1A <= cp <= 0xFF20) or (0xFF3B <= cp <= 0xFF40) or \
+        (0xFF5B <= cp <= 0xFF65)
+
+
+def _is_cjk(cp):
+    return (0x4E00 <= cp <= 0x9FFF) or (0x3400 <= cp <= 0x4DBF) or \
+        (0x20000 <= cp <= 0x2A6DF) or (0x2A700 <= cp <= 0x2B73F) or \
+        (0x2B740 <= cp <= 0x2B81F) or (0x2B820 <= cp <= 0x2CEAF) or \
+        (0xF900 <= cp <= 0xFAFF) or (0x2F800 <= cp <= 0x2FA1F)
+
+
+def _to_lower(cp):
+    if 0x41 <= cp <= 0x5A:
+        return cp + 32
+    if 0xC0 <= cp <= 0xDE and cp != 0xD7:
+        return cp + 0x20
+    if 0x100 <= cp <= 0x177 and cp % 2 == 0:
+        return cp + 1
+    if 0x391 <= cp <= 0x3A9:
+        return cp + 0x20
+    if 0x410 <= cp <= 0x42F:
+        return cp + 0x20
+    return cp
+
+
+def _basic_tokenize(text, lower):
+    out, cur = [], []
+    for c in text:
+        cp = ord(c)
+        if cp == 0 or cp == 0xFFFD or _is_ctrl(cp):
+            continue
+        if lower:
+            cp = _to_lower(cp)
+            c = chr(cp)
+        if _is_ws(cp):
+            if cur:
+                out.append("".join(cur))
+                cur = []
+            continue
+        if _is_punct(cp) or _is_cjk(cp):
+            if cur:
+                out.append("".join(cur))
+                cur = []
+            out.append(c)
+            continue
+        cur.append(c)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def _wordpiece(vocab, word, unk):
+    if len(word.encode("utf-8")) > 100:   # native limit is in BYTES
+        return [unk]
+    pieces, start = [], 0
+    while start < len(word):
+        end = len(word)
+        cur = None
+        while start < end:
+            sub = ("##" if start > 0 else "") + word[start:end]
+            if sub in vocab:
+                cur = vocab[sub]
+                break
+            end -= 1
+        if cur is None:
+            return [unk]
+        pieces.append(cur)
+        start = end
+    return pieces
+
+
+class FasterTokenizer:
+    """reference faster_tokenizer_op.cc op contract. Vocab: dict
+    token->id, path to a one-token-per-line vocab file, or list of
+    tokens. ``__call__(text, text_pair=None)`` returns
+    ``(input_ids, segment_ids)`` int32 Tensors [B, S]."""
+
+    def __init__(self, vocab: Union[Dict[str, int], str, Sequence[str]],
+                 do_lower_case: bool = True, max_seq_len: int = 128,
+                 pad_to_max_seq_len: bool = True):
+        if isinstance(vocab, str):
+            with open(vocab, encoding="utf-8") as f:
+                tokens = [line.rstrip("\n") for line in f]
+            vocab = {t: i for i, t in enumerate(tokens) if t}
+        elif not isinstance(vocab, dict):
+            vocab = {t: i for i, t in enumerate(vocab)}
+        self.vocab = dict(vocab)
+        if "[UNK]" not in self.vocab:
+            raise ValueError("vocab must contain [UNK]")
+        self.do_lower_case = do_lower_case
+        if int(max_seq_len) < 2:
+            raise ValueError("max_seq_len must be >= 2 ([CLS] + [SEP])")
+        self.max_seq_len = int(max_seq_len)
+        self.pad_to_max_seq_len = pad_to_max_seq_len
+        self._h = None
+        self._lib = load_native()
+        if self._lib is not None:
+            # id -> token blob ('\n'-separated, line index = id)
+            size = max(self.vocab.values()) + 1
+            lines = [""] * size
+            for t, i in self.vocab.items():
+                lines[i] = t
+            blob = "\n".join(lines).encode("utf-8")
+            self._h = self._lib.ptk_create(blob, int(do_lower_case))
+        self.backend = "native" if self._h else "python"
+
+    def __del__(self):
+        if getattr(self, "_h", None) and getattr(self, "_lib", None):
+            try:
+                self._lib.ptk_destroy(self._h)
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
+
+    # -- encode -------------------------------------------------------------
+    def __call__(self, text, text_pair=None):
+        texts = _to_text_list(text)
+        pairs = _to_text_list(text_pair) if text_pair is not None else None
+        if pairs is not None and len(pairs) != len(texts):
+            raise ValueError("text_pair batch size mismatch")
+        if pairs is not None and self.max_seq_len < 3:
+            raise ValueError(
+                "max_seq_len must be >= 3 for text pairs "
+                "([CLS] + 2x[SEP])")
+        n, S = len(texts), self.max_seq_len
+        ids = np.zeros((n, S), np.int32)
+        segs = np.zeros((n, S), np.int32)
+        lens = np.zeros((n,), np.int32)
+        if self._h:
+            arr_t = (ctypes.c_char_p * n)(
+                *[t.encode("utf-8") for t in texts])
+            arr_p = (ctypes.c_char_p * n)(
+                *[p.encode("utf-8") for p in pairs]) if pairs else None
+            rc = self._lib.ptk_encode(
+                self._h, arr_t, arr_p, n, S,
+                int(self.pad_to_max_seq_len),
+                ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                segs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if rc == -3:
+                raise ValueError("max_seq_len too small for the "
+                                 "special tokens")
+            if rc != 0:
+                raise ValueError(
+                    "encode requires [CLS]/[SEP] in the vocab")
+        else:
+            self._py_encode(texts, pairs, ids, segs, lens)
+        if not self.pad_to_max_seq_len:
+            S_eff = max(1, int(lens.max()) if n else 1)
+            ids, segs = ids[:, :S_eff], segs[:, :S_eff]
+        return Tensor(ids), Tensor(segs)
+
+    def tokenize(self, text: str) -> List[int]:
+        """Wordpiece ids without special tokens."""
+        if self._h:
+            cap = 4 * max(len(text), 1) + 8
+            buf = (ctypes.c_int32 * cap)()
+            m = self._lib.ptk_tokenize(self._h, text.encode("utf-8"),
+                                       buf, cap)
+            return list(buf[:min(m, cap)])
+        unk = self.vocab["[UNK]"]
+        out = []
+        for w in _basic_tokenize(text, self.do_lower_case):
+            out.extend(_wordpiece(self.vocab, w, unk))
+        return out
+
+    def _py_encode(self, texts, pairs, ids, segs, lens):
+        v = self.vocab
+        cls_id, sep_id = v.get("[CLS]"), v.get("[SEP]")
+        if cls_id is None or sep_id is None:
+            raise ValueError("encode requires [CLS]/[SEP] in the vocab")
+        pad_id = v.get("[PAD]", 0)
+        S = self.max_seq_len
+        for b, t in enumerate(texts):
+            a = self.tokenize(t)
+            bb = self.tokenize(pairs[b]) if pairs else []
+            budget = S - (3 if pairs else 2)
+            if budget < 0:
+                raise ValueError("max_seq_len too small for the "
+                                 "special tokens")
+            while len(a) + len(bb) > budget:
+                if len(a) >= len(bb):
+                    a.pop()
+                else:
+                    bb.pop()
+            row = [cls_id] + a + [sep_id]
+            seg = [0] * len(row)
+            if pairs:
+                row += bb + [sep_id]
+                seg += [1] * (len(bb) + 1)
+            lens[b] = len(row)
+            row += [pad_id] * (S - len(row))
+            seg += [0] * (S - len(seg))
+            ids[b, :] = row
+            segs[b, :] = seg

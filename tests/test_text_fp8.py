@@ -1,0 +1,167 @@
+"""FasterTokenizer (native C++ + python fallback), StringTensor, and the
+fp8 path (reference: faster_tokenizer_op.cc, phi/core/string_tensor.h,
+phi/kernels/fusion/fp8_gemm)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import FasterTokenizer, StringTensor, strings
+
+CJK_NI = "你"   # 你
+CJK_HAO = "好"  # 好
+VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "hello", "world", "un",
+         "##aff", "##able", "the", "quick", "brown", "fox", ",", "!",
+         CJK_NI, CJK_HAO]
+
+
+class TestFasterTokenizer:
+    def _tok(self, **kw):
+        return FasterTokenizer(VOCAB, **kw)
+
+    def test_native_backend_loads(self):
+        if os.environ.get("PADDLE_TPU_DISABLE_NATIVE") == "1":
+            pytest.skip("native disabled")
+        assert self._tok().backend == "native"
+
+    def test_wordpiece_and_case(self):
+        tok = self._tok()
+        v = {t: i for i, t in enumerate(VOCAB)}
+        assert tok.tokenize("Hello world") == [v["hello"], v["world"]]
+        assert tok.tokenize("unaffable") == [v["un"], v["##aff"],
+                                             v["##able"]]
+        assert tok.tokenize("xyzzy") == [v["[UNK]"]]
+
+    def test_punct_and_cjk_split(self):
+        tok = self._tok()
+        v = {t: i for i, t in enumerate(VOCAB)}
+        assert tok.tokenize("hello,world!") == [v["hello"], v[","],
+                                                v["world"], v["!"]]
+        assert tok.tokenize(CJK_NI + CJK_HAO) == [v[CJK_NI], v[CJK_HAO]]
+
+    def test_encode_single_and_pair(self):
+        tok = self._tok(max_seq_len=10)
+        v = {t: i for i, t in enumerate(VOCAB)}
+        ids, segs = tok("hello world")
+        assert ids.shape == [1, 10] and segs.shape == [1, 10]
+        row = np.asarray(ids.numpy())[0]
+        np.testing.assert_array_equal(
+            row[:4], [v["[CLS]"], v["hello"], v["world"], v["[SEP]"]])
+        assert (row[4:] == v["[PAD]"]).all()
+        ids, segs = tok(["hello"], text_pair=["world"])
+        row, seg = np.asarray(ids.numpy())[0], np.asarray(segs.numpy())[0]
+        np.testing.assert_array_equal(
+            row[:5], [v["[CLS]"], v["hello"], v["[SEP]"], v["world"],
+                      v["[SEP]"]])
+        np.testing.assert_array_equal(seg[:5], [0, 0, 0, 1, 1])
+
+    def test_truncation_longest_first(self):
+        tok = self._tok(max_seq_len=6)
+        ids, _ = tok(["the quick brown fox"], text_pair=["hello world"])
+        row = np.asarray(ids.numpy())[0]
+        assert len(row) == 6
+        v = {t: i for i, t in enumerate(VOCAB)}
+        assert row[0] == v["[CLS]"] and (row == v["[SEP]"]).sum() == 2
+
+    def test_python_fallback_matches_native(self):
+        """The fallback mirrors the native char classes exactly — same
+        ids for Latin-1/Greek/Cyrillic, byte-limit words, punct."""
+        ext_vocab = VOCAB + ["ärger", "αβ", "да",
+                             "¡"]
+        native = FasterTokenizer(ext_vocab, max_seq_len=12)
+        if native.backend != "native":
+            pytest.skip("native unavailable; nothing to compare")
+        py = FasterTokenizer(ext_vocab, max_seq_len=12)
+        py._h = None   # force the python path
+        py.backend = "python"
+        long_word = "α" * 60   # 120 utf-8 bytes: over the limit
+        for text, pair in [("Hello, world!", None),
+                           ("unaffable fox", "the quick brown fox"),
+                           (CJK_NI + CJK_HAO + " world", None),
+                           ("Ärger ΑΒ ДА", None),
+                           ("¡hola!", None),
+                           (long_word, None)]:
+            a = [np.asarray(t.numpy()) for t in native(text, pair)]
+            b = [np.asarray(t.numpy()) for t in py(text, pair)]
+            np.testing.assert_array_equal(a[0], b[0], err_msg=text)
+            np.testing.assert_array_equal(a[1], b[1], err_msg=text)
+        assert native.tokenize(long_word) == py.tokenize(long_word)
+
+    def test_small_max_seq_len_validated(self):
+        with pytest.raises(ValueError):
+            FasterTokenizer(VOCAB, max_seq_len=1)
+        tok = self._tok(max_seq_len=2)
+        ids, _ = tok("hello world")   # budget 0: only [CLS][SEP]
+        v = {t: i for i, t in enumerate(VOCAB)}
+        np.testing.assert_array_equal(np.asarray(ids.numpy())[0],
+                                      [v["[CLS]"], v["[SEP]"]])
+        with pytest.raises(ValueError):
+            tok(["hello"], text_pair=["world"])   # pairs need >= 3
+
+    def test_string_tensor_input(self):
+        tok = self._tok(max_seq_len=8)
+        st = StringTensor(["hello world", "the fox"])
+        ids, _ = tok(st)
+        assert ids.shape == [2, 8]
+
+
+class TestStringTensor:
+    def test_shape_and_ops(self):
+        st = StringTensor([["Hello", "WORLD"], ["MiXeD", ""]])
+        assert st.shape == [2, 2]
+        lo = strings.lower(st)
+        up = strings.upper(st)
+        assert lo.numpy()[0, 1] == "world"
+        assert up.numpy()[1, 0] == "MIXED"
+        e = strings.empty([3])
+        assert e.shape == [3] and e.numpy()[0] == ""
+
+    def test_ascii_only_mode(self):
+        st = StringTensor(["Ärger Ok"])   # Ärger
+        lo = strings.lower(st, use_utf8_encoding=False)
+        assert lo.numpy()[0] == "Ärger ok"   # non-ASCII untouched
+
+    def test_type_check(self):
+        with pytest.raises(TypeError):
+            StringTensor([1, 2])
+
+
+class TestFP8:
+    def test_quantize_roundtrip(self):
+        from paddle_tpu.incubate.nn.functional import (dequantize_fp8,
+                                                       quantize_fp8)
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(64, 64).astype(np.float32))
+        q, s = quantize_fp8(x, format="e4m3")
+        import jax.numpy as jnp
+        assert q.numpy().dtype == jnp.float8_e4m3fn
+        back = dequantize_fp8(q, s)
+        err = np.abs(np.asarray(back.numpy()) - np.asarray(x.numpy()))
+        # e4m3 has ~2 mantissa-bit relative precision
+        assert err.max() < 0.1 * np.abs(np.asarray(x.numpy())).max()
+
+    def test_fp8_linear_close_to_fp32(self):
+        from paddle_tpu.incubate.nn.functional import fp8_linear
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(8, 32).astype(np.float32))
+        w = paddle.to_tensor(rng.randn(32, 16).astype(np.float32))
+        b = paddle.to_tensor(rng.randn(16).astype(np.float32))
+        out = fp8_linear(x, w, bias=b)
+        ref = np.asarray(x.numpy()) @ np.asarray(w.numpy()) + \
+            np.asarray(b.numpy())
+        got = np.asarray(out.numpy(), np.float32)
+        # fp8 per-tensor scaling: relative error a few percent
+        denom = np.abs(ref).max()
+        assert np.abs(got - ref).max() / denom < 0.08
+        import jax.numpy as jnp
+        assert out.numpy().dtype == jnp.bfloat16
+
+    def test_e5m2_format(self):
+        from paddle_tpu.incubate.nn.functional import quantize_fp8
+        import jax.numpy as jnp
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        q, s = quantize_fp8(x, format="e5m2")
+        assert q.numpy().dtype == jnp.float8_e5m2
+        with pytest.raises(ValueError):
+            quantize_fp8(x, format="e3m4")
