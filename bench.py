@@ -227,6 +227,96 @@ def bench_sd_unet(steps=8, batch=4):
             "batch": batch}
 
 
+def bench_resnet_breakdown(batch=None):
+    """Round-3 verdict Next #3: the perf number must come with a
+    bottleneck analysis. Decomposes the ResNet train step into
+    host->device transfer, forward, forward+backward, and the full
+    donated train step (forward+backward+optimizer), each compiled and
+    timed separately; also saves an XPlane trace of 3 full steps."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    if batch is None:
+        batch = int(os.environ.get("BENCH_BREAKDOWN_BATCH", "256"))
+    paddle.seed(0)
+    net = resnet50(num_classes=1000)
+    net.train()
+    opt = paddle.optimizer.Momentum(0.1, parameters=net.parameters())
+    ts = paddle.jit.train_step(net, F.cross_entropy, opt,
+                               amp_level="O1", amp_dtype="bfloat16")
+    xh = np.random.randn(batch, 3, 224, 224).astype(np.float32)
+    yh = np.random.randint(0, 1000, batch)
+
+    res = {"metric": "resnet50_step_breakdown", "batch": batch}
+
+    def timed(fn, steps=10):
+        """Host-synced timing: block_until_ready does NOT synchronize
+        through the axon tunnel (module docstring), so each window ends
+        with a device->host transfer of one element."""
+        def sync(out):
+            leaf = jax.tree_util.tree_leaves(out)[0]
+            np.asarray(leaf.ravel()[0])
+        sync(fn())   # compile + warmup
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(steps):
+            out = fn()
+        sync(out)
+        return (time.perf_counter() - t0) / steps * 1e3  # ms
+
+    # host->device transfer of one batch (sync: tiny device->host read)
+    res["h2d_ms"] = round(timed(
+        lambda: jax.device_put(xh), steps=5), 2)
+
+    x = paddle.to_tensor(xh)
+    y = paddle.to_tensor(yh)
+    pure_fn, params, buffers = net.functional()
+
+    # fwd/bwd sub-measurements mirror the AMP-O1 bf16 data path (params
+    # and activations bf16, loss fp32) so the residual against the full
+    # bf16 train step isolates the optimizer update
+    params16 = jax.tree_util.tree_map(
+        lambda v: v.astype(jnp.bfloat16)
+        if jnp.issubdtype(v.dtype, jnp.floating) else v, params)
+    fwd = jax.jit(lambda p, b, v: pure_fn(p, b, v)[0])
+    xv = jax.device_put(jnp.asarray(xh, jnp.bfloat16))
+    res["forward_ms"] = round(timed(lambda: fwd(params16, buffers, xv)), 2)
+
+    yv = jax.device_put(jnp.asarray(yh, jnp.int32))
+
+    def loss_fn(p, b, v, t):
+        import jax.nn as jnn
+        logits = pure_fn(p, b, v)[0].astype(jnp.float32)
+        lp = jnn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(lp, t[:, None], 1))
+
+    fb = jax.jit(lambda p, b, v, t: jax.grad(loss_fn)(p, b, v, t))
+    res["fwd_bwd_ms"] = round(timed(
+        lambda: fb(params16, buffers, xv, yv)), 2)
+
+    res["full_step_ms"] = round(timed(lambda: ts(x, y)._value), 2)
+    res["imgs_per_sec"] = round(batch / (res["full_step_ms"] / 1e3), 1)
+    # residual of the full AMP step over bf16 fwd+bwd: optimizer update
+    # + AMP bookkeeping (approximate — separate programs fuse differently)
+    res["optimizer_residual_ms"] = round(
+        res["full_step_ms"] - res["fwd_bwd_ms"], 2)
+
+    try:
+        trace_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "profile_resnet")
+        with jax.profiler.trace(trace_dir):
+            for _ in range(3):
+                out = ts(x, y)
+            jax.block_until_ready(out._value)
+        res["xplane_trace"] = trace_dir
+    except Exception as e:  # noqa: BLE001 — trace is best-effort
+        res["xplane_error"] = f"{type(e).__name__}: {e}"[:120]
+    return res
+
+
 def bench_kernels():
     """VERDICT round-2 item: run the Pallas pack COMPILED on the real chip
     (not interpret mode) — numerics vs the XLA composition plus a
@@ -446,6 +536,7 @@ def bench_kernels():
 CONFIGS = {
     "probe": bench_probe,
     "resnet50": bench_resnet50,
+    "resnet_breakdown": bench_resnet_breakdown,
     "llama": bench_llama,
     "bert": bench_bert,
     "ernie_infer": bench_ernie_infer,
@@ -559,7 +650,8 @@ def _merge_opportunistic(out):
         out["captured_age_sec"] = age_of("resnet50")
         out["captured_at"] = opp.get("resnet50_iso") or opp.get("captured_at")
         out.pop("resnet_error", None)
-    for k in ("llama", "kernels", "ernie_infer", "sd_unet", "bert"):
+    for k in ("llama", "kernels", "ernie_infer", "sd_unet", "bert",
+              "resnet_breakdown"):
         live = out.get(k)
         stale_live = not isinstance(live, dict) or "error" in live
         cap = opp.get(k)
@@ -651,7 +743,8 @@ def main():
     # -- kernels validation + configs 2/4/6, on by default --------------
     if os.environ.get("BENCH_FAST", "0") in ("0", "", "false"):
         extra_t = int(os.environ.get("BENCH_EXTRA_TIMEOUT", "900"))
-        for name in ("kernels", "ernie_infer", "sd_unet", "bert"):
+        for name in ("kernels", "ernie_infer", "sd_unet", "bert",
+                     "resnet_breakdown"):
             out[name] = run_cfg(name, extra_t)
             save_partial()
 

@@ -13,12 +13,40 @@ __all__ = ["Model"]
 
 
 class Model:
+    """reference: python/paddle/hapi/model.py:1472 Model — the high-level
+    train/eval/predict facade. ``inputs``/``labels`` are InputSpec lists
+    (reference requires them in static mode; here they drive
+    ``save(training=False)`` inference export and ``summary()``)."""
+
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
         self._optimizer = None
         self._loss = None
         self._metrics = []
         self.stop_training = False
+        self._inputs = self._to_specs(inputs)
+        self._labels = self._to_specs(labels)
+
+    @staticmethod
+    def _to_specs(specs):
+        if specs is None:
+            return None
+        from ..static import InputSpec
+        out = []
+        for s in (specs if isinstance(specs, (list, tuple)) else [specs]):
+            if isinstance(s, InputSpec):
+                out.append(s)
+            elif isinstance(s, (list, tuple)):
+                out.append(InputSpec(s))
+            elif isinstance(s, np.ndarray):
+                out.append(InputSpec.from_numpy(s))
+            elif isinstance(s, Tensor):
+                out.append(InputSpec.from_tensor(s))
+            else:
+                raise TypeError(
+                    "Model inputs/labels entries must be InputSpec, "
+                    f"shape list, Tensor, or ndarray; got {type(s)}")
+        return out
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None):
@@ -201,9 +229,19 @@ class Model:
         return outputs
 
     def save(self, path, training=True):
+        """training=True: checkpoint (params + optimizer state).
+        training=False: inference export via jit.save (reference
+        Model.save -> paddle.jit.save with the prepared input specs)."""
+        if not training:
+            from .. import jit
+            if self._inputs is None:
+                raise ValueError(
+                    "save(training=False) needs Model(inputs=[InputSpec])")
+            jit.save(self.network, path, input_spec=self._inputs)
+            return
         from ..framework.io import save as psave
         psave(self.network.state_dict(), path + ".pdparams")
-        if training and self._optimizer is not None:
+        if self._optimizer is not None:
             psave(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
@@ -220,4 +258,9 @@ class Model:
 
     def summary(self, input_size=None, dtype=None):
         from .summary import summary
+        if input_size is None and self._inputs:
+            input_size = [tuple(1 if d is None else d for d in s.shape)
+                          for s in self._inputs]
+            if len(input_size) == 1:
+                input_size = input_size[0]
         return summary(self.network, input_size, dtypes=dtype)

@@ -3,34 +3,80 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, no_grad
+
+
+def _example_inputs(input_size, dtypes):
+    sizes = input_size
+    if isinstance(sizes, tuple) or (isinstance(sizes, list)
+                                    and sizes and not isinstance(
+                                        sizes[0], (list, tuple))):
+        sizes = [sizes]
+    if dtypes is None:
+        dtypes = ["float32"] * len(sizes)
+    elif isinstance(dtypes, str):
+        dtypes = [dtypes] * len(sizes)
+    return [Tensor(np.zeros(tuple(1 if d is None else int(d) for d in s),
+                            np.dtype(dt)))
+            for s, dt in zip(sizes, dtypes)]
 
 
 def summary(net, input_size=None, dtypes=None, input=None):
+    """Parameter table; with ``input_size``/``input`` also runs one
+    forward pass to record each sublayer's output shape (reference
+    model_summary hooks)."""
+    out_shapes = {}
+    if input is not None or input_size is not None:
+        xs = [input] if isinstance(input, Tensor) else (
+            list(input) if input is not None
+            else _example_inputs(input_size, dtypes))
+        hooks = []
+        for name, layer in net.named_sublayers():
+            def mk(nm):
+                def hook(lyr, inp, out):
+                    leaf = out[0] if isinstance(out, (tuple, list)) else out
+                    if isinstance(leaf, Tensor):
+                        out_shapes[nm] = list(leaf.shape)
+                return hook
+            hooks.append(layer.register_forward_post_hook(mk(name)))
+        try:
+            with no_grad():
+                net(*xs)
+        finally:
+            for h in hooks:
+                h.remove()
+
     rows = []
     total_params = 0
     trainable_params = 0
     for name, layer in net.named_sublayers(include_self=True):
-        n_params = 0
-        for _, p in layer._parameters.items():
-            if p is not None:
-                n_params += p.size
         if not name:
             continue
         total = sum(p.size for p in layer._parameters.values()
                     if p is not None)
-        rows.append((name, type(layer).__name__, total))
+        rows.append((name, type(layer).__name__, total,
+                     out_shapes.get(name)))
     for p in net.parameters():
         total_params += p.size
         if p.trainable:
             trainable_params += p.size
     width = max((len(r[0]) for r in rows), default=20) + 2
-    print(f"{'Layer':<{width}}{'Type':<24}{'Params':>12}")
-    print("-" * (width + 36))
-    for name, tname, n in rows:
-        print(f"{name:<{width}}{tname:<24}{n:>12,}")
-    print("-" * (width + 36))
+    shape_col = 20 if out_shapes else 0
+    hdr = f"{'Layer':<{width}}{'Type':<24}{'Params':>12}"
+    if shape_col:
+        hdr += f"  {'Output Shape':<{shape_col}}"
+    print(hdr)
+    print("-" * (width + 36 + (shape_col + 2 if shape_col else 0)))
+    for name, tname, n, shape in rows:
+        line = f"{name:<{width}}{tname:<24}{n:>12,}"
+        if shape_col:
+            line += f"  {str(shape) if shape else '':<{shape_col}}"
+        print(line)
+    print("-" * (width + 36 + (shape_col + 2 if shape_col else 0)))
     print(f"Total params: {total_params:,}")
     print(f"Trainable params: {trainable_params:,}")
-    return {"total_params": total_params,
-            "trainable_params": trainable_params}
+    result = {"total_params": total_params,
+              "trainable_params": trainable_params}
+    if out_shapes:
+        result["output_shapes"] = out_shapes
+    return result

@@ -198,3 +198,49 @@ class TestConfig:
         user = ProgBarLogger(5)
         cbks = config_callbacks([user], model=None, verbose=1)
         assert sum(isinstance(c, ProgBarLogger) for c in cbks.callbacks) == 1
+
+
+class TestModelSpecs:
+    def test_inference_export_and_predictor_roundtrip(self, tmp_path):
+        """Model(inputs=specs).save(training=False) -> loadable by the
+        inference Predictor (reference Model.save -> jit.save)."""
+        from paddle_tpu.static import InputSpec
+        from paddle_tpu.inference import Config, create_predictor
+        net = nn.Linear(4, 2)
+        # fixed batch: the serialized executable is shape-specialized
+        m = Model(net, inputs=[InputSpec([2, 4], "float32")])
+        path = str(tmp_path / "infer_model")
+        m.save(path, training=False)
+        cfg = Config(path)
+        pred = create_predictor(cfg)
+        x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+        out = pred.run([paddle.to_tensor(x)])[0].numpy()
+        ref = np.asarray(net(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_save_without_specs_raises(self, tmp_path):
+        m = _small_model()
+        with pytest.raises(ValueError, match="InputSpec"):
+            m.save(str(tmp_path / "x"), training=False)
+
+    def test_summary_uses_specs_for_output_shapes(self):
+        from paddle_tpu.static import InputSpec
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m = Model(net, inputs=[InputSpec([None, 4], "float32")])
+        s = m.summary()
+        assert s["total_params"] == (4 * 8 + 8) + (8 * 2 + 2)
+        # specs drove a forward pass: per-layer output shapes recorded
+        assert s["output_shapes"]["0"] == [1, 8]
+        assert s["output_shapes"]["2"] == [1, 2]
+
+    def test_numpy_input_spec_and_bad_type(self):
+        m = Model(nn.Linear(4, 2),
+                  inputs=np.zeros((2, 4), np.float32))
+        assert m._inputs[0].shape == [2, 4]
+        with pytest.raises(TypeError):
+            Model(nn.Linear(4, 2), inputs=[object()])
+
+    def test_shape_specs_accepted(self):
+        m = Model(nn.Linear(4, 2), inputs=[[None, 4]])
+        assert m._inputs[0].shape == [None, 4]
