@@ -14,6 +14,8 @@ from .pp_compiled import (CompiledPipeline, Compiled1F1B,  # noqa
                           CompiledInterleaved, pipeline_microbatch)
 from . import sequence_parallel_utils  # noqa: F401
 from . import random  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
 
 # paddle-compat: fleet.meta_parallel namespace
 from . import mp_layers as _mp

@@ -1,0 +1,95 @@
+"""fleet.utils (reference: python/paddle/distributed/fleet/utils/
+__init__.py — recompute re-export; recompute itself lives in
+fleet/recompute/recompute.py).
+
+``recompute`` is activation checkpointing: run the wrapped segment
+without stashing intermediate activations and recompute them during
+backward. The reference swaps RNG state and replays the forward inside
+a custom PyLayer; the TPU-native form wraps the functionalized segment
+in ``jax.checkpoint`` — XLA then rematerializes the segment's
+activations in the backward pass, which is the same FLOPs-for-HBM trade
+the reference makes, applied by the compiler.
+"""
+from __future__ import annotations
+
+import weakref
+
+import jax
+
+from ....core.tensor import Tensor, _pure_region, dispatch, to_value
+from ....static.control_flow import _discover, _flatten_out
+
+__all__ = ["recompute"]
+
+# discovery results cached per function OBJECT (weak key: entries die
+# with the function, so no id-reuse aliasing and no pinned weights after
+# a model is discarded) and per arg/kwarg structure. Unhashable or
+# non-weakrefable callables skip caching and pay discovery per call.
+_CAPTURE_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _sig_one(v):
+    v = to_value(v) if isinstance(v, Tensor) else v
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        return (tuple(v.shape), str(v.dtype))
+    return ("const", repr(v)[:40])
+
+
+def _sig(args, kwargs):
+    return (tuple(_sig_one(a) for a in args),
+            tuple((k, _sig_one(v)) for k, v in sorted(kwargs.items())))
+
+
+def recompute(function, *args, use_reentrant: bool = True,
+              preserve_rng_state: bool = True, **kwargs):
+    """reference: fleet/recompute/recompute.py recompute(function, *args).
+
+    Returns ``function(*args, **kwargs)`` with gradients computed by
+    re-running the segment in backward (no stored activations). Tensor
+    positional args AND parameters the segment's closure captures
+    (Layer weights) become explicit operands — both are value-swapped
+    during the trace, so a closure that also reads an arg tensor sees
+    the traced operand, never a baked constant. Non-Tensor args pass
+    through untouched (reference semantics). ``use_reentrant`` /
+    ``preserve_rng_state`` are accepted for API parity; jax.checkpoint
+    has no non-reentrant variant and the traced RNG key replays by
+    construction.
+    """
+    subkey = _sig(args, kwargs)
+    bucket = None
+    try:
+        bucket = _CAPTURE_CACHE.setdefault(function, {})
+    except TypeError:
+        bucket = None   # unhashable/non-weakrefable callable
+    cached = bucket.get(subkey) if bucket is not None else None
+
+    arg_tensors = [a for a in args if isinstance(a, Tensor)]
+    arg_ids = {id(a) for a in arg_tensors}
+    if cached is None:
+        captured, _, _, treedef = _discover(
+            lambda: function(*args, **kwargs))
+        extra = [t for t in captured if id(t) not in arg_ids]
+        if bucket is not None:
+            bucket[subkey] = (extra, treedef)
+    else:
+        extra, treedef = cached
+
+    operands = arg_tensors + extra   # all value-swapped during trace
+
+    @jax.checkpoint
+    def pure(*vals):
+        saved = [t._value for t in operands]
+        for t, v in zip(operands, vals):
+            t._value = v
+        try:
+            with _pure_region():
+                out = function(*args, **kwargs)
+            # flatten BEFORE restoring (identity outputs would bake)
+            return tuple(_flatten_out(out)[0])
+        finally:
+            for t, s in zip(operands, saved):
+                t._value = s
+
+    outs = dispatch(pure, tuple(operands), name="recompute",
+                    multi_output=True)
+    return jax.tree_util.tree_unflatten(treedef, list(outs))

@@ -1,0 +1,125 @@
+"""Activation checkpointing (reference:
+python/paddle/distributed/fleet/recompute/recompute.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet import recompute
+
+
+class TestRecompute:
+    def _net(self, seed=0):
+        paddle.seed(seed)
+        return nn.Sequential(nn.Linear(8, 16), nn.Tanh(),
+                             nn.Linear(16, 8))
+
+    def test_grads_match_plain(self):
+        net = self._net()
+        x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                             .astype(np.float32), stop_gradient=False)
+        out = recompute(net, x)
+        out.mean().backward()
+        g_rc = [np.asarray(p.grad.numpy()) for p in net.parameters()]
+        gx_rc = np.asarray(x.grad.numpy())
+
+        net2 = self._net()
+        x2 = paddle.to_tensor(np.asarray(x.numpy()), stop_gradient=False)
+        net2(x2).mean().backward()
+        g_pl = [np.asarray(p.grad.numpy()) for p in net2.parameters()]
+        for a, b in zip(g_rc, g_pl):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(gx_rc, np.asarray(x2.grad.numpy()),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_forward_value_matches(self):
+        net = self._net(3)
+        x = paddle.to_tensor(np.random.RandomState(1).randn(2, 8)
+                             .astype(np.float32))
+        np.testing.assert_allclose(np.asarray(recompute(net, x).numpy()),
+                                   np.asarray(net(x).numpy()),
+                                   rtol=1e-6)
+
+    def test_capture_cache_hit(self):
+        from paddle_tpu.distributed.fleet.utils import _CAPTURE_CACHE
+        net = self._net(5)
+        x = paddle.to_tensor(np.random.RandomState(2).randn(2, 8)
+                             .astype(np.float32))
+        before = len(_CAPTURE_CACHE)
+        recompute(net, x)
+        assert len(_CAPTURE_CACHE) == before + 1
+        recompute(net, x)   # same function + shapes: no new entry
+        assert len(_CAPTURE_CACHE) == before + 1
+
+    def test_trains_in_loop(self):
+        net = self._net(7)
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        rng = np.random.RandomState(0)
+        X = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        Y = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+        losses = []
+        for _ in range(10):
+            out = recompute(net, X)
+            loss = ((out - Y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_kwargs_passthrough(self):
+        def seg(a, scale=1.0):
+            return a * scale
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        out = recompute(seg, x, scale=2.0)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [2, 2, 2])
+
+
+class TestRecomputeReviewRegressions:
+    def test_non_tensor_args_pass_through(self):
+        """Python-typed args must reach the segment untouched."""
+        def seg(x, n, mode):
+            assert isinstance(n, int) and mode == "double"
+            for _ in range(n):
+                x = x * 2.0
+            return x
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        out = recompute(seg, x, 2, "double")
+        np.testing.assert_allclose(np.asarray(out.numpy()), [4, 4, 4])
+
+    def test_closure_reading_arg_tensor_not_baked(self):
+        """A closure that reads the SAME tensor passed positionally must
+        see the traced operand: d/dx (x + x) == 2, not 1."""
+        x = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+        out = recompute(lambda a: a + x, x)
+        out.backward()
+        assert float(x.grad.numpy()) == 2.0
+
+    def test_ephemeral_functions_no_stale_cache(self):
+        """Two different models through ephemeral callables must each
+        get their own gradients (id-reuse must not alias cache
+        entries)."""
+        import gc
+        grads = []
+        for seed in (1, 2):
+            net = nn.Linear(4, 4)
+            paddle.seed(seed)
+            x = paddle.to_tensor(np.ones((2, 4), np.float32))
+            out = recompute(lambda v: net(v) * 1.0, x)
+            out.sum().backward()
+            grads.append(np.asarray(net.weight.grad.numpy()).copy())
+            assert np.abs(grads[-1]).sum() > 0
+            del net
+            gc.collect()
+
+    def test_cache_dies_with_function(self):
+        import gc
+        from paddle_tpu.distributed.fleet.utils import _CAPTURE_CACHE
+        net = nn.Linear(4, 4)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        recompute(net, x)
+        assert net in _CAPTURE_CACHE
+        n_before = len(_CAPTURE_CACHE)
+        del net
+        gc.collect()
+        assert len(_CAPTURE_CACHE) < n_before   # weak key released
