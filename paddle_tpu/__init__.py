@@ -117,6 +117,9 @@ def __getattr__(name):
     if name == "callbacks":
         from .hapi import callbacks as _c
         return _c
+    if name == "hub":
+        from .hapi import hub as _h
+        return _h
     if name == "DataParallel":
         from .distributed.parallel import DataParallel as _dp
         return _dp

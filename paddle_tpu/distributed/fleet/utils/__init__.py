@@ -16,8 +16,8 @@ import weakref
 
 import jax
 
-from ....core.tensor import Tensor, _pure_region, dispatch, to_value
-from ....static.control_flow import _discover, _flatten_out
+from ....core.tensor import Tensor, dispatch, to_value
+from ....static.control_flow import _discover, _rebound
 
 __all__ = ["recompute"]
 
@@ -32,7 +32,7 @@ def _sig_one(v):
     v = to_value(v) if isinstance(v, Tensor) else v
     if hasattr(v, "shape") and hasattr(v, "dtype"):
         return (tuple(v.shape), str(v.dtype))
-    return ("const", repr(v)[:40])
+    return ("const", repr(v))   # full repr: prefixes must not collide
 
 
 def _sig(args, kwargs):
@@ -63,7 +63,11 @@ def recompute(function, *args, use_reentrant: bool = True,
         bucket = None   # unhashable/non-weakrefable callable
     cached = bucket.get(subkey) if bucket is not None else None
 
-    arg_tensors = [a for a in args if isinstance(a, Tensor)]
+    # Tensor args AND Tensor kwargs become fresh per-call operands (a
+    # cache hit must not ride the FIRST call's kwarg tensors — they'd
+    # bake as constants and silently drop gradients)
+    arg_tensors = [a for a in args if isinstance(a, Tensor)] + \
+        [v for _, v in sorted(kwargs.items()) if isinstance(v, Tensor)]
     arg_ids = {id(a) for a in arg_tensors}
     if cached is None:
         captured, _, _, treedef = _discover(
@@ -75,20 +79,8 @@ def recompute(function, *args, use_reentrant: bool = True,
         extra, treedef = cached
 
     operands = arg_tensors + extra   # all value-swapped during trace
-
-    @jax.checkpoint
-    def pure(*vals):
-        saved = [t._value for t in operands]
-        for t, v in zip(operands, vals):
-            t._value = v
-        try:
-            with _pure_region():
-                out = function(*args, **kwargs)
-            # flatten BEFORE restoring (identity outputs would bake)
-            return tuple(_flatten_out(out)[0])
-        finally:
-            for t, s in zip(operands, saved):
-                t._value = s
+    run = _rebound(lambda: function(*args, **kwargs), operands)
+    pure = jax.checkpoint(lambda *vals: tuple(run(list(vals))))
 
     outs = dispatch(pure, tuple(operands), name="recompute",
                     multi_output=True)

@@ -1,0 +1,98 @@
+"""paddle.hub (reference: python/paddle/hapi/hub.py — list/help/load
+over a repo's ``hubconf.py`` entrypoints).
+
+This environment has no egress, so the github/gitee sources raise a
+clear error pointing at ``source='local'`` (which implements the full
+reference contract: import hubconf.py from the repo dir, check its
+``dependencies`` list, expose non-underscore callables as entrypoints).
+"""
+from __future__ import annotations
+
+import builtins
+import os
+import sys
+import types
+from typing import Any, Callable, List
+
+__all__ = ["list", "help", "load"]
+
+VAR_DEPENDENCY = "dependencies"
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _import_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    sys.path.insert(0, repo_dir)
+    try:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("hubconf", path)
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+    finally:
+        sys.path.remove(repo_dir)
+    _check_dependencies(m)
+    return m
+
+
+def _check_module_exists(name: str) -> bool:
+    try:
+        __import__(name)
+        return True
+    except ImportError:
+        return False
+
+
+def _check_dependencies(m: types.ModuleType):
+    deps = getattr(m, VAR_DEPENDENCY, None)
+    if deps:
+        missing = [d for d in deps if not _check_module_exists(d)]
+        if missing:
+            raise RuntimeError(
+                "Missing dependencies: " + ", ".join(missing))
+
+
+def _resolve(repo_dir: str, source: str):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f'Unknown source: "{source}". Allowed values: "github" | '
+            '"gitee" | "local".')
+    if source != "local":
+        raise RuntimeError(
+            "this deployment has no network egress; clone the repo and "
+            "use hub.load(path, ..., source='local')")
+    return _import_hubconf(repo_dir)
+
+
+def _entry(m, name: str) -> Callable:
+    if not isinstance(name, str):
+        raise ValueError("Invalid input: model should be a str of "
+                         "function name")
+    func = getattr(m, name, None)
+    if func is None or not callable(func):
+        raise RuntimeError(f"Cannot find callable {name} in hubconf")
+    return func
+
+
+def list(repo_dir: str, source: str = "github",
+         force_reload: bool = False) -> builtins.list:
+    """reference hub.py:188 — entrypoint names in the repo's hubconf."""
+    m = _resolve(repo_dir, source)
+    # every non-underscore callable, including ones hubconf imported
+    # (`from models import resnet50` is the common pattern) — matching
+    # the reference; modules themselves aren't callable so don't appear
+    return [k for k, v in vars(m).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False) -> str:
+    """reference hub.py:238 — the entrypoint's docstring."""
+    return _entry(_resolve(repo_dir, source), model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         force_reload: bool = False, **kwargs) -> Any:
+    """reference hub.py:286 — call the entrypoint with kwargs."""
+    return _entry(_resolve(repo_dir, source), model)(**kwargs)

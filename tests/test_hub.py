@@ -1,0 +1,65 @@
+"""paddle.hub local source (reference: python/paddle/hapi/hub.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def hub_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        'dependencies = ["numpy"]\n'
+        "import numpy as _np\n\n\n"
+        "def tiny_mlp(width=4):\n"
+        '    """A tiny MLP entrypoint."""\n'
+        "    import paddle_tpu.nn as nn\n"
+        "    return nn.Linear(width, width)\n\n\n"
+        "def _private_helper():\n"
+        "    pass\n")
+    return str(tmp_path)
+
+
+def test_list_entrypoints(hub_repo):
+    names = paddle.hub.list(hub_repo, source="local")
+    assert "tiny_mlp" in names
+    assert not any(n.startswith("_") for n in names)
+
+
+def test_list_includes_imported_callables(tmp_path):
+    """Reference behavior: `from x import fn` entrypoints are listed."""
+    (tmp_path / "models.py").write_text(
+        "def imported_entry():\n    return 42\n")
+    (tmp_path / "hubconf.py").write_text(
+        "from models import imported_entry\n")
+    names = paddle.hub.list(str(tmp_path), source="local")
+    assert "imported_entry" in names
+    assert paddle.hub.load(str(tmp_path), "imported_entry",
+                           source="local") == 42
+
+
+def test_help_and_load(hub_repo):
+    doc = paddle.hub.help(hub_repo, "tiny_mlp", source="local")
+    assert "tiny MLP" in doc
+    net = paddle.hub.load(hub_repo, "tiny_mlp", source="local", width=6)
+    x = paddle.to_tensor(np.ones((2, 6), np.float32))
+    assert list(net(x).shape) == [2, 6]
+
+
+def test_missing_dependency_raises(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        'dependencies = ["not_a_real_pkg_xyz"]\n'
+        "def f():\n    return 1\n")
+    with pytest.raises(RuntimeError, match="Missing dependencies"):
+        paddle.hub.list(str(tmp_path), source="local")
+
+
+def test_remote_sources_gated(hub_repo):
+    with pytest.raises(RuntimeError, match="egress"):
+        paddle.hub.load("PaddlePaddle/PaddleClas", "resnet50")
+    with pytest.raises(ValueError, match="Unknown source"):
+        paddle.hub.list(hub_repo, source="svn")
+
+
+def test_bad_entry_raises(hub_repo):
+    with pytest.raises(RuntimeError, match="Cannot find callable"):
+        paddle.hub.load(hub_repo, "nope", source="local")

@@ -123,3 +123,24 @@ class TestRecomputeReviewRegressions:
         del net
         gc.collect()
         assert len(_CAPTURE_CACHE) < n_before   # weak key released
+
+    def test_tensor_kwarg_grad_on_cache_hit(self):
+        """Review regression: a Tensor kwarg must get gradients on the
+        SECOND (cache-hit) call, not just the first."""
+        def seg(a, w=None):
+            return (a * w).sum()
+        x1 = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        k1 = paddle.to_tensor(np.full(3, 2.0, np.float32),
+                              stop_gradient=False)
+        recompute(seg, x1, w=k1).backward()
+        np.testing.assert_allclose(np.asarray(k1.grad.numpy()), [1, 1, 1])
+        x2 = paddle.to_tensor(np.full(3, 5.0, np.float32),
+                              stop_gradient=False)
+        k2 = paddle.to_tensor(np.full(3, 3.0, np.float32),
+                              stop_gradient=False)
+        out2 = recompute(seg, x2, w=k2)
+        assert float(out2.numpy()) == 45.0
+        out2.backward()
+        assert k2.grad is not None
+        np.testing.assert_allclose(np.asarray(k2.grad.numpy()), [5, 5, 5])
+        np.testing.assert_allclose(np.asarray(x2.grad.numpy()), [3, 3, 3])
