@@ -12,4 +12,27 @@ from .auto_cast import (auto_cast, amp_guard, white_list, black_list,  # noqa
                         get_amp_dtype)
 from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
 
-__all__ = ["auto_cast", "decorate", "GradScaler", "is_auto_cast_enabled"]
+__all__ = ["auto_cast", "decorate", "GradScaler", "is_auto_cast_enabled",
+           "is_float16_supported", "is_bfloat16_supported"]
+
+
+def is_float16_supported(device=None):
+    """reference: amp/__init__.py is_float16_supported. TPUs compute
+    reduced precision as bfloat16; fp16 storage is supported but bf16 is
+    the native fast path, so this mirrors the reference's capability
+    probe semantics."""
+    import jax
+    try:
+        return jax.devices()[0].platform in ("tpu", "gpu", "cpu")
+    except Exception:  # noqa: BLE001 — backend probe failure
+        return False
+
+
+def is_bfloat16_supported(device=None):
+    """reference: amp/__init__.py is_bfloat16_supported — always true on
+    TPU (the MXU's native reduced precision)."""
+    import jax
+    try:
+        return jax.devices()[0].platform in ("tpu", "cpu", "gpu")
+    except Exception:  # noqa: BLE001
+        return False

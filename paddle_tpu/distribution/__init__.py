@@ -23,7 +23,8 @@ __all__ = [
     "Beta", "Dirichlet", "Gamma", "Exponential", "Laplace", "LogNormal",
     "Multinomial", "Gumbel", "Geometric", "Poisson", "Binomial", "Cauchy",
     "StudentT", "Chi2", "Independent", "TransformedDistribution",
-    "kl_divergence", "register_kl",
+    "kl_divergence", "register_kl", "ExponentialFamily", "MultivariateNormal",
+    "ContinuousBernoulli", "LKJCholesky",
 ]
 
 
@@ -833,3 +834,243 @@ def _kl_laplace_laplace(p, q):
     r = p.scale / q.scale
     d = jnp.abs(p.loc - q.loc) / q.scale
     return _t(jnp.log(q.scale / p.scale) + r * jnp.exp(-d / r) + d - 1)
+
+
+class ExponentialFamily(Distribution):
+    """reference: distribution/exponential_family.py — base for
+    exponential-family distributions; provides the Bregman-divergence
+    entropy via differentiating the log normalizer (subclasses supply
+    ``_natural_parameters`` and ``_log_normalizer``)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0
+
+    def entropy(self):
+        """-H = E[log p] = sum(eta * E[T(x)]) - A(eta) + E[log h(x)];
+        E[T] = dA/deta (the reference's autodiff-through-A trick)."""
+        nat = tuple(jnp.asarray(p, jnp.float32)
+                    for p in self._natural_parameters)
+        # E[T(x)] = dA/deta, elementwise for independent components
+        grads = jax.grad(lambda ps: jnp.sum(self._log_normalizer(*ps)))(nat)
+        result = self._log_normalizer(*nat)
+        for eta, g in zip(nat, grads):
+            result = result - eta * g
+        return _t(result - self._mean_carrier_measure)
+
+
+class MultivariateNormal(Distribution):
+    """reference: distribution/multivariate_normal.py — parameterized by
+    loc + one of covariance_matrix / precision_matrix / scale_tril."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = jnp.asarray(_v(loc), jnp.float32)
+        n_given = sum(p is not None for p in
+                      (covariance_matrix, precision_matrix, scale_tril))
+        if n_given != 1:
+            raise ValueError("pass exactly one of covariance_matrix, "
+                             "precision_matrix, scale_tril")
+        if scale_tril is not None:
+            self._L = jnp.asarray(_v(scale_tril), jnp.float32)
+        elif covariance_matrix is not None:
+            self._L = jnp.linalg.cholesky(
+                jnp.asarray(_v(covariance_matrix), jnp.float32))
+        else:
+            prec = jnp.asarray(_v(precision_matrix), jnp.float32)
+            self._L = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        d = self.loc.shape[-1]
+        if self._L.shape[-1] != d:
+            raise ValueError("loc/scale dimension mismatch")
+        super().__init__(jnp.broadcast_shapes(
+            self.loc.shape[:-1], self._L.shape[:-2]), (d,))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc,
+                                   self._batch_shape + self._event_shape))
+
+    @property
+    def covariance_matrix(self):
+        return _t(self._L @ jnp.swapaxes(self._L, -1, -2))
+
+    @property
+    def variance(self):
+        cov = self._L @ jnp.swapaxes(self._L, -1, -2)
+        return _t(jnp.broadcast_to(
+            jnp.diagonal(cov, axis1=-2, axis2=-1),
+            self._batch_shape + self._event_shape))
+
+    def sample(self, shape=()):
+        z = jax.random.normal(next_key(), tuple(shape)
+                              + self._batch_shape + self._event_shape)
+        return _t(self.loc + jnp.einsum("...ij,...j->...i", self._L, z))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = jnp.asarray(_v(value), jnp.float32)
+        d = self._event_shape[0]
+        diff = v - self.loc
+        # solve L y = diff; |y|^2 is the Mahalanobis term
+        y = jax.scipy.linalg.solve_triangular(self._L, diff[..., None],
+                                              lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(
+            jnp.diagonal(self._L, axis1=-2, axis2=-1)), -1)
+        return _t(-0.5 * jnp.sum(y * y, -1) - half_logdet
+                  - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = self._event_shape[0]
+        half_logdet = jnp.sum(jnp.log(
+            jnp.diagonal(self._L, axis1=-2, axis2=-1)), -1)
+        out = 0.5 * d * (1 + math.log(2 * math.pi)) + half_logdet
+        return _t(jnp.broadcast_to(out, self._batch_shape))
+
+
+class ContinuousBernoulli(ExponentialFamily):
+    """reference: distribution/continuous_bernoulli.py (Loaiza-Ganem &
+    Cunningham 2019): support (0,1), pdf C(lam) lam^x (1-lam)^(1-x)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = jnp.asarray(_v(probs), jnp.float32)
+        self._lims = lims
+        super().__init__(jnp.shape(self.probs))
+
+    def _outside(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _log_norm_const(self):
+        # C(lam) = 2 atanh(1-2lam) / (1-2lam), with the lam->1/2 limit 2
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        safe = jnp.where(self._outside(), lam, 0.25)
+        cut = jnp.log(2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe))
+        # Taylor at 1/2: log 2 + log(1 + (1-2lam)^2/3 + ...)
+        t = 1 - 2 * lam
+        taylor = math.log(2.0) + jnp.log1p(t * t / 3 + t ** 4 / 5)
+        return jnp.where(self._outside(), cut, taylor)
+
+    @property
+    def mean(self):
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        safe = jnp.where(self._outside(), lam, 0.25)
+        cut = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        t = lam - 0.5
+        taylor = 0.5 + t / 3 + 16 / 45 * t ** 3
+        return _t(jnp.where(self._outside(), cut, taylor))
+
+    @property
+    def variance(self):
+        # numeric second moment via quadrature is overkill; use the
+        # reference's closed form outside the limit window
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        safe = jnp.where(self._outside(), lam, 0.25)
+        cut = safe * (safe - 1) / (1 - 2 * safe) ** 2 + \
+            1 / (2 * jnp.arctanh(1 - 2 * safe)) ** 2
+        t = lam - 0.5
+        taylor = 1 / 12 - t * t / 15
+        return _t(jnp.where(self._outside(), cut, taylor))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(next_key(), self._extend(shape),
+                               minval=1e-6, maxval=1 - 1e-6)
+        return self._icdf(u)
+
+    rsample = sample
+
+    def _icdf(self, u):
+        # F(x) = (e^{eta x} - 1)/(e^eta - 1), eta = logit(lam):
+        # x = log1p(u (2lam-1)/(1-lam)) / log(lam/(1-lam))
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        safe = jnp.where(self._outside(), lam, 0.25)
+        num = jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+        den = jnp.log(safe / (1 - safe))
+        cut = num / den
+        return _t(jnp.where(self._outside(), cut, u))
+
+    def log_prob(self, value):
+        v = jnp.asarray(_v(value), jnp.float32)
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        return _t(self._log_norm_const() + v * jnp.log(lam)
+                  + (1 - v) * jnp.log1p(-lam))
+
+    def entropy(self):
+        # E[log p] has no neat closed form; use the exp-family identity
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        mu = _v(self.mean)
+        return _t(-(self._log_norm_const() + mu * jnp.log(lam)
+                    + (1 - mu) * jnp.log1p(-lam)))
+
+    @property
+    def _natural_parameters(self):
+        lam = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        return (jnp.log(lam / (1 - lam)),)
+
+    def _log_normalizer(self, eta):
+        # A(eta) = log[(e^eta - 1)/eta] for eta != 0
+        safe = jnp.where(jnp.abs(eta) > 1e-3, eta, 1.0)
+        cut = jnp.log(jnp.expm1(safe)) - jnp.log(safe)
+        taylor = eta / 2 + eta ** 2 / 24
+        return jnp.where(jnp.abs(eta) > 1e-3, cut, taylor)
+
+
+class LKJCholesky(Distribution):
+    """reference: distribution/lkj_cholesky.py — distribution over
+    Cholesky factors of correlation matrices (LKJ 2009), onion-method
+    sampling; density prop. to prod diag(L)_i^(dim - i - 2 + 2*conc)."""
+
+    def __init__(self, dim, concentration=1.0,
+                 sample_method="onion", name=None):
+        if dim < 2:
+            raise ValueError("dim must be >= 2")
+        self.dim = int(dim)
+        self.concentration = jnp.asarray(_v(concentration), jnp.float32)
+        self.sample_method = sample_method
+        super().__init__(jnp.shape(self.concentration),
+                         (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        """Onion method: rows built from beta-distributed radii and
+        uniformly-directed unit vectors."""
+        d = self.dim
+        batch = tuple(shape) + self._batch_shape
+        conc = jnp.broadcast_to(self.concentration, batch)
+        L = jnp.zeros(batch + (d, d), jnp.float32)
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            # beta(i/2, conc + (d - 1 - i)/2) radius-squared
+            a = i / 2.0
+            b = conc + (d - 1 - i) / 2.0
+            r2 = jax.random.beta(next_key(), a, b, batch)
+            u = jax.random.normal(next_key(), batch + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            L = L.at[..., i, :i].set(jnp.sqrt(r2)[..., None] * u)
+            L = L.at[..., i, i].set(jnp.sqrt(1 - r2))
+        return _t(L)
+
+    def log_prob(self, value):
+        L = jnp.asarray(_v(value), jnp.float32)
+        d = self.dim
+        conc = self.concentration
+        order = jnp.arange(2, d + 1, dtype=jnp.float32)
+        exponents = d - order + 2.0 * conc[..., None] - 2.0
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        unnorm = jnp.sum(exponents * jnp.log(diag), -1)
+        # normalizer (reference lkj_cholesky.py _log_normalizer):
+        # log Z = sum_{k=1..d-1} [ 0.5*k*log(pi)
+        #         + gammaln(alpha - k/2) ] - (d-1) * gammaln(alpha)
+        dm1 = d - 1
+        alpha = conc + 0.5 * dm1
+        ks = jnp.arange(1, dm1 + 1, dtype=jnp.float32)
+        log_norm = jnp.sum(
+            0.5 * ks * math.log(math.pi)
+            + jax.scipy.special.gammaln(alpha[..., None] - 0.5 * ks), -1) \
+            - dm1 * jax.scipy.special.gammaln(alpha)
+        return _t(unnorm - log_norm)

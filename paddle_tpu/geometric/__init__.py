@@ -18,7 +18,7 @@ from ..core.tensor import Tensor, dispatch, to_value
 
 __all__ = ["segment_sum", "segment_mean", "segment_max", "segment_min",
            "send_u_recv", "send_ue_recv", "sample_neighbors",
-           "weighted_sample_neighbors", "reindex_graph",
+           "weighted_sample_neighbors", "reindex_graph", "send_uv",
            "reindex_heter_graph", "graph_khop_sampler"]
 
 
@@ -322,3 +322,24 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
                 Tensor(np.concatenate(all_eids) if all_eids
                        else np.zeros(0, np.int64)))
     return edge_src, edge_dst, sample_index, reindex_nodes
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add",
+            name=None):
+    """reference: geometric/message_passing/send_recv.py send_uv —
+    per-edge messages combining source-node and destination-node
+    features (gather + elementwise; no reduce)."""
+    src = jnp.asarray(to_value(src_index), jnp.int32)
+    dst = jnp.asarray(to_value(dst_index), jnp.int32)
+    if message_op not in ("add", "sub", "mul", "div"):
+        raise ValueError(f"unsupported message_op {message_op}")
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    y = y if isinstance(y, Tensor) else Tensor(y)
+
+    def f(xv, yv):
+        a = jnp.take(xv, src, axis=0)
+        b = jnp.take(yv, dst, axis=0)
+        return {"add": a + b, "sub": a - b, "mul": a * b,
+                "div": a / b}[message_op]
+
+    return dispatch(f, (x, y), name="send_uv")

@@ -12,12 +12,13 @@ from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
 from .sampler import (Sampler, SequenceSampler, RandomSampler,  # noqa: F401
                       WeightedRandomSampler, BatchSampler,
                       SubsetRandomSampler, DistributedBatchSampler)
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (DataLoader, default_collate_fn,  # noqa: F401
+                         WorkerInfo, get_worker_info)
 
 __all__ = [
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "Subset", "ConcatDataset", "random_split", "Sampler",
     "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "BatchSampler", "SubsetRandomSampler", "DistributedBatchSampler",
-    "DataLoader",
+    "DataLoader", "WorkerInfo", "get_worker_info",
 ]

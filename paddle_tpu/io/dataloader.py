@@ -95,8 +95,31 @@ class _PrefetchIter:
         return self
 
 
+class WorkerInfo:
+    """reference: io/dataloader/worker.py WorkerInfo — id / num_workers /
+    dataset of the calling worker; None in the main process."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, "
+                f"num_workers={self.num_workers})")
+
+
+_WORKER_INFO = [None]
+
+
+def get_worker_info():
+    """reference: python/paddle/io/__init__.py get_worker_info — worker
+    context inside DataLoader subprocess/thread workers, else None."""
+    return _WORKER_INFO[0]
+
+
 def _worker_loop(dataset, collate_fn, task_q, result_q, use_shm,
-                 worker_init_fn, worker_id):
+                 worker_init_fn, worker_id, num_workers=0):
     """Subprocess worker (reference: python/paddle/io/dataloader/worker.py
     _worker_loop): pulls (batch_idx, indices) tasks, pushes collated numpy
     batches back — through the native shared-memory ring queue
@@ -104,6 +127,7 @@ def _worker_loop(dataset, collate_fn, task_q, result_q, use_shm,
     Workers never touch jax; device_put happens in the parent."""
     import pickle
     import traceback
+    _WORKER_INFO[0] = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:
@@ -181,7 +205,8 @@ class _ProcessPoolIter:
             ctx.Process(target=_worker_loop,
                         args=(loader.dataset, loader.collate_fn,
                               self.task_q, self.result_q, self.use_shm,
-                              loader.worker_init_fn, i),
+                              loader.worker_init_fn, i,
+                              loader.num_workers),
                         daemon=True)
             for i in range(loader.num_workers)]
         for w in self.workers:

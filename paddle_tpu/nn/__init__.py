@@ -3,7 +3,7 @@ from . import initializer  # noqa: F401
 from . import functional  # noqa: F401
 
 from .layer.layers import (Layer, Sequential, LayerList, ParameterList,  # noqa
-                           LayerDict)
+                           ParameterDict, LayerDict)
 from .layer.common import *  # noqa: F401,F403
 from .layer.activation import *  # noqa: F401,F403
 from .layer.conv import *  # noqa: F401,F403

@@ -213,3 +213,21 @@ def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
     else ``value``."""
     return dispatch(lambda v: jnp.where(v > threshold, v, value),
                     (_ensure(x),), name="thresholded_relu")
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):
+    """reference: nn/functional/activation.py hardtanh_ (inplace)."""
+    x._replace_value(jnp.clip(x._value, min, max))
+    return x
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    """reference: nn/functional/activation.py leaky_relu_ (inplace)."""
+    x._replace_value(jax.nn.leaky_relu(x._value, negative_slope))
+    return x
+
+
+def thresholded_relu_(x, threshold=1.0, value=0.0, name=None):
+    """reference: nn/functional/activation.py thresholded_relu_."""
+    x._replace_value(jnp.where(x._value > threshold, x._value, value))
+    return x

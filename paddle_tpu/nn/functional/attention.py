@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -145,3 +146,167 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
         ar = jnp.arange(m)
         return (ar[None, :] < v[..., None]).astype(convert_dtype(dtype))
     return dispatch(f, (_ensure(x),), name="sequence_mask")
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """reference: nn/functional/flash_attention.py flash_attn_qkvpacked —
+    qkv packed [B, S, H/Hk + 2, Hk, D]: leading slices are the query
+    heads (GQA groups), the last two are K and V."""
+    qkv = _ensure(qkv)
+
+    def f(p):
+        b, s, n, hk, d = p.shape
+        g = n - 2
+        # ops.flash_attention pairs q head j with kv head j // (H//Hk)
+        # (consecutive grouping), so kv-aligned q heads must land
+        # consecutively: [B,S,G,Hk,D] -> [B,S,Hk,G,D] -> [B,S,Hk*G,D]
+        q = jnp.swapaxes(p[:, :, :-2], 2, 3).reshape(b, s, g * hk, d)
+        k = p[:, :, -2]
+        v = p[:, :, -1]
+        from ...ops.flash_attention import flash_attention as _fa
+        rate = dropout if (dropout and training) else 0.0
+        return _fa(q, k, v, causal=causal, dropout_rate=rate)
+
+    out = dispatch(f, (qkv,), name="flash_attn_qkvpacked")
+    return out, None
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q=None, max_seqlen_k=None,
+                                scale=None, dropout=0.0, causal=False,
+                                return_softmax=False,
+                                fixed_seed_offset=None, rng_name="",
+                                training=True, varlen_padded=True,
+                                name=None):
+    """reference: flash_attention.py flash_attn_varlen_qkvpacked — the
+    packed-varlen form: qkv [total, H/Hk + 2, Hk, D] + cu_seqlens."""
+    qkv = _ensure(qkv)
+
+    def split(p):
+        t_, n_, hk_, d_ = p.shape
+        # same consecutive-grouping GQA head order as flash_attn_qkvpacked
+        q = jnp.swapaxes(p[:, :-2], 1, 2).reshape(t_, (n_ - 2) * hk_, d_)
+        return q, p[:, -2], p[:, -1]
+
+    q, k, v = dispatch(split, (qkv,), name="qkv_unpack",
+                       multi_output=True)
+    return flash_attn_unpadded(
+        q, k, v, cu_seqlens_q, cu_seqlens_k,
+        max_seqlen_q=max_seqlen_q, max_seqlen_k=max_seqlen_k, scale=scale,
+        dropout=dropout, causal=causal, return_softmax=return_softmax,
+        training=training)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, window_size=None,
+                        return_softmax_lse=False, return_seed_offset=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """reference: flash_attention.py flashmask_attention (FlashMask,
+    arXiv:2410.01359): column-wise row ranges define the mask.
+
+    startend_row_indices [B, Hk, Sk, L]:
+    - L=1 + causal: rows >= LTS[c] are masked for column c;
+    - L=2 + causal: rows in [LTS[c], LTE[c]) are masked;
+    - L=2 + non-causal: rows >= LTS (lower) and rows < UTE (upper);
+    - L=4 + non-causal: rows in [LTS, LTE) and [UTS, UTE) masked.
+
+    TPU-native: the ranges expand to a dense additive mask feeding the
+    fused attention (XLA fuses the comparison-generated mask into the
+    softmax; the dedicated Pallas block-skip path is the kernels pack's
+    autotune territory).
+    """
+    q, k, v = _ensure(query), _ensure(key), _ensure(value)
+    if startend_row_indices is None:
+        return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                               training=training)[0]
+    idx = _ensure(startend_row_indices)
+
+    def f(qv, kv, vv, iv):
+        b, sq, h, d = qv.shape
+        sk = kv.shape[1]
+        hk = iv.shape[1]
+        L = iv.shape[-1]
+        rows = jnp.arange(sq)[:, None]            # [Sq, 1]
+        iv = jnp.swapaxes(iv, 2, 3)               # [B, Hk, L, Sk]
+        if causal:
+            if L == 1:
+                masked = rows >= iv[:, :, 0][:, :, None, :]
+            elif L == 2:
+                masked = (rows >= iv[:, :, 0][:, :, None, :]) & \
+                         (rows < iv[:, :, 1][:, :, None, :])
+            else:
+                raise NotImplementedError(
+                    "causal flashmask expects 1 or 2 indices")
+            base = rows < jnp.arange(sk)[None, :]  # future positions
+            masked = masked | base[None, None]
+        else:
+            if L == 2:
+                masked = (rows >= iv[:, :, 0][:, :, None, :]) | \
+                         (rows < iv[:, :, 1][:, :, None, :])
+            elif L == 4:
+                masked = ((rows >= iv[:, :, 0][:, :, None, :]) &
+                          (rows < iv[:, :, 1][:, :, None, :])) | \
+                         ((rows >= iv[:, :, 2][:, :, None, :]) &
+                          (rows < iv[:, :, 3][:, :, None, :]))
+            else:
+                raise NotImplementedError(
+                    "non-causal flashmask expects 2 or 4 indices")
+        # broadcast Hk mask groups over the query heads
+        rep = h // hk
+        masked = jnp.repeat(masked, rep, axis=1)   # [B, H, Sq, Sk]
+        # finite mask value: a fully-masked query row must not softmax
+        # over all -inf (NaN); -1e30 keeps the row defined
+        bias = jnp.where(masked, jnp.asarray(-1e30, jnp.float32), 0.0)
+        return _sdpa_ref(qv, kv, vv, bias, dropout if training else 0.0,
+                         False, training)
+
+    out = dispatch(f, (q, k, v, idx), name="flashmask_attention")
+    if return_softmax_lse or return_seed_offset:
+        extras = tuple(None for _ in range(
+            int(return_softmax_lse) + int(return_seed_offset)))
+        return (out,) + extras
+    return out
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """reference: nn/functional/sparse_attention.py — block-sparse
+    attention with a CSR connectivity pattern per head. q/k/v
+    [B, H, S, D]; offset [B, H, S+1]; columns [B, H, nnz]. Positions not
+    listed in a row's CSR columns do not attend. Dense-mask lowering
+    (the CSR pattern becomes a boolean mask XLA fuses into softmax)."""
+    q, k, v = _ensure(query), _ensure(key), _ensure(value)
+    off, cols = _ensure(sparse_csr_offset), _ensure(sparse_csr_columns)
+    args = [q, k, v, off, cols]
+    if key_padding_mask is not None:
+        args.append(_ensure(key_padding_mask))
+
+    def f(qv, kv, vv, ov, cv, *kpm):
+        b, h, s, d = qv.shape
+        nnz = cv.shape[-1]
+        # row id of each nnz entry: number of row starts at or before it
+        row_of = (jnp.arange(nnz)[None, None, :]
+                  >= ov[..., 1:-1, None]).sum(-2)
+        mask = jnp.zeros((b, h, s, s), bool)
+        bidx = jnp.arange(b)[:, None, None]
+        hidx = jnp.arange(h)[None, :, None]
+        valid = jnp.arange(nnz)[None, None, :] < ov[..., -1:]
+        mask = mask.at[bidx, hidx, row_of, cv.astype(jnp.int32)].max(
+            valid)
+        scores = jnp.einsum("bhsd,bhtd->bhst", qv.astype(jnp.float32),
+                            kv.astype(jnp.float32)) / np.sqrt(d)
+        if kpm:
+            keep = kpm[0][:, None, None, :] > 0
+            mask = mask & keep
+        scores = jnp.where(mask, scores, -jnp.inf)
+        p = jax.nn.softmax(scores, axis=-1)
+        p = jnp.where(jnp.isfinite(
+            jnp.max(scores, -1, keepdims=True)), p, 0.0)
+        return jnp.einsum("bhst,bhtd->bhsd", p,
+                          vv.astype(jnp.float32)).astype(qv.dtype)
+
+    return dispatch(f, tuple(args), name="sparse_attention")

@@ -85,6 +85,26 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
     return dispatch(f, (_ensure(x),), name="alpha_dropout")
 
 
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """reference: nn/functional/common.py feature_alpha_dropout — alpha
+    dropout that drops whole channels (dim 1), keeping SELU
+    self-normalizing statistics."""
+    if not training or p == 0.0:
+        return _ensure(x)
+
+    def f(v):
+        key = next_key()
+        alpha = 1.6732632423543772848170429916717
+        scale = 1.0507009873554804934193349852946
+        alpha_p = -alpha * scale
+        mask_shape = (v.shape[0], v.shape[1]) + (1,) * (v.ndim - 2)
+        keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+        a = (1.0 / np.sqrt((alpha_p ** 2 * p + 1) * (1 - p))) if p < 1 else 0.
+        b = -a * alpha_p * p
+        return (jnp.where(keep, v, alpha_p) * a + b).astype(v.dtype)
+    return dispatch(f, (_ensure(x),), name="feature_alpha_dropout")
+
+
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Gather rows of ``weight``; padding_idx rows get zero grad (reference:
     python/paddle/nn/functional/input.py embedding). On TPU the gather lowers

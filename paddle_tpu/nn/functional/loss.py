@@ -5,7 +5,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...core.tensor import Tensor, dispatch
+from ...core.tensor import Tensor, dispatch, to_value
 
 
 def _ensure(x):
@@ -390,3 +390,293 @@ def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
         out = jnp.where(d <= delta, quad, lin)
         return _reduce(out, reduction)
     return dispatch(f, (_ensure(input), _ensure(label)), name="huber_loss")
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    """reference: nn/functional/loss.py multi_margin_loss — multi-class
+    hinge: mean_j max(0, margin - x_y + x_j)^p over j != y."""
+    args = (_ensure(input), _ensure(label)) + \
+        ((_ensure(weight),) if weight is not None else ())
+
+    def f(x, y, *w):
+        n, c = x.shape
+        y = y.astype(jnp.int32).reshape(-1)
+        x_y = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - x_y + x) ** p
+        if w:
+            m = m * jnp.take(w[0], y)[:, None]
+        m = m * (1 - jax.nn.one_hot(y, c, dtype=x.dtype))  # skip j == y
+        return _reduce(jnp.sum(m, axis=1) / c, reduction)
+
+    return dispatch(f, args, name="multi_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    """reference: nn/functional/loss.py triplet_margin_with_distance_loss
+    — triplet loss with a user distance; default pairwise L2."""
+    from ...core.tensor import Tensor as _T
+
+    def dist(a, b):
+        if distance_function is not None:
+            out = distance_function(_T(a), _T(b))
+            from ...core.tensor import to_value
+            return to_value(out)
+        return jnp.sqrt(jnp.sum((a - b) ** 2, axis=-1) + 1e-12)
+
+    def f(a, pos, neg):
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+
+    return dispatch(f, (_ensure(input), _ensure(positive),
+                        _ensure(negative)),
+                    name="triplet_margin_with_distance_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference: nn/functional/loss.py npair_loss (N-pair loss, NIPS16):
+    cross entropy over anchor . positive^T similarities + L2 on
+    embeddings."""
+    def f(a, pos, y):
+        y = y.reshape(-1)
+        sim = a @ pos.T                     # [B, B]
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = same / jnp.sum(same, axis=1, keepdims=True)
+        lsm = jax.nn.log_softmax(sim, axis=1)
+        ce = -jnp.mean(jnp.sum(tgt * lsm, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1))
+                        + jnp.mean(jnp.sum(pos * pos, 1))) * 0.25
+        return ce + reg
+
+    return dispatch(f, (_ensure(anchor), _ensure(positive),
+                        _ensure(labels)), name="npair_loss")
+
+
+def _default_tree_paths(num_classes):
+    """Complete-binary-tree paths for default hsigmoid (reference
+    HierarchicalSigmoid default mode, phi/kernels/cpu/hsigmoid_loss_
+    kernel.cc via matrix_bit_code): leaf for class c is heap node
+    c + num_classes - 1; internal nodes 0..num_classes-2; code bit 1 for
+    the RIGHT child on the way down."""
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+    tables, codes = [], []
+    for c in range(num_classes):
+        node = c + num_classes - 1
+        path, code = [], []
+        while node > 0:
+            parent = (node - 1) // 2
+            path.append(parent)
+            code.append(node == 2 * parent + 2)   # right child -> 1
+            node = parent
+        path = path[::-1][:depth]
+        code = code[::-1][:depth]
+        pad = depth - len(path)
+        tables.append(path + [-1] * pad)
+        codes.append([float(b) for b in code] + [0.0] * pad)
+    return (np.asarray(tables, np.int64), np.asarray(codes, np.float32))
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """reference: nn/functional/loss.py hsigmoid_loss — hierarchical
+    sigmoid: sum over the class's tree path of
+    softplus((1 - 2*code) * (w_node . x + b_node)); O(log C) per sample
+    instead of a C-way softmax."""
+    if path_table is None or path_code is None:
+        tbl, code = _default_tree_paths(int(num_classes))
+        pt = jnp.asarray(tbl)
+        pc = jnp.asarray(code)
+        gather_label = True
+    else:
+        pt = jnp.asarray(to_value(_ensure(path_table)), jnp.int64)
+        pc = jnp.asarray(to_value(_ensure(path_code)), jnp.float32)
+        gather_label = False
+    args = (_ensure(input), _ensure(label), _ensure(weight)) + \
+        ((_ensure(bias),) if bias is not None else ())
+
+    def f(x, y, w, *b):
+        y = y.astype(jnp.int32).reshape(-1)
+        if gather_label:
+            paths = pt[y]            # [N, depth]
+            codes = pc[y]
+        else:
+            paths, codes = pt, pc    # custom: already per-sample
+        valid = paths >= 0
+        idx = jnp.maximum(paths, 0)
+        wn = w[idx]                  # [N, depth, D]
+        logits = jnp.einsum("nd,ntd->nt", x.astype(jnp.float32),
+                            wn.astype(jnp.float32))
+        if b:
+            logits = logits + b[0].reshape(-1)[idx]
+        # reference sign convention: code bit 1 keeps the logit,
+        # 0 negates — loss = softplus(logit) - code*logit summed on path
+        per = jax.nn.softplus(logits) - codes * logits
+        per = jnp.where(valid, per, 0.0)
+        return jnp.sum(per, axis=1, keepdims=True)
+
+    return dispatch(f, args, name="hsigmoid_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """reference: nn/functional/loss.py margin_cross_entropy — combined
+    ArcFace/CosFace margin on the target logit:
+    cos(m1*theta + m2) - m3, all logits scaled by s."""
+    def f(lg, y):
+        y = y.astype(jnp.int32).reshape(-1)
+        lg = jnp.clip(lg.astype(jnp.float32), -1.0, 1.0)
+        tgt = jnp.take_along_axis(lg, y[:, None], 1)[:, 0]
+        theta = jnp.arccos(tgt)
+        new_tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        oh = jax.nn.one_hot(y, lg.shape[-1], dtype=lg.dtype)
+        out = (lg * (1 - oh) + new_tgt[:, None] * oh) * scale
+        lsm = jax.nn.log_softmax(out, axis=-1)
+        loss = -jnp.take_along_axis(lsm, y[:, None], 1)
+        red = _reduce(loss, reduction)
+        return (red, jnp.exp(lsm)) if return_softmax else red
+
+    out = dispatch(f, (_ensure(logits), _ensure(label)),
+                   name="margin_cross_entropy",
+                   multi_output=return_softmax)
+    return out
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """reference: nn/functional/common.py class_center_sample — sample
+    the positive class centers plus negatives up to num_samples; returns
+    (remapped_label, sampled_class_center). Host-side (the sampled set
+    is data-dependent), like the reference's CPU path."""
+    y = np.asarray(to_value(_ensure(label))).astype(np.int64).ravel()
+    pos = np.unique(y)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos,
+                            assume_unique=True)
+        extra = np.random.default_rng().choice(
+            rest, num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return Tensor(remap[y]), Tensor(sampled)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None, name=None):
+    """reference: nn/functional/loss.py adaptive_log_softmax_with_loss
+    (Grave et al. efficient softmax): head covers the frequent classes +
+    one logit per tail cluster; each tail projects down then classifies
+    within its cluster. Returns (target log-probs, mean NLL loss)."""
+    cutoffs = [int(c) for c in cutoffs]
+    args = (_ensure(input), _ensure(label), _ensure(head_weight)) + \
+        tuple(_ensure(w) for pair in tail_weights for w in pair) + \
+        ((_ensure(head_bias),) if head_bias is not None else ())
+    n_tails = len(tail_weights)
+    has_bias = head_bias is not None
+
+    def f(x, y, hw, *rest):
+        tails = [(rest[2 * i], rest[2 * i + 1]) for i in range(n_tails)]
+        hb = rest[2 * n_tails] if has_bias else None
+        y = y.astype(jnp.int32).reshape(-1)
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_lsm = jax.nn.log_softmax(head_logits, -1)
+        shortlist = cutoffs[0]
+        out = jnp.where(
+            y < shortlist,
+            jnp.take_along_axis(head_lsm,
+                                jnp.minimum(y, shortlist - 1)[:, None],
+                                1)[:, 0],
+            0.0)
+        for i, (proj, cls) in enumerate(tails):
+            lo = cutoffs[i]
+            hi = cutoffs[i + 1] if i + 1 < len(cutoffs) else None
+            in_cluster = (y >= lo) & ((y < hi) if hi is not None
+                                      else jnp.full_like(y, True,
+                                                         dtype=bool))
+            cluster_lsm = jax.nn.log_softmax(
+                (x @ proj) @ cls, -1)
+            rel = jnp.clip(y - lo, 0, cls.shape[-1] - 1)
+            lp = head_lsm[:, shortlist + i] + \
+                jnp.take_along_axis(cluster_lsm, rel[:, None], 1)[:, 0]
+            out = jnp.where(in_cluster, lp, out)
+        return out, -jnp.mean(out)
+
+    return dispatch(f, args, name="adaptive_log_softmax_with_loss",
+                    multi_output=True)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """reference: nn/functional/loss.py rnnt_loss (RNN transducer,
+    Graves 2012): forward-variable DP over the (T, U+1) lattice in log
+    space, vectorized as a lax.scan over T with a cumulative-logsumexp
+    sweep over U inside each step.
+
+    FastEmit regularization is NOT implemented (the reference defaults
+    to lambda=0.001; passing a non-zero value here raises rather than
+    silently returning the unregularized loss)."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss: FastEmit regularization (fastemit_lambda != 0) "
+            "is not implemented; pass fastemit_lambda=0.0")
+    args = (_ensure(input), _ensure(label), _ensure(input_lengths),
+            _ensure(label_lengths))
+
+    def f(logits, y, t_len, u_len):
+        b, t_max, u_max, v = logits.shape       # u_max = U + 1
+        lsm = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        blank_lp = lsm[..., blank]              # [B, T, U+1]
+        y = y.astype(jnp.int32)
+        # label emission log-probs: lab_lp[b, t, u] = lsm[b,t,u,y[b,u]]
+        yy = jnp.minimum(y, v - 1)
+        lab_lp = jnp.take_along_axis(
+            lsm, jnp.broadcast_to(yy[:, None, :, None],
+                                  (b, t_max, u_max - 1, 1)),
+            axis=-1)[..., 0]                    # [B, T, U]
+        neg_inf = jnp.asarray(-1e30, jnp.float32)
+
+        def step(alpha, t):
+            # alpha: [B, U+1] forward vars at time t
+            # emit transitions within the same t: u-1 -> u
+            blank_t = blank_lp[:, t]            # [B, U+1]
+            lab_t = lab_lp[:, t]                # [B, U]
+
+            def emit_scan(carry, u):
+                prev = carry                     # alpha_new[u-1]
+                cur = jnp.logaddexp(alpha[:, u],
+                                    prev + lab_t[:, u - 1])
+                return cur, cur
+
+            first = alpha[:, 0]
+            _, rest = jax.lax.scan(
+                emit_scan, first, jnp.arange(1, u_max))
+            alpha_e = jnp.concatenate(
+                [first[:, None], jnp.moveaxis(rest, 0, 1)], axis=1)
+            # advance time with a blank from every u
+            alpha_next = alpha_e + blank_t
+            return alpha_next, alpha_e
+
+        alpha0 = jnp.full((b, u_max), neg_inf).at[:, 0].set(0.0)
+        _, alphas = jax.lax.scan(step, alpha0, jnp.arange(t_max))
+        alphas = jnp.moveaxis(alphas, 0, 1)      # [B, T, U+1] (pre-blank)
+        # total log-prob: alpha[t_len-1, u_len] + blank at the corner
+        ti = jnp.clip(t_len.astype(jnp.int32) - 1, 0, t_max - 1)
+        ui = jnp.clip(u_len.astype(jnp.int32), 0, u_max - 1)
+        bidx = jnp.arange(b)
+        ll = alphas[bidx, ti, ui] + blank_lp[bidx, ti, ui]
+        loss = -ll
+        return _reduce(loss, reduction)
+
+    return dispatch(f, args, name="rnnt_loss")

@@ -9,7 +9,7 @@ __all__ = ["ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax",
            "LogSoftmax", "LeakyReLU", "ELU", "CELU", "SELU", "Silu", "Swish",
            "Mish", "Hardtanh", "Hardshrink", "Softshrink", "Hardsigmoid",
            "Hardswish", "Softplus", "Softsign", "LogSigmoid", "Tanhshrink",
-           "ThresholdedReLU", "Maxout", "PReLU", "RReLU", "GLU"]
+           "ThresholdedReLU", "Maxout", "PReLU", "RReLU", "GLU", "Softmax2D"]
 
 
 def _simple(name, fn_name, **fixed):
@@ -196,3 +196,15 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self.axis)
+
+
+class Softmax2D(Layer):
+    """reference: nn/layer/activation.py Softmax2D — softmax over the
+    channel dim of NCHW inputs."""
+
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        assert len(x.shape) in (3, 4), "Softmax2D expects 3D/4D input"
+        return F.softmax(x, axis=-3)

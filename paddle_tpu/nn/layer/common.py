@@ -16,7 +16,8 @@ __all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Dropout3D",
            "UpsamplingNearest2D", "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
            "Bilinear", "CosineSimilarity", "PairwiseDistance", "Identity",
            "Unfold", "Fold", "PixelShuffle", "PixelUnshuffle",
-           "ChannelShuffle", "LinearLR"]
+           "ChannelShuffle", "LinearLR", "ZeroPad1D", "ZeroPad3D",
+           "Unflatten", "FeatureAlphaDropout"]
 
 
 class Identity(Layer):
@@ -166,6 +167,10 @@ class UpsamplingBilinear2D(Upsample):
 class _PadNd(Layer):
     def __init__(self, padding, mode, value, data_format):
         super().__init__()
+        if isinstance(padding, int):
+            # reference PadND accepts a scalar: same pad on every side
+            n_spatial = max(len(data_format) - 2, 1)
+            padding = [padding] * (2 * n_spatial)
         self.padding = padding
         self.mode = mode
         self.value = value
@@ -284,3 +289,46 @@ class ChannelShuffle(Layer):
 
 
 LinearLR = None  # placed in optimizer.lr; kept to appease wildcard imports
+
+
+class ZeroPad1D(Pad1D):
+    """reference: nn/layer/common.py ZeroPad1D."""
+
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class ZeroPad3D(Pad3D):
+    """reference: nn/layer/common.py ZeroPad3D."""
+
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class Unflatten(Layer):
+    """reference: nn/layer/common.py Unflatten — expand one dim into the
+    given shape."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        from ...tensor.manipulation import reshape
+        ax = self.axis % len(x.shape)
+        new = list(x.shape[:ax]) + list(self.shape) \
+            + list(x.shape[ax + 1:])
+        return reshape(x, new)
+
+
+class FeatureAlphaDropout(Layer):
+    """reference: nn/layer/common.py FeatureAlphaDropout."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, p=self.p,
+                                       training=self.training)

@@ -538,6 +538,46 @@ class ParameterList(Layer):
         return self
 
 
+class ParameterDict(Layer):
+    """reference: nn/layer/container.py ParameterDict."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            self.update(parameters)
+
+    def __getitem__(self, key):
+        return self._parameters[key]
+
+    def __setitem__(self, key, parameter):
+        self.add_parameter(key, parameter)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def __contains__(self, key):
+        return key in self._parameters
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def values(self):
+        return self._parameters.values()
+
+    def items(self):
+        return self._parameters.items()
+
+    def update(self, parameters):
+        items = parameters.items() if hasattr(parameters, "items") \
+            else parameters
+        for k, p in items:
+            self.add_parameter(k, p)
+        return self
+
+
 class LayerDict(Layer):
     def __init__(self, sublayers=None):
         super().__init__()

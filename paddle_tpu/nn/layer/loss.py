@@ -8,7 +8,9 @@ __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
            "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss",
            "MarginRankingLoss", "CTCLoss", "HingeEmbeddingLoss",
            "CosineEmbeddingLoss", "TripletMarginLoss", "PoissonNLLLoss",
-           "GaussianNLLLoss", "MultiLabelSoftMarginLoss", "SoftMarginLoss"]
+           "GaussianNLLLoss", "MultiLabelSoftMarginLoss", "SoftMarginLoss", "MultiMarginLoss",
+           "TripletMarginWithDistanceLoss", "HSigmoidLoss",
+           "AdaptiveLogSoftmaxWithLoss", "RNNTLoss"]
 
 
 class CrossEntropyLoss(Layer):
@@ -199,3 +201,112 @@ class SoftMarginLoss(Layer):
 
     def forward(self, input, label):
         return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    """reference: nn/layer/loss.py MultiMarginLoss."""
+
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin = p, margin
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, p=self.p,
+                                   margin=self.margin, weight=self.weight,
+                                   reduction=self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """reference: nn/layer/loss.py TripletMarginWithDistanceLoss."""
+
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self.margin, self.swap = margin, swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative,
+            distance_function=self.distance_function, margin=self.margin,
+            swap=self.swap, reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """reference: nn/layer/loss.py HSigmoidLoss — holds the tree-node
+    weight [num_classes-1, D] (+bias) and applies F.hsigmoid_loss."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        n_nodes = num_classes - 1
+        self.weight = self.create_parameter([n_nodes, feature_size],
+                                            attr=weight_attr)
+        self.bias = self.create_parameter([n_nodes], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, bias=self.bias,
+                               path_table=path_table,
+                               path_code=path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """reference: nn/layer/loss.py AdaptiveLogSoftmaxWithLoss — head +
+    per-cluster down-projected tails (Grave et al.)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = [int(c) for c in cutoffs]
+        if cutoffs != sorted(set(cutoffs)) or not cutoffs or \
+                cutoffs[-1] > n_classes:
+            raise ValueError(f"invalid cutoffs {cutoffs}")
+        if cutoffs[-1] == n_classes:
+            cutoffs = cutoffs[:-1]
+        self.cutoffs = cutoffs + [n_classes]
+        self.n_clusters = len(self.cutoffs) - 1
+        head_size = cutoffs[0] + self.n_clusters
+        self.head_weight = self.create_parameter([in_features, head_size])
+        self.head_bias = self.create_parameter([head_size], is_bias=True) \
+            if head_bias else None
+        self.tail_weights = []
+        for i in range(self.n_clusters):
+            hsz = max(int(in_features / (div_value ** (i + 1))), 1)
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = self.create_parameter([in_features, hsz])
+            cls = self.create_parameter([hsz, osz])
+            self.add_parameter(f"tail_{i}_proj", proj)
+            self.add_parameter(f"tail_{i}_cls", cls)
+            self.tail_weights.append([proj, cls])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs, head_bias=self.head_bias)
+
+
+class RNNTLoss(Layer):
+    """reference: nn/layer/loss.py RNNTLoss."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)

@@ -7,7 +7,9 @@ from .layers import Layer
 __all__ = ["MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
            "AvgPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
            "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
-           "AdaptiveMaxPool3D"]
+           "AdaptiveMaxPool3D", "LPPool1D", "LPPool2D", "MaxUnPool1D",
+           "MaxUnPool2D", "MaxUnPool3D", "FractionalMaxPool2D",
+           "FractionalMaxPool3D"]
 
 
 class _Pool(Layer):
@@ -134,3 +136,92 @@ class AdaptiveMaxPool3D(_Pool):
 
     def forward(self, x):
         return F.adaptive_max_pool3d(x, **self.kw)
+
+
+class LPPool1D(_Pool):
+    """reference: nn/layer/pooling.py LPPool1D."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__(norm_type=norm_type, kernel_size=kernel_size,
+                         stride=stride, padding=padding,
+                         ceil_mode=ceil_mode, data_format=data_format)
+
+    def forward(self, x):
+        return F.lp_pool1d(x, **self.kw)
+
+
+class LPPool2D(_Pool):
+    """reference: nn/layer/pooling.py LPPool2D."""
+
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__(norm_type=norm_type, kernel_size=kernel_size,
+                         stride=stride, padding=padding,
+                         ceil_mode=ceil_mode, data_format=data_format)
+
+    def forward(self, x):
+        return F.lp_pool2d(x, **self.kw)
+
+
+class MaxUnPool1D(_Pool):
+    """reference: nn/layer/pooling.py MaxUnPool1D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride,
+                         padding=padding, data_format=data_format,
+                         output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool1d(x, indices, **self.kw)
+
+
+class MaxUnPool2D(_Pool):
+    """reference: nn/layer/pooling.py MaxUnPool2D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride,
+                         padding=padding, data_format=data_format,
+                         output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool2d(x, indices, **self.kw)
+
+
+class MaxUnPool3D(_Pool):
+    """reference: nn/layer/pooling.py MaxUnPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__(kernel_size=kernel_size, stride=stride,
+                         padding=padding, data_format=data_format,
+                         output_size=output_size)
+
+    def forward(self, x, indices):
+        return F.max_unpool3d(x, indices, **self.kw)
+
+
+class FractionalMaxPool2D(_Pool):
+    """reference: nn/layer/pooling.py FractionalMaxPool2D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__(output_size=output_size, kernel_size=kernel_size,
+                         random_u=random_u, return_mask=return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, **self.kw)
+
+
+class FractionalMaxPool3D(_Pool):
+    """reference: nn/layer/pooling.py FractionalMaxPool3D."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__(output_size=output_size, kernel_size=kernel_size,
+                         random_u=random_u, return_mask=return_mask)
+
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, **self.kw)

@@ -19,7 +19,7 @@ from .. import initializer as I
 from .layers import Layer, LayerList
 
 __all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
-           "LSTM", "GRU", "BiRNN", "RNNCellBase"]
+           "LSTM", "GRU", "BiRNN", "RNNCellBase", "BeamSearchDecoder", "dynamic_decode"]
 
 
 class RNNCellBase(Layer):
@@ -372,3 +372,116 @@ class LSTM(_RNNBase):
 
 class GRU(_RNNBase):
     MODE = "GRU"
+
+
+class BeamSearchDecoder:
+    """reference: nn/decode.py BeamSearchDecoder — beam search over an
+    RNN cell: expand beam_size x vocab candidates per step, keep the
+    top beam_size by accumulated log-prob, track parent beams for
+    backtracking. Eager implementation (decode loops are host-driven in
+    dygraph; the compiled generate path lives in inference/generation).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # helpers over raw values -------------------------------------------
+    @staticmethod
+    def _tile_beam(v, beam):
+        v = np.asarray(v)
+        return np.repeat(v[:, None], beam, axis=1).reshape(
+            (-1,) + v.shape[1:])
+
+    def initialize(self, initial_states):
+        from ...core.tensor import Tensor, to_value
+        states = jax.tree_util.tree_map(
+            lambda t: Tensor(self._tile_beam(
+                np.asarray(to_value(t)), self.beam_size)),
+            initial_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        leaves = jax.tree_util.tree_leaves(
+            initial_states, is_leaf=lambda t: isinstance(t, Tensor))
+        batch = np.asarray(to_value(leaves[0])).shape[0]
+        ids = np.full((batch * self.beam_size,), self.start_token,
+                      np.int64)
+        # only beam 0 live at t=0 (others -inf so the first top-k
+        # doesn't pick duplicates)
+        log_probs = np.full((batch, self.beam_size), -1e30, np.float32)
+        log_probs[:, 0] = 0.0
+        finished = np.zeros((batch, self.beam_size), bool)
+        return ids, states, log_probs, finished
+
+    def step(self, ids, states, log_probs, finished):
+        from ...core.tensor import Tensor, to_value
+        batch = log_probs.shape[0]
+        beam, K = self.beam_size, self.beam_size
+        inp = Tensor(ids) if self.embedding_fn is None \
+            else self.embedding_fn(Tensor(ids))
+        out, new_states = self.cell(inp, states)
+        logits = out if self.output_fn is None else self.output_fn(out)
+        lv = np.asarray(to_value(logits), np.float32)   # [B*beam, V]
+        v = lv.shape[-1]
+        step_lp = lv - np.log(np.exp(lv - lv.max(-1, keepdims=True))
+                              .sum(-1, keepdims=True)) \
+            - lv.max(-1, keepdims=True)
+        step_lp = step_lp.reshape(batch, beam, v)
+        # finished beams only extend with end_token at no cost
+        fin_mask = np.full((v,), -1e30, np.float32)
+        fin_mask[self.end_token] = 0.0
+        step_lp = np.where(finished[:, :, None], fin_mask[None, None],
+                           step_lp)
+        total = log_probs[:, :, None] + step_lp        # [B, beam, V]
+        flat = total.reshape(batch, beam * v)
+        top = np.argsort(-flat, axis=1)[:, :K]
+        new_lp = np.take_along_axis(flat, top, 1)
+        parent = top // v                               # [B, K]
+        token = top % v
+        # gather states along the beam dim
+        gather = (np.arange(batch)[:, None] * beam + parent).reshape(-1)
+        new_states = jax.tree_util.tree_map(
+            lambda t: Tensor(np.asarray(to_value(t))[gather]),
+            new_states, is_leaf=lambda t: isinstance(t, Tensor))
+        new_finished = np.take_along_axis(finished, parent, 1) | \
+            (token == self.end_token)
+        return (token.reshape(-1).astype(np.int64), new_states,
+                new_lp, new_finished, parent)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """reference: nn/decode.py dynamic_decode — drive a decoder until
+    every beam finishes or ``max_step_num``. Returns (ids [B, beam, T],
+    scores [B, beam]) (+ lengths)."""
+    from ...core.tensor import Tensor
+    ids, states, log_probs, finished = decoder.initialize(inits)
+    max_steps = max_step_num or 256
+    batch = log_probs.shape[0]
+    beam = decoder.beam_size
+    tokens_hist, parents_hist = [], []
+    for _ in range(max_steps):
+        ids, states, log_probs, finished, parent = decoder.step(
+            ids, states, log_probs, finished)
+        tokens_hist.append(ids.reshape(batch, beam))
+        parents_hist.append(parent)
+        if bool(finished.all()):
+            break
+    # backtrack parent pointers into full sequences
+    T = len(tokens_hist)
+    seqs = np.zeros((batch, beam, T), np.int64)
+    beam_idx = np.tile(np.arange(beam), (batch, 1))
+    for t in range(T - 1, -1, -1):
+        seqs[:, :, t] = np.take_along_axis(tokens_hist[t], beam_idx, 1)
+        beam_idx = np.take_along_axis(parents_hist[t], beam_idx, 1)
+    ids_out = Tensor(seqs)
+    scores = Tensor(log_probs)
+    if return_length:
+        lengths = (seqs != decoder.end_token).sum(-1)
+        return ids_out, scores, Tensor(lengths.astype(np.int64))
+    return ids_out, scores

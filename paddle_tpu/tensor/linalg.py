@@ -387,3 +387,40 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
         return qb @ u, s, vt.swapaxes(-2, -1)
     args = (_ensure(x),) + ((_ensure(M),) if M is not None else ())
     return dispatch(f, args, name="svd_lowrank", multi_output=True)
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="bfloat16", activation_type=None,
+                            name=None):
+    """reference: python/paddle/tensor/linalg.py fp8_fp8_half_gemm_fused
+    (CUTLASS fp8 GEMM with half-precision output). TPU-native: the
+    incubate fp8_gemm path — fp8 operands on the MXU, fp32 accumulate,
+    one rescale — plus the fused epilogue activation."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor as _T, dispatch as _dispatch
+
+    x = x if isinstance(x, _T) else _T(x)
+    y = y if isinstance(y, _T) else _T(y)
+    args = (x, y) + ((bias if isinstance(bias, _T) else _T(bias),)
+                     if bias is not None else ())
+    odt = jnp.dtype(output_dtype)
+
+    def f(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        acc = jax.lax.dot_general(
+            a, b, (((a.ndim - 1,), (b.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if bb:
+            acc = acc + bb[0].astype(jnp.float32)
+        if activation_type in ("gelu",):
+            acc = jax.nn.gelu(acc)
+        elif activation_type in ("relu",):
+            acc = jnp.maximum(acc, 0)
+        return acc.astype(odt)
+
+    return _dispatch(f, args, name="fp8_fp8_half_gemm_fused")

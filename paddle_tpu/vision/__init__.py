@@ -4,3 +4,39 @@ from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import bucketing  # noqa: F401
+
+# image IO backend (reference: python/paddle/vision/image.py)
+_image_backend = ["pil"]
+
+
+def set_image_backend(backend):
+    """reference: vision/image.py set_image_backend — 'pil' | 'cv2' |
+    'tensor' (numpy-decoded here)."""
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2', 'tensor'], "
+            f"but got {backend}")
+    _image_backend[0] = backend
+
+
+def get_image_backend():
+    """reference: vision/image.py get_image_backend."""
+    return _image_backend[0]
+
+
+def image_load(path, backend=None):
+    """reference: vision/image.py image_load — decode an image file with
+    the selected backend."""
+    backend = backend or _image_backend[0]
+    if backend == "pil":
+        from PIL import Image
+        return Image.open(path)
+    if backend == "cv2":
+        try:
+            import cv2
+        except ImportError as e:
+            raise ImportError("cv2 backend requires opencv-python") from e
+        return cv2.imread(path)
+    import numpy as _np
+    from PIL import Image
+    return _np.asarray(Image.open(path))

@@ -1,0 +1,556 @@
+"""Round-4 API long-tail: behavioral tests for the names closed by the
+extended parity gate (tools/check_api_parity.py)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+class TestLayersEasy:
+    def test_zeropad_1d_3d(self):
+        x = t(np.ones((1, 2, 3), np.float32))
+        out = nn.ZeroPad1D([1, 2])(x)
+        assert list(out.shape) == [1, 2, 6]
+        x3 = t(np.ones((1, 1, 2, 2, 2), np.float32))
+        out3 = nn.ZeroPad3D(1)(x3)
+        assert list(out3.shape) == [1, 1, 4, 4, 4]
+        assert float(out3.numpy()[0, 0, 0, 0, 0]) == 0.0
+
+    def test_unflatten(self):
+        x = t(np.arange(12, dtype=np.float32).reshape(2, 6))
+        out = nn.Unflatten(1, [2, 3])(x)
+        assert list(out.shape) == [2, 2, 3]
+
+    def test_softmax2d(self):
+        x = t(np.random.RandomState(0).randn(2, 4, 3, 3).astype(np.float32))
+        out = nn.Softmax2D()(x)
+        s = np.asarray(out.numpy()).sum(axis=1)
+        np.testing.assert_allclose(s, np.ones_like(s), rtol=1e-5)
+
+    def test_parameter_dict(self):
+        pd = nn.ParameterDict({"a": paddle.create_parameter([2], "float32")})
+        pd["b"] = paddle.create_parameter([3], "float32")
+        assert set(pd.keys()) == {"a", "b"}
+        assert len(pd.parameters()) == 2
+        assert "a" in pd and len(pd) == 2
+
+    def test_feature_alpha_dropout(self):
+        lyr = nn.FeatureAlphaDropout(p=0.5)
+        lyr.train()
+        x = t(np.ones((4, 8, 3), np.float32))
+        out = np.asarray(lyr(x).numpy())
+        # whole channels share their fate
+        per_channel = out.reshape(4, 8, 3)
+        for b in range(4):
+            for c in range(8):
+                assert len(np.unique(per_channel[b, c].round(5))) == 1
+        lyr.eval()
+        np.testing.assert_array_equal(np.asarray(lyr(x).numpy()),
+                                      np.ones((4, 8, 3), np.float32))
+
+    def test_lp_pool_layers(self):
+        x = t(np.random.RandomState(1).rand(1, 2, 8).astype(np.float32))
+        out = nn.LPPool1D(norm_type=2, kernel_size=2)(x)
+        assert list(out.shape) == [1, 2, 4]
+        x2 = t(np.random.RandomState(2).rand(1, 2, 4, 4).astype(np.float32))
+        out2 = nn.LPPool2D(norm_type=2, kernel_size=2)(x2)
+        assert list(out2.shape) == [1, 2, 2, 2]
+
+    def test_max_unpool_layers(self):
+        x = t(np.random.RandomState(3).rand(1, 1, 4, 4).astype(np.float32))
+        pooled, idx = F.max_pool2d(x, 2, return_mask=True)
+        restored = nn.MaxUnPool2D(kernel_size=2)(pooled, idx)
+        assert list(restored.shape) == [1, 1, 4, 4]
+        # each pooled max lands back at its argmax position
+        assert np.isclose(np.asarray(restored.numpy()).max(),
+                          np.asarray(pooled.numpy()).max())
+
+    def test_fractional_max_pool(self):
+        x = t(np.random.RandomState(4).rand(1, 1, 8, 8).astype(np.float32))
+        out = nn.FractionalMaxPool2D(output_size=3, random_u=0.3)(x)
+        assert list(out.shape) == [1, 1, 3, 3]
+        # deterministic with fixed u
+        out2 = nn.FractionalMaxPool2D(output_size=3, random_u=0.3)(x)
+        np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                      np.asarray(out2.numpy()))
+        # global max always survives pooling
+        assert np.isclose(np.asarray(out.numpy()).max(),
+                          np.asarray(x.numpy()).max())
+        out3, mask = F.fractional_max_pool2d(x, 4, random_u=0.6,
+                                             return_mask=True)
+        assert list(out3.shape) == [1, 1, 4, 4]
+        assert mask.numpy().shape == (1, 1, 4, 4)
+
+
+class TestLosses:
+    def test_multi_margin_loss(self):
+        x = t(np.array([[0.1, 0.9, 0.2]], np.float32))
+        y = t(np.array([1]))
+        loss = F.multi_margin_loss(x, y, margin=1.0)
+        # j=0: max(0, 1-0.9+0.1)=0.2 ; j=2: max(0,1-0.9+0.2)=0.3 ; /3
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   (0.2 + 0.3) / 3, rtol=1e-5)
+
+    def test_triplet_with_distance(self):
+        a = t(np.zeros((2, 3), np.float32))
+        p = t(np.zeros((2, 3), np.float32))
+        n = t(np.ones((2, 3), np.float32) * 2)
+        loss = F.triplet_margin_with_distance_loss(a, p, n, margin=1.0)
+        assert float(loss.numpy()) == 0.0   # d_pos=0, d_neg>1
+        lyr = nn.TripletMarginWithDistanceLoss(
+            distance_function=lambda u, v: ((u - v) ** 2).sum(-1))
+        out = lyr(a, p, n)
+        assert float(out.numpy()) == 0.0
+
+    def test_npair_loss_finite_and_trains(self):
+        rng = np.random.RandomState(0)
+        a = t(rng.randn(4, 8).astype(np.float32), sg=False)
+        p = t(rng.randn(4, 8).astype(np.float32))
+        y = t(np.array([0, 1, 0, 1]))
+        loss = F.npair_loss(a, p, y)
+        loss.backward()
+        assert np.isfinite(float(loss.numpy()))
+        assert a.grad is not None
+
+    def test_hsigmoid_loss_default_tree(self):
+        rng = np.random.RandomState(1)
+        C, D, N = 6, 5, 4
+        lyr = nn.HSigmoidLoss(D, C)
+        x = t(rng.randn(N, D).astype(np.float32))
+        y = t(rng.randint(0, C, N))
+        loss = lyr(x, y)
+        assert loss.shape == [N, 1]
+        assert np.isfinite(np.asarray(loss.numpy())).all()
+        loss.sum().backward()
+        assert lyr.weight.grad is not None
+
+    def test_hsigmoid_custom_path_matches_manual(self):
+        # one sample, manual path: nodes [0, 2], codes [1, 0]
+        x = t(np.array([[1.0, 2.0]], np.float32))
+        w = t(np.array([[0.5, 0.5], [9, 9], [1.0, -1.0]], np.float32))
+        pt = np.array([[0, 2]], np.int64)
+        pc = np.array([[1.0, 0.0]], np.float32)
+        loss = F.hsigmoid_loss(x, t(np.array([0])), 4, w,
+                               path_table=t(pt), path_code=t(pc))
+        z0 = 0.5 * 1 + 0.5 * 2     # 1.5
+        z1 = 1.0 * 1 - 1.0 * 2     # -1
+        expect = (np.log1p(np.exp(z0)) - 1.0 * z0) + \
+            (np.log1p(np.exp(z1)) - 0.0 * z1)
+        np.testing.assert_allclose(float(loss.numpy()), expect, rtol=1e-5)
+
+    def test_margin_cross_entropy_reduces_target_logit(self):
+        rng = np.random.RandomState(2)
+        logits = t((rng.rand(4, 10) * 2 - 1).astype(np.float32) * 0.9)
+        y = t(np.array([1, 2, 3, 4]))
+        lm = F.margin_cross_entropy(logits, y, margin1=1.0, margin2=0.5,
+                                    margin3=0.0, scale=30.0)
+        l0 = F.margin_cross_entropy(logits, y, margin1=1.0, margin2=0.0,
+                                    margin3=0.0, scale=30.0)
+        # margin makes the target harder: loss increases
+        assert float(lm.numpy()) > float(l0.numpy())
+
+    def test_adaptive_log_softmax(self):
+        rng = np.random.RandomState(3)
+        lyr = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[4, 10])
+        x = t(rng.randn(8, 16).astype(np.float32))
+        y = t(rng.randint(0, 20, 8))
+        out, loss = lyr(x, y)
+        assert out.shape == [8]
+        assert (np.asarray(out.numpy()) <= 0).all()   # log-probs
+        assert np.isfinite(float(loss.numpy()))
+        loss.backward()
+        assert lyr.head_weight.grad is not None
+
+    def test_rnnt_loss_simple(self):
+        """T=U=1 single label: loss = -(log P(label@t0,u0) +
+        log P(blank@t0,u1))."""
+        V = 3
+        logits = np.zeros((1, 1, 2, V), np.float32)
+        logits[0, 0, 0] = [0.0, 2.0, 0.0]   # favor label 1
+        logits[0, 0, 1] = [2.0, 0.0, 0.0]   # favor blank
+        lp = np.log(np.exp(logits) / np.exp(logits).sum(-1, keepdims=True))
+        expect = -(lp[0, 0, 0, 1] + lp[0, 0, 1, 0])
+        loss = F.rnnt_loss(t(logits), t(np.array([[1]], np.int32)),
+                           t(np.array([1], np.int32)),
+                           t(np.array([1], np.int32)), blank=0,
+                           reduction="mean")
+        np.testing.assert_allclose(float(loss.numpy()), expect, rtol=1e-5)
+        lyr = nn.RNNTLoss(blank=0)
+        out = lyr(t(logits), t(np.array([[1]], np.int32)),
+                  t(np.array([1], np.int32)), t(np.array([1], np.int32)))
+        np.testing.assert_allclose(float(out.numpy()), expect, rtol=1e-5)
+
+    def test_class_center_sample(self):
+        y = np.array([2, 5, 2, 9], np.int64)
+        remapped, sampled = F.class_center_sample(t(y), 20, 6)
+        sam = np.asarray(sampled.numpy())
+        rem = np.asarray(remapped.numpy())
+        assert len(sam) == 6
+        assert {2, 5, 9} <= set(sam.tolist())
+        for orig, new in zip(y, rem):
+            assert sam[new] == orig
+
+
+class TestAttentionWrappers:
+    def test_qkvpacked_matches_unpacked(self):
+        rng = np.random.RandomState(0)
+        B, S, H, D = 2, 8, 4, 16
+        qkv = rng.randn(B, S, 3, H, D).astype(np.float32)
+        out, _ = F.flash_attn_qkvpacked(t(qkv), causal=True)
+        ref, _ = F.flash_attention(t(qkv[:, :, 0]), t(qkv[:, :, 1]),
+                                   t(qkv[:, :, 2]), causal=True)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(ref.numpy()),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_flashmask_full_visible_matches_plain(self):
+        rng = np.random.RandomState(1)
+        B, S, H, D = 1, 8, 2, 8
+        q = rng.randn(B, S, H, D).astype(np.float32)
+        k = rng.randn(B, S, H, D).astype(np.float32)
+        v = rng.randn(B, S, H, D).astype(np.float32)
+        # causal L=1 with start index == S everywhere: pure causal mask
+        idx = np.full((B, H, S, 1), S, np.int32)
+        out = F.flashmask_attention(t(q), t(k), t(v), t(idx), causal=True)
+        ref, _ = F.flash_attention(t(q), t(k), t(v), causal=True)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(ref.numpy()),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_flashmask_blocks_range(self):
+        B, S, H, D = 1, 6, 1, 4
+        rng = np.random.RandomState(2)
+        q = rng.randn(B, S, H, D).astype(np.float32)
+        k = rng.randn(B, S, H, D).astype(np.float32)
+        v = rng.randn(B, S, H, D).astype(np.float32)
+        # column 0 masked for rows >= 2 (sliding-window-like)
+        idx = np.full((B, H, S, 1), S, np.int32)
+        idx[0, 0, 0, 0] = 2
+        out = F.flashmask_attention(t(q), t(k), t(v), t(idx), causal=True)
+        # row 3 must not attend to col 0: recompute manually
+        s = (q[0, :, 0] @ k[0, :, 0].T) / np.sqrt(D)
+        mask = np.triu(np.ones((S, S), bool), 1)
+        mask[2:, 0] = True
+        s = np.where(mask, -np.inf, s)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = p @ v[0, :, 0]
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, :, 0],
+                                   ref, rtol=2e-2, atol=2e-2)
+
+    def test_sparse_attention_matches_dense_mask(self):
+        B, H, S, D = 1, 1, 4, 8
+        rng = np.random.RandomState(3)
+        q = rng.randn(B, H, S, D).astype(np.float32)
+        k = rng.randn(B, H, S, D).astype(np.float32)
+        v = rng.randn(B, H, S, D).astype(np.float32)
+        # row i attends to {0, i}
+        cols, offs = [], [0]
+        for i in range(S):
+            row = sorted({0, i})
+            cols.extend(row)
+            offs.append(len(cols))
+        off = np.asarray(offs, np.int32)[None, None]
+        cv = np.asarray(cols, np.int32)[None, None]
+        out = F.sparse_attention(t(q), t(k), t(v), t(off), t(cv))
+        s = (q[0, 0] @ k[0, 0].T) / np.sqrt(D)
+        mask = np.zeros((S, S), bool)
+        for i in range(S):
+            mask[i, list({0, i})] = True
+        s = np.where(mask, s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ref = p @ v[0, 0]
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0], ref,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestBeamSearch:
+    def test_greedy_path_found(self):
+        """A cell whose logits always favor token 2 then end_token."""
+        class ToyCell(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.step = 0
+
+            def forward(self, inputs, states):
+                n = np.asarray(inputs.numpy()).shape[0]
+                sv = int(np.asarray(states.numpy())[0])
+                logits = np.full((n, 5), -5.0, np.float32)
+                logits[:, 2 if sv == 0 else 4] = 5.0
+                return (paddle.to_tensor(logits),
+                        paddle.to_tensor(
+                            np.asarray(states.numpy()) + 1))
+
+        dec = nn.BeamSearchDecoder(ToyCell(), start_token=0, end_token=4,
+                                   beam_size=2)
+        ids, scores = nn.dynamic_decode(
+            dec, inits=paddle.to_tensor(np.zeros((3,), np.int64)),
+            max_step_num=6)
+        arr = np.asarray(ids.numpy())
+        assert arr.shape[0] == 3 and arr.shape[1] == 2
+        np.testing.assert_array_equal(arr[:, 0, :2],
+                                      np.tile([2, 4], (3, 1)))
+        sc = np.asarray(scores.numpy())
+        assert (sc[:, 0] >= sc[:, 1]).all()   # beams sorted by score
+
+
+class TestStaticExtras:
+    def test_variable_alias_and_places(self):
+        from paddle_tpu import static
+        assert static.Variable is paddle.Tensor
+        assert static.cpu_places()[0].device_type == "cpu"
+        assert len(static.cuda_places([0, 1])) == 2
+
+    def test_accuracy_auc(self):
+        from paddle_tpu import static
+        probs = t(np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]],
+                           np.float32))
+        labels = t(np.array([[1], [0], [0]]))
+        acc = static.accuracy(probs, labels, k=1)
+        np.testing.assert_allclose(float(acc.numpy()), 2 / 3, rtol=1e-6)
+        a = static.auc(probs, labels)
+        assert 0.0 <= float(a.numpy()) <= 1.0
+
+    def test_ema_apply_restore(self):
+        from paddle_tpu import static
+        p = paddle.create_parameter([2], "float32")
+        p.set_value(t(np.array([1.0, 1.0], np.float32)))
+        ema = static.ExponentialMovingAverage(decay=0.5)
+        ema.update([p])
+        p.set_value(t(np.array([3.0, 3.0], np.float32)))
+        ema.update([p])
+        # shadow = .5*1 + .5*3 = 2
+        with ema.apply():
+            np.testing.assert_allclose(np.asarray(p.numpy()), [2.0, 2.0])
+        np.testing.assert_allclose(np.asarray(p.numpy()), [3.0, 3.0])
+
+    def test_gradients_and_append_backward(self):
+        from paddle_tpu import static
+        x = t(np.array([2.0], np.float32), sg=False)
+        y = (x * x).sum()
+        (gx,) = static.gradients([y], [x])
+        np.testing.assert_allclose(np.asarray(gx.numpy()), [4.0])
+
+    def test_py_func(self):
+        from paddle_tpu import static
+        x = t(np.array([1.0, 2.0], np.float32))
+        out_tmpl = t(np.zeros(2, np.float32))
+        out = static.py_func(lambda a: a * 3, x, out_tmpl)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [3.0, 6.0])
+
+    def test_print_passthrough(self, capsys):
+        from paddle_tpu import static
+        x = t(np.array([7.0], np.float32))
+        out = static.Print(x, message="dbg")
+        jax.effects_barrier()
+        np.testing.assert_allclose(np.asarray(out.numpy()), [7.0])
+        assert "dbg" in capsys.readouterr().out
+
+    def test_save_load_inference_model(self, tmp_path):
+        from paddle_tpu import static
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            w = paddle.create_parameter([4, 2], "float32")
+            y = x @ w
+        exe = static.Executor()
+        prefix = str(tmp_path / "inf")
+        static.save_inference_model(prefix, [x], [y], exe, program=main)
+        prog2, feeds, fetches = static.load_inference_model(prefix, exe)
+        a = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        ref, = exe.run(main, feed={"x": a}, fetch_list=[y])
+        got, = exe.run(prog2, feed={feeds[0]: a}, fetch_list=fetches)
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_ipu_gated(self):
+        from paddle_tpu import static
+        with pytest.raises(RuntimeError, match="IPU"):
+            static.IpuStrategy()
+        with pytest.raises(RuntimeError, match="IPU"):
+            static.ipu_shard_guard()
+
+    def test_scope_and_guards(self):
+        from paddle_tpu import static
+        s = static.global_scope()
+        with static.scope_guard(type(s)()):
+            assert static.global_scope() is not s
+        assert static.global_scope() is s
+        with static.device_guard("cpu"):
+            v = paddle.to_tensor(np.ones(2, np.float32))
+        assert np.asarray(v.numpy()).sum() == 2
+        with static.name_scope("block"):
+            pass
+        cp = static.CompiledProgram(static.Program())
+        bs = static.BuildStrategy()
+        bs.fuse_elewise_add_act_ops = True
+        assert bs.fuse_elewise_add_act_ops
+
+
+class TestDistributions:
+    def test_multivariate_normal(self):
+        from paddle_tpu.distribution import MultivariateNormal
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        d = MultivariateNormal(t(np.zeros(2, np.float32)),
+                               covariance_matrix=t(cov))
+        s = np.asarray(d.sample([5000]).numpy())
+        assert s.shape == (5000, 2)
+        emp = np.cov(s.T)
+        np.testing.assert_allclose(emp, cov, atol=0.15)
+        # log_prob matches scipy-free closed form at the mean
+        lp = float(d.log_prob(t(np.zeros(2, np.float32))).numpy())
+        expect = -0.5 * np.log((2 * np.pi) ** 2 * np.linalg.det(cov))
+        np.testing.assert_allclose(lp, expect, rtol=1e-5)
+        assert np.isfinite(float(np.asarray(d.entropy().numpy())))
+
+    def test_continuous_bernoulli(self):
+        from paddle_tpu.distribution import ContinuousBernoulli
+        d = ContinuousBernoulli(t(np.array([0.3], np.float32)))
+        s = np.asarray(d.sample([4000]).numpy())
+        assert ((s >= 0) & (s <= 1)).all()
+        np.testing.assert_allclose(s.mean(),
+                                   float(np.asarray(d.mean.numpy())),
+                                   atol=0.02)
+        # normalized density: integral of prob over (0,1) == 1
+        xs = np.linspace(1e-4, 1 - 1e-4, 2001, dtype=np.float32)
+        ps = np.asarray(d.prob(t(xs[:, None])).numpy()).ravel()
+        np.testing.assert_allclose(np.trapezoid(ps, xs), 1.0, rtol=1e-3)
+
+    def test_lkj_cholesky(self):
+        from paddle_tpu.distribution import LKJCholesky
+        d = LKJCholesky(3, concentration=2.0)
+        L = np.asarray(d.sample().numpy())
+        assert L.shape == (3, 3)
+        # valid cholesky of a correlation matrix: unit diagonal of L L^T
+        C = L @ L.T
+        np.testing.assert_allclose(np.diag(C), np.ones(3), atol=1e-5)
+        assert np.isfinite(float(np.asarray(d.log_prob(t(L)).numpy())))
+
+    def test_exponential_family_entropy_consistency(self):
+        from paddle_tpu.distribution import ContinuousBernoulli
+        d = ContinuousBernoulli(t(np.array([0.2], np.float32)))
+        # analytic-identity entropy vs numeric integral of -p log p
+        xs = np.linspace(1e-4, 1 - 1e-4, 4001, dtype=np.float32)
+        ps = np.asarray(d.prob(t(xs[:, None])).numpy()).ravel()
+        lp = np.asarray(d.log_prob(t(xs[:, None])).numpy()).ravel()
+        num = -np.trapezoid(ps * lp, xs)
+        np.testing.assert_allclose(
+            float(np.asarray(d.entropy().numpy())), num, atol=5e-3)
+
+
+class TestMiscParity:
+    def test_inplace_activations(self):
+        x = t(np.array([-2.0, 0.5, 2.0], np.float32))
+        F.hardtanh_(x)
+        np.testing.assert_allclose(np.asarray(x.numpy()), [-1, 0.5, 1])
+        y = t(np.array([-1.0, 1.0], np.float32))
+        F.leaky_relu_(y, 0.1)
+        np.testing.assert_allclose(np.asarray(y.numpy()), [-0.1, 1.0])
+
+    def test_send_uv(self):
+        from paddle_tpu import geometric
+        x = t(np.array([[1.0], [2.0], [3.0]], np.float32))
+        y = t(np.array([[10.0], [20.0], [30.0]], np.float32))
+        out = geometric.send_uv(x, y, np.array([0, 1]), np.array([1, 2]),
+                                "add")
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   [[21.0], [32.0]])
+
+    def test_amp_supported_probes(self):
+        assert paddle.amp.is_bfloat16_supported() in (True, False)
+        assert paddle.amp.is_float16_supported() in (True, False)
+
+    def test_get_worker_info_in_worker(self):
+        from paddle_tpu.io import DataLoader, Dataset, get_worker_info
+
+        class DS(Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                info = get_worker_info()
+                wid = info.id if info is not None else -1
+                return np.float32(wid)
+
+        assert get_worker_info() is None   # main process
+        dl = DataLoader(DS(), batch_size=4, num_workers=2)
+        vals = np.concatenate([np.asarray(b.numpy()).ravel()
+                               for b in dl])
+        assert set(vals.astype(int).tolist()) <= {-1, 0, 1}
+
+    def test_fp8_half_gemm_fused(self):
+        from paddle_tpu import linalg
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 8).astype(np.float32)
+        b = rng.randn(8, 3).astype(np.float32)
+        out = linalg.fp8_fp8_half_gemm_fused(t(a), t(b))
+        np.testing.assert_allclose(np.asarray(out.numpy(), np.float32),
+                                   a @ b, rtol=2e-2, atol=2e-2)
+
+    def test_image_backend(self):
+        from paddle_tpu import vision
+        assert vision.get_image_backend() == "pil"
+        vision.set_image_backend("tensor")
+        assert vision.get_image_backend() == "tensor"
+        vision.set_image_backend("pil")
+        with pytest.raises(ValueError):
+            vision.set_image_backend("bogus")
+
+
+class TestReviewRegressions:
+    def test_qkvpacked_gqa_head_order(self):
+        """Review regression: with G>1 groups and Hk>1 kv heads, packed q
+        heads must pair with their OWN kv head (consecutive grouping)."""
+        rng = np.random.RandomState(7)
+        B, S, G, Hk, D = 1, 6, 2, 2, 8
+        qkv = rng.randn(B, S, G + 2, Hk, D).astype(np.float32)
+        out, _ = F.flash_attn_qkvpacked(t(qkv), causal=False)
+        # reference: q head (g, kv) attends kv head `kv`
+        k, v = qkv[:, :, -2], qkv[:, :, -1]
+        got = np.asarray(out.numpy())          # [B, S, Hk*G, D]
+        for kv in range(Hk):
+            for g in range(G):
+                qh = qkv[:, :, g, kv]          # [B, S, D]
+                s_ = (qh[0] @ k[0, :, kv].T) / np.sqrt(D)
+                p = np.exp(s_ - s_.max(-1, keepdims=True))
+                p /= p.sum(-1, keepdims=True)
+                ref = p @ v[0, :, kv]
+                np.testing.assert_allclose(got[0, :, kv * G + g], ref,
+                                           rtol=3e-2, atol=3e-2)
+
+    def test_flashmask_fully_masked_row_no_nan(self):
+        B, S, H, D = 1, 4, 1, 4
+        rng = np.random.RandomState(8)
+        q = rng.randn(B, S, H, D).astype(np.float32)
+        k = rng.randn(B, S, H, D).astype(np.float32)
+        v = rng.randn(B, S, H, D).astype(np.float32)
+        idx = np.zeros((B, H, S, 1), np.int32)   # everything masked
+        out = F.flashmask_attention(t(q), t(k), t(v), t(idx), causal=True)
+        assert np.isfinite(np.asarray(out.numpy())).all()
+
+    def test_rnnt_fastemit_rejected(self):
+        with pytest.raises(NotImplementedError):
+            F.rnnt_loss(t(np.zeros((1, 1, 2, 3), np.float32)),
+                        t(np.array([[1]], np.int32)),
+                        t(np.array([1], np.int32)),
+                        t(np.array([1], np.int32)),
+                        fastemit_lambda=0.001)
+
+    def test_varlen_qkvpacked_runs(self):
+        rng = np.random.RandomState(9)
+        T, Hk, D = 10, 2, 8
+        qkv = rng.randn(T, 3, Hk, D).astype(np.float32)
+        cu = np.array([0, 4, 10], np.int32)
+        out, _ = F.flash_attn_varlen_qkvpacked(t(qkv), t(cu), t(cu),
+                                               causal=True)
+        assert np.asarray(out.numpy()).shape == (T, Hk, D)
+        assert np.isfinite(np.asarray(out.numpy())).all()

@@ -56,6 +56,48 @@ def main():
     missing["paddle.__all__"] = [n for n in top if not hasattr(paddle, n)
                                  and n not in EXCLUDED]
 
+    # subsystem __all__ registries: module path -> our module
+    import importlib
+    for ref_py, mod_name in [
+            ("python/paddle/nn/__init__.py", "paddle_tpu.nn"),
+            ("python/paddle/nn/functional/__init__.py",
+             "paddle_tpu.nn.functional"),
+            ("python/paddle/linalg.py", "paddle_tpu.linalg"),
+            ("python/paddle/fft.py", "paddle_tpu.fft"),
+            ("python/paddle/signal.py", "paddle_tpu.signal"),
+            ("python/paddle/sparse/__init__.py", "paddle_tpu.sparse"),
+            ("python/paddle/vision/__init__.py", "paddle_tpu.vision"),
+            ("python/paddle/geometric/__init__.py",
+             "paddle_tpu.geometric"),
+            ("python/paddle/amp/__init__.py", "paddle_tpu.amp"),
+            ("python/paddle/static/__init__.py", "paddle_tpu.static"),
+            ("python/paddle/metric/__init__.py", "paddle_tpu.metric"),
+            ("python/paddle/distribution/__init__.py",
+             "paddle_tpu.distribution"),
+            ("python/paddle/optimizer/__init__.py",
+             "paddle_tpu.optimizer"),
+            ("python/paddle/io/__init__.py", "paddle_tpu.io"),
+            ("python/paddle/distributed/__init__.py",
+             "paddle_tpu.distributed")]:
+        path = os.path.join(REF, ref_py)
+        if not os.path.exists(path):
+            continue
+        try:
+            names = _registry(path, r"__all__ = \[(.*?)\]")
+        except AttributeError:
+            continue   # module has no list-form __all__
+        try:
+            mod = importlib.import_module(mod_name)
+        except ModuleNotFoundError:
+            # attribute-style namespace (paddle.linalg lives on the
+            # package, not as an importable submodule path)
+            mod = paddle
+            for part in mod_name.split(".")[1:]:
+                mod = getattr(mod, part)
+        missing[mod_name] = [n for n in names if not hasattr(mod, n)
+                             and not hasattr(paddle, n)
+                             and n not in EXCLUDED]
+
     total = sum(len(v) for v in missing.values())
     for reg, names in missing.items():
         print(f"{reg}: {len(names)} missing"
