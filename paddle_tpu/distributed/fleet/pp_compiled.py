@@ -37,6 +37,17 @@ __all__ = ["CompiledPipeline", "Compiled1F1B", "CompiledInterleaved",
            "pipeline_microbatch"]
 
 
+def _dp_reduce(loss, grads, data_axis):
+    """Hybrid pp x dp tail shared by Compiled1F1B / CompiledInterleaved:
+    per-shard loss_fn already averaged over its mb slice, so the global
+    loss/grads are the dp-mean of shard values."""
+    n_dp = jax.lax.psum(1, data_axis)
+    loss = jax.lax.psum(loss, data_axis) / n_dp
+    grads = jax.tree_util.tree_map(
+        lambda g: jax.lax.psum(g, data_axis) / n_dp, grads)
+    return loss, grads
+
+
 def _shard_map_norep(fn, mesh, in_specs, out_specs):
     """shard_map without the replication check, across the jax rename
     (check_rep -> check_vma); single home for the compatibility shim."""
@@ -277,12 +288,7 @@ class Compiled1F1B:
             # accumulator summed M per-microbatch losses -> average
             loss = jax.lax.psum(loss_acc, axis) / M
             if self.data_axis is not None:
-                # per-shard loss_fn already averaged over its mb slice, so
-                # the global loss/grads are the dp-mean of shard values
-                n_dp = jax.lax.psum(1, self.data_axis)
-                loss = jax.lax.psum(loss, self.data_axis) / n_dp
-                grads = jax.tree_util.tree_map(
-                    lambda g: jax.lax.psum(g, self.data_axis) / n_dp, grads)
+                loss, grads = _dp_reduce(loss, grads, self.data_axis)
             grads = jax.tree_util.tree_map(lambda g: g[None], grads)
             return loss, grads
 
@@ -327,7 +333,7 @@ class CompiledInterleaved:
 
     def __init__(self, chunk_fn: Callable, loss_fn: Callable, mesh: Mesh,
                  num_microbatches: int, num_chunks: int, axis: str = "pp",
-                 split_dw: bool = False):
+                 split_dw: bool = False, data_axis: str | None = None):
         self.chunk_fn = chunk_fn
         self.loss_fn = loss_fn
         self.mesh = mesh
@@ -339,6 +345,8 @@ class CompiledInterleaved:
         # slot's parameter-grad ACCUMULATION is deferred one tick
         # (WeightGradStore put/flush); grads are identical
         self.split_dw = split_dw
+        # hybrid pp x dp, same contract as Compiled1F1B.data_axis
+        self.data_axis = data_axis
 
     def loss_and_grads(self, params, x, labels):
         S = self.num_stages
@@ -470,10 +478,13 @@ class CompiledInterleaved:
             carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T))
             _, _, _, grads, loss_acc, _ = carry
             loss = jax.lax.psum(loss_acc, axis) / M
+            if self.data_axis is not None:
+                loss, grads = _dp_reduce(loss, grads, self.data_axis)
             grads = jax.tree_util.tree_map(lambda g: g[None], grads)
             return loss, grads
 
         spec_p = jax.tree_util.tree_map(lambda _: P(axis), params)
-        fn = _shard_map_norep(device_prog, self.mesh, (spec_p, P(), P()),
-                              (P(), spec_p))
+        spec_x = P(None, self.data_axis) if self.data_axis else P()
+        fn = _shard_map_norep(device_prog, self.mesh,
+                              (spec_p, spec_x, spec_x), (P(), spec_p))
         return fn(params, x, labels)
