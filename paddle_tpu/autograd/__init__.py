@@ -1,8 +1,10 @@
 """paddle_tpu.autograd (reference: python/paddle/autograd/__init__.py)."""
 from .backward import run_backward as backward, grad  # noqa: F401
-from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+from .py_layer import (PyLayer, PyLayerContext,  # noqa: F401
+                       saved_tensors_hooks)
 from ..core.tensor import no_grad, enable_grad, set_grad_enabled  # noqa: F401
 from .functional import jacobian, hessian, vjp, jvp  # noqa: F401
 
 __all__ = ["backward", "grad", "PyLayer", "no_grad", "enable_grad",
+           "saved_tensors_hooks",
            "jacobian", "hessian", "vjp", "jvp"]

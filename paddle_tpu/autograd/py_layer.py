@@ -14,15 +14,49 @@ import jax.numpy as jnp
 from ..core.tensor import GradNode, Tensor, no_grad, to_value, is_grad_enabled
 
 
+# saved_tensors_hooks state (reference: autograd/saved_tensors_hooks.py
+# — pack/unpack hooks around tensors stashed for backward, e.g. to
+# offload them to host memory). The eager tape's residuals live inside
+# jax vjp closures and cannot be intercepted; the PyLayer
+# save_for_backward path — the reference's own example use — is hooked.
+_SAVED_HOOKS: list = []
+
+
+class saved_tensors_hooks:
+    """reference: paddle.autograd.saved_tensors_hooks(pack, unpack).
+    Inside the context, PyLayerContext.save_for_backward routes each
+    tensor through ``pack_hook`` and ``saved_tensor()`` routes the
+    stored object back through ``unpack_hook``."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _SAVED_HOOKS.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _SAVED_HOOKS.pop()
+
+
 class PyLayerContext:
     def __init__(self):
         self._saved: Tuple = ()
         self.materialize_grads = True
+        self._unpack = None
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        if _SAVED_HOOKS:
+            pack, unpack = _SAVED_HOOKS[-1]
+            self._saved = tuple(pack(t) for t in tensors)
+            self._unpack = unpack
+        else:
+            self._saved = tensors
 
     def saved_tensor(self):
+        if self._unpack is not None:
+            return tuple(self._unpack(s) for s in self._saved)
         return self._saved
 
     saved_tensors = saved_tensor

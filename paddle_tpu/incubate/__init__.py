@@ -1,3 +1,57 @@
 """paddle_tpu.incubate (reference: python/paddle/incubate/)."""
 from . import nn  # noqa: F401
 from . import asp  # noqa: F401
+
+# graph-learning op aliases (reference: incubate/operators/* re-exports
+# of the geometric kernels, kept for script compatibility)
+from ..geometric import (graph_khop_sampler,  # noqa: F401
+                         segment_max, segment_mean, segment_min,
+                         segment_sum)
+from ..geometric import reindex_graph as graph_reindex  # noqa: F401
+from ..geometric import sample_neighbors as \
+    graph_sample_neighbors  # noqa: F401
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+
+
+def identity_loss(x, reduction="none"):
+    """reference: incubate/operators/identity_loss — marks a tensor as a
+    loss for the IPU backend; here it is the reduction itself."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor, dispatch
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    red = {"none": lambda v: v, 0: lambda v: v,
+           "sum": jnp.sum, 1: jnp.sum,
+           "mean": jnp.mean, 2: jnp.mean}
+    if reduction not in red:
+        raise ValueError(f"unsupported reduction {reduction}")
+    return dispatch(red[reduction], (x,), name="identity_loss")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference: incubate/operators/softmax_mask_fuse — softmax(x +
+    mask) fused; XLA fuses the add into the softmax on TPU."""
+    import jax
+    from ..core.tensor import Tensor, dispatch
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    mask = mask if isinstance(mask, Tensor) else Tensor(mask)
+    return dispatch(lambda v, m: jax.nn.softmax(v + m, axis=-1),
+                    (x, mask), name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """reference: incubate/operators/softmax_mask_fuse_upper_triangle —
+    causal-masked softmax (upper triangle masked out)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor, dispatch
+    x = x if isinstance(x, Tensor) else Tensor(x)
+
+    def f(v):
+        q, k = v.shape[-2], v.shape[-1]
+        causal = jnp.tril(jnp.ones((q, k), bool))
+        return jax.nn.softmax(jnp.where(causal, v, -1e30), axis=-1)
+
+    return dispatch(f, (x,), name="softmax_mask_fuse_upper_triangle")
+
+
+from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402

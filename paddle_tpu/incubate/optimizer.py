@@ -1,0 +1,132 @@
+"""Incubate optimizer wrappers (reference:
+python/paddle/incubate/optimizer/lookahead.py LookAhead,
+python/paddle/incubate/optimizer/modelaverage.py ModelAverage)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import to_value
+
+
+class LookAhead:
+    """reference lookahead.py: wrap an inner optimizer; every k steps
+    pull the fast weights toward slow weights:
+    slow += alpha * (fast - slow); fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._steps = 0
+        self._slow = {}     # id(param) -> slow weight value
+
+    @property
+    def _parameters(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._steps += 1
+        params = self.inner_optimizer._parameter_list
+        if self._steps % self.k == 0:
+            for p in params:
+                pid = id(p)
+                # copy: the param buffer is DONATED by fused optimizer
+                # steps, so an alias held across steps would be deleted
+                fast = to_value(p).astype(jnp.float32).copy()
+                slow = self._slow.get(pid)
+                if slow is None:
+                    slow = fast
+                slow = slow + self.alpha * (fast - slow)
+                self._slow[pid] = slow
+                # hand the param a SEPARATE buffer: astype to the same
+                # dtype is a no-op alias, and the param buffer gets
+                # donated by the next fused optimizer step
+                p._replace_value(
+                    jnp.asarray(slow, to_value(p).dtype).copy())
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "steps": self._steps,
+                "slow": {i: np.asarray(v)
+                         for i, v in enumerate(self._slow.values())}}
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """reference modelaverage.py: a TRUE running average (sum / count,
+    not an EMA — an EMA from zero under-counts short runs); the window
+    restarts once the accumulate count passes max_average_window, like
+    the reference's old/num accumulator fold."""
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.rate = average_window_rate
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+        self._params = list(parameters or [])
+        self._sum = {id(p): jnp.zeros_like(to_value(p), jnp.float32)
+                     for p in self._params}
+        self._n = 0
+        self._backup = {}
+
+    def step(self):
+        window = max(self.min_w, min(self.max_w,
+                                     int((self._n + 1) * self.rate) or 1))
+        if self._n >= window:
+            # restart: keep the current average as one pseudo-sample
+            for pid in self._sum:
+                self._sum[pid] = self._sum[pid] / self._n
+            self._n = 1
+        self._n += 1
+        for p in self._params:
+            pid = id(p)
+            self._sum[pid] = self._sum[pid] + \
+                to_value(p).astype(jnp.float32).copy()
+
+    def apply(self, executor=None, need_restore=True):
+        class _Ctx:
+            def __init__(ctx):
+                pass
+
+            def __enter__(ctx):
+                self._backup = {id(p): p._value for p in self._params}
+                n = max(self._n, 1)
+                for p in self._params:
+                    avg = self._sum[id(p)] / n
+                    p._replace_value(
+                        jnp.asarray(avg, p._value.dtype).copy())
+                return ctx
+
+            def __exit__(ctx, *exc):
+                if need_restore:
+                    self.restore()
+
+        return _Ctx()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._replace_value(self._backup[id(p)])
+        self._backup = {}
+
+    def minimize(self, loss):
+        raise RuntimeError(
+            "ModelAverage wraps evaluation, not training; call step() "
+            "after your optimizer's step()")

@@ -8,4 +8,110 @@ from .generation import (GenerationConfig, generate, cached_forward,
                          init_cache, sample_token)
 
 __all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
+           "DataType", "PlaceType", "PrecisionType", "PredictorPool",
+           "XpuConfig", "get_version", "get_num_bytes_of_data_type",
+           "get_trt_compile_version", "get_trt_runtime_version",
+           "convert_to_mixed_precision",
            "generate", "cached_forward", "init_cache", "sample_token"]
+
+
+class DataType:
+    """reference: paddle_infer.DataType enum."""
+    FLOAT32 = 0
+    FLOAT16 = 1
+    INT64 = 2
+    INT32 = 3
+    UINT8 = 4
+    INT8 = 5
+    BOOL = 6
+    BFLOAT16 = 7
+    FLOAT64 = 8
+
+
+class PlaceType:
+    """reference: paddle_infer.PlaceType enum (kXPU slot = the TPU)."""
+    kUNK = -1
+    kCPU = 0
+    kGPU = 1
+    kXPU = 2
+    kNPU = 3
+    kIPU = 4
+    kCUSTOM = 5
+
+
+class PrecisionType:
+    """reference: AnalysisConfig::Precision."""
+    Float32 = 0
+    Half = 1
+    Int8 = 2
+    Bfloat16 = 3
+
+
+class PredictorPool:
+    """reference: paddle_infer.PredictorPool — N predictors sharing one
+    config (the AOT executable cache dedupes compilation)."""
+
+    def __init__(self, config, size=1):
+        first = create_predictor(config)
+        self._preds = [first] + [first.clone() for _ in range(size - 1)]
+
+    def retrive(self, idx):   # reference spells it this way
+        return self._preds[idx]
+
+    retrieve = retrive
+
+
+class XpuConfig:
+    """reference: paddle_infer.XpuConfig — accelerator knob bag; on this
+    framework XLA owns device configuration (knobs recorded only)."""
+
+    def __init__(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+
+def get_version():
+    """reference: paddle_infer.get_version."""
+    return "paddle_tpu-inference 3.0 (XLA AOT serving path)"
+
+
+def get_num_bytes_of_data_type(dtype):
+    sizes = {DataType.FLOAT32: 4, DataType.FLOAT16: 2, DataType.INT64: 8,
+             DataType.INT32: 4, DataType.UINT8: 1, DataType.INT8: 1,
+             DataType.BOOL: 1, DataType.BFLOAT16: 2, DataType.FLOAT64: 8}
+    return sizes.get(dtype, 4)
+
+
+def get_trt_compile_version():
+    """reference: TensorRT probe — always (0,0,0): the XLA executable
+    fills the TRT slot here."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def _get_phi_kernel_name(op_name):
+    """reference: paddle.inference._get_phi_kernel_name — maps a legacy
+    op name to its phi kernel; identity here (ops ARE jax fns)."""
+    return op_name
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """reference: inference/convert_to_mixed_precision — offline pass
+    rewriting a saved model to fp16/bf16. The XLA path applies AMP at
+    compile time, so this copies the model and records the requested
+    precision next to it."""
+    import json
+    import shutil
+    for src, dst in ((model_file, mixed_model_file),
+                     (params_file, mixed_params_file)):
+        if src and dst and src != dst:
+            shutil.copyfile(src, dst)
+    with open(str(mixed_model_file) + ".precision.json", "w") as f:
+        json.dump({"mixed_precision": str(mixed_precision),
+                   "keep_io_types": keep_io_types,
+                   "black_list": sorted(black_list or [])}, f)

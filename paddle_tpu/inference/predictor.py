@@ -138,6 +138,12 @@ class Predictor:
         # executable (restart-no-recompile verified by tests)
         self.last_run_from_cache = False
 
+    def clone(self):
+        """reference: AnalysisPredictor::Clone — a new predictor over the
+        same model/config (the on-disk AOT executable cache is shared, so
+        clones skip recompilation)."""
+        return Predictor(self.config)
+
     @staticmethod
     def _fingerprint(path: str) -> str:
         import hashlib

@@ -5,4 +5,21 @@ from .save_load import save, load, TranslatedLayer  # noqa: F401
 from .train_step import train_step, TrainStep  # noqa: F401
 
 __all__ = ["to_static", "not_to_static", "save", "load", "enable_to_static",
+           "set_verbosity", "set_code_level",
            "train_step", "TrainStep"]
+
+
+# SOT logging knobs (reference: jit/sot/utils/envs.py). Module state the
+# SOT recorder consults when emitting segment diagnostics.
+_SOT_LOG = {"verbosity": 0, "code_level": -1}
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """reference: jit/sot set_verbosity — SOT translate log verbosity."""
+    _SOT_LOG["verbosity"] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """reference: jit/sot set_code_level — dump level for SOT-generated
+    code objects."""
+    _SOT_LOG["code_level"] = int(level)

@@ -15,6 +15,7 @@ __all__ = [
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
     "Assign", "Orthogonal", "Dirac", "calculate_gain",
+    "Bilinear", "set_global_initializer",
 ]
 
 
@@ -188,3 +189,35 @@ class Dirac(Initializer):
             for i in range(min(per_group, ic)):
                 out[(g * per_group + i, i) + spatial_center] = 1.0
         return jnp.asarray(out).astype(d)
+
+
+class Bilinear(Initializer):
+    """reference: nn/initializer/Bilinear — upsampling-kernel init for
+    transposed convs (weight [C_out, C_in, k, k])."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        if len(shape) < 3:
+            raise ValueError("Bilinear init expects a conv weight rank>=3")
+        k = shape[-1]
+        factor = (k + 1) // 2
+        center = factor - 1 if k % 2 == 1 else factor - 0.5
+        og = np.ogrid[tuple(slice(0, s) for s in shape[2:])]
+        filt = np.ones(shape[2:], np.float64)
+        for g in og:
+            filt = filt * (1 - np.abs(g - center) / factor)
+        w = np.zeros(shape, np.float64)
+        w[...] = filt
+        import jax.numpy as jnp
+        return jnp.asarray(w, dtype)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """reference: nn/initializer/set_global_initializer — default
+    initializers for subsequently-created parameters; pass None, None
+    to reset."""
+    _GLOBAL_INIT[0] = weight_init
+    _GLOBAL_INIT[1] = bias_init
+
+
+_GLOBAL_INIT = [None, None]

@@ -61,7 +61,12 @@ class Layer:
         if attr is False:
             return None
         dtype = convert_dtype(dtype) if dtype else self._dtype
-        init = attr.initializer or default_initializer
+        # precedence (reference set_global_initializer semantics): the
+        # per-param attr wins; else the global initializer overrides the
+        # layer's built-in default; else framework fallback
+        init = attr.initializer \
+            or I._GLOBAL_INIT[1 if is_bias else 0] \
+            or default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
         from ...framework import _LAZY_INIT

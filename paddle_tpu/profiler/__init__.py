@@ -11,4 +11,32 @@ __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
     "export_chrome_tracing", "export_protobuf", "RecordEvent",
     "TracerEventType", "load_profiler_result", "benchmark", "Benchmark",
+    "SortedKeys", "SummaryView",
 ]
+
+
+class SortedKeys:
+    """reference: profiler/profiler_statistic.py SortedKeys — sort keys
+    for summary tables."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+class SummaryView:
+    """reference: profiler/profiler.py SummaryView — which table
+    summary() renders."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8

@@ -6,10 +6,12 @@ are the same API surface but require local files (this environment has no
 egress — pass ``data_file`` explicitly).
 """
 from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
-from .datasets import Imdb, UCIHousing  # noqa: F401
+from .datasets import (Imdb, UCIHousing, Imikolov,  # noqa: F401
+                       Movielens, WMT14, WMT16, Conll05st)
 from .tokenizer import FasterTokenizer  # noqa: F401
 from . import strings_ops as strings  # noqa: F401
 from .strings_ops import StringTensor  # noqa: F401
 
 __all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "UCIHousing",
+           "Imikolov", "Movielens", "WMT14", "WMT16", "Conll05st",
            "FasterTokenizer", "StringTensor", "strings"]

@@ -82,3 +82,222 @@ class Imdb(Dataset):
 
     def __getitem__(self, i):
         return self.docs[i], int(self.labels[i])
+
+
+class Imikolov(Dataset):
+    """reference: text/datasets/imikolov.py — PTB language-model n-grams
+    from the simple-examples tarball."""
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size=-1, mode="train", min_word_freq=50):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "Imikolov needs a local simple-examples.tgz "
+                "(no download in this environment); pass data_file=")
+        name = {"train": "ptb.train.txt", "test": "ptb.valid.txt"}[mode]
+        freq, lines = {}, []
+        with tarfile.open(data_file) as tf:
+            member = next(m for m in tf.getmembers()
+                          if m.name.endswith(name))
+            for line in tf.extractfile(member).read().decode().splitlines():
+                toks = ["<s>"] + line.strip().split() + ["<e>"]
+                lines.append(toks)
+                for w in toks:
+                    freq[w] = freq.get(w, 0) + 1
+        words = [w for w, c in sorted(freq.items(),
+                                      key=lambda kv: (-kv[1], kv[0]))
+                 if c >= min_word_freq or w in ("<s>", "<e>")]
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx.setdefault("<unk>", len(self.word_idx))
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        n = 5 if window_size < 0 else window_size
+        for toks in lines:
+            ids = [self.word_idx.get(w, unk) for w in toks]
+            if data_type.upper() == "NGRAM":
+                for i in range(len(ids) - n + 1):
+                    self.data.append(np.asarray(ids[i:i + n], np.int64))
+            else:   # SEQ
+                self.data.append(np.asarray(ids, np.int64))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class Movielens(Dataset):
+    """reference: text/datasets/movielens.py — ml-1m ratings with user
+    and movie features."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 test_ratio=0.1, rand_seed=0):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "Movielens needs a local ml-1m.zip "
+                "(no download in this environment); pass data_file=")
+        import zipfile
+        users, movies, ratings = {}, {}, []
+        with zipfile.ZipFile(data_file) as zf:
+            def read(name):
+                with zf.open(f"ml-1m/{name}") as f:
+                    return f.read().decode("latin1").splitlines()
+            for line in read("users.dat"):
+                uid, gender, age, job, _ = line.strip().split("::")
+                users[int(uid)] = (0 if gender == "M" else 1, int(age),
+                                   int(job))
+            cat_idx = {}
+            for line in read("movies.dat"):
+                mid, title, cats = line.strip().split("::")
+                ids = []
+                for c in cats.split("|"):
+                    ids.append(cat_idx.setdefault(c, len(cat_idx)))
+                movies[int(mid)] = ids
+            for line in read("ratings.dat"):
+                uid, mid, rate, _ = line.strip().split("::")
+                ratings.append((int(uid), int(mid), float(rate)))
+        rng = np.random.RandomState(rand_seed)
+        mask = rng.rand(len(ratings)) < test_ratio
+        self.data = [r for r, m in zip(ratings, mask)
+                     if (m if mode == "test" else not m)]
+        self.users, self.movies = users, movies
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        uid, mid, rate = self.data[i]
+        g, a, j = self.users[uid]
+        cats = np.asarray(self.movies[mid], np.int64)
+        return (np.int64(uid), np.int64(g), np.int64(a), np.int64(j),
+                np.int64(mid), cats, np.float32(rate))
+
+
+class _WMTBase(Dataset):
+    """Shared parallel-corpus reader: tarball with tokenized src/trg
+    files; builds vocab with <s>/<e>/<unk> like the reference."""
+
+    _SRC_SUFFIX = ""
+    _TRG_SUFFIX = ""
+
+    def __init__(self, data_file, mode, dict_size, trg_dict_size=None):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                f"{type(self).__name__} needs a local corpus tarball "
+                "(no download in this environment); pass data_file=")
+        pairs = []
+        with tarfile.open(data_file) as tf:
+            names = [m.name for m in tf.getmembers()]
+            src_name = next(n for n in names
+                            if mode in n and n.endswith(self._SRC_SUFFIX))
+            trg_name = next(n for n in names
+                            if mode in n and n.endswith(self._TRG_SUFFIX))
+            src = tf.extractfile(src_name).read().decode(
+                "utf-8", "ignore").splitlines()
+            trg = tf.extractfile(trg_name).read().decode(
+                "utf-8", "ignore").splitlines()
+        freq_s, freq_t = {}, {}
+        for s in src:
+            for w in s.split():
+                freq_s[w] = freq_s.get(w, 0) + 1
+        for t_ in trg:
+            for w in t_.split():
+                freq_t[w] = freq_t.get(w, 0) + 1
+
+        def vocab(freq, size):
+            words = ["<s>", "<e>", "<unk>"] + [
+                w for w, _ in sorted(freq.items(),
+                                     key=lambda kv: (-kv[1], kv[0]))]
+            words = words[:size]
+            return {w: i for i, w in enumerate(words)}
+
+        self.src_ids = vocab(freq_s, dict_size)
+        self.trg_ids = vocab(freq_t, trg_dict_size
+                             if trg_dict_size is not None else dict_size)
+        unk_s, unk_t = self.src_ids["<unk>"], self.trg_ids["<unk>"]
+        self.data = []
+        for s, t_ in zip(src, trg):
+            sid = [self.src_ids.get(w, unk_s) for w in s.split()]
+            tid = [self.trg_ids["<s>"]] + \
+                [self.trg_ids.get(w, unk_t) for w in t_.split()]
+            lbl = tid[1:] + [self.trg_ids["<e>"]]
+            self.data.append((np.asarray(sid, np.int64),
+                              np.asarray(tid, np.int64),
+                              np.asarray(lbl, np.int64)))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class WMT14(_WMTBase):
+    """reference: text/datasets/wmt14.py — en->fr translation pairs."""
+
+    _SRC_SUFFIX = ".en"
+    _TRG_SUFFIX = ".fr"
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 dict_size=30000):
+        super().__init__(data_file, mode, dict_size)
+
+
+class WMT16(_WMTBase):
+    """reference: text/datasets/wmt16.py — multi30k pairs;
+    ``lang`` selects the SOURCE language (en->de or de->en)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="train",
+                 src_dict_size=30000, trg_dict_size=30000, lang="en"):
+        if lang not in ("en", "de"):
+            raise ValueError("lang must be 'en' or 'de'")
+        self._SRC_SUFFIX = "." + lang
+        self._TRG_SUFFIX = ".de" if lang == "en" else ".en"
+        super().__init__(data_file, mode, src_dict_size, trg_dict_size)
+
+
+class Conll05st(Dataset):
+    """reference: text/datasets/conll05.py — semantic role labeling
+    (words/props column files inside the tarball)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode="test",
+                 **kwargs):
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "Conll05st needs a local conll05st tarball "
+                "(no download in this environment); pass data_file=")
+        self.sentences = []
+        with tarfile.open(data_file) as tf:
+            words_m = next((m for m in tf.getmembers()
+                            if "words" in m.name), None)
+            props_m = next((m for m in tf.getmembers()
+                            if "props" in m.name), None)
+            if words_m is None or props_m is None:
+                raise ValueError("tarball lacks words/props members")
+            words = tf.extractfile(words_m).read().decode().splitlines()
+            props = tf.extractfile(props_m).read().decode().splitlines()
+        sent_w, sent_p = [], []
+        for w, p in zip(words, props):
+            if not w.strip():
+                if sent_w:
+                    self.sentences.append((sent_w, sent_p))
+                sent_w, sent_p = [], []
+            else:
+                sent_w.append(w.strip())
+                sent_p.append(p.strip().split())
+        if sent_w:
+            self.sentences.append((sent_w, sent_p))
+        vocab = {}
+        for ws, _ in self.sentences:
+            for w in ws:
+                vocab.setdefault(w.lower(), len(vocab))
+        self.word_dict = vocab
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def __getitem__(self, i):
+        ws, ps = self.sentences[i]
+        ids = np.asarray([self.word_dict[w.lower()] for w in ws], np.int64)
+        return ids, ps

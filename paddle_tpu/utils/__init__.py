@@ -14,3 +14,43 @@ def run_check():
     print(f"paddle_tpu is installed successfully! "
           f"backend={jax.default_backend()}, "
           f"devices={jax.device_count()}")
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """reference: utils/deprecated.py — decorator emitting a
+    DeprecationWarning on call."""
+    import functools
+    import warnings
+
+    def wrap(func):
+        @functools.wraps(func)
+        def inner(*args, **kwargs):
+            msg = f"API {func.__module__}.{func.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+        return inner
+    return wrap
+
+
+def require_version(min_version, max_version=None):
+    """reference: utils/install_check.py require_version — assert the
+    installed framework version is in [min, max]."""
+    ver = "3.0.0"   # capability-parity surface of the surveyed snapshot
+
+    def key(v):
+        return [int(x) for x in str(v).split(".")[:3] if x.isdigit()]
+
+    if key(ver) < key(min_version):
+        raise Exception(
+            f"installed version {ver} < required min {min_version}")
+    if max_version is not None and key(ver) > key(max_version):
+        raise Exception(
+            f"installed version {ver} > required max {max_version}")

@@ -2,7 +2,22 @@
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,  # noqa
                      resnet152, BasicBlock, BottleneckBlock)
 from .lenet import LeNet  # noqa: F401
-from .vgg import VGG, vgg16, vgg19  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .ppyoloe import (PPYOLOE, ppyoloe_s, ppyoloe_tiny,  # noqa: F401
                       multiclass_nms)
+from .resnet import (resnext50_32x4d, resnext50_64x4d,  # noqa: F401,E402
+                     resnext101_32x4d, resnext101_64x4d,
+                     resnext152_32x4d, resnext152_64x4d,
+                     wide_resnet50_2, wide_resnet101_2)
+from .zoo_extra import (AlexNet, alexnet, SqueezeNet,  # noqa: F401,E402
+                        squeezenet1_0, squeezenet1_1, MobileNetV1,
+                        mobilenet_v1, MobileNetV3Large, MobileNetV3Small,
+                        mobilenet_v3_large, mobilenet_v3_small,
+                        ShuffleNetV2, shufflenet_v2_x0_25,
+                        shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+                        shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+                        shufflenet_v2_x2_0, shufflenet_v2_swish,
+                        DenseNet, densenet121, densenet161, densenet169,
+                        densenet201, densenet264, GoogLeNet, googlenet,
+                        InceptionV3, inception_v3)

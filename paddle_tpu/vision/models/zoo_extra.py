@@ -1,0 +1,638 @@
+"""Classification model zoo long tail (reference:
+python/paddle/vision/models/{alexnet,squeezenet,mobilenetv1,mobilenetv3,
+shufflenetv2,densenet,googlenet,inceptionv3}.py). Faithful compact
+re-implementations of the reference architectures; ``pretrained`` is
+accepted for signature parity (no weight downloads in this
+environment)."""
+from __future__ import annotations
+
+from .. import ops  # noqa: F401  (keeps package import side effects)
+from ... import nn
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise ValueError(
+            "pretrained weights require network download, unavailable "
+            "in this environment; load a local state_dict instead")
+
+
+class ConvBNAct(nn.Layer):
+    def __init__(self, cin, cout, k, stride=1, padding=0, groups=1,
+                 act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                              groups=groups, bias_attr=False)
+        self.bn = nn.BatchNorm2D(cout)
+        self.act = {"relu": nn.ReLU(), "hardswish": nn.Hardswish(),
+                    "swish": nn.Swish(), None: None}[act]
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+# -- AlexNet ---------------------------------------------------------------
+class AlexNet(nn.Layer):
+    """reference: vision/models/alexnet.py."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2))
+        self.avgpool = nn.AdaptiveAvgPool2D((6, 6))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Linear(256 * 36, 4096), nn.ReLU(),
+            nn.Dropout(0.5), nn.Linear(4096, 4096), nn.ReLU(),
+            nn.Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(x.flatten(1))
+
+
+def alexnet(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return AlexNet(**kwargs)
+
+
+# -- SqueezeNet ------------------------------------------------------------
+class _Fire(nn.Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = nn.Conv2D(cin, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.e1 = nn.Conv2D(squeeze, e1, 1)
+        self.e3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        s = self.relu(self.squeeze(x))
+        return concat([self.relu(self.e1(s)), self.relu(self.e3(s))], 1)
+
+
+class SqueezeNet(nn.Layer):
+    """reference: vision/models/squeezenet.py (1.0 and 1.1 variants)."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1))
+
+    def forward(self, x):
+        return self.classifier(self.features(x)).flatten(1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.1", **kwargs)
+
+
+# -- MobileNetV1 -----------------------------------------------------------
+class MobileNetV1(nn.Layer):
+    """reference: vision/models/mobilenetv1.py — depthwise-separable
+    stacks."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+            [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [ConvBNAct(3, c(32), 3, stride=2, padding=1)]
+        for cin, cout, s in cfg:
+            layers.append(ConvBNAct(c(cin), c(cin), 3, stride=s,
+                                    padding=1, groups=c(cin)))
+            layers.append(ConvBNAct(c(cin), c(cout), 1))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        return self.fc(self.pool(self.features(x)).flatten(1))
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+# -- MobileNetV3 -----------------------------------------------------------
+class _SE(nn.Layer):
+    def __init__(self, ch, rd=4):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, ch // rd, 1)
+        self.fc2 = nn.Conv2D(ch // rd, ch, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MBV3Block(nn.Layer):
+    def __init__(self, cin, exp, cout, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if exp != cin:
+            layers.append(ConvBNAct(cin, exp, 1, act=act))
+        layers.append(ConvBNAct(exp, exp, k, stride=stride,
+                                padding=k // 2, groups=exp, act=act))
+        if se:
+            layers.append(_SE(exp))
+        layers.append(ConvBNAct(exp, cout, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+_V3_LARGE = [
+    # k, exp, out, se, act, stride
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1)]
+_V3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1)]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, last_ch, scale=1.0,
+                 num_classes=1000):
+        super().__init__()
+
+        def c(ch):
+            return max(int(ch * scale + 4) // 8 * 8, 8)
+
+        layers = [ConvBNAct(3, c(16), 3, stride=2, padding=1,
+                            act="hardswish")]
+        cin = c(16)
+        for k, exp, out, se, act, s in cfg:
+            layers.append(_MBV3Block(cin, c(exp), c(out), k, s, se, act))
+            cin = c(out)
+        layers.append(ConvBNAct(cin, c(last_exp), 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Sequential(
+            nn.Linear(c(last_exp), last_ch), nn.Hardswish(),
+            nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        return self.classifier(self.pool(self.features(x)).flatten(1))
+
+
+class MobileNetV3Large(_MobileNetV3):
+    """reference: vision/models/mobilenetv3.py MobileNetV3Large."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, 960, 1280, scale, num_classes)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    """reference: vision/models/mobilenetv3.py MobileNetV3Small."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, 576, 1024, scale, num_classes)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+# -- ShuffleNetV2 ----------------------------------------------------------
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, cin, cout, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                ConvBNAct(cin // 2, branch, 1, act=act),
+                ConvBNAct(branch, branch, 3, stride=1, padding=1,
+                          groups=branch, act=None),
+                ConvBNAct(branch, branch, 1, act=act))
+            self.branch1 = None
+        else:
+            self.branch1 = nn.Sequential(
+                ConvBNAct(cin, cin, 3, stride=stride, padding=1,
+                          groups=cin, act=None),
+                ConvBNAct(cin, branch, 1, act=act))
+            self.branch2 = nn.Sequential(
+                ConvBNAct(cin, branch, 1, act=act),
+                ConvBNAct(branch, branch, 3, stride=stride, padding=1,
+                          groups=branch, act=None),
+                ConvBNAct(branch, branch, 1, act=act))
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat, split
+        if self.stride == 1:
+            a, b = split(x, 2, axis=1)
+            out = concat([a, self.branch2(b)], 1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], 1)
+        # channel shuffle, groups=2
+        n, c, h, w = out.shape
+        return out.reshape([n, 2, c // 2, h, w]).transpose(
+            [0, 2, 1, 3, 4]).reshape([n, c, h, w])
+
+
+class ShuffleNetV2(nn.Layer):
+    """reference: vision/models/shufflenetv2.py."""
+
+    _CHS = {0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+            0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+            1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048)}
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        chs = self._CHS[scale]
+        self.conv1 = ConvBNAct(3, chs[0], 3, stride=2, padding=1, act=act)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        cin = chs[0]
+        for i, reps in enumerate((4, 8, 4)):
+            cout = chs[i + 1]
+            units = [_ShuffleUnit(cin, cout, 2, act)]
+            units += [_ShuffleUnit(cout, cout, 1, act)
+                      for _ in range(reps - 1)]
+            stages.append(nn.Sequential(*units))
+            cin = cout
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = ConvBNAct(cin, chs[4], 1, act=act)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(chs[4], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.stages(x))
+        return self.fc(self.pool(x).flatten(1))
+
+
+def _shufflenet(scale, act="relu", pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _shufflenet(0.25, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _shufflenet(0.33, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _shufflenet(0.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _shufflenet(1.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _shufflenet(1.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _shufflenet(2.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return _shufflenet(1.0, act="swish", pretrained=pretrained, **kw)
+
+
+# -- DenseNet --------------------------------------------------------------
+class _DenseLayer(nn.Layer):
+    def __init__(self, cin, growth, bn_size, dropout):
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(cin)
+        self.conv1 = nn.Conv2D(cin, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+        self.dropout = dropout
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        return concat([x, out], 1)
+
+
+class DenseNet(nn.Layer):
+    """reference: vision/models/densenet.py."""
+
+    _CFG = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+            169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+            264: (6, 12, 64, 48)}
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        growth = 48 if layers == 161 else 32
+        init_ch = 96 if layers == 161 else 64
+        blocks = self._CFG[layers]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_ch, 7, stride=2, padding=3,
+                      bias_attr=False),
+            nn.BatchNorm2D(init_ch), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        ch = init_ch
+        feats = []
+        for bi, reps in enumerate(blocks):
+            for _ in range(reps):
+                feats.append(_DenseLayer(ch, growth, bn_size, dropout))
+                ch += growth
+            if bi != len(blocks) - 1:
+                feats.append(nn.Sequential(
+                    nn.BatchNorm2D(ch), nn.ReLU(),
+                    nn.Conv2D(ch, ch // 2, 1, bias_attr=False),
+                    nn.AvgPool2D(2, stride=2)))
+                ch //= 2
+        self.features = nn.Sequential(*feats)
+        self.bn_last = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.bn_last(self.features(self.stem(x))))
+        return self.fc(self.pool(x).flatten(1))
+
+
+def _densenet(layers, pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(layers=layers, **kw)
+
+
+def densenet121(pretrained=False, **kw):
+    return _densenet(121, pretrained, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return _densenet(161, pretrained, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return _densenet(169, pretrained, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return _densenet(201, pretrained, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return _densenet(264, pretrained, **kw)
+
+
+# -- GoogLeNet -------------------------------------------------------------
+class _Inception(nn.Layer):
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = ConvBNAct(cin, c1, 1)
+        self.b2 = nn.Sequential(ConvBNAct(cin, c3r, 1),
+                                ConvBNAct(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(ConvBNAct(cin, c5r, 1),
+                                ConvBNAct(c5r, c5, 5, padding=2))
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                ConvBNAct(cin, proj, 1))
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], 1)
+
+
+class GoogLeNet(nn.Layer):
+    """reference: vision/models/googlenet.py — returns (main, aux1, aux2)
+    logits like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            ConvBNAct(3, 64, 7, stride=2, padding=3),
+            nn.MaxPool2D(3, stride=2, padding=1),
+            ConvBNAct(64, 64, 1), ConvBNAct(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1))
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.4)
+        self.fc = nn.Linear(1024, num_classes)
+        self.aux1 = nn.Sequential(nn.AdaptiveAvgPool2D(4),
+                                  nn.Flatten(),
+                                  nn.Linear(512 * 16, 1024), nn.ReLU(),
+                                  nn.Linear(1024, num_classes))
+        self.aux2 = nn.Sequential(nn.AdaptiveAvgPool2D(4),
+                                  nn.Flatten(),
+                                  nn.Linear(528 * 16, 1024), nn.ReLU(),
+                                  nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.pool3(self.i3b(self.i3a(self.stem(x))))
+        x = self.i4a(x)
+        a1 = self.aux1(x)
+        x = self.i4d(self.i4c(self.i4b(x)))
+        a2 = self.aux2(x)
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        out = self.fc(self.dropout(self.pool(x).flatten(1)))
+        return out, a1, a2
+
+
+def googlenet(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return GoogLeNet(**kw)
+
+
+# -- InceptionV3 -----------------------------------------------------------
+class _IncA(nn.Layer):
+    def __init__(self, cin, pool_feat):
+        super().__init__()
+        self.b1 = ConvBNAct(cin, 64, 1)
+        self.b5 = nn.Sequential(ConvBNAct(cin, 48, 1),
+                                ConvBNAct(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(ConvBNAct(cin, 64, 1),
+                                ConvBNAct(64, 96, 3, padding=1),
+                                ConvBNAct(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBNAct(cin, pool_feat, 1))
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], 1)
+
+
+class _IncB(nn.Layer):
+    """Grid reduction 35->17."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = ConvBNAct(cin, 384, 3, stride=2)
+        self.b3d = nn.Sequential(ConvBNAct(cin, 64, 1),
+                                 ConvBNAct(64, 96, 3, padding=1),
+                                 ConvBNAct(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], 1)
+
+
+class _IncC(nn.Layer):
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.b1 = ConvBNAct(cin, 192, 1)
+        self.b7 = nn.Sequential(
+            ConvBNAct(cin, c7, 1),
+            ConvBNAct(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBNAct(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            ConvBNAct(cin, c7, 1),
+            ConvBNAct(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBNAct(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBNAct(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBNAct(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBNAct(cin, 192, 1))
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], 1)
+
+
+class _IncD(nn.Layer):
+    """Grid reduction 17->8."""
+
+    def __init__(self, cin):
+        super().__init__()
+        self.b3 = nn.Sequential(ConvBNAct(cin, 192, 1),
+                                ConvBNAct(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            ConvBNAct(cin, 192, 1),
+            ConvBNAct(192, 192, (1, 7), padding=(0, 3)),
+            ConvBNAct(192, 192, (7, 1), padding=(3, 0)),
+            ConvBNAct(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        return concat([self.b3(x), self.b7(x), self.pool(x)], 1)
+
+
+class _IncE(nn.Layer):
+    def __init__(self, cin):
+        super().__init__()
+        self.b1 = ConvBNAct(cin, 320, 1)
+        self.b3_stem = ConvBNAct(cin, 384, 1)
+        self.b3_a = ConvBNAct(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = ConvBNAct(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(ConvBNAct(cin, 448, 1),
+                                      ConvBNAct(448, 384, 3, padding=1))
+        self.b3d_a = ConvBNAct(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = ConvBNAct(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                ConvBNAct(cin, 192, 1))
+
+    def forward(self, x):
+        from ...tensor.manipulation import concat
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return concat([self.b1(x), self.b3_a(s), self.b3_b(s),
+                       self.b3d_a(d), self.b3d_b(d), self.bp(x)], 1)
+
+
+class InceptionV3(nn.Layer):
+    """reference: vision/models/inceptionv3.py."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = nn.Sequential(
+            ConvBNAct(3, 32, 3, stride=2), ConvBNAct(32, 32, 3),
+            ConvBNAct(32, 64, 3, padding=1), nn.MaxPool2D(3, stride=2),
+            ConvBNAct(64, 80, 1), ConvBNAct(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            _IncA(192, 32), _IncA(256, 64), _IncA(288, 64),
+            _IncB(288),
+            _IncC(768, 128), _IncC(768, 160), _IncC(768, 160),
+            _IncC(768, 192),
+            _IncD(768),
+            _IncE(1280), _IncE(2048))
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.5)
+        self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        return self.fc(self.dropout(self.pool(x).flatten(1)))
+
+
+def inception_v3(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return InceptionV3(**kw)

@@ -18,7 +18,8 @@ __all__ = ["nms", "box_coder", "roi_align", "roi_pool", "yolo_box",
            "generate_proposals", "prior_box", "matrix_nms",
            "multiclass_nms", "distribute_fpn_proposals", "psroi_pool",
            "deform_conv2d", "nms_padded", "multiclass_nms_padded",
-           "matrix_nms_padded"]
+           "matrix_nms_padded", "RoIAlign", "RoIPool", "PSRoIPool",
+           "DeformConv2D", "read_file", "decode_jpeg", "yolo_loss"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
@@ -673,3 +674,222 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         return out.astype(xv.dtype)
 
     return dispatch(f, tuple(args), name="deform_conv2d")
+
+
+# -- layer wrappers (reference: python/paddle/vision/ops.py classes) --------
+from ..nn import Layer as _Layer  # noqa: E402
+
+
+class RoIAlign(_Layer):
+    """reference: vision/ops.py RoIAlign."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale, aligned=aligned)
+
+
+class RoIPool(_Layer):
+    """reference: vision/ops.py RoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+class PSRoIPool(_Layer):
+    """reference: vision/ops.py PSRoIPool."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+class DeformConv2D(_Layer):
+    """reference: vision/ops.py DeformConv2D — holds the conv weight and
+    applies deform_conv2d (offset/mask computed by the caller)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size, kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]],
+            attr=weight_attr)
+        self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             stride=self._stride, padding=self._padding,
+                             dilation=self._dilation,
+                             deformable_groups=self._deformable_groups,
+                             groups=self._groups, mask=mask)
+
+
+def read_file(filename, name=None):
+    """reference: vision/ops.py read_file — file bytes as a uint8
+    tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(np.frombuffer(data, np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference: vision/ops.py decode_jpeg — decode a uint8 byte
+    tensor to CHW uint8 (PIL-backed on host; the reference uses
+    nvjpeg on device)."""
+    import io as _io
+    from PIL import Image
+    data = bytes(np.asarray(to_value(_ensure(x))).astype(np.uint8))
+    img = Image.open(_io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "unchanged"):
+        img = img.convert("RGB") if mode == "rgb" or img.mode != "L" \
+            else img
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: vision/ops.py yolo_loss (YOLOv3 loss,
+    phi/kernels/cpu/yolo_loss_kernel.cc): per-cell objectness +
+    box-regression + classification against anchors; responsible
+    anchors chosen by best IoU at the grid cell."""
+    xx = _ensure(x)
+    gb = _ensure(gt_box)
+    gl = _ensure(gt_label)
+    args = (xx, gb, gl) + ((_ensure(gt_score),)
+                           if gt_score is not None else ())
+    an = np.asarray(anchors, np.float32).reshape(-1, 2)
+    mask_an = an[np.asarray(anchor_mask, np.int64)]
+    na = len(anchor_mask)
+
+    def f(v, boxes, labels, *score):
+        b, c, h, w = v.shape
+        nc = int(class_num)
+        v = v.reshape(b, na, 5 + nc, h, w)
+        px = jax.nn.sigmoid(v[:, :, 0]) * scale_x_y \
+            - (scale_x_y - 1) / 2          # [B, A, H, W]
+        py = jax.nn.sigmoid(v[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2
+        pw, ph = v[:, :, 2], v[:, :, 3]
+        obj_logit = v[:, :, 4]
+        cls_logit = v[:, :, 5:]             # [B, A, C, H, W]
+        in_w = w * downsample_ratio         # width/height normalize
+        in_h = h * downsample_ratio         # SEPARATELY (non-square)
+
+        gx = boxes[:, :, 0] * w             # grid units [B, G]
+        gy = boxes[:, :, 1] * h
+        gw = boxes[:, :, 2]                 # normalized [0,1]
+        gh = boxes[:, :, 3]
+        valid = (gw > 0) & (gh > 0)         # [B, G]
+        gi = jnp.clip(gx.astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip(gy.astype(jnp.int32), 0, h - 1)
+
+        # responsible anchor: best IoU of (gw, gh) vs each masked anchor
+        aw = jnp.asarray(mask_an[:, 0]) / in_w      # [A] normalized
+        ah = jnp.asarray(mask_an[:, 1]) / in_h
+        inter = jnp.minimum(gw[..., None], aw) * \
+            jnp.minimum(gh[..., None], ah)
+        iou_a = inter / (gw[..., None] * gh[..., None]
+                         + aw * ah - inter + 1e-10)
+        best_a = jnp.argmax(iou_a, -1)      # [B, G]
+
+        bidx = jnp.arange(b)[:, None]
+        tx = gx - gi                          # targets
+        ty = gy - gj
+        tw = jnp.log(jnp.clip(gw * in_w /
+                              jnp.take(jnp.asarray(mask_an[:, 0]), best_a),
+                              1e-9, None))
+        th = jnp.log(jnp.clip(gh * in_h /
+                              jnp.take(jnp.asarray(mask_an[:, 1]), best_a),
+                              1e-9, None))
+        scale = 2.0 - gw * gh                # small-box upweighting
+
+        sel = (bidx, best_a, gj, gi)
+        loss_xy = jnp.where(
+            valid,
+            scale * ((px[sel] - tx) ** 2 + (py[sel] - ty) ** 2), 0.0)
+        loss_wh = jnp.where(
+            valid,
+            scale * (jnp.abs(pw[sel] - tw) + jnp.abs(ph[sel] - th)), 0.0)
+
+        # objectness: positives at responsible cells; negatives
+        # everywhere except cells whose best decoded-box IoU with any gt
+        # exceeds ignore_thresh (reference CalcObjnessLoss ignore path)
+        obj_t = jnp.zeros((b, na, h, w)).at[sel].max(
+            jnp.where(valid, 1.0, 0.0))
+        sc = score[0] if score else jnp.ones_like(gw)
+        pos_w = jnp.zeros((b, na, h, w)).at[sel].max(
+            jnp.where(valid, sc, 0.0))
+        # decode predicted boxes (normalized) for the ignore mask
+        gxg = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gyg = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        pcx = (gxg + px) / w
+        pcy = (gyg + py) / h
+        pbw = jnp.exp(pw) * aw[None, :, None, None]
+        pbh = jnp.exp(ph) * ah[None, :, None, None]
+        px1, px2 = pcx - pbw / 2, pcx + pbw / 2
+        py1, py2 = pcy - pbh / 2, pcy + pbh / 2
+        gx1 = (boxes[:, :, 0] - gw / 2)      # [B, G]
+        gx2 = (boxes[:, :, 0] + gw / 2)
+        gy1 = (boxes[:, :, 1] - gh / 2)
+        gy2 = (boxes[:, :, 1] + gh / 2)
+        iw = jnp.clip(jnp.minimum(px2[..., None], gx2[:, None, None, None])
+                      - jnp.maximum(px1[..., None],
+                                    gx1[:, None, None, None]), 0, None)
+        ih = jnp.clip(jnp.minimum(py2[..., None], gy2[:, None, None, None])
+                      - jnp.maximum(py1[..., None],
+                                    gy1[:, None, None, None]), 0, None)
+        inter_p = iw * ih                    # [B, A, H, W, G]
+        union = (pbw * pbh)[..., None] + \
+            (gw * gh)[:, None, None, None] - inter_p
+        iou_p = jnp.where(valid[:, None, None, None], inter_p /
+                          jnp.clip(union, 1e-10, None), 0.0)
+        ignore = jnp.max(iou_p, -1) > ignore_thresh   # [B, A, H, W]
+        bce = jnp.maximum(obj_logit, 0) - obj_logit * obj_t + \
+            jnp.log1p(jnp.exp(-jnp.abs(obj_logit)))
+        neg = jnp.where(ignore, 0.0, bce)
+        loss_obj = jnp.sum(jnp.where(obj_t > 0, bce * pos_w, neg),
+                           axis=(1, 2, 3))
+
+        smooth = 1.0 / max(nc, 1) if use_label_smooth else 0.0
+        onehot = jax.nn.one_hot(labels[:, :, 0].astype(jnp.int32), nc)
+        onehot = onehot * (1 - 2 * smooth) + smooth
+        cl = jnp.transpose(cls_logit, (0, 1, 3, 4, 2))[sel]  # [B, G, C]
+        bce_c = jnp.maximum(cl, 0) - cl * onehot + \
+            jnp.log1p(jnp.exp(-jnp.abs(cl)))
+        loss_cls = jnp.where(valid, jnp.sum(bce_c, -1), 0.0)
+
+        per_img = jnp.sum(loss_xy + loss_wh + loss_cls, axis=1) + loss_obj
+        return per_img
+
+    return dispatch(f, args, name="yolo_loss")

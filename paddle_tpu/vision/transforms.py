@@ -11,7 +11,12 @@ import numpy as np
 __all__ = ["Compose", "ToTensor", "Normalize", "Resize", "RandomCrop",
            "CenterCrop", "RandomHorizontalFlip", "RandomVerticalFlip",
            "Transpose", "RandomResizedCrop", "Pad", "to_tensor", "normalize",
-           "resize", "hflip", "vflip", "center_crop", "crop"]
+           "resize", "hflip", "vflip", "center_crop", "crop", "BaseTransform", "BrightnessTransform", "ContrastTransform",
+           "SaturationTransform", "HueTransform", "ColorJitter",
+           "Grayscale", "RandomRotation", "RandomAffine",
+           "RandomPerspective", "RandomErasing", "adjust_brightness",
+           "adjust_contrast", "adjust_hue", "to_grayscale", "erase",
+           "affine", "rotate", "perspective"]
 
 
 class Compose:
@@ -226,3 +231,417 @@ class Pad:
         if arr.ndim == 3:
             pads = pads + ((0, 0),)
         return np.pad(arr, pads, constant_values=self.fill)
+
+
+# -- photometric + geometric long tail (reference:
+# python/paddle/vision/transforms/{transforms,functional}.py) ---------------
+def _as_float_chw(img):
+    """Accept HWC/CHW numpy or Tensor; return (float CHW array, restore)."""
+    from ..core.tensor import Tensor, to_value
+    was_tensor = isinstance(img, Tensor)
+    arr = np.asarray(to_value(img) if was_tensor else img)
+    was_hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4) and \
+        arr.shape[0] not in (1, 3, 4)
+    if was_hwc:
+        arr = arr.transpose(2, 0, 1)
+    was_uint8 = arr.dtype == np.uint8
+    out = arr.astype(np.float32) / (255.0 if was_uint8 else 1.0)
+
+    def restore(x):
+        x = np.clip(x, 0.0, 1.0)
+        if was_uint8:
+            x = (x * 255.0 + 0.5).astype(np.uint8)
+        if was_hwc:
+            x = x.transpose(1, 2, 0)
+        return Tensor(x) if was_tensor else x
+
+    return out, restore
+
+
+def adjust_brightness(img, brightness_factor):
+    """reference: transforms/functional.py adjust_brightness."""
+    arr, restore = _as_float_chw(img)
+    return restore(arr * brightness_factor)
+
+
+def adjust_contrast(img, contrast_factor):
+    """reference: transforms/functional.py adjust_contrast — blend with
+    the grayscale mean."""
+    arr, restore = _as_float_chw(img)
+    gray = arr.mean() if arr.shape[0] == 1 else \
+        (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2]).mean()
+    return restore(arr * contrast_factor + gray * (1 - contrast_factor))
+
+
+def _adjust_saturation(arr, factor):
+    gray = 0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2]
+    return arr * factor + gray[None] * (1 - factor)
+
+
+def adjust_hue(img, hue_factor):
+    """reference: transforms/functional.py adjust_hue — rotate the hue
+    channel in HSV by hue_factor (in [-0.5, 0.5] turns)."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr, restore = _as_float_chw(img)
+    if arr.shape[0] == 1:
+        return restore(arr)
+    r, g, b = arr[0], arr[1], arr[2]
+    maxc = np.maximum(np.maximum(r, g), b)
+    minc = np.minimum(np.minimum(r, g), b)
+    v = maxc
+    d = maxc - minc
+    s = np.where(maxc > 0, d / np.maximum(maxc, 1e-12), 0.0)
+    dd = np.maximum(d, 1e-12)
+    rc, gc, bc = (maxc - r) / dd, (maxc - g) / dd, (maxc - b) / dd
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = np.where(d == 0, 0.0, h)
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t_ = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    r2 = np.choose(i, [v, q, p, p, t_, v])
+    g2 = np.choose(i, [t_, v, v, q, p, p])
+    b2 = np.choose(i, [p, p, t_, v, v, q])
+    return restore(np.stack([r2, g2, b2]))
+
+
+def to_grayscale(img, num_output_channels=1):
+    """reference: transforms/functional.py to_grayscale."""
+    arr, restore = _as_float_chw(img)
+    gray = arr.mean(0, keepdims=True) if arr.shape[0] == 1 else \
+        (0.299 * arr[0] + 0.587 * arr[1] + 0.114 * arr[2])[None]
+    return restore(np.repeat(gray, num_output_channels, 0))
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """reference: transforms/functional.py erase — overwrite the [i:i+h,
+    j:j+w] patch with value v."""
+    from ..core.tensor import Tensor, to_value
+    was_tensor = isinstance(img, Tensor)
+    arr = np.array(to_value(img) if was_tensor else img, copy=True)
+    hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4) and \
+        arr.shape[0] not in (1, 3, 4)
+    vv = np.asarray(v, arr.dtype)
+    if hwc:
+        arr[i:i + h, j:j + w, :] = np.moveaxis(np.broadcast_to(
+            vv, (arr.shape[-1], h, w)), 0, -1) if vv.ndim else vv
+    else:
+        arr[..., i:i + h, j:j + w] = vv if vv.ndim == 0 else \
+            np.broadcast_to(vv, arr[..., i:i + h, j:j + w].shape)
+    return Tensor(arr) if was_tensor else arr
+
+
+def _affine_grid_sample(arr, matrix, fill=0.0):
+    """Inverse-warp CHW float array by a 2x3 affine matrix (output->input
+    coords, centered), bilinear."""
+    c, h, w = arr.shape
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float32),
+                         np.arange(w, dtype=np.float32), indexing="ij")
+    cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+    xs0 = xs - cx
+    ys0 = ys - cy
+    m = np.asarray(matrix, np.float32).reshape(2, 3)
+    sx = m[0, 0] * xs0 + m[0, 1] * ys0 + m[0, 2] + cx
+    sy = m[1, 0] * xs0 + m[1, 1] * ys0 + m[1, 2] + cy
+    x0 = np.floor(sx)
+    y0 = np.floor(sy)
+    wx = sx - x0
+    wy = sy - y0
+    out = np.zeros_like(arr)
+    total = np.zeros((h, w), np.float32)
+    acc = np.zeros((c, h, w), np.float32)
+    for dy in (0, 1):
+        for dx in (0, 1):
+            xi = (x0 + dx).astype(np.int32)
+            yi = (y0 + dy).astype(np.int32)
+            wgt = (wx if dx else 1 - wx) * (wy if dy else 1 - wy)
+            inside = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+            xi_c = np.clip(xi, 0, w - 1)
+            yi_c = np.clip(yi, 0, h - 1)
+            wgt = np.where(inside, wgt, 0.0)
+            acc += arr[:, yi_c, xi_c] * wgt[None]
+            total += wgt
+    out = acc + fill * (1.0 - total)[None]
+    return out
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    """reference: transforms/functional.py affine (inverse-matrix warp,
+    torchvision-compatible parameterization)."""
+    arr, restore = _as_float_chw(img)
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(np.asarray(shear, np.float32).reshape(-1)[:2]) \
+        if np.ndim(shear) else (np.deg2rad(shear), 0.0)
+    # forward matrix = T * R * Sh * S ; we need its inverse for sampling
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    fwd = np.asarray([[a * scale, b * scale, translate[0]],
+                      [c * scale, d * scale, translate[1]]], np.float32)
+    full = np.vstack([fwd, [0, 0, 1]])
+    inv = np.linalg.inv(full)[:2]
+    return restore(_affine_grid_sample(arr, inv, fill=float(fill)
+                                       if np.ndim(fill) == 0 else 0.0))
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """reference: transforms/functional.py rotate."""
+    return affine(img, angle=angle, fill=fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """reference: transforms/functional.py perspective — warp mapping
+    ``startpoints`` to ``endpoints`` (4 corner pairs)."""
+    arr, restore = _as_float_chw(img)
+    c, h, w = arr.shape
+    # solve the 8-dof homography endpoints -> startpoints (inverse map)
+    A, bvec = [], []
+    for (ex, ey), (sx_, sy_) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx_ * ex, -sx_ * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy_ * ex, -sy_ * ey])
+        bvec += [sx_, sy_]
+    coef = np.linalg.lstsq(np.asarray(A, np.float64),
+                           np.asarray(bvec, np.float64), rcond=None)[0]
+    H = np.append(coef, 1.0).reshape(3, 3).astype(np.float32)
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float32),
+                         np.arange(w, dtype=np.float32), indexing="ij")
+    den = H[2, 0] * xs + H[2, 1] * ys + H[2, 2]
+    sx_m = (H[0, 0] * xs + H[0, 1] * ys + H[0, 2]) / den
+    sy_m = (H[1, 0] * xs + H[1, 1] * ys + H[1, 2]) / den
+    xi = np.clip(np.round(sx_m), 0, w - 1).astype(np.int32)
+    yi = np.clip(np.round(sy_m), 0, h - 1).astype(np.int32)
+    inside = (sx_m >= 0) & (sx_m < w) & (sy_m >= 0) & (sy_m < h)
+    out = np.where(inside[None], arr[:, yi, xi], float(fill))
+    return restore(out)
+
+
+class BaseTransform:
+    """reference: transforms/transforms.py BaseTransform — keys-aware
+    callable base; subclasses implement _apply_image (and optionally
+    _apply_{boxes,mask})."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            return self._apply_image(inputs)
+        outs = []
+        for key, data in zip(self.keys, inputs):
+            fn = getattr(self, f"_apply_{key}", None)
+            outs.append(fn(data) if fn else data)
+        # elements beyond the declared keys pass through untouched
+        # (reference BaseTransform keeps (image, label) pairs intact)
+        outs.extend(inputs[len(self.keys):])
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class BrightnessTransform(BaseTransform):
+    """reference: BrightnessTransform — random factor in
+    [max(0,1-value), 1+value]."""
+
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        arr, restore = _as_float_chw(img)
+        return restore(_adjust_saturation(arr, f) if arr.shape[0] == 3
+                       else arr)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """reference: ColorJitter — random brightness/contrast/saturation/
+    hue in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if np.ndim(degrees) == 0:
+            degrees = (-float(degrees), float(degrees))
+        self.degrees = degrees
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if np.ndim(degrees) == 0:
+            degrees = (-float(degrees), float(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = np.asarray(img) if not hasattr(img, "shape") else img
+        h = arr.shape[-2] if np.ndim(arr) == 3 and np.shape(arr)[0] in \
+            (1, 3, 4) else np.shape(arr)[0]
+        w = arr.shape[-1] if np.ndim(arr) == 3 and np.shape(arr)[0] in \
+            (1, 3, 4) else np.shape(arr)[1]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0],
+                                   self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1],
+                                   self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = (np.random.uniform(-self.shear, self.shear)
+              if np.ndim(self.shear) == 0 and self.shear else 0.0)
+        return affine(img, angle=angle, translate=(tx, ty), scale=sc,
+                      shear=(sh, 0.0), fill=self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr, _ = _as_float_chw(img)
+        _, h, w = arr.shape
+        d = self.distortion_scale
+        dx, dy = int(d * w / 2), int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return perspective(img, start, end, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """reference: RandomErasing (Zhong et al. 2020)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr, _ = _as_float_chw(img)
+        _, h, w = arr.shape
+        area = h * w
+        for _attempt in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                if self.value == "random":
+                    c = arr.shape[0]
+                    noise = np.random.rand(c, eh, ew)
+                    src = np.asarray(img) if not hasattr(img, "numpy") \
+                        else img
+                    dt = np.asarray(src).dtype \
+                        if hasattr(src, "dtype") else np.float32
+                    v = (noise * 255).astype(np.uint8) \
+                        if dt == np.uint8 else noise.astype(np.float32)
+                else:
+                    v = self.value
+                return erase(img, i, j, eh, ew, v)
+        return img

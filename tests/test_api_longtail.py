@@ -554,3 +554,276 @@ class TestReviewRegressions:
                                                causal=True)
         assert np.asarray(out.numpy()).shape == (T, Hk, D)
         assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+class TestRegistrySweep2:
+    """Round-4 second sweep: jit/profiler/inference/incubate/text/
+    transforms/vision.ops/initializer/autograd closures."""
+
+    def test_saved_tensors_hooks_pack_unpack(self):
+        from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+        packed, unpacked = [], []
+
+        class Sq(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, dy):
+                (x,) = ctx.saved_tensor()
+                return dy * 2.0 * x
+
+        def pack(t_):
+            packed.append(t_)
+            return ("box", t_)
+
+        def unpack(obj):
+            unpacked.append(obj)
+            return obj[1]
+
+        x = t(np.array([3.0], np.float32), sg=False)
+        with saved_tensors_hooks(pack, unpack):
+            y = Sq.apply(x)
+        y.backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), [6.0])
+        assert len(packed) == 1 and len(unpacked) == 1
+
+    def test_lookahead_trains(self):
+        from paddle_tpu.incubate import LookAhead
+        net = nn.Linear(4, 1)
+        inner = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+        opt = LookAhead(inner, alpha=0.5, k=2)
+        rng = np.random.RandomState(0)
+        X = t(rng.randn(16, 4).astype(np.float32))
+        Y = t(rng.randn(16, 1).astype(np.float32))
+        losses = []
+        for _ in range(8):
+            loss = ((net(X) - Y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_model_average_apply_restore(self):
+        from paddle_tpu.incubate import ModelAverage
+        p = paddle.create_parameter([2], "float32")
+        p.set_value(t(np.array([4.0, 4.0], np.float32)))
+        ma = ModelAverage(0.5, parameters=[p], min_average_window=1,
+                          max_average_window=1)
+        ma.step()   # window 1 -> average == current value
+        p.set_value(t(np.array([9.0, 9.0], np.float32)))
+        with ma.apply():
+            np.testing.assert_allclose(np.asarray(p.numpy()), [4.0, 4.0])
+        np.testing.assert_allclose(np.asarray(p.numpy()), [9.0, 9.0])
+
+    def test_incubate_graph_aliases(self):
+        import paddle_tpu.incubate as inc
+        out = inc.graph_send_recv(
+            t(np.eye(3, dtype=np.float32)), np.array([0, 1]),
+            np.array([1, 2]), "sum")
+        assert np.asarray(out.numpy()).shape == (3, 3)
+        sm = inc.softmax_mask_fuse_upper_triangle(
+            t(np.zeros((1, 1, 4, 4), np.float32)))
+        arr = np.asarray(sm.numpy())[0, 0]
+        assert arr[0, 1] == 0.0 and abs(arr[3].sum() - 1.0) < 1e-5
+
+    def test_text_datasets_gated(self):
+        from paddle_tpu.text import (Conll05st, Imikolov, Movielens,
+                                     WMT14, WMT16)
+        for cls in (Conll05st, Imikolov, Movielens, WMT14, WMT16):
+            with pytest.raises(RuntimeError, match="local"):
+                cls()
+
+    def test_transforms_photometric(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(0).rand(3, 8, 8) * 255).astype(
+            np.uint8)
+        br = T.adjust_brightness(img, 2.0)
+        assert br.dtype == np.uint8 and br.mean() >= img.mean()
+        gray = T.to_grayscale(img, 3)
+        assert gray.shape == (3, 8, 8)
+        np.testing.assert_array_equal(gray[0], gray[1])
+        # hue rotation by 0 is identity (up to rounding)
+        same = T.adjust_hue(img, 0.0)
+        np.testing.assert_allclose(same.astype(int), img.astype(int),
+                                   atol=2)
+
+    def test_transforms_geometric(self):
+        from paddle_tpu.vision import transforms as T
+        img = np.zeros((1, 9, 9), np.float32)
+        img[0, 4, 6] = 1.0   # point right of center
+        rot = T.rotate(img, 90)
+        # 90-degree rotation moves it above/below center
+        iy, ix = np.unravel_index(np.argmax(rot[0]), rot[0].shape)
+        assert (iy, ix) != (4, 6) and rot.max() > 0.4
+        er = T.erase(img, 3, 5, 3, 3, 0.0)
+        assert er[0, 4, 6] == 0.0
+        out = T.RandomErasing(prob=1.0)(img)
+        assert out.shape == img.shape
+
+    def test_colorjitter_pipeline(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(1).rand(8, 8, 3) * 255).astype(
+            np.uint8)   # HWC input path
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img)
+        assert out.shape == (8, 8, 3) and out.dtype == np.uint8
+
+    def test_vision_ops_layers(self):
+        from paddle_tpu.vision.ops import RoIAlign, DeformConv2D
+        x = t(np.random.RandomState(0).randn(1, 4, 16, 16)
+              .astype(np.float32))
+        boxes = t(np.array([[2.0, 2.0, 10.0, 10.0]], np.float32))
+        out = RoIAlign(output_size=4)(x, boxes, t(np.array([1])))
+        assert list(out.shape) == [1, 4, 4, 4]
+        dc = DeformConv2D(4, 8, 3, padding=1)
+        offset = t(np.zeros((1, 18, 16, 16), np.float32))
+        out2 = dc(x, offset)
+        assert list(out2.shape) == [1, 8, 16, 16]
+
+    def test_read_file_decode_jpeg(self, tmp_path):
+        from paddle_tpu.vision.ops import decode_jpeg, read_file
+        from PIL import Image
+        arr = (np.random.RandomState(0).rand(10, 12, 3) * 255).astype(
+            np.uint8)
+        p = str(tmp_path / "img.jpg")
+        Image.fromarray(arr).save(p, quality=95)
+        data = read_file(p)
+        img = decode_jpeg(data)
+        got = np.asarray(img.numpy())
+        assert got.shape == (3, 10, 12)
+        assert np.abs(got.astype(int).mean() - arr.mean()) < 12  # lossy
+
+    def test_yolo_loss_finite_and_trains(self):
+        from paddle_tpu.vision.ops import yolo_loss
+        rng = np.random.RandomState(0)
+        B, A, C, H, W = 2, 3, 4, 8, 8
+        x = t(rng.randn(B, A * (5 + C), H, W).astype(np.float32) * 0.1,
+              sg=False)
+        gt_box = t(np.array([[[0.5, 0.5, 0.3, 0.4], [0, 0, 0, 0]]] * B,
+                            np.float32))
+        gt_label = t(np.array([[[1], [0]]] * B, np.int32))
+        loss = yolo_loss(x, gt_box, gt_label,
+                         anchors=[10, 13, 16, 30, 33, 23],
+                         anchor_mask=[0, 1, 2], class_num=C,
+                         ignore_thresh=0.7, downsample_ratio=32)
+        assert np.isfinite(np.asarray(loss.numpy())).all()
+        loss.sum().backward()
+        assert np.isfinite(np.asarray(x.grad.numpy())).all()
+
+    def test_initializer_bilinear_and_global(self):
+        import paddle_tpu.nn.initializer as I
+        w = I.Bilinear()((2, 2, 4, 4), "float32")
+        arr = np.asarray(w)
+        assert arr.shape == (2, 2, 4, 4)
+        np.testing.assert_allclose(arr[0, 0], arr[1, 1])
+        assert arr[0, 0, 1, 1] > arr[0, 0, 0, 0]   # peaks at center
+        I.set_global_initializer(I.Constant(3.0), I.Constant(-1.0))
+        try:
+            lin = nn.Linear(2, 2)
+            assert (np.asarray(lin.weight.numpy()) == 3.0).all()
+            assert (np.asarray(lin.bias.numpy()) == -1.0).all()
+        finally:
+            I.set_global_initializer(None, None)
+
+    def test_inference_enums_and_version(self):
+        import paddle_tpu.inference as inf
+        assert inf.DataType.FLOAT32 == 0
+        assert inf.get_num_bytes_of_data_type(inf.DataType.INT64) == 8
+        assert inf.get_trt_compile_version() == (0, 0, 0)
+        assert "3.0" in inf.get_version()
+
+    def test_jit_profiler_shims(self):
+        paddle.jit.set_verbosity(2)
+        paddle.jit.set_code_level(5)
+        from paddle_tpu.profiler import SortedKeys, SummaryView
+        assert SortedKeys.CPUTotal == 0 and SummaryView.KernelView == 4
+
+    def test_utils_deprecated_require_version(self):
+        import warnings
+        from paddle_tpu.utils import deprecated, require_version
+
+        @deprecated(update_to="new_fn", since="2.0")
+        def old_fn():
+            return 42
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old_fn() == 42
+        assert any("deprecated" in str(x.message) for x in w)
+        require_version("2.0")
+        with pytest.raises(Exception):
+            require_version("99.0")
+
+
+@pytest.mark.slow
+class TestModelZooExtra:
+    def test_forwards(self):
+        from paddle_tpu.vision import models as M
+        x = t(np.random.RandomState(0).randn(1, 3, 64, 64)
+              .astype(np.float32))
+        for fn in (M.squeezenet1_0, M.shufflenet_v2_x0_5,
+                   lambda **k: M.mobilenet_v3_large(scale=0.35, **k)):
+            out = fn(num_classes=6)(x)
+            assert list(out.shape) == [1, 6]
+
+    def test_vgg_variants_and_pretrained_gate(self):
+        from paddle_tpu.vision import models as M
+        assert M.vgg11 is not None and M.vgg13 is not None
+        with pytest.raises(ValueError, match="pretrained"):
+            M.alexnet(pretrained=True)
+
+    def test_densenet_variant_channels(self):
+        from paddle_tpu.vision import models as M
+        net = M.densenet169(num_classes=3)
+        x = t(np.random.RandomState(1).randn(1, 3, 64, 64)
+              .astype(np.float32))
+        assert list(net(x).shape) == [1, 3]
+
+
+class TestSweep2ReviewRegressions:
+    def test_transform_tuple_passthrough(self):
+        from paddle_tpu.vision import transforms as T
+        img = (np.random.RandomState(0).rand(3, 8, 8) * 255).astype(
+            np.uint8)
+        out = T.ColorJitter(0.4)((img, 7))
+        assert isinstance(out, tuple) and len(out) == 2
+        assert out[1] == 7   # label survives
+
+    def test_model_average_true_average(self):
+        from paddle_tpu.incubate import ModelAverage
+        p = paddle.create_parameter([1], "float32")
+        ma = ModelAverage(1.0, parameters=[p], min_average_window=100,
+                          max_average_window=100)
+        for v in (2.0, 4.0, 6.0):
+            p.set_value(t(np.array([v], np.float32)))
+            ma.step()
+        with ma.apply():
+            # TRUE mean of {2, 4, 6}, not a zero-initialized EMA
+            np.testing.assert_allclose(np.asarray(p.numpy()), [4.0],
+                                       rtol=1e-6)
+
+    def test_yolo_ignore_thresh_suppresses_negative_loss(self):
+        from paddle_tpu.vision.ops import yolo_loss
+        rng = np.random.RandomState(3)
+        B, A, C, H, W = 1, 3, 2, 4, 4
+        x = rng.randn(B, A * (5 + C), H, W).astype(np.float32) * 0.1
+        gt_box = np.array([[[0.5, 0.5, 0.4, 0.4]]], np.float32)
+        gt_label = np.array([[[1]]], np.int32)
+        kw = dict(anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+                  class_num=C, downsample_ratio=32)
+        hi = yolo_loss(t(x), t(gt_box), t(gt_label), ignore_thresh=0.99,
+                       **kw)
+        lo = yolo_loss(t(x), t(gt_box), t(gt_label), ignore_thresh=0.0,
+                       **kw)
+        # thresh=0 ignores every overlapping cell -> strictly less
+        # negative-objectness loss than thresh=0.99
+        assert float(np.asarray(lo.numpy()).sum()) < \
+            float(np.asarray(hi.numpy()).sum())
+
+    def test_wmt16_lang_validated(self):
+        from paddle_tpu.text import WMT16
+        with pytest.raises(ValueError, match="lang"):
+            WMT16(data_file=None, lang="fr")

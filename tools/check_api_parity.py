@@ -78,7 +78,32 @@ def main():
              "paddle_tpu.optimizer"),
             ("python/paddle/io/__init__.py", "paddle_tpu.io"),
             ("python/paddle/distributed/__init__.py",
-             "paddle_tpu.distributed")]:
+             "paddle_tpu.distributed"),
+            ("python/paddle/audio/__init__.py", "paddle_tpu.audio"),
+            ("python/paddle/audio/functional/__init__.py",
+             "paddle_tpu.audio.functional"),
+            ("python/paddle/jit/__init__.py", "paddle_tpu.jit"),
+            ("python/paddle/profiler/__init__.py",
+             "paddle_tpu.profiler"),
+            ("python/paddle/nn/initializer/__init__.py",
+             "paddle_tpu.nn.initializer"),
+            ("python/paddle/vision/transforms/__init__.py",
+             "paddle_tpu.vision.transforms"),
+            ("python/paddle/vision/ops.py", "paddle_tpu.vision.ops"),
+            ("python/paddle/vision/models/__init__.py",
+             "paddle_tpu.vision.models"),
+            ("python/paddle/autograd/__init__.py",
+             "paddle_tpu.autograd"),
+            ("python/paddle/framework/__init__.py",
+             "paddle_tpu.framework"),
+            ("python/paddle/regularizer.py", "paddle_tpu.regularizer"),
+            ("python/paddle/inference/__init__.py",
+             "paddle_tpu.inference"),
+            ("python/paddle/onnx/__init__.py", "paddle_tpu.onnx"),
+            ("python/paddle/utils/__init__.py", "paddle_tpu.utils"),
+            ("python/paddle/incubate/__init__.py",
+             "paddle_tpu.incubate"),
+            ("python/paddle/text/__init__.py", "paddle_tpu.text")]:
         path = os.path.join(REF, ref_py)
         if not os.path.exists(path):
             continue
