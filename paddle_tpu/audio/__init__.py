@@ -1,3 +1,4 @@
 """paddle_tpu.audio (reference: python/paddle/audio)."""
 from . import backends, features, functional  # noqa: F401
 from .backends import load, save, info  # noqa: F401
+from . import datasets  # noqa: F401

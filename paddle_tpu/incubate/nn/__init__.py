@@ -1,1 +1,5 @@
 from . import functional  # noqa: F401
+from .layers import (FusedLinear, FusedDropoutAdd,  # noqa: F401,E402
+                     FusedBiasDropoutResidualLayerNorm, FusedFeedForward,
+                     FusedMultiHeadAttention, FusedMultiTransformer,
+                     FusedTransformerEncoderLayer)

@@ -5,6 +5,7 @@ variable_length_memory_efficient_attention, block_multihead_attention, …).
 On TPU these route to the ops/ pack (Pallas kernels + XLA compositions)."""
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 
 from ....core.tensor import Tensor, dispatch
@@ -177,3 +178,221 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
 
 from .fp8 import (quantize_fp8, dequantize_fp8, fp8_gemm,  # noqa: F401,E402
                   fp8_linear)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """reference: incubate/nn/functional/fused_matmul_bias — one fused
+    GEMM+bias (XLA fuses the bias add into the matmul epilogue)."""
+    def f(a, b, *bb):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2)
+        out = a @ b
+        return out + bb[0] if bb else out
+
+    args = (_ensure(x), _ensure(y)) + ((_ensure(bias),)
+                                       if bias is not None else ())
+    return dispatch(f, args, name="fused_matmul_bias")
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """reference: fused_linear_activation — GEMM + bias + epilogue act."""
+    import jax
+    out = fused_matmul_bias(x, y, bias, trans_x, trans_y)
+    acts = {"gelu": lambda v: jax.nn.gelu(v, approximate=True),
+            "relu": lambda v: jnp.maximum(v, 0),
+            "none": lambda v: v}
+    if activation not in acts:
+        raise ValueError(f"unsupported activation {activation}")
+    return dispatch(acts[activation], (out,), name="fused_act")
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """reference: incubate/nn/functional/fused_transformer.py
+    fused_bias_dropout_residual_layer_norm:
+    LN(residual + dropout(x + bias))."""
+    from ....nn.functional.common import dropout
+    from ....nn.functional.norm import layer_norm
+    h = x if bias is None else x + _ensure(bias)
+    h = dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = h + _ensure(residual)
+    d = h.shape[-1]
+    return layer_norm(h, (d,), weight=ln_scale, bias=ln_bias,
+                      epsilon=ln_epsilon)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode=
+                      "upscale_in_train", name=None):
+    """reference: fused_transformer.py fused_feedforward —
+    residual + dropout2(linear2(dropout1(act(linear1(maybe_ln(x))))))
+    with pre- or post-LN."""
+    from ....nn.functional.common import dropout
+    from ....nn.functional.norm import layer_norm
+    residual = x
+    d = x.shape[-1]
+    if pre_layer_norm:
+        x = layer_norm(x, (d,), weight=ln1_scale, bias=ln1_bias,
+                       epsilon=ln1_epsilon)
+    h = fused_linear_activation(x, linear1_weight, linear1_bias,
+                                activation=activation)
+    h = dropout(h, p=dropout1_rate, training=training, mode=mode)
+    h = fused_matmul_bias(h, linear2_weight, linear2_bias)
+    h = dropout(h, p=dropout2_rate, training=training, mode=mode)
+    out = _ensure(residual) + h
+    if not pre_layer_norm:
+        out = layer_norm(out, (d,), weight=ln2_scale, bias=ln2_bias,
+                         epsilon=ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(
+        x, qkv_weight, linear_weight, pre_layer_norm=False,
+        pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
+        pre_ln_epsilon=1e-5, qkv_bias=None, linear_bias=None,
+        cache_kv=None, attn_mask=None, dropout_rate=0.5,
+        attn_dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", ring_id=-1, add_residual=True,
+        num_heads=None, name=None):
+    """reference: fused_transformer.py fused_multi_head_attention —
+    the whole MHA block (optional pre-LN, packed QKV GEMM, SDPA,
+    out-projection, dropout, residual, optional post-LN) as one
+    composition XLA fuses. qkv_weight [3, H, D, hidden]."""
+    import jax
+    from ....nn.functional.common import dropout
+    from ....nn.functional.norm import layer_norm
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention: cache_kv decode is served by "
+            "paddle_tpu.inference's compiled generate/paged path")
+    residual = x
+    hid = x.shape[-1]
+    if pre_layer_norm:
+        x = layer_norm(x, (hid,), weight=pre_ln_scale, bias=pre_ln_bias,
+                       epsilon=pre_ln_epsilon)
+    qkv_w = _ensure(qkv_weight)
+    args = (_ensure(x), qkv_w) + \
+        ((_ensure(qkv_bias),) if qkv_bias is not None else ()) + \
+        ((_ensure(attn_mask),) if attn_mask is not None else ())
+    has_bias = qkv_bias is not None
+    has_mask = attn_mask is not None
+
+    def attn(xv, wv, *rest):
+        b, s, _ = xv.shape
+        three, nh, hd, _ = wv.shape
+        qkv = jnp.einsum("bsd,thed->bsthe", xv, wv)   # [B,S,3,H,hd]
+        if has_bias:
+            qkv = qkv + rest[0]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        score = jnp.einsum("bshe,bthe->bhst", q, k) / np.sqrt(hd)
+        if has_mask:
+            score = score + rest[-1]
+        p = jax.nn.softmax(score, -1)
+        out = jnp.einsum("bhst,bthe->bshe", p, v)
+        return out.reshape(b, s, nh * hd)
+
+    ctx = dispatch(attn, args, name="fused_mha_core")
+    ctx = dropout(ctx, p=attn_dropout_rate, training=training, mode=mode)
+    out = fused_matmul_bias(ctx, linear_weight, linear_bias)
+    out = dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = _ensure(residual) + out
+    if not pre_layer_norm:
+        out = layer_norm(out, (hid,), weight=ln_scale, bias=ln_bias,
+                         epsilon=ln_epsilon)
+    return out
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, pre_caches=None, seq_lens=None,
+        rotary_embs=None, rotary_emb_dims=0, time_step=None,
+        attn_mask=None, dropout_rate=0.0, activation="gelu",
+        training=False, mode="upscale_in_train", trans_qkvw=True,
+        ring_id=-1, name=None):
+    """reference: fused_transformer.py fused_multi_transformer — an
+    N-layer pre-LN decoder stack in one call (the serving fast path;
+    phi/kernels/fusion/gpu/fused_multi_transformer_*). Composes the
+    per-layer fused MHA/FFN above; the compiled-generate path in
+    paddle_tpu.inference covers the cached-decode use."""
+    if cache_kvs is not None or pre_caches is not None or \
+            time_step is not None or rotary_embs is not None:
+        raise NotImplementedError(
+            "fused_multi_transformer: cached/rotary decode is served by "
+            "paddle_tpu.inference's compiled generate/paged path")
+    if not trans_qkvw:
+        raise NotImplementedError(
+            "fused_multi_transformer: trans_qkvw=False layout not "
+            "supported (pass [3, H, head_dim, hidden] weights)")
+    out = x
+    n_layers = len(qkv_weights)
+    for i in range(n_layers):
+        out = fused_multi_head_attention(
+            out, qkv_weights[i],
+            linear_weights[i], pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_scales[i],
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, pre_ln_epsilon=epsilon,
+            training=training, mode=mode)
+        out = fused_feedforward(
+            out, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i],
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, ln1_epsilon=epsilon,
+            pre_layer_norm=pre_layer_norm, training=training, mode=mode)
+    return out
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0):
+    """reference: incubate/nn/memory_efficient_attention.py varlen form
+    — q/k/v [B, H, S, D] with per-example valid lengths; invalid
+    positions masked out of the softmax."""
+    import jax
+    q, k, v = _ensure(query), _ensure(key), _ensure(value)
+    sl, kl = _ensure(seq_lens), _ensure(kv_seq_lens)
+    args = (q, k, v, sl, kl) + ((_ensure(mask),)
+                                if mask is not None else ())
+    has_mask = mask is not None
+
+    def f(qv, kv, vv, slv, klv, *m):
+        b, h, sq, d = qv.shape
+        sk = kv.shape[2]
+        sc = scale if scale is not None else 1.0 / np.sqrt(d)
+        score = jnp.einsum("bhsd,bhtd->bhst", qv.astype(jnp.float32),
+                           kv.astype(jnp.float32)) * sc
+        if has_mask:
+            score = score + m[0]
+        live_q = jnp.arange(sq)[None, :] < slv.reshape(b, 1)
+        live_k = jnp.arange(sk)[None, :] < klv.reshape(b, 1)
+        score = jnp.where(live_k[:, None, None, :], score, -1e30)
+        if causal:
+            score = jnp.where(
+                jnp.tril(jnp.ones((sq, sk), bool))[None, None],
+                score, -1e30)
+        p = jax.nn.softmax(score, -1)
+        out = jnp.einsum("bhst,bhtd->bhsd", p,
+                         vv.astype(jnp.float32))
+        out = jnp.where(live_q[:, None, :, None], out, 0.0)
+        return out.astype(qv.dtype)
+
+    return dispatch(f, args, name="varlen_mem_efficient_attention")

@@ -1,0 +1,249 @@
+"""Incubate fused layers (reference: python/paddle/incubate/nn/layer/
+fused_transformer.py + fused_dropout_add.py) — parameter-holding
+wrappers over the fused functionals; on TPU the "fusion" is XLA's,
+applied to the single composed program each functional builds."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn import Layer
+from . import functional as F
+
+
+class FusedLinear(Layer):
+    """reference: FusedLinear — GEMM + bias epilogue."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        shape = [out_features, in_features] if transpose_weight \
+            else [in_features, out_features]
+        self.weight = self.create_parameter(shape, attr=weight_attr)
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                          is_bias=True)
+        self.transpose_weight = transpose_weight
+
+    def forward(self, x):
+        return F.fused_matmul_bias(x, self.weight, self.bias,
+                                   transpose_y=self.transpose_weight)
+
+
+class FusedDropoutAdd(Layer):
+    """reference: FusedDropoutAdd — y + dropout(x)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        return F.fused_dropout_add(x, y, p=self.p,
+                                   training=self.training,
+                                   mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """reference: FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        self.linear_bias = self.create_parameter([embed_dim],
+                                                 attr=bias_attr,
+                                                 is_bias=True)
+        from ...nn import initializer as I
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+
+    def forward(self, x, residual):
+        return F.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self.dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """reference: FusedFeedForward."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+        self.linear1_weight = self.create_parameter(
+            [d_model, dim_feedforward], attr=linear1_weight_attr)
+        self.linear1_bias = self.create_parameter(
+            [dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear2_weight = self.create_parameter(
+            [dim_feedforward, d_model], attr=linear2_weight_attr)
+        self.linear2_bias = self.create_parameter(
+            [d_model], attr=linear2_bias_attr, is_bias=True)
+        one = I.Constant(1.0)
+        self.ln1_scale = self.create_parameter(
+            [d_model], default_initializer=one)
+        self.ln1_bias = self.create_parameter([d_model], is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            [d_model], default_initializer=one)
+        self.ln2_bias = self.create_parameter([d_model], is_bias=True)
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = act_dropout_rate \
+            if act_dropout_rate is not None else dropout_rate
+        self.activation = activation
+        self.epsilon = epsilon
+        self.normalize_before = normalize_before
+
+    def forward(self, x):
+        return F.fused_feedforward(
+            x, self.linear1_weight, self.linear2_weight,
+            linear1_bias=self.linear1_bias,
+            linear2_bias=self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate,
+            activation=self.activation, ln1_epsilon=self.epsilon,
+            ln2_epsilon=self.epsilon,
+            pre_layer_norm=self.normalize_before,
+            training=self.training)
+
+
+class FusedMultiHeadAttention(Layer):
+    """reference: FusedMultiHeadAttention — packed-QKV MHA block."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must divide num_heads")
+        hd = embed_dim // num_heads
+        self.num_heads = num_heads
+        self.qkv_weight = self.create_parameter(
+            [3, num_heads, hd, embed_dim], attr=qkv_weight_attr)
+        self.qkv_bias = self.create_parameter(
+            [3, num_heads, hd], attr=qkv_bias_attr, is_bias=True)
+        self.linear_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=linear_weight_attr)
+        self.linear_bias = self.create_parameter(
+            [embed_dim], attr=linear_bias_attr, is_bias=True)
+        one = I.Constant(1.0)
+        self.pre_ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=one)
+        self.pre_ln_bias = self.create_parameter([embed_dim],
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=one)
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return F.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale,
+            pre_ln_bias=self.pre_ln_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training,
+            num_heads=self.num_heads)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference: FusedTransformerEncoderLayer — fused MHA + fused FFN."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate
+            if attn_dropout_rate is not None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))
+
+
+class FusedMultiTransformer(Layer):
+    """reference: FusedMultiTransformer — N pre-LN decoder layers via
+    the fused_multi_transformer functional."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu",
+                 normalize_before=True, num_layers=1, nranks=1,
+                 trans_qkvw=True, ring_id=-1, name=None, **kwargs):
+        super().__init__()
+        from ...nn import initializer as I
+        if not normalize_before:
+            raise NotImplementedError(
+                "FusedMultiTransformer is pre-LN only (reference "
+                "fused_transformer.py asserts normalize_before)")
+        hd = embed_dim // num_heads
+        one = I.Constant(1.0)
+        self.num_layers = num_layers
+        (self.ln_scales, self.ln_biases, self.qkv_weights,
+         self.qkv_biases, self.linear_weights, self.linear_biases,
+         self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+         self.ffn1_biases, self.ffn2_weights, self.ffn2_biases) = \
+            ([] for _ in range(12))
+        for i in range(num_layers):
+            mk = self.create_parameter
+            self.ln_scales.append(mk([embed_dim],
+                                     default_initializer=one))
+            self.ln_biases.append(mk([embed_dim], is_bias=True))
+            self.qkv_weights.append(mk([3, num_heads, hd, embed_dim]))
+            self.qkv_biases.append(mk([3, num_heads, hd], is_bias=True))
+            self.linear_weights.append(mk([embed_dim, embed_dim]))
+            self.linear_biases.append(mk([embed_dim], is_bias=True))
+            self.ffn_ln_scales.append(mk([embed_dim],
+                                         default_initializer=one))
+            self.ffn_ln_biases.append(mk([embed_dim], is_bias=True))
+            self.ffn1_weights.append(mk([embed_dim, dim_feedforward]))
+            self.ffn1_biases.append(mk([dim_feedforward], is_bias=True))
+            self.ffn2_weights.append(mk([dim_feedforward, embed_dim]))
+            self.ffn2_biases.append(mk([embed_dim], is_bias=True))
+            for j, lst in enumerate((
+                    self.ln_scales, self.ln_biases, self.qkv_weights,
+                    self.qkv_biases, self.linear_weights,
+                    self.linear_biases, self.ffn_ln_scales,
+                    self.ffn_ln_biases, self.ffn1_weights,
+                    self.ffn1_biases, self.ffn2_weights,
+                    self.ffn2_biases)):
+                self.add_parameter(f"l{i}_p{j}", lst[i])
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        return F.fused_multi_transformer(
+            src, self.ln_scales, self.ln_biases, self.qkv_weights,
+            self.qkv_biases, self.linear_weights, self.linear_biases,
+            self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
+            self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
+            pre_layer_norm=True, attn_mask=attn_mask,
+            dropout_rate=self.dropout_rate, activation=self.activation,
+            training=self.training)

