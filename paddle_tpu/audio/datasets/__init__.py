@@ -50,7 +50,8 @@ class _WavFolderDataset(Dataset):
                 f"{type(self).__name__} needs a local corpus directory "
                 "(no download in this environment); pass data_dir=")
         all_files = []
-        for root, _, names in os.walk(data_dir):
+        for root, dirs, names in os.walk(data_dir):
+            dirs.sort()   # deterministic fold assignment across machines
             for n in sorted(names):
                 if n.lower().endswith(".wav"):
                     lab = self._label_of(n, root)
@@ -77,16 +78,29 @@ class _WavFolderDataset(Dataset):
     def __len__(self):
         return len(self.files)
 
+    def _feature_layer(self, sr):
+        # cached per (feat_type, sr): filterbank/DCT construction must
+        # not run per sample in the data-loading hot path
+        key = (self.feat_type, sr)
+        cache = getattr(self, "_feat_cache", None)
+        if cache is None:
+            cache = self._feat_cache = {}
+        if key not in cache:
+            from .. import features as AF
+            ext = {"mfcc": AF.MFCC, "melspectrogram": AF.MelSpectrogram,
+                   "logmelspectrogram": AF.LogMelSpectrogram}
+            if self.feat_type == "spectrogram":
+                cache[key] = AF.Spectrogram()   # sr-independent
+            else:
+                cache[key] = ext[self.feat_type](sr=sr)
+        return cache[key]
+
     def __getitem__(self, idx):
         wav, sr = _load_wav(self.files[idx])
         feat = wav
         if self.feat_type != "raw":
-            from .. import features as AF
             import paddle_tpu as paddle
-            ext = {"mfcc": AF.MFCC, "spectrogram": AF.Spectrogram,
-                   "melspectrogram": AF.MelSpectrogram,
-                   "logmelspectrogram": AF.LogMelSpectrogram}
-            layer = ext[self.feat_type](sr=sr)
+            layer = self._feature_layer(sr)
             feat = np.asarray(layer(
                 paddle.to_tensor(wav[None])).numpy())[0]
         return feat, np.int64(self.labels[idx])

@@ -1,0 +1,15 @@
+"""Import-path alias (reference:
+python/paddle/distributed/fleet/meta_parallel/__init__.py) — ported
+scripts do ``from paddle.distributed.fleet.meta_parallel import
+ColumnParallelLinear, PipelineLayer, ...``; the implementations live in
+mp_layers / pipeline_parallel / sequence_parallel_utils / random here.
+"""
+from .mp_layers import (VocabParallelEmbedding,  # noqa: F401
+                        ColumnParallelLinear, RowParallelLinear,
+                        ParallelCrossEntropy)
+from .pipeline_parallel import (LayerDesc, PipelineLayer,  # noqa: F401
+                                PipelineParallel,
+                                PipelineParallelWithInterleave,
+                                SharedLayerDesc)
+from .random import get_rng_state_tracker, RNGStatesTracker  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401

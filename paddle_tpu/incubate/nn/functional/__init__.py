@@ -368,6 +368,11 @@ def variable_length_memory_efficient_attention(
     — q/k/v [B, H, S, D] with per-example valid lengths; invalid
     positions masked out of the softmax."""
     import jax
+    if pre_cache_length:
+        raise NotImplementedError(
+            "variable_length_memory_efficient_attention: "
+            "pre_cache_length != 0 is served by the paged/compiled "
+            "decode path in paddle_tpu.inference")
     q, k, v = _ensure(query), _ensure(key), _ensure(value)
     sl, kl = _ensure(seq_lens), _ensure(kv_seq_lens)
     args = (q, k, v, sl, kl) + ((_ensure(mask),)
@@ -386,9 +391,12 @@ def variable_length_memory_efficient_attention(
         live_k = jnp.arange(sk)[None, :] < klv.reshape(b, 1)
         score = jnp.where(live_k[:, None, None, :], score, -1e30)
         if causal:
-            score = jnp.where(
-                jnp.tril(jnp.ones((sq, sk), bool))[None, None],
-                score, -1e30)
+            # bottom-right-aligned causal: query i sees key j iff
+            # j <= i + (sk - sq) (correct when sq != sk, e.g. decode)
+            rows = jnp.arange(sq)[:, None]
+            cols = jnp.arange(sk)[None, :]
+            score = jnp.where((cols <= rows + (sk - sq))[None, None],
+                              score, -1e30)
         p = jax.nn.softmax(score, -1)
         out = jnp.einsum("bhst,bhtd->bhsd", p,
                          vv.astype(jnp.float32))

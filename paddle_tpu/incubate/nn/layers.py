@@ -154,6 +154,16 @@ class FusedMultiHeadAttention(Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention: cached decode is served by "
+                "paddle_tpu.inference's compiled generate/paged path")
+        if (key is not None and key is not query) or \
+                (value is not None and value is not query):
+            raise NotImplementedError(
+                "FusedMultiHeadAttention packs QKV from one input "
+                "(self-attention); use nn.MultiHeadAttention for "
+                "cross-attention")
         return F.fused_multi_head_attention(
             query, self.qkv_weight, self.linear_weight,
             pre_layer_norm=self.normalize_before,

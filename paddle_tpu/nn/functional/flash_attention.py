@@ -1,0 +1,13 @@
+"""Import-path alias (reference:
+python/paddle/nn/functional/flash_attention.py) — ported scripts do
+``from paddle.nn.functional.flash_attention import flash_attention``;
+the implementations live in nn/functional/attention.py here."""
+from .attention import (flash_attention,  # noqa: F401
+                        flash_attn_qkvpacked, flash_attn_unpadded,
+                        flash_attn_varlen_qkvpacked,
+                        flashmask_attention,
+                        scaled_dot_product_attention,
+                        sparse_attention)
+
+# reference spells the varlen entry both ways across releases
+flash_attn_varlen_func = flash_attn_unpadded

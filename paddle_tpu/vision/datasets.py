@@ -145,9 +145,10 @@ def _default_loader(path):
 
 
 class Cifar100(Cifar10):
-    """reference: vision/datasets/cifar.py Cifar100 — 100-class variant
-    (synthetic stand-in sized like the real split; pass a local pickle
-    via Cifar10-style data_file to use real data)."""
+    """reference: vision/datasets/cifar.py Cifar100 — 100-class variant.
+    Synthetic stand-in sized like the real split (like Cifar10 here:
+    the zero-egress environment has no archives; data_file is accepted
+    for signature parity only)."""
 
     def __init__(self, data_file=None, mode="train", transform=None,
                  download=False, backend=None):

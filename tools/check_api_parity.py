@@ -135,7 +135,9 @@ def main():
                 for part in mod_name.split(".")[1:]:
                     mod = getattr(mod, part)
             except AttributeError:
-                missing[mod_name] = ["<module missing entirely>"] + names
+                rest = [n for n in names if n not in EXCLUDED]
+                missing[mod_name] = (["<module missing entirely>"] + rest
+                                     if rest else [])
                 continue
         missing[mod_name] = [n for n in names if not hasattr(mod, n)
                              and not hasattr(paddle, n)
