@@ -101,7 +101,8 @@ class Normal(Distribution):
         return _t(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
 
     def sample(self, shape=()):
-        z = jax.random.normal(next_key(), self._extend(shape))
+        z = jax.random.normal(next_key(), self._extend(shape),
+                              dtype=jnp.float32)
         return _t(self.loc + self.scale * z)
 
     rsample = sample
@@ -139,7 +140,8 @@ class Uniform(Distribution):
         return _t((self.high - self.low) ** 2 / 12)
 
     def sample(self, shape=()):
-        u = jax.random.uniform(next_key(), self._extend(shape))
+        u = jax.random.uniform(next_key(), self._extend(shape),
+                               dtype=jnp.float32)
         return _t(self.low + (self.high - self.low) * u)
 
     rsample = sample
@@ -246,7 +248,8 @@ class Beta(Distribution):
 
     def sample(self, shape=()):
         return _t(jax.random.beta(next_key(), self.alpha, self.beta,
-                                  self._extend(shape)))
+                                  self._extend(shape),
+                                  dtype=jnp.float32))
 
     rsample = sample
 
@@ -289,7 +292,8 @@ class Dirichlet(Distribution):
 
     def sample(self, shape=()):
         return _t(jax.random.dirichlet(next_key(), self.concentration,
-                                       tuple(shape) + self._batch_shape))
+                                       tuple(shape) + self._batch_shape,
+                                       dtype=jnp.float32))
 
     rsample = sample
 
@@ -330,7 +334,7 @@ class Gamma(Distribution):
 
     def sample(self, shape=()):
         g = jax.random.gamma(next_key(), self.concentration,
-                             self._extend(shape))
+                             self._extend(shape), dtype=jnp.float32)
         return _t(g / self.rate)
 
     rsample = sample
@@ -364,7 +368,8 @@ class Exponential(Distribution):
         return _t(1.0 / self.rate ** 2)
 
     def sample(self, shape=()):
-        e = jax.random.exponential(next_key(), self._extend(shape))
+        e = jax.random.exponential(next_key(), self._extend(shape),
+                                   dtype=jnp.float32)
         return _t(e / self.rate)
 
     rsample = sample
@@ -396,7 +401,8 @@ class Laplace(Distribution):
                                    self._batch_shape))
 
     def sample(self, shape=()):
-        z = jax.random.laplace(next_key(), self._extend(shape))
+        z = jax.random.laplace(next_key(), self._extend(shape),
+                               dtype=jnp.float32)
         return _t(self.loc + self.scale * z)
 
     rsample = sample
@@ -500,7 +506,8 @@ class Gumbel(Distribution):
                   jnp.zeros(self._batch_shape))
 
     def sample(self, shape=()):
-        g = jax.random.gumbel(next_key(), self._extend(shape))
+        g = jax.random.gumbel(next_key(), self._extend(shape),
+                              dtype=jnp.float32)
         return _t(self.loc + self.scale * g)
 
     rsample = sample
@@ -531,6 +538,7 @@ class Geometric(Distribution):
 
     def sample(self, shape=()):
         u = jax.random.uniform(next_key(), self._extend(shape),
+                               dtype=jnp.float32,
                                minval=1e-7, maxval=1.0)
         return _t(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
 
@@ -618,7 +626,8 @@ class Cauchy(Distribution):
                                               self.scale.shape))
 
     def sample(self, shape=()):
-        c = jax.random.cauchy(next_key(), self._extend(shape))
+        c = jax.random.cauchy(next_key(), self._extend(shape),
+                              dtype=jnp.float32)
         return _t(self.loc + self.scale * c)
 
     rsample = sample
@@ -652,7 +661,8 @@ class StudentT(Distribution):
         return _t(jnp.where(self.df > 2, v, jnp.nan))
 
     def sample(self, shape=()):
-        t = jax.random.t(next_key(), self.df, self._extend(shape))
+        t = jax.random.t(next_key(), self.df, self._extend(shape),
+                         dtype=jnp.float32)
         return _t(self.loc + self.scale * t)
 
     rsample = sample
@@ -909,8 +919,9 @@ class MultivariateNormal(Distribution):
             self._batch_shape + self._event_shape))
 
     def sample(self, shape=()):
-        z = jax.random.normal(next_key(), tuple(shape)
-                              + self._batch_shape + self._event_shape)
+        z = jax.random.normal(next_key(),
+                              tuple(shape) + self._batch_shape
+                              + self._event_shape, dtype=jnp.float32)
         return _t(self.loc + jnp.einsum("...ij,...j->...i", self._L, z))
 
     rsample = sample
@@ -980,6 +991,7 @@ class ContinuousBernoulli(ExponentialFamily):
 
     def sample(self, shape=()):
         u = jax.random.uniform(next_key(), self._extend(shape),
+                               dtype=jnp.float32,
                                minval=1e-6, maxval=1 - 1e-6)
         return self._icdf(u)
 
@@ -1048,8 +1060,12 @@ class LKJCholesky(Distribution):
             # beta(i/2, conc + (d - 1 - i)/2) radius-squared
             a = i / 2.0
             b = conc + (d - 1 - i) / 2.0
-            r2 = jax.random.beta(next_key(), a, b, batch)
-            u = jax.random.normal(next_key(), batch + (i,))
+            # explicit f32: the framework runs with x64 enabled, so
+            # random draws default to float64 and would scatter-mismatch
+            r2 = jax.random.beta(next_key(), a, b, batch,
+                                 dtype=jnp.float32)
+            u = jax.random.normal(next_key(), batch + (i,),
+                                  dtype=jnp.float32)
             u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
             L = L.at[..., i, :i].set(jnp.sqrt(r2)[..., None] * u)
             L = L.at[..., i, i].set(jnp.sqrt(1 - r2))
