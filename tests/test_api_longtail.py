@@ -944,3 +944,29 @@ class TestFusedTransformerFamily:
         assert len(train) == 4 and len(test) == 2
         wav, lab = test[0]
         assert wav.dtype == np.float32 and lab in (0, 1)
+
+
+@pytest.mark.slow
+class TestZooGradFlow:
+    def test_googlenet_aux_heads_train(self):
+        from paddle_tpu.vision import models as M
+        net = M.googlenet(num_classes=3)
+        x = t(np.random.RandomState(0).randn(1, 3, 160, 160)
+              .astype(np.float32))
+        out, a1, a2 = net(x)
+        loss = out.mean() + 0.3 * a1.mean() + 0.3 * a2.mean()
+        loss.backward()
+        # grads reach the stem THROUGH both aux heads and the main path
+        g = net.stem[0].conv.weight.grad
+        assert g is not None
+        assert float(np.abs(np.asarray(g.numpy())).sum()) > 0
+
+    def test_shufflenet_channel_shuffle_backprop(self):
+        from paddle_tpu.vision import models as M
+        net = M.shufflenet_v2_x0_25(num_classes=4)
+        x = t(np.random.RandomState(1).randn(1, 3, 64, 64)
+              .astype(np.float32))
+        net(x).mean().backward()
+        g = net.conv1.conv.weight.grad
+        assert g is not None and np.isfinite(np.asarray(g.numpy())).all()
+        assert float(np.abs(np.asarray(g.numpy())).sum()) > 0
