@@ -281,11 +281,16 @@ def fused_multi_head_attention(
         x = layer_norm(x, (hid,), weight=pre_ln_scale, bias=pre_ln_bias,
                        epsilon=pre_ln_epsilon)
     qkv_w = _ensure(qkv_weight)
+    mask_t = _ensure(attn_mask) if attn_mask is not None else None
     args = (_ensure(x), qkv_w) + \
         ((_ensure(qkv_bias),) if qkv_bias is not None else ()) + \
-        ((_ensure(attn_mask),) if attn_mask is not None else ())
+        ((mask_t,) if mask_t is not None else ())
     has_bias = qkv_bias is not None
     has_mask = attn_mask is not None
+    # a learned additive bias (ALiBi/relative-position) must keep its
+    # gradient through the kernel
+    mask_grad = has_mask and not mask_t.stop_gradient
+    attn_drop = attn_dropout_rate if training else 0.0
 
     def attn(xv, wv, *rest):
         b, s, _ = xv.shape
@@ -294,15 +299,23 @@ def fused_multi_head_attention(
         if has_bias:
             qkv = qkv + rest[0]
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        score = jnp.einsum("bshe,bthe->bhst", q, k) / np.sqrt(hd)
+        # the softmax(QK^T)V core rides the flash kernel (Pallas on TPU,
+        # fused reference composition elsewhere); the additive mask maps
+        # onto the kernel's bias operand (broadcast to full [.,.,S,S] —
+        # the kernel requires explicit q/k dims), and attention dropout
+        # is the kernel's in-probability dropout, matching the
+        # reference's Philox-on-softmax semantics
+        from ....ops.flash_attention import flash_attention as _fa
+        bias = None
         if has_mask:
-            score = score + rest[-1]
-        p = jax.nn.softmax(score, -1)
-        out = jnp.einsum("bhst,bthe->bshe", p, v)
+            m = rest[-1]
+            bias = jnp.broadcast_to(
+                m, (m.shape[0], m.shape[1], s, k.shape[1]))
+        out = _fa(q, k, v, causal=False, bias=bias,
+                  bias_grad=mask_grad, dropout_rate=attn_drop)
         return out.reshape(b, s, nh * hd)
 
     ctx = dispatch(attn, args, name="fused_mha_core")
-    ctx = dropout(ctx, p=attn_dropout_rate, training=training, mode=mode)
     out = fused_matmul_bias(ctx, linear_weight, linear_bias)
     out = dropout(out, p=dropout_rate, training=training, mode=mode)
     if add_residual:

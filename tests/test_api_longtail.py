@@ -970,3 +970,33 @@ class TestZooGradFlow:
         g = net.conv1.conv.weight.grad
         assert g is not None and np.isfinite(np.asarray(g.numpy())).all()
         assert float(np.abs(np.asarray(g.numpy())).sum()) > 0
+
+
+class TestFusedMHANumerics:
+    def test_matches_manual_composition(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        rng = np.random.RandomState(11)
+        B, S, H, hd = 2, 6, 2, 8
+        D = H * hd
+        x = rng.randn(B, S, D).astype(np.float32)
+        w = (rng.randn(3, H, hd, D) * 0.2).astype(np.float32)
+        lw = np.eye(D, dtype=np.float32)
+        mask = (rng.randn(1, H, S, S) * 0.5).astype(np.float32)
+        out = IF.fused_multi_head_attention(
+            t(x), t(w), t(lw), attn_mask=t(mask), dropout_rate=0.0,
+            attn_dropout_rate=0.0, pre_layer_norm=True,
+            pre_ln_scale=t(np.ones(D, np.float32)),
+            pre_ln_bias=t(np.zeros(D, np.float32)), training=False)
+        # manual: LN -> qkv -> softmax((qk/sqrt d)+mask) v -> +residual
+        mu = x.mean(-1, keepdims=True)
+        sd = x.std(-1, keepdims=True)
+        xn = (x - mu) / np.sqrt(sd ** 2 + 1e-5)
+        qkv = np.einsum("bsd,thed->bsthe", xn, w)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        s_ = np.einsum("bshe,bthe->bhst", q, k) / np.sqrt(hd) + mask
+        p = np.exp(s_ - s_.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        ctx = np.einsum("bhst,bthe->bshe", p, v).reshape(B, S, D)
+        ref = x + ctx @ lw
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=2e-2, atol=2e-2)
