@@ -536,13 +536,74 @@ class TestReviewRegressions:
         out = F.flashmask_attention(t(q), t(k), t(v), t(idx), causal=True)
         assert np.isfinite(np.asarray(out.numpy())).all()
 
-    def test_rnnt_fastemit_rejected(self):
-        with pytest.raises(NotImplementedError):
-            F.rnnt_loss(t(np.zeros((1, 1, 2, 3), np.float32)),
-                        t(np.array([[1]], np.int32)),
-                        t(np.array([1], np.int32)),
-                        t(np.array([1], np.int32)),
-                        fastemit_lambda=0.001)
+    def test_rnnt_fastemit_value_and_gradient(self):
+        """FastEmit (Yu et al. 2021): loss VALUE is unchanged; the
+        GRADIENT through label-emission log-probs is scaled by (1+lam),
+        blank gradients untouched. Verified against a brute-force path
+        enumeration of the RNNT lattice (independent of the lax.scan DP)."""
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(11)
+        T, U, V = 3, 2, 4          # u_max = U + 1
+        logits = rng.randn(1, T, U + 1, V).astype(np.float32)
+        y = np.array([[1, 2]], np.int32)
+        lam = 0.37
+
+        def brute_ll(blank_lp, lab_lp):
+            # enumerate all monotone paths (emit label: u+1, blank: t+1)
+            # ending with the final blank at (T-1, U)
+            def rec(ti, ui):
+                if ti == T - 1 and ui == U:
+                    return blank_lp[ti, ui]
+                opts = []
+                if ui < U:
+                    opts.append(lab_lp[ti, ui] + rec(ti, ui + 1))
+                if ti < T - 1:
+                    opts.append(blank_lp[ti, ui] + rec(ti + 1, ui))
+                return jnp.logaddexp(*opts) if len(opts) == 2 else opts[0]
+            return rec(0, 0)
+
+        lsm = jax.nn.log_softmax(jnp.asarray(logits[0]), -1)
+        blank_lp = lsm[..., 0]
+        lab_lp = jnp.take_along_axis(
+            lsm[:, :U], jnp.broadcast_to(jnp.asarray(y[0])[None, :, None],
+                                         (T, U, 1)), -1)[..., 0]
+        # value: brute force == DP, and unchanged by lambda
+        args = (t(logits), t(y), t(np.array([T], np.int32)),
+                t(np.array([U], np.int32)))
+        l0 = float(F.rnnt_loss(*args, fastemit_lambda=0.0).numpy())
+        l1 = float(F.rnnt_loss(*args, fastemit_lambda=lam).numpy())
+        np.testing.assert_allclose(l0, -float(brute_ll(blank_lp, lab_lp)),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(l1, l0, rtol=1e-6)
+
+        # gradient: d(loss_lam)/dlogits == base grad + lam * label-only
+        # grad, both computed from the brute-force enumeration
+        def base(lg):
+            lsm = jax.nn.log_softmax(lg[0], -1)
+            bl, la = lsm[..., 0], jnp.take_along_axis(
+                lsm[:, :U], jnp.broadcast_to(
+                    jnp.asarray(y[0])[None, :, None], (T, U, 1)), -1)[..., 0]
+            return -brute_ll(bl, la)
+
+        def label_only(lg):
+            lsm = jax.nn.log_softmax(lg[0], -1)
+            bl, la = lsm[..., 0], jnp.take_along_axis(
+                lsm[:, :U], jnp.broadcast_to(
+                    jnp.asarray(y[0])[None, :, None], (T, U, 1)), -1)[..., 0]
+            return -brute_ll(jax.lax.stop_gradient(bl), la)
+
+        want = jax.grad(base)(jnp.asarray(logits)) + \
+            lam * jax.grad(label_only)(jnp.asarray(logits))
+
+        x = t(logits)
+        x.stop_gradient = False
+        loss = F.rnnt_loss(x, t(y), t(np.array([T], np.int32)),
+                           t(np.array([U], np.int32)), fastemit_lambda=lam)
+        loss.backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                                   np.asarray(want), rtol=1e-4, atol=1e-6)
 
     def test_varlen_qkvpacked_runs(self):
         rng = np.random.RandomState(9)
