@@ -569,18 +569,21 @@ def _run_child(name):
         print(json.dumps({"error": err}))
         return
     if name == "llama":
+        # One rung per CHILD process: after a TPU OOM the client is
+        # poisoned (observed: later rungs fail within seconds), so the
+        # fallback ladder lives in the parent (_spawn) which re-spawns a
+        # fresh process per rung. BENCH_LLAMA_RUNG selects the rung.
         lsteps = int(os.environ.get("BENCH_LLAMA_STEPS", "8"))
-        err = None
-        for lb, h, L, it in ((2, 2048, 12, 5504), (1, 2048, 12, 5504),
-                             (4, 1536, 8, 4096)):
-            try:
-                r = bench_llama(steps=lsteps, batch=lb, hidden=h, layers=L,
-                                inter=it)
-                print(json.dumps(r))
-                return
-            except Exception as e:  # noqa: BLE001
-                err = f"{type(e).__name__}: {e}"[:300]
-        print(json.dumps({"error": err}))
+        rung = int(os.environ.get("BENCH_LLAMA_RUNG", "0"))
+        lb, h, L, it = LLAMA_RUNGS[min(rung, len(LLAMA_RUNGS) - 1)]
+        try:
+            r = bench_llama(steps=lsteps, batch=lb, hidden=h, layers=L,
+                            inter=it)
+            r["rung"] = rung
+            print(json.dumps(r))
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps(
+                {"error": f"{type(e).__name__}: {e}"[:300]}))
         return
     try:
         print(json.dumps(CONFIGS[name]()))
@@ -588,9 +591,31 @@ def _run_child(name):
         print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}))
 
 
+# llama bench fallback ladder: (batch, hidden, layers, intermediate).
+# Tried in order, each in a FRESH subprocess (TPU OOM poisons the client).
+LLAMA_RUNGS = ((2, 2048, 12, 5504), (1, 2048, 12, 5504),
+               (4, 1536, 8, 4096), (2, 1024, 8, 2816))
+
+
 def _spawn(name, timeout):
     """Run one config in a subprocess; return its parsed JSON or an error
     dict. Never raises, never hangs past `timeout`."""
+    if name == "llama" and "BENCH_LLAMA_RUNG" not in os.environ:
+        t0 = time.time()
+        err = None
+        for i in range(len(LLAMA_RUNGS)):
+            lft = timeout - (time.time() - t0)
+            if lft < 60:
+                break
+            os.environ["BENCH_LLAMA_RUNG"] = str(i)
+            try:
+                r = _spawn(name, min(lft, 900))
+            finally:
+                del os.environ["BENCH_LLAMA_RUNG"]
+            if "error" not in r:
+                return r
+            err = r["error"]
+        return {"error": err or f"timeout after {timeout}s"}
     env = dict(os.environ)
     # sweep Pallas block configs on the chip; the winner persists in
     # ~/.cache/paddle_tpu/autotune.json, so the sweep cost is paid once

@@ -256,6 +256,32 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     return out
 
 
+def _rotary_pairs(x, cos, sin, dims):
+    """Pairwise (even, odd) rotary rotation, paddle fused-op convention:
+    out[2i]   = x[2i]*cos[2i]   - x[2i+1]*sin[2i]
+    out[2i+1] = x[2i+1]*cos[2i+1] + x[2i]*sin[2i+1]
+    With dims==2 the head_dim splits into two halves, each rotated with
+    its own cos/sin slice (reference rotary_emb_dims semantics).
+    x [..., hd]; cos/sin broadcastable to x."""
+    if dims <= 0:
+        return x
+    hd = x.shape[-1]
+    chunk = hd // dims
+    outs = []
+    for i in range(dims):
+        xp = x[..., i * chunk:(i + 1) * chunk]
+        cp = jnp.broadcast_to(cos[..., i * chunk:(i + 1) * chunk],
+                              xp.shape)
+        sp = jnp.broadcast_to(sin[..., i * chunk:(i + 1) * chunk],
+                              xp.shape)
+        x_ev, x_od = xp[..., 0::2], xp[..., 1::2]
+        r_ev = x_ev * cp[..., 0::2] - x_od * sp[..., 0::2]
+        r_od = x_od * cp[..., 1::2] + x_ev * sp[..., 1::2]
+        outs.append(jnp.stack([r_ev, r_od], axis=-1)
+                    .reshape(xp.shape))
+    return jnp.concatenate(outs, axis=-1) if dims > 1 else outs[0]
+
+
 def fused_multi_head_attention(
         x, qkv_weight, linear_weight, pre_layer_norm=False,
         pre_ln_scale=None, pre_ln_bias=None, ln_scale=None, ln_bias=None,
@@ -267,14 +293,27 @@ def fused_multi_head_attention(
     """reference: fused_transformer.py fused_multi_head_attention —
     the whole MHA block (optional pre-LN, packed QKV GEMM, SDPA,
     out-projection, dropout, residual, optional post-LN) as one
-    composition XLA fuses. qkv_weight [3, H, D, hidden]."""
+    composition XLA fuses. qkv_weight [3, H, D, hidden].
+
+    With cache_kv [2, B, H, C, hd] the call is a decode step: the new
+    tokens' k/v are appended (cache grows, eager-mode semantics like the
+    reference's CacheKVOut) and the query attends the full cache;
+    returns (out, cache_kv_out). For a fixed-size jit-able cache use
+    fused_multi_transformer(time_step=...) or the inference paged path."""
     import jax
     from ....nn.functional.common import dropout
     from ....nn.functional.norm import layer_norm
     if cache_kv is not None:
-        raise NotImplementedError(
-            "fused_multi_head_attention: cache_kv decode is served by "
-            "paddle_tpu.inference's compiled generate/paged path")
+        return _fused_mha_cached(
+            x, qkv_weight, linear_weight, cache_kv,
+            pre_layer_norm=pre_layer_norm, pre_ln_scale=pre_ln_scale,
+            pre_ln_bias=pre_ln_bias, ln_scale=ln_scale, ln_bias=ln_bias,
+            pre_ln_epsilon=pre_ln_epsilon, qkv_bias=qkv_bias,
+            linear_bias=linear_bias, attn_mask=attn_mask,
+            ln_epsilon=ln_epsilon, add_residual=add_residual,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate, training=training,
+            mode=mode)
     residual = x
     hid = x.shape[-1]
     if pre_layer_norm:
@@ -326,6 +365,92 @@ def fused_multi_head_attention(
     return out
 
 
+def _fused_mha_cached(x, qkv_weight, linear_weight, cache_kv,
+                      pre_layer_norm, pre_ln_scale, pre_ln_bias, ln_scale,
+                      ln_bias, pre_ln_epsilon, qkv_bias, linear_bias,
+                      attn_mask, ln_epsilon, add_residual,
+                      dropout_rate=0.0, attn_dropout_rate=0.0,
+                      training=False, mode="upscale_in_train"):
+    """Decode step for fused_multi_head_attention: append the new
+    tokens' k/v to the [2, B, H, C, hd] cache, attend the grown cache
+    with bottom-right-aligned causality, return (out, cache_kv_out).
+    Attention-probability and output dropout apply exactly as in the
+    non-cached path (same train/mode semantics)."""
+    import jax
+    from ....nn.functional.common import dropout
+    from ....nn.functional.norm import layer_norm
+    residual = x
+    hid = x.shape[-1]
+    if pre_layer_norm:
+        x = layer_norm(x, (hid,), weight=pre_ln_scale, bias=pre_ln_bias,
+                       epsilon=pre_ln_epsilon)
+    has_bias = qkv_bias is not None
+    has_mask = attn_mask is not None
+    attn_drop = float(attn_dropout_rate) if training else 0.0
+    key_t = None
+    if attn_drop:
+        from ....core import random as _rnd
+        key_t = Tensor(_rnd.next_key())
+    args = (_ensure(x), _ensure(qkv_weight), _ensure(cache_kv)) + \
+        ((_ensure(qkv_bias),) if has_bias else ()) + \
+        ((_ensure(attn_mask),) if has_mask else ()) + \
+        ((key_t,) if key_t is not None else ())
+
+    def attn(xv, wv, cache, *rest):
+        ri = 0
+        bias_v = mask_v = key_v = None
+        if has_bias:
+            bias_v, ri = rest[ri], ri + 1
+        if has_mask:
+            mask_v, ri = rest[ri], ri + 1
+        if attn_drop:
+            key_v = rest[ri]
+        b, s, _ = xv.shape
+        _, nh, hd, _ = wv.shape
+        qkv = jnp.einsum("bsd,thed->bsthe", xv, wv)
+        if has_bias:
+            qkv = qkv + bias_v
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # [B,S,H,hd] -> [B,H,S,hd], then grow the cache along seq
+        k_new = jnp.moveaxis(k, 1, 2)
+        v_new = jnp.moveaxis(v, 1, 2)
+        k_all = jnp.concatenate([cache[0], k_new.astype(cache.dtype)], 2)
+        v_all = jnp.concatenate([cache[1], v_new.astype(cache.dtype)], 2)
+        sk = k_all.shape[2]
+        score = jnp.einsum("bshe,bhte->bhst", q.astype(jnp.float32),
+                           k_all.astype(jnp.float32)) / np.sqrt(hd)
+        if has_mask:
+            score = score + jnp.broadcast_to(
+                mask_v.astype(jnp.float32), score.shape)
+        rows = jnp.arange(s)[:, None]
+        cols = jnp.arange(sk)[None, :]
+        score = jnp.where((cols <= rows + (sk - s))[None, None],
+                          score, -1e30)
+        p = jax.nn.softmax(score, -1)
+        if attn_drop:
+            keep = jax.random.bernoulli(key_v, 1.0 - attn_drop,
+                                        p.shape)
+            if mode == "upscale_in_train":
+                p = jnp.where(keep, p, 0.0) / (1.0 - attn_drop)
+            else:
+                p = jnp.where(keep, p, 0.0)
+        ctx = jnp.einsum("bhst,bhte->bshe", p,
+                         v_all.astype(jnp.float32)).astype(xv.dtype)
+        return (ctx.reshape(b, s, nh * hd),
+                jnp.stack([k_all, v_all]))
+
+    ctx, cache_out = dispatch(attn, args, name="fused_mha_cached",
+                              multi_output=True)
+    out = fused_matmul_bias(ctx, linear_weight, linear_bias)
+    out = dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = _ensure(residual) + out
+    if not pre_layer_norm:
+        out = layer_norm(out, (hid,), weight=ln_scale, bias=ln_bias,
+                         epsilon=ln_epsilon)
+    return out, cache_out
+
+
 def fused_multi_transformer(
         x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
         linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
@@ -339,12 +464,39 @@ def fused_multi_transformer(
     N-layer pre-LN decoder stack in one call (the serving fast path;
     phi/kernels/fusion/gpu/fused_multi_transformer_*). Composes the
     per-layer fused MHA/FFN above; the compiled-generate path in
-    paddle_tpu.inference covers the cached-decode use."""
-    if cache_kvs is not None or pre_caches is not None or \
-            time_step is not None or rotary_embs is not None:
-        raise NotImplementedError(
-            "fused_multi_transformer: cached/rotary decode is served by "
-            "paddle_tpu.inference's compiled generate/paged path")
+    paddle_tpu.inference covers the compiled generate/paged serving
+    path; this op also serves cached decode directly:
+
+    - cache_kvs: list of [2, B, H, max_seq, hd] per layer. Prefill
+      (time_step None): the prompt's k/v (after the pre_caches prefix,
+      if any) are written into positions [P, P+S) and the call returns
+      (out, cache_kvs) with the caches updated in place. Decode
+      (time_step=t, the real current cache length): x is [B, 1, hid],
+      k/v written at position t, the query attends cache[0..t].
+    - rotary_embs [2, B, 1, S, hd] (cos, sin): pairwise rotary applied
+      to q/k per _rotary_pairs, rotary_emb_dims 1 or 2.
+    - seq_lens [B]: per-example valid lengths. In prefill, shorter
+      prompts' padded key slots are masked. In decode, seq_lens is the
+      per-example current cache length: the new token writes at
+      position seq_lens[b] and attends j <= seq_lens[b] (so garbage
+      pad slots from a padded prefill are never read); the caller
+      increments seq_lens by 1 each step.
+    The whole N-layer stack + cache updates dispatch as ONE XLA program
+    (static shapes, dynamic_update_slice at the traced time_step), so
+    the decode step jits cleanly."""
+    if cache_kvs is not None:
+        return _fused_mt_cached(
+            x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+            linear_weights, linear_biases, ffn_ln_scales, ffn_ln_biases,
+            ffn1_weights, ffn1_biases, ffn2_weights, ffn2_biases,
+            pre_layer_norm, epsilon, cache_kvs, pre_caches, seq_lens,
+            rotary_embs, rotary_emb_dims, time_step, attn_mask,
+            activation, trans_qkvw)
+    if pre_caches is not None or time_step is not None or \
+            rotary_embs is not None:
+        raise ValueError(
+            "fused_multi_transformer: pre_caches/time_step/rotary_embs "
+            "require cache_kvs (generation mode)")
     if not trans_qkvw:
         raise NotImplementedError(
             "fused_multi_transformer: trans_qkvw=False layout not "
@@ -374,18 +526,213 @@ def fused_multi_transformer(
     return out
 
 
+def _fused_mt_cached(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                     linear_weights, linear_biases, ffn_ln_scales,
+                     ffn_ln_biases, ffn1_weights, ffn1_biases,
+                     ffn2_weights, ffn2_biases, pre_layer_norm, epsilon,
+                     cache_kvs, pre_caches, seq_lens, rotary_embs,
+                     rotary_emb_dims, time_step, attn_mask, activation,
+                     trans_qkvw):
+    """Generation-mode fused_multi_transformer (cache_kvs given): the
+    N-layer stack, cache writes included, as ONE dispatched XLA program.
+    See fused_multi_transformer's docstring for the phase semantics."""
+    import jax
+    if not trans_qkvw:
+        raise NotImplementedError(
+            "fused_multi_transformer: trans_qkvw=False layout not "
+            "supported (pass [3, H, head_dim, hidden] weights)")
+    n = len(qkv_weights)
+    # ensure ONCE so the in-place _replace_value at the end hits the
+    # same objects we return (a numpy-array cache would otherwise be
+    # wrapped in a throwaway Tensor and the update silently lost)
+    cache_kvs = [_ensure(c) for c in cache_kvs]
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[activation]
+    has = {
+        "ln_b": bool(ln_biases), "qkv_b": bool(qkv_biases),
+        "lin_b": bool(linear_biases), "ffn_ln_b": bool(ffn_ln_biases),
+        "ffn1_b": bool(ffn1_biases), "ffn2_b": bool(ffn2_biases),
+        "pre": pre_caches is not None, "sl": seq_lens is not None,
+        "rot": rotary_embs is not None, "mask": attn_mask is not None,
+    }
+    decode = time_step is not None
+
+    per_layer, stride_keys = [], []
+    for i in range(n):
+        row = [ln_scales[i], qkv_weights[i], linear_weights[i],
+               ffn_ln_scales[i], ffn1_weights[i], ffn2_weights[i],
+               cache_kvs[i]]
+        for flag, lst in (("ln_b", ln_biases), ("qkv_b", qkv_biases),
+                          ("lin_b", linear_biases),
+                          ("ffn_ln_b", ffn_ln_biases),
+                          ("ffn1_b", ffn1_biases), ("ffn2_b", ffn2_biases),
+                          ("pre", pre_caches)):
+            if has[flag]:
+                row.append(lst[i])
+        per_layer.append([_ensure(v) for v in row])
+    stride = len(per_layer[0])
+
+    extras = []
+    if has["sl"]:
+        extras.append(_ensure(seq_lens))
+    if has["rot"]:
+        extras.append(_ensure(rotary_embs))
+    if has["mask"]:
+        extras.append(_ensure(attn_mask))
+    if decode:
+        ts = time_step if isinstance(time_step, Tensor) else \
+            Tensor(np.asarray(time_step, np.int32).reshape(-1))
+        extras.append(ts)
+
+    args = (_ensure(x),) + tuple(v for row in per_layer for v in row) + \
+        tuple(extras)
+
+    def f(xv, *flat):
+        layers = [flat[i * stride:(i + 1) * stride] for i in range(n)]
+        rest = list(flat[n * stride:])
+        sl = rest.pop(0) if has["sl"] else None
+        rot = rest.pop(0) if has["rot"] else None
+        mask = rest.pop(0) if has["mask"] else None
+        t = rest.pop(0).reshape(()).astype(jnp.int32) if decode else None
+
+        b, s, hid = xv.shape
+        new_caches = []
+        h = xv
+        for row in layers:
+            it = iter(row)
+            ln_s, qkv_w, lin_w, ffn_ln_s, ffn1_w, ffn2_w, cache = \
+                (next(it) for _ in range(7))
+            ln_b = next(it) if has["ln_b"] else None
+            qkv_b = next(it) if has["qkv_b"] else None
+            lin_b = next(it) if has["lin_b"] else None
+            ffn_ln_b = next(it) if has["ffn_ln_b"] else None
+            ffn1_b = next(it) if has["ffn1_b"] else None
+            ffn2_b = next(it) if has["ffn2_b"] else None
+            pre = next(it) if has["pre"] else None
+
+            def ln(v, w, bb):
+                mu = jnp.mean(v, -1, keepdims=True)
+                var = jnp.var(v, -1, keepdims=True)
+                o = (v - mu) * jax.lax.rsqrt(var + epsilon)
+                if w is not None:
+                    o = o * w
+                if bb is not None:
+                    o = o + bb
+                return o
+
+            resid = h
+            hin = ln(h, ln_s, ln_b) if pre_layer_norm else h
+            _, nh, hd, _ = qkv_w.shape
+            qkv = jnp.einsum("bsd,thed->bsthe", hin, qkv_w)
+            if qkv_b is not None:
+                qkv = qkv + qkv_b
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            if rot is not None:
+                cos = rot[0][:, 0][:, :, None, :]   # [B,S,1,hd]
+                sin = rot[1][:, 0][:, :, None, :]
+                q = _rotary_pairs(q, cos, sin, max(rotary_emb_dims, 1))
+                k = _rotary_pairs(k, cos, sin, max(rotary_emb_dims, 1))
+            k_t = jnp.moveaxis(k, 1, 2).astype(cache.dtype)  # [B,H,S,hd]
+            v_t = jnp.moveaxis(v, 1, 2).astype(cache.dtype)
+            m_max = cache.shape[3]
+
+            if decode:
+                kv_new = jnp.stack([k_t, v_t])     # [2,B,H,1,hd]
+                if sl is not None:
+                    # ragged decode: each example's cache is its real
+                    # prompt [0, sl[b]) plus its decoded tokens; the new
+                    # token writes at sl[b] and attends j <= sl[b], so
+                    # padded prompts' garbage slots are never read.
+                    # The caller increments seq_lens each step.
+                    idx = sl.reshape(b).astype(jnp.int32)
+                    at = jnp.arange(m_max)[None, :] == idx[:, None]
+                    cache = jnp.where(at[None, :, None, :, None],
+                                      kv_new, cache)
+                    live = (jnp.arange(m_max)[None, None, None, :]
+                            <= idx[:, None, None, None])
+                else:
+                    z = jnp.asarray(0, jnp.int32)
+                    cache = jax.lax.dynamic_update_slice(
+                        cache, kv_new, (z, z, z, t, z))
+                    live = jnp.arange(m_max)[None, None, None, :] <= t
+                k_all, v_all = cache[0], cache[1]
+                score = jnp.einsum(
+                    "bshe,bhte->bhst", q.astype(jnp.float32),
+                    k_all.astype(jnp.float32)) / np.sqrt(hd)
+                if mask is not None:
+                    score = score + jnp.broadcast_to(
+                        mask.astype(jnp.float32), score.shape)
+                score = jnp.where(live, score, -1e30)
+            else:
+                p_len = pre.shape[3] if pre is not None else 0
+                if pre is not None:
+                    cache = jax.lax.dynamic_update_slice(
+                        cache, pre.astype(cache.dtype), (0, 0, 0, 0, 0))
+                cache = jax.lax.dynamic_update_slice(
+                    cache, jnp.stack([k_t, v_t]), (0, 0, 0, p_len, 0))
+                sk = p_len + s
+                k_all = cache[0, :, :, :sk]
+                v_all = cache[1, :, :, :sk]
+                score = jnp.einsum(
+                    "bshe,bhte->bhst", q.astype(jnp.float32),
+                    k_all.astype(jnp.float32)) / np.sqrt(hd)
+                rows = jnp.arange(s)[:, None]
+                cols = jnp.arange(sk)[None, :]
+                causal = (cols < p_len) | (cols - p_len <= rows)
+                if sl is not None:
+                    valid = (cols[None] < p_len) | \
+                        ((cols[None] - p_len) <
+                         sl.reshape(b, 1, 1).astype(jnp.int32))
+                    causal = causal[None] & valid
+                    score = jnp.where(causal[:, None], score, -1e30)
+                else:
+                    score = jnp.where(causal[None, None], score, -1e30)
+                if mask is not None:
+                    mm = mask.astype(jnp.float32)
+                    if mm.shape[-1] == s and p_len:
+                        mm = jnp.pad(
+                            mm, [(0, 0)] * (mm.ndim - 1) + [(p_len, 0)])
+                    score = score + jnp.broadcast_to(mm, score.shape)
+
+            p = jax.nn.softmax(score, -1)
+            ctx = jnp.einsum("bhst,bhte->bshe", p,
+                             v_all.astype(jnp.float32)).astype(h.dtype)
+            attn_out = ctx.reshape(b, s, nh * hd) @ lin_w
+            if lin_b is not None:
+                attn_out = attn_out + lin_b
+            h = resid + attn_out
+            if not pre_layer_norm:
+                h = ln(h, ln_s, ln_b)
+
+            resid = h
+            hin = ln(h, ffn_ln_s, ffn_ln_b) if pre_layer_norm else h
+            ff = act(hin @ ffn1_w + (ffn1_b if ffn1_b is not None else 0))
+            ff = ff @ ffn2_w + (ffn2_b if ffn2_b is not None else 0)
+            h = resid + ff
+            if not pre_layer_norm:
+                h = ln(h, ffn_ln_s, ffn_ln_b)
+            new_caches.append(cache)
+        return (h,) + tuple(new_caches)
+
+    outs = dispatch(f, args, name="fused_multi_transformer_cached",
+                    multi_output=True)
+    out, new_caches = outs[0], outs[1:]
+    # reference semantics: cache_kvs is updated in place (the list was
+    # _ensure'd to Tensors above, so these are the returned objects)
+    for old, new in zip(cache_kvs, new_caches):
+        old._replace_value(new._value)
+    return out, list(cache_kvs)
+
+
 def variable_length_memory_efficient_attention(
         query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
         causal=False, pre_cache_length=0):
     """reference: incubate/nn/memory_efficient_attention.py varlen form
     — q/k/v [B, H, S, D] with per-example valid lengths; invalid
-    positions masked out of the softmax."""
+    positions masked out of the softmax. pre_cache_length P marks the
+    first P key positions as an always-attendable prefix (prompt-tuning
+    prefix cache): they bypass both kv_seq_lens and the causal rule, and
+    kv_seq_lens counts only the non-prefix keys."""
     import jax
-    if pre_cache_length:
-        raise NotImplementedError(
-            "variable_length_memory_efficient_attention: "
-            "pre_cache_length != 0 is served by the paged/compiled "
-            "decode path in paddle_tpu.inference")
     q, k, v = _ensure(query), _ensure(key), _ensure(value)
     sl, kl = _ensure(seq_lens), _ensure(kv_seq_lens)
     args = (q, k, v, sl, kl) + ((_ensure(mask),)
@@ -400,16 +747,19 @@ def variable_length_memory_efficient_attention(
                            kv.astype(jnp.float32)) * sc
         if has_mask:
             score = score + m[0]
+        pcl = pre_cache_length
         live_q = jnp.arange(sq)[None, :] < slv.reshape(b, 1)
-        live_k = jnp.arange(sk)[None, :] < klv.reshape(b, 1)
+        kpos = jnp.arange(sk)[None, :]
+        live_k = (kpos < pcl) | (kpos - pcl < klv.reshape(b, 1))
         score = jnp.where(live_k[:, None, None, :], score, -1e30)
         if causal:
-            # bottom-right-aligned causal: query i sees key j iff
-            # j <= i + (sk - sq) (correct when sq != sk, e.g. decode)
+            # bottom-right-aligned causal over the non-prefix keys:
+            # query i sees key j iff j < P (prefix) or
+            # j - P <= i + (sk - P - sq) (correct when sq != sk - P)
             rows = jnp.arange(sq)[:, None]
             cols = jnp.arange(sk)[None, :]
-            score = jnp.where((cols <= rows + (sk - sq))[None, None],
-                              score, -1e30)
+            ok = (cols < pcl) | (cols - pcl <= rows + (sk - pcl - sq))
+            score = jnp.where(ok[None, None], score, -1e30)
         p = jax.nn.softmax(score, -1)
         out = jnp.einsum("bhst,bhtd->bhsd", p,
                          vv.astype(jnp.float32))

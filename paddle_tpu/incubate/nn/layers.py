@@ -154,10 +154,6 @@ class FusedMultiHeadAttention(Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
-        if cache is not None:
-            raise NotImplementedError(
-                "FusedMultiHeadAttention: cached decode is served by "
-                "paddle_tpu.inference's compiled generate/paged path")
         if (key is not None and key is not query) or \
                 (value is not None and value is not query):
             raise NotImplementedError(
@@ -170,7 +166,8 @@ class FusedMultiHeadAttention(Layer):
             pre_ln_scale=self.pre_ln_scale,
             pre_ln_bias=self.pre_ln_bias, ln_scale=self.ln_scale,
             ln_bias=self.ln_bias, qkv_bias=self.qkv_bias,
-            linear_bias=self.linear_bias, attn_mask=attn_mask,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask,
             dropout_rate=self.dropout_rate,
             attn_dropout_rate=self.attn_dropout_rate,
             ln_epsilon=self.epsilon, training=self.training,
@@ -248,12 +245,17 @@ class FusedMultiTransformer(Layer):
         self.dropout_rate = dropout_rate
         self.activation = activation
 
-    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+    def forward(self, src, attn_mask=None, caches=None, time_step=None,
+                pre_caches=None, seq_lens=None, rotary_embs=None,
+                rotary_emb_dims=0):
         return F.fused_multi_transformer(
             src, self.ln_scales, self.ln_biases, self.qkv_weights,
             self.qkv_biases, self.linear_weights, self.linear_biases,
             self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
             self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
             pre_layer_norm=True, attn_mask=attn_mask,
+            cache_kvs=caches, pre_caches=pre_caches, seq_lens=seq_lens,
+            rotary_embs=rotary_embs, rotary_emb_dims=rotary_emb_dims,
+            time_step=time_step,
             dropout_rate=self.dropout_rate, activation=self.activation,
             training=self.training)

@@ -976,13 +976,20 @@ class TestFusedTransformerFamily:
         with pytest.raises(RuntimeError, match="local"):
             TESS()
 
-    def test_fused_cache_args_rejected(self):
+    def test_fused_cache_requires_generation_mode(self):
+        """pre_caches/time_step/rotary without cache_kvs is an error
+        (cached decode itself is covered in test_fused_decode.py)."""
         import paddle_tpu.incubate.nn.functional as IF
         x = t(np.zeros((1, 2, 8), np.float32))
-        w = t(np.zeros((3, 2, 4, 8), np.float32))
-        lw = t(np.zeros((8, 8), np.float32))
-        with pytest.raises(NotImplementedError, match="cache"):
-            IF.fused_multi_head_attention(x, w, lw, cache_kv=x)
+        with pytest.raises(ValueError, match="cache_kvs"):
+            IF.fused_multi_transformer(
+                x, [t(np.ones(8, np.float32))], None,
+                [t(np.zeros((3, 2, 4, 8), np.float32))], None,
+                [t(np.zeros((8, 8), np.float32))], None,
+                [t(np.ones(8, np.float32))], None,
+                [t(np.zeros((8, 16), np.float32))], None,
+                [t(np.zeros((16, 8), np.float32))], None,
+                time_step=t(np.array([1], np.int32)))
 
     def test_flowers_split_sizes_match_reference(self):
         from paddle_tpu.vision.datasets import Flowers

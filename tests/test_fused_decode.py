@@ -1,0 +1,291 @@
+"""Cached-decode paths of the fused transformer ops (reference:
+python/paddle/incubate/nn/functional/fused_transformer.py generation
+mode: cache_kvs/time_step/pre_caches/rotary_embs; CacheKV growth in
+fused_multi_head_attention)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.nn.functional as IF
+
+t = paddle.to_tensor
+
+
+def _mk_stack(rng, n_layers, hid, nh, ffn, dtype=np.float32):
+    hd = hid // nh
+    mk = lambda *s: t((rng.randn(*s) * 0.05).astype(dtype))
+    return dict(
+        ln_scales=[mk(hid) + 1.0 for _ in range(n_layers)],
+        ln_biases=[mk(hid) for _ in range(n_layers)],
+        qkv_weights=[mk(3, nh, hd, hid) for _ in range(n_layers)],
+        qkv_biases=[mk(3, nh, hd) for _ in range(n_layers)],
+        linear_weights=[mk(hid, hid) for _ in range(n_layers)],
+        linear_biases=[mk(hid) for _ in range(n_layers)],
+        ffn_ln_scales=[mk(hid) + 1.0 for _ in range(n_layers)],
+        ffn_ln_biases=[mk(hid) for _ in range(n_layers)],
+        ffn1_weights=[mk(hid, ffn) for _ in range(n_layers)],
+        ffn1_biases=[mk(ffn) for _ in range(n_layers)],
+        ffn2_weights=[mk(ffn, hid) for _ in range(n_layers)],
+        ffn2_biases=[mk(hid) for _ in range(n_layers)],
+    )
+
+
+def _caches(b, nh, hd, m, n_layers):
+    return [t(np.zeros((2, b, nh, m, hd), np.float32))
+            for _ in range(n_layers)]
+
+
+class TestFusedMultiTransformerCached:
+    def test_prefill_plus_decode_matches_full_causal(self):
+        """Prefill S tokens then greedy-decode G more, one
+        time_step'ed call each; the per-position outputs must equal ONE
+        non-cached causal run over the full S+G sequence."""
+        rng = np.random.RandomState(0)
+        B, S, G, HID, NH, FFN, L = 2, 5, 3, 16, 2, 32, 2
+        HD = HID // NH
+        w = _mk_stack(rng, L, HID, NH, FFN)
+        x_full = (rng.randn(B, S + G, HID) * 0.1).astype(np.float32)
+
+        # ground truth: non-cached run with an explicit causal mask
+        total = S + G
+        causal = np.where(np.tril(np.ones((total, total))) > 0, 0.0,
+                          -1e30).astype(np.float32)[None, None]
+        full = IF.fused_multi_transformer(
+            t(x_full), **w, pre_layer_norm=True,
+            attn_mask=t(causal), training=False)
+        full = np.asarray(full.numpy())
+
+        caches = _caches(B, NH, HD, S + G + 2, L)
+        out_p, caches = IF.fused_multi_transformer(
+            t(x_full[:, :S]), **w, pre_layer_norm=True,
+            cache_kvs=caches, training=False)
+        np.testing.assert_allclose(np.asarray(out_p.numpy()),
+                                   full[:, :S], rtol=2e-4, atol=2e-5)
+        for g in range(G):
+            out_d, caches = IF.fused_multi_transformer(
+                t(x_full[:, S + g:S + g + 1]), **w, pre_layer_norm=True,
+                cache_kvs=caches, time_step=t(np.array([S + g], np.int32)),
+                training=False)
+            np.testing.assert_allclose(
+                np.asarray(out_d.numpy())[:, 0], full[:, S + g],
+                rtol=2e-4, atol=2e-5, err_msg=f"decode step {g}")
+
+    def test_pre_caches_equal_split_prefill(self):
+        """Splitting a prompt at P and feeding the first part's k/v as
+        pre_caches must reproduce the full prefill's suffix outputs."""
+        rng = np.random.RandomState(1)
+        B, P, S2, HID, NH, FFN, L = 1, 3, 4, 8, 2, 16, 2
+        HD = HID // NH
+        w = _mk_stack(rng, L, HID, NH, FFN)
+        x = (rng.randn(B, P + S2, HID) * 0.1).astype(np.float32)
+
+        caches = _caches(B, NH, HD, P + S2, L)
+        out_full, caches = IF.fused_multi_transformer(
+            t(x), **w, pre_layer_norm=True, cache_kvs=caches,
+            training=False)
+        pre = [t(np.asarray(c.numpy())[:, :, :, :P].copy())
+               for c in caches]
+
+        caches2 = _caches(B, NH, HD, P + S2, L)
+        out_sfx, caches2 = IF.fused_multi_transformer(
+            t(x[:, P:]), **w, pre_layer_norm=True, cache_kvs=caches2,
+            pre_caches=pre, training=False)
+        np.testing.assert_allclose(
+            np.asarray(out_sfx.numpy()),
+            np.asarray(out_full.numpy())[:, P:], rtol=2e-4, atol=2e-5)
+        # the prefix landed in the cache too
+        np.testing.assert_allclose(
+            np.asarray(caches2[0].numpy())[:, :, :, :P],
+            np.asarray(pre[0].numpy()), rtol=1e-6)
+
+    def test_rotary_decode_consistent_with_prefill(self):
+        """With rotary embeddings, decode steps must agree with a
+        one-shot prefill over the full sequence (two different code
+        paths through the rotary + cache logic)."""
+        rng = np.random.RandomState(2)
+        B, S, G, HID, NH, FFN, L = 1, 4, 2, 8, 2, 16, 1
+        HD = HID // NH
+        w = _mk_stack(rng, L, HID, NH, FFN)
+        total = S + G
+        x = (rng.randn(B, total, HID) * 0.1).astype(np.float32)
+        inv = 1.0 / (10000 ** (np.arange(0, HD, 2) / HD))
+        pos = np.arange(total)[:, None] * inv[None]
+        cos = np.repeat(np.cos(pos), 2, axis=-1)[None, None]
+        sin = np.repeat(np.sin(pos), 2, axis=-1)[None, None]
+        rot = np.stack([cos, sin]).astype(np.float32)  # [2,1,1,T,HD]
+
+        caches = _caches(B, NH, HD, total, L)
+        out_full, caches = IF.fused_multi_transformer(
+            t(x), **w, pre_layer_norm=True, cache_kvs=caches,
+            rotary_embs=t(rot), rotary_emb_dims=1, training=False)
+
+        caches2 = _caches(B, NH, HD, total, L)
+        out_p, caches2 = IF.fused_multi_transformer(
+            t(x[:, :S]), **w, pre_layer_norm=True, cache_kvs=caches2,
+            rotary_embs=t(rot[:, :, :, :S]), rotary_emb_dims=1,
+            training=False)
+        np.testing.assert_allclose(np.asarray(out_p.numpy()),
+                                   np.asarray(out_full.numpy())[:, :S],
+                                   rtol=2e-4, atol=2e-5)
+        for g in range(G):
+            out_d, caches2 = IF.fused_multi_transformer(
+                t(x[:, S + g:S + g + 1]), **w, pre_layer_norm=True,
+                cache_kvs=caches2,
+                rotary_embs=t(rot[:, :, :, S + g:S + g + 1]),
+                rotary_emb_dims=1,
+                time_step=t(np.array([S + g], np.int32)), training=False)
+            np.testing.assert_allclose(
+                np.asarray(out_d.numpy())[:, 0],
+                np.asarray(out_full.numpy())[:, S + g],
+                rtol=2e-4, atol=2e-5, err_msg=f"rotary decode step {g}")
+
+    def test_seq_lens_masks_padded_prompt(self):
+        """A shorter prompt padded to S with garbage must produce the
+        same prefill outputs (at valid positions) as the unpadded one."""
+        rng = np.random.RandomState(3)
+        B, S, HID, NH, FFN, L = 1, 6, 8, 2, 16, 1
+        HD = HID // NH
+        w = _mk_stack(rng, L, HID, NH, FFN)
+        real = 4
+        x = (rng.randn(B, S, HID) * 0.1).astype(np.float32)
+        x_pad = x.copy()
+        x_pad[:, real:] = 99.0   # garbage padding
+
+        c1 = _caches(B, NH, HD, S, L)
+        out1, _ = IF.fused_multi_transformer(
+            t(x[:, :real]), **w, pre_layer_norm=True, cache_kvs=c1,
+            training=False)
+        c2 = _caches(B, NH, HD, S, L)
+        out2, c2 = IF.fused_multi_transformer(
+            t(x_pad), **w, pre_layer_norm=True, cache_kvs=c2,
+            seq_lens=t(np.array([real], np.int32)), training=False)
+        np.testing.assert_allclose(
+            np.asarray(out2.numpy())[:, :real],
+            np.asarray(out1.numpy()), rtol=2e-4, atol=2e-5)
+
+        # ragged decode: the padded cache (garbage at [real, S)) must
+        # produce the same next-token output as the unpadded cache —
+        # the seq_lens mask keeps garbage slots out of the softmax
+        nxt = (rng.randn(B, 1, HID) * 0.1).astype(np.float32)
+        d1, _ = IF.fused_multi_transformer(
+            t(nxt), **w, pre_layer_norm=True, cache_kvs=c1,
+            seq_lens=t(np.array([real], np.int32)),
+            time_step=t(np.array([real], np.int32)), training=False)
+        d2, _ = IF.fused_multi_transformer(
+            t(nxt), **w, pre_layer_norm=True, cache_kvs=c2,
+            seq_lens=t(np.array([real], np.int32)),
+            time_step=t(np.array([real], np.int32)), training=False)
+        np.testing.assert_allclose(np.asarray(d2.numpy()),
+                                   np.asarray(d1.numpy()),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_numpy_cache_kvs_updated(self):
+        """Caches passed as raw numpy arrays must still come back
+        updated (the returned list carries the new values)."""
+        rng = np.random.RandomState(9)
+        B, S, HID, NH, FFN, L = 1, 3, 8, 2, 16, 1
+        HD = HID // NH
+        w = _mk_stack(rng, L, HID, NH, FFN)
+        x = (rng.randn(B, S, HID) * 0.1).astype(np.float32)
+        np_caches = [np.zeros((2, B, NH, S, HD), np.float32)]
+        _, out_caches = IF.fused_multi_transformer(
+            t(x), **w, pre_layer_norm=True, cache_kvs=np_caches,
+            training=False)
+        assert np.abs(np.asarray(out_caches[0].numpy())).sum() > 0
+
+
+class TestFusedMHACache:
+    def test_cache_growth_matches_full_run_last_token(self):
+        """Grow the cache over S-1 tokens then decode token S: its
+        output must equal the last row of a non-cached full-sequence run
+        (non-causal full attention == decode attention for the final
+        token)."""
+        rng = np.random.RandomState(4)
+        B, S, HID, NH = 2, 5, 16, 2
+        HD = HID // NH
+        qkv_w = t((rng.randn(3, NH, HD, HID) * 0.05).astype(np.float32))
+        qkv_b = t((rng.randn(3, NH, HD) * 0.05).astype(np.float32))
+        lin_w = t((rng.randn(HID, HID) * 0.05).astype(np.float32))
+        lin_b = t((rng.randn(HID) * 0.05).astype(np.float32))
+        ln_s = t(np.ones(HID, np.float32))
+        ln_b = t(np.zeros(HID, np.float32))
+        x = (rng.randn(B, S, HID) * 0.1).astype(np.float32)
+
+        full = IF.fused_multi_head_attention(
+            t(x), qkv_w, lin_w, pre_layer_norm=True, pre_ln_scale=ln_s,
+            pre_ln_bias=ln_b, qkv_bias=qkv_b, linear_bias=lin_b,
+            dropout_rate=0.0, attn_dropout_rate=0.0, training=False)
+        full = np.asarray(full.numpy())
+
+        empty = t(np.zeros((2, B, NH, 0, HD), np.float32))
+        _, cache = IF.fused_multi_head_attention(
+            t(x[:, :S - 1]), qkv_w, lin_w, pre_layer_norm=True,
+            pre_ln_scale=ln_s, pre_ln_bias=ln_b, qkv_bias=qkv_b,
+            linear_bias=lin_b, cache_kv=empty, dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False)
+        assert list(cache.shape) == [2, B, NH, S - 1, HD]
+        out, cache = IF.fused_multi_head_attention(
+            t(x[:, S - 1:]), qkv_w, lin_w, pre_layer_norm=True,
+            pre_ln_scale=ln_s, pre_ln_bias=ln_b, qkv_bias=qkv_b,
+            linear_bias=lin_b, cache_kv=cache, dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False)
+        assert list(cache.shape) == [2, B, NH, S, HD]
+        np.testing.assert_allclose(np.asarray(out.numpy())[:, 0],
+                                   full[:, -1], rtol=2e-4, atol=2e-5)
+
+
+class TestFusedMultiTransformerLayer:
+    def test_layer_decode_roundtrip(self):
+        """The FusedMultiTransformer layer drives the cached path:
+        prefill + one decode step agree with one full prefill."""
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        rng = np.random.RandomState(7)
+        B, S, HID, NH, FFN, L = 1, 4, 8, 2, 16, 2
+        HD = HID // NH
+        lyr = FusedMultiTransformer(HID, NH, FFN, num_layers=L)
+        lyr.eval()
+        x = (rng.randn(B, S + 1, HID) * 0.1).astype(np.float32)
+
+        c1 = _caches(B, NH, HD, S + 1, L)
+        out_full, _ = lyr(t(x), caches=c1)
+        c2 = _caches(B, NH, HD, S + 1, L)
+        _, c2 = lyr(t(x[:, :S]), caches=c2)
+        out_d, _ = lyr(t(x[:, S:]), caches=c2,
+                       time_step=t(np.array([S], np.int32)))
+        np.testing.assert_allclose(
+            np.asarray(out_d.numpy())[:, 0],
+            np.asarray(out_full.numpy())[:, S], rtol=2e-4, atol=2e-5)
+
+
+class TestVarlenPreCache:
+    def test_prefix_always_attendable(self):
+        """pre_cache_length=P: prefix keys bypass kv_seq_lens and the
+        causal rule; equivalent to a manual softmax over [prefix; live
+        suffix]."""
+        import jax.numpy as jnp
+        import jax
+        rng = np.random.RandomState(5)
+        B, H, SQ, P, SK_body, D = 1, 1, 3, 2, 4, 8
+        SK = P + SK_body
+        q = (rng.randn(B, H, SQ, D) * 0.3).astype(np.float32)
+        k = (rng.randn(B, H, SK, D) * 0.3).astype(np.float32)
+        v = (rng.randn(B, H, SK, D) * 0.3).astype(np.float32)
+        kl = 3   # only 3 of the 4 body keys live
+
+        out = IF.variable_length_memory_efficient_attention(
+            t(q), t(k), t(v), t(np.array([SQ], np.int32)),
+            t(np.array([kl], np.int32)), causal=True, pre_cache_length=P)
+        out = np.asarray(out.numpy())
+
+        # manual: query i sees prefix (all P) + body j<=i (j<kl)
+        sc = (q[0, 0] @ k[0, 0].T) / np.sqrt(D)
+        for i in range(SQ):
+            for j in range(SK):
+                body_j = j - P
+                if j >= P and (body_j > i + (SK - P - SQ)
+                               or body_j >= kl):
+                    sc[i, j] = -1e30
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = p @ v[0, 0]
+        np.testing.assert_allclose(out[0, 0], want, rtol=2e-4, atol=2e-5)
