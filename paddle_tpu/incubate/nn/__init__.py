@@ -2,4 +2,4 @@ from . import functional  # noqa: F401
 from .layers import (FusedLinear, FusedDropoutAdd,  # noqa: F401,E402
                      FusedBiasDropoutResidualLayerNorm, FusedFeedForward,
                      FusedMultiHeadAttention, FusedMultiTransformer,
-                     FusedTransformerEncoderLayer)
+                     FusedTransformerEncoderLayer, FP8Linear)

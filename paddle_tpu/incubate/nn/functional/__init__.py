@@ -177,7 +177,8 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, ffn1_bias=None,
 
 
 from .fp8 import (quantize_fp8, dequantize_fp8, fp8_gemm,  # noqa: F401,E402
-                  fp8_linear)
+                  fp8_linear, fp8_delayed_state, quantize_fp8_delayed,
+                  fp8_linear_delayed)
 
 
 def fused_matmul_bias(x, y, bias=None, transpose_x=False,
@@ -373,9 +374,9 @@ def _fused_mha_cached(x, qkv_weight, linear_weight, cache_kv,
                       training=False, mode="upscale_in_train"):
     """Decode step for fused_multi_head_attention: append the new
     tokens' k/v to the [2, B, H, C, hd] cache, attend the grown cache
-    with bottom-right-aligned causality, return (out, cache_kv_out).
-    Attention-probability and output dropout apply exactly as in the
-    non-cached path (same train/mode semantics)."""
+    (plain attention + user attn_mask, like the reference and the
+    non-cached path), return (out, cache_kv_out). Attention-probability
+    and output dropout apply exactly as in the non-cached path."""
     import jax
     from ....nn.functional.common import dropout
     from ....nn.functional.norm import layer_norm
@@ -416,16 +417,15 @@ def _fused_mha_cached(x, qkv_weight, linear_weight, cache_kv,
         v_new = jnp.moveaxis(v, 1, 2)
         k_all = jnp.concatenate([cache[0], k_new.astype(cache.dtype)], 2)
         v_all = jnp.concatenate([cache[1], v_new.astype(cache.dtype)], 2)
-        sk = k_all.shape[2]
         score = jnp.einsum("bshe,bhte->bhst", q.astype(jnp.float32),
                            k_all.astype(jnp.float32)) / np.sqrt(hd)
         if has_mask:
             score = score + jnp.broadcast_to(
                 mask_v.astype(jnp.float32), score.shape)
-        rows = jnp.arange(s)[:, None]
-        cols = jnp.arange(sk)[None, :]
-        score = jnp.where((cols <= rows + (sk - s))[None, None],
-                          score, -1e30)
+        # reference semantics: plain attention over [cache; new] — no
+        # implicit causal mask (same as the non-cached path, which runs
+        # flash_attention(causal=False)); decoders pass attn_mask for
+        # causality during multi-token prefill, decode is s=1 anyway
         p = jax.nn.softmax(score, -1)
         if attn_drop:
             keep = jax.random.bernoulli(key_v, 1.0 - attn_drop,

@@ -17,7 +17,9 @@ from jax import lax
 
 from ....core.tensor import Tensor, dispatch, to_value
 
-__all__ = ["quantize_fp8", "dequantize_fp8", "fp8_gemm", "fp8_linear"]
+__all__ = ["quantize_fp8", "dequantize_fp8", "fp8_gemm", "fp8_linear",
+           "fp8_delayed_state", "quantize_fp8_delayed",
+           "fp8_linear_delayed"]
 
 _FP8 = {"e4m3": jnp.float8_e4m3fn, "e5m2": jnp.float8_e5m2}
 _FP8_MAX = {"e4m3": 448.0, "e5m2": 57344.0}
@@ -86,3 +88,60 @@ def fp8_linear(x, weight, bias=None, format="e4m3", out_dtype="bfloat16"):
     xq, sx = quantize_fp8(x, format=format)
     wq, sw = quantize_fp8(weight, format=format)
     return fp8_gemm(xq, sx, wq, sw, bias=bias, out_dtype=out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# delayed scaling (amax history) — the reference's production fp8 recipe
+# ---------------------------------------------------------------------------
+
+def fp8_delayed_state(history_len=16):
+    """Fresh delayed-scaling state for ONE tensor: a rolling amax
+    history (reference: transformer-engine-style recipe the fp8_gemm
+    kernels are driven by in production; scale is derived from the max
+    of the last `history_len` amaxes instead of the current batch, so
+    quantization runs scale-first without a pre-pass over the data).
+    The state is a plain dict of Tensors so it checkpoints like any
+    other optimizer/layer state."""
+    return {"amax_history": Tensor(jnp.zeros((history_len,),
+                                             jnp.float32))}
+
+
+def quantize_fp8_delayed(x, state, format="e4m3", margin=0.0):
+    """Quantize with the DELAYED scale (from the state's amax history),
+    then record the current amax into the rolling history. Returns
+    ``(x_fp8, scale_used, new_state)`` — functional update; callers
+    carry new_state forward (and may checkpoint it).
+
+    First call (all-zero history) falls back to the current amax so the
+    initial step is not catastrophically clipped."""
+    dt, fmax = _fmt(format)
+    x = x if isinstance(x, Tensor) else Tensor(x)
+    hist = state["amax_history"]
+    hist = hist if isinstance(hist, Tensor) else Tensor(hist)
+
+    def f(v, h):
+        v32 = v.astype(jnp.float32)
+        amax_now = jnp.max(jnp.abs(v32))
+        amax_hist = jnp.max(h)
+        amax = jnp.where(amax_hist > 0.0, amax_hist, amax_now)
+        s = jnp.maximum(amax / fmax * (2.0 ** margin), 1e-12)
+        q = jnp.clip(v32 / s, -fmax, fmax).astype(dt)
+        new_h = jnp.roll(h, 1).at[0].set(amax_now)
+        return q, s, new_h
+
+    q, s, new_h = dispatch(f, (x, hist), name="quantize_fp8_delayed",
+                           multi_output=True)
+    return q, s, {"amax_history": new_h}
+
+
+def fp8_linear_delayed(x, weight, x_state, w_state, bias=None,
+                       format="e4m3", out_dtype="bfloat16", margin=0.0):
+    """fp8 linear under delayed scaling: both operands quantize with
+    their history-derived scales (no data pre-pass on the hot path).
+    Returns ``(out, new_x_state, new_w_state)``."""
+    xq, sx, x_state = quantize_fp8_delayed(x, x_state, format=format,
+                                           margin=margin)
+    wq, sw, w_state = quantize_fp8_delayed(weight, w_state,
+                                           format=format, margin=margin)
+    out = fp8_gemm(xq, sx, wq, sw, bias=bias, out_dtype=out_dtype)
+    return out, x_state, w_state

@@ -259,3 +259,39 @@ class FusedMultiTransformer(Layer):
             time_step=time_step,
             dropout_rate=self.dropout_rate, activation=self.activation,
             training=self.training)
+
+
+class FP8Linear(Layer):
+    """Linear layer computing on the MXU in fp8 under delayed scaling
+    (reference capability: paddle/phi/kernels/fusion/fp8_gemm/ driven by
+    a transformer-engine-style amax-history recipe). The per-operand
+    amax histories live as non-trainable buffers, updated on every
+    forward, so they ride checkpoints with the rest of the state."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, format="e4m3", history_len=16,
+                 margin=0.0, out_dtype="bfloat16", name=None):
+        super().__init__()
+        self.weight = self.create_parameter([in_features, out_features],
+                                            attr=weight_attr)
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                          is_bias=True)
+        self.format = format
+        self.margin = margin
+        self.out_dtype = out_dtype
+        for nm in ("x_amax_history", "w_amax_history"):
+            self.register_buffer(
+                nm, F.fp8_delayed_state(history_len)["amax_history"])
+
+    def forward(self, x):
+        out, xs, ws = F.fp8_linear_delayed(
+            x, self.weight, {"amax_history": self.x_amax_history},
+            {"amax_history": self.w_amax_history}, bias=self.bias,
+            format=self.format, out_dtype=self.out_dtype,
+            margin=self.margin)
+        # rolling histories update in place (buffers, not outputs)
+        self.x_amax_history._replace_value(
+            xs["amax_history"]._value)
+        self.w_amax_history._replace_value(
+            ws["amax_history"]._value)
+        return out

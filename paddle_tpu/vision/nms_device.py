@@ -23,10 +23,12 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 __all__ = ["nms_padded", "multiclass_nms_padded", "matrix_nms_padded",
-           "ppyoloe_postprocess"]
+           "ppyoloe_postprocess",
+           "generate_proposals_padded"]
 
 
 def _iou_matrix(b, normalized=True):
@@ -238,3 +240,70 @@ def ppyoloe_postprocess(cls_scores, boxes, score_threshold=0.25,
         keep_top_k=max_dets, nms_threshold=iou_threshold,
         background_label=-1)
     return out, nums
+
+
+def generate_proposals_padded(scores, bbox_deltas, img_size, anchors,
+                              variances, pre_nms_top_n=6000,
+                              post_nms_top_n=1000, nms_thresh=0.5,
+                              min_size=0.1, eta=1.0, pixel_offset=False):
+    """Device-side RPN proposal generation (jit-able counterpart of
+    ``vision.ops.generate_proposals``; reference:
+    paddle/phi/kernels/cpu/generate_proposals_kernel.cc). Fixed-size
+    outputs: ``rois [N, post_nms_top_n, 4]``, ``probs
+    [N, post_nms_top_n, 1]`` (pad rows zeroed), ``rois_num [N]`` — so
+    an RPN + head detector compiles as one XLA program.
+
+    scores [N, A, H, W]; bbox_deltas [N, 4A, H, W]; img_size [N, 2]
+    (h, w); anchors/variances [H, W, A, 4] (any shape reshaping to
+    [H*W*A, 4] in the scores' H, W, A flatten order).
+    """
+    bbox_clip = float(np.log(1000.0 / 16.0))
+    off = 1.0 if pixel_offset else 0.0
+    n, a = scores.shape[0], scores.shape[1]
+    sc = jnp.moveaxis(scores, 1, -1).reshape(n, -1)       # [N, HWA]
+    bd = jnp.moveaxis(bbox_deltas, 1, -1).reshape(n, -1, 4)
+    anc = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4)
+    k = sc.shape[1] if pre_nms_top_n <= 0 else \
+        min(int(pre_nms_top_n), sc.shape[1])
+
+    def one_img(s_i, d_i, im):
+        s_top, order = lax.top_k(s_i, k)
+        d_top, anc_i, var_i = d_i[order], anc[order], var[order]
+        aw = anc_i[:, 2] - anc_i[:, 0] + off
+        ah = anc_i[:, 3] - anc_i[:, 1] + off
+        acx = anc_i[:, 0] + 0.5 * aw
+        acy = anc_i[:, 1] + 0.5 * ah
+        cx = var_i[:, 0] * d_top[:, 0] * aw + acx
+        cy = var_i[:, 1] * d_top[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(var_i[:, 2] * d_top[:, 2],
+                                 bbox_clip)) * aw
+        bh = jnp.exp(jnp.minimum(var_i[:, 3] * d_top[:, 3],
+                                 bbox_clip)) * ah
+        im_h, im_w = im[0], im[1]
+        x1 = jnp.clip(cx - bw / 2, 0, im_w - off)
+        y1 = jnp.clip(cy - bh / 2, 0, im_h - off)
+        x2 = jnp.clip(cx + bw / 2 - off, 0, im_w - off)
+        y2 = jnp.clip(cy + bh / 2 - off, 0, im_h - off)
+        props = jnp.stack([x1, y1, x2, y2], -1)
+        ms = max(float(min_size), 1.0)
+        ws = x2 - x1 + off
+        hs = y2 - y1 + off
+        valid = (ws >= ms) & (hs >= ms)
+        if pixel_offset:
+            valid &= ((x1 + ws / 2) <= im_w) & ((y1 + hs / 2) <= im_h)
+        iou = _iou_matrix(props, normalized=not pixel_offset)
+        kept = _greedy_keep(iou, valid, nms_thresh, eta=eta)
+        # kept candidates first, preserving score order, then pads
+        m = min(post_nms_top_n, k)
+        sel = jnp.argsort(~kept, stable=True)[:m]
+        ok = kept[sel]
+        rois = jnp.where(ok[:, None], props[sel], 0.0)
+        probs = jnp.where(ok, s_top[sel], 0.0)
+        if m < post_nms_top_n:   # keep the advertised static shape
+            pad = post_nms_top_n - m
+            rois = jnp.pad(rois, ((0, pad), (0, 0)))
+            probs = jnp.pad(probs, ((0, pad),))
+        return rois, probs[:, None], jnp.sum(ok.astype(jnp.int32))
+
+    return jax.vmap(one_img)(sc, bd, img_size)

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor, dispatch, to_value
 from .nms_device import (matrix_nms_padded, multiclass_nms_padded,
-                         nms_padded)
+                         nms_padded, generate_proposals_padded)
 
 
 def _ensure(x):
@@ -18,7 +18,8 @@ __all__ = ["nms", "box_coder", "roi_align", "roi_pool", "yolo_box",
            "generate_proposals", "prior_box", "matrix_nms",
            "multiclass_nms", "distribute_fpn_proposals", "psroi_pool",
            "deform_conv2d", "nms_padded", "multiclass_nms_padded",
-           "matrix_nms_padded", "RoIAlign", "RoIPool", "PSRoIPool",
+           "matrix_nms_padded", "generate_proposals_padded",
+           "RoIAlign", "RoIPool", "PSRoIPool",
            "DeformConv2D", "read_file", "decode_jpeg", "yolo_loss"]
 
 

@@ -196,10 +196,11 @@ class TestFusedMultiTransformerCached:
 
 class TestFusedMHACache:
     def test_cache_growth_matches_full_run_last_token(self):
-        """Grow the cache over S-1 tokens then decode token S: its
-        output must equal the last row of a non-cached full-sequence run
-        (non-causal full attention == decode attention for the final
-        token)."""
+        """Reference cache_kv semantics: plain (non-causal) attention
+        over [cache; new]. A multi-token append over an empty cache must
+        therefore equal the non-cached run at EVERY position, and the
+        subsequent single-token decode must equal the full run's last
+        row."""
         rng = np.random.RandomState(4)
         B, S, HID, NH = 2, 5, 16, 2
         HD = HID // NH
@@ -218,12 +219,21 @@ class TestFusedMHACache:
         full = np.asarray(full.numpy())
 
         empty = t(np.zeros((2, B, NH, 0, HD), np.float32))
-        _, cache = IF.fused_multi_head_attention(
+        out_pre, cache = IF.fused_multi_head_attention(
             t(x[:, :S - 1]), qkv_w, lin_w, pre_layer_norm=True,
             pre_ln_scale=ln_s, pre_ln_bias=ln_b, qkv_bias=qkv_b,
             linear_bias=lin_b, cache_kv=empty, dropout_rate=0.0,
             attn_dropout_rate=0.0, training=False)
         assert list(cache.shape) == [2, B, NH, S - 1, HD]
+        # multi-token append == the non-cached run over the same prefix
+        full_pre = IF.fused_multi_head_attention(
+            t(x[:, :S - 1]), qkv_w, lin_w, pre_layer_norm=True,
+            pre_ln_scale=ln_s, pre_ln_bias=ln_b, qkv_bias=qkv_b,
+            linear_bias=lin_b, dropout_rate=0.0,
+            attn_dropout_rate=0.0, training=False)
+        np.testing.assert_allclose(np.asarray(out_pre.numpy()),
+                                   np.asarray(full_pre.numpy()),
+                                   rtol=2e-4, atol=2e-5)
         out, cache = IF.fused_multi_head_attention(
             t(x[:, S - 1:]), qkv_w, lin_w, pre_layer_norm=True,
             pre_ln_scale=ln_s, pre_ln_bias=ln_b, qkv_bias=qkv_b,

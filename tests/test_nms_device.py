@@ -189,3 +189,77 @@ class TestMatrixNmsPadded:
                                 use_gaussian=gauss)
         assert _mc_host_as_sets(host[0], host[1], host[2]) == \
             _mc_dev_as_sets(dev[0], dev[2], dev[1])
+
+
+class TestGenerateProposalsPadded:
+    def _data(self, N=2, A=3, H=5, W=4, seed=71):
+        r = np.random.RandomState(seed)
+        sc = r.rand(N, A, H, W).astype(np.float32)
+        bd = (r.randn(N, 4 * A, H, W) * 0.3).astype(np.float32)
+        ims = np.array([[48.0, 40.0]] * N, np.float32)
+        # grid anchors of varying size: top-left at (x*8, y*8)
+        anc = np.zeros((H, W, A, 4), np.float32)
+        xs = np.tile(np.arange(W)[None, :] * 8.0, (H, 1))
+        ys = np.tile(np.arange(H)[:, None] * 8.0, (1, W))
+        for a in range(A):
+            s = 6.0 + 4 * a
+            anc[..., a, 0] = xs
+            anc[..., a, 1] = ys
+            anc[..., a, 2] = xs + s
+            anc[..., a, 3] = ys + s
+        var = np.full((H, W, A, 4), 0.5, np.float32)
+        return sc, bd, ims, anc, var
+
+    @pytest.mark.parametrize("pixel_offset", [False, True])
+    def test_matches_host(self, pixel_offset):
+        from paddle_tpu.vision.nms_device import generate_proposals_padded
+        sc, bd, ims, anc, var = self._data(seed=71 + int(pixel_offset))
+        host_rois, host_probs, host_num = vops.generate_proposals(
+            sc, bd, ims, anc, var, pre_nms_top_n=40, post_nms_top_n=12,
+            nms_thresh=0.5, min_size=2.0, pixel_offset=pixel_offset,
+            return_rois_num=True)
+        rois, probs, nums = generate_proposals_padded(
+            jnp.asarray(sc), jnp.asarray(bd), jnp.asarray(ims),
+            jnp.asarray(anc), jnp.asarray(var), pre_nms_top_n=40,
+            post_nms_top_n=12, nms_thresh=0.5, min_size=2.0,
+            pixel_offset=pixel_offset)
+        hr = np.asarray(host_rois.numpy())
+        hp = np.asarray(host_probs.numpy())
+        hn = np.asarray(host_num.numpy())
+        np.testing.assert_array_equal(np.asarray(nums), hn)
+        ofs = 0
+        for i in range(sc.shape[0]):
+            ni = int(hn[i])
+            np.testing.assert_allclose(
+                np.asarray(rois)[i, :ni], hr[ofs:ofs + ni],
+                rtol=1e-4, atol=1e-4, err_msg=f"img {i}")
+            np.testing.assert_allclose(
+                np.asarray(probs)[i, :ni, 0], hp[ofs:ofs + ni, 0],
+                rtol=1e-5, err_msg=f"img {i}")
+            assert (np.asarray(rois)[i, ni:] == 0).all()
+            ofs += ni
+
+    def test_static_shape_with_few_candidates(self):
+        """post_nms_top_n larger than the candidate pool must still
+        return the advertised [N, post_nms_top_n, 4] shape (zero pad)."""
+        from paddle_tpu.vision.nms_device import generate_proposals_padded
+        sc, bd, ims, anc, var = self._data(seed=81)
+        k_total = sc.shape[1] * sc.shape[2] * sc.shape[3]
+        rois, probs, nums = generate_proposals_padded(
+            jnp.asarray(sc), jnp.asarray(bd), jnp.asarray(ims),
+            jnp.asarray(anc), jnp.asarray(var),
+            pre_nms_top_n=-1, post_nms_top_n=k_total + 50, min_size=2.0)
+        assert rois.shape == (2, k_total + 50, 4)
+        assert probs.shape == (2, k_total + 50, 1)
+        assert (np.asarray(rois)[0, int(nums[0]):] == 0).all()
+
+    def test_jits_as_one_program(self):
+        from paddle_tpu.vision.nms_device import generate_proposals_padded
+        sc, bd, ims, anc, var = self._data(seed=91)
+        f = jax.jit(lambda s, d, im: generate_proposals_padded(
+            s, d, im, jnp.asarray(anc), jnp.asarray(var),
+            pre_nms_top_n=30, post_nms_top_n=8, min_size=2.0))
+        rois, probs, nums = f(jnp.asarray(sc), jnp.asarray(bd),
+                              jnp.asarray(ims))
+        assert rois.shape == (2, 8, 4) and nums.shape == (2,)
+        assert int(nums.sum()) > 0

@@ -165,3 +165,58 @@ class TestFP8:
         assert q.numpy().dtype == jnp.float8_e5m2
         with pytest.raises(ValueError):
             quantize_fp8(x, format="e3m4")
+
+
+class TestFP8DelayedScaling:
+    def test_scale_is_delayed(self):
+        """The scale used for call N comes from the amax HISTORY, not
+        the current batch: after seeing amax=8, a smaller batch still
+        quantizes with 8/fmax."""
+        from paddle_tpu.incubate.nn.functional import (
+            fp8_delayed_state, quantize_fp8_delayed)
+        st = fp8_delayed_state(history_len=4)
+        x1 = paddle.to_tensor(np.array([[8.0, -2.0]], np.float32))
+        q1, s1, st = quantize_fp8_delayed(x1, st)
+        # empty history: falls back to current amax
+        np.testing.assert_allclose(float(s1.numpy()), 8.0 / 448.0,
+                                   rtol=1e-6)
+        x2 = paddle.to_tensor(np.array([[1.0, -0.5]], np.float32))
+        q2, s2, st = quantize_fp8_delayed(x2, st)
+        # history holds amax=8 -> delayed scale, not 1/448
+        np.testing.assert_allclose(float(s2.numpy()), 8.0 / 448.0,
+                                   rtol=1e-6)
+        hist = np.asarray(st["amax_history"].numpy())
+        assert hist[0] == 1.0 and hist[1] == 8.0
+
+    def test_history_rolls_out(self):
+        from paddle_tpu.incubate.nn.functional import (
+            fp8_delayed_state, quantize_fp8_delayed)
+        st = fp8_delayed_state(history_len=2)
+        big = paddle.to_tensor(np.array([16.0], np.float32))
+        small = paddle.to_tensor(np.array([2.0], np.float32))
+        _, _, st = quantize_fp8_delayed(big, st)
+        _, _, st = quantize_fp8_delayed(small, st)
+        _, _, st = quantize_fp8_delayed(small, st)
+        # 16 has rolled out of the 2-entry window
+        _, s, st = quantize_fp8_delayed(small, st)
+        np.testing.assert_allclose(float(s.numpy()), 2.0 / 448.0,
+                                   rtol=1e-6)
+
+    def test_fp8_linear_layer(self):
+        """FP8Linear forward approximates the fp32 linear and updates
+        its amax-history buffers in place."""
+        from paddle_tpu.incubate.nn import FP8Linear
+        rng = np.random.RandomState(2)
+        lyr = FP8Linear(32, 16)
+        x = paddle.to_tensor(rng.randn(8, 32).astype(np.float32))
+        h0 = np.asarray(lyr.x_amax_history.numpy()).copy()
+        out = lyr(x)
+        h1 = np.asarray(lyr.x_amax_history.numpy())
+        assert not np.allclose(h0, h1), "buffer must update"
+        ref = np.asarray(x.numpy()) @ np.asarray(lyr.weight.numpy()) + \
+            np.asarray(lyr.bias.numpy())
+        got = np.asarray(out.numpy(), np.float32)
+        denom = np.abs(ref).max() + 1e-6
+        assert np.abs(got - ref).max() / denom < 0.08
+        # buffers ride the state dict (checkpointable)
+        assert any("amax_history" in k for k in lyr.state_dict())
