@@ -156,6 +156,9 @@ def run_dryrun(n_devices: int, force_cpu: bool = True) -> None:
             # round-3 verdict weak #4: the driver gate must also exercise
             # the pipeline axis (compiled 1F1B) and the dp allreduce path
             _run_dryrun_pp(n_devices, force_cpu=force_cpu)
+            # expert parallelism: the remaining first-class axis family
+            # (SURVEY §2.4 MoE) — ep-sharded experts, GSPMD dispatch
+            _run_dryrun_ep(n_devices, force_cpu=force_cpu)
     finally:
         # _force_cpu_devices may have redirected the whole process to the
         # CPU platform + Pallas interpreter; restore so later code (or
@@ -261,3 +264,64 @@ def _run_dryrun_pp(n_devices: int, force_cpu: bool = True) -> None:
     print(f"dryrun_multichip ok: n={n_devices} mesh="
           f"{dict(mesh.shape)} schedule=compiled_1f1b_zb(dp_allreduce) "
           f"loss={loss0:.4f} grad_norm={gn0:.4f}")
+
+
+def _run_dryrun_ep(n_devices: int, force_cpu: bool = True) -> None:
+    """Third gate phase: expert parallelism. An ep x dp mesh with the
+    expert-stacked MLP weights sharded over ``ep`` and tokens over
+    ``dp``; the MoE dispatch/combine einsums become GSPMD cross-expert
+    collectives (the reference's global_scatter/global_gather pair,
+    SURVEY §2.4). One fwd+bwd+SGD step, loss/grad-norm must be finite."""
+    from jax.sharding import Mesh, NamedSharding
+    from .fleet.moe import moe_dispatch_combine
+
+    EP, DP = 2, n_devices // 2
+    devices, _ = resolve_devices(n_devices, force_cpu=force_cpu)
+    mesh = Mesh(np.array(devices[:n_devices]).reshape(EP, DP),
+                ("ep", "dp"))
+    T, D, H, E = 8 * DP, 16, 32, 2 * EP
+    rng = np.random.RandomState(0)
+    shard = lambda a, *spec: jax.device_put(
+        jnp.asarray(a, jnp.float32), NamedSharding(mesh, P(*spec)))
+    gate_w = shard(rng.randn(D, E) * 0.1)
+    w_in = shard(rng.randn(E, D, H) * 0.1, "ep")
+    w_out = shard(rng.randn(E, H, D) * 0.1, "ep")
+    x = shard(rng.randn(T, D), "dp")
+    tgt = shard(rng.randn(T, D), "dp")
+
+    def loss_of(params, x, tgt):
+        gw, wi, wo = params
+
+        def expert_fn(expert_in):            # [E, C, D] -> [E, C, D]
+            h = jnp.tanh(jnp.einsum("ecd,edh->ech", expert_in, wi))
+            return jnp.einsum("ech,ehd->ecd", h, wo)
+
+        out, aux = moe_dispatch_combine(x, x @ gw, expert_fn, top_k=2)
+        return jnp.mean((out - tgt) ** 2) + 0.01 * aux
+
+    @jax.jit
+    def train_step(params, x, tgt):
+        loss, grads = jax.value_and_grad(loss_of)(params, x, tgt)
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                             for g in jax.tree_util.tree_leaves(grads)))
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g,
+                                        params, grads)
+        return params, loss, gnorm
+
+    with jax.default_device(devices[0]), mesh:
+        compiled = train_step.lower((gate_w, w_in, w_out), x, tgt) \
+            .compile()
+        txt = compiled.as_text()
+        params, loss, gnorm = compiled((gate_w, w_in, w_out), x, tgt)
+        jax.block_until_ready(loss)
+    loss0, gn0 = float(loss), float(gnorm)
+    assert np.isfinite(loss0), f"non-finite ep loss {loss0}"
+    assert np.isfinite(gn0), f"non-finite ep grad_norm {gn0}"
+    colls = [c for c in ("all-to-all", "all-gather", "all-reduce",
+                         "reduce-scatter", "collective-permute")
+             if c in txt]
+    assert colls, "ep program compiled without any cross-device collective"
+    print(f"dryrun_multichip ok: n={n_devices} mesh="
+          f"{dict(mesh.shape)} moe=ep-sharded experts "
+          f"collectives={','.join(colls)} loss={loss0:.4f} "
+          f"grad_norm={gn0:.4f}")

@@ -260,10 +260,11 @@ def generate_proposals_padded(scores, bbox_deltas, img_size, anchors,
     bbox_clip = float(np.log(1000.0 / 16.0))
     off = 1.0 if pixel_offset else 0.0
     n, a = scores.shape[0], scores.shape[1]
-    sc = jnp.moveaxis(scores, 1, -1).reshape(n, -1)       # [N, HWA]
-    bd = jnp.moveaxis(bbox_deltas, 1, -1).reshape(n, -1, 4)
-    anc = anchors.reshape(-1, 4)
-    var = variances.reshape(-1, 4)
+    sc = jnp.moveaxis(jnp.asarray(scores), 1, -1).reshape(n, -1)
+    bd = jnp.moveaxis(jnp.asarray(bbox_deltas), 1, -1).reshape(n, -1, 4)
+    anc = jnp.asarray(anchors).reshape(-1, 4)
+    var = jnp.asarray(variances).reshape(-1, 4)
+    img_size = jnp.asarray(img_size)
     k = sc.shape[1] if pre_nms_top_n <= 0 else \
         min(int(pre_nms_top_n), sc.shape[1])
 

@@ -245,9 +245,9 @@ class TestGenerateProposalsPadded:
         from paddle_tpu.vision.nms_device import generate_proposals_padded
         sc, bd, ims, anc, var = self._data(seed=81)
         k_total = sc.shape[1] * sc.shape[2] * sc.shape[3]
+        # raw numpy inputs must work too (converted internally)
         rois, probs, nums = generate_proposals_padded(
-            jnp.asarray(sc), jnp.asarray(bd), jnp.asarray(ims),
-            jnp.asarray(anc), jnp.asarray(var),
+            sc, bd, ims, anc, var,
             pre_nms_top_n=-1, post_nms_top_n=k_total + 50, min_size=2.0)
         assert rois.shape == (2, k_total + 50, 4)
         assert probs.shape == (2, k_total + 50, 1)
