@@ -39,6 +39,7 @@ OUT = os.path.join(ROOT, "BENCH_OPPORTUNISTIC.json")
 PACK = [
     ("resnet50", 1500, 3),
     ("llama", 1500, 3),
+    ("resnet50_sweep", 1500, 2),
     ("resnet_breakdown", 1200, 2),
     ("kernels", 1200, 3),
     ("ernie_infer", 900, 2),
