@@ -62,6 +62,14 @@ def _ref_attention(q, k, v, causal=False, scale=None, bias=None,
     return out.astype(q.dtype)
 
 
+from ..core.flags import GLOBAL_FLAGS
+
+GLOBAL_FLAGS.define(
+    "use_flash_attention", True,
+    "route attention through the Pallas flash kernel on TPU "
+    "(0 = jnp composition, for A/B perf diagnosis)")
+
+
 def flash_attention(q, k, v, causal=False, scale=None, bias=None,
                     segment_ids=None, kv_segment_ids=None, bias_grad=False,
                     dropout_rate=0.0, dropout_seed=None):
@@ -73,7 +81,8 @@ def flash_attention(q, k, v, causal=False, scale=None, bias=None,
         from ..core.random import next_key
         dropout_seed = jax.random.randint(
             next_key(), (), 0, jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
-    if jax.default_backend() in ("tpu", "axon"):
+    if jax.default_backend() in ("tpu", "axon") and \
+            GLOBAL_FLAGS.get("use_flash_attention"):
         try:
             from .pallas.flash_attention import flash_attention_pallas
             return flash_attention_pallas(

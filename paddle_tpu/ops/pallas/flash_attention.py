@@ -103,7 +103,10 @@ def _dropout_keep(seed, qbh, qi, ki, bq, bk, rate):
     x = x ^ (x >> 13)
     x = x * jnp.uint32(0xC2B2AE35)
     x = x ^ (x >> 16)
-    u = (x >> 8).astype(jnp.float32) * (1.0 / (1 << 24))
+    # top-24-bit uniform. Mosaic on the axon backend cannot lower a
+    # direct uint32->float32 cast; (x >> 8) < 2^24 fits int32 exactly,
+    # so detour through a (free) signed bitcast before the float cast.
+    u = (x >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / (1 << 24))
     return u >= rate
 
 

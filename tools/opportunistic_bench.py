@@ -42,6 +42,7 @@ PACK = [
     ("resnet50_sweep", 1500, 2),
     ("resnet_breakdown", 1200, 2),
     ("kernels", 1200, 3),
+    ("llama_breakdown", 1200, 2),
     ("ernie_infer", 900, 2),
     ("sd_unet", 900, 2),
     ("bert", 900, 2),
