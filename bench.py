@@ -488,7 +488,14 @@ def bench_kernels():
                             speedup=round(us_x / us_p, 3))
             res["cases"][name] = case
         except Exception as e:  # noqa: BLE001 — record, keep going
-            res["cases"][name] = {"error": f"{type(e).__name__}: {e}"[:200]}
+            import re
+            msg = re.sub(r"\x1b\[[0-9;]*m", "", f"{type(e).__name__}: {e}")
+            case = {"error": msg[:200]}
+            if len(msg) > 200:
+                # the Mosaic/XLA root cause is at the END, after the
+                # HTTP/helper log noise
+                case["error_tail"] = msg[-600:]
+            res["cases"][name] = case
 
     # ---- flash attention (causal, GQA, varlen, bias) + backward --------
     B, S, H, KVH, D = (4, 2048, 16, 8, 128) if not interp \
@@ -541,12 +548,19 @@ def bench_kernels():
                                                bias=b)),
            q, k, v, bias, tol=3e-2)
 
+    del bias   # 268MB; keeping it live OOMs the ref-grad compile below
+
     def loss_p(q, k, v):
         return flash_attention_pallas(q, k, v, causal=True).astype(
             jnp.float32).sum()
 
     def loss_r(q, k, v):
         return ref_attn(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    # grad comparison on a half batch: the XLA reference backward holds
+    # ~4GB of [B,H,S,S] fp32 temps and OOMs HBM at full B alongside the
+    # other live case buffers (the Pallas kernel itself is fine at full B)
+    qg, kg, vg = q[:B // 2], k[:B // 2], v[:B // 2]
 
     seed_dp = jnp.asarray(7, jnp.uint32)
 
@@ -564,11 +578,11 @@ def bench_kernels():
     record("flash_bwd_dq",
            jax.jit(lambda q, k, v: jax.grad(loss_p, 0)(q, k, v)),
            jax.jit(lambda q, k, v: jax.grad(loss_r, 0)(q, k, v)),
-           q, k, v, tol=6e-2)
+           qg, kg, vg, tol=6e-2)
     record("flash_bwd_dk",
            jax.jit(lambda q, k, v: jax.grad(loss_p, 1)(q, k, v)),
            jax.jit(lambda q, k, v: jax.grad(loss_r, 1)(q, k, v)),
-           q, k, v, tol=6e-2)
+           qg, kg, vg, tol=6e-2)
 
     # ---- paged-attention decode (incl. a seq_len=0 slot) ---------------
     PB, PH, PKV, PD, BS = (16, 16, 16, 128, 16) if not interp \

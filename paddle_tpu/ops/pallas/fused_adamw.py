@@ -19,18 +19,19 @@ from jax.experimental.pallas import tpu as pltpu
 from ._util import interpret_mode as _interpret, no_x64
 
 
-def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, step_ref,
+def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, bc_ref,
                   p_out, m_out, v_out, *, b1, b2, eps, wd):
     p = p_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     m = m_ref[:]
     v = v_ref[:]
     lr = lr_ref[0]
-    t = step_ref[0]
+    # bias corrections 1/(1-b^t) are computed OUTSIDE the kernel: the
+    # in-kernel b1**t emitted math.powf, which Mosaic fails to legalize
     m_n = b1 * m + (1 - b1) * g
     v_n = b2 * v + (1 - b2) * g * g
-    mhat = m_n / (1 - b1 ** t)
-    vhat = v_n / (1 - b2 ** t)
+    mhat = m_n * bc_ref[0]
+    vhat = v_n * bc_ref[1]
     p_n = p * (1.0 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps)
     p_out[:] = p_n.astype(p_out.dtype)
     m_out[:] = m_n
@@ -42,9 +43,13 @@ def fused_adamw(param, grad, moment1, moment2, lr, step,
                 beta1=0.9, beta2=0.999, epsilon=1e-8, weight_decay=0.01):
     """All tensors 1-D (flatten+concat upstream); lr/step scalars."""
     n = param.shape[0]
-    block = 131072 if n % 131072 == 0 else n
+    block = min(131072, n)
+    while n % block:           # largest divisor: a non-divisible n must
+        block -= 1             # not fall back to a whole-array block
     lr_arr = jnp.asarray([lr], jnp.float32)
-    step_arr = jnp.asarray([step], jnp.float32)
+    t = jnp.asarray(step, jnp.float32)
+    bc_arr = jnp.stack([1.0 / (1.0 - beta1 ** t),
+                        1.0 / (1.0 - beta2 ** t)]).astype(jnp.float32)
     out = pl.pallas_call(
         functools.partial(_adamw_kernel, b1=beta1, b2=beta2, eps=epsilon,
                           wd=weight_decay),
@@ -69,5 +74,5 @@ def fused_adamw(param, grad, moment1, moment2, lr, step,
         ],
         input_output_aliases={0: 0, 2: 1, 3: 2},
         interpret=_interpret(),
-    )(param, grad, moment1, moment2, lr_arr, step_arr)
+    )(param, grad, moment1, moment2, lr_arr, bc_arr)
     return out

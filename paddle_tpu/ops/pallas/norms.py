@@ -31,6 +31,19 @@ def _rms_rows(x):
     return x.reshape(n, x.shape[-1])
 
 
+def _row_block(n, d, itemsize):
+    """Largest row-block that divides n and keeps the kernel inside the
+    16MB scoped-VMEM budget. in+out blocks are double-buffered, so a
+    (512, 4096) bf16 block (2 x 2 x 4MB = 16.03MB with the weight) OOMs
+    VMEM on v5e — budget 2MB per block buffer and the fp32 temporaries
+    fit comfortably."""
+    cap = max(8, (2 * 1024 * 1024) // max(1, d * itemsize))
+    b = min(cap, n)
+    while n % b:
+        b -= 1
+    return b
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def rms_norm_pallas(x, weight, epsilon=1e-6):
     return _rms_fwd(x, weight, epsilon)[0]
@@ -42,7 +55,7 @@ def _rms_fwd(x, weight, epsilon):
     d = x.shape[-1]
     x2 = _rms_rows(x)
     n = x2.shape[0]
-    block = min(512, n) if n % min(512, n) == 0 else n
+    block = _row_block(n, d, x.dtype.itemsize)
     out = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=epsilon),
         grid=(pl.cdiv(n, block),),
@@ -91,7 +104,7 @@ def layer_norm_pallas(x, weight, bias, epsilon=1e-5):
     d = x.shape[-1]
     x2 = _rms_rows(x)
     n = x2.shape[0]
-    block = min(512, n) if n % min(512, n) == 0 else n
+    block = _row_block(n, d, x.dtype.itemsize)
     out = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=epsilon),
         grid=(pl.cdiv(n, block),),
