@@ -83,8 +83,15 @@ def main():
 
     results = load_results()
     attempts = {name: 0 for name, _, _ in PACK}
+    # OPP_FORCE="llama,kernels" re-measures those configs even though a
+    # capture exists (e.g. after a perf fix); the old capture is only
+    # replaced on SUCCESS
+    force = [n.strip()
+             for n in os.environ.get("OPP_FORCE", "").split(",")
+             if n.strip()]
     pending = [name for name, _, _ in PACK
-               if not (isinstance(results.get(name), dict)
+               if name in force
+               or not (isinstance(results.get(name), dict)
                        and "error" not in results[name])]
     n_probe = 0
     log({"event": "start", "pending": pending})
@@ -116,9 +123,13 @@ def main():
              "attempt": attempts[name],
              **({} if ok_cfg else {"error": r.get("error", "")[:200]})})
         if ok_cfg or attempts[name] >= max_att:
-            results[name] = r
-            results[name + "_iso"] = time.strftime("%Y-%m-%dT%H:%M:%S")
-            save_results(results)
+            had_good = (isinstance(results.get(name), dict)
+                        and "error" not in results[name])
+            if ok_cfg or not had_good:
+                # never clobber a previous good capture with an error
+                results[name] = r
+                results[name + "_iso"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+                save_results(results)
             pending.pop(0)
         # on failure below max attempts: re-probe first (the tunnel may
         # have wedged mid-config), then retry
