@@ -46,6 +46,7 @@ PACK = [
     ("ernie_infer", 900, 2),
     ("sd_unet", 900, 2),
     ("bert", 900, 2),
+    ("ppyoloe", 900, 2),
 ]
 
 
