@@ -12,6 +12,7 @@ model's scanned layer params (models/llama.py).
 """
 from __future__ import annotations
 
+import dataclasses
 import math
 from dataclasses import dataclass
 from functools import partial
@@ -143,6 +144,21 @@ def sample_token(logits, key, gen: GenerationConfig):
     return jax.random.categorical(key, logits, axis=-1)
 
 
+_RUN_CACHE: Dict = {}
+_KEY_CACHE: Dict = {}
+
+
+def _key_for(seed: int):
+    """One 8-byte h2d per distinct seed, not per call (the axon tunnel
+    charges ~1s per blocking transfer)."""
+    k = _KEY_CACHE.get(seed)
+    if k is None:
+        if len(_KEY_CACHE) > 64:
+            _KEY_CACHE.pop(next(iter(_KEY_CACHE)))
+        k = _KEY_CACHE[seed] = jax.random.key(seed)
+    return k
+
+
 def generate(params: Dict, input_ids, cfg: _llama.LlamaConfig,
              gen: Optional[GenerationConfig] = None,
              seed: int = 0) -> jax.Array:
@@ -155,6 +171,17 @@ def generate(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     gen = gen or GenerationConfig()
     B, S = input_ids.shape
     T = S + gen.max_new_tokens
+
+    # the compiled runner is cached per (model-config field values,
+    # geometry, sampling knobs): defining + jitting `run` fresh on every
+    # call forced a full retrace per generate() (fresh function
+    # identity), ~1s of host time per serving request on top of the
+    # tunnel roundtrips. Value-keying keeps a mutated cfg from serving
+    # stale traced constants
+    ck = (dataclasses.astuple(cfg), B, S, dataclasses.astuple(gen))
+    cached = _RUN_CACHE.get(ck)
+    if cached is not None:
+        return cached(params, input_ids, _key_for(seed))
 
     @partial(jax.jit, static_argnums=())
     def run(params, input_ids, key):
@@ -182,8 +209,11 @@ def generate(params: Dict, input_ids, cfg: _llama.LlamaConfig,
             jnp.arange(gen.max_new_tokens))
         return jnp.concatenate([input_ids, toks.transpose(1, 0)], axis=1)
 
-    key = jax.random.key(seed)
-    return run(params, input_ids, key)
+    if len(_RUN_CACHE) > 16:    # bound: evict the oldest runner only —
+        # clearing all would re-trace every hot serving shape
+        _RUN_CACHE.pop(next(iter(_RUN_CACHE)))
+    _RUN_CACHE[ck] = run
+    return run(params, input_ids, _key_for(seed))
 
 
 # ---------------------------------------------------------------------------
