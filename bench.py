@@ -788,7 +788,13 @@ def _run_child(name):
 
 # llama bench fallback ladder: (batch, hidden, layers, intermediate).
 # Tried in order, each in a FRESH subprocess (TPU OOM poisons the client).
-LLAMA_RUNGS = ((2, 2048, 12, 5504), (1, 2048, 12, 5504),
+# Ordered by expected MFU: with the per-step h2d fix the step is
+# device-bound, so larger batches amortize the optimizer update (whose
+# cost is per-param, not per-token); the 740M config's optimizer state
+# (10.4GB fp32 master+moments) is tried at batch 4 then 2 before
+# falling to the 325M config at batch 8.
+LLAMA_RUNGS = ((4, 2048, 12, 5504), (2, 2048, 12, 5504),
+               (1, 2048, 12, 5504), (8, 1536, 8, 4096),
                (4, 1536, 8, 4096), (2, 1024, 8, 2816))
 
 # resnet50 batch sweep (config "resnet50_sweep"): find the

@@ -299,3 +299,69 @@ class TestVarlenPreCache:
         p /= p.sum(-1, keepdims=True)
         want = p @ v[0, 0]
         np.testing.assert_allclose(out[0, 0], want, rtol=2e-4, atol=2e-5)
+
+
+class TestGenerateRunCache:
+    """The compiled generate runner must be reused across calls (a fresh
+    jit per call costs a full retrace per serving request) but must NOT
+    serve stale constants after the config object is mutated."""
+
+    def _cfg(self):
+        from paddle_tpu.models.llama import LlamaConfig
+        return LlamaConfig(vocab_size=97, hidden_size=32,
+                           intermediate_size=64, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           max_position_embeddings=64)
+
+    def test_runner_reused_for_same_shape(self):
+        import jax.numpy as jnp
+        from paddle_tpu.inference import generation as G
+        from paddle_tpu.models.llama import init_params
+        import jax
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        toks = jnp.zeros((1, 8), jnp.int32)
+        g = G.GenerationConfig(max_new_tokens=4, greedy=True)
+        G._RUN_CACHE.clear()
+        out1 = G.generate(params, toks, cfg, g)
+        n_after_first = len(G._RUN_CACHE)
+        out2 = G.generate(params, toks, cfg, g)
+        assert n_after_first == 1
+        assert len(G._RUN_CACHE) == 1          # no second entry
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+    def test_mutated_config_misses_cache(self):
+        import jax.numpy as jnp
+        from paddle_tpu.inference import generation as G
+        from paddle_tpu.models.llama import init_params
+        import jax
+        cfg = self._cfg()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        toks = jnp.zeros((1, 8), jnp.int32)
+        g = G.GenerationConfig(max_new_tokens=4, greedy=True)
+        G._RUN_CACHE.clear()
+        G.generate(params, toks, cfg, g)
+        cfg.rope_theta = cfg.rope_theta * 2   # mutate in place
+        G.generate(params, toks, cfg, g)
+        # value-keyed cache: the mutated config must get its own runner
+        assert len(G._RUN_CACHE) == 2
+
+
+class TestTrainerBatchStaging:
+    def test_already_placed_array_passes_through(self):
+        """An input whose sharding already matches must NOT be re-put
+        (each re-put is a blocking h2d roundtrip per step)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        from paddle_tpu.distributed.trainer import (MeshConfig, Trainer,
+                                                    make_mesh)
+        import jax.numpy as jnp
+        mesh = make_mesh(MeshConfig())
+        tr = Trainer(lambda p, t: jnp.sum(p["w"]) * 0.0, mesh,
+                     {"w": PartitionSpec()}, lr=1e-3)
+        x = jnp.zeros((4, 8), jnp.int32)
+        staged = jax.device_put(x, NamedSharding(mesh, tr.data_spec))
+        assert tr._stage_batch(staged) is staged
+        # host numpy still gets placed
+        out = tr._stage_batch(np.zeros((4, 8), np.int32))
+        assert isinstance(out, jax.Array)
