@@ -113,9 +113,19 @@ class Trainer:
                  param_specs, data_spec=P(("dp", "fsdp"), "sp"),
                  lr=3e-4, b1=0.9, b2=0.95, weight_decay=0.1,
                  grad_clip=1.0, accumulate_steps: int = 1,
-                 donate: bool = True):
+                 donate: bool = True,
+                 fused_optimizer: Optional[bool] = None):
         """loss_fn(params, *batch) -> scalar. param_specs: pytree of
-        PartitionSpec matching params."""
+        PartitionSpec matching params.
+
+        fused_optimizer: None = auto. On a single-device mesh the AdamW
+        update runs as ONE Pallas multi-tensor pass over flat fp32
+        master/moment state with the bf16 shadow written in the same
+        pass (reference fused_adam_kernel.cu semantics). XLA's per-leaf
+        update measured ~50ms on a 325M model where the HBM bound is
+        ~11ms. On multi-device meshes the per-leaf path keeps every
+        state tensor sharded like its param, so it stays the default.
+        """
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.param_specs = param_specs
@@ -125,13 +135,44 @@ class Trainer:
         self.accumulate_steps = accumulate_steps
         self._step_fn = None
         self._donate = donate
+        self._fused_opt = fused_optimizer
+        self._fused = False
+        self._flat_meta = None
 
     # -- state init ----------------------------------------------------------
+    def _decide_fused(self, params) -> bool:
+        if self._fused_opt is not None:
+            return bool(self._fused_opt)
+        if self.mesh.devices.size != 1:
+            return False   # per-leaf path keeps state sharded like params
+        if jax.default_backend() not in ("tpu", "axon"):
+            return False   # interpret-mode pallas would be slower than XLA
+        leaves = jax.tree_util.tree_leaves(params)
+        return (len(leaves) > 0
+                and all(jnp.issubdtype(v.dtype, jnp.floating)
+                        for v in leaves)
+                and len({v.dtype for v in leaves}) == 1)
+
     def init_state(self, params) -> TrainState:
         shard = lambda tree: jax.tree_util.tree_map(
             lambda v, s: jax.device_put(v, NamedSharding(self.mesh, s)),
             tree, self.param_specs)
         params = shard(params)
+        self._fused = self._decide_fused(params)
+        step = jnp.zeros((), jnp.int32)
+        if self._fused:
+            leaves = jax.tree_util.tree_leaves(params)
+            self._flat_meta = (
+                jax.tree_util.tree_structure(params),
+                [v.shape for v in leaves],
+                [int(np.prod(v.shape)) for v in leaves],
+                leaves[0].dtype,
+            )
+            master = jnp.concatenate(
+                [jnp.ravel(v).astype(jnp.float32) for v in leaves])
+            mu = jnp.zeros_like(master)
+            nu = jnp.zeros_like(master)
+            return TrainState(params, master, mu, nu, step)
         # copy=True: when params are already fp32, astype would alias the
         # same buffer and double-donation breaks Execute()
         master = jax.tree_util.tree_map(
@@ -139,7 +180,6 @@ class Trainer:
         master = shard(master)
         mu = jax.tree_util.tree_map(jnp.zeros_like, master)
         nu = jax.tree_util.tree_map(jnp.zeros_like, master)
-        step = jnp.zeros((), jnp.int32)
         return TrainState(params, master, mu, nu, step)
 
     # -- compiled step -------------------------------------------------------
@@ -169,9 +209,12 @@ class Trainer:
                 grads = jax.tree_util.tree_map(lambda g: g / n, grads)
             else:
                 loss, grads = jax.value_and_grad(loss_of)(params, *batch)
-            new_state, gnorm = _adamw_update(
-                grads, state_tree, lr, b1=hp["b1"], b2=hp["b2"],
-                eps=1e-8, wd=hp["wd"], grad_clip=hp["grad_clip"])
+            if self._fused:
+                new_state, gnorm = self._fused_update(grads, state_tree, lr)
+            else:
+                new_state, gnorm = _adamw_update(
+                    grads, state_tree, lr, b1=hp["b1"], b2=hp["b2"],
+                    eps=1e-8, wd=hp["wd"], grad_clip=hp["grad_clip"])
             metrics = {"loss": loss, "grad_norm": gnorm}
             if nan_check:
                 # FLAGS_check_nan_inf inside the compiled hybrid-parallel
@@ -186,6 +229,34 @@ class Trainer:
         donate = (0,) if self._donate and not nan_check else ()
         self._step_nan = nan_check
         self._step_fn = jax.jit(step_fn, donate_argnums=donate)
+
+    def _fused_update(self, grads, state_tree, lr):
+        """Single-pass Pallas AdamW over flat fp32 state (+ bf16 shadow).
+        grads arrive as a pytree; one concat (the only extra HBM traffic)
+        feeds the multi-tensor kernel, and the updated shadow is sliced
+        back into the param tree shapes."""
+        from ..ops.pallas.fused_adamw import fused_adamw
+        hp = self.hp
+        treedef, shapes, sizes, pdtype = self._flat_meta
+        _, master, mu, nu, step = state_tree
+        step_n = step + 1
+        g_flat = jnp.concatenate(
+            [jnp.ravel(g) for g in jax.tree_util.tree_leaves(grads)])
+        gnorm = jnp.sqrt(jnp.sum(jnp.square(g_flat.astype(jnp.float32))))
+        scale = jnp.minimum(1.0, hp["grad_clip"]
+                            / jnp.maximum(gnorm, 1e-12)) \
+            if hp["grad_clip"] else jnp.float32(1.0)
+        master_n, mu_n, nu_n, shadow = fused_adamw(
+            master, g_flat, mu, nu, lr, step_n.astype(jnp.float32),
+            beta1=hp["b1"], beta2=hp["b2"], epsilon=1e-8,
+            weight_decay=hp["wd"], grad_scale=scale, shadow_dtype=pdtype)
+        leaves, off = [], 0
+        for shp, sz in zip(shapes, sizes):
+            leaves.append(jax.lax.slice(shadow, (off,),
+                                        (off + sz,)).reshape(shp))
+            off += sz
+        params_n = jax.tree_util.tree_unflatten(treedef, leaves)
+        return (params_n, master_n, mu_n, nu_n, step_n), gnorm
 
     def _stage_batch(self, b):
         """device_put only when needed. Re-putting an already-placed

@@ -20,14 +20,17 @@ from ._util import interpret_mode as _interpret, no_x64
 
 
 def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, bc_ref,
-                  p_out, m_out, v_out, *, b1, b2, eps, wd):
+                  *outs, b1, b2, eps, wd, shadow):
+    p_out, m_out, v_out = outs[0], outs[1], outs[2]
     p = p_ref[:].astype(jnp.float32)
-    g = g_ref[:].astype(jnp.float32)
+    # bc_ref = [1/(1-b1^t), 1/(1-b2^t), grad_scale]: the bias corrections
+    # are computed OUTSIDE the kernel (in-kernel b**t emitted math.powf,
+    # which Mosaic fails to legalize) and the grad-clip scale rides along
+    # so clipping fuses into the same HBM pass
+    g = g_ref[:].astype(jnp.float32) * bc_ref[2]
     m = m_ref[:]
     v = v_ref[:]
     lr = lr_ref[0]
-    # bias corrections 1/(1-b^t) are computed OUTSIDE the kernel: the
-    # in-kernel b1**t emitted math.powf, which Mosaic fails to legalize
     m_n = b1 * m + (1 - b1) * g
     v_n = b2 * v + (1 - b2) * g * g
     mhat = m_n * bc_ref[0]
@@ -36,23 +39,45 @@ def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, bc_ref,
     p_out[:] = p_n.astype(p_out.dtype)
     m_out[:] = m_n
     v_out[:] = v_n
+    if shadow:
+        outs[3][:] = p_n.astype(outs[3].dtype)
 
 
 @no_x64
 def fused_adamw(param, grad, moment1, moment2, lr, step,
-                beta1=0.9, beta2=0.999, epsilon=1e-8, weight_decay=0.01):
-    """All tensors 1-D (flatten+concat upstream); lr/step scalars."""
+                beta1=0.9, beta2=0.999, epsilon=1e-8, weight_decay=0.01,
+                grad_scale=None, shadow_dtype=None):
+    """All tensors 1-D (flatten+concat upstream); lr/step scalars.
+
+    ``grad_scale`` (scalar, e.g. the grad-clip factor) is applied to the
+    gradient inside the kernel. ``shadow_dtype`` adds a fourth output: the
+    updated parameter cast to that dtype in the same pass (AMP master-
+    weight training writes the bf16 model shadow for free).
+    """
     n = param.shape[0]
     block = min(131072, n)
     while n % block:           # largest divisor: a non-divisible n must
         block -= 1             # not fall back to a whole-array block
     lr_arr = jnp.asarray([lr], jnp.float32)
     t = jnp.asarray(step, jnp.float32)
+    scale = jnp.asarray(1.0 if grad_scale is None else grad_scale,
+                        jnp.float32)
     bc_arr = jnp.stack([1.0 / (1.0 - beta1 ** t),
-                        1.0 / (1.0 - beta2 ** t)]).astype(jnp.float32)
+                        1.0 / (1.0 - beta2 ** t),
+                        scale]).astype(jnp.float32)
+    shadow = shadow_dtype is not None
+    out_specs = [pl.BlockSpec((block,), lambda i: (i,)) for _ in range(3)]
+    out_shape = [
+        jax.ShapeDtypeStruct((n,), param.dtype),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+        jax.ShapeDtypeStruct((n,), jnp.float32),
+    ]
+    if shadow:
+        out_specs.append(pl.BlockSpec((block,), lambda i: (i,)))
+        out_shape.append(jax.ShapeDtypeStruct((n,), shadow_dtype))
     out = pl.pallas_call(
         functools.partial(_adamw_kernel, b1=beta1, b2=beta2, eps=epsilon,
-                          wd=weight_decay),
+                          wd=weight_decay, shadow=shadow),
         grid=(pl.cdiv(n, block),),
         in_specs=[
             pl.BlockSpec((block,), lambda i: (i,)),
@@ -62,16 +87,8 @@ def fused_adamw(param, grad, moment1, moment2, lr, step,
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=[
-            pl.BlockSpec((block,), lambda i: (i,)),
-            pl.BlockSpec((block,), lambda i: (i,)),
-            pl.BlockSpec((block,), lambda i: (i,)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n,), param.dtype),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
-            jax.ShapeDtypeStruct((n,), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         input_output_aliases={0: 0, 2: 1, 3: 2},
         interpret=_interpret(),
     )(param, grad, moment1, moment2, lr_arr, bc_arr)

@@ -365,3 +365,43 @@ class TestTrainerBatchStaging:
         # host numpy still gets placed
         out = tr._stage_batch(np.zeros((4, 8), np.int32))
         assert isinstance(out, jax.Array)
+
+
+class TestFusedOptimizerPath:
+    def test_fused_matches_per_leaf_update(self):
+        """Trainer(fused_optimizer=True) — the flat Pallas AdamW path
+        (interpret mode off-TPU) must track the per-leaf XLA update."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec
+        from paddle_tpu.distributed.trainer import (MeshConfig, Trainer,
+                                                    make_mesh)
+
+        def loss_fn(p, x):
+            h = jnp.tanh(x @ p["w1"])
+            return jnp.mean(jnp.square(h @ p["w2"]))
+
+        rng = np.random.RandomState(0)
+        params = {"w1": jnp.asarray(rng.randn(8, 16), jnp.float32),
+                  "w2": jnp.asarray(rng.randn(16, 4), jnp.float32)}
+        specs = {"w1": PartitionSpec(), "w2": PartitionSpec()}
+        x = jnp.asarray(rng.randn(32, 8), jnp.float32)
+        mesh = make_mesh(MeshConfig())
+
+        outs = {}
+        for fused in (False, True):
+            tr = Trainer(loss_fn, mesh, specs, lr=1e-2, grad_clip=1.0,
+                         fused_optimizer=fused, donate=False)
+            st = tr.init_state(dict(params))
+            for _ in range(3):
+                st, m = tr.step(st, x)
+            outs[fused] = (np.asarray(m["loss"]),
+                           np.asarray(m["grad_norm"]),
+                           {k: np.asarray(v) for k, v in st.params.items()})
+        np.testing.assert_allclose(outs[True][0], outs[False][0],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(outs[True][1], outs[False][1],
+                                   rtol=1e-5, atol=1e-6)
+        for k in params:
+            np.testing.assert_allclose(outs[True][2][k], outs[False][2][k],
+                                       rtol=1e-4, atol=1e-5)
