@@ -91,17 +91,18 @@ def bench_probe():
     return {"device": str(d), "platform": d.platform}
 
 
-def bench_resnet50(steps=20, batch=256):
+def bench_resnet50(steps=20, batch=256, amp_level=None):
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     from paddle_tpu.vision.models import resnet50
 
+    amp_level = amp_level or os.environ.get("BENCH_RESNET_AMP", "O1")
     paddle.seed(0)
     net = resnet50(num_classes=1000)
     net.train()
     opt = paddle.optimizer.Momentum(0.1, parameters=net.parameters())
     ts = paddle.jit.train_step(net, F.cross_entropy, opt,
-                               amp_level="O1", amp_dtype="bfloat16")
+                               amp_level=amp_level, amp_dtype="bfloat16")
     x = paddle.to_tensor(np.random.randn(batch, 3, 224, 224)
                          .astype(np.float32))
     y = paddle.to_tensor(np.random.randint(0, 1000, batch))
@@ -117,7 +118,7 @@ def bench_resnet50(steps=20, batch=256):
     return {"metric": "resnet50_train_imgs_per_sec_per_chip",
             "value": round(ips, 2), "unit": "imgs/sec/chip",
             "vs_baseline": round(ips / 2500.0, 4), "batch": batch,
-            "loss": round(final, 4)}
+            "amp": amp_level, "loss": round(final, 4)}
 
 
 def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
@@ -743,10 +744,13 @@ def _run_child(name):
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     if name == "resnet50_one":
-        # single-batch probe for the sweep: NO fallback ladder — the
-        # parent sweeps batches in separate subprocesses
+        # single-point probe for the sweep ("batch:amp"): NO fallback
+        # ladder — the parent sweeps points in separate subprocesses
+        point = os.environ.get("BENCH_RESNET_POINT", f"{batch}:O1")
+        pb, _, pa = point.partition(":")
         try:
-            print(json.dumps(bench_resnet50(steps=steps, batch=batch)))
+            print(json.dumps(bench_resnet50(steps=steps, batch=int(pb),
+                                            amp_level=pa or "O1")))
         except Exception as e:  # noqa: BLE001
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}))
         return
@@ -800,9 +804,13 @@ LLAMA_RUNGS = ((4, 2048, 12, 5504), (2, 2048, 12, 5504),
 # resnet50 batch sweep (config "resnet50_sweep"): find the
 # throughput-optimal batch on the chip, one FRESH subprocess per batch
 # (an OOM at 512 must not poison the smaller runs).
-RESNET_SWEEP_BATCHES = (512, 384)  # 256 = the resnet50 config's default,
-# already measured by the main PACK entry — don't burn the healthy-tunnel
-# window re-measuring it; the merge picks the best of sweep vs default.
+# (batch, amp_level) operating points for the sweep. batch 256/O1 is the
+# resnet50 config's default, already measured by the main PACK entry —
+# the merge picks the best of sweep vs default. The O2 points run the
+# whole net (incl. batch norm) in bf16 with fp32 master weights: the
+# XPlane trace shows the step is BN/elementwise bandwidth-bound, and O1
+# keeps BN in fp32, doubling exactly that traffic.
+RESNET_SWEEP_POINTS = ("512:O1", "384:O1", "256:O2", "512:O2")
 
 
 def _env_ladder(name, var, values, timeout, per_cap, keep_best=False):
@@ -849,8 +857,8 @@ def _spawn(name, timeout):
     """Run one config in a subprocess; return its parsed JSON or an error
     dict. Never raises, never hangs past `timeout`."""
     if name == "resnet50_sweep":
-        return _env_ladder("resnet50_one", "BENCH_BATCH",
-                           RESNET_SWEEP_BATCHES, timeout, per_cap=600,
+        return _env_ladder("resnet50_one", "BENCH_RESNET_POINT",
+                           RESNET_SWEEP_POINTS, timeout, per_cap=600,
                            keep_best=True)
     if name == "llama" and "BENCH_LLAMA_RUNG" not in os.environ:
         return _env_ladder("llama", "BENCH_LLAMA_RUNG",
