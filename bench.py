@@ -135,14 +135,20 @@ def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
                       num_attention_heads=hidden // 128,
                       num_key_value_heads=hidden // 128,
                       max_position_embeddings=seq)
+    # BENCH_LLAMA_ACC>1: micro-batch gradient accumulation (reference
+    # Fleet accumulate_steps) — amortizes the per-param optimizer pass
+    # over acc micro-batches of tokens
+    acc = int(os.environ.get("BENCH_LLAMA_ACC", "1"))
     mesh = make_mesh(MeshConfig())
     params = init_params(cfg, jax.random.PRNGKey(0))
     n_params = sum(v.size for v in jax.tree_util.tree_leaves(params))
     tr = Trainer(lambda p, t, l: loss_fn(p, t, l, cfg), mesh,
-                 param_shardings(mesh, cfg), lr=1e-4)
+                 param_shardings(mesh, cfg), lr=1e-4,
+                 accumulate_steps=acc)
     state = tr.init_state(params)
-    toks = jnp.asarray(np.random.randint(0, 32000, (batch, seq)), jnp.int32)
-    labels = jnp.roll(toks, -1, axis=1)
+    shape = (acc, batch, seq) if acc > 1 else (batch, seq)
+    toks = jnp.asarray(np.random.randint(0, 32000, shape), jnp.int32)
+    labels = jnp.roll(toks, -1, axis=-1)
 
     state, m = tr.step(state, toks, labels)
     float(m["loss"])  # warmup + compile
@@ -151,7 +157,7 @@ def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
         state, m = tr.step(state, toks, labels)
     float(m["loss"])
     dt = time.perf_counter() - t0
-    tps = steps * batch * seq / dt
+    tps = steps * acc * batch * seq / dt
     # causal attention adds ~6*L*S*D flops/token on top of 6N
     flops_per_tok = 6 * n_params + 6 * cfg.num_hidden_layers * seq * \
         cfg.hidden_size
@@ -159,7 +165,8 @@ def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
     return {"metric": "llama_train_tokens_per_sec_per_chip",
             "value": round(tps, 1), "unit": "tokens/sec/chip",
             "mfu": round(mfu, 4), "params": int(n_params), "batch": batch,
-            "seq": seq, "vs_baseline_mfu": round(mfu / 0.525, 4)}
+            "seq": seq, "accumulate": acc,
+            "vs_baseline_mfu": round(mfu / 0.525, 4)}
 
 
 def bench_llama_breakdown(batch=4, seq=2048, hidden=1536, layers=8,

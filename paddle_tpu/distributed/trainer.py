@@ -162,14 +162,23 @@ class Trainer:
         step = jnp.zeros((), jnp.int32)
         if self._fused:
             leaves = jax.tree_util.tree_leaves(params)
+            n = sum(int(np.prod(v.shape)) for v in leaves)
+            # pad the flat state to a kernel-block multiple: an awkward
+            # total would force fused_adamw's largest-divisor fallback
+            # onto a tiny block and a huge sequential grid. Padding tail
+            # sees zero grads, so its moments stay zero.
+            blk = 131072
+            pad = (-n) % blk if n >= blk else 0
             self._flat_meta = (
                 jax.tree_util.tree_structure(params),
                 [v.shape for v in leaves],
                 [int(np.prod(v.shape)) for v in leaves],
                 leaves[0].dtype,
+                pad,
             )
             master = jnp.concatenate(
-                [jnp.ravel(v).astype(jnp.float32) for v in leaves])
+                [jnp.ravel(v).astype(jnp.float32) for v in leaves]
+                + ([jnp.zeros((pad,), jnp.float32)] if pad else []))
             mu = jnp.zeros_like(master)
             nu = jnp.zeros_like(master)
             return TrainState(params, master, mu, nu, step)
@@ -237,11 +246,13 @@ class Trainer:
         back into the param tree shapes."""
         from ..ops.pallas.fused_adamw import fused_adamw
         hp = self.hp
-        treedef, shapes, sizes, pdtype = self._flat_meta
+        treedef, shapes, sizes, pdtype, pad = self._flat_meta
         _, master, mu, nu, step = state_tree
         step_n = step + 1
+        g_leaves = jax.tree_util.tree_leaves(grads)
         g_flat = jnp.concatenate(
-            [jnp.ravel(g) for g in jax.tree_util.tree_leaves(grads)])
+            [jnp.ravel(g) for g in g_leaves]
+            + ([jnp.zeros((pad,), g_leaves[0].dtype)] if pad else []))
         gnorm = jnp.sqrt(jnp.sum(jnp.square(g_flat.astype(jnp.float32))))
         scale = jnp.minimum(1.0, hp["grad_clip"]
                             / jnp.maximum(gnorm, 1e-12)) \
