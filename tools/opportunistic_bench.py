@@ -37,6 +37,7 @@ OUT = os.path.join(ROOT, "BENCH_OPPORTUNISTIC.json")
 
 # (config, timeout_sec, max_attempts)
 PACK = [
+    ("flash_tune", 900, 2),
     ("resnet50", 1500, 3),
     ("llama", 1500, 3),
     ("resnet50_sweep", 1500, 2),
