@@ -514,10 +514,11 @@ def bench_flash_tune():
         return {"metric": "flash_autotune_shapes", "value": 0,
                 "unit": "shapes swept", "skipped": "interpret mode"}
     GLOBAL_FLAGS.set("kernel_autotune", True)
-    # (B, S, H, KV, D) of the llama rungs (hidden 2048 -> 16 heads,
-    # 1536 -> 12) and the ernie decode prefill
+    # (B, S, H, KV, D) of every llama ladder rung (hidden 2048 -> 16
+    # heads, 1536 -> 12, 1024 -> 8) and the ernie decode prefill
     shapes = [(4, 2048, 16, 16, 128), (2, 2048, 16, 16, 128),
-              (8, 2048, 12, 12, 128), (4, 2048, 12, 12, 128),
+              (1, 2048, 16, 16, 128), (8, 2048, 12, 12, 128),
+              (4, 2048, 12, 12, 128), (2, 2048, 8, 8, 128),
               (8, 1024, 16, 16, 64)]
     tuned = {}
     key = jax.random.PRNGKey(0)
@@ -529,8 +530,10 @@ def bench_flash_tune():
         try:
             out = flash_attention_pallas(q, k, v, causal=True)
             jax.block_until_ready(out)
-            ck = (f"flash_attention|({B * H}, {S}, {S}, {B * KV}, {D}, "
-                  f"True, 'bfloat16', False, False)")
+            from paddle_tpu.ops.pallas.flash_attention import (
+                autotune_cache_key)
+            ck = autotune_cache_key(B * H, S, S, B * KV, D, True,
+                                    q.dtype)
             tuned[f"{B}x{S}x{H}x{D}"] = _cache.get(ck)
         except Exception as e:  # noqa: BLE001
             tuned[f"{B}x{S}x{H}x{D}"] = f"{type(e).__name__}: {e}"[:120]

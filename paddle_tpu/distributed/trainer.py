@@ -168,7 +168,8 @@ class Trainer:
             # onto a tiny block and a huge sequential grid. Padding tail
             # sees zero grads, so its moments stay zero.
             blk = 131072
-            pad = (-n) % blk if n >= blk else 0
+            pad = (-n) % blk   # unconditional: a small awkward n would
+            # otherwise walk the largest-divisor loop down to block=1
             self._flat_meta = (
                 jax.tree_util.tree_structure(params),
                 [v.shape for v in leaves],

@@ -700,6 +700,14 @@ _BLOCK_CANDIDATES = ((512, 512), (256, 512), (512, 256), (1024, 512),
                      (256, 1024))
 
 
+def autotune_cache_key(bh, sq, sk, kv_bh, d, causal, dtype,
+                       has_bias=False, has_seg=False) -> str:
+    """Single source of truth for the flash-attention autotune cache
+    key (bench.py's flash_tune sweep reports winners by this key)."""
+    key = (bh, sq, sk, kv_bh, d, causal, str(dtype), has_bias, has_seg)
+    return f"flash_attention|{key}"
+
+
 def _tuned_blocks(qt, kt, vt, bias_arg, seg_q, seg_k, s, causal, geom):
     """Autotuned (bq, bk) for this shape (reference:
     phi/kernels/autotune/auto_tune_base.h). Eager calls with
@@ -712,7 +720,8 @@ def _tuned_blocks(qt, kt, vt, bias_arg, seg_q, seg_k, s, causal, geom):
         return None  # single/double block — nothing to tune
     key = (bh, sq, sk, kt.shape[0], d, causal, str(qt.dtype),
            bias_arg is not None, seg_q is not None)
-    ck = f"flash_attention|{key}"
+    ck = autotune_cache_key(bh, sq, sk, kt.shape[0], d, causal, qt.dtype,
+                            bias_arg is not None, seg_q is not None)
     if isinstance(qt, jax.core.Tracer) or interpret_mode() or             not GLOBAL_FLAGS.get("kernel_autotune"):
         hit = _cache.get(ck) if GLOBAL_FLAGS.get("kernel_autotune") else None
         if hit is not None and 0 <= int(hit) < len(_BLOCK_CANDIDATES):
