@@ -830,7 +830,8 @@ def _run_child(name):
         # fresh process per rung. BENCH_LLAMA_RUNG selects the rung.
         lsteps = int(os.environ.get("BENCH_LLAMA_STEPS", "8"))
         rung = int(os.environ.get("BENCH_LLAMA_RUNG", "0"))
-        lb, h, L, it = LLAMA_RUNGS[min(rung, len(LLAMA_RUNGS) - 1)]
+        lb, h, L, it, acc = LLAMA_RUNGS[min(rung, len(LLAMA_RUNGS) - 1)]
+        os.environ.setdefault("BENCH_LLAMA_ACC", str(acc))
         try:
             r = bench_llama(steps=lsteps, batch=lb, hidden=h, layers=L,
                             inter=it)
@@ -846,16 +847,17 @@ def _run_child(name):
         print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}))
 
 
-# llama bench fallback ladder: (batch, hidden, layers, intermediate).
-# Tried in order, each in a FRESH subprocess (TPU OOM poisons the client).
-# Ordered by expected MFU: with the per-step h2d fix the step is
-# device-bound, so larger batches amortize the optimizer update (whose
-# cost is per-param, not per-token); the 740M config's optimizer state
-# (10.4GB fp32 master+moments) is tried at batch 4 then 2 before
-# falling to the 325M config at batch 8.
-LLAMA_RUNGS = ((4, 2048, 12, 5504), (2, 2048, 12, 5504),
-               (1, 2048, 12, 5504), (8, 1536, 8, 4096),
-               (4, 1536, 8, 4096), (2, 1024, 8, 2816))
+# llama bench fallback ladder: (batch, hidden, layers, intermediate,
+# accumulate_steps). Tried in order, each in a FRESH subprocess (TPU OOM
+# poisons the client). Ordered by expected MFU: with the per-step h2d
+# fix the step is device-bound, so more tokens per optimizer apply
+# (batch x accumulation) amortize the per-param update; accumulation is
+# kept moderate on the 740M rungs (the fp32 grad accumulator adds 3GB
+# next to the 10.4GB optimizer state).
+LLAMA_RUNGS = ((4, 2048, 12, 5504, 2), (2, 2048, 12, 5504, 2),
+               (1, 2048, 12, 5504, 2), (8, 1536, 8, 4096, 2),
+               (4, 1536, 8, 4096, 4), (2, 1024, 8, 2816, 4),
+               (2, 1024, 8, 2816, 1))
 
 # resnet50 batch sweep (config "resnet50_sweep"): find the
 # throughput-optimal batch on the chip, one FRESH subprocess per batch
