@@ -988,8 +988,10 @@ def _merge_opportunistic(out):
     could not measure."""
     if out.get("value", 0) == 0:
         _attach_probe_evidence(out)
-    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "BENCH_OPPORTUNISTIC.json")
+    path = os.environ.get(
+        "BENCH_OPP_PATH",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "BENCH_OPPORTUNISTIC.json"))
     try:
         with open(path) as f:
             opp = json.load(f)
