@@ -366,3 +366,24 @@ def test_shared_cache_dir_two_models_no_eviction(tmp_path):
     preds[0]._prune_stale()
     preds[1]._prune_stale()
     assert count_pdexec() == n_after_both
+
+
+def test_generate_paged_chunk_size_invariant(monkeypatch):
+    """Chunked decode (PADDLE_TPU_DECODE_CHUNK) must not change results:
+    a chunk boundary is only a host dispatch boundary."""
+    import jax
+    from paddle_tpu.inference.generation import (GenerationConfig,
+                                                 generate_paged)
+    from paddle_tpu.models.llama import LlamaConfig, init_params
+    cfg = LlamaConfig(vocab_size=97, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=64)
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    ids = np.random.RandomState(0).randint(0, 97, (2, 7)).astype(np.int32)
+    g = GenerationConfig(max_new_tokens=9, greedy=True)
+    outs = []
+    for chunk in ("2", "64"):
+        monkeypatch.setenv("PADDLE_TPU_DECODE_CHUNK", chunk)
+        outs.append(np.asarray(generate_paged(params, ids, cfg, g,
+                                              block_size=4)))
+    np.testing.assert_array_equal(outs[0], outs[1])
