@@ -122,7 +122,7 @@ def bench_resnet50(steps=20, batch=256, amp_level=None):
 
 
 def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
-                inter=5504):
+                inter=5504, accumulate=None):
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models.llama import (LlamaConfig, init_params, loss_fn,
@@ -135,10 +135,11 @@ def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
                       num_attention_heads=hidden // 128,
                       num_key_value_heads=hidden // 128,
                       max_position_embeddings=seq)
-    # BENCH_LLAMA_ACC>1: micro-batch gradient accumulation (reference
-    # Fleet accumulate_steps) — amortizes the per-param optimizer pass
-    # over acc micro-batches of tokens
-    acc = int(os.environ.get("BENCH_LLAMA_ACC", "1"))
+    # accumulate>1: micro-batch gradient accumulation (reference Fleet
+    # accumulate_steps) — amortizes the per-param optimizer pass over
+    # acc micro-batches of tokens
+    acc = accumulate if accumulate is not None \
+        else int(os.environ.get("BENCH_LLAMA_ACC", "1"))
     mesh = make_mesh(MeshConfig())
     params = init_params(cfg, jax.random.PRNGKey(0))
     n_params = sum(v.size for v in jax.tree_util.tree_leaves(params))
@@ -831,10 +832,11 @@ def _run_child(name):
         lsteps = int(os.environ.get("BENCH_LLAMA_STEPS", "8"))
         rung = int(os.environ.get("BENCH_LLAMA_RUNG", "0"))
         lb, h, L, it, acc = LLAMA_RUNGS[min(rung, len(LLAMA_RUNGS) - 1)]
-        os.environ.setdefault("BENCH_LLAMA_ACC", str(acc))
+        if "BENCH_LLAMA_ACC" in os.environ:   # explicit operator override
+            acc = int(os.environ["BENCH_LLAMA_ACC"])
         try:
             r = bench_llama(steps=lsteps, batch=lb, hidden=h, layers=L,
-                            inter=it)
+                            inter=it, accumulate=acc)
             r["rung"] = rung
             print(json.dumps(r))
         except Exception as e:  # noqa: BLE001

@@ -147,6 +147,7 @@ def sample_token(logits, key, gen: GenerationConfig):
 
 
 _RUN_CACHE: Dict = {}
+_PAGED_CACHE: Dict = {}
 _KEY_CACHE: Dict = {}
 
 
@@ -221,6 +222,40 @@ def generate(params: Dict, input_ids, cfg: _llama.LlamaConfig,
 # ---------------------------------------------------------------------------
 # Paged-KV serving path
 # ---------------------------------------------------------------------------
+def _paged_chunk_runner(cfg, gen):
+    """Jitted n-step decode scan, cached per (cfg values, gen values) —
+    a fresh jit per generate_paged call would re-trace the whole L-layer
+    scan every serving request."""
+    ck = (dataclasses.astuple(cfg), dataclasses.astuple(gen))
+    cached = _PAGED_CACHE.get(ck)
+    if cached is not None:
+        return cached
+
+    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(5, 6))
+    def chunk_fn(n, params, tok, key, done, k_pools, v_pools, seq_lens,
+                 block_tables):
+        def body(carry, _):
+            tok, key, done, seq_lens, kp, vp = carry
+            logits, kp, vp = _paged_decode_step(
+                params, tok, cfg, kp, vp, block_tables, seq_lens)
+            key, sub = jax.random.split(key)
+            nxt = sample_token(logits, sub, gen)
+            nxt = jnp.where(done, gen.eos_token_id, nxt)
+            done = done | (nxt == gen.eos_token_id)
+            return (nxt, key, done, seq_lens + 1, kp, vp), nxt
+
+        carry, toks = jax.lax.scan(
+            body, (tok, key, done, seq_lens, k_pools, v_pools), None,
+            length=n)
+        tok, key, done, seq_lens, k_pools, v_pools = carry
+        return toks, tok, key, done, seq_lens, k_pools, v_pools
+
+    if len(_PAGED_CACHE) > 16:
+        _PAGED_CACHE.pop(next(iter(_PAGED_CACHE)))
+    _PAGED_CACHE[ck] = chunk_fn
+    return chunk_fn
+
+
 def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
                        seq_lens):
     """One decode token per sequence over paged pools.
@@ -337,33 +372,18 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     # loop paid eager sampling ops plus a BLOCKING np.asarray d2h per
     # token — ~1s/token through the axon tunnel. Between chunks the host
     # can still reclaim finished sequences (the vLLM-style scheduling
-    # point the reference's AnalysisPredictor has).
-    @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(5, 6))
-    def chunk_fn(n, params, tok, key, done, k_pools, v_pools, seq_lens,
-                 block_tables):
-        def body(carry, _):
-            tok, key, done, seq_lens, kp, vp = carry
-            logits, kp, vp = _paged_decode_step(
-                params, tok, cfg, kp, vp, block_tables, seq_lens)
-            key, sub = jax.random.split(key)
-            nxt = sample_token(logits, sub, gen)
-            nxt = jnp.where(done, gen.eos_token_id, nxt)
-            done = done | (nxt == gen.eos_token_id)
-            return (nxt, key, done, seq_lens + 1, kp, vp), nxt
+    # point the reference's AnalysisPredictor has). The jitted chunk
+    # runner is cached per (config values, sampling knobs) like
+    # generate()'s — shapes and the static n key jit's own cache.
+    chunk_fn = _paged_chunk_runner(cfg, gen)
 
-        carry, toks = jax.lax.scan(
-            body, (tok, key, done, seq_lens, k_pools, v_pools), None,
-            length=n)
-        tok, key, done, seq_lens, k_pools, v_pools = carry
-        return toks, tok, key, done, seq_lens, k_pools, v_pools
-
-    key = jax.random.key(seed)
+    key = _key_for(seed)
     tok = sample_token(logits[:, -1], key, gen)
     done = tok == gen.eos_token_id
     chunks = [tok[:, None]]
     seq_lens = jnp.full((B,), S, jnp.int32)
     bt = jnp.asarray(tables, jnp.int32)
-    chunk = int(os.environ.get("PADDLE_TPU_DECODE_CHUNK", "32"))
+    chunk = max(1, int(os.environ.get("PADDLE_TPU_DECODE_CHUNK", "32")))
     left = gen.max_new_tokens - 1
     while left > 0:
         n = min(chunk, left)
