@@ -405,3 +405,38 @@ class TestFusedOptimizerPath:
         for k in params:
             np.testing.assert_allclose(outs[True][2][k], outs[False][2][k],
                                        rtol=1e-4, atol=1e-5)
+
+    def test_fused_with_nan_check(self):
+        """FLAGS_check_nan_inf rebuilds the step without donation; the
+        fused path must survive the rebuild and report finite metrics."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec
+        from paddle_tpu.core.flags import GLOBAL_FLAGS
+        from paddle_tpu.distributed.trainer import (MeshConfig, Trainer,
+                                                    make_mesh)
+
+        def loss_fn(p, x):
+            return jnp.mean(jnp.square(x @ p["w"]))
+
+        rng = np.random.RandomState(1)
+        params = {"w": jnp.asarray(rng.randn(8, 4), jnp.float32)}
+        mesh = make_mesh(MeshConfig())
+        tr = Trainer(loss_fn, mesh, {"w": PartitionSpec()}, lr=1e-2,
+                     fused_optimizer=True)
+        st = tr.init_state(params)
+        x = jnp.asarray(rng.randn(16, 8), jnp.float32)
+        GLOBAL_FLAGS.set("check_nan_inf", True)
+        try:
+            st, m = tr.step(st, x)
+            assert np.isfinite(float(m["loss"]))
+            # a poisoned batch must raise, not silently update
+            bad = x.at[0, 0].set(jnp.nan)
+            try:
+                tr.step(st, bad)
+                raised = False
+            except FloatingPointError:
+                raised = True
+            assert raised
+        finally:
+            GLOBAL_FLAGS.set("check_nan_inf", False)
