@@ -387,3 +387,24 @@ def test_generate_paged_chunk_size_invariant(monkeypatch):
         outs.append(np.asarray(generate_paged(params, ids, cfg, g,
                                               block_size=4)))
     np.testing.assert_array_equal(outs[0], outs[1])
+
+
+def test_generate_paged_runner_cached_across_calls():
+    """The jitted chunk runner must be reused across serving requests
+    (a fresh jit per call re-traces the whole decode scan)."""
+    import jax
+    from paddle_tpu.inference import generation as G
+    from paddle_tpu.models.llama import LlamaConfig, init_params
+    cfg = LlamaConfig(vocab_size=61, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      num_key_value_heads=2, max_position_embeddings=32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ids = np.zeros((1, 4), np.int32)
+    g = G.GenerationConfig(max_new_tokens=3, greedy=True)
+    G._PAGED_CACHE.clear()
+    G.generate_paged(params, ids, cfg, g, block_size=4)
+    assert len(G._PAGED_CACHE) == 1
+    runner = next(iter(G._PAGED_CACHE.values()))
+    G.generate_paged(params, ids, cfg, g, block_size=4)
+    assert len(G._PAGED_CACHE) == 1
+    assert next(iter(G._PAGED_CACHE.values())) is runner
