@@ -48,10 +48,16 @@ print("PROBE_OK", d)
 """
 
 
+_PROBE_RESULT = {}
+
+
 def _require_chip():
-    rc, out, err = _run_on_chip(PROBE, timeout=120)
-    if rc != 0 or "PROBE_OK" not in out:
-        pytest.skip(f"no live TPU backend (rc={rc})")
+    if "ok" not in _PROBE_RESULT:   # one probe per test run, not per test
+        rc, out, err = _run_on_chip(PROBE, timeout=120)
+        _PROBE_RESULT["ok"] = rc == 0 and "PROBE_OK" in out
+        _PROBE_RESULT["rc"] = rc
+    if not _PROBE_RESULT["ok"]:
+        pytest.skip(f"no live TPU backend (rc={_PROBE_RESULT['rc']})")
 
 
 def test_pallas_pack_compiles_on_chip():
