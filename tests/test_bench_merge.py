@@ -27,7 +27,11 @@ def _write(path, data):
         json.dump(data, f)
 
 
-NOW_ISO = time.strftime("%Y-%m-%dT%H:%M:%S")
+def _now_iso():
+    # computed at CALL time: module-import time can precede test
+    # execution by the whole suite's runtime under xdist, making a
+    # "fresh" capture look stale
+    return time.strftime("%Y-%m-%dT%H:%M:%S")
 
 
 def test_failed_live_run_served_from_capture(opp_file):
@@ -35,9 +39,9 @@ def test_failed_live_run_served_from_capture(opp_file):
         "resnet50": {"metric": "resnet50_train_imgs_per_sec_per_chip",
                      "value": 2235.9, "unit": "imgs/sec/chip",
                      "vs_baseline": 0.894},
-        "resnet50_iso": NOW_ISO,
+        "resnet50_iso": _now_iso(),
         "llama": {"value": 2847.3, "mfu": 0.03},
-        "llama_iso": NOW_ISO, "t": time.time()})
+        "llama_iso": _now_iso(), "t": time.time()})
     out = {"metric": "resnet50_train_imgs_per_sec_per_chip",
            "value": 0.0, "unit": "imgs/sec/chip", "vs_baseline": 0.0}
     bench._merge_opportunistic(out)
@@ -50,7 +54,7 @@ def test_failed_live_run_served_from_capture(opp_file):
 def test_fresh_sweep_overrides_slower_live_number(opp_file):
     _write(opp_file, {
         "resnet50_sweep": {"value": 2600.0, "batch": 512},
-        "resnet50_sweep_iso": NOW_ISO, "t": time.time()})
+        "resnet50_sweep_iso": _now_iso(), "t": time.time()})
     out = {"value": 2200.0, "unit": "imgs/sec/chip"}
     bench._merge_opportunistic(out)
     assert out["value"] == 2600.0
@@ -59,7 +63,7 @@ def test_fresh_sweep_overrides_slower_live_number(opp_file):
 def test_slower_sweep_does_not_override_live(opp_file):
     _write(opp_file, {
         "resnet50_sweep": {"value": 2000.0},
-        "resnet50_sweep_iso": NOW_ISO, "t": time.time()})
+        "resnet50_sweep_iso": _now_iso(), "t": time.time()})
     out = {"value": 2200.0, "unit": "imgs/sec/chip"}
     bench._merge_opportunistic(out)
     assert out["value"] == 2200.0
@@ -78,7 +82,7 @@ def test_stale_sweep_does_not_mask_live_regression(opp_file):
 
 def test_live_config_result_not_clobbered(opp_file):
     _write(opp_file, {
-        "llama": {"value": 1.0}, "llama_iso": NOW_ISO, "t": time.time()})
+        "llama": {"value": 1.0}, "llama_iso": _now_iso(), "t": time.time()})
     out = {"value": 2200.0, "llama": {"value": 40000.0, "mfu": 0.5}}
     bench._merge_opportunistic(out)
     assert out["llama"]["value"] == 40000.0
