@@ -425,3 +425,43 @@ class TestFlashDropout:
                 dropout_seed=jnp.asarray(100 + i, jnp.uint32)))
         err = np.abs(acc / N - o0).mean() / (np.abs(o0).mean() + 1e-9)
         assert err < 0.15, err
+
+
+def test_autotune_cache_key_matches_tuned_blocks():
+    """bench's flash_tune reports winners via autotune_cache_key; it must
+    stay byte-identical to the key _tuned_blocks writes, or the sweep
+    silently reports None winners after a key-format change."""
+    import jax
+    import jax.numpy as jnp
+    from unittest import mock
+    from paddle_tpu.ops.pallas import flash_attention as F
+
+    q = jnp.zeros((8, 2048, 128), jnp.bfloat16)   # folded [b*h, s, d]
+    k = jnp.zeros((4, 2048, 128), jnp.bfloat16)
+    seen = {}
+
+    def fake_get(ck):
+        seen["ck"] = ck
+        return None
+
+    with mock.patch.object(F, "autotune_cache_key",
+                           wraps=F.autotune_cache_key):
+        with mock.patch.object(
+                __import__("paddle_tpu.ops.pallas.autotune",
+                           fromlist=["_cache"])._cache, "get",
+                side_effect=fake_get):
+            from paddle_tpu.core.flags import GLOBAL_FLAGS
+            prev = GLOBAL_FLAGS.get("kernel_autotune")
+            GLOBAL_FLAGS.set("kernel_autotune", True)
+            try:
+                # traced call -> reads the cache via the internal key
+                jax.eval_shape(
+                    lambda q, k: F._tuned_blocks(
+                        q, k, k, None, None, None, 1.0, True,
+                        (8, 4, 2048, 2048, 128, 1.0, True)) or (1, 1),
+                    q, k)
+            finally:
+                GLOBAL_FLAGS.set("kernel_autotune", prev)
+    expect = F.autotune_cache_key(8, 2048, 2048, 4, 128, True,
+                                  "bfloat16")
+    assert seen.get("ck") == expect, (seen.get("ck"), expect)
