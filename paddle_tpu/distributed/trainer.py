@@ -82,12 +82,14 @@ def _adamw_update(grads, state: Tuple, lr, b1=0.9, b2=0.95, eps=1e-8,
 
     def upd(g, m, mu_i, nu_i):
         g32 = g.astype(jnp.float32) * scale
-        mu_n = b1 * mu_i + (1 - b1) * g32
-        nu_n = b2 * nu_i + (1 - b2) * jnp.square(g32)
+        mu_n = b1 * mu_i.astype(jnp.float32) + (1 - b1) * g32
+        nu_n = b2 * nu_i.astype(jnp.float32) + (1 - b2) * jnp.square(g32)
         mhat = mu_n / (1 - b1 ** step)
         vhat = nu_n / (1 - b2 ** step)
         m_n = m * (1.0 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps)
-        return m_n, mu_n, nu_n
+        # moments keep their stored dtype (bf16 under a reduced
+        # moment_dtype policy) so state shapes/dtypes are step-invariant
+        return m_n, mu_n.astype(mu_i.dtype), nu_n.astype(nu_i.dtype)
 
     flat_g = jax.tree_util.tree_leaves(grads)
     flat_m = jax.tree_util.tree_leaves(master)
@@ -114,17 +116,28 @@ class Trainer:
                  lr=3e-4, b1=0.9, b2=0.95, weight_decay=0.1,
                  grad_clip=1.0, accumulate_steps: int = 1,
                  donate: bool = True,
-                 fused_optimizer: Optional[bool] = None):
+                 fused_optimizer: Optional[bool] = None,
+                 moment_dtype=None):
         """loss_fn(params, *batch) -> scalar. param_specs: pytree of
         PartitionSpec matching params.
 
         fused_optimizer: None = auto. On a single-device mesh the AdamW
         update runs as ONE Pallas multi-tensor pass over flat fp32
-        master/moment state with the bf16 shadow written in the same
-        pass (reference fused_adam_kernel.cu semantics). XLA's per-leaf
-        update measured ~50ms on a 325M model where the HBM bound is
-        ~11ms. On multi-device meshes the per-leaf path keeps every
-        state tensor sharded like its param, so it stays the default.
+        master/moment state with the low-precision shadow written in
+        the same pass (reference fused_adam_kernel.cu semantics). XLA's
+        per-leaf update measured ~50ms on a 325M model where the HBM
+        bound is ~11ms. On multi-device meshes the per-leaf path keeps
+        every state tensor sharded like its param, so it stays the
+        default. Mixed floating param trees (bf16 weights + fp32 norms,
+        the llama layout) are supported: fp32 leaves are sliced back
+        from the fp32 master, shadow-dtype leaves from the shadow.
+
+        moment_dtype: storage dtype for the AdamW mu/nu state (None =
+        fp32). bfloat16 halves optimizer-state HBM (10 -> 6 bytes per
+        param next to the fp32 master), the policy that lets the
+        single-chip ladder climb past ~1B params on 16GB; the update
+        math still runs in fp32 (reference multi_precision AdamW,
+        python/paddle/optimizer/adamw.py _multi_precision path).
         """
         self.loss_fn = loss_fn
         self.mesh = mesh
@@ -138,8 +151,24 @@ class Trainer:
         self._fused_opt = fused_optimizer
         self._fused = False
         self._flat_meta = None
+        self.moment_dtype = moment_dtype
 
     # -- state init ----------------------------------------------------------
+    @staticmethod
+    def _fused_tree_ok(params) -> bool:
+        """Param-tree eligibility for the flat fused path: non-empty,
+        all-floating, and at most ONE dtype besides fp32 — fp32 leaves
+        slice back from the fp32 master, the rest from the single
+        low-precision shadow (llama's bf16-weights + fp32-norms layout).
+        Shared by auto-decide and the forced-path validation so the two
+        can never drift."""
+        leaves = jax.tree_util.tree_leaves(params)
+        non_f32 = {v.dtype for v in leaves} - {jnp.dtype(jnp.float32)}
+        return (len(leaves) > 0
+                and all(jnp.issubdtype(v.dtype, jnp.floating)
+                        for v in leaves)
+                and len(non_f32) <= 1)
+
     def _decide_fused(self, params) -> bool:
         if self._fused_opt is not None:
             return bool(self._fused_opt)
@@ -147,11 +176,7 @@ class Trainer:
             return False   # per-leaf path keeps state sharded like params
         if jax.default_backend() not in ("tpu", "axon"):
             return False   # interpret-mode pallas would be slower than XLA
-        leaves = jax.tree_util.tree_leaves(params)
-        return (len(leaves) > 0
-                and all(jnp.issubdtype(v.dtype, jnp.floating)
-                        for v in leaves)
-                and len({v.dtype for v in leaves}) == 1)
+        return self._fused_tree_ok(params)
 
     def init_state(self, params) -> TrainState:
         shard = lambda tree: jax.tree_util.tree_map(
@@ -159,37 +184,66 @@ class Trainer:
             tree, self.param_specs)
         params = shard(params)
         self._fused = self._decide_fused(params)
+        if self._fused and self._fused_opt:
+            # forced fused path must still satisfy _decide_fused's
+            # preconditions: flat unsharded state on a multi-device mesh
+            # silently drops FSDP sharding (and likely OOMs), and a
+            # mixed-dtype tree would cast every leaf to leaves[0].dtype
+            if self.mesh.devices.size != 1:
+                raise ValueError(
+                    "fused_optimizer=True builds flat UNSHARDED "
+                    "master/moment state — unsupported on a "
+                    f"{self.mesh.devices.size}-device mesh (param "
+                    "sharding would be lost). Use fused_optimizer=None "
+                    "(auto) or False.")
+            if not self._fused_tree_ok(params):
+                dts = sorted({str(v.dtype) for v in
+                              jax.tree_util.tree_leaves(params)})
+                raise ValueError(
+                    "fused_optimizer=True requires a non-empty param "
+                    "tree of floating dtype with at most one dtype "
+                    f"besides float32 (one flat shadow); got {dts}.")
         step = jnp.zeros((), jnp.int32)
+        mdt = self.moment_dtype or jnp.float32
         if self._fused:
             leaves = jax.tree_util.tree_leaves(params)
             n = sum(int(np.prod(v.shape)) for v in leaves)
             # pad the flat state to a kernel-block multiple: an awkward
-            # total would force fused_adamw's largest-divisor fallback
-            # onto a tiny block and a huge sequential grid. Padding tail
-            # sees zero grads, so its moments stay zero.
+            # total would force fused_adamw onto its internal padding
+            # path every step. Padding tail sees zero grads, so its
+            # moments stay zero.
             blk = 131072
-            pad = (-n) % blk   # unconditional: a small awkward n would
-            # otherwise walk the largest-divisor loop down to block=1
+            pad = (-n) % blk
+            # one low-precision shadow dtype; fp32 leaves slice back
+            # from the master itself (exact) so an all-fp32 tree needs
+            # no shadow output at all
+            non_f32 = [v.dtype for v in leaves
+                       if v.dtype != jnp.dtype(jnp.float32)]
+            pdtype = non_f32[0] if non_f32 else None
             self._flat_meta = (
                 jax.tree_util.tree_structure(params),
                 [v.shape for v in leaves],
                 [int(np.prod(v.shape)) for v in leaves],
-                leaves[0].dtype,
+                pdtype,
                 pad,
+                [v.dtype for v in leaves],
             )
             master = jnp.concatenate(
                 [jnp.ravel(v).astype(jnp.float32) for v in leaves]
                 + ([jnp.zeros((pad,), jnp.float32)] if pad else []))
-            mu = jnp.zeros_like(master)
-            nu = jnp.zeros_like(master)
+            mu = jnp.zeros(master.shape, mdt)
+            nu = jnp.zeros(master.shape, mdt)
             return TrainState(params, master, mu, nu, step)
         # copy=True: when params are already fp32, astype would alias the
         # same buffer and double-donation breaks Execute()
         master = jax.tree_util.tree_map(
             lambda v: jnp.array(v, dtype=jnp.float32, copy=True), params)
         master = shard(master)
-        mu = jax.tree_util.tree_map(jnp.zeros_like, master)
-        nu = jax.tree_util.tree_map(jnp.zeros_like, master)
+        mu = jax.tree_util.tree_map(
+            lambda v: jnp.zeros(v.shape, mdt), master)
+        nu = jax.tree_util.tree_map(
+            lambda v: jnp.zeros(v.shape, mdt), master)
+        mu, nu = shard(mu), shard(nu)
         return TrainState(params, master, mu, nu, step)
 
     # -- compiled step -------------------------------------------------------
@@ -247,24 +301,40 @@ class Trainer:
         back into the param tree shapes."""
         from ..ops.pallas.fused_adamw import fused_adamw
         hp = self.hp
-        treedef, shapes, sizes, pdtype, pad = self._flat_meta
+        treedef, shapes, sizes, pdtype, pad, dtypes = self._flat_meta
         _, master, mu, nu, step = state_tree
         step_n = step + 1
         g_leaves = jax.tree_util.tree_leaves(grads)
+        # concat dtype: the low-precision dtype ONLY when every grad
+        # already carries it (lossless, halves the flat grad's HBM).
+        # A mixed tree concats in fp32 — truncating the fp32 leaves'
+        # grads to bf16 would break the exactness the fp32-master
+        # slice-back promises and skew the global clip norm.
+        leaf_dts = {g.dtype for g in g_leaves}
+        gdt = (pdtype if pdtype is not None
+               and leaf_dts == {jnp.dtype(pdtype)} else jnp.float32)
         g_flat = jnp.concatenate(
-            [jnp.ravel(g) for g in g_leaves]
-            + ([jnp.zeros((pad,), g_leaves[0].dtype)] if pad else []))
+            [jnp.ravel(g).astype(gdt) for g in g_leaves]
+            + ([jnp.zeros((pad,), gdt)] if pad else []))
         gnorm = jnp.sqrt(jnp.sum(jnp.square(g_flat.astype(jnp.float32))))
         scale = jnp.minimum(1.0, hp["grad_clip"]
                             / jnp.maximum(gnorm, 1e-12)) \
             if hp["grad_clip"] else jnp.float32(1.0)
-        master_n, mu_n, nu_n, shadow = fused_adamw(
+        outs = fused_adamw(
             master, g_flat, mu, nu, lr, step_n.astype(jnp.float32),
             beta1=hp["b1"], beta2=hp["b2"], epsilon=1e-8,
             weight_decay=hp["wd"], grad_scale=scale, shadow_dtype=pdtype)
+        if pdtype is not None:
+            master_n, mu_n, nu_n, shadow = outs
+        else:
+            master_n, mu_n, nu_n = outs
+            shadow = master_n
         leaves, off = [], 0
-        for shp, sz in zip(shapes, sizes):
-            leaves.append(jax.lax.slice(shadow, (off,),
+        for shp, sz, dt in zip(shapes, sizes, dtypes):
+            # fp32 leaves come back exact from the master; the rest from
+            # the single low-precision shadow written in the same pass
+            src = master_n if dt == jnp.dtype(jnp.float32) else shadow
+            leaves.append(jax.lax.slice(src, (off,),
                                         (off + sz,)).reshape(shp))
             off += sz
         params_n = jax.tree_util.tree_unflatten(treedef, leaves)
@@ -288,6 +358,25 @@ class Trainer:
             except Exception:  # noqa: BLE001 — conservative: fall through
                 pass
         return jax.device_put(b, target)
+
+    def prefetch(self, batches, depth: int = 2):
+        """Double-buffered ingest (reference:
+        python/paddle/io/dataloader/dataloader_iter.py:368 buffer
+        reader): yields batches already staged onto the mesh with the
+        trainer's data sharding while the NEXT batch's h2d transfer runs
+        behind the CURRENT step's compute, so steady-state step time is
+        max(compute, transfer) instead of compute + transfer. ``batches``
+        yields a tuple/list per step (the ``*batch`` of :meth:`step`) or
+        a single array."""
+        from ..io.dataloader import _DevicePrefetchIter
+
+        def stage(b):
+            if isinstance(b, (tuple, list)):
+                return tuple(self._stage_batch(x) for x in b)
+            return self._stage_batch(b)
+
+        return _DevicePrefetchIter(iter(batches), stage,
+                                   depth=max(1, depth))
 
     def step(self, state: TrainState, *batch) -> Tuple[TrainState, Dict]:
         from ..core.flags import GLOBAL_FLAGS

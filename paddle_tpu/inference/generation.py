@@ -151,10 +151,22 @@ _PAGED_CACHE: Dict = {}
 _KEY_CACHE: Dict = {}
 
 
+def _cache_get(cache: Dict, key):
+    """LRU read: re-insert on hit so dict order tracks recency — with
+    plain FIFO eviction the hottest serving shape can be the oldest
+    entry and get evicted on every insertion (a ~1s retrace per
+    request, exactly what these caches exist to prevent)."""
+    hit = cache.get(key)
+    if hit is not None:
+        del cache[key]
+        cache[key] = hit
+    return hit
+
+
 def _key_for(seed: int):
     """One 8-byte h2d per distinct seed, not per call (the axon tunnel
     charges ~1s per blocking transfer)."""
-    k = _KEY_CACHE.get(seed)
+    k = _cache_get(_KEY_CACHE, seed)
     if k is None:
         if len(_KEY_CACHE) > 64:
             _KEY_CACHE.pop(next(iter(_KEY_CACHE)))
@@ -182,7 +194,7 @@ def generate(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     # tunnel roundtrips. Value-keying keeps a mutated cfg from serving
     # stale traced constants
     ck = (dataclasses.astuple(cfg), B, S, dataclasses.astuple(gen))
-    cached = _RUN_CACHE.get(ck)
+    cached = _cache_get(_RUN_CACHE, ck)
     if cached is not None:
         return cached(params, input_ids, _key_for(seed))
 
@@ -226,8 +238,13 @@ def _paged_chunk_runner(cfg, gen):
     """Jitted n-step decode scan, cached per (cfg values, gen values) —
     a fresh jit per generate_paged call would re-trace the whole L-layer
     scan every serving request."""
-    ck = (dataclasses.astuple(cfg), dataclasses.astuple(gen))
-    cached = _PAGED_CACHE.get(ck)
+    from ..core.flags import GLOBAL_FLAGS
+    # the kernel-route flag is traced INTO the compiled scan, so it must
+    # key the cache — an A/B flip (bench_paged_decode) would otherwise
+    # silently reuse the first-compiled path
+    ck = (dataclasses.astuple(cfg), dataclasses.astuple(gen),
+          bool(GLOBAL_FLAGS.get("use_paged_kernel")))
+    cached = _cache_get(_PAGED_CACHE, ck)
     if cached is not None:
         return cached
 

@@ -52,6 +52,82 @@ def default_collate_fn(batch):
     raise TypeError(f"cannot collate batch of type {type(sample)}")
 
 
+class _DevicePrefetchIter:
+    """Double-buffered async H2D stage (reference:
+    python/paddle/io/dataloader/dataloader_iter.py:368 — pin-memory +
+    buffer-reader thread hiding ingest behind compute). A dedicated
+    thread pulls host batches from ``src``, stages them on device
+    (``jax.device_put``), and keeps up to ``depth`` staged batches
+    queued ahead of the consumer, so the transfer for batch N+1 runs
+    while the step consuming batch N computes. One thread serializes
+    transfers — deliberate: concurrent h2d streams contend for the
+    same PCIe/tunnel bandwidth without helping latency."""
+
+    _END = ("end", None)
+
+    def __init__(self, src, stage, depth=2):
+        self.q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._done = False
+        self._src = src
+        self._stage = stage
+        self._thread = threading.Thread(
+            target=self._run, name="device-prefetch", daemon=True)
+        self._thread.start()
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self.q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self):
+        try:
+            for host_batch in self._src:
+                if self._stop.is_set():
+                    return
+                if not self._put(("item", self._stage(host_batch))):
+                    return
+            self._put(self._END)
+        except BaseException as e:  # noqa: BLE001 — relayed to consumer
+            self._put(("err", e))
+
+    def __next__(self):
+        # after an error was relayed (or close()), the producer thread
+        # is gone and nothing will ever be enqueued again — a blocking
+        # get() would deadlock a consumer that catches the error and
+        # keeps iterating; terminate the iteration instead
+        if self._done:
+            raise StopIteration
+        while True:
+            try:
+                kind, payload = self.q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if self._stop.is_set():
+                    self._done = True
+                    raise StopIteration from None
+        if kind == "item":
+            return payload
+        self._done = True
+        self._stop.set()
+        if kind == "err":
+            raise payload
+        raise StopIteration
+
+    def __iter__(self):
+        return self
+
+    def close(self):
+        self._stop.set()
+
+    def __del__(self):
+        self._stop.set()
+
+
 class _PrefetchIter:
     def __init__(self, loader, index_iter):
         self.loader = loader
@@ -67,7 +143,12 @@ class _PrefetchIter:
         ds = self.loader.dataset
         samples = [ds[i] for i in indices]
         batch = self.loader.collate_fn(samples)
-        return self.loader._to_device(batch)
+        # pooled workers stage to device in-thread (overlapped there);
+        # the synchronous num_workers=0 path returns the host batch and
+        # lets DataLoader.__iter__ wrap it in _DevicePrefetchIter
+        if self.pool is not None:
+            return self.loader._to_device(batch)
+        return batch
 
     def _fill(self):
         while len(self.pending) < self.prefetch:
@@ -274,7 +355,7 @@ class _ProcessPoolIter:
         batch = self.buffer.pop(self.next_idx)
         self.next_idx += 1
         self._fill()
-        return self.loader._to_device(batch)
+        return batch
 
     def _shutdown(self):
         for _ in self.workers:
@@ -310,8 +391,7 @@ class _IterableDatasetIter:
         if self.loader.drop_last and \
                 len(samples) < self.loader.batch_size:
             raise StopIteration
-        batch = self.loader.collate_fn(samples)
-        return self.loader._to_device(batch)
+        return self.loader.collate_fn(samples)
 
     def __iter__(self):
         return self
@@ -369,10 +449,19 @@ class DataLoader:
 
     def __iter__(self):
         if self._is_iterable:
-            return _IterableDatasetIter(self)
-        if self.worker_type == "process" and self.num_workers > 0:
-            return _ProcessPoolIter(self, iter(self.batch_sampler))
-        return _PrefetchIter(self, iter(self.batch_sampler))
+            inner = _IterableDatasetIter(self)
+        elif self.worker_type == "process" and self.num_workers > 0:
+            inner = _ProcessPoolIter(self, iter(self.batch_sampler))
+        else:
+            inner = _PrefetchIter(self, iter(self.batch_sampler))
+            if inner.pool is not None:
+                # thread workers already stage to device in-pool; their
+                # futures run ahead of the consumer, so h2d is overlapped
+                return inner
+        if not self.prefetch_to_device:
+            return map(_to_tensors, inner)
+        return _DevicePrefetchIter(inner, self._to_device,
+                                   depth=max(self.prefetch_factor, 1))
 
     def __len__(self):
         if self._is_iterable:

@@ -24,6 +24,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core.flags import GLOBAL_FLAGS as _FLAGS
+
+_FLAGS.define(
+    "use_paged_kernel", True,
+    "route paged-KV decode attention through the Pallas kernel on TPU "
+    "(0 = XLA gather+einsum composition, for A/B perf diagnosis)")
+
 
 def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
                            scale: Optional[float] = None):
@@ -39,7 +46,9 @@ def paged_attention_decode(q, k_pool, v_pool, block_tables, seq_lens,
     that streams pages through VMEM via scalar-prefetched block tables; the
     gather+einsum below is the reference-numerics fallback.
     """
-    if jax.default_backend() in ("tpu", "axon"):
+    from ..core.flags import GLOBAL_FLAGS
+    if jax.default_backend() in ("tpu", "axon") and \
+            GLOBAL_FLAGS.get("use_paged_kernel"):
         try:
             from .pallas.paged_attention import paged_attention_decode_pallas
             return paged_attention_decode_pallas(

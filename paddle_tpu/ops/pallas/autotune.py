@@ -81,10 +81,15 @@ def _sync(x):
     np.asarray(jax.device_get(leaf)).ravel()[:1]
 
 
-def autotune(op_name: str, key: Tuple, candidates: Sequence[Any],
+def autotune(cache_key: str, candidates: Sequence[Any],
              build: Callable[[Any], Callable], args: Tuple,
              warmup: int = 1, iters: int = 3):
-    """Pick the fastest candidate config for (op_name, key).
+    """Pick the fastest candidate config for ``cache_key``.
+
+    ``cache_key`` is the pre-formatted persistent-cache key — callers
+    with a traced read path (e.g. flash attention's
+    ``autotune_cache_key``) pass the same string to both the sweep and
+    the read so the two encodings can never drift.
 
     ``build(config) -> fn``; fn(*args) is timed. Returns the winning
     config. With autotune disabled (or in interpret mode) returns
@@ -95,7 +100,7 @@ def autotune(op_name: str, key: Tuple, candidates: Sequence[Any],
     if len(candidates) == 1 or interpret_mode() or \
             not GLOBAL_FLAGS.get("kernel_autotune"):
         return candidates[0]
-    ck = f"{op_name}|{key}"
+    ck = cache_key
     hit = _cache.get(ck)
     if hit is not None:
         # stored as index into the candidate list (configs are static)

@@ -718,8 +718,6 @@ def _tuned_blocks(qt, kt, vt, bias_arg, seg_q, seg_k, s, causal, geom):
     sk = kt.shape[1]
     if sq < 1024 and sk < 1024:
         return None  # single/double block — nothing to tune
-    key = (bh, sq, sk, kt.shape[0], d, causal, str(qt.dtype),
-           bias_arg is not None, seg_q is not None)
     ck = autotune_cache_key(bh, sq, sk, kt.shape[0], d, causal, qt.dtype,
                             bias_arg is not None, seg_q is not None)
     if isinstance(qt, jax.core.Tracer) or interpret_mode() or             not GLOBAL_FLAGS.get("kernel_autotune"):
@@ -737,5 +735,4 @@ def _tuned_blocks(qt, kt, vt, bias_arg, seg_q, seg_k, s, causal, geom):
             return o
         return run
 
-    return autotune("flash_attention", key, list(_BLOCK_CANDIDATES),
-                    build, (qt, kt, vt))
+    return autotune(ck, list(_BLOCK_CANDIDATES), build, (qt, kt, vt))

@@ -28,8 +28,10 @@ def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, bc_ref,
     # which Mosaic fails to legalize) and the grad-clip scale rides along
     # so clipping fuses into the same HBM pass
     g = g_ref[:].astype(jnp.float32) * bc_ref[2]
-    m = m_ref[:]
-    v = v_ref[:]
+    # moments may be stored reduced-precision (bf16 optimizer-state
+    # policy); the update math always runs fp32
+    m = m_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
     lr = lr_ref[0]
     m_n = b1 * m + (1 - b1) * g
     v_n = b2 * v + (1 - b2) * g * g
@@ -37,8 +39,8 @@ def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, bc_ref,
     vhat = v_n * bc_ref[1]
     p_n = p * (1.0 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps)
     p_out[:] = p_n.astype(p_out.dtype)
-    m_out[:] = m_n
-    v_out[:] = v_n
+    m_out[:] = m_n.astype(m_out.dtype)
+    v_out[:] = v_n.astype(v_out.dtype)
     if shadow:
         outs[3][:] = p_n.astype(outs[3].dtype)
 
@@ -56,8 +58,19 @@ def fused_adamw(param, grad, moment1, moment2, lr, step,
     """
     n = param.shape[0]
     block = min(131072, n)
-    while n % block:           # largest divisor: a non-divisible n must
-        block -= 1             # not fall back to a whole-array block
+    # pad to a block multiple rather than shrinking the block: the
+    # largest-divisor fallback degrades to block=1 (a grid of n
+    # sequential invocations) for awkward/prime n from direct callers
+    pad = (-n) % block
+    if pad:
+        param = jnp.concatenate(
+            [param, jnp.zeros((pad,), param.dtype)])
+        grad = jnp.concatenate([grad, jnp.zeros((pad,), grad.dtype)])
+        moment1 = jnp.concatenate(
+            [moment1, jnp.zeros((pad,), moment1.dtype)])
+        moment2 = jnp.concatenate(
+            [moment2, jnp.zeros((pad,), moment2.dtype)])
+        n += pad
     lr_arr = jnp.asarray([lr], jnp.float32)
     t = jnp.asarray(step, jnp.float32)
     scale = jnp.asarray(1.0 if grad_scale is None else grad_scale,
@@ -69,8 +82,8 @@ def fused_adamw(param, grad, moment1, moment2, lr, step,
     out_specs = [pl.BlockSpec((block,), lambda i: (i,)) for _ in range(3)]
     out_shape = [
         jax.ShapeDtypeStruct((n,), param.dtype),
-        jax.ShapeDtypeStruct((n,), jnp.float32),
-        jax.ShapeDtypeStruct((n,), jnp.float32),
+        jax.ShapeDtypeStruct((n,), moment1.dtype),
+        jax.ShapeDtypeStruct((n,), moment2.dtype),
     ]
     if shadow:
         out_specs.append(pl.BlockSpec((block,), lambda i: (i,)))
@@ -92,4 +105,6 @@ def fused_adamw(param, grad, moment1, moment2, lr, step,
         input_output_aliases={0: 0, 2: 1, 3: 2},
         interpret=_interpret(),
     )(param, grad, moment1, moment2, lr_arr, bc_arr)
+    if pad:
+        out = [o[:n - pad] for o in out]
     return out

@@ -32,16 +32,25 @@ def _rms_rows(x):
 
 
 def _row_block(n, d, itemsize):
-    """Largest row-block that divides n and keeps the kernel inside the
-    16MB scoped-VMEM budget. in+out blocks are double-buffered, so a
-    (512, 4096) bf16 block (2 x 2 x 4MB = 16.03MB with the weight) OOMs
-    VMEM on v5e — budget 2MB per block buffer and the fp32 temporaries
-    fit comfortably."""
+    """Row-block that keeps the kernel inside the 16MB scoped-VMEM
+    budget. in+out blocks are double-buffered, so a (512, 4096) bf16
+    block (2 x 2 x 4MB = 16.03MB with the weight) OOMs VMEM on v5e —
+    budget 2MB per block buffer and the fp32 temporaries fit
+    comfortably. Callers pad the row count up to a block multiple
+    (``_pad_rows``) rather than shrinking the block: the old
+    largest-divisor fallback degraded to block=1 for prime n."""
     cap = max(8, (2 * 1024 * 1024) // max(1, d * itemsize))
-    b = min(cap, n)
-    while n % b:
-        b -= 1
-    return b
+    return min(cap, n)
+
+
+def _pad_rows(x2, block):
+    """Pad (n, d) rows to a block multiple; returns (padded, orig_n)."""
+    n = x2.shape[0]
+    pad = (-n) % block
+    if pad:
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((pad, x2.shape[1]), x2.dtype)])
+    return x2, n
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -54,20 +63,20 @@ def _rms_fwd(x, weight, epsilon):
     orig_shape = x.shape
     d = x.shape[-1]
     x2 = _rms_rows(x)
-    n = x2.shape[0]
-    block = _row_block(n, d, x.dtype.itemsize)
+    block = _row_block(x2.shape[0], d, x.dtype.itemsize)
+    x2, n = _pad_rows(x2, block)
     out = pl.pallas_call(
         functools.partial(_rms_fwd_kernel, eps=epsilon),
-        grid=(pl.cdiv(n, block),),
+        grid=(pl.cdiv(x2.shape[0], block),),
         # weight rides as a (1, d) block: Mosaic requires >=2-D blocks with
         # lane-aligned trailing dims; 1-D specs fail to legalize
         in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
                   pl.BlockSpec((1, d), lambda i: (0, 0))],
         out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((x2.shape[0], d), x.dtype),
         interpret=_interpret(),
     )(x2, weight.reshape(1, d))
-    return out.reshape(orig_shape), (x, weight)
+    return out[:n].reshape(orig_shape), (x, weight)
 
 
 def _rms_bwd(epsilon, res, g):
@@ -103,16 +112,16 @@ def layer_norm_pallas(x, weight, bias, epsilon=1e-5):
     orig_shape = x.shape
     d = x.shape[-1]
     x2 = _rms_rows(x)
-    n = x2.shape[0]
-    block = _row_block(n, d, x.dtype.itemsize)
+    block = _row_block(x2.shape[0], d, x.dtype.itemsize)
+    x2, n = _pad_rows(x2, block)
     out = pl.pallas_call(
         functools.partial(_ln_fwd_kernel, eps=epsilon),
-        grid=(pl.cdiv(n, block),),
+        grid=(pl.cdiv(x2.shape[0], block),),
         in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
                   pl.BlockSpec((1, d), lambda i: (0, 0)),
                   pl.BlockSpec((1, d), lambda i: (0, 0))],
         out_specs=pl.BlockSpec((block, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((x2.shape[0], d), x.dtype),
         interpret=_interpret(),
     )(x2, weight.reshape(1, d), bias.reshape(1, d))
-    return out.reshape(orig_shape)
+    return out[:n].reshape(orig_shape)

@@ -406,6 +406,110 @@ class TestFusedOptimizerPath:
             np.testing.assert_allclose(outs[True][2][k], outs[False][2][k],
                                        rtol=1e-4, atol=1e-5)
 
+    def test_forced_fused_rejects_multidevice_and_mixed_dtype(self):
+        """fused_optimizer=True must fail loudly where auto would
+        decline: flat unsharded state on a multi-device mesh silently
+        loses FSDP sharding, and mixed dtypes get silently cast."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec
+        from paddle_tpu.distributed.trainer import (MeshConfig, Trainer,
+                                                    make_mesh)
+
+        def loss_fn(p, x):
+            return jnp.mean(jnp.square(x @ p["w"]))
+
+        devs = np.array(jax.devices()[:2]).reshape(2, 1, 1)
+        mesh2 = Mesh(devs, ("dp", "fsdp", "sp"))
+        tr = Trainer(loss_fn, mesh2, {"w": PartitionSpec()},
+                     fused_optimizer=True)
+        with pytest.raises(ValueError, match="UNSHARDED"):
+            tr.init_state({"w": jnp.ones((4, 4), jnp.float32)})
+
+        mesh1 = make_mesh(MeshConfig())
+        # TWO non-fp32 dtypes: no single shadow can cover both
+        tr = Trainer(lambda p, x: jnp.mean(x @ p["w"]), mesh1,
+                     {"w": PartitionSpec(), "b": PartitionSpec()},
+                     fused_optimizer=True)
+        with pytest.raises(ValueError, match="floating"):
+            tr.init_state({"w": jnp.ones((4, 4), jnp.float16),
+                           "b": jnp.ones((4,), jnp.bfloat16)})
+
+    def test_fused_mixed_dtype_tree_matches_per_leaf(self):
+        """The llama layout (bf16 weights + fp32 norms) must run the
+        fused path: fp32 leaves slice back exact from the master, bf16
+        leaves from the shadow; three steps track the per-leaf update."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec
+        from paddle_tpu.distributed.trainer import (MeshConfig, Trainer,
+                                                    make_mesh)
+
+        def loss_fn(p, x):
+            h = jnp.tanh(x @ p["w"].astype(jnp.float32))
+            return jnp.mean(jnp.square(h * p["scale"]))
+
+        rng = np.random.RandomState(0)
+        params = {"w": jnp.asarray(rng.randn(8, 16), jnp.bfloat16),
+                  "scale": jnp.ones((16,), jnp.float32)}
+        specs = {"w": PartitionSpec(), "scale": PartitionSpec()}
+        x = jnp.asarray(rng.randn(32, 8), jnp.float32)
+        mesh = make_mesh(MeshConfig())
+
+        outs = {}
+        for fused in (False, True):
+            tr = Trainer(loss_fn, mesh, specs, lr=1e-2, grad_clip=1.0,
+                         fused_optimizer=fused, donate=False)
+            st = tr.init_state(dict(params))
+            assert tr._fused == fused
+            for _ in range(3):
+                st, m = tr.step(st, x)
+            outs[fused] = (np.asarray(m["loss"]),
+                           {k: np.asarray(v, np.float32)
+                            for k, v in st.params.items()})
+        np.testing.assert_allclose(outs[True][0], outs[False][0],
+                                   rtol=1e-3, atol=1e-4)
+        for k in params:
+            np.testing.assert_allclose(outs[True][1][k], outs[False][1][k],
+                                       rtol=2e-2, atol=2e-3)
+        # dtypes preserved through the fused update
+        tr = Trainer(loss_fn, mesh, specs, fused_optimizer=True,
+                     donate=False)
+        st = tr.init_state(dict(params))
+        st, _ = tr.step(st, x)
+        assert st.params["w"].dtype == jnp.bfloat16
+        assert st.params["scale"].dtype == jnp.float32
+
+    def test_fused_bf16_moment_dtype(self):
+        """moment_dtype=bfloat16 halves mu/nu storage; the update still
+        descends and state dtypes stay step-invariant (donation)."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec
+        from paddle_tpu.distributed.trainer import (MeshConfig, Trainer,
+                                                    make_mesh)
+
+        def loss_fn(p, x):
+            return jnp.mean(jnp.square(x @ p["w"]))
+
+        rng = np.random.RandomState(2)
+        mesh = make_mesh(MeshConfig())
+        for fused in (False, True):
+            tr = Trainer(loss_fn, mesh, {"w": PartitionSpec()}, lr=1e-2,
+                         fused_optimizer=fused, donate=False,
+                         moment_dtype=jnp.bfloat16)
+            st = tr.init_state(
+                {"w": jnp.asarray(rng.randn(16, 4), jnp.float32)})
+            assert jax.tree_util.tree_leaves(st.mu)[0].dtype == jnp.bfloat16
+            losses = []
+            for _ in range(5):
+                st, m = tr.step(
+                    st, jnp.asarray(rng.randn(32, 16), jnp.float32))
+                losses.append(float(m["loss"]))
+                assert jax.tree_util.tree_leaves(st.mu)[0].dtype \
+                    == jnp.bfloat16
+            assert losses[-1] < losses[0]
+
     def test_fused_with_nan_check(self):
         """FLAGS_check_nan_inf rebuilds the step without donation; the
         fused path must survive the rebuild and report finite metrics."""

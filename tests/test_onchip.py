@@ -93,7 +93,7 @@ def test_trainer_step_is_device_bound():
     the tunnel and fails the 4x bound."""
     _require_chip()
     rc, out, err = _run_on_chip("""
-import time, numpy as np, jax, jax.numpy as jnp, json
+import os, time, numpy as np, jax, jax.numpy as jnp, json
 from paddle_tpu.models.llama import (LlamaConfig, init_params, loss_fn,
                                      param_shardings)
 from paddle_tpu.distributed.trainer import MeshConfig, Trainer, make_mesh
@@ -116,7 +116,12 @@ for _ in range(10):
 np.asarray(jnp.ravel(m["loss"])[0])
 per_step = (time.perf_counter() - t0) / 10
 print("STEP_MS", per_step * 1e3)
-assert per_step < 0.25, f"step plumbing not device-bound: {per_step}s"
+# env-overridable bound: the absolute value depends on chip generation
+# and tunnel latency; a healthy-but-slower environment should loosen
+# it (ONCHIP_STEP_BOUND_S) rather than fail the plumbing check
+bound = float(os.environ.get("ONCHIP_STEP_BOUND_S", "0.25"))
+assert per_step < bound, \
+    f"step plumbing not device-bound: {per_step}s >= {bound}s"
 print("DEVBOUND_OK")
 """)
     assert rc == 0 and "DEVBOUND_OK" in out, (out, err[-2000:])

@@ -138,6 +138,55 @@ class TestFusedAdamW:
         np.testing.assert_allclose(np.asarray(p2), p_ref, rtol=1e-5,
                                    atol=1e-6)
 
+    def test_prime_length_pads_not_degrades(self):
+        # awkward (prime) n must pad to a block multiple, not fall back
+        # to block=1 with an n-wide sequential grid; outputs keep n
+        from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
+        rng = np.random.RandomState(1)
+        n = 1009  # prime
+        p = jnp.asarray(rng.randn(n), jnp.float32)
+        g = jnp.asarray(rng.randn(n), jnp.float32)
+        m = jnp.zeros(n, jnp.float32)
+        v = jnp.zeros(n, jnp.float32)
+        p2, m2, v2 = fused_adamw(p, g, m, v, lr=0.1, step=1.0,
+                                 weight_decay=0.01)
+        assert p2.shape == (n,) and m2.shape == (n,) and v2.shape == (n,)
+        m_ref = 0.1 * np.asarray(g)
+        vhat = (0.001 * np.asarray(g) ** 2) / (1 - 0.999)
+        p_ref = np.asarray(p) * (1 - 0.1 * 0.01) - \
+            0.1 * (m_ref / (1 - 0.9)) / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(np.asarray(p2), p_ref, rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestNormRowPadding:
+    def test_rms_prime_rows(self):
+        from paddle_tpu.ops.pallas.norms import rms_norm_pallas
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(1, 127, 256), jnp.float32)  # prime rows
+        w = jnp.asarray(rng.randn(256), jnp.float32)
+        o = rms_norm_pallas(x, w)
+        xf = np.asarray(x)
+        ref = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-6) \
+            * np.asarray(w)
+        assert o.shape == x.shape
+        np.testing.assert_allclose(np.asarray(o), ref, atol=2e-5)
+
+    def test_layernorm_prime_rows(self):
+        from paddle_tpu.ops.pallas.norms import layer_norm_pallas
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(127, 256), jnp.float32)
+        w = jnp.asarray(rng.randn(256), jnp.float32)
+        b = jnp.asarray(rng.randn(256), jnp.float32)
+        o = layer_norm_pallas(x, w, b)
+        xf = np.asarray(x)
+        mu = xf.mean(-1, keepdims=True)
+        var = xf.var(-1, keepdims=True)
+        ref = (xf - mu) / np.sqrt(var + 1e-5) * np.asarray(w) \
+            + np.asarray(b)
+        assert o.shape == x.shape
+        np.testing.assert_allclose(np.asarray(o), ref, atol=2e-5)
+
 
 class TestFlashAttentionExtended:
     """GQA / segment-id (varlen) / bias capabilities of the Pallas kernel
@@ -268,12 +317,12 @@ class TestAutotune:
         prev = u._FORCE_INTERPRET
         u.set_force_interpret(False)  # autotune is a no-op in interpret mode
         try:
-            cfg = at.autotune("toy", (4,), ["slow", "fast"], build,
+            cfg = at.autotune("toy|(4,)", ["slow", "fast"], build,
                               (jnp.ones(4),), warmup=1, iters=2)
             assert cfg == "fast"
             calls.clear()
             # second lookup: cache hit, no sweep
-            cfg2 = at.autotune("toy", (4,), ["slow", "fast"], build,
+            cfg2 = at.autotune("toy|(4,)", ["slow", "fast"], build,
                                (jnp.ones(4),))
             assert cfg2 == "fast" and not calls
             # persistent across instances
