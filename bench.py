@@ -39,6 +39,19 @@ PEAK_FLOPS = {  # bf16 peak per chip, by TPU generation
     "v4": 275e12,
 }
 
+PEAK_HBM_BW = {  # bytes/sec per chip, by TPU generation
+    "v6e": 1640e9, "v5p": 2765e9, "v5e": 819e9, "v5litepod": 819e9,
+    "v4": 1228e9,
+}
+
+
+def _peak_bw():
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
+    for k, v in PEAK_HBM_BW.items():
+        if gen.startswith(k):
+            return v
+    return 819e9
+
 
 def _timed_host_synced(fn, steps, warn_sink=None):
     """ms/call of `fn` with host-synced windows: block_until_ready does
@@ -122,7 +135,7 @@ def bench_resnet50(steps=20, batch=256, amp_level=None):
 
 
 def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
-                inter=5504, accumulate=None):
+                inter=5504, accumulate=None, moment_dtype=None):
     import jax
     import jax.numpy as jnp
     from paddle_tpu.models.llama import (LlamaConfig, init_params, loss_fn,
@@ -143,9 +156,11 @@ def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
     mesh = make_mesh(MeshConfig())
     params = init_params(cfg, jax.random.PRNGKey(0))
     n_params = sum(v.size for v in jax.tree_util.tree_leaves(params))
+    mdt = {"bfloat16": jnp.bfloat16, "float32": None,
+           None: None}[moment_dtype]
     tr = Trainer(lambda p, t, l: loss_fn(p, t, l, cfg), mesh,
                  param_shardings(mesh, cfg), lr=1e-4,
-                 accumulate_steps=acc)
+                 accumulate_steps=acc, moment_dtype=mdt)
     state = tr.init_state(params)
     shape = (acc, batch, seq) if acc > 1 else (batch, seq)
     toks = jnp.asarray(np.random.randint(0, 32000, shape), jnp.int32)
@@ -166,7 +181,9 @@ def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
     return {"metric": "llama_train_tokens_per_sec_per_chip",
             "value": round(tps, 1), "unit": "tokens/sec/chip",
             "mfu": round(mfu, 4), "params": int(n_params), "batch": batch,
-            "seq": seq, "accumulate": acc,
+            "seq": seq, "accumulate": acc, "hidden": hidden,
+            "layers": layers,
+            **({"moment_dtype": moment_dtype} if moment_dtype else {}),
             "vs_baseline_mfu": round(mfu / 0.525, 4)}
 
 
@@ -343,6 +360,62 @@ def bench_ernie_infer(batch=8, ctx=512, gen=64):
             "batch": batch, "ctx": ctx, "gen": gen}
 
 
+def bench_paged_decode():
+    """VERDICT r4 Next #5: time generate_paged on chip at serving shapes,
+    Pallas paged-attention kernel vs the XLA gather composition
+    (FLAGS_use_paged_kernel=0). Reference capability: the paged-KV fused
+    decode in paddle/phi/kernels/fusion/ (block_multihead_attention).
+    Each (batch, ctx) point reports tokens/s for both paths."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu.ops.paged_attention  # noqa: F401 — defines the flag
+    from paddle_tpu.core.flags import GLOBAL_FLAGS
+    from paddle_tpu.inference.generation import (GenerationConfig,
+                                                 generate_paged)
+    from paddle_tpu.models.llama import LlamaConfig, init_params
+
+    gen_n = int(os.environ.get("BENCH_PAGED_GEN", "64"))
+    points = [(8, 512), (32, 512), (8, 2048), (32, 2048)]
+    if os.environ.get("BENCH_PAGED_POINTS"):
+        points = [tuple(map(int, p.split("x")))
+                  for p in os.environ["BENCH_PAGED_POINTS"].split(",")]
+    res = {"metric": "paged_decode_tokens_per_sec_per_chip", "value": 0.0,
+           "unit": "tokens/sec/chip", "gen": gen_n, "points": {}}
+    best = 0.0
+    for batch, ctx in points:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=4096, num_hidden_layers=12,
+                          num_attention_heads=16, num_key_value_heads=16,
+                          max_position_embeddings=ctx + gen_n)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        toks = jnp.asarray(np.random.randint(0, 32000, (batch, ctx)),
+                           jnp.int32)
+        g = GenerationConfig(max_new_tokens=gen_n, greedy=True)
+        point = {}
+        for label, flag in (("pallas", True), ("xla_gather", False)):
+            prev = GLOBAL_FLAGS.get("use_paged_kernel")
+            GLOBAL_FLAGS.set("use_paged_kernel", flag)
+            try:
+                ms = _timed_host_synced(
+                    lambda: generate_paged(params, toks, cfg, g),
+                    steps=3)
+                point[label] = round(batch * gen_n / (ms / 1e3), 1)
+            except Exception as e:  # noqa: BLE001
+                point[label] = f"{type(e).__name__}: {e}"[:160]
+            finally:
+                GLOBAL_FLAGS.set("use_paged_kernel", prev)
+        if isinstance(point.get("pallas"), float) and \
+                isinstance(point.get("xla_gather"), float):
+            point["speedup"] = round(point["pallas"]
+                                     / max(point["xla_gather"], 1e-9), 3)
+        res["points"][f"{batch}x{ctx}"] = point
+        if isinstance(point.get("pallas"), float):
+            best = max(best, point["pallas"])
+        del params
+    res["value"] = best
+    return res
+
+
 def bench_sd_unet(steps=8, batch=4):
     """BASELINE config 6: Stable-Diffusion-class UNet denoise step,
     compiled (SD-1.x geometry at 64x64 latents)."""
@@ -441,6 +514,38 @@ def bench_resnet_breakdown(batch=None):
     # + AMP bookkeeping (approximate — separate programs fuse differently)
     res["optimizer_residual_ms"] = round(
         res["full_step_ms"] - res["fwd_bwd_ms"], 2)
+
+    # ingest overlap: fresh host batch every step, (a) synchronous h2d
+    # inline (step = transfer + compute) vs (b) the double-buffered
+    # _DevicePrefetchIter (steady state = max(transfer, compute)).
+    # Over the tunnel transfer dominates, so (b) ≈ h2d_ms while (a) ≈
+    # h2d_ms + full_step_ms; on a directly-attached chip (b) ≈ compute.
+    try:
+        from paddle_tpu.io.dataloader import _DevicePrefetchIter
+        n_ing, t_sync = 4, time.perf_counter()
+        for _ in range(n_ing):
+            loss = ts(paddle.to_tensor(xh), paddle.to_tensor(yh))
+        float(loss)
+        res["ingest_sync_step_ms"] = round(
+            (time.perf_counter() - t_sync) / n_ing * 1e3, 2)
+        pf = _DevicePrefetchIter(
+            iter([(xh, yh)] * (n_ing + 2)),
+            lambda b: (paddle.to_tensor(b[0]), paddle.to_tensor(b[1])),
+            depth=2)
+        loss = ts(*next(pf))  # first pull pays its own transfer
+        float(loss)
+        t_pf = time.perf_counter()
+        for _ in range(n_ing):
+            loss = ts(*next(pf))
+        float(loss)
+        res["ingest_prefetch_step_ms"] = round(
+            (time.perf_counter() - t_pf) / n_ing * 1e3, 2)
+        pf.close()
+        res["ingest_overlap_speedup"] = round(
+            res["ingest_sync_step_ms"]
+            / max(res["ingest_prefetch_step_ms"], 1e-6), 2)
+    except Exception as e:  # noqa: BLE001 — breakdown leg is best-effort
+        res["ingest_error"] = f"{type(e).__name__}: {e}"[:160]
 
     try:
         trace_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -570,7 +675,14 @@ def bench_kernels():
         jax.block_until_ready(out)
         return (time.perf_counter() - t0) / steps * 1e6  # us
 
-    def record(name, pallas_fn, ref_fn, *args, tol):
+    def record(name, pallas_fn, ref_fn, *args, tol, flops=None,
+               bytes_moved=None):
+        """flops / bytes_moved (per call) turn the relative speedup into
+        ABSOLUTE utilization: mfu = flops/time/peak_flops, bw_frac =
+        bytes/time/peak_HBM_bw (VERDICT r4 weak #4 — 'fast' must be
+        measured against the hardware roofline, not a jnp baseline; the
+        CUDA library kernel behind the reference's
+        phi/kernels/gpu/flash_attn_kernel.cu:517 is ~60% MFU class)."""
         try:
             got = np.asarray(jax.block_until_ready(pallas_fn(*args)),
                              np.float32)
@@ -583,6 +695,11 @@ def bench_kernels():
                 us_x = timed(ref_fn, *args)
                 case.update(us_pallas=round(us_p, 1), us_xla=round(us_x, 1),
                             speedup=round(us_x / us_p, 3))
+                if flops is not None:
+                    case["mfu"] = round(flops / (us_p * 1e-6) / _peak(), 4)
+                if bytes_moved is not None:
+                    case["bw_frac"] = round(
+                        bytes_moved / (us_p * 1e-6) / _peak_bw(), 4)
             res["cases"][name] = case
         except Exception as e:  # noqa: BLE001 — record, keep going
             import re
@@ -620,11 +737,13 @@ def bench_kernels():
         return jnp.einsum("bhqk,bkhd->bqhd", p,
                           vr.astype(jnp.float32)).astype(q.dtype)
 
+    # causal fwd: QK^T + PV are 2*B*H*S*S*D each, halved by the mask
+    fwd_flops = 2 * B * H * S * S * D
     record("flash_causal_gqa",
            jax.jit(lambda q, k, v: flash_attention_pallas(q, k, v,
                                                           causal=True)),
            jax.jit(lambda q, k, v: ref_attn(q, k, v, causal=True)),
-           q, k, v, tol=3e-2)
+           q, k, v, tol=3e-2, flops=fwd_flops)
 
     seg = jnp.concatenate([jnp.zeros((B, S // 2), jnp.int32),
                            jnp.ones((B, S - S // 2), jnp.int32)], axis=1)
@@ -672,14 +791,17 @@ def bench_kernels():
                dropout_seed=seed_dp)),
            jax.jit(ref_attn_dropout), q, k, v, tol=3e-2)
 
+    # grad(loss) runs fwd + full bwd (dq,dk,dv): ~3.5x the fwd flops
+    # (bwd is 2.5x: dP/dV matmuls + recomputed attention)
+    bwd_flops = int(2 * (B // 2) * H * S * S * D * 3.5)
     record("flash_bwd_dq",
            jax.jit(lambda q, k, v: jax.grad(loss_p, 0)(q, k, v)),
            jax.jit(lambda q, k, v: jax.grad(loss_r, 0)(q, k, v)),
-           qg, kg, vg, tol=6e-2)
+           qg, kg, vg, tol=6e-2, flops=bwd_flops)
     record("flash_bwd_dk",
            jax.jit(lambda q, k, v: jax.grad(loss_p, 1)(q, k, v)),
            jax.jit(lambda q, k, v: jax.grad(loss_r, 1)(q, k, v)),
-           qg, kg, vg, tol=6e-2)
+           qg, kg, vg, tol=6e-2, flops=bwd_flops)
 
     # ---- paged-attention decode (incl. a seq_len=0 slot) ---------------
     PB, PH, PKV, PD, BS = (16, 16, 16, 128, 16) if not interp \
@@ -711,11 +833,17 @@ def bench_kernels():
         p = jnp.where(lens[:, None, None] > 0, p, 0.0)  # len=0 -> zeros
         return jnp.einsum("bhk,bkhd->bhd", p, vv).astype(dq.dtype)
 
+    # decode attention is pure HBM streaming — count only the LIVE pages
+    # (the kernel reads ceil(len/BS) pages per sequence, not the whole
+    # table; the full-table count would inflate bw_frac ~2x at these
+    # random lens)
+    live_pages = int(np.sum(np.ceil(np.asarray(lens) / BS)))
+    paged_bytes = live_pages * BS * PKV * PD * 2 * 2  # bf16, k+v
     record("paged_decode",
            jax.jit(lambda dq, kp, vp: paged_attention_decode_pallas(
                dq, kp, vp, tables, lens)),
            jax.jit(ref_paged),
-           dq, kp, vp, tol=3e-2)
+           dq, kp, vp, tol=3e-2, bytes_moved=paged_bytes)
 
     # ---- fused adamw ---------------------------------------------------
     N = 131072 * 32 if not interp else 4096
@@ -733,10 +861,11 @@ def bench_kernels():
         p2 = p - lr * (mh / (jnp.sqrt(vh) + eps) + wd * p)
         return p2, m2, v2
 
+    # reads p,g,m,v + writes p,m,v — 7 fp32 streams, pure bandwidth
     record("fused_adamw",
            jax.jit(lambda p, g, m, v: fused_adamw(p, g, m, v, 1e-3, 1.0)[0]),
            jax.jit(lambda p, g, m, v: ref_adamw(p, g, m, v)[0]),
-           p0, g0, m0, v0, tol=1e-5)
+           p0, g0, m0, v0, tol=1e-5, bytes_moved=N * 4 * 7)
 
     # ---- rms norm ------------------------------------------------------
     X = jax.random.normal(qk[1], (8192, 4096) if not interp else (64, 256),
@@ -750,7 +879,7 @@ def bench_kernels():
             * w.astype(jnp.float32)).astype(x.dtype)
 
     record("rms_norm", jax.jit(rms_norm_pallas), jax.jit(ref_rms),
-           X, W, tol=3e-2)
+           X, W, tol=3e-2, bytes_moved=X.size * 2 * 2)  # bf16 in+out
 
     LW = jax.random.normal(qk[2], (X.shape[-1],), jnp.bfloat16)
     LB = jax.random.normal(qk[3], (X.shape[-1],), jnp.bfloat16)
@@ -767,7 +896,7 @@ def bench_kernels():
     # ~4-8 differ from the reference by 1-2 bf16 ulps (f32 op order), so
     # the tolerance is 2 ulps at that magnitude
     record("layer_norm", jax.jit(layer_norm_pallas), jax.jit(ref_ln),
-           X, LW, LB, tol=6.5e-2)
+           X, LW, LB, tol=6.5e-2, bytes_moved=X.size * 2 * 2)
 
     n_ok = sum(1 for c in res["cases"].values() if c.get("ok"))
     res.update(metric="pallas_kernels_ok", value=n_ok,
@@ -785,6 +914,7 @@ CONFIGS = {
     "flash_tune": bench_flash_tune,
     "bert": bench_bert,
     "ernie_infer": bench_ernie_infer,
+    "paged_decode": bench_paged_decode,
     "sd_unet": bench_sd_unet,
     "kernels": bench_kernels,
 }
@@ -824,6 +954,23 @@ def _run_child(name):
                 err = f"{type(e).__name__}: {e}"[:300]
         print(json.dumps({"error": err}))
         return
+    if name == "llama_rung":
+        # one LLAMA_LADDER rung per child (the parent sweeps them all)
+        lsteps = int(os.environ.get("BENCH_LLAMA_STEPS", "6"))
+        i = int(os.environ.get("BENCH_LADDER_IDX", "0"))
+        label, lb, sq, h, L, it, acc, mdt = \
+            LLAMA_LADDER[min(i, len(LLAMA_LADDER) - 1)]
+        try:
+            r = bench_llama(steps=lsteps, batch=lb, seq=sq, hidden=h,
+                            layers=L, inter=it, accumulate=acc,
+                            moment_dtype=mdt)
+            r["label"] = label
+            print(json.dumps(r))
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps(
+                {"label": label,
+                 "error": f"{type(e).__name__}: {e}"[:300]}))
+        return
     if name == "llama":
         # One rung per CHILD process: after a TPU OOM the client is
         # poisoned (observed: later rungs fail within seconds), so the
@@ -860,6 +1007,25 @@ LLAMA_RUNGS = ((4, 2048, 12, 5504, 2), (2, 2048, 12, 5504, 2),
                (1, 2048, 12, 5504, 2), (8, 1536, 8, 4096, 2),
                (4, 1536, 8, 4096, 4), (2, 1024, 8, 2816, 4),
                (2, 1024, 8, 2816, 1))
+
+# VERDICT r4 Next #2: the MFU-vs-params curve toward 7B-shaped dims
+# (hidden 4096 x 32 heads is the LLaMA-2-7B layer geometry). Every rung
+# runs in a FRESH subprocess and ALL rungs are attempted (curve, not
+# fallback). Rungs past ~1B params switch the optimizer state to bf16
+# moments (fp32 master kept): 2+4+2+2+2 = 12 bytes/param peak next to
+# remat'd activations is what a 16GB v5e fits. Reference capability:
+# sharding stage-3 trains 7B across chips
+# (python/paddle/distributed/fleet/meta_parallel/sharding/
+# group_sharded_stage3.py:85); single-chip rungs must prove the
+# per-chip math before the multi-chip story means anything.
+# (label, batch, seq, hidden, layers, inter, acc, moment_dtype)
+LLAMA_LADDER = (
+    ("325M", 8, 2048, 1536, 8, 4096, 2, None),
+    ("740M", 4, 2048, 2048, 12, 5504, 2, None),
+    ("1.10B", 4, 2048, 3072, 8, 8192, 1, "bfloat16"),
+    ("1.07B-h4096", 2, 2048, 4096, 4, 11008, 1, "bfloat16"),
+    ("1.27B-h4096", 1, 2048, 4096, 5, 11008, 1, "bfloat16"),
+)
 
 # resnet50 batch sweep (config "resnet50_sweep"): find the
 # throughput-optimal batch on the chip, one FRESH subprocess per batch
@@ -913,9 +1079,51 @@ def _env_ladder(name, var, values, timeout, per_cap, keep_best=False):
         {"sweep": sweep} if keep_best else {})}
 
 
+def _llama_ladder(timeout):
+    """Run EVERY LLAMA_LADDER rung (fresh subprocess each) and report
+    the MFU-vs-params curve; headline value = MFU at the largest rung
+    that ran. Unlike the llama fallback ladder this is a sweep — an OOM
+    at one rung is recorded in the curve and the next rung still runs."""
+    t0 = time.time()
+    curve, best = [], None
+    prev = os.environ.get("BENCH_LADDER_IDX")
+    try:
+        for i, rung in enumerate(LLAMA_LADDER):
+            left = timeout - (time.time() - t0)
+            if left < 120:
+                curve.append({"label": rung[0],
+                              "error": "bench window exhausted"})
+                continue
+            os.environ["BENCH_LADDER_IDX"] = str(i)
+            r = _spawn("llama_rung", min(left, 1200))
+            r.setdefault("label", rung[0])
+            keep = {k: r[k] for k in ("label", "value", "mfu", "params",
+                                      "batch", "accumulate",
+                                      "moment_dtype", "error")
+                    if k in r}
+            curve.append(keep)
+            if "error" not in r and (best is None
+                                     or r["params"] > best["params"]):
+                best = r
+    finally:
+        if prev is None:
+            os.environ.pop("BENCH_LADDER_IDX", None)
+        else:
+            os.environ["BENCH_LADDER_IDX"] = prev
+    if best is None:
+        return {"error": "no ladder rung succeeded", "curve": curve}
+    return {"metric": "llama_mfu_ladder", "value": best["mfu"],
+            "unit": "MFU at largest rung", "top_rung": best["label"],
+            "params": best["params"],
+            "tokens_per_sec": best.get("value"), "curve": curve,
+            "vs_baseline_mfu": round(best["mfu"] / 0.525, 4)}
+
+
 def _spawn(name, timeout):
     """Run one config in a subprocess; return its parsed JSON or an error
     dict. Never raises, never hangs past `timeout`."""
+    if name == "llama_ladder":
+        return _llama_ladder(timeout)
     if name == "resnet50_sweep":
         return _env_ladder("resnet50_one", "BENCH_RESNET_POINT",
                            RESNET_SWEEP_POINTS, timeout, per_cap=600,
@@ -1033,7 +1241,8 @@ def _merge_opportunistic(out):
         out["captured_at"] = opp.get("resnet50_sweep_iso")
         out.pop("resnet_error", None)
     for k in ("llama", "kernels", "ernie_infer", "sd_unet", "bert",
-              "resnet_breakdown", "llama_breakdown", "ppyoloe"):
+              "resnet_breakdown", "llama_breakdown", "ppyoloe",
+              "llama_ladder", "paged_decode"):
         live = out.get(k)
         stale_live = not isinstance(live, dict) or "error" in live
         cap = opp.get(k)
@@ -1125,9 +1334,11 @@ def main():
     # -- kernels validation + configs 2/4/6, on by default --------------
     if os.environ.get("BENCH_FAST", "0") in ("0", "", "false"):
         extra_t = int(os.environ.get("BENCH_EXTRA_TIMEOUT", "900"))
-        for name in ("kernels", "ernie_infer", "sd_unet", "bert",
-                     "resnet_breakdown", "ppyoloe"):
-            out[name] = run_cfg(name, extra_t)
+        for name in ("kernels", "ernie_infer", "paged_decode", "sd_unet",
+                     "bert", "resnet_breakdown", "ppyoloe",
+                     "llama_ladder"):
+            out[name] = run_cfg(name, 2700 if name == "llama_ladder"
+                                else extra_t)
             save_partial()
 
     _merge_opportunistic(out)

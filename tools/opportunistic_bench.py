@@ -40,11 +40,13 @@ PACK = [
     ("flash_tune", 900, 2),
     ("resnet50", 1500, 3),
     ("llama", 1500, 3),
+    ("llama_ladder", 2700, 2),
     ("resnet50_sweep", 1500, 2),
     ("resnet_breakdown", 1200, 2),
     ("kernels", 1200, 3),
     ("llama_breakdown", 1200, 2),
     ("ernie_infer", 900, 2),
+    ("paged_decode", 1500, 2),
     ("sd_unet", 900, 2),
     ("bert", 900, 2),
     ("ppyoloe", 900, 2),
