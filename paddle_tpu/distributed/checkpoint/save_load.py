@@ -123,17 +123,28 @@ def save_state_dict(state_dict, path, process_group=None,
 
     def _write():
         np.savez(os.path.join(path, f"{rank}_0.distcp.npz"), **arrays)
+        meta_json = {
+            "world": jax.process_count(),
+            "rank": rank,
+            "global_shapes": {k: list(v)
+                              for k, v in meta.global_shapes.items()},
+            "shards": {k: [{"global_offset": list(s.global_offset),
+                            "local_shape": list(s.local_shape),
+                            "dtype": s.dtype, "file": s.file,
+                            "key_in_file": s.key_in_file}
+                           for s in v]
+                       for k, v in meta.shards.items()},
+        }
+        # EVERY rank writes its metadata fragment: a process only sees
+        # its ADDRESSABLE shards, so coordinator-only metadata would
+        # silently drop every other process's data on a multi-process
+        # save (load then resurrects stale/zero rows — the elastic e2e
+        # test caught exactly this). The loader merges fragments;
+        # metadata.json (the coordinator's fragment under the legacy
+        # name) keeps single-process checkpoints readable by old code.
+        with open(os.path.join(path, f"metadata_{rank}.json"), "w") as f:
+            json.dump(meta_json, f)
         if rank == coordinator_rank:
-            meta_json = {
-                "global_shapes": {k: list(v)
-                                  for k, v in meta.global_shapes.items()},
-                "shards": {k: [{"global_offset": list(s.global_offset),
-                                "local_shape": list(s.local_shape),
-                                "dtype": s.dtype, "file": s.file,
-                                "key_in_file": s.key_in_file}
-                               for s in v]
-                           for k, v in meta.shards.items()},
-            }
             with open(os.path.join(path, "metadata.json"), "w") as f:
                 json.dump(meta_json, f)
 
@@ -156,15 +167,39 @@ def save_state_dict(state_dict, path, process_group=None,
 
 
 def _read_metadata(path) -> Metadata:
-    with open(os.path.join(path, "metadata.json")) as f:
-        raw = json.load(f)
+    """Merge all per-rank metadata fragments (multi-process saves); fall
+    back to the legacy single metadata.json. Duplicate shard records
+    (e.g. every rank saving its own replicated scalar copy) dedupe by
+    global_offset — first writer wins."""
+    frags = [os.path.join(path, "metadata_0.json")]
+    if os.path.exists(frags[0]):
+        # the coordinator's fragment is rewritten on EVERY save; its
+        # "world" bounds which sibling fragments belong to this save —
+        # a re-save into the same dir after a world shrink must not
+        # merge the old larger world's leftover fragments
+        with open(frags[0]) as f:
+            world = json.load(f).get("world", 1)
+        frags = [os.path.join(path, f"metadata_{r}.json")
+                 for r in range(world)]
+        frags = [fp for fp in frags if os.path.exists(fp)]
+    else:
+        frags = [os.path.join(path, "metadata.json")]
     meta = Metadata()
-    meta.global_shapes = {k: tuple(v)
-                          for k, v in raw["global_shapes"].items()}
-    for k, shards in raw["shards"].items():
-        meta.shards[k] = [LocalTensorMetadata(
-            tuple(s["global_offset"]), tuple(s["local_shape"]), s["dtype"],
-            s["file"], s["key_in_file"]) for s in shards]
+    seen = {}
+    for fp in frags:
+        with open(fp) as f:
+            raw = json.load(f)
+        for k, v in raw["global_shapes"].items():
+            meta.global_shapes[k] = tuple(v)
+        for k, shards in raw["shards"].items():
+            for s in shards:
+                key = (k, tuple(s["global_offset"]))
+                if key in seen:
+                    continue
+                seen[key] = True
+                meta.shards.setdefault(k, []).append(LocalTensorMetadata(
+                    tuple(s["global_offset"]), tuple(s["local_shape"]),
+                    s["dtype"], s["file"], s["key_in_file"]))
     return meta
 
 

@@ -43,11 +43,18 @@ def init_parallel_env(coordinator_address: Optional[str] = None,
             addr = f"{addr}:{port}"
         if coordinator_address is None and addr == paddle_master:
             # PADDLE_MASTER is the launcher's TCPStore (control plane);
-            # the JAX coordination service gets the next port. Explicit
-            # coordinator_address / MASTER_ADDR setups are used verbatim.
+            # the JAX coordination service gets the next port, offset by
+            # the WORLD-agreed elastic incarnation tag so a respawned
+            # world never races the dying coordinator for its socket.
+            # (NOT the per-node PADDLE_JOB_ID retry counter — that can
+            # differ across nodes and would split the world across two
+            # coordinator addresses.) Explicit coordinator_address /
+            # MASTER_ADDR setups are used verbatim.
             host, _, p = addr.rpartition(":")
             if p.isdigit():
-                addr = f"{host}:{int(p) + 1}"
+                epoch = int(os.environ.get("PADDLE_COORD_EPOCH", "0")
+                            or 0)
+                addr = f"{host}:{int(p) + 1 + epoch}"
         plat = (jax.config.jax_platforms or
                 os.environ.get("JAX_PLATFORMS", ""))
         if "cpu" in str(plat):

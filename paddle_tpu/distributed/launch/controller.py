@@ -20,8 +20,11 @@ from typing import Dict, List, Optional
 
 from ..store import TCPStore, TCPStoreServer
 
-HEARTBEAT_INTERVAL = 5.0
-HEARTBEAT_STALE = 30.0
+# env-tunable so elastic failover tests (and latency-sensitive jobs) can
+# use sub-second detection instead of the production 30s default
+HEARTBEAT_INTERVAL = float(os.environ.get("PADDLE_HEARTBEAT_INTERVAL",
+                                          "5"))
+HEARTBEAT_STALE = float(os.environ.get("PADDLE_HEARTBEAT_STALE", "30"))
 
 
 @dataclass
@@ -100,6 +103,13 @@ class Controller:
             "MASTER_ADDR": self.master_addr.rsplit(":", 1)[0],
             "MASTER_PORT": self.master_addr.rsplit(":", 1)[1],
             "PADDLE_JOB_ID": str(self._job_id[0]),
+            # WORLD-agreed incarnation tag for the coordination-service
+            # port offset: the per-node _job_id retry counter can differ
+            # across nodes (a rejoining node restarts its count), and a
+            # port derived from it would split the world across two
+            # coordinators. The membership hash is identical on every
+            # member by construction.
+            "PADDLE_COORD_EPOCH": str(getattr(self, "_coord_epoch", 0)),
         })
         return env
 
@@ -160,9 +170,14 @@ class Controller:
                     continue
                 val = self.store.get(f"heartbeat/{node}")
                 if val is not None and now - float(val) > HEARTBEAT_STALE:
-                    # a cleanly-finished node stops heartbeating but is not
-                    # a failure — it left an exit/{n} marker
-                    if self.store.get(f"exit/{node}") is not None:
+                    # a cleanly-finished node stops heartbeating but is
+                    # not a failure — it left exit/{n} == 0. A CRASHED
+                    # node's nonzero exit marker must still count as a
+                    # failure (its controller may write the marker on
+                    # the way down), or survivors would run forever
+                    # against a hung world
+                    ex = self.store.get(f"exit/{node}")
+                    if ex is not None and ex.strip() in (b"0", "0"):
                         continue
                     return node
         except (ConnectionError, OSError):
@@ -219,6 +234,10 @@ class Controller:
                     f"{rank}\n")
             self.spec.nnodes = nnodes
             self.spec.node_rank = rank
+            import hashlib
+            view = ",".join(self._elastic._last_membership)
+            self._coord_epoch = 1 + int(
+                hashlib.md5(view.encode()).hexdigest()[:6], 16) % 997
         except TimeoutError as e:
             sys.stderr.write(f"[launch] elastic resolve failed: {e}\n")
 

@@ -22,12 +22,19 @@ outside [min_nodes, max_nodes] keeps waiting instead of relaunching.
 """
 from __future__ import annotations
 
+import os
 import socket
 import time
 import uuid
 from typing import List, Optional, Tuple
 
-HEARTBEAT_TTL = 30.0
+HEARTBEAT_TTL = float(os.environ.get("PADDLE_ELASTIC_TTL", "30"))
+# membership must be unchanged for this long before resolve() accepts it:
+# survivors of a node loss register at slightly different times, and a
+# too-eager resolve would hand two controllers different world sizes
+# (a deadlocked incarnation) — reference manager.py waits for etcd watch
+# events to quiesce the same way
+SETTLE_SECONDS = float(os.environ.get("PADDLE_ELASTIC_SETTLE", "3"))
 
 
 class ElasticManager:
@@ -78,26 +85,31 @@ class ElasticManager:
     def changed(self) -> bool:
         return self.membership() != self._last_membership
 
-    def resolve(self, timeout: float = 120.0) -> Tuple[int, int]:
+    def resolve(self, timeout: float = 120.0,
+                settle: Optional[float] = None) -> Tuple[int, int]:
         """Wait for a stable in-bounds membership; returns
         (nnodes, node_rank) with ranks assigned by sorted node id
-        (reference: manager.py hostname-ordered re-rank)."""
+        (reference: manager.py hostname-ordered re-rank). The view must
+        be unchanged for ``settle`` seconds before it is accepted."""
+        settle = SETTLE_SECONDS if settle is None else settle
         deadline = time.time() + timeout
+        view, view_since = None, 0.0
         while True:
             self.heartbeat()
             live = self.membership()
-            if self.min_nodes <= len(live) <= self.max_nodes \
-                    and self.node_id in live:
-                # require two consecutive identical views (settled)
-                time.sleep(0.2)
-                if self.membership() == live:
-                    self._last_membership = live
-                    return len(live), live.index(self.node_id)
-            if time.time() > deadline:
+            now = time.time()
+            if live != view:
+                view, view_since = live, now
+            in_bounds = (self.min_nodes <= len(live) <= self.max_nodes
+                         and self.node_id in live)
+            if in_bounds and now - view_since >= settle:
+                self._last_membership = live
+                return len(live), live.index(self.node_id)
+            if now > deadline:
                 raise TimeoutError(
                     f"elastic membership did not settle in bounds "
                     f"[{self.min_nodes}, {self.max_nodes}]: {live}")
-            time.sleep(1.0)
+            time.sleep(0.2)
 
     def scale_event(self) -> Optional[str]:
         """None | 'scale_in' | 'scale_out' vs the last resolved view."""

@@ -114,26 +114,45 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
         # [b, s_local, h, d] -> a2a -> [b, s, h_local, d]
         n = jax.lax.axis_size(axis_name)
 
-        def a2a_fwd(x):
+        def seq2head_impl(x):
             b, sl, h, d = x.shape
             x = x.reshape(b, sl, n, h // n, d)
             x = jax.lax.all_to_all(x, axis_name, split_axis=2,
                                    concat_axis=1, tiled=False)
             return x.reshape(b, sl * n, h // n, d)
 
-        def a2a_bwd(x):
+        def head2seq_impl(x):
             b, s, hl, d = x.shape
             x = x.reshape(b, n, s // n, hl, d)
             x = jax.lax.all_to_all(x, axis_name, split_axis=1,
                                    concat_axis=3, tiled=False)
             return x.reshape(b, s // n, hl * n, d)
 
-        qg, kg, vg = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
+        # The two redistributions are mutually-inverse global
+        # permutations, so each one's adjoint IS the other. Spelling
+        # that out via custom_vjp matters: JAX's built-in transpose of
+        # this all_to_all+reshape pattern mis-shapes the cotangent
+        # (reshape 2048 vs 256 verifier error), which only bites on the
+        # BACKWARD pass — the multichip gate's sep phase caught it.
+        @jax.custom_vjp
+        def seq2head(x):
+            return seq2head_impl(x)
+
+        @jax.custom_vjp
+        def head2seq(x):
+            return head2seq_impl(x)
+
+        seq2head.defvjp(lambda x: (seq2head_impl(x), None),
+                        lambda _, g: (head2seq_impl(g),))
+        head2seq.defvjp(lambda x: (head2seq_impl(x), None),
+                        lambda _, g: (seq2head_impl(g),))
+
+        qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
         # public entry: pallas flash kernel on TPU (O(s) memory over the
         # full global sequence), jnp reference fallback elsewhere
         from .flash_attention import flash_attention
         og = flash_attention(qg, kg, vg, causal=causal, scale=scale)
-        return a2a_bwd(og)
+        return head2seq(og)
 
     spec = P(None, axis_name, None, None)
     fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),

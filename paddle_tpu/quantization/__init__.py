@@ -13,10 +13,12 @@ from .observers import (BaseObserver, AbsmaxObserver,
                         PerChannelAbsmaxObserver, PercentileObserver)
 from .quanters import (fake_quant, FakeQuanterWithAbsMax, quantize_to_int8,
                        int8_matmul)
-from .qat import QAT, PTQ, QuantConfig, QuantedLinear, Int8Linear
+from .qat import (QAT, PTQ, QuantConfig, QuantedLinear, Int8Linear,
+                  FP8Linear)
 
 __all__ = [
     "QuantConfig", "QAT", "PTQ", "QuantedLinear", "Int8Linear",
+    "FP8Linear",
     "BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
     "PerChannelAbsmaxObserver", "PercentileObserver",
     "fake_quant", "FakeQuanterWithAbsMax", "quantize_to_int8",

@@ -12,7 +12,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from .. import nn
-from ..core.tensor import Tensor, dispatch
+from ..core.tensor import Tensor, dispatch, to_value
 from .observers import AbsmaxObserver, PerChannelAbsmaxObserver
 from .quanters import (FakeQuanterWithAbsMax, fake_quant, quantize_to_int8,
                        int8_matmul)
@@ -94,6 +94,32 @@ class Int8Linear(nn.Layer):
         return dispatch(f, args, name="int8_linear")
 
 
+class FP8Linear(nn.Layer):
+    """Deploy-time FP8 linear (reference: paddle/phi/kernels/fusion/
+    fp8_gemm/ CUTLASS path; here the e4m3 operands hit the MXU via
+    lax.dot_general with an fp32 accumulator). Weights are stored e4m3
+    with one per-tensor scale; activations quantize dynamically at call
+    time. Serves through jit.save -> Predictor like Int8Linear."""
+
+    def __init__(self, weight, bias: Optional[Tensor],
+                 format: str = "e4m3"):
+        super().__init__()
+        from ..incubate.nn.functional.fp8 import quantize_fp8
+        wq, sw = quantize_fp8(
+            weight if isinstance(weight, Tensor) else Tensor(weight),
+            format=format)
+        self._w = to_value(wq)
+        self._w_scale = to_value(sw)
+        self._format = format
+        self.bias = bias
+
+    def forward(self, x):
+        from ..incubate.nn.functional.fp8 import fp8_gemm, quantize_fp8
+        xq, sx = quantize_fp8(x, format=self._format)
+        return fp8_gemm(xq, sx, Tensor(self._w), Tensor(self._w_scale),
+                        bias=self.bias, out_dtype="float32")
+
+
 class QAT:
     """reference: quantization/qat.py class QAT."""
 
@@ -171,19 +197,29 @@ class PTQ:
             else:
                 self._insert(child)
 
-    def convert(self, model: nn.Layer, inplace: bool = True) -> nn.Layer:
+    def convert(self, model: nn.Layer, inplace: bool = True,
+                target: str = "int8") -> nn.Layer:
+        """``target``: "int8" (per-channel int8 weights + calibrated
+        activation scale) or "fp8" (e4m3 weights, dynamic activation
+        scaling — the calibration pass is then only a sanity run)."""
+        if target not in ("int8", "fp8"):
+            raise ValueError(f"target must be int8|fp8, got {target!r}")
         if not inplace:
             model = copy.deepcopy(model)
-        self._convert(model)
+        self._convert(model, target)
         return model
 
-    def _convert(self, layer: nn.Layer):
+    def _convert(self, layer: nn.Layer, target: str = "int8"):
         for name, child in list(layer.named_children()):
             if isinstance(child, _ObservedLinear):
                 inner = child.inner
+                if target == "fp8":
+                    setattr(layer, name,
+                            FP8Linear(inner.weight, inner.bias))
+                    continue
                 w_int8, w_scale = quantize_to_int8(inner.weight, axis=-1)
                 act_scale = float(child.act_observer.scale())
                 setattr(layer, name,
                         Int8Linear(w_int8, w_scale, act_scale, inner.bias))
             else:
-                self._convert(child)
+                self._convert(child, target)

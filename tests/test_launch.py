@@ -196,9 +196,9 @@ class TestElasticManager:
             a.register()
             b.register()
             # rank order is sorted node id: hostA=0, hostB=1
-            n, r = a.resolve(timeout=10)
+            n, r = a.resolve(timeout=10, settle=0.3)
             assert (n, r) == (2, 1)
-            n, r = b.resolve(timeout=10)
+            n, r = b.resolve(timeout=10, settle=0.3)
             assert (n, r) == (2, 0)
         finally:
             srv.close()
@@ -214,17 +214,17 @@ class TestElasticManager:
                                heartbeat_ttl=0.6)
             a.register()
             b.register()
-            assert a.resolve(timeout=10) == (2, 0)
+            assert a.resolve(timeout=10, settle=0.3) == (2, 0)
             # n1 leaves (stops heartbeating)
             b.leave()
             _t.sleep(0.1)
             assert a.scale_event() == "scale_in"
-            n, r = a.resolve(timeout=10)
+            n, r = a.resolve(timeout=10, settle=0.3)
             assert (n, r) == (1, 0)
             # n1 rejoins -> scale_out
             b.heartbeat()
             assert a.scale_event() == "scale_out"
-            assert a.resolve(timeout=10) == (2, 0)
+            assert a.resolve(timeout=10, settle=0.3) == (2, 0)
         finally:
             srv.close()
 
@@ -239,3 +239,104 @@ class TestElasticManager:
                 a.resolve(timeout=1.5)
         finally:
             srv.close()
+
+
+# -- elastic end-to-end -----------------------------------------------------
+def test_elastic_end_to_end(tmp_path):
+    """VERDICT r4 Next #6 — the full failover loop through the REAL
+    stack: 4 single-trainer nodes train a GSPMD-sharded model over gloo;
+    the node-3 trainer dies hard mid-run; the surviving controllers
+    detect the stale heartbeat, re-rank via the ElasticManager to a
+    3-node world, respawn, and the workers resume from the 4-way-sharded
+    distributed checkpoint loaded onto the 3-device mesh
+    (reshard-on-load). The resumed trajectory must exactly continue the
+    pre-crash one. Reference: fleet/elastic/manager.py:126 (watch ->
+    re-rank -> relaunch) + checkpoint/load_state_dict.py:526."""
+    import socket as _socket
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    worker = os.path.join(REPO, "tests", "elastic_worker.py")
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "PADDLE_ELASTIC_MIN": "2", "PADDLE_ELASTIC_MAX": "4",
+        "PADDLE_HEARTBEAT_INTERVAL": "0.5",
+        "PADDLE_HEARTBEAT_STALE": "3",
+        "PADDLE_ELASTIC_TTL": "5", "PADDLE_ELASTIC_SETTLE": "2",
+        "ELASTIC_VICTIM": "3",
+    })
+    procs = []
+    for node in range(4):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nnodes", "4", "--node_rank", str(node),
+             "--nproc_per_node", "1",
+             "--master", f"127.0.0.1:{port}",
+             "--elastic_retries", "0" if node == 3 else "2",
+             "--log_dir", str(tmp_path / f"log{node}"),
+             worker, str(out_dir)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = {}
+    try:
+        # generous bound: ~52s standalone, but xdist runs this next to
+        # other multi-process tests on a shared box
+        for node, p in enumerate(procs):
+            outs[node] = p.communicate(timeout=420)[0]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    logs = "\n\n".join(f"== node {n} ==\n{o[-3000:]}"
+                       for n, o in outs.items())
+    # victim node fails; survivors finish clean after the re-ranked run
+    assert procs[3].returncode != 0, logs
+    for node in range(3):
+        assert procs[node].returncode == 0, logs
+
+    results = {}
+    for r in range(3):
+        f = out_dir / f"rank{r}_job1.json"
+        assert f.exists(), f"rank {r} job 1 wrote no result\n{logs}"
+        results[r] = json.loads(f.read_text())
+    for r, res in results.items():
+        assert res["world"] == 3, logs
+        assert res["start"] == 5, (res, logs)  # resumed, not restarted
+
+    # the resumed trajectory must exactly continue deterministic GD
+    import importlib.util
+    spec = importlib.util.spec_from_file_location("elastic_worker", worker)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    COLS, CRASH_STEP, LR, N, ROWS, TOTAL_STEPS = (
+        mod.COLS, mod.CRASH_STEP, mod.LR, mod.N, mod.ROWS,
+        mod.TOTAL_STEPS)
+    rng = np.random.RandomState(0)
+    A = rng.randn(N, ROWS).astype(np.float32)
+    b = rng.randn(N, COLS).astype(np.float32)
+    w = rng.randn(ROWS, COLS).astype(np.float32) * 0.1
+    losses = []
+    for _ in range(TOTAL_STEPS):
+        r_ = A @ w - b
+        losses.append(float((r_ ** 2).mean()))
+        w = w - LR * (2.0 / N / COLS) * (A.T @ r_)
+    np.testing.assert_allclose(results[0]["losses"],
+                               losses[CRASH_STEP:], rtol=1e-3,
+                               err_msg=logs[-1500:])
+    assert results[0]["losses"][-1] < losses[CRASH_STEP - 1], \
+        "loss did not keep descending after failover"
+    # reassemble the 3-way-sharded final weights from per-rank shards
+    w_got = np.zeros_like(w)
+    for res in results.values():
+        off = res["w_offset"]
+        loc = np.asarray(res["w_local"], np.float32)
+        w_got[off:off + loc.shape[0]] = loc
+    np.testing.assert_allclose(w_got, w, rtol=1e-3, atol=1e-5)
