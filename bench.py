@@ -620,12 +620,14 @@ def bench_flash_tune():
         return {"metric": "flash_autotune_shapes", "value": 0,
                 "unit": "shapes swept", "skipped": "interpret mode"}
     GLOBAL_FLAGS.set("kernel_autotune", True)
-    # (B, S, H, KV, D) of every llama ladder rung (hidden 2048 -> 16
-    # heads, 1536 -> 12, 1024 -> 8) and the ernie decode prefill
+    # (B, S, H, KV, D) of every llama rung (hidden 2048 -> 16 heads,
+    # 1536 -> 12, 1024 -> 8), the LLAMA_LADDER top rungs (3072 -> 24,
+    # 4096 -> 32) and the ernie decode prefill
     shapes = [(4, 2048, 16, 16, 128), (2, 2048, 16, 16, 128),
               (1, 2048, 16, 16, 128), (8, 2048, 12, 12, 128),
               (4, 2048, 12, 12, 128), (2, 2048, 8, 8, 128),
-              (8, 1024, 16, 16, 64)]
+              (4, 2048, 24, 24, 128), (2, 2048, 32, 32, 128),
+              (1, 2048, 32, 32, 128), (8, 1024, 16, 16, 64)]
     tuned = {}
     key = jax.random.PRNGKey(0)
     for B, S, H, KV, D in shapes:

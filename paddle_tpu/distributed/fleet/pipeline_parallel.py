@@ -285,11 +285,32 @@ class _SharedWrapper(Layer):
 
 class PipelineParallel(Layer):
     """1F1B micro-batch engine (reference: pipeline_parallel.py:242,
-    forward_backward_pipeline:684)."""
+    forward_backward_pipeline:684).
+
+    SCOPE: this eager engine is the single-host / debugging path — the
+    single controller moves activations by ``jax.device_put`` between
+    stage sub-meshes, which on a multi-host pod would serialize every
+    cross-host transfer through the controller. Production multi-chip
+    pipeline schedules run through
+    :class:`~paddle_tpu.distributed.fleet.pp_compiled.Compiled1F1B` /
+    ``CompiledInterleaved`` (the whole schedule is ONE XLA program
+    with ppermute transfers, validated multi-chip in the driver gate).
+    A warning fires when this engine is constructed over a multi-host
+    mesh."""
 
     def __init__(self, layers, hcg: Optional[HybridCommunicateGroup] = None,
                  strategy=None, accumulate_steps: int = 1):
         super().__init__()
+        try:
+            n_proc = jax.process_count()
+        except Exception:  # noqa: BLE001 — uninitialized backend
+            n_proc = 1
+        if n_proc > 1:
+            warnings.warn(
+                "PipelineParallel (eager engine) is single-host only: "
+                "the controller serializes cross-host activation "
+                "transfers. Use fleet.pp_compiled.Compiled1F1B for "
+                "multi-host pipelines.", stacklevel=2)
         if not isinstance(layers, PipelineLayer):
             raise TypeError(
                 "PipelineParallel requires a PipelineLayer "

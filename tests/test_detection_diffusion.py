@@ -114,6 +114,37 @@ def test_ppyoloe_bucketed_shapes_compile_once_each():
     assert seen == {(3, 64, 64), (3, 96, 96)}
 
 
+def test_bucketed_serving_steady_state_no_recompile():
+    """VERDICT r4 Next #4: steady-state bucket REUSE — a stream of
+    many distinct image sizes must trigger exactly one jit trace per
+    BUCKET, never one per shape (the dynamic-shape serving policy;
+    reference: TRT dynamic shapes, analysis_predictor.h:101)."""
+    net = ppyoloe_tiny(num_classes=2)
+    net.eval()
+    pure_fn, params, buffers = net.functional()
+
+    traces = []
+
+    @jax.jit
+    def fwd(params, buffers, images):
+        traces.append(images.shape)  # runs only when jit re-traces
+        (scores, boxes), _ = pure_fn(params, buffers, images)
+        return scores
+
+    b = ShapeBucketer(buckets=(64, 96))
+    rng = np.random.RandomState(0)
+    shapes_seen = set()
+    for _ in range(12):
+        h, w = int(rng.randint(30, 96)), int(rng.randint(30, 96))
+        shapes_seen.add((h, w))
+        padded, _, _ = b.pad_image(
+            rng.randn(3, h, w).astype(np.float32))
+        out = fwd(params, buffers, jnp.asarray(padded[None]))
+        assert np.isfinite(np.asarray(out)).all()
+    assert len(shapes_seen) > 2          # genuinely dynamic stream
+    assert len(traces) <= 2, traces      # one compile per bucket, max
+
+
 def test_ppyoloe_detect_single_jit_no_host_round_trip():
     """BASELINE config 5 requirement (round-3 verdict weak #5): backbone
     -> neck -> head -> device NMS compiles as ONE jit program — the
