@@ -25,6 +25,8 @@ from .auto_parallel import (ProcessMesh, Shard, Replicate, Partial,  # noqa
                             dtensor_to_local, unshard_dtensor, get_mesh,
                             set_mesh, shard_dataloader)
 from . import fleet  # noqa: F401
+from .fleet.sparse_table import (CountFilterEntry,  # noqa: F401
+                                 ProbabilityEntry, ShardedSparseTable)
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .store import TCPStore, TCPStoreServer  # noqa: F401
@@ -40,4 +42,5 @@ __all__ = [
     "new_group", "DataParallel", "fleet", "ProcessMesh", "Shard",
     "Replicate", "Partial", "shard_tensor", "reshard", "shard_layer",
     "shard_optimizer", "save_state_dict", "load_state_dict",
+    "CountFilterEntry", "ProbabilityEntry", "ShardedSparseTable",
 ]

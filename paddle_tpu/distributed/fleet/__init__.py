@@ -12,6 +12,8 @@ from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa
                         RowParallelLinear, ParallelCrossEntropy)
 from .pp_compiled import (CompiledPipeline, Compiled1F1B,  # noqa
                           CompiledInterleaved, pipeline_microbatch)
+from .sparse_table import (ShardedSparseTable, CountFilterEntry,  # noqa
+                           ProbabilityEntry, dedupe_sum)
 from . import sequence_parallel_utils  # noqa: F401
 from . import random  # noqa: F401
 from . import utils  # noqa: F401

@@ -1,0 +1,241 @@
+"""Large-scale sparse embedding tables — the TPU-native answer to the
+reference's parameter-server mode.
+
+Reference capability being replaced (not ported):
+- ``paddle.static.nn.sparse_embedding`` (python/paddle/static/nn/
+  common.py:3840) looks rows up from a ``MemorySparseTable`` living on
+  parameter-server processes (paddle/distributed/ps/the_one_ps.py
+  SparseTable), with sparse push/pull gradients, per-row optimizer
+  state, frequency-gated row admission (paddle/distributed/
+  entry_attr.py CountFilterEntry) and a padding row.
+
+TPU-native design: there are no separate server processes — the table
+IS a mesh-sharded array (rows over a mesh axis, GSPMD moves the
+gather/scatter traffic over ICI), and the "sparse push" is a
+fixed-shape scatter update touching only the looked-up rows, exactly
+like the PS applies a sparse optimizer to pulled rows. Per-row
+optimizer state (Adagrad accumulators) and admission counts are arrays
+sharded like the table, so the whole thing rides the normal
+distributed-checkpoint path (save/reshard/load) instead of PS
+snapshot RPCs. Capacity scales with the mesh: a v5p-64 slice holds a
+~2TB fp32 table at 32GB/chip — the workload class the reference needs
+a CPU parameter-server fleet for.
+
+Everything here is jit-compatible: the dedupe is a fixed-shape
+sort + segment-sum (no data-dependent shapes), so the update compiles
+once per batch geometry.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardedSparseTable", "CountFilterEntry", "ProbabilityEntry",
+           "dedupe_sum"]
+
+
+class CountFilterEntry:
+    """Frequency-gated row admission (reference: entry_attr.py:107):
+    a row's embedding only becomes active after it has been seen
+    ``count_filter`` times; before that, lookups return zeros. Guards
+    huge vocab tails from wasting capacity on one-off ids."""
+
+    def __init__(self, count_filter: int):
+        if count_filter < 1:
+            raise ValueError("count_filter must be >= 1")
+        self.count_filter = int(count_filter)
+
+
+class ProbabilityEntry:
+    """Probabilistic row admission (reference: entry_attr.py:62): each
+    observation admits the row with probability ``probability``. Used
+    through ``ShardedSparseTable(entry=...)`` — ``observe`` draws the
+    coin, ``lookup`` gates on admission (count >= 1)."""
+
+    count_filter = 1   # admitted after the first successful draw
+
+    def __init__(self, probability: float):
+        if not 0.0 < probability <= 1.0:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = float(probability)
+
+
+def dedupe_sum(ids, grads):
+    """Fixed-shape duplicate-id reduction: returns (ids_u, grads_u)
+    where every distinct id appears once with its gradients summed, and
+    padding slots point at row 0 with zero gradient (a harmless
+    scatter-add). The PS's sparse-push semantics — duplicate ids in one
+    batch push ONE summed gradient — without data-dependent shapes."""
+    n = ids.shape[0]
+    order = jnp.argsort(ids)
+    ids_s = ids[order]
+    g_s = grads[order]
+    new_seg = jnp.concatenate(
+        [jnp.ones((1,), bool), ids_s[1:] != ids_s[:-1]])
+    seg_idx = jnp.cumsum(new_seg) - 1                    # [n] in [0, n)
+    g_u = jax.ops.segment_sum(g_s, seg_idx, num_segments=n)
+    ids_u = jnp.zeros((n,), ids.dtype).at[seg_idx].set(ids_s)
+    used = jnp.arange(n) < (seg_idx[-1] + 1)
+    ids_u = jnp.where(used, ids_u, 0)
+    g_u = jnp.where(used[:, None], g_u, 0.0)
+    return ids_u, g_u
+
+
+class ShardedSparseTable:
+    """Mesh-sharded embedding table with sparse optimizer updates.
+
+    State (all sharded ``P(axis, None)`` / ``P(axis)`` over ``mesh``):
+    - ``weight``  [rows, dim]
+    - ``accum``   [rows] Adagrad accumulator (optimizer="adagrad")
+    - ``counts``  [rows] int32 admission counts (when ``entry`` given)
+
+    ``lookup`` gathers rows (GSPMD turns it into an ICI all-gather of
+    the touched shards); ``apply_sparse_grad`` pushes summed per-id
+    gradients back with a scatter, updating only touched rows — the
+    direct analog of the PS pull/push cycle, minus the RPCs.
+    """
+
+    def __init__(self, num_rows: int, dim: int, mesh: Mesh,
+                 axis: str = "mp", optimizer: str = "adagrad",
+                 lr: float = 0.05, padding_idx: Optional[int] = None,
+                 entry: Optional[CountFilterEntry] = None,
+                 initializer=None, seed: int = 0):
+        if optimizer not in ("adagrad", "sgd"):
+            raise ValueError(f"optimizer must be adagrad|sgd: {optimizer}")
+        self.num_rows, self.dim = int(num_rows), int(dim)
+        self.mesh, self.axis = mesh, axis
+        self.optimizer = optimizer
+        self.lr = float(lr)
+        self.padding_idx = (None if padding_idx is None
+                            else int(padding_idx) % int(num_rows))
+        self.entry = entry
+        key = jax.random.PRNGKey(seed)
+        row_sh = NamedSharding(mesh, P(axis, None))
+        vec_sh = NamedSharding(mesh, P(axis))
+        if initializer is None:
+            # init UNDER the sharding: each device materializes only its
+            # shard — building the full table on one device first would
+            # cap capacity at a single chip's HBM, the exact limit this
+            # class exists to remove
+            def _init():
+                w = (jax.random.normal(key, (num_rows, dim), jnp.float32)
+                     * (1.0 / np.sqrt(dim)))
+                if self.padding_idx is not None:
+                    w = w.at[self.padding_idx].set(0.0)
+                return w
+            with mesh:
+                self.weight = jax.jit(_init, out_shardings=row_sh)()
+        else:
+            w = jnp.asarray(initializer((num_rows, dim)), jnp.float32)
+            if self.padding_idx is not None:
+                w = w.at[self.padding_idx].set(0.0)
+            self.weight = jax.device_put(w, row_sh)
+        self.accum = (jax.device_put(jnp.zeros((num_rows,), jnp.float32),
+                                     vec_sh)
+                      if optimizer == "adagrad" else None)
+        self.counts = (jax.device_put(jnp.zeros((num_rows,), jnp.int32),
+                                      vec_sh)
+                       if entry is not None else None)
+
+    # -- pull ----------------------------------------------------------------
+    def lookup(self, weight, ids, counts=None):
+        """Rows for ``ids`` (any leading shape). Non-admitted rows (see
+        ``CountFilterEntry``) and the padding row come back zero.
+        Pure function of its array args so it jits/grads cleanly."""
+        out = jnp.take(weight, ids, axis=0)
+        mask = None
+        if self.entry is not None and counts is not None:
+            mask = jnp.take(counts, ids, axis=0) >= self.entry.count_filter
+        if self.padding_idx is not None:
+            pmask = ids != self.padding_idx
+            mask = pmask if mask is None else (mask & pmask)
+        if mask is not None:
+            out = jnp.where(mask[..., None], out, 0.0)
+        return out
+
+    def observe(self, counts, ids, key=None):
+        """Admission bookkeeping: count every occurrence (duplicates
+        included — the PS counts per-example shows). With a
+        ProbabilityEntry, each show admits with probability p; the PRNG
+        ``key`` is REQUIRED then (an implicit host-side draw would be
+        baked in as a trace-time constant under jit, replaying the same
+        coin flips every step)."""
+        flat = ids.reshape(-1)
+        if isinstance(self.entry, ProbabilityEntry):
+            if key is None:
+                raise ValueError(
+                    "ProbabilityEntry admission needs an explicit PRNG "
+                    "key per observe() call (split it from your step "
+                    "key)")
+            draw = (jax.random.uniform(key, flat.shape)
+                    < self.entry.probability).astype(jnp.int32)
+            return counts.at[flat].add(draw)
+        return counts.at[flat].add(1)
+
+    # -- push ----------------------------------------------------------------
+    def apply_sparse_grad(self, weight, accum, ids, grads,
+                          lr: Optional[float] = None, counts=None):
+        """Sparse optimizer step over the touched rows only (reference:
+        the sparse SGD/Adagrad rules the SparseTable applies on push).
+        ``ids`` [n], ``grads`` [n, dim]; duplicates are pre-summed so
+        each distinct row sees ONE combined gradient. Non-admitted rows
+        (entry gating via ``counts``) get NO push, like the PS. Returns
+        (weight, accum). Untouched rows are bit-identical.
+
+        All scatters are ``add`` (dedupe padding slots contribute
+        exact zeros): ``set`` with the repeated padding index would race
+        a stale against a fresh value nondeterministically."""
+        lr = self.lr if lr is None else lr
+        flat_ids = ids.reshape(-1)
+        flat_g = grads.reshape(-1, self.dim).astype(jnp.float32)
+        if self.padding_idx is not None:
+            keep = (flat_ids != self.padding_idx)[:, None]
+            flat_g = jnp.where(keep, flat_g, 0.0)
+        if self.entry is not None and counts is not None:
+            admitted = (jnp.take(counts, flat_ids)
+                        >= self.entry.count_filter)
+            flat_g = jnp.where(admitted[:, None], flat_g, 0.0)
+        ids_u, g_u = dedupe_sum(flat_ids, flat_g)
+        if self.optimizer == "sgd":
+            weight = weight.at[ids_u].add(-lr * g_u)
+            return weight, accum
+        gsq = jnp.sum(jnp.square(g_u), axis=-1)
+        accum = accum.at[ids_u].add(gsq)          # padding adds zero
+        acc_rows = jnp.take(accum, ids_u)         # post-update values
+        scale = lr * jax.lax.rsqrt(acc_rows + 1e-10)
+        weight = weight.at[ids_u].add(-scale[:, None] * g_u)
+        return weight, accum
+
+    # -- convenience train step ---------------------------------------------
+    def grad_and_update(self, weight, accum, ids, loss_fn,
+                        lr: Optional[float] = None, counts=None):
+        """One pull→loss→sparse-push cycle: ``loss_fn(embedded)`` where
+        ``embedded = lookup(ids)``; gradients w.r.t. the PULLED ROWS
+        only (never the full table — the point of sparse training).
+        With an admission entry, pass the CURRENT ``counts`` array
+        explicitly — it is functional state like weight/accum, and a
+        ``self.counts`` read here would be a stale trace-time constant
+        under jit."""
+        if self.entry is not None and counts is None:
+            raise ValueError(
+                "this table has an admission entry: pass counts= (the "
+                "array returned by observe()) so gating sees the "
+                "current state")
+        rows = self.lookup(weight, ids, counts)
+        loss, g_rows = jax.value_and_grad(loss_fn)(rows)
+        weight, accum = self.apply_sparse_grad(
+            weight, accum, ids, g_rows.reshape(-1, self.dim), lr=lr,
+            counts=counts)
+        return loss, weight, accum
+
+    def state_dict(self):
+        out = {"weight": self.weight}
+        if self.accum is not None:
+            out["accum"] = self.accum
+        if self.counts is not None:
+            out["counts"] = self.counts
+        return out

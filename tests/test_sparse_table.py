@@ -1,0 +1,212 @@
+"""ShardedSparseTable — the TPU-native parameter-server analog
+(reference: paddle.static.nn.sparse_embedding + distributed/ps
+SparseTable sparse push/pull; entry_attr.py CountFilterEntry)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed.fleet import (CountFilterEntry,
+                                          ShardedSparseTable, dedupe_sum)
+
+
+def _mesh(n=8, axis="mp"):
+    return Mesh(np.array(jax.devices()[:n]), (axis,))
+
+
+def test_dedupe_sum_merges_duplicates():
+    ids = jnp.asarray([5, 2, 5, 7, 2, 5], jnp.int32)
+    g = jnp.arange(6 * 3, dtype=jnp.float32).reshape(6, 3)
+    ids_u, g_u = dedupe_sum(ids, g)
+    got = {}
+    for i in range(6):
+        rid = int(ids_u[i])
+        v = np.asarray(g_u[i])
+        if v.any():
+            got[rid] = got.get(rid, 0) + v
+    want = {}
+    for i, rid in enumerate([5, 2, 5, 7, 2, 5]):
+        want[rid] = want.get(rid, 0) + np.asarray(g[i])
+    for rid, v in want.items():
+        np.testing.assert_allclose(got[rid], v, rtol=1e-6)
+
+
+def test_lookup_and_padding_row():
+    mesh = _mesh()
+    t = ShardedSparseTable(64, 16, mesh, optimizer="sgd", padding_idx=0)
+    ids = jnp.asarray([[1, 0], [63, 7]], jnp.int32)
+    out = t.lookup(t.weight, ids)
+    assert out.shape == (2, 2, 16)
+    np.testing.assert_array_equal(np.asarray(out[0, 1]), 0.0)
+    np.testing.assert_allclose(np.asarray(out[0, 0]),
+                               np.asarray(t.weight[1]))
+
+
+def test_sparse_sgd_matches_dense_with_duplicates():
+    """Sparse push with duplicate ids == dense embedding grad descent
+    (duplicates sum — the PS sparse-push contract)."""
+    mesh = _mesh()
+    t = ShardedSparseTable(32, 8, mesh, optimizer="sgd", lr=0.1)
+    w0 = np.asarray(t.weight).copy()
+    ids = jnp.asarray([3, 9, 3, 3, 20], jnp.int32)
+    tgt = jnp.asarray(np.random.RandomState(0).randn(5, 8), jnp.float32)
+
+    def loss_fn(rows):
+        return jnp.mean((rows - tgt) ** 2)
+
+    loss, w1, _ = t.grad_and_update(t.weight, t.accum, ids, loss_fn)
+
+    # dense reference: full-table embedding, same loss, plain SGD
+    def dense_loss(w):
+        return jnp.mean((jnp.take(w, ids, axis=0) - tgt) ** 2)
+    gw = jax.grad(dense_loss)(jnp.asarray(w0))
+    w_ref = np.asarray(w0 - 0.1 * gw)
+    np.testing.assert_allclose(np.asarray(w1), w_ref, atol=1e-6)
+    # untouched rows bit-identical
+    untouched = [i for i in range(32) if i not in (3, 9, 20)]
+    np.testing.assert_array_equal(np.asarray(w1)[untouched],
+                                  w0[untouched])
+
+
+def test_sparse_adagrad_accumulates_per_row():
+    mesh = _mesh()
+    t = ShardedSparseTable(16, 4, mesh, optimizer="adagrad", lr=0.5)
+    ids = jnp.asarray([2, 5, 2], jnp.int32)
+    g = jnp.ones((3, 4), jnp.float32)
+    w1, acc1 = t.apply_sparse_grad(t.weight, t.accum, ids, g)
+    # row 2 sees the SUMMED gradient (2.0 per element) once
+    gsum_row2 = 4 * (2.0 ** 2)     # |g|^2 of the summed grad
+    assert float(acc1[2]) == pytest.approx(gsum_row2)
+    assert float(acc1[5]) == pytest.approx(4 * 1.0)
+    assert float(acc1[7]) == 0.0
+    step2 = 0.5 / np.sqrt(gsum_row2 + 1e-10) * 2.0
+    np.testing.assert_allclose(np.asarray(t.weight[2] - w1[2]),
+                               np.full((4,), step2), rtol=1e-5)
+
+
+def test_adagrad_row0_with_duplicates_not_corrupted():
+    """Regression: dedupe padding slots point at row 0; the accumulator
+    scatter must be an ADD of exact zeros, never a repeated-index SET
+    racing stale vs fresh values — a batch containing real id 0 plus
+    duplicates of another id hits exactly that pattern."""
+    mesh = _mesh()
+    t = ShardedSparseTable(16, 4, mesh, optimizer="adagrad", lr=0.5)
+    ids = jnp.asarray([0, 7, 7], jnp.int32)
+    g = jnp.ones((3, 4), jnp.float32)
+    _, acc1 = t.apply_sparse_grad(t.weight, t.accum, ids, g)
+    assert float(acc1[0]) == pytest.approx(4 * 1.0)   # row 0 kept
+    assert float(acc1[7]) == pytest.approx(4 * 4.0)   # summed dup grad
+
+
+def test_probability_entry_and_top_level_exports():
+    import paddle_tpu.distributed as dist
+    assert dist.CountFilterEntry is CountFilterEntry
+    assert "ShardedSparseTable" in dist.__all__
+    mesh = _mesh()
+    t = ShardedSparseTable(16, 4, mesh, optimizer="sgd",
+                           entry=dist.ProbabilityEntry(1.0))
+    ids = jnp.asarray([3], jnp.int32)
+    out = t.lookup(t.weight, ids, t.counts)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)
+    with pytest.raises(ValueError, match="PRNG key"):
+        t.observe(t.counts, ids)   # implicit key would bake into jit
+    counts = t.observe(t.counts, ids, key=jax.random.PRNGKey(0))
+    out = t.lookup(t.weight, ids, counts)   # p=1.0: admitted first show
+    assert np.abs(np.asarray(out)).sum() > 0
+    with pytest.raises(ValueError):
+        dist.ProbabilityEntry(0.0)
+
+
+def test_gated_rows_get_no_push_until_admitted():
+    """Reference PS semantics: a non-admitted row receives NO optimizer
+    push — its embedding and Adagrad state stay pristine until the
+    admission threshold is crossed."""
+    mesh = _mesh()
+    t = ShardedSparseTable(16, 4, mesh, optimizer="adagrad", lr=0.5,
+                           entry=CountFilterEntry(2))
+    ids = jnp.asarray([3], jnp.int32)
+    tgt = jnp.ones((1, 4), jnp.float32)
+    counts = t.observe(t.counts, ids)      # count 1 < 2: still gated
+
+    def loss_fn(rows):
+        return jnp.mean((rows - tgt) ** 2)
+
+    _, w1, a1 = t.grad_and_update(t.weight, t.accum, ids, loss_fn,
+                                  counts=counts)
+    np.testing.assert_array_equal(np.asarray(w1[3]),
+                                  np.asarray(t.weight[3]))
+    assert float(a1[3]) == 0.0
+    counts = t.observe(counts, ids)        # count 2: admitted
+    _, w2, a2 = t.grad_and_update(w1, a1, ids, loss_fn, counts=counts)
+    assert np.abs(np.asarray(w2[3] - w1[3])).sum() > 0
+    assert float(a2[3]) > 0.0
+    # entry table without counts must fail loudly, not silently gate
+    with pytest.raises(ValueError, match="counts"):
+        t.grad_and_update(w2, a2, ids, loss_fn)
+
+
+def test_entry_gating_admits_after_threshold():
+    mesh = _mesh()
+    t = ShardedSparseTable(16, 4, mesh, optimizer="sgd",
+                           entry=CountFilterEntry(2))
+    ids = jnp.asarray([3], jnp.int32)
+    out = t.lookup(t.weight, ids, t.counts)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)  # unseen: gated
+    counts = t.observe(t.counts, ids)
+    out = t.lookup(t.weight, ids, counts)
+    np.testing.assert_array_equal(np.asarray(out), 0.0)  # count 1 < 2
+    counts = t.observe(counts, ids)
+    out = t.lookup(t.weight, ids, counts)
+    assert np.abs(np.asarray(out)).sum() > 0              # admitted
+
+
+def test_sharded_update_under_jit_matches_single_device():
+    """The whole pull->loss->push cycle jitted over the 8-device mesh
+    must equal the 1-device result (GSPMD moves rows, math unchanged)."""
+    ids = jnp.asarray([4, 11, 4, 30], jnp.int32)
+    tgt = jnp.asarray(np.random.RandomState(1).randn(4, 8), jnp.float32)
+
+    results = {}
+    for n in (1, 8):
+        mesh = _mesh(n)
+        t = ShardedSparseTable(32, 8, mesh, optimizer="adagrad", lr=0.2,
+                               seed=7)
+
+        @jax.jit
+        def train2(w, a):
+            def loss_fn(rows):
+                return jnp.mean((rows - tgt) ** 2)
+            l1, w, a = t.grad_and_update(w, a, ids, loss_fn)
+            l2, w, a = t.grad_and_update(w, a, ids, loss_fn)
+            return l1, l2, w, a
+
+        with mesh:
+            l1, l2, w, a = train2(t.weight, t.accum)
+        results[n] = (float(l1), float(l2), np.asarray(w), np.asarray(a))
+    assert results[1][1] < results[1][0]   # loss descends
+    np.testing.assert_allclose(results[8][2], results[1][2], atol=1e-6)
+    np.testing.assert_allclose(results[8][3], results[1][3], atol=1e-6)
+    assert results[8][0] == pytest.approx(results[1][0])
+
+
+def test_state_dict_roundtrip_through_dist_checkpoint(tmp_path):
+    """Table + accumulators ride the distributed checkpoint (the PS
+    snapshot analog), resharding 8 -> 4 devices on load."""
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed.checkpoint.save_load import (
+        load_state_dict, save_state_dict)
+
+    t = ShardedSparseTable(32, 8, _mesh(8), optimizer="adagrad")
+    ids = jnp.asarray([1, 2, 3], jnp.int32)
+    w, a = t.apply_sparse_grad(t.weight, t.accum, ids,
+                               jnp.ones((3, 8), jnp.float32))
+    save_state_dict({"weight": Tensor(w), "accum": Tensor(a)},
+                    str(tmp_path))
+    t2 = ShardedSparseTable(32, 8, _mesh(4), optimizer="adagrad", seed=9)
+    st = {"weight": Tensor(t2.weight), "accum": Tensor(t2.accum)}
+    load_state_dict(st, str(tmp_path))
+    np.testing.assert_allclose(np.asarray(st["weight"]._value),
+                               np.asarray(w), atol=1e-7)
+    np.testing.assert_allclose(np.asarray(st["accum"]._value),
+                               np.asarray(a), atol=1e-7)

@@ -36,20 +36,23 @@ LOG = os.path.join(ROOT, "BENCH_PROBE_LOG.jsonl")
 OUT = os.path.join(ROOT, "BENCH_OPPORTUNISTIC.json")
 
 # (config, timeout_sec, max_attempts)
+# Ordered by round-5 verdict priority: tunnel windows historically last
+# ~45 min, so the north star (llama, with its blocks freshly tuned) and
+# the never-measured ppyoloe must land before the breakdowns/sweeps.
 PACK = [
     ("flash_tune", 900, 2),
-    ("resnet50", 1500, 3),
     ("llama", 1500, 3),
-    ("llama_ladder", 2700, 2),
-    ("resnet50_sweep", 1500, 2),
-    ("resnet_breakdown", 1200, 2),
-    ("kernels", 1200, 3),
-    ("llama_breakdown", 1200, 2),
+    ("resnet50", 1500, 3),
+    ("ppyoloe", 900, 2),
+    ("bert", 900, 2),
     ("ernie_infer", 900, 2),
     ("paged_decode", 1500, 2),
+    ("llama_ladder", 2700, 2),
+    ("resnet50_sweep", 1500, 2),
+    ("kernels", 1200, 3),
+    ("resnet_breakdown", 1200, 2),
+    ("llama_breakdown", 1200, 2),
     ("sd_unet", 900, 2),
-    ("bert", 900, 2),
-    ("ppyoloe", 900, 2),
 ]
 
 
