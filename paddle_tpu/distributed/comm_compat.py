@@ -1,0 +1,195 @@
+"""Process-group lifecycle + object collectives + spawn (reference:
+python/paddle/distributed/parallel.py — is_available, get_backend,
+destroy_process_group, spawn (spawn.py), scatter_object_list
+(communication/scatter.py:169), gloo_init_parallel_env / gloo_barrier /
+gloo_release (parallel_with_gloo.py)).
+
+TPU-native mapping: the "backend" is XLA's coordination service +
+collectives ('xla' on TPU, 'gloo' CPU multi-process); the reference's
+auxiliary gloo control group maps onto the launcher's TCPStore — same
+rendezvous, no extra transport.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional
+
+import numpy as np
+
+from .env import get_rank, get_world_size, is_initialized
+
+__all__ = ["is_available", "get_backend", "destroy_process_group",
+           "spawn", "scatter_object_list", "gloo_init_parallel_env",
+           "gloo_barrier", "gloo_release"]
+
+
+def is_available() -> bool:
+    """reference: parallel.py is_available — whether the distributed
+    package works in this build. Always true: collectives are part of
+    jax/XLA, not an optional compile flag."""
+    return True
+
+
+def get_backend(group=None) -> str:
+    """'xla' on an accelerator (collectives over ICI/DCN), 'gloo' for
+    CPU multi-process (reference returns NCCL/GLOO the same way)."""
+    import jax
+    try:
+        plat = jax.default_backend()
+    except Exception:  # noqa: BLE001 — backend not initialized yet
+        plat = "cpu"
+    return "gloo" if plat == "cpu" else "xla"
+
+
+def destroy_process_group(group=None):
+    """Tear down the coordination service (reference:
+    parallel.py destroy_process_group). Safe to call when nothing was
+    initialized."""
+    from . import env as _env
+    import jax
+    if group is not None:
+        return    # sub-groups hold no OS resources here
+    if _env._initialized[0]:
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — already down
+            pass
+        _env._initialized[0] = False
+
+
+def spawn(func, args=(), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, **options):
+    """Launch ``nprocs`` single-rank worker processes running ``func``
+    (reference: spawn.py:spawn — the notebook-friendly alternative to
+    the launch CLI). Each child gets the PADDLE_* env contract and a
+    shared TCPStore master; ``func`` runs after env setup, so
+    ``init_parallel_env()`` inside it rendezvouses exactly like under
+    ``paddle_tpu.distributed.launch``."""
+    import multiprocessing as mp
+    import socket
+
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if nprocs == 1:
+        func(*args)
+        return None
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_entry,
+                        args=(func, args, rank, nprocs, port),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    for p in procs:
+        p.join()
+    bad = [p.exitcode for p in procs if p.exitcode]
+    if bad:
+        raise RuntimeError(f"spawned workers failed: exit codes {bad}")
+    return None
+
+
+def _spawn_entry(func, args, rank, nprocs, port):
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_MASTER": f"127.0.0.1:{port}",
+        "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
+    })
+    # rank 0 hosts the control-plane store like the launch controller
+    if rank == 0:
+        from .store import TCPStoreServer
+        server = TCPStoreServer(port=port)  # noqa: F841 — owned by proc
+    func(*args)
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None,
+                        src: int = 0, group=None):
+    """Scatter picklable objects from ``src`` (reference:
+    communication/scatter.py:169): rank r receives
+    ``in_object_list[r]``. Objects ride the tensor scatter as padded
+    uint8 buffers with a broadcast length header."""
+    from . import collective as C
+    from ..core.tensor import Tensor
+
+    world = get_world_size(group)
+    rank = get_rank(group)
+    out_object_list.clear()
+    if world <= 1:
+        out_object_list.append(in_object_list[0]
+                               if in_object_list else None)
+        return
+    if rank == src:
+        if in_object_list is None or len(in_object_list) != world:
+            raise ValueError(
+                f"src must pass one object per rank ({world})")
+        blobs = [np.frombuffer(pickle.dumps(o), np.uint8).astype(
+            np.float32) for o in in_object_list]
+        width = max(b.size for b in blobs)
+        lens = np.asarray([b.size for b in blobs], np.float32)
+        mat = np.zeros((world, width), np.float32)
+        for i, b in enumerate(blobs):
+            mat[i, :b.size] = b
+    else:
+        lens = np.zeros((world,), np.float32)
+        mat = None
+    lens_t = Tensor(lens)
+    C.broadcast(lens_t, src=src, group=group)
+    lens = np.asarray(lens_t._value).astype(np.int64)
+    width = int(lens.max())
+    recv = Tensor(np.zeros((width,), np.float32))
+    parts = None
+    if rank == src:
+        parts = [Tensor(mat[i, :width].copy()) for i in range(world)]
+    C.scatter(recv, parts, src=src, group=group)
+    buf = np.asarray(recv._value).astype(np.uint8)[:lens[rank]]
+    out_object_list.append(pickle.loads(buf.tobytes()))
+
+
+# -- auxiliary gloo-style control group over the TCPStore -------------------
+_GLOO = {"store": None, "server": None, "world": 1, "rank": 0,
+         "n_barrier": 0}
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int,
+                           server_endpoint: str):
+    """Small CPU control group (reference: parallel_with_gloo.py:52 —
+    used for barrier/coordination outside the training backend). Rank 0
+    hosts the store at ``server_endpoint``."""
+    from .store import TCPStore, TCPStoreServer
+    host, _, port = server_endpoint.rpartition(":")
+    port = int(port)
+    if rank_id == 0:
+        _GLOO["server"] = TCPStoreServer(port=port)
+        port = _GLOO["server"].port
+    _GLOO["store"] = TCPStore(host or "127.0.0.1", port)
+    _GLOO["world"] = rank_num
+    _GLOO["rank"] = rank_id
+    _GLOO["store"].set(f"gloo/rank/{rank_id}", "up")
+
+
+def gloo_barrier():
+    """reference: parallel_with_gloo.py gloo_barrier."""
+    if _GLOO["store"] is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    _GLOO["n_barrier"] += 1
+    _GLOO["store"].barrier(f"gloo/barrier/{_GLOO['n_barrier']}",
+                           _GLOO["world"])
+
+
+def gloo_release():
+    """reference: parallel_with_gloo.py gloo_release."""
+    store, server = _GLOO["store"], _GLOO["server"]
+    _GLOO.update(store=None, server=None, world=1, rank=0)
+    if server is not None:
+        try:
+            server.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -7,7 +7,13 @@ ZeRO sharding + DataParallel, orchestrated by ``fleet.init`` /
 """
 from .fleet import (init, distributed_model, distributed_optimizer,  # noqa
                     DistributedStrategy, get_hybrid_communicate_group,
-                    worker_num, worker_index)
+                    worker_num, worker_index, Fleet)
+from ..topology import (CommunicateTopology,  # noqa: F401
+                        HybridCommunicateGroup)
+from .ps_compat import (Role, PaddleCloudRoleMaker,  # noqa: F401
+                        UserDefinedRoleMaker, UtilBase,
+                        MultiSlotDataGenerator,
+                        MultiSlotStringDataGenerator)
 from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa
                         RowParallelLinear, ParallelCrossEntropy)
 from .pp_compiled import (CompiledPipeline, Compiled1F1B,  # noqa

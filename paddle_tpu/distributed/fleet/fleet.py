@@ -109,3 +109,53 @@ def distributed_optimizer(optimizer, strategy=None):
     hcg = get_hybrid_communicate_group()
     return HybridParallelOptimizer(optimizer, hcg,
                                    _state.strategy or DistributedStrategy())
+
+
+class Fleet:
+    """The class behind the ``fleet`` singleton (reference:
+    fleet/fleet.py:119 class Fleet — the module-level ``fleet`` object
+    users call ``fleet.init()`` etc. on). Here the module IS the
+    singleton; this class delegates to it so ported code that
+    instantiates or type-checks ``Fleet`` keeps working, and
+    ``util`` exposes the UtilBase helpers."""
+
+    def __init__(self):
+        from .ps_compat import UtilBase
+        self.util = UtilBase()
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        return init(role_maker, is_collective, strategy)
+
+    def is_first_worker(self):
+        return worker_index() == 0
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_worker(self):
+        return True
+
+    def worker_endpoints(self, to_string=False):
+        import os
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        eps = [e for e in eps if e]
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return 0       # TPU-native: no parameter servers (sparse_table)
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        from .ps_compat import UtilBase
+        UtilBase().barrier()
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)

@@ -1,0 +1,265 @@
+"""Fleet role/util/data-generator surface (reference:
+python/paddle/distributed/fleet/base/role_maker.py:40 Role,
+:548 PaddleCloudRoleMaker, :1213 UserDefinedRoleMaker;
+base/util_factory.py:64 UtilBase;
+data_generator/data_generator.py:25 DataGenerator + MultiSlot*).
+
+In the reference these orchestrate the parameter-server fleet (workers
+vs servers, barrier/all-reduce through gloo, and the line-based
+MultiSlotDataFeed wire format that PS data loaders consume). In the
+TPU-native design there are no server processes — every process is a
+WORKER rank of the mesh (see fleet/sparse_table.py for where the PS
+capability itself went) — but the role/util/data-generator APIs remain
+real: roles resolve from the launcher env, UtilBase runs its
+collectives through the eager collective layer, and the data
+generators emit the exact MultiSlot text format so existing PS data
+pipelines keep producing consumable files.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+           "UtilBase", "DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class Role:
+    """reference: role_maker.py:40."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Rank/role resolution from the launcher env (reference:
+    role_maker.py:548 — reads the PADDLE_* env contract). On TPU every
+    process is a worker; server counts are 0 unless injected via
+    kwargs (tests / ported configs)."""
+
+    def __init__(self, is_collective: bool = True, **kwargs):
+        self._is_collective = is_collective
+        self._role = kwargs.get("role", Role.WORKER)
+        self._worker_num = int(kwargs.get(
+            "worker_num", os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+        self._server_num = int(kwargs.get("server_num", 0))
+        self._rank = int(kwargs.get(
+            "current_id", os.environ.get("PADDLE_TRAINER_ID", "0")))
+
+    def _generate_role(self):
+        return None
+
+    def role(self):
+        return self._role
+
+    def is_worker(self) -> bool:
+        return self._role in (Role.WORKER, Role.ALL)
+
+    def is_server(self) -> bool:
+        return self._role in (Role.SERVER, Role.ALL)
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self._rank == 0
+
+    def worker_index(self) -> int:
+        return self._rank
+
+    def server_index(self) -> int:
+        return self._rank if self.is_server() else -1
+
+    def worker_num(self) -> int:
+        return self._worker_num
+
+    def server_num(self) -> int:
+        return self._server_num
+
+    def role_id(self) -> int:
+        return self._rank
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit ranks instead of env (reference: role_maker.py:1213)."""
+
+    def __init__(self, is_collective: bool = False, init_gloo: bool = False,
+                 **kwargs):
+        super().__init__(is_collective=is_collective, **kwargs)
+
+
+class UtilBase:
+    """Cross-rank utilities (reference: base/util_factory.py:64 —
+    all_reduce / barrier / all_gather through the fleet's comm world).
+    Here they run through the eager collective layer (XLA/gloo), which
+    is a no-op single-process."""
+
+    def all_reduce(self, input, mode: str = "sum", comm_world="worker"):
+        from .. import collective as C
+        from ..env import get_world_size
+        arr = np.asarray(input)
+        if get_world_size() <= 1:
+            return arr if mode != "mean" else arr
+        from ...core.tensor import Tensor
+        t = Tensor(arr.astype(np.float64).astype(np.float32))
+        op = {"sum": C.ReduceOp.SUM, "min": C.ReduceOp.MIN,
+              "max": C.ReduceOp.MAX, "mean": C.ReduceOp.AVG}[mode]
+        C.all_reduce(t, op=op)
+        return np.asarray(t._value)
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as C
+        from ..env import get_world_size
+        if get_world_size() > 1:
+            C.barrier()
+
+    def all_gather(self, input, comm_world="worker") -> List:
+        from .. import collective as C
+        from ..env import get_world_size
+        if get_world_size() <= 1:
+            return [input]
+        from ...core.tensor import Tensor
+        out: List = []
+        C.all_gather(out, Tensor(np.asarray(input, np.float32)))
+        return [np.asarray(t._value) for t in out]
+
+    def get_file_shard(self, files: Sequence[str]) -> List[str]:
+        """Split a file list evenly over workers (reference semantics:
+        contiguous blocks, remainder to the first ranks)."""
+        from ..env import get_rank, get_world_size
+        return shard_file_list(files, get_rank(), get_world_size())
+
+    def print_on_rank(self, message: str, rank_id: int = 0):
+        from ..env import get_rank
+        if get_rank() == rank_id:
+            print(message)
+
+
+def shard_file_list(files: Sequence[str], rank: int,
+                    world: int) -> List[str]:
+    """Contiguous per-worker file split, remainder to the first ranks
+    (reference set_filelist semantics). Shared by UtilBase and the PS
+    dataset feeders."""
+    files = list(files)
+    base, rem = divmod(len(files), world)
+    start = rank * base + min(rank, rem)
+    return files[start:start + base + (1 if rank < rem else 0)]
+
+
+class DataGenerator:
+    """Line-processing base (reference: data_generator.py:25): user
+    overrides ``generate_sample(line)`` (and optionally
+    ``generate_batch``); ``run_from_stdin`` / ``run_from_memory`` emit
+    the MultiSlotDataFeed text format on stdout."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size: int):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(line) -> iterator factory")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    def _flush(self, batch_samples, out):
+        for sample in self.generate_batch(batch_samples)():
+            out.write(self._gen_str(sample))
+
+    def run_from_memory(self, out=None):
+        out = out or sys.stdout
+        batch, it = [], self.generate_sample(None)
+        for parsed in it():
+            if parsed is None:
+                continue
+            batch.append(parsed)
+            if len(batch) == self.batch_size_:
+                self._flush(batch, out)
+                batch = []
+        if batch:
+            self._flush(batch, out)
+
+    def run_from_stdin(self, stdin=None, out=None):
+        stdin = stdin or sys.stdin
+        out = out or sys.stdout
+        batch = []
+        for line in stdin:
+            it = self.generate_sample(line)
+            for parsed in it():
+                if parsed is None:
+                    continue
+                batch.append(parsed)
+                if len(batch) == self.batch_size_:
+                    self._flush(batch, out)
+                    batch = []
+        if batch:
+            self._flush(batch, out)
+
+
+def _check_line(line):
+    if isinstance(line, zip):
+        line = list(line)
+    if not isinstance(line, (list, tuple)):
+        raise ValueError(
+            "the output of generate_sample() must be list or tuple, "
+            "e.g. [('words', [1926, 8, 17]), ('label', [1])]")
+    return line
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """[(name, [str, ...]), ...] -> "len v1 v2 ... len v1 ..." lines
+    (reference: data_generator.py:237)."""
+
+    def _gen_str(self, line) -> str:
+        line = _check_line(line)
+        parts = []
+        for _name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Typed variant (reference: data_generator.py:285): tracks a
+    (name, uint64|float) proto per slot and validates consistency
+    across lines."""
+
+    def _gen_str(self, line) -> str:
+        line = _check_line(line)
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                if not isinstance(name, str):
+                    raise ValueError(f"slot name must be str: {name!r}")
+                if not isinstance(elements, list) or not elements:
+                    raise ValueError(
+                        f"slot {name}: elements must be a non-empty list")
+                t = "uint64" if all(isinstance(e, int) for e in elements) \
+                    else "float"
+                self._proto_info.append((name, t))
+        elif len(line) != len(self._proto_info):
+            raise ValueError(
+                f"expected {len(self._proto_info)} slots, got {len(line)}")
+        parts = []
+        for i, (name, elements) in enumerate(line):
+            pname, ptype = self._proto_info[i]
+            if name != pname:
+                raise ValueError(
+                    f"slot order changed: expected {pname}, got {name}")
+            if ptype == "uint64" and not all(
+                    isinstance(e, int) for e in elements):
+                # promote the slot to float once a float appears
+                self._proto_info[i] = (pname, "float")
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"

@@ -146,6 +146,11 @@ class ShardedSparseTable:
         """Rows for ``ids`` (any leading shape). Non-admitted rows (see
         ``CountFilterEntry``) and the padding row come back zero.
         Pure function of its array args so it jits/grads cleanly."""
+        if self.entry is not None and counts is None:
+            raise ValueError(
+                "this table has an admission entry: pass counts= (the "
+                "array returned by observe()) — omitting it would "
+                "silently skip gating")
         out = jnp.take(weight, ids, axis=0)
         mask = None
         if self.entry is not None and counts is not None:

@@ -1,0 +1,159 @@
+"""PS-mode dataset feeders (reference: python/paddle/distributed/fleet/
+dataset/dataset.py — InMemoryDataset / QueueDataset over the
+MultiSlotDataFeed text format; entry_attr.py ShowClickEntry).
+
+The reference streams slot files through C++ DataFeed readers into the
+parameter-server trainers. Here the SAME text format (what
+fleet.MultiSlot*DataGenerator emits — "len v1 v2 ... len v1 ..." per
+line) parses into numpy slot batches feeding the mesh trainers:
+InMemoryDataset loads + globally shuffles in host memory, QueueDataset
+streams file-by-file with no materialization. Both shard their file
+lists per worker like the reference's ``set_filelist`` split.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["InMemoryDataset", "QueueDataset", "ShowClickEntry"]
+
+
+class ShowClickEntry:
+    """Show/click statistics entry for sparse-table training
+    (reference: entry_attr.py ShowClickEntry — names the show and
+    click slots the table's CTR statistics read)."""
+
+    def __init__(self, show_name: str, click_name: str):
+        if not show_name or not click_name:
+            raise ValueError("show/click slot names must be non-empty")
+        self.show_name = show_name
+        self.click_name = click_name
+
+
+def _parse_line(line: str, slots: Sequence[str],
+                float_slots: Sequence[str]):
+    toks = line.split()
+    out: Dict[str, np.ndarray] = {}
+    i = 0
+    for name in slots:
+        if i >= len(toks):
+            raise ValueError(f"truncated MultiSlot line at slot {name}")
+        n = int(toks[i])
+        vals = toks[i + 1:i + 1 + n]
+        i += 1 + n
+        dt = np.float32 if name in float_slots else np.int64
+        out[name] = np.asarray([dt(v) if dt is np.float32 else int(v)
+                                for v in vals], dt)
+    return out
+
+
+class _DatasetBase:
+    def __init__(self):
+        self._files: List[str] = []
+        self._slots: List[str] = []
+        self._float_slots: List[str] = []
+        self.batch_size = 1
+        self._entry: Optional[ShowClickEntry] = None
+
+    # reference config surface -------------------------------------------
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             thread_num=1, **kwargs):
+        self.batch_size = batch_size
+        if use_var:
+            self.set_use_var(use_var)
+
+    def set_filelist(self, files: Sequence[str]):
+        self._files = list(files)
+
+    def set_use_var(self, var_list):
+        """Slot order = var order (reference binds feed vars); names
+        may be plain strings or objects with ``.name``."""
+        self._slots = [getattr(v, "name", str(v)) for v in var_list]
+
+    def set_float_slots(self, names: Sequence[str]):
+        self._float_slots = list(names)
+
+    def set_batch_size(self, batch_size: int):
+        self.batch_size = batch_size
+
+    def set_show_click_entry(self, entry: ShowClickEntry):
+        self._entry = entry
+
+    def _my_files(self) -> List[str]:
+        from .env import get_rank, get_world_size
+        from .fleet.ps_compat import shard_file_list
+        return shard_file_list(self._files, get_rank(),
+                               get_world_size())
+
+    def _iter_samples(self, files) -> Iterator[Dict[str, np.ndarray]]:
+        for path in files:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield _parse_line(line, self._slots,
+                                          self._float_slots)
+
+    def _batches(self, samples) -> Iterator[Dict[str, np.ndarray]]:
+        batch: List[Dict[str, np.ndarray]] = []
+        for s in samples:
+            batch.append(s)
+            if len(batch) == self.batch_size:
+                yield self._collate(batch)
+                batch = []
+        if batch:
+            yield self._collate(batch)
+
+    @staticmethod
+    def _collate(batch):
+        out = {}
+        for name in batch[0]:
+            arrs = [b[name] for b in batch]
+            width = max(a.shape[0] for a in arrs)
+            dt = arrs[0].dtype
+            pad = np.zeros((len(arrs), width), dt)
+            for i, a in enumerate(arrs):
+                pad[i, :a.shape[0]] = a
+            out[name] = pad
+        return out
+
+
+class InMemoryDataset(_DatasetBase):
+    """Load-then-shuffle feeder (reference: dataset.py InMemoryDataset
+    — load_into_memory / local_shuffle / global_shuffle). Global
+    shuffle on a mesh is a per-worker shuffle of the worker's file
+    shard with a shared seed (every sample still visited once
+    globally)."""
+
+    def __init__(self):
+        super().__init__()
+        self._mem: List[Dict[str, np.ndarray]] = []
+
+    def load_into_memory(self):
+        self._mem = list(self._iter_samples(self._my_files()))
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        random.Random(seed).shuffle(self._mem)
+
+    def global_shuffle(self, fleet=None, thread_num=1,
+                       seed: Optional[int] = 0):
+        self.local_shuffle(seed)
+
+    def release_memory(self):
+        self._mem = []
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return len(self._mem)
+
+    def __iter__(self):
+        return self._batches(iter(self._mem))
+
+
+class QueueDataset(_DatasetBase):
+    """Streaming feeder (reference: dataset.py QueueDataset): no
+    materialization — batches come straight off the file stream."""
+
+    def __iter__(self):
+        return self._batches(self._iter_samples(self._my_files()))

@@ -767,3 +767,8 @@ def variable_length_memory_efficient_attention(
         return out.astype(qv.dtype)
 
     return dispatch(f, args, name="varlen_mem_efficient_attention")
+
+
+from .serving_attention import (blha_get_max_len,  # noqa: F401, E402
+                                block_multihead_attention,
+                                masked_multihead_attention)

@@ -1,0 +1,270 @@
+"""Serving-side fused attention ops (reference:
+python/paddle/incubate/nn/functional/block_multihead_attention.py:33,
+masked_multihead_attention.py:74, blha_get_max_len.py:26 — the CUDA
+fusion kernels behind paddle/phi/kernels/fusion/gpu/
+block_multi_head_attention_kernel.cu and masked_multihead_attention).
+
+TPU-native design: both ops are one jit-fusable jnp program — the
+block (paged) variant drives the same pool/table machinery as
+``paddle_tpu.ops.paged_attention`` (the Pallas decode kernel underneath
+on TPU), the masked variant is a single fused decode step over a dense
+[2, B, H, S, D] cache. Quantized-cache / beam-search / smooth-quant
+extras are gated loudly (the serving path here runs bf16 caches; int8
+cache quant is a memory optimization the paged pools don't need at
+these shapes).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ....core.tensor import Tensor, dispatch
+
+__all__ = ["blha_get_max_len", "block_multihead_attention",
+           "masked_multihead_attention"]
+
+
+def _ensure(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def blha_get_max_len(seq_lens_encoder, seq_lens_decoder, batch_size):
+    """Max encoder/decoder length this step (reference:
+    blha_get_max_len.py:26 — a tiny fused reduction used to pick the
+    kernel path before block_multihead_attention)."""
+    a = _ensure(seq_lens_encoder)
+    b = _ensure(seq_lens_decoder)
+    return dispatch(
+        lambda e, d: (jnp.max(e).astype(jnp.int32).reshape(1),
+                      jnp.max(d).astype(jnp.int32).reshape(1)),
+        (a, b), name="blha_get_max_len", multi_output=True)
+
+
+def _gate(kwargs):
+    unsupported = {k: v for k, v in kwargs.items() if v is not None}
+    if unsupported:
+        raise NotImplementedError(
+            "block/masked multihead attention: quantized-cache / "
+            "beam-search / smooth-quant arguments are not part of the "
+            f"TPU serving path (got {sorted(unsupported)}); the bf16 "
+            "paged pools make the int8-cache memory optimization "
+            "unnecessary at serving shapes")
+
+
+def masked_multihead_attention(
+        x, cache_kv=None, bias=None, src_mask=None, cum_offsets=None,
+        sequence_lengths=None, rotary_tensor=None, beam_cache_offset=None,
+        qkv_out_scale=None, out_shift=None, out_smooth=None, seq_len=1,
+        rotary_emb_dims=0, use_neox_rotary_style=False,
+        compute_dtype="default", out_scale=-1, quant_round_type=1,
+        quant_max_bound=127.0, quant_min_bound=-127.0):
+    """One fused decode step over a dense cache (reference:
+    masked_multihead_attention.py:74). x [B, 3*H*D] packed qkv for the
+    CURRENT token; cache_kv [2, B, H, S_max, D]; sequence_lengths [B]
+    or [B,1] = number of tokens already in the cache (the new token is
+    written at that slot). Returns (out [B, H*D], cache_kv_out)."""
+    _gate(dict(cum_offsets=cum_offsets, rotary_tensor=rotary_tensor,
+               beam_cache_offset=beam_cache_offset,
+               qkv_out_scale=qkv_out_scale, out_shift=out_shift,
+               out_smooth=out_smooth))
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention requires cache_kv")
+    if out_scale is not None and out_scale > 0:
+        raise NotImplementedError(
+            "masked_multihead_attention: quantized output "
+            "(out_scale > 0) is not part of the TPU serving path")
+    x = _ensure(x)
+    cache_kv = _ensure(cache_kv)
+    args = [x, cache_kv]
+    if bias is not None:
+        args.append(_ensure(bias))
+    if src_mask is not None:
+        args.append(_ensure(src_mask))
+    if sequence_lengths is not None:
+        args.append(_ensure(sequence_lengths))
+    has_bias = bias is not None
+    has_mask = src_mask is not None
+    has_lens = sequence_lengths is not None
+
+    def f(xv, cache, *rest):
+        i = 0
+        b = rest[i] if has_bias else None
+        i += int(has_bias)
+        m = rest[i] if has_mask else None
+        i += int(has_mask)
+        lens = rest[i] if has_lens else None
+        _, B, H, S, D = cache.shape
+        qkv = xv.reshape(B, 3, H, D)
+        if b is not None:
+            qkv = qkv + b.reshape(1, 3, H, D).astype(qkv.dtype)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]   # [B, H, D]
+        if lens is None:
+            pos = jnp.full((B,), seq_len - 1, jnp.int32)
+        else:
+            pos = lens.reshape(B).astype(jnp.int32)
+        # write the new token at its slot: one-hot scatter keeps the
+        # whole step a single fused program (no dynamic slices per seq)
+        onehot = (jnp.arange(S)[None, :] == pos[:, None])   # [B, S]
+        sel = onehot[:, None, :, None]                      # [B,1,S,1]
+        kc = jnp.where(sel, k_new[:, :, None, :].astype(cache.dtype),
+                       cache[0])
+        vc = jnp.where(sel, v_new[:, :, None, :].astype(cache.dtype),
+                       cache[1])
+        # attend over positions <= pos
+        live = jnp.arange(S)[None, :] <= pos[:, None]       # [B, S]
+        s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                       kc.astype(jnp.float32)) / np.sqrt(D)
+        if m is not None:
+            mm = m.astype(jnp.float32).reshape(B, 1, -1)
+            s = s + jnp.pad(mm, ((0, 0), (0, 0), (0, S - mm.shape[-1])))
+        s = jnp.where(live[:, None, :], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhs,bhsd->bhd", p, vc.astype(jnp.float32))
+        out = o.reshape(B, H * D).astype(xv.dtype)
+        return out, jnp.stack([kc, vc])
+
+    return dispatch(f, tuple(args), name="masked_multihead_attention",
+                    multi_output=True)
+
+
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets, cum_offsets, cu_seqlens_q,
+        cu_seqlens_k, block_tables, pre_key_cache=None,
+        pre_value_cache=None, cache_k_quant_scales=None,
+        cache_v_quant_scales=None, cache_k_dequant_scales=None,
+        cache_v_dequant_scales=None, qkv_out_scale=None, qkv_bias=None,
+        out_shift=None, out_smooth=None, max_enc_len_this_time=None,
+        max_dec_len_this_time=None, rope_emb=None, mask=None,
+        tgt_mask=None, max_seq_len=-1, block_size=64,
+        use_neox_style=False, use_dynamic_cachekv_quant=False,
+        quant_round_type=1, quant_max_bound=127.0,
+        quant_min_bound=-127.0, out_scale=-1,
+        compute_dtype="default", rope_theta=10000.0):
+    """Paged-KV fused attention for serving (reference:
+    block_multihead_attention.py:33 / the CUDA kernel in
+    phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu).
+
+    Two phases, selected per call like the reference:
+    - PREFILL (``seq_lens_encoder`` > 0): causal self-attention over
+      each sequence's prompt tokens (varlen, ``cu_seqlens_q``) and the
+      K/V written into the paged caches through ``block_tables``.
+    - DECODE (``seq_lens_decoder`` > 0): one token per sequence,
+      appended to its pages, attention over all cached tokens — the
+      same math as ``ops.paged_attention`` (Pallas kernel on TPU).
+
+    Mixed prefill+decode batches and the quantized-cache / pre-cache /
+    smooth-quant arguments are gated (see ``_gate``). Cache layout
+    matches the reference: [max_block_num, num_head, block_size,
+    head_size]. Returns (out, qkv, key_cache, value_cache)."""
+    _gate(dict(pre_key_cache=pre_key_cache,
+               pre_value_cache=pre_value_cache,
+               cache_k_quant_scales=cache_k_quant_scales,
+               cache_v_quant_scales=cache_v_quant_scales,
+               cache_k_dequant_scales=cache_k_dequant_scales,
+               cache_v_dequant_scales=cache_v_dequant_scales,
+               qkv_out_scale=qkv_out_scale, out_shift=out_shift,
+               out_smooth=out_smooth, rope_emb=rope_emb))
+    qkv = _ensure(qkv)
+    key_cache, value_cache = _ensure(key_cache), _ensure(value_cache)
+    enc = np.asarray(_ensure(seq_lens_encoder)._value).reshape(-1)
+    dec = np.asarray(_ensure(seq_lens_decoder)._value).reshape(-1)
+    this = np.asarray(_ensure(seq_lens_this_time)._value).reshape(-1)
+    tables = _ensure(block_tables)
+    decode_mode = bool((enc == 0).all())
+    if not decode_mode and not (dec == 0).all():
+        raise NotImplementedError(
+            "mixed prefill+decode batches: split the batch (the "
+            "reference dispatches separate kernels per phase too)")
+    args = (qkv, key_cache, value_cache, tables)
+    if qkv_bias is not None:
+        args = args + (_ensure(qkv_bias),)
+    has_bias = qkv_bias is not None
+    extra_mask = tgt_mask if decode_mode else mask
+    if extra_mask is not None:
+        args = args + (_ensure(extra_mask),)
+    has_mask = extra_mask is not None
+    B = enc.shape[0]
+    dec_lens = jnp.asarray(dec, jnp.int32)
+    cu_q = np.asarray(_ensure(cu_seqlens_q)._value).reshape(-1)
+
+    def f(qkv_v, kc, vc, bt, *rest):
+        i = 0
+        b = rest[i] if has_bias else None
+        i += int(has_bias)
+        am = rest[i] if has_mask else None
+        NB, H, BS, D = kc.shape
+        if b is not None:
+            qkv_v = qkv_v + b.reshape(1, -1).astype(qkv_v.dtype)
+        if decode_mode:
+            # [B, 3, H, D] — one token per sequence
+            pk = qkv_v.reshape(B, 3, H, D)
+            q, kn, vn = pk[:, 0], pk[:, 1], pk[:, 2]
+            # append at dec_lens: pools in our [N, BS, H, D] layout
+            from ....ops.paged_attention import (paged_attention_decode,
+                                                 write_to_pool)
+            kp = jnp.swapaxes(kc, 1, 2)        # [NB, BS, H, D]
+            vp = jnp.swapaxes(vc, 1, 2)
+            kp, vp = write_to_pool(kp, vp, bt, dec_lens,
+                                   kn.astype(kp.dtype),
+                                   vn.astype(vp.dtype))
+            if am is None:
+                o = paged_attention_decode(q, kp, vp, bt, dec_lens + 1)
+            else:
+                # additive tgt_mask [B, 1, 1, S]: gather composition —
+                # an arbitrary bias cannot ride the paged kernel
+                MBb = bt.shape[1]
+                S = MBb * BS
+                kk = kp[bt].reshape(B, S, H, D).astype(jnp.float32)
+                vv = vp[bt].reshape(B, S, H, D).astype(jnp.float32)
+                s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
+                               kk) / np.sqrt(D)
+                amb = am.astype(jnp.float32).reshape(B, 1, -1)
+                amb = (jnp.pad(amb, ((0, 0), (0, 0),
+                                     (0, max(0, S - amb.shape[-1]))))
+                       [:, :, :S])
+                s = s + amb
+                live = jnp.arange(S)[None, :] <= dec_lens[:, None]
+                s = jnp.where(live[:, None, :], s, -jnp.inf)
+                p = jax.nn.softmax(s, axis=-1)
+                o = jnp.einsum("bhk,bkhd->bhd", p, vv)
+            out = o.reshape(B, H * D).astype(qkv_v.dtype)
+            return (out, qkv_v, jnp.swapaxes(kp, 1, 2).astype(kc.dtype),
+                    jnp.swapaxes(vp, 1, 2).astype(vc.dtype))
+        # prefill: varlen causal attention token-major [T, 3, H, D]
+        T = qkv_v.shape[0]
+        pk = qkv_v.reshape(T, 3, H, D)
+        q, k, v = pk[:, 0], pk[:, 1], pk[:, 2]
+        # segment ids from cu_seqlens (static host values)
+        seg = np.zeros((T,), np.int32)
+        for i in range(B):
+            seg[cu_q[i]:cu_q[i + 1]] = i
+        seg = jnp.asarray(seg)
+        qf = q.astype(jnp.float32)
+        kf = k.astype(jnp.float32)
+        s = jnp.einsum("thd,shd->hts", qf, kf) / np.sqrt(D)
+        same = (seg[:, None] == seg[None, :])
+        causal = (jnp.arange(T)[:, None] >= jnp.arange(T)[None, :])
+        pos_q = jnp.arange(T) - jnp.asarray(cu_q[:-1])[seg]
+        if am is not None:
+            # additive mask [B, 1, S, S] gathered onto flat token pairs
+            s = s + am.astype(jnp.float32)[seg[:, None], 0,
+                                           pos_q[:, None],
+                                           pos_q[None, :]][None]
+        s = jnp.where((same & causal)[None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("hts,shd->thd", p, v.astype(jnp.float32))
+        out = o.reshape(T, H * D).astype(qkv_v.dtype)
+        # write prompt K/V into the pages: token t of sequence i lands
+        # in page bt[i, pos // BS] at slot pos % BS
+        page = bt[seg, pos_q // BS]                         # [T]
+        slot = pos_q % BS
+        kc = kc.at[page, :, slot].set(k.astype(kc.dtype))
+        vc = vc.at[page, :, slot].set(v.astype(vc.dtype))
+        return out, qkv_v, kc, vc
+
+    return dispatch(f, args, name="block_multihead_attention",
+                    multi_output=True)

@@ -1,6 +1,7 @@
 """paddle_tpu.nn (reference: python/paddle/nn/__init__.py)."""
 from . import initializer  # noqa: F401
 from . import functional  # noqa: F401
+from . import quant  # noqa: F401
 
 from .layer.layers import (Layer, Sequential, LayerList, ParameterList,  # noqa
                            ParameterDict, LayerDict)

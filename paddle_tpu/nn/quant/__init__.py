@@ -1,0 +1,237 @@
+"""paddle.nn.quant — weight-only quantization for LLM serving.
+
+Reference: python/paddle/nn/quant/quantized_linear.py (weight_quantize:64,
+weight_dequantize:131, weight_only_linear:191, llm_int8_linear:285,
+apply_per_channel_scale:351) — CUTLASS int8/int4 GEMM epilogues behind
+_C_ops.
+
+TPU-native design: the MXU has no int4/int8×bf16 mixed GEMM, but
+weight-only quantization is a MEMORY optimization, not a compute one —
+serving decode is HBM-bound on weight streaming, so storing weights
+int8/int4 (2-4x less HBM traffic) and dequantizing into the matmul's
+bf16 operand (XLA fuses the `convert+mul` into the GEMM's operand read)
+captures the same win the CUDA kernels target. No ``arch`` gating: any
+TPU works; the argument is accepted and ignored for API compatibility.
+
+int4 packing: two signed nibbles per int8 byte along the input-dim axis
+(lo nibble = even k, hi nibble = odd k), weight stored transposed
+[n, k] like the reference (int4: [n, k/2]).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, dispatch
+
+__all__ = ["Stub", "weight_quantize", "weight_dequantize",
+           "weight_only_linear", "llm_int8_linear",
+           "apply_per_channel_scale"]
+
+
+from ..layer.layers import Layer as _Layer
+
+
+class Stub(_Layer):
+    """Quantization insertion-point placeholder (reference:
+    nn/quant/stub.py:29): marks where an observer/quanter should be
+    swapped in before PTQ/QAT when the quantized op is a functional
+    call inside a layer's forward. Identity until an observer is
+    attached by a quantization pass."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        if self._observer is not None and callable(self._observer):
+            return self._observer(x)
+        return x
+
+_ALGOS = ("weight_only_int8", "weight_only_int4", "llm.int8")
+
+
+def _check(algo, group_size):
+    if algo not in _ALGOS:
+        raise ValueError(f"algo must be one of {_ALGOS}, got {algo!r}")
+    if group_size not in (-1, 64, 128):
+        raise ValueError(
+            f"group_size must be -1, 64 or 128, got {group_size}")
+
+
+def _group_absmax(xt, group_size):
+    """xt [n, k] -> scale: per-channel [n] (group_size=-1) or grouped
+    [k // group_size, n] (reference layout)."""
+    if group_size == -1:
+        return jnp.max(jnp.abs(xt), axis=1)
+    n, k = xt.shape
+    g = xt.reshape(n, k // group_size, group_size)
+    return jnp.max(jnp.abs(g), axis=2).T          # [k/gs, n]
+
+
+def _expand_scale(scale, n, k, group_size, dtype):
+    """Scale broadcastable against the [n, k] transposed weight."""
+    if group_size == -1:
+        return scale.reshape(n, 1).astype(dtype)
+    return jnp.repeat(scale.T.astype(dtype), group_size,
+                      axis=1).reshape(n, k)
+
+
+def weight_quantize(x, algo: str = "weight_only_int8", arch=None,
+                    group_size: int = -1):
+    """[k, n] float weight -> (quantized [n, k] int8 (int4: [n, k/2]),
+    scale). Per-channel absmax (or per-group along k)."""
+    _check(algo, group_size)
+    x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    k = x.shape[0]
+    if algo == "weight_only_int4" and k % 2:
+        raise ValueError(
+            f"weight_only_int4 packs two values per byte along the "
+            f"input dim: k must be even, got {k}")
+    if group_size != -1 and k % group_size:
+        raise ValueError(
+            f"k={k} must be divisible by group_size={group_size}")
+
+    def f(v):
+        xt = v.astype(jnp.float32).T              # [n, k]
+        n, k = xt.shape
+        qmax = 7.0 if algo == "weight_only_int4" else 127.0
+        scale = _group_absmax(xt, group_size) / qmax
+        scale = jnp.maximum(scale, 1e-10)
+        full = _expand_scale(scale, n, k, group_size, jnp.float32)
+        q = jnp.clip(jnp.round(xt / full), -qmax, qmax).astype(jnp.int8)
+        if algo == "weight_only_int4":
+            lo = q[:, 0::2] & 0x0F
+            hi = (q[:, 1::2] & 0x0F) << 4
+            q = (lo | hi).astype(jnp.int8)        # [n, k/2]
+        return q, scale.astype(jnp.float32)
+
+    return dispatch(f, (x,), name="weight_quantize", multi_output=True)
+
+
+def _unpack_int4(q):
+    """[n, k/2] packed -> [n, k] signed int8 in [-8, 7]."""
+    lo = (q & 0x0F).astype(jnp.int8)
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = ((q >> 4) & 0x0F).astype(jnp.int8)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    return jnp.stack([lo, hi], axis=2).reshape(q.shape[0],
+                                               q.shape[1] * 2)
+
+
+def weight_dequantize(x, scale, algo: str = "weight_only_int8",
+                      out_dtype="float16", group_size: int = -1):
+    """Inverse of weight_quantize: back to the [k, n] float layout.
+    Parameter order matches the reference (quantized_linear.py:131):
+    (x, scale, algo, out_dtype, group_size) — positional callers
+    ported from Paddle must keep working."""
+    _check(algo, group_size)
+    x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    scale = scale if isinstance(scale, Tensor) else Tensor(
+        jnp.asarray(scale))
+    odt = jnp.dtype(out_dtype)
+
+    def f(q, s):
+        if algo == "weight_only_int4":
+            q = _unpack_int4(q)
+        n, k = q.shape
+        full = _expand_scale(s, n, k, group_size, jnp.float32)
+        return (q.astype(jnp.float32) * full).T.astype(odt)
+
+    return dispatch(f, (x, scale), name="weight_dequantize")
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype: str = "int8", arch=None,
+                       group_size: int = -1):
+    """x [..., k] @ dequant(weight [n, k]) + bias -> [..., n].
+
+    The dequant (convert + scale multiply) sits directly on the GEMM's
+    weight operand so XLA fuses it into the operand read — HBM sees the
+    int8/int4 bytes, the MXU sees bf16/f16 (the reference's fused
+    dequant GEMM epilogue, minus the custom kernel)."""
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(f"weight_dtype must be int8|int4: {weight_dtype}")
+    _check("weight_only_int4" if weight_dtype == "int4"
+           else "weight_only_int8", group_size)
+    args = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+            for t in (x, weight)
+            + ((weight_scale,) if weight_scale is not None else ())
+            + ((bias,) if bias is not None else ())]
+    has_scale = weight_scale is not None
+    has_bias = bias is not None
+
+    def f(v, q, *rest):
+        s = rest[0] if has_scale else None
+        b = rest[-1] if has_bias else None
+        if weight_dtype == "int4":
+            q = _unpack_int4(q)
+        n, k = q.shape
+        w = q.astype(v.dtype)
+        if s is not None:
+            w = w * _expand_scale(s, n, k, group_size, v.dtype)
+        out = jnp.einsum("...k,nk->...n", v, w)
+        if b is not None:
+            out = out + b.astype(out.dtype)
+        return out
+
+    return dispatch(f, tuple(args), name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold: float = 6.0):
+    """LLM.int8() decomposition (reference :285): activation channels
+    whose absmax exceeds ``threshold`` (the outliers) run in the
+    original float precision; the rest run through the int8 weight.
+    out = x_outlier @ W_dequant_outlier + x_regular @ W_dequant."""
+    args = [t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))
+            for t in (x, weight)
+            + ((weight_scale,) if weight_scale is not None else ())
+            + ((bias,) if bias is not None else ())]
+    has_scale = weight_scale is not None
+    has_bias = bias is not None
+
+    def f(v, q, *rest):
+        s = rest[0] if has_scale else None
+        b = rest[-1] if has_bias else None
+        n, k = q.shape
+        v32 = v.astype(jnp.float32)
+        ws = (s.reshape(n).astype(jnp.float32) if s is not None
+              else jnp.ones((n,), jnp.float32))
+        # outlier input features (per-feature absmax over all tokens)
+        amax = jnp.max(jnp.abs(v32), axis=tuple(range(v.ndim - 1)))
+        outlier = amax >= threshold                       # [k]
+        # float path: outlier features only, against dequant weight
+        v_out = jnp.where(outlier, v32, 0.0)
+        w32 = q.astype(jnp.float32) * ws[:, None]
+        out_f = jnp.einsum("...k,nk->...n", v_out, w32)
+        # int8 path: regular features, per-token absmax activation
+        # quantization, int8 x int8 GEMM with int32 accumulation on the
+        # MXU, one rescale (the LLM.int8() decomposition)
+        v_reg = jnp.where(outlier, 0.0, v32)
+        a_s = jnp.maximum(
+            jnp.max(jnp.abs(v_reg), axis=-1, keepdims=True) / 127.0,
+            1e-10)
+        vq = jnp.clip(jnp.round(v_reg / a_s), -127, 127).astype(jnp.int8)
+        # shared int8 GEMM helper: one rescale convention repo-wide
+        from ...quantization.quanters import int8_matmul
+        out_i = int8_matmul(vq, q.T, a_s, ws)
+        out = out_f + out_i
+        if b is not None:
+            out = out + b.astype(jnp.float32)
+        return out.astype(v.dtype)
+
+    return dispatch(f, tuple(args), name="llm_int8_linear")
+
+
+def apply_per_channel_scale(x, scales):
+    """x [..., k] * scales [k] (reference :351 — smooth-quant style
+    activation pre-scaling before a quantized matmul)."""
+    x = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    scales = scales if isinstance(scales, Tensor) else Tensor(
+        jnp.asarray(scales))
+    return dispatch(lambda v, s: v * s.astype(v.dtype), (x, scales),
+                    name="apply_per_channel_scale")

@@ -1,0 +1,230 @@
+"""Plan-based parallelize API + PS datasets + comm compat (reference:
+distributed/auto_parallel/intermediate/*, fleet/dataset, parallel.py)."""
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+
+
+@pytest.fixture
+def mesh2d():
+    m = dist.ProcessMesh(np.arange(8).reshape(4, 2),
+                         dim_names=["dp", "mp"])
+    dist.auto_parallel.api.set_mesh(m)
+    yield m
+    dist.auto_parallel.api.set_mesh(None)
+
+
+def _specs(t):
+    sh = t._value.sharding
+    return tuple(sh.spec) if hasattr(sh, "spec") else None
+
+
+class TestParallelizePlans:
+    def test_col_row_plans_shard_weights(self, mesh2d):
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 16))
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 16).astype(np.float32))
+        ref = net(x).numpy()
+        model, _ = dist.parallelize(
+            net, mesh=mesh2d,
+            config={"mp_config": {"parallelize_plan": {
+                "0": dist.ColWiseParallel(),
+                "2": dist.RowWiseParallel(),
+            }}})
+        # weight [in, out]: col plan shards OUT dim on mp, row plan IN
+        assert _specs(model[0].weight)[1] == "mp"
+        assert _specs(model[2].weight)[0] == "mp"
+        # forward math unchanged (GSPMD inserts the collectives)
+        np.testing.assert_allclose(model(x).numpy(), ref, atol=1e-5)
+
+    def test_sharding_level3_shards_params(self, mesh2d):
+        net = nn.Linear(8, 32)
+        model, _ = dist.parallelize(
+            net, mesh=mesh2d, config={"dp_config": {"sharding_level": 3}})
+        assert _specs(model.weight)[0] == "dp"
+
+    def test_prepare_layer_output_hook(self, mesh2d):
+        net = nn.Sequential(nn.Linear(4, 4))
+        seen = []
+
+        def hook(out):
+            seen.append(True)
+            return out
+
+        model, _ = dist.parallelize(
+            net, mesh=mesh2d,
+            config={"mp_config": {"parallelize_plan": {
+                "0": dist.PrepareLayerOutput(hook)}}})
+        model(paddle.to_tensor(np.zeros((2, 4), np.float32)))
+        assert seen
+
+    def test_sequence_parallel_enable_forward_parity(self, mesh2d):
+        net = nn.Linear(16, 16)
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(2, 8, 16).astype(np.float32))
+        ref = net(x).numpy()
+        model, _ = dist.parallelize(
+            net, mesh=mesh2d,
+            config={"mp_config": {"parallelize_plan": {
+                "": dist.SequenceParallelEnable()}}})
+        np.testing.assert_allclose(model(x).numpy(), ref, atol=1e-5)
+
+    def test_pp_config_points_at_pipeline_engine(self, mesh2d):
+        with pytest.raises(NotImplementedError, match="Compiled1F1B"):
+            dist.parallelize(nn.Linear(4, 4), mesh=mesh2d,
+                             config={"pp_config": {"split_spec": "x"}})
+
+    def test_to_distributed_auto_plans(self, mesh2d):
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 16))
+        opt = paddle.optimizer.AdamW(1e-3, parameters=net.parameters())
+        model, opt2, _ = dist.to_distributed(net, opt, None, 8)
+        sharded = [
+            _specs(p) for _n, p in model.named_parameters()
+            if len(p.shape) == 2 and _specs(p)
+            and any(s == "mp" for s in _specs(p))]
+        assert sharded, "no weight got an mp placement"
+
+    def test_local_layer_places_outputs(self, mesh2d):
+        class Doubler(dist.LocalLayer):
+            def forward(self, x):
+                return x * 2
+
+        lay = Doubler(out_dist_attrs=[
+            (mesh2d, [dist.Shard(0), dist.Replicate()])])
+        x = paddle.to_tensor(np.ones((8, 4), np.float32))
+        out = lay(x)
+        np.testing.assert_allclose(out.numpy(), 2.0)
+        assert _specs(out)[0] == "dp"
+
+    def test_misc_small_apis(self, mesh2d):
+        t = dist.dtensor_from_fn(
+            lambda: paddle.to_tensor(np.ones((8, 4), np.float32)),
+            mesh2d, [dist.Shard(0), dist.Replicate()])
+        assert _specs(t)[0] == "dp"
+        st = dist.Strategy({"sharding": {"stage": 2}})
+        assert st.sharding.stage == 2
+        assert dist.ShardingStage3().level == 3
+        assert dist.SplitPoint.END.name == "END"
+        assert dist.ReduceType.kRedSum is not None
+        attr = dist.DistAttr(mesh2d, ["x", None])
+        assert "x" in repr(attr)
+        from paddle_tpu.amp import GradScaler
+        sc = GradScaler(init_loss_scaling=8.0)
+        assert dist.shard_scaler(sc) is sc
+
+
+class TestCommCompat:
+    def test_backend_lifecycle(self):
+        assert dist.is_available()
+        assert dist.get_backend() in ("gloo", "xla")
+        dist.destroy_process_group()   # no-op without init
+
+    def test_scatter_object_list_single(self):
+        out = []
+        dist.scatter_object_list(out, [{"a": 1}], src=0)
+        assert out == [{"a": 1}]
+
+    def test_gloo_group_barrier_two_ranks(self):
+        import socket
+        import threading
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        ep = f"127.0.0.1:{port}"
+        errs = []
+
+        def rank1():
+            try:
+                import time
+                time.sleep(0.3)
+                from paddle_tpu.distributed import comm_compat as cc
+                # rank 1 uses its own module state? same process: use a
+                # raw store client + matching barrier key instead
+                from paddle_tpu.distributed.store import TCPStore
+                st = TCPStore("127.0.0.1", port)
+                st.set("gloo/rank/1", "up")
+                st.barrier("gloo/barrier/1", 2)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        th = threading.Thread(target=rank1)
+        th.start()
+        dist.gloo_init_parallel_env(0, 2, ep)
+        dist.gloo_barrier()
+        th.join(timeout=30)
+        dist.gloo_release()
+        assert not errs and not th.is_alive()
+
+
+class TestPSDatasets:
+    def _write_slot_file(self, path, n=10):
+        from paddle_tpu.distributed.fleet import MultiSlotDataGenerator
+
+        class Gen(MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    i = int(line)
+                    yield [("ids", [i, i + 1, i + 2]),
+                           ("label", [i % 2])]
+                return it
+
+        g = Gen()
+        import io
+        buf = io.StringIO()
+        g.set_batch(4)
+        g.run_from_stdin(stdin=[str(i) for i in range(n)], out=buf)
+        path.write_text(buf.getvalue())
+        return buf.getvalue()
+
+    def test_generator_format_and_inmemory_roundtrip(self, tmp_path):
+        f = tmp_path / "part-0"
+        text = self._write_slot_file(f)
+        assert text.splitlines()[0] == "3 0 1 2 1 0"
+        ds = dist.InMemoryDataset()
+        ds.init(batch_size=4, use_var=["ids", "label"])
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 10
+        ds.local_shuffle(seed=0)
+        batches = list(ds)
+        assert batches[0]["ids"].shape == (4, 3)
+        assert batches[0]["label"].dtype == np.int64
+        total = sum(b["label"].shape[0] for b in batches)
+        assert total == 10
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+    def test_queue_dataset_streams(self, tmp_path):
+        f = tmp_path / "part-0"
+        self._write_slot_file(f, n=6)
+        ds = dist.QueueDataset()
+        ds.init(batch_size=2, use_var=["ids", "label"])
+        ds.set_filelist([str(f)])
+        assert sum(1 for _ in ds) == 3
+        ds.set_show_click_entry(dist.ShowClickEntry("show", "click"))
+        with pytest.raises(ValueError):
+            dist.ShowClickEntry("", "click")
+
+
+def _spawn_worker(out_dir):
+    import os as _os
+    rank = _os.environ["PADDLE_TRAINER_ID"]
+    with open(f"{out_dir}/spawned_{rank}", "w") as f:
+        f.write(_os.environ["PADDLE_TRAINERS_NUM"])
+
+
+@pytest.mark.slow
+def test_spawn_runs_workers_with_env(tmp_path):
+    dist.spawn(_spawn_worker, args=(str(tmp_path),), nprocs=2)
+    for r in range(2):
+        assert (tmp_path / f"spawned_{r}").read_text() == "2"

@@ -278,3 +278,101 @@ def test_prune_model_and_decorated_optimizer_keeps_sparsity():
     for _, layer in net.named_sublayers():
         if isinstance(layer, nn.Linear):
             assert asp.check_mask_1d(layer.weight.numpy(), 2, 4)
+
+
+# -- nn.quant weight-only serving (reference: nn/quant/quantized_linear.py)
+class TestWeightOnlyQuant:
+    def test_int8_roundtrip_and_linear(self):
+        from paddle_tpu.nn.quant import (weight_dequantize,
+                                         weight_only_linear,
+                                         weight_quantize)
+        rng = np.random.RandomState(0)
+        w = paddle.to_tensor(rng.randn(64, 32).astype(np.float32))
+        q, s = weight_quantize(w, algo="weight_only_int8")
+        assert tuple(q.shape) == (32, 64)      # transposed, like the ref
+        assert tuple(s.shape) == (32,)
+        wd = weight_dequantize(q, s, out_dtype="float32")
+        rel = np.abs(wd.numpy() - w.numpy()).max() / np.abs(
+            w.numpy()).max()
+        assert rel < 0.01                      # 1/127 rounding class
+        x = paddle.to_tensor(rng.randn(4, 64).astype(np.float32))
+        b = paddle.to_tensor(rng.randn(32).astype(np.float32))
+        out = weight_only_linear(x, q, bias=b, weight_scale=s)
+        ref = x.numpy() @ w.numpy() + b.numpy()
+        rel = np.abs(out.numpy() - ref).max() / np.abs(ref).max()
+        assert rel < 0.02
+
+    def test_int4_pack_roundtrip_and_linear(self):
+        from paddle_tpu.nn.quant import (weight_dequantize,
+                                         weight_only_linear,
+                                         weight_quantize)
+        rng = np.random.RandomState(1)
+        w = paddle.to_tensor(rng.randn(64, 16).astype(np.float32))
+        q, s = weight_quantize(w, algo="weight_only_int4")
+        assert tuple(q.shape) == (16, 32)      # two nibbles per byte
+        wd = weight_dequantize(q, s, algo="weight_only_int4",
+                               out_dtype="float32")
+        rel = np.abs(wd.numpy() - w.numpy()).max() / np.abs(
+            w.numpy()).max()
+        assert rel < 0.16                      # 1/7 rounding class
+        x = paddle.to_tensor(rng.randn(2, 3, 64).astype(np.float32))
+        out = weight_only_linear(x, q, weight_scale=s,
+                                 weight_dtype="int4")
+        ref = x.numpy() @ w.numpy()
+        assert out.shape == [2, 3, 16]
+        rel = np.abs(out.numpy() - ref).max() / np.abs(ref).max()
+        assert rel < 0.2
+
+    def test_grouped_scales(self):
+        from paddle_tpu.nn.quant import (weight_dequantize,
+                                         weight_quantize)
+        rng = np.random.RandomState(2)
+        # per-group scales must beat per-channel when one group is huge
+        w_np = rng.randn(128, 8).astype(np.float32)
+        w_np[:64] *= 100.0
+        w = paddle.to_tensor(w_np)
+        q_pc, s_pc = weight_quantize(w)
+        q_g, s_g = weight_quantize(w, group_size=64)
+        assert tuple(s_g.shape) == (2, 8)
+        err_pc = np.abs(weight_dequantize(q_pc, s_pc,
+                                          out_dtype="float32").numpy()
+                        - w_np)[64:].max()
+        err_g = np.abs(weight_dequantize(q_g, s_g, group_size=64,
+                                         out_dtype="float32").numpy()
+                       - w_np)[64:].max()
+        assert err_g < err_pc * 0.1
+
+    def test_llm_int8_outlier_decomposition(self):
+        from paddle_tpu.nn.quant import llm_int8_linear, weight_quantize
+        rng = np.random.RandomState(3)
+        w = paddle.to_tensor(rng.randn(32, 16).astype(np.float32))
+        q, s = weight_quantize(w, algo="llm.int8")
+        x_np = rng.randn(8, 32).astype(np.float32)
+        x_np[:, 5] *= 50.0                     # one outlier feature
+        x = paddle.to_tensor(x_np)
+        out = llm_int8_linear(x, q, weight_scale=s, threshold=6.0)
+        ref = x_np @ np.asarray(w.numpy())
+        rel = np.abs(out.numpy() - ref).max() / np.abs(ref).max()
+        assert rel < 0.03                      # outliers exact-ish in fp
+        # naive full-int8 activation quant would be much worse here
+        from paddle_tpu.quantization import int8_matmul
+        a_s = np.abs(x_np).max() / 127.0
+        xq = np.clip(np.round(x_np / a_s), -127, 127).astype(np.int8)
+        naive = np.asarray(int8_matmul(
+            jnp.asarray(xq), jnp.asarray(np.asarray(q.numpy())).T, a_s,
+            jnp.asarray(np.asarray(s.numpy()))))
+        rel_naive = np.abs(naive - ref).max() / np.abs(ref).max()
+        assert rel < rel_naive
+
+    def test_apply_per_channel_scale_and_validation(self):
+        from paddle_tpu.nn.quant import (apply_per_channel_scale,
+                                         weight_quantize)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        s = paddle.to_tensor(np.asarray([1, 2, 3, 4], np.float32))
+        out = apply_per_channel_scale(x, s)
+        np.testing.assert_allclose(out.numpy(),
+                                   [[1, 2, 3, 4]] * 2)
+        with pytest.raises(ValueError):
+            weight_quantize(x, algo="nope")
+        with pytest.raises(ValueError):
+            weight_quantize(x, group_size=32)

@@ -28,7 +28,11 @@ EXCLUDED: dict = {}
 def _registry(path, pattern):
     src = open(path).read()
     m = re.search(pattern, src, re.S)
-    return sorted(set(re.findall(r"'([A-Za-z0-9_]+)'", m.group(1))))
+    # both quote styles: newer reference files (e.g. nn/quant) use
+    # double quotes — matching only single quotes silently yields an
+    # EMPTY registry, a vacuous "0 missing"
+    return sorted(set(re.findall(r"['\"]([A-Za-z0-9_]+)['\"]",
+                                 m.group(1))))
 
 
 def main():
@@ -62,6 +66,8 @@ def main():
             ("python/paddle/nn/__init__.py", "paddle_tpu.nn"),
             ("python/paddle/nn/functional/__init__.py",
              "paddle_tpu.nn.functional"),
+            ("python/paddle/nn/quant/__init__.py",
+             "paddle_tpu.nn.quant"),
             ("python/paddle/linalg.py", "paddle_tpu.linalg"),
             ("python/paddle/fft.py", "paddle_tpu.fft"),
             ("python/paddle/signal.py", "paddle_tpu.signal"),
