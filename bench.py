@@ -392,12 +392,15 @@ def bench_paged_decode():
                            jnp.int32)
         g = GenerationConfig(max_new_tokens=gen_n, greedy=True)
         point = {}
-        for label, flag in (("pallas", True), ("xla_gather", False)):
+        for label, flag, cdt in (("pallas", True, None),
+                                 ("xla_gather", False, None),
+                                 ("int8_cache", False, "int8")):
             prev = GLOBAL_FLAGS.get("use_paged_kernel")
             GLOBAL_FLAGS.set("use_paged_kernel", flag)
             try:
                 ms = _timed_host_synced(
-                    lambda: generate_paged(params, toks, cfg, g),
+                    lambda: generate_paged(params, toks, cfg, g,
+                                           cache_dtype=cdt),
                     steps=3)
                 point[label] = round(batch * gen_n / (ms / 1e3), 1)
             except Exception as e:  # noqa: BLE001

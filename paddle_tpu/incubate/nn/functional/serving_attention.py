@@ -162,23 +162,44 @@ def block_multihead_attention(
     head_size]. Returns (out, qkv, key_cache, value_cache)."""
     _gate(dict(pre_key_cache=pre_key_cache,
                pre_value_cache=pre_value_cache,
-               cache_k_quant_scales=cache_k_quant_scales,
-               cache_v_quant_scales=cache_v_quant_scales,
-               cache_k_dequant_scales=cache_k_dequant_scales,
-               cache_v_dequant_scales=cache_v_dequant_scales,
                qkv_out_scale=qkv_out_scale, out_shift=out_shift,
                out_smooth=out_smooth, rope_emb=rope_emb))
+    if use_dynamic_cachekv_quant and (
+            cache_k_quant_scales is not None
+            or cache_k_dequant_scales is not None):
+        raise NotImplementedError(
+            "dynamic cache-kv quantization: the TPU path supports the "
+            "STATIC per-head scale mode (use_dynamic_cachekv_quant="
+            "False)")
     qkv = _ensure(qkv)
     key_cache, value_cache = _ensure(key_cache), _ensure(value_cache)
     enc = np.asarray(_ensure(seq_lens_encoder)._value).reshape(-1)
     dec = np.asarray(_ensure(seq_lens_decoder)._value).reshape(-1)
-    this = np.asarray(_ensure(seq_lens_this_time)._value).reshape(-1)
     tables = _ensure(block_tables)
     decode_mode = bool((enc == 0).all())
     if not decode_mode and not (dec == 0).all():
         raise NotImplementedError(
             "mixed prefill+decode batches: split the batch (the "
             "reference dispatches separate kernels per phase too)")
+    kd = cache_k_dequant_scales
+    vd = cache_v_dequant_scales
+    has_quant = kd is not None or vd is not None
+    if has_quant and (kd is None or vd is None):
+        raise ValueError("pass BOTH cache_k/v_dequant_scales")
+    if (cache_k_quant_scales is not None
+            or cache_v_quant_scales is not None) and not has_quant:
+        # quant-side scales without dequant-side would silently run the
+        # raw bf16 write path against int8 caches — garbage, not an A/B
+        raise ValueError(
+            "static int8 cache mode reads cache_k/v_DEQUANT_scales "
+            "(the write side derives from the same per-head scales); "
+            "pass them too")
+    if has_quant and not decode_mode:
+        raise NotImplementedError(
+            "int8 cache in the prefill phase: quantize the pools after "
+            "prefill (inference.generate_paged(cache_dtype='int8') "
+            "shows the calibration point); the static-scale decode "
+            "phase is supported here")
     args = (qkv, key_cache, value_cache, tables)
     if qkv_bias is not None:
         args = args + (_ensure(qkv_bias),)
@@ -187,6 +208,8 @@ def block_multihead_attention(
     if extra_mask is not None:
         args = args + (_ensure(extra_mask),)
     has_mask = extra_mask is not None
+    if has_quant:
+        args = args + (_ensure(kd), _ensure(vd))
     B = enc.shape[0]
     dec_lens = jnp.asarray(dec, jnp.int32)
     cu_q = np.asarray(_ensure(cu_seqlens_q)._value).reshape(-1)
@@ -196,6 +219,9 @@ def block_multihead_attention(
         b = rest[i] if has_bias else None
         i += int(has_bias)
         am = rest[i] if has_mask else None
+        i += int(has_mask)
+        ksc = rest[i].reshape(-1) if has_quant else None
+        vsc = rest[i + 1].reshape(-1) if has_quant else None
         NB, H, BS, D = kc.shape
         if b is not None:
             qkv_v = qkv_v + b.reshape(1, -1).astype(qkv_v.dtype)
@@ -204,14 +230,22 @@ def block_multihead_attention(
             pk = qkv_v.reshape(B, 3, H, D)
             q, kn, vn = pk[:, 0], pk[:, 1], pk[:, 2]
             # append at dec_lens: pools in our [N, BS, H, D] layout
-            from ....ops.paged_attention import (paged_attention_decode,
-                                                 write_to_pool)
+            from ....ops.paged_attention import (
+                paged_attention_decode, paged_attention_decode_quant,
+                write_to_pool, write_to_pool_quant)
             kp = jnp.swapaxes(kc, 1, 2)        # [NB, BS, H, D]
             vp = jnp.swapaxes(vc, 1, 2)
-            kp, vp = write_to_pool(kp, vp, bt, dec_lens,
-                                   kn.astype(kp.dtype),
-                                   vn.astype(vp.dtype))
-            if am is None:
+            if ksc is not None:
+                kp, vp = write_to_pool_quant(kp, vp, bt, dec_lens,
+                                             kn, vn, ksc, vsc)
+            else:
+                kp, vp = write_to_pool(kp, vp, bt, dec_lens,
+                                       kn.astype(kp.dtype),
+                                       vn.astype(vp.dtype))
+            if am is None and ksc is not None:
+                o = paged_attention_decode_quant(
+                    q, kp, vp, bt, dec_lens + 1, ksc, vsc)
+            elif am is None:
                 o = paged_attention_decode(q, kp, vp, bt, dec_lens + 1)
             else:
                 # additive tgt_mask [B, 1, 1, S]: gather composition —
@@ -220,6 +254,9 @@ def block_multihead_attention(
                 S = MBb * BS
                 kk = kp[bt].reshape(B, S, H, D).astype(jnp.float32)
                 vv = vp[bt].reshape(B, S, H, D).astype(jnp.float32)
+                if ksc is not None:   # int8 pools: per-head dequant
+                    kk = kk * ksc[None, None, :, None]
+                    vv = vv * vsc[None, None, :, None]
                 s = jnp.einsum("bhd,bkhd->bhk", q.astype(jnp.float32),
                                kk) / np.sqrt(D)
                 amb = am.astype(jnp.float32).reshape(B, 1, -1)

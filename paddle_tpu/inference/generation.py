@@ -234,7 +234,7 @@ def generate(params: Dict, input_ids, cfg: _llama.LlamaConfig,
 # ---------------------------------------------------------------------------
 # Paged-KV serving path
 # ---------------------------------------------------------------------------
-def _paged_chunk_runner(cfg, gen):
+def _paged_chunk_runner(cfg, gen, quant=False):
     """Jitted n-step decode scan, cached per (cfg values, gen values) —
     a fresh jit per generate_paged call would re-trace the whole L-layer
     scan every serving request."""
@@ -243,18 +243,19 @@ def _paged_chunk_runner(cfg, gen):
     # key the cache — an A/B flip (bench_paged_decode) would otherwise
     # silently reuse the first-compiled path
     ck = (dataclasses.astuple(cfg), dataclasses.astuple(gen),
-          bool(GLOBAL_FLAGS.get("use_paged_kernel")))
+          bool(GLOBAL_FLAGS.get("use_paged_kernel")), bool(quant))
     cached = _cache_get(_PAGED_CACHE, ck)
     if cached is not None:
         return cached
 
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(5, 6))
     def chunk_fn(n, params, tok, key, done, k_pools, v_pools, seq_lens,
-                 block_tables):
+                 block_tables, kv_scales=None):
         def body(carry, _):
             tok, key, done, seq_lens, kp, vp = carry
             logits, kp, vp = _paged_decode_step(
-                params, tok, cfg, kp, vp, block_tables, seq_lens)
+                params, tok, cfg, kp, vp, block_tables, seq_lens,
+                kv_scales=kv_scales)
             key, sub = jax.random.split(key)
             nxt = sample_token(logits, sub, gen)
             nxt = jnp.where(done, gen.eos_token_id, nxt)
@@ -274,17 +275,22 @@ def _paged_chunk_runner(cfg, gen):
 
 
 def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
-                       seq_lens):
+                       seq_lens, kv_scales=None):
     """One decode token per sequence over paged pools.
 
     tok: [B] int32 current tokens; k_pools/v_pools: [L, N, BS, KV, hd];
     block_tables: [B, MB]; seq_lens: [B] lengths INCLUDING the current
     token's position (i.e. the new token is written at seq_lens, and
     attention runs over seq_lens+1 tokens).
+    ``kv_scales``: (k_scale [L, KV], v_scale [L, KV]) when the pools are
+    int8 (static per-head cache quantization — reference block_attn.h
+    int8 cache mode): halves KV HBM, the attention math stays fp32.
     Returns (logits [B, V], k_pools, v_pools).
     """
     from ..ops import rms_norm as fused_rms_norm, swiglu as fused_swiglu
-    from ..ops.paged_attention import paged_attention_decode, write_to_pool
+    from ..ops.paged_attention import (paged_attention_decode,
+                                      paged_attention_decode_quant,
+                                      write_to_pool, write_to_pool_quant)
 
     H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                  cfg.head_dim)
@@ -296,7 +302,10 @@ def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
                                 cfg.head_dim, base=cfg.rope_theta)
 
     def layer(x, xs):
-        lp, kp, vp = xs
+        if kv_scales is None:
+            lp, kp, vp = xs
+        else:
+            lp, kp, vp, ksc, vsc = xs
         h = fused_rms_norm(x[:, None], lp["input_norm"].astype(x.dtype),
                            cfg.rms_norm_eps)[:, 0]
         q = (h @ lp["q_proj"]).reshape(B, 1, H, hd)
@@ -304,11 +313,17 @@ def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
         v = (h @ lp["v_proj"]).reshape(B, 1, KV, hd)
         q = apply_rope(q, sin, cos, position_ids=pos_ids)
         k = apply_rope(k, sin, cos, position_ids=pos_ids)
-        kp, vp = write_to_pool(kp, vp, block_tables, seq_lens,
-                               k[:, 0].astype(kp.dtype),
-                               v[:, 0].astype(vp.dtype))
-        attn = paged_attention_decode(q[:, 0], kp, vp, block_tables,
-                                      seq_lens + 1)
+        if kv_scales is None:
+            kp, vp = write_to_pool(kp, vp, block_tables, seq_lens,
+                                   k[:, 0].astype(kp.dtype),
+                                   v[:, 0].astype(vp.dtype))
+            attn = paged_attention_decode(q[:, 0], kp, vp, block_tables,
+                                          seq_lens + 1)
+        else:
+            kp, vp = write_to_pool_quant(kp, vp, block_tables, seq_lens,
+                                         k[:, 0], v[:, 0], ksc, vsc)
+            attn = paged_attention_decode_quant(
+                q[:, 0], kp, vp, block_tables, seq_lens + 1, ksc, vsc)
         x = x + attn.reshape(B, H * hd).astype(x.dtype) @ lp["o_proj"]
         h = fused_rms_norm(x[:, None], lp["post_norm"].astype(x.dtype),
                            cfg.rms_norm_eps)[:, 0]
@@ -316,8 +331,9 @@ def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
         x = x + ff @ lp["down_proj"]
         return x, (kp, vp)
 
-    x, (k_pools, v_pools) = jax.lax.scan(
-        layer, x, (params["layers"], k_pools, v_pools))
+    scan_xs = (params["layers"], k_pools, v_pools) if kv_scales is None \
+        else (params["layers"], k_pools, v_pools) + tuple(kv_scales)
+    x, (k_pools, v_pools) = jax.lax.scan(layer, x, scan_xs)
     x = fused_rms_norm(x[:, None], params["final_norm"].astype(x.dtype),
                        cfg.rms_norm_eps)[:, 0]
     head = params.get("lm_head")
@@ -328,8 +344,14 @@ def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
 
 def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
                    gen: Optional[GenerationConfig] = None,
-                   block_size: int = 16, seed: int = 0):
+                   block_size: int = 16, seed: int = 0,
+                   cache_dtype=None):
     """vLLM-style serving loop over a paged KV cache.
+
+    ``cache_dtype="int8"``: static per-head cache quantization
+    (reference block_attn.h int8 cache mode) — KV pools take half the
+    HBM, so the same footprint serves 2x the batch; scales calibrate
+    from the prefill KV.
 
     Prefill runs through the dense-cache path, the dense cache is repacked
     into block pools, then each decode step is one jitted program using
@@ -382,6 +404,20 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     v_pools = v_pools.at[:, flat_tables].set(
         vc.reshape(L, B * MB, BS, KV, hd))
 
+    kv_scales = None
+    if cache_dtype in ("int8", jnp.int8):
+        # static per-layer-per-head scales from the prefill KV (the
+        # reference's static cachekv-quant calibration point); pools
+        # shrink 2x and decode dequants per head in the gather consumer
+        from ..ops.paged_attention import quantize_pools
+        k_pools, v_pools, k_sc, v_sc = jax.vmap(quantize_pools)(
+            k_pools, v_pools)
+        kv_scales = (k_sc, v_sc)
+    elif cache_dtype not in (None, "bfloat16", "float32",
+                             jnp.bfloat16, jnp.float32):
+        raise ValueError(f"cache_dtype must be bfloat16|float32|int8, "
+                         f"got {cache_dtype!r}")
+
     # Chunked decode: pages for the whole generation are allocated
     # upfront (static tables), so no host bookkeeping is needed between
     # steps — run chunk_size decode steps as ONE jitted lax.scan
@@ -392,7 +428,7 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     # point the reference's AnalysisPredictor has). The jitted chunk
     # runner is cached per (config values, sampling knobs) like
     # generate()'s — shapes and the static n key jit's own cache.
-    chunk_fn = _paged_chunk_runner(cfg, gen)
+    chunk_fn = _paged_chunk_runner(cfg, gen, quant=kv_scales is not None)
 
     key = _key_for(seed)
     tok = sample_token(logits[:, -1], key, gen)
@@ -405,7 +441,8 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     while left > 0:
         n = min(chunk, left)
         toks, tok, key, done, seq_lens, k_pools, v_pools = chunk_fn(
-            n, params, tok, key, done, k_pools, v_pools, seq_lens, bt)
+            n, params, tok, key, done, k_pools, v_pools, seq_lens, bt,
+            kv_scales)
         chunks.append(toks.transpose(1, 0))  # [n, B] -> [B, n]
         left -= n
     toks = jnp.concatenate(chunks, axis=1)

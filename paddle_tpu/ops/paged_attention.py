@@ -75,8 +75,12 @@ def _warn_fallback(name, e):
 
 
 def paged_attention_decode_xla(q, k_pool, v_pool, block_tables, seq_lens,
-                               scale: Optional[float] = None):
-    """Gather+einsum reference path (always XLA, any backend)."""
+                               scale: Optional[float] = None,
+                               k_scale=None, v_scale=None):
+    """Gather+einsum reference path (always XLA, any backend).
+    ``k_scale``/``v_scale`` [KV]: per-head dequant for int8 pools —
+    applied right after the gather so the rest of the math is shared
+    with the bf16 path."""
     B, H, hd = q.shape
     N, BS, KV, _ = k_pool.shape
     MB = block_tables.shape[1]
@@ -85,6 +89,10 @@ def paged_attention_decode_xla(q, k_pool, v_pool, block_tables, seq_lens,
     # gather each sequence's blocks: [B, MB, BS, KV, hd] → [B, T, KV, hd]
     k = jnp.take(k_pool, block_tables, axis=0).reshape(B, MB * BS, KV, hd)
     v = jnp.take(v_pool, block_tables, axis=0).reshape(B, MB * BS, KV, hd)
+    if k_scale is not None:
+        k = k.astype(jnp.float32) * k_scale[None, None, :, None]
+    if v_scale is not None:
+        v = v.astype(jnp.float32) * v_scale[None, None, :, None]
     rep = H // KV
     if rep > 1:
         k = jnp.repeat(k, rep, axis=2)
@@ -119,6 +127,52 @@ def write_to_pool(k_pool, v_pool, block_tables, seq_lens, k_new, v_new):
     k_pool = k_pool.at[phys, offset].set(k_new)
     v_pool = v_pool.at[phys, offset].set(v_new)
     return k_pool, v_pool
+
+
+# -- int8 cache quantization (static per-head scales) -----------------------
+# Reference capability: block_multihead_attention's cache_k/v quant —
+# paddle/phi/kernels/fusion/gpu/block_attn.h int8 cache load path with
+# static [num_head] dequant scales. On TPU this is purely a memory
+# optimization: int8 pools halve KV HBM (2x batch at the same footprint);
+# the attention math runs bf16/fp32 after a per-head dequant multiply that
+# XLA fuses into the gather consumer.
+
+def quantize_pools(k_pool, v_pool):
+    """bf16/f32 pools [N, BS, KV, hd] -> (int8 pools, k_scale [KV],
+    v_scale [KV]) with symmetric per-head absmax scales (unwritten
+    slots are zero-initialized, so whole-pool absmax is safe)."""
+    def one(p):
+        amax = jnp.max(jnp.abs(p.astype(jnp.float32)), axis=(0, 1, 3))
+        scale = jnp.maximum(amax / 127.0, 1e-8)              # [KV]
+        q = jnp.clip(jnp.round(p.astype(jnp.float32)
+                               / scale[None, None, :, None]),
+                     -127, 127).astype(jnp.int8)
+        return q, scale
+    kq, ks = one(k_pool)
+    vq, vs = one(v_pool)
+    return kq, vq, ks, vs
+
+
+def write_to_pool_quant(k_pool, v_pool, block_tables, seq_lens,
+                        k_new, v_new, k_scale, v_scale):
+    """``write_to_pool`` for int8 pools: the new token's K/V quantize
+    with the static per-head scales on the way in."""
+    def q(x, s):
+        return jnp.clip(jnp.round(x.astype(jnp.float32)
+                                  / s[None, :, None]),
+                        -127, 127).astype(jnp.int8)
+    return write_to_pool(k_pool, v_pool, block_tables, seq_lens,
+                         q(k_new, k_scale), q(v_new, v_scale))
+
+
+def paged_attention_decode_quant(q, k_pool, v_pool, block_tables,
+                                 seq_lens, k_scale, v_scale,
+                                 scale: Optional[float] = None):
+    """Decode attention over int8 pools: gather int8 (the HBM win),
+    dequant per head, then the SAME attention math as the bf16 path."""
+    return paged_attention_decode_xla(q, k_pool, v_pool, block_tables,
+                                      seq_lens, scale=scale,
+                                      k_scale=k_scale, v_scale=v_scale)
 
 
 class BlockManager:
