@@ -53,7 +53,14 @@ _PROBE_RESULT = {}
 
 def _require_chip():
     if "ok" not in _PROBE_RESULT:   # one probe per test run, not per test
-        rc, out, err = _run_on_chip(PROBE, timeout=120)
+        try:
+            rc, out, err = _run_on_chip(PROBE, timeout=120)
+        except BaseException:
+            # _run_on_chip skips on tunnel wedge — record it first or
+            # every subsequent test re-pays the full probe timeout
+            _PROBE_RESULT["ok"] = False
+            _PROBE_RESULT["rc"] = "wedge"
+            raise
         _PROBE_RESULT["ok"] = rc == 0 and "PROBE_OK" in out
         _PROBE_RESULT["rc"] = rc
     if not _PROBE_RESULT["ok"]:
@@ -125,3 +132,77 @@ assert per_step < bound, \
 print("DEVBOUND_OK")
 """)
     assert rc == 0 and "DEVBOUND_OK" in out, (out, err[-2000:])
+
+
+def test_int8_paged_decode_on_chip():
+    """int8 static-KV serving path compiles + runs on the real chip:
+    logits track the bf16 cache within quant tolerance."""
+    _require_chip()
+    rc, out, err = _run_on_chip("""
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.inference import generation as G
+from paddle_tpu.models.llama import LlamaConfig, init_params
+from paddle_tpu.ops.paged_attention import quantize_pools
+cfg = LlamaConfig(vocab_size=512, hidden_size=256, intermediate_size=512,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=4, max_position_embeddings=128)
+params = init_params(cfg, jax.random.PRNGKey(0))
+B, S, BS, MB = 2, 32, 16, 8
+kc, vc = G.init_cache(cfg, B, MB * BS)
+toks = jnp.asarray(np.random.RandomState(0).randint(0, 512, (B, S)),
+                   jnp.int32)
+logits, kc, vc = G.cached_forward(params, toks, cfg, kc, vc, 0)
+L, KV, hd = 2, 4, cfg.head_dim
+NB = B * MB
+kp = jnp.reshape(kc, (L, NB, BS, KV, hd))
+vp = jnp.reshape(vc, (L, NB, BS, KV, hd))
+tables = jnp.asarray(np.arange(NB).reshape(B, MB), jnp.int32)
+lens = jnp.full((B,), S, jnp.int32)
+tok = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+lg_bf, _, _ = G._paged_decode_step(params, tok, cfg, kp, vp, tables, lens)
+kq, vq, ks, vs = jax.vmap(quantize_pools)(kp, vp)
+lg_i8, _, _ = G._paged_decode_step(params, tok, cfg, kq, vq, tables,
+                                   lens, kv_scales=(ks, vs))
+rel = float(jnp.max(jnp.abs(lg_i8.astype(jnp.float32)
+                            - lg_bf.astype(jnp.float32)))
+            / (jnp.max(jnp.abs(lg_bf.astype(jnp.float32))) + 1e-9))
+a = np.asarray(jnp.ravel(lg_i8)[0])   # tunnel-safe sync
+assert rel < 0.1, rel
+print("INT8_PAGED_OK", rel)
+""")
+    assert rc == 0 and "INT8_PAGED_OK" in out, (out, err[-2000:])
+
+
+def test_fused_mixed_dtype_trainer_on_chip():
+    """The mixed bf16+fp32 llama tree must take the FUSED AdamW path on
+    the real chip (the round-5 fix; the old single-dtype check silently
+    fell back to the slow per-leaf update)."""
+    _require_chip()
+    rc, out, err = _run_on_chip("""
+import numpy as np, jax, jax.numpy as jnp
+from paddle_tpu.models.llama import (LlamaConfig, init_params, loss_fn,
+                                     param_shardings)
+from paddle_tpu.distributed.trainer import MeshConfig, Trainer, make_mesh
+cfg = LlamaConfig(vocab_size=4096, hidden_size=512, intermediate_size=1024,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  num_key_value_heads=4, max_position_embeddings=256)
+params = init_params(cfg, jax.random.PRNGKey(0))
+dts = sorted({str(v.dtype) for v in jax.tree_util.tree_leaves(params)})
+assert "float32" in dts and len(dts) == 2, dts   # genuinely mixed
+mesh = make_mesh(MeshConfig())
+tr = Trainer(lambda p, t, l: loss_fn(p, t, l, cfg), mesh,
+             param_shardings(mesh, cfg), lr=1e-4,
+             moment_dtype=jnp.bfloat16)
+st = tr.init_state(params)
+assert tr._fused, "mixed-dtype tree must take the fused path on chip"
+toks = jnp.asarray(np.random.RandomState(0).randint(0, 4096, (2, 256)),
+                   jnp.int32)
+st, m = tr.step(st, toks, jnp.roll(toks, -1, -1))
+l0 = float(np.asarray(jnp.ravel(m["loss"])[0]))
+for _ in range(4):
+    st, m = tr.step(st, toks, jnp.roll(toks, -1, -1))
+l1 = float(np.asarray(jnp.ravel(m["loss"])[0]))
+assert np.isfinite(l1) and l1 < l0, (l0, l1)
+print("FUSED_MIXED_OK", l0, "->", l1)
+""")
+    assert rc == 0 and "FUSED_MIXED_OK" in out, (out, err[-2000:])
