@@ -1,0 +1,39 @@
+"""Shared example setup: pick the real TPU when present, otherwise an
+8-device virtual CPU mesh (same trick as tests/conftest.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))       # repo root on sys.path
+
+
+def _tpu_present(timeout=20):
+    """Probe for a live TPU backend in a SUBPROCESS: on a wedged
+    tunnel, client init can hang forever in-process."""
+    import subprocess
+    import sys
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices()[0]; "
+             "raise SystemExit(0 if d.platform in ('tpu', 'axon') "
+             "else 1)"],
+            timeout=timeout, capture_output=True)
+        return p.returncode == 0
+    except Exception:  # noqa: BLE001 — wedge/timeout = no TPU
+        return False
+
+
+def setup(n_virtual=8):
+    force_cpu = os.environ.get("EXAMPLES_FORCE_CPU")
+    use_cpu = (force_cpu != "0") if force_cpu is not None \
+        else not _tpu_present()
+    if use_cpu:
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   f" --xla_force_host_platform_device_"
+                                   f"count={n_virtual}").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+    print(f"devices: {jax.device_count()} x {jax.devices()[0].platform}")
+    return jax

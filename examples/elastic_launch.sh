@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Fault-tolerant multi-node launch (reference: fleet elastic mode).
+#
+# Each node runs one controller; the TCPStore on node 0 is the
+# rendezvous. With PADDLE_ELASTIC_MIN/MAX set, a node loss re-ranks
+# the survivors and respawns the world at the smaller size — trainers
+# resume from the latest COMPLETE per-step distributed checkpoint
+# (see tests/elastic_worker.py for the training-side pattern, and
+# tests/test_launch.py::test_elastic_end_to_end for the full flow
+# exercised in CI with a hard-killed trainer).
+#
+# Node i of N (same command on every node, MASTER on node 0's address):
+#
+#   PADDLE_ELASTIC_MIN=2 PADDLE_ELASTIC_MAX=4 \
+#   python -m paddle_tpu.distributed.launch \
+#       --nnodes 4 --node_rank $i --nproc_per_node 1 \
+#       --master 10.0.0.1:6170 --elastic_retries 2 \
+#       --log_dir ./logs train_script.py
+#
+# Demo below: 2 local "nodes" on one machine.
+set -e
+PORT=${PORT:-6170}
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+cat > /tmp/_elastic_demo_worker.py <<'PY'
+import os
+print(f"rank {os.environ['PADDLE_TRAINER_ID']}"
+      f"/{os.environ['PADDLE_TRAINERS_NUM']} up "
+      f"(job {os.environ.get('PADDLE_JOB_ID')})")
+PY
+pids=()
+for i in 0 1; do
+  PADDLE_ELASTIC_MIN=1 PADDLE_ELASTIC_MAX=2 JAX_PLATFORMS=cpu \
+  python -m paddle_tpu.distributed.launch \
+      --nnodes 2 --node_rank $i --nproc_per_node 1 \
+      --master 127.0.0.1:$PORT --elastic_retries 1 \
+      --log_dir /tmp/elastic_demo_logs_$i /tmp/_elastic_demo_worker.py &
+  pids+=($!)
+done
+for pid in "${pids[@]}"; do
+  wait "$pid"   # a failing node must fail the demo, not print success
+done
+echo "both nodes finished; see /tmp/elastic_demo_logs_*/workerlog.*"

@@ -1,0 +1,52 @@
+"""Hybrid-parallel LLaMA training over a named mesh.
+
+The reference wires Fleet process groups + NCCL by hand; here the
+SAME hybrid topology is a `jax.sharding.Mesh` with named axes and the
+Trainer's GSPMD shardings — XLA inserts the collectives. Includes the
+round-5 perf stack: fused flat-state AdamW (mixed bf16/fp32 tree),
+bf16 optimizer moments, gradient accumulation, device-prefetched
+ingest."""
+import numpy as np
+
+from _common import setup
+
+jax = setup(n_virtual=8)
+
+import jax.numpy as jnp                                   # noqa: E402
+from paddle_tpu.distributed.trainer import (MeshConfig,   # noqa: E402
+                                            Trainer, make_mesh)
+from paddle_tpu.models.llama import (LlamaConfig,         # noqa: E402
+                                     init_params, loss_fn,
+                                     param_shardings)
+
+
+def main():
+    cfg = LlamaConfig(vocab_size=1024, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=4,
+                      max_position_embeddings=128)
+    mesh = make_mesh(MeshConfig(fsdp=2, sp=2, tp=2))   # 8 devices
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tr = Trainer(lambda p, t, l: loss_fn(p, t, l, cfg), mesh,
+                 param_shardings(mesh, cfg), lr=3e-4,
+                 accumulate_steps=1, moment_dtype=jnp.bfloat16)
+    state = tr.init_state(params)
+
+    rng = np.random.RandomState(0)
+
+    def batches():
+        while True:
+            toks = rng.randint(0, 1024, (4, 128)).astype(np.int32)
+            yield toks, np.roll(toks, -1, -1)
+
+    it = iter(batches())
+    # device prefetch: batch N+1's h2d overlaps step N's compute
+    pf = tr.prefetch((next(it) for _ in range(8)))
+    for i, (toks, labels) in enumerate(pf):
+        state, m = tr.step(state, toks, labels)
+        print(f"step {i}: loss {float(m['loss']):.4f} "
+              f"gnorm {float(m['grad_norm']):.3f}")
+
+
+if __name__ == "__main__":
+    main()
