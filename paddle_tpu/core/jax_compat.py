@@ -1,0 +1,34 @@
+"""Version shims for renamed jax APIs — the single home.
+
+ops/ and distributed/ both need these; keeping one copy means the next
+jax rename is patched in one place instead of silently diverging the
+ring/ulysses paths from the pipeline/collective paths.
+"""
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax import shard_map
+except ImportError:  # older jax keeps it under experimental
+    from jax.experimental.shard_map import shard_map
+
+__all__ = ["shard_map", "shard_map_norep", "axis_size"]
+
+
+def shard_map_norep(fn, mesh, in_specs, out_specs):
+    """shard_map without the replication check, across the jax rename
+    (check_rep -> check_vma)."""
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    try:
+        return shard_map(fn, check_rep=False, **kwargs)
+    except TypeError:  # jax >= 0.8 renamed the replication check
+        return shard_map(fn, check_vma=False, **kwargs)
+
+
+def axis_size(axis_name):
+    """Static mesh-axis size inside shard_map/collective tracing."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:  # jax < 0.6: psum of a literal 1 folds to
+        return jax.lax.psum(1, axis_name)   # the static axis size

@@ -309,14 +309,38 @@ class Strategy:
         self.pp_config = config.get("pp_config", {})
 
 
+_PLAIN_PLAN_KEY = re.compile(r"^[\w.]*$")
+
+
 def _match_plans(model, plan_map: Dict[str, PlanBase]):
     """(layer, plan) pairs for every named sublayer matching a key
-    (exact name, prefix, or regex — reference matches the same way)."""
+    (exact name, prefix, or regex — reference matches the same way).
+
+    Exact matching takes precedence per layer: a layer named by an
+    exact key gets ONLY that key's plans, so a broader dotted-prefix
+    key (matching the subtree) cannot silently override an explicit
+    per-layer plan depending on dict order. Regex is only the fallback
+    for keys that actually contain regex syntax — a plain dotted layer
+    path must not behave as a pattern ('.' over-matching any char),
+    and a key with unbalanced metacharacters ('(' , '+') must degrade
+    to literal matching instead of raising re.error mid-parallelize."""
     hits: List[Tuple[Any, PlanBase]] = []
     for name, sub in model.named_sublayers(include_self=True):
+        exact = [plan for pat, plan in plan_map.items() if name == pat]
+        if exact:
+            hits.extend((sub, plan) for plan in exact)
+            continue
         for pat, plan in plan_map.items():
-            if name == pat or re.fullmatch(pat, name):
+            if name.startswith(pat + "."):
                 hits.append((sub, plan))
+                continue
+            if _PLAIN_PLAN_KEY.match(pat):
+                continue        # literal dotted name: no regex semantics
+            try:
+                if re.fullmatch(pat, name):
+                    hits.append((sub, plan))
+            except re.error:
+                pass            # malformed pattern: literal-only key
     return hits
 
 

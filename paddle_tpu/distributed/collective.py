@@ -417,7 +417,8 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 
 
 def _trace_axis_size(ax):
-    return jax.lax.axis_size(ax)
+    from ..core.jax_compat import axis_size
+    return axis_size(ax)
 
 
 def send(tensor, dst=0, group=None, sync_op=True):

@@ -67,17 +67,19 @@ def spawn(func, args=(), nprocs: int = -1, join: bool = True,
     ``init_parallel_env()`` inside it rendezvouses exactly like under
     ``paddle_tpu.distributed.launch``."""
     import multiprocessing as mp
-    import socket
 
     if nprocs <= 0:
         nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     if nprocs == 1:
         func(*args)
         return None
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    # The parent hosts the TCPStore and hands children the ALREADY-BOUND
+    # port: the previous bind/close-then-rebind dance both raced other
+    # processes for the freed port and let ranks > 0 connect before rank
+    # 0's in-child server was listening.
+    from .store import TCPStoreServer
+    server = TCPStoreServer(port=0)
+    port = server.port
     ctx = mp.get_context("spawn")
     procs = []
     for rank in range(nprocs):
@@ -87,13 +89,24 @@ def spawn(func, args=(), nprocs: int = -1, join: bool = True,
         p.start()
         procs.append(p)
     if not join:
+        # keep the store alive for the detached workers' lifetime
+        _SPAWN_SERVERS.append(server)
         return procs
-    for p in procs:
-        p.join()
+    try:
+        for p in procs:
+            p.join()
+    finally:
+        try:
+            server.close()
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
     bad = [p.exitcode for p in procs if p.exitcode]
     if bad:
         raise RuntimeError(f"spawned workers failed: exit codes {bad}")
     return None
+
+
+_SPAWN_SERVERS: List = []   # join=False stores, alive until process exit
 
 
 def _spawn_entry(func, args, rank, nprocs, port):
@@ -103,10 +116,8 @@ def _spawn_entry(func, args, rank, nprocs, port):
         "PADDLE_MASTER": f"127.0.0.1:{port}",
         "JAX_PLATFORMS": os.environ.get("JAX_PLATFORMS", "cpu"),
     })
-    # rank 0 hosts the control-plane store like the launch controller
-    if rank == 0:
-        from .store import TCPStoreServer
-        server = TCPStoreServer(port=port)  # noqa: F841 — owned by proc
+    # the control-plane store is hosted by the PARENT (already listening
+    # before any child started) — no rank-0 bootstrap ordering hazard
     func(*args)
 
 

@@ -28,10 +28,8 @@ from typing import Any, Callable, Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-try:
-    from jax import shard_map
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map
+
+from ...core.jax_compat import shard_map_norep as _shard_map_norep_impl
 
 __all__ = ["CompiledPipeline", "Compiled1F1B", "CompiledInterleaved",
            "pipeline_microbatch"]
@@ -49,13 +47,10 @@ def _dp_reduce(loss, grads, data_axis):
 
 
 def _shard_map_norep(fn, mesh, in_specs, out_specs):
-    """shard_map without the replication check, across the jax rename
-    (check_rep -> check_vma); single home for the compatibility shim."""
-    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
-    try:
-        return shard_map(fn, check_rep=False, **kwargs)
-    except TypeError:  # jax >= 0.8 renamed the replication check
-        return shard_map(fn, check_vma=False, **kwargs)
+    """shard_map without the replication check; the version shim lives
+    in core/jax_compat.py (shared with ops/ring_attention)."""
+    return _shard_map_norep_impl(fn, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)
 
 
 def pipeline_microbatch(batch, num_microbatches: int):

@@ -119,6 +119,10 @@ def masked_multihead_attention(
                        kc.astype(jnp.float32)) / np.sqrt(D)
         if m is not None:
             mm = m.astype(jnp.float32).reshape(B, 1, -1)
+            # clamp BEFORE padding, mirroring the decode tgt_mask path:
+            # a mask longer than the cache S_max would make the pad
+            # width negative and jnp.pad raises
+            mm = mm[:, :, :S]
             s = s + jnp.pad(mm, ((0, 0), (0, 0), (0, S - mm.shape[-1])))
         s = jnp.where(live[:, None, :], s, -jnp.inf)
         p = jax.nn.softmax(s, axis=-1)

@@ -6,13 +6,15 @@ replace the CUDA decode kernels."""
 from .predictor import Config, Predictor, create_predictor
 from .generation import (GenerationConfig, generate, cached_forward,
                          init_cache, sample_token)
+from .serving import Request, ServingEngine
 
 __all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
            "DataType", "PlaceType", "PrecisionType", "PredictorPool",
            "XpuConfig", "get_version", "get_num_bytes_of_data_type",
            "get_trt_compile_version", "get_trt_runtime_version",
            "convert_to_mixed_precision",
-           "generate", "cached_forward", "init_cache", "sample_token"]
+           "generate", "cached_forward", "init_cache", "sample_token",
+           "Request", "ServingEngine"]
 
 
 class DataType:

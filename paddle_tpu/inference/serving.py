@@ -1,0 +1,497 @@
+"""Continuous-batching serving engine over the paged-KV cache.
+
+``generate_paged`` runs STATIC batches: every prompt prefills together
+and the whole batch drains at the pace of its slowest request, so real
+mixed-arrival traffic leaves decode slots idle and queues new requests
+behind the entire batch (head-of-line blocking). This module is the
+scheduler the paged building blocks (``ops.paged_attention``'s pools +
+``BlockManager``) were missing — vLLM-style continuous batching, the
+TPU analog of the reference's AnalysisPredictor serving loop around
+``fusion/block_multihead_attention``:
+
+- a fixed-capacity SLOT TABLE: every decode step is ONE jitted program
+  over all ``capacity`` slots. Inactive slots are padded — seq_len 0,
+  block table pointing at the reserved scratch page — so admission and
+  completion never change shapes: steady state is zero retraces.
+- BUCKETED CHUNKED PREFILL: a new request's prompt runs through
+  per-bucket jitted programs in bounded chunks (each at most the
+  largest bucket), interleaved with in-flight decode steps. Each chunk
+  gathers the request's pages into a dense view, runs the same
+  ``cached_forward`` math as ``generate``'s prefill, and scatters the
+  updated pages back — at most one trace per bucket, ever.
+- SLOT RECYCLING: a finished request releases its KV pages back to the
+  ``BlockManager`` and its slot is immediately re-admitted from the
+  queue at the next step.
+- int8 cache (``cache_dtype="int8"``): pools store int8 with static
+  per-layer-per-head scales calibrated once from the first admitted
+  prompt (the same calibration point as ``generate_paged``); prefill
+  dequants pages into the chunk's dense view and requantizes on the way
+  out (idempotent for untouched positions, same scale), decode runs the
+  quantized gather path.
+
+Host/device split: the decode carry (tokens, seq_lens, key, pools)
+stays device-resident between steps; host mirrors are re-uploaded only
+when admission state changes. The per-step device->host read of the
+sampled tokens is the scheduling point where the host detects EOS /
+length-done and recycles slots.
+"""
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..ops.paged_attention import (BlockManager, dequant_cache,
+                                   quant_cache)
+from .generation import (GenerationConfig, _paged_decode_step,
+                         cached_forward, init_cache)
+
+__all__ = ["Request", "ServingEngine"]
+
+_SCRATCH_SEQ = -1      # BlockManager key owning the reserved page 0
+
+
+def _sample_slots(logits, key, temps):
+    """[C, V] logits -> [C] next tokens. ``temps[i] <= 0`` selects
+    greedy for that slot; otherwise temperature sampling — per-request
+    sampling rides as a traced array, so mixing greedy and sampled
+    requests in one batch costs no retrace."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1)
+    sampled = jax.random.categorical(
+        key, logits / jnp.maximum(temps, 1e-6)[:, None], axis=-1)
+    return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
+
+
+@dataclass
+class Request:
+    """One serving request and its lifecycle record."""
+    req_id: int
+    prompt: np.ndarray                       # [S] int32
+    gen: GenerationConfig
+    submit_t: float = 0.0
+    tokens: List[int] = field(default_factory=list)   # generated ids
+    ttft: Optional[float] = None             # sec, first token - submit
+    finish_t: Optional[float] = None
+    done: bool = False
+
+    @property
+    def output_ids(self) -> np.ndarray:
+        return np.concatenate([np.asarray(self.prompt, np.int32),
+                               np.asarray(self.tokens, np.int32)])
+
+
+class _Slot:
+    __slots__ = ("req", "phase", "seq_len", "prefill_pos")
+
+    def __init__(self):
+        self.req: Optional[Request] = None
+        self.phase = "idle"          # idle | prefill | decode
+        self.seq_len = 0             # tokens cached in the pools
+        self.prefill_pos = 0         # next prompt position to prefill
+
+
+class ServingEngine:
+    """Continuous-batching engine over a shared paged KV pool.
+
+    ``submit()`` enqueues a request; ``step()`` runs one scheduler
+    iteration (admit -> one prefill chunk -> one decode step over all
+    live slots); ``drain()`` steps until idle. ``metrics()`` reports
+    tokens/s, TTFT, decode-slot utilization and compile/trace counts.
+    """
+
+    def __init__(self, params: Dict, cfg, capacity: int = 4,
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 max_seq_len: Optional[int] = None, cache_dtype=None,
+                 prefill_buckets=(32, 128), seed: int = 0):
+        self.params = params
+        self.cfg = cfg
+        self.capacity = int(capacity)
+        self.block_size = int(block_size)
+        self.max_seq_len = int(max_seq_len
+                               or cfg.max_position_embeddings)
+        if self.max_seq_len > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds the rope table "
+                f"bound max_position_embeddings "
+                f"= {cfg.max_position_embeddings}")
+        self.buckets = tuple(sorted({int(b) for b in prefill_buckets}))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError("prefill_buckets must be positive")
+        BS = self.block_size
+        # the chunk's dense view is MB*BS wide; the last chunk may pad
+        # past max_seq_len by up to a bucket, so the table gets the slack
+        # (table width only — the physical pool is shared and unchanged)
+        self.max_blocks = -(-(self.max_seq_len + self.buckets[-1]) // BS)
+        if num_blocks is None:
+            num_blocks = self.capacity * (-(-self.max_seq_len // BS)) + 1
+        self.num_blocks = int(num_blocks)
+
+        if cache_dtype in ("int8", jnp.int8):
+            self._quant = True
+        elif cache_dtype in (None, "bfloat16", "float32",
+                             jnp.bfloat16, jnp.float32):
+            self._quant = False
+        else:
+            raise ValueError(f"cache_dtype must be bfloat16|float32|int8,"
+                             f" got {cache_dtype!r}")
+        L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        pool_dtype = jnp.int8 if self._quant else cfg.dtype
+        shape = (L, self.num_blocks, BS, KV, hd)
+        self._k_pools = jnp.zeros(shape, pool_dtype)
+        self._v_pools = jnp.zeros(shape, pool_dtype)
+        self._kv_scales = None       # (k [L,KV], v [L,KV]) once calibrated
+
+        self.mgr = BlockManager(self.num_blocks, BS, self.max_blocks)
+        # reserve physical page 0 as scratch: padded table entries (and
+        # inactive decode slots) default there, so their writes land in
+        # a page no live sequence ever reads
+        scratch = self.mgr.allocate(_SCRATCH_SEQ, 1)
+        assert scratch == [0], "scratch must be page 0 (tables pad with 0)"
+
+        C, MB = self.capacity, self.max_blocks
+        self._slots = [_Slot() for _ in range(C)]
+        self._queue: Deque[Request] = collections.deque()
+        self._requests: List[Request] = []
+        self._next_id = 0
+        self._slot_tables = np.zeros((C, MB), np.int32)  # true tables
+        # decode-program inputs (host mirrors). Mid-prefill slots keep
+        # table 0 / seq 0 here: their decode write must hit scratch, not
+        # their half-written prompt pages.
+        self._h_tok = np.zeros((C,), np.int32)
+        self._h_seq = np.zeros((C,), np.int32)
+        self._h_tables = np.zeros((C, MB), np.int32)
+        self._h_temps = np.zeros((C,), np.float32)
+        self._dirty = True
+        self._d_tok = self._d_seq = None
+        self._d_tables = self._d_temps = None
+        self._d_key = jax.random.key(seed)
+
+        self._decode_fn = None
+        self._prefill_fns: Dict[int, object] = {}
+        self._calib_fn = None
+        self._calib_bucket = None
+        # *_traces counters increment inside the traced python bodies,
+        # which only run when XLA (re)traces — they count compilations,
+        # not calls. The tier-1 suite pins steady state to 1 decode
+        # program + <=1 per prefill bucket over a 30-request stream.
+        self.counters = {
+            "decode_traces": 0, "prefill_traces": {},
+            "calibration_traces": 0, "decode_steps": 0,
+            "prefill_chunks": 0, "live_slot_steps": 0,
+            "tokens_generated": 0, "requests_submitted": 0,
+            "requests_completed": 0,
+        }
+        self._t_first = None
+        self._t_last = None
+
+    # -- public API ---------------------------------------------------
+    def submit(self, prompt, gen: Optional[GenerationConfig] = None
+               ) -> Request:
+        """Enqueue one request. Admission happens inside ``step()`` when
+        a slot and enough KV pages are free (FIFO, no overtaking)."""
+        gen = gen or GenerationConfig()
+        if gen.top_k > 0 or gen.top_p < 1.0:
+            raise NotImplementedError(
+                "ServingEngine: per-request top-k/top-p would bake the "
+                "knob values into the traced decode program (a retrace "
+                "per distinct config); greedy/temperature ride as traced"
+                " arrays. Use generate()/generate_paged for top-k/top-p")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        total = int(prompt.size) + int(gen.max_new_tokens)
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt+max_new_tokens = {total} exceeds engine "
+                f"max_seq_len = {self.max_seq_len}")
+        need = -(-total // self.block_size)
+        if need > self.num_blocks - 1:          # minus the scratch page
+            raise ValueError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self.num_blocks - 1}; raise num_blocks")
+        req = Request(self._next_id, prompt, gen,
+                      submit_t=time.perf_counter())
+        self._next_id += 1
+        self._queue.append(req)
+        self._requests.append(req)
+        self.counters["requests_submitted"] += 1
+        return req
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit from the queue, run one
+        prefill chunk (if an admission is in flight), then one decode
+        step over all live slots. Returns True if any work ran."""
+        if self._t_first is None:
+            self._t_first = time.perf_counter()
+        self._admit()
+        did = self._run_prefill()
+        did = self._run_decode() or did
+        if did:
+            self._t_last = time.perf_counter()
+        return did
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and all(
+            s.phase == "idle" for s in self._slots)
+
+    def drain(self, max_steps: Optional[int] = None) -> int:
+        """Step until queue and slots are empty; returns step count."""
+        n = 0
+        while not self.idle:
+            if not self.step():
+                raise RuntimeError(
+                    "engine starved: queued requests cannot be admitted "
+                    "(KV pool too small for the in-flight mix?)")
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        return n
+
+    def metrics(self) -> Dict:
+        c = {k: (dict(v) if isinstance(v, dict) else v)
+             for k, v in self.counters.items()}
+        wall = ((self._t_last - self._t_first)
+                if self._t_first is not None and self._t_last is not None
+                else 0.0)
+        c["wall_time_s"] = round(wall, 6)
+        c["tokens_per_sec"] = (round(c["tokens_generated"] / wall, 3)
+                               if wall > 0 else 0.0)
+        ttfts = [r.ttft for r in self._requests if r.ttft is not None]
+        c["ttft_ms_mean"] = (round(float(np.mean(ttfts)) * 1e3, 3)
+                             if ttfts else None)
+        c["ttft_ms_max"] = (round(float(np.max(ttfts)) * 1e3, 3)
+                            if ttfts else None)
+        steps = c["decode_steps"]
+        c["slot_utilization"] = (
+            round(c["live_slot_steps"] / (steps * self.capacity), 4)
+            if steps else 0.0)
+        return c
+
+    def reset_metrics(self):
+        """Zero the throughput counters/timers (e.g. after a compile
+        warmup pass). Trace counters are cumulative and stay."""
+        for k in ("decode_steps", "prefill_chunks", "live_slot_steps",
+                  "tokens_generated", "requests_submitted",
+                  "requests_completed"):
+            self.counters[k] = 0
+        self._t_first = self._t_last = None
+        self._requests = [r for r in self._requests if not r.done]
+
+    # -- scheduling ---------------------------------------------------
+    def _temp_of(self, gen: GenerationConfig) -> float:
+        return 0.0 if (gen.greedy or gen.temperature == 0.0) \
+            else float(gen.temperature)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _admit(self):
+        for slot_id, slot in enumerate(self._slots):
+            if slot.phase != "idle" or not self._queue:
+                continue
+            req = self._queue[0]
+            total = req.prompt.size + req.gen.max_new_tokens
+            need = -(-total // self.block_size)
+            if len(self.mgr.free) < need:
+                break          # FIFO backpressure: wait for pages
+            self._queue.popleft()
+            if self._quant and self._kv_scales is None:
+                # static scales calibrate from the first admitted prompt
+                # BEFORE any prefill/decode program exists, so the
+                # programs close over the final scale arrays
+                self._calibrate(req.prompt)
+            table = self.mgr.allocate(req.req_id, total)
+            slot.req = req
+            slot.phase = "prefill"
+            slot.seq_len = 0
+            slot.prefill_pos = 0
+            self._slot_tables[slot_id] = 0
+            self._slot_tables[slot_id, :len(table)] = table
+
+    def _run_prefill(self) -> bool:
+        for slot_id, slot in enumerate(self._slots):
+            if slot.phase != "prefill":
+                continue
+            req = slot.req
+            S = req.prompt.size
+            pos0 = slot.prefill_pos
+            n = min(S - pos0, self.buckets[-1])
+            P = self._bucket_for(n)
+            fn = self._prefill_fns.get(P)
+            if fn is None:
+                fn = self._prefill_fns[P] = self._make_prefill_fn(P)
+            toks = np.zeros((1, P), np.int32)
+            toks[0, :n] = req.prompt[pos0:pos0 + n]
+            # pos0/last_idx ride at the platform default int width so
+            # the literal indices inside cached_forward's dynamic
+            # slices promote consistently whether or not x64 is on
+            tok, self._d_key, self._k_pools, self._v_pools = fn(
+                self.params, jnp.asarray(toks), jnp.asarray(pos0),
+                jnp.asarray(self._slot_tables[slot_id].copy()),
+                jnp.asarray(n - 1),
+                jnp.asarray(self._temp_of(req.gen), jnp.float32),
+                self._d_key, self._k_pools, self._v_pools)
+            self.counters["prefill_chunks"] += 1
+            slot.prefill_pos += n
+            if slot.prefill_pos == S:
+                first = int(np.asarray(tok))
+                req.ttft = time.perf_counter() - req.submit_t
+                req.tokens.append(first)
+                self.counters["tokens_generated"] += 1
+                slot.seq_len = S
+                if (first == req.gen.eos_token_id
+                        or req.gen.max_new_tokens <= 1):
+                    self._finish(slot_id)
+                else:
+                    slot.phase = "decode"
+                    self._h_tok[slot_id] = first
+                    self._h_seq[slot_id] = S
+                    self._h_tables[slot_id] = self._slot_tables[slot_id]
+                    self._h_temps[slot_id] = self._temp_of(req.gen)
+                    self._dirty = True
+            return True
+        return False
+
+    def _run_decode(self) -> bool:
+        live = [i for i, s in enumerate(self._slots)
+                if s.phase == "decode"]
+        if not live:
+            return False
+        if self._decode_fn is None:
+            self._decode_fn = self._make_decode_fn()
+        if self._dirty:
+            self._d_tok = jnp.asarray(self._h_tok.copy())
+            self._d_seq = jnp.asarray(self._h_seq.copy())
+            self._d_tables = jnp.asarray(self._h_tables.copy())
+            self._d_temps = jnp.asarray(self._h_temps.copy())
+            self._dirty = False
+        (self._d_tok, self._d_seq, self._d_key, self._k_pools,
+         self._v_pools) = self._decode_fn(
+            self.params, self._d_tok, self._d_seq, self._d_tables,
+            self._d_temps, self._d_key, self._k_pools, self._v_pools)
+        nxt = np.asarray(self._d_tok)       # the per-step host sync
+        self.counters["decode_steps"] += 1
+        self.counters["live_slot_steps"] += len(live)
+        for i in live:
+            slot = self._slots[i]
+            req = slot.req
+            t = int(nxt[i])
+            req.tokens.append(t)
+            self.counters["tokens_generated"] += 1
+            slot.seq_len += 1
+            self._h_seq[i] = slot.seq_len
+            self._h_tok[i] = t
+            if (t == req.gen.eos_token_id
+                    or len(req.tokens) >= req.gen.max_new_tokens):
+                self._finish(i)
+        return True
+
+    def _finish(self, slot_id: int):
+        slot = self._slots[slot_id]
+        req = slot.req
+        req.done = True
+        req.finish_t = time.perf_counter()
+        self.mgr.release(req.req_id)
+        slot.req = None
+        slot.phase = "idle"
+        slot.seq_len = 0
+        slot.prefill_pos = 0
+        self._slot_tables[slot_id] = 0
+        self._h_tok[slot_id] = 0
+        self._h_seq[slot_id] = 0
+        self._h_tables[slot_id] = 0
+        self._h_temps[slot_id] = 0.0
+        self._dirty = True          # released pages must not be written
+        self.counters["requests_completed"] += 1
+
+    # -- jitted programs ----------------------------------------------
+    def _make_decode_fn(self):
+        cfg, counters = self.cfg, self.counters
+        scales = self._kv_scales    # closed over: fixed after calibration
+
+        def step(params, tok, seq_lens, tables, temps, key,
+                 k_pools, v_pools):
+            counters["decode_traces"] += 1
+            logits, k_pools, v_pools = _paged_decode_step(
+                params, tok, cfg, k_pools, v_pools, tables, seq_lens,
+                kv_scales=scales)
+            key, sub = jax.random.split(key)
+            nxt = _sample_slots(logits, sub, temps)
+            # inactive (padded) slots hold seq 0 and stay there; their
+            # write above landed in scratch page 0, never read
+            seq_lens = jnp.where(seq_lens > 0, seq_lens + 1, 0)
+            return nxt, seq_lens, key, k_pools, v_pools
+
+        return jax.jit(step, donate_argnums=(6, 7))
+
+    def _make_prefill_fn(self, P: int):
+        cfg, counters = self.cfg, self.counters
+        MB, BS = self.max_blocks, self.block_size
+        L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        scales = self._kv_scales
+        counters["prefill_traces"].setdefault(P, 0)
+
+        def chunk(params, toks, pos0, table, last_idx, temp, key,
+                  k_pools, v_pools):
+            counters["prefill_traces"][P] += 1
+            # this request's pages as a dense [L, 1, T, KV, hd] cache:
+            # the chunk runs the SAME cached_forward math as generate()'s
+            # prefill, so single-request outputs match token-for-token
+            kc = jnp.take(k_pools, table, axis=1) \
+                .reshape(L, 1, MB * BS, KV, hd)
+            vc = jnp.take(v_pools, table, axis=1) \
+                .reshape(L, 1, MB * BS, KV, hd)
+            if scales is not None:
+                kc = dequant_cache(kc, scales[0]).astype(cfg.dtype)
+                vc = dequant_cache(vc, scales[1]).astype(cfg.dtype)
+            logits, kc, vc = cached_forward(params, toks, cfg, kc, vc,
+                                            pos0)
+            if scales is not None:
+                kc = quant_cache(kc, scales[0])
+                vc = quant_cache(vc, scales[1])
+            k_pools = k_pools.at[:, table].set(
+                kc.reshape(L, MB, BS, KV, hd).astype(k_pools.dtype))
+            v_pools = v_pools.at[:, table].set(
+                vc.reshape(L, MB, BS, KV, hd).astype(v_pools.dtype))
+            # sample the request's FIRST token from the last valid
+            # position (only meaningful on the final chunk)
+            lg = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1,
+                                              axis=1)[:, 0]
+            key, sub = jax.random.split(key)
+            tok = _sample_slots(lg, sub, temp[None])[0]
+            return tok, key, k_pools, v_pools
+
+        return jax.jit(chunk, donate_argnums=(7, 8))
+
+    def _calibrate(self, prompt: np.ndarray):
+        cfg, counters = self.cfg, self.counters
+        P = self._bucket_for(min(int(prompt.size), self.buckets[-1]))
+        if self._calib_fn is None or self._calib_bucket != P:
+            def calib(params, toks):
+                counters["calibration_traces"] += 1
+                kc, vc = init_cache(cfg, 1, toks.shape[1],
+                                    dtype=cfg.dtype)
+                _, kc, vc = cached_forward(params, toks, cfg, kc, vc, 0)
+                amax = lambda x: jnp.max(                  # noqa: E731
+                    jnp.abs(x.astype(jnp.float32)), axis=(1, 2, 4))
+                return amax(kc), amax(vc)
+            self._calib_fn = jax.jit(calib)
+            self._calib_bucket = P
+        toks = np.zeros((1, P), np.int32)
+        n = min(int(prompt.size), P)
+        toks[0, :n] = prompt[:n]
+        k_amax, v_amax = self._calib_fn(self.params, jnp.asarray(toks))
+        self._kv_scales = (jnp.maximum(k_amax / 127.0, 1e-8),
+                           jnp.maximum(v_amax / 127.0, 1e-8))

@@ -153,6 +153,22 @@ def quantize_pools(k_pool, v_pool):
     return kq, vq, ks, vs
 
 
+def dequant_cache(x, scale):
+    """int8 dense cache view [L, B, T, KV, hd] -> fp32 with per-layer-
+    per-head scales [L, KV] (the serving engine's chunked prefill pulls
+    quantized pages into a dense view through this)."""
+    return x.astype(jnp.float32) * scale[:, None, None, :, None]
+
+
+def quant_cache(x, scale):
+    """Inverse of ``dequant_cache``: fp dense view -> int8 with the same
+    static scales. round(clip(q*s/s)) == q, so requantizing positions
+    that were only dequantized (not rewritten) is exact."""
+    return jnp.clip(jnp.round(x.astype(jnp.float32)
+                              / scale[:, None, None, :, None]),
+                    -127, 127).astype(jnp.int8)
+
+
 def write_to_pool_quant(k_pool, v_pool, block_tables, seq_lens,
                         k_new, v_new, k_scale, v_scale):
     """``write_to_pool`` for int8 pools: the new token's K/V quantize

@@ -40,7 +40,10 @@ def no_x64(fn):
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         if jax.config.jax_enable_x64:
-            with jax.enable_x64(False):
+            # jax.enable_x64(False) was removed; the supported context
+            # manager lives under jax.experimental
+            from jax.experimental import disable_x64
+            with disable_x64():
                 return fn(*args, **kwargs)
         return fn(*args, **kwargs)
     return wrapper

@@ -24,6 +24,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core.jax_compat import (axis_size as _axis_size,
+                               shard_map_norep as _shard_map_norep)
+
 __all__ = ["ring_attention", "ring_attention_local", "ulysses_attention"]
 
 
@@ -44,7 +47,7 @@ def ring_attention_local(q, k, v, axis_name: str = "sp", causal=True,
                          scale=None):
     """Per-shard body (call inside shard_map). q,k,v: [b, s_local, h, d]."""
     b, sl, h, d = q.shape
-    n = jax.lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     sc = scale if scale is not None else 1.0 / (d ** 0.5)
     perm = [(i, (i - 1) % n) for i in range(n)]  # kv ring: shift left
@@ -93,13 +96,11 @@ def ring_attention(q, k, v, mesh: Mesh, axis_name: str = "sp", causal=True,
                    scale=None):
     """Global entry: q,k,v [b, s, h, d] sharded (or shardable) on seq.
     Runs the ring under shard_map over ``axis_name``."""
-    from jax import shard_map
     spec = P(None, axis_name, None, None)
-    fn = shard_map(
+    fn = _shard_map_norep(
         functools.partial(ring_attention_local, axis_name=axis_name,
                           causal=causal, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 
@@ -108,11 +109,9 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     """All-to-all head redistribution (reference `sep` semantics): seq-
     sharded → head-sharded via all_to_all, full-sequence attention per
     head group, then back."""
-    from jax import shard_map
-
     def local(q, k, v):
         # [b, s_local, h, d] -> a2a -> [b, s, h_local, d]
-        n = jax.lax.axis_size(axis_name)
+        n = _axis_size(axis_name)
 
         def seq2head_impl(x):
             b, sl, h, d = x.shape
@@ -155,6 +154,6 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
         return head2seq(og)
 
     spec = P(None, axis_name, None, None)
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_vma=False)
+    fn = _shard_map_norep(local, mesh=mesh,
+                          in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

@@ -110,7 +110,10 @@ class TestCollectivesInShardMap:
         return Mesh(np.array(jax.devices()[:8]), axis_names=("dp",))
 
     def test_all_reduce_psum(self):
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:   # older jax: experimental
+            from jax.experimental.shard_map import shard_map
         mesh = self._mesh()
         x = jnp.arange(8.0)
 
@@ -124,7 +127,10 @@ class TestCollectivesInShardMap:
         np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
 
     def test_all_gather(self):
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:   # older jax: experimental
+            from jax.experimental.shard_map import shard_map
         mesh = self._mesh()
         x = jnp.arange(8.0)
 
@@ -138,7 +144,10 @@ class TestCollectivesInShardMap:
         assert out.shape == (64,)
 
     def test_reduce_scatter(self):
-        from jax import shard_map
+        try:
+            from jax import shard_map
+        except ImportError:   # older jax: experimental
+            from jax.experimental.shard_map import shard_map
         mesh = self._mesh()
         x = jnp.ones((64,))
 

@@ -56,6 +56,37 @@ def test_masked_multihead_attention_matches_loop():
     np.testing.assert_allclose(cache2[1], vc, atol=1e-6)
 
 
+def test_masked_mha_long_src_mask_clamped():
+    """Regression (ADVICE.md r5): a src_mask whose last dim exceeds the
+    cache S_max made the pad width negative (jnp.pad raised). It must
+    clamp to S_max — matching the result of passing the pre-clamped
+    mask — like the decode tgt_mask path does."""
+    rng = np.random.RandomState(7)
+    B, H, S, D = 2, 2, 8, 4
+    cache = rng.randn(2, B, H, S, D).astype(np.float32)
+    lens = np.asarray([3, 6], np.int32)
+    x = rng.randn(B, 3 * H * D).astype(np.float32)
+    long_mask = rng.randn(B, 1, S + 5).astype(np.float32)  # > S_max
+
+    out_long, _ = IF.masked_multihead_attention(
+        paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+        src_mask=paddle.to_tensor(long_mask),
+        sequence_lengths=paddle.to_tensor(lens))
+    out_clamped, _ = IF.masked_multihead_attention(
+        paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+        src_mask=paddle.to_tensor(long_mask[:, :, :S]),
+        sequence_lengths=paddle.to_tensor(lens))
+    np.testing.assert_allclose(np.asarray(out_long.numpy()),
+                               np.asarray(out_clamped.numpy()),
+                               atol=1e-6)
+    # short masks still pad up to S_max
+    out_short, _ = IF.masked_multihead_attention(
+        paddle.to_tensor(x), cache_kv=paddle.to_tensor(cache),
+        src_mask=paddle.to_tensor(long_mask[:, :, :2]),
+        sequence_lengths=paddle.to_tensor(lens))
+    assert np.isfinite(np.asarray(out_short.numpy())).all()
+
+
 def test_masked_mha_gates_quant_args():
     x = paddle.to_tensor(np.zeros((1, 3 * 2 * 4), np.float32))
     cache = paddle.to_tensor(np.zeros((2, 1, 2, 8, 4), np.float32))

@@ -47,6 +47,7 @@ PACK = [
     ("bert", 900, 2),
     ("ernie_infer", 900, 2),
     ("paged_decode", 1500, 2),
+    ("serving_engine", 1200, 2),
     ("llama_ladder", 2700, 2),
     ("resnet50_sweep", 1500, 2),
     ("kernels", 1200, 3),
@@ -85,7 +86,9 @@ def save_results(res):
 def main():
     budget = float(os.environ.get("OPP_TOTAL_HOURS", "11")) * 3600
     interval = float(os.environ.get("OPP_INTERVAL", "180"))
-    probe_timeout = int(os.environ.get("OPP_PROBE_TIMEOUT", "150"))
+    # the probe is a tiny device_put+add now (<20 s liveness); a wedged
+    # tunnel should cost 20 s per attempt, not 150 s of the window
+    probe_timeout = int(os.environ.get("OPP_PROBE_TIMEOUT", "20"))
     t0 = time.time()
 
     results = load_results()
