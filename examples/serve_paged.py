@@ -2,7 +2,9 @@
 then the vLLM-style paged-KV loop, then the same loop on an int8
 quantized cache (half the KV HBM -> 2x batch at the same footprint),
 then mixed-arrival traffic through the continuous-batching
-ServingEngine vs the static batch (head-of-line blocking demo)."""
+ServingEngine vs the static batch (head-of-line blocking demo), and
+finally the radix PREFIX CACHE: requests sharing a system prompt skip
+prefilling the shared pages (copy-on-write KV page sharing)."""
 import time
 
 import numpy as np
@@ -78,6 +80,34 @@ def main():
           f"TTFT mean {m['ttft_ms_mean']:.1f} ms, "
           f"slot util {m['slot_utilization']:.2f}, traces: "
           f"decode={m['decode_traces']} prefill={m['prefill_traces']}")
+
+    # -- radix prefix cache: shared system prompt ----------------------
+    # 6 requests = one 48-token system prompt + distinct 8-token user
+    # tails. With prefix_cache=True the first request prefills the
+    # shared pages once; every later request longest-prefix-matches at
+    # admission, appends the shared pages to its block table (the
+    # partially-filled tail page arrives as a copy-on-write fork) and
+    # prefills only its un-cached suffix. Greedy outputs stay
+    # bit-identical to the cold path.
+    sys_prompt = rng.randint(0, 512, (48,)).astype(np.int32)
+    eng = ServingEngine(params, cfg, capacity=4, block_size=16,
+                        prefill_buckets=(16, 64), max_seq_len=96,
+                        prefix_cache=True)
+    for _ in range(6):
+        tail = rng.randint(0, 512, (8,)).astype(np.int32)
+        eng.submit(np.concatenate([sys_prompt, tail]),
+                   GenerationConfig(max_new_tokens=8, greedy=True))
+        eng.step()      # staggered arrivals: the first request's
+        #                 prefill indexes the shared pages, so every
+        #                 LATER arrival hits while it still decodes
+    eng.drain()
+    m = eng.metrics()
+    pc = m["prefix_cache"]
+    print(f"Prefix cache shared-prompt stream: hits={pc['hits']} "
+          f"misses={pc['misses']} prefill tokens skipped="
+          f"{pc['tokens_skipped']} shared pages={pc['shared_pages']} "
+          f"COW forks={pc['cow_forks']} cached pages="
+          f"{pc['cached_pages']} (TTFT mean {m['ttft_ms_mean']:.1f} ms)")
 
 
 if __name__ == "__main__":

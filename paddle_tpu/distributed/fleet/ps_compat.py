@@ -104,6 +104,22 @@ class UtilBase:
         if get_world_size() <= 1:
             return arr if mode != "mean" else arr
         from ...core.tensor import Tensor
+        # integer inputs stay on an integer path: the old float32
+        # round-trip silently lost exactness for counts > 2^24 (a global
+        # example counter at that scale is exactly what this reduces).
+        # The collective runs in int64 (the package enables x64) so
+        # int32 per-rank counts cannot wrap in the cross-rank sum; the
+        # result narrows back to the input dtype only when it fits.
+        if arr.dtype.kind in "iu" and mode in ("sum", "min", "max"):
+            wide = np.int64 if arr.dtype.kind == "i" else np.uint64
+            t = Tensor(arr.astype(wide))
+            op = {"sum": C.ReduceOp.SUM, "min": C.ReduceOp.MIN,
+                  "max": C.ReduceOp.MAX}[mode]
+            C.all_reduce(t, op=op)
+            out = np.asarray(t._value)
+            if (out.astype(arr.dtype) == out).all():
+                return out.astype(arr.dtype)
+            return out
         t = Tensor(arr.astype(np.float64).astype(np.float32))
         op = {"sum": C.ReduceOp.SUM, "min": C.ReduceOp.MIN,
               "max": C.ReduceOp.MAX, "mean": C.ReduceOp.AVG}[mode]

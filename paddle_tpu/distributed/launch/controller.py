@@ -148,9 +148,19 @@ class Controller:
             pc.close_log()
 
     # -- watch / elastic ----------------------------------------------------
+    def _skey(self, kind: str, node) -> str:
+        """Store keys for liveness markers, namespaced by the
+        coordination epoch: exit/heartbeat markers persist in the
+        TCPStore across elastic re-ranks, and after membership changes
+        re-assign ranks a stale ``exit/N == 0`` from a prior incarnation
+        would mask a genuinely dead node in ``_peer_failure``. The epoch
+        is the membership hash already agreed for PADDLE_COORD_EPOCH, so
+        every surviving node namespaces identically."""
+        return f"{kind}/{getattr(self, '_coord_epoch', 0)}/{node}"
+
     def _heartbeat(self):
         try:
-            self.store.set(f"heartbeat/{self.spec.node_rank}",
+            self.store.set(self._skey("heartbeat", self.spec.node_rank),
                            str(time.time()))
         except (ConnectionError, OSError):
             # master gone mid-run; peers keep watching their local procs —
@@ -168,18 +178,28 @@ class Controller:
             for node in range(self.spec.nnodes):
                 if node == self.spec.node_rank:
                     continue
-                val = self.store.get(f"heartbeat/{node}")
-                if val is not None and now - float(val) > HEARTBEAT_STALE:
-                    # a cleanly-finished node stops heartbeating but is
-                    # not a failure — it left exit/{n} == 0. A CRASHED
-                    # node's nonzero exit marker must still count as a
-                    # failure (its controller may write the marker on
-                    # the way down), or survivors would run forever
-                    # against a hung world
-                    ex = self.store.get(f"exit/{node}")
-                    if ex is not None and ex.strip() in (b"0", "0"):
+                val = self.store.get(self._skey("heartbeat", node))
+                if val is None:
+                    # no heartbeat yet under THIS epoch: a peer that
+                    # died before its first beat of a new incarnation
+                    # would otherwise be invisible forever (its old-
+                    # epoch keys are deliberately ignored). Grace-time
+                    # it from when we started watching this incarnation
+                    start = getattr(self, "_watch_start", now)
+                    if now - start <= HEARTBEAT_STALE:
                         continue
-                    return node
+                elif now - float(val) <= HEARTBEAT_STALE:
+                    continue
+                # a cleanly-finished node stops heartbeating but is
+                # not a failure — it left exit/{n} == 0. A CRASHED
+                # node's nonzero exit marker must still count as a
+                # failure (its controller may write the marker on
+                # the way down), or survivors would run forever
+                # against a hung world
+                ex = self.store.get(self._skey("exit", node))
+                if ex is not None and ex.strip() in (b"0", "0"):
+                    continue
+                return node
         except (ConnectionError, OSError):
             return None
         return None
@@ -244,6 +264,7 @@ class Controller:
     def _watch_once(self) -> int:
         last_hb = 0.0
         last_peer_check = time.time()
+        self._watch_start = last_peer_check   # missing-heartbeat grace
         while True:
             now = time.time()
             if now - last_hb > HEARTBEAT_INTERVAL:
@@ -291,13 +312,15 @@ class Controller:
         spec = self.spec
         try:
             if self.store and spec.nnodes > 1:
-                self.store.set(f"exit/{spec.node_rank}", str(code))
+                self.store.set(self._skey("exit", spec.node_rank),
+                               str(code))
                 if self.server is not None:
                     deadline = time.time() + 300
                     while time.time() < deadline:
                         done = sum(
                             1 for n in range(spec.nnodes)
-                            if self.store.get(f"exit/{n}") is not None)
+                            if self.store.get(self._skey("exit", n))
+                            is not None)
                         if done >= spec.nnodes:
                             break
                         time.sleep(0.5)

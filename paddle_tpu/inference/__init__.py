@@ -4,17 +4,19 @@ fused-transformer serving kernels). StableHLO artifacts + XLA AOT compile
 replace the pass pipeline; paged attention + the jitted generate loop
 replace the CUDA decode kernels."""
 from .predictor import Config, Predictor, create_predictor
-from .generation import (GenerationConfig, generate, cached_forward,
-                         init_cache, sample_token)
+from .generation import (GenerationConfig, generate, generate_paged,
+                         cached_forward, init_cache, sample_token)
 from .serving import Request, ServingEngine
+from .prefix_cache import PrefixCache, PagedKVCacheStore
 
 __all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
            "DataType", "PlaceType", "PrecisionType", "PredictorPool",
            "XpuConfig", "get_version", "get_num_bytes_of_data_type",
            "get_trt_compile_version", "get_trt_runtime_version",
            "convert_to_mixed_precision",
-           "generate", "cached_forward", "init_cache", "sample_token",
-           "Request", "ServingEngine"]
+           "generate", "generate_paged", "cached_forward", "init_cache",
+           "sample_token", "Request", "ServingEngine", "PrefixCache",
+           "PagedKVCacheStore"]
 
 
 class DataType:

@@ -345,13 +345,21 @@ def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
 def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
                    gen: Optional[GenerationConfig] = None,
                    block_size: int = 16, seed: int = 0,
-                   cache_dtype=None):
+                   cache_dtype=None, prefix_cache=None):
     """vLLM-style serving loop over a paged KV cache.
 
     ``cache_dtype="int8"``: static per-head cache quantization
     (reference block_attn.h int8 cache mode) — KV pools take half the
     HBM, so the same footprint serves 2x the batch; scales calibrate
     from the prefill KV.
+
+    ``prefix_cache``: opt-in ``PagedKVCacheStore``
+    (inference/prefix_cache.py) whose pools/radix tree persist across
+    calls — each sequence longest-prefix-matches its prompt against
+    previously generated sequences and prefills only the un-cached
+    suffix. bf16/f32 caches only (the per-call int8 recalibration is
+    incompatible with pages that outlive the call, so int8 cleanly opts
+    out here; the ServingEngine's static-scale int8 mode does share).
 
     Prefill runs through the dense-cache path, the dense cache is repacked
     into block pools, then each decode step is one jitted program using
@@ -364,6 +372,10 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     from ..ops.paged_attention import BlockManager
 
     gen = gen or GenerationConfig()
+    if prefix_cache is not None:
+        return _generate_paged_prefix(params, input_ids, cfg, gen,
+                                      block_size, seed, cache_dtype,
+                                      prefix_cache)
     B, S = input_ids.shape
     T = S + gen.max_new_tokens
     if T > cfg.max_position_embeddings:
@@ -447,3 +459,130 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
         left -= n
     toks = jnp.concatenate(chunks, axis=1)
     return jnp.concatenate([input_ids, toks], axis=1)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_prefill_pages(kp, vp, wtable, kc, vc):
+    """Scatter one sequence's dense prefill view back into the pools
+    through its WRITE table. Donation keeps the pools in place — an
+    eager ``.at[].set`` here would materialize two whole-pool copies
+    per sequence per call."""
+    L, _, BS, KV, hd = kp.shape
+    MB = wtable.shape[0]
+    kc = kc.reshape(L, MB, BS, KV, hd).astype(kp.dtype)
+    vc = vc.reshape(L, MB, BS, KV, hd).astype(vp.dtype)
+    return kp.at[:, wtable].set(kc), vp.at[:, wtable].set(vc)
+
+
+def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
+                           seed, cache_dtype, store):
+    """``generate_paged`` over a persistent ``PagedKVCacheStore``.
+
+    Admission longest-prefix-matches each prompt against the store's
+    radix tree (full pages shared in place, partial tail via COW fork)
+    and prefills only the un-cached suffix — one ``cached_forward``
+    over a dense gathered view per sequence, because each sequence has
+    its own start position. The scatter back to the pools goes through
+    a write table whose shared entries are redirected to the scratch
+    page, so shared pages are never written. Decode reuses the cold
+    path's jitted chunk runner unchanged; finished sequences are
+    indexed back into the tree (trimmed at the first EOS) instead of
+    freed."""
+    import numpy as np
+
+    if cache_dtype not in (None, "bfloat16", "float32",
+                           jnp.bfloat16, jnp.float32):
+        raise ValueError(
+            "generate_paged(prefix_cache=...) supports bf16/f32 caches "
+            f"only, got cache_dtype={cache_dtype!r}: the int8 path "
+            "recalibrates per call, which cannot share pages that "
+            "outlive the call (use ServingEngine's static-scale int8)")
+    if int(block_size) != store.block_size:
+        raise ValueError(
+            f"block_size {block_size} != prefix store block_size "
+            f"{store.block_size}")
+    B, S = input_ids.shape
+    T = S + gen.max_new_tokens
+    if T > cfg.max_position_embeddings:
+        raise ValueError(
+            f"prompt+max_new_tokens = {T} exceeds max_position_embeddings "
+            f"= {cfg.max_position_embeddings} (rope table bound)")
+    L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    BS = store.block_size
+    MB = -(-T // BS)
+    mgr, cache = store.mgr, store.cache
+    prompts = np.asarray(input_ids, np.int32)
+
+    seq_ids, matched_ns, shared_ns = [], [], []
+    tables = np.zeros((B, MB), np.int32)
+    for b in range(B):
+        sid = store.next_seq_id
+        store.next_seq_id += 1
+        got = cache.acquire(prompts[b], S - 1, MB)
+        if got is None:
+            for done_sid in seq_ids:
+                mgr.release(done_sid)
+            raise RuntimeError(
+                f"prefix store pool exhausted: batch needs up to "
+                f"{B * MB} pages, store has {store.num_blocks - 1}")
+        pages, matched, shared = got
+        mgr.attach(sid, pages, owned=True)
+        t = mgr.allocate(sid, T)
+        tables[b, :len(t)] = t
+        seq_ids.append(sid)
+        matched_ns.append(matched)
+        shared_ns.append(shared)
+
+    # suffix prefill, one sequence at a time (per-sequence pos0)
+    logits_last = []
+    for b in range(B):
+        tb = jnp.asarray(tables[b], jnp.int32)
+        kc = jnp.take(store.k_pools, tb, axis=1) \
+            .reshape(L, 1, MB * BS, KV, hd)
+        vc = jnp.take(store.v_pools, tb, axis=1) \
+            .reshape(L, 1, MB * BS, KV, hd)
+        M = matched_ns[b]
+        lg, kc, vc = cached_forward(
+            params, jnp.asarray(prompts[b:b + 1, M:]), cfg, kc, vc, M)
+        wt = tables[b].copy()
+        wt[:shared_ns[b]] = 0              # never write a shared page
+        store.k_pools, store.v_pools = _scatter_prefill_pages(
+            store.k_pools, store.v_pools, jnp.asarray(wt, jnp.int32),
+            kc, vc)
+        logits_last.append(lg[:, -1])
+
+    key = _key_for(seed)
+    tok = sample_token(jnp.concatenate(logits_last, axis=0), key, gen)
+    done = tok == gen.eos_token_id
+    chunks = [tok[:, None]]
+    seq_lens = jnp.full((B,), S, jnp.int32)
+    bt = jnp.asarray(tables, jnp.int32)
+    chunk_fn = _paged_chunk_runner(cfg, gen, quant=False)
+    k_pools, v_pools = store.k_pools, store.v_pools
+    chunk = max(1, int(os.environ.get("PADDLE_TPU_DECODE_CHUNK", "32")))
+    left = gen.max_new_tokens - 1
+    while left > 0:
+        n = min(chunk, left)
+        toks, tok, key, done, seq_lens, k_pools, v_pools = chunk_fn(
+            n, params, tok, key, done, k_pools, v_pools, seq_lens, bt,
+            None)
+        chunks.append(toks.transpose(1, 0))
+        left -= n
+    store.k_pools, store.v_pools = k_pools, v_pools
+    out = jnp.concatenate(chunks, axis=1)            # [B, N]
+
+    out_np = np.asarray(out)
+    for b in range(B):
+        # KV is valid for prompt + N-1 generated tokens (the last one's
+        # KV was never written); forced-eos padding after the first EOS
+        # is not meaningful traffic, so the index stops there
+        valid = gen.max_new_tokens - 1
+        if gen.eos_token_id >= 0:
+            hits = np.nonzero(out_np[b] == gen.eos_token_id)[0]
+            if hits.size:
+                valid = min(valid, int(hits[0]) + 1)
+        seq = np.concatenate([prompts[b], out_np[b, :valid]])
+        cache.insert(seq, list(mgr.tables.get(seq_ids[b], ())))
+        mgr.release(seq_ids[b])
+    return jnp.concatenate([jnp.asarray(input_ids), out], axis=1)

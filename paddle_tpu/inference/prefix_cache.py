@@ -1,0 +1,349 @@
+"""Radix prefix cache: copy-on-write KV page sharing across requests.
+
+Real serving traffic (system prompts, few-shot templates, multi-turn
+chat) repeats long token prefixes, yet the continuous-batching engine
+prefilled every request from scratch. This module turns the paged
+``BlockManager`` from a per-request allocator into a CROSS-REQUEST
+cache: a radix tree indexes token-id prefixes at PAGE granularity, and
+each tree node owns one ref-counted physical page in the existing KV
+pools.
+
+Design (the TPU analog of vLLM's automatic prefix caching / SGLang's
+RadixAttention, applied to the pools of ``ops.paged_attention``):
+
+- FULL pages (``block_size`` tokens) are shared IN PLACE: a longest-
+  prefix match at admission appends the matched physical pages directly
+  to the request's block table (incref), and the request prefills only
+  its un-cached suffix. Because matching full pages is page-aligned and
+  capped at ``len(prompt) - 1`` tokens, every position a request ever
+  writes (suffix prefill + decode appends) lands in a page it owns.
+- The PARTIALLY-FILLED TAIL page of a cached sequence is never shared
+  in place: it is handed out only as a COPY-ON-WRITE fork (fresh page +
+  device copy), so a divergent continuation writes its own copy and can
+  never corrupt the cached original.
+- KV pages are position-causal (the KV at position i depends only on
+  tokens <= i), so any PREFIX of a cached page's valid tokens is also
+  valid — a tail node with j tokens serves any request matching the
+  first c <= j of them.
+- EVICTION is LRU over refcount-1 leaves (tree-only pages; a page
+  shared with any live request has refcount >= 2 and is pinned),
+  cascading upward as parents become leaves. It runs on demand through
+  ``BlockManager.reclaim`` when the free list is dry, so a full pool
+  degrades to per-request allocation instead of failing admission.
+
+The cache is pure host-side bookkeeping: the only device work it ever
+issues is the one-page COW copy (a single jitted program, traced once).
+Decode and prefill programs are unchanged in shape and count — cache
+hits cause zero retraces.
+"""
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.paged_attention import BlockManager
+
+__all__ = ["PrefixCache", "PagedKVCacheStore"]
+
+
+class _Node:
+    """One radix-tree node owning ONE physical KV page.
+
+    ``tokens`` (a tuple of 1..block_size ids) are the tokens whose KV
+    the page holds. A node with ``len(tokens) == block_size`` is a full
+    page: shareable in place and extendable with children. A shorter
+    node is a partial tail: leaf-only, handed out via COW fork, and
+    upgradeable in place when a later insert extends it."""
+
+    __slots__ = ("tokens", "page", "children", "parent", "last_used")
+
+    def __init__(self, tokens: Tuple[int, ...], page: Optional[int],
+                 parent: Optional["_Node"]):
+        self.tokens = tokens
+        self.page = page
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.parent = parent
+        self.last_used = 0
+
+
+def _common(a: Sequence[int], b: Sequence[int]) -> int:
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
+
+
+class PrefixCache:
+    """Radix index over one ``BlockManager``'s pages.
+
+    ``copy_page(src, dst)`` is supplied by the pool owner (ServingEngine
+    or PagedKVCacheStore) and device-copies one physical page — the COW
+    primitive. The cache installs itself as the manager's ``reclaim``
+    callback so allocation pressure drives eviction."""
+
+    def __init__(self, mgr: BlockManager, block_size: int,
+                 copy_page: Callable[[int, int], None]):
+        self.mgr = mgr
+        self.bs = int(block_size)
+        self.copy_page = copy_page
+        self.root = _Node((), None, None)
+        self._tick = 0
+        self.stats = {"hits": 0, "misses": 0, "tokens_skipped": 0,
+                      "shared_pages": 0, "cow_forks": 0,
+                      "evicted_pages": 0, "inserted_pages": 0}
+        mgr.reclaim = self.evict
+
+    # -- introspection ------------------------------------------------
+    def _walk(self):
+        stack = list(self.root.children.values())
+        while stack:
+            n = stack.pop()
+            yield n
+            stack.extend(n.children.values())
+
+    @property
+    def cached_pages(self) -> int:
+        return sum(1 for _ in self._walk())
+
+    def evictable_count(self) -> int:
+        """Pages reclaimable right now: nodes whose whole subtree is
+        unpinned (refcount 1, i.e. tree-only — eviction is leaf-first,
+        so a pinned descendant blocks its ancestors)."""
+        def walk(n: _Node) -> Tuple[int, bool]:
+            cnt, free_sub = 0, True
+            for ch in n.children.values():
+                c, f = walk(ch)
+                cnt += c
+                free_sub = free_sub and f
+            if n is self.root:
+                return cnt, False
+            if free_sub and int(self.mgr.refcount[n.page]) == 1:
+                return cnt + 1, True
+            return cnt, False
+        return walk(self.root)[0]
+
+    def metrics(self) -> Dict:
+        m = dict(self.stats)
+        m["cached_pages"] = self.cached_pages
+        m["evictable_pages"] = self.evictable_count()
+        return m
+
+    # -- lookup -------------------------------------------------------
+    def _touch(self, node: Optional[_Node]):
+        self._tick += 1
+        while node is not None and node is not self.root:
+            node.last_used = self._tick
+            node = node.parent
+
+    def match(self, tokens: Sequence[int]
+              ) -> Tuple[List[_Node], Optional[_Node], int]:
+        """Longest cached prefix of ``tokens``: (full_nodes, tail_node,
+        tail_len). ``full_nodes`` are whole-page in-place matches;
+        ``tail_len`` leading tokens of ``tail_node`` are additionally
+        usable through a COW fork. Read-only (no refcount changes)."""
+        toks = [int(t) for t in tokens]
+        node, pos, full = self.root, 0, []
+        while pos < len(toks):
+            rem = toks[pos:pos + self.bs]
+            best, best_c = None, 0
+            for ch in node.children.values():
+                c = _common(ch.tokens, rem)
+                if c > best_c:
+                    best, best_c = ch, c
+            if best is None or best_c == 0:
+                break
+            if best_c == self.bs:          # whole page matched in place
+                full.append(best)
+                node = best
+                pos += self.bs
+                continue
+            return full, best, best_c      # partial: COW-fork territory
+        return full, None, 0
+
+    # -- admission ----------------------------------------------------
+    def acquire(self, tokens: Sequence[int], limit: int,
+                total_pages: int):
+        """Admission-side lookup with backpressure: match at most
+        ``limit`` tokens (callers pass ``len(prompt) - 1`` so at least
+        one suffix token always prefills and produces logits), pin the
+        matched full pages, and check that free + evictable pages cover
+        the request's remaining ``total_pages`` need. Returns ``None``
+        (wait; nothing mutated) when they do not, else
+        ``(pages, matched_tokens, n_shared)`` where every returned page
+        carries exactly one reference owned by the caller — full pages
+        a fresh share, the COW fork its allocation."""
+        toks = [int(t) for t in tokens][:max(int(limit), 0)]
+        full, tail, tail_len = self.match(toks)
+        will_fork = tail is not None and tail_len > 0
+        # pin the whole matched path — including the fork SOURCE —
+        # before counting evictables, so the backpressure check can
+        # never count a page the allocation below will find pinned
+        # (that mismatch would crash allocation instead of waiting)
+        for nd in full:
+            self.mgr.incref(nd.page)
+        if will_fork:
+            self.mgr.incref(tail.page)
+        needed = total_pages - len(full)   # fork + fresh suffix pages
+        if len(self.mgr.free) < needed and \
+                len(self.mgr.free) + self.evictable_count() < needed:
+            if will_fork:
+                self.mgr.decref(tail.page)
+            for nd in full:
+                self.mgr.decref(nd.page)
+            return None
+        pages = [nd.page for nd in full]
+        matched = len(full) * self.bs
+        if will_fork:
+            dst = self.mgr.fork(tail.page)   # src ALSO pinned above, so
+            self.copy_page(tail.page, dst)   # the pin spans the copy
+            self.mgr.decref(tail.page)       # drop the outer pin
+            pages.append(dst)
+            matched += tail_len
+            self.stats["cow_forks"] += 1
+            self._touch(tail)
+        elif full:
+            self._touch(full[-1])
+        self.stats["hits" if matched else "misses"] += 1
+        self.stats["tokens_skipped"] += matched
+        self.stats["shared_pages"] += len(full)
+        return pages, matched, len(full)
+
+    # -- insertion ----------------------------------------------------
+    def insert(self, tokens: Sequence[int], pages: Sequence[int]):
+        """Index a finished sequence's pages under its token ids
+        (``tokens`` must cover exactly the positions with valid KV).
+        Walks the tree page by page: already-cached pages are left for
+        the caller's ``release`` to drop, novel pages are adopted
+        (incref — they survive the release), and a partial tail node is
+        upgraded in place when the new page extends its tokens."""
+        toks = [int(t) for t in tokens]
+        node = self.root
+        for i in range(0, len(toks), self.bs):
+            pi = i // self.bs
+            pt = tuple(toks[i:i + self.bs])
+            if pi >= len(pages) or not pt:
+                break
+            page = pages[pi]
+            best, best_c = None, 0
+            for ch in node.children.values():
+                c = _common(ch.tokens, pt)
+                if c > best_c:
+                    best, best_c = ch, c
+            if best is not None and best_c == len(best.tokens) == len(pt):
+                node = best                  # exact: already cached
+                self._touch(node)
+                continue
+            if best is not None and best_c == len(best.tokens) < len(pt):
+                # ours extends a partial tail: upgrade its page in place
+                # (partial nodes are COW-only => refcount 1, no children)
+                old = best.page
+                self.mgr.incref(page)
+                best.tokens = pt
+                best.page = page
+                self.mgr.decref(old)
+                self.stats["inserted_pages"] += 1
+                node = best
+                self._touch(node)
+                continue
+            if best is not None and best_c == len(pt) <= len(best.tokens):
+                self._touch(best)            # cached covers ours: drop
+                break                        # (< bs tokens => last page)
+            # novel or divergent-within-page: adopt as a sibling node
+            self.mgr.incref(page)
+            ch = _Node(pt, page, node)
+            node.children[pt] = ch
+            self.stats["inserted_pages"] += 1
+            node = ch
+            self._touch(node)
+
+    # -- eviction -----------------------------------------------------
+    def evict(self, n_pages: int) -> int:
+        """LRU-evict up to ``n_pages`` refcount-1 leaf pages, cascading
+        to parents as they become childless. Pages shared with a live
+        request (refcount >= 2) are never touched. Installed as the
+        BlockManager's ``reclaim`` hook."""
+        heap = [(nd.last_used, id(nd), nd) for nd in self._walk()
+                if not nd.children
+                and int(self.mgr.refcount[nd.page]) == 1]
+        heapq.heapify(heap)
+        freed = 0
+        while heap and freed < n_pages:
+            _, _, nd = heapq.heappop(heap)
+            if nd.children or nd.parent is None:
+                continue                      # stale heap entry
+            if int(self.mgr.refcount[nd.page]) != 1:
+                continue                      # pinned since collection
+            parent = nd.parent
+            del parent.children[nd.tokens]
+            nd.parent = None
+            self.mgr.decref(nd.page)          # 1 -> 0: back to the pool
+            freed += 1
+            self.stats["evicted_pages"] += 1
+            if (parent is not self.root and not parent.children
+                    and int(self.mgr.refcount[parent.page]) == 1):
+                heapq.heappush(
+                    heap, (parent.last_used, id(parent), parent))
+        return freed
+
+
+def make_page_copier():
+    """One jitted program copying physical page ``src`` -> ``dst`` in
+    both pools ([L, N, BS, KV, hd]); donation keeps it in place. Pass
+    src/dst as traced int32 scalars so distinct pages share the trace."""
+    import jax
+
+    def cp(kp, vp, src, dst):
+        return (kp.at[:, dst].set(kp[:, src]),
+                vp.at[:, dst].set(vp[:, src]))
+    return jax.jit(cp, donate_argnums=(0, 1))
+
+
+class PagedKVCacheStore:
+    """Persistent pools + BlockManager + PrefixCache backing
+    ``generate_paged(prefix_cache=...)``.
+
+    ``generate_paged`` normally builds fresh pools per call, so nothing
+    can be reused across calls; this store owns the pools instead and
+    survives between calls, letting a later call skip prefill for any
+    prompt prefix a previous call already computed. bf16/f32 only: the
+    int8 path re-quantizes whole pools with per-call scales, which is
+    incompatible with pages that outlive the call (the ServingEngine's
+    int8 mode, with its engine-global static scales, does participate).
+    """
+
+    _SCRATCH_SEQ = -1
+
+    def __init__(self, cfg, block_size: int = 16, num_blocks: int = 256,
+                 dtype=None):
+        import jax.numpy as jnp
+        self.cfg = cfg
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                     cfg.head_dim)
+        shape = (L, self.num_blocks, self.block_size, KV, hd)
+        self.k_pools = jnp.zeros(shape, dtype or cfg.dtype)
+        self.v_pools = jnp.zeros(shape, dtype or cfg.dtype)
+        self.mgr = BlockManager(self.num_blocks, self.block_size,
+                                self.num_blocks)
+        # page 0 is scratch: padded block-table entries default there
+        scratch = self.mgr.allocate(self._SCRATCH_SEQ, 1)
+        assert scratch == [0], "scratch must be page 0"
+        self._copy_fn = make_page_copier()
+        self.cache = PrefixCache(self.mgr, self.block_size,
+                                 copy_page=self._copy_page)
+        self.next_seq_id = 0
+
+    def _copy_page(self, src: int, dst: int):
+        import jax.numpy as jnp
+        self.k_pools, self.v_pools = self._copy_fn(
+            self.k_pools, self.v_pools, jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32))
+
+    def metrics(self) -> Dict:
+        m = self.cache.metrics()
+        m["free_pages"] = len(self.mgr.free)
+        return m

@@ -28,6 +28,18 @@ TPU analog of the reference's AnalysisPredictor serving loop around
   dequants pages into the chunk's dense view and requantizes on the way
   out (idempotent for untouched positions, same scale), decode runs the
   quantized gather path.
+- RADIX PREFIX CACHE (``prefix_cache=True``): finished requests return
+  their KV pages to a radix tree (inference/prefix_cache.py) instead of
+  freeing them; admission longest-prefix-matches the prompt so a warm
+  request appends the shared pages to its block table and prefills only
+  its un-cached suffix. Prefill programs take a separate WRITE table
+  whose shared-prefix entries are redirected to the scratch page, so a
+  shared page is never written by construction; the partially-filled
+  tail page is handed out only as a copy-on-write fork. The tree evicts
+  LRU refcount-1 pages on allocator pressure. Programs keep the exact
+  shapes of the cold path: cache hits cause zero retraces, and because
+  the engine's int8 scales are engine-global and static, the int8 cache
+  participates in sharing unchanged.
 
 Host/device split: the decode carry (tokens, seq_lens, key, pools)
 stays device-resident between steps; host mirrors are re-uploaded only
@@ -108,7 +120,8 @@ class ServingEngine:
     def __init__(self, params: Dict, cfg, capacity: int = 4,
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  max_seq_len: Optional[int] = None, cache_dtype=None,
-                 prefill_buckets=(32, 128), seed: int = 0):
+                 prefill_buckets=(32, 128), seed: int = 0,
+                 prefix_cache: bool = False):
         self.params = params
         self.cfg = cfg
         self.capacity = int(capacity)
@@ -155,12 +168,24 @@ class ServingEngine:
         scratch = self.mgr.allocate(_SCRATCH_SEQ, 1)
         assert scratch == [0], "scratch must be page 0 (tables pad with 0)"
 
+        self._pcache = None
+        if prefix_cache:
+            from .prefix_cache import PrefixCache, make_page_copier
+            self._copy_fn = make_page_copier()
+            self._pcache = PrefixCache(self.mgr, BS,
+                                       copy_page=self._copy_page)
+
         C, MB = self.capacity, self.max_blocks
         self._slots = [_Slot() for _ in range(C)]
         self._queue: Deque[Request] = collections.deque()
         self._requests: List[Request] = []
         self._next_id = 0
         self._slot_tables = np.zeros((C, MB), np.int32)  # true tables
+        # prefill WRITE tables: identical to the true tables except that
+        # shared-prefix entries point at scratch page 0 — the prefill
+        # scatter can then never write a page another request (or the
+        # tree) reads, whatever the chunk computes
+        self._slot_wtables = np.zeros((C, MB), np.int32)
         # decode-program inputs (host mirrors). Mid-prefill slots keep
         # table 0 / seq 0 here: their decode write must hit scratch, not
         # their half-written prompt pages.
@@ -190,6 +215,14 @@ class ServingEngine:
         }
         self._t_first = None
         self._t_last = None
+
+    def _copy_page(self, src: int, dst: int):
+        """COW primitive for the prefix cache: device-copy one physical
+        page in both pools (one jitted program, traced once — src/dst
+        ride as int32 scalars)."""
+        self._k_pools, self._v_pools = self._copy_fn(
+            self._k_pools, self._v_pools, jnp.asarray(src, jnp.int32),
+            jnp.asarray(dst, jnp.int32))
 
     # -- public API ---------------------------------------------------
     def submit(self, prompt, gen: Optional[GenerationConfig] = None
@@ -273,6 +306,8 @@ class ServingEngine:
         c["slot_utilization"] = (
             round(c["live_slot_steps"] / (steps * self.capacity), 4)
             if steps else 0.0)
+        if self._pcache is not None:
+            c["prefix_cache"] = self._pcache.metrics()
         return c
 
     def reset_metrics(self):
@@ -282,6 +317,12 @@ class ServingEngine:
                   "tokens_generated", "requests_submitted",
                   "requests_completed"):
             self.counters[k] = 0
+        if self._pcache is not None:
+            # workload counters like the above (the cached PAGES stay —
+            # only the counts restart, so a warmed-up bench window
+            # reports its own hits/skips, not the warmup's)
+            for k in self._pcache.stats:
+                self._pcache.stats[k] = 0
         self._t_first = self._t_last = None
         self._requests = [r for r in self._requests if not r.done]
 
@@ -303,21 +344,41 @@ class ServingEngine:
             req = self._queue[0]
             total = req.prompt.size + req.gen.max_new_tokens
             need = -(-total // self.block_size)
-            if len(self.mgr.free) < need:
-                break          # FIFO backpressure: wait for pages
+            acquired = None
+            if self._pcache is None:
+                if len(self.mgr.free) < need:
+                    break      # FIFO backpressure: wait for pages
+            else:
+                # longest-prefix match, capped at S-1 so the request
+                # always prefills >= 1 token (the logits source for its
+                # first sampled token). acquire() pins the matched
+                # pages and owns the backpressure check — free plus
+                # evictable must cover the un-matched remainder.
+                acquired = self._pcache.acquire(
+                    req.prompt, int(req.prompt.size) - 1, need)
+                if acquired is None:
+                    break      # FIFO backpressure: wait for pages
             self._queue.popleft()
             if self._quant and self._kv_scales is None:
                 # static scales calibrate from the first admitted prompt
                 # BEFORE any prefill/decode program exists, so the
                 # programs close over the final scale arrays
                 self._calibrate(req.prompt)
+            matched = shared = 0
+            if acquired is not None:
+                pages, matched, shared = acquired
+                # matched pages join the block table directly; their
+                # references transfer to this request's table entries
+                self.mgr.attach(req.req_id, pages, owned=True)
             table = self.mgr.allocate(req.req_id, total)
             slot.req = req
             slot.phase = "prefill"
             slot.seq_len = 0
-            slot.prefill_pos = 0
+            slot.prefill_pos = matched     # prefill only the suffix
             self._slot_tables[slot_id] = 0
             self._slot_tables[slot_id, :len(table)] = table
+            self._slot_wtables[slot_id] = self._slot_tables[slot_id]
+            self._slot_wtables[slot_id, :shared] = 0
 
     def _run_prefill(self) -> bool:
         for slot_id, slot in enumerate(self._slots):
@@ -339,6 +400,7 @@ class ServingEngine:
             tok, self._d_key, self._k_pools, self._v_pools = fn(
                 self.params, jnp.asarray(toks), jnp.asarray(pos0),
                 jnp.asarray(self._slot_tables[slot_id].copy()),
+                jnp.asarray(self._slot_wtables[slot_id].copy()),
                 jnp.asarray(n - 1),
                 jnp.asarray(self._temp_of(req.gen), jnp.float32),
                 self._d_key, self._k_pools, self._v_pools)
@@ -350,6 +412,17 @@ class ServingEngine:
                 req.tokens.append(first)
                 self.counters["tokens_generated"] += 1
                 slot.seq_len = S
+                if self._pcache is not None:
+                    # the prompt's KV is fully valid NOW — index it so
+                    # concurrent requests sharing the prefix hit while
+                    # this one is still decoding. Decode appends at
+                    # positions >= S, beyond every position the tree
+                    # claims of these pages, so sharing them live is
+                    # safe; _finish later extends the index with the
+                    # generated tokens.
+                    self._pcache.insert(
+                        req.prompt,
+                        list(self.mgr.tables.get(req.req_id, ())))
                 if (first == req.gen.eos_token_id
                         or req.gen.max_new_tokens <= 1):
                     self._finish(slot_id)
@@ -402,12 +475,23 @@ class ServingEngine:
         req = slot.req
         req.done = True
         req.finish_t = time.perf_counter()
+        if self._pcache is not None and slot.seq_len > 0:
+            # hand the pages to the radix tree instead of freeing them.
+            # Valid KV covers prompt + all generated tokens except the
+            # last sampled one (its KV was never written): that is
+            # exactly slot.seq_len positions.
+            gen_n = slot.seq_len - req.prompt.size
+            seq = np.concatenate(
+                [req.prompt, np.asarray(req.tokens[:gen_n], np.int32)])
+            self._pcache.insert(
+                seq, list(self.mgr.tables.get(req.req_id, ())))
         self.mgr.release(req.req_id)
         slot.req = None
         slot.phase = "idle"
         slot.seq_len = 0
         slot.prefill_pos = 0
         self._slot_tables[slot_id] = 0
+        self._slot_wtables[slot_id] = 0
         self._h_tok[slot_id] = 0
         self._h_seq[slot_id] = 0
         self._h_tables[slot_id] = 0
@@ -443,7 +527,7 @@ class ServingEngine:
         scales = self._kv_scales
         counters["prefill_traces"].setdefault(P, 0)
 
-        def chunk(params, toks, pos0, table, last_idx, temp, key,
+        def chunk(params, toks, pos0, table, wtable, last_idx, temp, key,
                   k_pools, v_pools):
             counters["prefill_traces"][P] += 1
             # this request's pages as a dense [L, 1, T, KV, hd] cache:
@@ -461,9 +545,13 @@ class ServingEngine:
             if scales is not None:
                 kc = quant_cache(kc, scales[0])
                 vc = quant_cache(vc, scales[1])
-            k_pools = k_pools.at[:, table].set(
+            # the scatter goes through the WRITE table: entries backed
+            # by shared prefix-cache pages are redirected to scratch
+            # page 0 there, so the chunk cannot corrupt a shared page
+            # (without a prefix cache wtable == table)
+            k_pools = k_pools.at[:, wtable].set(
                 kc.reshape(L, MB, BS, KV, hd).astype(k_pools.dtype))
-            v_pools = v_pools.at[:, table].set(
+            v_pools = v_pools.at[:, wtable].set(
                 vc.reshape(L, MB, BS, KV, hd).astype(v_pools.dtype))
             # sample the request's FIRST token from the last valid
             # position (only meaningful on the final chunk)
@@ -473,7 +561,7 @@ class ServingEngine:
             tok = _sample_slots(lg, sub, temp[None])[0]
             return tok, key, k_pools, v_pools
 
-        return jax.jit(chunk, donate_argnums=(7, 8))
+        return jax.jit(chunk, donate_argnums=(8, 9))
 
     def _calibrate(self, prompt: np.ndarray):
         cfg, counters = self.cfg, self.counters

@@ -194,7 +194,17 @@ def paged_attention_decode_quant(q, k_pool, v_pool, block_tables,
 class BlockManager:
     """Host-side physical block allocator (reference: the block-table
     bookkeeping AnalysisPredictor does around block_multihead_attention).
-    Not jitted — runs in the serving loop between steps."""
+    Not jitted — runs in the serving loop between steps.
+
+    Pages are REF-COUNTED so one physical page can back multiple block
+    tables (the radix prefix cache shares prompt-prefix pages across
+    requests, inference/prefix_cache.py): ``allocate`` hands out pages
+    at refcount 1, ``attach`` appends already-populated shared pages to
+    a table (incref), ``release`` decrefs every table entry and a page
+    returns to the free list only when its count hits 0. When the free
+    list runs dry, the ``reclaim`` callback (the prefix cache's LRU
+    eviction) gets one chance to free cold cached pages before the
+    allocator gives up."""
 
     def __init__(self, num_blocks: int, block_size: int,
                  max_blocks_per_seq: int):
@@ -203,14 +213,74 @@ class BlockManager:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.free = list(range(num_blocks - 1, -1, -1))
         self.tables = {}            # seq_id -> list of physical block ids
+        self.refcount = np.zeros(num_blocks, np.int32)
+        self.reclaim = None         # callback(n_pages) -> pages freed
+
+    def alloc_page(self) -> int:
+        """Pop one free page at refcount 1 (sole owner: the caller)."""
+        if not self.free and self.reclaim is not None:
+            self.reclaim(1)
+        if not self.free:
+            raise RuntimeError("KV cache pool exhausted")
+        p = self.free.pop()
+        if self.refcount[p] != 0:
+            raise RuntimeError(
+                f"free list corrupt: page {p} has refcount "
+                f"{int(self.refcount[p])}")
+        self.refcount[p] = 1
+        return p
+
+    def incref(self, page: int):
+        if self.refcount[page] <= 0:
+            raise RuntimeError(
+                f"incref on unowned page {page}: sharing a freed page "
+                "would alias live KV data")
+        self.refcount[page] += 1
+
+    def decref(self, page: int) -> bool:
+        """Drop one reference; returns True when the page was freed.
+        Going below zero is a bookkeeping bug, never silently allowed —
+        it means a page was double-released while possibly shared."""
+        rc = int(self.refcount[page]) - 1
+        if rc < 0:
+            raise RuntimeError(f"refcount of page {page} went negative")
+        self.refcount[page] = rc
+        if rc == 0:
+            self.free.append(page)
+            return True
+        return False
+
+    def fork(self, src_page: int) -> int:
+        """Copy-on-write allocation: a fresh page destined to receive a
+        copy of ``src_page`` (the owner of the pools performs the device
+        copy). The source is pinned for the duration so the reclaim
+        callback cannot evict it while the fork is in flight."""
+        self.incref(src_page)
+        try:
+            return self.alloc_page()
+        finally:
+            self.decref(src_page)
+
+    def attach(self, seq_id: int, pages, owned: bool = False):
+        """Append already-populated pages (a matched shared prefix, or
+        a COW fork whose reference is transferred) to a sequence's
+        table. Must run before ``allocate`` fills the suffix."""
+        table = self.tables.setdefault(seq_id, [])
+        for p in pages:
+            if not owned:
+                self.incref(p)
+            table.append(p)
+        return table
 
     def allocate(self, seq_id: int, num_tokens: int):
         need = (num_tokens + self.block_size - 1) // self.block_size
         table = self.tables.setdefault(seq_id, [])
+        shortfall = (need - len(table)) - len(self.free)
+        if shortfall > 0 and self.reclaim is not None:
+            # one batched eviction pass instead of a tree walk per page
+            self.reclaim(shortfall)
         while len(table) < need:
-            if not self.free:
-                raise RuntimeError("KV cache pool exhausted")
-            table.append(self.free.pop())
+            table.append(self.alloc_page())
         return table
 
     def append_token(self, seq_id: int, cur_len: int):
@@ -219,7 +289,7 @@ class BlockManager:
 
     def release(self, seq_id: int):
         for b in self.tables.pop(seq_id, []):
-            self.free.append(b)
+            self.decref(b)
 
     def table_array(self, seq_ids) -> np.ndarray:
         out = np.zeros((len(seq_ids), self.max_blocks_per_seq), np.int32)

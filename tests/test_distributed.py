@@ -314,3 +314,126 @@ class TestCheckpointStreaming:
         np.testing.assert_allclose(w2.numpy(), w.numpy())
         assert calls["full"] == 0, "full-array assembly used for sharded target"
         assert calls["slice"] >= 1
+
+
+class TestUtilBaseAllReduceIntegerExactness:
+    """ADVICE r5: UtilBase.all_reduce round-tripped every reduction
+    through float32, so integer counts > 2^24 silently lost exactness.
+    Integer inputs must ride an integer collective path."""
+
+    def _patched(self, monkeypatch, world=2):
+        import paddle_tpu.distributed.env as env
+        import paddle_tpu.distributed.collective as C
+        monkeypatch.setattr(env, "get_world_size", lambda group=None: world)
+        seen = {}
+
+        def fake_all_reduce(t, op=C.ReduceOp.SUM, group=None,
+                            sync_op=True):
+            # simulate a 2-rank SUM of identical contributions; record
+            # the dtype that actually crossed the collective
+            seen["dtype"] = np.asarray(t._value).dtype
+            if op == C.ReduceOp.SUM:
+                t._value = t._value * world
+            return t
+        monkeypatch.setattr(C, "all_reduce", fake_all_reduce)
+        return seen
+
+    def test_large_int_count_stays_exact(self, monkeypatch):
+        from paddle_tpu.distributed.fleet.ps_compat import UtilBase
+        seen = self._patched(monkeypatch)
+        big = np.array([2**24 + 1], np.int64)   # not f32-representable
+        out = UtilBase().all_reduce(big, mode="sum")
+        assert seen["dtype"].kind in "iu", seen
+        assert out.dtype.kind in "iu"
+        np.testing.assert_array_equal(out, np.array([2 * (2**24 + 1)]))
+
+    def test_int32_sum_widens_instead_of_wrapping(self, monkeypatch):
+        # per-rank counts that fit int32 must not wrap in the
+        # cross-rank sum: the collective runs in int64
+        from paddle_tpu.distributed.fleet.ps_compat import UtilBase
+        seen = self._patched(monkeypatch)
+        out = UtilBase().all_reduce(np.array([1_500_000_000], np.int32),
+                                    mode="sum")
+        assert seen["dtype"] == np.int64
+        np.testing.assert_array_equal(out, np.array([3_000_000_000]))
+        assert out.dtype == np.int64            # too big to narrow back
+
+    def test_unsigned_rides_unsigned(self, monkeypatch):
+        # uint inputs widen to uint64, not int64 (2^63 would wrap)
+        from paddle_tpu.distributed.fleet.ps_compat import UtilBase
+        seen = self._patched(monkeypatch)
+        out = UtilBase().all_reduce(
+            np.array([2_000_000_000], np.uint32), mode="sum")
+        assert seen["dtype"] == np.uint64
+        np.testing.assert_array_equal(out, np.array([4_000_000_000]))
+
+    def test_float_path_unchanged(self, monkeypatch):
+        from paddle_tpu.distributed.fleet.ps_compat import UtilBase
+        seen = self._patched(monkeypatch)
+        out = UtilBase().all_reduce(np.array([1.5], np.float64),
+                                    mode="sum")
+        assert seen["dtype"] == np.float32
+        np.testing.assert_allclose(out, [3.0])
+
+    def test_single_process_passthrough_preserves_dtype(self):
+        from paddle_tpu.distributed.fleet.ps_compat import UtilBase
+        big = np.array([2**53 + 1], np.int64)
+        out = UtilBase().all_reduce(big, mode="sum")
+        np.testing.assert_array_equal(out, big)
+        assert out.dtype == np.int64
+
+
+class TestControllerEpochNamespacedLiveness:
+    """ADVICE r5: exit/heartbeat markers persisted across elastic
+    re-ranks, so a stale ``exit/N == 0`` from a prior incarnation could
+    mask a genuinely dead node after ranks were re-assigned. Liveness
+    keys are now namespaced by the coordination epoch."""
+
+    class _FakeStore:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = str(v)
+
+        def get(self, k):
+            return self.d.get(k)
+
+    def _controller(self, epoch):
+        import time
+        from paddle_tpu.distributed.launch.controller import (Controller,
+                                                              JobSpec)
+        c = Controller(JobSpec(script="x", nnodes=2, node_rank=0))
+        c.store = self._FakeStore()
+        c._coord_epoch = epoch
+        return c, time.time()
+
+    def test_stale_exit_from_prior_epoch_does_not_mask_failure(self):
+        c, now = self._controller(epoch=5)
+        c.store.set("heartbeat/5/1", str(now - 1000))   # stale peer
+        c.store.set("exit/0/1", "0")     # clean exit of a PRIOR epoch
+        assert c._peer_failure() == 1    # still a failure now
+
+    def test_current_epoch_clean_exit_not_a_failure(self):
+        c, now = self._controller(epoch=5)
+        c.store.set("heartbeat/5/1", str(now - 1000))
+        c.store.set("exit/5/1", "0")     # clean exit, THIS incarnation
+        assert c._peer_failure() is None
+
+    def test_heartbeat_written_under_epoch_key(self):
+        c, _ = self._controller(epoch=7)
+        c._heartbeat()
+        assert "heartbeat/7/0" in c.store.d
+
+    def test_dead_before_first_heartbeat_detected_after_grace(self):
+        # a peer that dies before its first beat of a NEW epoch leaves
+        # no key under that epoch; after the grace window it must still
+        # count as failed (its old-epoch keys are ignored by design)
+        c, now = self._controller(epoch=5)
+        c._watch_start = now - 1000
+        assert c._peer_failure() == 1
+
+    def test_missing_heartbeat_within_grace_tolerated(self):
+        c, now = self._controller(epoch=5)
+        c._watch_start = now
+        assert c._peer_failure() is None

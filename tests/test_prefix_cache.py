@@ -1,0 +1,383 @@
+"""Radix prefix cache (inference/prefix_cache.py): refcount/eviction/
+COW invariants over the BlockManager, suffix-only prefill on a warm
+cache, exact greedy parity with the cold path, and the persistent
+``generate_paged(prefix_cache=...)`` store."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama
+from paddle_tpu.inference import (GenerationConfig, PagedKVCacheStore,
+                                  ServingEngine, generate)
+from paddle_tpu.inference.generation import generate_paged
+from paddle_tpu.inference.prefix_cache import PrefixCache
+from paddle_tpu.ops.paged_attention import BlockManager
+
+CFG = llama.LlamaConfig(vocab_size=97, hidden_size=64,
+                        intermediate_size=128, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        max_position_embeddings=128, dtype=jnp.float32,
+                        remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def _engine(params, **kw):
+    kw.setdefault("capacity", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("prefix_cache", True)
+    return ServingEngine(params, CFG, **kw)
+
+
+def _want(params, p, g):
+    return np.asarray(generate(params, jnp.asarray(p)[None], CFG,
+                               g))[0, len(p):].tolist()
+
+
+# -- BlockManager refcount invariants ---------------------------------
+
+class TestRefcounts:
+    def test_refcount_never_negative(self):
+        mgr = BlockManager(4, 4, 4)
+        p = mgr.alloc_page()
+        assert mgr.refcount[p] == 1
+        assert mgr.decref(p) is True          # 1 -> 0: freed
+        with pytest.raises(RuntimeError, match="negative"):
+            mgr.decref(p)
+
+    def test_incref_on_free_page_rejected(self):
+        mgr = BlockManager(4, 4, 4)
+        p = mgr.alloc_page()
+        mgr.decref(p)
+        with pytest.raises(RuntimeError, match="unowned"):
+            mgr.incref(p)
+
+    def test_shared_page_survives_one_release(self):
+        mgr = BlockManager(8, 4, 8)
+        t1 = mgr.allocate(1, 8)               # two pages, rc 1 each
+        mgr.attach(2, t1)                     # seq 2 shares both (rc 2)
+        mgr.allocate(2, 8)
+        free_before = len(mgr.free)
+        mgr.release(1)
+        assert all(mgr.refcount[p] == 1 for p in mgr.tables[2])
+        assert len(mgr.free) == free_before   # shared pages survived
+        mgr.release(2)
+        assert len(mgr.free) == 8
+        assert (mgr.refcount == 0).all()
+
+    def test_fork_allocates_fresh_page(self):
+        mgr = BlockManager(4, 4, 4)
+        src = mgr.alloc_page()
+        dst = mgr.fork(src)
+        assert dst != src
+        assert mgr.refcount[src] == 1         # pin dropped after fork
+        assert mgr.refcount[dst] == 1
+
+
+# -- tree-level invariants (no model needed) --------------------------
+
+def _tree(num_blocks=32, bs=4):
+    mgr = BlockManager(num_blocks, bs, num_blocks)
+    copies = []
+    cache = PrefixCache(mgr, bs, copy_page=lambda s, d:
+                        copies.append((s, d)))
+    return mgr, cache, copies
+
+
+def _insert_released(mgr, cache, toks, pages):
+    """Insert as a finished request would: the tree adopts the pages,
+    then the request's own references are dropped — cached pages end at
+    refcount 1 (tree-only)."""
+    cache.insert(toks, pages)
+    for p in pages:
+        mgr.decref(p)
+
+
+class TestRadixTree:
+    def test_insert_match_full_and_tail(self):
+        mgr, cache, copies = _tree()
+        toks = list(range(10))                 # 2 full pages + 2-tail
+        pages = [mgr.alloc_page() for _ in range(3)]
+        _insert_released(mgr, cache, toks, pages)
+        assert cache.cached_pages == 3
+        full, tail, c = cache.match(toks)
+        assert [n.page for n in full] == pages[:2]
+        assert tail is not None and tail.page == pages[2] and c == 2
+
+    def test_acquire_cow_forks_tail_before_any_write(self):
+        mgr, cache, copies = _tree()
+        toks = list(range(10))
+        pages = [mgr.alloc_page() for _ in range(3)]
+        _insert_released(mgr, cache, toks, pages)
+        got = cache.acquire(toks + [50, 51], limit=11, total_pages=4)
+        acq_pages, matched, shared = got
+        assert matched == 10 and shared == 2
+        # the tail page was forked: the request got a COPY, and the
+        # device copy ran BEFORE the page was handed out
+        assert acq_pages[:2] == pages[:2]
+        assert acq_pages[2] != pages[2]
+        assert copies == [(pages[2], acq_pages[2])]
+        assert mgr.refcount[pages[2]] == 1     # original still tree-only
+
+    def test_match_capped_at_limit(self):
+        mgr, cache, _ = _tree()
+        toks = list(range(8))                  # exactly 2 full pages
+        pages = [mgr.alloc_page() for _ in range(2)]
+        _insert_released(mgr, cache, toks, pages)
+        # limit 7 (= S-1 for an 8-token prompt): the second page cannot
+        # be shared whole — it must come back as a 3-token COW fork
+        acq_pages, matched, shared = cache.acquire(toks, limit=7,
+                                                   total_pages=2)
+        assert shared == 1 and matched == 7
+        assert acq_pages[0] == pages[0] and acq_pages[1] != pages[1]
+
+    def test_acquire_waits_when_only_fork_source_is_evictable(self):
+        """Backpressure must account for the fork pinning its source:
+        with an empty free list and the would-be-forked tail the only
+        evictable page, acquire must WAIT (None, nothing leaked) — not
+        crash allocation mid-fork."""
+        mgr, cache, copies = _tree(num_blocks=2)
+        pages = [mgr.alloc_page(), mgr.alloc_page()]
+        _insert_released(mgr, cache, list(range(6)), pages)
+        assert not mgr.free
+        got = cache.acquire(list(range(8)), limit=7, total_pages=2)
+        assert got is None
+        assert mgr.refcount[pages[0]] == 1      # pins rolled back
+        assert mgr.refcount[pages[1]] == 1
+        assert not copies                        # no half-done fork
+
+    def test_eviction_only_frees_refcount_zero(self):
+        mgr, cache, _ = _tree(num_blocks=8)
+        toks = list(range(16))                 # 4 full pages
+        pages = [mgr.alloc_page() for _ in range(4)]
+        _insert_released(mgr, cache, toks, pages)
+        # share the first two pages with a live "request"
+        acq_pages, matched, shared = cache.acquire(
+            toks[:9], limit=8, total_pages=3)
+        assert shared == 2
+        freed = cache.evict(100)               # ask for everything
+        # only the two unpinned tree pages could go; pinned ones stayed
+        assert freed == 2
+        assert mgr.refcount[pages[2]] == 0 and mgr.refcount[pages[3]] == 0
+        assert mgr.refcount[pages[0]] == 2 and mgr.refcount[pages[1]] == 2
+        assert (mgr.refcount >= 0).all()
+
+    def test_lru_evicts_oldest_first(self):
+        mgr, cache, _ = _tree(num_blocks=16)
+        a = [mgr.alloc_page() for _ in range(2)]
+        b = [mgr.alloc_page() for _ in range(2)]
+        _insert_released(mgr, cache, [1, 2, 3, 4, 5, 6, 7, 8], a)
+        _insert_released(mgr, cache, [9, 10, 11, 12, 13, 14, 15, 16], b)
+        cache.acquire([9, 10, 11, 12, 13], limit=5, total_pages=2)
+        # branch b was touched more recently; evicting 2 takes branch a
+        assert cache.evict(2) == 2
+        assert mgr.refcount[a[0]] == 0 and mgr.refcount[a[1]] == 0
+
+    def test_divergent_insert_keeps_both_branches(self):
+        mgr, cache, _ = _tree()
+        p1 = [mgr.alloc_page() for _ in range(2)]
+        p2 = [mgr.alloc_page() for _ in range(2)]
+        _insert_released(mgr, cache, [1, 2, 3, 4, 5, 6, 7, 8], p1)
+        _insert_released(mgr, cache, [1, 2, 3, 9, 5, 6, 7, 8], p2)
+        assert cache.cached_pages == 4
+        full, tail, c = cache.match([1, 2, 3, 9, 5])
+        assert [n.page for n in full] == [p2[0]]
+        full, tail, c = cache.match([1, 2, 3, 4, 5])
+        assert [n.page for n in full] == [p1[0]]
+
+
+# -- engine-level behavior --------------------------------------------
+
+def test_warm_cache_exact_parity_and_suffix_only_prefill(params):
+    """A second request sharing the prompt prefills ONLY its suffix
+    (one 1-token chunk instead of two bucket chunks) and its greedy
+    output is bit-identical to the cold path and to generate()."""
+    rng = np.random.RandomState(0)
+    eng = _engine(params)
+    p = rng.randint(0, 97, (20,)).astype(np.int32)
+    g = GenerationConfig(max_new_tokens=5, greedy=True)
+    r1 = eng.submit(p, g)
+    eng.drain()
+    cold_chunks = eng.counters["prefill_chunks"]
+    assert cold_chunks == 2                     # 16-bucket + 4 tokens
+    r2 = eng.submit(p, g)
+    eng.drain()
+    assert eng.counters["prefill_chunks"] - cold_chunks == 1
+    want = _want(params, p, g)
+    assert r1.tokens == want
+    assert r2.tokens == want
+    m = eng.metrics()["prefix_cache"]
+    assert m["hits"] == 1 and m["misses"] == 1
+    assert m["tokens_skipped"] == 19            # capped at S-1
+    assert m["cow_forks"] == 1                  # 3-token tail fork
+    assert m["shared_pages"] == 4
+
+
+def test_three_request_shared_prefix_stream_parity(params):
+    """3 requests sharing a 12-token system prefix with distinct
+    continuations, interleaved through 2 slots: every output must equal
+    cold-cache generate() exactly, and later requests must skip the
+    shared pages."""
+    rng = np.random.RandomState(1)
+    eng = _engine(params, capacity=2)
+    sys_prefix = rng.randint(0, 97, (12,)).astype(np.int32)
+    g = GenerationConfig(max_new_tokens=5, greedy=True)
+    reqs = []
+    for i in range(3):
+        tail = rng.randint(0, 97, (5 + i,)).astype(np.int32)
+        p = np.concatenate([sys_prefix, tail])
+        reqs.append((p, eng.submit(p, g)))
+    eng.drain()
+    for p, r in reqs:
+        assert r.tokens == _want(params, p, g), "divergent continuation"
+    m = eng.metrics()["prefix_cache"]
+    assert m["hits"] >= 1
+    assert m["tokens_skipped"] >= 12            # the shared system pages
+    c = eng.counters
+    assert c["decode_traces"] == 1              # no retrace from hits
+    assert all(n <= 1 for n in c["prefill_traces"].values()), c
+
+
+def test_cow_protects_shared_page_from_divergent_writer(params):
+    """A request that shares a prefix then diverges writes into its COW
+    fork; re-running the ORIGINAL prompt afterwards must still match
+    cold-cache generate() exactly (the cached page was not corrupted)."""
+    rng = np.random.RandomState(2)
+    eng = _engine(params)
+    g = GenerationConfig(max_new_tokens=4, greedy=True)
+    base = rng.randint(0, 97, (10,)).astype(np.int32)
+    eng.submit(base, g)
+    eng.drain()
+    # diverges at position 9 — inside the cached partial tail page
+    div = base.copy()
+    div[9] = (div[9] + 1) % 97
+    div = np.concatenate([div, rng.randint(0, 97, (6,)).astype(np.int32)])
+    eng.submit(div, g)
+    eng.drain()
+    assert eng.metrics()["prefix_cache"]["cow_forks"] >= 1
+    r = eng.submit(base, g)
+    eng.drain()
+    assert r.tokens == _want(params, base, g)
+
+
+def test_in_flight_prefix_sharing(params):
+    """The prompt is indexed when its PREFILL completes, not at finish:
+    a second request arriving while the first still decodes must hit
+    the cache and share live (refcount >= 2) pages — and both outputs
+    stay exact."""
+    rng = np.random.RandomState(8)
+    eng = _engine(params)
+    g = GenerationConfig(max_new_tokens=8, greedy=True)
+    p = rng.randint(0, 97, (12,)).astype(np.int32)
+    r1 = eng.submit(p, g)
+    eng.step()                  # admits + completes r1's prefill
+    assert not r1.done
+    tail = rng.randint(0, 97, (4,)).astype(np.int32)
+    p2 = np.concatenate([p, tail])
+    r2 = eng.submit(p2, g)      # r1 still decoding
+    eng.drain()
+    m = eng.metrics()["prefix_cache"]
+    assert m["hits"] == 1 and m["tokens_skipped"] >= 12
+    assert r1.tokens == _want(params, p, g)
+    assert r2.tokens == _want(params, p2, g)
+
+
+def test_eviction_under_undersized_pool(params):
+    """Distinct prompts through a pool that cannot hold the tree force
+    LRU eviction; outputs stay exact, pages are conserved, and no page
+    with refcount > 0 is ever freed (free-list pages all have rc 0)."""
+    rng = np.random.RandomState(3)
+    eng = _engine(params, capacity=2, num_blocks=14, max_seq_len=32)
+    g = GenerationConfig(max_new_tokens=4, greedy=True)
+    reqs = [(p := rng.randint(0, 97, (16,)).astype(np.int32),
+             eng.submit(p, g)) for _ in range(6)]
+    eng.drain()
+    for p, r in reqs:
+        assert r.tokens == _want(params, p, g)
+    m = eng.metrics()["prefix_cache"]
+    assert m["evicted_pages"] > 0
+    rc = eng.mgr.refcount
+    assert (rc >= 0).all()
+    assert all(rc[p] == 0 for p in eng.mgr.free)
+    # conservation: free + cached(tree) + scratch == pool
+    assert len(eng.mgr.free) + m["cached_pages"] + 1 == eng.num_blocks
+
+
+def test_int8_engine_participates(params):
+    """Engine-global static scales make int8 pages shareable: a warm
+    repeat of the same prompt hits the cache and reproduces the cold
+    int8 tokens exactly."""
+    rng = np.random.RandomState(4)
+    eng = _engine(params, cache_dtype="int8")
+    g = GenerationConfig(max_new_tokens=5, greedy=True)
+    p = rng.randint(0, 97, (12,)).astype(np.int32)
+    r1 = eng.submit(p, g)
+    eng.drain()
+    r2 = eng.submit(p, g)
+    eng.drain()
+    assert r1.tokens == r2.tokens
+    assert eng._k_pools.dtype == jnp.int8
+    assert eng.metrics()["prefix_cache"]["hits"] == 1
+
+
+def test_mixed_stream_with_cache_stays_zero_retrace(params):
+    """A 12-request mixed stream (some shared prefixes, some cold, some
+    sampled) through the cached engine keeps the PR-1 trace bar: one
+    decode program, <=1 trace per prefill bucket."""
+    rng = np.random.RandomState(5)
+    eng = _engine(params, capacity=3)
+    sysp = rng.randint(0, 97, (8,)).astype(np.int32)
+    subs = []
+    for i in range(12):
+        S = int(rng.randint(3, 15))
+        p = rng.randint(0, 97, (S,)).astype(np.int32)
+        if i % 2:
+            p = np.concatenate([sysp, p[:6]])
+        g = GenerationConfig(max_new_tokens=int(rng.randint(2, 6)),
+                             greedy=bool(i % 3), temperature=0.7)
+        subs.append(eng.submit(p, g))
+        eng.step()
+    eng.drain()
+    assert all(r.done for r in subs)
+    c = eng.counters
+    assert c["decode_traces"] == 1, c
+    assert all(n <= 1 for n in c["prefill_traces"].values()), c
+
+
+# -- generate_paged store ---------------------------------------------
+
+def test_generate_paged_prefix_store_parity(params):
+    """Warm-store greedy output is bit-identical to the cold call and
+    to generate(); the warm call skips the cached prefix pages."""
+    store = PagedKVCacheStore(CFG, block_size=4, num_blocks=64)
+    rng = np.random.RandomState(6)
+    p = jnp.asarray(rng.randint(0, 97, (2, 13)), jnp.int32)
+    g = GenerationConfig(max_new_tokens=6, greedy=True)
+    cold = np.asarray(generate_paged(params, p, CFG, g, block_size=4,
+                                     prefix_cache=store))
+    skipped0 = store.cache.stats["tokens_skipped"]
+    warm = np.asarray(generate_paged(params, p, CFG, g, block_size=4,
+                                     prefix_cache=store))
+    ref = np.asarray(generate(params, p, CFG, g))
+    np.testing.assert_array_equal(cold, warm)
+    np.testing.assert_array_equal(cold, ref)
+    assert store.cache.stats["tokens_skipped"] > skipped0
+    # all request pages returned: free + tree + scratch == pool
+    assert (len(store.mgr.free) + store.cache.cached_pages + 1
+            == store.num_blocks)
+
+
+def test_generate_paged_prefix_store_rejects_int8(params):
+    store = PagedKVCacheStore(CFG, block_size=4, num_blocks=32)
+    p = jnp.zeros((1, 6), jnp.int32)
+    with pytest.raises(ValueError, match="int8"):
+        generate_paged(params, p, CFG,
+                       GenerationConfig(max_new_tokens=2, greedy=True),
+                       block_size=4, cache_dtype="int8",
+                       prefix_cache=store)

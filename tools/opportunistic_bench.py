@@ -48,6 +48,7 @@ PACK = [
     ("ernie_infer", 900, 2),
     ("paged_decode", 1500, 2),
     ("serving_engine", 1200, 2),
+    ("serving_prefix_cache", 1200, 2),
     ("llama_ladder", 2700, 2),
     ("resnet50_sweep", 1500, 2),
     ("kernels", 1200, 3),
