@@ -102,7 +102,10 @@ class UtilBase:
         from ..env import get_world_size
         arr = np.asarray(input)
         if get_world_size() <= 1:
-            return arr if mode != "mean" else arr
+            # match the multi-rank contract: integer mean returns float
+            if mode == "mean" and arr.dtype.kind in "iu":
+                return arr.astype(np.float64)
+            return arr
         from ...core.tensor import Tensor
         # integer inputs stay on an integer path: the old float32
         # round-trip silently lost exactness for counts > 2^24 (a global
@@ -110,13 +113,18 @@ class UtilBase:
         # The collective runs in int64 (the package enables x64) so
         # int32 per-rank counts cannot wrap in the cross-rank sum; the
         # result narrows back to the input dtype only when it fits.
-        if arr.dtype.kind in "iu" and mode in ("sum", "min", "max"):
+        if arr.dtype.kind in "iu":
             wide = np.int64 if arr.dtype.kind == "i" else np.uint64
             t = Tensor(arr.astype(wide))
+            # mean reduces as an exact integer SUM; the division by
+            # world size happens on the host in float64 (returns float —
+            # an integer mean is generally not an integer anyway)
             op = {"sum": C.ReduceOp.SUM, "min": C.ReduceOp.MIN,
-                  "max": C.ReduceOp.MAX}[mode]
+                  "max": C.ReduceOp.MAX, "mean": C.ReduceOp.SUM}[mode]
             C.all_reduce(t, op=op)
             out = np.asarray(t._value)
+            if mode == "mean":
+                return out / np.float64(get_world_size())
             if (out.astype(arr.dtype) == out).all():
                 return out.astype(arr.dtype)
             return out

@@ -238,11 +238,15 @@ class PrefixCache:
                 continue
             if best is not None and best_c == len(best.tokens) < len(pt):
                 # ours extends a partial tail: upgrade its page in place
-                # (partial nodes are COW-only => refcount 1, no children)
+                # (partial nodes are COW-only => refcount 1, no children).
+                # parent.children is keyed by the node's tokens, so the
+                # entry must be rekeyed or eviction's keyed delete misses
                 old = best.page
                 self.mgr.incref(page)
+                del node.children[best.tokens]
                 best.tokens = pt
                 best.page = page
+                node.children[pt] = best
                 self.mgr.decref(old)
                 self.stats["inserted_pages"] += 1
                 node = best

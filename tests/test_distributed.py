@@ -367,6 +367,18 @@ class TestUtilBaseAllReduceIntegerExactness:
         assert seen["dtype"] == np.uint64
         np.testing.assert_array_equal(out, np.array([4_000_000_000]))
 
+    def test_int_mean_rides_exact_integer_sum(self, monkeypatch):
+        # REVIEW: integer mean fell through to the float32 AVG
+        # collective; it must cross the wire as an exact integer SUM
+        # and divide by world size on the host (result is float)
+        from paddle_tpu.distributed.fleet.ps_compat import UtilBase
+        seen = self._patched(monkeypatch)
+        big = np.array([2**24 + 1], np.int64)   # not f32-representable
+        out = UtilBase().all_reduce(big, mode="mean")
+        assert seen["dtype"].kind in "iu", seen
+        assert out.dtype == np.float64
+        np.testing.assert_array_equal(out, np.array([float(2**24 + 1)]))
+
     def test_float_path_unchanged(self, monkeypatch):
         from paddle_tpu.distributed.fleet.ps_compat import UtilBase
         seen = self._patched(monkeypatch)
@@ -381,6 +393,11 @@ class TestUtilBaseAllReduceIntegerExactness:
         out = UtilBase().all_reduce(big, mode="sum")
         np.testing.assert_array_equal(out, big)
         assert out.dtype == np.int64
+        # integer mean returns float even at world 1 (same contract as
+        # the multi-rank path)
+        mean = UtilBase().all_reduce(np.array([7], np.int64), mode="mean")
+        assert mean.dtype == np.float64
+        np.testing.assert_array_equal(mean, [7.0])
 
 
 class TestControllerEpochNamespacedLiveness:

@@ -179,6 +179,30 @@ class TestRadixTree:
         assert cache.evict(2) == 2
         assert mgr.refcount[a[0]] == 0 and mgr.refcount[a[1]] == 0
 
+    def test_partial_tail_upgrade_rekeys_parent_for_eviction(self):
+        """REVIEW regression: the upgrade-in-place path replaced a
+        partial tail's tokens without rekeying its parent's children
+        dict, so a later eviction's keyed delete raised KeyError.
+        Repro: insert a short sequence, extend it via a second
+        insert, then evict everything."""
+        mgr, cache, _ = _tree()
+        p1 = [mgr.alloc_page() for _ in range(2)]
+        _insert_released(mgr, cache, [1, 2, 3, 4, 5, 6], p1)
+        got = cache.acquire([1, 2, 3, 4, 5, 6, 7, 8], limit=7,
+                            total_pages=2)
+        assert got is not None
+        acq_pages, matched, shared = got
+        assert matched == 6 and shared == 1    # full page + 2-token fork
+        _insert_released(mgr, cache, [1, 2, 3, 4, 5, 6, 7, 8], acq_pages)
+        # the tail node was upgraded in place to a full page; it must be
+        # findable both by match() and by its parent's dict key
+        full, tail, c = cache.match([1, 2, 3, 4, 5, 6, 7, 8])
+        assert [n.tokens for n in full] == [(1, 2, 3, 4), (5, 6, 7, 8)]
+        assert (5, 6, 7, 8) in full[0].children
+        assert cache.evict(100) == 2           # keyed delete must not raise
+        assert (mgr.refcount == 0).all()
+        assert len(mgr.free) == mgr.num_blocks
+
     def test_divergent_insert_keeps_both_branches(self):
         mgr, cache, _ = _tree()
         p1 = [mgr.alloc_page() for _ in range(2)]
@@ -306,6 +330,28 @@ def test_eviction_under_undersized_pool(params):
     assert (rc >= 0).all()
     assert all(rc[p] == 0 for p in eng.mgr.free)
     # conservation: free + cached(tree) + scratch == pool
+    assert len(eng.mgr.free) + m["cached_pages"] + 1 == eng.num_blocks
+
+
+def test_eviction_after_tail_upgrade(params):
+    """REVIEW regression, engine path: a finished request's insert
+    upgrades the partial tail node its own prefill-time insert created
+    (prompt length not page-aligned); eviction of that upgraded node
+    must find it under its parent's rekeyed dict entry instead of
+    KeyError-ing mid-admission."""
+    rng = np.random.RandomState(7)
+    eng = _engine(params, capacity=2, num_blocks=14, max_seq_len=32)
+    g = GenerationConfig(max_new_tokens=3, greedy=True)
+    reqs = [(p := rng.randint(0, 97, (10,)).astype(np.int32),
+             eng.submit(p, g)) for _ in range(6)]
+    eng.drain()
+    for p, r in reqs:
+        assert r.tokens == _want(params, p, g)
+    m = eng.metrics()["prefix_cache"]
+    assert m["evicted_pages"] > 0
+    rc = eng.mgr.refcount
+    assert (rc >= 0).all()
+    assert all(rc[p] == 0 for p in eng.mgr.free)
     assert len(eng.mgr.free) + m["cached_pages"] + 1 == eng.num_blocks
 
 
