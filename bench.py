@@ -430,9 +430,10 @@ def bench_serving_engine():
     The static baseline forms FIFO batches of `capacity`, each batch
     waits for its last arrival and drains at the pace of its slowest
     request; the engine admits each request the step after it arrives
-    and recycles finished slots immediately. Reports tokens/s, mean
-    TTFT (engine) / request latency (both), and decode-slot
-    utilization."""
+    and recycles finished slots immediately. Reports tokens/s, TTFT /
+    TPOT / queue-wait p50/p95/p99 (observability layer), decode-slot
+    utilization, and banks the full per-phase timeline as JSONL next
+    to the BENCH capture (tools/trace_summary.py reads it)."""
     import jax
     import jax.numpy as jnp
     from paddle_tpu.inference.generation import (GenerationConfig,
@@ -467,11 +468,11 @@ def bench_serving_engine():
     # -- continuous batching (compile warmup outside the timed window) --
     eng = ServingEngine(params, cfg, capacity=cap, block_size=16,
                         max_seq_len=ctx + gen_n, cache_dtype=cdt,
-                        prefill_buckets=(ctx,))
+                        prefill_buckets=(ctx,), observability=True)
     eng.submit(prompts[0], GenerationConfig(max_new_tokens=2,
                                             greedy=True))
     eng.drain()
-    eng.reset_metrics()
+    eng.reset_metrics()   # also arms the retrace watchdog
     t0 = time.perf_counter()
     i = 0
     while i < R or not eng.idle:
@@ -499,17 +500,34 @@ def bench_serving_engine():
         lat.extend(end - arrivals[j] for j in range(b0, b0 + cap))
     static_tps = R * gen_n / free_at
 
+    # full distributions + the per-phase timeline banked next to the
+    # BENCH capture: a short healthy window yields p50/p95/p99, not a
+    # single mean
+    lat_m = m["latency"]
+    tl_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_SERVING_TIMELINE.jsonl")
+    try:
+        eng.write_timeline(tl_path)
+    except OSError:
+        tl_path = None
     return {"metric": "serving_engine_tokens_per_sec_per_chip",
             "value": round(eng_tps, 1), "unit": "tokens/sec/chip",
             "static_tokens_per_sec": round(static_tps, 1),
             "speedup_vs_static": round(eng_tps / max(static_tps, 1e-9),
                                        3),
             "ttft_ms_mean": m["ttft_ms_mean"],
+            "ttft_ms": lat_m["ttft_ms"],
+            "tpot_ms": lat_m["tpot_ms"],
+            "queue_wait_ms": lat_m["queue_wait_ms"],
+            "decode_step_ms": lat_m["decode_step_ms"],
             "static_latency_ms_mean": round(
                 float(np.mean(lat)) * 1e3, 1),
             "slot_utilization": m["slot_utilization"],
             "decode_traces": m["decode_traces"],
             "prefill_traces": m["prefill_traces"],
+            "retrace_warnings": m["retrace_warnings"],
+            "prefill_tokens_per_sec": m["prefill_tokens_per_sec"],
+            **({"timeline_jsonl": tl_path} if tl_path else {}),
             "requests": R, "capacity": cap, "ctx": ctx, "gen": gen_n,
             "arrival_rate_hz": rate,
             **({"cache_dtype": cdt} if cdt else {})}
@@ -566,7 +584,8 @@ def bench_serving_prefix_cache():
         eng = ServingEngine(params, cfg, capacity=cap, block_size=16,
                             max_seq_len=ctx + gen_n, num_blocks=blocks,
                             prefill_buckets=(tail, ctx),
-                            prefix_cache=prefix_cache)
+                            prefix_cache=prefix_cache,
+                            observability=True)
         gw = GenerationConfig(max_new_tokens=2, greedy=True)
         eng.submit(prompts[0][:ctx], gw)
         eng.drain()                      # compile warmup + prefix seed
@@ -583,13 +602,25 @@ def bench_serving_prefix_cache():
             if not eng.step() and i < R:
                 time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
         wall = time.perf_counter() - t0
-        return eng.metrics(), wall
+        tl = None
+        if prefix_cache:
+            try:
+                tl = eng.write_timeline(os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_PREFIX_TIMELINE.jsonl"))
+            except OSError:
+                pass
+        return eng.metrics(), wall, tl
 
-    warm_m, warm_wall = run_one(True)
-    cold_m, cold_wall = run_one(False)
+    warm_m, warm_wall, warm_tl = run_one(True)
+    cold_m, cold_wall, _ = run_one(False)
     pc = warm_m.get("prefix_cache", {})
     return {"metric": "serving_prefix_cache_ttft_ms_mean",
             "value": warm_m["ttft_ms_mean"], "unit": "ms",
+            "warm_ttft_ms": warm_m["latency"]["ttft_ms"],
+            "cold_ttft_ms": cold_m["latency"]["ttft_ms"],
+            "warm_queue_wait_ms": warm_m["latency"]["queue_wait_ms"],
+            "retrace_warnings": warm_m["retrace_warnings"],
             "cold_ttft_ms_mean": cold_m["ttft_ms_mean"],
             "ttft_speedup": round(
                 (cold_m["ttft_ms_mean"] or 0.0)
@@ -602,6 +633,11 @@ def bench_serving_prefix_cache():
             "evicted_pages": pc.get("evicted_pages", 0),
             "warm_prefill_chunks": warm_m["prefill_chunks"],
             "cold_prefill_chunks": cold_m["prefill_chunks"],
+            "warm_prefill_tokens_per_sec":
+                warm_m["prefill_tokens_per_sec"],
+            "cold_prefill_tokens_per_sec":
+                cold_m["prefill_tokens_per_sec"],
+            **({"timeline_jsonl": warm_tl} if warm_tl else {}),
             "requests": R, "capacity": cap, "shared_prefix": shared,
             "tail": tail, "gen": gen_n, "arrival_rate_hz": rate}
 

@@ -2,8 +2,10 @@
 then the vLLM-style paged-KV loop, then the same loop on an int8
 quantized cache (half the KV HBM -> 2x batch at the same footprint),
 then mixed-arrival traffic through the continuous-batching
-ServingEngine vs the static batch (head-of-line blocking demo), and
-finally the radix PREFIX CACHE: requests sharing a system prompt skip
+ServingEngine vs the static batch (head-of-line blocking demo) with
+the OBSERVABILITY layer on (TTFT/TPOT/queue-wait percentiles, per-step
+allocator gauges, chrome-trace + JSONL timeline export), and finally
+the radix PREFIX CACHE: requests sharing a system prompt skip
 prefilling the shared pages (copy-on-write KV page sharing)."""
 import time
 
@@ -59,12 +61,13 @@ def main():
                  for s, n in zip(rng.randint(8, 33, 8),
                                  rng.randint(8, 17, 8))]
     eng = ServingEngine(params, cfg, capacity=4, block_size=16,
-                        prefill_buckets=(16, 32), max_seq_len=96)
+                        prefill_buckets=(16, 32), max_seq_len=96,
+                        observability=True)
     for warm_len in (16, 32):        # compile warmup: both prefill
         eng.submit(np.zeros(warm_len, np.int32),  # buckets + decode
                    GenerationConfig(max_new_tokens=2, greedy=True))
     eng.drain()
-    eng.reset_metrics()
+    eng.reset_metrics()   # restart the stats window + arm the watchdog
     t0 = time.perf_counter()
     i = 0
     while i < len(reqs_spec) or not eng.idle:
@@ -80,6 +83,26 @@ def main():
           f"TTFT mean {m['ttft_ms_mean']:.1f} ms, "
           f"slot util {m['slot_utilization']:.2f}, traces: "
           f"decode={m['decode_traces']} prefill={m['prefill_traces']}")
+    # the observability layer: full latency distributions, allocator
+    # gauges sampled every step, and a scrub-able chrome trace
+    lat = m["latency"]
+    print("  latency p50/p95/p99 ms: "
+          f"ttft {lat['ttft_ms']['p50']}/{lat['ttft_ms']['p95']}"
+          f"/{lat['ttft_ms']['p99']}, "
+          f"queue wait {lat['queue_wait_ms']['p50']}"
+          f"/{lat['queue_wait_ms']['p95']}"
+          f"/{lat['queue_wait_ms']['p99']}, "
+          f"decode step {lat['decode_step_ms']['p50']}"
+          f"/{lat['decode_step_ms']['p95']}"
+          f"/{lat['decode_step_ms']['p99']}")
+    print(f"  gauges: pages free last={m['gauges']['pages_free']['last']}"
+          f" min={m['gauges']['pages_free']['min']}, "
+          f"retrace warnings={m['retrace_warnings']}")
+    trace = eng.export_trace("serve_paged_trace.json")
+    jsonl = eng.write_timeline("serve_paged_timeline.jsonl")
+    print(f"  chrome trace -> {trace} (open in Perfetto), "
+          f"timeline -> {jsonl} "
+          f"(python tools/trace_summary.py {jsonl})")
 
     # -- radix prefix cache: shared system prompt ----------------------
     # 6 requests = one 48-token system prompt + distinct 8-token user

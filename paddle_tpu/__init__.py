@@ -61,6 +61,7 @@ from . import text  # noqa: F401
 from . import onnx  # noqa: F401
 from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401
 from . import inference  # noqa: F401
 from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401

@@ -345,7 +345,8 @@ def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
 def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
                    gen: Optional[GenerationConfig] = None,
                    block_size: int = 16, seed: int = 0,
-                   cache_dtype=None, prefix_cache=None):
+                   cache_dtype=None, prefix_cache=None,
+                   observability=None):
     """vLLM-style serving loop over a paged KV cache.
 
     ``cache_dtype="int8"``: static per-head cache quantization
@@ -367,15 +368,27 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     The host owns page allocation (BlockManager) between steps — the
     reference's AnalysisPredictor does the same bookkeeping around
     block_multihead_attention.
+
+    ``observability``: an optional ``paddle_tpu.observability
+    .Observability`` harness. When given, the call records host-side
+    phase timings (prefill dispatch, per-chunk decode dispatch) into
+    its timeline/histograms and samples pool gauges — purely
+    observational: no extra device syncs, identical outputs.
     """
+    import time as _time
+
     import numpy as np
     from ..ops.paged_attention import BlockManager
 
     gen = gen or GenerationConfig()
+    if observability is True:      # mirror ServingEngine's normalization
+        from ..observability import Observability
+        observability = Observability()
     if prefix_cache is not None:
         return _generate_paged_prefix(params, input_ids, cfg, gen,
                                       block_size, seed, cache_dtype,
-                                      prefix_cache)
+                                      prefix_cache, observability)
+    obs = observability or None
     B, S = input_ids.shape
     T = S + gen.max_new_tokens
     if T > cfg.max_position_embeddings:
@@ -389,9 +402,17 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     num_blocks = B * MB + 1
 
     # prefill with the dense cache, then repack into pools
+    t0 = _time.perf_counter() if obs is not None else 0.0
     k_cache, v_cache = init_cache(cfg, B, T)
     logits, k_cache, v_cache = cached_forward(
         params, input_ids, cfg, k_cache, v_cache, 0)
+    if obs is not None:
+        # host dispatch time (device completes async; forcing it here
+        # would add a sync the serving path is asserted not to have)
+        dur = (_time.perf_counter() - t0) * 1e3
+        obs.hist("prefill_chunk_ms").observe(dur)
+        obs.timeline.record("prefill_chunk", dur_ms=dur, pos0=0,
+                            n=int(B * S), bucket=int(S))
 
     mgr = BlockManager(num_blocks, BS, MB)
     for sid in range(B):
@@ -450,11 +471,21 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     bt = jnp.asarray(tables, jnp.int32)
     chunk = max(1, int(os.environ.get("PADDLE_TPU_DECODE_CHUNK", "32")))
     left = gen.max_new_tokens - 1
+    if obs is not None:
+        obs.sample_gauges(_time.perf_counter(), {
+            "pages_free": len(mgr.free),
+            "pages_in_use": num_blocks - len(mgr.free)})
     while left > 0:
         n = min(chunk, left)
+        t0 = _time.perf_counter() if obs is not None else 0.0
         toks, tok, key, done, seq_lens, k_pools, v_pools = chunk_fn(
             n, params, tok, key, done, k_pools, v_pools, seq_lens, bt,
             kv_scales)
+        if obs is not None:
+            dur = (_time.perf_counter() - t0) * 1e3
+            obs.hist("decode_step_ms").observe(dur / n)
+            obs.timeline.record("decode_step", dur_ms=dur,
+                                live_slots=B, tokens=int(n * B))
         chunks.append(toks.transpose(1, 0))  # [n, B] -> [B, n]
         left -= n
     toks = jnp.concatenate(chunks, axis=1)
@@ -475,7 +506,8 @@ def _scatter_prefill_pages(kp, vp, wtable, kc, vc):
 
 
 def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
-                           seed, cache_dtype, store):
+                           seed, cache_dtype, store,
+                           observability=None):
     """``generate_paged`` over a persistent ``PagedKVCacheStore``.
 
     Admission longest-prefix-matches each prompt against the store's
@@ -534,6 +566,15 @@ def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
         matched_ns.append(matched)
         shared_ns.append(shared)
 
+    import time as _time
+
+    obs = observability or None
+    if obs is not None:
+        obs.sample_gauges(_time.perf_counter(), {
+            "pages_free": len(mgr.free),
+            "pages_in_use": store.num_blocks - len(mgr.free),
+            "prefix_tree_pages": cache.cached_pages})
+
     # suffix prefill, one sequence at a time (per-sequence pos0)
     logits_last = []
     for b in range(B):
@@ -543,6 +584,8 @@ def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
         vc = jnp.take(store.v_pools, tb, axis=1) \
             .reshape(L, 1, MB * BS, KV, hd)
         M = matched_ns[b]
+        if obs is not None:
+            t0 = _time.perf_counter()
         lg, kc, vc = cached_forward(
             params, jnp.asarray(prompts[b:b + 1, M:]), cfg, kc, vc, M)
         wt = tables[b].copy()
@@ -551,6 +594,12 @@ def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
             store.k_pools, store.v_pools, jnp.asarray(wt, jnp.int32),
             kc, vc)
         logits_last.append(lg[:, -1])
+        if obs is not None:
+            dur = (_time.perf_counter() - t0) * 1e3
+            obs.hist("prefill_chunk_ms").observe(dur)
+            obs.timeline.record("prefill_chunk", req_id=seq_ids[b],
+                                dur_ms=dur, pos0=M, n=int(S - M),
+                                matched_tokens=M)
 
     key = _key_for(seed)
     tok = sample_token(jnp.concatenate(logits_last, axis=0), key, gen)
@@ -564,9 +613,16 @@ def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
     left = gen.max_new_tokens - 1
     while left > 0:
         n = min(chunk, left)
+        if obs is not None:
+            t0 = _time.perf_counter()
         toks, tok, key, done, seq_lens, k_pools, v_pools = chunk_fn(
             n, params, tok, key, done, k_pools, v_pools, seq_lens, bt,
             None)
+        if obs is not None:
+            dur = (_time.perf_counter() - t0) * 1e3
+            obs.hist("decode_step_ms").observe(dur / n)
+            obs.timeline.record("decode_step", dur_ms=dur,
+                                live_slots=B, tokens=int(n * B))
         chunks.append(toks.transpose(1, 0))
         left -= n
     store.k_pools, store.v_pools = k_pools, v_pools

@@ -58,6 +58,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..observability import Observability
 from ..ops.paged_attention import (BlockManager, dequant_cache,
                                    quant_cache)
 from .generation import (GenerationConfig, _paged_decode_step,
@@ -89,6 +90,8 @@ class Request:
     submit_t: float = 0.0
     tokens: List[int] = field(default_factory=list)   # generated ids
     ttft: Optional[float] = None             # sec, first token - submit
+    admit_t: Optional[float] = None          # absolute, perf_counter
+    first_token_t: Optional[float] = None    # absolute, perf_counter
     finish_t: Optional[float] = None
     done: bool = False
 
@@ -115,13 +118,25 @@ class ServingEngine:
     iteration (admit -> one prefill chunk -> one decode step over all
     live slots); ``drain()`` steps until idle. ``metrics()`` reports
     tokens/s, TTFT, decode-slot utilization and compile/trace counts.
+
+    ``observability=True`` (or an ``Observability`` instance) threads
+    the metrics/tracing harness through the scheduler: per-request
+    lifecycle events in a bounded ring buffer, TTFT/TPOT/queue-wait
+    p50/p95/p99 histograms, per-step allocator + prefix-cache gauges,
+    a retrace watchdog armed by ``reset_metrics()``, and flight-
+    recorder stall dumps on ``drain()`` starvation or a blown
+    ``step_deadline_s``. ``export_trace(path)`` writes a chrome trace,
+    ``write_timeline(path)`` the structured per-phase JSONL. All hooks
+    are host-side timestamps — greedy output, program shapes and the
+    single per-step device sync are unchanged.
     """
 
     def __init__(self, params: Dict, cfg, capacity: int = 4,
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  max_seq_len: Optional[int] = None, cache_dtype=None,
                  prefill_buckets=(32, 128), seed: int = 0,
-                 prefix_cache: bool = False):
+                 prefix_cache: bool = False,
+                 observability=False):
         self.params = params
         self.cfg = cfg
         self.capacity = int(capacity)
@@ -209,12 +224,26 @@ class ServingEngine:
         self.counters = {
             "decode_traces": 0, "prefill_traces": {},
             "calibration_traces": 0, "decode_steps": 0,
-            "prefill_chunks": 0, "live_slot_steps": 0,
+            "prefill_chunks": 0, "prefill_tokens": 0,
+            "live_slot_steps": 0,
             "tokens_generated": 0, "requests_submitted": 0,
-            "requests_completed": 0,
+            "requests_completed": 0, "drain_truncations": 0,
         }
         self._t_first = None
         self._t_last = None
+        self._metrics_reset_t = None   # TTFTs from before this are warmup
+        self.last_drain_truncated = False
+        # observability: None when disabled — every hook below is a
+        # single `is not None` check, so the disabled hot loop allocates
+        # NO event objects and issues NO extra device syncs (the per-
+        # step d2h token read in _run_decode stays the only sync point)
+        if observability:
+            self._obs = (observability
+                         if isinstance(observability, Observability)
+                         else Observability())
+            self._obs.registry.adopt_counters(self.counters)
+        else:
+            self._obs = None
 
     def _copy_page(self, src: int, dst: int):
         """COW primitive for the prefix cache: device-copy one physical
@@ -255,12 +284,18 @@ class ServingEngine:
         self._queue.append(req)
         self._requests.append(req)
         self.counters["requests_submitted"] += 1
+        if self._obs is not None:
+            self._obs.timeline.record(
+                "submit", req.req_id, prompt_tokens=int(prompt.size),
+                max_new_tokens=int(gen.max_new_tokens))
         return req
 
     def step(self) -> bool:
         """One scheduler iteration: admit from the queue, run one
         prefill chunk (if an admission is in flight), then one decode
         step over all live slots. Returns True if any work ran."""
+        obs = self._obs
+        t0 = time.perf_counter() if obs is not None else 0.0
         if self._t_first is None:
             self._t_first = time.perf_counter()
         self._admit()
@@ -268,7 +303,44 @@ class ServingEngine:
         did = self._run_decode() or did
         if did:
             self._t_last = time.perf_counter()
+        if obs is not None:
+            self._observe_step(t0, did)
         return did
+
+    def _observe_step(self, t0: float, did: bool):
+        """Post-step observability: gauges, watchdog, step deadline.
+        Pure host bookkeeping — reads only host mirrors, never the
+        device."""
+        obs = self._obs
+        now = time.perf_counter()
+        free = len(self.mgr.free)
+        vals = {
+            "pages_free": free,
+            "pages_in_use": self.num_blocks - free,
+            "kv_refcount_total": int(self.mgr.refcount.sum()),
+            "queue_depth": len(self._queue),
+            "live_slots": sum(1 for s in self._slots
+                              if s.phase != "idle"),
+        }
+        if self._pcache is not None:
+            st = self._pcache.stats
+            looked = st["hits"] + st["misses"]
+            vals["prefix_tree_pages"] = self._pcache.cached_pages
+            vals["prefix_hit_ratio"] = (round(st["hits"] / looked, 4)
+                                        if looked else 0.0)
+        obs.sample_gauges(now, vals)
+        if obs.watchdog.check(self.counters):
+            obs.timeline.record("retrace",
+                                events=len(obs.watchdog.events))
+        if did:
+            dur = now - t0
+            obs.hist("step_ms").observe(dur * 1e3)
+            if obs.step_deadline_s is not None \
+                    and dur > obs.step_deadline_s:
+                obs.stall_dump(
+                    f"step took {dur * 1e3:.1f} ms "
+                    f"(deadline {obs.step_deadline_s * 1e3:.1f} ms)",
+                    self.scheduler_snapshot())
 
     @property
     def idle(self) -> bool:
@@ -276,17 +348,65 @@ class ServingEngine:
             s.phase == "idle" for s in self._slots)
 
     def drain(self, max_steps: Optional[int] = None) -> int:
-        """Step until queue and slots are empty; returns step count."""
+        """Step until queue and slots are empty; returns step count.
+
+        Hitting ``max_steps`` with work still pending is recorded —
+        ``last_drain_truncated`` is set and the ``drain_truncations``
+        counter increments — so a capped drain is distinguishable from
+        a clean one at the call site. Starvation (a step that can run
+        nothing while requests are queued) raises, after writing a
+        flight-recorder stall dump when observability is on."""
         n = 0
+        self.last_drain_truncated = False
         while not self.idle:
             if not self.step():
+                dump = ""
+                if self._obs is not None:
+                    dump = self._obs.stall_dump(
+                        "drain starved: queued requests cannot be "
+                        "admitted", self.scheduler_snapshot(),
+                        metrics=self.metrics())
                 raise RuntimeError(
                     "engine starved: queued requests cannot be admitted "
-                    "(KV pool too small for the in-flight mix?)")
+                    "(KV pool too small for the in-flight mix?)"
+                    + (f"; stall dump: {dump}" if dump else ""))
             n += 1
             if max_steps is not None and n >= max_steps:
+                if not self.idle:
+                    self.last_drain_truncated = True
+                    self.counters["drain_truncations"] += 1
+                    if self._obs is not None:
+                        self._obs.timeline.record(
+                            "drain_truncated", steps=n,
+                            queue_depth=len(self._queue),
+                            live_slots=sum(1 for s in self._slots
+                                           if s.phase != "idle"))
                 break
         return n
+
+    def scheduler_snapshot(self) -> Dict:
+        """Host-side scheduler state for stall dumps: queue depth, slot
+        phases, per-slot seq_len, free pages, prefix-cache state."""
+        snap = {
+            "queue_depth": len(self._queue),
+            "queued": [{"req_id": r.req_id,
+                        "prompt_tokens": int(r.prompt.size),
+                        "need_pages": -(-(int(r.prompt.size)
+                                          + int(r.gen.max_new_tokens))
+                                        // self.block_size)}
+                       for r in list(self._queue)[:16]],
+            "slots": [{"slot": i, "phase": s.phase,
+                       "req_id": s.req.req_id if s.req else None,
+                       "seq_len": s.seq_len,
+                       "prefill_pos": s.prefill_pos}
+                      for i, s in enumerate(self._slots)],
+            "pages_free": len(self.mgr.free),
+            "num_blocks": self.num_blocks,
+            "capacity": self.capacity,
+        }
+        if self._pcache is not None:
+            snap["prefix_cache"] = self._pcache.metrics()
+        return snap
 
     def metrics(self) -> Dict:
         c = {k: (dict(v) if isinstance(v, dict) else v)
@@ -297,7 +417,17 @@ class ServingEngine:
         c["wall_time_s"] = round(wall, 6)
         c["tokens_per_sec"] = (round(c["tokens_generated"] / wall, 3)
                                if wall > 0 else 0.0)
-        ttfts = [r.ttft for r in self._requests if r.ttft is not None]
+        # prompt tokens processed over the same window: prefill- vs
+        # decode-bound workloads are indistinguishable without it
+        c["prefill_tokens_per_sec"] = (
+            round(c["prefill_tokens"] / wall, 3) if wall > 0 else 0.0)
+        # TTFTs measured before the last reset_metrics() belong to the
+        # warmup window — a request in flight across the reset keeps
+        # its Request object but must not pollute this window's stats
+        cut = self._metrics_reset_t
+        ttfts = [r.ttft for r in self._requests
+                 if r.ttft is not None
+                 and (cut is None or (r.first_token_t or 0.0) >= cut)]
         c["ttft_ms_mean"] = (round(float(np.mean(ttfts)) * 1e3, 3)
                              if ttfts else None)
         c["ttft_ms_max"] = (round(float(np.max(ttfts)) * 1e3, 3)
@@ -308,14 +438,25 @@ class ServingEngine:
             if steps else 0.0)
         if self._pcache is not None:
             c["prefix_cache"] = self._pcache.metrics()
+        if self._obs is not None:
+            obs = self._obs
+            c["latency"] = obs.latency_snapshot()
+            c["gauges"] = obs.gauges_snapshot()
+            c["retrace_warnings"] = len(obs.watchdog.events)
+            c["stall_dumps"] = len(obs.stall_dumps)
+            c["timeline_events"] = len(obs.timeline)
+            c["timeline_dropped"] = obs.timeline.dropped
         return c
 
     def reset_metrics(self):
         """Zero the throughput counters/timers (e.g. after a compile
-        warmup pass). Trace counters are cumulative and stay."""
-        for k in ("decode_steps", "prefill_chunks", "live_slot_steps",
-                  "tokens_generated", "requests_submitted",
-                  "requests_completed"):
+        warmup pass). Trace counters are cumulative and stay — but the
+        retrace watchdog arms HERE: any program that traces after this
+        call is a steady-state retrace and warns."""
+        for k in ("decode_steps", "prefill_chunks", "prefill_tokens",
+                  "live_slot_steps", "tokens_generated",
+                  "requests_submitted", "requests_completed",
+                  "drain_truncations"):
             self.counters[k] = 0
         if self._pcache is not None:
             # workload counters like the above (the cached PAGES stay —
@@ -324,7 +465,36 @@ class ServingEngine:
             for k in self._pcache.stats:
                 self._pcache.stats[k] = 0
         self._t_first = self._t_last = None
+        self._metrics_reset_t = time.perf_counter()
         self._requests = [r for r in self._requests if not r.done]
+        if self._obs is not None:
+            self._obs.reset_window()
+            self._obs.watchdog.mark_warmup(self.counters)
+
+    # -- observability export -----------------------------------------
+    @property
+    def observability(self) -> Optional[Observability]:
+        return self._obs
+
+    def _require_obs(self) -> Observability:
+        if self._obs is None:
+            raise RuntimeError(
+                "observability is disabled for this engine; construct "
+                "with ServingEngine(..., observability=True)")
+        return self._obs
+
+    def export_trace(self, path: str) -> str:
+        """Write the request-lifecycle chrome trace (+ gauge counter
+        tracks) to ``path`` — open in Perfetto / chrome://tracing."""
+        return self._require_obs().export_chrome(path)
+
+    def write_timeline(self, path: str) -> str:
+        """Write the structured per-phase JSONL (events + per-request
+        records) to ``path`` — input for tools/trace_summary.py."""
+        return self._require_obs().write_jsonl(
+            path, header={"capacity": self.capacity,
+                          "num_blocks": self.num_blocks,
+                          "block_size": self.block_size})
 
     # -- scheduling ---------------------------------------------------
     def _temp_of(self, gen: GenerationConfig) -> float:
@@ -379,6 +549,14 @@ class ServingEngine:
             self._slot_tables[slot_id, :len(table)] = table
             self._slot_wtables[slot_id] = self._slot_tables[slot_id]
             self._slot_wtables[slot_id, :shared] = 0
+            if self._obs is not None:
+                req.admit_t = time.perf_counter()
+                wait_ms = (req.admit_t - req.submit_t) * 1e3
+                self._obs.hist("queue_wait_ms").observe(wait_ms)
+                self._obs.timeline.record(
+                    "admit", req.req_id, slot=slot_id,
+                    queue_wait_ms=round(wait_ms, 3),
+                    matched_tokens=matched)
 
     def _run_prefill(self) -> bool:
         for slot_id, slot in enumerate(self._slots):
@@ -394,6 +572,7 @@ class ServingEngine:
                 fn = self._prefill_fns[P] = self._make_prefill_fn(P)
             toks = np.zeros((1, P), np.int32)
             toks[0, :n] = req.prompt[pos0:pos0 + n]
+            t0 = time.perf_counter() if self._obs is not None else 0.0
             # pos0/last_idx ride at the platform default int width so
             # the literal indices inside cached_forward's dynamic
             # slices promote consistently whether or not x64 is on
@@ -405,11 +584,25 @@ class ServingEngine:
                 jnp.asarray(self._temp_of(req.gen), jnp.float32),
                 self._d_key, self._k_pools, self._v_pools)
             self.counters["prefill_chunks"] += 1
+            self.counters["prefill_tokens"] += n
+            if self._obs is not None:
+                # host dispatch time only (the chunk completes async on
+                # device; forcing it here would ADD a sync to the loop)
+                dur_ms = (time.perf_counter() - t0) * 1e3
+                self._obs.hist("prefill_chunk_ms").observe(dur_ms)
+                self._obs.timeline.record(
+                    "prefill_chunk", req.req_id, dur_ms=dur_ms,
+                    pos0=pos0, n=n, bucket=P)
             slot.prefill_pos += n
             if slot.prefill_pos == S:
                 first = int(np.asarray(tok))
-                req.ttft = time.perf_counter() - req.submit_t
+                req.first_token_t = time.perf_counter()
+                req.ttft = req.first_token_t - req.submit_t
                 req.tokens.append(first)
+                if self._obs is not None:
+                    self._obs.timeline.record(
+                        "first_token", req.req_id,
+                        ttft_ms=round(req.ttft * 1e3, 3))
                 self.counters["tokens_generated"] += 1
                 slot.seq_len = S
                 if self._pcache is not None:
@@ -449,6 +642,7 @@ class ServingEngine:
             self._d_tables = jnp.asarray(self._h_tables.copy())
             self._d_temps = jnp.asarray(self._h_temps.copy())
             self._dirty = False
+        t0 = time.perf_counter() if self._obs is not None else 0.0
         (self._d_tok, self._d_seq, self._d_key, self._k_pools,
          self._v_pools) = self._decode_fn(
             self.params, self._d_tok, self._d_seq, self._d_tables,
@@ -456,6 +650,14 @@ class ServingEngine:
         nxt = np.asarray(self._d_tok)       # the per-step host sync
         self.counters["decode_steps"] += 1
         self.counters["live_slot_steps"] += len(live)
+        if self._obs is not None:
+            # dispatch-to-sync wall time: the d2h read above already
+            # synchronizes every step, so this measures real step
+            # latency without adding any device round-trip
+            dur_ms = (time.perf_counter() - t0) * 1e3
+            self._obs.hist("decode_step_ms").observe(dur_ms)
+            self._obs.timeline.record("decode_step", dur_ms=dur_ms,
+                                      live_slots=len(live))
         for i in live:
             slot = self._slots[i]
             req = slot.req
@@ -475,6 +677,37 @@ class ServingEngine:
         req = slot.req
         req.done = True
         req.finish_t = time.perf_counter()
+        if self._obs is not None:
+            n_gen = len(req.tokens)
+            tpot_ms = (((req.finish_t - req.first_token_t)
+                        / (n_gen - 1)) * 1e3
+                       if n_gen > 1 and req.first_token_t is not None
+                       else None)
+            rec = {
+                "req_id": req.req_id,
+                "prompt_tokens": int(req.prompt.size),
+                "gen_tokens": n_gen,
+                "queue_wait_ms": (round((req.admit_t - req.submit_t)
+                                        * 1e3, 3)
+                                  if req.admit_t is not None else None),
+                "ttft_ms": (round(req.ttft * 1e3, 3)
+                            if req.ttft is not None else None),
+                "tpot_ms": (round(tpot_ms, 3)
+                            if tpot_ms is not None else None),
+                "e2e_ms": round((req.finish_t - req.submit_t) * 1e3, 3),
+            }
+            # a request whose first token predates the last reset
+            # carries a warmup-measured TTFT: keep its record but
+            # exclude it from the histograms — the SAME predicate
+            # metrics() uses for ttft_ms_mean/max, so the two never
+            # disagree within one snapshot
+            cut = self._metrics_reset_t
+            self._obs.observe_request(
+                rec, stale=(cut is not None
+                            and req.first_token_t is not None
+                            and req.first_token_t < cut))
+            self._obs.timeline.record("finish", req.req_id,
+                                      gen_tokens=n_gen)
         if self._pcache is not None and slot.seq_len > 0:
             # hand the pages to the radix tree instead of freeing them.
             # Valid KV covers prompt + all generated tokens except the

@@ -1,0 +1,131 @@
+"""paddle_tpu.observability — serving-stack metrics, tracing and stall
+diagnostics.
+
+One lightweight harness threaded through the serving path (and usable
+standalone around ``generate_paged``): a metrics registry (counters +
+gauges + streaming histograms with p50/p95/p99 export), per-request
+lifecycle timelines in a bounded ring buffer (chrome-trace export
+through ``profiler/``), a retrace watchdog, and flight-recorder stall
+dumps. Everything here is host-side bookkeeping: recording an event is
+a timestamp + a deque append, and **no code path issues a device sync**
+— the engine's one per-step d2h read stays the only synchronization
+point. When disabled the engine holds no harness at all (``None``), so
+the disabled hot loop allocates zero event objects.
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Dict, Optional
+
+from .metrics import Gauge, Histogram, MetricsRegistry
+from .stall import dump_stall
+from .timeline import Timeline, TimelineEvent
+from .watchdog import RetraceWatchdog
+
+__all__ = ["Observability", "MetricsRegistry", "Histogram", "Gauge",
+           "Timeline", "TimelineEvent", "RetraceWatchdog", "dump_stall"]
+
+# the latency histograms every engine window reports (schema-stable:
+# tests freeze this set — extend deliberately, never ad hoc)
+LATENCY_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "e2e_ms",
+                      "prefill_chunk_ms", "decode_step_ms", "step_ms")
+
+
+class Observability:
+    """Per-component observability harness.
+
+    Owns one :class:`MetricsRegistry`, one :class:`Timeline` ring, one
+    :class:`RetraceWatchdog` and the stall-dump plumbing. The engine
+    holds either an instance (enabled) or ``None`` (disabled — zero
+    overhead, no event objects ever allocated).
+    """
+
+    def __init__(self, ring_capacity: int = 4096,
+                 gauge_window: int = 512,
+                 step_deadline_s: Optional[float] = None,
+                 stall_dump_path: Optional[str] = None,
+                 warn_on_retrace: bool = True,
+                 max_request_records: int = 2048):
+        self.registry = MetricsRegistry()
+        self.timeline = Timeline(ring_capacity)
+        self.watchdog = RetraceWatchdog(warn=warn_on_retrace)
+        self.gauge_window = int(gauge_window)
+        self.step_deadline_s = step_deadline_s
+        self.stall_dump_path = stall_dump_path
+        self.stall_dumps = []          # [(reason, path)]
+        self.request_records: deque = deque(maxlen=max_request_records)
+        for name in LATENCY_HISTOGRAMS:
+            self.registry.histogram(name, unit="ms")
+
+    # -- recording shortcuts ------------------------------------------
+    def hist(self, name: str) -> Histogram:
+        return self.registry.histogram(name)
+
+    def sample_gauges(self, t: float, values: Dict[str, float]):
+        for name, v in values.items():
+            self.registry.gauge(name, self.gauge_window).set(v, t)
+
+    def observe_request(self, record: Dict, stale: bool = False):
+        """One finished request: feed the latency histograms and keep
+        the record for JSONL export. ``queue_wait_ms`` is observed at
+        admission (not here) so requests parked in the queue still
+        count the moment they admit. ``stale=True`` (the request was
+        submitted before the last window reset, so its latencies span
+        the warmup) keeps the record but skips the histograms —
+        matching the ttft_ms_mean/max warmup exclusion."""
+        if not stale:
+            for key in ("ttft_ms", "tpot_ms", "e2e_ms"):
+                v = record.get(key)
+                if v is not None:
+                    self.hist(key).observe(v)
+        else:
+            record = dict(record, warmup=True)
+        self.request_records.append(record)
+
+    # -- stall diagnostics --------------------------------------------
+    def stall_dump(self, reason: str, scheduler: Dict,
+                   metrics: Optional[Dict] = None) -> str:
+        path = self.stall_dump_path
+        if path and self.stall_dumps:
+            # successive dumps must not clobber the first report
+            # (splitext, not rpartition: a dot in a parent directory
+            # must not get the counter spliced into it)
+            base, ext = os.path.splitext(path)
+            path = f"{base}.{len(self.stall_dumps)}{ext}"
+        self.timeline.record("stall", reason=reason)
+        written = dump_stall(reason, scheduler, self.timeline.tail(),
+                             metrics=metrics, path=path)
+        self.stall_dumps.append((reason, written))
+        return written
+
+    # -- reporting ----------------------------------------------------
+    def reset_window(self):
+        """Restart the distribution window (after compile warmup):
+        histograms and per-request records clear, the timeline ring and
+        gauge series keep rolling (history is cheap and useful)."""
+        self.registry.reset_histograms()
+        self.request_records.clear()
+
+    def latency_snapshot(self) -> Dict:
+        return {name: self.registry.histogram(name).snapshot()
+                for name in LATENCY_HISTOGRAMS}
+
+    def gauges_snapshot(self) -> Dict:
+        return {name: g.snapshot()
+                for name, g in sorted(self.registry.gauges.items())}
+
+    def export_chrome(self, path: str) -> str:
+        return self.timeline.export_chrome(
+            path, gauges=self.registry.gauges)
+
+    def write_jsonl(self, path: str, header: Optional[Dict] = None
+                    ) -> str:
+        return self.timeline.write_jsonl(
+            path, request_records=list(self.request_records),
+            header=header)
+
+    @staticmethod
+    def now() -> float:
+        return time.perf_counter()

@@ -1,0 +1,48 @@
+"""Stall diagnostics: flight-recorder dumps for a starved scheduler.
+
+When ``drain()`` starves (queued work that can never admit) or a step
+blows its deadline, an exception string is not a diagnosis. Reusing
+the pattern of ``distributed/flight_recorder.py``: dump the timeline
+ring buffer tail plus a scheduler snapshot (queue depth, slot phases,
+per-slot seq_len, free pages, prefix-cache state) as one JSON report —
+to a file when a path is configured, to stderr otherwise.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional
+
+__all__ = ["dump_stall"]
+
+
+def dump_stall(reason: str, scheduler: Dict, timeline_tail,
+               metrics: Optional[Dict] = None,
+               path: Optional[str] = None) -> str:
+    """Write one stall report; returns the path written (or "" when the
+    report went to stderr). Dumping must never raise into the engine —
+    a failed write degrades to stderr."""
+    report = {
+        "reason": reason,
+        "pid": os.getpid(),
+        "time": time.time(),
+        "iso": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "scheduler": scheduler,
+        "metrics": metrics or {},
+        "timeline_tail": list(timeline_tail),
+    }
+    text = json.dumps(report, indent=1, default=str)
+    if path:
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+            return path
+        except OSError as e:
+            sys.stderr.write(f"[stall-dump] write to {path} failed "
+                             f"({e}); falling back to stderr\n")
+    sys.stderr.write(f"[stall-dump] {reason}\n{text}\n")
+    return ""

@@ -1,7 +1,8 @@
 """paddle_tpu.profiler — unified profiler (reference:
 python/paddle/profiler/). Host tracer + XLA/TPU XPlane device traces."""
 from .profiler import (Profiler, ProfilerState, ProfilerTarget,
-                       make_scheduler, export_chrome_tracing, export_protobuf)
+                       make_scheduler, export_chrome_tracing,
+                       export_protobuf, write_chrome_trace)
 from .record_event import (RecordEvent, TracerEventType, load_profiler_result,
                            get_host_tracer)
 from .timer import benchmark, Benchmark
@@ -9,7 +10,8 @@ from .statistics import build_summary, event_type_summary
 
 __all__ = [
     "Profiler", "ProfilerState", "ProfilerTarget", "make_scheduler",
-    "export_chrome_tracing", "export_protobuf", "RecordEvent",
+    "export_chrome_tracing", "export_protobuf", "write_chrome_trace",
+    "RecordEvent",
     "TracerEventType", "load_profiler_result", "benchmark", "Benchmark",
     "SortedKeys", "SummaryView",
 ]

@@ -100,6 +100,29 @@ def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
     return export_chrome_tracing(dir_name, worker_name)
 
 
+def write_chrome_trace(path: str, events, *,
+                       process_name: str = "paddle_tpu host",
+                       extra_events=None):
+    """Serialize HostEvents (+ optional pre-built chrome event dicts,
+    e.g. counter tracks) as one chrome-trace json. Shared by the
+    Profiler export and the serving observability timeline, so every
+    trace this framework writes opens in the same Perfetto workflow."""
+    pid = os.getpid()
+    trace = [{
+        "name": ev.name, "ph": "X", "cat": ev.event_type.name,
+        "ts": ev.start_ns / 1000.0, "dur": ev.duration_ns / 1000.0,
+        "pid": pid, "tid": ev.tid,
+    } for ev in events]
+    meta = [{"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": process_name}}]
+    for ev in extra_events or ():
+        ev.setdefault("pid", pid)
+        trace.append(ev)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": meta + trace,
+                   "displayTimeUnit": "ms"}, f)
+
+
 class _OpTracerAdapter:
     """Forwards eager-dispatch op timings into the host tracer as
     Operator-type events (reference: RecordEvents emitted inside generated
@@ -246,18 +269,7 @@ class Profiler:
         self._export_chrome(path)
 
     def _export_chrome(self, path: str):
-        events = get_host_tracer().events()
-        pid = os.getpid()
-        trace = [{
-            "name": ev.name, "ph": "X", "cat": ev.event_type.name,
-            "ts": ev.start_ns / 1000.0, "dur": ev.duration_ns / 1000.0,
-            "pid": pid, "tid": ev.tid,
-        } for ev in events]
-        meta = [{"name": "process_name", "ph": "M", "pid": pid,
-                 "args": {"name": "paddle_tpu host"}}]
-        with open(path, "w") as f:
-            json.dump({"traceEvents": meta + trace,
-                       "displayTimeUnit": "ms"}, f)
+        write_chrome_trace(path, get_host_tracer().events())
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms") -> str:

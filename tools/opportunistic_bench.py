@@ -19,7 +19,13 @@ failed for two rounds straight. This prober runs for the whole session:
 - if the tunnel dies mid-pack, the remaining configs stay pending and
   capture resumes at the next healthy probe;
 - ``bench.py`` serves the freshest captured result (flagged with its
-  age) whenever its own live probe fails.
+  age) whenever its own live probe fails;
+- the serving configs run with the observability layer on, so each
+  capture banks its full per-phase timeline JSONL
+  (``BENCH_SERVING_TIMELINE.jsonl`` / ``BENCH_PREFIX_TIMELINE.jsonl``,
+  summarized by ``tools/trace_summary.py``) next to this file — a
+  short healthy TPU window yields TTFT/TPOT/queue-wait distributions,
+  not point estimates.
 
 Run detached:  nohup python tools/opportunistic_bench.py &
 """
@@ -132,6 +138,8 @@ def main():
         log({"event": "config", "name": name, "ok": ok_cfg,
              "secs": round(time.time() - t_cfg, 1),
              "attempt": attempts[name],
+             **({"timeline_jsonl": r["timeline_jsonl"]}
+                if ok_cfg and r.get("timeline_jsonl") else {}),
              **({} if ok_cfg else {"error": r.get("error", "")[:200]})})
         if ok_cfg or attempts[name] >= max_att:
             had_good = (isinstance(results.get(name), dict)

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Summarize a serving-timeline JSONL (ServingEngine.write_timeline).
+
+Reads the structured per-phase JSONL the observability layer emits next
+to each BENCH capture and prints, without needing a browser:
+
+- per-phase breakdown: count / total / mean / max wall time per event
+  name (decode_step, prefill_chunk, ...),
+- the top-N slowest timed steps (the retrace or allocator hiccup is
+  almost always one of these),
+- per-request latency distributions (queue wait, TTFT, TPOT, e2e)
+  with p50/p95/p99 computed from the request records.
+
+Usage:  python tools/trace_summary.py TIMELINE.jsonl [--top 10] [--json]
+"""
+import argparse
+import json
+import sys
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def load(path):
+    meta, events, requests = {}, [], []
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                print(f"warning: skipping malformed line {ln}",
+                      file=sys.stderr)
+                continue
+            kind = rec.get("kind")
+            if kind == "meta":
+                meta = rec
+            elif kind == "event":
+                events.append(rec)
+            elif kind == "request":
+                requests.append(rec)
+    return meta, events, requests
+
+
+def summarize(meta, events, requests, top=10):
+    out = {"meta": {k: meta.get(k) for k in
+                    ("schema", "events", "dropped", "capacity",
+                     "num_blocks", "block_size") if k in meta}}
+
+    phases = {}
+    for ev in events:
+        d = ev.get("dur_ms")
+        if d is None:
+            continue
+        p = phases.setdefault(ev["name"], {"count": 0, "total_ms": 0.0,
+                                           "max_ms": 0.0})
+        p["count"] += 1
+        p["total_ms"] += d
+        p["max_ms"] = max(p["max_ms"], d)
+    for p in phases.values():
+        p["mean_ms"] = round(p["total_ms"] / p["count"], 3)
+        p["total_ms"] = round(p["total_ms"], 3)
+        p["max_ms"] = round(p["max_ms"], 3)
+    out["phases"] = phases
+
+    timed = [ev for ev in events if ev.get("dur_ms") is not None]
+    timed.sort(key=lambda e: -e["dur_ms"])
+    out["slowest_steps"] = timed[:top]
+
+    lat = {}
+    # warmup-flagged records (in flight across reset_metrics) are
+    # excluded, matching the engine's own histogram exclusion
+    live = [r for r in requests if not r.get("warmup")]
+    for key in ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
+        vals = sorted(r[key] for r in live
+                      if r.get(key) is not None)
+        if vals:
+            lat[key] = {"count": len(vals),
+                        "mean": round(sum(vals) / len(vals), 3),
+                        "p50": round(_percentile(vals, 0.50), 3),
+                        "p95": round(_percentile(vals, 0.95), 3),
+                        "p99": round(_percentile(vals, 0.99), 3),
+                        "max": round(vals[-1], 3)}
+    out["request_latency"] = lat
+    out["requests"] = len(requests)
+    return out
+
+
+def render(summary):
+    lines = []
+    m = summary["meta"]
+    lines.append(f"timeline: {m.get('events', '?')} events "
+                 f"({m.get('dropped', 0)} dropped), "
+                 f"{summary['requests']} request records")
+    lines.append("")
+    lines.append(f"{'phase':<18}{'count':>8}{'total ms':>12}"
+                 f"{'mean ms':>10}{'max ms':>10}")
+    for name, p in sorted(summary["phases"].items(),
+                          key=lambda kv: -kv[1]["total_ms"]):
+        lines.append(f"{name:<18}{p['count']:>8}{p['total_ms']:>12}"
+                     f"{p['mean_ms']:>10}{p['max_ms']:>10}")
+    if summary["slowest_steps"]:
+        lines.append("")
+        lines.append(f"top {len(summary['slowest_steps'])} slowest steps:")
+        for ev in summary["slowest_steps"]:
+            extra = {k: v for k, v in ev.items()
+                     if k not in ("kind", "name", "dur_ms", "t_ns")}
+            lines.append(f"  {ev['dur_ms']:>10.3f} ms  {ev['name']:<16}"
+                         f"{json.dumps(extra) if extra else ''}")
+    if summary["request_latency"]:
+        lines.append("")
+        lines.append(f"{'latency':<16}{'count':>7}{'mean':>10}"
+                     f"{'p50':>10}{'p95':>10}{'p99':>10}{'max':>10}")
+        for name, s in summary["request_latency"].items():
+            lines.append(f"{name:<16}{s['count']:>7}{s['mean']:>10}"
+                         f"{s['p50']:>10}{s['p95']:>10}{s['p99']:>10}"
+                         f"{s['max']:>10}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="timeline JSONL file")
+    ap.add_argument("--top", type=int, default=10,
+                    help="slowest steps to list (default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+    meta, events, requests = load(args.path)
+    summary = summarize(meta, events, requests, top=args.top)
+    print(json.dumps(summary, indent=1) if args.json
+          else render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
