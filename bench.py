@@ -34,11 +34,6 @@ import time
 
 import numpy as np
 
-PEAK_FLOPS = {  # bf16 peak per chip, by TPU generation
-    "v6e": 918e12, "v5p": 459e12, "v5e": 197e12, "v5litepod": 197e12,
-    "v4": 275e12,
-}
-
 PEAK_HBM_BW = {  # bytes/sec per chip, by TPU generation
     "v6e": 1640e9, "v5p": 2765e9, "v5e": 819e9, "v5litepod": 819e9,
     "v4": 1228e9,
@@ -83,11 +78,11 @@ def _timed_host_synced(fn, steps, warn_sink=None):
 
 
 def _peak():
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
-    for k, v in PEAK_FLOPS.items():
-        if gen.startswith(k):
-            return v
-    return 197e12
+    # the shared peak table (honors PADDLE_TPU_PEAK_FLOPS +
+    # PALLAS_AXON_TPU_GEN): the formula MFU and the cost-analysis MFU
+    # in one capture must divide by the SAME denominator
+    from paddle_tpu.observability.compile import device_peak_flops
+    return device_peak_flops()[0]
 
 
 # --------------------------------------------------------------------------
@@ -163,9 +158,14 @@ def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
     n_params = sum(v.size for v in jax.tree_util.tree_leaves(params))
     mdt = {"bfloat16": jnp.bfloat16, "float32": None,
            None: None}[moment_dtype]
+    # observability (default on, BENCH_TRAIN_OBS=0 to disable): per-step
+    # phase histograms, compile telemetry + automatic MFU, host-vs-device
+    # gap detection, and the per-step timeline banked as JSONL
+    obs_on = os.environ.get("BENCH_TRAIN_OBS", "1") != "0"
     tr = Trainer(lambda p, t, l: loss_fn(p, t, l, cfg), mesh,
                  param_shardings(mesh, cfg), lr=1e-4,
-                 accumulate_steps=acc, moment_dtype=mdt)
+                 accumulate_steps=acc, moment_dtype=mdt,
+                 observability=obs_on)
     state = tr.init_state(params)
     shape = (acc, batch, seq) if acc > 1 else (batch, seq)
     toks = jnp.asarray(np.random.randint(0, 32000, shape), jnp.int32)
@@ -173,6 +173,14 @@ def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
 
     state, m = tr.step(state, toks, labels)
     float(m["loss"])  # warmup + compile
+    # SECOND warmup step: the x64 master promotion after step 1 changes
+    # the state signature and recompiles once (the compile telemetry made
+    # this visible — previously that recompile landed INSIDE the timed
+    # window and skewed every rung's tokens/s); the timed window below
+    # now measures the steady-state program only
+    state, m = tr.step(state, toks, labels)
+    float(m["loss"])
+    tr.reset_metrics()    # restart distributions + arm compile watchdog
     t0 = time.perf_counter()
     for _ in range(steps):
         state, m = tr.step(state, toks, labels)
@@ -183,13 +191,42 @@ def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
     flops_per_tok = 6 * n_params + 6 * cfg.num_hidden_layers * seq * \
         cfg.hidden_size
     mfu = tps * flops_per_tok / _peak()
-    return {"metric": "llama_train_tokens_per_sec_per_chip",
-            "value": round(tps, 1), "unit": "tokens/sec/chip",
-            "mfu": round(mfu, 4), "params": int(n_params), "batch": batch,
-            "seq": seq, "accumulate": acc, "hidden": hidden,
-            "layers": layers,
-            **({"moment_dtype": moment_dtype} if moment_dtype else {}),
-            "vs_baseline_mfu": round(mfu / 0.525, 4)}
+    out = {"metric": "llama_train_tokens_per_sec_per_chip",
+           "value": round(tps, 1), "unit": "tokens/sec/chip",
+           "mfu": round(mfu, 4), "params": int(n_params), "batch": batch,
+           "seq": seq, "accumulate": acc, "hidden": hidden,
+           "layers": layers,
+           **({"moment_dtype": moment_dtype} if moment_dtype else {}),
+           "vs_baseline_mfu": round(mfu / 0.525, 4)}
+    if obs_on:
+        tm = tr.metrics()
+        # flags the measurement mode in the capture: the observed loop
+        # host-syncs every step (one block_until_ready + scalar d2h),
+        # so its tokens/s is not directly comparable to a BENCH_TRAIN_OBS=0
+        # run or to pre-r9 captures (which also timed a hidden recompile
+        # — see the two-step warmup above)
+        out["observed_loop"] = True
+        out["step_ms"] = tm["latency"]["step_ms"]
+        out["phase_ms_mean"] = {
+            k: tm["latency"][k]["mean"]
+            for k in ("stage_ms", "dispatch_ms", "sync_ms")}
+        out["compiles_in_window"] = tm["retrace_warnings"]
+        out["host_gap_findings"] = tm["host_gap_findings"]
+        if tm["mfu"]:
+            out["mfu_cost_analysis"] = tm["mfu"]["mfu"]
+            out["flops_per_step_per_device_cost_analysis"] = \
+                tm["mfu"]["flops_per_step_per_device"]
+        if tm["hbm"]:
+            out["hbm_breakdown"] = tm["hbm"]
+        tl_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_TRAIN_TIMELINE.jsonl")
+        try:
+            tr.write_timeline(tl_path)
+            out["timeline_jsonl"] = tl_path
+        except OSError:
+            pass
+    return out
 
 
 def bench_llama_breakdown(batch=4, seq=2048, hidden=1536, layers=8,

@@ -5,7 +5,9 @@ SAME hybrid topology is a `jax.sharding.Mesh` with named axes and the
 Trainer's GSPMD shardings — XLA inserts the collectives. Includes the
 round-5 perf stack: fused flat-state AdamW (mixed bf16/fp32 tree),
 bf16 optimizer moments, gradient accumulation, device-prefetched
-ingest."""
+ingest — and the round-9 training observability: per-step phase
+histograms (stage/dispatch/sync), compile telemetry with automatic
+MFU, and a chrome trace you can open in Perfetto."""
 import numpy as np
 
 from _common import setup
@@ -29,7 +31,8 @@ def main():
     params = init_params(cfg, jax.random.PRNGKey(0))
     tr = Trainer(lambda p, t, l: loss_fn(p, t, l, cfg), mesh,
                  param_shardings(mesh, cfg), lr=3e-4,
-                 accumulate_steps=1, moment_dtype=jnp.bfloat16)
+                 accumulate_steps=1, moment_dtype=jnp.bfloat16,
+                 observability=True)
     state = tr.init_state(params)
 
     rng = np.random.RandomState(0)
@@ -41,11 +44,28 @@ def main():
 
     it = iter(batches())
     # device prefetch: batch N+1's h2d overlaps step N's compute
+    # (observability samples the staged-queue depth on each pull)
     pf = tr.prefetch((next(it) for _ in range(8)))
     for i, (toks, labels) in enumerate(pf):
         state, m = tr.step(state, toks, labels)
         print(f"step {i}: loss {float(m['loss']):.4f} "
               f"gnorm {float(m['grad_norm']):.3f}")
+
+    # training telemetry: per-step phase split, compile wall time,
+    # cost-analysis MFU, HBM breakdown
+    tm = tr.metrics()
+    st = tm["latency"]["step_ms"]
+    print(f"steps={tm['steps']} tokens/s={tm['tokens_per_sec']:.0f} "
+          f"step_ms p50={st['p50']} p99={st['p99']} "
+          f"compiles={tm['compiles']}")
+    if tm["mfu"]:
+        print(f"mfu={tm['mfu']['mfu']} (flops/step/device="
+              f"{tm['mfu']['flops_per_step_per_device']:.3g}, "
+              f"peak={tm['mfu']['peak_source']})")
+    tr.export_trace("train_trace.json")
+    tr.write_timeline("train_timeline.jsonl")
+    print("wrote train_trace.json + train_timeline.jsonl "
+          "(tools/trace_summary.py --mode train)")
 
 
 if __name__ == "__main__":

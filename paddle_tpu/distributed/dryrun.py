@@ -204,7 +204,8 @@ def _run_dryrun(n_devices: int, force_cpu: bool = True) -> None:
             return loss_fn(params, tokens, labels, cfg)
 
         trainer = Trainer(loss, mesh, specs,
-                          data_spec=P(("dp", "fsdp"), "sp"), lr=1e-3)
+                          data_spec=P(("dp", "fsdp"), "sp"), lr=1e-3,
+                          observability=True)
         state = trainer.init_state(params)
         B = max(mc.dp * mc.fsdp, 1) * 2
         S = max(mc.sp, 1) * 16
@@ -217,11 +218,20 @@ def _run_dryrun(n_devices: int, force_cpu: bool = True) -> None:
         jax.block_until_ready(metrics["loss"])
     loss0 = float(metrics["loss"])
     assert np.isfinite(loss0), f"non-finite loss {loss0}"
+    # the observed step must have telemetered its compile: wall time,
+    # cost-analysis flops (MFU numerator) and the per-step phase split
+    tm = trainer.metrics()
+    assert tm["compiles"] >= 1, tm
+    assert tm["latency"]["step_ms"]["count"] == 1, tm
+    comp = tm["compile"]["programs"]["train_step"]
     from ..ops.pallas._util import interpret_mode
     print(f"dryrun_multichip ok: n={n_devices} mesh="
           f"{dict(mesh.shape)} platform={devices[0].platform} "
           f"pallas_interpret={interpret_mode()} loss={loss0:.4f} "
-          f"grad_norm={float(metrics['grad_norm']):.4f}")
+          f"grad_norm={float(metrics['grad_norm']):.4f} "
+          f"compile_ms={comp['wall_ms_last']:.0f} "
+          f"flops/step={(comp.get('cost') or {}).get('flops', 0):.3g} "
+          f"hbm_total={((comp.get('memory') or {}).get('total_bytes', 0))}")
 
 
 def _run_dryrun_pp(n_devices: int, force_cpu: bool = True) -> None:

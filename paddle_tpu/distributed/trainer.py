@@ -19,6 +19,7 @@ comm groups — here it is ~200 lines because the compiler owns comm.
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -26,6 +27,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..observability import (CompileWatcher, HostGapDetector,
+                             Observability, TRAIN_HISTOGRAMS,
+                             live_hbm_bytes)
 
 __all__ = ["MeshConfig", "make_mesh", "TrainState", "Trainer"]
 
@@ -117,7 +122,10 @@ class Trainer:
                  grad_clip=1.0, accumulate_steps: int = 1,
                  donate: bool = True,
                  fused_optimizer: Optional[bool] = None,
-                 moment_dtype=None):
+                 moment_dtype=None,
+                 observability=False,
+                 host_gap_factor: float = 4.0,
+                 host_gap_min_ms: float = 50.0):
         """loss_fn(params, *batch) -> scalar. param_specs: pytree of
         PartitionSpec matching params.
 
@@ -138,6 +146,20 @@ class Trainer:
         single-chip ladder climb past ~1B params on 16GB; the update
         math still runs in fp32 (reference multi_precision AdamW,
         python/paddle/optimizer/adamw.py _multi_precision path).
+
+        observability: True (or an ``Observability`` instance) threads
+        the metrics/tracing harness through ``step()``/``prefetch()``:
+        per-step phase histograms (stage/h2d, compiled dispatch, host
+        sync), loss/grad-norm/prefetch-queue-depth/live-HBM gauges,
+        compile telemetry (wall time, retrace counts, cost-analysis
+        FLOPs for automatic MFU, memory-analysis HBM breakdown) and a
+        host-vs-device gap detector that emits a flight-recorder-style
+        dump when host-side time dwarfs the device wait (the llama-
+        bench h2d-residual failure mode). The observed step runs the
+        SAME jitted program through the AOT ``lower().compile()`` path
+        (identical HLO, bit-identical numerics) and adds ONE per-step
+        metrics sync; disabled, the hot path is byte-for-byte the old
+        one — no event objects, no extra device syncs.
         """
         self.loss_fn = loss_fn
         self.mesh = mesh
@@ -152,6 +174,28 @@ class Trainer:
         self._fused = False
         self._flat_meta = None
         self.moment_dtype = moment_dtype
+        # throughput counters exist in both modes (cheap dict ticks —
+        # the frozen metrics schema needs them); the harness itself is
+        # None when disabled, so the disabled loop allocates no event
+        # objects and issues no extra device syncs
+        self.counters = {"steps": 0, "samples": 0, "tokens": 0}
+        self._t_first: Optional[float] = None
+        self._t_last: Optional[float] = None
+        if observability:
+            self._obs = (observability
+                         if isinstance(observability, Observability)
+                         else Observability(histograms=TRAIN_HISTOGRAMS))
+            self._obs.registry.adopt_counters(self.counters)
+            self._compile = CompileWatcher(self._obs.registry,
+                                           self._obs.timeline)
+            self._gap = HostGapDetector(factor=host_gap_factor,
+                                        min_wall_ms=host_gap_min_ms)
+            self._compiled_cache: Dict = {}
+        else:
+            self._obs = None
+            self._compile = None
+            self._gap = None
+            self._compiled_cache = None
 
     # -- state init ----------------------------------------------------------
     @staticmethod
@@ -293,6 +337,10 @@ class Trainer:
         donate = (0,) if self._donate and not nan_check else ()
         self._step_nan = nan_check
         self._step_fn = jax.jit(step_fn, donate_argnums=donate)
+        if self._compiled_cache is not None:
+            # the program changed (nan-check flag flip): cached AOT
+            # executables compile against the OLD step_fn
+            self._compiled_cache.clear()
 
     def _fused_update(self, grads, state_tree, lr):
         """Single-pass Pallas AdamW over flat fp32 state (+ bf16 shadow).
@@ -367,7 +415,9 @@ class Trainer:
         behind the CURRENT step's compute, so steady-state step time is
         max(compute, transfer) instead of compute + transfer. ``batches``
         yields a tuple/list per step (the ``*batch`` of :meth:`step`) or
-        a single array."""
+        a single array. With observability on, each pull samples the
+        staged-queue depth as a gauge — a queue pinned at 0 means the
+        consumer is ingest-bound, at ``depth`` compute-bound."""
         from ..io.dataloader import _DevicePrefetchIter
 
         def stage(b):
@@ -375,13 +425,45 @@ class Trainer:
                 return tuple(self._stage_batch(x) for x in b)
             return self._stage_batch(b)
 
+        on_next = None
+        obs = self._obs
+        if obs is not None:
+            def on_next(qsize):
+                obs.registry.gauge("prefetch_queue_depth",
+                                   obs.gauge_window).set(qsize, obs.now())
+
         return _DevicePrefetchIter(iter(batches), stage,
-                                   depth=max(1, depth))
+                                   depth=max(1, depth), on_next=on_next)
+
+    # the trainer's OWN counter keys: reset_metrics()/metrics() touch
+    # exactly these — the counters dict is adopted by the registry and
+    # a bound flight recorder stores its dict-valued collective
+    # counters in the same dict, which a blanket zero would destroy
+    _COUNTER_KEYS = ("steps", "samples", "tokens")
+
+    def _count_step(self, batch, t_end: float):
+        """Throughput bookkeeping shared by both step paths: samples =
+        leading batch dims, tokens = full element count of the first
+        batch array (covers the (acc, B, S) accumulation layout)."""
+        self.counters["steps"] += 1
+        b0 = batch[0] if batch else None
+        shape = getattr(b0, "shape", None)
+        if shape:
+            if len(shape) >= 2:
+                self.counters["samples"] += int(np.prod(shape[:-1]))
+                self.counters["tokens"] += int(np.prod(shape))
+            else:
+                self.counters["samples"] += int(shape[0])
+        self._t_last = t_end
 
     def step(self, state: TrainState, *batch) -> Tuple[TrainState, Dict]:
         from ..core.flags import GLOBAL_FLAGS
         if self._step_fn is None or                 self._step_nan != bool(GLOBAL_FLAGS.get("check_nan_inf")):
             self._build()
+        if self._obs is not None:
+            return self._step_observed(state, batch)
+        if self._t_first is None:
+            self._t_first = time.perf_counter()
         batch = tuple(self._stage_batch(b) for b in batch)
         if getattr(self, "_lr_cache", None) is None or \
                 self._lr_cache[0] != self.lr:
@@ -390,8 +472,223 @@ class Trainer:
         with self.mesh:
             new_tree, metrics = self._step_fn(state.tree(),
                                               self._lr_cache[1], *batch)
+        self._count_step(batch, time.perf_counter())
         if "finite" in metrics and not bool(metrics.pop("finite")):
             raise FloatingPointError(
                 "check_nan_inf: non-finite loss/grad_norm in compiled "
                 f"train step (loss={float(metrics['loss'])})")
         return TrainState.from_tree(new_tree), metrics
+
+    # -- observed step (enabled mode) ---------------------------------------
+    def _compiled_for(self, tree, lr, staged):
+        """AOT executable for this abstract input signature, compiled
+        (and telemetered) once per signature through the CompileWatcher.
+        A signature miss after :meth:`reset_metrics` armed the watcher
+        is a steady-state retrace and warns — the train-loop analog of
+        the serving retrace watchdog. Returns ``(fn, compile_ms)`` so
+        the caller can attribute compile time to its own histogram
+        instead of the dispatch phase. The key hashes (treedef, shape,
+        dtype object) — dtype objects, not strings: re-stringifying
+        every leaf of a large param tree per step would be unattributed
+        host overhead in exactly the layer built to surface it."""
+        leaves, treedef = jax.tree_util.tree_flatten((tree, lr) + staged)
+        key = (treedef,
+               tuple((getattr(v, "shape", ()), getattr(v, "dtype", None))
+                     for v in leaves))
+        fn = self._compiled_cache.get(key)
+        if fn is not None:
+            return fn, 0.0
+        rec = self._compile
+        fn = rec.compile("train_step", self._step_fn, tree, lr, *staged)
+        self._compiled_cache[key] = fn
+        return fn, rec.programs["train_step"]["wall_s_last"] * 1e3
+
+    def _step_observed(self, state: TrainState, batch
+                       ) -> Tuple[TrainState, Dict]:
+        """The enabled-mode step: same program, phase-timed. Runs the
+        identical jitted ``step_fn`` through ``lower().compile()`` (the
+        HLO is the same, so loss/grad_norm stay bit-identical to the
+        disabled path) and splits the wall time into stage (batch h2d),
+        dispatch (compiled call returning) and sync (the wait for the
+        device) — the split the host-vs-device gap detector reads."""
+        obs = self._obs
+        t0 = obs.now()
+        if self._t_first is None:
+            self._t_first = t0
+        staged = tuple(self._stage_batch(b) for b in batch)
+        t_stage = obs.now()
+        if getattr(self, "_lr_cache", None) is None or \
+                self._lr_cache[0] != self.lr:
+            self._lr_cache = (self.lr, jnp.float32(self.lr))
+        tree = state.tree()
+        with self.mesh:
+            fn, compile_ms = self._compiled_for(
+                tree, self._lr_cache[1], staged)
+            new_tree, metrics = fn(tree, self._lr_cache[1], *staged)
+        t_disp = obs.now()
+        jax.block_until_ready(metrics)
+        t_sync = obs.now()
+        stage_ms = (t_stage - t0) * 1e3
+        # dispatch = key-build + cache lookup + the compiled call
+        # returning; a compile this step is timed by the watcher and
+        # excluded here rather than masquerading as dispatch work
+        dispatch_ms = max((t_disp - t_stage) * 1e3 - compile_ms, 0.0)
+        sync_ms = (t_sync - t_disp) * 1e3
+        step_ms = (t_sync - t0) * 1e3
+        self._count_step(batch, t_sync)
+        step_idx = self.counters["steps"]
+        for name, v in (("step_ms", step_ms), ("stage_ms", stage_ms),
+                        ("dispatch_ms", dispatch_ms),
+                        ("sync_ms", sync_ms)):
+            obs.hist(name).observe(v)
+        loss = float(metrics["loss"])
+        gnorm = float(metrics["grad_norm"])
+        vals = {"loss": loss, "grad_norm": gnorm}
+        hbm = live_hbm_bytes(self.mesh.devices.flat[0])
+        if hbm is not None:
+            vals["hbm_bytes_in_use"] = hbm
+        obs.sample_gauges(t_sync, vals)
+        obs.timeline.record(
+            "train_step", dur_ms=step_ms, step=step_idx,
+            stage_ms=round(stage_ms, 3),
+            dispatch_ms=round(dispatch_ms, 3),
+            sync_ms=round(sync_ms, 3), loss=round(loss, 6))
+        finding = self._gap.observe(step_idx, stage_ms, dispatch_ms,
+                                    sync_ms)
+        if finding is not None:
+            obs.timeline.record("host_gap", **finding)
+            if self._gap.should_dump():
+                obs.stall_dump(
+                    f"host-vs-device gap: step {step_idx} spent "
+                    f"{finding['host_ms']:.1f} ms on the host "
+                    f"(stage {finding['stage_ms']:.1f} + dispatch "
+                    f"{finding['dispatch_ms']:.1f}) vs "
+                    f"{finding['device_wait_ms']:.1f} ms waiting on "
+                    "the device — per-step h2d staging or host-side "
+                    "work owns this step, not compute",
+                    scheduler={"phase_split": finding,
+                               "mesh": {str(k): int(v) for k, v
+                                        in self.mesh.shape.items()},
+                               "accumulate_steps": self.accumulate_steps},
+                    metrics={"steps": step_idx})
+        if obs.step_deadline_s is not None \
+                and step_ms > obs.step_deadline_s * 1e3:
+            obs.stall_dump(
+                f"train step {step_idx} took {step_ms:.1f} ms "
+                f"(deadline {obs.step_deadline_s * 1e3:.1f} ms)",
+                scheduler={"step": step_idx,
+                           "phases": {"stage_ms": round(stage_ms, 3),
+                                      "dispatch_ms": round(dispatch_ms, 3),
+                                      "sync_ms": round(sync_ms, 3)}})
+        if "finite" in metrics and not bool(metrics.pop("finite")):
+            raise FloatingPointError(
+                "check_nan_inf: non-finite loss/grad_norm in compiled "
+                f"train step (loss={loss})")
+        return TrainState.from_tree(new_tree), metrics
+
+    # -- metrics / export ---------------------------------------------------
+    @property
+    def observability(self) -> Optional[Observability]:
+        return self._obs
+
+    def _require_obs(self) -> Observability:
+        if self._obs is None:
+            raise RuntimeError(
+                "observability is disabled for this trainer; construct "
+                "with Trainer(..., observability=True)")
+        return self._obs
+
+    def metrics(self) -> Dict:
+        """Training telemetry snapshot. Base keys (both modes): step /
+        sample / token counters and throughput over the current window.
+        With observability on: per-step phase histograms, gauges,
+        compile telemetry (count, wall time, cost/memory analysis),
+        cost-analysis-derived MFU, the train-step HBM breakdown, and
+        the host-gap / stall-dump / timeline counters.
+
+        Caveat (disabled mode only): the window closes at async
+        dispatch return — without a sync the device may still be
+        executing, so tokens/samples-per-sec are upper bounds unless
+        the caller reads a metric (``float(m["loss"])``) before
+        snapshotting. The observed step syncs per step, so its window
+        is exact."""
+        c = {k: self.counters[k] for k in self._COUNTER_KEYS}
+        wall = ((self._t_last - self._t_first)
+                if self._t_first is not None and self._t_last is not None
+                else 0.0)
+        c["wall_time_s"] = round(wall, 6)
+        c["samples_per_sec"] = (round(c["samples"] / wall, 3)
+                                if wall > 0 else 0.0)
+        c["tokens_per_sec"] = (round(c["tokens"] / wall, 3)
+                               if wall > 0 else 0.0)
+        if self._obs is None:
+            return c
+        obs = self._obs
+        c["latency"] = obs.latency_snapshot(TRAIN_HISTOGRAMS)
+        c["gauges"] = obs.gauges_snapshot()
+        comp = self._compile.snapshot()
+        c["compile"] = comp
+        c["compiles"] = comp["count"]
+        c["retrace_warnings"] = comp["retraces_after_warmup"]
+        c["mfu"] = self._compile.mfu("train_step", steps=c["steps"],
+                                     wall_s=wall)
+        prog = self._compile.programs.get("train_step")
+        c["hbm"] = prog.get("memory") if prog else None
+        c["host_gap_findings"] = len(self._gap.findings)
+        c["stall_dumps"] = (len(obs.stall_dumps)
+                            + obs.stall_dumps_suppressed)
+        c["timeline_events"] = len(obs.timeline)
+        c["timeline_dropped"] = obs.timeline.dropped
+        # a bound flight recorder parks per-(op, axis) call/byte
+        # counters in the shared dict and latency histograms in the
+        # registry; surface both as one sub-dict (conditional key, the
+        # prefix_cache idiom) — the histograms would otherwise be dead
+        # data reachable only by poking registry internals
+        calls = self.counters.get("collective_calls")
+        if calls:
+            c["collectives"] = {
+                "calls": dict(calls),
+                "bytes": dict(self.counters.get("collective_bytes", {})),
+                "latency_ms": {
+                    name[len("collective_"):-len("_ms")]: h.snapshot()
+                    for name, h in sorted(
+                        obs.registry.histograms.items())
+                    if name.startswith("collective_")
+                    and name.endswith("_ms")}}
+        return c
+
+    def reset_metrics(self):
+        """Zero the throughput window (e.g. after compile warmup).
+        With observability on this also restarts the histogram window
+        and ARMS the compile watcher: any train-step compile after this
+        call is a steady-state retrace and warns — the trainer analog
+        of the serving ``reset_metrics()`` watchdog contract. Only the
+        trainer's own counter keys reset — a bound flight recorder's
+        collective counters in the shared dict survive."""
+        for k in self._COUNTER_KEYS:
+            self.counters[k] = 0
+        self._t_first = self._t_last = None
+        if self._obs is not None:
+            self._obs.reset_window()
+            self._compile.arm()
+            # warmup's first-staging host gap must neither show up in
+            # the measured window's findings nor spend its dump budget
+            # (the PR-3 warmup-exclusion contract); already-written
+            # dump FILES stay counted — retention is about disk
+            self._gap.reset()
+
+    def export_trace(self, path: str) -> str:
+        """Write the per-step chrome trace (train_step/compile spans +
+        gauge counter tracks + any bound flight-recorder collective
+        tracks) — open in Perfetto / chrome://tracing."""
+        return self._require_obs().export_chrome(
+            path, process_name="paddle_tpu trainer")
+
+    def write_timeline(self, path: str) -> str:
+        """Write the structured per-step JSONL — input for
+        ``tools/trace_summary.py --mode train``."""
+        return self._require_obs().write_jsonl(
+            path, header={"mode": "train",
+                          "mesh": {str(k): int(v)
+                                   for k, v in self.mesh.shape.items()},
+                          "accumulate_steps": self.accumulate_steps})

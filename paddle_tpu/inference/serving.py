@@ -443,7 +443,8 @@ class ServingEngine:
             c["latency"] = obs.latency_snapshot()
             c["gauges"] = obs.gauges_snapshot()
             c["retrace_warnings"] = len(obs.watchdog.events)
-            c["stall_dumps"] = len(obs.stall_dumps)
+            c["stall_dumps"] = (len(obs.stall_dumps)
+                                + obs.stall_dumps_suppressed)
             c["timeline_events"] = len(obs.timeline)
             c["timeline_dropped"] = obs.timeline.dropped
         return c

@@ -65,12 +65,16 @@ class _DevicePrefetchIter:
 
     _END = ("end", None)
 
-    def __init__(self, src, stage, depth=2):
+    def __init__(self, src, stage, depth=2, on_next=None):
         self.q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
         self._done = False
         self._src = src
         self._stage = stage
+        # observability hook: called with the staged-queue depth after
+        # each consumer pull (a queue pinned at 0 = ingest-bound, at
+        # depth = compute-bound); must be cheap and never raise
+        self._on_next = on_next
         self._thread = threading.Thread(
             target=self._run, name="device-prefetch", daemon=True)
         self._thread.start()
@@ -111,6 +115,8 @@ class _DevicePrefetchIter:
                     self._done = True
                     raise StopIteration from None
         if kind == "item":
+            if self._on_next is not None:
+                self._on_next(self.q.qsize())
             return payload
         self._done = True
         self._stop.set()

@@ -1,45 +1,62 @@
-"""paddle_tpu.observability — serving-stack metrics, tracing and stall
-diagnostics.
+"""paddle_tpu.observability — metrics, tracing and stall diagnostics
+for the serving AND training/multichip stacks.
 
 One lightweight harness threaded through the serving path (and usable
-standalone around ``generate_paged``): a metrics registry (counters +
-gauges + streaming histograms with p50/p95/p99 export), per-request
-lifecycle timelines in a bounded ring buffer (chrome-trace export
-through ``profiler/``), a retrace watchdog, and flight-recorder stall
-dumps. Everything here is host-side bookkeeping: recording an event is
-a timestamp + a deque append, and **no code path issues a device sync**
-— the engine's one per-step d2h read stays the only synchronization
-point. When disabled the engine holds no harness at all (``None``), so
-the disabled hot loop allocates zero event objects.
+standalone around ``generate_paged``) and, since r9, through the
+hybrid-parallel ``Trainer`` and the collective flight recorder: a
+metrics registry (counters + gauges + streaming histograms with
+p50/p95/p99 export), lifecycle timelines in a bounded ring buffer
+(chrome-trace export through ``profiler/``), compile telemetry
+(``compile.py``: compile wall time, retrace counts, cost-analysis MFU,
+memory-analysis HBM breakdown, host-vs-device gap detection), a
+retrace watchdog, and flight-recorder stall dumps. Everything here is
+host-side bookkeeping: recording an event is a timestamp + a deque
+append, and **no code path issues a device sync** — the owning
+component decides its sync points (the engine's one per-step d2h read;
+the observed trainer's one per-step metrics sync). When disabled the
+component holds no harness at all (``None``), so the disabled hot
+loop allocates zero event objects.
 """
 from __future__ import annotations
 
-import os
 import time
 from collections import deque
-from typing import Dict, Optional
+from typing import Dict, Optional, Sequence
 
+from .compile import (CompileWatcher, HostGapDetector, device_peak_flops,
+                      live_hbm_bytes)
 from .metrics import Gauge, Histogram, MetricsRegistry
-from .stall import dump_stall
+from .stall import dump_path_for, dump_stall
 from .timeline import Timeline, TimelineEvent
 from .watchdog import RetraceWatchdog
 
 __all__ = ["Observability", "MetricsRegistry", "Histogram", "Gauge",
-           "Timeline", "TimelineEvent", "RetraceWatchdog", "dump_stall"]
+           "Timeline", "TimelineEvent", "RetraceWatchdog", "dump_stall",
+           "CompileWatcher", "HostGapDetector", "device_peak_flops",
+           "live_hbm_bytes", "LATENCY_HISTOGRAMS", "TRAIN_HISTOGRAMS"]
 
 # the latency histograms every engine window reports (schema-stable:
 # tests freeze this set — extend deliberately, never ad hoc)
 LATENCY_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "e2e_ms",
                       "prefill_chunk_ms", "decode_step_ms", "step_ms")
 
+# the per-step phase histograms every trainer window reports (same
+# contract): stage = batch h2d staging, dispatch = the compiled call
+# returning (host work under async dispatch), sync = the wait for the
+# device, compile = AOT compile wall time
+TRAIN_HISTOGRAMS = ("step_ms", "stage_ms", "dispatch_ms", "sync_ms",
+                    "compile_ms")
+
 
 class Observability:
     """Per-component observability harness.
 
     Owns one :class:`MetricsRegistry`, one :class:`Timeline` ring, one
-    :class:`RetraceWatchdog` and the stall-dump plumbing. The engine
+    :class:`RetraceWatchdog` and the stall-dump plumbing. The component
     holds either an instance (enabled) or ``None`` (disabled — zero
-    overhead, no event objects ever allocated).
+    overhead, no event objects ever allocated). ``histograms`` selects
+    the pre-created latency set: :data:`LATENCY_HISTOGRAMS` (serving,
+    default) or :data:`TRAIN_HISTOGRAMS` (trainer).
     """
 
     def __init__(self, ring_capacity: int = 4096,
@@ -47,16 +64,27 @@ class Observability:
                  step_deadline_s: Optional[float] = None,
                  stall_dump_path: Optional[str] = None,
                  warn_on_retrace: bool = True,
-                 max_request_records: int = 2048):
+                 max_request_records: int = 2048,
+                 max_stall_dumps: int = 8,
+                 histograms: Sequence[str] = LATENCY_HISTOGRAMS):
         self.registry = MetricsRegistry()
         self.timeline = Timeline(ring_capacity)
         self.watchdog = RetraceWatchdog(warn=warn_on_retrace)
         self.gauge_window = int(gauge_window)
         self.step_deadline_s = step_deadline_s
         self.stall_dump_path = stall_dump_path
-        self.stall_dumps = []          # [(reason, path)]
+        self.max_stall_dumps = int(max_stall_dumps)
+        # bounded log of (reason, path): with a path configured only
+        # written files land here (<= max_stall_dumps); the stderr
+        # route is uncapped by design, so the deque bounds a flapping
+        # trigger's memory
+        self.stall_dumps: deque = deque(
+            maxlen=max(64, self.max_stall_dumps))
+        self.stall_dumps_suppressed = 0
         self.request_records: deque = deque(maxlen=max_request_records)
-        for name in LATENCY_HISTOGRAMS:
+        self._flight = None            # bound FlightRecorder, if any
+        self._hist_names = tuple(histograms)
+        for name in self._hist_names:
             self.registry.histogram(name, unit="ms")
 
     # -- recording shortcuts ------------------------------------------
@@ -84,16 +112,32 @@ class Observability:
             record = dict(record, warmup=True)
         self.request_records.append(record)
 
+    # -- flight recorder binding --------------------------------------
+    def bind_flight_recorder(self, recorder):
+        """Unify a collective :class:`FlightRecorder` with this
+        harness: completed collectives feed per-(op, axis) latency
+        histograms + bytes-moved counters into this registry, hang
+        dumps share the stall-dump retention policy, and chrome-trace
+        export gains the recorder's per-rank collective tracks."""
+        recorder.bind(registry=self.registry, clock=self.now)
+        self._flight = recorder
+        return recorder
+
     # -- stall diagnostics --------------------------------------------
     def stall_dump(self, reason: str, scheduler: Dict,
                    metrics: Optional[Dict] = None) -> str:
-        path = self.stall_dump_path
-        if path and self.stall_dumps:
-            # successive dumps must not clobber the first report
-            # (splitext, not rpartition: a dot in a parent directory
-            # must not get the counter spliced into it)
-            base, ext = os.path.splitext(path)
-            path = f"{base}.{len(self.stall_dumps)}{ext}"
+        path, suppressed = dump_path_for(
+            self.stall_dump_path,
+            sum(1 for _, p in self.stall_dumps if p),
+            self.max_stall_dumps)
+        if suppressed:
+            # file-retention bound hit: count, don't append — a
+            # flapping trigger past the cap must not grow the log
+            # without bound (stderr-routed dumps are never capped —
+            # dump_path_for)
+            self.stall_dumps_suppressed += 1
+            self.timeline.record("stall", reason=reason, suppressed=True)
+            return ""
         self.timeline.record("stall", reason=reason)
         written = dump_stall(reason, scheduler, self.timeline.tail(),
                              metrics=metrics, path=path)
@@ -108,17 +152,24 @@ class Observability:
         self.registry.reset_histograms()
         self.request_records.clear()
 
-    def latency_snapshot(self) -> Dict:
+    def latency_snapshot(self, names: Optional[Sequence[str]] = None
+                         ) -> Dict:
+        names = self._hist_names if names is None else names
         return {name: self.registry.histogram(name).snapshot()
-                for name in LATENCY_HISTOGRAMS}
+                for name in names}
 
     def gauges_snapshot(self) -> Dict:
         return {name: g.snapshot()
                 for name, g in sorted(self.registry.gauges.items())}
 
-    def export_chrome(self, path: str) -> str:
+    def export_chrome(self, path: str,
+                      process_name: str = "paddle_tpu serving") -> str:
+        extra = None
+        if self._flight is not None:
+            extra = self._flight.to_host_events()
         return self.timeline.export_chrome(
-            path, gauges=self.registry.gauges)
+            path, gauges=self.registry.gauges,
+            process_name=process_name, extra_host_events=extra)
 
     def write_jsonl(self, path: str, header: Optional[Dict] = None
                     ) -> str:

@@ -25,10 +25,13 @@ from typing import Dict, List, Optional
 __all__ = ["TimelineEvent", "Timeline"]
 
 # canonical event taxonomy (DESIGN.md "observability" section); meta
-# keys ride alongside, e.g. prefill_chunk carries pos0/n/bucket
+# keys ride alongside, e.g. prefill_chunk carries pos0/n/bucket and
+# train_step carries step/stage_ms/dispatch_ms/sync_ms
 EVENT_NAMES = ("submit", "admit", "prefill_chunk", "first_token",
                "decode_step", "finish", "drain_truncated", "stall",
-               "retrace", "prefix_evict")
+               "retrace", "prefix_evict",
+               # training/multichip events (r9)
+               "train_step", "compile", "host_gap", "collective")
 
 
 class TimelineEvent:
@@ -119,9 +122,12 @@ class Timeline:
         return host_events
 
     def export_chrome(self, path: str, gauges: Optional[Dict] = None,
-                      process_name: str = "paddle_tpu serving") -> str:
+                      process_name: str = "paddle_tpu serving",
+                      extra_host_events=None) -> str:
         """Write a chrome-trace json of the ring (plus gauge series as
-        counter tracks) via the profiler's shared trace writer."""
+        counter tracks, plus any pre-built ``extra_host_events`` spans —
+        e.g. the flight recorder's per-rank collective tracks) via the
+        profiler's shared trace writer."""
         from ..profiler.profiler import write_chrome_trace
 
         extra = []
@@ -135,7 +141,11 @@ class Timeline:
                 extra.append({"name": name, "ph": "C",
                               "ts": t * 1e6,
                               "args": {"value": v}})
-        write_chrome_trace(path, self.to_host_events(),
+        host_events = self.to_host_events()
+        if extra_host_events:
+            host_events = sorted(host_events + list(extra_host_events),
+                                 key=lambda e: e.start_ns)
+        write_chrome_trace(path, host_events,
                            process_name=process_name, extra_events=extra)
         return path
 

@@ -20,12 +20,14 @@ failed for two rounds straight. This prober runs for the whole session:
   capture resumes at the next healthy probe;
 - ``bench.py`` serves the freshest captured result (flagged with its
   age) whenever its own live probe fails;
-- the serving configs run with the observability layer on, so each
-  capture banks its full per-phase timeline JSONL
-  (``BENCH_SERVING_TIMELINE.jsonl`` / ``BENCH_PREFIX_TIMELINE.jsonl``,
-  summarized by ``tools/trace_summary.py``) next to this file — a
-  short healthy TPU window yields TTFT/TPOT/queue-wait distributions,
-  not point estimates.
+- the serving AND training configs run with the observability layer
+  on, so each capture banks its full per-phase timeline JSONL
+  (``BENCH_SERVING_TIMELINE.jsonl`` / ``BENCH_PREFIX_TIMELINE.jsonl`` /
+  ``BENCH_TRAIN_TIMELINE.jsonl``, summarized by
+  ``tools/trace_summary.py`` — ``--mode train`` for the trainer's
+  stage/dispatch/sync phase split and host-vs-device gap report) next
+  to this file — a short healthy TPU window yields step-time and
+  TTFT/TPOT/queue-wait distributions, not point estimates.
 
 Run detached:  nohup python tools/opportunistic_bench.py &
 """

@@ -1,9 +1,10 @@
 #!/usr/bin/env python
-"""Summarize a serving-timeline JSONL (ServingEngine.write_timeline).
+"""Summarize an observability-timeline JSONL.
 
 Reads the structured per-phase JSONL the observability layer emits next
 to each BENCH capture and prints, without needing a browser:
 
+serving mode (ServingEngine.write_timeline):
 - per-phase breakdown: count / total / mean / max wall time per event
   name (decode_step, prefill_chunk, ...),
 - the top-N slowest timed steps (the retrace or allocator hiccup is
@@ -11,7 +12,17 @@ to each BENCH capture and prints, without needing a browser:
 - per-request latency distributions (queue wait, TTFT, TPOT, e2e)
   with p50/p95/p99 computed from the request records.
 
-Usage:  python tools/trace_summary.py TIMELINE.jsonl [--top 10] [--json]
+train mode (Trainer.write_timeline, ``--mode train`` or auto-detected
+from the meta header):
+- per-phase breakdown of the step: stage (batch h2d), dispatch
+  (compiled call), sync (device wait) totals/means,
+- host-vs-device gap per step (host = stage + dispatch vs device =
+  sync) with the worst offenders listed — the llama h2d-residual
+  diagnosis, from a file,
+- top-N slowest steps and every compile event (program, wall time).
+
+Usage:  python tools/trace_summary.py TIMELINE.jsonl
+            [--mode auto|serving|train] [--top 10] [--json]
 """
 import argparse
 import json
@@ -125,18 +136,129 @@ def render(summary):
     return "\n".join(lines)
 
 
+def summarize_train(meta, events, top=10, gap_factor=4.0,
+                    min_wall_ms=50.0):
+    """Train-mode summary over ``train_step``/``compile``/``host_gap``
+    events: per-phase totals, host-vs-device gap per step, slowest
+    steps, compile log. ``host_bound_steps`` applies the SAME predicate
+    as the live HostGapDetector (ratio > gap_factor AND wall >=
+    min_wall_ms) — the offline diagnosis must not contradict the live
+    one on identical data (fast steps have huge ratios but no one
+    cares about a 2 ms step)."""
+    out = {"meta": {k: meta.get(k) for k in
+                    ("schema", "events", "dropped", "mode", "mesh",
+                     "accumulate_steps") if k in meta}}
+    steps = [ev for ev in events if ev.get("name") == "train_step"]
+    phases = {}
+    for key in ("stage_ms", "dispatch_ms", "sync_ms"):
+        vals = sorted(ev[key] for ev in steps if ev.get(key) is not None)
+        if vals:
+            phases[key] = {"count": len(vals),
+                           "total_ms": round(sum(vals), 3),
+                           "mean_ms": round(sum(vals) / len(vals), 3),
+                           "p50_ms": round(_percentile(vals, 0.50), 3),
+                           "max_ms": round(vals[-1], 3)}
+    out["phases"] = phases
+
+    gaps = []
+    for ev in steps:
+        host = (ev.get("stage_ms") or 0.0) + (ev.get("dispatch_ms")
+                                              or 0.0)
+        dev = ev.get("sync_ms")
+        if dev is None:
+            continue
+        gaps.append({"step": ev.get("step"),
+                     "host_ms": round(host, 3),
+                     "device_wait_ms": round(dev, 3),
+                     "ratio": round(host / max(dev, 1e-3), 1),
+                     "host_bound": (host > gap_factor * max(dev, 1e-3)
+                                    and host + dev >= min_wall_ms)})
+    # genuinely host-bound steps first (then by host time): sorting on
+    # raw ratio would bury the one real 3 s host-bound step under a
+    # pile of trivially fast steps whose sync rounds to ~0
+    gaps.sort(key=lambda g: (not g["host_bound"], -g["host_ms"]))
+    out["host_device_gap"] = {
+        "steps": len(gaps),
+        "host_bound_steps": sum(1 for g in gaps if g["host_bound"]),
+        "worst": gaps[:top]}
+
+    timed = [ev for ev in steps if ev.get("dur_ms") is not None]
+    timed.sort(key=lambda e: -e["dur_ms"])
+    out["slowest_steps"] = timed[:top]
+    out["compiles"] = [{k: ev.get(k) for k in
+                        ("program", "dur_ms", "count")}
+                       for ev in events if ev.get("name") == "compile"]
+    out["host_gap_events"] = sum(1 for ev in events
+                                 if ev.get("name") == "host_gap")
+    out["stalls"] = [ev.get("reason") for ev in events
+                     if ev.get("name") == "stall"]
+    return out
+
+
+def render_train(summary):
+    lines = []
+    m = summary["meta"]
+    lines.append(f"train timeline: {m.get('events', '?')} events "
+                 f"({m.get('dropped', 0)} dropped), mesh="
+                 f"{m.get('mesh')}")
+    lines.append("")
+    lines.append(f"{'phase':<14}{'count':>7}{'total ms':>12}"
+                 f"{'mean ms':>10}{'p50 ms':>10}{'max ms':>10}")
+    for name, p in summary["phases"].items():
+        lines.append(f"{name:<14}{p['count']:>7}{p['total_ms']:>12}"
+                     f"{p['mean_ms']:>10}{p['p50_ms']:>10}"
+                     f"{p['max_ms']:>10}")
+    g = summary["host_device_gap"]
+    lines.append("")
+    lines.append(f"host-vs-device: {g['host_bound_steps']}/{g['steps']} "
+                 "steps host-bound")
+    for w in g["worst"][:5]:
+        lines.append(f"  step {w['step']}: host {w['host_ms']} ms vs "
+                     f"device wait {w['device_wait_ms']} ms "
+                     f"({w['ratio']}x)")
+    if summary["compiles"]:
+        lines.append("")
+        lines.append("compiles:")
+        for c in summary["compiles"]:
+            lines.append(f"  {c.get('program')}: {c.get('dur_ms'):.1f} ms"
+                         f" (#{c.get('count')})")
+    if summary["slowest_steps"]:
+        lines.append("")
+        lines.append(f"top {len(summary['slowest_steps'])} slowest steps:")
+        for ev in summary["slowest_steps"]:
+            lines.append(f"  {ev['dur_ms']:>10.3f} ms  step "
+                         f"{ev.get('step')}")
+    if summary["stalls"]:
+        lines.append("")
+        lines.append(f"stalls: {len(summary['stalls'])}")
+        for r in summary["stalls"][:5]:
+            lines.append(f"  {r}")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="timeline JSONL file")
+    ap.add_argument("--mode", choices=("auto", "serving", "train"),
+                    default="auto",
+                    help="summary flavor (auto reads the meta header)")
     ap.add_argument("--top", type=int, default=10,
                     help="slowest steps to list (default 10)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
     args = ap.parse_args(argv)
     meta, events, requests = load(args.path)
-    summary = summarize(meta, events, requests, top=args.top)
-    print(json.dumps(summary, indent=1) if args.json
-          else render(summary))
+    mode = args.mode
+    if mode == "auto":
+        mode = meta.get("mode", "serving")
+    if mode == "train":
+        summary = summarize_train(meta, events, top=args.top)
+        print(json.dumps(summary, indent=1) if args.json
+              else render_train(summary))
+    else:
+        summary = summarize(meta, events, requests, top=args.top)
+        print(json.dumps(summary, indent=1) if args.json
+              else render(summary))
     return 0
 
 
