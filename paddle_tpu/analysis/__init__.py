@@ -1,0 +1,38 @@
+"""paddle_tpu.analysis — static program auditing over jaxpr/HLO.
+
+The reference framework leans on compiler-level static passes over its
+IR (PIR DCE / constant-fold / promotion checks) to catch whole bug
+classes before execution. This package is the TPU-native analog: rule
+passes over the ``ClosedJaxpr`` (and, where available, the lowered
+StableHLO) of any jitted program — or of an abstract-signature entry in
+the :class:`ProgramRegistry` — that turn dtype leaks, missed donation,
+retrace hazards, mismatched collectives and constant bloat into a CI
+gate instead of a post-hoc runtime diagnosis. The motivating specimen:
+PR-4's compile telemetry only caught the AdamW ``1 - b1 ** step``
+float64 promotion *at runtime*, after it had silently doubled
+master-weight HBM and hidden a retrace inside every prior bench window.
+:func:`audit_program` catches that class with zero execution.
+
+Everything here is trace-time only: auditing never lowers, compiles or
+runs the program, and never mutates the audited jit object's caches.
+"""
+from __future__ import annotations
+
+from .auditor import (AuditReport, audit_program, audit_registry,
+                      audit_spec, diff_findings, findings_to_json,
+                      load_baseline, publish_findings, write_baseline)
+from .registry import (REGISTRY, ProgramRegistry, ProgramSpec,
+                       abstract_signature, register_program)
+from .rules import (ALL_RULES, Finding, collective_consistency_rule,
+                    constant_bloat_rule, donation_rule,
+                    dtype_promotion_rule, retrace_hazard_rule)
+
+__all__ = [
+    "AuditReport", "Finding", "ProgramRegistry", "ProgramSpec",
+    "REGISTRY", "ALL_RULES", "abstract_signature", "audit_program",
+    "audit_registry", "audit_spec", "diff_findings", "findings_to_json",
+    "dtype_promotion_rule",
+    "donation_rule", "retrace_hazard_rule", "collective_consistency_rule",
+    "constant_bloat_rule", "load_baseline", "publish_findings",
+    "register_program", "write_baseline",
+]

@@ -1,0 +1,181 @@
+"""Canonical program catalog for the audit gate.
+
+``tools/program_audit.py`` and the tier-1 ``pytest -m audit`` test need
+one shared, deterministic set of "the programs this framework ships":
+the hybrid-parallel trainer step, the fused eager-optimizer step, the
+serving engine's decode + per-bucket prefill programs, the prefix-cache
+COW page copier, and a shard_map collectives program. The builders here
+construct each one at a TINY, CPU-traceable size — audits only trace,
+so tiny shapes exercise the identical program structure the production
+sizes compile — and register the specs through the same component hooks
+production code uses (``Trainer.audit_spec``,
+``ServingEngine.program_specs``, ``Optimizer.audit_spec``), keeping the
+catalog honest: it cannot drift from what the components actually run.
+
+``build_catalog`` returns the specs; it does not audit. The deliberate
+REGRESSION specimen (the pre-fix AdamW update, kept as a tracing
+fixture for the dtype rule's self-test and the CLI's
+``--demo-regression`` gate check) is opt-in and never part of the
+default catalog.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["build_catalog", "build_demo_regression", "CATALOG_PROGRAMS"]
+
+# the default gate set, in audit order
+CATALOG_PROGRAMS = ("train_step", "fused_optimizer_step",
+                    "serving_decode", "serving_prefill_16",
+                    "serving_prefill_32", "serving_page_copy",
+                    "collectives")
+
+
+def _tiny_llama_cfg(seq: int = 64):
+    from ..models.llama import LlamaConfig
+    return LlamaConfig(vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_hidden_layers=2,
+                       num_attention_heads=2, num_key_value_heads=2,
+                       max_position_embeddings=seq, remat=False)
+
+
+def _trainer_spec(register: bool):
+    import jax
+    import numpy as np
+    from ..distributed.trainer import MeshConfig, Trainer, make_mesh
+    from ..models.llama import init_params, loss_fn, param_shardings
+
+    cfg = _tiny_llama_cfg(seq=32)
+    mesh = make_mesh(MeshConfig())
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tr = Trainer(lambda p, t, l: loss_fn(p, t, l, cfg), mesh,
+                 param_shardings(mesh, cfg), lr=1e-4)
+    state = tr.init_state(params)
+    toks = np.zeros((2, 32), np.int32)
+    return tr.audit_spec(state, toks, np.zeros((2, 32), np.int32),
+                         register=register)
+
+
+def _fused_optimizer_spec(register: bool):
+    import numpy as np
+    import paddle_tpu as paddle
+    from ..optimizer import AdamW
+
+    w = paddle.to_tensor(np.zeros((64, 64), np.float32),
+                         stop_gradient=False)
+    b = paddle.to_tensor(np.zeros((64,), np.float32),
+                         stop_gradient=False)
+    loss = (w.sum() + b.sum())
+    loss.backward()
+    opt = AdamW(learning_rate=1e-3, parameters=[w, b], weight_decay=0.01)
+    opt.step()          # builds + records the fused update program
+    return opt.audit_spec(register=register)
+
+
+def _serving_specs(register: bool):
+    import jax
+    from ..inference.serving import ServingEngine
+    from ..models.llama import init_params
+
+    cfg = _tiny_llama_cfg(seq=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(params, cfg, capacity=2, block_size=8,
+                        max_seq_len=64, prefill_buckets=(16, 32),
+                        prefix_cache=True)
+    return eng.program_specs(register=register)
+
+
+def _collectives_spec(register: bool):
+    """A representative multichip program: shard_map over the full
+    device set with the collective families the flight recorder's op
+    taxonomy tracks (psum / all_gather / ppermute)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from ..core.jax_compat import shard_map
+    from .registry import ProgramSpec, REGISTRY
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs.reshape(len(devs), 1), ("dp", "tp"))
+
+    n = len(devs)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(x):
+        y = jax.lax.psum(x, "dp")
+        g = jax.lax.all_gather(y, "tp")
+        z = jax.lax.ppermute(g.sum(0), "dp", perm)
+        return jax.lax.psum(z, "tp")
+
+    fn = jax.jit(shard_map(body, mesh=mesh,
+                           in_specs=P("dp", None), out_specs=P(),
+                           check_rep=False))
+    spec = ProgramSpec(
+        name="collectives", fn=fn,
+        args=(jax.ShapeDtypeStruct((2 * len(devs), 8), jnp.float32),),
+        mesh_axes=("dp", "tp"), tags=("distributed",))
+    if register:
+        REGISTRY.register(spec)
+    return spec
+
+
+def build_catalog(names: Optional[List[str]] = None,
+                  register: bool = True):
+    """Build the canonical ProgramSpecs (all of CATALOG_PROGRAMS, or
+    the requested subset). Building is trace-free — specs hold only
+    callables + abstract signatures."""
+    wanted = set(names) if names is not None else set(CATALOG_PROGRAMS)
+    unknown = wanted - set(CATALOG_PROGRAMS)
+    if unknown:
+        # a typo'd (or since-renamed) program name must never let a CI
+        # gate pass vacuously after auditing nothing
+        raise ValueError(
+            f"unknown catalog program(s): {sorted(unknown)} — known: "
+            f"{list(CATALOG_PROGRAMS)}")
+    specs = []
+    if "train_step" in wanted:
+        specs.append(_trainer_spec(register))
+    if "fused_optimizer_step" in wanted:
+        specs.append(_fused_optimizer_spec(register))
+    if wanted & {"serving_decode", "serving_prefill_16",
+                 "serving_prefill_32", "serving_page_copy"}:
+        specs.extend(s for s in _serving_specs(register)
+                     if s.name in wanted)
+    if "collectives" in wanted:
+        specs.append(_collectives_spec(register))
+    return specs
+
+
+def build_demo_regression(register: bool = False):
+    """The PRE-FIX AdamW update as an auditable spec: ``1 - b1**step``
+    with an int32 step drops its weak type under the global x64 flag
+    and widens the fp32 master tree to float64 — the bug PR-4's compile
+    telemetry caught at runtime and this auditor catches statically.
+    Used by the rule self-test and the CLI's ``--demo-regression``
+    injected-regression check; never in the default catalog."""
+    import jax
+    import jax.numpy as jnp
+    from .registry import ProgramSpec, REGISTRY
+
+    def prefix_adamw(master, mu, nu, step, lr, g):
+        b1, b2, eps = 0.9, 0.95, 1e-8
+        step = step + 1
+        mu_n = b1 * mu + (1 - b1) * g
+        nu_n = b2 * nu + (1 - b2) * jnp.square(g)
+        mhat = mu_n / (1 - b1 ** step)          # the bug: f64 under x64
+        vhat = nu_n / (1 - b2 ** step)
+        m_n = master - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return m_n, mu_n, nu_n, step
+
+    f32 = lambda shape: jax.ShapeDtypeStruct(shape, jnp.float32)  # noqa: E731
+    spec = ProgramSpec(
+        name="demo_regression_adamw",
+        fn=jax.jit(prefix_adamw, donate_argnums=(0, 1, 2, 3)),
+        args=(f32((256,)), f32((256,)), f32((256,)),
+              jax.ShapeDtypeStruct((), jnp.int32), f32(()), f32((256,))),
+        donate_argnums=(0, 1, 2, 3),
+        carry={0: 0, 1: 1, 2: 2, 3: 3}, tags=("demo",))
+    if register:
+        REGISTRY.register(spec)
+    return spec

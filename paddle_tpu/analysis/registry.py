@@ -1,0 +1,168 @@
+"""Program registry: named jitted programs + abstract signatures.
+
+The auditor never wants live buffers — a program is fully auditable
+from its callable plus the *abstract* signature it is called with
+(shape/dtype/weak-type per leaf, the same key the observability
+CompileWatcher hashes). :class:`ProgramSpec` records exactly that, plus
+the static metadata the rule passes consume: declared donation, static
+argnums (and their recorded values — a float static is a retrace per
+distinct value), the mesh axis names collectives may reference, and a
+carry map describing which outputs feed which inputs on the next call
+(the state-threading contract whose dtype drift IS the retrace-causing
+AdamW bug class).
+
+A module-level :data:`REGISTRY` collects the specs the framework's
+components hand over (``Trainer.audit()``, ``ServingEngine.audit()``,
+the fused optimizer, the catalog builders in :mod:`.catalog`), so
+``tools/program_audit.py`` and the bench gates audit one shared set.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = ["ProgramSpec", "ProgramRegistry", "REGISTRY",
+           "abstract_signature", "register_program"]
+
+
+def _abstract_leaf(v):
+    """Leaf -> ShapeDtypeStruct (weak-type preserved where the aval
+    carries it); non-array leaves pass through untouched."""
+    import jax
+
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is None or dtype is None:
+        return v
+    try:
+        weak = bool(getattr(getattr(v, "aval", None), "weak_type", False))
+        return jax.ShapeDtypeStruct(shape, dtype, weak_type=weak) \
+            if weak else jax.ShapeDtypeStruct(shape, dtype)
+    except TypeError:
+        # older jax: no weak_type kwarg
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def abstract_signature(tree):
+    """Pytree of arrays -> pytree of ``ShapeDtypeStruct``. Works on
+    live arrays, already-abstract structs, and DONATED (deleted)
+    arrays — deletion frees the buffer but keeps shape/dtype metadata,
+    which is all an audit needs."""
+    import jax
+
+    return jax.tree_util.tree_map(_abstract_leaf, tree)
+
+
+def signature_key(args: Tuple, kwargs: Dict) -> Tuple:
+    """Hashable (treedef, per-leaf (shape, dtype-str, weak)) key for a
+    call signature — the retrace-hazard rule compares these."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (treedef, tuple(
+        (tuple(getattr(v, "shape", ())), str(getattr(v, "dtype", type(v))),
+         bool(getattr(getattr(v, "aval", None), "weak_type",
+                      getattr(v, "weak_type", False))))
+        for v in leaves))
+
+
+@dataclass
+class ProgramSpec:
+    """One auditable program: callable + abstract call signature +
+    static metadata for the rule passes.
+
+    ``carry`` maps flat OUTPUT leaf index -> flat INPUT leaf index for
+    state threaded between calls (new_state out feeds state in). The
+    retrace-hazard rule compares the paired avals: a dtype/shape drift
+    there is a guaranteed retrace on the next call.
+    """
+    name: str
+    fn: Callable
+    args: Tuple = ()
+    kwargs: Dict = field(default_factory=dict)
+    donate_argnums: Tuple[int, ...] = ()
+    static_argnums: Tuple[int, ...] = ()
+    static_argvals: Tuple = ()
+    mesh_axes: Tuple[str, ...] = ()
+    carry: Optional[Dict[int, int]] = None
+    tags: Tuple[str, ...] = ()
+    signatures: List[Tuple] = field(default_factory=list)
+
+    def record_signature(self, args: Tuple = None, kwargs: Dict = None):
+        """Record one observed call signature (deduplicated). With no
+        arguments, records the spec's own args — so registering a spec
+        always leaves at least its declared signature on file."""
+        args = self.args if args is None else args
+        kwargs = self.kwargs if kwargs is None else (kwargs or {})
+        key = signature_key(args, kwargs)
+        if key not in self.signatures:
+            self.signatures.append(key)
+        return key
+
+
+class ProgramRegistry:
+    """Name -> :class:`ProgramSpec`, latest registration wins."""
+
+    def __init__(self):
+        self._specs: Dict[str, ProgramSpec] = {}
+
+    def register(self, spec: ProgramSpec) -> ProgramSpec:
+        spec.record_signature()
+        old = self._specs.get(spec.name)
+        if old is not None and old.fn is spec.fn:
+            # same name AND same callable = the same program being
+            # re-registered (e.g. Trainer.audit after the observed
+            # step recorded compile signatures): keep the observed
+            # history — wiping it would blind MULTIPLE_SIGNATURES.
+            # A different callable under the same name is a genuinely
+            # new program; inheriting a stranger's signatures would
+            # fabricate drift, so those start fresh.
+            for sig in old.signatures:
+                if sig not in spec.signatures:
+                    spec.signatures.append(sig)
+        self._specs[spec.name] = spec
+        return spec
+
+    def record_signature(self, name: str, *args, **kwargs):
+        spec = self._specs.get(name)
+        if spec is not None:
+            spec.record_signature(abstract_signature(args),
+                                  abstract_signature(kwargs))
+
+    def get(self, name: str) -> Optional[ProgramSpec]:
+        return self._specs.get(name)
+
+    def names(self) -> List[str]:
+        return sorted(self._specs)
+
+    def specs(self) -> List[ProgramSpec]:
+        return [self._specs[n] for n in self.names()]
+
+    def remove(self, name: str):
+        self._specs.pop(name, None)
+
+    def clear(self):
+        self._specs.clear()
+
+    def __len__(self):
+        return len(self._specs)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._specs
+
+
+REGISTRY = ProgramRegistry()
+
+
+def register_program(name: str, fn: Callable, *args,
+                     registry: Optional[ProgramRegistry] = None,
+                     **meta) -> ProgramSpec:
+    """Convenience: build a spec with an abstracted signature and
+    register it. ``meta`` forwards ProgramSpec fields (donate_argnums,
+    static_argnums, mesh_axes, carry, tags...)."""
+    kwargs = meta.pop("kwargs", {})
+    spec = ProgramSpec(name=name, fn=fn,
+                       args=tuple(abstract_signature(args)),
+                       kwargs=dict(abstract_signature(kwargs)),
+                       **meta)
+    return (registry if registry is not None else REGISTRY).register(spec)

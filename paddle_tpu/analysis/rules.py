@@ -1,0 +1,525 @@
+"""Rule passes over traced program artifacts.
+
+Each rule is a pure function ``rule(art: ProgramArtifacts, **cfg) ->
+List[Finding]`` over the traced artifacts of ONE program. Rules never
+execute or compile the program — they walk jaxprs (recursively through
+pjit/scan/while/cond/shard_map sub-jaxprs) and compare abstract values,
+so an audit is safe to run against a production registry entry.
+
+The catalog of rules and the bug class each one catches:
+
+- ``dtype_promotion``   — float64 appearing in a ≤f32-input program
+  (weak-type widening under the global x64 flag: the ``1 - b1**step``
+  AdamW bug class) and large silent bf16→f32 upcasts.
+- ``donation``          — declared ``donate_argnums`` vs the aliasing
+  the avals actually admit: donated-but-unaliasable inputs (wasted
+  declaration) and large state-shaped inputs that could be donated.
+- ``retrace_hazard``    — multiple recorded call signatures, float
+  static args, and carry (state out -> state in) dtype/shape/weak-type
+  drift — each one a guaranteed or likely steady-state retrace.
+- ``collective_consistency`` — collective axis names that exist in no
+  enclosing mesh, cond branches whose collective sequences differ
+  (rank-divergent issue order = deadlock), collectives under a
+  data-dependent while.
+- ``constant_bloat``    — large constants baked into the jaxpr (they
+  ride into every executable copy and bloat HBM silently).
+
+Finding identity (``fingerprint``) is ``program::rule::code::site``
+with ``site`` a rule-chosen stable discriminator — the baseline diff in
+:mod:`.auditor` keys on it, so message wording can improve without
+churning baselines.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Finding", "ProgramArtifacts", "ALL_RULES",
+           "dtype_promotion_rule", "donation_rule", "retrace_hazard_rule",
+           "collective_consistency_rule", "constant_bloat_rule"]
+
+SEVERITIES = ("error", "warning", "info")
+
+# collective primitives whose axis names must exist and whose issue
+# order must be rank-invariant; axis_index only *names* an axis (no
+# synchronization), so it joins the axis check but not the order lint
+_SYNC_COLLECTIVES = frozenset({
+    "psum", "pmin", "pmax", "ppermute", "pbroadcast", "all_gather",
+    "all_to_all", "psum_scatter", "pgather", "reduce_scatter"})
+_AXIS_PRIMS = _SYNC_COLLECTIVES | {"axis_index"}
+
+
+@dataclass
+class Finding:
+    """One audit finding. ``to_dict()`` is the FROZEN export schema
+    (tests pin the key set): rule, code, severity, program, site,
+    message, detail, fingerprint."""
+    rule: str
+    code: str
+    severity: str
+    program: str
+    message: str
+    site: str = ""
+    detail: Dict = field(default_factory=dict)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.program}::{self.rule}::{self.code}::{self.site}"
+
+    def to_dict(self) -> Dict:
+        return {"rule": self.rule, "code": self.code,
+                "severity": self.severity, "program": self.program,
+                "site": self.site, "message": self.message,
+                "detail": dict(self.detail),
+                "fingerprint": self.fingerprint}
+
+
+@dataclass
+class ProgramArtifacts:
+    """Traced artifacts handed to every rule: the ambient-config
+    ClosedJaxpr, the x64-probed ClosedJaxpr (traced under
+    ``jax_enable_x64`` to surface latent weak-type widening; None when
+    the ambient config already has x64 on or the probe failed), and
+    the flat input/output avals + per-flat-input donation mask."""
+    spec: object
+    closed: object
+    closed_x64: Optional[object] = None
+    in_avals: Tuple = ()
+    out_avals: Tuple = ()
+    donated: Tuple[bool, ...] = ()
+    in_avals_x64: Tuple = ()
+    out_avals_x64: Tuple = ()
+
+
+# -- jaxpr walking ------------------------------------------------------
+
+
+def _as_jaxpr(obj):
+    """ClosedJaxpr-or-Jaxpr -> (jaxpr, consts) | None (duck-typed: no
+    private jax imports to break on)."""
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner, tuple(getattr(obj, "consts", ()) or ())
+    if hasattr(obj, "eqns") and hasattr(obj, "invars"):
+        return obj, ()
+    return None
+
+
+def iter_subjaxprs(eqn) -> Iterator[Tuple[str, object, Tuple]]:
+    """Yield (param_name, jaxpr, consts) for every sub-jaxpr in an
+    eqn's params (branches tuples, scan/while/cond bodies, shard_map
+    and pjit inner jaxprs)."""
+    for k, v in eqn.params.items():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vals:
+            r = _as_jaxpr(item)
+            if r is not None:
+                yield k, r[0], r[1]
+
+
+def walk_eqns(closed) -> Iterator[object]:
+    """Depth-first over every eqn of a (Closed)Jaxpr, descending into
+    all sub-jaxprs."""
+    r = _as_jaxpr(closed)
+    if r is None:
+        return
+    stack = [r[0]]
+    while stack:
+        jaxpr = stack.pop()
+        for eqn in jaxpr.eqns:
+            yield eqn
+            for _, sub, _ in iter_subjaxprs(eqn):
+                stack.append(sub)
+
+
+def walk_consts(closed) -> Iterator[object]:
+    """Every constant captured by the jaxpr or any sub-jaxpr."""
+    r = _as_jaxpr(closed)
+    if r is None:
+        return
+    for c in r[1]:
+        yield c
+    stack = [r[0]]
+    while stack:
+        jaxpr = stack.pop()
+        for eqn in jaxpr.eqns:
+            for _, sub, consts in iter_subjaxprs(eqn):
+                for c in consts:
+                    yield c
+                stack.append(sub)
+
+
+def _dtype_of(x):
+    d = getattr(x, "dtype", None)
+    if d is None:
+        return None
+    try:
+        return np.dtype(d)
+    except TypeError:
+        return None     # extended dtypes (PRNG keys) have no np.dtype
+
+
+def _is_wide_float(dt) -> bool:
+    return dt is not None and (dt == np.float64 or dt == np.complex128)
+
+
+def _nbytes(x) -> int:
+    dt = _dtype_of(x)
+    shape = tuple(getattr(x, "shape", ()) or ())
+    if dt is None:
+        return 0
+    return int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+
+
+def _aval_str(a) -> str:
+    dt = _dtype_of(a)
+    return f"{dt.name if dt is not None else '?'}{list(getattr(a, 'shape', ()))}"
+
+
+# -- rule 1: dtype promotion --------------------------------------------
+
+
+def dtype_promotion_rule(art: ProgramArtifacts, *,
+                         upcast_min_bytes: int = 8 << 20) -> List[Finding]:
+    """f64 ops in a ≤f32-input program; large bf16→f32 upcasts."""
+    out: List[Finding] = []
+    name = art.spec.name
+    # x64-probed trace preferred: the bug class only MANIFESTS when the
+    # global x64 flag is on, which is exactly what the probe simulates
+    closed = art.closed_x64 if art.closed_x64 is not None else art.closed
+    in_avals = art.in_avals_x64 if art.closed_x64 is not None \
+        else art.in_avals
+    if not any(_is_wide_float(_dtype_of(a)) for a in in_avals):
+        offenders = []
+        for eqn in walk_eqns(closed):
+            for v in eqn.outvars:
+                dt = _dtype_of(getattr(v, "aval", None))
+                if _is_wide_float(dt):
+                    offenders.append((eqn.primitive.name,
+                                      _aval_str(v.aval)))
+        for c in walk_consts(closed):
+            if _is_wide_float(_dtype_of(c)):
+                offenders.append(("const", _aval_str(c)))
+        if offenders:
+            prim, aval = offenders[0]
+            out.append(Finding(
+                rule="dtype_promotion", code="F64_PROMOTION",
+                severity="error", program=name,
+                site=f"{prim}:{aval}",
+                message=(
+                    f"{len(offenders)} float64 value(s) inside a program "
+                    f"whose inputs are all <= float32 (first: {prim} -> "
+                    f"{aval}) — a Python-scalar op dropped its weak type "
+                    "under the global x64 flag (the `1 - b1**step` AdamW "
+                    "class): state widens, HBM doubles, and the next call "
+                    "retraces"),
+                detail={"f64_ops": len(offenders),
+                        "first_primitive": prim, "first_aval": aval,
+                        "probed_x64": art.closed_x64 is not None}))
+    # large bf16 -> f32 upcasts (ambient trace: these exist with or
+    # without x64); intentional master-weight upcasts live above the
+    # threshold only for genuinely large operands
+    total, count, first = 0, 0, None
+    for eqn in walk_eqns(art.closed):
+        if eqn.primitive.name != "convert_element_type":
+            continue
+        if not eqn.invars:
+            continue
+        src = getattr(eqn.invars[0], "aval", None)
+        dst = getattr(eqn.outvars[0], "aval", None)
+        if src is None or dst is None:
+            continue
+        sdt, ddt = _dtype_of(src), _dtype_of(dst)
+        if (sdt is not None and ddt == np.float32
+                and str(sdt) == "bfloat16"
+                and _nbytes(dst) >= upcast_min_bytes):
+            count += 1
+            total += _nbytes(dst)
+            if first is None:
+                first = _aval_str(dst)
+    if count:
+        out.append(Finding(
+            rule="dtype_promotion", code="BF16_UPCAST_BLOAT",
+            severity="info", program=name, site=f"bf16->f32:{first}",
+            message=(f"{count} bf16->f32 upcast(s) totalling "
+                     f"{total >> 20} MiB of f32 output (first: {first}) "
+                     "— fine for master-weight math, silent HBM bloat "
+                     "anywhere else"),
+            detail={"upcasts": count, "total_bytes": total,
+                    "first_aval": first}))
+    return out
+
+
+# -- rule 2: donation ---------------------------------------------------
+
+
+def donation_rule(art: ProgramArtifacts, *,
+                  min_bytes: int = 1 << 20) -> List[Finding]:
+    """Declared donation vs what the avals can actually alias."""
+    out: List[Finding] = []
+    name = art.spec.name
+    in_avals, out_avals = art.in_avals, art.out_avals
+    donated = art.donated
+    if not in_avals or not out_avals or len(donated) != len(in_avals):
+        return out
+    key = lambda a: (tuple(getattr(a, "shape", ()) or ()),  # noqa: E731
+                     str(_dtype_of(a)))
+    claimed = [False] * len(out_avals)
+
+    def claim(a) -> bool:
+        k = key(a)
+        for j, o in enumerate(out_avals):
+            if not claimed[j] and key(o) == k:
+                claimed[j] = True
+                return True
+        return False
+
+    # donated inputs claim matching outputs first — exactly XLA's
+    # donation matching order — so a donatable-but-undonated report
+    # never double-counts an output a donated buffer already covers
+    for i, a in enumerate(in_avals):
+        if donated[i] and not claim(a):
+            out.append(Finding(
+                rule="donation", code="DONATED_UNALIASED",
+                severity="warning", program=name,
+                site=f"arg{i}:{_aval_str(a)}",
+                message=(f"donated input {i} ({_aval_str(a)}) matches no "
+                         "output shape/dtype — the donation is ignored "
+                         "at runtime (XLA warns per execution) and the "
+                         "buffer is still invalidated for the caller"),
+                detail={"flat_arg": i, "aval": _aval_str(a),
+                        "bytes": _nbytes(a)}))
+    for i, a in enumerate(in_avals):
+        if donated[i] or _nbytes(a) < min_bytes:
+            continue
+        if claim(a):
+            out.append(Finding(
+                rule="donation", code="DONATABLE_NOT_DONATED",
+                severity="warning", program=name,
+                site=f"arg{i}:{_aval_str(a)}",
+                message=(f"input {i} ({_aval_str(a)}, "
+                         f"{_nbytes(a) >> 20} MiB) matches an output "
+                         "and is not donated — the program holds two "
+                         "copies of state XLA could update in place"),
+                detail={"flat_arg": i, "aval": _aval_str(a),
+                        "bytes": _nbytes(a)}))
+    return out
+
+
+# -- rule 3: retrace hazards --------------------------------------------
+
+
+def retrace_hazard_rule(art: ProgramArtifacts) -> List[Finding]:
+    """Signature drift, float statics, and carry aval drift."""
+    out: List[Finding] = []
+    spec = art.spec
+    name = spec.name
+    sigs = list(getattr(spec, "signatures", ()) or ())
+    if len(sigs) > 1:
+        out.append(Finding(
+            rule="retrace_hazard", code="MULTIPLE_SIGNATURES",
+            severity="warning", program=name, site="signatures",
+            message=(f"{len(sigs)} distinct call signatures recorded — "
+                     "every distinct abstract signature is one full "
+                     "retrace + compile; steady state should see one"),
+            detail={"signatures": len(sigs)}))
+    for idx, v in enumerate(getattr(spec, "static_argvals", ()) or ()):
+        if isinstance(v, float):
+            out.append(Finding(
+                rule="retrace_hazard", code="FLOAT_STATIC_ARG",
+                severity="warning", program=name, site=f"static{idx}",
+                message=(f"static arg {idx} carries a float ({v!r}) — "
+                         "every distinct value bakes a new program; "
+                         "floats should ride as traced scalars"),
+                detail={"static_index": idx, "value": v}))
+    carry = getattr(spec, "carry", None)
+    if carry:
+        # prefer the x64 probe: the AdamW master-tree widening only
+        # shows there, and THAT trace is the one the x64 user runs
+        if art.closed_x64 is not None and art.out_avals_x64:
+            in_avals, out_avals = art.in_avals_x64, art.out_avals_x64
+            probed = True
+        else:
+            in_avals, out_avals = art.in_avals, art.out_avals
+            probed = False
+        for o, i in sorted(carry.items()):
+            if o >= len(out_avals) or i >= len(in_avals):
+                continue
+            oa, ia = out_avals[o], in_avals[i]
+            odt, idt = _dtype_of(oa), _dtype_of(ia)
+            oshape = tuple(getattr(oa, "shape", ()) or ())
+            ishape = tuple(getattr(ia, "shape", ()) or ())
+            if odt != idt:
+                out.append(Finding(
+                    rule="retrace_hazard", code="CARRY_DTYPE_DRIFT",
+                    severity="error", program=name,
+                    site=f"out{o}->in{i}",
+                    message=(f"carried state drifts dtype: output {o} "
+                             f"({_aval_str(oa)}) feeds input {i} "
+                             f"({_aval_str(ia)}) on the next call — "
+                             "guaranteed retrace, and widened state "
+                             "stays widened"),
+                    detail={"out_index": o, "in_index": i,
+                            "out_aval": _aval_str(oa),
+                            "in_aval": _aval_str(ia),
+                            "probed_x64": probed}))
+            elif oshape != ishape:
+                out.append(Finding(
+                    rule="retrace_hazard", code="CARRY_SHAPE_DRIFT",
+                    severity="error", program=name,
+                    site=f"out{o}->in{i}",
+                    message=(f"carried state drifts shape: output {o} "
+                             f"({_aval_str(oa)}) feeds input {i} "
+                             f"({_aval_str(ia)}) — guaranteed retrace "
+                             "every call"),
+                    detail={"out_index": o, "in_index": i,
+                            "out_aval": _aval_str(oa),
+                            "in_aval": _aval_str(ia)}))
+            elif (bool(getattr(oa, "weak_type", False))
+                  != bool(getattr(ia, "weak_type", False))):
+                out.append(Finding(
+                    rule="retrace_hazard", code="CARRY_WEAK_DRIFT",
+                    severity="warning", program=name,
+                    site=f"out{o}->in{i}",
+                    message=(f"carried state drifts weak type between "
+                             f"output {o} and input {i} — weak type is "
+                             "part of the jit signature, so the next "
+                             "call retraces once"),
+                    detail={"out_index": o, "in_index": i}))
+    return out
+
+
+# -- rule 4: collective consistency -------------------------------------
+
+
+def _collective_axes(eqn) -> List[str]:
+    axes = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+    if not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return [a for a in axes if isinstance(a, str)]
+
+
+def _collective_sequence(jaxpr) -> List[Tuple[str, Tuple[str, ...]]]:
+    """Ordered (primitive, axes) sequence of synchronizing collectives
+    under ``jaxpr``, descending into sub-jaxprs in program order."""
+    seq = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _SYNC_COLLECTIVES:
+            seq.append((eqn.primitive.name,
+                        tuple(_collective_axes(eqn))))
+        for _, sub, _ in iter_subjaxprs(eqn):
+            seq.extend(_collective_sequence(sub))
+    return seq
+
+
+def collective_consistency_rule(art: ProgramArtifacts) -> List[Finding]:
+    out: List[Finding] = []
+    name = art.spec.name
+    root_axes = set(getattr(art.spec, "mesh_axes", ()) or ())
+    conds = whiles = 0
+
+    def visit(jaxpr, env: set):
+        nonlocal conds, whiles
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim in _AXIS_PRIMS:
+                for ax in _collective_axes(eqn):
+                    if ax not in env:
+                        out.append(Finding(
+                            rule="collective_consistency",
+                            code="UNKNOWN_COLLECTIVE_AXIS",
+                            severity="error", program=name,
+                            site=f"{prim}@{ax}",
+                            message=(f"{prim} references axis {ax!r} "
+                                     "which exists in no enclosing mesh "
+                                     f"(axes in scope: {sorted(env)}) — "
+                                     "this program cannot run on the "
+                                     "declared mesh"),
+                            detail={"primitive": prim, "axis": ax,
+                                    "in_scope": sorted(env)}))
+            sub_env = env
+            if prim == "shard_map":
+                mesh = eqn.params.get("mesh")
+                axis_names = tuple(getattr(mesh, "axis_names", ()) or ())
+                if axis_names:
+                    sub_env = env | set(axis_names)
+            elif prim in ("pmap", "xla_pmap"):
+                ax = eqn.params.get("axis_name")
+                if isinstance(ax, str):
+                    sub_env = env | {ax}
+            if prim == "cond":
+                conds += 1
+                branches = eqn.params.get("branches", ())
+                seqs = []
+                for b in branches:
+                    r = _as_jaxpr(b)
+                    seqs.append(_collective_sequence(r[0]) if r else [])
+                if seqs and any(s != seqs[0] for s in seqs[1:]):
+                    out.append(Finding(
+                        rule="collective_consistency",
+                        code="COND_COLLECTIVE_DIVERGENCE",
+                        severity="warning", program=name,
+                        site=f"cond#{conds}",
+                        message=(
+                            "cond branches issue different collective "
+                            f"sequences ({[len(s) for s in seqs]} "
+                            "collectives per branch) — if the predicate "
+                            "ever differs across ranks, issue order "
+                            "diverges and the mesh deadlocks"),
+                        detail={"cond_index": conds,
+                                "branch_sequences": [
+                                    [f"{p}@{','.join(a)}" for p, a in s]
+                                    for s in seqs]}))
+            if prim == "while":
+                whiles += 1
+                body = eqn.params.get("body_jaxpr")
+                r = _as_jaxpr(body) if body is not None else None
+                if r and _collective_sequence(r[0]):
+                    out.append(Finding(
+                        rule="collective_consistency",
+                        code="COLLECTIVE_IN_WHILE",
+                        severity="info", program=name,
+                        site=f"while#{whiles}",
+                        message=(
+                            "collective inside a while body — a rank-"
+                            "divergent trip count (data-dependent "
+                            "predicate) would desynchronize collective "
+                            "issue order across the mesh"),
+                        detail={"while_index": whiles}))
+            for _, sub, _ in iter_subjaxprs(eqn):
+                visit(sub, sub_env)
+
+    r = _as_jaxpr(art.closed)
+    if r is not None:
+        visit(r[0], root_axes)
+    return out
+
+
+# -- rule 5: constant bloat ---------------------------------------------
+
+
+def constant_bloat_rule(art: ProgramArtifacts, *,
+                        min_bytes: int = 1 << 20) -> List[Finding]:
+    out: List[Finding] = []
+    name = art.spec.name
+    n = 0
+    for c in walk_consts(art.closed):
+        nb = _nbytes(c)
+        if nb >= min_bytes:
+            n += 1
+            out.append(Finding(
+                rule="constant_bloat", code="LARGE_CONSTANT",
+                severity="warning", program=name,
+                site=f"const#{n}:{_aval_str(c)}",
+                message=(f"constant {_aval_str(c)} ({nb >> 20} MiB) is "
+                         "baked into the jaxpr — it ships inside every "
+                         "executable and dodges the allocator; pass it "
+                         "as an argument instead"),
+                detail={"aval": _aval_str(c), "bytes": nb}))
+    return out
+
+
+ALL_RULES = (dtype_promotion_rule, donation_rule, retrace_hazard_rule,
+             collective_consistency_rule, constant_bloat_rule)

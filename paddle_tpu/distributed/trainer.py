@@ -84,13 +84,22 @@ def _adamw_update(grads, state: Tuple, lr, b1=0.9, b2=0.95, eps=1e-8,
     gnorm = jnp.sqrt(gnorm_sq)
     scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12)) \
         if grad_clip else 1.0
+    # bias corrections pinned to float32: `b1 ** step` with an int32
+    # step promotes through float64 under the global x64 flag (the
+    # Python float drops its weak type against the integer array),
+    # which widened the whole master tree after step 1 and recompiled
+    # step 2 in every earlier bench window. pow(f32, f32) is the same
+    # computation the weak-typed path ran in f32 mode — bit-identical.
+    stepf = step.astype(jnp.float32)
+    bc1 = 1.0 - jnp.float32(b1) ** stepf
+    bc2 = 1.0 - jnp.float32(b2) ** stepf
 
     def upd(g, m, mu_i, nu_i):
         g32 = g.astype(jnp.float32) * scale
         mu_n = b1 * mu_i.astype(jnp.float32) + (1 - b1) * g32
         nu_n = b2 * nu_i.astype(jnp.float32) + (1 - b2) * jnp.square(g32)
-        mhat = mu_n / (1 - b1 ** step)
-        vhat = nu_n / (1 - b2 ** step)
+        mhat = mu_n / bc1
+        vhat = nu_n / bc2
         m_n = m * (1.0 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps)
         # moments keep their stored dtype (bf16 under a reduced
         # moment_dtype policy) so state shapes/dtypes are step-invariant
@@ -501,6 +510,24 @@ class Trainer:
         rec = self._compile
         fn = rec.compile("train_step", self._step_fn, tree, lr, *staged)
         self._compiled_cache[key] = fn
+        # feed the static analyzer's registry (only on a compile, so
+        # zero steady-state cost): the first compile REGISTERS this
+        # trainer's spec, later compiles record their signatures into
+        # it — a second distinct signature is what the retrace-hazard
+        # rule reports as MULTIPLE_SIGNATURES. Recording is gated on
+        # spec.fn being THIS step_fn: another trainer (or the audit
+        # catalog) owning the name must not inherit our signatures.
+        try:
+            from ..analysis import REGISTRY as _AREG
+            spec = _AREG.get("train_step")
+            if spec is None or spec.fn is not self._step_fn:
+                _AREG.register(self._build_audit_spec(tree, lr, staged))
+            else:
+                from ..analysis import abstract_signature as _abs
+                spec.record_signature(tuple(_abs((tree, lr) + staged)),
+                                      {})
+        except Exception:  # noqa: BLE001 — telemetry must never raise
+            pass
         return fn, rec.programs["train_step"]["wall_s_last"] * 1e3
 
     def _step_observed(self, state: TrainState, batch
@@ -586,6 +613,55 @@ class Trainer:
                 f"train step (loss={loss})")
         return TrainState.from_tree(new_tree), metrics
 
+    # -- static program audit -----------------------------------------------
+    def _build_audit_spec(self, tree, lr, batch):
+        """The ONE definition of the train step's ProgramSpec (shared
+        by :meth:`audit_spec` and the observed step's compile hook, so
+        carry/donation metadata cannot drift between them): abstract
+        signature, the state-leaf carry map (new state out feeds state
+        in next call — the contract whose dtype drift was the AdamW
+        x64 bug), declared donation, and the mesh axis names."""
+        from ..analysis import ProgramSpec, abstract_signature
+        n_state = len(jax.tree_util.tree_leaves(tree))
+        return ProgramSpec(
+            name="train_step", fn=self._step_fn,
+            args=tuple(abstract_signature((tree, lr) + tuple(batch))),
+            donate_argnums=(0,) if self._donate else (),
+            carry={i: i for i in range(n_state)},
+            mesh_axes=tuple(str(a) for a in self.mesh.axis_names),
+            tags=("trainer",))
+
+    def audit_spec(self, state: TrainState, *batch, register: bool = True):
+        """Build the :class:`paddle_tpu.analysis.ProgramSpec` for the
+        compiled train step at THIS state/batch signature (no buffers
+        captured). ``register=True`` also files it in the global
+        analysis registry so ``tools/program_audit.py`` sees it."""
+        from ..analysis import REGISTRY
+        from ..core.flags import GLOBAL_FLAGS
+        if self._step_fn is None or \
+                self._step_nan != bool(GLOBAL_FLAGS.get("check_nan_inf")):
+            self._build()
+        spec = self._build_audit_spec(state.tree(),
+                                      jnp.float32(self.lr), batch)
+        if register:
+            REGISTRY.register(spec)
+        return spec
+
+    def audit(self, state: TrainState, *batch, register: bool = True):
+        """Static program audit of the train step (trace-only, nothing
+        executes, the jit cache is untouched): runs the
+        ``paddle_tpu.analysis`` rule passes — dtype promotion, donation,
+        retrace hazards, collective consistency, constant bloat — and
+        returns the :class:`AuditReport`. Findings land in the
+        ``audit_findings`` counter (and the timeline, when
+        observability is on)."""
+        from ..analysis import audit_spec as _audit, publish_findings
+        spec = self.audit_spec(state, *batch, register=register)
+        with self.mesh:
+            report = _audit(spec)
+        publish_findings(report, counters=self.counters, obs=self._obs)
+        return report
+
     # -- metrics / export ---------------------------------------------------
     @property
     def observability(self) -> Optional[Observability]:
@@ -621,6 +697,10 @@ class Trainer:
                                 if wall > 0 else 0.0)
         c["tokens_per_sec"] = (round(c["tokens"] / wall, 3)
                                if wall > 0 else 0.0)
+        if "audit_findings" in self.counters:
+            # conditional key (the prefix_cache idiom): present only
+            # after a static program audit ran against this trainer
+            c["audit_findings"] = self.counters["audit_findings"]
         if self._obs is None:
             return c
         obs = self._obs

@@ -734,6 +734,18 @@ class ServingEngine:
         self.counters["requests_completed"] += 1
 
     # -- jitted programs ----------------------------------------------
+    # decode step args: (params, tok, seq_lens, tables, temps, key,
+    # k_pools, v_pools) -> (tok, seq_lens, key, k_pools, v_pools).
+    # ONE declaration of which args are donated and which outputs feed
+    # which args next call — _make_decode_fn and program_specs both
+    # read these, so the audit spec cannot drift from the program
+    _DECODE_DONATE = (1, 2, 5, 6, 7)
+    _DECODE_CARRY = {0: 1, 1: 2, 2: 5, 3: 6, 4: 7}   # out idx -> argnum
+    # prefill chunk args: (params, toks, pos0, table, wtable, last_idx,
+    # temp, key, k_pools, v_pools) -> (tok, key, k_pools, v_pools)
+    _PREFILL_DONATE = (7, 8, 9)
+    _PREFILL_CARRY = {1: 7, 2: 8, 3: 9}
+
     def _make_decode_fn(self):
         cfg, counters = self.cfg, self.counters
         scales = self._kv_scales    # closed over: fixed after calibration
@@ -751,7 +763,11 @@ class ServingEngine:
             seq_lens = jnp.where(seq_lens > 0, seq_lens + 1, 0)
             return nxt, seq_lens, key, k_pools, v_pools
 
-        return jax.jit(step, donate_argnums=(6, 7))
+        # donate the whole carried state, not just the pools: tok/seq/
+        # key are replaced by this call's outputs every step (on host
+        # mutation the mirrors re-upload fresh arrays), so the old
+        # buffers update in place — the donation audit's own finding
+        return jax.jit(step, donate_argnums=self._DECODE_DONATE)
 
     def _make_prefill_fn(self, P: int):
         cfg, counters = self.cfg, self.counters
@@ -795,7 +811,9 @@ class ServingEngine:
             tok = _sample_slots(lg, sub, temp[None])[0]
             return tok, key, k_pools, v_pools
 
-        return jax.jit(chunk, donate_argnums=(8, 9))
+        # key is carried state exactly like the pools: the caller
+        # rebinds self._d_key to the returned key, so donate it too
+        return jax.jit(chunk, donate_argnums=self._PREFILL_DONATE)
 
     def _calibrate(self, prompt: np.ndarray):
         cfg, counters = self.cfg, self.counters
@@ -817,3 +835,78 @@ class ServingEngine:
         k_amax, v_amax = self._calib_fn(self.params, jnp.asarray(toks))
         self._kv_scales = (jnp.maximum(k_amax / 127.0, 1e-8),
                            jnp.maximum(v_amax / 127.0, 1e-8))
+
+    # -- static program audit -----------------------------------------
+    def program_specs(self, register: bool = True):
+        """:class:`paddle_tpu.analysis.ProgramSpec` entries for the
+        engine's jitted programs — the decode step, one prefill per
+        bucket, and (with a prefix cache) the COW page copier — with
+        abstract signatures derived from the engine's own shapes. The
+        fns are FRESH jit instances, so auditing them can never disturb
+        the live programs' compilation caches; their traced python
+        bodies do tick the trace counters, which :meth:`audit`
+        snapshots and restores."""
+        from ..analysis import ProgramSpec, REGISTRY, abstract_signature
+        sds = jax.ShapeDtypeStruct
+        C, MB = self.capacity, self.max_blocks
+        params_sd = abstract_signature(self.params)
+        pools_sd = abstract_signature(self._k_pools)
+        key_sd = abstract_signature(self._d_key)
+        n_p = len(jax.tree_util.tree_leaves(params_sd))
+        # arg 0 is the params pytree (n_p flat leaves); every later
+        # arg is a single leaf, so argnum k>0 sits at flat index
+        # n_p + (k - 1) — the class-level carry maps (argnum-keyed, the
+        # same declarations the jit donate_argnums read) convert here
+        flat = lambda argnum: n_p + argnum - 1          # noqa: E731
+        specs = [ProgramSpec(
+            name="serving_decode", fn=self._make_decode_fn(),
+            args=(params_sd, sds((C,), jnp.int32), sds((C,), jnp.int32),
+                  sds((C, MB), jnp.int32), sds((C,), jnp.float32),
+                  key_sd, pools_sd, pools_sd),
+            donate_argnums=self._DECODE_DONATE,
+            carry={o: flat(a) for o, a in self._DECODE_CARRY.items()},
+            tags=("serving",))]
+        # pos0/last_idx ride at the platform default int width
+        # (serving._run_prefill stages them with a bare jnp.asarray)
+        idx_dt = jnp.asarray(0).dtype
+        for P in self.buckets:
+            specs.append(ProgramSpec(
+                name=f"serving_prefill_{P}", fn=self._make_prefill_fn(P),
+                args=(params_sd, sds((1, P), jnp.int32), sds((), idx_dt),
+                      sds((MB,), jnp.int32), sds((MB,), jnp.int32),
+                      sds((), idx_dt), sds((), jnp.float32), key_sd,
+                      pools_sd, pools_sd),
+                donate_argnums=self._PREFILL_DONATE,
+                carry={o: flat(a)
+                       for o, a in self._PREFILL_CARRY.items()},
+                tags=("serving",)))
+        if self._pcache is not None:
+            specs.append(ProgramSpec(
+                name="serving_page_copy", fn=self._copy_fn,
+                args=(pools_sd, pools_sd, sds((), jnp.int32),
+                      sds((), jnp.int32)),
+                donate_argnums=(0, 1), carry={0: 0, 1: 1},
+                tags=("serving",)))
+        if register:
+            for s in specs:
+                REGISTRY.register(s)
+        return specs
+
+    def audit(self, register: bool = True):
+        """Static audit of every engine program (trace-only — nothing
+        executes, live compiled programs are untouched, and the trace
+        counters the tier-1 suite pins are snapshotted/restored).
+        Returns the list of :class:`AuditReport`; the finding count
+        lands in the ``audit_findings`` counter."""
+        from ..analysis import audit_spec as _audit, publish_findings
+        import copy
+        snap = {k: copy.deepcopy(self.counters[k])
+                for k in ("decode_traces", "prefill_traces",
+                          "calibration_traces")}
+        try:
+            reports = [_audit(s)
+                       for s in self.program_specs(register=register)]
+        finally:
+            self.counters.update(snap)
+        publish_findings(reports, counters=self.counters, obs=self._obs)
+        return reports

@@ -248,6 +248,16 @@ class Optimizer:
         lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
         step = jnp.asarray(self._global_step + 1, dtype=jnp.float32)
 
+        # abstract signature banked for the static program auditor —
+        # ShapeDtypeStructs only, so no donated/live buffer is retained
+        # past this step (holding g_vals would pin a param-tree of
+        # HBM). Re-banked only when the fused-cache key changes, so the
+        # steady-state step pays a single tuple compare
+        if getattr(self, "_audit_key", None) != key:
+            self._audit_key = key
+            self._audit_entry = (fn, jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype),
+                (p_vals, g_vals, acc_vals, master_vals, lr, step)))
         new_ps, new_accs, new_masters = fn(p_vals, g_vals, acc_vals,
                                            master_vals, lr, step)
         mi = 0
@@ -261,6 +271,48 @@ class Optimizer:
 
     def _post_apply(self):
         pass
+
+    # -- static program audit ------------------------------------------------
+    def audit_spec(self, register: bool = True):
+        """:class:`paddle_tpu.analysis.ProgramSpec` for the fused
+        update program of the LAST ``step()`` (raises before the first
+        step — the program and its signature only exist then). The
+        carry map pairs params/accumulators/masters outputs with their
+        donated inputs; grads are deliberately NOT donated (``p.grad``
+        stays readable after ``step()``), which the donation rule
+        accepts because the donated params already claim the matching
+        outputs."""
+        entry = getattr(self, "_audit_entry", None)
+        if entry is None:
+            raise RuntimeError(
+                "no fused update recorded — run one optimizer step() "
+                "before audit()")
+        from ..analysis import ProgramSpec, REGISTRY
+        fn, args = entry
+        p_vals, g_vals, acc_vals, master_vals = args[0], args[1], \
+            args[2], args[3]
+        n_p = len(p_vals)
+        n_a = len(jax.tree_util.tree_leaves(acc_vals))
+        n_m = len(master_vals)
+        # flat inputs: p (n_p), g (n_p), accs (n_a), masters (n_m),
+        # lr, step; flat outputs: p, accs, masters
+        carry = {i: i for i in range(n_p)}
+        carry.update({n_p + j: 2 * n_p + j for j in range(n_a)})
+        carry.update({n_p + n_a + k: 2 * n_p + n_a + k
+                      for k in range(n_m)})
+        spec = ProgramSpec(
+            name="fused_optimizer_step", fn=fn, args=tuple(args),
+            donate_argnums=(0, 2, 3), carry=carry,
+            tags=("optimizer", type(self).__name__))
+        if register:
+            REGISTRY.register(spec)
+        return spec
+
+    def audit(self, register: bool = True):
+        """Static audit (paddle_tpu.analysis) of the fused update
+        program — trace-only, the compiled-update cache is untouched."""
+        from ..analysis import audit_spec as _audit
+        return _audit(self.audit_spec(register=register))
 
     def _fused_cache_get(self, key, metas, has_master, clip, names):
         if self._compiled_update is None:

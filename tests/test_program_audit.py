@@ -1,0 +1,106 @@
+"""tools/program_audit.py — the CI audit gate. Canned-program CLI
+contract (findings JSON schema, baseline diff semantics, exit codes)
+plus the tier-1 gate itself: every catalog program audited against the
+committed AUDIT_BASELINE.json with zero new findings."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.audit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "program_audit.py")
+COMMITTED_BASELINE = os.path.join(REPO, "AUDIT_BASELINE.json")
+
+
+def _run(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    return subprocess.run([sys.executable, CLI, *args],
+                          capture_output=True, text=True, env=env,
+                          timeout=600)
+
+
+# -- the tier-1 gate (in-process: one build+audit of the full catalog) --
+
+def test_audit_gate_catalog_clean_vs_committed_baseline():
+    """THE gate: all registered bench programs (trainer step, fused
+    optimizer, serving decode + prefill buckets, page copier,
+    collectives) audited against the committed baseline — no new
+    findings. A regression here means a rule pass caught something the
+    baseline does not accept: fix the program, or consciously accept
+    the finding with --write-baseline."""
+    from paddle_tpu.analysis import (audit_spec, diff_findings,
+                                     load_baseline)
+    from paddle_tpu.analysis.catalog import (CATALOG_PROGRAMS,
+                                             build_catalog)
+    specs = build_catalog()
+    assert sorted(s.name for s in specs) == sorted(CATALOG_PROGRAMS)
+    reports = [audit_spec(s) for s in specs]
+    baseline = load_baseline(COMMITTED_BASELINE)
+    new, _fixed = diff_findings(reports, baseline)
+    assert new == [], "\n".join(
+        f"{f.fingerprint}: {f.message}" for f in new)
+
+
+def test_demo_regression_fails_the_gate_in_process():
+    """The injected regression (pre-fix AdamW) must produce NEW
+    findings vs the committed baseline — the gate can actually fail."""
+    from paddle_tpu.analysis import (audit_spec, diff_findings,
+                                     load_baseline)
+    from paddle_tpu.analysis.catalog import build_demo_regression
+    rep = audit_spec(build_demo_regression())
+    new, _ = diff_findings([rep], load_baseline(COMMITTED_BASELINE))
+    codes = {f.code for f in new}
+    assert "F64_PROMOTION" in codes and "CARRY_DTYPE_DRIFT" in codes
+
+
+# -- CLI contract (subprocess: canned single-program runs) --------------
+
+def test_cli_json_schema_and_baseline_diff(tmp_path):
+    out_json = str(tmp_path / "findings.json")
+    base = str(tmp_path / "baseline.json")
+    # write a baseline for ONE canned program (page copier: cheapest)
+    r = _run("--program", "serving_page_copy", "--baseline", base,
+             "--write-baseline", "--json", out_json, "--quiet")
+    assert r.returncode == 0, r.stderr
+    with open(out_json) as fh:
+        doc = json.load(fh)
+    assert set(doc.keys()) == {"version", "programs", "summary"}
+    assert list(doc["programs"]) == ["serving_page_copy"]
+    prog = doc["programs"]["serving_page_copy"]
+    assert set(prog.keys()) == {"program", "findings", "rules_run",
+                                "meta"}
+    assert set(prog["rules_run"]) == {
+        "dtype_promotion_rule", "donation_rule", "retrace_hazard_rule",
+        "collective_consistency_rule", "constant_bloat_rule"}
+    for f in prog["findings"]:
+        assert set(f.keys()) == {"rule", "code", "severity", "program",
+                                 "site", "message", "detail",
+                                 "fingerprint"}
+    with open(base) as fh:
+        bdoc = json.load(fh)
+    assert set(bdoc.keys()) == {"version", "findings"}
+    # gate against the fresh baseline: clean, exit 0
+    r2 = _run("--program", "serving_page_copy", "--baseline", base)
+    assert r2.returncode == 0, r2.stderr
+
+
+def test_cli_nonzero_exit_on_injected_regression(tmp_path):
+    """--demo-regression injects the pre-fix AdamW program: the gate
+    must fail (exit 2) and name the finding on stderr."""
+    base = str(tmp_path / "baseline.json")
+    r = _run("--program", "serving_page_copy", "--baseline", base,
+             "--write-baseline", "--quiet")
+    assert r.returncode == 0, r.stderr
+    r2 = _run("--program", "serving_page_copy", "--baseline", base,
+              "--demo-regression", "--quiet")
+    assert r2.returncode == 2
+    assert "GATE FAILED" in r2.stderr
+    assert "F64_PROMOTION" in r2.stderr

@@ -131,8 +131,9 @@ def test_compile_watchdog_arms_on_reset():
     tr = _trainer(observability=True)
     state = tr.init_state(init_params(CFG, jax.random.key(0)))
     toks, labels = _batch()
-    # two warmup steps: the x64 master promotion after step 1 changes
-    # the state signature once (pre-existing seed behavior, now visible)
+    # two warmup steps (one would do since the fp32 bias correction
+    # fixed the x64 master promotion — kept at two so this test pins
+    # the watchdog contract, not the warmup length)
     for _ in range(2):
         state, _ = tr.step(state, toks, labels)
     tr.reset_metrics()
@@ -611,3 +612,125 @@ def test_trainer_reset_survives_bound_flight_recorder(tmp_path):
         # ...recorder counters survived (cumulative, like trace counts)
     finally:
         disable_flight_recorder()
+
+
+# -- the AdamW x64 bias-correction fix (the bug the compile telemetry
+# -- found at runtime in r9; fixed at the source in this PR) -----------
+
+def _legacy_adamw_update(grads, state, lr, b1=0.9, b2=0.95, eps=1e-8,
+                         wd=0.1, grad_clip=1.0):
+    """VERBATIM pre-fix _adamw_update math: `1 - b1 ** step` with an
+    int32 step drops its weak type under the global x64 flag and
+    promotes the master tree to float64. Kept as the reference for the
+    bit-identical-in-f32 assertion and the auditor self-test."""
+    params, master, mu, nu, step = state
+    step = step + 1
+    gnorm_sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                   for g in jax.tree_util.tree_leaves(grads))
+    gnorm = jnp.sqrt(gnorm_sq)
+    scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-12)) \
+        if grad_clip else 1.0
+
+    def upd(g, m, mu_i, nu_i):
+        g32 = g.astype(jnp.float32) * scale
+        mu_n = b1 * mu_i.astype(jnp.float32) + (1 - b1) * g32
+        nu_n = b2 * nu_i.astype(jnp.float32) + (1 - b2) * jnp.square(g32)
+        mhat = mu_n / (1 - b1 ** step)
+        vhat = nu_n / (1 - b2 ** step)
+        m_n = m * (1.0 - lr * wd) - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return m_n, mu_n.astype(mu_i.dtype), nu_n.astype(nu_i.dtype)
+
+    tl = jax.tree_util.tree_leaves
+    treedef = jax.tree_util.tree_structure(grads)
+    new_m, new_mu, new_nu = [], [], []
+    for g, m, mi, ni in zip(tl(grads), tl(master), tl(mu), tl(nu)):
+        a, b, c = upd(g, m, mi, ni)
+        new_m.append(a)
+        new_mu.append(b)
+        new_nu.append(c)
+    unf = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)  # noqa: E731
+    master_n, mu_n, nu_n = unf(new_m), unf(new_mu), unf(new_nu)
+    params_n = jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype), master_n, params)
+    return (params_n, master_n, mu_n, nu_n, step), gnorm
+
+
+def _tiny_opt_state(key=0):
+    rng = np.random.RandomState(key)
+    mk = lambda *s: jnp.asarray(rng.randn(*s), jnp.float32)  # noqa: E731
+    params = {"w": mk(8, 4), "b": mk(4)}
+    master = jax.tree_util.tree_map(lambda v: v.astype(jnp.float32),
+                                    params)
+    mu = jax.tree_util.tree_map(jnp.zeros_like, master)
+    nu = jax.tree_util.tree_map(jnp.zeros_like, master)
+    return (params, master, mu, nu, jnp.zeros((), jnp.int32))
+
+
+def test_adamw_fix_keeps_f32_state_under_x64():
+    """The repo runs with jax_enable_x64 globally on (paddle int64 /
+    float64 semantics) — exactly the config that promoted the pre-fix
+    master tree to float64 after step 1."""
+    from paddle_tpu.distributed.trainer import _adamw_update
+    assert jax.config.jax_enable_x64        # the bug's precondition
+    # the updates run JITTED, like the trainer's step: the weak type
+    # survives eager execution (weak f64 defers to the f32 array) but
+    # is dropped under tracing — the bug only exists in the compiled
+    # step, which is why it took compile telemetry to find and why a
+    # trace-level static auditor is the right tool to catch it
+    fixed_fn = jax.jit(
+        lambda g, s: _adamw_update(g, s, jnp.float32(1e-3)))
+    state = _tiny_opt_state()
+    g = jax.tree_util.tree_map(jnp.ones_like, state[0])
+    for _ in range(3):
+        state, _ = fixed_fn(g, state)
+    for leaf in jax.tree_util.tree_leaves(state[1]):    # master
+        assert leaf.dtype == jnp.float32
+    assert state[4].dtype == jnp.int32                  # step
+    # and the legacy math really does widen (the bug exists, the fix
+    # is not vacuous)
+    legacy_fn = jax.jit(
+        lambda g, s: _legacy_adamw_update(g, s, jnp.float32(1e-3)))
+    legacy, _ = legacy_fn(g, _tiny_opt_state())
+    assert {str(leaf.dtype) for leaf in
+            jax.tree_util.tree_leaves(legacy[1])} == {"float64"}
+
+
+def test_adamw_fix_bit_identical_to_legacy_in_f32():
+    """With x64 off the weak-typed legacy path already ran pow(f32,
+    f32): the explicit fp32 bias correction must be the SAME program —
+    bit-identical state after 5 steps, not merely close."""
+    from jax.experimental import disable_x64
+    from paddle_tpu.distributed.trainer import _adamw_update
+    with disable_x64():
+        s_new, s_old = _tiny_opt_state(1), _tiny_opt_state(1)
+        for i in range(5):
+            rng = np.random.RandomState(100 + i)
+            g = {"w": jnp.asarray(rng.randn(8, 4), jnp.float32),
+                 "b": jnp.asarray(rng.randn(4), jnp.float32)}
+            s_new, gn_new = _adamw_update(g, s_new, jnp.float32(1e-3))
+            s_old, gn_old = _legacy_adamw_update(g, s_old,
+                                                 jnp.float32(1e-3))
+        assert float(gn_new) == float(gn_old)
+        for a, b in zip(jax.tree_util.tree_leaves(s_new),
+                        jax.tree_util.tree_leaves(s_old)):
+            assert a.dtype == b.dtype
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_adamw_single_compile_across_10_steps_with_x64():
+    """The regression the fix buys back: one compile for the whole run
+    (pre-fix, the step-1 master promotion changed the state signature
+    and recompiled at step 2 inside every bench window)."""
+    assert jax.config.jax_enable_x64
+    tr = _trainer(observability=True)
+    state = tr.init_state(init_params(CFG, jax.random.key(0)))
+    toks, labels = _batch()
+    losses = []
+    for _ in range(10):
+        state, m = tr.step(state, toks, labels)
+        losses.append(float(m["loss"]))
+    assert tr.metrics()["compiles"] == 1
+    for leaf in jax.tree_util.tree_leaves(state.master):
+        assert leaf.dtype == jnp.float32
+    assert state.step.dtype == jnp.int32
+    assert all(np.isfinite(losses))

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Static program audit gate over the registered framework programs.
+
+Audits the canonical program catalog (trainer step, fused optimizer
+step, serving decode + prefill buckets, prefix-cache page copier,
+collectives) with the ``paddle_tpu.analysis`` rule passes — dtype
+promotion, donation, retrace hazards, collective consistency, constant
+bloat — and diffs the findings against the committed baseline. NEW
+findings (not in the baseline) fail the gate with exit code 2; findings
+the baseline accepts pass silently; baseline entries that no longer
+reproduce are reported as fixed (refresh with ``--write-baseline``).
+
+Usage:
+  python tools/program_audit.py                       # gate vs AUDIT_BASELINE.json
+  python tools/program_audit.py --json out.json       # bank the full findings doc
+  python tools/program_audit.py --write-baseline      # freeze current findings
+  python tools/program_audit.py --program serving_decode --program train_step
+  python tools/program_audit.py --list                # catalog program names
+  python tools/program_audit.py --demo-regression     # inject the pre-fix AdamW
+                                                      # program (gate must FAIL)
+
+Exit codes: 0 clean (no new findings), 2 new findings, 3 bad
+invocation or broken baseline file (unknown --program name, an
+unreadable/mis-versioned baseline, or a --write-baseline combination
+that would corrupt the accepted set). A program that fails to trace is
+itself a finding, so 2 covers it.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_BASELINE = os.path.join(_REPO, "AUDIT_BASELINE.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON (default: repo AUDIT_BASELINE.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the diff: report findings, exit 2 on any")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="freeze current findings as the baseline and exit 0")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full findings document to PATH")
+    ap.add_argument("--program", action="append", default=None,
+                    help="audit only these catalog programs (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="print catalog program names and exit")
+    ap.add_argument("--demo-regression", action="store_true",
+                    help="also audit the pre-fix AdamW specimen — the "
+                         "gate must fail (CI self-check)")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis.catalog import (CATALOG_PROGRAMS,
+                                             build_catalog,
+                                             build_demo_regression)
+    if args.list:
+        print("\n".join(CATALOG_PROGRAMS))
+        return 0
+
+    from paddle_tpu.analysis import (audit_spec, diff_findings,
+                                     findings_to_json, load_baseline,
+                                     write_baseline)
+
+    if args.write_baseline and args.demo_regression:
+        # freezing the injected regression into the baseline would
+        # make the CI self-check (--demo-regression must exit 2) pass
+        # vacuously forever
+        print("[audit] refusing --write-baseline with "
+              "--demo-regression: the demo specimen must never become "
+              "an accepted finding", file=sys.stderr)
+        return 3
+    if args.write_baseline and args.program \
+            and args.baseline == DEFAULT_BASELINE:
+        # a subset run only audited some programs; writing it over the
+        # shared baseline would drop every other program's accepted
+        # fingerprints
+        print("[audit] refusing --write-baseline for a --program "
+              "subset over the shared baseline — audit the full "
+              "catalog, or point --baseline at a scratch file",
+              file=sys.stderr)
+        return 3
+
+    try:
+        specs = build_catalog(names=args.program)
+    except ValueError as e:
+        print(f"[audit] {e}", file=sys.stderr)
+        return 3
+    if args.demo_regression:
+        specs.append(build_demo_regression())
+    reports = [audit_spec(s) for s in specs]
+    doc = findings_to_json(reports)
+
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    say = (lambda *a: None) if args.quiet else print
+    for r in reports:
+        say(f"[audit] {r.program}: {len(r.findings)} finding(s)")
+        for f in r.findings:
+            say(f"  {f.severity:7s} {f.rule}/{f.code} @ {f.site}")
+            say(f"          {f.message}")
+
+    if args.write_baseline:
+        write_baseline(reports, args.baseline)
+        say(f"[audit] baseline written: {args.baseline} "
+            f"({doc['summary']['findings']} accepted finding(s))")
+        return 0
+
+    if args.no_baseline:
+        n = doc["summary"]["findings"]
+        say(f"[audit] {n} finding(s), no baseline diff")
+        return 2 if n else 0
+
+    try:
+        baseline = load_baseline(args.baseline)
+    except FileNotFoundError:
+        say(f"[audit] no baseline at {args.baseline} — treating every "
+            "finding as new (write one with --write-baseline)")
+        baseline = {"findings": {}}
+    except ValueError as e:
+        print(f"[audit] BROKEN BASELINE: {e}", file=sys.stderr)
+        return 3
+
+    new, fixed = diff_findings(reports, baseline)
+    for fp in fixed:
+        say(f"[audit] fixed vs baseline: {fp}")
+    if fixed and not new:
+        say("[audit] refresh the baseline with --write-baseline to "
+            "shrink it")
+    if new:
+        print(f"[audit] GATE FAILED: {len(new)} new finding(s) vs "
+              f"{args.baseline}:", file=sys.stderr)
+        for f in new:
+            print(f"  {f.severity:7s} {f.fingerprint}\n"
+                  f"          {f.message}", file=sys.stderr)
+        return 2
+    say(f"[audit] gate clean: {doc['summary']['findings']} finding(s), "
+        f"all accepted by baseline ({len(fixed)} fixed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
