@@ -105,6 +105,54 @@ def _audit_gate(run_audit, counters):
         return None
 
 
+def _kernel_gate(out):
+    """Post-window per-kernel regression gate (BENCH_KERNEL_GATE=0 opts
+    out): diff the fresh ``kernels`` capture against the banked BENCH
+    trajectory through tools/kernel_bench_gate.py — run as the real CLI
+    so its nonzero-exit contract is exercised, but a regression only
+    marks the capture (``kernel_gate.rc``); it never kills the bench,
+    the driver grades the JSON."""
+    if os.environ.get("BENCH_KERNEL_GATE", "1") == "0":
+        return
+    cap = out.get("kernels")
+    if not isinstance(cap, dict) or "error" in cap:
+        return
+    import tempfile
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "kernel_bench_gate.py")
+    cap_path = res_path = None
+    try:
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump({"kernels": cap}, f)
+            cap_path = f.name
+        res_path = cap_path + ".gate"
+        p = subprocess.run(
+            [sys.executable, tool, "--capture", cap_path,
+             "--json", res_path, "--quiet"],
+            capture_output=True, text=True, timeout=120)
+        gate = {"rc": p.returncode}
+        try:
+            with open(res_path) as f:
+                gate.update(json.load(f))
+        except (OSError, json.JSONDecodeError):
+            pass
+        if p.returncode != 0:
+            gate["stderr"] = (p.stderr or "")[-400:]
+            print(f"[bench] kernel gate failed (rc={p.returncode}): "
+                  f"{(p.stderr or '').strip()[-200:]}", file=sys.stderr)
+        out["kernel_gate"] = gate
+    except Exception as e:  # noqa: BLE001 — gate is evidence, not bench
+        out["kernel_gate"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        for pth in (cap_path, res_path):
+            if pth:
+                try:
+                    os.unlink(pth)
+                except OSError:
+                    pass
+
+
 def bench_probe():
     """<20 s liveness check: tiny device_put + add, round-tripped to the
     host. Deliberately NOT a matmul — the probe exists to answer "is the
@@ -559,16 +607,56 @@ def bench_serving_engine():
         lat.extend(end - arrivals[j] for j in range(b0, b0 + cap))
     static_tps = R * gen_n / free_at
 
-    # full distributions + the per-phase timeline banked next to the
-    # BENCH capture: a short healthy window yields p50/p95/p99, not a
-    # single mean
-    lat_m = m["latency"]
+    # bank the per-phase timeline BEFORE the A/B burst below pushes
+    # synthetic requests through the engine — the banked JSONL must
+    # describe the same window as the reported distributions
     tl_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "BENCH_SERVING_TIMELINE.jsonl")
     try:
         eng.write_timeline(tl_path)
     except OSError:
         tl_path = None
+
+    # -- fused-vs-unfused decode A/B (BENCH_SERVE_AB=0 opts out): the
+    # same full-capacity burst through the (already warm) fused-decode
+    # engine and a fresh engine pinned to the pre-fusion step, per-step
+    # decode timing read from the observability histograms — the
+    # capture carries both sides of the megakernel claim, not just the
+    # fused number
+    ab = None
+    if os.environ.get("BENCH_SERVE_AB", "1") != "0":
+        def _burst_decode_ms(e):
+            e.reset_metrics()
+            for j in range(cap):
+                e.submit(prompts[j], g)
+            e.drain()
+            return e.metrics()["latency"]["decode_step_ms"]
+
+        try:
+            fused_ms = _burst_decode_ms(eng)
+            eng_u = ServingEngine(params, cfg, capacity=cap,
+                                  block_size=16,
+                                  max_seq_len=ctx + gen_n,
+                                  cache_dtype=cdt,
+                                  prefill_buckets=(ctx,),
+                                  observability=True,
+                                  fused_decode=False)
+            eng_u.submit(prompts[0], GenerationConfig(max_new_tokens=2,
+                                                      greedy=True))
+            eng_u.drain()            # compile outside the measured burst
+            unfused_ms = _burst_decode_ms(eng_u)
+            f50, u50 = fused_ms.get("p50"), unfused_ms.get("p50")
+            ab = {"variant": eng.decode_variant,
+                  "fused_decode_step_ms": fused_ms,
+                  "unfused_decode_step_ms": unfused_ms,
+                  **({"fused_decode_speedup": round(u50 / f50, 3)}
+                     if f50 and u50 else {})}
+        except Exception as e:  # noqa: BLE001 — A/B is evidence, not
+            ab = {"error": f"{type(e).__name__}: {e}"[:200]}  # the bench
+
+    # full distributions (snapshotted into ``m`` before the A/B): a
+    # short healthy window yields p50/p95/p99, not a single mean
+    lat_m = m["latency"]
     return {"metric": "serving_engine_tokens_per_sec_per_chip",
             "value": round(eng_tps, 1), "unit": "tokens/sec/chip",
             "static_tokens_per_sec": round(static_tps, 1),
@@ -588,6 +676,7 @@ def bench_serving_engine():
             "prefill_tokens_per_sec": m["prefill_tokens_per_sec"],
             **({"audit_findings": audit_findings}
                if audit_findings is not None else {}),
+            **({"decode_ab": ab} if ab is not None else {}),
             **({"timeline_jsonl": tl_path} if tl_path else {}),
             "requests": R, "capacity": cap, "ctx": ctx, "gen": gen_n,
             "arrival_rate_hz": rate,
@@ -932,8 +1021,116 @@ def bench_flash_tune():
             tuned[f"{B}x{S}x{H}x{D}"] = _cache.get(ck)
         except Exception as e:  # noqa: BLE001
             tuned[f"{B}x{S}x{H}x{D}"] = f"{type(e).__name__}: {e}"[:120]
+
+    # decode-path tunables (pages-per-grid-step for the paged/fused
+    # attention kernels, block_f for the fused MLP): the serving read
+    # sites are all TRACED (the jitted chunk runner / engine decode fn)
+    # and can only READ the persistent table — this eager sweep is what
+    # writes it, exactly like flash's above. The paged kernel (the
+    # unfused fallback's attention) sweeps at the serving_engine/llama
+    # bench shapes; the fused megakernels sweep at shapes inside their
+    # VMEM budget (where registry dispatch actually selects them — a
+    # direct eager call past the budget would just VMEM-OOM the
+    # compiler, sweeping a key no traced program ever reads). int8
+    # pools are a distinct shape class with their own cache key.
+    from paddle_tpu.ops.pallas.fused_decode_block import (
+        decode_meta_dims, fused_attn_block_pallas, fused_mlp_block_pallas)
+    from paddle_tpu.ops.pallas.registry import KERNELS
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_attention_decode_pallas)
+    decode_tuned = {}
+    key = jax.random.PRNGKey(1)
+
+    def _sweep(name, fn):
+        try:
+            jax.block_until_ready(fn())
+            decode_tuned[name] = "swept"
+        except Exception as e:  # noqa: BLE001
+            decode_tuned[name] = f"{type(e).__name__}: {e}"[:120]
+
+    BS = 16
+    # MB keys the autotune cache, so sweep BOTH page-count classes the
+    # bench readers trace with: generate_paged's static baseline packs
+    # exactly ceil((ctx+gen)/BS) pages per sequence, while the
+    # ServingEngine's table adds a prefill-bucket of slack
+    # (serving.py max_blocks) — derived from the same env knobs
+    # bench_serving_engine reads so they cannot drift apart silently
+    s_ctx = int(os.environ.get("BENCH_SERVE_CTX", "256"))
+    s_gen = int(os.environ.get("BENCH_SERVE_GEN", "64"))
+    MBs = sorted({-(-(s_ctx + s_gen) // BS),
+                  -(-(s_ctx + s_gen + s_ctx) // BS)})
+    # B/H/KV/hd also key the table: alongside the fixed generic rows,
+    # sweep the exact shape class bench_serving_engine's traced
+    # readers will look up (capacity/heads from the same env knobs;
+    # its LlamaConfig rides the default bf16 with hd fixed at 64)
+    rows = [(jnp.float32, 8, 16, 16, 64),
+            (jnp.float32, 8, 16, 16, 128),
+            (jnp.float32, 8, 8, 8, 64),
+            (jnp.bfloat16, 8, 16, 16, 64)]
+    s_cap = int(os.environ.get("BENCH_SERVE_CAPACITY", "8"))
+    s_heads = int(os.environ.get("BENCH_SERVE_HIDDEN", "1024")) // 64
+    serving_row = (jnp.bfloat16, s_cap, s_heads, s_heads, 64)
+    if serving_row not in rows:
+        rows.append(serving_row)
+    for dt, B, H, KV, hd in rows:
+        D = H * hd
+        ks = jax.random.split(key, 11)
+        x = jax.random.normal(ks[3], (B, D), dt)
+        nw = jnp.ones((D,), dt)
+        wq = jax.random.normal(ks[4], (D, H * hd), dt) * 0.02
+        wk = jax.random.normal(ks[5], (D, KV * hd), dt) * 0.02
+        wv = jax.random.normal(ks[6], (D, KV * hd), dt) * 0.02
+        wo = jax.random.normal(ks[7], (H * hd, D), dt) * 0.02
+        sc = (jnp.ones((KV,), jnp.float32),) * 2
+        for MB in MBs:
+            T = BS * MB
+            q = jax.random.normal(ks[0], (B, H, hd), dt)
+            kp = jax.random.normal(ks[1], (B * MB, BS, KV, hd), dt)
+            vp = jax.random.normal(ks[2], (B * MB, BS, KV, hd), dt)
+            bt = jnp.arange(B * MB, dtype=jnp.int32).reshape(B, MB)
+            sl = jnp.full((B,), T - 2, jnp.int32)
+            tag = f"{B}x{H}x{KV}x{hd}x{jnp.dtype(dt).name}xMB{MB}"
+            _sweep(f"paged_decode|{tag}",
+                   lambda: paged_attention_decode_pallas(q, kp, vp,
+                                                         bt, sl))
+            half = jnp.arange(hd // 2, dtype=jnp.float32)[None, :]
+            pos = jnp.arange(T, dtype=jnp.float32)[:, None]
+            ang = pos / (10000.0 ** (2 * half / hd))
+            sin = jnp.sin(ang).astype(dt)
+            cos = jnp.cos(ang).astype(dt)
+            for quant in (False, True):
+                # the SAME builder decode_meta() delegates to, so this
+                # eager sweep's dispatch cannot drift from the traced
+                # serving readers'
+                m = decode_meta_dims(B, D, H, KV, hd, 4 * D, BS, MB,
+                                     dt, jnp.int8 if quant else dt,
+                                     quant)
+                sel_name, _ = KERNELS.dispatch("decode_attn_block", m)
+                if sel_name != "pallas_fused":
+                    decode_tuned[f"fused_attn"
+                                 f"{'_int8' if quant else ''}|{tag}"] \
+                        = f"skipped: dispatch -> {sel_name}"
+                    continue
+                if quant:
+                    _sweep(f"fused_attn_int8|{tag}",
+                           lambda: fused_attn_block_pallas(
+                               x, nw, wq, wk, wv, wo, sin, cos,
+                               kp.astype(jnp.int8),
+                               vp.astype(jnp.int8),
+                               bt, sl, kv_scales=sc)[0])
+                else:
+                    _sweep(f"fused_attn|{tag}",
+                           lambda: fused_attn_block_pallas(
+                               x, nw, wq, wk, wv, wo, sin, cos,
+                               kp, vp, bt, sl)[0])
+        wg = jax.random.normal(ks[8], (D, 4 * D), dt) * 0.02
+        wu = jax.random.normal(ks[9], (D, 4 * D), dt) * 0.02
+        wd = jax.random.normal(ks[10], (4 * D, D), dt) * 0.02
+        _sweep(f"fused_mlp|{B}x{H}x{KV}x{hd}x{jnp.dtype(dt).name}",
+               lambda: fused_mlp_block_pallas(x, nw, wg, wu, wd))
     return {"metric": "flash_autotune_shapes", "value": len(shapes),
-            "unit": "shapes swept", "winners": tuned}
+            "unit": "shapes swept", "winners": tuned,
+            "decode_tunables": decode_tuned}
 
 
 def bench_kernels():
@@ -1133,6 +1330,53 @@ def bench_kernels():
                dq, kp, vp, tables, lens)),
            jax.jit(ref_paged),
            dq, kp, vp, tol=3e-2, bytes_moved=paged_bytes)
+
+    # ---- fused decode-block megakernels (serving hot path) -------------
+    # one transformer block's decode step per kernel vs the unfused
+    # composition it replaces — the same A/B the registry dispatches
+    from paddle_tpu.ops.pallas.fused_decode_block import (
+        attn_block_ref, fused_attn_block_pallas, fused_mlp_block_pallas,
+        mlp_block_ref)
+
+    FB, FD, FKV, Fhd, FBS, FMB = (8, 1024, 16, 64, 16, 16) if not interp \
+        else (2, 64, 2, 16, 8, 4)
+    FH, FF = FKV, FD * 4              # MHA layout (groups=1), SwiGLU 4x
+    fk = jax.random.split(jax.random.PRNGKey(1), 10)
+    fx = jax.random.normal(fk[0], (FB, FD), jnp.bfloat16)
+    fnw = jnp.ones((FD,), jnp.bfloat16)
+    fwq = jax.random.normal(fk[1], (FD, FH * Fhd), jnp.bfloat16) * 0.05
+    fwk = jax.random.normal(fk[2], (FD, FKV * Fhd), jnp.bfloat16) * 0.05
+    fwv = jax.random.normal(fk[3], (FD, FKV * Fhd), jnp.bfloat16) * 0.05
+    fwo = jax.random.normal(fk[4], (FH * Fhd, FD), jnp.bfloat16) * 0.05
+    fpos = np.arange(FBS * FMB)[:, None] / (
+        10000.0 ** (np.arange(0, Fhd, 2) / Fhd))
+    fsin = jnp.asarray(np.sin(fpos), jnp.float32)
+    fcos = jnp.asarray(np.cos(fpos), jnp.float32)
+    FN = FB * FMB + 2
+    fkp = jax.random.normal(fk[5], (FN, FBS, FKV, Fhd), jnp.bfloat16)
+    fvp = jax.random.normal(fk[6], (FN, FBS, FKV, Fhd), jnp.bfloat16)
+    frng = np.random.RandomState(3)
+    ftab = jnp.asarray(frng.permutation(FN)[:FB * FMB].reshape(FB, FMB),
+                       jnp.int32)
+    flens = jnp.asarray(frng.randint(1, FBS * FMB, (FB,)), jnp.int32)
+    # HBM traffic: the block weights (the part fusion keeps resident)
+    # + the live KV pages, both sides of the residual stream
+    fused_live = int(np.sum(np.ceil(np.asarray(flens) / FBS)))
+    attn_bytes = (2 * FD * FH * Fhd + 2 * FD * FKV * Fhd) * 2 \
+        + fused_live * FBS * FKV * Fhd * 2 * 2 + 2 * FB * FD * 2
+    record("fused_attn_block",
+           jax.jit(lambda *a: fused_attn_block_pallas(*a)[0]),
+           jax.jit(lambda *a: attn_block_ref(*a)[0]),
+           fx, fnw, fwq, fwk, fwv, fwo, fsin, fcos, fkp, fvp, ftab,
+           flens, tol=5e-2, bytes_moved=attn_bytes)
+
+    fwg = jax.random.normal(fk[7], (FD, FF), jnp.bfloat16) * 0.05
+    fwu = jax.random.normal(fk[8], (FD, FF), jnp.bfloat16) * 0.05
+    fwd_ = jax.random.normal(fk[9], (FF, FD), jnp.bfloat16) * 0.05
+    record("fused_mlp_block",
+           jax.jit(fused_mlp_block_pallas), jax.jit(mlp_block_ref),
+           fx, fnw, fwg, fwu, fwd_, tol=5e-2,
+           bytes_moved=3 * FD * FF * 2 + 2 * FB * FD * 2)
 
     # ---- fused adamw ---------------------------------------------------
     N = 131072 * 32 if not interp else 4096
@@ -1664,6 +1908,8 @@ def main():
                      "resnet_breakdown", "ppyoloe", "llama_ladder"):
             out[name] = run_cfg(name, 2700 if name == "llama_ladder"
                                 else extra_t)
+            if name == "kernels":
+                _kernel_gate(out)    # post-window regression diff
             save_partial()
 
     _merge_opportunistic(out)

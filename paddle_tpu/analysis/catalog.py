@@ -26,9 +26,9 @@ __all__ = ["build_catalog", "build_demo_regression", "CATALOG_PROGRAMS"]
 
 # the default gate set, in audit order
 CATALOG_PROGRAMS = ("train_step", "fused_optimizer_step",
-                    "serving_decode", "serving_prefill_16",
-                    "serving_prefill_32", "serving_page_copy",
-                    "collectives")
+                    "serving_decode", "serving_decode_fused",
+                    "serving_prefill_16", "serving_prefill_32",
+                    "serving_page_copy", "collectives")
 
 
 def _tiny_llama_cfg(seq: int = 64):
@@ -82,7 +82,25 @@ def _serving_specs(register: bool):
     eng = ServingEngine(params, cfg, capacity=2, block_size=8,
                         max_seq_len=64, prefill_buckets=(16, 32),
                         prefix_cache=True)
-    return eng.program_specs(register=register)
+    specs = eng.program_specs(register=register)
+    # the fused decode-block program, FORCED onto the Pallas megakernel
+    # variant so the audited jaxpr contains the fused kernels even on
+    # CPU (auto-dispatch would fall back to the composition there) —
+    # the gate must cover the program production TPUs actually run.
+    # Register ONLY the filtered fused-decode spec: the fused engine's
+    # other programs (its own prefill buckets) would latest-wins
+    # replace the main engine's entries in the global REGISTRY while
+    # the gate list kept auditing the main engine's versions
+    fused_eng = ServingEngine(params, cfg, capacity=2, block_size=8,
+                              max_seq_len=64, prefill_buckets=(16,),
+                              fused_decode="pallas")
+    fused = [s for s in fused_eng.program_specs(register=False)
+             if s.name == "serving_decode_fused"]
+    if register:
+        from .registry import REGISTRY
+        for s in fused:
+            REGISTRY.register(s)
+    return specs + fused
 
 
 def _collectives_spec(register: bool):
@@ -138,8 +156,9 @@ def build_catalog(names: Optional[List[str]] = None,
         specs.append(_trainer_spec(register))
     if "fused_optimizer_step" in wanted:
         specs.append(_fused_optimizer_spec(register))
-    if wanted & {"serving_decode", "serving_prefill_16",
-                 "serving_prefill_32", "serving_page_copy"}:
+    if wanted & {"serving_decode", "serving_decode_fused",
+                 "serving_prefill_16", "serving_prefill_32",
+                 "serving_page_copy"}:
         specs.extend(s for s in _serving_specs(register)
                      if s.name in wanted)
     if "collectives" in wanted:

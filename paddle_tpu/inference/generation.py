@@ -234,26 +234,65 @@ def generate(params: Dict, input_ids, cfg: _llama.LlamaConfig,
 # ---------------------------------------------------------------------------
 # Paged-KV serving path
 # ---------------------------------------------------------------------------
-def _paged_chunk_runner(cfg, gen, quant=False):
+def _fused_mode(fused_decode):
+    """Normalize a ``fused_decode`` knob: None reads the global flag
+    (default ON — "on where supported": auto-dispatch still falls back
+    to the unfused composition off-TPU / for unsupported shapes)."""
+    from ..core.flags import GLOBAL_FLAGS
+    from ..ops.pallas import fused_decode_block  # noqa: F401 — defines flag
+    if fused_decode is None:
+        fused_decode = bool(GLOBAL_FLAGS.get("fused_decode"))
+    if fused_decode is False:
+        return False
+    if fused_decode is True:
+        return "auto"
+    if fused_decode in ("auto", "pallas", "ref"):
+        return fused_decode
+    raise ValueError(f"fused_decode must be bool|auto|pallas|ref, "
+                     f"got {fused_decode!r}")
+
+
+def _paged_chunk_runner(cfg, gen, quant=False, fused=False):
     """Jitted n-step decode scan, cached per (cfg values, gen values) —
     a fresh jit per generate_paged call would re-trace the whole L-layer
     scan every serving request."""
     from ..core.flags import GLOBAL_FLAGS
-    # the kernel-route flag is traced INTO the compiled scan, so it must
-    # key the cache — an A/B flip (bench_paged_decode) would otherwise
-    # silently reuse the first-compiled path
+    # the kernel-route flags are traced INTO the compiled scan, so they
+    # must key the cache — an A/B flip (bench_paged_decode) would
+    # otherwise silently reuse the first-compiled path. Same for the
+    # registry's force pins: in "auto" mode dispatch consults the
+    # thread-local pin at trace time, so a program traced inside a
+    # KERNELS.force(...) block must not be replayed for unpinned calls
+    if fused:
+        from ..ops.pallas.fused_decode_block import _vmem_budget
+        from ..ops.pallas.registry import KERNELS
+        from ..ops.pallas._util import interpret_mode
+        # every trace-time input that can reshape the program: the pin
+        # stack (consulted by dispatch in "auto" mode only), the VMEM
+        # budget (reshapes the supports predicates AND the fused MLP's
+        # block_f candidate list, which forced "pallas" mode still
+        # reads) and the interpret override (flips pallas variants off
+        # in "auto", flips interpret compilation in forced modes)
+        pins = (KERNELS.forced_state() if fused in ("auto", True)
+                else ())
+        route = (pins, _vmem_budget(), bool(interpret_mode()))
+    else:
+        route = ()
     ck = (dataclasses.astuple(cfg), dataclasses.astuple(gen),
-          bool(GLOBAL_FLAGS.get("use_paged_kernel")), bool(quant))
+          bool(GLOBAL_FLAGS.get("use_paged_kernel")), bool(quant),
+          fused, route)
     cached = _cache_get(_PAGED_CACHE, ck)
     if cached is not None:
         return cached
+    step = _paged_decode_step if not fused else functools.partial(
+        _fused_decode_step, mode=fused)
 
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(5, 6))
     def chunk_fn(n, params, tok, key, done, k_pools, v_pools, seq_lens,
                  block_tables, kv_scales=None):
         def body(carry, _):
             tok, key, done, seq_lens, kp, vp = carry
-            logits, kp, vp = _paged_decode_step(
+            logits, kp, vp = step(
                 params, tok, cfg, kp, vp, block_tables, seq_lens,
                 kv_scales=kv_scales)
             key, sub = jax.random.split(key)
@@ -342,11 +381,73 @@ def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
     return x @ head, k_pools, v_pools
 
 
+def _fused_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
+                       seq_lens, kv_scales=None, mode="auto"):
+    """``_paged_decode_step`` through the fused decode-block kernels.
+
+    Per block, instead of ~6 separate programs: ONE fused attention
+    kernel (RMSNorm + QKV + RoPE + paged attention incl. the new token
+    + o_proj + residual), the pool append for the new token's K/V, and
+    ONE fused MLP kernel (RMSNorm + SwiGLU + residual). Variant choice
+    (Pallas megakernel vs the bit-identical unfused composition) comes
+    from the kernel registry at trace time; ``mode`` forwards to
+    :func:`paddle_tpu.ops.pallas.fused_decode_block
+    .resolve_decode_blocks`. Signature and carried state match
+    ``_paged_decode_step`` exactly, so callers swap freely.
+    """
+    from ..ops import rms_norm as fused_rms_norm
+    from ..ops.paged_attention import write_to_pool, write_to_pool_quant
+    from ..ops.pallas.fused_decode_block import (decode_meta,
+                                                 resolve_decode_blocks)
+
+    B = tok.shape[0]
+    meta = decode_meta(cfg, B=B, BS=k_pools.shape[2],
+                       MB=block_tables.shape[1],
+                       pool_dtype=k_pools.dtype,
+                       quant=kv_scales is not None)
+    attn_fn, mlp_fn, _ = resolve_decode_blocks(meta, mode)
+    x = jnp.take(params["embed_tokens"], tok, axis=0)        # [B, D]
+    sin, cos = build_rope_cache(cfg.max_position_embeddings,
+                                cfg.head_dim, base=cfg.rope_theta)
+
+    def layer(x, xs):
+        if kv_scales is None:
+            lp, kp, vp = xs
+            scales = None
+        else:
+            lp, kp, vp, ksc, vsc = xs
+            scales = (ksc, vsc)
+        x, k_new, v_new = attn_fn(
+            x, lp["input_norm"].astype(x.dtype), lp["q_proj"],
+            lp["k_proj"], lp["v_proj"], lp["o_proj"], sin, cos, kp, vp,
+            block_tables, seq_lens, scales, cfg.rms_norm_eps)
+        if scales is None:
+            kp, vp = write_to_pool(kp, vp, block_tables, seq_lens,
+                                   k_new.astype(kp.dtype),
+                                   v_new.astype(vp.dtype))
+        else:
+            kp, vp = write_to_pool_quant(kp, vp, block_tables, seq_lens,
+                                         k_new, v_new, ksc, vsc)
+        x = mlp_fn(x, lp["post_norm"].astype(x.dtype), lp["gate_proj"],
+                   lp["up_proj"], lp["down_proj"], cfg.rms_norm_eps)
+        return x, (kp, vp)
+
+    scan_xs = (params["layers"], k_pools, v_pools) if kv_scales is None \
+        else (params["layers"], k_pools, v_pools) + tuple(kv_scales)
+    x, (k_pools, v_pools) = jax.lax.scan(layer, x, scan_xs)
+    x = fused_rms_norm(x[:, None], params["final_norm"].astype(x.dtype),
+                       cfg.rms_norm_eps)[:, 0]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed_tokens"].T
+    return x @ head, k_pools, v_pools
+
+
 def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
                    gen: Optional[GenerationConfig] = None,
                    block_size: int = 16, seed: int = 0,
                    cache_dtype=None, prefix_cache=None,
-                   observability=None):
+                   observability=None, fused_decode=None):
     """vLLM-style serving loop over a paged KV cache.
 
     ``cache_dtype="int8"``: static per-head cache quantization
@@ -374,6 +475,12 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     phase timings (prefill dispatch, per-chunk decode dispatch) into
     its timeline/histograms and samples pool gauges — purely
     observational: no extra device syncs, identical outputs.
+
+    ``fused_decode``: route each decode block through the fused
+    decode-block kernels (ops/pallas/fused_decode_block.py). None reads
+    FLAGS_fused_decode (default ON); dispatch picks the Pallas
+    megakernels where supported and the bit-identical unfused
+    composition elsewhere. "pallas"/"ref" force a variant.
     """
     import time as _time
 
@@ -384,10 +491,12 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     if observability is True:      # mirror ServingEngine's normalization
         from ..observability import Observability
         observability = Observability()
+    fused = _fused_mode(fused_decode)
     if prefix_cache is not None:
         return _generate_paged_prefix(params, input_ids, cfg, gen,
                                       block_size, seed, cache_dtype,
-                                      prefix_cache, observability)
+                                      prefix_cache, observability,
+                                      fused=fused)
     obs = observability or None
     B, S = input_ids.shape
     T = S + gen.max_new_tokens
@@ -461,7 +570,8 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     # point the reference's AnalysisPredictor has). The jitted chunk
     # runner is cached per (config values, sampling knobs) like
     # generate()'s — shapes and the static n key jit's own cache.
-    chunk_fn = _paged_chunk_runner(cfg, gen, quant=kv_scales is not None)
+    chunk_fn = _paged_chunk_runner(cfg, gen, quant=kv_scales is not None,
+                                   fused=fused)
 
     key = _key_for(seed)
     tok = sample_token(logits[:, -1], key, gen)
@@ -507,7 +617,7 @@ def _scatter_prefill_pages(kp, vp, wtable, kc, vc):
 
 def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
                            seed, cache_dtype, store,
-                           observability=None):
+                           observability=None, fused=False):
     """``generate_paged`` over a persistent ``PagedKVCacheStore``.
 
     Admission longest-prefix-matches each prompt against the store's
@@ -607,7 +717,7 @@ def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
     chunks = [tok[:, None]]
     seq_lens = jnp.full((B,), S, jnp.int32)
     bt = jnp.asarray(tables, jnp.int32)
-    chunk_fn = _paged_chunk_runner(cfg, gen, quant=False)
+    chunk_fn = _paged_chunk_runner(cfg, gen, quant=False, fused=fused)
     k_pools, v_pools = store.k_pools, store.v_pools
     chunk = max(1, int(os.environ.get("PADDLE_TPU_DECODE_CHUNK", "32")))
     left = gen.max_new_tokens - 1

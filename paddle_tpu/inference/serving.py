@@ -50,6 +50,7 @@ length-done and recycles slots.
 from __future__ import annotations
 
 import collections
+import functools
 import time
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional
@@ -61,7 +62,8 @@ import jax.numpy as jnp
 from ..observability import Observability
 from ..ops.paged_attention import (BlockManager, dequant_cache,
                                    quant_cache)
-from .generation import (GenerationConfig, _paged_decode_step,
+from .generation import (GenerationConfig, _fused_decode_step,
+                         _fused_mode, _paged_decode_step,
                          cached_forward, init_cache)
 
 __all__ = ["Request", "ServingEngine"]
@@ -136,9 +138,18 @@ class ServingEngine:
                  max_seq_len: Optional[int] = None, cache_dtype=None,
                  prefill_buckets=(32, 128), seed: int = 0,
                  prefix_cache: bool = False,
-                 observability=False):
+                 observability=False, fused_decode=None):
         self.params = params
         self.cfg = cfg
+        # decode-block kernel routing: False = the pre-fusion unfused
+        # step; "auto" (default, via FLAGS_fused_decode) = fused step
+        # with registry dispatch (Pallas megakernels where supported,
+        # bit-identical composition elsewhere); "pallas"/"ref" force a
+        # variant (tests, audit catalog)
+        self._fused = _fused_mode(fused_decode)
+        # registry dispatch outcome captured when the decode program
+        # traces (see _make_decode_fn); None until the first trace
+        self._decode_variant = None
         self.capacity = int(capacity)
         self.block_size = int(block_size)
         self.max_seq_len = int(max_seq_len
@@ -342,6 +353,32 @@ class ServingEngine:
                     f"(deadline {obs.step_deadline_s * 1e3:.1f} ms)",
                     self.scheduler_snapshot())
 
+    def _resolve_variant(self) -> Dict:
+        from ..ops.pallas.fused_decode_block import (decode_meta,
+                                                     resolve_decode_blocks)
+        meta = decode_meta(self.cfg, B=self.capacity,
+                           BS=self.block_size, MB=self.max_blocks,
+                           pool_dtype=self._k_pools.dtype,
+                           quant=self._quant)
+        _, _, names = resolve_decode_blocks(meta, self._fused)
+        return {"mode": str(self._fused), **names}
+
+    @property
+    def decode_variant(self) -> Dict:
+        """Which decode-block implementation this engine's decode
+        program runs: ``{"mode": ..., "attn": ..., "mlp": ...}``.
+        Captured when the decode program TRACES (dispatch is consulted
+        at trace time), so later env changes — the VMEM budget, a
+        ``KERNELS.force`` pin around a ``metrics()`` call — cannot make
+        the report drift from the compiled program. Before the first
+        decode step it reports what dispatch would pick now."""
+        if not self._fused:
+            return {"mode": "unfused", "attn": "unfused",
+                    "mlp": "unfused"}
+        if self._decode_variant is not None:
+            return dict(self._decode_variant)
+        return self._resolve_variant()
+
     @property
     def idle(self) -> bool:
         return not self._queue and all(
@@ -436,6 +473,7 @@ class ServingEngine:
         c["slot_utilization"] = (
             round(c["live_slot_steps"] / (steps * self.capacity), 4)
             if steps else 0.0)
+        c["decode_variant"] = self.decode_variant
         if self._pcache is not None:
             c["prefix_cache"] = self._pcache.metrics()
         if self._obs is not None:
@@ -746,14 +784,27 @@ class ServingEngine:
     _PREFILL_DONATE = (7, 8, 9)
     _PREFILL_CARRY = {1: 7, 2: 8, 3: 9}
 
-    def _make_decode_fn(self):
+    def _make_decode_fn(self, record_variant=True):
         cfg, counters = self.cfg, self.counters
         scales = self._kv_scales    # closed over: fixed after calibration
+        fused = self._fused
+        if fused:
+            decode_step = functools.partial(_fused_decode_step,
+                                            mode=fused)
+        else:
+            decode_step = _paged_decode_step
 
         def step(params, tok, seq_lens, tables, temps, key,
                  k_pools, v_pools):
             counters["decode_traces"] += 1
-            logits, k_pools, v_pools = _paged_decode_step(
+            if fused and record_variant:
+                # trace-time snapshot: the same dispatch the
+                # decode_step below consults, captured in the same
+                # context, so decode_variant reports compiled reality.
+                # Audit clones (program_specs) trace under their own
+                # pins/env and must not clobber the live report
+                self._decode_variant = self._resolve_variant()
+            logits, k_pools, v_pools = decode_step(
                 params, tok, cfg, k_pools, v_pools, tables, seq_lens,
                 kv_scales=scales)
             key, sub = jax.random.split(key)
@@ -858,8 +909,14 @@ class ServingEngine:
         # n_p + (k - 1) — the class-level carry maps (argnum-keyed, the
         # same declarations the jit donate_argnums read) convert here
         flat = lambda argnum: n_p + argnum - 1          # noqa: E731
+        # a FORCED-pallas engine registers the fused decode program
+        # under its own name so the audit gate covers the megakernel
+        # path next to (not instead of) the default program
+        decode_name = ("serving_decode_fused"
+                       if self._fused in ("pallas",) else "serving_decode")
         specs = [ProgramSpec(
-            name="serving_decode", fn=self._make_decode_fn(),
+            name=decode_name, fn=self._make_decode_fn(
+                record_variant=False),
             args=(params_sd, sds((C,), jnp.int32), sds((C,), jnp.int32),
                   sds((C, MB), jnp.int32), sds((C,), jnp.float32),
                   key_sd, pools_sd, pools_sd),

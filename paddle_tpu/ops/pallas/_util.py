@@ -4,6 +4,82 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.numpy as jnp
+
+# Pages-per-grid-step autotune candidates for the page-streaming decode
+# kernels (paged_attention's unfused kernel and the fused decode-block
+# attention kernel key the SAME persistent table and must sweep the
+# same space — pages are processed sequentially, so the choice only
+# affects pipelining, never numerics).
+PAGE_STEP_CANDIDATES = (1, 2, 4)
+
+
+def clamped_page_index(BS, pp, j):
+    """BlockSpec index map for the ``j``-th KV-page input of a
+    pages-per-step decode grid ``(B, cdiv(MB, pp))``.
+
+    Clamps dead pages to the sequence's last live page so Mosaic's
+    revisit-elision skips the copy, and keeps garbage block-table
+    entries out of the fetch. All-int32 arithmetic: index maps are
+    retraced at LOWERING time, outside the kernels' no_x64 trace
+    window, where a bare python-int operand would promote to i64 and
+    fail MLIR verification. Shared by the unfused paged-decode kernel
+    and the fused attention megakernel — the clamp must not be able to
+    drift between the two, or their bit-parity contract breaks.
+    """
+    def f(b, mi, bt_ref, len_ref):
+        last = jnp.maximum(len_ref[b] - jnp.int32(1),
+                           jnp.int32(0)) // jnp.int32(BS)
+        idx = jnp.minimum(mi.astype(jnp.int32) * jnp.int32(pp)
+                          + jnp.int32(j), last)
+        return (bt_ref[b, idx], 0, 0, 0)
+    return f
+
+
+def online_softmax_page_update(q, k, v, pg, bs, seq_len, scale,
+                               kv, groups, m_scr, l_scr, acc_scr):
+    """One KV page's online-softmax update against ``m/l/acc`` scratch.
+
+    THE page-streaming reduction body, shared by the unfused
+    paged-decode kernel and the fused attention megakernel: their
+    bit-parity contract requires the two reductions to stay
+    numerically identical op-for-op, so the math has exactly one
+    definition (like :func:`clamped_page_index` for the fetch clamp).
+    ``q`` [H, hd], ``k``/``v`` [BS, KV, hd] — all f32 (callers dequant/
+    upcast first); ``pg`` is the page index, tokens at/after
+    ``seq_len`` are masked out. All literals explicitly f32/i32: the
+    body can be retraced at LOWERING time outside the no_x64 window.
+    """
+    f32 = jnp.float32
+    tok = pg * jnp.int32(bs) + jax.lax.broadcasted_iota(
+        jnp.int32, (1, bs), 1)[0]
+    valid = tok < seq_len                                 # (BS,)
+    s_rows = []
+    for kvh in range(kv):
+        qg = q[kvh * groups:(kvh + 1) * groups, :]        # (g, hd)
+        kk = k[:, kvh, :]                                 # (BS, hd)
+        s_rows.append(jax.lax.dot_general(
+            qg, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=f32))                  # (g, BS)
+    s = jnp.concatenate(s_rows, axis=0) * f32(scale)      # (H, BS)
+    s = jnp.where(valid[None, :], s, f32(-jnp.inf))
+    m_prev = m_scr[:]                                     # (H, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    # a fully-invalid page cannot happen (callers guard with pl.when):
+    # all--inf rows only arise when seq_len <= pg*bs — excluded
+    p = jnp.exp(s - m_new)
+    p = jnp.where(valid[None, :], p, f32(0.0))
+    alpha = jnp.exp(m_prev - m_new)
+    l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+    pv_rows = []
+    for kvh in range(kv):
+        ps = p[kvh * groups:(kvh + 1) * groups, :]        # (g, BS)
+        vv = v[:, kvh, :]                                 # (BS, hd)
+        pv_rows.append(jax.lax.dot_general(
+            ps, vv, (((1,), (0,)), ((), ())),
+            preferred_element_type=f32))                  # (g, hd)
+    acc_scr[:] = acc_scr[:] * alpha + jnp.concatenate(pv_rows, axis=0)
+    m_scr[:] = m_new
 
 
 # Process-wide override for Pallas interpret mode. None = auto (off-TPU →

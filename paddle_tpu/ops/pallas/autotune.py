@@ -48,9 +48,23 @@ class AutotuneCache:
         self._loaded = True
         try:
             with open(self._path) as f:
-                self._mem.update(json.load(f))
-        except (OSError, ValueError):
+                data = json.load(f)
+            if not isinstance(data, dict):
+                raise ValueError(
+                    f"expected a JSON object, got {type(data).__name__}")
+            self._mem.update(data)
+        except FileNotFoundError:
             pass
+        except (OSError, ValueError, TypeError) as e:
+            # a corrupt / truncated / wrong-shaped cache file must not
+            # poison the import of the first tuned kernel: discard it
+            # (the next sweep rewrites it) and say so once
+            import warnings
+            warnings.warn(
+                f"discarding corrupt autotune cache {self._path} "
+                f"({type(e).__name__}: {e}); re-tuning from scratch",
+                RuntimeWarning, stacklevel=3)
+            self._mem.clear()
 
     def get(self, key: str):
         with self._lock:
@@ -61,17 +75,54 @@ class AutotuneCache:
         with self._lock:
             self._load()
             self._mem[key] = value
+            # atomic publish: write a PRIVATE temp file (pid-suffixed so
+            # concurrent processes never interleave writes into one
+            # temp) and os.replace it over the cache — a reader can see
+            # the old file or the new file, never a torn one
+            tmp = f"{self._path}.{os.getpid()}.tmp"
             try:
                 os.makedirs(os.path.dirname(self._path), exist_ok=True)
-                tmp = self._path + ".tmp"
                 with open(tmp, "w") as f:
                     json.dump(self._mem, f)
                 os.replace(tmp, self._path)
             except OSError:
-                pass  # disk cache is best-effort
+                try:                  # disk cache is best-effort, but a
+                    os.unlink(tmp)    # half-written temp must not leak
+                except OSError:
+                    pass
 
 
 _cache = AutotuneCache()
+
+
+def resolve_candidate(cache_key: str, candidates: Sequence[Any],
+                      build: Callable[[Any], Callable], args: Tuple):
+    """Resolve one tunable config at a kernel call site.
+
+    With FLAGS_kernel_autotune on: eager calls sweep on device via
+    :func:`autotune`; traced / interpret-mode calls read the persistent
+    cache (winners stored as an INDEX into the candidate list) and fall
+    back to ``candidates[0]``. With the flag off (the default), the
+    cache is NOT consulted and every call deterministically uses
+    ``candidates[0]`` — the same convention flash attention's tuned
+    path has always used, keeping default-flag numerics independent of
+    whatever a cache file on disk happens to hold. The single shared
+    home for this resolution — the fused decode-block kernels and the
+    unfused paged-decode kernel key the SAME table, so the read
+    convention must not be able to drift between them.
+    """
+    if len(candidates) == 1:
+        return candidates[0]
+    traced = any(isinstance(a, jax.core.Tracer)
+                 for a in jax.tree_util.tree_leaves(args))
+    if traced or interpret_mode() or \
+            not GLOBAL_FLAGS.get("kernel_autotune"):
+        hit = _cache.get(cache_key) \
+            if GLOBAL_FLAGS.get("kernel_autotune") else None
+        if hit is not None and 0 <= int(hit) < len(candidates):
+            return candidates[int(hit)]
+        return candidates[0]
+    return autotune(cache_key, candidates, build, args)
 
 
 def _sync(x):

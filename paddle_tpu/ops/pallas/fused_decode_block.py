@@ -1,0 +1,598 @@
+"""Fused decode-block Pallas kernels for the serving hot path.
+
+BENCH_r05 showed the paged decode step round-tripping activations
+through HBM between ~6 small programs per transformer block, with the
+isolated Pallas kernels winning only 1.1-1.37x each — the bound is
+memory traffic, not FLOPs. Per ClusterFusion++ (full transformer-block
+decoding fusion) and FlashFuser (PAPERS.md), this module fuses the
+per-block decode path into TWO Pallas kernels that keep the activations
+in VMEM between stages:
+
+- ``decode_attn_block``: pre-attention RMSNorm + QKV projection + RoPE
+  + paged attention over the existing KV pools (fp32/bf16 and int8
+  cache variants, new token folded into the online softmax from VMEM
+  scratch so the pool write can happen after the kernel) + output
+  projection + residual add. One kernel launch instead of rmsnorm,
+  3 projections, rope, pool write, attention, o_proj and the residual.
+- ``decode_mlp_block``: post-attention RMSNorm + gated MLP (SwiGLU)
+  + residual, tiled over the intermediate dim so the weight working set
+  fits VMEM at any model width (block size autotuned).
+
+The weights of one block ride resident in VMEM (constant-index blocks
+are fetched once per kernel invocation), so fusion is only legal where
+they fit: each variant registers a ``supports`` predicate with the
+kernel registry (:mod:`.registry`) and dispatch falls back to the
+``unfused`` composition — the EXACT building-block sequence of
+``inference.generation._paged_decode_step``, bit-identical to the
+pre-fusion path — in interpret mode, for unsupported head dims, or
+when the per-block weights exceed the VMEM budget
+(``PADDLE_TPU_FUSED_VMEM_BUDGET``, default 10 MiB out of the 16 MiB
+scoped-VMEM window, leaving room for double-buffered KV pages and the
+fp32 scratch).
+
+Acceptance contract: greedy output through the fused path must match
+the unfused path bit-for-bit wherever the ``unfused`` variant is
+selected, and token-for-token on TPU (tests/test_fused_decode_block.py
+pins both; the tier-1 engine stream asserts exact parity).
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...core.flags import GLOBAL_FLAGS
+from ._util import (PAGE_STEP_CANDIDATES, clamped_page_index,
+                    interpret_mode as _interpret, no_x64,
+                    online_softmax_page_update)
+from .registry import KERNELS
+
+__all__ = [
+    "fused_attn_block_pallas", "fused_mlp_block_pallas",
+    "attn_block_ref", "mlp_block_ref", "decode_meta",
+    "decode_meta_dims",
+    "resolve_decode_blocks", "mlp_autotune_key", "attn_autotune_key",
+]
+
+GLOBAL_FLAGS.define(
+    "fused_decode", True,
+    "route the paged decode step through the fused decode-block "
+    "kernels where the registry supports them (0 = always the unfused "
+    "composition, for A/B diagnosis)")
+
+
+def _vmem_budget() -> int:
+    return int(os.environ.get("PADDLE_TPU_FUSED_VMEM_BUDGET",
+                              10 * 2 ** 20))
+
+
+# ---------------------------------------------------------------------------
+# attention-stage megakernel
+# ---------------------------------------------------------------------------
+def _attn_block_kernel(bt_ref, len_ref, x_ref, nw_ref, wq_ref, wk_ref,
+                       wv_ref, wo_ref, sin_ref, cos_ref, *rest,
+                       scale, bs, kv, groups, eps, pp, quant):
+    k_refs = rest[:pp]
+    v_refs = rest[pp:2 * pp]
+    i = 2 * pp
+    if quant:
+        ksc_ref, vsc_ref = rest[i:i + 2]
+        i += 2
+    xo_ref, kn_ref, vn_ref = rest[i:i + 3]
+    q_scr, ka_scr, va_scr, m_scr, l_scr, acc_scr = rest[i + 3:]
+
+    b = pl.program_id(0)
+    mi = pl.program_id(1)
+    seq_len = len_ref[b]          # tokens already in the pool (excl. new)
+    dt = x_ref.dtype
+    hd = q_scr.shape[1]
+    hd2 = hd // 2
+    # every literal is explicitly typed: the kernel body (like the index
+    # maps) can be retraced at LOWERING time outside the no_x64 window,
+    # where a bare python literal becomes f64/i64 and breaks the
+    # already-specialized f32/i32 call signatures
+    f32 = jnp.float32
+    epsf = f32(eps)
+    scalef = f32(scale)
+
+    @pl.when(mi == 0)
+    def _prologue():
+        # RMSNorm — same staging as ops.rms_norm_ref: fp32 moment, cast
+        # back to the model dtype BEFORE the weight multiply
+        xf = x_ref[:].astype(jnp.float32)                     # (1, D)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        h = (xf * jax.lax.rsqrt(ms + epsf)).astype(dt) * nw_ref[:]
+        q = jnp.dot(h, wq_ref[:], preferred_element_type=jnp.float32)
+        k = jnp.dot(h, wk_ref[:], preferred_element_type=jnp.float32)
+        v = jnp.dot(h, wv_ref[:], preferred_element_type=jnp.float32)
+        sinr, cosr = sin_ref[:], cos_ref[:]                   # (1, hd2)
+
+        def rope(t, n):
+            # mimic the unfused op order exactly: the projection lands
+            # at model dtype, apply_rope recasts to f32 and rotates
+            t = t.astype(dt).astype(jnp.float32).reshape(n, hd)
+            t1, t2 = t[:, :hd2], t[:, hd2:]
+            return jnp.concatenate([t1 * cosr - t2 * sinr,
+                                    t2 * cosr + t1 * sinr], axis=-1)
+
+        qr = rope(q, kv * groups).astype(dt)                  # (H, hd)
+        kr = rope(k, kv).astype(dt)                           # (KV, hd)
+        vm = v.astype(dt).reshape(kv, hd)
+        kn_ref[0] = kr          # raw new-token K/V: the caller owns the
+        vn_ref[0] = vm          # pool write (quantizing if int8)
+        q_scr[:] = qr.astype(jnp.float32)
+        if quant:
+            # attention must see dequant(quant(new K/V)) — the same
+            # values the unfused path reads back from the int8 pool
+            ks = ksc_ref[0][:, None]
+            vs = vsc_ref[0][:, None]
+            kq = jnp.clip(jnp.round(kr.astype(jnp.float32) / ks),
+                          f32(-127), f32(127))
+            vq = jnp.clip(jnp.round(vm.astype(jnp.float32) / vs),
+                          f32(-127), f32(127))
+            ka_scr[:] = kq * ks
+            va_scr[:] = vq * vs
+        else:
+            pool_dt = k_refs[0].dtype
+            ka_scr[:] = kr.astype(pool_dt).astype(jnp.float32)
+            va_scr[:] = vm.astype(pool_dt).astype(jnp.float32)
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # -- stream the live pages (online softmax, exact across pages) ----
+    for j in range(pp):
+        pg = mi.astype(jnp.int32) * jnp.int32(pp) + jnp.int32(j) \
+            if hasattr(mi, "astype") else jnp.int32(mi * pp + j)
+
+        @pl.when(pg * jnp.int32(bs) < seq_len)
+        def _page(k_ref=k_refs[j], v_ref=v_refs[j], pg=pg):
+            k = k_ref[0].astype(jnp.float32)                  # (BS, KV, hd)
+            v = v_ref[0].astype(jnp.float32)
+            if quant:
+                k = k * ksc_ref[0][None, :, None]
+                v = v * vsc_ref[0][None, :, None]
+            # the reduction body is SHARED with the unfused paged
+            # decode kernel (their bit-parity contract)
+            online_softmax_page_update(q_scr[:], k, v, pg, bs, seq_len,
+                                       scale, kv, groups,
+                                       m_scr, l_scr, acc_scr)
+
+    @pl.when(mi == pl.num_programs(1) - 1)
+    def _epilogue():
+        # fold in the NEW token (position seq_len, always unmasked) from
+        # VMEM scratch — the pool write happens after the kernel
+        q = q_scr[:]
+        ka = ka_scr[:]
+        va = va_scr[:]
+        s_rows = []
+        for kvh in range(kv):
+            qg = q[kvh * groups:(kvh + 1) * groups, :]
+            s_rows.append(jax.lax.dot_general(
+                qg, ka[kvh:kvh + 1, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))          # (g, 1)
+        s_new = jnp.concatenate(s_rows, axis=0) * scalef      # (H, 1)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, s_new)
+        alpha = jnp.exp(m_prev - m_new)       # 0 when no page ran (m=-inf)
+        p = jnp.exp(s_new - m_new)            # > 0: l_fin never zero
+        l_fin = alpha * l_scr[:] + p
+        pv_rows = []
+        for kvh in range(kv):
+            pg = p[kvh * groups:(kvh + 1) * groups, :]
+            pv_rows.append(pg * va[kvh:kvh + 1, :])           # (g, hd)
+        acc_fin = acc_scr[:] * alpha + jnp.concatenate(pv_rows, axis=0)
+        attn = (acc_fin / l_fin).astype(dt)                   # (H, hd)
+        o = jnp.dot(attn.reshape(1, -1), wo_ref[:],
+                    preferred_element_type=jnp.float32)
+        xo_ref[:] = x_ref[:] + o.astype(dt)
+
+
+def attn_autotune_key(B, H, KV, hd, BS, MB, dtype, pool_dtype) -> str:
+    """Persistent autotune-cache key for the fused attention kernel's
+    pages-per-grid-step (single source of truth for sweep + read).
+    ``pool_dtype`` keys the cache variant: an int8 pool moves half the
+    page bytes and adds scale inputs, so it is a distinct shape class
+    (mirroring ``decode_meta``'s dispatch keying)."""
+    return (f"fused_attn_pages|"
+            f"{(B, H, KV, hd, BS, MB, str(dtype), str(pool_dtype))}")
+
+
+def _tuned_pages(key_str, candidates, build, args):
+    """Tunable-config resolution, delegated to the shared
+    :func:`..autotune.resolve_candidate` (one read convention for every
+    kernel sharing the persistent table)."""
+    from .autotune import resolve_candidate
+    return resolve_candidate(key_str, candidates, build, args)
+
+
+@no_x64
+def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
+                            k_pool, v_pool, block_tables, seq_lens,
+                            kv_scales=None, eps=1e-6,
+                            pages_per_step=None):
+    """Fused attention stage of one decode block.
+
+    x: [B, D] residual stream; nw: [D] (already at x.dtype);
+    wq [D, H*hd], wk/wv [D, KV*hd], wo [H*hd, D]; sin/cos: full rope
+    tables [T, hd//2]; pools [N, BS, KV, hd] (int8 with ``kv_scales``);
+    block_tables [B, MB]; seq_lens [B] — the count of tokens already in
+    the pool (the new token goes at position ``seq_lens``; attention
+    covers ``seq_lens + 1`` tokens, the new one folded in from VMEM).
+
+    Returns (x_out [B, D], k_new [B, KV, hd], v_new [B, KV, hd]); the
+    caller writes k_new/v_new into the pools (``write_to_pool[_quant]``)
+    exactly as the unfused path does.
+    """
+    B, D = x.shape
+    N, BS, KV, hd = k_pool.shape
+    MB = block_tables.shape[1]
+    E = wq.shape[1]
+    H = E // hd
+    groups = H // KV
+    scale = 1.0 / math.sqrt(hd)
+    quant = kv_scales is not None
+
+    if pages_per_step is None:
+        cands = [p for p in PAGE_STEP_CANDIDATES if p <= MB]
+        ck = attn_autotune_key(B, H, KV, hd, BS, MB, x.dtype, k_pool.dtype)
+        args = (x, nw, wq, wk, wv, wo, sin, cos, k_pool, v_pool,
+                block_tables, seq_lens)
+
+        def build(pp_):
+            return lambda *a: fused_attn_block_pallas(
+                *a, kv_scales=kv_scales, eps=eps, pages_per_step=pp_)[0]
+
+        pages_per_step = _tuned_pages(ck, cands or [1], build, args)
+    pp = max(1, min(int(pages_per_step), MB))
+
+    sin_b = jnp.take(jnp.asarray(sin), seq_lens, axis=0)     # (B, hd2)
+    cos_b = jnp.take(jnp.asarray(cos), seq_lens, axis=0)
+
+    row = lambda b, mi, bt, ln: (b, 0)                   # noqa: E731
+    const = lambda b, mi, bt, ln: (0, 0)                 # noqa: E731
+
+    def page_index(j):
+        return clamped_page_index(BS, pp, j)
+
+    in_specs = [
+        pl.BlockSpec((1, D), row),                        # x
+        pl.BlockSpec((1, D), const),                      # norm weight
+        pl.BlockSpec((D, E), const),                      # wq
+        pl.BlockSpec((D, KV * hd), const),                # wk
+        pl.BlockSpec((D, KV * hd), const),                # wv
+        pl.BlockSpec((E, D), const),                      # wo
+        pl.BlockSpec((1, hd // 2), row),                  # sin row
+        pl.BlockSpec((1, hd // 2), row),                  # cos row
+    ]
+    in_specs += [pl.BlockSpec((1, BS, KV, hd), page_index(j))
+                 for j in range(pp)]                      # k pages
+    in_specs += [pl.BlockSpec((1, BS, KV, hd), page_index(j))
+                 for j in range(pp)]                      # v pages
+    inputs = [x, nw.reshape(1, D), wq, wk, wv, wo, sin_b, cos_b]
+    inputs += [k_pool] * pp + [v_pool] * pp
+    if quant:
+        in_specs += [pl.BlockSpec((1, KV), const)] * 2
+        inputs += [jnp.asarray(kv_scales[0], jnp.float32).reshape(1, KV),
+                   jnp.asarray(kv_scales[1], jnp.float32).reshape(1, KV)]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, pl.cdiv(MB, pp)),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, D), row),
+            pl.BlockSpec((1, KV, hd), lambda b, mi, bt, ln: (b, 0, 0)),
+            pl.BlockSpec((1, KV, hd), lambda b, mi, bt, ln: (b, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((H, hd), jnp.float32),     # q
+            pltpu.VMEM((KV, hd), jnp.float32),    # new K (attention view)
+            pltpu.VMEM((KV, hd), jnp.float32),    # new V (attention view)
+            pltpu.VMEM((H, 1), jnp.float32),      # m
+            pltpu.VMEM((H, 1), jnp.float32),      # l
+            pltpu.VMEM((H, hd), jnp.float32),     # acc
+        ],
+    )
+    xo, kn, vn = pl.pallas_call(
+        functools.partial(_attn_block_kernel, scale=scale, bs=BS, kv=KV,
+                          groups=groups, eps=eps, pp=pp, quant=quant),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((B, D), x.dtype),
+                   jax.ShapeDtypeStruct((B, KV, hd), x.dtype),
+                   jax.ShapeDtypeStruct((B, KV, hd), x.dtype)],
+        interpret=_interpret(),
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(seq_lens, jnp.int32), *inputs)
+    return xo, kn, vn
+
+
+# ---------------------------------------------------------------------------
+# MLP-stage megakernel
+# ---------------------------------------------------------------------------
+def _mlp_block_kernel(x_ref, nw_ref, wg_ref, wu_ref, wd_ref, o_ref,
+                      h_scr, acc_scr, *, eps):
+    j = pl.program_id(0)
+    dt = x_ref.dtype
+
+    @pl.when(j == 0)
+    def _pre():
+        xf = x_ref[:].astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        # jnp.float32(eps): the body can be retraced at lowering time
+        # outside the no_x64 window (see _attn_block_kernel)
+        h_scr[:] = (xf * jax.lax.rsqrt(ms + jnp.float32(eps))
+                    ).astype(dt) * nw_ref[:]
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    h = h_scr[:]
+    g = jnp.dot(h, wg_ref[:],
+                preferred_element_type=jnp.float32).astype(dt)
+    u = jnp.dot(h, wu_ref[:],
+                preferred_element_type=jnp.float32).astype(dt)
+    ff = jax.nn.silu(g) * u                       # swiglu, model dtype
+    acc_scr[:] = acc_scr[:] + jnp.dot(
+        ff, wd_ref[:], preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(0) - 1)
+    def _fin():
+        o_ref[:] = x_ref[:] + acc_scr[:].astype(dt)
+
+
+_MLP_BLOCK_CANDIDATES = (512, 256, 1024, 2048)
+
+
+def mlp_autotune_key(B, D, F, dtype, budget=None) -> str:
+    """Persistent autotune-cache key for the fused MLP kernel's
+    intermediate-dim block size. The VMEM budget is part of the key:
+    winners are stored as an INDEX into the budget-fitting candidate
+    list, so a different ``PADDLE_TPU_FUSED_VMEM_BUDGET`` (which
+    reshapes that list) must read a different cache entry — not decode
+    a stale index against the wrong candidates."""
+    budget = _vmem_budget() if budget is None else int(budget)
+    return f"fused_mlp_block|{(B, D, F, str(dtype), budget)}"
+
+
+def _mlp_candidates(F: int):
+    """Intermediate-dim tile sizes: divisors of F only (a ragged last
+    block would multiply garbage columns into the accumulator)."""
+    cands = [c for c in _MLP_BLOCK_CANDIDATES if c <= F and F % c == 0]
+    return cands or [F]
+
+
+def _mlp_vmem_need(B: int, D: int, itemsize: int, bf: int) -> int:
+    """Per-grid-step VMEM bytes at tile ``bf``: 3 weight tiles + the
+    x/h/acc activation rows + the g/u/ff intermediates."""
+    return 3 * D * bf * itemsize + B * D * (4 + 2 * itemsize) \
+        + 3 * B * bf * 4
+
+
+def _mlp_fitting_candidates(B: int, D: int, F: int, itemsize: int):
+    """The divisor candidates that fit the VMEM budget. Dispatch
+    (``_supports_mlp``), the traced default pick, and the autotune
+    sweep all consume THIS list — a supported-and-dispatched kernel can
+    therefore never compile over the budget its predicate promised."""
+    budget = _vmem_budget()
+    return [bf for bf in _mlp_candidates(F)
+            if _mlp_vmem_need(B, D, itemsize, bf) <= budget]
+
+
+@no_x64
+def fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=1e-6, block_f=None):
+    """Fused MLP stage of one decode block: RMSNorm + SwiGLU + residual.
+
+    x: [B, D]; nw: [D] at x.dtype; wg/wu: [D, F]; wd: [F, D]. Tiled over
+    F in ``block_f`` columns (autotuned, divisors of F) so only
+    3*D*block_f weight elements are VMEM-resident per grid step.
+    """
+    B, D = x.shape
+    F = wg.shape[1]
+    if block_f is None:
+        it = jnp.dtype(x.dtype).itemsize
+        # budget-fitting tiles only; a forced call with nothing fitting
+        # (tests, interpret) gets the smallest divisor tile
+        cands = _mlp_fitting_candidates(B, D, F, it) \
+            or [min(_mlp_candidates(F))]
+        ck = mlp_autotune_key(B, D, F, x.dtype)
+
+        def build(bf):
+            return lambda *a: fused_mlp_block_pallas(*a, eps=eps,
+                                                     block_f=bf)
+
+        block_f = _tuned_pages(ck, cands, build, (x, nw, wg, wu, wd))
+    bf = int(block_f)
+    if F % bf:
+        # grid=(F // bf,) floor-drops a ragged tail block: a non-divisor
+        # tile would silently never feed the last F % bf columns into
+        # the down-projection accumulator
+        raise ValueError(f"block_f={bf} must divide the intermediate "
+                         f"dim F={F}")
+
+    const = lambda j: (0, 0)                              # noqa: E731
+    out = pl.pallas_call(
+        functools.partial(_mlp_block_kernel, eps=eps),
+        grid=(F // bf,),
+        in_specs=[pl.BlockSpec((B, D), const),
+                  pl.BlockSpec((1, D), const),
+                  pl.BlockSpec((D, bf), lambda j: (0, j)),
+                  pl.BlockSpec((D, bf), lambda j: (0, j)),
+                  pl.BlockSpec((bf, D), lambda j: (j, 0))],
+        out_specs=pl.BlockSpec((B, D), const),
+        out_shape=jax.ShapeDtypeStruct((B, D), x.dtype),
+        scratch_shapes=[pltpu.VMEM((B, D), x.dtype),
+                        pltpu.VMEM((B, D), jnp.float32)],
+        interpret=_interpret(),
+    )(x, nw.reshape(1, D), wg, wu, wd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# unfused reference variants — the EXACT pre-fusion building-block
+# sequence, so dispatch falling back here is bit-identical to the
+# original ``_paged_decode_step`` math
+# ---------------------------------------------------------------------------
+def attn_block_ref(x, nw, wq, wk, wv, wo, sin, cos, k_pool, v_pool,
+                   block_tables, seq_lens, kv_scales=None, eps=1e-6):
+    from .. import rms_norm as fused_rms_norm
+    from ..paged_attention import (paged_attention_decode,
+                                   paged_attention_decode_quant,
+                                   write_to_pool, write_to_pool_quant)
+    from ..rope import apply_rope
+
+    B, D = x.shape
+    _, _, KV, hd = k_pool.shape
+    H = wq.shape[1] // hd
+    pos_ids = seq_lens[:, None]
+    h = fused_rms_norm(x[:, None], nw, eps)[:, 0]
+    q = (h @ wq).reshape(B, 1, H, hd)
+    k = (h @ wk).reshape(B, 1, KV, hd)
+    v = (h @ wv).reshape(B, 1, KV, hd)
+    q = apply_rope(q, sin, cos, position_ids=pos_ids)
+    k = apply_rope(k, sin, cos, position_ids=pos_ids)
+    k_new, v_new = k[:, 0], v[:, 0]
+    # the internal write below makes attention see the new token; the
+    # caller performs the SAME write for the carried pools, and XLA
+    # CSEs the duplicate scatter away
+    if kv_scales is None:
+        kp, vp = write_to_pool(k_pool, v_pool, block_tables, seq_lens,
+                               k_new.astype(k_pool.dtype),
+                               v_new.astype(v_pool.dtype))
+        attn = paged_attention_decode(q[:, 0], kp, vp, block_tables,
+                                      seq_lens + 1)
+    else:
+        ksc, vsc = kv_scales
+        kp, vp = write_to_pool_quant(k_pool, v_pool, block_tables,
+                                     seq_lens, k_new, v_new, ksc, vsc)
+        attn = paged_attention_decode_quant(
+            q[:, 0], kp, vp, block_tables, seq_lens + 1, ksc, vsc)
+    x = x + attn.reshape(B, H * hd).astype(x.dtype) @ wo
+    return x, k_new, v_new
+
+
+def mlp_block_ref(x, nw, wg, wu, wd, eps=1e-6):
+    from .. import rms_norm as fused_rms_norm, swiglu as fused_swiglu
+
+    h = fused_rms_norm(x[:, None], nw, eps)[:, 0]
+    ff = fused_swiglu(h @ wg, h @ wu)
+    return x + ff @ wd
+
+
+# ---------------------------------------------------------------------------
+# registry: shape-class dispatch with the composition as fallback
+# ---------------------------------------------------------------------------
+def decode_meta_dims(B, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
+                     quant) -> dict:
+    """Static dispatch metadata from raw dims — the ONE builder of
+    everything the ``supports`` predicates read. The serving/generate
+    paths go through :func:`decode_meta`; eager sweeps (bench
+    flash_tune) that have no model config call this directly, so their
+    dispatch cannot drift from the traced read sites."""
+    dtype = jnp.dtype(dtype)
+    return {
+        "B": int(B), "D": int(D), "H": int(H), "KV": int(KV),
+        "hd": int(hd), "F": int(F), "BS": int(BS), "MB": int(MB),
+        "dtype": str(dtype), "itemsize": int(dtype.itemsize),
+        "pool_dtype": str(jnp.dtype(pool_dtype)),
+        "quant": bool(quant), "interpret": bool(_interpret()),
+    }
+
+
+def decode_meta(cfg, B, BS, MB, pool_dtype, quant) -> dict:
+    """Static dispatch metadata for one decode step — everything the
+    ``supports`` predicates read. Built at trace time from static
+    shapes only, so dispatch is deterministic per program."""
+    return decode_meta_dims(B, cfg.hidden_size, cfg.num_attention_heads,
+                            cfg.num_key_value_heads, cfg.head_dim,
+                            cfg.intermediate_size, BS, MB, cfg.dtype,
+                            pool_dtype, quant)
+
+
+def _supports_attn(meta):
+    if meta["interpret"]:
+        return False, "interpret mode (off-TPU): composition is faster"
+    hd = meta["hd"]
+    if hd % 8 != 0 or hd < 16:
+        return False, f"head_dim {hd} not a multiple of 8 (lane tiling)"
+    if meta["H"] % meta["KV"] != 0:
+        return False, "H not a multiple of KV"
+    D, H, KV = meta["D"], meta["H"], meta["KV"]
+    it = meta["itemsize"]
+    weights = (2 * D * H * hd + 2 * D * KV * hd) * it
+    page = meta["BS"] * KV * hd * (1 if meta["quant"] else it)
+    scratch = (2 * H * hd + 2 * KV * hd + 2 * H) * 4
+    # page windows at the WORST-case autotune choice: the tuner may
+    # pick any pages-per-step candidate, each holding a K and a V page
+    # input block, double-buffered by the pipeline — supports() must
+    # admit only shapes that fit whatever the sweep later selects
+    pages = 4 * max(PAGE_STEP_CANDIDATES)
+    need = weights + pages * page + scratch + 4 * D * it
+    budget = _vmem_budget()
+    if need > budget:
+        return False, (f"block weights + pages need ~{need >> 20}MiB "
+                       f"VMEM > budget {budget >> 20}MiB")
+    return True, f"fits VMEM (~{need >> 20}MiB)"
+
+
+def _supports_mlp(meta):
+    if meta["interpret"]:
+        return False, "interpret mode (off-TPU): composition is faster"
+    D, F, B = meta["D"], meta["F"], meta["B"]
+    fits = _mlp_fitting_candidates(B, D, F, meta["itemsize"])
+    if fits:
+        return True, f"fits VMEM at block_f={fits[0]}"
+    return False, (f"no intermediate tile of F={F} fits the "
+                   f"{_vmem_budget() >> 20}MiB VMEM budget")
+
+
+def _attn_pallas_variant(x, nw, wq, wk, wv, wo, sin, cos, k_pool,
+                         v_pool, block_tables, seq_lens,
+                         kv_scales=None, eps=1e-6):
+    return fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
+                                   k_pool, v_pool, block_tables,
+                                   seq_lens, kv_scales=kv_scales,
+                                   eps=eps)
+
+
+def _mlp_pallas_variant(x, nw, wg, wu, wd, eps=1e-6):
+    return fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=eps)
+
+
+KERNELS.register("decode_attn_block", "pallas_fused",
+                 _attn_pallas_variant, priority=10,
+                 supports=_supports_attn, tags=("serving", "pallas"))
+KERNELS.register("decode_attn_block", "unfused", attn_block_ref,
+                 priority=0, tags=("serving",))
+KERNELS.register("decode_mlp_block", "pallas_fused", _mlp_pallas_variant,
+                 priority=10, supports=_supports_mlp,
+                 tags=("serving", "pallas"))
+KERNELS.register("decode_mlp_block", "unfused", mlp_block_ref,
+                 priority=0, tags=("serving",))
+
+
+def resolve_decode_blocks(meta: dict, mode="auto"):
+    """Resolve the two decode-block ops for one program.
+
+    ``mode``: "auto"/True — registry dispatch (Pallas where supported,
+    composition elsewhere); "pallas" — force the fused kernels (tests /
+    audit tracing on CPU); "ref" — force the composition. Returns
+    (attn_fn, mlp_fn, variant_dict)."""
+    if mode in ("auto", True, None):
+        a_name, a_fn = KERNELS.dispatch("decode_attn_block", meta)
+        m_name, m_fn = KERNELS.dispatch("decode_mlp_block", meta)
+    elif mode in ("pallas", "force"):
+        a_name, m_name = "pallas_fused", "pallas_fused"
+        a_fn = KERNELS.variant("decode_attn_block", a_name).fn
+        m_fn = KERNELS.variant("decode_mlp_block", m_name).fn
+    elif mode == "ref":
+        a_name = m_name = "unfused"
+        a_fn = KERNELS.variant("decode_attn_block", a_name).fn
+        m_fn = KERNELS.variant("decode_mlp_block", m_name).fn
+    else:
+        raise ValueError(
+            f"fused_decode mode must be auto|pallas|ref, got {mode!r}")
+    return a_fn, m_fn, {"attn": a_name, "mlp": m_name}

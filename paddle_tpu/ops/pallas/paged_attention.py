@@ -31,14 +31,24 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._util import interpret_mode as _interpret, no_x64
+from ._util import (PAGE_STEP_CANDIDATES, clamped_page_index,
+                    interpret_mode as _interpret, no_x64,
+                    online_softmax_page_update)
 
 
-def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_scr, l_scr, acc_scr, *, scale, bs, kv, groups):
+def _decode_kernel(bt_ref, len_ref, q_ref, *rest, scale, bs, kv, groups,
+                   pp):
+    k_refs = rest[:pp]
+    v_refs = rest[pp:2 * pp]
+    o_ref, m_scr, l_scr, acc_scr = rest[2 * pp:]
     b = pl.program_id(0)
     mi = pl.program_id(1)
     seq_len = len_ref[b]
+    # explicitly-typed literals: the body can be retraced at LOWERING
+    # time outside the no_x64 window (jit callers), where bare python
+    # literals become f64/i64 and break the specialized call signatures
+    f32 = jnp.float32
+    zerof = f32(0.0)
 
     @pl.when(mi == 0)
     def _init():
@@ -46,76 +56,91 @@ def _decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    @pl.when(mi * bs < seq_len)
-    def _body():
-        q = q_ref[0].astype(jnp.float32)          # [H, hd]
-        k = k_ref[0].astype(jnp.float32)          # [BS, KV, hd]
-        v = v_ref[0].astype(jnp.float32)
-        # token validity within this page
-        tok = mi * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)[0]
-        valid = tok < seq_len                     # [BS]
-        h = q.shape[0]
-        s_rows = []
-        for kvh in range(kv):
-            qg = q[kvh * groups:(kvh + 1) * groups, :]     # [g, hd]
-            kk = k[:, kvh, :]                              # [BS, hd]
-            s_rows.append(jax.lax.dot_general(
-                qg, kk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32))       # [g, BS]
-        s = jnp.concatenate(s_rows, axis=0) * scale        # [H, BS]
-        s = jnp.where(valid[None, :], s, -jnp.inf)
-        m_prev = m_scr[:]                                  # [H, 1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        # fully-invalid page cannot happen (guarded by pl.when), but a
-        # page can still be all -inf only if seq_len <= mi*bs — excluded
-        p = jnp.exp(s - m_new)
-        p = jnp.where(valid[None, :], p, 0.0)
-        alpha = jnp.exp(m_prev - m_new)
-        l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
-        pv_rows = []
-        for kvh in range(kv):
-            pg = p[kvh * groups:(kvh + 1) * groups, :]     # [g, BS]
-            vv = v[:, kvh, :]                              # [BS, hd]
-            pv_rows.append(jax.lax.dot_general(
-                pg, vv, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32))       # [g, hd]
-        pv = jnp.concatenate(pv_rows, axis=0)              # [H, hd]
-        acc_scr[:] = acc_scr[:] * alpha + pv
-        m_scr[:] = m_new
+    # pages-per-grid-step (pp) is an autotune candidate: more pages per
+    # step = fewer grid iterations and deeper copy pipelining, at pp
+    # extra VMEM page buffers — processed sequentially, so the online
+    # softmax is bit-identical across pp choices
+    for j in range(pp):
+        pg = mi.astype(jnp.int32) * jnp.int32(pp) + jnp.int32(j) \
+            if hasattr(mi, "astype") else jnp.int32(mi * pp + j)
+
+        @pl.when(pg * jnp.int32(bs) < seq_len)
+        def _body(k_ref=k_refs[j], v_ref=v_refs[j], pg=pg):
+            # the reduction body is SHARED with the fused decode-block
+            # attention kernel (their bit-parity contract)
+            online_softmax_page_update(
+                q_ref[0].astype(jnp.float32),             # [H, hd]
+                k_ref[0].astype(jnp.float32),             # [BS, KV, hd]
+                v_ref[0].astype(jnp.float32),
+                pg, bs, seq_len, scale, kv, groups,
+                m_scr, l_scr, acc_scr)
 
     @pl.when(mi == pl.num_programs(1) - 1)
     def _finish():
         l = l_scr[:]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
+        l_safe = jnp.where(l == zerof, f32(1.0), l)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_autotune_key(B, H, KV, hd, BS, MB, dtype) -> str:
+    """Single source of truth for the paged-decode autotune cache key
+    (sweeps and traced reads must agree, like flash attention's)."""
+    return f"paged_decode|{(B, H, KV, hd, BS, MB, str(dtype))}"
+
+
+def _tuned_page_step(q, k_pool, v_pool, block_tables, seq_lens, MB):
+    """Pages-per-grid-step for this shape, resolved through the shared
+    :func:`.autotune.resolve_candidate` (traced/interpret calls read
+    the persistent cache; eager calls with FLAGS_kernel_autotune sweep
+    the candidates on device — reference: phi/kernels/autotune)."""
+    from .autotune import resolve_candidate
+    B, H, hd = q.shape
+    _, BS, KV, _ = k_pool.shape
+    cands = [p for p in PAGE_STEP_CANDIDATES if p <= MB]
+    if len(cands) <= 1:
+        return 1
+
+    def build(pp):
+        return lambda *a: paged_attention_decode_pallas(
+            *a, pages_per_step=pp)
+
+    return resolve_candidate(
+        paged_autotune_key(B, H, KV, hd, BS, MB, q.dtype), cands,
+        build, (q, k_pool, v_pool, block_tables, seq_lens))
 
 
 @no_x64
 def paged_attention_decode_pallas(q, k_pool, v_pool, block_tables,
-                                  seq_lens, scale=None):
+                                  seq_lens, scale=None,
+                                  pages_per_step=None):
     """q: [B, H, hd]; pools: [N, BS, KV, hd]; block_tables: [B, MB] int32;
-    seq_lens: [B] int32 → [B, H, hd]. seq_len 0 slots return 0."""
+    seq_lens: [B] int32 → [B, H, hd]. seq_len 0 slots return 0.
+
+    ``pages_per_step``: KV pages fetched per grid step (1/2/4). None
+    resolves through the autotune cache (``paged_autotune_key``); the
+    choice only affects pipelining, never numerics."""
     B, H, hd = q.shape
     N, BS, KV, _ = k_pool.shape
     MB = block_tables.shape[1]
     groups = H // KV
     scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    if pages_per_step is None:
+        pages_per_step = _tuned_page_step(q, k_pool, v_pool,
+                                          block_tables, seq_lens, MB)
+    pp = max(1, min(int(pages_per_step), MB))
 
-    def kv_index(b, mi, bt_ref, len_ref):
-        # clamp dead pages to the sequence's last live page so the copy
-        # is elided; also keeps garbage table entries out of the fetch
-        last = jnp.maximum(len_ref[b] - 1, 0) // BS
-        page = bt_ref[b, jnp.minimum(mi, last)]
-        return (page, 0, 0, 0)
+    def kv_index(j):
+        return clamped_page_index(BS, pp, j)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, MB),
+        grid=(B, pl.cdiv(MB, pp)),
         in_specs=[
             pl.BlockSpec((1, H, hd), lambda b, mi, bt, ln: (b, 0, 0)),
-            pl.BlockSpec((1, BS, KV, hd), kv_index),
-            pl.BlockSpec((1, BS, KV, hd), kv_index),
+            *[pl.BlockSpec((1, BS, KV, hd), kv_index(j))
+              for j in range(pp)],
+            *[pl.BlockSpec((1, BS, KV, hd), kv_index(j))
+              for j in range(pp)],
         ],
         out_specs=pl.BlockSpec((1, H, hd), lambda b, mi, bt, ln: (b, 0, 0)),
         scratch_shapes=[
@@ -126,10 +151,11 @@ def paged_attention_decode_pallas(q, k_pool, v_pool, block_tables,
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, scale=scale, bs=BS, kv=KV,
-                          groups=groups),
+                          groups=groups, pp=pp),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
         interpret=_interpret(),
     )(jnp.asarray(block_tables, jnp.int32),
-      jnp.asarray(seq_lens, jnp.int32), q, k_pool, v_pool)
+      jnp.asarray(seq_lens, jnp.int32), q,
+      *([k_pool] * pp), *([v_pool] * pp))
     return out

@@ -77,6 +77,7 @@ BASE_KEYS = {
     "requests_completed", "drain_truncations", "wall_time_s",
     "tokens_per_sec", "prefill_tokens_per_sec", "ttft_ms_mean",
     "ttft_ms_max", "slot_utilization",
+    "decode_variant",        # r11: fused decode-block dispatch report
 }
 OBS_KEYS = {"latency", "gauges", "retrace_warnings", "stall_dumps",
             "timeline_events", "timeline_dropped"}

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""Per-kernel bench regression gate against the banked BENCH trajectory.
+
+The BENCH captures bank a ``kernels`` section with per-kernel
+``us_pallas`` timings (bench.py ``bench_kernels``, persisted in
+``BENCH_OPPORTUNISTIC.json`` and the per-round ``BENCH_rNN.json``
+files). A tuning or fusion regression used to be invisible until a
+reviewer eyeballed the numbers; this tool turns the trajectory into a
+gate: a fresh capture whose ``us_pallas`` exceeds the banked best by
+more than the threshold fails with exit code 1, the way audit findings
+fail tools/program_audit.py.
+
+Usage:
+  python tools/kernel_bench_gate.py --capture fresh.json       # gate
+  python tools/kernel_bench_gate.py --capture fresh.json --threshold 0.5
+  python tools/kernel_bench_gate.py --list-banked              # show refs
+  python tools/kernel_bench_gate.py --capture fresh.json --json out.json
+
+``--capture`` accepts either a bare ``bench_kernels`` result (a dict
+with ``cases``) or a full bench.py output document (the ``kernels`` key
+is used). The banked reference for each kernel is the BEST (minimum)
+``us_pallas`` across every banked capture — a regression is measured
+against the trajectory's high-water mark, not last round's possibly-
+already-regressed number.
+
+bench.py runs this as a post-window step after the ``kernels`` config
+(opt out with ``BENCH_KERNEL_GATE=0``; threshold via
+``BENCH_KERNEL_GATE_THRESHOLD``, default 0.30 — device timing noise at
+these microsecond scales makes tighter gates flaky).
+
+Exit codes: 0 pass (or nothing comparable — no banked data / interpret
+capture: a gate with no reference must not fail vacuously), 1 regression
+over threshold, 3 bad invocation.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_THRESHOLD = 0.30
+
+
+def _kernel_cases(doc):
+    """A bench doc (full output, opportunistic bank, or bare kernels
+    result) -> {kernel: us_pallas} for timed, non-interpret cases."""
+    if not isinstance(doc, dict):
+        return {}
+    k = doc.get("kernels") if "cases" not in doc else doc
+    if not isinstance(k, dict) or k.get("interpret"):
+        return {}
+    out = {}
+    for name, case in (k.get("cases") or {}).items():
+        us = case.get("us_pallas") if isinstance(case, dict) else None
+        if isinstance(us, (int, float)) and us > 0:
+            out[name] = float(us)
+    return out
+
+
+def collect_banked(repo: str = _REPO):
+    """Best (minimum) banked us_pallas per kernel across the BENCH
+    trajectory, with the source file of each reference."""
+    best, src = {}, {}
+    paths = sorted(glob.glob(os.path.join(repo, "BENCH_r*.json")))
+    paths += [os.path.join(repo, "BENCH_OPPORTUNISTIC.json")]
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        # BENCH_rNN files wrap the output under "parsed"
+        for d in (doc, doc.get("parsed") if isinstance(doc, dict)
+                  else None):
+            for name, us in _kernel_cases(d or {}).items():
+                if name not in best or us < best[name]:
+                    best[name] = us
+                    src[name] = os.path.basename(path)
+    return best, src
+
+
+def gate_capture(capture, threshold: float = DEFAULT_THRESHOLD,
+                 repo: str = _REPO):
+    """Diff a fresh capture against the banked trajectory.
+
+    Returns a dict: ``status`` pass|regressed|no_reference, per-kernel
+    ``regressions`` (over threshold), ``improved`` (faster than the
+    banked best), ``new`` (no banked reference yet), ``checked``."""
+    fresh = _kernel_cases(capture)
+    banked, src = collect_banked(repo)
+    res = {"threshold": threshold, "checked": 0, "regressions": {},
+           "improved": {}, "new": sorted(set(fresh) - set(banked)),
+           "status": "pass"}
+    if not fresh:
+        res["status"] = "no_reference"
+        res["note"] = ("capture has no timed us_pallas cases "
+                       "(interpret mode or all errored)")
+        return res
+    if not banked:
+        res["status"] = "no_reference"
+        res["note"] = "no banked BENCH trajectory to diff against"
+        return res
+    for name in sorted(set(fresh) & set(banked)):
+        res["checked"] += 1
+        ratio = fresh[name] / banked[name]
+        entry = {"us_pallas": fresh[name], "banked_best": banked[name],
+                 "banked_in": src[name], "ratio": round(ratio, 3)}
+        if ratio > 1.0 + threshold:
+            res["regressions"][name] = entry
+        elif ratio < 1.0:
+            res["improved"][name] = entry
+    if res["regressions"]:
+        res["status"] = "regressed"
+    return res
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--capture", metavar="PATH",
+                    help="fresh bench JSON (full output or bare "
+                         "kernels result)")
+    ap.add_argument("--threshold", type=float, default=float(
+        os.environ.get("BENCH_KERNEL_GATE_THRESHOLD",
+                       DEFAULT_THRESHOLD)),
+        help="allowed us_pallas growth over the banked best "
+             "(0.30 = +30%%)")
+    ap.add_argument("--repo", default=_REPO,
+                    help="repo dir holding the banked BENCH files")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the gate result document to PATH")
+    ap.add_argument("--list-banked", action="store_true",
+                    help="print the banked per-kernel references and "
+                         "exit")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    say = (lambda *a: None) if args.quiet else print
+
+    if args.list_banked:
+        banked, src = collect_banked(args.repo)
+        for name in sorted(banked):
+            print(f"{name:24s} {banked[name]:10.1f} us  ({src[name]})")
+        if not banked:
+            print("(no banked kernel captures found)")
+        return 0
+    if not args.capture:
+        print("[kernel-gate] --capture is required (or --list-banked)",
+              file=sys.stderr)
+        return 3
+    try:
+        with open(args.capture) as f:
+            capture = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"[kernel-gate] cannot read capture {args.capture}: {e}",
+              file=sys.stderr)
+        return 3
+    if args.threshold < 0:
+        print("[kernel-gate] threshold must be >= 0", file=sys.stderr)
+        return 3
+
+    res = gate_capture(capture, threshold=args.threshold,
+                       repo=args.repo)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(res, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if res["status"] == "no_reference":
+        say(f"[kernel-gate] SKIP: {res.get('note', '')}")
+        return 0
+    for name, e in res["regressions"].items():
+        print(f"[kernel-gate] REGRESSION {name}: {e['us_pallas']:.1f}us "
+              f"vs banked {e['banked_best']:.1f}us ({e['banked_in']}) "
+              f"= {e['ratio']:.2f}x (threshold "
+              f"{1 + res['threshold']:.2f}x)", file=sys.stderr)
+    for name, e in res["improved"].items():
+        say(f"[kernel-gate] improved {name}: {e['us_pallas']:.1f}us vs "
+            f"banked {e['banked_best']:.1f}us ({e['ratio']:.2f}x)")
+    if res["new"]:
+        say(f"[kernel-gate] new kernels (no banked reference yet): "
+            f"{', '.join(res['new'])}")
+    if res["status"] == "regressed":
+        print(f"[kernel-gate] GATE FAILED: {len(res['regressions'])} "
+              f"kernel(s) regressed past +{res['threshold']:.0%}",
+              file=sys.stderr)
+        return 1
+    say(f"[kernel-gate] gate clean: {res['checked']} kernel(s) within "
+        f"+{res['threshold']:.0%} of the banked trajectory")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
