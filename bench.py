@@ -293,6 +293,78 @@ def bench_llama(steps=8, batch=2, seq=2048, hidden=2048, layers=12,
             out["timeline_jsonl"] = tl_path
         except OSError:
             pass
+
+    # -- fused-vs-unfused training A/B (BENCH_TRAIN_AB=0 opts out): the
+    # SAME step through a trainer on the dispatched fused training
+    # path ("auto": linear+CE custom_vjp, SwiGLU, RMSNorm backward +
+    # residual epilogue where the registry supports them — the route
+    # production runs, not a force that could VMEM-OOM past the
+    # budget) and one pinned to the exact pre-fusion composition
+    # ("ref") — per-step timing from the observability
+    # histograms, HBM peak from memory_analysis(), MFU from
+    # cost_analysis(). The training-side decode_ab: the capture carries
+    # both sides of the fusion claim (step_ms + the [T, V]-logit HBM
+    # traffic the chunked kernel never materializes), not just the
+    # fused number.
+    if os.environ.get("BENCH_TRAIN_AB", "1") != "0":
+        import dataclasses as _dc
+
+        def _train_side(mode, ab_steps):
+            cfg_s = _dc.replace(cfg, fused_train=mode)
+            # observability rides the same BENCH_TRAIN_OBS opt-out as
+            # the main window (and the multi-device observed trainer
+            # has a known step-2 AOT sharding limitation, so the A/B
+            # must stay runnable with it off) — the wall-clock mean is
+            # always reported, the richer step_ms/HBM/MFU telemetry
+            # only when observed
+            tr_s = Trainer(lambda p, t, l: loss_fn(p, t, l, cfg_s), mesh,
+                           param_shardings(mesh, cfg_s), lr=1e-4,
+                           accumulate_steps=acc, moment_dtype=mdt,
+                           observability=obs_on)
+            st = tr_s.init_state(params)
+            st, mm = tr_s.step(st, toks, labels)      # compile + warmup
+            float(mm["loss"])
+            tr_s.reset_metrics()
+            t1 = time.perf_counter()
+            for _ in range(ab_steps):
+                st, mm = tr_s.step(st, toks, labels)
+            float(mm["loss"])
+            dt_s = time.perf_counter() - t1
+            side = {"mode": mode,
+                    "step_ms_mean": round(dt_s / ab_steps * 1e3, 3),
+                    "tokens_per_sec": round(
+                        ab_steps * acc * batch * seq / dt_s, 1)}
+            if obs_on:
+                tm_s = tr_s.metrics()
+                side["step_ms"] = tm_s["latency"]["step_ms"]
+                if tm_s.get("mfu"):
+                    side["mfu_cost_analysis"] = tm_s["mfu"]["mfu"]
+                if tm_s.get("hbm"):
+                    side["hbm_peak_bytes"] = tm_s["hbm"].get(
+                        "total_bytes")
+                    side["hbm_temp_bytes"] = tm_s["hbm"].get(
+                        "temp_bytes")
+            return side
+
+        try:
+            ab_steps = int(os.environ.get("BENCH_TRAIN_AB_STEPS", steps))
+            fused_side = _train_side("auto", ab_steps)
+            unfused_side = _train_side("ref", ab_steps)
+            ab = {"fused": fused_side, "unfused": unfused_side}
+            f50 = (fused_side.get("step_ms") or {}).get("p50") \
+                or fused_side["step_ms_mean"]
+            u50 = (unfused_side.get("step_ms") or {}).get("p50") \
+                or unfused_side["step_ms_mean"]
+            if f50 and u50:
+                ab["fused_train_speedup"] = round(u50 / f50, 3)
+            fh, uh = (fused_side.get("hbm_peak_bytes"),
+                      unfused_side.get("hbm_peak_bytes"))
+            if fh and uh:
+                ab["hbm_peak_saved_bytes"] = int(uh - fh)
+            out["train_ab"] = ab
+        except Exception as e:  # noqa: BLE001 — A/B is evidence, not
+            out["train_ab"] = {                      # the bench
+                "error": f"{type(e).__name__}: {e}"[:200]}
     return out
 
 
@@ -1128,9 +1200,69 @@ def bench_flash_tune():
         wd = jax.random.normal(ks[10], (4 * D, D), dt) * 0.02
         _sweep(f"fused_mlp|{B}x{H}x{KV}x{hd}x{jnp.dtype(dt).name}",
                lambda: fused_mlp_block_pallas(x, nw, wg, wu, wd))
+    # training-path tunables (fused linear+CE (block_t, block_v) and
+    # fused-SwiGLU block_f): the read sites are the jitted train steps
+    # (models/llama.py, models/gpt.py loss_fn) — traced, so they can
+    # only READ the persistent table; this eager sweep writes it. Each
+    # sweep times the full fwd+bwd the trainer runs (the kernels'
+    # resolve_candidate builders do), at the exact (T, D, V) shape
+    # classes the llama/gpt bench rungs trace with — derived from the
+    # same defaults bench_llama/bench_gpt use so the keys cannot drift
+    # from the traced readers'. Shapes are swept ONLY where registry
+    # dispatch selects the Pallas variant (a direct eager call past the
+    # VMEM budget would sweep a key no traced program ever reads).
+    from paddle_tpu.ops.pallas.fused_train import (
+        ce_meta, linear_ce_pallas, swiglu_meta, swiglu_pallas)
+    train_tuned = {}
+    key = jax.random.PRNGKey(2)
+    # (batch, seq, hidden, vocab, inter): the default llama bench rung
+    # + the LLAMA_LADDER rungs' loss shapes (hidden 1536/1024 rungs
+    # share vocab 32000); gpt rides the llama (B*S, D, V) shape class
+    tshapes = [(2, 2048, 2048, 32000, 5504),
+               (8, 2048, 1536, 32000, 4096),
+               (2, 2048, 1024, 32000, 2816)]
+    for B, S, D, V, F in tshapes:
+        T = B * S
+        ks = jax.random.split(key, 4)
+        dt = jnp.bfloat16
+        tag = f"{T}x{D}x{V}x{jnp.dtype(dt).name}"
+        sel, _ = KERNELS.dispatch("fused_linear_ce", ce_meta(T, D, V, dt))
+        if sel != "pallas_fused":
+            train_tuned[f"linear_ce|{tag}"] = f"skipped: dispatch -> {sel}"
+        else:
+            x = jax.random.normal(ks[0], (T, D), dt) * 0.05
+            hw = jax.random.normal(ks[1], (D, V), dt) * 0.02
+            lb = jnp.asarray(
+                np.random.RandomState(0).randint(0, V, (T,)), jnp.int32)
+            try:
+                _, grads = jax.value_and_grad(
+                    lambda a, h: linear_ce_pallas(a, h, lb),
+                    argnums=(0, 1))(x, hw)
+                jax.block_until_ready(grads)
+                train_tuned[f"linear_ce|{tag}"] = "swept"
+            except Exception as e:  # noqa: BLE001
+                train_tuned[f"linear_ce|{tag}"] = \
+                    f"{type(e).__name__}: {e}"[:120]
+        stag = f"{T}x{F}x{jnp.dtype(dt).name}"
+        sel, _ = KERNELS.dispatch("fused_swiglu", swiglu_meta(T, F, dt))
+        if sel != "pallas_fused":
+            train_tuned[f"swiglu|{stag}"] = f"skipped: dispatch -> {sel}"
+        else:
+            g = jax.random.normal(ks[2], (T, F), dt)
+            u = jax.random.normal(ks[3], (T, F), dt)
+            try:
+                _, grads = jax.value_and_grad(
+                    lambda a, b: swiglu_pallas(a, b).astype(
+                        jnp.float32).sum(), argnums=(0, 1))(g, u)
+                jax.block_until_ready(grads)
+                train_tuned[f"swiglu|{stag}"] = "swept"
+            except Exception as e:  # noqa: BLE001
+                train_tuned[f"swiglu|{stag}"] = \
+                    f"{type(e).__name__}: {e}"[:120]
     return {"metric": "flash_autotune_shapes", "value": len(shapes),
             "unit": "shapes swept", "winners": tuned,
-            "decode_tunables": decode_tuned}
+            "decode_tunables": decode_tuned,
+            "train_tunables": train_tuned}
 
 
 def bench_kernels():
@@ -1430,6 +1562,80 @@ def bench_kernels():
     # the tolerance is 2 ulps at that magnitude
     record("layer_norm", jax.jit(layer_norm_pallas), jax.jit(ref_ln),
            X, LW, LB, tol=6.5e-2, bytes_moved=X.size * 2 * 2)
+
+    # ---- fused training kernels (Liger-style hot path) -----------------
+    # each case times the full fwd+bwd the trainer runs (grads
+    # concatenated into ONE array so both variants must compute every
+    # output — a tuple would defeat record()'s elementwise diff and let
+    # XLA dead-code-eliminate half the backward). These feed the same
+    # kernel_bench_gate as the decode kernels: once banked, a fusion
+    # regression fails the bench run.
+    from paddle_tpu.ops.pallas.fused_train import (linear_ce_pallas,
+                                                   linear_ce_ref,
+                                                   swiglu_pallas,
+                                                   swiglu_ref)
+    from paddle_tpu.ops.pallas.norms import (_rms_bwd_ref,
+                                             rms_norm_bwd_pallas)
+
+    CT, CD, CV = (4096, 2048, 32000) if not interp else (64, 64, 256)
+    ck = jax.random.split(jax.random.PRNGKey(2), 6)
+    ch = jax.random.normal(ck[0], (CT, CD), jnp.bfloat16) * 0.05
+    chead = jax.random.normal(ck[1], (CD, CV), jnp.bfloat16) * 0.02
+    clab = jnp.asarray(np.random.RandomState(1).randint(-1, CV, (CT,)),
+                       jnp.int32)   # a few ignored labels in the mix
+
+    def _ce_grads(fn):
+        def run(x, h, l):
+            loss, (dx, dh) = jax.value_and_grad(
+                lambda a, b: fn(a, b, l), argnums=(0, 1))(x, h)
+            return jnp.concatenate(
+                [loss.reshape(1), dx.astype(jnp.float32).ravel(),
+                 dh.astype(jnp.float32).ravel()])
+        return run
+
+    # fwd s + bwd recompute (x2) + dx + dh contractions: 5 matmuls of
+    # 2·T·D·V each over the fused fwd+bwd
+    record("fused_linear_ce", jax.jit(_ce_grads(linear_ce_pallas)),
+           jax.jit(_ce_grads(linear_ce_ref)), ch, chead, clab,
+           tol=3e-2, flops=10 * CT * CD * CV)
+
+    SR, SF = (8192, 4096) if not interp else (64, 256)
+    sg = jax.random.normal(ck[2], (SR, SF), jnp.bfloat16)
+    su = jax.random.normal(ck[3], (SR, SF), jnp.bfloat16)
+
+    def _swiglu_grads(fn):
+        def run(g, u):
+            dg, du = jax.grad(
+                lambda a, b: fn(a, b).astype(jnp.float32).sum(),
+                argnums=(0, 1))(g, u)
+            return jnp.concatenate([dg.astype(jnp.float32).ravel(),
+                                    du.astype(jnp.float32).ravel()])
+        return run
+
+    # fwd reads g+u, bwd reads g+u+d and writes dg+du — 7 bf16 streams
+    record("fused_swiglu", jax.jit(_swiglu_grads(swiglu_pallas)),
+           jax.jit(_swiglu_grads(swiglu_ref)), sg, su,
+           tol=3e-2, bytes_moved=SR * SF * 2 * 7)
+
+    # f32 case: the ref keeps dw in f32 (the composition's dtype), so a
+    # bf16 comparison would only measure output rounding
+    nx = jax.random.normal(ck[4], (SR, SF) if not interp else (64, 256),
+                           jnp.float32)
+    nw = jax.random.normal(jax.random.PRNGKey(5), (nx.shape[-1],),
+                           jnp.float32)
+    ng = jax.random.normal(ck[5], nx.shape, jnp.float32)
+
+    def _rms_bwd_cat(dx, dw):
+        return jnp.concatenate([dx.astype(jnp.float32).ravel(),
+                                dw.astype(jnp.float32).ravel()])
+
+    # reads x+g (+w), writes dx+dw — 4 f32 row streams dominate
+    record("rms_norm_bwd",
+           jax.jit(lambda x, w, g: _rms_bwd_cat(
+               *rms_norm_bwd_pallas(x, w, g))),
+           jax.jit(lambda x, w, g: _rms_bwd_cat(
+               *_rms_bwd_ref(1e-6, (x, w), g))),
+           nx, nw, ng, tol=2e-2, bytes_moved=nx.size * 4 * 4)
 
     n_ok = sum(1 for c in res["cases"].values() if c.get("ok"))
     res.update(metric="pallas_kernels_ok", value=n_ok,

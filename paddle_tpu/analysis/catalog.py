@@ -25,7 +25,8 @@ from typing import List, Optional
 __all__ = ["build_catalog", "build_demo_regression", "CATALOG_PROGRAMS"]
 
 # the default gate set, in audit order
-CATALOG_PROGRAMS = ("train_step", "fused_optimizer_step",
+CATALOG_PROGRAMS = ("train_step", "train_step_fused",
+                    "fused_optimizer_step",
                     "serving_decode", "serving_decode_fused",
                     "serving_prefill_16", "serving_prefill_32",
                     "serving_page_copy", "collectives")
@@ -54,6 +55,42 @@ def _trainer_spec(register: bool):
     toks = np.zeros((2, 32), np.int32)
     return tr.audit_spec(state, toks, np.zeros((2, 32), np.int32),
                          register=register)
+
+
+def _trainer_fused_spec(register: bool):
+    """The SAME tiny trainer step with the fused training path pinned
+    to the Pallas kernels (``cfg.fused_train="pallas"``), so the
+    audited jaxpr contains the fused linear+CE custom_vjp, SwiGLU and
+    RMSNorm-backward/residual-epilogue kernels even on CPU (where
+    auto-dispatch falls back to the composition) — the gate must cover
+    the program production TPUs actually run. Built with
+    register=False and re-registered under its own name: audit_spec's
+    "train_step" would otherwise latest-wins clobber the default
+    trainer's entry in the global REGISTRY (the serving_decode_fused
+    idiom). The fp32 loss accumulation feeds the dtype-promotion rule;
+    the donated state tree feeds the donation rule."""
+    import dataclasses as _dc
+
+    import jax
+    import numpy as np
+    from ..distributed.trainer import MeshConfig, Trainer, make_mesh
+    from ..models.llama import init_params, loss_fn, param_shardings
+
+    cfg = _dc.replace(_tiny_llama_cfg(seq=32), fused_train="pallas")
+    mesh = make_mesh(MeshConfig())
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tr = Trainer(lambda p, t, l: loss_fn(p, t, l, cfg), mesh,
+                 param_shardings(mesh, cfg), lr=1e-4)
+    state = tr.init_state(params)
+    toks = np.zeros((2, 32), np.int32)
+    spec = tr.audit_spec(state, toks, np.zeros((2, 32), np.int32),
+                         register=False)
+    spec = _dc.replace(spec, name="train_step_fused",
+                       tags=spec.tags + ("fused",))
+    if register:
+        from .registry import REGISTRY
+        REGISTRY.register(spec)
+    return spec
 
 
 def _fused_optimizer_spec(register: bool):
@@ -154,6 +191,8 @@ def build_catalog(names: Optional[List[str]] = None,
     specs = []
     if "train_step" in wanted:
         specs.append(_trainer_spec(register))
+    if "train_step_fused" in wanted:
+        specs.append(_trainer_fused_spec(register))
     if "fused_optimizer_step" in wanted:
         specs.append(_fused_optimizer_spec(register))
     if wanted & {"serving_decode", "serving_decode_fused",

@@ -35,6 +35,18 @@ from ..observability import (CompileWatcher, HostGapDetector,
 __all__ = ["MeshConfig", "make_mesh", "TrainState", "Trainer"]
 
 
+def _fused_train_key():
+    """Everything that can flip the fused-training-kernel dispatch at
+    TRACE time: the FLAGS_fused_train mode plus any registry force
+    pins. A loss_fn routed through the registry (models/llama.py,
+    models/gpt.py) bakes the dispatched variant into the traced step,
+    so a changed key must REBUILD the step program — not silently
+    replay a program traced under the old routing."""
+    from ..ops.pallas._util import fused_train_mode
+    from ..ops.pallas.registry import KERNELS
+    return (fused_train_mode(), KERNELS.forced_state())
+
+
 @dataclasses.dataclass
 class MeshConfig:
     dp: int = 1
@@ -345,6 +357,7 @@ class Trainer:
         # state must survive the raise (donated inputs are invalidated)
         donate = (0,) if self._donate and not nan_check else ()
         self._step_nan = nan_check
+        self._step_fused = _fused_train_key()
         self._step_fn = jax.jit(step_fn, donate_argnums=donate)
         if self._compiled_cache is not None:
             # the program changed (nan-check flag flip): cached AOT
@@ -467,7 +480,9 @@ class Trainer:
 
     def step(self, state: TrainState, *batch) -> Tuple[TrainState, Dict]:
         from ..core.flags import GLOBAL_FLAGS
-        if self._step_fn is None or                 self._step_nan != bool(GLOBAL_FLAGS.get("check_nan_inf")):
+        if self._step_fn is None or \
+                self._step_nan != bool(GLOBAL_FLAGS.get("check_nan_inf")) \
+                or self._step_fused != _fused_train_key():
             self._build()
         if self._obs is not None:
             return self._step_observed(state, batch)
@@ -639,7 +654,8 @@ class Trainer:
         from ..analysis import REGISTRY
         from ..core.flags import GLOBAL_FLAGS
         if self._step_fn is None or \
-                self._step_nan != bool(GLOBAL_FLAGS.get("check_nan_inf")):
+                self._step_nan != bool(GLOBAL_FLAGS.get("check_nan_inf")) \
+                or self._step_fused != _fused_train_key():
             self._build()
         spec = self._build_audit_spec(state.tree(),
                                       jnp.float32(self.lr), batch)

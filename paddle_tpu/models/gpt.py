@@ -18,8 +18,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import layer_norm as fused_layer_norm
+from ..ops.pallas.fused_train import fused_linear_ce
 from ._common import (resolve_mesh_axes, spec_fn, normal_init,
-                      masked_cross_entropy, prenorm_block)
+                      prenorm_block)
 
 
 @dataclasses.dataclass
@@ -33,6 +34,10 @@ class GPTConfig:
     layer_norm_epsilon: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # fused linear+CE loss path: None reads FLAGS_fused_train,
+    # False/"ref" pins the chunked lax.scan composition, "pallas"
+    # forces the Pallas custom_vjp kernel (see models/llama.py)
+    fused_train: Any = None
 
     @property
     def head_dim(self):
@@ -101,7 +106,9 @@ def _block(lp, x, cfg: GPTConfig):
                          eps=cfg.layer_norm_epsilon, causal=True)
 
 
-def forward(params: Dict, tokens, cfg: GPTConfig) -> jax.Array:
+def forward_hidden(params: Dict, tokens, cfg: GPTConfig) -> jax.Array:
+    """Final-layer-norm hidden states [B, S, D] (the fused loss applies
+    the tied lm head in chunks instead of materializing [B, S, V])."""
     b, s = tokens.shape
     x = jnp.take(params["wte"], tokens, axis=0) + \
         params["wpe"][:s][None, :, :]
@@ -113,11 +120,21 @@ def forward(params: Dict, tokens, cfg: GPTConfig) -> jax.Array:
         return body(lp, carry), None
 
     x, _ = jax.lax.scan(scan_fn, x, params["layers"])
-    x = fused_layer_norm(x, params["ln_f_w"].astype(x.dtype),
-                         params["ln_f_b"].astype(x.dtype),
-                         cfg.layer_norm_epsilon)
-    return x @ params["wte"].T   # tied embeddings (GPT-2 convention)
+    return fused_layer_norm(x, params["ln_f_w"].astype(x.dtype),
+                            params["ln_f_b"].astype(x.dtype),
+                            cfg.layer_norm_epsilon)
+
+
+def forward(params: Dict, tokens, cfg: GPTConfig) -> jax.Array:
+    # tied embeddings (GPT-2 convention)
+    return forward_hidden(params, tokens, cfg) @ params["wte"].T
 
 
 def loss_fn(params: Dict, tokens, labels, cfg: GPTConfig) -> jax.Array:
-    return masked_cross_entropy(forward(params, tokens, cfg), labels)
+    """Next-token cross entropy via the fused chunked lm-head+CE —
+    [B, S, V] logits are never materialized (previously full logits
+    through ``masked_cross_entropy``); semantics unchanged (negative
+    labels ignored, fp32 masked token mean)."""
+    hidden = forward_hidden(params, tokens, cfg)
+    return fused_linear_ce(hidden, params["wte"].T, labels,
+                           mode=cfg.fused_train)

@@ -30,8 +30,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.rope import build_rope_cache, apply_rope
 from ._common import masked_cross_entropy as _masked_cross_entropy
-from ..ops import rms_norm as fused_rms_norm, swiglu as fused_swiglu
+from ..ops import rms_norm as fused_rms_norm
 from ..ops.flash_attention import flash_attention
+from ..ops.pallas.fused_train import (fused_linear_ce,
+                                      fused_swiglu as _fused_swiglu_train)
+from ..ops.pallas.norms import residual_rms_norm as _residual_rms_norm
 
 __all__ = ["LlamaConfig", "init_params", "forward", "loss_fn",
            "build_forward", "param_shardings", "LLAMA_7B", "LLAMA_TINY"]
@@ -51,6 +54,11 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # fused training-path kernels (Liger-style): None reads
+    # FLAGS_fused_train (default on); False/"ref" pins the unfused
+    # composition (bit-identical to the pre-fusion path), "pallas"
+    # forces the Pallas kernels (tests / audit tracing on CPU)
+    fused_train: Any = None
 
     @property
     def head_dim(self):
@@ -138,7 +146,7 @@ def _decoder_layer(layer_params, x, sin, cos, cfg: LlamaConfig,
     H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
                  cfg.head_dim)
     h = fused_rms_norm(x, layer_params["input_norm"].astype(x.dtype),
-                       cfg.rms_norm_eps)
+                       cfg.rms_norm_eps, mode=cfg.fused_train)
     b, s, _ = h.shape
     q = (h @ layer_params["q_proj"]).reshape(b, s, H, hd)
     kk = (h @ layer_params["k_proj"]).reshape(b, s, KV, hd)
@@ -148,11 +156,17 @@ def _decoder_layer(layer_params, x, sin, cos, cfg: LlamaConfig,
     # GQA handled natively by the kernel (KV heads indexed, not repeated)
     attn = flash_attention(q, kk, v, causal=True)
     attn = attn.reshape(b, s, H * hd)
-    x = x + attn @ layer_params["o_proj"]
-    h = fused_rms_norm(x, layer_params["post_norm"].astype(x.dtype),
-                       cfg.rms_norm_eps)
-    ff = fused_swiglu(h @ layer_params["gate_proj"],
-                      h @ layer_params["up_proj"])
+    # fused training path (Liger-style): the residual add + post-norm
+    # collapse into one kernel and SwiGLU's fwd/bwd each run as one
+    # pass; the dispatched fallback is the EXACT pre-fusion
+    # composition, so mode "ref" / off-TPU is bit-identical to the
+    # pre-fusion block
+    x, h = _residual_rms_norm(attn @ layer_params["o_proj"], x,
+                              layer_params["post_norm"].astype(x.dtype),
+                              cfg.rms_norm_eps, mode=cfg.fused_train)
+    ff = _fused_swiglu_train(h @ layer_params["gate_proj"],
+                             h @ layer_params["up_proj"],
+                             mode=cfg.fused_train)
     x = x + ff @ layer_params["down_proj"]
     return x
 
@@ -179,7 +193,7 @@ def forward_hidden(params: Dict, tokens, cfg: LlamaConfig,
 
     x, _ = jax.lax.scan(scan_fn, x, params["layers"])
     return fused_rms_norm(x, params["final_norm"].astype(x.dtype),
-                          cfg.rms_norm_eps)
+                          cfg.rms_norm_eps, mode=cfg.fused_train)
 
 
 def forward(params: Dict, tokens, cfg: LlamaConfig,
@@ -193,16 +207,18 @@ def forward(params: Dict, tokens, cfg: LlamaConfig,
 
 
 def loss_fn(params: Dict, tokens, labels, cfg: LlamaConfig) -> jax.Array:
-    """Next-token cross entropy in fp32 via the chunked fused
+    """Next-token cross entropy in fp32 via the fused chunked
     lm-head+CE — full [B, S, V] logits are never materialized (the
     reference's fused c_softmax_with_cross_entropy has the same goal for
-    vocab-sharded logits; here chunking also caps HBM)."""
-    from ._common import fused_linear_cross_entropy
+    vocab-sharded logits). Registry-dispatched: the Pallas custom_vjp
+    kernel on TPU (neither logits nor their gradient touch HBM), the
+    lax.scan composition elsewhere (``cfg.fused_train`` pins a
+    variant)."""
     hidden = forward_hidden(params, tokens, cfg)
     head = params.get("lm_head")
     if head is None:
         head = params["embed_tokens"].T
-    return fused_linear_cross_entropy(hidden, head, labels)
+    return fused_linear_ce(hidden, head, labels, mode=cfg.fused_train)
 
 
 def build_forward(cfg: LlamaConfig, key=None):

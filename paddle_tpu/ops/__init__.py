@@ -30,12 +30,20 @@ def rms_norm_ref(x, weight, epsilon=1e-6):
             ).astype(x.dtype) * weight
 
 
-def rms_norm(x, weight, epsilon=1e-6):
-    if _on_tpu():
+def rms_norm(x, weight, epsilon=1e-6, mode=None):
+    """``mode`` (fused-train contract: None reads FLAGS_fused_train,
+    "pallas"/"ref" pin) selects the Pallas BACKWARD variant on TPU; a
+    "pallas" pin also forces the Pallas kernel off-TPU (interpret
+    mode — how tests and the audit catalog trace it on CPU)."""
+    from .pallas._util import fused_train_mode
+    m = fused_train_mode(mode)
+    if _on_tpu() or m == "pallas":
         try:
             from .pallas.norms import rms_norm_pallas
-            return rms_norm_pallas(x, weight, epsilon)
+            return rms_norm_pallas(x, weight, epsilon, mode)
         except Exception:
+            if m == "pallas":
+                raise     # an explicit pin must not silently fall back
             pass
     return rms_norm_ref(x, weight, epsilon)
 

@@ -6,6 +6,59 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ...core.flags import GLOBAL_FLAGS
+
+# The training-side analog of FLAGS_fused_decode: routes the training
+# hot path (chunked lm-head+CE, SwiGLU, RMSNorm backward, the
+# residual+norm epilogue) through the fused Pallas kernels where the
+# registry supports them. Defined here — the ONE shared home — because
+# both norms.py and fused_train.py consult it and neither may import
+# the other.
+GLOBAL_FLAGS.define(
+    "fused_train", True,
+    "route the training hot path (fused linear+cross-entropy, SwiGLU, "
+    "RMSNorm backward) through the fused Pallas training kernels where "
+    "the registry supports them (0 = always the unfused composition, "
+    "for A/B diagnosis)")
+
+
+def fused_train_mode(mode=None) -> str:
+    """Normalize a fused-train mode knob to ``auto | pallas | ref``.
+
+    ``None`` reads FLAGS_fused_train (the global default); explicit
+    ``False``/``0``/"ref" pins the unfused composition, "pallas"/
+    "force" pins the Pallas kernels (tests / audit tracing on CPU),
+    ``True``/"auto" means registry dispatch. Dispatch consults this at
+    TRACE time, so any caller caching traced programs must fold the
+    resolved mode (and ``KERNELS.forced_state()``) into its cache key.
+    """
+    if mode is None:
+        mode = GLOBAL_FLAGS.get("fused_train")
+    if mode in (False, 0, "ref"):
+        return "ref"
+    if mode in ("pallas", "force"):
+        return "pallas"
+    if mode in (True, 1, None, "auto"):
+        return "auto"
+    raise ValueError(
+        f"fused_train mode must be auto|pallas|ref, got {mode!r}")
+
+
+def dispatch_fused_variant(op: str, meta, mode=None):
+    """The ONE fused-training mode contract: resolve ``op`` to a
+    callable — registry dispatch in "auto" (highest-priority variant
+    whose ``supports(meta)`` admits the shape class), a pinned variant
+    for "pallas"/"ref". Every fused-train op wrapper
+    (``fused_linear_ce``, ``fused_swiglu``, ``residual_rms_norm``, the
+    RMSNorm backward) routes through here so the contract cannot drift
+    between copies."""
+    from .registry import KERNELS
+    mode = fused_train_mode(mode)
+    if mode == "auto":
+        return KERNELS.dispatch(op, meta)[1]
+    return KERNELS.variant(
+        op, "pallas_fused" if mode == "pallas" else "unfused").fn
+
 # Pages-per-grid-step autotune candidates for the page-streaming decode
 # kernels (paged_attention's unfused kernel and the fused decode-block
 # attention kernel key the SAME persistent table and must sweep the

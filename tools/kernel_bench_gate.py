@@ -28,6 +28,12 @@ bench.py runs this as a post-window step after the ``kernels`` config
 ``BENCH_KERNEL_GATE_THRESHOLD``, default 0.30 — device timing noise at
 these microsecond scales makes tighter gates flaky).
 
+Coverage is exactly ``bench_kernels``'s timed case set: the serving
+decode kernels AND the fused training kernels (``fused_linear_ce``,
+``fused_swiglu``, ``rms_norm_bwd`` — each timed over the full fwd+bwd
+the trainer runs), so a training-fusion regression fails bench runs
+the same way a decode regression does.
+
 Exit codes: 0 pass (or nothing comparable — no banked data / interpret
 capture: a gate with no reference must not fail vacuously), 1 regression
 over threshold, 3 bad invocation.
