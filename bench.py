@@ -105,6 +105,52 @@ def _audit_gate(run_audit, counters):
         return None
 
 
+def _kernel_audit(out):
+    """Pre-``kernels`` static geometry audit (BENCH_KERNEL_AUDIT=0 opts
+    out): run tools/kernel_audit.py as the real CLI against the
+    committed KERNEL_AUDIT_BASELINE.json — a kernel whose launch
+    geometry regressed (grid floor-drop, VMEM overcommit, dispatch-key
+    gap) fails the audit BEFORE the bench spends a window timing it.
+    Like the program audit, a failure marks the capture
+    (``kernel_audit.rc``); it never kills the bench."""
+    if os.environ.get("BENCH_KERNEL_AUDIT", "1") == "0":
+        return
+    import tempfile
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "kernel_audit.py")
+    res_path = None
+    try:
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            res_path = f.name
+        # pin the child to CPU: the audit only jax.eval_shape's, and a
+        # TPU-backend init would contend with (or hang behind) the chip
+        # the bench windows are about to use
+        p = subprocess.run(
+            [sys.executable, tool, "--json", res_path, "--quiet"],
+            capture_output=True, text=True, timeout=600,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        audit = {"rc": p.returncode}
+        try:
+            with open(res_path) as f:
+                audit["summary"] = json.load(f).get("summary", {})
+        except (OSError, json.JSONDecodeError):
+            pass
+        if p.returncode != 0:
+            audit["stderr"] = (p.stderr or "")[-400:]
+            print(f"[bench] kernel audit failed (rc={p.returncode}): "
+                  f"{(p.stderr or '').strip()[-200:]}", file=sys.stderr)
+        out["kernel_audit"] = audit
+    except Exception as e:  # noqa: BLE001 — audit is evidence, not bench
+        out["kernel_audit"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        if res_path:
+            try:
+                os.unlink(res_path)
+            except OSError:
+                pass
+
+
 def _kernel_gate(out):
     """Post-window per-kernel regression gate (BENCH_KERNEL_GATE=0 opts
     out): diff the fresh ``kernels`` capture against the banked BENCH
@@ -2112,6 +2158,8 @@ def main():
                      "serving_engine", "serving_prefix_cache",
                      "sd_unet", "bert",
                      "resnet_breakdown", "ppyoloe", "llama_ladder"):
+            if name == "kernels":
+                _kernel_audit(out)   # pre-window geometry audit
             out[name] = run_cfg(name, 2700 if name == "llama_ladder"
                                 else extra_t)
             if name == "kernels":

@@ -23,14 +23,18 @@ from .auditor import (AuditReport, audit_program, audit_registry,
                       load_baseline, publish_findings, write_baseline)
 from .registry import (REGISTRY, ProgramRegistry, ProgramSpec,
                        abstract_signature, register_program)
+from .kernel_rules import (KERNEL_RULE_CODES, check_launch,
+                           dispatch_key_rule)
 from .rules import (ALL_RULES, Finding, collective_consistency_rule,
                     constant_bloat_rule, donation_rule,
                     dtype_promotion_rule, retrace_hazard_rule)
 
 __all__ = [
     "AuditReport", "Finding", "ProgramRegistry", "ProgramSpec",
-    "REGISTRY", "ALL_RULES", "abstract_signature", "audit_program",
-    "audit_registry", "audit_spec", "diff_findings", "findings_to_json",
+    "REGISTRY", "ALL_RULES", "KERNEL_RULE_CODES", "abstract_signature",
+    "audit_program",
+    "audit_registry", "audit_spec", "check_launch", "diff_findings",
+    "dispatch_key_rule", "findings_to_json",
     "dtype_promotion_rule",
     "donation_rule", "retrace_hazard_rule", "collective_consistency_rule",
     "constant_bloat_rule", "load_baseline", "publish_findings",

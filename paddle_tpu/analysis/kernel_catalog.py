@@ -1,0 +1,499 @@
+"""Canonical kernel catalog for the geometry-audit gate.
+
+``tools/kernel_audit.py`` and the tier-1 ``pytest -m kernel_audit``
+test need one shared, deterministic set of "the Pallas launches this
+framework ships": every registered kernel op (and the kernels inside
+their custom_vjp backwards) traced at TWO shape classes — ``tiny``
+(the CPU test shapes) and the ``flagship`` serving/training shapes the
+bench configs actually run (bench_serving_engine's engine dims,
+bench_llama's rung dims). Audits only TRACE (``jax.eval_shape`` under
+:class:`~paddle_tpu.ops.pallas._util.capture_kernel_launches`), so the
+flagship shapes cost abstract evaluation, not interpret-mode compute.
+
+Each case declares the launch names it must capture: a case that stops
+reaching one of its kernels produces a ``COVERAGE_GAP`` finding rather
+than silently shrinking the gate (the no-silent-caps rule). The union
+of those declarations, :data:`ALL_KERNEL_NAMES`, is the coverage
+contract the tier-1 test pins against the ``pl.pallas_call`` sites in
+``ops/pallas/``.
+
+The deliberate REGRESSION specimen (the verbatim PRE-FIX non-divisor
+``block_f`` fused-MLP launch whose floor-divided grid drops the
+trailing intermediate columns — the review-caught bug the divisor
+guard now rejects) is opt-in via :func:`build_demo_kernel_regression`
+and never part of the default catalog.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .auditor import AuditReport
+from .kernel_rules import check_launch, dispatch_key_rule
+from .rules import Finding
+
+__all__ = ["KernelCase", "kernel_cases", "capture_case", "audit_kernels",
+           "audit_kernel_registry", "build_demo_kernel_regression",
+           "ALL_KERNEL_NAMES", "KERNEL_CASE_NAMES"]
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelCase:
+    """One audited (kernel family, shape class): ``build()`` returns a
+    trace-only ``(fn, abstract_args)`` pair; ``kernels`` declares the
+    launch names tracing it must capture."""
+    op: str
+    case: str
+    kernels: Tuple[str, ...]
+    build: Callable[[], Tuple[Callable, tuple]]
+
+    @property
+    def name(self) -> str:
+        return f"{self.op}@{self.case}"
+
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+    return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+
+
+# -- per-family builders ------------------------------------------------
+# flagship dims mirror the bench configs: bench_serving_engine's engine
+# (D=1024, H=KV=16, hd=64, F=4096, BS=16, capacity 8, bf16) and
+# bench_llama's rung (D=2048, F=5504, V=32000, batch 2 x seq 2048, bf16)
+
+
+def _rms_case(rows, d, dtype):
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from ..ops.pallas.norms import rms_norm_pallas
+
+        def fn(x, w):
+            return jax.value_and_grad(
+                lambda a, b: rms_norm_pallas(a, b, 1e-6, "pallas")
+                .astype(jnp.float32).sum(), argnums=(0, 1))(x, w)
+        return fn, (_sds((rows, d), dtype), _sds((d,), dtype))
+    return build
+
+
+def _res_rms_case(rows, d, dtype):
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from ..ops.pallas.norms import residual_rms_norm_pallas
+
+        def fn(delta, x, w):
+            def loss(dd, xx, ww):
+                y, h = residual_rms_norm_pallas(dd, xx, ww, 1e-6,
+                                                mode="pallas")
+                return (y.astype(jnp.float32).sum()
+                        + h.astype(jnp.float32).sum())
+            return jax.value_and_grad(loss, argnums=(0, 1, 2))(
+                delta, x, w)
+        s = _sds((rows, d), dtype)
+        return fn, (s, s, _sds((d,), dtype))
+    return build
+
+
+def _layer_norm_case(rows, d, dtype):
+    def build():
+        from ..ops.pallas.norms import layer_norm_pallas
+
+        def fn(x, w, b):
+            return layer_norm_pallas(x, w, b, 1e-5)
+        return fn, (_sds((rows, d), dtype), _sds((d,), dtype),
+                    _sds((d,), dtype))
+    return build
+
+
+def _adamw_case(n, dtype, mdtype, shadow_dtype):
+    def build():
+        from ..ops.pallas.fused_adamw import fused_adamw
+
+        def fn(p, g, m, v):
+            return fused_adamw(p, g, m, v, 1e-3, 2.0,
+                               shadow_dtype=shadow_dtype)
+        return fn, (_sds((n,), dtype), _sds((n,), dtype),
+                    _sds((n,), mdtype), _sds((n,), mdtype))
+    return build
+
+
+def _paged_case(B, H, KV, hd, BS, N, MB, dtype, pp=None):
+    def build():
+        from ..ops.pallas.paged_attention import (
+            paged_attention_decode_pallas)
+
+        def fn(q, kp, vp, bt, ln):
+            return paged_attention_decode_pallas(q, kp, vp, bt, ln,
+                                                 pages_per_step=pp)
+        return fn, (_sds((B, H, hd), dtype),
+                    _sds((N, BS, KV, hd), dtype),
+                    _sds((N, BS, KV, hd), dtype),
+                    _sds((B, MB), "int32"), _sds((B,), "int32"))
+    return build
+
+
+def _flash_case(B, S, H, KVH, hd, dtype, causal=True, bias=False,
+                seg=False):
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from ..ops.pallas.flash_attention import flash_attention_pallas
+
+        def fn(q, k, v, *extra):
+            kw = {}
+            i = 0
+            if bias:
+                kw["bias"] = extra[i]
+                kw["bias_grad"] = True
+                i += 1
+            if seg:
+                kw["segment_ids"] = extra[i]
+                i += 1
+
+            def loss(qq, kk, vv):
+                return flash_attention_pallas(
+                    qq, kk, vv, causal=causal, **kw) \
+                    .astype(jnp.float32).sum()
+            return jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+        args = [_sds((B, S, H, hd), dtype),
+                _sds((B, S, KVH, hd), dtype),
+                _sds((B, S, KVH, hd), dtype)]
+        if bias:
+            args.append(_sds((1, 1, S, S), "float32"))
+        if seg:
+            args.append(_sds((B, S), "int32"))
+        return fn, tuple(args)
+    return build
+
+
+def _attn_block_case(B, D, H, KV, hd, BS, N, MB, dtype, quant=False,
+                     pp=None):
+    def build():
+        from ..ops.pallas.fused_decode_block import fused_attn_block_pallas
+
+        pool_dt = "int8" if quant else dtype
+
+        def fn(x, nw, wq, wk, wv, wo, sin, cos, kp, vp, bt, ln, *sc):
+            kv_scales = (sc[0], sc[1]) if quant else None
+            return fused_attn_block_pallas(
+                x, nw, wq, wk, wv, wo, sin, cos, kp, vp, bt, ln,
+                kv_scales=kv_scales, pages_per_step=pp)
+        args = [_sds((B, D), dtype), _sds((D,), dtype),
+                _sds((D, H * hd), dtype), _sds((D, KV * hd), dtype),
+                _sds((D, KV * hd), dtype), _sds((H * hd, D), dtype),
+                _sds((MB * BS + 1, hd // 2), "float32"),
+                _sds((MB * BS + 1, hd // 2), "float32"),
+                _sds((N, BS, KV, hd), pool_dt),
+                _sds((N, BS, KV, hd), pool_dt),
+                _sds((B, MB), "int32"), _sds((B,), "int32")]
+        if quant:
+            args += [_sds((KV,), "float32"), _sds((KV,), "float32")]
+        return fn, tuple(args)
+    return build
+
+
+def _mlp_block_case(B, D, F, dtype):
+    def build():
+        from ..ops.pallas.fused_decode_block import fused_mlp_block_pallas
+
+        def fn(x, nw, wg, wu, wd):
+            return fused_mlp_block_pallas(x, nw, wg, wu, wd)
+        return fn, (_sds((B, D), dtype), _sds((D,), dtype),
+                    _sds((D, F), dtype), _sds((D, F), dtype),
+                    _sds((F, D), dtype))
+    return build
+
+
+def _linear_ce_case(T, D, V, dtype):
+    def build():
+        import jax
+        from ..ops.pallas.fused_train import linear_ce_pallas
+
+        def fn(hidden, head, labels):
+            return jax.value_and_grad(
+                lambda h, w: linear_ce_pallas(h, w, labels),
+                argnums=(0, 1))(hidden, head)
+        return fn, (_sds((T, D), dtype), _sds((D, V), dtype),
+                    _sds((T,), "int32"))
+    return build
+
+
+def _swiglu_case(R, F, dtype):
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from ..ops.pallas.fused_train import swiglu_pallas
+
+        def fn(g, u):
+            return jax.value_and_grad(
+                lambda gg, uu: swiglu_pallas(gg, uu)
+                .astype(jnp.float32).sum(), argnums=(0, 1))(g, u)
+        return fn, (_sds((R, F), dtype), _sds((R, F), dtype))
+    return build
+
+
+_CE_KERNELS = ("linear_ce_fwd", "linear_ce_bwd_dx", "linear_ce_bwd_dh")
+_FLASH_KERNELS = ("flash_attention_fwd", "flash_attention_bwd_dq",
+                  "flash_attention_bwd_dkv")
+
+
+def kernel_cases() -> List[KernelCase]:
+    """The default gate set: every Pallas kernel family at its tiny +
+    flagship shape classes (building is import-cheap; tracing happens
+    in :func:`capture_case`)."""
+    C = KernelCase
+    return [
+        C("rms_norm", "tiny", ("rms_norm_fwd", "rms_norm_bwd"),
+          _rms_case(24, 128, "float32")),
+        C("rms_norm", "flagship_train", ("rms_norm_fwd", "rms_norm_bwd"),
+          _rms_case(4096, 2048, "bfloat16")),
+        C("rms_norm_residual", "tiny",
+          ("residual_rms_norm_fwd", "rms_norm_bwd"),
+          _res_rms_case(24, 128, "float32")),
+        C("rms_norm_residual", "flagship_train",
+          ("residual_rms_norm_fwd", "rms_norm_bwd"),
+          _res_rms_case(4096, 2048, "bfloat16")),
+        C("layer_norm", "tiny", ("layer_norm_fwd",),
+          _layer_norm_case(24, 128, "float32")),
+        C("layer_norm", "flagship_train", ("layer_norm_fwd",),
+          _layer_norm_case(4096, 1024, "float32")),
+        C("fused_adamw", "tiny", ("fused_adamw",),
+          _adamw_case(1024, "float32", "float32", None)),
+        C("fused_adamw", "flagship_train", ("fused_adamw",),
+          _adamw_case(4 << 20, "float32", "bfloat16", "bfloat16")),
+        C("paged_attention", "tiny", ("paged_attention_decode",),
+          _paged_case(2, 4, 2, 16, 8, 8, 4, "float32")),
+        C("paged_attention", "flagship_serving",
+          ("paged_attention_decode",),
+          _paged_case(8, 16, 16, 64, 16, 128, 24, "bfloat16")),
+        C("paged_attention", "flagship_serving_pp4",
+          ("paged_attention_decode",),
+          _paged_case(8, 16, 16, 64, 16, 128, 24, "bfloat16", pp=4)),
+        C("flash_attention", "tiny", _FLASH_KERNELS,
+          _flash_case(1, 128, 4, 2, 64, "float32")),
+        C("flash_attention", "tiny_bias_seg", _FLASH_KERNELS,
+          _flash_case(1, 128, 4, 2, 64, "float32", bias=True, seg=True)),
+        C("flash_attention", "flagship_train", _FLASH_KERNELS,
+          _flash_case(4, 2048, 16, 8, 128, "bfloat16")),
+        C("decode_attn_block", "tiny", ("decode_attn_block",),
+          _attn_block_case(2, 32, 2, 2, 16, 8, 8, 4, "float32")),
+        C("decode_attn_block", "flagship_serving", ("decode_attn_block",),
+          _attn_block_case(8, 1024, 16, 16, 64, 16, 128, 24, "bfloat16")),
+        C("decode_attn_block", "flagship_serving_pp4",
+          ("decode_attn_block",),
+          _attn_block_case(8, 1024, 16, 16, 64, 16, 128, 24, "bfloat16",
+                           pp=4)),
+        C("decode_attn_block", "flagship_serving_int8",
+          ("decode_attn_block",),
+          _attn_block_case(8, 1024, 16, 16, 64, 16, 128, 24, "bfloat16",
+                           quant=True)),
+        C("decode_mlp_block", "tiny", ("decode_mlp_block",),
+          _mlp_block_case(2, 32, 64, "float32")),
+        C("decode_mlp_block", "flagship_serving", ("decode_mlp_block",),
+          _mlp_block_case(8, 1024, 4096, "bfloat16")),
+        C("fused_linear_ce", "tiny", _CE_KERNELS,
+          _linear_ce_case(24, 64, 96, "float32")),
+        C("fused_linear_ce", "flagship_train", _CE_KERNELS,
+          _linear_ce_case(4096, 2048, 32000, "bfloat16")),
+        C("fused_swiglu", "tiny", ("swiglu_fwd", "swiglu_bwd"),
+          _swiglu_case(16, 64, "float32")),
+        C("fused_swiglu", "flagship_train", ("swiglu_fwd", "swiglu_bwd"),
+          _swiglu_case(4096, 5504, "bfloat16")),
+    ]
+
+
+KERNEL_CASE_NAMES: Tuple[str, ...] = tuple(
+    c.name for c in kernel_cases())
+
+#: every audited launch name — the coverage contract the tier-1 test
+#: pins against the audited_pallas_call sites under ops/pallas/
+ALL_KERNEL_NAMES = frozenset(
+    k for c in kernel_cases() for k in c.kernels)
+
+
+def capture_case(case: KernelCase):
+    """Trace one case under launch capture. Returns (specs, error)."""
+    import jax
+    from ..ops.pallas._util import capture_kernel_launches
+
+    fn, args = case.build()
+    try:
+        with capture_kernel_launches() as specs:
+            jax.eval_shape(fn, *args)
+        return specs, None
+    except Exception as e:  # noqa: BLE001 — a broken trace is a finding
+        return [], e
+
+
+def audit_case(case: KernelCase) -> AuditReport:
+    """Capture + run every geometry rule for one case. A trace failure
+    or a declared-but-uncaptured kernel is itself a finding — the gate
+    must not shrink silently."""
+    report = AuditReport(program=case.name,
+                         rules_run=["kernel_geometry"])
+    specs, err = capture_case(case)
+    if err is not None:
+        report.findings.append(Finding(
+            rule="kernel_auditor", code="TRACE_ERROR", severity="error",
+            program=case.name, site=type(err).__name__,
+            message=(f"kernel case failed to trace: "
+                     f"{type(err).__name__}: {err}"),
+            detail={"exception": type(err).__name__}))
+        report.meta["trace_error"] = str(err)
+        return report
+    captured = {s.name for s in specs}
+    for missing in sorted(set(case.kernels) - captured):
+        report.findings.append(Finding(
+            rule="kernel_auditor", code="COVERAGE_GAP", severity="error",
+            program=case.name, site=missing,
+            message=(f"case declares kernel {missing!r} but tracing "
+                     f"captured only {sorted(captured)} — a launch "
+                     "stopped routing through audited_pallas_call (or "
+                     "the case no longer reaches it)"),
+            detail={"declared": sorted(case.kernels),
+                    "captured": sorted(captured)}))
+    for spec in specs:
+        report.findings.extend(check_launch(spec, program=case.name))
+    report.meta["kernels"] = sorted(captured)
+    report.meta["launches"] = len(specs)
+    return report
+
+
+# -- registry lint ------------------------------------------------------
+
+
+def _lint_metas() -> Dict[str, dict]:
+    """Representative flagship meta per registered op, built through
+    the SAME meta builders the call sites use (so the lint instruments
+    the real key set, not a hand-copied one)."""
+    import jax.numpy as jnp
+    from ..ops.pallas.fused_adamw import adamw_meta
+    from ..ops.pallas.fused_decode_block import decode_meta_dims
+    from ..ops.pallas.fused_train import ce_meta, swiglu_meta
+    from ..ops.pallas.norms import rms_bwd_meta
+
+    decode = decode_meta_dims(8, 1024, 16, 16, 64, 4096, 16, 24,
+                              jnp.bfloat16, jnp.bfloat16, False)
+    return {
+        "decode_attn_block": decode,
+        "decode_mlp_block": decode,
+        "fused_linear_ce": ce_meta(4096, 2048, 32000, jnp.bfloat16),
+        "fused_swiglu": swiglu_meta(4096, 5504, jnp.bfloat16),
+        "rms_norm_bwd": rms_bwd_meta(4096, 2048, jnp.bfloat16),
+        "rms_norm_residual": rms_bwd_meta(4096, 2048, jnp.bfloat16),
+        "fused_adamw": adamw_meta(4 << 20, jnp.float32, jnp.bfloat16,
+                                  True),
+    }
+
+
+def audit_kernel_registry() -> AuditReport:
+    """The DISPATCH_KEY_GAP lint over every registered kernel op. An op
+    the lint has no sample meta for is itself a finding: adding a
+    kernel op means teaching the auditor its shape class."""
+    from ..ops.pallas.registry import KERNELS
+
+    report = AuditReport(program="kernel_registry",
+                         rules_run=["dispatch_key"])
+    metas = _lint_metas()
+    for op in KERNELS.ops():
+        meta = metas.get(op)
+        if meta is None:
+            report.findings.append(Finding(
+                rule="kernel_geometry", code="DISPATCH_KEY_GAP",
+                severity="error", program="kernel_registry",
+                site=f"{op}:no-sample",
+                message=(f"registered kernel op {op!r} has no lint "
+                         "sample meta in the kernel catalog — its "
+                         "supports() reads cannot be checked against "
+                         "the declared cache-key coverage"),
+                detail={"op": op}))
+            continue
+        report.findings.extend(dispatch_key_rule(
+            KERNELS, op, meta, program="kernel_registry"))
+    report.meta["ops"] = KERNELS.ops()
+    return report
+
+
+def audit_kernels(names: Optional[List[str]] = None,
+                  registry_lint: bool = True) -> List[AuditReport]:
+    """Audit the catalog (all cases, or the ``op`` / ``op@case``
+    subset) + the registry lint. Mirrors ``catalog.build_catalog``'s
+    unknown-name contract: a typo'd selection raises instead of gating
+    nothing."""
+    cases = kernel_cases()
+    if names is not None:
+        wanted = set(names)
+        known = {c.name for c in cases} | {c.op for c in cases} \
+            | {"kernel_registry"}
+        unknown = wanted - known
+        if unknown:
+            raise ValueError(
+                f"unknown kernel case(s): {sorted(unknown)} — known: "
+                f"{sorted(known)}")
+        cases = [c for c in cases
+                 if c.name in wanted or c.op in wanted]
+        registry_lint = registry_lint and "kernel_registry" in wanted
+    reports = [audit_case(c) for c in cases]
+    if registry_lint:
+        reports.append(audit_kernel_registry())
+    return reports
+
+
+# -- demo regression ----------------------------------------------------
+
+
+def build_demo_kernel_regression() -> AuditReport:
+    """The PRE-FIX non-divisor ``block_f`` fused-MLP launch, verbatim:
+    ``grid=(F // bf,)`` with ``F % bf != 0`` floor-drops the ragged
+    tail tile, so the last ``F % bf`` intermediate columns never feed
+    the down-projection accumulator — greedy decode silently computes
+    with a truncated MLP. The shipped kernel now REJECTS non-divisor
+    tiles; this specimen re-creates the exact pre-fix launch so the
+    CLI's ``--demo-regression`` proves the gate still catches the
+    class (and CI self-checks exit code 2)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ..ops.pallas._util import (audited_pallas_call,
+                                    capture_kernel_launches)
+    from ..ops.pallas.fused_decode_block import _mlp_block_kernel
+
+    B, D, F, bf = 2, 32, 96, 64   # F % bf = 32 columns silently dropped
+
+    def prefix_mlp(x, nw, wg, wu, wd, eps=1e-6):
+        const = lambda j: (0, 0)                          # noqa: E731
+        return audited_pallas_call(
+            functools.partial(_mlp_block_kernel, eps=eps),
+            name="demo_prefix_mlp_block",
+            accum_outputs=(0,),
+            grid=(F // bf,),           # the bug: floor, not cdiv+guard
+            in_specs=[pl.BlockSpec((B, D), const),
+                      pl.BlockSpec((1, D), const),
+                      pl.BlockSpec((D, bf), lambda j: (0, j)),
+                      pl.BlockSpec((D, bf), lambda j: (0, j)),
+                      pl.BlockSpec((bf, D), lambda j: (j, 0))],
+            out_specs=pl.BlockSpec((B, D), const),
+            out_shape=jax.ShapeDtypeStruct((B, D), x.dtype),
+            scratch_shapes=[pltpu.VMEM((B, D), x.dtype),
+                            pltpu.VMEM((B, D), jnp.float32)],
+            interpret=True,
+        )(x, nw.reshape(1, D), wg, wu, wd)
+
+    report = AuditReport(program="demo_prefix_mlp_block@tiny",
+                         rules_run=["kernel_geometry"])
+    with capture_kernel_launches() as specs:
+        jax.eval_shape(
+            prefix_mlp, _sds((B, D), "float32"), _sds((D,), "float32"),
+            _sds((D, F), "float32"), _sds((D, F), "float32"),
+            _sds((F, D), "float32"))
+    for spec in specs:
+        report.findings.extend(
+            check_launch(spec, program=report.program))
+    return report

@@ -1,0 +1,493 @@
+"""Kernel-geometry rule passes over captured Pallas launches.
+
+PR 5's jaxpr auditor gates program-level bug classes; the serving and
+training hot paths now live one layer down, inside the Pallas
+megakernels, where the recurring review-caught bugs are GEOMETRY bugs:
+a non-divisor tile whose floor-divided grid silently drops the trailing
+columns, a pipeline window set that overshoots the scoped-VMEM OOM
+point, an output index map that revisits a block nobody declared as an
+accumulator. Every kernel routes its ``pl.pallas_call`` through
+``ops/pallas/_util.audited_pallas_call``, which records a
+:class:`~paddle_tpu.ops.pallas._util.KernelLaunchSpec` at trace time;
+the rules here evaluate the captured index maps CONCRETELY over the
+full grid (they are pure Python on ints — scalar-prefetch maps are
+evaluated against zero-filled sample tables, recorded as ``sampled`` in
+the finding detail) and prove:
+
+- ``GRID_FLOOR_DROP``   — an operand's block-coordinate set does not
+  cover every block of its array: output elements never written, or —
+  for launches WITHOUT scalar prefetch, where every read is statically
+  addressed — input blocks never read (the fused_mlp_block non-divisor
+  ``block_f`` review class: ``grid=(F // bf,)`` leaves the trailing
+  weight columns out of the accumulation). Scalar-prefetch launches
+  read pages data-dependently (live pages only), so their input
+  coverage is intentionally partial and exempt.
+- ``OOB_BLOCK``         — an index map sends a block start past the
+  array extent (or negative) on some grid step; a partially overhanging
+  LAST block is legal (Pallas masks it) and not flagged.
+- ``WRITE_RACE``        — an output index map is non-injective across
+  grid steps without a declared accumulation (``accum_outputs``):
+  sequential TPU grids make revisits well-defined, but an UNDECLARED
+  revisit is a last-write-wins bug waiting for a grid reorder.
+- ``VMEM_OVERCOMMIT``   — Σ block bytes × pipeline-window count
+  (grid-varying blocks are double-buffered by Mosaic, constant-index
+  blocks are fetched once, scratch is resident) over the scoped-VMEM
+  envelope — the PR-7 residual-epilogue OOM class.
+- ``SCRATCH_MISMATCH``  — the kernel callable's positional arity does
+  not match prefetch + inputs + outputs + scratch (or a zero-sized
+  scratch buffer is declared).
+- ``DISPATCH_KEY_GAP``  — the registry lint: a meta key read by a
+  variant's ``supports()`` (or the candidate builders it calls) that
+  the op's declared program-cache/autotune key coverage
+  (``KERNELS.declare_cache_key``) does not include — the thrice-fixed
+  ``_PAGED_CACHE`` stale-route class.
+
+Findings reuse the PR-5 frozen schema (:class:`.rules.Finding`), so the
+baseline-diff workflow, fingerprints and the CLI/JSON contract are
+shared with the program auditor.
+"""
+from __future__ import annotations
+
+import inspect
+import itertools
+import os
+from collections.abc import Mapping
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .rules import Finding
+
+__all__ = ["KERNEL_RULE_CODES", "check_launch", "dispatch_key_rule",
+           "scoped_vmem_envelope"]
+
+KERNEL_RULE_CODES = ("GRID_FLOOR_DROP", "OOB_BLOCK", "WRITE_RACE",
+                     "VMEM_OVERCOMMIT", "SCRATCH_MISMATCH",
+                     "DISPATCH_KEY_GAP")
+
+#: the documented v5e scoped-VMEM OOM point the PR-6/7 review rounds
+#: kept bumping into; a launch whose pipelined windows exceed it fails
+#: to compile (or OOMs) on real chips
+SCOPED_VMEM_BYTES = 16 << 20
+
+
+def scoped_vmem_envelope(budget: int = 0) -> int:
+    """The VMEM ceiling a launch's windows must fit: the scoped-VMEM
+    window (``PADDLE_TPU_SCOPED_VMEM_BUDGET``, default 16 MiB), raised
+    to the fused dispatch budget (``PADDLE_TPU_FUSED_VMEM_BUDGET``,
+    captured per launch) when an operator explicitly configures a
+    larger one — the dispatch budget bounds the weight-resident share,
+    the envelope bounds weights + double-buffered pipeline windows +
+    scratch together."""
+    env = int(os.environ.get("PADDLE_TPU_SCOPED_VMEM_BUDGET",
+                             SCOPED_VMEM_BYTES))
+    return max(env, int(budget or 0))
+
+
+# -- geometry evaluation ------------------------------------------------
+
+
+def _itemsize(dtype: str) -> int:
+    import jax.numpy as jnp
+
+    try:
+        return int(jnp.dtype(dtype).itemsize)
+    except TypeError:
+        return 4
+
+
+def _norm_block(block_shape) -> Tuple[int, ...]:
+    """Block shape with squeezed (None) dims as size-1."""
+    return tuple(1 if b is None else int(b) for b in block_shape)
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def _prefetch_samples(spec, ramp: bool = False) -> List[np.ndarray]:
+    """Stand-ins for the scalar-prefetch operands. The default is
+    zero-filled: a zero table is always a VALID table (page 0 exists
+    whenever the pool is non-empty), so bounds proven on it are proofs
+    for the in-range-table contract, recorded as ``sampled`` in the
+    finding detail. ``ramp=True`` fills ints with ``arange % 2``
+    instead — used ONLY by the VMEM window model to detect that a
+    table-dereferencing index map actually VARIES across grid steps
+    (on the all-zero table every page fetch collapses to page 0 and a
+    streamed, double-buffered operand would masquerade as a resident
+    constant block); {0, 1} stays in range for any table whose target
+    extent is >= 2, and the ramp is never used for bounds findings."""
+    out = []
+    for shape, dtype in spec.prefetch:
+        try:
+            dt = np.dtype(dtype)
+        except TypeError:
+            dt = np.int32
+        if ramp and np.issubdtype(dt, np.integer):
+            n = int(np.prod(shape or (1,), dtype=np.int64))
+            out.append((np.arange(n, dtype=dt) % 2).reshape(shape))
+        else:
+            out.append(np.zeros(shape, dt))
+    return out
+
+
+def _operand_coords(spec, op, _memo=None,
+                    ramp: bool = False) -> Optional[Dict[Tuple, Tuple]]:
+    """grid point -> block coordinates for one operand, evaluated
+    concretely over the FULL grid. None for whole-array operands
+    (memory-space specs: no index map, no blocking). ``_memo`` (keyed
+    by operand identity) dedupes the evaluation across rules — one
+    walk of the grid per operand, not one per rule."""
+    if op.block_shape is None or op.index_map is None:
+        return None
+    key = (id(op), ramp)
+    if _memo is not None and key in _memo:
+        return _memo[key]
+    samples = _prefetch_samples(spec, ramp=ramp)
+    coords: Dict[Tuple, Tuple] = {}
+    for point in itertools.product(*(range(g) for g in spec.grid)):
+        # np.int32 grid indices: the all-int32 index maps (e.g. the
+        # clamped page fetch) call .astype on them, which a bare
+        # python int lacks
+        raw = op.index_map(*(np.int32(p) for p in point), *samples)
+        if not isinstance(raw, tuple):
+            raw = (raw,)
+        coords[point] = tuple(int(v) for v in raw)
+    if _memo is not None:
+        _memo[key] = coords
+    return coords
+
+
+def _finding(program, code, severity, site, message, detail):
+    return Finding(rule="kernel_geometry", code=code, severity=severity,
+                   program=program, site=site, message=message,
+                   detail=detail)
+
+
+def _bounds_findings(spec, program, label, op, coords) -> List[Finding]:
+    """OOB_BLOCK for one operand: any block whose START lies outside
+    the array extent. A ragged LAST block overhanging the extent is
+    legal (Pallas masks the tail) and not flagged."""
+    out = []
+    block = _norm_block(op.block_shape)
+    if not coords:
+        return out
+    ndim = len(op.shape)
+    for point, coord in coords.items():
+        if len(coord) != ndim or len(block) != ndim:
+            out.append(_finding(
+                program, "OOB_BLOCK", "error",
+                f"{spec.name}/{label}",
+                f"{spec.name} {label}: index map returns {len(coord)} "
+                f"coords for a {ndim}-d array {list(op.shape)}",
+                {"kernel": spec.name, "grid_point": list(point),
+                 "coords": list(coord)}))
+            return out
+        for d, (c, bs, ext) in enumerate(zip(coord, block, op.shape)):
+            start = c * bs
+            if start < 0 or start >= ext:
+                out.append(_finding(
+                    program, "OOB_BLOCK", "error",
+                    f"{spec.name}/{label}",
+                    (f"{spec.name} {label}: grid point {list(point)} "
+                     f"maps dim {d} to block {c} (elements "
+                     f"[{start}, {start + bs})) outside the array "
+                     f"extent {ext} — the fetch/write is past the "
+                     "array"),
+                    {"kernel": spec.name, "grid_point": list(point),
+                     "dim": d, "block_index": c, "block_size": bs,
+                     "extent": ext,
+                     "sampled": spec.num_scalar_prefetch > 0}))
+                return out  # one proof per operand is enough
+    return out
+
+
+def _coverage_finding(spec, program, label, op, coords, verb):
+    block = _norm_block(op.block_shape)
+    covered = set(coords.values())
+    required = set(itertools.product(
+        *(range(_cdiv(ext, bs)) for ext, bs in zip(op.shape, block))))
+    missing = required - covered
+    if not missing:
+        return None
+    first = sorted(missing)[0]
+    starts = [c * bs for c, bs in zip(first, block)]
+    return _finding(
+        program, "GRID_FLOOR_DROP", "error",
+        f"{spec.name}/{label}",
+        (f"{spec.name} {label}: {len(missing)} of {len(required)} "
+         f"blocks are never {verb} (first missing block {list(first)} "
+         f"= elements starting at {starts} of {list(op.shape)}) — a "
+         "floor-divided grid is dropping the trailing blocks (the "
+         "non-divisor block_f class)"),
+        {"kernel": spec.name, "missing_blocks": len(missing),
+         "required_blocks": len(required),
+         "first_missing": list(first), "grid": list(spec.grid),
+         "block_shape": list(block)})
+
+
+def _output_findings(spec, program, memo) -> List[Finding]:
+    """Coverage + injectivity + bounds for every output."""
+    out: List[Finding] = []
+    for i, op in enumerate(spec.outputs):
+        label = f"out{i}"
+        coords = _operand_coords(spec, op, memo)
+        if coords is None:
+            continue  # whole-array output: trivially covered
+        out.extend(_bounds_findings(spec, program, label, op, coords))
+        block = _norm_block(op.block_shape)
+        if len(block) != len(op.shape) or any(
+                len(c) != len(block) for c in coords.values()):
+            continue  # malformed arity: already an OOB_BLOCK finding —
+            # comparing wrong-arity coords would fabricate coverage/
+            # race findings on top of the real one
+        f = _coverage_finding(spec, program, label, op, coords,
+                              "written")
+        if f is not None:
+            out.append(f)
+        covered = set(coords.values())
+        if len(covered) < len(coords) and i not in spec.accum_outputs:
+            revisits = len(coords) - len(covered)
+            out.append(_finding(
+                program, "WRITE_RACE", "error",
+                f"{spec.name}/{label}",
+                (f"{spec.name} {label}: index map revisits the same "
+                 f"output block on {revisits} of {len(coords)} grid "
+                 "steps with no declared accumulation — sequential "
+                 "last-write-wins today, a race after any grid "
+                 "reorder; declare it via audited_pallas_call("
+                 "accum_outputs=...) if the revisit is an intentional "
+                 "scratch-accumulate pattern"),
+                {"kernel": spec.name, "revisited_steps": revisits,
+                 "grid_steps": len(coords),
+                 "distinct_blocks": len(covered)}))
+    return out
+
+
+def _input_findings(spec, program, memo) -> List[Finding]:
+    out: List[Finding] = []
+    for i, op in enumerate(spec.inputs):
+        coords = _operand_coords(spec, op, memo)
+        if coords is None:
+            continue
+        out.extend(_bounds_findings(spec, program, f"in{i}", op, coords))
+        if spec.num_scalar_prefetch:
+            continue  # page reads are data-dependent: live pages only
+        block = _norm_block(op.block_shape)
+        if len(block) != len(op.shape) or any(
+                len(c) != len(block) for c in coords.values()):
+            continue  # malformed arity: OOB_BLOCK already reported
+        f = _coverage_finding(spec, program, f"in{i}", op, coords,
+                              "read")
+        if f is not None:
+            out.append(f)
+    return out
+
+
+def _vmem_findings(spec, program, memo) -> List[Finding]:
+    """Window model: a grid-VARYING block is double-buffered by the
+    Mosaic pipeline (2 windows), a constant-index block is fetched once
+    and stays resident (1 window — revisit elision), scratch is
+    resident for the whole launch. SMEM operands don't charge the
+    window. Variance of a table-dereferencing (scalar-prefetch) map is
+    probed on BOTH the zero and the ramp sample tables — on the
+    all-zero table every page fetch collapses to page 0 and a streamed
+    pool operand would wrongly look like a resident constant. Σ must
+    fit the scoped-VMEM envelope."""
+    need = 0
+    parts = []
+    for kind, ops in (("in", spec.inputs), ("out", spec.outputs)):
+        for i, op in enumerate(ops):
+            if op.space == "smem":
+                continue
+            if op.block_shape is None:
+                nbytes = int(np.prod(op.shape or (1,), dtype=np.int64)) \
+                    * _itemsize(op.dtype)
+                windows = 1
+            else:
+                block = _norm_block(op.block_shape)
+                nbytes = int(np.prod(block, dtype=np.int64)) \
+                    * _itemsize(op.dtype)
+                coords = _operand_coords(spec, op, memo)
+                distinct = set(coords.values()) if coords else set()
+                if spec.num_scalar_prefetch and len(distinct) <= 1:
+                    ramped = _operand_coords(spec, op, memo, ramp=True)
+                    if ramped:
+                        distinct |= set(ramped.values())
+                windows = 2 if len(distinct) > 1 else 1
+            need += windows * nbytes
+            if windows * nbytes >= (64 << 10):
+                parts.append(f"{kind}{i}:{windows}x{nbytes >> 10}KiB")
+    for shape, dtype, space in spec.scratch:
+        if space == "smem":
+            continue
+        need += int(np.prod(shape or (1,), dtype=np.int64)) \
+            * _itemsize(dtype)
+    envelope = scoped_vmem_envelope(spec.vmem_budget)
+    if need > envelope:
+        return [_finding(
+            program, "VMEM_OVERCOMMIT", "error",
+            f"{spec.name}/windows",
+            (f"{spec.name}: pipelined VMEM windows total "
+             f"~{need >> 20}MiB > the {envelope >> 20}MiB scoped-VMEM "
+             f"envelope (largest: {', '.join(parts[:4])}) — the "
+             "double-buffered window set OOMs a v5e (the PR-7 "
+             "residual-epilogue class); shrink the block sizes or "
+             "scale the per-buffer budget by the window count"),
+            {"kernel": spec.name, "need_bytes": need,
+             "envelope_bytes": envelope,
+             "fused_budget_bytes": spec.vmem_budget,
+             "windows": parts})]
+    return []
+
+
+def _scratch_findings(spec, program) -> List[Finding]:
+    out: List[Finding] = []
+    for i, (shape, dtype, space) in enumerate(spec.scratch):
+        if int(np.prod(shape or (1,), dtype=np.int64)) == 0:
+            out.append(_finding(
+                program, "SCRATCH_MISMATCH", "error",
+                f"{spec.name}/scratch{i}",
+                f"{spec.name}: scratch {i} has zero elements "
+                f"({list(shape)}) — a dead declaration",
+                {"kernel": spec.name, "scratch": i,
+                 "shape": list(shape)}))
+    if spec.kernel is None:
+        return out
+    try:
+        sig = inspect.signature(spec.kernel)
+    except (TypeError, ValueError):
+        return out
+    params = list(sig.parameters.values())
+    has_var = any(p.kind is inspect.Parameter.VAR_POSITIONAL
+                  for p in params)
+    npos = sum(1 for p in params
+               if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                             inspect.Parameter.POSITIONAL_OR_KEYWORD)
+               and p.default is inspect.Parameter.empty)
+    expected = (spec.num_scalar_prefetch + len(spec.inputs)
+                + len(spec.outputs) + len(spec.scratch))
+    bad = (npos > expected) if has_var else (npos != expected)
+    if bad:
+        out.append(_finding(
+            program, "SCRATCH_MISMATCH", "error",
+            f"{spec.name}/arity",
+            (f"{spec.name}: kernel takes {npos} positional refs"
+             f"{' (+ *varargs)' if has_var else ''} but the launch "
+             f"passes {expected} ({spec.num_scalar_prefetch} prefetch "
+             f"+ {len(spec.inputs)} in + {len(spec.outputs)} out + "
+             f"{len(spec.scratch)} scratch) — the ref lists are "
+             "misaligned"),
+            {"kernel": spec.name, "positional": npos,
+             "expected": expected, "varargs": has_var}))
+    return out
+
+
+def check_launch(spec, program: str = None) -> List[Finding]:
+    """Run every geometry rule over one captured launch. ``program``
+    names the audited shape class (defaults to the kernel name) and
+    keys the finding fingerprints."""
+    program = program or spec.name
+    memo: Dict[int, Dict] = {}
+    out: List[Finding] = []
+    out.extend(_output_findings(spec, program, memo))
+    out.extend(_input_findings(spec, program, memo))
+    out.extend(_vmem_findings(spec, program, memo))
+    out.extend(_scratch_findings(spec, program))
+    return out
+
+
+# -- registry lint ------------------------------------------------------
+
+
+class _RecordingMeta(Mapping):
+    """Mapping recording every key a supports() predicate (or anything
+    it calls) reads — the instrumentation behind DISPATCH_KEY_GAP.
+    Membership tests count as reads, and any iteration or copy
+    (``keys``/``items``/``values``/``dict(meta)``/``{**meta}``)
+    conservatively counts as reading EVERY key — a predicate that
+    copies or walks the meta can depend on all of it. Deliberately NOT
+    a dict subclass: CPython's ``dict(subclass)`` C fast path skips
+    overridden methods, while copying a Mapping goes through the
+    (instrumented) protocol."""
+
+    def __init__(self, data):
+        self._data = dict(data)
+        self.accessed = set()
+
+    def __getitem__(self, k):
+        self.accessed.add(k)
+        return self._data[k]
+
+    def get(self, k, default=None):
+        self.accessed.add(k)
+        return self._data.get(k, default)
+
+    def __contains__(self, k):
+        self.accessed.add(k)
+        return k in self._data
+
+    def __iter__(self):
+        self.accessed.update(self._data)
+        return iter(self._data)
+
+    def __len__(self):
+        return len(self._data)
+
+
+def dispatch_key_rule(registry, op: str, meta: Dict,
+                      program: str = "kernel_registry") -> List[Finding]:
+    """Instrument every variant's ``supports(meta)`` for op and flag
+    meta keys it reads that the op's declared program-cache/autotune
+    key coverage (``registry.declare_cache_key``) does not include.
+
+    A supports() read is a TRACE-TIME dispatch input: if the caller's
+    program cache does not key on it, a changed value silently replays
+    a program compiled under the other routing — the bug class fixed
+    three times by hand in the ``_PAGED_CACHE`` route key before this
+    lint existed."""
+    out: List[Finding] = []
+    decl = registry.cache_key_decl(op)
+    if decl is None:
+        out.append(_finding(
+            program, "DISPATCH_KEY_GAP", "error", f"{op}:undeclared",
+            (f"kernel op {op!r} has supports() dispatch but no "
+             "declare_cache_key() coverage declaration — the lint "
+             "cannot prove its callers' program caches key every "
+             "dispatch input"),
+            {"op": op}))
+        return out
+    fields, covers = decl
+    fieldset = set(fields)
+    for variant in registry.variants(op):
+        if variant.supports is None:
+            continue
+        rec = _RecordingMeta(meta)
+        try:
+            variant.supports(rec)
+        except Exception as e:  # noqa: BLE001 — a raising predicate is a bug
+            out.append(_finding(
+                program, "DISPATCH_KEY_GAP", "error",
+                f"{op}/{variant.name}:raised",
+                f"supports() of {op}/{variant.name} raised "
+                f"{type(e).__name__}: {e}",
+                {"op": op, "variant": variant.name,
+                 "exception": type(e).__name__}))
+            continue
+        gap = sorted(k for k in rec.accessed
+                     if k not in fieldset
+                     and covers.get(k) not in fieldset)
+        if gap:
+            out.append(_finding(
+                program, "DISPATCH_KEY_GAP", "error",
+                f"{op}/{variant.name}",
+                (f"supports() of {op}/{variant.name} reads meta "
+                 f"key(s) {gap} that the op's declared program-cache/"
+                 "autotune key coverage does not include — a changed "
+                 "value would flip dispatch without retracing (the "
+                 "_PAGED_CACHE stale-route class); add the key to the "
+                 "caller's cache key and to declare_cache_key()"),
+                {"op": op, "variant": variant.name, "gap": gap,
+                 "accessed": sorted(rec.accessed),
+                 "declared": sorted(fieldset)}))
+    return out

@@ -37,14 +37,19 @@ __all__ = ["MeshConfig", "make_mesh", "TrainState", "Trainer"]
 
 def _fused_train_key():
     """Everything that can flip the fused-training-kernel dispatch at
-    TRACE time: the FLAGS_fused_train mode plus any registry force
-    pins. A loss_fn routed through the registry (models/llama.py,
-    models/gpt.py) bakes the dispatched variant into the traced step,
-    so a changed key must REBUILD the step program — not silently
-    replay a program traced under the old routing."""
-    from ..ops.pallas._util import fused_train_mode
+    TRACE time: the FLAGS_fused_train mode, any registry force pins,
+    the scoped-VMEM budget (it reshapes the supports() predicates and
+    the tile-candidate lists) and the interpret override. A loss_fn
+    routed through the registry (models/llama.py, models/gpt.py) bakes
+    the dispatched variant into the traced step, so a changed key must
+    REBUILD the step program — not silently replay a program traced
+    under the old routing (the same contract generation.py's
+    _PAGED_CACHE route key keeps for the decode megakernels)."""
+    from ..ops.pallas._util import (fused_train_mode, fused_vmem_budget,
+                                    interpret_mode)
     from ..ops.pallas.registry import KERNELS
-    return (fused_train_mode(), KERNELS.forced_state())
+    return (fused_train_mode(), KERNELS.forced_state(),
+            fused_vmem_budget(), bool(interpret_mode()))
 
 
 @dataclasses.dataclass
@@ -368,8 +373,11 @@ class Trainer:
         """Single-pass Pallas AdamW over flat fp32 state (+ bf16 shadow).
         grads arrive as a pytree; one concat (the only extra HBM traffic)
         feeds the multi-tensor kernel, and the updated shadow is sliced
-        back into the param tree shapes."""
-        from ..ops.pallas.fused_adamw import fused_adamw
+        back into the param tree shapes. The kernel is registry-
+        dispatched (``adamw_update``): the Pallas multi-tensor kernel
+        on TPU, its bit-matching jnp composition under interpret mode —
+        the dispatch inputs are covered by ``_fused_train_key``."""
+        from ..ops.pallas.fused_adamw import adamw_update
         hp = self.hp
         treedef, shapes, sizes, pdtype, pad, dtypes = self._flat_meta
         _, master, mu, nu, step = state_tree
@@ -390,7 +398,7 @@ class Trainer:
         scale = jnp.minimum(1.0, hp["grad_clip"]
                             / jnp.maximum(gnorm, 1e-12)) \
             if hp["grad_clip"] else jnp.float32(1.0)
-        outs = fused_adamw(
+        outs = adamw_update(
             master, g_flat, mu, nu, lr, step_n.astype(jnp.float32),
             beta1=hp["b1"], beta2=hp["b2"], epsilon=1e-8,
             weight_decay=hp["wd"], grad_scale=scale, shadow_dtype=pdtype)

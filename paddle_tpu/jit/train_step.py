@@ -181,15 +181,18 @@ class TrainStep:
         labels = tuple(to_value(x) if isinstance(x, Tensor) else jnp.asarray(x)
                        for x in _as_tuple(labels))
         from ..core.flags import GLOBAL_FLAGS
-        from ..ops.pallas._util import fused_train_mode
+        from ..ops.pallas._util import (fused_train_mode,
+                                        fused_vmem_budget, interpret_mode)
         from ..ops.pallas.registry import KERNELS
         nan_check = bool(GLOBAL_FLAGS.get("check_nan_inf"))
-        # the fused-train mode + any registry force pins are trace-time
-        # dispatch inputs for models routed through the fused training
-        # kernels: a flipped flag must retrace, not replay a program
-        # compiled under the other routing
+        # the fused-train mode, any registry force pins, the VMEM
+        # budget and the interpret override are trace-time dispatch
+        # inputs for models routed through the fused training kernels:
+        # a flipped knob must retrace, not replay a program compiled
+        # under the other routing
         key = (len(inputs), len(labels), nan_check,
                fused_train_mode(), KERNELS.forced_state(),
+               fused_vmem_budget(), bool(interpret_mode()),
                tuple((x.shape, str(x.dtype)) for x in inputs + labels))
         fn = self._compiled.get(key)
         if fn is None:

@@ -1,7 +1,11 @@
 """Shared Pallas helpers."""
 from __future__ import annotations
 
+import dataclasses
 import functools
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -154,6 +158,209 @@ def interpret_mode() -> bool:
     if _FORCE_INTERPRET is not None:
         return _FORCE_INTERPRET
     return jax.default_backend() not in ("tpu", "axon")
+
+
+# ---------------------------------------------------------------------------
+# kernel-launch capture: the geometry-audit layer
+# ---------------------------------------------------------------------------
+def fused_vmem_budget() -> int:
+    """The scoped-VMEM budget the fused kernels' dispatch predicates
+    honor (``PADDLE_TPU_FUSED_VMEM_BUDGET``, default 10 MiB of the
+    16 MiB window — the rest stays free for double-buffered pipeline
+    windows and fp32 scratch). The ONE shared home: supports()
+    predicates, autotune candidate lists, program-cache route keys and
+    the kernel-geometry auditor all read this value, so it cannot
+    drift between them."""
+    return int(os.environ.get("PADDLE_TPU_FUSED_VMEM_BUDGET",
+                              10 * 2 ** 20))
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelOperand:
+    """One blocked operand of a captured Pallas launch: the array's
+    abstract geometry plus its BlockSpec's (block_shape, index_map).
+    ``block_shape`` None = whole-array operand (memory-space spec, no
+    index map). ``space`` is a best-effort label ("vmem"/"smem"/"any")."""
+    shape: Tuple[int, ...]
+    dtype: str
+    block_shape: Optional[Tuple] = None
+    index_map: Optional[Callable] = None
+    space: str = "vmem"
+
+
+@dataclasses.dataclass
+class KernelLaunchSpec:
+    """Trace-time record of one ``pl.pallas_call`` launch: everything
+    the kernel-geometry rules (:mod:`paddle_tpu.analysis.kernel_rules`)
+    need to prove grid coverage, block bounds, write injectivity and
+    the VMEM window budget — captured at the audited_pallas_call
+    boundary, never by re-parsing kernel code."""
+    name: str
+    grid: Tuple[int, ...]
+    num_scalar_prefetch: int = 0
+    prefetch: Tuple[Tuple[Tuple[int, ...], str], ...] = ()
+    inputs: Tuple[KernelOperand, ...] = ()
+    outputs: Tuple[KernelOperand, ...] = ()
+    scratch: Tuple[Tuple[Tuple[int, ...], str, str], ...] = ()
+    accum_outputs: Tuple[int, ...] = ()
+    vmem_budget: int = 0
+    interpret: bool = False
+    input_output_aliases: Dict[int, int] = dataclasses.field(
+        default_factory=dict)
+    kernel: Optional[Callable] = None
+
+
+_CAPTURE = threading.local()
+
+
+class capture_kernel_launches:
+    """Context manager collecting every :class:`KernelLaunchSpec`
+    recorded by :func:`audited_pallas_call` while tracing under it.
+
+    ``with capture_kernel_launches() as specs: jax.eval_shape(fn, ...)``
+    — capture is thread-local and stack-nested (an inner capture also
+    feeds the outer one), and costs nothing when no capture is active
+    (the serving/training hot paths never pay for the audit layer)."""
+
+    def __init__(self):
+        self.specs = []
+
+    def __enter__(self):
+        stack = getattr(_CAPTURE, "stack", None)
+        if stack is None:
+            stack = _CAPTURE.stack = []
+        stack.append(self.specs)
+        return self.specs
+
+    def __exit__(self, *exc):
+        _CAPTURE.stack.pop()
+        return False
+
+
+def _record_launch(spec: KernelLaunchSpec) -> None:
+    for sink in getattr(_CAPTURE, "stack", []) or []:
+        sink.append(spec)
+
+
+def _space_label(block_spec) -> str:
+    ms = getattr(block_spec, "memory_space", None)
+    if ms is None:
+        return "vmem"
+    s = str(ms).lower()
+    for label in ("smem", "vmem", "any"):
+        if label in s:
+            return label
+    return s or "vmem"
+
+
+def _operand(arg, block_spec) -> KernelOperand:
+    shape = tuple(getattr(arg, "shape", ()) or ())
+    dtype = str(getattr(arg, "dtype", "?"))
+    bs = getattr(block_spec, "block_shape", None)
+    return KernelOperand(
+        shape=shape, dtype=dtype,
+        block_shape=tuple(bs) if bs is not None else None,
+        index_map=getattr(block_spec, "index_map", None),
+        space=_space_label(block_spec))
+
+
+def _scratch_record(s):
+    shape = tuple(getattr(s, "shape", ()) or ())
+    try:
+        dtype = str(jnp.dtype(getattr(s, "dtype", None)))
+    except TypeError:
+        dtype = str(getattr(s, "dtype", "?"))
+    ms = str(getattr(s, "memory_space", "")).lower()
+    space = "smem" if "smem" in (ms or type(s).__name__.lower()) \
+        else "vmem"
+    return (shape, dtype, space)
+
+
+def audited_pallas_call(kernel, *, name: str = None, grid,
+                        in_specs, out_specs, out_shape,
+                        scratch_shapes=None, num_scalar_prefetch: int = 0,
+                        input_output_aliases=None, interpret: bool = False,
+                        accum_outputs: Tuple[int, ...] = ()):
+    """The ONE ``pl.pallas_call`` gateway for every kernel in this
+    package (the coverage test asserts no other call site exists).
+
+    Signature-compatible with the plain-grid ``pallas_call`` kwargs;
+    ``num_scalar_prefetch > 0`` builds the
+    ``pltpu.PrefetchScalarGridSpec`` internally so scalar-prefetch
+    launches capture through the same path. ``accum_outputs`` DECLARES
+    the output indices whose index map intentionally revisits a block
+    across grid steps (sequential accumulation / write-once-at-last-
+    step patterns) — the WRITE_RACE rule flags any undeclared revisit.
+
+    When a :class:`capture_kernel_launches` context is active on this
+    thread, invoking the returned callable records a
+    :class:`KernelLaunchSpec` (grid, per-operand BlockSpecs + avals,
+    scratch shapes, the active VMEM budget) before delegating to the
+    real ``pl.pallas_call``; with no capture active the only overhead
+    is one Python frame at trace time.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    in_specs = list(in_specs)
+    out_specs_flat = (list(out_specs)
+                      if isinstance(out_specs, (list, tuple))
+                      else [out_specs])
+    out_shape_flat = (list(out_shape)
+                      if isinstance(out_shape, (list, tuple))
+                      else [out_shape])
+    scratch = list(scratch_shapes) if scratch_shapes else []
+
+    if num_scalar_prefetch:
+        kw = {"input_output_aliases": dict(input_output_aliases)} \
+            if input_output_aliases else {}
+        call = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=num_scalar_prefetch,
+                grid=tuple(grid), in_specs=in_specs,
+                out_specs=out_specs, scratch_shapes=tuple(scratch)),
+            out_shape=out_shape, interpret=interpret, **kw)
+    else:
+        kw: Dict[str, Any] = dict(grid=tuple(grid), in_specs=in_specs,
+                                  out_specs=out_specs,
+                                  out_shape=out_shape,
+                                  interpret=interpret)
+        if scratch:
+            kw["scratch_shapes"] = scratch
+        if input_output_aliases:
+            kw["input_output_aliases"] = dict(input_output_aliases)
+        call = pl.pallas_call(kernel, **kw)
+
+    kname = name
+    if kname is None:
+        base = kernel.func if isinstance(kernel, functools.partial) \
+            else kernel
+        kname = getattr(base, "__name__", "pallas_kernel")
+
+    def wrapped(*args):
+        if getattr(_CAPTURE, "stack", None):
+            pre = args[:num_scalar_prefetch]
+            blocked = args[num_scalar_prefetch:]
+            _record_launch(KernelLaunchSpec(
+                name=kname, grid=tuple(int(g) for g in grid),
+                num_scalar_prefetch=int(num_scalar_prefetch),
+                prefetch=tuple(
+                    (tuple(getattr(a, "shape", ()) or ()),
+                     str(getattr(a, "dtype", "?"))) for a in pre),
+                inputs=tuple(_operand(a, s)
+                             for a, s in zip(blocked, in_specs)),
+                outputs=tuple(_operand(sh, s) for sh, s in
+                              zip(out_shape_flat, out_specs_flat)),
+                scratch=tuple(_scratch_record(s) for s in scratch),
+                accum_outputs=tuple(accum_outputs),
+                vmem_budget=fused_vmem_budget(),
+                interpret=bool(interpret),
+                input_output_aliases=dict(input_output_aliases or {}),
+                kernel=kernel))
+        return call(*args)
+
+    return wrapped
 
 
 def no_x64(fn):

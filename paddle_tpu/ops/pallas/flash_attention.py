@@ -43,7 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._util import interpret_mode as _interpret, no_x64
+from ._util import (audited_pallas_call, interpret_mode as _interpret,
+                    no_x64)
 
 DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
@@ -266,8 +267,12 @@ def _fwd(q, k, v, bias, seg_q, seg_k, scale, causal, meta, seed=None):
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, has_seg=has_seg,
                                has_bias=has_bias, off=off, dropout=dropout)
-    o, lse = pl.pallas_call(
+    o, lse = audited_pallas_call(
         kernel,
+        name="flash_attention_fwd",
+        # o and lse blocks are revisited across the k-block axis
+        # (online softmax in scratch, written at the last k block)
+        accum_outputs=(0, 1),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -503,10 +508,14 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal,
                                       lambda b, i, j: (b, i, j)))
         out_shape.append(jax.ShapeDtypeStruct((bh, sq, sk), jnp.float32))
 
-    res = pl.pallas_call(
+    res = audited_pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, has_seg=has_seg, has_bias=has_bias,
                           has_dbias=has_dbias, off=off, dropout=dropout),
+        name="flash_attention_bwd_dq",
+        # dq accumulates across the k-block axis in scratch (the dbias
+        # output, when present, IS injective: one block per (i, j))
+        accum_outputs=(0,),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -578,11 +587,14 @@ def _bwd_impl(q, k, v, bias, seg_q, seg_k, o, lse, do, scale, causal,
     ]
     args2 += [do, lse3, delta3]
 
-    dk, dv = pl.pallas_call(
+    dk, dv = audited_pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nq=nq, groups=groups,
                           has_seg=has_seg, has_bias=has_bias, off=off,
                           dropout=dropout, h=h, kvh=kvh),
+        name="flash_attention_bwd_dkv",
+        # dk/dv accumulate across the fused (group, q-block) axis
+        accum_outputs=(0, 1),
         grid=(bkvh, nk, groups * nq),
         in_specs=in_specs2,
         out_specs=[

@@ -16,7 +16,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._util import interpret_mode as _interpret, no_x64
+from ._util import (audited_pallas_call, interpret_mode as _interpret,
+                    no_x64)
+from .registry import KERNELS
 
 
 def _adamw_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, bc_ref,
@@ -88,9 +90,10 @@ def fused_adamw(param, grad, moment1, moment2, lr, step,
     if shadow:
         out_specs.append(pl.BlockSpec((block,), lambda i: (i,)))
         out_shape.append(jax.ShapeDtypeStruct((n,), shadow_dtype))
-    out = pl.pallas_call(
+    out = audited_pallas_call(
         functools.partial(_adamw_kernel, b1=beta1, b2=beta2, eps=epsilon,
                           wd=weight_decay, shadow=shadow),
+        name="fused_adamw",
         grid=(pl.cdiv(n, block),),
         in_specs=[
             pl.BlockSpec((block,), lambda i: (i,)),
@@ -108,3 +111,77 @@ def fused_adamw(param, grad, moment1, moment2, lr, step,
     if pad:
         out = [o[:n - pad] for o in out]
     return out
+
+
+@no_x64
+def adamw_update_ref(param, grad, moment1, moment2, lr, step,
+                     beta1=0.9, beta2=0.999, epsilon=1e-8,
+                     weight_decay=0.01, grad_scale=None,
+                     shadow_dtype=None):
+    """The eager jnp composition of :func:`fused_adamw` — the
+    priority-0 ``unfused`` registry fallback. Op order mirrors the
+    kernel exactly (same bias-correction staging, fp32 interior, same
+    literal types under ``no_x64``), so dispatch falling back here —
+    interpret mode, off-TPU — keeps the update math the kernel's."""
+    f32 = jnp.float32
+    t = jnp.asarray(step, f32)
+    scale = jnp.asarray(1.0 if grad_scale is None else grad_scale, f32)
+    bc0 = (1.0 / (1.0 - beta1 ** t)).astype(f32)
+    bc1 = (1.0 / (1.0 - beta2 ** t)).astype(f32)
+    lr32 = jnp.asarray(lr, f32)
+    p = param.astype(f32)
+    g = grad.astype(f32) * scale
+    m = moment1.astype(f32)
+    v = moment2.astype(f32)
+    m_n = beta1 * m + (1 - beta1) * g
+    v_n = beta2 * v + (1 - beta2) * g * g
+    mhat = m_n * bc0
+    vhat = v_n * bc1
+    p_n = p * (1.0 - lr32 * weight_decay) \
+        - lr32 * mhat / (jnp.sqrt(vhat) + epsilon)
+    out = [p_n.astype(param.dtype), m_n.astype(moment1.dtype),
+           v_n.astype(moment2.dtype)]
+    if shadow_dtype is not None:
+        out.append(p_n.astype(shadow_dtype))
+    return out
+
+
+def adamw_meta(n, dtype, moment_dtype, shadow) -> dict:
+    """Static dispatch metadata for one fused-AdamW call site."""
+    dtype = jnp.dtype(dtype)
+    return {"n": int(n), "dtype": str(dtype),
+            "moment_dtype": str(jnp.dtype(moment_dtype)),
+            "shadow": bool(shadow), "interpret": bool(_interpret())}
+
+
+def _supports_adamw(meta):
+    if meta["interpret"]:
+        return False, "interpret mode (off-TPU): composition is faster"
+    return True, "flat multi-tensor: any length blocks"
+
+
+KERNELS.register("fused_adamw", "pallas_fused", fused_adamw,
+                 priority=10, supports=_supports_adamw,
+                 tags=("train", "optimizer", "pallas"))
+KERNELS.register("fused_adamw", "unfused", adamw_update_ref, priority=0,
+                 tags=("train", "optimizer"))
+# all dispatch inputs beyond the traced shapes/dtypes are covered by the
+# trainer's program-cache key (_fused_train_key: force pins + VMEM
+# budget + interpret) — the DISPATCH_KEY_GAP registry lint checks the
+# supports() reads against this declaration
+KERNELS.declare_cache_key(
+    "fused_adamw", ("n", "dtype", "moment_dtype", "shadow", "interpret"))
+
+
+def adamw_update(param, grad, moment1, moment2, lr, step, **kw):
+    """Fused-AdamW update, registry-dispatched: the Pallas multi-tensor
+    kernel where supported (real TPU), the bit-matching eager jnp
+    composition elsewhere (interpret mode); ``KERNELS.force`` pins a
+    variant for tests/audits. Dispatch happens at TRACE time, so jit
+    callers key their program caches on the registry's forced state +
+    interpret (the trainer's ``_fused_train_key``)."""
+    _, fn = KERNELS.dispatch(
+        "fused_adamw",
+        adamw_meta(param.shape[0], param.dtype, moment1.dtype,
+                   kw.get("shadow_dtype") is not None))
+    return fn(param, grad, moment1, moment2, lr, step, **kw)

@@ -39,7 +39,6 @@ from __future__ import annotations
 
 import functools
 import math
-import os
 
 import jax
 import jax.numpy as jnp
@@ -47,7 +46,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ...core.flags import GLOBAL_FLAGS
-from ._util import (PAGE_STEP_CANDIDATES, clamped_page_index,
+from ._util import (PAGE_STEP_CANDIDATES, audited_pallas_call,
+                    clamped_page_index, fused_vmem_budget,
                     interpret_mode as _interpret, no_x64,
                     online_softmax_page_update)
 from .registry import KERNELS
@@ -66,9 +66,9 @@ GLOBAL_FLAGS.define(
     "composition, for A/B diagnosis)")
 
 
-def _vmem_budget() -> int:
-    return int(os.environ.get("PADDLE_TPU_FUSED_VMEM_BUDGET",
-                              10 * 2 ** 20))
+# the ONE budget knob, shared with fused_train/generation/the kernel
+# auditor — re-exported under the historic name for its import sites
+_vmem_budget = fused_vmem_budget
 
 
 # ---------------------------------------------------------------------------
@@ -281,7 +281,10 @@ def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
         inputs += [jnp.asarray(kv_scales[0], jnp.float32).reshape(1, KV),
                    jnp.asarray(kv_scales[1], jnp.float32).reshape(1, KV)]
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
+    xo, kn, vn = audited_pallas_call(
+        functools.partial(_attn_block_kernel, scale=scale, bs=BS, kv=KV,
+                          groups=groups, eps=eps, pp=pp, quant=quant),
+        name="decode_attn_block",
         num_scalar_prefetch=2,
         grid=(B, pl.cdiv(MB, pp)),
         in_specs=in_specs,
@@ -298,11 +301,9 @@ def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
             pltpu.VMEM((H, 1), jnp.float32),      # l
             pltpu.VMEM((H, hd), jnp.float32),     # acc
         ],
-    )
-    xo, kn, vn = pl.pallas_call(
-        functools.partial(_attn_block_kernel, scale=scale, bs=BS, kv=KV,
-                          groups=groups, eps=eps, pp=pp, quant=quant),
-        grid_spec=grid_spec,
+        # all three outputs are per-sequence blocks revisited across the
+        # page steps (prologue/epilogue writes under pl.when)
+        accum_outputs=(0, 1, 2),
         out_shape=[jax.ShapeDtypeStruct((B, D), x.dtype),
                    jax.ShapeDtypeStruct((B, KV, hd), x.dtype),
                    jax.ShapeDtypeStruct((B, KV, hd), x.dtype)],
@@ -372,12 +373,16 @@ def _mlp_vmem_need(B: int, D: int, itemsize: int, bf: int) -> int:
         + 3 * B * bf * 4
 
 
-def _mlp_fitting_candidates(B: int, D: int, F: int, itemsize: int):
+def _mlp_fitting_candidates(B: int, D: int, F: int, itemsize: int,
+                            budget: int = None):
     """The divisor candidates that fit the VMEM budget. Dispatch
     (``_supports_mlp``), the traced default pick, and the autotune
     sweep all consume THIS list — a supported-and-dispatched kernel can
-    therefore never compile over the budget its predicate promised."""
-    budget = _vmem_budget()
+    therefore never compile over the budget its predicate promised.
+    ``budget`` rides as a parameter (supports() passes the meta's
+    ``vmem_budget`` key) so the env read stays a VISIBLE dispatch
+    input, not a hidden one the cache-key lint cannot see."""
+    budget = _vmem_budget() if budget is None else int(budget)
     return [bf for bf in _mlp_candidates(F)
             if _mlp_vmem_need(B, D, itemsize, bf) <= budget]
 
@@ -394,11 +399,14 @@ def fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=1e-6, block_f=None):
     F = wg.shape[1]
     if block_f is None:
         it = jnp.dtype(x.dtype).itemsize
+        # ONE budget read per trace: the fitting list and the autotune
+        # key must see the same value (the budget-in-meta contract)
+        budget = _vmem_budget()
         # budget-fitting tiles only; a forced call with nothing fitting
         # (tests, interpret) gets the smallest divisor tile
-        cands = _mlp_fitting_candidates(B, D, F, it) \
+        cands = _mlp_fitting_candidates(B, D, F, it, budget) \
             or [min(_mlp_candidates(F))]
-        ck = mlp_autotune_key(B, D, F, x.dtype)
+        ck = mlp_autotune_key(B, D, F, x.dtype, budget)
 
         def build(bf):
             return lambda *a: fused_mlp_block_pallas(*a, eps=eps,
@@ -414,8 +422,12 @@ def fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=1e-6, block_f=None):
                          f"dim F={F}")
 
     const = lambda j: (0, 0)                              # noqa: E731
-    out = pl.pallas_call(
+    out = audited_pallas_call(
         functools.partial(_mlp_block_kernel, eps=eps),
+        name="decode_mlp_block",
+        # the output block is revisited every intermediate tile (down-
+        # projection accumulated in scratch, written at the last tile)
+        accum_outputs=(0,),
         grid=(F // bf,),
         in_specs=[pl.BlockSpec((B, D), const),
                   pl.BlockSpec((1, D), const),
@@ -499,6 +511,10 @@ def decode_meta_dims(B, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
         "dtype": str(dtype), "itemsize": int(dtype.itemsize),
         "pool_dtype": str(jnp.dtype(pool_dtype)),
         "quant": bool(quant), "interpret": bool(_interpret()),
+        # the budget is a real dispatch input (it reshapes supports()
+        # and the block_f candidate list), so it rides in the meta —
+        # visible to the DISPATCH_KEY_GAP lint like every other key
+        "vmem_budget": int(_vmem_budget()),
     }
 
 
@@ -531,7 +547,7 @@ def _supports_attn(meta):
     # admit only shapes that fit whatever the sweep later selects
     pages = 4 * max(PAGE_STEP_CANDIDATES)
     need = weights + pages * page + scratch + 4 * D * it
-    budget = _vmem_budget()
+    budget = meta["vmem_budget"]
     if need > budget:
         return False, (f"block weights + pages need ~{need >> 20}MiB "
                        f"VMEM > budget {budget >> 20}MiB")
@@ -542,11 +558,12 @@ def _supports_mlp(meta):
     if meta["interpret"]:
         return False, "interpret mode (off-TPU): composition is faster"
     D, F, B = meta["D"], meta["F"], meta["B"]
-    fits = _mlp_fitting_candidates(B, D, F, meta["itemsize"])
+    fits = _mlp_fitting_candidates(B, D, F, meta["itemsize"],
+                                   meta["vmem_budget"])
     if fits:
         return True, f"fits VMEM at block_f={fits[0]}"
     return False, (f"no intermediate tile of F={F} fits the "
-                   f"{_vmem_budget() >> 20}MiB VMEM budget")
+                   f"{meta['vmem_budget'] >> 20}MiB VMEM budget")
 
 
 def _attn_pallas_variant(x, nw, wq, wk, wv, wo, sin, cos, k_pool,
@@ -572,6 +589,19 @@ KERNELS.register("decode_mlp_block", "pallas_fused", _mlp_pallas_variant,
                  tags=("serving", "pallas"))
 KERNELS.register("decode_mlp_block", "unfused", mlp_block_ref,
                  priority=0, tags=("serving",))
+# every decode_meta_dims key is either in the jitted decode program's
+# trace signature (the shape/dtype keys) or in generation.py's
+# _PAGED_CACHE route tuple / the engine's program key (pins, the VMEM
+# budget, the interpret override) — the registry lint holds supports()
+# to this declaration
+_DECODE_KEY_FIELDS = ("B", "D", "H", "KV", "hd", "F", "BS", "MB",
+                      "dtype", "pool_dtype", "quant", "interpret",
+                      "vmem_budget")
+_DECODE_KEY_COVERS = {"itemsize": "dtype"}
+KERNELS.declare_cache_key("decode_attn_block", _DECODE_KEY_FIELDS,
+                          covers=_DECODE_KEY_COVERS)
+KERNELS.declare_cache_key("decode_mlp_block", _DECODE_KEY_FIELDS,
+                          covers=_DECODE_KEY_COVERS)
 
 
 def resolve_decode_blocks(meta: dict, mode="auto"):

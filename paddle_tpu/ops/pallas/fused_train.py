@@ -45,7 +45,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._util import (dispatch_fused_variant, interpret_mode as _interpret,
+from ._util import (audited_pallas_call, dispatch_fused_variant,
+                    fused_vmem_budget, interpret_mode as _interpret,
                     no_x64)
 from .registry import KERNELS
 
@@ -56,12 +57,9 @@ __all__ = [
 ]
 
 
-def _vmem_budget() -> int:
-    """The SAME scoped-VMEM budget knob the decode megakernels honor
-    (``PADDLE_TPU_FUSED_VMEM_BUDGET``) — one envelope for all fused
-    kernels."""
-    from .fused_decode_block import _vmem_budget as _b
-    return _b()
+# the SAME scoped-VMEM budget knob the decode megakernels honor
+# (``PADDLE_TPU_FUSED_VMEM_BUDGET``) — one envelope for all fused kernels
+_vmem_budget = fused_vmem_budget
 
 
 def _round_up(n: int, m: int) -> int:
@@ -197,8 +195,8 @@ def _ce_vmem_need(bt, bv, D, itemsize):
     return io + logits + acc
 
 
-def _ce_fitting_candidates(T, D, itemsize):
-    budget = _vmem_budget()
+def _ce_fitting_candidates(T, D, itemsize, budget=None):
+    budget = _vmem_budget() if budget is None else int(budget)
     return [(bt, bv) for bt, bv in _CE_BLOCK_CANDIDATES
             if _ce_vmem_need(bt, bv, D, itemsize) <= budget]
 
@@ -210,7 +208,11 @@ def _ce_blocks(x2, head, lab):
     T, D = x2.shape
     V = head.shape[1]
     it = jnp.dtype(x2.dtype).itemsize
-    cands = _ce_fitting_candidates(T, D, it) or [_CE_BLOCK_CANDIDATES[-1]]
+    # ONE budget read per trace: fitting list + autotune key must see
+    # the same value (the budget-in-meta contract)
+    budget = _vmem_budget()
+    cands = _ce_fitting_candidates(T, D, it, budget) \
+        or [_CE_BLOCK_CANDIDATES[-1]]
     # clamping tiny problems dedups candidates that collapse together
     cands = list(dict.fromkeys(
         (min(bt, _round_up(T, 8)), min(bv, _round_up(V, 128)))
@@ -218,7 +220,7 @@ def _ce_blocks(x2, head, lab):
     if len(cands) == 1:
         return cands[0]
     from .autotune import resolve_candidate
-    ck = linear_ce_autotune_key(T, D, V, x2.dtype)
+    ck = linear_ce_autotune_key(T, D, V, x2.dtype, budget)
 
     def build(cfg):
         bt_, bv_ = cfg
@@ -241,8 +243,12 @@ def _ce_fwd_call(x2, head, lab2, v_real, bt, bv):
     T, D = x2.shape
     V = head.shape[1]
     nt, nv = T // bt, V // bv
-    lse, pick = pl.pallas_call(
+    lse, pick = audited_pallas_call(
         functools.partial(_ce_fwd_kernel, v_real=v_real, bt=bt, bv=bv),
+        name="linear_ce_fwd",
+        # both per-token outputs are revisited every vocab chunk
+        # (online-lse state in scratch, written at the last chunk)
+        accum_outputs=(0, 1),
         grid=(nv, nt),
         in_specs=[pl.BlockSpec((bt, D), lambda j, i: (i, 0)),
                   pl.BlockSpec((D, bv), lambda j, i: (0, j)),
@@ -264,8 +270,11 @@ def _ce_bwd_call(x2, head, lab2, lse, coef, v_real, bt, bv):
     V = head.shape[1]
     nt, nv = T // bt, V // bv
     args = (x2, head, lab2, lse, coef)
-    dx = pl.pallas_call(
+    dx = audited_pallas_call(
         functools.partial(_ce_dx_kernel, v_real=v_real, bv=bv),
+        name="linear_ce_bwd_dx",
+        # grad_hidden accumulates across vocab chunks in scratch
+        accum_outputs=(0,),
         grid=(nt, nv),
         in_specs=[pl.BlockSpec((bt, D), lambda i, j: (i, 0)),
                   pl.BlockSpec((D, bv), lambda i, j: (0, j)),
@@ -277,8 +286,11 @@ def _ce_bwd_call(x2, head, lab2, lse, coef, v_real, bt, bv):
         scratch_shapes=[pltpu.VMEM((bt, D), jnp.float32)],
         interpret=_interpret(),
     )(*args)
-    dh = pl.pallas_call(
+    dh = audited_pallas_call(
         functools.partial(_ce_dh_kernel, v_real=v_real, bv=bv),
+        name="linear_ce_bwd_dh",
+        # grad_head accumulates across token chunks in scratch
+        accum_outputs=(0,),
         grid=(nv, nt),
         in_specs=[pl.BlockSpec((bt, D), lambda j, i: (i, 0)),
                   pl.BlockSpec((D, bv), lambda j, i: (0, j)),
@@ -377,16 +389,21 @@ def ce_meta(T, D, V, dtype) -> dict:
     dtype = jnp.dtype(dtype)
     return {"T": int(T), "D": int(D), "V": int(V), "dtype": str(dtype),
             "itemsize": int(dtype.itemsize),
-            "interpret": bool(_interpret())}
+            "interpret": bool(_interpret()),
+            # a real dispatch input (reshapes the fitting-candidate
+            # list), so it rides in the meta where the cache-key lint
+            # can see it — not as a hidden env read
+            "vmem_budget": int(_vmem_budget())}
 
 
 def _supports_ce(meta):
     if meta["interpret"]:
         return False, "interpret mode (off-TPU): composition is faster"
-    fits = _ce_fitting_candidates(meta["T"], meta["D"], meta["itemsize"])
+    fits = _ce_fitting_candidates(meta["T"], meta["D"], meta["itemsize"],
+                                  meta["vmem_budget"])
     if not fits:
         return False, (f"no (block_t, block_v) tile fits the "
-                       f"{_vmem_budget() >> 20}MiB VMEM budget at "
+                       f"{meta['vmem_budget'] >> 20}MiB VMEM budget at "
                        f"D={meta['D']}")
     return True, f"fits VMEM at blocks {fits[0]}"
 
@@ -398,6 +415,12 @@ KERNELS.register("fused_linear_ce", "pallas_fused",
                  tags=("train", "pallas"))
 KERNELS.register("fused_linear_ce", "unfused", linear_ce_ref,
                  priority=0, tags=("train",))
+# the shape/dtype keys live in the train-step trace signature; mode,
+# force pins, the VMEM budget and interpret are in _fused_train_key
+KERNELS.declare_cache_key(
+    "fused_linear_ce",
+    ("T", "D", "V", "dtype", "interpret", "vmem_budget"),
+    covers={"itemsize": "dtype"})
 
 
 def fused_linear_ce(hidden, head, labels, mode=None):
@@ -490,8 +513,9 @@ def _swiglu_pad(a, br):
 @no_x64
 def _swiglu_fwd_call(g2, u2, br, bf):
     R, F = g2.shape
-    return pl.pallas_call(
+    return audited_pallas_call(
         _swiglu_fwd_kernel,
+        name="swiglu_fwd",
         grid=(R // br, F // bf),
         in_specs=[pl.BlockSpec((br, bf), lambda i, j: (i, j))] * 2,
         out_specs=pl.BlockSpec((br, bf), lambda i, j: (i, j)),
@@ -503,8 +527,9 @@ def _swiglu_fwd_call(g2, u2, br, bf):
 @no_x64
 def _swiglu_bwd_call(g2, u2, d2, br, bf):
     R, F = g2.shape
-    return pl.pallas_call(
+    return audited_pallas_call(
         _swiglu_bwd_kernel,
+        name="swiglu_bwd",
         grid=(R // br, F // bf),
         in_specs=[pl.BlockSpec((br, bf), lambda i, j: (i, j))] * 3,
         out_specs=[pl.BlockSpec((br, bf), lambda i, j: (i, j))] * 2,
@@ -577,6 +602,9 @@ KERNELS.register("fused_swiglu", "pallas_fused",
                  tags=("train", "pallas"))
 KERNELS.register("fused_swiglu", "unfused", swiglu_ref,
                  priority=0, tags=("train",))
+KERNELS.declare_cache_key(
+    "fused_swiglu", ("R", "F", "dtype", "interpret"),
+    covers={"itemsize": "dtype"})
 
 
 def fused_swiglu(gate, up, mode=None):

@@ -16,8 +16,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._util import (dispatch_fused_variant, interpret_mode as _interpret,
-                    no_x64)
+from ._util import (audited_pallas_call, dispatch_fused_variant,
+                    interpret_mode as _interpret, no_x64)
 from .registry import KERNELS
 
 
@@ -70,8 +70,9 @@ def _rms_fwd(x, weight, epsilon):
     x2 = _rms_rows(x)
     block = _row_block(x2.shape[0], d, x.dtype.itemsize)
     x2, n = _pad_rows(x2, block)
-    out = pl.pallas_call(
+    out = audited_pallas_call(
         functools.partial(_rms_fwd_kernel, eps=epsilon),
+        name="rms_norm_fwd",
         grid=(pl.cdiv(x2.shape[0], block),),
         # weight rides as a (1, d) block: Mosaic requires >=2-D blocks with
         # lane-aligned trailing dims; 1-D specs fail to legalize
@@ -144,8 +145,12 @@ def rms_norm_bwd_pallas(x, weight, g, epsilon=1e-6):
     block = _row_block(x2.shape[0], d, max(x.dtype.itemsize, 4))
     x2, n = _pad_rows(x2, block)
     g2, _ = _pad_rows(g2, block)
-    dx, dw = pl.pallas_call(
+    dx, dw = audited_pallas_call(
         functools.partial(_rms_bwd_kernel, eps=epsilon),
+        name="rms_norm_bwd",
+        # dw revisits block (0, 0) every grid step (cross-row reduction
+        # folded in scratch, written once at the last step)
+        accum_outputs=(1,),
         grid=(pl.cdiv(x2.shape[0], block),),
         in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
                   pl.BlockSpec((1, d), lambda i: (0, 0)),
@@ -184,6 +189,9 @@ KERNELS.register("rms_norm_bwd", "pallas_fused", _rms_bwd_pallas_variant,
                  tags=("train", "pallas"))
 KERNELS.register("rms_norm_bwd", "unfused", _rms_bwd_ref, priority=0,
                  tags=("train",))
+KERNELS.declare_cache_key(
+    "rms_norm_bwd", ("rows", "d", "dtype", "interpret"),
+    covers={"itemsize": "dtype"})
 
 
 def _rms_bwd(epsilon, mode, res, g):
@@ -237,8 +245,9 @@ def _res_rms_fwd_call(delta, x, weight, epsilon):
     block = _row_block(x2.shape[0], d, x.dtype.itemsize * 4)
     d2, n = _pad_rows(d2, block)
     x2, _ = _pad_rows(x2, block)
-    y, h = pl.pallas_call(
+    y, h = audited_pallas_call(
         functools.partial(_res_rms_fwd_kernel, eps=epsilon),
+        name="residual_rms_norm_fwd",
         grid=(pl.cdiv(x2.shape[0], block),),
         in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
                   pl.BlockSpec((block, d), lambda i: (i, 0)),
@@ -303,6 +312,9 @@ KERNELS.register("rms_norm_residual", "pallas_fused",
                  supports=_supports_res_rms, tags=("train", "pallas"))
 KERNELS.register("rms_norm_residual", "unfused", residual_rms_norm_ref,
                  priority=0, tags=("train",))
+KERNELS.declare_cache_key(
+    "rms_norm_residual", ("rows", "d", "dtype", "interpret"),
+    covers={"itemsize": "dtype"})
 
 
 def residual_rms_norm(delta, x, weight, epsilon=1e-6, mode=None):
@@ -332,8 +344,9 @@ def layer_norm_pallas(x, weight, bias, epsilon=1e-5):
     x2 = _rms_rows(x)
     block = _row_block(x2.shape[0], d, x.dtype.itemsize)
     x2, n = _pad_rows(x2, block)
-    out = pl.pallas_call(
+    out = audited_pallas_call(
         functools.partial(_ln_fwd_kernel, eps=epsilon),
+        name="layer_norm_fwd",
         grid=(pl.cdiv(x2.shape[0], block),),
         in_specs=[pl.BlockSpec((block, d), lambda i: (i, 0)),
                   pl.BlockSpec((1, d), lambda i: (0, 0)),

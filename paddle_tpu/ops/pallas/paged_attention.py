@@ -31,9 +31,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ._util import (PAGE_STEP_CANDIDATES, clamped_page_index,
-                    interpret_mode as _interpret, no_x64,
-                    online_softmax_page_update)
+from ._util import (PAGE_STEP_CANDIDATES, audited_pallas_call,
+                    clamped_page_index, interpret_mode as _interpret,
+                    no_x64, online_softmax_page_update)
 
 
 def _decode_kernel(bt_ref, len_ref, q_ref, *rest, scale, bs, kv, groups,
@@ -132,7 +132,10 @@ def paged_attention_decode_pallas(q, k_pool, v_pool, block_tables,
     def kv_index(j):
         return clamped_page_index(BS, pp, j)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
+    out = audited_pallas_call(
+        functools.partial(_decode_kernel, scale=scale, bs=BS, kv=KV,
+                          groups=groups, pp=pp),
+        name="paged_attention_decode",
         num_scalar_prefetch=2,
         grid=(B, pl.cdiv(MB, pp)),
         in_specs=[
@@ -148,11 +151,9 @@ def paged_attention_decode_pallas(q, k_pool, v_pool, block_tables,
             pltpu.VMEM((H, 1), jnp.float32),
             pltpu.VMEM((H, hd), jnp.float32),
         ],
-    )
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, bs=BS, kv=KV,
-                          groups=groups, pp=pp),
-        grid_spec=grid_spec,
+        # the sequence's output block is revisited every page step
+        # (online softmax in scratch, written once at the last page)
+        accum_outputs=(0,),
         out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
         interpret=_interpret(),
     )(jnp.asarray(block_tables, jnp.int32),

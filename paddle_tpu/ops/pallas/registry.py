@@ -61,6 +61,8 @@ class KernelRegistry:
     def __init__(self):
         self._ops: Dict[str, List[KernelVariant]] = {}
         self._forced = threading.local()
+        self._cache_keys: Dict[str, Tuple[Tuple[str, ...],
+                                          Dict[str, str]]] = {}
 
     # -- registration --------------------------------------------------
     def register(self, op: str, name: str, fn: Callable, *,
@@ -73,6 +75,25 @@ class KernelRegistry:
         lst.sort(key=lambda v: -v.priority)
         self._ops[op] = lst
         return var
+
+    def declare_cache_key(self, op: str, fields, covers=None) -> None:
+        """Declare the meta keys ``op``'s CALLERS fold into their
+        program-cache / autotune keys — explicitly (route keys like
+        generation.py's ``_PAGED_CACHE`` tuple, the trainer's
+        ``_fused_train_key``) or implicitly via the jit trace signature
+        (every shape/dtype-derived key). The ``DISPATCH_KEY_GAP``
+        registry lint (:mod:`paddle_tpu.analysis.kernel_rules`)
+        instruments ``supports()`` and flags any meta key it reads that
+        this declaration does not cover — the thrice-fixed
+        stale-dispatch-route class, turned from a review item into a
+        gate. ``covers`` maps a derived key to the declared key that
+        subsumes it (e.g. ``{"itemsize": "dtype"}``)."""
+        self._cache_keys[op] = (tuple(fields), dict(covers or {}))
+
+    def cache_key_decl(self, op: str):
+        """(declared_fields, covers) for ``op``, or None if the op has
+        never declared its dispatch-key coverage."""
+        return self._cache_keys.get(op)
 
     def variant(self, op: str, name: str) -> KernelVariant:
         for v in self._ops.get(op, []):

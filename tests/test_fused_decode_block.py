@@ -253,13 +253,21 @@ def test_dispatch_interpret_falls_back_unfused():
 
 def test_vmem_budget_gates_fused_variant(monkeypatch):
     """Oversized block weights must fail the ``supports`` predicate with
-    a reason naming the VMEM budget, even off interpret mode."""
+    a reason naming the VMEM budget, even off interpret mode. The
+    budget rides IN the meta (decode_meta reads the env at build time —
+    i.e. at trace time, when the _PAGED_CACHE route key is computed),
+    so the shrunken-budget meta is rebuilt the way a retrace would."""
     meta = fdb.decode_meta(CFG, B=2, BS=4, MB=4,
                            pool_dtype=jnp.float32, quant=False)
     meta["interpret"] = False
+    assert meta["vmem_budget"] == fdb._vmem_budget()
     ok, why = fdb._supports_attn(dict(meta))
     assert ok, why                               # tiny cfg fits
     monkeypatch.setenv("PADDLE_TPU_FUSED_VMEM_BUDGET", "1024")
+    meta = fdb.decode_meta(CFG, B=2, BS=4, MB=4,
+                           pool_dtype=jnp.float32, quant=False)
+    meta["interpret"] = False
+    assert meta["vmem_budget"] == 1024
     ok, why = fdb._supports_attn(dict(meta))
     assert not ok and "VMEM" in why
     ok, why = fdb._supports_mlp(dict(meta))
@@ -489,6 +497,25 @@ def test_gate_skips_without_reference(gate, tmp_path):
                           "cases": {"k1": {"us_pallas": 900.0}}}}
     assert gate.gate_capture(interp, repo=str(tmp_path))["status"] == \
         "no_reference"                           # interpret: no timing
+
+
+def test_gate_names_skipped_keys_instead_of_bare_pass(gate, tmp_path):
+    """Trajectory files exist but share no kernel key with the capture:
+    the gate must say exactly which keys it skipped (and exit 0 as a
+    SKIP, not report a vacuous pass), and a partial overlap must list
+    the banked keys the capture stopped timing."""
+    _bank(tmp_path, "BENCH_r01.json", {"old_kernel": {"us_pallas": 50.0},
+                                       "k1": {"us_pallas": 100.0}})
+    cap = {"kernels": {"cases": {"renamed": {"us_pallas": 10.0}}}}
+    res = gate.gate_capture(cap, repo=str(tmp_path))
+    assert res["status"] == "no_reference"
+    assert "k1" in res["note"] and "renamed" in res["note"]
+    assert res["skipped_banked"] == ["k1", "old_kernel"]
+    # partial overlap: gate runs, but the dropped key is named
+    cap = {"kernels": {"cases": {"k1": {"us_pallas": 90.0}}}}
+    res = gate.gate_capture(cap, repo=str(tmp_path))
+    assert res["status"] == "pass" and res["checked"] == 1
+    assert res["skipped_banked"] == ["old_kernel"]
 
 
 def test_gate_cli_exit_codes(gate, tmp_path):

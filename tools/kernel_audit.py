@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Kernel-geometry audit gate over every registered Pallas kernel.
+
+Captures each kernel's launch geometry (grid, BlockSpecs, scratch, the
+active VMEM budget) through ``ops/pallas/_util.audited_pallas_call`` at
+the tiny + flagship serving/training shape classes, evaluates the index
+maps concretely over the full grid, and proves: grid coverage
+(GRID_FLOOR_DROP), block bounds (OOB_BLOCK), output-write injectivity
+(WRITE_RACE), the pipelined VMEM window budget (VMEM_OVERCOMMIT),
+kernel/launch arity (SCRATCH_MISMATCH), and the registry's
+dispatch-key coverage (DISPATCH_KEY_GAP). Findings diff against the
+committed baseline exactly like ``tools/program_audit.py``: NEW
+findings fail the gate with exit 2, accepted ones pass, fixed ones
+shrink the baseline on its next refresh.
+
+Usage:
+  python tools/kernel_audit.py                      # gate vs KERNEL_AUDIT_BASELINE.json
+  python tools/kernel_audit.py --json out.json      # bank the findings doc
+  python tools/kernel_audit.py --write-baseline     # freeze current findings
+  python tools/kernel_audit.py --case fused_linear_ce --case decode_mlp_block@tiny
+  python tools/kernel_audit.py --list               # case names
+  python tools/kernel_audit.py --demo-regression    # inject the verbatim pre-fix
+                                                    # non-divisor block_f kernel
+                                                    # (gate must FAIL)
+
+Exit codes: 0 clean (no new findings), 2 new findings, 3 bad
+invocation or broken baseline. A kernel case that fails to trace, or a
+declared launch the trace no longer captures, is itself a finding, so
+2 covers those too.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_BASELINE = os.path.join(_REPO, "KERNEL_AUDIT_BASELINE.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON (default: repo "
+                         "KERNEL_AUDIT_BASELINE.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the diff: report findings, exit 2 on any")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="freeze current findings as the baseline and "
+                         "exit 0")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full findings document to PATH")
+    ap.add_argument("--case", action="append", default=None,
+                    help="audit only these cases — an op name "
+                         "(all its shape classes) or op@case "
+                         "(repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="print case names and exit")
+    ap.add_argument("--demo-regression", action="store_true",
+                    help="also audit the pre-fix non-divisor block_f "
+                         "kernel — the gate must fail (CI self-check)")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis.kernel_catalog import (
+        KERNEL_CASE_NAMES, audit_kernels, build_demo_kernel_regression)
+    if args.list:
+        print("\n".join(KERNEL_CASE_NAMES + ("kernel_registry",)))
+        return 0
+
+    from paddle_tpu.analysis import (diff_findings, findings_to_json,
+                                     load_baseline, write_baseline)
+
+    if args.write_baseline and args.demo_regression:
+        print("[kernel-audit] refusing --write-baseline with "
+              "--demo-regression: the demo specimen must never become "
+              "an accepted finding", file=sys.stderr)
+        return 3
+    if args.write_baseline and args.case \
+            and os.path.realpath(args.baseline) \
+            == os.path.realpath(DEFAULT_BASELINE):
+        print("[kernel-audit] refusing --write-baseline for a --case "
+              "subset over the shared baseline — audit the full "
+              "catalog, or point --baseline at a scratch file",
+              file=sys.stderr)
+        return 3
+
+    try:
+        reports = audit_kernels(names=args.case)
+    except ValueError as e:
+        print(f"[kernel-audit] {e}", file=sys.stderr)
+        return 3
+    if args.demo_regression:
+        reports.append(build_demo_kernel_regression())
+    doc = findings_to_json(reports)
+
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    say = (lambda *a: None) if args.quiet else print
+    for r in reports:
+        extra = ""
+        if r.meta.get("launches") is not None:
+            extra = (f" ({r.meta['launches']} launch(es): "
+                     f"{', '.join(r.meta.get('kernels', []))})")
+        say(f"[kernel-audit] {r.program}: {len(r.findings)} "
+            f"finding(s){extra}")
+        for f in r.findings:
+            say(f"  {f.severity:7s} {f.rule}/{f.code} @ {f.site}")
+            say(f"          {f.message}")
+
+    if args.write_baseline:
+        write_baseline(reports, args.baseline)
+        say(f"[kernel-audit] baseline written: {args.baseline} "
+            f"({doc['summary']['findings']} accepted finding(s))")
+        return 0
+
+    if args.no_baseline:
+        n = doc["summary"]["findings"]
+        say(f"[kernel-audit] {n} finding(s), no baseline diff")
+        return 2 if n else 0
+
+    try:
+        baseline = load_baseline(args.baseline)
+    except FileNotFoundError:
+        say(f"[kernel-audit] no baseline at {args.baseline} — treating "
+            "every finding as new (write one with --write-baseline)")
+        baseline = {"findings": {}}
+    except ValueError as e:
+        print(f"[kernel-audit] BROKEN BASELINE: {e}", file=sys.stderr)
+        return 3
+
+    new, fixed = diff_findings(reports, baseline)
+    for fp in fixed:
+        say(f"[kernel-audit] fixed vs baseline: {fp}")
+    if fixed and not new:
+        say("[kernel-audit] refresh the baseline with --write-baseline "
+            "to shrink it")
+    if new:
+        print(f"[kernel-audit] GATE FAILED: {len(new)} new finding(s) "
+              f"vs {args.baseline}:", file=sys.stderr)
+        for f in new:
+            print(f"  {f.severity:7s} {f.fingerprint}\n"
+                  f"          {f.message}", file=sys.stderr)
+        return 2
+    say(f"[kernel-audit] gate clean: {doc['summary']['findings']} "
+        f"finding(s), all accepted by baseline ({len(fixed)} fixed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -100,6 +100,9 @@ def gate_capture(capture, threshold: float = DEFAULT_THRESHOLD,
     banked, src = collect_banked(repo)
     res = {"threshold": threshold, "checked": 0, "regressions": {},
            "improved": {}, "new": sorted(set(fresh) - set(banked)),
+           # banked keys this capture did NOT time: a shrunken capture
+           # must be visible, not silently ungated (no-silent-caps)
+           "skipped_banked": sorted(set(banked) - set(fresh)),
            "status": "pass"}
     if not fresh:
         res["status"] = "no_reference"
@@ -109,6 +112,15 @@ def gate_capture(capture, threshold: float = DEFAULT_THRESHOLD,
     if not banked:
         res["status"] = "no_reference"
         res["note"] = "no banked BENCH trajectory to diff against"
+        return res
+    if not set(fresh) & set(banked):
+        # trajectory files EXIST and the capture timed kernels, yet not
+        # one key lines up — a renamed case set would otherwise ride a
+        # bare "pass" forever while gating nothing
+        res["status"] = "no_reference"
+        res["note"] = (f"no comparable kernel keys: capture has "
+                       f"{sorted(fresh)}, banked trajectory has "
+                       f"{sorted(banked)}")
         return res
     for name in sorted(set(fresh) & set(banked)):
         res["checked"] += 1
@@ -176,6 +188,9 @@ def main(argv=None) -> int:
 
     if res["status"] == "no_reference":
         say(f"[kernel-gate] SKIP: {res.get('note', '')}")
+        for name in res.get("skipped_banked", []):
+            say(f"[kernel-gate] skipped banked key (no fresh timing): "
+                f"{name}")
         return 0
     for name, e in res["regressions"].items():
         print(f"[kernel-gate] REGRESSION {name}: {e['us_pallas']:.1f}us "
@@ -188,6 +203,11 @@ def main(argv=None) -> int:
     if res["new"]:
         say(f"[kernel-gate] new kernels (no banked reference yet): "
             f"{', '.join(res['new'])}")
+    if res["skipped_banked"]:
+        # exactly which banked keys this run did NOT gate — a capture
+        # that quietly stopped timing a kernel must say so
+        say(f"[kernel-gate] banked keys skipped (not timed by this "
+            f"capture): {', '.join(res['skipped_banked'])}")
     if res["status"] == "regressed":
         print(f"[kernel-gate] GATE FAILED: {len(res['regressions'])} "
               f"kernel(s) regressed past +{res['threshold']:.0%}",

@@ -18,6 +18,10 @@ Usage:
   python tools/program_audit.py --list                # catalog program names
   python tools/program_audit.py --demo-regression     # inject the pre-fix AdamW
                                                       # program (gate must FAIL)
+  python tools/program_audit.py --all                 # ALSO run the kernel-geometry
+                                                      # audit (tools/kernel_audit.py)
+                                                      # vs its own baseline; worst
+                                                      # exit code wins
 
 Exit codes: 0 clean (no new findings), 2 new findings, 3 bad
 invocation or broken baseline file (unknown --program name, an
@@ -55,6 +59,11 @@ def main(argv=None) -> int:
     ap.add_argument("--demo-regression", action="store_true",
                     help="also audit the pre-fix AdamW specimen — the "
                          "gate must fail (CI self-check)")
+    ap.add_argument("--all", action="store_true", dest="all_audits",
+                    help="also run the kernel-geometry audit "
+                         "(tools/kernel_audit.py) vs "
+                         "KERNEL_AUDIT_BASELINE.json; exits with the "
+                         "worst of the two gates")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -88,6 +97,26 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 3
 
+    def finish(rc: int) -> int:
+        """--all: chain the kernel-geometry gate; worst exit wins."""
+        if not args.all_audits:
+            return rc
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "kernel_audit",
+            os.path.join(_REPO, "tools", "kernel_audit.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        # NOT --write-baseline: --all promises to RUN the kernel gate,
+        # never to silently freeze its current findings into
+        # KERNEL_AUDIT_BASELINE.json while refreshing the program one
+        kargs = []
+        for flag in ("no_baseline", "demo_regression", "quiet"):
+            if getattr(args, flag):
+                kargs.append("--" + flag.replace("_", "-"))
+        krc = mod.main(kargs)
+        return max(rc, krc)
+
     try:
         specs = build_catalog(names=args.program)
     except ValueError as e:
@@ -114,12 +143,12 @@ def main(argv=None) -> int:
         write_baseline(reports, args.baseline)
         say(f"[audit] baseline written: {args.baseline} "
             f"({doc['summary']['findings']} accepted finding(s))")
-        return 0
+        return finish(0)
 
     if args.no_baseline:
         n = doc["summary"]["findings"]
         say(f"[audit] {n} finding(s), no baseline diff")
-        return 2 if n else 0
+        return finish(2 if n else 0)
 
     try:
         baseline = load_baseline(args.baseline)
@@ -143,10 +172,10 @@ def main(argv=None) -> int:
         for f in new:
             print(f"  {f.severity:7s} {f.fingerprint}\n"
                   f"          {f.message}", file=sys.stderr)
-        return 2
+        return finish(2)
     say(f"[audit] gate clean: {doc['summary']['findings']} finding(s), "
         f"all accepted by baseline ({len(fixed)} fixed)")
-    return 0
+    return finish(0)
 
 
 if __name__ == "__main__":
