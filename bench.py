@@ -910,6 +910,110 @@ def bench_serving_prefix_cache():
             "tail": tail, "gen": gen_n, "arrival_rate_hz": rate}
 
 
+def bench_serving_tp():
+    """Tensor-parallel serving A/B on FORCED-HOST virtual CPU devices:
+    the SAME Poisson arrival trace through a tp=1 engine and a tp=N
+    mesh-sharded engine (inference/tp.py). The virtual CPU mesh proves
+    STRUCTURE, not chip perf — the capture's value is greedy parity,
+    program counts (1 decode program, <=1 trace/bucket under sharding),
+    the declared collective schedule (flight-recorder calls/bytes) and
+    the full TTFT/TPOT distributions for both sides, banked next to
+    serving_engine's decode_ab the same way."""
+    from paddle_tpu.distributed.dryrun import resolve_devices
+
+    tp = int(os.environ.get("BENCH_TP_DEGREE", "4"))
+    coll = os.environ.get("BENCH_TP_COLLECTIVE", "psum")
+    devices, _ = resolve_devices(max(tp, 2), force_cpu=True)
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.inference import (GenerationConfig, ServingEngine,
+                                      ServingMesh)
+    from paddle_tpu.models.llama import LlamaConfig, init_params
+
+    cap = int(os.environ.get("BENCH_TP_CAPACITY", "4"))
+    R = int(os.environ.get("BENCH_TP_REQUESTS", str(3 * cap)))
+    ctx = int(os.environ.get("BENCH_TP_CTX", "32"))
+    gen_n = int(os.environ.get("BENCH_TP_GEN", "16"))
+    rate = float(os.environ.get("BENCH_TP_RATE_HZ", "8.0"))
+    hidden = int(os.environ.get("BENCH_TP_HIDDEN", "128"))
+    layers = int(os.environ.get("BENCH_TP_LAYERS", "4"))
+    cfg = LlamaConfig(vocab_size=8192, hidden_size=hidden,
+                      intermediate_size=hidden * 4,
+                      num_hidden_layers=layers,
+                      num_attention_heads=hidden // 32,
+                      num_key_value_heads=hidden // 32,
+                      max_position_embeddings=ctx + gen_n,
+                      dtype=jnp.float32, remat=False)
+    with jax.default_device(devices[0]):
+        params = init_params(cfg, jax.random.PRNGKey(0),
+                             dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, 8192, (R, ctx)).astype(np.int32)
+    gaps = rng.exponential(1.0 / rate, R)
+    gaps[0] = 0.0
+    arrivals = np.cumsum(gaps)
+    g = GenerationConfig(max_new_tokens=gen_n, greedy=True)
+
+    def run(mesh):
+        eng = ServingEngine(params, cfg, capacity=cap, block_size=16,
+                            max_seq_len=ctx + gen_n,
+                            prefill_buckets=(ctx,), mesh=mesh,
+                            observability=True)
+        eng.submit(prompts[0], GenerationConfig(max_new_tokens=2,
+                                                greedy=True))
+        eng.drain()                      # compile outside the window
+        eng.reset_metrics()
+        outs, t0, i = [], time.perf_counter(), 0
+        reqs = []
+        while i < R or not eng.idle:
+            now = time.perf_counter() - t0
+            while i < R and arrivals[i] <= now:
+                reqs.append(eng.submit(prompts[i], g))
+                i += 1
+            if not eng.step() and i < R:
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+        wall = time.perf_counter() - t0
+        m = eng.metrics()
+        outs = [r.output_ids for r in reqs]
+        side = {"tokens_per_sec": round(R * gen_n / wall, 1),
+                "ttft_ms": m["latency"]["ttft_ms"],
+                "tpot_ms": m["latency"]["tpot_ms"],
+                "decode_step_ms": m["latency"]["decode_step_ms"],
+                "decode_traces": m["decode_traces"],
+                "prefill_traces": m["prefill_traces"],
+                "retrace_warnings": m["retrace_warnings"]}
+        if "collectives" in m:
+            side["collectives"] = {"calls": m["collectives"]["calls"],
+                                   "bytes": m["collectives"]["bytes"]}
+        if "mesh" in m:
+            side["mesh"] = m["mesh"]
+        return side, outs
+
+    base, out1 = run(None)
+    mesh = ServingMesh.make(tp=tp, collective=coll,
+                            devices=devices[:tp])
+    shard, outN = run(mesh)
+    matches = [bool(np.array_equal(a, b)) for a, b in zip(out1, outN)]
+    tok_eq = sum(int(np.count_nonzero(a == b)) for a, b in
+                 zip(out1, outN) if a.shape == b.shape)
+    tok_all = sum(a.size for a in out1)
+    f50 = shard["decode_step_ms"].get("p50")
+    u50 = base["decode_step_ms"].get("p50")
+    return {"metric": "serving_tp_greedy_parity",
+            "value": round(sum(matches) / max(len(matches), 1), 4),
+            "unit": "fraction of requests with identical greedy output",
+            "token_match": round(tok_eq / max(tok_all, 1), 6),
+            "collective": coll, "tp": tp,
+            "platform": "forced-host-cpu (structure evidence, not "
+                        "chip perf)",
+            "tp1": base, f"tp{tp}": shard,
+            **({"decode_step_p50_ratio": round(f50 / u50, 3)}
+               if f50 and u50 else {}),
+            "requests": R, "capacity": cap, "ctx": ctx, "gen": gen_n,
+            "arrival_rate_hz": rate}
+
+
 def bench_sd_unet(steps=8, batch=4):
     """BASELINE config 6: Stable-Diffusion-class UNet denoise step,
     compiled (SD-1.x geometry at 64x64 latents)."""
@@ -1702,6 +1806,7 @@ CONFIGS = {
     "paged_decode": bench_paged_decode,
     "serving_engine": bench_serving_engine,
     "serving_prefix_cache": bench_serving_prefix_cache,
+    "serving_tp": bench_serving_tp,
     "sd_unet": bench_sd_unet,
     "kernels": bench_kernels,
 }
@@ -2062,7 +2167,7 @@ def _merge_opportunistic(out):
     for k in ("llama", "kernels", "ernie_infer", "sd_unet", "bert",
               "resnet_breakdown", "llama_breakdown", "ppyoloe",
               "llama_ladder", "paged_decode", "serving_engine",
-              "serving_prefix_cache"):
+              "serving_prefix_cache", "serving_tp"):
         live = out.get(k)
         stale_live = not isinstance(live, dict) or "error" in live
         cap = opp.get(k)
@@ -2156,7 +2261,7 @@ def main():
         extra_t = int(os.environ.get("BENCH_EXTRA_TIMEOUT", "900"))
         for name in ("kernels", "ernie_infer", "paged_decode",
                      "serving_engine", "serving_prefix_cache",
-                     "sd_unet", "bert",
+                     "serving_tp", "sd_unet", "bert",
                      "resnet_breakdown", "ppyoloe", "llama_ladder"):
             if name == "kernels":
                 _kernel_audit(out)   # pre-window geometry audit

@@ -92,8 +92,19 @@ def trace_artifacts(spec: ProgramSpec, x64_probe: bool = True
     callers turn that into a TRACE_ERROR finding."""
     import jax
 
-    mk = (jax.make_jaxpr(spec.fn, static_argnums=spec.static_argnums)
-          if spec.static_argnums else jax.make_jaxpr(spec.fn))
+    mk0 = (jax.make_jaxpr(spec.fn, static_argnums=spec.static_argnums)
+           if spec.static_argnums else jax.make_jaxpr(spec.fn))
+    if spec.axis_env:
+        # per-shard bodies (functions meant to run INSIDE shard_map)
+        # reference axes they do not bind; trace them under the spec's
+        # declared axis bindings (jax_compat.extend_axis_env)
+        from ..core.jax_compat import extend_axis_env
+
+        def mk(*a, **kw):
+            with extend_axis_env(spec.axis_env):
+                return mk0(*a, **kw)
+    else:
+        mk = mk0
     closed = mk(*spec.args, **spec.kwargs)
     in_avals, out_avals, donated = _flat_io(closed, spec)
     art = ProgramArtifacts(spec=spec, closed=closed, in_avals=in_avals,

@@ -22,14 +22,17 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-__all__ = ["build_catalog", "build_demo_regression", "CATALOG_PROGRAMS"]
+__all__ = ["build_catalog", "build_demo_regression",
+           "build_demo_tp_regression", "CATALOG_PROGRAMS"]
 
 # the default gate set, in audit order
 CATALOG_PROGRAMS = ("train_step", "train_step_fused",
                     "fused_optimizer_step",
                     "serving_decode", "serving_decode_fused",
                     "serving_prefill_16", "serving_prefill_32",
-                    "serving_page_copy", "collectives")
+                    "serving_page_copy",
+                    "serving_decode_tp", "serving_prefill_tp_16",
+                    "collectives")
 
 
 def _tiny_llama_cfg(seq: int = 64):
@@ -140,6 +143,53 @@ def _serving_specs(register: bool):
     return specs + fused
 
 
+def _tp_cfg():
+    """Divisible head counts for the tensor-parallel serving specs
+    (the default tiny cfg's KV=2 only shards 2-way)."""
+    from ..models.llama import LlamaConfig
+    import jax.numpy as jnp
+    return LlamaConfig(vocab_size=128, hidden_size=64,
+                       intermediate_size=128, num_hidden_layers=2,
+                       num_attention_heads=4, num_key_value_heads=4,
+                       max_position_embeddings=64, dtype=jnp.float32,
+                       remat=False)
+
+
+def _catalog_tp() -> int:
+    """Largest supported tp degree on the visible devices (CI forces 8
+    virtual CPU devices -> 4; a bare single-device env still builds the
+    same program NAMES at tp=1, so the gate list never shrinks)."""
+    import jax
+    n = len(jax.devices())
+    return max(t for t in (1, 2, 4) if t <= n)
+
+
+def _serving_tp_specs(register: bool):
+    """The REAL tensor-parallel serving programs: a mesh'd engine's
+    decode + prefill, registered with their declared mesh axes so the
+    collective-consistency rule gates actual sharded programs — the
+    psums/all_gathers live inside the shard_map'd jaxpr, and the
+    declared ``mesh_axes`` must agree with the mesh the programs were
+    built over."""
+    import jax
+    from ..inference.serving import ServingEngine
+    from ..inference.tp import ServingMesh
+    from ..models.llama import init_params
+
+    cfg = _tp_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(params, cfg, capacity=2, block_size=8,
+                        max_seq_len=64, prefill_buckets=(16,),
+                        mesh=ServingMesh.make(tp=_catalog_tp()))
+    specs = [s for s in eng.program_specs(register=False)
+             if s.name in ("serving_decode_tp", "serving_prefill_tp_16")]
+    if register:
+        from .registry import REGISTRY
+        for s in specs:
+            REGISTRY.register(s)
+    return specs
+
+
 def _collectives_spec(register: bool):
     """A representative multichip program: shard_map over the full
     device set with the collective families the flight recorder's op
@@ -200,6 +250,9 @@ def build_catalog(names: Optional[List[str]] = None,
                  "serving_page_copy"}:
         specs.extend(s for s in _serving_specs(register)
                      if s.name in wanted)
+    if wanted & {"serving_decode_tp", "serving_prefill_tp_16"}:
+        specs.extend(s for s in _serving_tp_specs(register)
+                     if s.name in wanted)
     if "collectives" in wanted:
         specs.append(_collectives_spec(register))
     return specs
@@ -234,6 +287,65 @@ def build_demo_regression(register: bool = False):
               jax.ShapeDtypeStruct((), jnp.int32), f32(()), f32((256,))),
         donate_argnums=(0, 1, 2, 3),
         carry={0: 0, 1: 1, 2: 2, 3: 3}, tags=("demo",))
+    if register:
+        REGISTRY.register(spec)
+    return spec
+
+
+def build_demo_tp_regression(register: bool = False):
+    """Mismatched mesh-axis injection for the collective rule: the REAL
+    per-shard tensor-parallel decode body (``inference.tp
+    ._tp_decode_step``, psum placement) traced under its true axis
+    binding (``axis_env=(("tp", 2),)`` — the body hardcodes psum over
+    "tp") while the spec DECLARES ``mesh_axes=("model",)``. That is
+    exactly the bug a mesh-axis rename introduces: the engine would
+    provide an axis named "model", the body still reduces over "tp",
+    and the program cannot run on the declared mesh.
+    ``UNKNOWN_COLLECTIVE_AXIS`` must fire — the CLI's
+    ``--demo-regression`` gate self-check covers the sharded serving
+    path with it. Never part of the default catalog."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from ..inference.tp import _tp_decode_step
+    from .registry import ProgramSpec, REGISTRY
+
+    cfg, tp = _tp_cfg(), 2
+    L, D = cfg.num_hidden_layers, cfg.hidden_size
+    H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    F, V = cfg.intermediate_size, cfg.vocab_size
+    B, BS, NB, MB = 2, 8, 9, 8
+    sds = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+    isd = lambda *s: jax.ShapeDtypeStruct(s, jnp.int32)    # noqa: E731
+    # the LOCAL shard's parameter shapes (what shard_map hands the body)
+    params_sd = {
+        "embed_tokens": sds(V, D), "final_norm": sds(D),
+        "lm_head": sds(D, V),
+        "layers": {
+            "input_norm": sds(L, D), "post_norm": sds(L, D),
+            "q_proj": sds(L, D, H * hd // tp),
+            "k_proj": sds(L, D, KV * hd // tp),
+            "v_proj": sds(L, D, KV * hd // tp),
+            "o_proj": sds(L, H * hd // tp, D),
+            "gate_proj": sds(L, D, F // tp),
+            "up_proj": sds(L, D, F // tp),
+            "down_proj": sds(L, F // tp, D),
+        },
+    }
+    pools_sd = sds(L, NB, BS, KV // tp, hd)
+    fn = functools.partial(_tp_decode_step, cfg=cfg, axis="tp",
+                           collective="psum", fused=False)
+    spec = ProgramSpec(
+        name="demo_regression_tp_axis",
+        fn=lambda params, tok, kp, vp, tables, seq: fn(
+            params, tok, k_pools=kp, v_pools=vp, block_tables=tables,
+            seq_lens=seq),
+        args=(params_sd, isd(B), pools_sd, pools_sd, isd(B, MB),
+              isd(B)),
+        mesh_axes=("model",),          # the mismatch: body psums @tp
+        axis_env=(("tp", tp),), tags=("demo",))
     if register:
         REGISTRY.register(spec)
     return spec

@@ -470,7 +470,7 @@ def build_demo_kernel_regression() -> AuditReport:
     def prefix_mlp(x, nw, wg, wu, wd, eps=1e-6):
         const = lambda j: (0, 0)                          # noqa: E731
         return audited_pallas_call(
-            functools.partial(_mlp_block_kernel, eps=eps),
+            functools.partial(_mlp_block_kernel, eps=eps, residual=True),
             name="demo_prefix_mlp_block",
             accum_outputs=(0,),
             grid=(F // bf,),           # the bug: floor, not cdiv+guard

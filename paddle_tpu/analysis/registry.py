@@ -84,6 +84,13 @@ class ProgramSpec:
     static_argnums: Tuple[int, ...] = ()
     static_argvals: Tuple = ()
     mesh_axes: Tuple[str, ...] = ()
+    # ((axis_name, size), ...) bound in the ambient axis env while the
+    # auditor TRACES this spec — a per-shard program body (the function
+    # INSIDE a shard_map) references mesh axes it does not bind itself,
+    # so it only traces under an extended env. ``mesh_axes`` above is
+    # the DECLARATION the collective-consistency rule checks against;
+    # the two differing is exactly the mismatched-axis bug class.
+    axis_env: Tuple[Tuple[str, int], ...] = ()
     carry: Optional[Dict[int, int]] = None
     tags: Tuple[str, ...] = ()
     signatures: List[Tuple] = field(default_factory=list)

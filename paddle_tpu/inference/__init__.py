@@ -8,6 +8,7 @@ from .generation import (GenerationConfig, generate, generate_paged,
                          cached_forward, init_cache, sample_token)
 from .serving import Request, ServingEngine
 from .prefix_cache import PrefixCache, PagedKVCacheStore
+from .tp import ServingMesh
 
 __all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
            "DataType", "PlaceType", "PrecisionType", "PredictorPool",
@@ -15,8 +16,8 @@ __all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
            "get_trt_compile_version", "get_trt_runtime_version",
            "convert_to_mixed_precision",
            "generate", "generate_paged", "cached_forward", "init_cache",
-           "sample_token", "Request", "ServingEngine", "PrefixCache",
-           "PagedKVCacheStore"]
+           "sample_token", "Request", "ServingEngine", "ServingMesh",
+           "PrefixCache", "PagedKVCacheStore"]
 
 
 class DataType:

@@ -252,10 +252,22 @@ def _fused_mode(fused_decode):
                      f"got {fused_decode!r}")
 
 
-def _paged_chunk_runner(cfg, gen, quant=False, fused=False):
+def _mesh_route(sm):
+    """The mesh's contribution to a program-cache key: axis name, tp
+    degree, collective placement and the device identities (two meshes
+    over different chips must not share a compiled program)."""
+    if sm is None:
+        return ()
+    return (sm.axis, sm.tp, sm.collective,
+            tuple(int(d.id) for d in sm.mesh.devices.flat))
+
+
+def _paged_chunk_runner(cfg, gen, quant=False, fused=False, sm=None):
     """Jitted n-step decode scan, cached per (cfg values, gen values) —
     a fresh jit per generate_paged call would re-trace the whole L-layer
-    scan every serving request."""
+    scan every serving request. ``sm``: an optional ServingMesh — the
+    scan body then runs the tensor-parallel decode step under shard_map
+    (inference/tp.py), still ONE jitted program per chunk size."""
     from ..core.flags import GLOBAL_FLAGS
     # the kernel-route flags are traced INTO the compiled scan, so they
     # must key the cache — an A/B flip (bench_paged_decode) would
@@ -280,12 +292,25 @@ def _paged_chunk_runner(cfg, gen, quant=False, fused=False):
         route = ()
     ck = (dataclasses.astuple(cfg), dataclasses.astuple(gen),
           bool(GLOBAL_FLAGS.get("use_paged_kernel")), bool(quant),
-          fused, route)
+          fused, route, _mesh_route(sm))
     cached = _cache_get(_PAGED_CACHE, ck)
     if cached is not None:
         return cached
-    step = _paged_decode_step if not fused else functools.partial(
-        _fused_decode_step, mode=fused)
+    if sm is None:
+        step = _paged_decode_step if not fused else functools.partial(
+            _fused_decode_step, mode=fused)
+    else:
+        def step(params, tok, cfg_, kp, vp, block_tables, seq_lens,
+                 kv_scales=None):
+            # one shard_map per decode step inside the scan body (the
+            # ONE wiring, shared with the engine's decode program):
+            # per-shard forward, sampling on the replicated logits
+            # outside — shard_map'd random ops and typed keys disagree
+            # across jax versions, and logits are replicated anyway
+            extra = tuple(kv_scales) if kv_scales is not None else ()
+            return sm.sharded_decode_fn(
+                cfg_, fused, quant=kv_scales is not None)(
+                params, tok, seq_lens, block_tables, kp, vp, *extra)
 
     @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(5, 6))
     def chunk_fn(n, params, tok, key, done, k_pools, v_pools, seq_lens,
@@ -443,11 +468,49 @@ def _fused_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
     return x @ head, k_pools, v_pools
 
 
+_TP_PREFILL_CACHE: Dict = {}
+
+
+def _tp_prefill_runner(cfg, sm, B, S, T):
+    """Jitted tensor-parallel prefill for generate_paged: builds the
+    LOCAL dense cache inside the per-shard body (KV_loc heads) and runs
+    the tensor-parallel ``cached_forward`` mirror. Cached per
+    (cfg values, geometry, mesh route) like the chunk runner."""
+    import dataclasses as _dc
+    from ..core.jax_compat import shard_map_norep
+    from .tp import _tp_cached_forward
+
+    ck = (_dc.astuple(cfg), B, S, T, _mesh_route(sm))
+    cached = _cache_get(_TP_PREFILL_CACHE, ck)
+    if cached is not None:
+        return cached
+    L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    KV_loc = KV // sm.tp
+    rep = sm.replicated
+    cache_spec = sm.pool_spec      # [L, B, T, KV, hd]: axis 3 again
+
+    def fwd(params, toks):
+        shape = (L, B, T, KV_loc, hd)
+        kc, vc = jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+        return _tp_cached_forward(params, toks, cfg, kc, vc, 0,
+                                  axis=sm.axis,
+                                  collective=sm.collective)
+
+    fn = jax.jit(shard_map_norep(fwd, sm.mesh,
+                                 (sm.param_specs(cfg), rep),
+                                 (rep, cache_spec, cache_spec)))
+    if len(_TP_PREFILL_CACHE) > 16:
+        _TP_PREFILL_CACHE.pop(next(iter(_TP_PREFILL_CACHE)))
+    _TP_PREFILL_CACHE[ck] = fn
+    return fn
+
+
 def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
                    gen: Optional[GenerationConfig] = None,
                    block_size: int = 16, seed: int = 0,
                    cache_dtype=None, prefix_cache=None,
-                   observability=None, fused_decode=None):
+                   observability=None, fused_decode=None, mesh=None):
     """vLLM-style serving loop over a paged KV cache.
 
     ``cache_dtype="int8"``: static per-head cache quantization
@@ -481,17 +544,36 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     FLAGS_fused_decode (default ON); dispatch picks the Pallas
     megakernels where supported and the bit-identical unfused
     composition elsewhere. "pallas"/"ref" force a variant.
+
+    ``mesh``: a ``ServingMesh`` (or 1-D jax Mesh / int tp) — prefill
+    and every decode chunk run tensor-parallel over the head axis
+    (inference/tp.py): pools and projections shard, the residual
+    stream and logits stay replicated, still ONE jitted program per
+    chunk size. collective="gather" is bit-identical to mesh=None;
+    the default "psum" placement is roundoff-parity (documented).
     """
     import time as _time
 
     import numpy as np
     from ..ops.paged_attention import BlockManager
+    from .tp import normalize_mesh
 
     gen = gen or GenerationConfig()
     if observability is True:      # mirror ServingEngine's normalization
         from ..observability import Observability
         observability = Observability()
     fused = _fused_mode(fused_decode)
+    sm = normalize_mesh(mesh)
+    if sm is not None:
+        ok, reason = sm.supports(cfg)
+        if not ok:
+            raise ValueError(f"generate_paged(mesh=...): {reason}")
+        if prefix_cache is not None:
+            raise NotImplementedError(
+                "generate_paged(prefix_cache=...) does not take a mesh:"
+                " the persistent store owns single-device pools that "
+                "outlive the call. Use ServingEngine(mesh=..., "
+                "prefix_cache=True) for sharded prefix sharing")
     if prefix_cache is not None:
         return _generate_paged_prefix(params, input_ids, cfg, gen,
                                       block_size, seed, cache_dtype,
@@ -512,9 +594,17 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
 
     # prefill with the dense cache, then repack into pools
     t0 = _time.perf_counter() if obs is not None else 0.0
-    k_cache, v_cache = init_cache(cfg, B, T)
-    logits, k_cache, v_cache = cached_forward(
-        params, input_ids, cfg, k_cache, v_cache, 0)
+    if sm is None:
+        k_cache, v_cache = init_cache(cfg, B, T)
+        logits, k_cache, v_cache = cached_forward(
+            params, input_ids, cfg, k_cache, v_cache, 0)
+    else:
+        # the dense cache is built LOCAL inside the sharded program;
+        # the repack below then runs eagerly on the sharded arrays
+        # (page axis unsharded — no collectives)
+        params = sm.shard(params, sm.param_specs(cfg))
+        logits, k_cache, v_cache = _tp_prefill_runner(cfg, sm, B, S, T)(
+            params, jnp.asarray(input_ids))
     if obs is not None:
         # host dispatch time (device completes async; forcing it here
         # would add a sync the serving path is asserted not to have)
@@ -534,6 +624,9 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     pool_shape = (L, num_blocks, BS, KV, hd)
     k_pools = jnp.zeros(pool_shape, k_cache.dtype)
     v_pools = jnp.zeros(pool_shape, v_cache.dtype)
+    if sm is not None:
+        k_pools = sm.shard(k_pools, sm.pool_spec)
+        v_pools = sm.shard(v_pools, sm.pool_spec)
     # dense [L, B, T, KV, hd] -> pages
     pad = MB * BS - T
     kc = jnp.pad(k_cache, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
@@ -571,7 +664,7 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     # runner is cached per (config values, sampling knobs) like
     # generate()'s — shapes and the static n key jit's own cache.
     chunk_fn = _paged_chunk_runner(cfg, gen, quant=kv_scales is not None,
-                                   fused=fused)
+                                   fused=fused, sm=sm)
 
     key = _key_for(seed)
     tok = sample_token(logits[:, -1], key, gen)

@@ -41,6 +41,16 @@ TPU analog of the reference's AnalysisPredictor serving loop around
   the engine's int8 scales are engine-global and static, the int8 cache
   participates in sharing unchanged.
 
+- TENSOR PARALLELISM (``mesh=ServingMesh(...)``, inference/tp.py): the
+  paged KV pools, the QKV/o-proj/MLP weights and the per-slot attention
+  computation shard along the HEAD axis of a named 1-D mesh via
+  shard_map; the decode step stays ONE jitted program (sampling runs on
+  the replicated logits), bucketed prefill stays <=1 trace per bucket,
+  and the page tables stay host-global so BlockManager/prefix-cache
+  logic is identical. Collective placement and the greedy-parity
+  contract (bit-identical for collective="gather", roundoff for the
+  default "psum") are documented in inference/tp.py.
+
 Host/device split: the decode carry (tokens, seq_lens, key, pools)
 stays device-resident between steps; host mirrors are re-uploaded only
 when admission state changes. The per-step device->host read of the
@@ -59,6 +69,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core.jax_compat import shard_map_norep
 from ..observability import Observability
 from ..ops.paged_attention import (BlockManager, dequant_cache,
                                    quant_cache)
@@ -138,7 +149,32 @@ class ServingEngine:
                  max_seq_len: Optional[int] = None, cache_dtype=None,
                  prefill_buckets=(32, 128), seed: int = 0,
                  prefix_cache: bool = False,
-                 observability=False, fused_decode=None):
+                 observability=False, fused_decode=None, mesh=None):
+        # tensor parallelism (inference/tp.py): a ServingMesh shards
+        # the KV pools, projections and per-slot attention along the
+        # head axis; programs wrap in shard_map. None = single device.
+        # Accepts a ServingMesh, a 1-D jax Mesh, or an int tp degree.
+        from .tp import normalize_mesh
+        self._mesh = normalize_mesh(mesh)
+        if self._mesh is not None:
+            ok, reason = self._mesh.supports(cfg)
+            if not ok:
+                # clean rejection, same reason-string contract as the
+                # kernel registry's supports() predicates
+                raise ValueError(f"ServingEngine(mesh=...): {reason}")
+            if self._mesh.collective == "gather" \
+                    and _fused_mode(fused_decode) == "pallas":
+                # an explicit pin must never silently no-op (the PR-7
+                # rms_norm precedent): the gather placement runs the
+                # exact unfused composition BY CONTRACT (bit-parity is
+                # defined by the single-device op sequence)
+                raise ValueError(
+                    'fused_decode="pallas" cannot be honored under '
+                    'collective="gather" — that placement runs the '
+                    "exact unfused composition (its bit-parity "
+                    'contract); use collective="psum" or drop the pin')
+            params = self._mesh.shard(params,
+                                      self._mesh.param_specs(cfg))
         self.params = params
         self.cfg = cfg
         # decode-block kernel routing: False = the pre-fusion unfused
@@ -185,6 +221,14 @@ class ServingEngine:
         shape = (L, self.num_blocks, BS, KV, hd)
         self._k_pools = jnp.zeros(shape, pool_dtype)
         self._v_pools = jnp.zeros(shape, pool_dtype)
+        if self._mesh is not None:
+            # pools shard their head-dim CONTENTS; page indices stay
+            # host-global, so BlockManager/prefix-cache logic below is
+            # identical with or without a mesh
+            self._k_pools = self._mesh.shard(self._k_pools,
+                                             self._mesh.pool_spec)
+            self._v_pools = self._mesh.shard(self._v_pools,
+                                             self._mesh.pool_spec)
         self._kv_scales = None       # (k [L,KV], v [L,KV]) once calibrated
 
         self.mgr = BlockManager(self.num_blocks, BS, self.max_blocks)
@@ -223,6 +267,11 @@ class ServingEngine:
         self._d_tok = self._d_seq = None
         self._d_tables = self._d_temps = None
         self._d_key = jax.random.key(seed)
+        if self._mesh is not None:
+            # donated carried state must live replicated ON the mesh:
+            # donating a buffer the jit would first have to reshard
+            # silently voids the donation (and warns) every step
+            self._d_key = self._mesh.replicate(self._d_key)
 
         self._decode_fn = None
         self._prefill_fns: Dict[int, object] = {}
@@ -255,6 +304,43 @@ class ServingEngine:
             self._obs.registry.adopt_counters(self.counters)
         else:
             self._obs = None
+        # serving-collective instrumentation: a mesh'd engine with
+        # observability on binds an engine-scoped flight recorder and
+        # replays the DECLARED per-step collective inventory around
+        # each dispatched program — host-observed spans (the engine's
+        # one-sync-per-step philosophy), byte counters exact because
+        # the shapes are static. metrics() surfaces them under
+        # "collectives" exactly like Trainer.metrics().
+        self._flight = None
+        self._coll_decode = ()
+        self._coll_prefill: Dict[int, tuple] = {}
+        if self._mesh is not None and self._obs is not None:
+            from ..distributed.flight_recorder import FlightRecorder
+            rec = FlightRecorder(capacity=4096)
+            rec.enabled = True
+            self._flight = self._obs.bind_flight_recorder(rec)
+            self._coll_decode = tuple(self._mesh.collective_inventory(
+                cfg, B=self.capacity))
+
+    def _record_collectives(self, inventory):
+        """Open one CommTask per declared collective class; returns the
+        tasks for :meth:`_end_collectives` after the program's sync."""
+        if self._flight is None or not inventory:
+            return None
+        return [self._flight.begin(op, ax, shape, dt)
+                for op, ax, shape, dt in inventory]
+
+    def _end_collectives(self, tasks):
+        if tasks:
+            for t in tasks:
+                self._flight.end(t)
+
+    def _upload(self, x):
+        """Host mirror -> device, committed replicated on the mesh when
+        tensor-parallel (so donated carried state never reshards)."""
+        if self._mesh is not None:
+            return self._mesh.replicate(np.ascontiguousarray(x))
+        return jnp.asarray(x)
 
     def _copy_page(self, src: int, dst: int):
         """COW primitive for the prefix cache: device-copy one physical
@@ -356,10 +442,31 @@ class ServingEngine:
     def _resolve_variant(self) -> Dict:
         from ..ops.pallas.fused_decode_block import (decode_meta,
                                                      resolve_decode_blocks)
-        meta = decode_meta(self.cfg, B=self.capacity,
-                           BS=self.block_size, MB=self.max_blocks,
-                           pool_dtype=self._k_pools.dtype,
-                           quant=self._quant)
+        from ..ops.pallas.fused_decode_block import decode_meta_dims
+        sm = self._mesh
+        if sm is not None and sm.collective == "gather":
+            # the gather placement's bit-parity contract IS the
+            # single-device op sequence — it always runs the exact
+            # composition, whatever the fused knob says
+            return {"mode": str(self._fused), "attn": "unfused",
+                    "mlp": "unfused"}
+        cfg, tp = self.cfg, (1 if sm is None else sm.tp)
+        if tp == 1:
+            meta = decode_meta(cfg, B=self.capacity,
+                               BS=self.block_size, MB=self.max_blocks,
+                               pool_dtype=self._k_pools.dtype,
+                               quant=self._quant)
+        else:
+            # dispatch consults the PER-SHARD shape class: local head
+            # and intermediate counts, tp riding in the meta — the
+            # same dims _tp_decode_step derives inside shard_map
+            meta = decode_meta_dims(
+                self.capacity, cfg.hidden_size,
+                cfg.num_attention_heads // tp,
+                cfg.num_key_value_heads // tp, cfg.head_dim,
+                cfg.intermediate_size // tp, self.block_size,
+                self.max_blocks, cfg.dtype, self._k_pools.dtype,
+                self._quant, tp=tp)
         _, _, names = resolve_decode_blocks(meta, self._fused)
         return {"mode": str(self._fused), **names}
 
@@ -446,8 +553,14 @@ class ServingEngine:
         return snap
 
     def metrics(self) -> Dict:
+        # the flight recorder parks raw collective_calls/bytes counters
+        # in the adopted dict; they surface ONLY under the structured
+        # "collectives" key below (the Trainer.metrics contract)
         c = {k: (dict(v) if isinstance(v, dict) else v)
-             for k, v in self.counters.items()}
+             for k, v in self.counters.items()
+             if k not in ("collective_calls", "collective_bytes")}
+        if self._mesh is not None:
+            c["mesh"] = self._mesh.describe()
         wall = ((self._t_last - self._t_first)
                 if self._t_first is not None and self._t_last is not None
                 else 0.0)
@@ -485,6 +598,22 @@ class ServingEngine:
                                 + obs.stall_dumps_suppressed)
             c["timeline_events"] = len(obs.timeline)
             c["timeline_dropped"] = obs.timeline.dropped
+            if self._flight is not None:
+                # the bound recorder feeds per-(op, axis) latency
+                # histograms + call/byte counters — one structured
+                # sub-dict, schema-frozen in test_observability
+                c["collectives"] = {
+                    "calls": dict(self.counters.get(
+                        "collective_calls", {})),
+                    "bytes": dict(self.counters.get(
+                        "collective_bytes", {})),
+                    "latency_ms": {
+                        name[len("collective_"):-len("_ms")]:
+                            h.snapshot()
+                        for name, h in sorted(
+                            obs.registry.histograms.items())
+                        if name.startswith("collective_")
+                        and name.endswith("_ms")}}
         return c
 
     def reset_metrics(self):
@@ -506,6 +635,14 @@ class ServingEngine:
         self._t_first = self._t_last = None
         self._metrics_reset_t = time.perf_counter()
         self._requests = [r for r in self._requests if not r.done]
+        if self._flight is not None:
+            # the recorder's call/byte counters live in the adopted
+            # dict; reset_window() below restarts the collective
+            # latency HISTOGRAMS, so the counters must restart with
+            # them — metrics()["collectives"] reports ONE window
+            # (calls == histogram count), never warmup-inflated totals
+            self.counters.pop("collective_calls", None)
+            self.counters.pop("collective_bytes", None)
         if self._obs is not None:
             self._obs.reset_window()
             self._obs.watchdog.mark_warmup(self.counters)
@@ -612,6 +749,15 @@ class ServingEngine:
             toks = np.zeros((1, P), np.int32)
             toks[0, :n] = req.prompt[pos0:pos0 + n]
             t0 = time.perf_counter() if self._obs is not None else 0.0
+            if self._flight is not None:
+                inv = self._coll_prefill.get(P)
+                if inv is None:
+                    inv = self._coll_prefill[P] = tuple(
+                        self._mesh.collective_inventory(self.cfg, B=1,
+                                                        chunk=P))
+                tasks = self._record_collectives(inv)
+            else:
+                tasks = None
             # pos0/last_idx ride at the platform default int width so
             # the literal indices inside cached_forward's dynamic
             # slices promote consistently whether or not x64 is on
@@ -622,6 +768,7 @@ class ServingEngine:
                 jnp.asarray(n - 1),
                 jnp.asarray(self._temp_of(req.gen), jnp.float32),
                 self._d_key, self._k_pools, self._v_pools)
+            self._end_collectives(tasks)
             self.counters["prefill_chunks"] += 1
             self.counters["prefill_tokens"] += n
             if self._obs is not None:
@@ -676,17 +823,19 @@ class ServingEngine:
         if self._decode_fn is None:
             self._decode_fn = self._make_decode_fn()
         if self._dirty:
-            self._d_tok = jnp.asarray(self._h_tok.copy())
-            self._d_seq = jnp.asarray(self._h_seq.copy())
-            self._d_tables = jnp.asarray(self._h_tables.copy())
-            self._d_temps = jnp.asarray(self._h_temps.copy())
+            self._d_tok = self._upload(self._h_tok.copy())
+            self._d_seq = self._upload(self._h_seq.copy())
+            self._d_tables = self._upload(self._h_tables.copy())
+            self._d_temps = self._upload(self._h_temps.copy())
             self._dirty = False
         t0 = time.perf_counter() if self._obs is not None else 0.0
+        tasks = self._record_collectives(self._coll_decode)
         (self._d_tok, self._d_seq, self._d_key, self._k_pools,
          self._v_pools) = self._decode_fn(
             self.params, self._d_tok, self._d_seq, self._d_tables,
             self._d_temps, self._d_key, self._k_pools, self._v_pools)
         nxt = np.asarray(self._d_tok)       # the per-step host sync
+        self._end_collectives(tasks)
         self.counters["decode_steps"] += 1
         self.counters["live_slot_steps"] += len(live)
         if self._obs is not None:
@@ -785,6 +934,8 @@ class ServingEngine:
     _PREFILL_CARRY = {1: 7, 2: 8, 3: 9}
 
     def _make_decode_fn(self, record_variant=True):
+        if self._mesh is not None:
+            return self._make_decode_fn_tp(record_variant)
         cfg, counters = self.cfg, self.counters
         scales = self._kv_scales    # closed over: fixed after calibration
         fused = self._fused
@@ -820,7 +971,38 @@ class ServingEngine:
         # buffers update in place — the donation audit's own finding
         return jax.jit(step, donate_argnums=self._DECODE_DONATE)
 
+    def _make_decode_fn_tp(self, record_variant=True):
+        """The tensor-parallel decode program: the SAME signature,
+        donation and carry contract as the single-device one — the
+        per-shard forward (inference/tp.py) runs under shard_map over
+        the ServingMesh, sampling runs on the replicated logits outside
+        it. Still ONE jitted program; admission/completion never change
+        shapes, so steady state stays zero retraces."""
+        cfg, counters = self.cfg, self.counters
+        scales = self._kv_scales
+        fused = self._fused
+        sm = self._mesh
+        sharded = sm.sharded_decode_fn(cfg, fused,
+                                       quant=scales is not None)
+
+        def step(params, tok, seq_lens, tables, temps, key,
+                 k_pools, v_pools):
+            counters["decode_traces"] += 1
+            if fused and record_variant:
+                self._decode_variant = self._resolve_variant()
+            extra = tuple(scales) if scales is not None else ()
+            logits, k_pools, v_pools = sharded(
+                params, tok, seq_lens, tables, k_pools, v_pools, *extra)
+            key, sub = jax.random.split(key)
+            nxt = _sample_slots(logits, sub, temps)
+            seq_lens = jnp.where(seq_lens > 0, seq_lens + 1, 0)
+            return nxt, seq_lens, key, k_pools, v_pools
+
+        return jax.jit(step, donate_argnums=self._DECODE_DONATE)
+
     def _make_prefill_fn(self, P: int):
+        if self._mesh is not None:
+            return self._make_prefill_fn_tp(P)
         cfg, counters = self.cfg, self.counters
         MB, BS = self.max_blocks, self.block_size
         L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
@@ -864,6 +1046,67 @@ class ServingEngine:
 
         # key is carried state exactly like the pools: the caller
         # rebinds self._d_key to the returned key, so donate it too
+        return jax.jit(chunk, donate_argnums=self._PREFILL_DONATE)
+
+    def _make_prefill_fn_tp(self, P: int):
+        """Tensor-parallel bucketed prefill chunk: the per-shard body
+        gathers the request's pages into a LOCAL dense view (the page
+        indices are host-global; each shard holds its slice of the
+        head axis), runs the tensor-parallel ``cached_forward`` mirror
+        and scatters back through the WRITE table — same signature,
+        donation and <=1-trace-per-bucket contract as the single-device
+        chunk."""
+        from .tp import _tp_cached_forward
+        cfg, counters = self.cfg, self.counters
+        MB, BS = self.max_blocks, self.block_size
+        L, hd = cfg.num_hidden_layers, cfg.head_dim
+        scales = self._kv_scales
+        sm = self._mesh
+        counters["prefill_traces"].setdefault(P, 0)
+        rep = sm.replicated
+        in_specs = (sm.param_specs(cfg), rep, rep, rep, rep,
+                    sm.pool_spec, sm.pool_spec)
+        if scales is not None:
+            in_specs += (sm.scale_spec, sm.scale_spec)
+
+        def fwd(params, toks, pos0, table, wtable, k_pools, v_pools,
+                *sc):
+            KV_l = k_pools.shape[3]       # local KV heads of this shard
+            kc = jnp.take(k_pools, table, axis=1) \
+                .reshape(L, 1, MB * BS, KV_l, hd)
+            vc = jnp.take(v_pools, table, axis=1) \
+                .reshape(L, 1, MB * BS, KV_l, hd)
+            if sc:
+                kc = dequant_cache(kc, sc[0]).astype(cfg.dtype)
+                vc = dequant_cache(vc, sc[1]).astype(cfg.dtype)
+            logits, kc, vc = _tp_cached_forward(
+                params, toks, cfg, kc, vc, pos0, axis=sm.axis,
+                collective=sm.collective)
+            if sc:
+                kc = quant_cache(kc, sc[0])
+                vc = quant_cache(vc, sc[1])
+            k_pools = k_pools.at[:, wtable].set(
+                kc.reshape(L, MB, BS, KV_l, hd).astype(k_pools.dtype))
+            v_pools = v_pools.at[:, wtable].set(
+                vc.reshape(L, MB, BS, KV_l, hd).astype(v_pools.dtype))
+            return logits, k_pools, v_pools
+
+        sharded = shard_map_norep(fwd, sm.mesh, in_specs,
+                                  (rep, sm.pool_spec, sm.pool_spec))
+
+        def chunk(params, toks, pos0, table, wtable, last_idx, temp,
+                  key, k_pools, v_pools):
+            counters["prefill_traces"][P] += 1
+            extra = tuple(scales) if scales is not None else ()
+            logits, k_pools, v_pools = sharded(
+                params, toks, pos0, table, wtable, k_pools, v_pools,
+                *extra)
+            lg = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1,
+                                              axis=1)[:, 0]
+            key, sub = jax.random.split(key)
+            tok = _sample_slots(lg, sub, temp[None])[0]
+            return tok, key, k_pools, v_pools
+
         return jax.jit(chunk, donate_argnums=self._PREFILL_DONATE)
 
     def _calibrate(self, prompt: np.ndarray):
@@ -911,24 +1154,31 @@ class ServingEngine:
         flat = lambda argnum: n_p + argnum - 1          # noqa: E731
         # a FORCED-pallas engine registers the fused decode program
         # under its own name so the audit gate covers the megakernel
-        # path next to (not instead of) the default program
+        # path next to (not instead of) the default program; a mesh'd
+        # engine suffixes _tp the same way (the collective-consistency
+        # rule gates the sharded programs against the DECLARED axes)
+        sm = self._mesh
+        tp_sfx = "_tp" if sm is not None else ""
+        axes = (sm.axis,) if sm is not None else ()
+        tags = ("serving",) + (("tp",) if sm is not None else ())
         decode_name = ("serving_decode_fused"
                        if self._fused in ("pallas",) else "serving_decode")
         specs = [ProgramSpec(
-            name=decode_name, fn=self._make_decode_fn(
+            name=decode_name + tp_sfx, fn=self._make_decode_fn(
                 record_variant=False),
             args=(params_sd, sds((C,), jnp.int32), sds((C,), jnp.int32),
                   sds((C, MB), jnp.int32), sds((C,), jnp.float32),
                   key_sd, pools_sd, pools_sd),
             donate_argnums=self._DECODE_DONATE,
             carry={o: flat(a) for o, a in self._DECODE_CARRY.items()},
-            tags=("serving",))]
+            mesh_axes=axes, tags=tags)]
         # pos0/last_idx ride at the platform default int width
         # (serving._run_prefill stages them with a bare jnp.asarray)
         idx_dt = jnp.asarray(0).dtype
         for P in self.buckets:
             specs.append(ProgramSpec(
-                name=f"serving_prefill_{P}", fn=self._make_prefill_fn(P),
+                name=f"serving_prefill{tp_sfx}_{P}",
+                fn=self._make_prefill_fn(P),
                 args=(params_sd, sds((1, P), jnp.int32), sds((), idx_dt),
                       sds((MB,), jnp.int32), sds((MB,), jnp.int32),
                       sds((), idx_dt), sds((), jnp.float32), key_sd,
@@ -936,14 +1186,14 @@ class ServingEngine:
                 donate_argnums=self._PREFILL_DONATE,
                 carry={o: flat(a)
                        for o, a in self._PREFILL_CARRY.items()},
-                tags=("serving",)))
+                mesh_axes=axes, tags=tags))
         if self._pcache is not None:
             specs.append(ProgramSpec(
-                name="serving_page_copy", fn=self._copy_fn,
+                name="serving_page_copy" + tp_sfx, fn=self._copy_fn,
                 args=(pools_sd, pools_sd, sds((), jnp.int32),
                       sds((), jnp.int32)),
                 donate_argnums=(0, 1), carry={0: 0, 1: 1},
-                tags=("serving",)))
+                mesh_axes=axes, tags=tags))
         if register:
             for s in specs:
                 REGISTRY.register(s)

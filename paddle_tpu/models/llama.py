@@ -37,7 +37,8 @@ from ..ops.pallas.fused_train import (fused_linear_ce,
 from ..ops.pallas.norms import residual_rms_norm as _residual_rms_norm
 
 __all__ = ["LlamaConfig", "init_params", "forward", "loss_fn",
-           "build_forward", "param_shardings", "LLAMA_7B", "LLAMA_TINY"]
+           "build_forward", "param_shardings", "tp_param_specs",
+           "LLAMA_7B", "LLAMA_TINY"]
 
 
 @dataclasses.dataclass
@@ -137,6 +138,41 @@ def param_shardings(mesh: Mesh, cfg: LlamaConfig) -> Dict:
     }
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = s(fsdp, tp)
+    return specs
+
+
+def tp_param_specs(cfg: LlamaConfig, axis: str = "tp",
+                   collective: str = "psum") -> Dict:
+    """PartitionSpecs for SERVING tensor parallelism over a 1-D mesh:
+    head-axis (Megatron) sharding of the per-layer projections, with
+    everything the replicated residual stream touches kept replicated
+    (embedding, norms, lm_head) so greedy sampling runs identically on
+    every shard.
+
+    ``collective="psum"`` row-shards o_proj/down_proj (their partial
+    products all-reduce, one psum per sub-block — the bandwidth-optimal
+    placement). ``collective="gather"`` keeps o_proj/down_proj
+    REPLICATED and all-gathers the per-shard attention heads / MLP
+    columns instead: every matmul then has exactly the single-device
+    operands and shapes, which is what makes that mode's greedy output
+    bit-identical (inference/tp.py documents the contract)."""
+    col = P(None, None, axis)                  # shard output columns
+    row = P(None, axis, None) if collective == "psum" else P(None, None,
+                                                             None)
+    specs = {
+        "embed_tokens": P(None, None),
+        "layers": {
+            "input_norm": P(None, None),
+            "q_proj": col, "k_proj": col, "v_proj": col,
+            "o_proj": row,
+            "post_norm": P(None, None),
+            "gate_proj": col, "up_proj": col,
+            "down_proj": row,
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, None)
     return specs
 
 

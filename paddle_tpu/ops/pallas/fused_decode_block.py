@@ -76,7 +76,7 @@ _vmem_budget = fused_vmem_budget
 # ---------------------------------------------------------------------------
 def _attn_block_kernel(bt_ref, len_ref, x_ref, nw_ref, wq_ref, wk_ref,
                        wv_ref, wo_ref, sin_ref, cos_ref, *rest,
-                       scale, bs, kv, groups, eps, pp, quant):
+                       scale, bs, kv, groups, eps, pp, quant, residual):
     k_refs = rest[:pp]
     v_refs = rest[pp:2 * pp]
     i = 2 * pp
@@ -190,7 +190,11 @@ def _attn_block_kernel(bt_ref, len_ref, x_ref, nw_ref, wq_ref, wk_ref,
         attn = (acc_fin / l_fin).astype(dt)                   # (H, hd)
         o = jnp.dot(attn.reshape(1, -1), wo_ref[:],
                     preferred_element_type=jnp.float32)
-        xo_ref[:] = x_ref[:] + o.astype(dt)
+        # residual=False returns the bare o-projection: the tensor-
+        # parallel caller psums the per-shard partials across the head
+        # axis FIRST and adds the (replicated) residual after
+        xo_ref[:] = (x_ref[:] + o.astype(dt)) if residual \
+            else o.astype(dt)
 
 
 def attn_autotune_key(B, H, KV, hd, BS, MB, dtype, pool_dtype) -> str:
@@ -215,7 +219,7 @@ def _tuned_pages(key_str, candidates, build, args):
 def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
                             k_pool, v_pool, block_tables, seq_lens,
                             kv_scales=None, eps=1e-6,
-                            pages_per_step=None):
+                            pages_per_step=None, residual=True):
     """Fused attention stage of one decode block.
 
     x: [B, D] residual stream; nw: [D] (already at x.dtype);
@@ -227,7 +231,10 @@ def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
 
     Returns (x_out [B, D], k_new [B, KV, hd], v_new [B, KV, hd]); the
     caller writes k_new/v_new into the pools (``write_to_pool[_quant]``)
-    exactly as the unfused path does.
+    exactly as the unfused path does. ``residual=False`` returns the
+    bare o-projection instead of ``x + o`` — the tensor-parallel step
+    runs this kernel per head shard and all-reduces the partials before
+    adding the replicated residual.
     """
     B, D = x.shape
     N, BS, KV, hd = k_pool.shape
@@ -246,7 +253,8 @@ def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
 
         def build(pp_):
             return lambda *a: fused_attn_block_pallas(
-                *a, kv_scales=kv_scales, eps=eps, pages_per_step=pp_)[0]
+                *a, kv_scales=kv_scales, eps=eps, pages_per_step=pp_,
+                residual=residual)[0]
 
         pages_per_step = _tuned_pages(ck, cands or [1], build, args)
     pp = max(1, min(int(pages_per_step), MB))
@@ -283,7 +291,8 @@ def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
 
     xo, kn, vn = audited_pallas_call(
         functools.partial(_attn_block_kernel, scale=scale, bs=BS, kv=KV,
-                          groups=groups, eps=eps, pp=pp, quant=quant),
+                          groups=groups, eps=eps, pp=pp, quant=quant,
+                          residual=residual),
         name="decode_attn_block",
         num_scalar_prefetch=2,
         grid=(B, pl.cdiv(MB, pp)),
@@ -317,7 +326,7 @@ def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
 # MLP-stage megakernel
 # ---------------------------------------------------------------------------
 def _mlp_block_kernel(x_ref, nw_ref, wg_ref, wu_ref, wd_ref, o_ref,
-                      h_scr, acc_scr, *, eps):
+                      h_scr, acc_scr, *, eps, residual):
     j = pl.program_id(0)
     dt = x_ref.dtype
 
@@ -342,7 +351,9 @@ def _mlp_block_kernel(x_ref, nw_ref, wg_ref, wu_ref, wd_ref, o_ref,
 
     @pl.when(j == pl.num_programs(0) - 1)
     def _fin():
-        o_ref[:] = x_ref[:] + acc_scr[:].astype(dt)
+        # residual=False: bare down-projection partial (see attn kernel)
+        o_ref[:] = (x_ref[:] + acc_scr[:].astype(dt)) if residual \
+            else acc_scr[:].astype(dt)
 
 
 _MLP_BLOCK_CANDIDATES = (512, 256, 1024, 2048)
@@ -388,12 +399,15 @@ def _mlp_fitting_candidates(B: int, D: int, F: int, itemsize: int,
 
 
 @no_x64
-def fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=1e-6, block_f=None):
+def fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=1e-6, block_f=None,
+                           residual=True):
     """Fused MLP stage of one decode block: RMSNorm + SwiGLU + residual.
 
     x: [B, D]; nw: [D] at x.dtype; wg/wu: [D, F]; wd: [F, D]. Tiled over
     F in ``block_f`` columns (autotuned, divisors of F) so only
     3*D*block_f weight elements are VMEM-resident per grid step.
+    ``residual=False`` returns the bare down-projection (tensor-parallel
+    partial — the caller all-reduces, then adds the residual).
     """
     B, D = x.shape
     F = wg.shape[1]
@@ -410,7 +424,8 @@ def fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=1e-6, block_f=None):
 
         def build(bf):
             return lambda *a: fused_mlp_block_pallas(*a, eps=eps,
-                                                     block_f=bf)
+                                                     block_f=bf,
+                                                     residual=residual)
 
         block_f = _tuned_pages(ck, cands, build, (x, nw, wg, wu, wd))
     bf = int(block_f)
@@ -423,7 +438,7 @@ def fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=1e-6, block_f=None):
 
     const = lambda j: (0, 0)                              # noqa: E731
     out = audited_pallas_call(
-        functools.partial(_mlp_block_kernel, eps=eps),
+        functools.partial(_mlp_block_kernel, eps=eps, residual=residual),
         name="decode_mlp_block",
         # the output block is revisited every intermediate tile (down-
         # projection accumulated in scratch, written at the last tile)
@@ -449,7 +464,8 @@ def fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=1e-6, block_f=None):
 # original ``_paged_decode_step`` math
 # ---------------------------------------------------------------------------
 def attn_block_ref(x, nw, wq, wk, wv, wo, sin, cos, k_pool, v_pool,
-                   block_tables, seq_lens, kv_scales=None, eps=1e-6):
+                   block_tables, seq_lens, kv_scales=None, eps=1e-6,
+                   residual=True):
     from .. import rms_norm as fused_rms_norm
     from ..paged_attention import (paged_attention_decode,
                                    paged_attention_decode_quant,
@@ -482,28 +498,37 @@ def attn_block_ref(x, nw, wq, wk, wv, wo, sin, cos, k_pool, v_pool,
                                      seq_lens, k_new, v_new, ksc, vsc)
         attn = paged_attention_decode_quant(
             q[:, 0], kp, vp, block_tables, seq_lens + 1, ksc, vsc)
-    x = x + attn.reshape(B, H * hd).astype(x.dtype) @ wo
-    return x, k_new, v_new
+    o = attn.reshape(B, H * hd).astype(x.dtype) @ wo
+    return (x + o if residual else o), k_new, v_new
 
 
-def mlp_block_ref(x, nw, wg, wu, wd, eps=1e-6):
+def mlp_block_ref(x, nw, wg, wu, wd, eps=1e-6, residual=True):
     from .. import rms_norm as fused_rms_norm, swiglu as fused_swiglu
 
     h = fused_rms_norm(x[:, None], nw, eps)[:, 0]
     ff = fused_swiglu(h @ wg, h @ wu)
-    return x + ff @ wd
+    o = ff @ wd
+    return x + o if residual else o
 
 
 # ---------------------------------------------------------------------------
 # registry: shape-class dispatch with the composition as fallback
 # ---------------------------------------------------------------------------
 def decode_meta_dims(B, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
-                     quant) -> dict:
+                     quant, tp=1) -> dict:
     """Static dispatch metadata from raw dims — the ONE builder of
     everything the ``supports`` predicates read. The serving/generate
     paths go through :func:`decode_meta`; eager sweeps (bench
     flash_tune) that have no model config call this directly, so their
-    dispatch cannot drift from the traced read sites."""
+    dispatch cannot drift from the traced read sites.
+
+    ``tp``: tensor-parallel degree. The tensor-parallel step builds the
+    meta from its PER-SHARD dims (H/KV/F here are the LOCAL head and
+    intermediate counts as seen inside shard_map), so the VMEM math in
+    the predicates is already local; ``tp`` rides alongside so a shard
+    of a tp=N mesh is a distinct shape class from a tp=1 model that
+    happens to share the local dims (their program caches must not
+    collide, and the dispatch report can say which it served)."""
     dtype = jnp.dtype(dtype)
     return {
         "B": int(B), "D": int(D), "H": int(H), "KV": int(KV),
@@ -511,6 +536,7 @@ def decode_meta_dims(B, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
         "dtype": str(dtype), "itemsize": int(dtype.itemsize),
         "pool_dtype": str(jnp.dtype(pool_dtype)),
         "quant": bool(quant), "interpret": bool(_interpret()),
+        "tp": int(tp),
         # the budget is a real dispatch input (it reshapes supports()
         # and the block_f candidate list), so it rides in the meta —
         # visible to the DISPATCH_KEY_GAP lint like every other key
@@ -518,14 +544,14 @@ def decode_meta_dims(B, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
     }
 
 
-def decode_meta(cfg, B, BS, MB, pool_dtype, quant) -> dict:
+def decode_meta(cfg, B, BS, MB, pool_dtype, quant, tp=1) -> dict:
     """Static dispatch metadata for one decode step — everything the
     ``supports`` predicates read. Built at trace time from static
     shapes only, so dispatch is deterministic per program."""
     return decode_meta_dims(B, cfg.hidden_size, cfg.num_attention_heads,
                             cfg.num_key_value_heads, cfg.head_dim,
                             cfg.intermediate_size, BS, MB, cfg.dtype,
-                            pool_dtype, quant)
+                            pool_dtype, quant, tp=tp)
 
 
 def _supports_attn(meta):
@@ -568,15 +594,16 @@ def _supports_mlp(meta):
 
 def _attn_pallas_variant(x, nw, wq, wk, wv, wo, sin, cos, k_pool,
                          v_pool, block_tables, seq_lens,
-                         kv_scales=None, eps=1e-6):
+                         kv_scales=None, eps=1e-6, residual=True):
     return fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
                                    k_pool, v_pool, block_tables,
                                    seq_lens, kv_scales=kv_scales,
-                                   eps=eps)
+                                   eps=eps, residual=residual)
 
 
-def _mlp_pallas_variant(x, nw, wg, wu, wd, eps=1e-6):
-    return fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=eps)
+def _mlp_pallas_variant(x, nw, wg, wu, wd, eps=1e-6, residual=True):
+    return fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=eps,
+                                  residual=residual)
 
 
 KERNELS.register("decode_attn_block", "pallas_fused",
@@ -590,13 +617,14 @@ KERNELS.register("decode_mlp_block", "pallas_fused", _mlp_pallas_variant,
 KERNELS.register("decode_mlp_block", "unfused", mlp_block_ref,
                  priority=0, tags=("serving",))
 # every decode_meta_dims key is either in the jitted decode program's
-# trace signature (the shape/dtype keys) or in generation.py's
-# _PAGED_CACHE route tuple / the engine's program key (pins, the VMEM
-# budget, the interpret override) — the registry lint holds supports()
-# to this declaration
+# trace signature (the shape/dtype keys; tp via the sharded local
+# shapes + the mesh baked into the shard_map'd program) or in
+# generation.py's _PAGED_CACHE route tuple / the engine's program key
+# (pins, the VMEM budget, the interpret override, the mesh) — the
+# registry lint holds supports() to this declaration
 _DECODE_KEY_FIELDS = ("B", "D", "H", "KV", "hd", "F", "BS", "MB",
                       "dtype", "pool_dtype", "quant", "interpret",
-                      "vmem_budget")
+                      "tp", "vmem_budget")
 _DECODE_KEY_COVERS = {"itemsize": "dtype"}
 KERNELS.declare_cache_key("decode_attn_block", _DECODE_KEY_FIELDS,
                           covers=_DECODE_KEY_COVERS)

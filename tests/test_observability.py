@@ -124,6 +124,31 @@ def test_metrics_schema_frozen_enabled(params):
         BASE_KEYS | OBS_KEYS | {"prefix_cache"}
 
 
+def test_metrics_schema_frozen_tp(params):
+    """Mesh'd engines extend the frozen schema by exactly "mesh"
+    (always) and "collectives" (observability on — the bound flight
+    recorder's structured sub-dict); the raw recorder counters must
+    never leak as top-level keys in either mode."""
+    from paddle_tpu.inference import ServingMesh
+    mesh = ServingMesh.make(tp=2, collective="psum")
+    eng = _engine(params, mesh=mesh)                 # disabled mode
+    _run_stream(eng)
+    m = eng.metrics()
+    assert set(m.keys()) == BASE_KEYS | {"mesh"}
+    assert set(m["mesh"].keys()) == {"axis", "tp", "collective"}
+    eng2 = _engine(params, mesh=mesh, observability=True)
+    _run_stream(eng2)
+    m2 = eng2.metrics()
+    assert set(m2.keys()) == BASE_KEYS | OBS_KEYS | {"mesh",
+                                                     "collectives"}
+    assert set(m2["collectives"].keys()) == {"calls", "bytes",
+                                             "latency_ms"}
+    assert set(m2["latency"].keys()) == LATENCY_KEYS
+    assert m2["collectives"]["calls"]["psum@tp"] > 0
+    for hist in m2["collectives"]["latency_ms"].values():
+        assert set(hist.keys()) == HIST_KEYS
+
+
 def test_gauges_sampled_each_step(params):
     eng = _engine(params, prefix_cache=True, observability=True)
     _run_stream(eng, n=3)

@@ -61,6 +61,19 @@ def test_demo_regression_fails_the_gate_in_process():
     assert "F64_PROMOTION" in codes and "CARRY_DTYPE_DRIFT" in codes
 
 
+def test_demo_tp_regression_fails_the_gate_in_process():
+    """The second injected regression (mismatched-mesh-axis sharded
+    decode body) must produce a NEW UNKNOWN_COLLECTIVE_AXIS finding vs
+    the committed baseline — the collective rule bites on a real
+    tensor-parallel serving program."""
+    from paddle_tpu.analysis import (audit_spec, diff_findings,
+                                     load_baseline)
+    from paddle_tpu.analysis.catalog import build_demo_tp_regression
+    rep = audit_spec(build_demo_tp_regression())
+    new, _ = diff_findings([rep], load_baseline(COMMITTED_BASELINE))
+    assert "UNKNOWN_COLLECTIVE_AXIS" in {f.code for f in new}
+
+
 # -- CLI contract (subprocess: canned single-program runs) --------------
 
 def test_cli_json_schema_and_baseline_diff(tmp_path):
@@ -104,3 +117,6 @@ def test_cli_nonzero_exit_on_injected_regression(tmp_path):
     assert r2.returncode == 2
     assert "GATE FAILED" in r2.stderr
     assert "F64_PROMOTION" in r2.stderr
+    # the second specimen: mismatched mesh axis on the real sharded
+    # serving decode body
+    assert "UNKNOWN_COLLECTIVE_AXIS" in r2.stderr

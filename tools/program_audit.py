@@ -69,7 +69,8 @@ def main(argv=None) -> int:
 
     from paddle_tpu.analysis.catalog import (CATALOG_PROGRAMS,
                                              build_catalog,
-                                             build_demo_regression)
+                                             build_demo_regression,
+                                             build_demo_tp_regression)
     if args.list:
         print("\n".join(CATALOG_PROGRAMS))
         return 0
@@ -123,7 +124,12 @@ def main(argv=None) -> int:
         print(f"[audit] {e}", file=sys.stderr)
         return 3
     if args.demo_regression:
+        # both injected specimens: the pre-fix AdamW (dtype rule) and
+        # the mismatched-mesh-axis sharded decode body (collective
+        # rule) — the gate must fail on each class, proving the rules
+        # bite on real programs
         specs.append(build_demo_regression())
+        specs.append(build_demo_tp_regression())
     reports = [audit_spec(s) for s in specs]
     doc = findings_to_json(reports)
 
