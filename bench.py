@@ -1014,6 +1014,138 @@ def bench_serving_tp():
             "arrival_rate_hz": rate}
 
 
+def bench_serving_disagg():
+    """Colocated vs DISAGGREGATED serving A/B on forced-host CPU
+    devices under a PREFILL-HEAVY Poisson mix (long prompts, short
+    decodes — the workload where one prefill chunk stalls every
+    in-flight decode slot on a colocated engine). Same arrival trace
+    through a colocated ServingEngine and a DisaggregatedEngine
+    (1-device prefill group + 1-device decode group by default); banks
+    greedy parity, TTFT/TPOT/decode_step_ms distributions for both
+    sides, the colocated DECODE-CONTENTION count (steps that ran a
+    prefill chunk while decode slots were live — each one a decode
+    stall the split removes), and the KV-handoff bytes/latency the
+    disaggregated side pays instead."""
+    from paddle_tpu.distributed.dryrun import resolve_devices
+
+    pre_tp = int(os.environ.get("BENCH_DISAGG_PREFILL_TP", "1"))
+    dec_tp = int(os.environ.get("BENCH_DISAGG_DECODE_TP", "1"))
+    coll = os.environ.get("BENCH_DISAGG_COLLECTIVE", "gather")
+    devices, _ = resolve_devices(max(pre_tp + dec_tp, 2),
+                                 force_cpu=True)
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.inference import (DisaggregatedEngine,
+                                      GenerationConfig, ServingEngine)
+    from paddle_tpu.models.llama import LlamaConfig, init_params
+
+    cap = int(os.environ.get("BENCH_DISAGG_CAPACITY", "4"))
+    R = int(os.environ.get("BENCH_DISAGG_REQUESTS", str(4 * cap)))
+    ctx = int(os.environ.get("BENCH_DISAGG_CTX", "96"))
+    gen_n = int(os.environ.get("BENCH_DISAGG_GEN", "12"))
+    rate = float(os.environ.get("BENCH_DISAGG_RATE_HZ", "16.0"))
+    hidden = int(os.environ.get("BENCH_DISAGG_HIDDEN", "128"))
+    layers = int(os.environ.get("BENCH_DISAGG_LAYERS", "4"))
+    cfg = LlamaConfig(vocab_size=8192, hidden_size=hidden,
+                      intermediate_size=hidden * 4,
+                      num_hidden_layers=layers,
+                      num_attention_heads=hidden // 32,
+                      num_key_value_heads=hidden // 32,
+                      max_position_embeddings=ctx + gen_n,
+                      dtype=jnp.float32, remat=False)
+    with jax.default_device(devices[0]):
+        params = init_params(cfg, jax.random.PRNGKey(0),
+                             dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, 8192, (R, ctx)).astype(np.int32)
+    gaps = rng.exponential(1.0 / rate, R)
+    gaps[0] = 0.0
+    arrivals = np.cumsum(gaps)
+    g = GenerationConfig(max_new_tokens=gen_n, greedy=True)
+    buckets = (32, ctx)
+
+    def run(make):
+        eng = make()
+        eng.submit(prompts[0], GenerationConfig(max_new_tokens=2,
+                                                greedy=True))
+        eng.drain()                  # compile outside the window
+        eng.reset_metrics()
+        t0, i, reqs = time.perf_counter(), 0, []
+        contended = 0
+        is_coloc = isinstance(eng, ServingEngine)
+        while i < R or not eng.idle:
+            now = time.perf_counter() - t0
+            while i < R and arrivals[i] <= now:
+                reqs.append(eng.submit(prompts[i], g))
+                i += 1
+            if is_coloc:
+                pc0 = eng.counters["prefill_chunks"]
+                ds0 = eng.counters["decode_steps"]
+                ran = eng.step()
+                # a step that ran BOTH a prefill chunk and a decode
+                # dispatch serialized the decode behind the chunk on
+                # the same chips: one counted decode stall
+                if (eng.counters["prefill_chunks"] > pc0
+                        and eng.counters["decode_steps"] > ds0):
+                    contended += 1
+            else:
+                ran = eng.step()
+            if not ran and i < R:
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+        wall = time.perf_counter() - t0
+        m = eng.metrics()
+        return m, wall, [r.output_ids for r in reqs], contended
+
+    def mk_coloc():
+        return ServingEngine(params, cfg, capacity=cap, block_size=16,
+                             max_seq_len=ctx + gen_n,
+                             prefill_buckets=buckets,
+                             observability=True)
+
+    def mk_disagg():
+        return DisaggregatedEngine(
+            params, cfg, prefill_devices=devices[:pre_tp],
+            decode_devices=devices[pre_tp:pre_tp + dec_tp],
+            collective=coll, capacity=cap, prefill_slots=2,
+            block_size=16, max_seq_len=ctx + gen_n,
+            prefill_buckets=buckets, observability=True)
+
+    coloc_m, coloc_wall, coloc_out, contended = run(mk_coloc)
+    dis_m, dis_wall, dis_out, _ = run(mk_disagg)
+    matches = [bool(np.array_equal(a, b))
+               for a, b in zip(coloc_out, dis_out)]
+    dec = dis_m["groups"]["decode"]
+    side = lambda m, w: {                                # noqa: E731
+        "tokens_per_sec": round(R * gen_n / w, 1),
+        "ttft_ms": m["latency"]["ttft_ms"],
+        "tpot_ms": m["latency"]["tpot_ms"]}
+    return {"metric": "serving_disagg_greedy_parity",
+            "value": round(sum(matches) / max(len(matches), 1), 4),
+            "unit": "fraction of requests with identical greedy output",
+            "platform": "forced-host-cpu (structure evidence, not "
+                        "chip perf)",
+            "colocated": {**side(coloc_m, coloc_wall),
+                          "decode_step_ms":
+                              coloc_m["latency"]["decode_step_ms"],
+                          "decode_contended_steps": contended,
+                          "decode_steps": coloc_m["decode_steps"]},
+            "disaggregated": {
+                **side(dis_m, dis_wall),
+                "decode_step_ms":
+                    dec["latency"]["decode_step_ms"],
+                "decode_steps": dec["decode_steps"],
+                "handoffs": dis_m["handoffs"],
+                "handoff_ms": dis_m["latency"]["handoff_ms"],
+                "kv_bytes_transferred": dis_m["kv_bytes_transferred"],
+                "handoff_traces": dis_m["handoff_traces"],
+                "retrace_warnings": dis_m["retrace_warnings"]},
+            "prefill_tp": pre_tp, "decode_tp": dec_tp,
+            "collective": coll,
+            "requests": R, "capacity": cap, "ctx": ctx, "gen": gen_n,
+            "arrival_rate_hz": rate}
+
+
 def bench_sd_unet(steps=8, batch=4):
     """BASELINE config 6: Stable-Diffusion-class UNet denoise step,
     compiled (SD-1.x geometry at 64x64 latents)."""
@@ -1807,6 +1939,7 @@ CONFIGS = {
     "serving_engine": bench_serving_engine,
     "serving_prefix_cache": bench_serving_prefix_cache,
     "serving_tp": bench_serving_tp,
+    "serving_disagg": bench_serving_disagg,
     "sd_unet": bench_sd_unet,
     "kernels": bench_kernels,
 }
@@ -2167,7 +2300,7 @@ def _merge_opportunistic(out):
     for k in ("llama", "kernels", "ernie_infer", "sd_unet", "bert",
               "resnet_breakdown", "llama_breakdown", "ppyoloe",
               "llama_ladder", "paged_decode", "serving_engine",
-              "serving_prefix_cache", "serving_tp"):
+              "serving_prefix_cache", "serving_tp", "serving_disagg"):
         live = out.get(k)
         stale_live = not isinstance(live, dict) or "error" in live
         cap = opp.get(k)
@@ -2261,7 +2394,7 @@ def main():
         extra_t = int(os.environ.get("BENCH_EXTRA_TIMEOUT", "900"))
         for name in ("kernels", "ernie_infer", "paged_decode",
                      "serving_engine", "serving_prefix_cache",
-                     "serving_tp", "sd_unet", "bert",
+                     "serving_tp", "serving_disagg", "sd_unet", "bert",
                      "resnet_breakdown", "ppyoloe", "llama_ladder"):
             if name == "kernels":
                 _kernel_audit(out)   # pre-window geometry audit

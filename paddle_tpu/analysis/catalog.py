@@ -32,6 +32,8 @@ CATALOG_PROGRAMS = ("train_step", "train_step_fused",
                     "serving_prefill_16", "serving_prefill_32",
                     "serving_page_copy",
                     "serving_decode_tp", "serving_prefill_tp_16",
+                    "disagg_decode", "disagg_prefill_16",
+                    "disagg_kv_extract", "disagg_kv_insert",
                     "collectives")
 
 
@@ -190,6 +192,36 @@ def _serving_tp_specs(register: bool):
     return specs
 
 
+def _serving_disagg_specs(register: bool):
+    """The disaggregated engine's programs: the decode group's decode
+    step, the prefill group's bucketed prefill, and the KV-page
+    handoff pair (extract on the prefill pools, donated insert into
+    the decode pools). Built over 1-device groups — two devices where
+    the environment has them, the single-device overlap fallback
+    otherwise — so the gate list never shrinks (the ``_catalog_tp``
+    idiom)."""
+    import jax
+    from ..inference.disagg import DisaggregatedEngine
+    from ..models.llama import init_params
+
+    cfg = _tp_cfg()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    devs = jax.devices()
+    eng = DisaggregatedEngine(
+        params, cfg, prefill_devices=devs[:1],
+        decode_devices=devs[1:2] or devs[:1],
+        capacity=2, prefill_slots=1, block_size=8, max_seq_len=64,
+        prefill_buckets=(16,))
+    specs = [s for s in eng.program_specs(register=False)
+             if s.name in ("disagg_decode", "disagg_prefill_16",
+                           "disagg_kv_extract", "disagg_kv_insert")]
+    if register:
+        from .registry import REGISTRY
+        for s in specs:
+            REGISTRY.register(s)
+    return specs
+
+
 def _collectives_spec(register: bool):
     """A representative multichip program: shard_map over the full
     device set with the collective families the flight recorder's op
@@ -252,6 +284,10 @@ def build_catalog(names: Optional[List[str]] = None,
                      if s.name in wanted)
     if wanted & {"serving_decode_tp", "serving_prefill_tp_16"}:
         specs.extend(s for s in _serving_tp_specs(register)
+                     if s.name in wanted)
+    if wanted & {"disagg_decode", "disagg_prefill_16",
+                 "disagg_kv_extract", "disagg_kv_insert"}:
+        specs.extend(s for s in _serving_disagg_specs(register)
                      if s.name in wanted)
     if "collectives" in wanted:
         specs.append(_collectives_spec(register))

@@ -141,6 +141,38 @@ def _adamw_update(grads, state: Tuple, lr, b1=0.9, b2=0.95, eps=1e-8,
     return (params_n, master_n, mu_n, nu_n, step), gnorm
 
 
+def _sharding_cache_key(v):
+    """Hashable EQUIVALENCE key for a leaf's sharding. NamedSharding
+    __eq__ is syntactic — on any mesh, ``P()`` vs ``P(None,)`` vs a
+    spec naming only SIZE-1 axes all place the array identically, and
+    XLA output shardings routinely flip between those spellings. Keyed
+    raw they would recompile a semantically identical program (a
+    1-device mesh would pay a spurious step-2 compile); so the key
+    drops size-1 mesh axes from the spec and trailing replicated dims,
+    keeping only partitions that move bytes."""
+    sh = getattr(v, "sharding", None)
+    mk = getattr(sh, "memory_kind", None)
+    if not isinstance(sh, NamedSharding):
+        if sh is not None and len(sh.device_set) == 1:
+            # a fresh uncommitted array (SingleDeviceSharding) and a
+            # replicated NamedSharding over a 1-device mesh place the
+            # bytes identically — same key, no spurious recompile
+            return ("single", frozenset(sh.device_set), mk)
+        return sh
+    mesh_shape = sh.mesh.shape
+    spec = []
+    for entry in sh.spec:
+        names = (() if entry is None
+                 else entry if isinstance(entry, tuple) else (entry,))
+        names = tuple(n for n in names if mesh_shape[n] > 1)
+        spec.append(names or None)
+    while spec and spec[-1] is None:
+        spec.pop()
+    if not spec and len(sh.device_set) == 1:
+        return ("single", frozenset(sh.device_set), mk)
+    return ("named", tuple(sorted(mesh_shape.items())), tuple(spec), mk)
+
+
 class Trainer:
     def __init__(self, loss_fn: Callable, mesh: Mesh,
                  param_specs, data_spec=P(("dp", "fsdp"), "sp"),
@@ -217,6 +249,7 @@ class Trainer:
             self._gap = HostGapDetector(factor=host_gap_factor,
                                         min_wall_ms=host_gap_min_ms)
             self._compiled_cache: Dict = {}
+            self._aot_fallback = False
         else:
             self._obs = None
             self._compile = None
@@ -520,12 +553,22 @@ class Trainer:
         the serving retrace watchdog. Returns ``(fn, compile_ms)`` so
         the caller can attribute compile time to its own histogram
         instead of the dispatch phase. The key hashes (treedef, shape,
-        dtype object) — dtype objects, not strings: re-stringifying
-        every leaf of a large param tree per step would be unattributed
-        host overhead in exactly the layer built to surface it."""
+        dtype object, sharding) — dtype objects, not strings:
+        re-stringifying every leaf of a large param tree per step would
+        be unattributed host overhead in exactly the layer built to
+        surface it. The SHARDING must be in the key: on a multi-device
+        mesh GSPMD propagation may re-shard state leaves in the step-1
+        OUTPUT (norm weights, gate/up_proj), and an executable compiled
+        for the step-0 shardings rejects the changed inputs at step 2
+        ("input sharding(s) does not match") where plain jit reshards
+        silently. Keyed on sharding, step 2 is a cache miss and
+        ``lower()`` carries the COMMITTED shardings in — one extra
+        warmup compile, then a stable program (GSPMD reaches its fixed
+        point at the propagated layout)."""
         leaves, treedef = jax.tree_util.tree_flatten((tree, lr) + staged)
         key = (treedef,
-               tuple((getattr(v, "shape", ()), getattr(v, "dtype", None))
+               tuple((getattr(v, "shape", ()), getattr(v, "dtype", None),
+                      _sharding_cache_key(v))
                      for v in leaves))
         fn = self._compiled_cache.get(key)
         if fn is not None:
@@ -572,9 +615,37 @@ class Trainer:
             self._lr_cache = (self.lr, jnp.float32(self.lr))
         tree = state.tree()
         with self.mesh:
-            fn, compile_ms = self._compiled_for(
-                tree, self._lr_cache[1], staged)
-            new_tree, metrics = fn(tree, self._lr_cache[1], *staged)
+            if self._aot_fallback:
+                # a previous sharding mismatch demoted this trainer to
+                # the plain jit path (one-time warning below): same
+                # program, jit reshards silently; compile telemetry is
+                # whatever the watcher recorded before the demotion
+                compile_ms = 0.0
+                new_tree, metrics = self._step_fn(
+                    tree, self._lr_cache[1], *staged)
+            else:
+                fn, compile_ms = self._compiled_for(
+                    tree, self._lr_cache[1], staged)
+                try:
+                    new_tree, metrics = fn(tree, self._lr_cache[1],
+                                           *staged)
+                except ValueError as e:
+                    # the sharding-aware cache key above should make
+                    # this unreachable; if a backend still rejects the
+                    # committed shardings, degrade to the jit path
+                    # cleanly instead of killing the train loop
+                    if "sharding" not in str(e):
+                        raise
+                    import warnings
+                    warnings.warn(
+                        "observed train step: AOT executable rejected "
+                        f"the committed input shardings ({e}); falling "
+                        "back to the plain jit path for this trainer "
+                        "(phase timings stay, compile telemetry "
+                        "freezes)", RuntimeWarning, stacklevel=2)
+                    self._aot_fallback = True
+                    new_tree, metrics = self._step_fn(
+                        tree, self._lr_cache[1], *staged)
         t_disp = obs.now()
         jax.block_until_ready(metrics)
         t_sync = obs.now()

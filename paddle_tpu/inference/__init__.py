@@ -9,6 +9,8 @@ from .generation import (GenerationConfig, generate, generate_paged,
 from .serving import Request, ServingEngine
 from .prefix_cache import PrefixCache, PagedKVCacheStore
 from .tp import ServingMesh
+from .admission import AdmissionQueue
+from .disagg import DisaggregatedEngine
 
 __all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
            "DataType", "PlaceType", "PrecisionType", "PredictorPool",
@@ -17,7 +19,8 @@ __all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
            "convert_to_mixed_precision",
            "generate", "generate_paged", "cached_forward", "init_cache",
            "sample_token", "Request", "ServingEngine", "ServingMesh",
-           "PrefixCache", "PagedKVCacheStore"]
+           "PrefixCache", "PagedKVCacheStore", "AdmissionQueue",
+           "DisaggregatedEngine"]
 
 
 class DataType:

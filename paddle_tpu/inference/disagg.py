@@ -1,0 +1,634 @@
+"""Disaggregated prefill/decode serving: split chip groups with
+KV-page handoff.
+
+PR 9 sharded the serving programs over a mesh, but prefill chunks and
+decode steps still interleave on the SAME chips: every ``step()`` runs
+one prefill chunk ahead of the decode dispatch, so one long prompt
+stalls every in-flight decode slot behind a multi-hundred-ms chunk —
+the classic TPOT-spike failure mode (the per-step sync point makes the
+contention visible as inflated ``decode_step_ms``). Disaggregated
+serving removes it structurally, the way the paper's reference stack
+separates scheduling from execution (fleet executor / predictor split)
+and ClusterFusion++ (PAPERS.md) keeps the decode chips on their fused
+hot loop uninterrupted:
+
+- a **prefill group** and a **decode group** — disjoint device sets,
+  each a :class:`~paddle_tpu.inference.tp.ServingMesh` (tp >= 1) —
+  each run their OWN compiled programs over their OWN paged KV pools.
+  The prefill group runs only bucketed chunked prefill (plus int8
+  calibration and the radix prefix cache); the decode group runs only
+  the single jitted decode-step program.
+- a finished prefill hands its KV pages to the decode group through a
+  jitted **page-handoff** pair: ``extract`` gathers the request's
+  pages from the prefill pools into a fixed-width page block (padded
+  page indices read the scratch page, so ONE trace covers every
+  request size), ``jax.device_put`` moves the block onto the decode
+  group's sharding (device-to-device copy over ICI/DCN on real
+  multi-chip; the same code path runs on forced-host CPU devices in
+  tier-1), and ``insert`` scatters it into the decode pools — donated,
+  so the decode pools update in place. **Page-table translation is
+  host-side**: each group's ``BlockManager`` owns its own physical
+  page numbering, the handoff allocates decode-side pages and writes
+  the translated table, and the prefill side releases its pages (the
+  radix prefix cache keeps its refcounted copies, so warm admissions
+  keep working on the prefill side).
+- **SLO-aware admission** (inference/admission.py) is shared with the
+  colocated engine: priority classes + per-request deadlines on
+  ``submit()``, a priority queue with aging replacing FIFO, and
+  preemption/requeue of decode slots under pressure — a victim keeps
+  its KV pages and its decode carry, so the resumed decode stream is
+  bit-identical to the un-preempted run.
+
+Greedy parity: the prefill group runs the exact prefill math of the
+colocated engine and the decode group the exact decode math; the
+handoff moves raw page bytes. With single-device groups (or the
+``"gather"`` collective placement) greedy output is therefore
+BIT-identical to the colocated ``ServingEngine`` — asserted in tier-1
+over mixed-arrival streams including the prefix-cache warm path and
+int8 pools. Steady state is zero retraces per group: 1 decode program,
+<=1 prefill program per bucket, plus the two handoff programs traced
+once each.
+
+Observability: both workers share the DisaggregatedEngine's timeline
+ring and request-record log, handoff latency/bytes feed a bound flight
+recorder (``kv_handoff@xfer``) plus the ``handoff_ms`` histogram, and
+``metrics()`` composes the scheduler report (per-class queue wait, SLO
+attainment, preemptions) with both groups' full engine metrics.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..observability import Observability
+from .generation import GenerationConfig
+from .serving import (Request, ServingEngine, _collectives_snapshot,
+                      _drain_loop)
+from .tp import ServingMesh, normalize_mesh
+
+__all__ = ["DisaggregatedEngine"]
+
+# the engine-level latency set: request-level distributions (fed by
+# whichever worker finishes/admits the request — the histogram objects
+# are SHARED with both workers' registries) plus what only the
+# orchestrator can time (handoff, whole-engine step)
+DISAGG_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "e2e_ms",
+                     "handoff_ms", "step_ms")
+# the sub-set shared by reference with the workers' registries
+_SHARED_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "e2e_ms")
+
+
+class _PrefillWorker(ServingEngine):
+    """The prefill-group half: a ServingEngine that allocates KV pages
+    for the PROMPT only and, instead of transitioning a completed
+    prefill into a decode slot, vacates the slot (pages stay attached)
+    and hands the request to the DisaggregatedEngine's handoff queue.
+    Requests that finish during prefill (EOS first token, single-token
+    budget) complete here and never touch the decode group."""
+
+    def __init__(self, *args, on_complete=None, **kw):
+        self._on_complete_cb = on_complete
+        super().__init__(*args, **kw)
+
+    def _alloc_tokens(self, req: Request) -> int:
+        return int(req.prompt.size)     # generation lives elsewhere
+
+    def _on_prefill_complete(self, slot_id: int, first: int):
+        slot = self._slots[slot_id]
+        req = slot.req
+        if (first == req.gen.eos_token_id
+                or req.gen.max_new_tokens <= 1):
+            self._finish(slot_id)       # done entirely on this group
+            self._on_complete_cb(req, None)
+            return
+        pages = list(self.mgr.tables.get(req.req_id, ()))
+        # vacate the slot but KEEP the pages attached — the handoff
+        # owns their transfer to the decode group and their release
+        self._clear_slot(slot_id)
+        self._on_complete_cb(req, pages)
+
+
+class DisaggregatedEngine:
+    """Prefill/decode-disaggregated serving over two chip groups.
+
+    Construction (one of):
+
+    - ``prefill_devices=[...], decode_devices=[...]`` — explicit
+      device lists (each becomes a tp=len(list) ServingMesh);
+    - ``mesh=ServingMesh(...)`` (or a 1-D jax Mesh, or an int device
+      count) + ``prefill_tp=k`` — the mesh's devices split into the
+      first ``k`` (prefill) and the rest (decode);
+    - neither — all visible devices split at ``prefill_tp``. A
+      single-device environment falls back to both groups sharing the
+      one device (programs and handoff identical in structure; only
+      the physical overlap differs), so audits and catalogs build the
+      same program set everywhere.
+
+    ``submit()/step()/drain()/metrics()`` mirror the colocated
+    :class:`ServingEngine` contract; ``priority``/``deadline_s`` ride
+    per request (inference/admission.py semantics).
+    """
+
+    def __init__(self, params, cfg, prefill_devices=None,
+                 decode_devices=None, mesh=None, prefill_tp: int = 1,
+                 collective: str = "psum",
+                 capacity: int = 4, prefill_slots: int = 2,
+                 block_size: int = 16,
+                 num_blocks: Optional[int] = None,
+                 prefill_num_blocks: Optional[int] = None,
+                 max_seq_len: Optional[int] = None, cache_dtype=None,
+                 prefill_buckets=(32, 128), seed: int = 0,
+                 prefix_cache: bool = False, observability=False,
+                 fused_decode=None, aging_s: Optional[float] = None):
+        pre_mesh, dec_mesh = self._resolve_groups(
+            prefill_devices, decode_devices, mesh, prefill_tp,
+            collective)
+        self.cfg = cfg
+        self.counters = {
+            "handoffs": 0, "handoff_traces": 0,
+            "kv_bytes_transferred": 0, "requests_submitted": 0,
+            "drain_truncations": 0,
+        }
+        if observability:
+            self._obs = (observability
+                         if isinstance(observability, Observability)
+                         else Observability(histograms=DISAGG_HISTOGRAMS))
+            self._obs.registry.adopt_counters(self.counters)
+            pre_obs: object = Observability()
+            dec_obs: object = Observability()
+        else:
+            self._obs = None
+            pre_obs = dec_obs = False
+        self._flight = None
+        if self._obs is not None:
+            from ..distributed.flight_recorder import FlightRecorder
+            rec = FlightRecorder(capacity=4096)
+            rec.enabled = True
+            self._flight = self._obs.bind_flight_recorder(rec)
+
+        BS = int(block_size)
+        msl = int(max_seq_len or cfg.max_position_embeddings)
+        if prefill_num_blocks is None:
+            # prompt pages for every prefill slot PLUS slack for pages
+            # parked in the handoff queue while the decode pool pushes
+            # back (vacated prefill slots keep refilling)
+            prefill_num_blocks = \
+                (int(prefill_slots) + int(capacity)) * (-(-msl // BS)) + 1
+        self.prefill = _PrefillWorker(
+            params, cfg, capacity=prefill_slots, block_size=BS,
+            num_blocks=prefill_num_blocks, max_seq_len=msl,
+            cache_dtype=cache_dtype, prefill_buckets=prefill_buckets,
+            seed=seed, prefix_cache=prefix_cache, observability=pre_obs,
+            fused_decode=False, mesh=pre_mesh, aging_s=aging_s,
+            on_complete=self._on_prefilled)
+        self.decode = ServingEngine(
+            params, cfg, capacity=capacity, block_size=BS,
+            num_blocks=num_blocks, max_seq_len=msl,
+            cache_dtype=cache_dtype, prefill_buckets=prefill_buckets,
+            seed=seed + 1, prefix_cache=False, observability=dec_obs,
+            fused_decode=fused_decode, mesh=dec_mesh, aging_s=aging_s)
+        if self._obs is not None:
+            # one timeline ring + one request-record log for the whole
+            # engine: both workers' events (submit/admit/prefill_chunk/
+            # first_token/decode_step/preempt/resume/finish) interleave
+            # with the orchestrator's handoff events, so one JSONL
+            # export describes the full request lifecycle
+            self.prefill._obs.timeline = self._obs.timeline
+            self.decode._obs.timeline = self._obs.timeline
+            self.prefill._obs.request_records = self._obs.request_records
+            self.decode._obs.request_records = self._obs.request_records
+            self._share_histograms()
+
+        self.block_size = BS
+        self.max_seq_len = msl
+        self.capacity = int(capacity)
+        self.prefill_slots = int(prefill_slots)
+        self._quant = self.decode._quant
+        # fixed handoff width = the largest prompt's page count; padded
+        # entries index scratch page 0 on both sides, so ONE trace of
+        # each handoff program covers every request size
+        self._xfer_w = -(-msl // BS)
+        self._extract_fn = None
+        self._insert_fn = None
+        self._handoffs: Deque[Tuple[Request, List[int]]] = deque()
+        self._requests: List[Request] = []
+        self._hand_stats = [0, 0.0, 0.0]    # count, sum_ms, max_ms
+        self._t_first = self._t_last = None
+        self._metrics_reset_t = None
+        self.last_drain_truncated = False
+
+    # -- group resolution ---------------------------------------------
+    @staticmethod
+    def _resolve_groups(prefill_devices, decode_devices, mesh,
+                        prefill_tp, collective):
+        if prefill_devices is not None or decode_devices is not None:
+            if not prefill_devices or not decode_devices:
+                raise ValueError(
+                    "explicit groups need BOTH prefill_devices and "
+                    "decode_devices non-empty")
+            mk = lambda d: ServingMesh.make(          # noqa: E731
+                tp=len(d), collective=collective, devices=list(d))
+            return mk(prefill_devices), mk(decode_devices)
+        if isinstance(mesh, int):
+            mesh = ServingMesh.make(tp=mesh, collective=collective)
+        sm = normalize_mesh(mesh)
+        if sm is None:
+            devs = jax.devices()
+            if len(devs) < 2:
+                # single-device fallback: both groups share the one
+                # device — program structure and the handoff path are
+                # identical, so audits/catalogs build everywhere
+                one = ServingMesh.make(tp=1, collective=collective,
+                                       devices=devs)
+                return one, one
+            sm = ServingMesh.make(tp=len(devs), collective=collective,
+                                  devices=devs)
+        return sm.split(prefill_tp)
+
+    # -- public API ---------------------------------------------------
+    def submit(self, prompt, gen: Optional[GenerationConfig] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Request:
+        """Enqueue one request on the prefill group (the decode group
+        admits it via KV handoff once its prompt is prefilled)."""
+        gen = gen or GenerationConfig()
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size >= 1:
+            total = int(prompt.size) + int(gen.max_new_tokens)
+            need = -(-total // self.decode.block_size)
+            if need > self.decode.num_blocks - 1:
+                raise ValueError(
+                    f"request needs {need} KV pages but the DECODE "
+                    f"group's pool only has {self.decode.num_blocks - 1}"
+                    "; raise num_blocks")
+        req = self.prefill.submit(prompt, gen, priority=priority,
+                                  deadline_s=deadline_s)
+        self._requests.append(req)
+        self.counters["requests_submitted"] += 1
+        return req
+
+    def step(self) -> bool:
+        """One orchestrator iteration: drain ready handoffs into the
+        decode group, then one prefill-group step (admission + one
+        chunk) and one decode-group step (resume admission + one decode
+        step over all live slots) — the two groups' device work streams
+        run concurrently, which is the whole point."""
+        obs = self._obs
+        t0 = time.perf_counter() if obs is not None else 0.0
+        if self._t_first is None:
+            self._t_first = time.perf_counter()
+        did = self._run_handoffs()
+        did = self.prefill.step() or did
+        did = self.decode.step() or did
+        if did:
+            self._t_last = time.perf_counter()
+            if obs is not None:
+                obs.hist("step_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
+        return did
+
+    @property
+    def idle(self) -> bool:
+        return (not self._handoffs and self.prefill.idle
+                and self.decode.idle)
+
+    def drain(self, max_steps: Optional[int] = None) -> int:
+        """Step until both groups and the handoff queue are empty
+        (the shared :func:`_drain_loop` semantics: capped drains record
+        truncation; starvation raises after a stall dump)."""
+        return _drain_loop(
+            self, max_steps,
+            starve_reason="disaggregated drain starved: pending work "
+                          "cannot progress",
+            starve_error="disaggregated engine starved: pending "
+                         "requests cannot be admitted or handed off "
+                         "(KV pools too small for the in-flight mix?)")
+
+    def _drain_truncated_event(self, n: int):
+        if self._obs is not None:
+            self._obs.timeline.record(
+                "drain_truncated", steps=n,
+                handoff_queue_depth=len(self._handoffs))
+
+    # -- handoff ------------------------------------------------------
+    def _on_prefilled(self, req: Request, pages: Optional[List[int]]):
+        if pages is None:
+            return          # finished on the prefill group
+        self._handoffs.append((req, pages))
+
+    def _run_handoffs(self) -> bool:
+        did = False
+        while self._handoffs:
+            req, pages = self._handoffs[0]
+            need = -(-(int(req.prompt.size)
+                       + int(req.gen.max_new_tokens))
+                     // self.decode.block_size)
+            if len(self.decode.mgr.free) < need:
+                break       # decode-pool backpressure: finish frees
+            self._handoffs.popleft()
+            self._transfer(req, pages)
+            did = True
+        return did
+
+    def _build_handoff_fns(self):
+        """The jitted page-handoff pair. ``extract`` gathers a fixed-
+        width block of pages from the prefill pools; ``insert``
+        scatters it into the decode pools (donated — the pools update
+        in place). Padded index entries point at scratch page 0 on
+        both sides: the extra reads copy scratch bytes, the extra
+        writes land in a page no live sequence ever reads — so one
+        trace each covers every request size (the slot-table padding
+        idiom)."""
+        counters = self.counters
+
+        def extract(kp, vp, idx):
+            counters["handoff_traces"] += 1
+            return (jnp.take(kp, idx, axis=1),
+                    jnp.take(vp, idx, axis=1))
+
+        def insert(kp, vp, idx, kpag, vpag):
+            counters["handoff_traces"] += 1
+            return (kp.at[:, idx].set(kpag), vp.at[:, idx].set(vpag))
+
+        return (jax.jit(extract),
+                jax.jit(insert, donate_argnums=(0, 1)))
+
+    def _sync_scales(self):
+        """Copy the prefill group's one-shot int8 calibration onto the
+        decode group (before its decode program first traces, so the
+        program closes over the final scale arrays) — the engine-global
+        static-scale contract, now spanning two pools."""
+        dm = self.decode._mesh
+        self.decode._kv_scales = tuple(
+            dm.shard(jnp.asarray(np.asarray(s)), dm.scale_spec)
+            for s in self.prefill._kv_scales)
+
+    def _transfer(self, req: Request, src_pages: List[int]):
+        """Move one finished prefill's KV pages to the decode group:
+        extract -> device_put -> insert, then host-side page-table
+        translation (decode-side allocation came first so the dst
+        indices exist) and a resume entry into the decode group's
+        admission queue."""
+        pre, dec = self.prefill, self.decode
+        if self._extract_fn is None:
+            self._extract_fn, self._insert_fn = self._build_handoff_fns()
+        if self._quant and dec._kv_scales is None:
+            self._sync_scales()
+        t0 = time.perf_counter()
+        S = int(req.prompt.size)
+        n_src = len(src_pages)
+        total = S + int(req.gen.max_new_tokens)
+        # decode-side allocation IS the page-table translation: the
+        # request's table on this group is a fresh set of physical
+        # pages; the first len(src_pages) receive the prompt's KV, the
+        # rest are decode headroom
+        dst_table = dec.mgr.allocate(req.req_id, total)
+        W = self._xfer_w
+        src_idx = np.zeros((W,), np.int32)
+        dst_idx = np.zeros((W,), np.int32)
+        src_idx[:n_src] = src_pages
+        dst_idx[:n_src] = dst_table[:n_src]
+        cfgv = self.cfg
+        L, KV, hd = (cfgv.num_hidden_layers,
+                     cfgv.num_key_value_heads, cfgv.head_dim)
+        BS = self.block_size
+        itemsize = jnp.dtype(pre._k_pools.dtype).itemsize
+        nbytes = 2 * L * n_src * BS * KV * hd * itemsize
+        task = None
+        if self._flight is not None:
+            task = self._flight.begin(
+                "kv_handoff", "xfer", (2 * L, n_src * BS, KV * hd),
+                str(jnp.dtype(pre._k_pools.dtype)))
+        kpag, vpag = self._extract_fn(pre._k_pools, pre._v_pools,
+                                      pre._mesh.replicate(src_idx))
+        t1 = time.perf_counter()
+        sh = dec._mesh.sharding(dec._mesh.pool_spec)
+        kpag = jax.device_put(kpag, sh)
+        vpag = jax.device_put(vpag, sh)
+        t2 = time.perf_counter()
+        dec._k_pools, dec._v_pools = self._insert_fn(
+            dec._k_pools, dec._v_pools, dec._mesh.replicate(dst_idx),
+            kpag, vpag)
+        t3 = time.perf_counter()
+        if task is not None:
+            self._flight.end(task)
+        # prefill-side release: the radix tree's refcounted shares
+        # survive (warm prefix matches keep hitting on this group)
+        pre.mgr.release(req.req_id)
+        # resume entry for the decode group: carry = (prompt length,
+        # first sampled token) — exactly the colocated engine's
+        # decode-entry state, so generation continues bit-identically.
+        # started=True: the admission SLO was met at prefill admission
+        req.resume = (S, int(req.tokens[-1]))
+        req.qentry = dec._queue.push(req, cls=req.priority,
+                                     submit_t=req.submit_t,
+                                     started=True)
+        dur_ms = (t3 - t0) * 1e3
+        self.counters["handoffs"] += 1
+        self.counters["kv_bytes_transferred"] += nbytes
+        st = self._hand_stats
+        st[0] += 1
+        st[1] += dur_ms
+        st[2] = max(st[2], dur_ms)
+        if self._obs is not None:
+            self._obs.hist("handoff_ms").observe(dur_ms)
+            self._obs.timeline.record(
+                "handoff", req.req_id, dur_ms=dur_ms, pages=n_src,
+                bytes=nbytes,
+                extract_ms=round((t1 - t0) * 1e3, 3),
+                put_ms=round((t2 - t1) * 1e3, 3),
+                insert_ms=round((t3 - t2) * 1e3, 3))
+
+    # -- reporting ----------------------------------------------------
+    def scheduler_snapshot(self) -> Dict:
+        return {"handoff_queue_depth": len(self._handoffs),
+                "handoffs_pending": [r.req_id
+                                     for r, _ in list(self._handoffs)[:16]],
+                "prefill": self.prefill.scheduler_snapshot(),
+                "decode": self.decode.scheduler_snapshot()}
+
+    def metrics(self) -> Dict:
+        c = {k: v for k, v in self.counters.items()
+             if k not in ("collective_calls", "collective_bytes")}
+        pre_c, dec_c = self.prefill.counters, self.decode.counters
+        wall = ((self._t_last - self._t_first)
+                if self._t_first is not None
+                and self._t_last is not None else 0.0)
+        c["wall_time_s"] = round(wall, 6)
+        gen_tokens = (pre_c["tokens_generated"]
+                      + dec_c["tokens_generated"])
+        c["tokens_generated"] = gen_tokens
+        c["tokens_per_sec"] = (round(gen_tokens / wall, 3)
+                               if wall > 0 else 0.0)
+        c["requests_completed"] = (pre_c["requests_completed"]
+                                   + dec_c["requests_completed"])
+        cut = self._metrics_reset_t
+        ttfts = [r.ttft for r in self._requests
+                 if r.ttft is not None
+                 and (cut is None or (r.first_token_t or 0.0) >= cut)]
+        c["ttft_ms_mean"] = (round(float(np.mean(ttfts)) * 1e3, 3)
+                             if ttfts else None)
+        c["ttft_ms_max"] = (round(float(np.max(ttfts)) * 1e3, 3)
+                            if ttfts else None)
+        n, s, mx = self._hand_stats
+        c["handoff_ms_mean"] = round(s / n, 3) if n else None
+        c["handoff_ms_max"] = round(mx, 3) if n else None
+        sched = self.prefill._scheduler_metrics()
+        sched["preemptions"] = dec_c["preemptions"]
+        sched["requeues"] = dec_c["requeues"]
+        sched["deadline_expired"] = pre_c["deadline_expired"]
+        sched["handoff_queue_depth"] = len(self._handoffs)
+        c["scheduler"] = sched
+        c["groups"] = {"prefill": self.prefill.metrics(),
+                       "decode": self.decode.metrics()}
+        if self._obs is not None:
+            obs = self._obs
+            c["latency"] = obs.latency_snapshot()
+            c["retrace_warnings"] = (
+                len(self.prefill._obs.watchdog.events)
+                + len(self.decode._obs.watchdog.events))
+            c["stall_dumps"] = (len(obs.stall_dumps)
+                                + obs.stall_dumps_suppressed)
+            c["timeline_events"] = len(obs.timeline)
+            c["timeline_dropped"] = obs.timeline.dropped
+            if self._flight is not None:
+                c["collectives"] = _collectives_snapshot(self.counters,
+                                                         obs)
+        return c
+
+    def reset_metrics(self):
+        """Restart the measurement window on the orchestrator AND both
+        groups (each group's retrace watchdog arms; the handoff trace
+        counter is cumulative like every trace counter)."""
+        for k in ("handoffs", "kv_bytes_transferred",
+                  "requests_submitted", "drain_truncations"):
+            self.counters[k] = 0
+        self._hand_stats = [0, 0.0, 0.0]
+        self._t_first = self._t_last = None
+        self._metrics_reset_t = time.perf_counter()
+        self._requests = [r for r in self._requests if not r.done]
+        if self._flight is not None:
+            self.counters.pop("collective_calls", None)
+            self.counters.pop("collective_bytes", None)
+        if self._obs is not None:
+            self._obs.reset_window()
+        self.prefill.reset_metrics()
+        self.decode.reset_metrics()
+        if self._obs is not None:
+            # the workers' reset_window() replaced their histogram
+            # objects — re-share the request-level set so both feed the
+            # engine-level distributions again
+            self._share_histograms()
+
+    def _share_histograms(self):
+        """Point both workers' request-level latency histograms at the
+        engine-level objects: a request admits on the prefill group and
+        finishes on the decode group (or on the prefill group for an
+        EOS-at-first-token), and its TTFT/TPOT/queue-wait must land in
+        ONE distribution wherever it completes."""
+        for name in _SHARED_HISTOGRAMS:
+            h = self._obs.registry.histogram(name)
+            self.prefill._obs.registry.histograms[name] = h
+            self.decode._obs.registry.histograms[name] = h
+
+    @property
+    def observability(self) -> Optional[Observability]:
+        return self._obs
+
+    def _require_obs(self) -> Observability:
+        if self._obs is None:
+            raise RuntimeError(
+                "observability is disabled for this engine; construct "
+                "with DisaggregatedEngine(..., observability=True)")
+        return self._obs
+
+    def export_trace(self, path: str) -> str:
+        return self._require_obs().export_chrome(
+            path, process_name="paddle_tpu disagg serving")
+
+    def write_timeline(self, path: str) -> str:
+        return self._require_obs().write_jsonl(
+            path, header={"mode": "serving",
+                          "disaggregated": True,
+                          "capacity": self.capacity,
+                          "prefill_slots": self.prefill_slots,
+                          "block_size": self.block_size})
+
+    # -- static program audit -----------------------------------------
+    def program_specs(self, register: bool = True):
+        """Both groups' programs under disagg names — the decode
+        group's decode step, the prefill group's per-bucket prefill
+        (plus COW page copier with a prefix cache), and the two handoff
+        programs — so the PR-5 audit gate covers the disaggregated
+        path next to (not instead of) the colocated programs."""
+        from ..analysis import ProgramSpec, REGISTRY
+        sds = jax.ShapeDtypeStruct
+        specs = []
+        for s in self.decode.program_specs(register=False):
+            if s.name.startswith("serving_decode"):
+                specs.append(dataclasses.replace(
+                    s, name="disagg_decode",
+                    tags=s.tags + ("disagg",)))
+        for s in self.prefill.program_specs(register=False):
+            if "prefill" in s.name:
+                P = s.name.rsplit("_", 1)[1]
+                specs.append(dataclasses.replace(
+                    s, name=f"disagg_prefill_{P}",
+                    tags=s.tags + ("disagg",)))
+            elif "page_copy" in s.name:
+                specs.append(dataclasses.replace(
+                    s, name="disagg_page_copy",
+                    tags=s.tags + ("disagg",)))
+        # fresh jit instances for the handoff pair (auditing must not
+        # disturb the live programs' caches)
+        ext, ins = self._build_handoff_fns()
+        pre_pools = jax.ShapeDtypeStruct(self.prefill._k_pools.shape,
+                                         self.prefill._k_pools.dtype)
+        dec_pools = jax.ShapeDtypeStruct(self.decode._k_pools.shape,
+                                         self.decode._k_pools.dtype)
+        W = self._xfer_w
+        pages_sd = sds((pre_pools.shape[0], W) + pre_pools.shape[2:],
+                       pre_pools.dtype)
+        idx_sd = sds((W,), jnp.int32)
+        specs.append(ProgramSpec(
+            name="disagg_kv_extract", fn=ext,
+            args=(pre_pools, pre_pools, idx_sd),
+            tags=("serving", "disagg")))
+        specs.append(ProgramSpec(
+            name="disagg_kv_insert", fn=ins,
+            args=(dec_pools, dec_pools, idx_sd, pages_sd, pages_sd),
+            donate_argnums=(0, 1), carry={0: 0, 1: 1},
+            tags=("serving", "disagg")))
+        if register:
+            for s in specs:
+                REGISTRY.register(s)
+        return specs
+
+    def audit(self, register: bool = True):
+        """Static audit of every program of both groups (trace-only;
+        the trace counters the tier-1 suite pins are snapshotted and
+        restored)."""
+        from ..analysis import audit_spec as _audit, publish_findings
+        import copy
+        snaps = []
+        for eng in (self.prefill, self.decode):
+            snaps.append((eng.counters,
+                          {k: copy.deepcopy(eng.counters[k])
+                           for k in ("decode_traces", "prefill_traces",
+                                     "calibration_traces")}))
+        h_snap = self.counters["handoff_traces"]
+        try:
+            reports = [_audit(s)
+                       for s in self.program_specs(register=register)]
+        finally:
+            for counters, snap in snaps:
+                counters.update(snap)
+            self.counters["handoff_traces"] = h_snap
+        publish_findings(reports, counters=self.counters, obs=self._obs)
+        return reports

@@ -38,6 +38,13 @@ class GenerationConfig:
     top_p: float = 1.0        # 1.0 = disabled
     eos_token_id: int = -1    # -1 = never stop early
     greedy: bool = False
+    # serving-scheduler knobs (ServingEngine/DisaggregatedEngine
+    # submit() defaults; ignored by the static generate paths):
+    # priority CLASS, lower = more urgent; deadline_s bounds queue
+    # wait — a request still queued past it is rejected, not admitted
+    # late (inference/admission.py)
+    priority: int = 1
+    deadline_s: Optional[float] = None
 
 
 def _repeat_kv(x, n):

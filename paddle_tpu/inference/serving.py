@@ -59,11 +59,10 @@ length-done and recycles slots.
 """
 from __future__ import annotations
 
-import collections
 import functools
 import time
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
@@ -73,6 +72,7 @@ from ..core.jax_compat import shard_map_norep
 from ..observability import Observability
 from ..ops.paged_attention import (BlockManager, dequant_cache,
                                    quant_cache)
+from .admission import AdmissionQueue
 from .generation import (GenerationConfig, _fused_decode_step,
                          _fused_mode, _paged_decode_step,
                          cached_forward, init_cache)
@@ -94,6 +94,50 @@ def _sample_slots(logits, key, temps):
     return jnp.where(temps <= 0.0, greedy, sampled).astype(jnp.int32)
 
 
+def _collectives_snapshot(counters: Dict, obs: Observability) -> Dict:
+    """The structured ``metrics()["collectives"]`` sub-dict (the
+    Trainer.metrics contract): per-(op, axis) call/byte counters from
+    the adopted dict + latency histograms from the bound recorder.
+    ONE definition shared by ServingEngine and DisaggregatedEngine."""
+    return {"calls": dict(counters.get("collective_calls", {})),
+            "bytes": dict(counters.get("collective_bytes", {})),
+            "latency_ms": {
+                name[len("collective_"):-len("_ms")]: h.snapshot()
+                for name, h in sorted(obs.registry.histograms.items())
+                if name.startswith("collective_")
+                and name.endswith("_ms")}}
+
+
+def _drain_loop(eng, max_steps: Optional[int], starve_reason: str,
+                starve_error: str) -> int:
+    """The shared drain loop (ServingEngine and DisaggregatedEngine):
+    step until idle; a capped drain records truncation; a step that
+    can run nothing while work is pending raises, after a stall dump —
+    unless the engine went idle during that step (e.g. its only
+    remaining request deadline-expired), which is a clean finish."""
+    n = 0
+    eng.last_drain_truncated = False
+    while not eng.idle:
+        if not eng.step():
+            if eng.idle:
+                break       # the last step only expired/cleaned up
+            dump = ""
+            if eng._obs is not None:
+                dump = eng._obs.stall_dump(starve_reason,
+                                           eng.scheduler_snapshot(),
+                                           metrics=eng.metrics())
+            raise RuntimeError(
+                starve_error + (f"; stall dump: {dump}" if dump else ""))
+        n += 1
+        if max_steps is not None and n >= max_steps:
+            if not eng.idle:
+                eng.last_drain_truncated = True
+                eng.counters["drain_truncations"] += 1
+                eng._drain_truncated_event(n)
+            break
+    return n
+
+
 @dataclass
 class Request:
     """One serving request and its lifecycle record."""
@@ -101,12 +145,27 @@ class Request:
     prompt: np.ndarray                       # [S] int32
     gen: GenerationConfig
     submit_t: float = 0.0
+    priority: int = 1                        # class, LOWER = more urgent
+    deadline_s: Optional[float] = None       # admission SLO (vs submit)
     tokens: List[int] = field(default_factory=list)   # generated ids
     ttft: Optional[float] = None             # sec, first token - submit
     admit_t: Optional[float] = None          # absolute, perf_counter
     first_token_t: Optional[float] = None    # absolute, perf_counter
     finish_t: Optional[float] = None
     done: bool = False
+    expired: bool = False                    # deadline passed in queue
+    preemptions: int = 0
+    # (seq_len, last sampled token): set when the request holds valid
+    # KV pages but no slot — a preempted decode slot awaiting requeue,
+    # or a disaggregated handoff entering the decode group. Admission
+    # re-enters decode directly from this carry; because the values are
+    # exactly the ones the vacated slot held, the resumed decode is
+    # bit-identical to the un-preempted run.
+    resume: Optional[Tuple[int, int]] = None
+    # the request's live admission-queue entry (engine bookkeeping):
+    # set at push, reused by preemption's requeue so the victim keeps
+    # its original line position and requeue count
+    qentry: Optional[object] = field(default=None, repr=False)
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -149,7 +208,8 @@ class ServingEngine:
                  max_seq_len: Optional[int] = None, cache_dtype=None,
                  prefill_buckets=(32, 128), seed: int = 0,
                  prefix_cache: bool = False,
-                 observability=False, fused_decode=None, mesh=None):
+                 observability=False, fused_decode=None, mesh=None,
+                 aging_s: Optional[float] = None):
         # tensor parallelism (inference/tp.py): a ServingMesh shards
         # the KV pools, projections and per-slot attention along the
         # head axis; programs wrap in shard_map. None = single device.
@@ -247,7 +307,18 @@ class ServingEngine:
 
         C, MB = self.capacity, self.max_blocks
         self._slots = [_Slot() for _ in range(C)]
-        self._queue: Deque[Request] = collections.deque()
+        # SLO-aware admission (inference/admission.py): priority
+        # classes with FIFO tie-break, per-request admission deadlines,
+        # aging for starvation-freedom. Default submissions (one class,
+        # no deadline, no aging) pop in exact FIFO order — the PR-1
+        # contract unchanged.
+        self._queue = AdmissionQueue(aging_s=aging_s)
+        # per-class queue-wait running stats + SLO attainment counters,
+        # updated O(1) at admit/expire so metrics() never scans the
+        # request list per class: cls -> [admitted, wait_ms_sum,
+        # wait_ms_max]; slo = [with-deadline seen, attained]
+        self._sched_cls: Dict[int, List[float]] = {}
+        self._slo = [0, 0]
         self._requests: List[Request] = []
         self._next_id = 0
         self._slot_tables = np.zeros((C, MB), np.int32)  # true tables
@@ -288,6 +359,7 @@ class ServingEngine:
             "live_slot_steps": 0,
             "tokens_generated": 0, "requests_submitted": 0,
             "requests_completed": 0, "drain_truncations": 0,
+            "preemptions": 0, "requeues": 0, "deadline_expired": 0,
         }
         self._t_first = None
         self._t_last = None
@@ -351,10 +423,23 @@ class ServingEngine:
             jnp.asarray(dst, jnp.int32))
 
     # -- public API ---------------------------------------------------
-    def submit(self, prompt, gen: Optional[GenerationConfig] = None
-               ) -> Request:
-        """Enqueue one request. Admission happens inside ``step()`` when
-        a slot and enough KV pages are free (FIFO, no overtaking)."""
+    def _alloc_tokens(self, req: Request) -> int:
+        """Token span this engine allocates KV pages for. The colocated
+        engine holds the whole request (prompt + generation); the
+        disaggregated prefill worker overrides to prompt-only — its
+        pages hand off to the decode group before generation."""
+        return int(req.prompt.size) + int(req.gen.max_new_tokens)
+
+    def submit(self, prompt, gen: Optional[GenerationConfig] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Request:
+        """Enqueue one request. Admission happens inside ``step()``
+        when a slot and enough KV pages are free, ordered by priority
+        class (LOWER = more urgent; FIFO within a class, aging per the
+        engine's ``aging_s``). ``deadline_s`` bounds queue wait: a
+        request still queued past its deadline is rejected (marked
+        ``expired``), never admitted late. ``priority``/``deadline_s``
+        default from ``gen``."""
         gen = gen or GenerationConfig()
         if gen.top_k > 0 or gen.top_p < 1.0:
             raise NotImplementedError(
@@ -370,39 +455,52 @@ class ServingEngine:
             raise ValueError(
                 f"prompt+max_new_tokens = {total} exceeds engine "
                 f"max_seq_len = {self.max_seq_len}")
-        need = -(-total // self.block_size)
+        if priority is None:
+            priority = getattr(gen, "priority", 1)
+        if deadline_s is None:
+            deadline_s = getattr(gen, "deadline_s", None)
+        req = Request(self._next_id, prompt, gen,
+                      submit_t=time.perf_counter(),
+                      priority=int(priority), deadline_s=deadline_s)
+        need = -(-self._alloc_tokens(req) // self.block_size)
         if need > self.num_blocks - 1:          # minus the scratch page
             raise ValueError(
                 f"request needs {need} KV pages but the pool only has "
                 f"{self.num_blocks - 1}; raise num_blocks")
-        req = Request(self._next_id, prompt, gen,
-                      submit_t=time.perf_counter())
         self._next_id += 1
-        self._queue.append(req)
+        req.qentry = self._queue.push(req, cls=req.priority,
+                                      submit_t=req.submit_t,
+                                      deadline_s=deadline_s)
         self._requests.append(req)
         self.counters["requests_submitted"] += 1
         if self._obs is not None:
             self._obs.timeline.record(
                 "submit", req.req_id, prompt_tokens=int(prompt.size),
-                max_new_tokens=int(gen.max_new_tokens))
+                max_new_tokens=int(gen.max_new_tokens),
+                priority=req.priority,
+                **({"deadline_s": deadline_s}
+                   if deadline_s is not None else {}))
         return req
 
     def step(self) -> bool:
         """One scheduler iteration: admit from the queue, run one
         prefill chunk (if an admission is in flight), then one decode
-        step over all live slots. Returns True if any work ran."""
+        step over all live slots. Returns True if any work ran —
+        including deadline expiries, which shrink the queue and so
+        count as scheduler progress (a drain() whose last step only
+        expires a request must finish cleanly, not report starvation)."""
         obs = self._obs
         t0 = time.perf_counter() if obs is not None else 0.0
         if self._t_first is None:
             self._t_first = time.perf_counter()
-        self._admit()
+        expired = self._admit()
         did = self._run_prefill()
         did = self._run_decode() or did
         if did:
             self._t_last = time.perf_counter()
         if obs is not None:
             self._observe_step(t0, did)
-        return did
+        return did or expired > 0
 
     def _observe_step(self, t0: float, did: bool):
         """Post-step observability: gauges, watchdog, step deadline.
@@ -419,6 +517,8 @@ class ServingEngine:
             "live_slots": sum(1 for s in self._slots
                               if s.phase != "idle"),
         }
+        if self._slo[0]:
+            vals["slo_attainment"] = self._slo[1] / self._slo[0]
         if self._pcache is not None:
             st = self._pcache.stats
             looked = st["hits"] + st["misses"]
@@ -500,45 +600,35 @@ class ServingEngine:
         a clean one at the call site. Starvation (a step that can run
         nothing while requests are queued) raises, after writing a
         flight-recorder stall dump when observability is on."""
-        n = 0
-        self.last_drain_truncated = False
-        while not self.idle:
-            if not self.step():
-                dump = ""
-                if self._obs is not None:
-                    dump = self._obs.stall_dump(
-                        "drain starved: queued requests cannot be "
-                        "admitted", self.scheduler_snapshot(),
-                        metrics=self.metrics())
-                raise RuntimeError(
-                    "engine starved: queued requests cannot be admitted "
-                    "(KV pool too small for the in-flight mix?)"
-                    + (f"; stall dump: {dump}" if dump else ""))
-            n += 1
-            if max_steps is not None and n >= max_steps:
-                if not self.idle:
-                    self.last_drain_truncated = True
-                    self.counters["drain_truncations"] += 1
-                    if self._obs is not None:
-                        self._obs.timeline.record(
-                            "drain_truncated", steps=n,
-                            queue_depth=len(self._queue),
-                            live_slots=sum(1 for s in self._slots
-                                           if s.phase != "idle"))
-                break
-        return n
+        return _drain_loop(
+            self, max_steps,
+            starve_reason="drain starved: queued requests cannot be "
+                          "admitted",
+            starve_error="engine starved: queued requests cannot be "
+                         "admitted (KV pool too small for the "
+                         "in-flight mix?)")
+
+    def _drain_truncated_event(self, n: int):
+        if self._obs is not None:
+            self._obs.timeline.record(
+                "drain_truncated", steps=n,
+                queue_depth=len(self._queue),
+                live_slots=sum(1 for s in self._slots
+                               if s.phase != "idle"))
 
     def scheduler_snapshot(self) -> Dict:
         """Host-side scheduler state for stall dumps: queue depth, slot
         phases, per-slot seq_len, free pages, prefix-cache state."""
         snap = {
             "queue_depth": len(self._queue),
-            "queued": [{"req_id": r.req_id,
-                        "prompt_tokens": int(r.prompt.size),
-                        "need_pages": -(-(int(r.prompt.size)
-                                          + int(r.gen.max_new_tokens))
-                                        // self.block_size)}
-                       for r in list(self._queue)[:16]],
+            "queued": [{"req_id": e.item.req_id,
+                        "prompt_tokens": int(e.item.prompt.size),
+                        "priority": e.item.priority,
+                        "requeues": e.requeues,
+                        "need_pages":
+                            -(-self._alloc_tokens(e.item)
+                              // self.block_size)}
+                       for e in list(self._queue)[:16]],
             "slots": [{"slot": i, "phase": s.phase,
                        "req_id": s.req.req_id if s.req else None,
                        "seq_len": s.seq_len,
@@ -587,6 +677,7 @@ class ServingEngine:
             round(c["live_slot_steps"] / (steps * self.capacity), 4)
             if steps else 0.0)
         c["decode_variant"] = self.decode_variant
+        c["scheduler"] = self._scheduler_metrics()
         if self._pcache is not None:
             c["prefix_cache"] = self._pcache.metrics()
         if self._obs is not None:
@@ -602,19 +693,26 @@ class ServingEngine:
                 # the bound recorder feeds per-(op, axis) latency
                 # histograms + call/byte counters — one structured
                 # sub-dict, schema-frozen in test_observability
-                c["collectives"] = {
-                    "calls": dict(self.counters.get(
-                        "collective_calls", {})),
-                    "bytes": dict(self.counters.get(
-                        "collective_bytes", {})),
-                    "latency_ms": {
-                        name[len("collective_"):-len("_ms")]:
-                            h.snapshot()
-                        for name, h in sorted(
-                            obs.registry.histograms.items())
-                        if name.startswith("collective_")
-                        and name.endswith("_ms")}}
+                c["collectives"] = _collectives_snapshot(self.counters,
+                                                         obs)
         return c
+
+    def _scheduler_metrics(self) -> Dict:
+        """The SLO-admission window report: per-class queue-wait stats
+        (running O(1) sums — never a request-list scan), deadline
+        attainment (fraction of deadline-carrying requests admitted
+        within their deadline; None when none carried one), and the
+        live queue depth. Same shape in both observability modes."""
+        per = {str(cls): {
+                   "admitted": int(st[0]),
+                   "queue_wait_ms_mean": (round(st[1] / st[0], 3)
+                                          if st[0] else 0.0),
+                   "queue_wait_ms_max": round(st[2], 3)}
+               for cls, st in sorted(self._sched_cls.items())}
+        n, ok = self._slo
+        return {"per_class": per,
+                "slo_attainment": (round(ok / n, 4) if n else None),
+                "queue_depth": len(self._queue)}
 
     def reset_metrics(self):
         """Zero the throughput counters/timers (e.g. after a compile
@@ -624,8 +722,11 @@ class ServingEngine:
         for k in ("decode_steps", "prefill_chunks", "prefill_tokens",
                   "live_slot_steps", "tokens_generated",
                   "requests_submitted", "requests_completed",
-                  "drain_truncations"):
+                  "drain_truncations", "preemptions", "requeues",
+                  "deadline_expired"):
             self.counters[k] = 0
+        self._sched_cls = {}
+        self._slo = [0, 0]
         if self._pcache is not None:
             # workload counters like the above (the cached PAGES stay —
             # only the counts restart, so a warmed-up bench window
@@ -683,28 +784,62 @@ class ServingEngine:
                 return b
         return self.buckets[-1]
 
-    def _admit(self):
-        for slot_id, slot in enumerate(self._slots):
-            if slot.phase != "idle" or not self._queue:
-                continue
-            req = self._queue[0]
-            total = req.prompt.size + req.gen.max_new_tokens
-            need = -(-total // self.block_size)
+    def _admit(self) -> int:
+        """Admit from the queue until blocked; returns the number of
+        deadline expiries (scheduler progress the caller must count)."""
+        now = time.perf_counter()
+        expired = self._queue.pop_expired(now)
+        for entry in expired:
+            self._expire(entry.item, now)
+        while self._queue:
+            entry = self._queue.best(now)
+            req = entry.item
+            # a slot first — idle, or a strictly lower-priority decode
+            # victim (candidate only; the preemption itself waits until
+            # the page check passes). Slots are checked BEFORE pages so
+            # a saturated engine never pays the prefix-cache acquire
+            # (which pins pages and may device-copy a COW fork) on
+            # every step just to release it again.
+            slot_id = next((i for i, s in enumerate(self._slots)
+                            if s.phase == "idle"), None)
+            victim = None
+            if slot_id is None:
+                victim = self._preempt_candidate(req)
+                if victim is None:
+                    break
             acquired = None
-            if self._pcache is None:
-                if len(self.mgr.free) < need:
-                    break      # FIFO backpressure: wait for pages
-            else:
-                # longest-prefix match, capped at S-1 so the request
-                # always prefills >= 1 token (the logits source for its
-                # first sampled token). acquire() pins the matched
-                # pages and owns the backpressure check — free plus
-                # evictable must cover the un-matched remainder.
-                acquired = self._pcache.acquire(
-                    req.prompt, int(req.prompt.size) - 1, need)
-                if acquired is None:
-                    break      # FIFO backpressure: wait for pages
-            self._queue.popleft()
+            if req.resume is None:
+                ok, acquired = self._acquire_pages(req)
+                if not ok:
+                    # the line head is page-starved. Fresh requests may
+                    # not overtake it (page fairness — FIFO-within-
+                    # order backpressure), but a RESUME entry allocates
+                    # NOTHING and holds pages whose release is the only
+                    # way the head ever unblocks, so the best resume
+                    # entry admits instead (deadlock freedom: a
+                    # preempted victim parked behind a page-short head
+                    # must not pin the pool forever).
+                    entry = self._queue.best(
+                        now, pred=lambda e: e.item.resume is not None)
+                    if entry is None:
+                        break
+                    req = entry.item
+                    if slot_id is None:
+                        # preemption rights are per-entry (raw class):
+                        # re-pick the victim for the resume entry
+                        victim = self._preempt_candidate(req)
+                        if victim is None:
+                            break
+            if slot_id is None:
+                slot_id = self._preempt(victim)
+            self._queue.remove(entry)
+            if req.resume is not None:
+                # valid KV pages already attached (a preempted decode
+                # slot, or a disaggregated KV handoff): re-enter decode
+                # directly — no pages to allocate, no prefill
+                self._admit_resume(slot_id, req, now)
+                continue
+            slot = self._slots[slot_id]
             if self._quant and self._kv_scales is None:
                 # static scales calibrate from the first admitted prompt
                 # BEFORE any prefill/decode program exists, so the
@@ -716,7 +851,8 @@ class ServingEngine:
                 # matched pages join the block table directly; their
                 # references transfer to this request's table entries
                 self.mgr.attach(req.req_id, pages, owned=True)
-            table = self.mgr.allocate(req.req_id, total)
+            table = self.mgr.allocate(req.req_id,
+                                      self._alloc_tokens(req))
             slot.req = req
             slot.phase = "prefill"
             slot.seq_len = 0
@@ -725,14 +861,134 @@ class ServingEngine:
             self._slot_tables[slot_id, :len(table)] = table
             self._slot_wtables[slot_id] = self._slot_tables[slot_id]
             self._slot_wtables[slot_id, :shared] = 0
+            self._record_admit(req, slot_id, now, matched)
+        return len(expired)
+
+    def _acquire_pages(self, req: Request):
+        """Page-availability check for a fresh admission: ``(ok,
+        acquired)``. Without a prefix cache this is a pure free-list
+        check; with one, ``acquire()`` longest-prefix matches (capped
+        at S-1 so the request always prefills >= 1 token, the logits
+        source for its first sampled token), PINS the matched pages,
+        and owns the backpressure check — free plus evictable must
+        cover the un-matched remainder."""
+        need = -(-self._alloc_tokens(req) // self.block_size)
+        if self._pcache is None:
+            return len(self.mgr.free) >= need, None
+        acquired = self._pcache.acquire(
+            req.prompt, int(req.prompt.size) - 1, need)
+        return acquired is not None, acquired
+
+    def _record_admit(self, req: Request, slot_id: int, now: float,
+                      matched: int = 0):
+        """Admission bookkeeping shared by the fresh and resume paths:
+        queue-wait stats per priority class, SLO attainment, the
+        queue_wait histogram and the timeline event."""
+        first = req.admit_t is None
+        if first:
+            # admit_t is the FIRST admission (queue-wait semantics);
+            # a resume keeps it so per-request records report the
+            # original admission wait, not the requeue wait
+            req.admit_t = time.perf_counter()
+            wait_ms = (req.admit_t - req.submit_t) * 1e3
+            st = self._sched_cls.setdefault(req.priority, [0, 0.0, 0.0])
+            st[0] += 1
+            st[1] += wait_ms
+            st[2] = max(st[2], wait_ms)
+            if req.deadline_s is not None:
+                self._slo[0] += 1
+                if wait_ms <= req.deadline_s * 1e3:
+                    self._slo[1] += 1
             if self._obs is not None:
-                req.admit_t = time.perf_counter()
-                wait_ms = (req.admit_t - req.submit_t) * 1e3
                 self._obs.hist("queue_wait_ms").observe(wait_ms)
-                self._obs.timeline.record(
-                    "admit", req.req_id, slot=slot_id,
-                    queue_wait_ms=round(wait_ms, 3),
-                    matched_tokens=matched)
+        if self._obs is not None:
+            wait_ms = (time.perf_counter() - req.submit_t) * 1e3
+            self._obs.timeline.record(
+                "admit" if first else "resume", req.req_id,
+                slot=slot_id, queue_wait_ms=round(wait_ms, 3),
+                matched_tokens=matched, priority=req.priority)
+
+    def _expire(self, req: Request, now: float):
+        """Admission deadline passed while queued: reject, never admit
+        late. A fresh request holds no pages; an expired RESUME entry
+        cannot occur (started entries never expire)."""
+        req.done = True
+        req.expired = True
+        req.finish_t = now
+        self.counters["deadline_expired"] += 1
+        if req.deadline_s is not None:
+            self._slo[0] += 1       # a deadline seen and MISSED
+        if req.req_id in self.mgr.tables:     # defensive: resume state
+            self.mgr.release(req.req_id)
+        if self._obs is not None:
+            self._obs.timeline.record(
+                "expired", req.req_id, priority=req.priority,
+                waited_ms=round((now - req.submit_t) * 1e3, 3))
+
+    def _preempt_candidate(self, req: Request) -> Optional[int]:
+        """The decode slot a waiting ``req`` may evict: the strictly
+        lower-priority (HIGHER class) live decode slot, worst class
+        first, latest-admitted within a class (least progress lost).
+        Raw classes compare — aging promotes queue ORDER, not the right
+        to evict running work. None when no slot is evictable."""
+        cand = [(s.req.priority, s.req.admit_t or 0.0, i)
+                for i, s in enumerate(self._slots)
+                if s.phase == "decode"]
+        if not cand:
+            return None
+        cls, _, slot_id = max(cand)
+        return slot_id if cls > req.priority else None
+
+    def _preempt(self, slot_id: int) -> int:
+        """Evict a decode slot: the victim's KV pages stay attached in
+        the BlockManager and its decode carry (seq_len, last token) is
+        saved on the request, so the requeued entry — re-inserted at
+        its ORIGINAL line position within its class — resumes decode
+        bit-identically to the un-preempted run."""
+        slot = self._slots[slot_id]
+        req = slot.req
+        req.resume = (slot.seq_len, int(self._h_tok[slot_id]))
+        req.preemptions += 1
+        self.counters["preemptions"] += 1
+        self.counters["requeues"] += 1
+        # requeue the request's ORIGINAL entry: class, submit time and
+        # line seq survive, the requeue count ticks, and started=True
+        # exempts it from deadline expiry (its admission SLO was met)
+        self._queue.requeue(req.qentry)
+        if self._obs is not None:
+            self._obs.timeline.record(
+                "preempt", req.req_id, slot=slot_id,
+                priority=req.priority,
+                gen_tokens=len(req.tokens), seq_len=slot.seq_len)
+        self._clear_slot(slot_id)
+        return slot_id
+
+    def _admit_resume(self, slot_id: int, req: Request, now: float):
+        """Re-enter decode from saved carry: the slot gets exactly the
+        values the vacated slot held (or, for a disaggregated handoff,
+        the prefill group's first-token carry), so the decode stream
+        continues bit-identically."""
+        seq_len, tok = req.resume
+        req.resume = None
+        table = self.mgr.tables.get(req.req_id)
+        if not table:
+            raise RuntimeError(
+                f"resume of request {req.req_id} without attached KV "
+                "pages — preemption must retain the victim's pages")
+        slot = self._slots[slot_id]
+        slot.req = req
+        slot.phase = "decode"
+        slot.seq_len = seq_len
+        slot.prefill_pos = int(req.prompt.size)
+        self._slot_tables[slot_id] = 0
+        self._slot_tables[slot_id, :len(table)] = table
+        self._slot_wtables[slot_id] = self._slot_tables[slot_id]
+        self._h_tok[slot_id] = tok
+        self._h_seq[slot_id] = seq_len
+        self._h_tables[slot_id] = self._slot_tables[slot_id]
+        self._h_temps[slot_id] = self._temp_of(req.gen)
+        self._dirty = True
+        self._record_admit(req, slot_id, now)
 
     def _run_prefill(self) -> bool:
         for slot_id, slot in enumerate(self._slots):
@@ -802,18 +1058,27 @@ class ServingEngine:
                     self._pcache.insert(
                         req.prompt,
                         list(self.mgr.tables.get(req.req_id, ())))
-                if (first == req.gen.eos_token_id
-                        or req.gen.max_new_tokens <= 1):
-                    self._finish(slot_id)
-                else:
-                    slot.phase = "decode"
-                    self._h_tok[slot_id] = first
-                    self._h_seq[slot_id] = S
-                    self._h_tables[slot_id] = self._slot_tables[slot_id]
-                    self._h_temps[slot_id] = self._temp_of(req.gen)
-                    self._dirty = True
+                self._on_prefill_complete(slot_id, first)
             return True
         return False
+
+    def _on_prefill_complete(self, slot_id: int, first: int):
+        """Prompt fully prefilled and first token sampled: transition
+        the slot to decode (or finish on EOS / single-token budget).
+        The disaggregated prefill worker overrides this to hand the
+        request's KV pages to the decode group instead."""
+        slot = self._slots[slot_id]
+        req = slot.req
+        if (first == req.gen.eos_token_id
+                or req.gen.max_new_tokens <= 1):
+            self._finish(slot_id)
+        else:
+            slot.phase = "decode"
+            self._h_tok[slot_id] = first
+            self._h_seq[slot_id] = slot.seq_len
+            self._h_tables[slot_id] = self._slot_tables[slot_id]
+            self._h_temps[slot_id] = self._temp_of(req.gen)
+            self._dirty = True
 
     def _run_decode(self) -> bool:
         live = [i for i, s in enumerate(self._slots)
@@ -883,6 +1148,9 @@ class ServingEngine:
                 "tpot_ms": (round(tpot_ms, 3)
                             if tpot_ms is not None else None),
                 "e2e_ms": round((req.finish_t - req.submit_t) * 1e3, 3),
+                "priority": req.priority,
+                **({"preemptions": req.preemptions}
+                   if req.preemptions else {}),
             }
             # a request whose first token predates the last reset
             # carries a warmup-measured TTFT: keep its record but
@@ -907,6 +1175,14 @@ class ServingEngine:
             self._pcache.insert(
                 seq, list(self.mgr.tables.get(req.req_id, ())))
         self.mgr.release(req.req_id)
+        self._clear_slot(slot_id)
+        self.counters["requests_completed"] += 1
+
+    def _clear_slot(self, slot_id: int):
+        """Vacate a slot WITHOUT touching the request's KV pages: the
+        finish path releases them first; preemption and the
+        disaggregated handoff deliberately keep them attached."""
+        slot = self._slots[slot_id]
         slot.req = None
         slot.phase = "idle"
         slot.seq_len = 0
@@ -917,8 +1193,7 @@ class ServingEngine:
         self._h_seq[slot_id] = 0
         self._h_tables[slot_id] = 0
         self._h_temps[slot_id] = 0.0
-        self._dirty = True          # released pages must not be written
-        self.counters["requests_completed"] += 1
+        self._dirty = True          # vacated slot must not be written
 
     # -- jitted programs ----------------------------------------------
     # decode step args: (params, tok, seq_lens, tables, temps, key,

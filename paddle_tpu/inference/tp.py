@@ -144,6 +144,26 @@ class ServingMesh:
     def tp(self) -> int:
         return int(self.mesh.shape[self.axis])
 
+    @property
+    def devices(self) -> list:
+        return list(self.mesh.devices.flat)
+
+    def split(self, first: int) -> Tuple["ServingMesh", "ServingMesh"]:
+        """Split this mesh's device list into two disjoint ServingMesh
+        groups: the first ``first`` devices and the remainder — the
+        disaggregated engine's (prefill, decode) chip groups. Both keep
+        this mesh's axis name and collective placement."""
+        devs = self.devices
+        if not 1 <= first < len(devs):
+            raise ValueError(
+                f"split(first={first}) needs 1 <= first < {len(devs)} "
+                f"(the mesh has {len(devs)} device(s); both groups "
+                "need at least one)")
+        mk = lambda d: ServingMesh(                      # noqa: E731
+            Mesh(np.array(d), (self.axis,)), axis=self.axis,
+            collective=self.collective)
+        return mk(devs[:first]), mk(devs[first:])
+
     def describe(self) -> Dict:
         return {"axis": self.axis, "tp": self.tp,
                 "collective": self.collective}

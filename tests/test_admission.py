@@ -1,0 +1,171 @@
+"""AdmissionQueue in isolation (inference/admission.py): priority
+ordering, FIFO tie-break within a class, deadline-expiry rejection +
+requeue accounting, and starvation-freedom of the lowest class under
+sustained high-priority load via aging. Pure host-side scheduling —
+no device work; a fake clock makes every test deterministic."""
+import pytest
+
+from paddle_tpu.inference.admission import AdmissionQueue
+
+pytestmark = pytest.mark.disagg
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _q(aging_s=None, t0=0.0):
+    clk = FakeClock(t0)
+    return AdmissionQueue(aging_s=aging_s, clock=clk), clk
+
+
+# -- priority ordering -------------------------------------------------
+
+def test_lower_class_pops_first():
+    q, _ = _q()
+    q.push("batch", cls=2)
+    q.push("std", cls=1)
+    q.push("rt", cls=0)
+    assert q.pop().item == "rt"
+    assert q.pop().item == "std"
+    assert q.pop().item == "batch"
+    assert q.pop() is None
+
+
+def test_fifo_tie_break_within_class():
+    q, _ = _q()
+    for i in range(5):
+        q.push(f"r{i}", cls=1)
+    assert [q.pop().item for _ in range(5)] == \
+        [f"r{i}" for i in range(5)]
+
+
+def test_default_usage_is_exact_fifo():
+    """All-default submissions (one class, no deadline, no aging) must
+    pop in submission order — the PR-1 engine contract the priority
+    queue replaces FIFO without changing."""
+    q, _ = _q()
+    items = list(range(10))
+    for i in items:
+        q.push(i)
+    assert [q.pop().item for _ in items] == items
+
+
+def test_best_does_not_remove():
+    q, _ = _q()
+    q.push("a", cls=1)
+    assert q.best().item == "a"
+    assert len(q) == 1
+    assert q.pop().item == "a"
+    assert len(q) == 0 and not q
+
+
+# -- deadline expiry ---------------------------------------------------
+
+def test_deadline_expiry_rejects_only_past_deadline():
+    q, clk = _q()
+    q.push("fast", cls=1, deadline_s=1.0)
+    q.push("slow", cls=1, deadline_s=10.0)
+    q.push("none", cls=1)
+    clk.advance(2.0)
+    dead = q.pop_expired()
+    assert [e.item for e in dead] == ["fast"]
+    assert len(q) == 2
+    clk.advance(20.0)
+    dead = q.pop_expired()
+    assert [e.item for e in dead] == ["slow"]   # no-deadline never dies
+    assert [e.item for e in list(q)] == ["none"]
+
+
+def test_started_entries_never_expire():
+    """A requeued (preempted) entry already met its admission SLO:
+    abandoning half-generated output would waste the work done."""
+    q, clk = _q()
+    e = q.push("victim", cls=2, deadline_s=1.0)
+    q.remove(e)          # admitted
+    clk.advance(5.0)
+    q.requeue(e)         # preempted: back in line, started=True
+    clk.advance(100.0)
+    assert q.pop_expired() == []
+    assert q.pop().item == "victim"
+
+
+def test_requeue_accounting_and_line_position():
+    """Requeue keeps the ORIGINAL sequence number: the victim re-enters
+    the line where it stood, ahead of later same-class arrivals, and
+    its requeue count ticks."""
+    q, _ = _q()
+    e0 = q.push("victim", cls=1)
+    q.push("later1", cls=1)
+    q.remove(e0)         # admitted
+    q.push("later2", cls=1)
+    q.requeue(e0)        # preempted
+    assert e0.requeues == 1
+    assert [q.pop().item for _ in range(3)] == \
+        ["victim", "later1", "later2"]
+
+
+# -- aging / starvation-freedom ----------------------------------------
+
+def test_aging_promotes_effective_class():
+    q, clk = _q(aging_s=1.0)
+    e = q.push("batch", cls=3)
+    assert q.effective_class(e) == 3
+    clk.advance(1.5)
+    assert q.effective_class(e) == 2
+    clk.advance(2.0)
+    assert q.effective_class(e) == 0     # floor at 0
+    clk.advance(10.0)
+    assert q.effective_class(e) == 0
+
+
+def test_starvation_freedom_of_lowest_class():
+    """Sustained class-0 load must NOT starve a class-3 entry: aging
+    promotes it one class per aging_s, and FIFO-within-class (earliest
+    seq first) then guarantees it beats every younger class-0 arrival.
+    Bounded wait: within 4 aging periods it MUST be the next pop."""
+    q, clk = _q(aging_s=1.0)
+    q.push("starved", cls=3)
+    popped = []
+    for step in range(12):
+        q.push(f"hp{step}", cls=0)      # one fresh high-prio per tick
+        popped.append(q.pop().item)
+        clk.advance(0.5)
+        if "starved" in popped:
+            break
+    assert "starved" in popped
+    # 4 aging periods = 8 half-second ticks: admitted by then
+    assert popped.index("starved") <= 8
+
+
+def test_no_aging_means_strict_priority():
+    q, clk = _q(aging_s=None)
+    q.push("batch", cls=3)
+    clk.advance(1e6)
+    q.push("hp", cls=0)
+    assert q.pop().item == "hp"
+
+
+def test_invalid_aging_rejected():
+    with pytest.raises(ValueError, match="aging_s"):
+        AdmissionQueue(aging_s=0.0)
+
+
+# -- snapshot ----------------------------------------------------------
+
+def test_snapshot_orders_by_effective_class():
+    q, clk = _q(aging_s=1.0)
+    q.push("old_batch", cls=2)
+    clk.advance(2.5)                    # aged to effective 0
+    q.push("fresh_std", cls=1)
+    snap = q.snapshot()
+    assert [s["cls"] for s in snap] == [2, 1]
+    assert snap[0]["effective_cls"] == 0
+    assert snap[0]["waited_s"] == pytest.approx(2.5)

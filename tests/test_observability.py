@@ -78,6 +78,9 @@ BASE_KEYS = {
     "tokens_per_sec", "prefill_tokens_per_sec", "ttft_ms_mean",
     "ttft_ms_max", "slot_utilization",
     "decode_variant",        # r11: fused decode-block dispatch report
+    # r15: SLO-aware admission (preempt/requeue counters + the
+    # per-class queue-wait / slo_attainment scheduler report)
+    "preemptions", "requeues", "deadline_expired", "scheduler",
 }
 OBS_KEYS = {"latency", "gauges", "retrace_warnings", "stall_dumps",
             "timeline_events", "timeline_dropped"}

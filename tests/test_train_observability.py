@@ -152,6 +152,79 @@ def test_compile_watchdog_arms_on_reset():
     assert tr.metrics()["retrace_warnings"] == 0
 
 
+# -- multi-device AOT sharding (the r12 step-2 failure, fixed r15) ------
+
+def _md_trainer(**kw):
+    mesh = make_mesh(MeshConfig(fsdp=2), devices=jax.devices()[:2])
+    kw.setdefault("data_spec", P())
+    kw.setdefault("lr", 1e-3)
+    return Trainer(lambda p, t, l: loss_fn(p, t, l, CFG), mesh,
+                   param_shardings(mesh, CFG), **kw)
+
+
+def test_multi_device_observed_trainer_survives_step2_resharding():
+    """The pre-existing failure recorded in the verify skill since r12:
+    on a multi-device mesh, GSPMD propagation re-shards some state
+    leaves in the step-1 OUTPUT and the observed path's AOT executable
+    rejected them at step 2 ("input sharding(s) does not match"). The
+    compiled-cache key now includes each leaf's sharding, so step 2 is
+    one extra warmup compile at the propagated (fixed-point) layout —
+    and losses stay bit-identical to the unobserved trainer."""
+    runs = []
+    for obs in (False, True):
+        tr = _md_trainer(observability=obs)
+        state = tr.init_state(init_params(CFG, jax.random.key(0)))
+        losses = []
+        for i in range(3):
+            toks, labels = _batch(seed=i)
+            state, m = tr.step(state, toks, labels)   # step 2 used to raise
+            losses.append(float(m["loss"]))
+        runs.append(losses)
+        if obs:
+            # one compile per GSPMD layout (initial + propagated),
+            # stable afterwards; the clean path never demoted to jit
+            assert tr.metrics()["compile"]["count"] == 2
+            assert tr._aot_fallback is False
+    assert runs[0] == runs[1]
+
+
+@pytest.mark.slow
+def test_observed_step_falls_back_to_jit_on_sharding_reject(
+        monkeypatch):
+    """Belt-and-braces path: if a backend still rejects the committed
+    shardings at call time, the observed step demotes to the plain jit
+    path with a ONE-TIME warning instead of killing the train loop —
+    and the math is unchanged (same jitted program)."""
+    tr = _trainer(observability=True)
+    ref = _trainer()
+    state = tr.init_state(init_params(CFG, jax.random.key(2)))
+    rstate = ref.init_state(init_params(CFG, jax.random.key(2)))
+    toks, labels = _batch()
+
+    def reject(self, tree, lr, staged):
+        def boom(*a, **k):
+            raise ValueError(
+                "Compiled object called with input sharding(s) does "
+                "not match the sharding(s) the computation was "
+                "compiled with")
+        return boom, 0.0
+
+    monkeypatch.setattr(Trainer, "_compiled_for", reject)
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        state, m = tr.step(state, toks, labels)
+    assert tr._aot_fallback is True
+    rstate, rm = ref.step(rstate, toks, labels)
+    assert float(m["loss"]) == float(rm["loss"])
+    # demoted: later steps run the jit path silently
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error", RuntimeWarning)
+        state, m2 = tr.step(state, toks, labels)
+    rstate, rm2 = ref.step(rstate, toks, labels)
+    assert float(m2["loss"]) == float(rm2["loss"])
+    assert tr.metrics()["latency"]["step_ms"]["count"] == 2
+
+
 # -- numerics: observability must not change the math -------------------
 
 def test_bit_identical_loss_with_observability_on_vs_off():

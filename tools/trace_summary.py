@@ -4,13 +4,19 @@
 Reads the structured per-phase JSONL the observability layer emits next
 to each BENCH capture and prints, without needing a browser:
 
-serving mode (ServingEngine.write_timeline):
+serving mode (ServingEngine.write_timeline /
+DisaggregatedEngine.write_timeline):
 - per-phase breakdown: count / total / mean / max wall time per event
   name (decode_step, prefill_chunk, ...),
 - the top-N slowest timed steps (the retrace or allocator hiccup is
   almost always one of these),
 - per-request latency distributions (queue wait, TTFT, TPOT, e2e)
-  with p50/p95/p99 computed from the request records.
+  with p50/p95/p99 computed from the request records,
+- a scheduler section when the SLO-admission machinery left traces:
+  per-priority-class queue-wait percentiles (request records carry
+  their class), preemption / resume / deadline-expiry counts, and the
+  KV-handoff breakdown (count, bytes, extract/put/insert phase means)
+  for disaggregated timelines.
 
 train mode (Trainer.write_timeline, ``--mode train`` or auto-detected
 from the meta header):
@@ -90,17 +96,66 @@ def summarize(meta, events, requests, top=10):
     # excluded, matching the engine's own histogram exclusion
     live = [r for r in requests if not r.get("warmup")]
     for key in ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
-        vals = sorted(r[key] for r in live
-                      if r.get(key) is not None)
+        vals = [r[key] for r in live if r.get(key) is not None]
         if vals:
-            lat[key] = {"count": len(vals),
-                        "mean": round(sum(vals) / len(vals), 3),
-                        "p50": round(_percentile(vals, 0.50), 3),
-                        "p95": round(_percentile(vals, 0.95), 3),
-                        "p99": round(_percentile(vals, 0.99), 3),
-                        "max": round(vals[-1], 3)}
+            lat[key] = _dist(vals)
     out["request_latency"] = lat
     out["requests"] = len(requests)
+
+    sched = summarize_scheduler(events, live)
+    if sched is not None:
+        out["scheduler"] = sched
+    return out
+
+
+def _dist(vals):
+    vals = sorted(vals)
+    return {"count": len(vals),
+            "mean": round(sum(vals) / len(vals), 3),
+            "p50": round(_percentile(vals, 0.50), 3),
+            "p95": round(_percentile(vals, 0.95), 3),
+            "p99": round(_percentile(vals, 0.99), 3),
+            "max": round(vals[-1], 3)}
+
+
+def summarize_scheduler(events, requests):
+    """The SLO-admission section: per-priority-class queue-wait
+    percentiles from the request records, preemption/resume/expiry
+    counts from the timeline, and the KV-handoff phase breakdown
+    (disaggregated engines). Returns None when the timeline carries no
+    scheduler traces at all — plain FIFO timelines keep their old
+    summary shape."""
+    counts = {name: sum(1 for ev in events if ev.get("name") == name)
+              for name in ("preempt", "resume", "expired", "handoff")}
+    classes = sorted({r.get("priority") for r in requests
+                      if r.get("priority") is not None})
+    multi_class = len(classes) > 1
+    if not any(counts.values()) and not multi_class:
+        return None
+    out = {"preemptions": counts["preempt"],
+           "resumes": counts["resume"],
+           "deadline_expired": counts["expired"]}
+    per = {}
+    for cls in classes:
+        waits = [r["queue_wait_ms"] for r in requests
+                 if r.get("priority") == cls
+                 and r.get("queue_wait_ms") is not None]
+        if waits:
+            per[str(cls)] = _dist(waits)
+    if per:
+        out["per_class_queue_wait_ms"] = per
+    hand = [ev for ev in events if ev.get("name") == "handoff"]
+    if hand:
+        h = {"count": len(hand),
+             "bytes_total": sum(ev.get("bytes", 0) for ev in hand),
+             "pages_total": sum(ev.get("pages", 0) for ev in hand),
+             "handoff_ms": _dist([ev["dur_ms"] for ev in hand
+                                  if ev.get("dur_ms") is not None])}
+        for phase in ("extract_ms", "put_ms", "insert_ms"):
+            vals = [ev[phase] for ev in hand if ev.get(phase) is not None]
+            if vals:
+                h[phase + "_mean"] = round(sum(vals) / len(vals), 3)
+        out["handoff"] = h
     return out
 
 
@@ -133,6 +188,30 @@ def render(summary):
             lines.append(f"{name:<16}{s['count']:>7}{s['mean']:>10}"
                          f"{s['p50']:>10}{s['p95']:>10}{s['p99']:>10}"
                          f"{s['max']:>10}")
+    sched = summary.get("scheduler")
+    if sched:
+        lines.append("")
+        lines.append(f"scheduler: {sched['preemptions']} preemptions, "
+                     f"{sched['resumes']} resumes, "
+                     f"{sched['deadline_expired']} deadline-expired")
+        per = sched.get("per_class_queue_wait_ms", {})
+        if per:
+            lines.append(f"{'class wait ms':<16}{'count':>7}{'mean':>10}"
+                         f"{'p50':>10}{'p95':>10}{'p99':>10}{'max':>10}")
+            for cls, s in per.items():
+                lines.append(f"{'class ' + cls:<16}{s['count']:>7}"
+                             f"{s['mean']:>10}{s['p50']:>10}"
+                             f"{s['p95']:>10}{s['p99']:>10}"
+                             f"{s['max']:>10}")
+        h = sched.get("handoff")
+        if h:
+            lines.append(
+                f"kv handoff: {h['count']} transfers, "
+                f"{h['bytes_total']} bytes, p50 "
+                f"{h['handoff_ms']['p50']} ms (extract "
+                f"{h.get('extract_ms_mean', 0.0)} / put "
+                f"{h.get('put_ms_mean', 0.0)} / insert "
+                f"{h.get('insert_ms_mean', 0.0)})")
     return "\n".join(lines)
 
 
