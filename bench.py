@@ -1146,6 +1146,168 @@ def bench_serving_disagg():
             "arrival_rate_hz": rate}
 
 
+def bench_serving_fleet():
+    """Fleet serving A/B: N prefix-cached replicas (host-RAM KV
+    offload on, pools deliberately undersized so eviction pressure
+    spills) behind the ServingFleet router, over a Poisson arrival
+    stream whose prompts share ZIPF-distributed prefixes (a few hot
+    system prompts, a long tail — the real traffic shape). The SAME
+    trace runs three ways: prefix-aware routing, round-robin routing
+    (the naive baseline the prefix router must beat on warm-hit
+    ratio), and one monolithic colocated engine (the greedy-parity
+    reference and the single-engine throughput anchor). Banks the
+    router warm-hit ratio and the replica-cache hit ratio for both
+    policies, TTFT/TPOT distributions, spill/restore pages+bytes
+    through the offload tier, and the parity fraction."""
+    import jax
+    from paddle_tpu.inference import (GenerationConfig, ServingEngine,
+                                      ServingFleet)
+    from paddle_tpu.models.llama import LlamaConfig, init_params
+
+    N = int(os.environ.get("BENCH_FLEET_REPLICAS", "2"))
+    cap = int(os.environ.get("BENCH_FLEET_CAPACITY", "2"))
+    R = int(os.environ.get("BENCH_FLEET_REQUESTS", str(12 * N)))
+    pref = int(os.environ.get("BENCH_FLEET_PREFIX", "48"))
+    tail = int(os.environ.get("BENCH_FLEET_TAIL", "16"))
+    gen_n = int(os.environ.get("BENCH_FLEET_GEN", "8"))
+    P = int(os.environ.get("BENCH_FLEET_TEMPLATES", "4"))
+    zipf_a = float(os.environ.get("BENCH_FLEET_ZIPF_A", "1.2"))
+    rate = float(os.environ.get("BENCH_FLEET_RATE_HZ", "16.0"))
+    hidden = int(os.environ.get("BENCH_FLEET_HIDDEN", "128"))
+    layers = int(os.environ.get("BENCH_FLEET_LAYERS", "4"))
+    ctx = pref + tail
+    BS = 16
+
+    import jax.numpy as jnp
+    cfg = LlamaConfig(vocab_size=8192, hidden_size=hidden,
+                      intermediate_size=hidden * 4,
+                      num_hidden_layers=layers,
+                      num_attention_heads=hidden // 32,
+                      num_key_value_heads=hidden // 32,
+                      max_position_embeddings=ctx + gen_n,
+                      dtype=jnp.float32, remat=False)
+    params = init_params(cfg, jax.random.PRNGKey(0),
+                         dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    templates = [rng.randint(0, 8192, (pref,)) for _ in range(P)]
+    # Zipf template popularity, clipped to the template pool
+    picks = np.minimum(rng.zipf(zipf_a, R) - 1, P - 1)
+    prompts = [np.concatenate([templates[int(k)],
+                               rng.randint(0, 8192, (tail,))])
+               .astype(np.int32) for k in picks]
+    gaps = rng.exponential(1.0 / rate, R)
+    gaps[0] = 0.0
+    arrivals = np.cumsum(gaps)
+    g = GenerationConfig(max_new_tokens=gen_n, greedy=True)
+    req_pages = -(-(ctx + gen_n) // BS)
+
+    def mk_replica():
+        # pool = live requests + ~1.5 cached prompts: the Zipf tail
+        # forces eviction pressure, so the offload tier actually spills
+        return ServingEngine(
+            params, cfg, capacity=cap, block_size=BS,
+            max_seq_len=ctx + gen_n,
+            num_blocks=(cap + 1) * req_pages + req_pages // 2 + 1,
+            prefill_buckets=(tail, ctx), prefix_cache=True,
+            kv_offload=True, observability=True)
+
+    def run_fleet(policy):
+        reps = [mk_replica() for _ in range(N)]
+        warm = GenerationConfig(max_new_tokens=2, greedy=True)
+        wtail = rng.randint(0, 8192, (tail,))
+        for eng in reps:
+            # compile BOTH buckets on every replica outside the window
+            # (full-prompt ctx bucket, then a warm hit sharing the
+            # SAME template so the suffix tail bucket runs too)
+            eng.submit(prompts[0][:ctx], warm)
+            eng.drain()
+            eng.submit(np.concatenate([prompts[0][:pref], wtail])
+                       .astype(np.int32), warm)
+            eng.drain()
+        fleet = ServingFleet(reps, policy=policy, observability=True)
+        fleet.reset_metrics()
+        t0, i = time.perf_counter(), 0
+        reqs = []
+        while i < R or not fleet.idle:
+            now = time.perf_counter() - t0
+            while i < R and arrivals[i] <= now:
+                reqs.append(fleet.submit(prompts[i], g))
+                i += 1
+            if not fleet.step() and i < R:
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+        wall = time.perf_counter() - t0
+        return fleet.metrics(), wall, [r.output_ids for r in reqs]
+
+    def run_mono():
+        blocks = (N * cap + P + 1) * req_pages + 1
+        eng = ServingEngine(params, cfg, capacity=N * cap,
+                            block_size=BS, max_seq_len=ctx + gen_n,
+                            num_blocks=blocks,
+                            prefill_buckets=(tail, ctx),
+                            prefix_cache=True, observability=True)
+        warm = GenerationConfig(max_new_tokens=2, greedy=True)
+        eng.submit(prompts[0][:ctx], warm)
+        eng.drain()
+        eng.submit(np.concatenate(
+            [prompts[0][:pref], rng.randint(0, 8192, (tail,))])
+            .astype(np.int32), warm)
+        eng.drain()
+        eng.reset_metrics()
+        t0, i = time.perf_counter(), 0
+        reqs = []
+        while i < R or not eng.idle:
+            now = time.perf_counter() - t0
+            while i < R and arrivals[i] <= now:
+                reqs.append(eng.submit(prompts[i], g))
+                i += 1
+            if not eng.step() and i < R:
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+        wall = time.perf_counter() - t0
+        return eng.metrics(), wall, [r.output_ids for r in reqs]
+
+    def cache_hit_ratio(m):
+        hits = miss = 0
+        for rm in m["replicas"].values():
+            pc = rm.get("prefix_cache", {})
+            hits += pc.get("hits", 0)
+            miss += pc.get("misses", 0)
+        return round(hits / max(hits + miss, 1), 4)
+
+    pfx_m, pfx_wall, pfx_out = run_fleet("prefix")
+    rr_m, rr_wall, rr_out = run_fleet("round_robin")
+    mono_m, mono_wall, mono_out = run_mono()
+    matches = [bool(np.array_equal(a, b))
+               for a, b in zip(mono_out, pfx_out)]
+    side = lambda m, w: {                               # noqa: E731
+        "tokens_per_sec": round(R * gen_n / w, 1),
+        "ttft_ms": m["latency"]["ttft_ms"],
+        "tpot_ms": m["latency"]["tpot_ms"],
+        "retrace_warnings": m["retrace_warnings"]}
+    return {
+        "metric": "serving_fleet_warm_hit_ratio",
+        "value": pfx_m["routing"]["warm_hit_ratio"],
+        "unit": "fraction of requests routed onto their warm replica",
+        "platform": "forced-host-cpu (structure evidence, not chip "
+                    "perf)",
+        "greedy_parity_vs_monolithic": round(
+            sum(matches) / max(len(matches), 1), 4),
+        "prefix_routing": {
+            **side(pfx_m, pfx_wall),
+            "warm_hit_ratio": pfx_m["routing"]["warm_hit_ratio"],
+            "cache_hit_ratio": cache_hit_ratio(pfx_m),
+            "diverted": pfx_m["routing"]["diverted"],
+            "offload": pfx_m["offload"]},
+        "round_robin": {
+            **side(rr_m, rr_wall),
+            "warm_hit_ratio": rr_m["routing"]["warm_hit_ratio"],
+            "cache_hit_ratio": cache_hit_ratio(rr_m),
+            "offload": rr_m["offload"]},
+        "monolithic": side(mono_m, mono_wall),
+        "replicas": N, "capacity_per_replica": cap, "requests": R,
+        "templates": P, "zipf_a": zipf_a, "prefix": pref,
+        "tail": tail, "gen": gen_n, "arrival_rate_hz": rate}
+
+
 def bench_sd_unet(steps=8, batch=4):
     """BASELINE config 6: Stable-Diffusion-class UNet denoise step,
     compiled (SD-1.x geometry at 64x64 latents)."""
@@ -1940,6 +2102,7 @@ CONFIGS = {
     "serving_prefix_cache": bench_serving_prefix_cache,
     "serving_tp": bench_serving_tp,
     "serving_disagg": bench_serving_disagg,
+    "serving_fleet": bench_serving_fleet,
     "sd_unet": bench_sd_unet,
     "kernels": bench_kernels,
 }

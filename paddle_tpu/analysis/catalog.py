@@ -31,6 +31,8 @@ CATALOG_PROGRAMS = ("train_step", "train_step_fused",
                     "serving_decode", "serving_decode_fused",
                     "serving_prefill_16", "serving_prefill_32",
                     "serving_page_copy",
+                    "serving_kv_spill_extract",
+                    "serving_kv_restore_insert",
                     "serving_decode_tp", "serving_prefill_tp_16",
                     "disagg_decode", "disagg_prefill_16",
                     "disagg_kv_extract", "disagg_kv_insert",
@@ -143,6 +145,32 @@ def _serving_specs(register: bool):
         for s in fused:
             REGISTRY.register(s)
     return specs + fused
+
+
+def _serving_offload_specs(register: bool):
+    """The host-RAM KV offload tier's handoff pair (the spill-side
+    single-page extract and the donated restore-side insert) from a
+    prefix-cached engine with ``kv_offload`` on. Registered filtered,
+    like the fused-decode spec: the offload engine's other programs
+    would latest-wins clobber the main engine's entries while the gate
+    list kept auditing the main engine's versions."""
+    import jax
+    from ..inference.serving import ServingEngine
+    from ..models.llama import init_params
+
+    cfg = _tiny_llama_cfg(seq=64)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(params, cfg, capacity=2, block_size=8,
+                        max_seq_len=64, prefill_buckets=(16,),
+                        prefix_cache=True, kv_offload=True)
+    specs = [s for s in eng.program_specs(register=False)
+             if s.name in ("serving_kv_spill_extract",
+                           "serving_kv_restore_insert")]
+    if register:
+        from .registry import REGISTRY
+        for s in specs:
+            REGISTRY.register(s)
+    return specs
 
 
 def _tp_cfg():
@@ -281,6 +309,10 @@ def build_catalog(names: Optional[List[str]] = None,
                  "serving_prefill_16", "serving_prefill_32",
                  "serving_page_copy"}:
         specs.extend(s for s in _serving_specs(register)
+                     if s.name in wanted)
+    if wanted & {"serving_kv_spill_extract",
+                 "serving_kv_restore_insert"}:
+        specs.extend(s for s in _serving_offload_specs(register)
                      if s.name in wanted)
     if wanted & {"serving_decode_tp", "serving_prefill_tp_16"}:
         specs.extend(s for s in _serving_tp_specs(register)

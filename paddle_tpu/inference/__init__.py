@@ -11,6 +11,7 @@ from .prefix_cache import PrefixCache, PagedKVCacheStore
 from .tp import ServingMesh
 from .admission import AdmissionQueue
 from .disagg import DisaggregatedEngine
+from .fleet import ServingFleet
 
 __all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
            "DataType", "PlaceType", "PrecisionType", "PredictorPool",
@@ -20,7 +21,7 @@ __all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
            "generate", "generate_paged", "cached_forward", "init_cache",
            "sample_token", "Request", "ServingEngine", "ServingMesh",
            "PrefixCache", "PagedKVCacheStore", "AdmissionQueue",
-           "DisaggregatedEngine"]
+           "DisaggregatedEngine", "ServingFleet"]
 
 
 class DataType:

@@ -32,6 +32,26 @@ hot loop uninterrupted:
   the translated table, and the prefill side releases its pages (the
   radix prefix cache keeps its refcounted copies, so warm admissions
   keep working on the prefill side).
+- the handoff is **async and double-buffered** (r16): a transfer's
+  extract + device_put are ISSUED in one orchestrator step and its
+  donated insert lands at the top of the NEXT step, so the
+  device-to-device copy overlaps the prefill chunk and decode step
+  dispatched in between instead of serializing ahead of them (at most
+  two transfers in flight). The request's resume entry is pushed only
+  when its final insert lands, so the decode group never sees
+  half-arrived pages and the bit-parity contract is untouched.
+- **chunked-prefill handoff** (r16): a multi-chunk prompt streams each
+  completed chunk's full pages to the decode group while later chunks
+  still run (same extract/put/insert programs, offset page windows),
+  so a long prompt's bulk transfer stops serializing behind its last
+  chunk in the handoff queue. Chunk boundaries rewrite already-filled
+  positions with identical bytes (the gather/forward/scatter round
+  trip is idempotent for untouched positions), so partial pages are
+  final the moment their chunk completes. Opportunistic: partials ship
+  only when the decode pool can already admit the whole request; a
+  request that finishes ON the prefill group (EOS at first token)
+  after shipping partials queues an abort marker that releases its
+  decode-side pages after any in-flight inserts land.
 - **SLO-aware admission** (inference/admission.py) is shared with the
   colocated engine: priority classes + per-request deadlines on
   ``submit()``, a priority queue with aging replacing FIFO, and
@@ -84,20 +104,49 @@ DISAGG_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "e2e_ms",
 _SHARED_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms", "e2e_ms")
 
 
+class _HandoffJob:
+    """One queued transfer: a slice of a request's prefill-side pages
+    (``src_pages[offset:]``) bound for the decode group. ``final``
+    carries the resume entry; ``abort`` releases the decode-side
+    allocation of a request that finished on the prefill group after
+    shipping partials."""
+
+    __slots__ = ("req", "src_pages", "offset", "final", "abort")
+
+    def __init__(self, req: Request, src_pages: List[int], offset: int,
+                 final: bool, abort: bool = False):
+        self.req = req
+        self.src_pages = src_pages
+        self.offset = int(offset)
+        self.final = final
+        self.abort = abort
+
+
 class _PrefillWorker(ServingEngine):
     """The prefill-group half: a ServingEngine that allocates KV pages
     for the PROMPT only and, instead of transitioning a completed
     prefill into a decode slot, vacates the slot (pages stay attached)
     and hands the request to the DisaggregatedEngine's handoff queue.
-    Requests that finish during prefill (EOS first token, single-token
-    budget) complete here and never touch the decode group."""
+    Mid-prompt chunks report through ``on_chunk`` (the chunked-prefill
+    handoff). Requests that finish during prefill (EOS first token,
+    single-token budget) complete here and never touch the decode
+    group."""
 
-    def __init__(self, *args, on_complete=None, **kw):
+    def __init__(self, *args, on_complete=None, on_chunk=None, **kw):
         self._on_complete_cb = on_complete
+        self._on_chunk_cb = on_chunk
         super().__init__(*args, **kw)
 
     def _alloc_tokens(self, req: Request) -> int:
         return int(req.prompt.size)     # generation lives elsewhere
+
+    def _on_prefill_chunk(self, slot_id: int):
+        if self._on_chunk_cb is not None:
+            slot = self._slots[slot_id]
+            self._on_chunk_cb(
+                slot.req,
+                list(self.mgr.tables.get(slot.req.req_id, ())),
+                slot.prefill_pos)
 
     def _on_prefill_complete(self, slot_id: int, first: int):
         slot = self._slots[slot_id]
@@ -144,14 +193,15 @@ class DisaggregatedEngine:
                  prefill_num_blocks: Optional[int] = None,
                  max_seq_len: Optional[int] = None, cache_dtype=None,
                  prefill_buckets=(32, 128), seed: int = 0,
-                 prefix_cache: bool = False, observability=False,
+                 prefix_cache: bool = False, kv_offload=False,
+                 observability=False,
                  fused_decode=None, aging_s: Optional[float] = None):
         pre_mesh, dec_mesh = self._resolve_groups(
             prefill_devices, decode_devices, mesh, prefill_tp,
             collective)
         self.cfg = cfg
         self.counters = {
-            "handoffs": 0, "handoff_traces": 0,
+            "handoffs": 0, "partial_handoffs": 0, "handoff_traces": 0,
             "kv_bytes_transferred": 0, "requests_submitted": 0,
             "drain_truncations": 0,
         }
@@ -184,9 +234,11 @@ class DisaggregatedEngine:
             params, cfg, capacity=prefill_slots, block_size=BS,
             num_blocks=prefill_num_blocks, max_seq_len=msl,
             cache_dtype=cache_dtype, prefill_buckets=prefill_buckets,
-            seed=seed, prefix_cache=prefix_cache, observability=pre_obs,
+            seed=seed, prefix_cache=prefix_cache, kv_offload=kv_offload,
+            observability=pre_obs,
             fused_decode=False, mesh=pre_mesh, aging_s=aging_s,
-            on_complete=self._on_prefilled)
+            on_complete=self._on_prefilled,
+            on_chunk=self._on_prefill_chunk)
         self.decode = ServingEngine(
             params, cfg, capacity=capacity, block_size=BS,
             num_blocks=num_blocks, max_seq_len=msl,
@@ -216,7 +268,11 @@ class DisaggregatedEngine:
         self._xfer_w = -(-msl // BS)
         self._extract_fn = None
         self._insert_fn = None
-        self._handoffs: Deque[Tuple[Request, List[int]]] = deque()
+        self._handoffs: Deque[_HandoffJob] = deque()
+        # started transfers whose donated insert lands at the top of
+        # the NEXT step (async double-buffering: <= 2 in flight)
+        self._inflight: Deque[Dict] = deque()
+        self._partial_sent: Dict[int, int] = {}   # req_id -> pages sent
         self._requests: List[Request] = []
         self._hand_stats = [0, 0.0, 0.0]    # count, sum_ms, max_ms
         self._t_first = self._t_last = None
@@ -295,8 +351,32 @@ class DisaggregatedEngine:
 
     @property
     def idle(self) -> bool:
-        return (not self._handoffs and self.prefill.idle
-                and self.decode.idle)
+        return (not self._handoffs and not self._inflight
+                and self.prefill.idle and self.decode.idle)
+
+    # -- fleet-router surface (inference/fleet.py) --------------------
+    @property
+    def queue_depth(self) -> int:
+        """Un-admitted work anywhere in the engine: both groups'
+        admission queues plus handoffs queued or in flight."""
+        return (len(self.prefill._queue) + len(self.decode._queue)
+                + len(self._handoffs) + len(self._inflight))
+
+    @property
+    def live_slots(self) -> int:
+        return self.prefill.live_slots + self.decode.live_slots
+
+    @property
+    def prefix_cache_version(self) -> int:
+        return self.prefill.prefix_cache_version
+
+    def prefix_summary(self):
+        """The radix tree lives on the prefill group (where admission
+        happens) — its summary IS this engine's warm-state summary."""
+        return self.prefill.prefix_summary()
+
+    def offload_metrics(self) -> Dict:
+        return self.prefill.offload_metrics()
 
     def drain(self, max_steps: Optional[int] = None) -> int:
         """Step until both groups and the handoff queue are empty
@@ -314,25 +394,89 @@ class DisaggregatedEngine:
         if self._obs is not None:
             self._obs.timeline.record(
                 "drain_truncated", steps=n,
-                handoff_queue_depth=len(self._handoffs))
+                handoff_queue_depth=(len(self._handoffs)
+                                     + len(self._inflight)))
 
     # -- handoff ------------------------------------------------------
+    def _need_pages(self, req: Request) -> int:
+        return -(-(int(req.prompt.size) + int(req.gen.max_new_tokens))
+                 // self.decode.block_size)
+
+    def _on_prefill_chunk(self, req: Request, pages: List[int],
+                          pos: int):
+        """Chunked-prefill handoff: a mid-prompt chunk completed —
+        queue the prompt pages it finished (every position < ``pos``
+        is final; later chunks rewrite them with identical bytes) as a
+        partial transfer. Opportunistic: skipped unless the decode
+        pool can already admit the WHOLE request, so a partial can
+        never strand a half-transferred prompt against backpressure."""
+        dec = self.decode
+        done = pos // self.block_size
+        sent = self._partial_sent.get(req.req_id, 0)
+        if done <= sent:
+            return
+        if req.req_id not in dec.mgr.tables:
+            if len(dec.mgr.free) < self._need_pages(req):
+                return
+            dec.mgr.allocate(req.req_id, int(req.prompt.size)
+                             + int(req.gen.max_new_tokens))
+        self._partial_sent[req.req_id] = done
+        self._handoffs.append(
+            _HandoffJob(req, pages[:done], sent, final=False))
+
     def _on_prefilled(self, req: Request, pages: Optional[List[int]]):
+        sent = self._partial_sent.pop(req.req_id, 0)
         if pages is None:
-            return          # finished on the prefill group
-        self._handoffs.append((req, pages))
+            # finished on the prefill group. If partials already went
+            # across, an abort marker releases the decode-side pages —
+            # queued BEHIND them so it lands after their inserts.
+            if req.req_id in self.decode.mgr.tables:
+                self._handoffs.append(
+                    _HandoffJob(req, [], sent, final=False, abort=True))
+            return
+        self._handoffs.append(_HandoffJob(req, pages, sent, final=True))
+
+    def _next_startable_job(self) -> Optional[int]:
+        """Index of the next job the transfer engine may start, or
+        None. FIFO, except that a job which allocates NOTHING (abort,
+        partial, or a final whose decode table already exists from its
+        partials) may overtake a page-blocked head: its pages are
+        already held, and completing it is the only way those pages
+        ever free — the _admit resume-overtake idiom, without which a
+        page-blocked short final ahead of a partial-allocated long
+        final deadlocks the engine. An allocating final never
+        overtakes (page fairness)."""
+        dec = self.decode
+        for i, job in enumerate(self._handoffs):
+            needs_alloc = (job.final and not job.abort
+                           and job.req.req_id not in dec.mgr.tables)
+            if not needs_alloc:
+                return i
+            if i == 0 and (len(dec.mgr.free)
+                           >= self._need_pages(job.req)):
+                return i
+            # page-blocked (or non-head) allocating final: waits
+        return None
 
     def _run_handoffs(self) -> bool:
+        """Land the inserts of transfers issued LAST step, then issue
+        new ones (double-buffered: at most two in flight). The gap
+        between issue and landing is where the device-to-device copy
+        overlaps this step's prefill chunk and decode dispatch."""
         did = False
-        while self._handoffs:
-            req, pages = self._handoffs[0]
-            need = -(-(int(req.prompt.size)
-                       + int(req.gen.max_new_tokens))
-                     // self.decode.block_size)
-            if len(self.decode.mgr.free) < need:
+        while self._inflight:
+            self._complete_transfer(self._inflight.popleft())
+            did = True
+        while self._handoffs and len(self._inflight) < 2:
+            idx = self._next_startable_job()
+            if idx is None:
                 break       # decode-pool backpressure: finish frees
-            self._handoffs.popleft()
-            self._transfer(req, pages)
+            job = self._handoffs[idx]
+            del self._handoffs[idx]
+            if job.abort:
+                self._inflight.append({"job": job})
+            else:
+                self._inflight.append(self._start_transfer(job))
             did = True
         return did
 
@@ -369,41 +513,46 @@ class DisaggregatedEngine:
             dm.shard(jnp.asarray(np.asarray(s)), dm.scale_spec)
             for s in self.prefill._kv_scales)
 
-    def _transfer(self, req: Request, src_pages: List[int]):
-        """Move one finished prefill's KV pages to the decode group:
-        extract -> device_put -> insert, then host-side page-table
-        translation (decode-side allocation came first so the dst
-        indices exist) and a resume entry into the decode group's
-        admission queue."""
+    def _start_transfer(self, job: _HandoffJob) -> Dict:
+        """Issue one transfer's extract -> device_put (the insert lands
+        next step): host-side page-table translation first (decode-side
+        allocation, reused across a request's partial windows), then
+        the jitted gather off the prefill pools and the async
+        device-to-device copy onto the decode group's sharding. A FINAL
+        job releases the request's prefill-side pages here — the
+        extract already captured their bytes (functional arrays), and
+        the radix tree's refcounted shares survive (warm prefix matches
+        keep hitting on this group)."""
         pre, dec = self.prefill, self.decode
+        req = job.req
         if self._extract_fn is None:
             self._extract_fn, self._insert_fn = self._build_handoff_fns()
         if self._quant and dec._kv_scales is None:
             self._sync_scales()
         t0 = time.perf_counter()
-        S = int(req.prompt.size)
-        n_src = len(src_pages)
-        total = S + int(req.gen.max_new_tokens)
+        total = int(req.prompt.size) + int(req.gen.max_new_tokens)
         # decode-side allocation IS the page-table translation: the
         # request's table on this group is a fresh set of physical
         # pages; the first len(src_pages) receive the prompt's KV, the
-        # rest are decode headroom
+        # rest are decode headroom. Partial windows extend one table.
         dst_table = dec.mgr.allocate(req.req_id, total)
+        src = job.src_pages[job.offset:]
+        n = len(src)
         W = self._xfer_w
         src_idx = np.zeros((W,), np.int32)
         dst_idx = np.zeros((W,), np.int32)
-        src_idx[:n_src] = src_pages
-        dst_idx[:n_src] = dst_table[:n_src]
+        src_idx[:n] = src
+        dst_idx[:n] = dst_table[job.offset:job.offset + n]
         cfgv = self.cfg
         L, KV, hd = (cfgv.num_hidden_layers,
                      cfgv.num_key_value_heads, cfgv.head_dim)
         BS = self.block_size
         itemsize = jnp.dtype(pre._k_pools.dtype).itemsize
-        nbytes = 2 * L * n_src * BS * KV * hd * itemsize
+        nbytes = 2 * L * n * BS * KV * hd * itemsize
         task = None
         if self._flight is not None:
             task = self._flight.begin(
-                "kv_handoff", "xfer", (2 * L, n_src * BS, KV * hd),
+                "kv_handoff", "xfer", (2 * L, n * BS, KV * hd),
                 str(jnp.dtype(pre._k_pools.dtype)))
         kpag, vpag = self._extract_fn(pre._k_pools, pre._v_pools,
                                       pre._mesh.replicate(src_idx))
@@ -412,44 +561,73 @@ class DisaggregatedEngine:
         kpag = jax.device_put(kpag, sh)
         vpag = jax.device_put(vpag, sh)
         t2 = time.perf_counter()
+        if job.final:
+            pre.mgr.release(req.req_id)
+        return {"job": job, "kpag": kpag, "vpag": vpag,
+                "dst_idx": dst_idx, "pages": n, "nbytes": nbytes,
+                "task": task, "t0": t0, "t1": t1, "t2": t2}
+
+    def _complete_transfer(self, st: Dict):
+        """Land one transfer: the donated insert into the decode pools,
+        then (final jobs only) the resume entry into the decode group's
+        admission queue — pushed strictly after the insert, so the
+        decode group never admits onto half-arrived pages. Abort
+        markers release the decode-side allocation instead (their
+        request finished on the prefill group)."""
+        job = st["job"]
+        req = job.req
+        dec = self.decode
+        if job.abort:
+            dec.mgr.release(req.req_id)
+            if self._obs is not None:
+                self._obs.timeline.record("handoff_abort", req.req_id)
+            return
         dec._k_pools, dec._v_pools = self._insert_fn(
-            dec._k_pools, dec._v_pools, dec._mesh.replicate(dst_idx),
-            kpag, vpag)
+            dec._k_pools, dec._v_pools,
+            dec._mesh.replicate(st["dst_idx"]), st["kpag"], st["vpag"])
         t3 = time.perf_counter()
-        if task is not None:
-            self._flight.end(task)
-        # prefill-side release: the radix tree's refcounted shares
-        # survive (warm prefix matches keep hitting on this group)
-        pre.mgr.release(req.req_id)
+        if st["task"] is not None:
+            self._flight.end(st["task"])
+        self.counters["kv_bytes_transferred"] += st["nbytes"]
+        dur_ms = (t3 - st["t0"]) * 1e3
+        phase_ms = {
+            "extract_ms": round((st["t1"] - st["t0"]) * 1e3, 3),
+            "put_ms": round((st["t2"] - st["t1"]) * 1e3, 3),
+            "insert_ms": round((t3 - st["t2"]) * 1e3, 3),
+        }
+        if not job.final:
+            self.counters["partial_handoffs"] += 1
+            if self._obs is not None:
+                self._obs.timeline.record(
+                    "handoff_partial", req.req_id, dur_ms=dur_ms,
+                    pages=st["pages"], bytes=st["nbytes"], **phase_ms)
+            return
         # resume entry for the decode group: carry = (prompt length,
         # first sampled token) — exactly the colocated engine's
         # decode-entry state, so generation continues bit-identically.
         # started=True: the admission SLO was met at prefill admission
-        req.resume = (S, int(req.tokens[-1]))
+        req.resume = (int(req.prompt.size), int(req.tokens[-1]))
         req.qentry = dec._queue.push(req, cls=req.priority,
                                      submit_t=req.submit_t,
                                      started=True)
-        dur_ms = (t3 - t0) * 1e3
         self.counters["handoffs"] += 1
-        self.counters["kv_bytes_transferred"] += nbytes
-        st = self._hand_stats
-        st[0] += 1
-        st[1] += dur_ms
-        st[2] = max(st[2], dur_ms)
+        hs = self._hand_stats
+        hs[0] += 1
+        hs[1] += dur_ms
+        hs[2] = max(hs[2], dur_ms)
         if self._obs is not None:
             self._obs.hist("handoff_ms").observe(dur_ms)
             self._obs.timeline.record(
-                "handoff", req.req_id, dur_ms=dur_ms, pages=n_src,
-                bytes=nbytes,
-                extract_ms=round((t1 - t0) * 1e3, 3),
-                put_ms=round((t2 - t1) * 1e3, 3),
-                insert_ms=round((t3 - t2) * 1e3, 3))
+                "handoff", req.req_id, dur_ms=dur_ms,
+                pages=st["pages"], bytes=st["nbytes"], **phase_ms)
 
     # -- reporting ----------------------------------------------------
     def scheduler_snapshot(self) -> Dict:
-        return {"handoff_queue_depth": len(self._handoffs),
-                "handoffs_pending": [r.req_id
-                                     for r, _ in list(self._handoffs)[:16]],
+        return {"handoff_queue_depth": (len(self._handoffs)
+                                        + len(self._inflight)),
+                "handoff_inflight": len(self._inflight),
+                "handoffs_pending": [j.req.req_id
+                                     for j in list(self._handoffs)[:16]],
                 "prefill": self.prefill.scheduler_snapshot(),
                 "decode": self.decode.scheduler_snapshot()}
 
@@ -483,7 +661,8 @@ class DisaggregatedEngine:
         sched["preemptions"] = dec_c["preemptions"]
         sched["requeues"] = dec_c["requeues"]
         sched["deadline_expired"] = pre_c["deadline_expired"]
-        sched["handoff_queue_depth"] = len(self._handoffs)
+        sched["handoff_queue_depth"] = (len(self._handoffs)
+                                        + len(self._inflight))
         c["scheduler"] = sched
         c["groups"] = {"prefill": self.prefill.metrics(),
                        "decode": self.decode.metrics()}
@@ -506,8 +685,9 @@ class DisaggregatedEngine:
         """Restart the measurement window on the orchestrator AND both
         groups (each group's retrace watchdog arms; the handoff trace
         counter is cumulative like every trace counter)."""
-        for k in ("handoffs", "kv_bytes_transferred",
-                  "requests_submitted", "drain_truncations"):
+        for k in ("handoffs", "partial_handoffs",
+                  "kv_bytes_transferred", "requests_submitted",
+                  "drain_truncations"):
             self.counters[k] = 0
         self._hand_stats = [0, 0.0, 0.0]
         self._t_first = self._t_last = None
@@ -585,6 +765,11 @@ class DisaggregatedEngine:
                 specs.append(dataclasses.replace(
                     s, name="disagg_page_copy",
                     tags=s.tags + ("disagg",)))
+            elif "kv_spill" in s.name or "kv_restore" in s.name:
+                # the prefill group's host-tier handoff pair
+                specs.append(dataclasses.replace(
+                    s, name="disagg_" + s.name[len("serving_"):],
+                    tags=s.tags + ("disagg",)))
         # fresh jit instances for the handoff pair (auditing must not
         # disturb the live programs' caches)
         ext, ins = self._build_handoff_fns()
@@ -621,7 +806,8 @@ class DisaggregatedEngine:
             snaps.append((eng.counters,
                           {k: copy.deepcopy(eng.counters[k])
                            for k in ("decode_traces", "prefill_traces",
-                                     "calibration_traces")}))
+                                     "calibration_traces",
+                                     "offload_traces")}))
         h_snap = self.counters["handoff_traces"]
         try:
             reports = [_audit(s)

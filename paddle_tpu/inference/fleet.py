@@ -1,0 +1,467 @@
+"""ServingFleet: prefix-aware replica router over N serving engines.
+
+PRs 1-10 built one serving engine per chip group — colocated
+(serving.py), tensor-parallel (tp.py), disaggregated (disagg.py). The
+north star of serving millions of users needs the layer ABOVE: many
+engine replicas behind one router. The naive router (round-robin,
+least-loaded) destroys the thing the radix prefix cache spent ten PRs
+building — warm KV state is REPLICA-LOCAL, and a warm request routed
+to the wrong replica pays a full cold prefill. This module is the
+TPU-native analog of the reference's hybrid-orchestration layer
+(SURVEY §2.4) applied to serving: route work to where the state
+already lives.
+
+- **Tree-summary protocol.** Each replica's radix prefix cache exports
+  a page-aligned summary ``{hash(token_prefix): n_tokens}`` plus a
+  monotone ``version`` (``ServingEngine.prefix_summary()`` /
+  ``prefix_cache_version``); the router caches the summary per replica
+  and refreshes only when the version moves. Summaries include SPILLED
+  nodes — a prefix living in a replica's host-RAM tier is still warm
+  there (it restores on admission), which is exactly why the offload
+  tier and the router ship together: warm state stops dying at the HBM
+  boundary, and the router keeps finding it.
+- **Prefix-aware routing** (``policy="prefix"``, default): hash the
+  prompt's page-aligned prefixes longest-first against every replica's
+  summary; the longest match wins (ties: least loaded, then lowest
+  index). A cold prompt falls back to least-loaded placement with a
+  round-robin tie-break so an idle fleet spreads cold work instead of
+  piling it on replica 0.
+- **Per-replica admission backpressure**: a replica whose un-admitted
+  queue is at ``max_queue_depth`` is not a routing candidate while any
+  other replica has headroom — a warm request whose home replica is
+  saturated DIVERTS to a cold replica (counted, so the warm-hit ratio
+  honestly reflects the tradeoff) rather than queueing behind it.
+- ``policy="round_robin"`` / ``"least_loaded"`` keep the naive
+  placements available as A/B baselines (``bench.py serving_fleet``
+  measures the warm-hit gap between them and prefix routing).
+
+Replicas are any mix of engine kinds — colocated ``ServingEngine``
+(with or without mesh/prefix cache/offload) and
+``DisaggregatedEngine`` expose the same ``submit/step/drain/metrics``
+surface plus the router protocol (``queue_depth``, ``live_slots``,
+``prefix_summary``). Greedy output is per-request deterministic on
+every engine kind (the PR-1..10 parity contracts), so fleet output is
+bit-identical to a single colocated engine REGARDLESS of placement —
+asserted in tier-1 over mixed-kind fleets.
+
+The router is pure host-side bookkeeping: no device work, no new
+programs, zero retraces. ``step()`` round-robins one scheduler
+iteration per replica; each replica's device work streams
+independently (on real multi-chip fleets each replica owns its chips —
+the forced-host CPU tier-1 runs prove structure, not chip perf).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..observability import Observability
+from .generation import GenerationConfig
+from .serving import Request, _drain_loop
+
+__all__ = ["ServingFleet"]
+
+# request-level distributions shared BY REFERENCE with every
+# observability-enabled replica (the disagg engine's idiom): a request
+# admits and finishes on its replica, but its TTFT/TPOT must land in
+# ONE fleet-wide distribution wherever it ran
+FLEET_SHARED_HISTOGRAMS = ("ttft_ms", "tpot_ms", "queue_wait_ms",
+                           "e2e_ms")
+# ...plus what only the router can time
+FLEET_HISTOGRAMS = FLEET_SHARED_HISTOGRAMS + ("step_ms",)
+
+_POLICIES = ("prefix", "least_loaded", "round_robin")
+
+
+class _Replica:
+    """Router-side handle: the engine plus its cached tree summary."""
+
+    __slots__ = ("name", "engine", "bs", "version", "summary",
+                 "max_tokens", "routed", "warm_routed")
+
+    def __init__(self, name: str, engine):
+        self.name = name
+        self.engine = engine
+        self.bs = int(engine.block_size)
+        self.version = -1            # forces the first refresh
+        self.summary: Dict[int, int] = {}
+        self.max_tokens = 0          # longest cached path, in tokens
+        self.routed = 0
+        self.warm_routed = 0
+
+    def refresh(self) -> Dict[int, int]:
+        v = self.engine.prefix_cache_version
+        if v != self.version:
+            self.summary = self.engine.prefix_summary()
+            self.max_tokens = max(self.summary.values(), default=0)
+            self.version = v
+        return self.summary
+
+    @property
+    def load(self) -> Tuple[int, int]:
+        return (self.engine.queue_depth, self.engine.live_slots)
+
+
+class ServingFleet:
+    """N engine replicas behind one prefix-aware router.
+
+    ``replicas`` is a list of engines or ``(name, engine)`` pairs (a
+    bare list names them ``replica0..N-1``). ``submit()`` routes one
+    request and returns the replica's :class:`Request`; ``step()``
+    runs one scheduler iteration on every replica; ``drain()`` steps
+    until the whole fleet is idle. ``metrics()`` reports the routing
+    counters (warm/cold/diverted + warm-hit ratio), per-replica queue
+    depth/load, the aggregated host-tier spill/restore report, and
+    each replica's full engine metrics under ``"replicas"``.
+    """
+
+    def __init__(self, replicas, policy: str = "prefix",
+                 max_queue_depth: Optional[int] = None,
+                 observability=False):
+        if not replicas:
+            raise ValueError("ServingFleet needs at least one replica")
+        self._replicas: List[_Replica] = []
+        for i, r in enumerate(replicas):
+            name, eng = (r if isinstance(r, (tuple, list))
+                         else (f"replica{i}", r))
+            self._replicas.append(_Replica(str(name), eng))
+        names = [r.name for r in self._replicas]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate replica names: {names}")
+        if len({id(r.engine) for r in self._replicas}) != len(names):
+            raise ValueError("the same engine object appears twice — "
+                             "each replica needs its own engine")
+        if policy not in _POLICIES:
+            raise ValueError(f"policy must be one of {_POLICIES}, "
+                             f"got {policy!r}")
+        self.policy = policy
+        self.max_queue_depth = (None if max_queue_depth is None
+                                else int(max_queue_depth))
+        if self.max_queue_depth is not None and self.max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1 (or None)")
+        self.counters = {
+            "requests_submitted": 0, "routed_warm": 0, "routed_cold": 0,
+            "routed_diverted": 0, "fleet_steps": 0,
+            "drain_truncations": 0,
+        }
+        self._rr_next = 0            # round_robin policy cursor
+        self._rr_cold = 0            # cold-placement tie-break cursor
+        self._requests: List[Request] = []
+        self._t_first = self._t_last = None
+        self._metrics_reset_t = None
+        self.last_drain_truncated = False
+        if observability:
+            self._obs = (observability
+                         if isinstance(observability, Observability)
+                         else Observability(histograms=FLEET_HISTOGRAMS))
+            self._obs.registry.adopt_counters(self.counters)
+            self._share_histograms()
+        else:
+            self._obs = None
+
+    def _share_histograms(self):
+        """Point every observability-enabled replica's request-level
+        latency histograms at the fleet-level objects (replicas without
+        observability keep their zero-overhead None harness and simply
+        don't feed the fleet distributions). A disaggregated replica
+        re-shares onward to its two workers."""
+        for rep in self._replicas:
+            obs = rep.engine.observability
+            if obs is None:
+                continue
+            for name in FLEET_SHARED_HISTOGRAMS:
+                obs.registry.histograms[name] = \
+                    self._obs.registry.histogram(name)
+            resh = getattr(rep.engine, "_share_histograms", None)
+            if resh is not None:
+                resh()
+
+    # -- routing ------------------------------------------------------
+    def _match_tokens(self, rep: _Replica,
+                      toks: Tuple[int, ...]) -> int:
+        """Longest page-aligned cached prefix of the prompt (as an
+        int tuple) on ``rep``, in tokens. Capped at ``len(prompt) - 1``
+        full pages — mirroring admission's cap, so the router never
+        scores a match the engine could not use. Hash collisions are
+        guarded by the stored token length (a colliding entry of the
+        wrong length cannot match)."""
+        summ = rep.refresh()
+        if not summ:
+            return 0
+        bs = rep.bs
+        # cap the scan at the replica's LONGEST cached path: probing a
+        # prefix longer than anything it holds is wasted hashing (the
+        # cold-prompt routing hot path would otherwise pay
+        # O(len(prompt)^2 / bs) element-hashes per replica)
+        top = min((len(toks) - 1) // bs, rep.max_tokens // bs)
+        for k in range(top, 0, -1):
+            if summ.get(hash(toks[:k * bs])) == k * bs:
+                return k * bs
+        return 0
+
+    def _route(self, prompt: np.ndarray) -> Tuple[_Replica, int, bool]:
+        """Pick a replica: ``(replica, matched_tokens, diverted)``.
+        The naive policies still SCORE the chosen replica (summaries
+        are cached, the probe is cheap), so their warm_hit_ratio is a
+        real measurement of lucky warm landings — the A/B baseline the
+        bench banks, not a constant 0."""
+        reps = self._replicas
+        toks = tuple(int(t) for t in prompt)
+        if self.policy == "round_robin":
+            r = reps[self._rr_next % len(reps)]
+            self._rr_next += 1
+            return r, self._match_tokens(r, toks), False
+        cap = self.max_queue_depth
+        open_ = [i for i, r in enumerate(reps)
+                 if cap is None or r.engine.queue_depth < cap]
+        if not open_:                 # whole fleet saturated: least
+            open_ = list(range(len(reps)))      # loaded still wins
+        diverted = False
+        if self.policy == "prefix":
+            scores = [self._match_tokens(r, toks) for r in reps]
+            best = max(scores)
+            if best > 0:
+                warm_open = [i for i in open_ if scores[i] == best]
+                if warm_open:
+                    i = min(warm_open,
+                            key=lambda j: (reps[j].load, j))
+                    return reps[i], best, False
+                # the warm home replica(s) are saturated: divert
+                # instead of queueing behind them — to the best
+                # SHORTER match still open (a partial prefix skip
+                # beats a full cold prefill), else to cold capacity
+                diverted = True
+                warm_any = [i for i in open_ if scores[i] > 0]
+                if warm_any:
+                    sub = max(scores[i] for i in warm_any)
+                    cands = [i for i in warm_any if scores[i] == sub]
+                    i = min(cands, key=lambda j: (reps[j].load, j))
+                    return reps[i], sub, True
+        lo = min(reps[j].load for j in open_)
+        cands = [j for j in open_ if reps[j].load == lo]
+        i = cands[self._rr_cold % len(cands)]
+        self._rr_cold += 1
+        matched = (self._match_tokens(reps[i], toks)
+                   if self.policy == "least_loaded" else 0)
+        return reps[i], matched, diverted
+
+    # -- public API ---------------------------------------------------
+    def submit(self, prompt, gen: Optional[GenerationConfig] = None,
+               priority: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Request:
+        """Route one request onto a replica and enqueue it there.
+        Returns the replica engine's :class:`Request` — lifecycle,
+        output and SLO semantics are the replica's own."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        rep, matched, diverted = self._route(prompt)
+        req = rep.engine.submit(prompt, gen, priority=priority,
+                                deadline_s=deadline_s)
+        rep.routed += 1
+        self.counters["requests_submitted"] += 1
+        if matched > 0:
+            rep.warm_routed += 1
+            self.counters["routed_warm"] += 1
+        else:
+            self.counters["routed_cold"] += 1
+        if diverted:
+            self.counters["routed_diverted"] += 1
+        self._requests.append(req)
+        if self._obs is not None:
+            self._obs.timeline.record(
+                "route", req.req_id, replica=rep.name,
+                matched_tokens=matched,
+                **({"diverted": True} if diverted else {}))
+        return req
+
+    def step(self) -> bool:
+        """One scheduler iteration on every replica (their device work
+        streams run independently). Returns True if any replica did
+        work."""
+        obs = self._obs
+        t0 = time.perf_counter() if obs is not None else 0.0
+        if self._t_first is None:
+            self._t_first = time.perf_counter()
+        did = False
+        for rep in self._replicas:
+            did = rep.engine.step() or did
+        self.counters["fleet_steps"] += 1
+        if did:
+            self._t_last = time.perf_counter()
+        if obs is not None:
+            now = time.perf_counter()
+            if did:
+                obs.hist("step_ms").observe((now - t0) * 1e3)
+            obs.sample_gauges(now, {
+                f"queue_depth[{r.name}]": r.engine.queue_depth
+                for r in self._replicas})
+        return did
+
+    @property
+    def idle(self) -> bool:
+        return all(r.engine.idle for r in self._replicas)
+
+    def drain(self, max_steps: Optional[int] = None) -> int:
+        """Step until every replica is idle (shared ``_drain_loop``
+        semantics: capped drains record truncation, fleet-wide
+        starvation raises after a stall dump)."""
+        return _drain_loop(
+            self, max_steps,
+            starve_reason="fleet drain starved: no replica can make "
+                          "progress",
+            starve_error="fleet starved: no replica can admit its "
+                         "queued requests (KV pools too small for the "
+                         "in-flight mix?)")
+
+    def _drain_truncated_event(self, n: int):
+        if self._obs is not None:
+            self._obs.timeline.record(
+                "drain_truncated", steps=n,
+                queue_depths={r.name: r.engine.queue_depth
+                              for r in self._replicas})
+
+    # -- reporting ----------------------------------------------------
+    def scheduler_snapshot(self) -> Dict:
+        return {
+            "policy": self.policy,
+            "queue_depths": {r.name: r.engine.queue_depth
+                             for r in self._replicas},
+            "live_slots": {r.name: r.engine.live_slots
+                           for r in self._replicas},
+            "replicas": {r.name: r.engine.scheduler_snapshot()
+                         for r in self._replicas},
+        }
+
+    def metrics(self) -> Dict:
+        c = self.counters
+        rm = {r.name: r.engine.metrics() for r in self._replicas}
+        wall = ((self._t_last - self._t_first)
+                if self._t_first is not None and self._t_last is not None
+                else 0.0)
+        gen_tokens = sum(m["tokens_generated"] for m in rm.values())
+        routed = c["routed_warm"] + c["routed_cold"]
+        cut = self._metrics_reset_t
+        ttfts = [r.ttft for r in self._requests
+                 if r.ttft is not None
+                 and (cut is None or (r.first_token_t or 0.0) >= cut)]
+        off: Dict[str, int] = {}
+        for r in self._replicas:
+            for k, v in r.engine.offload_metrics().items():
+                off[k] = off.get(k, 0) + v
+        m = {
+            "replicas_n": len(self._replicas),
+            "requests_submitted": c["requests_submitted"],
+            "requests_completed": sum(mm["requests_completed"]
+                                      for mm in rm.values()),
+            "tokens_generated": gen_tokens,
+            "tokens_per_sec": (round(gen_tokens / wall, 3)
+                               if wall > 0 else 0.0),
+            "wall_time_s": round(wall, 6),
+            "fleet_steps": c["fleet_steps"],
+            "drain_truncations": c["drain_truncations"],
+            "ttft_ms_mean": (round(float(np.mean(ttfts)) * 1e3, 3)
+                             if ttfts else None),
+            "ttft_ms_max": (round(float(np.max(ttfts)) * 1e3, 3)
+                            if ttfts else None),
+            "routing": {
+                "policy": self.policy,
+                "warm": c["routed_warm"],
+                "cold": c["routed_cold"],
+                "diverted": c["routed_diverted"],
+                "warm_hit_ratio": (round(c["routed_warm"] / routed, 4)
+                                   if routed else 0.0),
+                "per_replica": {
+                    r.name: {"routed": r.routed,
+                             "warm_routed": r.warm_routed,
+                             "queue_depth": r.engine.queue_depth,
+                             "live_slots": r.engine.live_slots}
+                    for r in self._replicas},
+            },
+            "offload": off,
+            "replicas": rm,
+        }
+        if self._obs is not None:
+            obs = self._obs
+            m["latency"] = obs.latency_snapshot()
+            m["gauges"] = obs.gauges_snapshot()
+            # replicas own their watchdogs; the fleet report must
+            # surface ANY steady-state retrace in the fleet
+            m["retrace_warnings"] = sum(
+                mm.get("retrace_warnings", 0) for mm in rm.values())
+            m["stall_dumps"] = (len(obs.stall_dumps)
+                                + obs.stall_dumps_suppressed)
+            m["timeline_events"] = len(obs.timeline)
+            m["timeline_dropped"] = obs.timeline.dropped
+        return m
+
+    def reset_metrics(self):
+        """Restart the measurement window on the router AND every
+        replica (each replica's retrace watchdog arms)."""
+        for k in ("requests_submitted", "routed_warm", "routed_cold",
+                  "routed_diverted", "fleet_steps", "drain_truncations"):
+            self.counters[k] = 0
+        for r in self._replicas:
+            r.routed = r.warm_routed = 0
+            r.engine.reset_metrics()
+        self._requests = [r for r in self._requests if not r.done]
+        self._t_first = self._t_last = None
+        self._metrics_reset_t = time.perf_counter()
+        if self._obs is not None:
+            # the replicas' reset_window() replaced their histogram
+            # objects — restart the fleet window and re-share so every
+            # replica feeds the fleet distributions again
+            self._obs.reset_window()
+            self._share_histograms()
+
+    # -- observability export -----------------------------------------
+    @property
+    def observability(self) -> Optional[Observability]:
+        return self._obs
+
+    def _require_obs(self) -> Observability:
+        if self._obs is None:
+            raise RuntimeError(
+                "observability is disabled for this fleet; construct "
+                "with ServingFleet(..., observability=True)")
+        return self._obs
+
+    def export_trace(self, path: str) -> str:
+        return self._require_obs().export_chrome(
+            path, process_name="paddle_tpu serving fleet")
+
+    def write_timeline(self, path: str) -> str:
+        return self._require_obs().write_jsonl(
+            path, header={"mode": "serving", "fleet": True,
+                          "policy": self.policy,
+                          "replicas": [r.name for r in self._replicas]})
+
+    # -- static program audit -----------------------------------------
+    def program_specs(self, register: bool = True):
+        """Every replica's programs, names prefixed ``fleet.<name>.``
+        so a mixed fleet's full program set audits side by side. The
+        router itself owns no programs."""
+        import dataclasses
+        specs = []
+        for r in self._replicas:
+            for s in r.engine.program_specs(register=False):
+                specs.append(dataclasses.replace(
+                    s, name=f"fleet.{r.name}.{s.name}",
+                    tags=s.tags + ("fleet",)))
+        if register:
+            from ..analysis import REGISTRY
+            for s in specs:
+                REGISTRY.register(s)
+        return specs
+
+    def audit(self, register: bool = True):
+        """Static audit of every replica's programs (trace-only; each
+        replica's pinned trace counters snapshot/restore)."""
+        from ..analysis import audit_spec as _audit, publish_findings
+        reports = []
+        for r in self._replicas:
+            reports.extend(r.engine.audit(register=False))
+        if register:
+            self.program_specs(register=True)
+        publish_findings(reports, counters=self.counters, obs=self._obs)
+        return reports

@@ -30,11 +30,23 @@ RadixAttention, applied to the pools of ``ops.paged_attention``):
   cascading upward as parents become leaves. It runs on demand through
   ``BlockManager.reclaim`` when the free list is dry, so a full pool
   degrades to per-request allocation instead of failing admission.
+- HOST-RAM OFFLOAD TIER (``spill_page``/``restore_page`` supplied by
+  the pool owner): instead of destroying a warm page, eviction SPILLS
+  its bytes to host memory (one jitted single-page extract followed by
+  ``device_put`` onto the host memory space — pinned where the backend
+  offers it) and the node stays in the tree with ``page=None``. A
+  later prefix hit on a spilled node RESTORES the page through the
+  same machinery in the opposite direction (``device_put`` back +
+  donated single-page insert), byte-identical to what was spilled —
+  effective prefix-cache capacity becomes HBM + host RAM. A finished
+  request whose pages re-cover a spilled node re-adopts its device
+  pages directly (no device copy). ``host_budget_pages`` bounds the
+  tier; past it the LRU childless spilled node is dropped for real.
 
 The cache is pure host-side bookkeeping: the only device work it ever
-issues is the one-page COW copy (a single jitted program, traced once).
-Decode and prefill programs are unchanged in shape and count — cache
-hits cause zero retraces.
+issues is the one-page COW copy and the spill/restore pair (three
+jitted programs, traced once each). Decode and prefill programs are
+unchanged in shape and count — cache hits cause zero retraces.
 """
 from __future__ import annotations
 
@@ -45,7 +57,41 @@ import numpy as np
 
 from ..ops.paged_attention import BlockManager
 
-__all__ = ["PrefixCache", "PagedKVCacheStore"]
+__all__ = ["PrefixCache", "PagedKVCacheStore", "host_put"]
+
+# resolved on first host_put PER PLATFORM (a process can host mixed
+# TPU + CPU engines): platform -> memory kind ("" = numpy fallback)
+_HOST_MEMORY_KIND: Dict[str, str] = {}
+
+
+def host_put(x):
+    """Move an array's bytes into HOST memory via ``jax.device_put`` —
+    ``pinned_host`` where the backend offers it (TPU), the backend's
+    unpinned host space otherwise (CPU PjRt), plain numpy as the last
+    resort. The bytes are preserved exactly (raw copy, no cast), which
+    is what makes the spill/restore byte-identity contract provable."""
+    import jax
+    dev = next(iter(x.devices()))
+    kind = _HOST_MEMORY_KIND.get(dev.platform)
+    if kind is None:
+        for kind in ("pinned_host", "unpinned_host"):
+            try:
+                y = jax.device_put(
+                    x, jax.sharding.SingleDeviceSharding(
+                        dev, memory_kind=kind))
+                _HOST_MEMORY_KIND[dev.platform] = kind
+                return y
+            except (ValueError, NotImplementedError):
+                continue
+        _HOST_MEMORY_KIND[dev.platform] = kind = ""
+    if kind:
+        try:
+            return jax.device_put(
+                x, jax.sharding.SingleDeviceSharding(
+                    dev, memory_kind=kind))
+        except (ValueError, NotImplementedError):
+            pass    # degrade mid-eviction rather than crash admission
+    return np.asarray(x)
 
 
 class _Node:
@@ -55,9 +101,15 @@ class _Node:
     the page holds. A node with ``len(tokens) == block_size`` is a full
     page: shareable in place and extendable with children. A shorter
     node is a partial tail: leaf-only, handed out via COW fork, and
-    upgradeable in place when a later insert extends it."""
+    upgradeable in place when a later insert extends it.
 
-    __slots__ = ("tokens", "page", "children", "parent", "last_used")
+    With the offload tier a node is either RESIDENT (``page`` set,
+    ``host`` None) or SPILLED (``page`` None, ``host`` holding the
+    page's bytes in host memory); spilled nodes stay matchable and
+    restore on demand."""
+
+    __slots__ = ("tokens", "page", "children", "parent", "last_used",
+                 "host")
 
     def __init__(self, tokens: Tuple[int, ...], page: Optional[int],
                  parent: Optional["_Node"]):
@@ -66,6 +118,7 @@ class _Node:
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.parent = parent
         self.last_used = 0
+        self.host = None
 
 
 def _common(a: Sequence[int], b: Sequence[int]) -> int:
@@ -82,18 +135,42 @@ class PrefixCache:
     ``copy_page(src, dst)`` is supplied by the pool owner (ServingEngine
     or PagedKVCacheStore) and device-copies one physical page — the COW
     primitive. The cache installs itself as the manager's ``reclaim``
-    callback so allocation pressure drives eviction."""
+    callback so allocation pressure drives eviction.
+
+    ``spill_page(page) -> payload`` / ``restore_page(payload, dst)``
+    (both supplied, or neither) enable the host-RAM offload tier:
+    eviction spills instead of dropping, and a prefix hit on a spilled
+    node restores before sharing. ``host_budget_pages`` caps the tier
+    (None = unbounded); past it the LRU childless spilled node dies."""
 
     def __init__(self, mgr: BlockManager, block_size: int,
-                 copy_page: Callable[[int, int], None]):
+                 copy_page: Callable[[int, int], None],
+                 spill_page: Optional[Callable[[int], object]] = None,
+                 restore_page: Optional[Callable[[object, int],
+                                                 None]] = None,
+                 host_budget_pages: Optional[int] = None):
+        if (spill_page is None) != (restore_page is None):
+            raise ValueError("spill_page and restore_page come as a "
+                             "pair: a tier that can spill but not "
+                             "restore would silently drop warm KV")
         self.mgr = mgr
         self.bs = int(block_size)
         self.copy_page = copy_page
+        self._spill = spill_page
+        self._restore = restore_page
+        self.host_budget = (None if host_budget_pages is None
+                            else int(host_budget_pages))
         self.root = _Node((), None, None)
         self._tick = 0
+        self._host_pages = 0
+        # bumped on every structural change (insert/evict/spill/
+        # restore/drop): the fleet router's tree-summary staleness check
+        self.version = 0
         self.stats = {"hits": 0, "misses": 0, "tokens_skipped": 0,
                       "shared_pages": 0, "cow_forks": 0,
-                      "evicted_pages": 0, "inserted_pages": 0}
+                      "evicted_pages": 0, "inserted_pages": 0,
+                      "spilled_pages": 0, "restored_pages": 0,
+                      "readopted_pages": 0, "host_evicted_pages": 0}
         mgr.reclaim = self.evict
 
     # -- introspection ------------------------------------------------
@@ -106,12 +183,19 @@ class PrefixCache:
 
     @property
     def cached_pages(self) -> int:
-        return sum(1 for _ in self._walk())
+        """Device-RESIDENT tree pages (spilled nodes hold no page)."""
+        return sum(1 for n in self._walk() if n.page is not None)
+
+    @property
+    def host_pages(self) -> int:
+        """Pages currently living in the host tier."""
+        return self._host_pages
 
     def evictable_count(self) -> int:
         """Pages reclaimable right now: nodes whose whole subtree is
         unpinned (refcount 1, i.e. tree-only — eviction is leaf-first,
-        so a pinned descendant blocks its ancestors)."""
+        so a pinned descendant blocks its ancestors; a spilled node
+        holds no page and pins nothing)."""
         def walk(n: _Node) -> Tuple[int, bool]:
             cnt, free_sub = 0, True
             for ch in n.children.values():
@@ -120,6 +204,8 @@ class PrefixCache:
                 free_sub = free_sub and f
             if n is self.root:
                 return cnt, False
+            if n.page is None:
+                return cnt, free_sub
             if free_sub and int(self.mgr.refcount[n.page]) == 1:
                 return cnt + 1, True
             return cnt, False
@@ -129,7 +215,28 @@ class PrefixCache:
         m = dict(self.stats)
         m["cached_pages"] = self.cached_pages
         m["evictable_pages"] = self.evictable_count()
+        m["host_pages"] = self._host_pages
         return m
+
+    def summary(self) -> Dict[int, int]:
+        """The fleet router's tree summary: ``{prefix_hash: n_tokens}``
+        for every page-aligned cached path (resident AND spilled — a
+        spilled node is still a warm hit; it restores on acquire).
+        Hashes are over the token-id tuple from the root, so a router
+        can test "does this replica hold the first k pages of this
+        prompt" without holding the tree itself; ``version`` tells it
+        when a cached summary went stale."""
+        out: Dict[int, int] = {}
+
+        def walk(node: _Node, toks: Tuple[int, ...]):
+            for ch in node.children.values():
+                if len(ch.tokens) != self.bs:
+                    continue        # partial tails: page-aligned only
+                t = toks + ch.tokens
+                out[hash(t)] = len(t)
+                walk(ch, t)
+        walk(self.root, ())
+        return out
 
     # -- lookup -------------------------------------------------------
     def _touch(self, node: Optional[_Node]):
@@ -174,26 +281,45 @@ class PrefixCache:
         (wait; nothing mutated) when they do not, else
         ``(pages, matched_tokens, n_shared)`` where every returned page
         carries exactly one reference owned by the caller — full pages
-        a fresh share, the COW fork its allocation."""
+        a fresh share, the COW fork its allocation.
+
+        Matched SPILLED nodes count toward the page need (each restore
+        consumes one fresh pool page) and are restored — device_put
+        back + single-page insert — only after the backpressure check
+        passes, root-first and pinned as they land so a later restore's
+        reclaim can never spill them straight back."""
         toks = [int(t) for t in tokens][:max(int(limit), 0)]
         full, tail, tail_len = self.match(toks)
         will_fork = tail is not None and tail_len > 0
-        # pin the whole matched path — including the fork SOURCE —
-        # before counting evictables, so the backpressure check can
-        # never count a page the allocation below will find pinned
-        # (that mismatch would crash allocation instead of waiting)
-        for nd in full:
+        resident = [nd for nd in full if nd.page is not None]
+        n_restore = len(full) - len(resident)
+        if will_fork and tail.page is None:
+            n_restore += 1
+        # pin the matched RESIDENT path — including a resident fork
+        # SOURCE — before counting evictables, so the backpressure
+        # check can never count a page the allocation below will find
+        # pinned (that mismatch would crash allocation instead of
+        # waiting)
+        for nd in resident:
             self.mgr.incref(nd.page)
-        if will_fork:
+        if will_fork and tail.page is not None:
             self.mgr.incref(tail.page)
-        needed = total_pages - len(full)   # fork + fresh suffix pages
+        # fork + fresh suffix pages + one pool page per restore
+        needed = total_pages - len(full) + n_restore
         if len(self.mgr.free) < needed and \
                 len(self.mgr.free) + self.evictable_count() < needed:
-            if will_fork:
+            if will_fork and tail.page is not None:
                 self.mgr.decref(tail.page)
-            for nd in full:
+            for nd in resident:
                 self.mgr.decref(nd.page)
             return None
+        for nd in full:
+            if nd.page is None:
+                self._restore_node(nd)
+                self.mgr.incref(nd.page)    # the caller's reference
+        if will_fork and tail.page is None:
+            self._restore_node(tail)
+            self.mgr.incref(tail.page)      # the fork-source pin
         pages = [nd.page for nd in full]
         matched = len(full) * self.bs
         if will_fork:
@@ -210,6 +336,20 @@ class PrefixCache:
         self.stats["tokens_skipped"] += matched
         self.stats["shared_pages"] += len(full)
         return pages, matched, len(full)
+
+    def _restore_node(self, nd: _Node):
+        """Bring a spilled node back on device: one fresh pool page
+        (rc 1 — the tree's reference) + the owner's restore_page
+        device_put/insert. The allocation may itself reclaim; matched
+        resident pages are pinned by then and spilled nodes hold no
+        page, so the reclaim can never touch the matched path."""
+        page = self.mgr.alloc_page()
+        self._restore(nd.host, page)
+        nd.page = page
+        nd.host = None
+        self._host_pages -= 1
+        self.stats["restored_pages"] += 1
+        self.version += 1
 
     # -- insertion ----------------------------------------------------
     def insert(self, tokens: Sequence[int], pages: Sequence[int]):
@@ -233,6 +373,16 @@ class PrefixCache:
                 if c > best_c:
                     best, best_c = ch, c
             if best is not None and best_c == len(best.tokens) == len(pt):
+                if best.page is None:
+                    # a finished request re-covered a SPILLED node:
+                    # re-adopt its device page directly — cheaper than
+                    # a device restore, same bytes by position-causality
+                    self.mgr.incref(page)
+                    best.page = page
+                    best.host = None
+                    self._host_pages -= 1
+                    self.stats["readopted_pages"] += 1
+                    self.version += 1
                 node = best                  # exact: already cached
                 self._touch(node)
                 continue
@@ -246,9 +396,20 @@ class PrefixCache:
                 del node.children[best.tokens]
                 best.tokens = pt
                 best.page = page
+                if best.host is not None:
+                    # a spilled tail re-materialized by the caller's
+                    # longer page: the host copy is superseded —
+                    # counted as a re-adoption so the tier's page
+                    # accounting (spilled == restored + readopted +
+                    # host_evicted + host_pages) stays closed
+                    best.host = None
+                    self._host_pages -= 1
+                    self.stats["readopted_pages"] += 1
                 node.children[pt] = best
-                self.mgr.decref(old)
+                if old is not None:
+                    self.mgr.decref(old)
                 self.stats["inserted_pages"] += 1
+                self.version += 1
                 node = best
                 self._touch(node)
                 continue
@@ -260,15 +421,25 @@ class PrefixCache:
             ch = _Node(pt, page, node)
             node.children[pt] = ch
             self.stats["inserted_pages"] += 1
+            self.version += 1
             node = ch
             self._touch(node)
 
     # -- eviction -----------------------------------------------------
     def evict(self, n_pages: int) -> int:
-        """LRU-evict up to ``n_pages`` refcount-1 leaf pages, cascading
-        to parents as they become childless. Pages shared with a live
+        """Reclaim up to ``n_pages`` refcount-1 pages for the
+        allocator, LRU-first. Without the offload tier the victim's
+        node is dropped from the tree; with it the node SPILLS — bytes
+        to host memory, node kept matchable. Pages shared with a live
         request (refcount >= 2) are never touched. Installed as the
         BlockManager's ``reclaim`` hook."""
+        if self._spill is not None:
+            return self._evict_spill(n_pages)
+        return self._evict_drop(n_pages)
+
+    def _evict_drop(self, n_pages: int) -> int:
+        """LRU-evict refcount-1 leaf pages, cascading to parents as
+        they become childless (the pre-offload behavior)."""
         heap = [(nd.last_used, id(nd), nd) for nd in self._walk()
                 if not nd.children
                 and int(self.mgr.refcount[nd.page]) == 1]
@@ -286,11 +457,79 @@ class PrefixCache:
             self.mgr.decref(nd.page)          # 1 -> 0: back to the pool
             freed += 1
             self.stats["evicted_pages"] += 1
+            self.version += 1
             if (parent is not self.root and not parent.children
                     and int(self.mgr.refcount[parent.page]) == 1):
                 heapq.heappush(
                     heap, (parent.last_used, id(parent), parent))
         return freed
+
+    def _evict_spill(self, n_pages: int) -> int:
+        """Offload-tier eviction: spill the LRU resident leaf-of-the-
+        resident-subtree (rc-1, no resident descendant — children spill
+        before parents, so hot shared ancestors stay on device longest)
+        to host memory; the node stays in the tree with ``page=None``
+        and restores on the next prefix hit."""
+        freed = 0
+        while freed < n_pages:
+            cands = self._resident_leaves()
+            if not cands:
+                break
+            cands.sort(key=lambda nd: (nd.last_used, id(nd)))
+            for nd in cands:
+                if freed >= n_pages:
+                    break
+                self._spill_node(nd)
+                freed += 1
+            # loop: spilling a layer of leaves may expose their parents
+        return freed
+
+    def _resident_leaves(self) -> List[_Node]:
+        """Resident rc-1 nodes with no resident descendant — the
+        spillable frontier."""
+        out: List[_Node] = []
+
+        def walk(n: _Node) -> bool:
+            any_res = False
+            for ch in n.children.values():
+                any_res = walk(ch) or any_res
+            res = n is not self.root and n.page is not None
+            if (res and not any_res
+                    and int(self.mgr.refcount[n.page]) == 1):
+                out.append(n)
+            return res or any_res
+        walk(self.root)
+        return out
+
+    def _spill_node(self, nd: _Node):
+        nd.host = self._spill(nd.page)
+        self.mgr.decref(nd.page)        # 1 -> 0: back to the pool
+        nd.page = None
+        self._host_pages += 1
+        self.stats["spilled_pages"] += 1
+        self.version += 1
+        self._enforce_host_budget()
+
+    def _enforce_host_budget(self):
+        """Past the host budget the LRU CHILDLESS spilled node dies for
+        real (dropping a mid-tree node would orphan the descendants'
+        token paths; leaf-first spill order makes the oldest spilled
+        nodes childless in practice)."""
+        while (self.host_budget is not None
+               and self._host_pages > self.host_budget):
+            cands = [nd for nd in self._walk()
+                     if nd.page is None and nd.host is not None
+                     and not nd.children]
+            if not cands:
+                break
+            nd = min(cands, key=lambda n: (n.last_used, id(n)))
+            del nd.parent.children[nd.tokens]
+            nd.parent = None
+            nd.host = None
+            self._host_pages -= 1
+            self.stats["host_evicted_pages"] += 1
+            self.stats["evicted_pages"] += 1
+            self.version += 1
 
 
 def make_page_copier():

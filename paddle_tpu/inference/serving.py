@@ -207,7 +207,7 @@ class ServingEngine:
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  max_seq_len: Optional[int] = None, cache_dtype=None,
                  prefill_buckets=(32, 128), seed: int = 0,
-                 prefix_cache: bool = False,
+                 prefix_cache: bool = False, kv_offload=False,
                  observability=False, fused_decode=None, mesh=None,
                  aging_s: Optional[float] = None):
         # tensor parallelism (inference/tp.py): a ServingMesh shards
@@ -299,11 +299,34 @@ class ServingEngine:
         assert scratch == [0], "scratch must be page 0 (tables pad with 0)"
 
         self._pcache = None
+        # host-RAM KV offload tier (prefix_cache.py): kv_offload=True
+        # (or an int host-page budget) makes eviction SPILL refcount-1
+        # radix pages to host memory instead of dropping them, and a
+        # prefix hit on a spilled node restore them — effective
+        # prefix-cache capacity becomes HBM + host RAM
+        self._kv_offload = bool(kv_offload)
+        self._offload_extract_fn = None
+        self._offload_insert_fn = None
+        L_, KV_, hd_ = (cfg.num_hidden_layers,
+                        cfg.num_key_value_heads, cfg.head_dim)
+        # one physical page across BOTH pools, in bytes (the spill/
+        # restore byte counters)
+        self._page_nbytes = int(2 * L_ * BS * KV_ * hd_
+                                * jnp.dtype(pool_dtype).itemsize)
+        if kv_offload and not prefix_cache:
+            raise ValueError(
+                "kv_offload requires prefix_cache=True: the host tier "
+                "spills radix-tree pages, not per-request tables")
         if prefix_cache:
             from .prefix_cache import PrefixCache, make_page_copier
             self._copy_fn = make_page_copier()
-            self._pcache = PrefixCache(self.mgr, BS,
-                                       copy_page=self._copy_page)
+            budget = (int(kv_offload)
+                      if kv_offload and kv_offload is not True else None)
+            self._pcache = PrefixCache(
+                self.mgr, BS, copy_page=self._copy_page,
+                spill_page=self._spill_page if kv_offload else None,
+                restore_page=self._restore_page if kv_offload else None,
+                host_budget_pages=budget)
 
         C, MB = self.capacity, self.max_blocks
         self._slots = [_Slot() for _ in range(C)]
@@ -360,6 +383,11 @@ class ServingEngine:
             "tokens_generated": 0, "requests_submitted": 0,
             "requests_completed": 0, "drain_truncations": 0,
             "preemptions": 0, "requeues": 0, "deadline_expired": 0,
+            # host-tier handoff pair: trace counter (spill extract +
+            # restore insert, <=1 each — they trace lazily on the first
+            # spill) and the bytes moved each direction
+            "offload_traces": 0, "kv_spill_bytes": 0,
+            "kv_restore_bytes": 0,
         }
         self._t_first = None
         self._t_last = None
@@ -374,6 +402,9 @@ class ServingEngine:
                          if isinstance(observability, Observability)
                          else Observability())
             self._obs.registry.adopt_counters(self.counters)
+            if self._kv_offload:
+                # handoff_ms-style distributions for the host tier
+                self._obs.ensure_histograms(("spill_ms", "restore_ms"))
         else:
             self._obs = None
         # serving-collective instrumentation: a mesh'd engine with
@@ -421,6 +452,76 @@ class ServingEngine:
         self._k_pools, self._v_pools = self._copy_fn(
             self._k_pools, self._v_pools, jnp.asarray(src, jnp.int32),
             jnp.asarray(dst, jnp.int32))
+
+    # -- host-RAM KV offload tier -------------------------------------
+    def _make_offload_fns(self):
+        """The host-tier handoff pair — the PR-10 extract/device_put/
+        insert machinery pointed inward: ``extract`` gathers ONE
+        physical page from both pools, ``insert`` scatters a restored
+        page back (donated, so the pools update in place). The page
+        index rides as a traced scalar: one trace each covers every
+        page, ever."""
+        counters = self.counters
+
+        def extract(kp, vp, src):
+            counters["offload_traces"] += 1
+            return kp[:, src], vp[:, src]
+
+        def insert(kp, vp, dst, kpag, vpag):
+            counters["offload_traces"] += 1
+            return (kp.at[:, dst].set(kpag), vp.at[:, dst].set(vpag))
+
+        return (jax.jit(extract), jax.jit(insert, donate_argnums=(0, 1)))
+
+    def _spill_page(self, page: int):
+        """PrefixCache spill callback: one page's raw bytes -> host
+        memory (``host_put``: pinned where the backend offers it). The
+        returned payload is opaque to the cache; only
+        :meth:`_restore_page` reads it."""
+        from .prefix_cache import host_put
+        if self._offload_extract_fn is None:
+            (self._offload_extract_fn,
+             self._offload_insert_fn) = self._make_offload_fns()
+        t0 = time.perf_counter()
+        kpg, vpg = self._offload_extract_fn(
+            self._k_pools, self._v_pools, jnp.asarray(page, jnp.int32))
+        payload = (host_put(kpg), host_put(vpg))
+        self.counters["kv_spill_bytes"] += self._page_nbytes
+        if self._obs is not None:
+            dur = (time.perf_counter() - t0) * 1e3
+            self._obs.hist("spill_ms").observe(dur)
+            self._obs.timeline.record(
+                "kv_spill", page=int(page), bytes=self._page_nbytes,
+                dur_ms=round(dur, 3))
+        return payload
+
+    def _restore_page(self, payload, dst: int):
+        """PrefixCache restore callback: device_put the spilled bytes
+        back and scatter them into physical page ``dst`` (the handoff
+        insert in the decode direction) — byte-identical to what was
+        spilled."""
+        if self._offload_insert_fn is None:
+            (self._offload_extract_fn,
+             self._offload_insert_fn) = self._make_offload_fns()
+        t0 = time.perf_counter()
+        kpg, vpg = payload
+        if self._mesh is not None:
+            kpg = self._mesh.replicate(np.asarray(kpg))
+            vpg = self._mesh.replicate(np.asarray(vpg))
+        else:
+            dev = next(iter(self._k_pools.devices()))
+            kpg = jax.device_put(kpg, dev)
+            vpg = jax.device_put(vpg, dev)
+        self._k_pools, self._v_pools = self._offload_insert_fn(
+            self._k_pools, self._v_pools, jnp.asarray(dst, jnp.int32),
+            kpg, vpg)
+        self.counters["kv_restore_bytes"] += self._page_nbytes
+        if self._obs is not None:
+            dur = (time.perf_counter() - t0) * 1e3
+            self._obs.hist("restore_ms").observe(dur)
+            self._obs.timeline.record(
+                "kv_restore", page=int(dst), bytes=self._page_nbytes,
+                dur_ms=round(dur, 3))
 
     # -- public API ---------------------------------------------------
     def _alloc_tokens(self, req: Request) -> int:
@@ -525,6 +626,8 @@ class ServingEngine:
             vals["prefix_tree_pages"] = self._pcache.cached_pages
             vals["prefix_hit_ratio"] = (round(st["hits"] / looked, 4)
                                         if looked else 0.0)
+            if self._kv_offload:
+                vals["prefix_host_pages"] = self._pcache.host_pages
         obs.sample_gauges(now, vals)
         if obs.watchdog.check(self.counters):
             obs.timeline.record("retrace",
@@ -590,6 +693,29 @@ class ServingEngine:
     def idle(self) -> bool:
         return not self._queue and all(
             s.phase == "idle" for s in self._slots)
+
+    # -- fleet-router surface (inference/fleet.py) --------------------
+    @property
+    def queue_depth(self) -> int:
+        """Requests submitted but not yet admitted — the router's
+        admission-backpressure signal."""
+        return len(self._queue)
+
+    @property
+    def live_slots(self) -> int:
+        return sum(1 for s in self._slots if s.phase != "idle")
+
+    @property
+    def prefix_cache_version(self) -> int:
+        """Monotone radix-tree version (0 without a prefix cache) —
+        the router refreshes its cached tree summary when this moves."""
+        return 0 if self._pcache is None else self._pcache.version
+
+    def prefix_summary(self) -> Dict[int, int]:
+        """The router's tree summary: ``{prefix_hash: n_tokens}`` for
+        every page-aligned cached path (empty without a prefix
+        cache)."""
+        return {} if self._pcache is None else self._pcache.summary()
 
     def drain(self, max_steps: Optional[int] = None) -> int:
         """Step until queue and slots are empty; returns step count.
@@ -723,7 +849,8 @@ class ServingEngine:
                   "live_slot_steps", "tokens_generated",
                   "requests_submitted", "requests_completed",
                   "drain_truncations", "preemptions", "requeues",
-                  "deadline_expired"):
+                  "deadline_expired", "kv_spill_bytes",
+                  "kv_restore_bytes"):
             self.counters[k] = 0
         self._sched_cls = {}
         self._slo = [0, 0]
@@ -1036,6 +1163,11 @@ class ServingEngine:
                     "prefill_chunk", req.req_id, dur_ms=dur_ms,
                     pos0=pos0, n=n, bucket=P)
             slot.prefill_pos += n
+            if slot.prefill_pos < S:
+                # mid-prompt chunk done: the chunked-prefill handoff
+                # hook (disagg.py streams completed pages to the decode
+                # group while later chunks still run). No-op here.
+                self._on_prefill_chunk(slot_id)
             if slot.prefill_pos == S:
                 first = int(np.asarray(tok))
                 req.first_token_t = time.perf_counter()
@@ -1061,6 +1193,28 @@ class ServingEngine:
                 self._on_prefill_complete(slot_id, first)
             return True
         return False
+
+    def _on_prefill_chunk(self, slot_id: int):
+        """Hook: one mid-prompt prefill chunk completed (the slot's
+        ``prefill_pos`` already advanced, more prompt remains). The
+        disaggregated prefill worker overrides this to stream the
+        chunk's completed KV pages to the decode group."""
+
+    def offload_metrics(self) -> Dict:
+        """The host-tier report the fleet aggregates across replicas:
+        page counts from the radix tree + bytes from the engine
+        counters. All zeros without ``kv_offload``."""
+        pc = self._pcache.stats if self._pcache is not None else {}
+        return {
+            "spilled_pages": pc.get("spilled_pages", 0),
+            "restored_pages": pc.get("restored_pages", 0),
+            "readopted_pages": pc.get("readopted_pages", 0),
+            "host_evicted_pages": pc.get("host_evicted_pages", 0),
+            "host_pages": (self._pcache.host_pages
+                           if self._pcache is not None else 0),
+            "spill_bytes": self.counters["kv_spill_bytes"],
+            "restore_bytes": self.counters["kv_restore_bytes"],
+        }
 
     def _on_prefill_complete(self, slot_id: int, first: int):
         """Prompt fully prefilled and first token sampled: transition
@@ -1469,6 +1623,23 @@ class ServingEngine:
                       sds((), jnp.int32)),
                 donate_argnums=(0, 1), carry={0: 0, 1: 1},
                 mesh_axes=axes, tags=tags))
+        if self._kv_offload:
+            # the host-tier handoff pair (fresh jit instances — the
+            # disagg_kv_extract/insert idiom): a single-page gather out
+            # of the pools and the donated single-page scatter back
+            ext, ins = self._make_offload_fns()
+            ps = self._k_pools.shape
+            page_sd = sds((ps[0],) + ps[2:], self._k_pools.dtype)
+            specs.append(ProgramSpec(
+                name="serving_kv_spill_extract" + tp_sfx, fn=ext,
+                args=(pools_sd, pools_sd, sds((), jnp.int32)),
+                mesh_axes=axes, tags=tags + ("offload",)))
+            specs.append(ProgramSpec(
+                name="serving_kv_restore_insert" + tp_sfx, fn=ins,
+                args=(pools_sd, pools_sd, sds((), jnp.int32),
+                      page_sd, page_sd),
+                donate_argnums=(0, 1), carry={0: 0, 1: 1},
+                mesh_axes=axes, tags=tags + ("offload",)))
         if register:
             for s in specs:
                 REGISTRY.register(s)
@@ -1484,7 +1655,7 @@ class ServingEngine:
         import copy
         snap = {k: copy.deepcopy(self.counters[k])
                 for k in ("decode_traces", "prefill_traces",
-                          "calibration_traces")}
+                          "calibration_traces", "offload_traces")}
         try:
             reports = [_audit(s)
                        for s in self.program_specs(register=register)]

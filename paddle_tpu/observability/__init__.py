@@ -91,6 +91,16 @@ class Observability:
     def hist(self, name: str) -> Histogram:
         return self.registry.histogram(name)
 
+    def ensure_histograms(self, names: Sequence[str]):
+        """Extend the reported latency set (e.g. an engine feature —
+        the KV offload tier's spill_ms/restore_ms — adds its own
+        distributions): the names join ``latency_snapshot()``'s output
+        and survive ``reset_window()`` like the built-in set."""
+        for name in names:
+            if name not in self._hist_names:
+                self._hist_names += (name,)
+            self.registry.histogram(name, unit="ms")
+
     def sample_gauges(self, t: float, values: Dict[str, float]):
         for name, v in values.items():
             self.registry.gauge(name, self.gauge_window).set(v, t)

@@ -181,6 +181,108 @@ def test_eos_at_first_token_finishes_on_prefill_group(params,
         _solo_output(solo_engine, np.arange(1, 9, dtype=np.int32), g))
 
 
+# -- async double-buffered + chunked-prefill handoff (r16) -------------
+
+def test_async_handoff_overlaps_next_step(params):
+    """The handoff is double-buffered: the step that ISSUES a
+    transfer's extract/device_put does not land its insert (no resume
+    entry yet — the copy overlaps that step's other work); the NEXT
+    step's handoff drain completes it and the decode group admits."""
+    g = GenerationConfig(max_new_tokens=6, greedy=True)
+    eng = _disagg(params, prefill_buckets=(8,))
+    eng.submit(np.arange(1, 9, dtype=np.int32), g)
+    eng.step()                       # admit + the single prefill chunk
+    assert len(eng._handoffs) == 1 and not eng._inflight
+    assert eng.counters["handoffs"] == 0
+    eng.step()                       # transfer issued, insert pending
+    assert len(eng._inflight) == 1 and not eng._handoffs
+    assert eng.counters["handoffs"] == 0
+    assert eng.decode.live_slots == 0
+    eng.step()                       # insert lands -> resume admits
+    assert not eng._inflight
+    assert eng.counters["handoffs"] == 1
+    assert eng.decode.live_slots == 1
+    eng.drain()
+
+
+def test_chunked_prefill_partial_handoff_bit_parity(params, solo_engine):
+    """Long prompts (> the largest bucket) stream each completed
+    chunk's pages to the decode group ahead of the final handoff:
+    partial transfers happen, the same two handoff programs cover them
+    (no new traces), and greedy output stays bit-identical."""
+    g = GenerationConfig(max_new_tokens=6, greedy=True)
+    rng = np.random.RandomState(9)
+    # 21 tokens through (8, 16) buckets: 16-chunk then 5-chunk, so the
+    # first chunk completes 4 full pages mid-prompt (block 4)
+    prompts = [rng.randint(0, 97, (21,)).astype(np.int32)
+               for _ in range(4)]
+    eng = _disagg(params)
+    reqs = [eng.submit(p, g) for p in prompts]
+    eng.drain()
+    m = eng.metrics()
+    assert m["partial_handoffs"] >= 4        # one window per prompt
+    assert m["handoffs"] == 4
+    assert m["handoff_traces"] == 2          # same two programs
+    for req, prompt in zip(reqs, prompts):
+        assert np.array_equal(req.output_ids,
+                              _solo_output(solo_engine, prompt, g)), \
+            f"req {req.req_id} diverged under chunked handoff"
+
+
+def test_partial_handoff_abort_on_prefill_group_finish(params):
+    """A long prompt whose budget is one token ships partial windows,
+    then finishes ON the prefill group: the abort marker must release
+    the decode-side allocation after the in-flight inserts land —
+    every decode-pool page comes back, no decode slot ever runs."""
+    g = GenerationConfig(max_new_tokens=1, greedy=True)
+    eng = _disagg(params)
+    free0 = len(eng.decode.mgr.free)
+    r = eng.submit(np.arange(1, 22, dtype=np.int32), g)
+    eng.drain()
+    assert r.done and len(r.tokens) == 1
+    assert eng.counters["partial_handoffs"] >= 1
+    assert eng.counters["handoffs"] == 0
+    assert eng.decode.counters["decode_steps"] == 0
+    assert not eng.decode.mgr.tables.get(r.req_id)
+    assert len(eng.decode.mgr.free) == free0
+
+
+def test_partial_allocation_cannot_deadlock_blocked_final(params):
+    """REVIEW regression (r16): a long prompt's chunked-prefill
+    handoff allocates its decode table at chunk time; a short
+    request's final handoff queued AHEAD of the long one's can then be
+    page-blocked while the pages it waits for are held by the
+    still-unfinished long request — whose own (allocation-free) final
+    sits BEHIND the blocked head. The non-allocating final must
+    overtake, or nothing ever frees and drain() raises 'starved'."""
+    g_long = GenerationConfig(max_new_tokens=4, greedy=True)
+    g_short = GenerationConfig(max_new_tokens=4, greedy=True)
+    rng = np.random.RandomState(21)
+    # decode pool: 12 usable pages (block 4). Long: 36 + 4 -> 10 pages,
+    # allocated at its FIRST chunk. Short: 8 + 4 -> 3 pages > the 2
+    # left. The tiny opener just frees prefill slot 0 so the short
+    # prompt's chunks can interleave mid-long-prompt.
+    eng = _disagg(params, num_blocks=13, prefill_slots=2,
+                  max_seq_len=48)
+    tiny = eng.submit(np.arange(1, 5, dtype=np.int32),
+                      GenerationConfig(max_new_tokens=1, greedy=True))
+    long_p = rng.randint(0, 97, (36,)).astype(np.int32)
+    long_r = eng.submit(long_p, g_long)
+    eng.step()                  # tiny prefills + finishes (slot 0 free)
+    eng.step()                  # long chunk 1 -> partial alloc 10 pages
+    assert long_r.req_id in eng.decode.mgr.tables
+    short_p = rng.randint(0, 97, (8,)).astype(np.int32)
+    short_r = eng.submit(short_p, g_short)   # admits into slot 0:
+    eng.drain()                 # its final queues AHEAD of the long's
+    assert tiny.done and long_r.done and short_r.done
+    solo = _coloc(params, capacity=1, max_seq_len=48)
+    for req, prompt, g in ((long_r, long_p, g_long),
+                           (short_r, short_p, g_short)):
+        s = solo.submit(prompt, g)
+        solo.drain()
+        assert np.array_equal(req.output_ids, s.output_ids)
+
+
 # -- SLO admission: preemption, priorities, deadlines ------------------
 
 @pytest.fixture(scope="module")
@@ -414,7 +516,10 @@ def test_oversized_request_rejected_against_decode_pool(params):
 # -- metrics schema ----------------------------------------------------
 
 DISAGG_BASE_KEYS = {
-    "handoffs", "handoff_traces", "kv_bytes_transferred",
+    # r16: partial_handoffs counts chunked-prefill page windows shipped
+    # ahead of a long prompt's final handoff
+    "handoffs", "partial_handoffs", "handoff_traces",
+    "kv_bytes_transferred",
     "requests_submitted", "requests_completed", "drain_truncations",
     "wall_time_s", "tokens_generated", "tokens_per_sec",
     "ttft_ms_mean", "ttft_ms_max", "handoff_ms_mean", "handoff_ms_max",

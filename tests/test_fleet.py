@@ -1,0 +1,472 @@
+"""ServingFleet (inference/fleet.py) + the host-RAM KV offload tier
+(prefix_cache.py spill/restore).
+
+The acceptance bar (ISSUE 12): a fleet of N >= 2 replicas of MIXED
+engine kinds (colocated + disaggregated) behind the prefix-aware
+router serves a 30-request mixed-arrival greedy stream bit-identical
+to a single colocated engine, with zero steady-state retraces; routing
+is deterministically prefix-affine with least-loaded fallback and
+per-replica admission backpressure; and a prefix hit on a SPILLED page
+restores bit-identical KV bytes (refcount + conservation invariants
+held throughout)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama
+from paddle_tpu.inference import (DisaggregatedEngine, GenerationConfig,
+                                  ServingEngine, ServingFleet, generate)
+
+pytestmark = pytest.mark.fleet
+
+CFG = llama.LlamaConfig(vocab_size=97, hidden_size=64,
+                        intermediate_size=128, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=4,
+                        max_position_embeddings=160,
+                        dtype=jnp.float32, remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def _engine(params, **kw):
+    kw.setdefault("capacity", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("max_seq_len", 64)
+    return ServingEngine(params, CFG, **kw)
+
+
+def _disagg(params, **kw):
+    kw.setdefault("prefill_devices", jax.devices()[:1])
+    kw.setdefault("decode_devices", jax.devices()[1:2])
+    kw.setdefault("capacity", 2)
+    kw.setdefault("prefill_slots", 1)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("max_seq_len", 64)
+    return DisaggregatedEngine(params, CFG, **kw)
+
+
+def _want(params, p, g):
+    return np.asarray(generate(params, jnp.asarray(p)[None], CFG,
+                               g))[0, len(p):].tolist()
+
+
+def _stream(fleet_or_eng, n=30, seed=7, max_new=5):
+    """n greedy requests arriving in waves interleaved with steps."""
+    rng = np.random.RandomState(seed)
+    sizes = rng.randint(4, 15, n)
+    reqs = []
+    for i, s in enumerate(sizes):
+        reqs.append(fleet_or_eng.submit(
+            rng.randint(0, 97, (int(s),)).astype(np.int32),
+            GenerationConfig(max_new_tokens=max_new, greedy=True)))
+        if i % 3 == 2:
+            fleet_or_eng.step()
+            fleet_or_eng.step()
+    fleet_or_eng.drain()
+    return [r.output_ids for r in reqs]
+
+
+def _same(a, b):
+    return all(np.array_equal(x, y) for x, y in zip(a, b))
+
+
+@pytest.fixture(scope="module")
+def ref_stream(params):
+    return _stream(_engine(params, capacity=3))
+
+
+# -- the acceptance stream: mixed-kind bit-parity + zero retraces ------
+
+def test_fleet_bit_parity_mixed_engine_kinds(params, ref_stream):
+    """Fleet of a colocated prefix-cached replica + a disaggregated
+    replica: the 30-request stream is bit-identical to the single
+    colocated engine, a warm repeat of the same stream stays
+    bit-identical with ZERO steady-state retraces, and both replicas
+    actually served work."""
+    fleet = ServingFleet(
+        [("coloc", _engine(params, prefix_cache=True,
+                           observability=True)),
+         ("disagg", _disagg(params, prefix_cache=True,
+                            observability=True))],
+        observability=True)
+    cold = _stream(fleet)
+    assert _same(ref_stream, cold), "fleet greedy output diverged"
+    m = fleet.metrics()
+    per = m["routing"]["per_replica"]
+    assert per["coloc"]["routed"] > 0 and per["disagg"]["routed"] > 0
+    assert m["requests_completed"] == 30
+    assert m["latency"]["ttft_ms"]["count"] == 30  # shared histograms
+    fleet.reset_metrics()            # arms every replica's watchdog
+    warm = _stream(fleet)            # same seed -> same prompts
+    assert _same(ref_stream, warm), "warm fleet stream diverged"
+    m = fleet.metrics()
+    assert m["retrace_warnings"] == 0
+    # warm repeats route onto the replica already holding the prefix
+    assert m["routing"]["warm"] > 0
+    assert m["replicas"]["coloc"]["decode_traces"] == 1
+    assert m["replicas"]["disagg"]["groups"]["decode"][
+        "decode_traces"] == 1
+
+
+# -- routing ----------------------------------------------------------
+
+def test_prefix_affinity_routing_deterministic(params):
+    """Cold placement spreads by least-loaded round-robin; warm
+    requests land deterministically on the replica that already holds
+    their prefix pages."""
+    rng = np.random.RandomState(1)
+    fleet = ServingFleet([_engine(params, prefix_cache=True),
+                          _engine(params, prefix_cache=True)])
+    g = GenerationConfig(max_new_tokens=3, greedy=True)
+    a = rng.randint(0, 97, (12,)).astype(np.int32)
+    b = rng.randint(0, 97, (12,)).astype(np.int32)
+    ra = fleet.submit(a, g)          # cold -> replica0 (rr tie-break)
+    rb = fleet.submit(b, g)          # cold -> replica1
+    fleet.drain()
+    per = fleet.metrics()["routing"]["per_replica"]
+    assert per["replica0"]["routed"] == 1
+    assert per["replica1"]["routed"] == 1
+    a2 = np.concatenate([a, rng.randint(0, 97, (4,))]).astype(np.int32)
+    b2 = np.concatenate([b, rng.randint(0, 97, (4,))]).astype(np.int32)
+    ra2 = fleet.submit(a2, g)        # warm -> replica0
+    rb2 = fleet.submit(b2, g)        # warm -> replica1
+    fleet.drain()
+    m = fleet.metrics()
+    assert m["routing"]["warm"] == 2
+    assert m["routing"]["warm_hit_ratio"] == 0.5
+    per = m["routing"]["per_replica"]
+    assert per["replica0"]["warm_routed"] == 1
+    assert per["replica1"]["warm_routed"] == 1
+    # the replicas' caches confirm the affinity (one hit each)
+    assert m["replicas"]["replica0"]["prefix_cache"]["hits"] == 1
+    assert m["replicas"]["replica1"]["prefix_cache"]["hits"] == 1
+    for req, p in ((ra, a), (rb, b), (ra2, a2), (rb2, b2)):
+        assert req.tokens == _want(params, p, g)
+
+
+def test_round_robin_and_least_loaded_policies(params):
+    rng = np.random.RandomState(2)
+    g = GenerationConfig(max_new_tokens=2, greedy=True)
+    prompts = [rng.randint(0, 97, (6,)).astype(np.int32)
+               for _ in range(4)]
+    rr = ServingFleet([_engine(params), _engine(params)],
+                      policy="round_robin")
+    for p in prompts:
+        rr.submit(p, g)
+    per = rr.metrics()["routing"]["per_replica"]
+    assert per["replica0"]["routed"] == 2
+    assert per["replica1"]["routed"] == 2
+    assert rr.metrics()["routing"]["warm_hit_ratio"] == 0.0
+    rr.drain()
+    ll = ServingFleet([_engine(params), _engine(params)],
+                      policy="least_loaded")
+    ll.submit(prompts[0], g)         # replica0 now loaded
+    r1 = ll._replicas[1]
+    ll.submit(prompts[1], g)         # least loaded -> replica1
+    assert r1.routed == 1
+    ll.drain()
+
+
+def test_backpressure_diverts_warm_request_from_saturated_replica(
+        params):
+    """Per-replica admission backpressure: a warm request whose home
+    replica's queue is at max_queue_depth diverts to a cold replica
+    (counted) instead of queueing behind it — and still completes
+    bit-exactly there."""
+    rng = np.random.RandomState(3)
+    eng0 = _engine(params, capacity=1, prefix_cache=True)
+    eng1 = _engine(params, capacity=1, prefix_cache=True)
+    fleet = ServingFleet([eng0, eng1], max_queue_depth=1)
+    g = GenerationConfig(max_new_tokens=4, greedy=True)
+    a = rng.randint(0, 97, (12,)).astype(np.int32)
+    fleet.submit(a, g)               # cold -> replica0, caches a
+    fleet.drain()
+    # saturate replica0's admission queue (submitted, never stepped)
+    eng0.submit(rng.randint(0, 97, (8,)).astype(np.int32), g)
+    eng0.submit(rng.randint(0, 97, (8,)).astype(np.int32), g)
+    assert eng0.queue_depth >= 1
+    a2 = np.concatenate([a, rng.randint(0, 97, (4,))]).astype(np.int32)
+    r = fleet.submit(a2, g)          # warm home saturated -> divert
+    m = fleet.metrics()
+    assert m["routing"]["diverted"] == 1
+    assert m["routing"]["per_replica"]["replica1"]["routed"] == 1
+    fleet.drain()
+    assert r.tokens == _want(params, a2, g)
+
+
+def test_divert_prefers_shorter_warm_match_over_cold(params):
+    """REVIEW fix: when the best-match replica is saturated, an OPEN
+    replica holding a shorter warm match of the same prompt beats cold
+    placement (a partial prefix skip beats a full cold prefill)."""
+    rng = np.random.RandomState(7)
+    eng0 = _engine(params, capacity=1, prefix_cache=True)
+    eng1 = _engine(params, capacity=1, prefix_cache=True)
+    eng2 = _engine(params, capacity=1, prefix_cache=True)
+    fleet = ServingFleet([eng0, eng1, eng2], max_queue_depth=1)
+    g = GenerationConfig(max_new_tokens=3, greedy=True)
+    a = rng.randint(0, 97, (12,)).astype(np.int32)
+    fleet.submit(a, g)               # full prompt cached on replica0
+    fleet.drain()
+    eng1.submit(a[:8], g)            # a SHORTER prefix on replica1
+    eng1.drain()
+    eng0.submit(rng.randint(0, 97, (8,)).astype(np.int32), g)
+    eng0.submit(rng.randint(0, 97, (8,)).astype(np.int32), g)
+    assert eng0.queue_depth >= 1     # best-match home saturated
+    r = fleet.submit(np.concatenate([a, rng.randint(0, 97, (4,))])
+                     .astype(np.int32), g)
+    m = fleet.metrics()
+    assert m["routing"]["diverted"] == 1
+    assert m["routing"]["warm"] == 1          # the divert stayed warm
+    assert m["routing"]["per_replica"]["replica1"]["routed"] == 1
+    fleet.drain()
+    assert r.done
+    assert eng1.metrics()["prefix_cache"]["hits"] >= 1
+
+
+def test_fleet_validation(params):
+    eng = _engine(params)
+    with pytest.raises(ValueError, match="at least one"):
+        ServingFleet([])
+    with pytest.raises(ValueError, match="duplicate"):
+        ServingFleet([("a", eng), ("a", _engine(params))])
+    with pytest.raises(ValueError, match="twice"):
+        ServingFleet([eng, eng])
+    with pytest.raises(ValueError, match="policy"):
+        ServingFleet([eng], policy="random")
+    with pytest.raises(ValueError, match="max_queue_depth"):
+        ServingFleet([eng], max_queue_depth=0)
+
+
+# -- host-RAM KV offload tier -----------------------------------------
+
+def test_spill_restore_byte_identity_and_refcounts(params):
+    """The acceptance bullet: spill a cached prefix to host RAM, hit
+    it again — the restored pages hold BIT-identical KV bytes, outputs
+    match generate() exactly, and the refcount/conservation invariants
+    hold through the whole spill/restore cycle."""
+    rng = np.random.RandomState(4)
+    eng = _engine(params, prefix_cache=True, kv_offload=True)
+    g = GenerationConfig(max_new_tokens=4, greedy=True)
+    p = rng.randint(0, 97, (12,)).astype(np.int32)
+    r1 = eng.submit(p, g)
+    eng.drain()
+    assert r1.tokens == _want(params, p, g)
+    pc = eng._pcache
+    full, _, _ = pc.match(p)
+    assert len(full) == 3            # 12 tokens = 3 full pages
+    before = [(np.asarray(eng._k_pools[:, nd.page]),
+               np.asarray(eng._v_pools[:, nd.page])) for nd in full]
+    # force the whole tree out to the host tier
+    spilled = pc.evict(100)
+    assert spilled >= 3
+    assert all(nd.page is None and nd.host is not None for nd in full)
+    st = pc.stats
+    assert st["spilled_pages"] == spilled
+    assert pc.host_pages == spilled
+    assert pc.cached_pages == 0
+    assert eng.counters["kv_spill_bytes"] > 0
+    # every spilled page went back to the allocator
+    assert len(eng.mgr.free) + 1 == eng.num_blocks
+    # warm hit on the spilled prefix: acquire restores, output exact
+    r2 = eng.submit(p, g)
+    eng.drain()
+    assert r2.tokens == _want(params, p, g)
+    assert st["restored_pages"] >= 2         # the shared full pages
+    assert st["hits"] == 1
+    assert eng.counters["kv_restore_bytes"] > 0
+    assert eng.counters["offload_traces"] == 2   # extract + insert
+    full2, _, _ = pc.match(p)
+    for nd, (kb, vb) in zip(full2[:2], before[:2]):
+        assert nd.page is not None
+        np.testing.assert_array_equal(
+            np.asarray(eng._k_pools[:, nd.page]), kb)
+        np.testing.assert_array_equal(
+            np.asarray(eng._v_pools[:, nd.page]), vb)
+    rc = eng.mgr.refcount
+    assert (rc >= 0).all()
+    assert all(rc[pg] == 0 for pg in eng.mgr.free)
+    m = eng.metrics()["prefix_cache"]
+    assert (len(eng.mgr.free) + m["cached_pages"] + 1
+            == eng.num_blocks)
+
+
+def test_eviction_pressure_spills_then_serves_warm_from_host(params):
+    """An undersized pool under a multi-prompt stream spills instead
+    of destroying warm state: every output stays exact, and a repeat
+    of the FIRST (long-evicted) prompt is served warm out of the host
+    tier — the capacity-extension proof (HBM + host RAM)."""
+    rng = np.random.RandomState(5)
+    eng = _engine(params, capacity=2, num_blocks=14, max_seq_len=32,
+                  prefix_cache=True, kv_offload=True,
+                  observability=True)
+    g = GenerationConfig(max_new_tokens=4, greedy=True)
+    reqs = [(pp := rng.randint(0, 97, (16,)).astype(np.int32),
+             eng.submit(pp, g)) for _ in range(6)]
+    eng.drain()
+    for pp, r in reqs:
+        assert r.tokens == _want(params, pp, g)
+    m = eng.metrics()["prefix_cache"]
+    assert m["spilled_pages"] > 0
+    assert m["evicted_pages"] == 0           # nothing was destroyed
+    hits0 = m["hits"]
+    first = reqs[0][0]
+    r = eng.submit(first, g)
+    eng.drain()
+    assert r.tokens == _want(params, first, g)
+    m = eng.metrics()["prefix_cache"]
+    assert m["hits"] == hits0 + 1
+    assert m["restored_pages"] > 0           # served from the host tier
+    # spill/restore distributions joined the latency report
+    lat = eng.metrics()["latency"]
+    assert lat["spill_ms"]["count"] == m["spilled_pages"]
+    assert lat["restore_ms"]["count"] == m["restored_pages"]
+    rc = eng.mgr.refcount
+    assert (rc >= 0).all()
+    assert all(rc[pg] == 0 for pg in eng.mgr.free)
+    assert (len(eng.mgr.free) + m["cached_pages"] + 1
+            == eng.num_blocks)
+
+
+def test_host_budget_drops_lru_spilled_pages(params):
+    """kv_offload=<int> bounds the host tier: past the budget the LRU
+    childless spilled node dies for real (counted), and the tier never
+    exceeds the cap."""
+    rng = np.random.RandomState(6)
+    eng = _engine(params, capacity=2, num_blocks=14, max_seq_len=32,
+                  prefix_cache=True, kv_offload=2)
+    g = GenerationConfig(max_new_tokens=4, greedy=True)
+    for _ in range(6):
+        eng.submit(rng.randint(0, 97, (16,)).astype(np.int32), g)
+    eng.drain()
+    m = eng.metrics()["prefix_cache"]
+    assert m["spilled_pages"] > 2
+    assert m["host_evicted_pages"] > 0
+    assert m["host_pages"] <= 2
+
+
+def test_offload_requires_prefix_cache(params):
+    with pytest.raises(ValueError, match="prefix_cache"):
+        _engine(params, kv_offload=True)
+
+
+def test_fleet_offload_aggregation(params):
+    """The fleet's offload report sums every replica's host tier."""
+    rng = np.random.RandomState(8)
+    fleet = ServingFleet(
+        [_engine(params, capacity=2, num_blocks=14, max_seq_len=32,
+                 prefix_cache=True, kv_offload=True)
+         for _ in range(2)])
+    g = GenerationConfig(max_new_tokens=4, greedy=True)
+    for _ in range(8):
+        fleet.submit(rng.randint(0, 97, (16,)).astype(np.int32), g)
+    fleet.drain()
+    off = fleet.metrics()["offload"]
+    assert off["spilled_pages"] > 0
+    assert off["spill_bytes"] > 0
+    per_replica = [r.engine.offload_metrics()["spilled_pages"]
+                   for r in fleet._replicas]
+    assert off["spilled_pages"] == sum(per_replica)
+
+
+# -- metrics schema ----------------------------------------------------
+
+FLEET_BASE_KEYS = {
+    "replicas_n", "requests_submitted", "requests_completed",
+    "tokens_generated", "tokens_per_sec", "wall_time_s", "fleet_steps",
+    "drain_truncations", "ttft_ms_mean", "ttft_ms_max", "routing",
+    "offload", "replicas",
+}
+FLEET_OBS_KEYS = {"latency", "gauges", "retrace_warnings",
+                  "stall_dumps", "timeline_events", "timeline_dropped"}
+FLEET_LATENCY_KEYS = {"ttft_ms", "tpot_ms", "queue_wait_ms", "e2e_ms",
+                      "step_ms"}
+ROUTING_KEYS = {"policy", "warm", "cold", "diverted", "warm_hit_ratio",
+                "per_replica"}
+OFFLOAD_KEYS = {"spilled_pages", "restored_pages", "readopted_pages",
+                "host_evicted_pages", "host_pages", "spill_bytes",
+                "restore_bytes"}
+
+
+def test_fleet_metrics_schema_frozen(params):
+    """The fleet metric key set is a CONTRACT (bench output): extend
+    deliberately, never by accident — enabled AND disabled."""
+    fleet = ServingFleet([_engine(params), _engine(params)])
+    _stream(fleet, n=4)
+    m = fleet.metrics()
+    assert set(m.keys()) == FLEET_BASE_KEYS
+    assert set(m["routing"].keys()) == ROUTING_KEYS
+    assert set(m["offload"].keys()) == OFFLOAD_KEYS
+    fleet = ServingFleet(
+        [_engine(params, observability=True),
+         _engine(params, observability=True)], observability=True)
+    _stream(fleet, n=4)
+    m = fleet.metrics()
+    assert set(m.keys()) == FLEET_BASE_KEYS | FLEET_OBS_KEYS
+    assert set(m["latency"].keys()) == FLEET_LATENCY_KEYS
+    assert m["latency"]["ttft_ms"]["count"] == 4
+    assert m["latency"]["tpot_ms"]["count"] == 4
+    # reset restarts the window and re-shares the histograms
+    fleet.reset_metrics()
+    _stream(fleet, n=3, seed=9)
+    m = fleet.metrics()
+    assert m["latency"]["ttft_ms"]["count"] == 3
+    assert m["requests_submitted"] == 3
+
+
+def test_fleet_timeline_route_events(params, tmp_path):
+    fleet = ServingFleet([_engine(params, prefix_cache=True),
+                          _engine(params, prefix_cache=True)],
+                         observability=True)
+    _stream(fleet, n=4)
+    path = str(tmp_path / "fleet_timeline.jsonl")
+    fleet.write_timeline(path)
+    import json
+    with open(path) as fh:
+        lines = [json.loads(ln) for ln in fh]
+    header = lines[0]
+    assert header.get("fleet") is True
+    assert header.get("policy") == "prefix"
+    routes = [ln for ln in lines
+              if ln.get("name") == "route"]
+    assert len(routes) == 4
+    assert all("replica" in ev and "matched_tokens" in ev
+               for ev in routes)
+
+
+# -- audit wiring ------------------------------------------------------
+
+def test_catalog_offload_specs_audit_clean():
+    from paddle_tpu.analysis import audit_spec
+    from paddle_tpu.analysis.catalog import (CATALOG_PROGRAMS,
+                                             build_catalog)
+    names = ["serving_kv_spill_extract", "serving_kv_restore_insert"]
+    for n in names:
+        assert n in CATALOG_PROGRAMS
+    specs = build_catalog(names=names, register=False)
+    assert sorted(s.name for s in specs) == sorted(names)
+    for s in specs:
+        rep = audit_spec(s)
+        assert rep.findings == [], [f.fingerprint for f in rep.findings]
+    ins = next(s for s in specs
+               if s.name == "serving_kv_restore_insert")
+    assert ins.donate_argnums == (0, 1)
+    assert ins.carry == {0: 0, 1: 1}
+
+
+def test_engine_audit_covers_offload_and_restores_counters(params):
+    eng = _engine(params, prefix_cache=True, kv_offload=True)
+    eng.submit(np.arange(1, 9, dtype=np.int32),
+               GenerationConfig(max_new_tokens=2, greedy=True))
+    eng.drain()
+    before = eng.counters["offload_traces"]
+    reports = eng.audit(register=False)
+    assert all(r.findings == [] for r in reports)
+    assert eng.counters["offload_traces"] == before
+    assert {r.program for r in reports} >= {
+        "serving_kv_spill_extract", "serving_kv_restore_insert"}

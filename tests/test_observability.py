@@ -81,6 +81,9 @@ BASE_KEYS = {
     # r15: SLO-aware admission (preempt/requeue counters + the
     # per-class queue-wait / slo_attainment scheduler report)
     "preemptions", "requeues", "deadline_expired", "scheduler",
+    # r16: host-RAM KV offload tier (spill extract / restore insert
+    # traces + bytes each direction; zeros without kv_offload)
+    "offload_traces", "kv_spill_bytes", "kv_restore_bytes",
 }
 OBS_KEYS = {"latency", "gauges", "retrace_warnings", "stall_dumps",
             "timeline_events", "timeline_dropped"}

@@ -60,6 +60,7 @@ PACK = [
     # forced-host CPU: structure/parity evidence, cheap and tunnel-proof
     ("serving_tp", 900, 2),
     ("serving_disagg", 900, 2),
+    ("serving_fleet", 900, 2),
     ("llama_ladder", 2700, 2),
     ("resnet50_sweep", 1500, 2),
     ("kernels", 1200, 3),
