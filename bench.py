@@ -910,6 +910,104 @@ def bench_serving_prefix_cache():
             "tail": tail, "gen": gen_n, "arrival_rate_hz": rate}
 
 
+def bench_serving_prefill():
+    """Prefill-heavy Poisson mix: fused vs unfused chunked prefill A/B
+    (the r17 prefill-side megakernel). Mixed-length prompts (ragged
+    chunks — the pad-FLOPs story) with short generations run through
+    the SAME arrival trace twice: fused_prefill=False (the verbatim
+    gather/cached_forward/scatter chunk) and the default fused route.
+    Reports TTFT / prefill-chunk-time distributions, prefill tokens/s,
+    the pad-token counter (the compute the ragged kernels skip where
+    dispatched), the dispatched variant, and greedy parity between the
+    two engines. Off-TPU dispatch falls back on both sides, so the
+    capture proves structure + bit-parity; on TPU it carries the
+    fused-vs-unfused TTFT claim. Banked next to serving_engine's
+    decode_ab."""
+    import jax
+    from paddle_tpu.inference.generation import GenerationConfig
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models.llama import LlamaConfig, init_params
+
+    cap = int(os.environ.get("BENCH_SPREFILL_CAPACITY", "8"))
+    R = int(os.environ.get("BENCH_SPREFILL_REQUESTS", str(3 * cap)))
+    ctx = int(os.environ.get("BENCH_SPREFILL_CTX", "256"))
+    gen_n = int(os.environ.get("BENCH_SPREFILL_GEN", "8"))
+    rate = float(os.environ.get("BENCH_SPREFILL_RATE_HZ", "6.0"))
+    hidden = int(os.environ.get("BENCH_SPREFILL_HIDDEN", "1024"))
+    layers = int(os.environ.get("BENCH_SPREFILL_LAYERS", "12"))
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                      intermediate_size=hidden * 4,
+                      num_hidden_layers=layers,
+                      num_attention_heads=hidden // 64,
+                      num_key_value_heads=hidden // 64,
+                      max_position_embeddings=ctx + gen_n)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    # MIXED lengths: uniform in [ctx//4, ctx] so chunks are ragged
+    lens = rng.randint(max(ctx // 4, 8), ctx + 1, R)
+    prompts = [rng.randint(0, 32000, (int(s),)).astype(np.int32)
+               for s in lens]
+    gaps = rng.exponential(1.0 / rate, R)
+    gaps[0] = 0.0
+    arrivals = np.cumsum(gaps)
+    g = GenerationConfig(max_new_tokens=gen_n, greedy=True)
+    buckets = tuple(sorted({min(64, ctx), ctx}))
+
+    def run(fp):
+        eng = ServingEngine(params, cfg, capacity=cap, block_size=16,
+                            max_seq_len=ctx + gen_n,
+                            prefill_buckets=buckets, fused_prefill=fp,
+                            observability=True)
+        gw = GenerationConfig(max_new_tokens=2, greedy=True)
+        for s in buckets:           # warm every bucket + decode
+            eng.submit(rng.randint(0, 32000, (s - 2,))
+                       .astype(np.int32), gw)
+            eng.drain()
+        eng.reset_metrics()
+        reqs, t0, i = [], time.perf_counter(), 0
+        while i < R or not eng.idle:
+            now = time.perf_counter() - t0
+            while i < R and arrivals[i] <= now:
+                reqs.append(eng.submit(prompts[i], g))
+                i += 1
+            if not eng.step() and i < R:
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+        wall = time.perf_counter() - t0
+        m = eng.metrics()
+        side = {"ttft_ms": m["latency"]["ttft_ms"],
+                "ttft_ms_mean": m["ttft_ms_mean"],
+                "prefill_chunk_ms": m["latency"]["prefill_chunk_ms"],
+                "prefill_tokens_per_sec": m["prefill_tokens_per_sec"],
+                "tokens_per_sec": round(R * gen_n / wall, 1),
+                "prefill_chunks": m["prefill_chunks"],
+                "prefill_pad_tokens": m["prefill_pad_tokens"],
+                "prefill_traces": m["prefill_traces"],
+                "retrace_warnings": m["retrace_warnings"],
+                "variant": m["prefill_variant"]}
+        return side, [r.output_ids for r in reqs]
+
+    unfused, out_u = run(False)
+    fused, out_f = run(None)            # the default flag route
+    matches = [bool(np.array_equal(a, b))
+               for a, b in zip(out_f, out_u)]
+    f_t, u_t = fused["ttft_ms_mean"], unfused["ttft_ms_mean"]
+    return {"metric": "serving_prefill_fused_ttft_ms_mean",
+            "value": f_t, "unit": "ms",
+            "unfused_ttft_ms_mean": u_t,
+            "ttft_speedup": (round(u_t / f_t, 3)
+                             if f_t and u_t else None),
+            "greedy_parity": round(sum(matches) / max(len(matches), 1),
+                                   4),
+            "fused": fused, "unfused": unfused,
+            "pad_tokens_skipped_by_fused_dispatch":
+                fused["prefill_pad_tokens"]
+                if fused["variant"].get("attn") == "pallas_fused"
+                else 0,
+            "requests": R, "capacity": cap, "ctx": ctx, "gen": gen_n,
+            "buckets": list(buckets), "arrival_rate_hz": rate}
+
+
 def bench_serving_tp():
     """Tensor-parallel serving A/B on FORCED-HOST virtual CPU devices:
     the SAME Poisson arrival trace through a tp=1 engine and a tp=N
@@ -1644,6 +1742,36 @@ def bench_flash_tune():
         wd = jax.random.normal(ks[10], (4 * D, D), dt) * 0.02
         _sweep(f"fused_mlp|{B}x{H}x{KV}x{hd}x{jnp.dtype(dt).name}",
                lambda: fused_mlp_block_pallas(x, nw, wg, wu, wd))
+        # fused-prefill tunables ((block_q, pages_per_step) pairs) at
+        # the serving bucket widths — the engine's chunk runners are
+        # traced and only READ the table; dispatch-guarded like the
+        # decode sweeps (a rejected shape's key is never looked up)
+        from paddle_tpu.ops.pallas.fused_prefill_block import (
+            fused_prefill_attn_pallas, prefill_meta_dims)
+        for P in (32, 64):
+            MBp = MBs[-1]
+            pm = prefill_meta_dims(P, D, H, KV, hd, 4 * D, BS, MBp,
+                                   dt, dt, False)
+            sel_name, _ = KERNELS.dispatch("prefill_attn_block", pm)
+            ptag = f"{P}x{H}x{KV}x{hd}x{jnp.dtype(dt).name}xMB{MBp}"
+            if sel_name != "pallas_fused":
+                decode_tuned[f"fused_prefill|{ptag}"] = \
+                    f"skipped: dispatch -> {sel_name}"
+                continue
+            T2 = BS * MBp
+            pos0 = min(T2 - P, T2 // 2)
+            kpp = jax.random.normal(ks[1], (B * MBp, BS, KV, hd), dt)
+            vpp = jax.random.normal(ks[2], (B * MBp, BS, KV, hd), dt)
+            ptab = jnp.arange(MBp, dtype=jnp.int32)
+            pang = ((pos0 + np.arange(P))[:, None]
+                    / (10000.0 ** (np.arange(0, hd, 2) / hd)))
+            psin = jnp.asarray(np.sin(pang), jnp.float32)
+            pcos = jnp.asarray(np.cos(pang), jnp.float32)
+            xp = jax.random.normal(ks[3], (P, D), dt)
+            _sweep(f"fused_prefill|{ptag}",
+                   lambda: fused_prefill_attn_pallas(
+                       xp, nw, wq, wk, wv, wo, psin, pcos, kpp, vpp,
+                       ptab, jnp.int32(pos0), jnp.int32(P))[0])
     # training-path tunables (fused linear+CE (block_t, block_v) and
     # fused-SwiGLU block_f): the read sites are the jitted train steps
     # (models/llama.py, models/gpt.py loss_fn) — traced, so they can
@@ -1954,6 +2082,40 @@ def bench_kernels():
            fx, fnw, fwg, fwu, fwd_, tol=5e-2,
            bytes_moved=3 * FD * FF * 2 + 2 * FB * FD * 2)
 
+    # ---- fused prefill-block megakernel (ragged chunked prefill) -------
+    # one transformer block's prefill chunk (warm mid-window start,
+    # ragged valid rows) vs the dense gather composition it replaces —
+    # feeds the same kernel_bench_gate trajectory as the decode rows
+    from paddle_tpu.ops.pallas.fused_prefill_block import (
+        fused_prefill_attn_pallas, prefill_attn_block_ref)
+
+    PP, PMB = (64, 24) if not interp else (16, 6)
+    p_pos0, p_valid = (PMB * FBS) // 2, PP - 3
+    pk = jax.random.split(jax.random.PRNGKey(4), 2)
+    ppos = (p_pos0 + np.arange(PP))[:, None] / (
+        10000.0 ** (np.arange(0, Fhd, 2) / Fhd))
+    psin = jnp.asarray(np.sin(ppos), jnp.float32)
+    pcos = jnp.asarray(np.cos(ppos), jnp.float32)
+    px = jax.random.normal(pk[0], (PP, FD), jnp.bfloat16)
+    PN = PMB + 2
+    pkp = jax.random.normal(pk[1], (PN, FBS, FKV, Fhd), jnp.bfloat16)
+    pvp = jax.random.normal(pk[0], (PN, FBS, FKV, Fhd), jnp.bfloat16)
+    ptab = jnp.asarray(np.random.RandomState(5).permutation(PN - 1)
+                       [:PMB] + 1, jnp.int32)
+    # live traffic: block weights + the history pages + chunk I/O
+    hist_pages = -(-p_pos0 // FBS)
+    prefill_bytes = (2 * FD * FH * Fhd + 2 * FD * FKV * Fhd) * 2 \
+        + hist_pages * FBS * FKV * Fhd * 2 * 2 + 2 * PP * FD * 2
+    record("fused_prefill_attn",
+           jax.jit(lambda *a: fused_prefill_attn_pallas(
+               *a, jnp.int32(p_pos0), jnp.int32(p_valid))[0]
+               [:p_valid]),
+           jax.jit(lambda *a: prefill_attn_block_ref(
+               *a, jnp.int32(p_pos0), jnp.int32(p_valid))[0]
+               [:p_valid]),
+           px, fnw, fwq, fwk, fwv, fwo, psin, pcos, pkp, pvp, ptab,
+           tol=5e-2, bytes_moved=prefill_bytes)
+
     # ---- fused adamw ---------------------------------------------------
     N = 131072 * 32 if not interp else 4096
     p0 = jax.random.normal(qk[7], (N,), jnp.float32)
@@ -2100,6 +2262,7 @@ CONFIGS = {
     "paged_decode": bench_paged_decode,
     "serving_engine": bench_serving_engine,
     "serving_prefix_cache": bench_serving_prefix_cache,
+    "serving_prefill": bench_serving_prefill,
     "serving_tp": bench_serving_tp,
     "serving_disagg": bench_serving_disagg,
     "serving_fleet": bench_serving_fleet,
@@ -2463,7 +2626,8 @@ def _merge_opportunistic(out):
     for k in ("llama", "kernels", "ernie_infer", "sd_unet", "bert",
               "resnet_breakdown", "llama_breakdown", "ppyoloe",
               "llama_ladder", "paged_decode", "serving_engine",
-              "serving_prefix_cache", "serving_tp", "serving_disagg"):
+              "serving_prefix_cache", "serving_prefill", "serving_tp",
+              "serving_disagg"):
         live = out.get(k)
         stale_live = not isinstance(live, dict) or "error" in live
         cap = opp.get(k)
@@ -2557,7 +2721,8 @@ def main():
         extra_t = int(os.environ.get("BENCH_EXTRA_TIMEOUT", "900"))
         for name in ("kernels", "ernie_infer", "paged_decode",
                      "serving_engine", "serving_prefix_cache",
-                     "serving_tp", "serving_disagg", "sd_unet", "bert",
+                     "serving_prefill", "serving_tp", "serving_disagg",
+                     "sd_unet", "bert",
                      "resnet_breakdown", "ppyoloe", "llama_ladder"):
             if name == "kernels":
                 _kernel_audit(out)   # pre-window geometry audit

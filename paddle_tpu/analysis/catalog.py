@@ -30,6 +30,7 @@ CATALOG_PROGRAMS = ("train_step", "train_step_fused",
                     "fused_optimizer_step",
                     "serving_decode", "serving_decode_fused",
                     "serving_prefill_16", "serving_prefill_32",
+                    "serving_prefill_fused",
                     "serving_page_copy",
                     "serving_kv_spill_extract",
                     "serving_kv_restore_insert",
@@ -140,6 +141,16 @@ def _serving_specs(register: bool):
                               fused_decode="pallas")
     fused = [s for s in fused_eng.program_specs(register=False)
              if s.name == "serving_decode_fused"]
+    # the fused PREFILL chunk the same way: a forced-pallas-prefill
+    # engine's bucket program, renamed to its catalog entry (the
+    # audited jaxpr contains the prefill megakernels even on CPU)
+    import dataclasses as _dc
+    fp_eng = ServingEngine(params, cfg, capacity=2, block_size=8,
+                           max_seq_len=64, prefill_buckets=(16,),
+                           fused_prefill="pallas")
+    fused += [_dc.replace(s, name="serving_prefill_fused")
+              for s in fp_eng.program_specs(register=False)
+              if s.name == "serving_prefill_fused_16"]
     if register:
         from .registry import REGISTRY
         for s in fused:
@@ -307,7 +318,7 @@ def build_catalog(names: Optional[List[str]] = None,
         specs.append(_fused_optimizer_spec(register))
     if wanted & {"serving_decode", "serving_decode_fused",
                  "serving_prefill_16", "serving_prefill_32",
-                 "serving_page_copy"}:
+                 "serving_prefill_fused", "serving_page_copy"}:
         specs.extend(s for s in _serving_specs(register)
                      if s.name in wanted)
     if wanted & {"serving_kv_spill_extract",

@@ -195,6 +195,35 @@ def _attn_block_case(B, D, H, KV, hd, BS, N, MB, dtype, quant=False,
     return build
 
 
+def _prefill_attn_case(P, D, H, KV, hd, BS, N, MB, dtype, quant=False,
+                       pos0=0, bq=None, pp=None):
+    def build():
+        import jax.numpy as jnp
+        from ..ops.pallas.fused_prefill_block import (
+            fused_prefill_attn_pallas)
+
+        pool_dt = "int8" if quant else dtype
+
+        def fn(x, nw, wq, wk, wv, wo, sin, cos, kp, vp, tab, *sc):
+            kv_scales = (sc[0], sc[1]) if quant else None
+            return fused_prefill_attn_pallas(
+                x, nw, wq, wk, wv, wo, sin, cos, kp, vp, tab,
+                jnp.int32(pos0), jnp.int32(P), kv_scales=kv_scales,
+                block_q=bq, pages_per_step=pp)
+        args = [_sds((P, D), dtype), _sds((D,), dtype),
+                _sds((D, H * hd), dtype), _sds((D, KV * hd), dtype),
+                _sds((D, KV * hd), dtype), _sds((H * hd, D), dtype),
+                _sds((P, hd // 2), "float32"),
+                _sds((P, hd // 2), "float32"),
+                _sds((N, BS, KV, hd), pool_dt),
+                _sds((N, BS, KV, hd), pool_dt),
+                _sds((MB,), "int32")]
+        if quant:
+            args += [_sds((KV,), "float32"), _sds((KV,), "float32")]
+        return fn, tuple(args)
+    return build
+
+
 def _mlp_block_case(B, D, F, dtype):
     def build():
         from ..ops.pallas.fused_decode_block import fused_mlp_block_pallas
@@ -294,6 +323,25 @@ def kernel_cases() -> List[KernelCase]:
           _mlp_block_case(2, 32, 64, "float32")),
         C("decode_mlp_block", "flagship_serving", ("decode_mlp_block",),
           _mlp_block_case(8, 1024, 4096, "bfloat16")),
+        # fused prefill: tiny (warm mid-page start) + the
+        # bench_serving_engine shape class at a warm-suffix bucket
+        # (P=64; the 10MiB dispatch budget binds the largest buckets
+        # at this width — the audit's 16MiB window model still fits)
+        C("prefill_attn_block", "tiny", ("prefill_attn_block",),
+          _prefill_attn_case(16, 32, 4, 2, 16, 8, 9, 6, "float32",
+                             pos0=10)),
+        C("prefill_attn_block", "flagship_serving",
+          ("prefill_attn_block",),
+          _prefill_attn_case(64, 1024, 16, 16, 64, 16, 129, 24,
+                             "bfloat16", pos0=128)),
+        C("prefill_attn_block", "flagship_serving_int8",
+          ("prefill_attn_block",),
+          _prefill_attn_case(64, 1024, 16, 16, 64, 16, 129, 24,
+                             "bfloat16", quant=True, pos0=128)),
+        # the prefill MLP op dispatches the decode MLP megakernel at
+        # chunk-row counts — audited at the bucket widths
+        C("prefill_mlp_block", "flagship_serving", ("decode_mlp_block",),
+          _mlp_block_case(64, 1024, 4096, "bfloat16")),
         C("fused_linear_ce", "tiny", _CE_KERNELS,
           _linear_ce_case(24, 64, 96, "float32")),
         C("fused_linear_ce", "flagship_train", _CE_KERNELS,
@@ -372,14 +420,19 @@ def _lint_metas() -> Dict[str, dict]:
     import jax.numpy as jnp
     from ..ops.pallas.fused_adamw import adamw_meta
     from ..ops.pallas.fused_decode_block import decode_meta_dims
+    from ..ops.pallas.fused_prefill_block import prefill_meta_dims
     from ..ops.pallas.fused_train import ce_meta, swiglu_meta
     from ..ops.pallas.norms import rms_bwd_meta
 
     decode = decode_meta_dims(8, 1024, 16, 16, 64, 4096, 16, 24,
                               jnp.bfloat16, jnp.bfloat16, False)
+    prefill = prefill_meta_dims(64, 1024, 16, 16, 64, 4096, 16, 24,
+                                jnp.bfloat16, jnp.bfloat16, False)
     return {
         "decode_attn_block": decode,
         "decode_mlp_block": decode,
+        "prefill_attn_block": prefill,
+        "prefill_mlp_block": prefill,
         "fused_linear_ce": ce_meta(4096, 2048, 32000, jnp.bfloat16),
         "fused_swiglu": swiglu_meta(4096, 5504, jnp.bfloat16),
         "rms_norm_bwd": rms_bwd_meta(4096, 2048, jnp.bfloat16),

@@ -195,7 +195,8 @@ class DisaggregatedEngine:
                  prefill_buckets=(32, 128), seed: int = 0,
                  prefix_cache: bool = False, kv_offload=False,
                  observability=False,
-                 fused_decode=None, aging_s: Optional[float] = None):
+                 fused_decode=None, fused_prefill=None,
+                 aging_s: Optional[float] = None):
         pre_mesh, dec_mesh = self._resolve_groups(
             prefill_devices, decode_devices, mesh, prefill_tp,
             collective)
@@ -236,7 +237,8 @@ class DisaggregatedEngine:
             cache_dtype=cache_dtype, prefill_buckets=prefill_buckets,
             seed=seed, prefix_cache=prefix_cache, kv_offload=kv_offload,
             observability=pre_obs,
-            fused_decode=False, mesh=pre_mesh, aging_s=aging_s,
+            fused_decode=False, fused_prefill=fused_prefill,
+            mesh=pre_mesh, aging_s=aging_s,
             on_complete=self._on_prefilled,
             on_chunk=self._on_prefill_chunk)
         self.decode = ServingEngine(
@@ -244,7 +246,8 @@ class DisaggregatedEngine:
             num_blocks=num_blocks, max_seq_len=msl,
             cache_dtype=cache_dtype, prefill_buckets=prefill_buckets,
             seed=seed + 1, prefix_cache=False, observability=dec_obs,
-            fused_decode=fused_decode, mesh=dec_mesh, aging_s=aging_s)
+            fused_decode=fused_decode, fused_prefill=fused_prefill,
+            mesh=dec_mesh, aging_s=aging_s)
         if self._obs is not None:
             # one timeline ring + one request-record log for the whole
             # engine: both workers' events (submit/admit/prefill_chunk/

@@ -259,6 +259,112 @@ def _fused_mode(fused_decode):
                      f"got {fused_decode!r}")
 
 
+def _fused_prefill_mode(fused_prefill):
+    """Normalize a ``fused_prefill`` knob: None reads the global flag
+    (default ON — "on where supported": dispatch still falls back to
+    the verbatim unfused chunk off-TPU / for unsupported shapes)."""
+    from ..core.flags import GLOBAL_FLAGS
+    from ..ops.pallas import fused_prefill_block  # noqa: F401 — flag
+    if fused_prefill is None:
+        fused_prefill = bool(GLOBAL_FLAGS.get("fused_prefill"))
+    if fused_prefill is False:
+        return False
+    if fused_prefill is True:
+        return "auto"
+    if fused_prefill in ("auto", "pallas", "ref"):
+        return fused_prefill
+    raise ValueError(f"fused_prefill must be bool|auto|pallas|ref, "
+                     f"got {fused_prefill!r}")
+
+
+def _prefill_route(mode):
+    """The trace-time inputs (beyond the jit signature) that can
+    reshape a fused-prefill chunk program: the registry's force-pin
+    stack (consulted by dispatch in "auto" mode), the VMEM budget
+    (reshapes supports() and the tile candidate lists) and the
+    interpret override — every program cache holding a fused-prefill
+    trace must fold this in (the ``_PAGED_CACHE`` route contract)."""
+    if not mode:
+        return ()
+    from ..ops.pallas._util import interpret_mode
+    from ..ops.pallas.fused_decode_block import _vmem_budget
+    from ..ops.pallas.registry import KERNELS
+    pins = KERNELS.forced_state() if mode in ("auto", True) else ()
+    return (pins, _vmem_budget(), bool(interpret_mode()))
+
+
+def _fused_prefill_forward(params, toks, cfg, k_pools, v_pools, table,
+                           wtable, pos0, n_valid, kv_scales=None,
+                           mode="auto"):
+    """One request's prefill chunk through the fused prefill-block
+    kernels, pool-direct (ops/pallas/fused_prefill_block.py).
+
+    toks: [P] int32 bucket-padded chunk tokens (``n_valid`` real);
+    pools [L, N, BS, KV, hd]; table/wtable [MB] — the request's READ
+    table and prefix-cache WRITE table. Per layer: ONE fused attention
+    kernel (RMSNorm + QKV + RoPE + flash attention over the paged
+    history + the chunk's own K/V + o_proj + residual), the chunk's
+    K/V scattered into the pools through the write table
+    (``write_chunk_to_pool[_quant]`` — only the chunk's own positions,
+    not the whole dense view), and ONE fused MLP kernel. Returns
+    (logits [P, V], k_pools, v_pools). Callers guard with
+    :func:`fused_prefill_block.prefill_fused_selected` — when dispatch
+    does not pick BOTH Pallas kernels they run the verbatim unfused
+    chunk instead (the bit-identical fallback contract).
+    """
+    from ..ops import rms_norm as fused_rms_norm
+    from ..ops.paged_attention import (write_chunk_to_pool,
+                                       write_chunk_to_pool_quant)
+    from ..ops.pallas.fused_prefill_block import (prefill_meta,
+                                                  resolve_prefill_blocks)
+
+    P = toks.shape[0]
+    BS = k_pools.shape[2]
+    MB = table.shape[0]
+    meta = prefill_meta(cfg, P, BS, MB, k_pools.dtype,
+                        kv_scales is not None)
+    attn_fn, mlp_fn, _ = resolve_prefill_blocks(meta, mode)
+    x = jnp.take(params["embed_tokens"], toks, axis=0)       # [P, D]
+    sin_full, cos_full = build_rope_cache(MB * BS, cfg.head_dim,
+                                          base=cfg.rope_theta)
+    pos0 = jnp.asarray(pos0, jnp.int32)
+    n_valid = jnp.asarray(n_valid, jnp.int32)
+    sin = jax.lax.dynamic_slice_in_dim(sin_full, pos0, P, axis=0)
+    cos = jax.lax.dynamic_slice_in_dim(cos_full, pos0, P, axis=0)
+    wtable = jnp.asarray(wtable, jnp.int32)
+
+    def layer(x, xs):
+        if kv_scales is None:
+            lp, kp, vp = xs
+            scales = None
+        else:
+            lp, kp, vp, ksc, vsc = xs
+            scales = (ksc, vsc)
+        x, k_new, v_new = attn_fn(
+            x, lp["input_norm"].astype(x.dtype), lp["q_proj"],
+            lp["k_proj"], lp["v_proj"], lp["o_proj"], sin, cos, kp, vp,
+            table, pos0, n_valid, scales, cfg.rms_norm_eps)
+        if scales is None:
+            kp, vp = write_chunk_to_pool(kp, vp, wtable, pos0, n_valid,
+                                         k_new, v_new)
+        else:
+            kp, vp = write_chunk_to_pool_quant(
+                kp, vp, wtable, pos0, n_valid, k_new, v_new, ksc, vsc)
+        x = mlp_fn(x, lp["post_norm"].astype(x.dtype), lp["gate_proj"],
+                   lp["up_proj"], lp["down_proj"], cfg.rms_norm_eps)
+        return x, (kp, vp)
+
+    scan_xs = (params["layers"], k_pools, v_pools) if kv_scales is None \
+        else (params["layers"], k_pools, v_pools) + tuple(kv_scales)
+    x, (k_pools, v_pools) = jax.lax.scan(layer, x, scan_xs)
+    x = fused_rms_norm(x[None], params["final_norm"].astype(x.dtype),
+                       cfg.rms_norm_eps)[0]
+    head = params.get("lm_head")
+    if head is None:
+        head = params["embed_tokens"].T
+    return x @ head, k_pools, v_pools
+
+
 def _mesh_route(sm):
     """The mesh's contribution to a program-cache key: axis name, tp
     degree, collective placement and the device identities (two meshes
@@ -475,6 +581,34 @@ def _fused_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
     return x @ head, k_pools, v_pools
 
 
+_FUSED_PREFILL_CACHE: Dict = {}
+
+
+def _suffix_prefill_runner(cfg, P, MB, mode):
+    """Jitted pool-direct fused suffix prefill for the prefix-store
+    path: one sequence's un-cached suffix (exact length ``P`` — no
+    bucket padding here, so ``n_valid == P``) through
+    :func:`_fused_prefill_forward`, pools donated so the persistent
+    store's pools update in place. Cached per (cfg values, suffix
+    length, table width, mode, prefill route)."""
+    ck = (dataclasses.astuple(cfg), P, MB, mode, _prefill_route(mode))
+    cached = _cache_get(_FUSED_PREFILL_CACHE, ck)
+    if cached is not None:
+        return cached
+
+    @functools.partial(jax.jit, donate_argnums=(4, 5))
+    def run(params, toks, pos0, table, k_pools, v_pools, wtable):
+        logits, k_pools, v_pools = _fused_prefill_forward(
+            params, toks, cfg, k_pools, v_pools, table, wtable, pos0,
+            jnp.int32(P), kv_scales=None, mode=mode)
+        return logits[P - 1], k_pools, v_pools
+
+    if len(_FUSED_PREFILL_CACHE) > 16:
+        _FUSED_PREFILL_CACHE.pop(next(iter(_FUSED_PREFILL_CACHE)))
+    _FUSED_PREFILL_CACHE[ck] = run
+    return run
+
+
 _TP_PREFILL_CACHE: Dict = {}
 
 
@@ -517,7 +651,8 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
                    gen: Optional[GenerationConfig] = None,
                    block_size: int = 16, seed: int = 0,
                    cache_dtype=None, prefix_cache=None,
-                   observability=None, fused_decode=None, mesh=None):
+                   observability=None, fused_decode=None, mesh=None,
+                   fused_prefill=None):
     """vLLM-style serving loop over a paged KV cache.
 
     ``cache_dtype="int8"``: static per-head cache quantization
@@ -552,6 +687,16 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     megakernels where supported and the bit-identical unfused
     composition elsewhere. "pallas"/"ref" force a variant.
 
+    ``fused_prefill``: route the PREFIX-STORE suffix prefill through
+    the fused prefill-block kernels (ops/pallas/fused_prefill_block.py)
+    where dispatch supports them — the suffix runs pool-direct (no
+    dense gather/scatter) with the warm prefix pages read as paged
+    history. None reads FLAGS_fused_prefill (default ON); the unfused
+    chunk composition is the bit-identical fallback everywhere
+    dispatch rejects. The COLD path's one-shot dense prefill (which
+    repacks into pools afterwards) is not a chunked program and is
+    unaffected by this knob.
+
     ``mesh``: a ``ServingMesh`` (or 1-D jax Mesh / int tp) — prefill
     and every decode chunk run tensor-parallel over the head axis
     (inference/tp.py): pools and projections shard, the residual
@@ -582,10 +727,10 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
                 "outlive the call. Use ServingEngine(mesh=..., "
                 "prefix_cache=True) for sharded prefix sharing")
     if prefix_cache is not None:
-        return _generate_paged_prefix(params, input_ids, cfg, gen,
-                                      block_size, seed, cache_dtype,
-                                      prefix_cache, observability,
-                                      fused=fused)
+        return _generate_paged_prefix(
+            params, input_ids, cfg, gen, block_size, seed, cache_dtype,
+            prefix_cache, observability, fused=fused,
+            fused_prefill=_fused_prefill_mode(fused_prefill))
     obs = observability or None
     B, S = input_ids.shape
     T = S + gen.max_new_tokens
@@ -717,7 +862,8 @@ def _scatter_prefill_pages(kp, vp, wtable, kc, vc):
 
 def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
                            seed, cache_dtype, store,
-                           observability=None, fused=False):
+                           observability=None, fused=False,
+                           fused_prefill=False):
     """``generate_paged`` over a persistent ``PagedKVCacheStore``.
 
     Admission longest-prefix-matches each prompt against the store's
@@ -785,31 +931,53 @@ def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
             "pages_in_use": store.num_blocks - len(mgr.free),
             "prefix_tree_pages": cache.cached_pages})
 
-    # suffix prefill, one sequence at a time (per-sequence pos0)
+    # suffix prefill, one sequence at a time (per-sequence pos0).
+    # With ``fused_prefill`` and dispatch selecting the Pallas pair,
+    # the suffix runs POOL-DIRECT (the warm prefix pages are the paged
+    # history, the suffix K/V scatter through the write table) —
+    # otherwise the verbatim gather/cached_forward/scatter composition.
+    from ..ops.pallas.fused_prefill_block import (prefill_fused_selected,
+                                                  prefill_meta)
     logits_last = []
     for b in range(B):
-        tb = jnp.asarray(tables[b], jnp.int32)
-        kc = jnp.take(store.k_pools, tb, axis=1) \
-            .reshape(L, 1, MB * BS, KV, hd)
-        vc = jnp.take(store.v_pools, tb, axis=1) \
-            .reshape(L, 1, MB * BS, KV, hd)
         M = matched_ns[b]
-        if obs is not None:
-            t0 = _time.perf_counter()
-        lg, kc, vc = cached_forward(
-            params, jnp.asarray(prompts[b:b + 1, M:]), cfg, kc, vc, M)
         wt = tables[b].copy()
         wt[:shared_ns[b]] = 0              # never write a shared page
-        store.k_pools, store.v_pools = _scatter_prefill_pages(
-            store.k_pools, store.v_pools, jnp.asarray(wt, jnp.int32),
-            kc, vc)
-        logits_last.append(lg[:, -1])
+        if obs is not None:
+            t0 = _time.perf_counter()
+        use_fused = fused_prefill and prefill_fused_selected(
+            prefill_meta(cfg, S - M, BS, MB, store.k_pools.dtype,
+                         False), fused_prefill)
+        if use_fused:
+            run = _suffix_prefill_runner(cfg, S - M, MB, fused_prefill)
+            lg_last, store.k_pools, store.v_pools = run(
+                params, jnp.asarray(prompts[b, M:]),
+                jnp.asarray(M, jnp.int32),
+                jnp.asarray(tables[b], jnp.int32),
+                store.k_pools, store.v_pools,
+                jnp.asarray(wt, jnp.int32))
+            logits_last.append(lg_last[None])
+        else:
+            tb = jnp.asarray(tables[b], jnp.int32)
+            kc = jnp.take(store.k_pools, tb, axis=1) \
+                .reshape(L, 1, MB * BS, KV, hd)
+            vc = jnp.take(store.v_pools, tb, axis=1) \
+                .reshape(L, 1, MB * BS, KV, hd)
+            lg, kc, vc = cached_forward(
+                params, jnp.asarray(prompts[b:b + 1, M:]), cfg, kc, vc,
+                M)
+            store.k_pools, store.v_pools = _scatter_prefill_pages(
+                store.k_pools, store.v_pools,
+                jnp.asarray(wt, jnp.int32), kc, vc)
+            logits_last.append(lg[:, -1])
         if obs is not None:
             dur = (_time.perf_counter() - t0) * 1e3
             obs.hist("prefill_chunk_ms").observe(dur)
             obs.timeline.record("prefill_chunk", req_id=seq_ids[b],
                                 dur_ms=dur, pos0=M, n=int(S - M),
-                                matched_tokens=M)
+                                matched_tokens=M,
+                                variant=("pallas" if use_fused
+                                         else "ref"))
 
     key = _key_for(seed)
     tok = sample_token(jnp.concatenate(logits_last, axis=0), key, gen)

@@ -30,14 +30,16 @@ RadixAttention, applied to the pools of ``ops.paged_attention``):
   cascading upward as parents become leaves. It runs on demand through
   ``BlockManager.reclaim`` when the free list is dry, so a full pool
   degrades to per-request allocation instead of failing admission.
-- HOST-RAM OFFLOAD TIER (``spill_page``/``restore_page`` supplied by
-  the pool owner): instead of destroying a warm page, eviction SPILLS
-  its bytes to host memory (one jitted single-page extract followed by
-  ``device_put`` onto the host memory space — pinned where the backend
-  offers it) and the node stays in the tree with ``page=None``. A
-  later prefix hit on a spilled node RESTORES the page through the
-  same machinery in the opposite direction (``device_put`` back +
-  donated single-page insert), byte-identical to what was spilled —
+- HOST-RAM OFFLOAD TIER (``spill_pages``/``restore_pages`` supplied by
+  the pool owner): instead of destroying warm pages, eviction SPILLS
+  their bytes to host memory in fixed-width multi-page WINDOWS (one
+  jitted window extract followed by ``device_put`` onto the host
+  memory space — pinned where the backend offers it) and the nodes
+  stay in the tree with ``page=None``. A later prefix hit on spilled
+  nodes RESTORES the pages through the same machinery in the opposite
+  direction (``device_put`` back + one donated window insert, whose
+  device copy overlaps the suffix prefill chunk issued next),
+  byte-identical to what was spilled —
   effective prefix-cache capacity becomes HBM + host RAM. A finished
   request whose pages re-cover a spilled node re-adopts its device
   pages directly (no device copy). ``host_budget_pages`` bounds the
@@ -137,27 +139,32 @@ class PrefixCache:
     primitive. The cache installs itself as the manager's ``reclaim``
     callback so allocation pressure drives eviction.
 
-    ``spill_page(page) -> payload`` / ``restore_page(payload, dst)``
-    (both supplied, or neither) enable the host-RAM offload tier:
-    eviction spills instead of dropping, and a prefix hit on a spilled
-    node restores before sharing. ``host_budget_pages`` caps the tier
-    (None = unbounded); past it the LRU childless spilled node dies."""
+    ``spill_pages(pages) -> payloads`` / ``restore_pages(payloads,
+    dsts)`` (both supplied, or neither) enable the host-RAM offload
+    tier: eviction spills instead of dropping, and a prefix hit on a
+    spilled node restores before sharing — both move whole batches so
+    the owner can window the transfers. ``host_budget_pages`` caps the
+    tier (None = unbounded); past it the LRU childless spilled node
+    dies."""
 
     def __init__(self, mgr: BlockManager, block_size: int,
                  copy_page: Callable[[int, int], None],
-                 spill_page: Optional[Callable[[int], object]] = None,
-                 restore_page: Optional[Callable[[object, int],
-                                                 None]] = None,
-                 host_budget_pages: Optional[int] = None):
-        if (spill_page is None) != (restore_page is None):
-            raise ValueError("spill_page and restore_page come as a "
+                 host_budget_pages: Optional[int] = None,
+                 spill_pages: Optional[Callable] = None,
+                 restore_pages: Optional[Callable] = None):
+        if (spill_pages is None) != (restore_pages is None):
+            raise ValueError("spill_pages and restore_pages come as a "
                              "pair: a tier that can spill but not "
                              "restore would silently drop warm KV")
         self.mgr = mgr
         self.bs = int(block_size)
         self.copy_page = copy_page
-        self._spill = spill_page
-        self._restore = restore_page
+        # the batched offload pair (r17): spill_pages(pages) -> one
+        # opaque per-page payload each; restore_pages(payloads, dsts)
+        # — the pool owner moves whole batches in fixed-width
+        # multi-page windows (serving.py's windowed handoff programs)
+        self._spill_batch = spill_pages
+        self._restore_batch = restore_pages
         self.host_budget = (None if host_budget_pages is None
                             else int(host_budget_pages))
         self.root = _Node((), None, None)
@@ -313,13 +320,17 @@ class PrefixCache:
             for nd in resident:
                 self.mgr.decref(nd.page)
             return None
-        for nd in full:
-            if nd.page is None:
-                self._restore_node(nd)
+        spilled = [nd for nd in full if nd.page is None]
+        fork_spilled = will_fork and tail.page is None
+        if spilled or fork_spilled:
+            # ONE batched restore, root-first (list order); the pin
+            # below lands before any later caller's reclaim can run
+            batch = spilled + ([tail] if fork_spilled else [])
+            self._restore_nodes(batch)
+            for nd in spilled:
                 self.mgr.incref(nd.page)    # the caller's reference
-        if will_fork and tail.page is None:
-            self._restore_node(tail)
-            self.mgr.incref(tail.page)      # the fork-source pin
+            if fork_spilled:
+                self.mgr.incref(tail.page)  # the fork-source pin
         pages = [nd.page for nd in full]
         matched = len(full) * self.bs
         if will_fork:
@@ -337,19 +348,24 @@ class PrefixCache:
         self.stats["shared_pages"] += len(full)
         return pages, matched, len(full)
 
-    def _restore_node(self, nd: _Node):
-        """Bring a spilled node back on device: one fresh pool page
-        (rc 1 — the tree's reference) + the owner's restore_page
-        device_put/insert. The allocation may itself reclaim; matched
-        resident pages are pinned by then and spilled nodes hold no
-        page, so the reclaim can never touch the matched path."""
-        page = self.mgr.alloc_page()
-        self._restore(nd.host, page)
-        nd.page = page
-        nd.host = None
-        self._host_pages -= 1
-        self.stats["restored_pages"] += 1
-        self.version += 1
+    def _restore_nodes(self, nodes: List[_Node]):
+        """Bring spilled nodes back on device: fresh pool pages (rc 1
+        — the tree's reference) + ONE batched ``restore_pages``
+        transfer when the owner supplied it (fixed-width multi-page
+        windows whose donated insert is dispatched, not synced — the
+        device copy overlaps the suffix prefill chunk issued next),
+        the per-page callback otherwise. The allocations may reclaim;
+        matched resident pages are pinned by then, restoring nodes
+        hold no page, and the freshly-allocated destinations are not
+        in the tree — so the reclaim can never touch the batch."""
+        dsts = [self.mgr.alloc_page() for _ in nodes]
+        self._restore_batch([nd.host for nd in nodes], dsts)
+        for nd, dst in zip(nodes, dsts):
+            nd.page = dst
+            nd.host = None
+            self._host_pages -= 1
+            self.stats["restored_pages"] += 1
+            self.version += 1
 
     # -- insertion ----------------------------------------------------
     def insert(self, tokens: Sequence[int], pages: Sequence[int]):
@@ -433,7 +449,7 @@ class PrefixCache:
         to host memory, node kept matchable. Pages shared with a live
         request (refcount >= 2) are never touched. Installed as the
         BlockManager's ``reclaim`` hook."""
-        if self._spill is not None:
+        if self._spill_batch is not None:
             return self._evict_spill(n_pages)
         return self._evict_drop(n_pages)
 
@@ -476,11 +492,9 @@ class PrefixCache:
             if not cands:
                 break
             cands.sort(key=lambda nd: (nd.last_used, id(nd)))
-            for nd in cands:
-                if freed >= n_pages:
-                    break
-                self._spill_node(nd)
-                freed += 1
+            batch = cands[:n_pages - freed]
+            self._spill_nodes(batch)    # one call spills the whole
+            freed += len(batch)         # LRU layer (windowed transfer)
             # loop: spilling a layer of leaves may expose their parents
         return freed
 
@@ -501,13 +515,18 @@ class PrefixCache:
         walk(self.root)
         return out
 
-    def _spill_node(self, nd: _Node):
-        nd.host = self._spill(nd.page)
-        self.mgr.decref(nd.page)        # 1 -> 0: back to the pool
-        nd.page = None
-        self._host_pages += 1
-        self.stats["spilled_pages"] += 1
-        self.version += 1
+    def _spill_nodes(self, nodes: List[_Node]):
+        """Spill a batch of victim nodes: one batched transfer through
+        the owner's ``spill_pages`` (fixed-width multi-page windows)."""
+        pages = [nd.page for nd in nodes]
+        payloads = self._spill_batch(pages)
+        for nd, payload in zip(nodes, payloads):
+            nd.host = payload
+            self.mgr.decref(nd.page)    # 1 -> 0: back to the pool
+            nd.page = None
+            self._host_pages += 1
+            self.stats["spilled_pages"] += 1
+            self.version += 1
         self._enforce_host_budget()
 
     def _enforce_host_budget(self):

@@ -74,8 +74,9 @@ from ..ops.paged_attention import (BlockManager, dequant_cache,
                                    quant_cache)
 from .admission import AdmissionQueue
 from .generation import (GenerationConfig, _fused_decode_step,
-                         _fused_mode, _paged_decode_step,
-                         cached_forward, init_cache)
+                         _fused_mode, _fused_prefill_forward,
+                         _fused_prefill_mode, _paged_decode_step,
+                         _prefill_route, cached_forward, init_cache)
 
 __all__ = ["Request", "ServingEngine"]
 
@@ -209,6 +210,7 @@ class ServingEngine:
                  prefill_buckets=(32, 128), seed: int = 0,
                  prefix_cache: bool = False, kv_offload=False,
                  observability=False, fused_decode=None, mesh=None,
+                 fused_prefill=None,
                  aging_s: Optional[float] = None):
         # tensor parallelism (inference/tp.py): a ServingMesh shards
         # the KV pools, projections and per-slot attention along the
@@ -243,6 +245,34 @@ class ServingEngine:
         # bit-identical composition elsewhere); "pallas"/"ref" force a
         # variant (tests, audit catalog)
         self._fused = _fused_mode(fused_decode)
+        # prefill-chunk kernel routing, mirroring fused_decode: False =
+        # always the verbatim gather/cached_forward/scatter chunk;
+        # "auto" (default, FLAGS_fused_prefill) = pool-direct fused
+        # chunk where the registry supports BOTH prefill-block kernels,
+        # the verbatim chunk elsewhere (bit-identical by construction);
+        # "pallas"/"ref" force. Tensor-parallel engines (tp > 1) and
+        # the "gather" placement keep the unfused chunk — gather's
+        # bit-parity contract IS the single-device op sequence, and the
+        # sharded prefill body is not fused yet.
+        self._fused_prefill = _fused_prefill_mode(fused_prefill)
+        self._prefill_mesh_ok = self._mesh is None or (
+            self._mesh.tp == 1 and self._mesh.collective != "gather")
+        if self._fused_prefill == "pallas" and not self._prefill_mesh_ok:
+            # an explicit pin must never silently no-op (the PR-7
+            # rms_norm precedent)
+            raise ValueError(
+                'fused_prefill="pallas" cannot be honored on this mesh'
+                " — tensor-parallel (tp > 1) and gather-placement "
+                "prefill run the unfused chunk by contract; use "
+                'collective="psum" with tp=1 or drop the pin')
+        # registry dispatch outcome captured when a fused prefill
+        # program traces; None until then (see _make_prefill_fn_fused)
+        self._prefill_variant = None
+        # route actually built per (bucket, kernel-route) program-cache
+        # key ("pallas" | "ref"), for the timeline's variant
+        # attribution (tools/trace_summary.py) — keyed exactly like
+        # _prefill_fns so a route change cannot stale the attribution
+        self._prefill_kind: Dict[tuple, str] = {}
         # registry dispatch outcome captured when the decode program
         # traces (see _make_decode_fn); None until the first trace
         self._decode_variant = None
@@ -307,6 +337,13 @@ class ServingEngine:
         self._kv_offload = bool(kv_offload)
         self._offload_extract_fn = None
         self._offload_insert_fn = None
+        # spill/restore move in fixed-width multi-page WINDOWS (one
+        # jitted gather + one host transfer per window instead of a
+        # program per page; padded index entries point at scratch page
+        # 0 — the disagg handoff idiom)
+        import os as _os
+        self._offload_window = max(1, int(_os.environ.get(
+            "PADDLE_TPU_OFFLOAD_WINDOW", "8")))
         L_, KV_, hd_ = (cfg.num_hidden_layers,
                         cfg.num_key_value_heads, cfg.head_dim)
         # one physical page across BOTH pools, in bytes (the spill/
@@ -324,8 +361,9 @@ class ServingEngine:
                       if kv_offload and kv_offload is not True else None)
             self._pcache = PrefixCache(
                 self.mgr, BS, copy_page=self._copy_page,
-                spill_page=self._spill_page if kv_offload else None,
-                restore_page=self._restore_page if kv_offload else None,
+                spill_pages=self._spill_pages if kv_offload else None,
+                restore_pages=(self._restore_pages if kv_offload
+                               else None),
                 host_budget_pages=budget)
 
         C, MB = self.capacity, self.max_blocks
@@ -379,6 +417,10 @@ class ServingEngine:
             "decode_traces": 0, "prefill_traces": {},
             "calibration_traces": 0, "decode_steps": 0,
             "prefill_chunks": 0, "prefill_tokens": 0,
+            # bucket-pad rows fed to prefill chunks (the compute the
+            # RAGGED fused-prefill kernels skip; the unfused chunk
+            # pays it — the serving_prefill bench's pad-FLOPs counter)
+            "prefill_pad_tokens": 0,
             "live_slot_steps": 0,
             "tokens_generated": 0, "requests_submitted": 0,
             "requests_completed": 0, "drain_truncations": 0,
@@ -456,71 +498,107 @@ class ServingEngine:
     # -- host-RAM KV offload tier -------------------------------------
     def _make_offload_fns(self):
         """The host-tier handoff pair — the PR-10 extract/device_put/
-        insert machinery pointed inward: ``extract`` gathers ONE
-        physical page from both pools, ``insert`` scatters a restored
-        page back (donated, so the pools update in place). The page
-        index rides as a traced scalar: one trace each covers every
-        page, ever."""
+        insert machinery pointed inward, WINDOWED (r17): ``extract``
+        gathers a fixed-width block of ``_offload_window`` physical
+        pages from both pools in one program, ``insert`` scatters a
+        restored window back (donated, so the pools update in place).
+        Padded index entries point at scratch page 0 on both sides
+        (the disagg fixed-width idiom), so one trace each covers every
+        batch size, ever."""
         counters = self.counters
 
-        def extract(kp, vp, src):
+        def extract(kp, vp, idx):
             counters["offload_traces"] += 1
-            return kp[:, src], vp[:, src]
+            return kp[:, idx], vp[:, idx]
 
-        def insert(kp, vp, dst, kpag, vpag):
+        def insert(kp, vp, idx, kpag, vpag):
             counters["offload_traces"] += 1
-            return (kp.at[:, dst].set(kpag), vp.at[:, dst].set(vpag))
+            return (kp.at[:, idx].set(kpag), vp.at[:, idx].set(vpag))
 
         return (jax.jit(extract), jax.jit(insert, donate_argnums=(0, 1)))
 
-    def _spill_page(self, page: int):
-        """PrefixCache spill callback: one page's raw bytes -> host
-        memory (``host_put``: pinned where the backend offers it). The
-        returned payload is opaque to the cache; only
-        :meth:`_restore_page` reads it."""
+    def _spill_pages(self, pages):
+        """PrefixCache batch-spill callback: the pages' raw bytes ->
+        host memory in fixed-width windows — ONE jitted gather + ONE
+        host transfer per pool per window replaces the per-page
+        programs. The window leaves the device through ``host_put``
+        (pinned host memory where the backend offers it — the fast d2h
+        path the per-page tier used), then splits into per-page numpy
+        payloads so the host tier's per-page budget accounting stays
+        exact; only :meth:`_restore_pages` reads them."""
         from .prefix_cache import host_put
         if self._offload_extract_fn is None:
             (self._offload_extract_fn,
              self._offload_insert_fn) = self._make_offload_fns()
+        W = self._offload_window
         t0 = time.perf_counter()
-        kpg, vpg = self._offload_extract_fn(
-            self._k_pools, self._v_pools, jnp.asarray(page, jnp.int32))
-        payload = (host_put(kpg), host_put(vpg))
-        self.counters["kv_spill_bytes"] += self._page_nbytes
-        if self._obs is not None:
+        payloads = []
+        for w0 in range(0, len(pages), W):
+            win = list(pages[w0:w0 + W])
+            idx = np.zeros((W,), np.int32)
+            idx[:len(win)] = win
+            kw, vw = self._offload_extract_fn(
+                self._k_pools, self._v_pools, jnp.asarray(idx))
+            kw, vw = host_put(kw), host_put(vw)   # pinned d2h per pool
+            kw_np, vw_np = np.asarray(kw), np.asarray(vw)
+            for j in range(len(win)):
+                payloads.append((np.ascontiguousarray(kw_np[:, j]),
+                                 np.ascontiguousarray(vw_np[:, j])))
+        self.counters["kv_spill_bytes"] += self._page_nbytes * len(pages)
+        if self._obs is not None and pages:
             dur = (time.perf_counter() - t0) * 1e3
-            self._obs.hist("spill_ms").observe(dur)
-            self._obs.timeline.record(
-                "kv_spill", page=int(page), bytes=self._page_nbytes,
+            per = dur / len(pages)
+            for _ in pages:      # one observation per PAGE (the
+                self._obs.hist("spill_ms").observe(per)   # count
+            self._obs.timeline.record(   # contract: count == pages)
+                "kv_spill", pages=[int(p) for p in pages],
+                bytes=self._page_nbytes * len(pages),
                 dur_ms=round(dur, 3))
-        return payload
+        return payloads
 
-    def _restore_page(self, payload, dst: int):
-        """PrefixCache restore callback: device_put the spilled bytes
-        back and scatter them into physical page ``dst`` (the handoff
-        insert in the decode direction) — byte-identical to what was
-        spilled."""
+    def _restore_pages(self, payloads, dsts):
+        """PrefixCache batch-restore callback: device_put the spilled
+        windows back and scatter them into the destination pages with
+        the donated window insert — byte-identical to what was
+        spilled. The insert is DISPATCHED, never synced: the
+        device-side copy overlaps the suffix prefill chunk the caller
+        issues next (which consumes the updated pools) instead of
+        completing before it."""
         if self._offload_insert_fn is None:
             (self._offload_extract_fn,
              self._offload_insert_fn) = self._make_offload_fns()
+        W = self._offload_window
+        ps = self._k_pools.shape           # [L, N, BS, KV, hd]
         t0 = time.perf_counter()
-        kpg, vpg = payload
-        if self._mesh is not None:
-            kpg = self._mesh.replicate(np.asarray(kpg))
-            vpg = self._mesh.replicate(np.asarray(vpg))
-        else:
-            dev = next(iter(self._k_pools.devices()))
-            kpg = jax.device_put(kpg, dev)
-            vpg = jax.device_put(vpg, dev)
-        self._k_pools, self._v_pools = self._offload_insert_fn(
-            self._k_pools, self._v_pools, jnp.asarray(dst, jnp.int32),
-            kpg, vpg)
-        self.counters["kv_restore_bytes"] += self._page_nbytes
-        if self._obs is not None:
+        for w0 in range(0, len(dsts), W):
+            win_p = payloads[w0:w0 + W]
+            win_d = list(dsts[w0:w0 + W])
+            idx = np.zeros((W,), np.int32)
+            idx[:len(win_d)] = win_d
+            kw = np.zeros((ps[0], W) + ps[2:], self._k_pools.dtype)
+            vw = np.zeros_like(kw)
+            for j, (kpg, vpg) in enumerate(win_p):
+                kw[:, j] = kpg
+                vw[:, j] = vpg
+            if self._mesh is not None:
+                kw = self._mesh.replicate(kw)
+                vw = self._mesh.replicate(vw)
+            else:
+                dev = next(iter(self._k_pools.devices()))
+                kw = jax.device_put(kw, dev)
+                vw = jax.device_put(vw, dev)
+            self._k_pools, self._v_pools = self._offload_insert_fn(
+                self._k_pools, self._v_pools, jnp.asarray(idx), kw, vw)
+        self.counters["kv_restore_bytes"] += \
+            self._page_nbytes * len(dsts)
+        if self._obs is not None and dsts:
             dur = (time.perf_counter() - t0) * 1e3
-            self._obs.hist("restore_ms").observe(dur)
+            per = dur / len(dsts)
+            for _ in dsts:
+                self._obs.hist("restore_ms").observe(per)
             self._obs.timeline.record(
-                "kv_restore", page=int(dst), bytes=self._page_nbytes,
+                "kv_restore", pages=[int(d) for d in dsts],
+                bytes=self._page_nbytes * len(dsts),
                 dur_ms=round(dur, 3))
 
     # -- public API ---------------------------------------------------
@@ -803,6 +881,7 @@ class ServingEngine:
             round(c["live_slot_steps"] / (steps * self.capacity), 4)
             if steps else 0.0)
         c["decode_variant"] = self.decode_variant
+        c["prefill_variant"] = self.prefill_variant
         c["scheduler"] = self._scheduler_metrics()
         if self._pcache is not None:
             c["prefix_cache"] = self._pcache.metrics()
@@ -846,6 +925,7 @@ class ServingEngine:
         retrace watchdog arms HERE: any program that traces after this
         call is a steady-state retrace and warns."""
         for k in ("decode_steps", "prefill_chunks", "prefill_tokens",
+                  "prefill_pad_tokens",
                   "live_slot_steps", "tokens_generated",
                   "requests_submitted", "requests_completed",
                   "drain_truncations", "preemptions", "requeues",
@@ -1126,9 +1206,17 @@ class ServingEngine:
             pos0 = slot.prefill_pos
             n = min(S - pos0, self.buckets[-1])
             P = self._bucket_for(n)
-            fn = self._prefill_fns.get(P)
+            # the program cache keys the bucket AND the kernel route
+            # (force pins / VMEM budget / interpret override) exactly
+            # like generation.py's _PAGED_CACHE: a program traced under
+            # a pin must not be replayed for unpinned calls
+            pk = (P,) + self._prefill_route_key()
+            fn = self._prefill_fns.get(pk)
             if fn is None:
-                fn = self._prefill_fns[P] = self._make_prefill_fn(P)
+                fn = self._prefill_fns[pk] = self._make_prefill_fn(P)
+                self._prefill_kind[pk] = ("pallas"
+                                          if self._prefill_fused_for(P)
+                                          else "ref")
             toks = np.zeros((1, P), np.int32)
             toks[0, :n] = req.prompt[pos0:pos0 + n]
             t0 = time.perf_counter() if self._obs is not None else 0.0
@@ -1154,6 +1242,7 @@ class ServingEngine:
             self._end_collectives(tasks)
             self.counters["prefill_chunks"] += 1
             self.counters["prefill_tokens"] += n
+            self.counters["prefill_pad_tokens"] += P - n
             if self._obs is not None:
                 # host dispatch time only (the chunk completes async on
                 # device; forcing it here would ADD a sync to the loop)
@@ -1161,7 +1250,8 @@ class ServingEngine:
                 self._obs.hist("prefill_chunk_ms").observe(dur_ms)
                 self._obs.timeline.record(
                     "prefill_chunk", req.req_id, dur_ms=dur_ms,
-                    pos0=pos0, n=n, bucket=P)
+                    pos0=pos0, n=n, bucket=P,
+                    variant=self._prefill_kind.get(pk, "ref"))
             slot.prefill_pos += n
             if slot.prefill_pos < S:
                 # mid-prompt chunk done: the chunked-prefill handoff
@@ -1429,9 +1519,100 @@ class ServingEngine:
 
         return jax.jit(step, donate_argnums=self._DECODE_DONATE)
 
-    def _make_prefill_fn(self, P: int):
+    def _prefill_route_key(self):
+        """The fused-prefill route's contribution to the per-bucket
+        program cache key (empty when the knob is off)."""
+        return _prefill_route(self._fused_prefill) \
+            if (self._fused_prefill and self._prefill_mesh_ok) else ()
+
+    def _prefill_meta(self, P: int):
+        from ..ops.pallas.fused_prefill_block import prefill_meta
+        return prefill_meta(self.cfg, P, self.block_size,
+                            self.max_blocks, self._k_pools.dtype,
+                            self._quant)
+
+    def _prefill_fused_for(self, P: int) -> bool:
+        """Whether bucket ``P``'s chunk program should be the
+        pool-direct fused one: ALL-OR-NOTHING — both prefill-block ops
+        must resolve to the Pallas megakernels, otherwise the verbatim
+        pre-fusion chunk runs (bit-identical by construction)."""
+        if not self._fused_prefill or not self._prefill_mesh_ok:
+            return False
+        from ..ops.pallas.fused_prefill_block import (
+            prefill_fused_selected)
+        return prefill_fused_selected(self._prefill_meta(P),
+                                      self._fused_prefill)
+
+    @property
+    def prefill_variant(self) -> Dict:
+        """Which prefill-chunk implementation this engine's bucket
+        programs run: ``{"mode": ..., "attn": ..., "mlp": ...}`` —
+        captured when a fused chunk TRACES (the decode_variant
+        contract); before that, what dispatch would pick now for the
+        largest bucket."""
+        if not self._fused_prefill or not self._prefill_mesh_ok:
+            return {"mode": "unfused", "attn": "unfused",
+                    "mlp": "unfused"}
+        if self._prefill_variant is not None:
+            return dict(self._prefill_variant)
+        from ..ops.pallas.fused_prefill_block import (
+            resolve_prefill_blocks)
+        _, _, names = resolve_prefill_blocks(
+            self._prefill_meta(self.buckets[-1]), self._fused_prefill)
+        return {"mode": str(self._fused_prefill), **names}
+
+    def _make_prefill_fn_fused(self, P: int, record_variant=True):
+        """The pool-direct fused chunk program for bucket ``P``: same
+        signature, donation and <=1-trace-per-bucket contract as the
+        unfused chunk, but per layer ONE fused attention kernel over
+        the paged history + ONE fused MLP kernel, with the chunk's K/V
+        scattered through the WRITE table (only the chunk's own
+        positions move — not the whole dense view) and ragged
+        valid-length bounds skipping pad compute."""
+        cfg, counters = self.cfg, self.counters
+        MB, BS = self.max_blocks, self.block_size
+        scales = self._kv_scales
+        mode = self._fused_prefill
+        counters["prefill_traces"].setdefault(P, 0)
+
+        def chunk(params, toks, pos0, table, wtable, last_idx, temp,
+                  key, k_pools, v_pools):
+            counters["prefill_traces"][P] += 1
+            if record_variant:
+                # trace-time snapshot: the same dispatch the forward
+                # below consults, captured in the same context (the
+                # decode_variant idiom; audit clones must not clobber)
+                from ..ops.pallas.fused_prefill_block import (
+                    resolve_prefill_blocks)
+                _, _, names = resolve_prefill_blocks(
+                    self._prefill_meta(P), mode)
+                self._prefill_variant = {"mode": str(mode), **names}
+            n_valid = (jnp.asarray(last_idx, jnp.int32)
+                       + jnp.int32(1))
+            logits, k_pools, v_pools = _fused_prefill_forward(
+                params, toks[0], cfg, k_pools, v_pools, table, wtable,
+                pos0, n_valid, kv_scales=scales, mode=mode)
+            lg = jax.lax.dynamic_slice_in_dim(logits, last_idx, 1,
+                                              axis=0)
+            key, sub = jax.random.split(key)
+            tok = _sample_slots(lg, sub, temp[None])[0]
+            return tok, key, k_pools, v_pools
+
+        return jax.jit(chunk, donate_argnums=self._PREFILL_DONATE)
+
+    def _make_prefill_fn(self, P: int, record_variant=True):
+        if self._prefill_fused_for(P):
+            return self._make_prefill_fn_fused(
+                P, record_variant=record_variant)
         if self._mesh is not None:
             return self._make_prefill_fn_tp(P)
+        return self._make_prefill_fn_ref(P)
+
+    def _make_prefill_fn_ref(self, P: int):
+        """The verbatim pre-fusion chunk: gather the request's pages
+        into a dense view, run ``cached_forward``, scatter the whole
+        view back through the WRITE table — the fused path's
+        bit-identical fallback."""
         cfg, counters = self.cfg, self.counters
         MB, BS = self.max_blocks, self.block_size
         L, KV, hd = (cfg.num_hidden_layers, cfg.num_key_value_heads,
@@ -1592,6 +1773,12 @@ class ServingEngine:
         tags = ("serving",) + (("tp",) if sm is not None else ())
         decode_name = ("serving_decode_fused"
                        if self._fused in ("pallas",) else "serving_decode")
+        # a forced-pallas-PREFILL engine registers its bucket programs
+        # under their own name the same way (the audit gate covers the
+        # fused chunk next to, not instead of, the default program)
+        prefill_base = ("serving_prefill_fused"
+                        if self._fused_prefill in ("pallas",)
+                        else "serving_prefill")
         specs = [ProgramSpec(
             name=decode_name + tp_sfx, fn=self._make_decode_fn(
                 record_variant=False),
@@ -1606,8 +1793,8 @@ class ServingEngine:
         idx_dt = jnp.asarray(0).dtype
         for P in self.buckets:
             specs.append(ProgramSpec(
-                name=f"serving_prefill{tp_sfx}_{P}",
-                fn=self._make_prefill_fn(P),
+                name=f"{prefill_base}{tp_sfx}_{P}",
+                fn=self._make_prefill_fn(P, record_variant=False),
                 args=(params_sd, sds((1, P), jnp.int32), sds((), idx_dt),
                       sds((MB,), jnp.int32), sds((MB,), jnp.int32),
                       sds((), idx_dt), sds((), jnp.float32), key_sd,
@@ -1629,15 +1816,16 @@ class ServingEngine:
             # of the pools and the donated single-page scatter back
             ext, ins = self._make_offload_fns()
             ps = self._k_pools.shape
-            page_sd = sds((ps[0],) + ps[2:], self._k_pools.dtype)
+            W = self._offload_window
+            page_sd = sds((ps[0], W) + ps[2:], self._k_pools.dtype)
+            idx_sd = sds((W,), jnp.int32)
             specs.append(ProgramSpec(
                 name="serving_kv_spill_extract" + tp_sfx, fn=ext,
-                args=(pools_sd, pools_sd, sds((), jnp.int32)),
+                args=(pools_sd, pools_sd, idx_sd),
                 mesh_axes=axes, tags=tags + ("offload",)))
             specs.append(ProgramSpec(
                 name="serving_kv_restore_insert" + tp_sfx, fn=ins,
-                args=(pools_sd, pools_sd, sds((), jnp.int32),
-                      page_sd, page_sd),
+                args=(pools_sd, pools_sd, idx_sd, page_sd, page_sd),
                 donate_argnums=(0, 1), carry={0: 0, 1: 1},
                 mesh_axes=axes, tags=tags + ("offload",)))
         if register:

@@ -129,6 +129,44 @@ def write_to_pool(k_pool, v_pool, block_tables, seq_lens, k_new, v_new):
     return k_pool, v_pool
 
 
+def write_chunk_to_pool(k_pool, v_pool, wtable, pos0, n_valid,
+                        k_new, v_new):
+    """Scatter one prefill chunk's K/V into the paged pools.
+
+    k_new/v_new: [P, KV, hd] for token positions pos0..pos0+P-1 of ONE
+    request; ``wtable`` [MB] is the request's WRITE table (prefix-cache
+    shared pages redirected to scratch page 0, the COW contract), and
+    rows at/after ``n_valid`` (bucket padding) are redirected to the
+    scratch page too — so the fused prefill path writes exactly the
+    chunk's own tokens instead of re-scattering the whole dense view,
+    and can never touch a shared page whatever it computes.
+    """
+    P = k_new.shape[0]
+    BS = k_pool.shape[1]
+    rows = jnp.arange(P, dtype=jnp.int32)
+    pos = jnp.asarray(pos0, jnp.int32) + rows
+    valid = rows < jnp.asarray(n_valid, jnp.int32)
+    page = jnp.where(valid, jnp.take(jnp.asarray(wtable, jnp.int32),
+                                     pos // BS), 0)
+    off = pos % BS
+    k_pool = k_pool.at[page, off].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[page, off].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def write_chunk_to_pool_quant(k_pool, v_pool, wtable, pos0, n_valid,
+                              k_new, v_new, k_scale, v_scale):
+    """``write_chunk_to_pool`` for int8 pools: the chunk's K/V quantize
+    with the static per-head scales on the way in (the same formula as
+    ``quant_cache``, so re-quantizing untouched positions stays exact)."""
+    def q(x, s):
+        return jnp.clip(jnp.round(x.astype(jnp.float32)
+                                  / s[None, :, None]),
+                        -127, 127).astype(jnp.int8)
+    return write_chunk_to_pool(k_pool, v_pool, wtable, pos0, n_valid,
+                               q(k_new, k_scale), q(v_new, v_scale))
+
+
 # -- int8 cache quantization (static per-head scales) -----------------------
 # Reference capability: block_multihead_attention's cache_k/v quant —
 # paddle/phi/kernels/fusion/gpu/block_attn.h int8 cache load path with

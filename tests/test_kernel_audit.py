@@ -24,6 +24,7 @@ COMMITTED_BASELINE = os.path.join(REPO, "KERNEL_AUDIT_BASELINE.json")
 # sweep and the coverage assertions iterate the live registry)
 from paddle_tpu.ops.pallas import (fused_adamw as fa,           # noqa: E402
                                    fused_decode_block as fdb,
+                                   fused_prefill_block as fpb,
                                    fused_train as ft, norms)
 from paddle_tpu.ops.pallas._util import (KernelLaunchSpec,      # noqa: E402
                                          KernelOperand,
@@ -106,8 +107,9 @@ def test_catalog_captures_every_declared_kernel(catalog_reports):
         "layer_norm_fwd", "fused_adamw", "paged_attention_decode",
         "flash_attention_fwd", "flash_attention_bwd_dq",
         "flash_attention_bwd_dkv", "decode_attn_block",
-        "decode_mlp_block", "linear_ce_fwd", "linear_ce_bwd_dx",
-        "linear_ce_bwd_dh", "swiglu_fwd", "swiglu_bwd"}
+        "decode_mlp_block", "prefill_attn_block", "linear_ce_fwd",
+        "linear_ce_bwd_dx", "linear_ce_bwd_dh", "swiglu_fwd",
+        "swiglu_bwd"}
     captured = set()
     for r in catalog_reports:
         assert not any(f.code in ("COVERAGE_GAP", "TRACE_ERROR")
@@ -444,6 +446,40 @@ def _diff_decode_mlp_block():
     return run, ("decode_mlp_block",)
 
 
+def _diff_prefill_attn_block():
+    # warm mid-page start, ragged valid rows (13 of 16), odd page count
+    P, D, H, KV, hd, BS, MB = 16, 32, 4, 2, 16, 8, 5
+    N = MB + 3
+    x, nw = _f32(P, D), jnp.abs(_f32(D)) + 0.5
+    wq, wk, wv = _f32(D, H * hd), _f32(D, KV * hd), _f32(D, KV * hd)
+    wo = _f32(H * hd, D)
+    pos0, n_valid = 10, 13
+    inv = 1.0 / (10000.0 ** (np.arange(0, hd, 2) / hd))
+    ang = (pos0 + np.arange(P))[:, None] * inv[None, :]
+    sin = jnp.asarray(np.sin(ang), jnp.float32)
+    cos = jnp.asarray(np.cos(ang), jnp.float32)
+    kp, vp = _f32(N, BS, KV, hd), _f32(N, BS, KV, hd)
+    tab = jnp.asarray(_RNG.permutation(N - 1)[:MB] + 1, jnp.int32)
+
+    def run(fn):
+        xo, kn, vn = fn(x, nw, wq, wk, wv, wo, sin, cos, kp, vp, tab,
+                        jnp.int32(pos0), jnp.int32(n_valid))
+        # rows past n_valid of xo are unspecified in the ragged fused
+        # kernel (their compute is skipped) — compare the live rows
+        return xo[:n_valid], kn, vn
+    return run, ("prefill_attn_block",)
+
+
+def _diff_prefill_mlp_block():
+    P, D, F = 16, 32, 96                              # prefill rows
+    args = (_f32(P, D), jnp.abs(_f32(D)) + 0.5, _f32(D, F),
+            _f32(D, F), _f32(F, D))
+
+    def run(fn):
+        return fn(*args)
+    return run, ("prefill_mlp_block",)
+
+
 _DIFF_CASES = {
     "rms_norm_bwd": _diff_rms_norm_bwd,
     "rms_norm_residual": _diff_rms_norm_residual,
@@ -452,6 +488,8 @@ _DIFF_CASES = {
     "fused_adamw": _diff_fused_adamw,
     "decode_attn_block": _diff_decode_attn_block,
     "decode_mlp_block": _diff_decode_mlp_block,
+    "prefill_attn_block": _diff_prefill_attn_block,
+    "prefill_mlp_block": _diff_prefill_mlp_block,
 }
 
 

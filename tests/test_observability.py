@@ -84,6 +84,9 @@ BASE_KEYS = {
     # r16: host-RAM KV offload tier (spill extract / restore insert
     # traces + bytes each direction; zeros without kv_offload)
     "offload_traces", "kv_spill_bytes", "kv_restore_bytes",
+    # r17: fused prefill-block dispatch report + the bucket-pad rows
+    # fed to prefill chunks (the compute the ragged fused kernels skip)
+    "prefill_variant", "prefill_pad_tokens",
 }
 OBS_KEYS = {"latency", "gauges", "retrace_warnings", "stall_dumps",
             "timeline_events", "timeline_dropped"}

@@ -105,7 +105,52 @@ def summarize(meta, events, requests, top=10):
     sched = summarize_scheduler(events, live)
     if sched is not None:
         out["scheduler"] = sched
+    pre = summarize_prefill(events)
+    if pre is not None:
+        out["prefill"] = pre
     return out
+
+
+def summarize_prefill(events):
+    """The prefill section (r17): per-bucket chunk timings, ragged
+    occupancy (valid vs bucket-padded tokens fed to the chunks), and
+    fused-vs-ref variant attribution from the ``variant`` field the
+    engines stamp on each prefill_chunk event. Returns None when the
+    timeline has no bucketed prefill chunks (train mode / decode-only
+    windows keep their old summary shape)."""
+    chunks = [ev for ev in events if ev.get("name") == "prefill_chunk"
+              and ev.get("bucket") is not None]
+    if not chunks:
+        return None
+    per = {}
+    for ev in chunks:
+        b = per.setdefault(int(ev["bucket"]), {
+            "count": 0, "total_ms": 0.0, "max_ms": 0.0,
+            "valid_tokens": 0, "pad_tokens": 0})
+        b["count"] += 1
+        d = ev.get("dur_ms") or 0.0
+        b["total_ms"] += d
+        b["max_ms"] = max(b["max_ms"], d)
+        n = int(ev.get("n") or 0)
+        b["valid_tokens"] += n
+        b["pad_tokens"] += max(int(ev["bucket"]) - n, 0)
+    for b in per.values():
+        b["mean_ms"] = round(b["total_ms"] / b["count"], 3)
+        b["total_ms"] = round(b["total_ms"], 3)
+        b["max_ms"] = round(b["max_ms"], 3)
+        fed = b["valid_tokens"] + b["pad_tokens"]
+        b["occupancy"] = round(b["valid_tokens"] / fed, 4) if fed \
+            else None
+    variants = {}
+    for ev in chunks:
+        v = ev.get("variant") or "unknown"
+        variants[v] = variants.get(v, 0) + 1
+    tot_valid = sum(b["valid_tokens"] for b in per.values())
+    tot_pad = sum(b["pad_tokens"] for b in per.values())
+    fed = tot_valid + tot_pad
+    return {"per_bucket": {str(k): v for k, v in sorted(per.items())},
+            "occupancy": round(tot_valid / fed, 4) if fed else None,
+            "variants": variants}
 
 
 def _dist(vals):
@@ -188,6 +233,21 @@ def render(summary):
             lines.append(f"{name:<16}{s['count']:>7}{s['mean']:>10}"
                          f"{s['p50']:>10}{s['p95']:>10}{s['p99']:>10}"
                          f"{s['max']:>10}")
+    pre = summary.get("prefill")
+    if pre:
+        lines.append("")
+        lines.append(
+            f"prefill: occupancy {pre['occupancy']} "
+            f"(valid/fed token ratio), variants "
+            + ", ".join(f"{k}={v}"
+                        for k, v in sorted(pre["variants"].items())))
+        lines.append(f"{'bucket':<10}{'chunks':>8}{'mean ms':>10}"
+                     f"{'max ms':>10}{'valid tok':>11}{'pad tok':>9}"
+                     f"{'occ':>7}")
+        for bk, b in pre["per_bucket"].items():
+            lines.append(f"{bk:<10}{b['count']:>8}{b['mean_ms']:>10}"
+                         f"{b['max_ms']:>10}{b['valid_tokens']:>11}"
+                         f"{b['pad_tokens']:>9}{b['occupancy']:>7}")
     sched = summary.get("scheduler")
     if sched:
         lines.append("")
