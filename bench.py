@@ -1008,6 +1008,122 @@ def bench_serving_prefill():
             "buckets": list(buckets), "arrival_rate_hz": rate}
 
 
+def bench_serving_quant():
+    """Weight-quantized serving A/B (r18): fp vs int8 vs int4 weights
+    through the SAME Poisson arrival trace (the standard serving mix),
+    one ServingEngine per mode over a shared model. Reports per mode:
+    tokens/s, TTFT/TPOT distributions, the weight-HBM bytes each
+    decode step streams (the bandwidth multiplier the quantization
+    buys — int4 is ~4x less than bf16), the dispatched
+    weight_quant_variant, plus the accuracy budget vs the fp engine:
+    greedy flip fraction (per-token mismatches over the stream) and
+    the max/mean absolute logit error of ONE dense forward on a fixed
+    prompt. Off-TPU dispatch falls back to the dequantize-then-matmul
+    composition on every side, so the capture proves structure +
+    accuracy; on TPU it carries the fused dequant-matmul bandwidth
+    claim."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.inference.generation import (GenerationConfig,
+                                                 cached_forward,
+                                                 init_cache)
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models.llama import LlamaConfig, init_params
+    from paddle_tpu.quantization import ptq
+
+    cap = int(os.environ.get("BENCH_SQUANT_CAPACITY", "4"))
+    R = int(os.environ.get("BENCH_SQUANT_REQUESTS", str(3 * cap)))
+    ctx = int(os.environ.get("BENCH_SQUANT_CTX", "128"))
+    gen_n = int(os.environ.get("BENCH_SQUANT_GEN", "32"))
+    rate = float(os.environ.get("BENCH_SQUANT_RATE_HZ", "4.0"))
+    hidden = int(os.environ.get("BENCH_SQUANT_HIDDEN", "512"))
+    layers = int(os.environ.get("BENCH_SQUANT_LAYERS", "6"))
+
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=hidden,
+                      intermediate_size=hidden * 4,
+                      num_hidden_layers=layers,
+                      num_attention_heads=hidden // 64,
+                      num_key_value_heads=hidden // 64,
+                      max_position_embeddings=ctx + gen_n)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, 32000, (R, ctx)).astype(np.int32)
+    gaps = rng.exponential(1.0 / rate, R)
+    gaps[0] = 0.0
+    arrivals = np.cumsum(gaps)
+    g = GenerationConfig(max_new_tokens=gen_n, greedy=True)
+    # quantize ONCE per mode (deterministic) so the engines and the
+    # logit-error forward see the same trees
+    trees = {"fp": params,
+             "int8": ptq.quantize_weights(params, bits=8),
+             "int4": ptq.quantize_weights(params, bits=4)}
+
+    # accuracy budget: one dense forward at the bench shape per tree
+    probe = jnp.asarray(prompts[:1])
+    kc, vc = init_cache(cfg, 1, ctx)
+    ref_logits = np.asarray(cached_forward(params, probe, cfg, kc, vc,
+                                           0)[0][0, -1], np.float32)
+
+    def run(mode):
+        eng = ServingEngine(trees[mode], cfg, capacity=cap,
+                            block_size=16, max_seq_len=ctx + gen_n,
+                            prefill_buckets=(ctx,), observability=True)
+        eng.submit(prompts[0], GenerationConfig(max_new_tokens=2,
+                                                greedy=True))
+        eng.drain()                      # compile outside the window
+        eng.reset_metrics()
+        reqs, t0, i = [], time.perf_counter(), 0
+        while i < R or not eng.idle:
+            now = time.perf_counter() - t0
+            while i < R and arrivals[i] <= now:
+                reqs.append(eng.submit(prompts[i], g))
+                i += 1
+            if not eng.step() and i < R:
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
+        wall = time.perf_counter() - t0
+        m = eng.metrics()
+        side = {"tokens_per_sec": round(R * gen_n / wall, 1),
+                "ttft_ms": m["latency"]["ttft_ms"],
+                "tpot_ms": m["latency"]["tpot_ms"],
+                "decode_step_ms": m["latency"]["decode_step_ms"],
+                "weight_hbm_bytes": ptq.weight_hbm_bytes(trees[mode]),
+                "weight_quant_variant": m["weight_quant_variant"],
+                "decode_traces": m["decode_traces"],
+                "retrace_warnings": m["retrace_warnings"]}
+        if mode != "fp":
+            kc, vc = init_cache(cfg, 1, ctx)
+            lg = np.asarray(cached_forward(trees[mode], probe, cfg, kc,
+                                           vc, 0)[0][0, -1], np.float32)
+            side["max_logit_err_vs_fp"] = round(
+                float(np.abs(lg - ref_logits).max()), 5)
+            side["mean_logit_err_vs_fp"] = round(
+                float(np.abs(lg - ref_logits).mean()), 6)
+        return side, [r.tokens for r in reqs]
+
+    sides, streams = {}, {}
+    for mode in ("fp", "int8", "int4"):
+        sides[mode], streams[mode] = run(mode)
+    total = sum(len(t) for t in streams["fp"]) or 1
+    for mode in ("int8", "int4"):
+        flips = sum(a != b for tf, tq in zip(streams["fp"],
+                                             streams[mode])
+                    for a, b in zip(tf, tq))
+        sides[mode]["greedy_flip_fraction"] = round(flips / total, 4)
+        sides[mode]["requests_bit_identical"] = sum(
+            tf == tq for tf, tq in zip(streams["fp"], streams[mode]))
+    fp_b = sides["fp"]["weight_hbm_bytes"]
+    return {"metric": "serving_quant_int4_weight_hbm_reduction",
+            "value": round(fp_b / max(sides["int4"]["weight_hbm_bytes"],
+                                      1), 3),
+            "unit": "x fewer weight bytes/step",
+            "int8_weight_hbm_reduction": round(
+                fp_b / max(sides["int8"]["weight_hbm_bytes"], 1), 3),
+            "fp": sides["fp"], "int8": sides["int8"],
+            "int4": sides["int4"],
+            "requests": R, "capacity": cap, "ctx": ctx, "gen": gen_n,
+            "arrival_rate_hz": rate}
+
+
 def bench_serving_tp():
     """Tensor-parallel serving A/B on FORCED-HOST virtual CPU devices:
     the SAME Poisson arrival trace through a tp=1 engine and a tp=N
@@ -1742,6 +1858,47 @@ def bench_flash_tune():
         wd = jax.random.normal(ks[10], (4 * D, D), dt) * 0.02
         _sweep(f"fused_mlp|{B}x{H}x{KV}x{hd}x{jnp.dtype(dt).name}",
                lambda: fused_mlp_block_pallas(x, nw, wg, wu, wd))
+        # quantized-WEIGHT tunables (r18): int8/int4 tiles are their
+        # own autotune shape classes (distinct cache keys) — sweep
+        # ONLY where registry dispatch selects the Pallas variant
+        # under the weight_dtype meta, like every guard above
+        from paddle_tpu.quantization import ptq as _ptq
+        for wq_name, wq_bits in (("int8", 8), ("int4", 4)):
+            tag = (f"{B}x{H}x{KV}x{hd}x{jnp.dtype(dt).name}"
+                   f"x{wq_name}w")
+            mq = decode_meta_dims(B, D, H, KV, hd, 4 * D, BS, MBs[-1],
+                                  dt, dt, False, weight_dtype=wq_name)
+            if KERNELS.dispatch("decode_attn_block", mq)[0] \
+                    != "pallas_fused":
+                decode_tuned[f"fused_attn_{wq_name}w|{tag}"] = \
+                    "skipped: dispatch -> unfused"
+            else:
+                qw = {k: _ptq.quantize_leaf(v, wq_bits)
+                      for k, v in (("q", wq), ("k", wk), ("v", wv),
+                                   ("o", wo))}
+                MBq = MBs[-1]
+                kpq = jax.random.normal(ks[1], (B * MBq, BS, KV, hd),
+                                        dt)
+                vpq = jax.random.normal(ks[2], (B * MBq, BS, KV, hd),
+                                        dt)
+                btq = jnp.arange(B * MBq,
+                                 dtype=jnp.int32).reshape(B, MBq)
+                slq = jnp.full((B,), BS * MBq - 2, jnp.int32)
+                _sweep(f"fused_attn_{wq_name}w|{tag}",
+                       lambda: fused_attn_block_pallas(
+                           x, nw, qw["q"], qw["k"], qw["v"], qw["o"],
+                           sin, cos, kpq, vpq, btq, slq)[0])
+            if KERNELS.dispatch("decode_mlp_block", mq)[0] \
+                    != "pallas_fused":
+                decode_tuned[f"fused_mlp_{wq_name}w|{tag}"] = \
+                    "skipped: dispatch -> unfused"
+            else:
+                _sweep(f"fused_mlp_{wq_name}w|{tag}",
+                       lambda: fused_mlp_block_pallas(
+                           x, nw, _ptq.quantize_leaf(wg, wq_bits),
+                           _ptq.quantize_leaf(wu, wq_bits),
+                           _ptq.quantize_leaf(wd, wq_bits,
+                                              pack_axis=1)))
         # fused-prefill tunables ((block_q, pages_per_step) pairs) at
         # the serving bucket widths — the engine's chunk runners are
         # traced and only READ the table; dispatch-guarded like the
@@ -2082,6 +2239,33 @@ def bench_kernels():
            fx, fnw, fwg, fwu, fwd_, tol=5e-2,
            bytes_moved=3 * FD * FF * 2 + 2 * FB * FD * 2)
 
+    # ---- quantized-WEIGHT megakernel variants (r18) --------------------
+    # int8 / packed-int4 weight tiles with in-register dequant vs the
+    # dequantize-then-matmul composition (both sides see the SAME
+    # quantized tree, so the diff is kernel-vs-composition roundoff,
+    # not quantization error) — same kernel_bench_gate trajectory
+    from paddle_tpu.quantization import ptq as _ptq
+    for wq_tag, wq_bits, wbytes in (("w8", 8, 1.0), ("w4", 4, 0.5)):
+        qwq = _ptq.quantize_leaf(fwq, wq_bits)
+        qwk = _ptq.quantize_leaf(fwk, wq_bits)
+        qwv = _ptq.quantize_leaf(fwv, wq_bits)
+        qwo = _ptq.quantize_leaf(fwo, wq_bits)
+        attn_q_bytes = int((2 * FD * FH * Fhd + 2 * FD * FKV * Fhd)
+                           * wbytes) \
+            + fused_live * FBS * FKV * Fhd * 2 * 2 + 2 * FB * FD * 2
+        record(f"fused_attn_block_{wq_tag}",
+               jax.jit(lambda *a: fused_attn_block_pallas(*a)[0]),
+               jax.jit(lambda *a: attn_block_ref(*a)[0]),
+               fx, fnw, qwq, qwk, qwv, qwo, fsin, fcos, fkp, fvp, ftab,
+               flens, tol=5e-2, bytes_moved=attn_q_bytes)
+        qwg = _ptq.quantize_leaf(fwg, wq_bits)
+        qwu = _ptq.quantize_leaf(fwu, wq_bits)
+        qwd = _ptq.quantize_leaf(fwd_, wq_bits, pack_axis=1)
+        record(f"fused_mlp_block_{wq_tag}",
+               jax.jit(fused_mlp_block_pallas), jax.jit(mlp_block_ref),
+               fx, fnw, qwg, qwu, qwd, tol=5e-2,
+               bytes_moved=int(3 * FD * FF * wbytes) + 2 * FB * FD * 2)
+
     # ---- fused prefill-block megakernel (ragged chunked prefill) -------
     # one transformer block's prefill chunk (warm mid-window start,
     # ragged valid rows) vs the dense gather composition it replaces —
@@ -2263,6 +2447,7 @@ CONFIGS = {
     "serving_engine": bench_serving_engine,
     "serving_prefix_cache": bench_serving_prefix_cache,
     "serving_prefill": bench_serving_prefill,
+    "serving_quant": bench_serving_quant,
     "serving_tp": bench_serving_tp,
     "serving_disagg": bench_serving_disagg,
     "serving_fleet": bench_serving_fleet,
@@ -2626,8 +2811,8 @@ def _merge_opportunistic(out):
     for k in ("llama", "kernels", "ernie_infer", "sd_unet", "bert",
               "resnet_breakdown", "llama_breakdown", "ppyoloe",
               "llama_ladder", "paged_decode", "serving_engine",
-              "serving_prefix_cache", "serving_prefill", "serving_tp",
-              "serving_disagg"):
+              "serving_prefix_cache", "serving_prefill",
+              "serving_quant", "serving_tp", "serving_disagg"):
         live = out.get(k)
         stale_live = not isinstance(live, dict) or "error" in live
         cap = opp.get(k)
@@ -2721,8 +2906,8 @@ def main():
         extra_t = int(os.environ.get("BENCH_EXTRA_TIMEOUT", "900"))
         for name in ("kernels", "ernie_infer", "paged_decode",
                      "serving_engine", "serving_prefix_cache",
-                     "serving_prefill", "serving_tp", "serving_disagg",
-                     "sd_unet", "bert",
+                     "serving_prefill", "serving_quant", "serving_tp",
+                     "serving_disagg", "sd_unet", "bert",
                      "resnet_breakdown", "ppyoloe", "llama_ladder"):
             if name == "kernels":
                 _kernel_audit(out)   # pre-window geometry audit

@@ -29,6 +29,7 @@ __all__ = ["build_catalog", "build_demo_regression",
 CATALOG_PROGRAMS = ("train_step", "train_step_fused",
                     "fused_optimizer_step",
                     "serving_decode", "serving_decode_fused",
+                    "serving_decode_wq",
                     "serving_prefill_16", "serving_prefill_32",
                     "serving_prefill_fused",
                     "serving_page_copy",
@@ -151,6 +152,19 @@ def _serving_specs(register: bool):
     fused += [_dc.replace(s, name="serving_prefill_fused")
               for s in fp_eng.program_specs(register=False)
               if s.name == "serving_prefill_fused_16"]
+    # the quantized-WEIGHT decode program (r18): an int8 weight tree's
+    # decode step — the quantized param signature (integer leaves +
+    # scale leaves) and the dequantize-then-matmul route feed the
+    # dtype/donation/retrace rules. Registered renamed, the
+    # serving_decode_fused idiom (never latest-wins clobbering the fp
+    # engine's entry).
+    wq_eng = ServingEngine(params, cfg, capacity=2, block_size=8,
+                           max_seq_len=64, prefill_buckets=(16,),
+                           weight_quant="int8")
+    fused += [_dc.replace(s, name="serving_decode_wq",
+                          tags=s.tags + ("weight_quant",))
+              for s in wq_eng.program_specs(register=False)
+              if s.name == "serving_decode"]
     if register:
         from .registry import REGISTRY
         for s in fused:
@@ -317,6 +331,7 @@ def build_catalog(names: Optional[List[str]] = None,
     if "fused_optimizer_step" in wanted:
         specs.append(_fused_optimizer_spec(register))
     if wanted & {"serving_decode", "serving_decode_fused",
+                 "serving_decode_wq",
                  "serving_prefill_16", "serving_prefill_32",
                  "serving_prefill_fused", "serving_page_copy"}:
         specs.extend(s for s in _serving_specs(register)

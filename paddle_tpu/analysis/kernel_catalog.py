@@ -169,21 +169,38 @@ def _flash_case(B, S, H, KVH, hd, dtype, causal=True, bias=False,
     return build
 
 
+def _wq_sds(shape, wq, pack_axis=0):
+    """Abstract quantized weight leaf (quantization/ptq.py format):
+    int8 keeps the dense shape, packed int4 halves ``pack_axis``; the
+    per-output-channel f32 scale always spans the LAST axis."""
+    if wq == "int4":
+        qshape = list(shape)
+        qshape[pack_axis] //= 2
+        return {"qw4": _sds(tuple(qshape), "int8"),
+                "scale": _sds((shape[-1],), "float32")}
+    return {"qw8": _sds(shape, "int8"),
+            "scale": _sds((shape[-1],), "float32")}
+
+
 def _attn_block_case(B, D, H, KV, hd, BS, N, MB, dtype, quant=False,
-                     pp=None):
+                     pp=None, wq=None):
     def build():
         from ..ops.pallas.fused_decode_block import fused_attn_block_pallas
 
         pool_dt = "int8" if quant else dtype
 
-        def fn(x, nw, wq, wk, wv, wo, sin, cos, kp, vp, bt, ln, *sc):
+        def fn(x, nw, wq_, wk_, wv_, wo_, sin, cos, kp, vp, bt, ln,
+               *sc):
             kv_scales = (sc[0], sc[1]) if quant else None
             return fused_attn_block_pallas(
-                x, nw, wq, wk, wv, wo, sin, cos, kp, vp, bt, ln,
+                x, nw, wq_, wk_, wv_, wo_, sin, cos, kp, vp, bt, ln,
                 kv_scales=kv_scales, pages_per_step=pp)
+
+        def w(shape):
+            return _wq_sds(shape, wq) if wq else _sds(shape, dtype)
         args = [_sds((B, D), dtype), _sds((D,), dtype),
-                _sds((D, H * hd), dtype), _sds((D, KV * hd), dtype),
-                _sds((D, KV * hd), dtype), _sds((H * hd, D), dtype),
+                w((D, H * hd)), w((D, KV * hd)),
+                w((D, KV * hd)), w((H * hd, D)),
                 _sds((MB * BS + 1, hd // 2), "float32"),
                 _sds((MB * BS + 1, hd // 2), "float32"),
                 _sds((N, BS, KV, hd), pool_dt),
@@ -196,7 +213,7 @@ def _attn_block_case(B, D, H, KV, hd, BS, N, MB, dtype, quant=False,
 
 
 def _prefill_attn_case(P, D, H, KV, hd, BS, N, MB, dtype, quant=False,
-                       pos0=0, bq=None, pp=None):
+                       pos0=0, bq=None, pp=None, wq=None):
     def build():
         import jax.numpy as jnp
         from ..ops.pallas.fused_prefill_block import (
@@ -204,15 +221,18 @@ def _prefill_attn_case(P, D, H, KV, hd, BS, N, MB, dtype, quant=False,
 
         pool_dt = "int8" if quant else dtype
 
-        def fn(x, nw, wq, wk, wv, wo, sin, cos, kp, vp, tab, *sc):
+        def fn(x, nw, wq_, wk_, wv_, wo_, sin, cos, kp, vp, tab, *sc):
             kv_scales = (sc[0], sc[1]) if quant else None
             return fused_prefill_attn_pallas(
-                x, nw, wq, wk, wv, wo, sin, cos, kp, vp, tab,
+                x, nw, wq_, wk_, wv_, wo_, sin, cos, kp, vp, tab,
                 jnp.int32(pos0), jnp.int32(P), kv_scales=kv_scales,
                 block_q=bq, pages_per_step=pp)
+
+        def w(shape):
+            return _wq_sds(shape, wq) if wq else _sds(shape, dtype)
         args = [_sds((P, D), dtype), _sds((D,), dtype),
-                _sds((D, H * hd), dtype), _sds((D, KV * hd), dtype),
-                _sds((D, KV * hd), dtype), _sds((H * hd, D), dtype),
+                w((D, H * hd)), w((D, KV * hd)),
+                w((D, KV * hd)), w((H * hd, D)),
                 _sds((P, hd // 2), "float32"),
                 _sds((P, hd // 2), "float32"),
                 _sds((N, BS, KV, hd), pool_dt),
@@ -224,15 +244,21 @@ def _prefill_attn_case(P, D, H, KV, hd, BS, N, MB, dtype, quant=False,
     return build
 
 
-def _mlp_block_case(B, D, F, dtype):
+def _mlp_block_case(B, D, F, dtype, wq=None):
     def build():
         from ..ops.pallas.fused_decode_block import fused_mlp_block_pallas
 
         def fn(x, nw, wg, wu, wd):
             return fused_mlp_block_pallas(x, nw, wg, wu, wd)
+
+        def w(shape, pack_axis=0):
+            return _wq_sds(shape, wq, pack_axis) if wq \
+                else _sds(shape, dtype)
         return fn, (_sds((B, D), dtype), _sds((D,), dtype),
-                    _sds((D, F), dtype), _sds((D, F), dtype),
-                    _sds((F, D), dtype))
+                    w((D, F)), w((D, F)),
+                    # down_proj packs its OUTPUT axis (the F tiles
+                    # never split it — the ptq.WQ_KEYS contract)
+                    w((F, D), pack_axis=1))
     return build
 
 
@@ -319,10 +345,33 @@ def kernel_cases() -> List[KernelCase]:
           ("decode_attn_block",),
           _attn_block_case(8, 1024, 16, 16, 64, 16, 128, 24, "bfloat16",
                            quant=True)),
+        # quantized-WEIGHT variants (r18): int8/int4 tiles + scale rows
+        # at the tiny and flagship serving shape classes — the launches
+        # the weight_quant routes actually dispatch on TPU
+        C("decode_attn_block", "tiny_int8_weights",
+          ("decode_attn_block",),
+          _attn_block_case(2, 32, 2, 2, 16, 8, 8, 4, "float32",
+                           wq="int8")),
+        C("decode_attn_block", "flagship_serving_int8_weights",
+          ("decode_attn_block",),
+          _attn_block_case(8, 1024, 16, 16, 64, 16, 128, 24, "bfloat16",
+                           wq="int8")),
+        C("decode_attn_block", "flagship_serving_int4_weights",
+          ("decode_attn_block",),
+          _attn_block_case(8, 1024, 16, 16, 64, 16, 128, 24, "bfloat16",
+                           wq="int4")),
         C("decode_mlp_block", "tiny", ("decode_mlp_block",),
           _mlp_block_case(2, 32, 64, "float32")),
         C("decode_mlp_block", "flagship_serving", ("decode_mlp_block",),
           _mlp_block_case(8, 1024, 4096, "bfloat16")),
+        C("decode_mlp_block", "tiny_int4_weights", ("decode_mlp_block",),
+          _mlp_block_case(2, 32, 64, "float32", wq="int4")),
+        C("decode_mlp_block", "flagship_serving_int8_weights",
+          ("decode_mlp_block",),
+          _mlp_block_case(8, 1024, 4096, "bfloat16", wq="int8")),
+        C("decode_mlp_block", "flagship_serving_int4_weights",
+          ("decode_mlp_block",),
+          _mlp_block_case(8, 1024, 4096, "bfloat16", wq="int4")),
         # fused prefill: tiny (warm mid-page start) + the
         # bench_serving_engine shape class at a warm-suffix bucket
         # (P=64; the 10MiB dispatch budget binds the largest buckets
@@ -338,6 +387,14 @@ def kernel_cases() -> List[KernelCase]:
           ("prefill_attn_block",),
           _prefill_attn_case(64, 1024, 16, 16, 64, 16, 129, 24,
                              "bfloat16", quant=True, pos0=128)),
+        C("prefill_attn_block", "flagship_serving_int8_weights",
+          ("prefill_attn_block",),
+          _prefill_attn_case(64, 1024, 16, 16, 64, 16, 129, 24,
+                             "bfloat16", pos0=128, wq="int8")),
+        C("prefill_attn_block", "flagship_serving_int4_weights",
+          ("prefill_attn_block",),
+          _prefill_attn_case(64, 1024, 16, 16, 64, 16, 129, 24,
+                             "bfloat16", pos0=128, wq="int4")),
         # the prefill MLP op dispatches the decode MLP megakernel at
         # chunk-row counts — audited at the bucket widths
         C("prefill_mlp_block", "flagship_serving", ("decode_mlp_block",),

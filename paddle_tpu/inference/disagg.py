@@ -196,10 +196,19 @@ class DisaggregatedEngine:
                  prefix_cache: bool = False, kv_offload=False,
                  observability=False,
                  fused_decode=None, fused_prefill=None,
+                 weight_quant=None,
                  aging_s: Optional[float] = None):
         pre_mesh, dec_mesh = self._resolve_groups(
             prefill_devices, decode_devices, mesh, prefill_tp,
             collective)
+        # weight quantization: quantize ONCE here so both group
+        # workers share the same tree (byte-identical scales on both
+        # sides — the handoff's bit-parity contract needs the decode
+        # group to continue exactly the prefill group's math); the
+        # workers then adopt the carried mode
+        from ..quantization.ptq import ensure_quantized
+        params, self._weight_quant = ensure_quantized(params,
+                                                      weight_quant)
         self.cfg = cfg
         self.counters = {
             "handoffs": 0, "partial_handoffs": 0, "handoff_traces": 0,

@@ -47,6 +47,25 @@ class GenerationConfig:
     deadline_s: Optional[float] = None
 
 
+def _mm(h, w):
+    """``h @ w`` where ``w`` may be a quantized weight leaf
+    (``{"qw8"|"qw4": q, "scale": s}`` — quantization/ptq.py): quantized
+    leaves DEQUANTIZE-THEN-MATMUL at the activation dtype, the
+    priority-0 fallback contract every unfused matmul site shares (so
+    the unfused route is bit-identical to that composition by
+    construction)."""
+    from ..quantization.quanters import maybe_dequantize
+    return h @ maybe_dequantize(w, h.dtype)
+
+
+def _wq_mode(params):
+    """The weight-quant mode a param tree carries (None/"int8"/"int4"),
+    read off the tree STRUCTURE — static at trace time, so dispatch
+    metas and program-cache route keys can carry it."""
+    from ..quantization.ptq import weight_quant_mode
+    return weight_quant_mode(params)
+
+
 def _repeat_kv(x, n):
     """[B, T, KV, hd] -> [B, T, KV*n, hd] (dense-cache GQA expansion)."""
     if n == 1:
@@ -76,9 +95,9 @@ def _cached_layer(lp, x, sin, cos, cfg, kc, vc, pos):
     T = kc.shape[1]
     h = fused_rms_norm(x, lp["input_norm"].astype(x.dtype),
                        cfg.rms_norm_eps)
-    q = (h @ lp["q_proj"]).reshape(b, s, H, hd)
-    k = (h @ lp["k_proj"]).reshape(b, s, KV, hd)
-    v = (h @ lp["v_proj"]).reshape(b, s, KV, hd)
+    q = _mm(h, lp["q_proj"]).reshape(b, s, H, hd)
+    k = _mm(h, lp["k_proj"]).reshape(b, s, KV, hd)
+    v = _mm(h, lp["v_proj"]).reshape(b, s, KV, hd)
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
     kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, pos, 0, 0))
@@ -97,10 +116,10 @@ def _cached_layer(lp, x, sin, cos, cfg, kc, vc, pos):
     probs = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum("bhst,bthd->bshd", probs, vv.astype(jnp.float32))
     attn = attn.astype(x.dtype).reshape(b, s, H * hd)
-    x = x + attn @ lp["o_proj"]
+    x = x + _mm(attn, lp["o_proj"])
     h = fused_rms_norm(x, lp["post_norm"].astype(x.dtype), cfg.rms_norm_eps)
-    ff = fused_swiglu(h @ lp["gate_proj"], h @ lp["up_proj"])
-    x = x + ff @ lp["down_proj"]
+    ff = fused_swiglu(_mm(h, lp["gate_proj"]), _mm(h, lp["up_proj"]))
+    x = x + _mm(ff, lp["down_proj"])
     return x, kc, vc
 
 
@@ -322,7 +341,8 @@ def _fused_prefill_forward(params, toks, cfg, k_pools, v_pools, table,
     BS = k_pools.shape[2]
     MB = table.shape[0]
     meta = prefill_meta(cfg, P, BS, MB, k_pools.dtype,
-                        kv_scales is not None)
+                        kv_scales is not None,
+                        weight_dtype=_wq_mode(params))
     attn_fn, mlp_fn, _ = resolve_prefill_blocks(meta, mode)
     x = jnp.take(params["embed_tokens"], toks, axis=0)       # [P, D]
     sin_full, cos_full = build_rope_cache(MB * BS, cfg.head_dim,
@@ -375,12 +395,18 @@ def _mesh_route(sm):
             tuple(int(d.id) for d in sm.mesh.devices.flat))
 
 
-def _paged_chunk_runner(cfg, gen, quant=False, fused=False, sm=None):
+def _paged_chunk_runner(cfg, gen, quant=False, fused=False, sm=None,
+                        wq=None):
     """Jitted n-step decode scan, cached per (cfg values, gen values) —
     a fresh jit per generate_paged call would re-trace the whole L-layer
     scan every serving request. ``sm``: an optional ServingMesh — the
     scan body then runs the tensor-parallel decode step under shard_map
-    (inference/tp.py), still ONE jitted program per chunk size."""
+    (inference/tp.py), still ONE jitted program per chunk size.
+    ``wq``: the weight-quant mode ("int8"/"int4"/None) — it rides in
+    the param tree's STRUCTURE (the jit signature would retrace
+    anyway), but it also reshapes kernel dispatch at trace time, so it
+    keys this cache explicitly (the ``_PAGED_CACHE`` route contract —
+    a flipped quant mode must retrace, never replay)."""
     from ..core.flags import GLOBAL_FLAGS
     # the kernel-route flags are traced INTO the compiled scan, so they
     # must key the cache — an A/B flip (bench_paged_decode) would
@@ -405,7 +431,7 @@ def _paged_chunk_runner(cfg, gen, quant=False, fused=False, sm=None):
         route = ()
     ck = (dataclasses.astuple(cfg), dataclasses.astuple(gen),
           bool(GLOBAL_FLAGS.get("use_paged_kernel")), bool(quant),
-          fused, route, _mesh_route(sm))
+          fused, route, _mesh_route(sm), wq)
     cached = _cache_get(_PAGED_CACHE, ck)
     if cached is not None:
         return cached
@@ -485,9 +511,9 @@ def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
             lp, kp, vp, ksc, vsc = xs
         h = fused_rms_norm(x[:, None], lp["input_norm"].astype(x.dtype),
                            cfg.rms_norm_eps)[:, 0]
-        q = (h @ lp["q_proj"]).reshape(B, 1, H, hd)
-        k = (h @ lp["k_proj"]).reshape(B, 1, KV, hd)
-        v = (h @ lp["v_proj"]).reshape(B, 1, KV, hd)
+        q = _mm(h, lp["q_proj"]).reshape(B, 1, H, hd)
+        k = _mm(h, lp["k_proj"]).reshape(B, 1, KV, hd)
+        v = _mm(h, lp["v_proj"]).reshape(B, 1, KV, hd)
         q = apply_rope(q, sin, cos, position_ids=pos_ids)
         k = apply_rope(k, sin, cos, position_ids=pos_ids)
         if kv_scales is None:
@@ -501,11 +527,12 @@ def _paged_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
                                          k[:, 0], v[:, 0], ksc, vsc)
             attn = paged_attention_decode_quant(
                 q[:, 0], kp, vp, block_tables, seq_lens + 1, ksc, vsc)
-        x = x + attn.reshape(B, H * hd).astype(x.dtype) @ lp["o_proj"]
+        x = x + _mm(attn.reshape(B, H * hd).astype(x.dtype),
+                    lp["o_proj"])
         h = fused_rms_norm(x[:, None], lp["post_norm"].astype(x.dtype),
                            cfg.rms_norm_eps)[:, 0]
-        ff = fused_swiglu(h @ lp["gate_proj"], h @ lp["up_proj"])
-        x = x + ff @ lp["down_proj"]
+        ff = fused_swiglu(_mm(h, lp["gate_proj"]), _mm(h, lp["up_proj"]))
+        x = x + _mm(ff, lp["down_proj"])
         return x, (kp, vp)
 
     scan_xs = (params["layers"], k_pools, v_pools) if kv_scales is None \
@@ -542,7 +569,8 @@ def _fused_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
     meta = decode_meta(cfg, B=B, BS=k_pools.shape[2],
                        MB=block_tables.shape[1],
                        pool_dtype=k_pools.dtype,
-                       quant=kv_scales is not None)
+                       quant=kv_scales is not None,
+                       weight_dtype=_wq_mode(params))
     attn_fn, mlp_fn, _ = resolve_decode_blocks(meta, mode)
     x = jnp.take(params["embed_tokens"], tok, axis=0)        # [B, D]
     sin, cos = build_rope_cache(cfg.max_position_embeddings,
@@ -652,7 +680,7 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
                    block_size: int = 16, seed: int = 0,
                    cache_dtype=None, prefix_cache=None,
                    observability=None, fused_decode=None, mesh=None,
-                   fused_prefill=None):
+                   fused_prefill=None, weight_quant=None):
     """vLLM-style serving loop over a paged KV cache.
 
     ``cache_dtype="int8"``: static per-head cache quantization
@@ -703,11 +731,21 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     stream and logits stay replicated, still ONE jitted program per
     chunk size. collective="gather" is bit-identical to mesh=None;
     the default "psum" placement is roundoff-parity (documented).
+
+    ``weight_quant``: "int8"/"int4" — per-channel weight quantization
+    on the decode + prefill hot paths (quantization/ptq.py). A plain
+    fp tree is quantized in ONE shot on the way in (host-side absmax);
+    an already-quantized tree (``ptq.quantize_weights``, e.g. with
+    activation-aware clipping) rides as-is and None adopts its mode.
+    Where the fused kernels dispatch, int8/int4 tiles stream through
+    VMEM and dequantize in-register; everywhere else the unfused route
+    is dequantize-then-matmul by construction.
     """
     import time as _time
 
     import numpy as np
     from ..ops.paged_attention import BlockManager
+    from ..quantization.ptq import ensure_quantized
     from .tp import normalize_mesh
 
     gen = gen or GenerationConfig()
@@ -716,6 +754,14 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
         observability = Observability()
     fused = _fused_mode(fused_decode)
     sm = normalize_mesh(mesh)
+    params, wq_mode = ensure_quantized(params, weight_quant)
+    if wq_mode is not None and sm is not None:
+        raise ValueError(
+            "generate_paged(weight_quant=...) does not take a mesh: "
+            "sharding quantized weight trees (packed int4 + per-channel"
+            " scales) over tp > 1 is named headroom — run quantized "
+            "serving single-device, or use ServingEngine with tp=1 "
+            "groups")
     if sm is not None:
         ok, reason = sm.supports(cfg)
         if not ok:
@@ -730,7 +776,8 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
         return _generate_paged_prefix(
             params, input_ids, cfg, gen, block_size, seed, cache_dtype,
             prefix_cache, observability, fused=fused,
-            fused_prefill=_fused_prefill_mode(fused_prefill))
+            fused_prefill=_fused_prefill_mode(fused_prefill),
+            wq=wq_mode)
     obs = observability or None
     B, S = input_ids.shape
     T = S + gen.max_new_tokens
@@ -816,7 +863,7 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
     # runner is cached per (config values, sampling knobs) like
     # generate()'s — shapes and the static n key jit's own cache.
     chunk_fn = _paged_chunk_runner(cfg, gen, quant=kv_scales is not None,
-                                   fused=fused, sm=sm)
+                                   fused=fused, sm=sm, wq=wq_mode)
 
     key = _key_for(seed)
     tok = sample_token(logits[:, -1], key, gen)
@@ -863,7 +910,7 @@ def _scatter_prefill_pages(kp, vp, wtable, kc, vc):
 def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
                            seed, cache_dtype, store,
                            observability=None, fused=False,
-                           fused_prefill=False):
+                           fused_prefill=False, wq=None):
     """``generate_paged`` over a persistent ``PagedKVCacheStore``.
 
     Admission longest-prefix-matches each prompt against the store's
@@ -947,7 +994,7 @@ def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
             t0 = _time.perf_counter()
         use_fused = fused_prefill and prefill_fused_selected(
             prefill_meta(cfg, S - M, BS, MB, store.k_pools.dtype,
-                         False), fused_prefill)
+                         False, weight_dtype=wq), fused_prefill)
         if use_fused:
             run = _suffix_prefill_runner(cfg, S - M, MB, fused_prefill)
             lg_last, store.k_pools, store.v_pools = run(
@@ -985,7 +1032,8 @@ def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
     chunks = [tok[:, None]]
     seq_lens = jnp.full((B,), S, jnp.int32)
     bt = jnp.asarray(tables, jnp.int32)
-    chunk_fn = _paged_chunk_runner(cfg, gen, quant=False, fused=fused)
+    chunk_fn = _paged_chunk_runner(cfg, gen, quant=False, fused=fused,
+                                   wq=wq)
     k_pools, v_pools = store.k_pools, store.v_pools
     chunk = max(1, int(os.environ.get("PADDLE_TPU_DECODE_CHUNK", "32")))
     left = gen.max_new_tokens - 1

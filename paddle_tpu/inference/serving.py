@@ -210,14 +210,30 @@ class ServingEngine:
                  prefill_buckets=(32, 128), seed: int = 0,
                  prefix_cache: bool = False, kv_offload=False,
                  observability=False, fused_decode=None, mesh=None,
-                 fused_prefill=None,
+                 fused_prefill=None, weight_quant=None,
                  aging_s: Optional[float] = None):
         # tensor parallelism (inference/tp.py): a ServingMesh shards
         # the KV pools, projections and per-slot attention along the
         # head axis; programs wrap in shard_map. None = single device.
         # Accepts a ServingMesh, a 1-D jax Mesh, or an int tp degree.
+        from ..quantization.ptq import ensure_quantized
         from .tp import normalize_mesh
+        # weight quantization (quantization/ptq.py): "int8"/"int4"
+        # quantizes a plain fp tree in ONE shot (host-side per-channel
+        # absmax — the int8-KV first-prompt idiom, pointed at weights);
+        # an already-quantized tree (e.g. activation-aware PTQ) rides
+        # as-is and None adopts its mode. The mode is STRUCTURE of the
+        # param tree, so every traced program keys on it for free and
+        # kernel dispatch sees it via the weight_dtype meta key.
+        params, self._wq = ensure_quantized(params, weight_quant)
         self._mesh = normalize_mesh(mesh)
+        if self._wq and self._mesh is not None and self._mesh.tp > 1:
+            raise ValueError(
+                f"ServingEngine(weight_quant={self._wq!r}) cannot shard"
+                f" over tp={self._mesh.tp} > 1: packed-int4 rows and "
+                "per-channel scale trees need per-shard packing specs "
+                "(named headroom) — run quantized serving single-device"
+                " or on tp=1 groups")
         if self._mesh is not None:
             ok, reason = self._mesh.supports(cfg)
             if not ok:
@@ -235,8 +251,8 @@ class ServingEngine:
                     'collective="gather" — that placement runs the '
                     "exact unfused composition (its bit-parity "
                     'contract); use collective="psum" or drop the pin')
-            params = self._mesh.shard(params,
-                                      self._mesh.param_specs(cfg))
+            params = self._mesh.shard(
+                params, self._mesh.param_specs(cfg, params))
         self.params = params
         self.cfg = cfg
         # decode-block kernel routing: False = the pre-fusion unfused
@@ -736,7 +752,8 @@ class ServingEngine:
             meta = decode_meta(cfg, B=self.capacity,
                                BS=self.block_size, MB=self.max_blocks,
                                pool_dtype=self._k_pools.dtype,
-                               quant=self._quant)
+                               quant=self._quant,
+                               weight_dtype=self._wq)
         else:
             # dispatch consults the PER-SHARD shape class: local head
             # and intermediate counts, tp riding in the meta — the
@@ -747,7 +764,7 @@ class ServingEngine:
                 cfg.num_key_value_heads // tp, cfg.head_dim,
                 cfg.intermediate_size // tp, self.block_size,
                 self.max_blocks, cfg.dtype, self._k_pools.dtype,
-                self._quant, tp=tp)
+                self._quant, tp=tp, weight_dtype=self._wq)
         _, _, names = resolve_decode_blocks(meta, self._fused)
         return {"mode": str(self._fused), **names}
 
@@ -766,6 +783,22 @@ class ServingEngine:
         if self._decode_variant is not None:
             return dict(self._decode_variant)
         return self._resolve_variant()
+
+    @property
+    def weight_quant_variant(self) -> Dict:
+        """Which weight-dtype class the engine's programs run:
+        ``{"mode": "off"}`` for plain fp weights, else ``{"mode":
+        "int8"|"int4", "weight_dtype": ..., "attn": ..., "mlp": ...}``
+        with the decode-block variants that serve the quantized tree.
+        Derives from :attr:`decode_variant`, which is snapshotted when
+        the decode program TRACES — a trace-time report of compiled
+        reality, never live dispatch (the ``decode_variant``
+        contract)."""
+        if not self._wq:
+            return {"mode": "off"}
+        v = self.decode_variant
+        return {"mode": self._wq, "weight_dtype": self._wq,
+                "attn": v["attn"], "mlp": v["mlp"]}
 
     @property
     def idle(self) -> bool:
@@ -882,6 +915,7 @@ class ServingEngine:
             if steps else 0.0)
         c["decode_variant"] = self.decode_variant
         c["prefill_variant"] = self.prefill_variant
+        c["weight_quant_variant"] = self.weight_quant_variant
         c["scheduler"] = self._scheduler_metrics()
         if self._pcache is not None:
             c["prefix_cache"] = self._pcache.metrics()
@@ -1502,7 +1536,8 @@ class ServingEngine:
         fused = self._fused
         sm = self._mesh
         sharded = sm.sharded_decode_fn(cfg, fused,
-                                       quant=scales is not None)
+                                       quant=scales is not None,
+                                       params=self.params)
 
         def step(params, tok, seq_lens, tables, temps, key,
                  k_pools, v_pools):
@@ -1529,7 +1564,7 @@ class ServingEngine:
         from ..ops.pallas.fused_prefill_block import prefill_meta
         return prefill_meta(self.cfg, P, self.block_size,
                             self.max_blocks, self._k_pools.dtype,
-                            self._quant)
+                            self._quant, weight_dtype=self._wq)
 
     def _prefill_fused_for(self, P: int) -> bool:
         """Whether bucket ``P``'s chunk program should be the
@@ -1674,8 +1709,8 @@ class ServingEngine:
         sm = self._mesh
         counters["prefill_traces"].setdefault(P, 0)
         rep = sm.replicated
-        in_specs = (sm.param_specs(cfg), rep, rep, rep, rep,
-                    sm.pool_spec, sm.pool_spec)
+        in_specs = (sm.param_specs(cfg, self.params), rep, rep, rep,
+                    rep, sm.pool_spec, sm.pool_spec)
         if scales is not None:
             in_specs += (sm.scale_spec, sm.scale_spec)
 

@@ -183,10 +183,14 @@ class ServingMesh:
     def replicated(self) -> P:
         return P()
 
-    def param_specs(self, cfg) -> Dict:
+    def param_specs(self, cfg, params=None) -> Dict:
+        """PartitionSpec tree for a llama param tree; pass ``params``
+        when the tree may carry quantized weight leaves (the spec tree
+        must mirror their dict structure)."""
         from ..models.llama import tp_param_specs
         return tp_param_specs(cfg, axis=self.axis,
-                              collective=self.collective)
+                              collective=self.collective,
+                              params=params)
 
     def sharding(self, spec: P) -> NamedSharding:
         return NamedSharding(self.mesh, spec)
@@ -208,15 +212,17 @@ class ServingMesh:
         return jax.device_put(x, self.sharding(P()))
 
     # -- sharded program wiring ---------------------------------------
-    def sharded_decode_fn(self, cfg, fused, quant: bool):
+    def sharded_decode_fn(self, cfg, fused, quant: bool, params=None):
         """The shard_map'd per-step decode forward: ``(params, tok,
         seq_lens, tables, k_pools, v_pools, *scales) -> (logits,
         k_pools, v_pools)`` — the ONE wiring of in/out specs around
         :func:`_tp_decode_step`, shared by ``ServingEngine``'s decode
         program and ``generate_paged``'s chunk runner so the two can
-        never desync on layout or signature."""
+        never desync on layout or signature. ``params``: pass the real
+        tree when it may carry quantized weight leaves (spec-structure
+        mirroring)."""
         rep = self.replicated
-        in_specs = (self.param_specs(cfg), rep, rep, rep,
+        in_specs = (self.param_specs(cfg, params), rep, rep, rep,
                     self.pool_spec, self.pool_spec)
         if quant:
             in_specs += (self.scale_spec, self.scale_spec)
@@ -272,14 +278,24 @@ class ServingMesh:
 # the LOCAL shard; tok/seq_lens/tables and the residual stream are
 # replicated)
 # ---------------------------------------------------------------------------
+def _wshape(w):
+    """Stored shape of a weight leaf (plain array or quantized dict —
+    quantization/ptq.py format). Column counts are what the local-dim
+    reads below need, and int4 packing never halves the output
+    columns of q/k/v/gate/up."""
+    if isinstance(w, dict):
+        return (w["qw8"] if "qw8" in w else w["qw4"]).shape
+    return w.shape
+
+
 def _local_dims(params, cfg):
     """Local head/intermediate counts, read off the sharded arrays
     (shard_map hands the body local shapes, so the arrays themselves
     are the single source of truth for what this shard owns)."""
     hd = cfg.head_dim
-    H_loc = params["layers"]["q_proj"].shape[2] // hd
-    KV_loc = params["layers"]["k_proj"].shape[2] // hd
-    F_loc = params["layers"]["gate_proj"].shape[2]
+    H_loc = _wshape(params["layers"]["q_proj"])[2] // hd
+    KV_loc = _wshape(params["layers"]["k_proj"])[2] // hd
+    F_loc = _wshape(params["layers"]["gate_proj"])[2]
     return H_loc, KV_loc, F_loc
 
 
@@ -322,10 +338,12 @@ def _tp_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
                                       v_pools, block_tables, seq_lens,
                                       kv_scales, axis)
     if fused:
+        from .generation import _wq_mode
         meta = decode_meta_dims(
             B, cfg.hidden_size, H_loc, KV_loc, cfg.head_dim, F_loc,
             k_pools.shape[2], block_tables.shape[1], cfg.dtype,
-            k_pools.dtype, quant, tp=tp)
+            k_pools.dtype, quant, tp=tp,
+            weight_dtype=_wq_mode(params))
         attn_fn, mlp_fn, _ = resolve_decode_blocks(meta, fused)
     else:
         attn_fn, mlp_fn = attn_block_ref, mlp_block_ref
@@ -383,6 +401,7 @@ def _tp_decode_step_gather(params, tok, cfg, k_pools, v_pools,
                                        paged_attention_decode_quant,
                                        write_to_pool, write_to_pool_quant)
     from ..ops.rope import apply_rope, build_rope_cache
+    from .generation import _mm
 
     H, hd = cfg.num_attention_heads, cfg.head_dim
     B = tok.shape[0]
@@ -399,9 +418,9 @@ def _tp_decode_step_gather(params, tok, cfg, k_pools, v_pools,
             lp, kp, vp, ksc, vsc = xs
         h = fused_rms_norm(x[:, None], lp["input_norm"].astype(x.dtype),
                            cfg.rms_norm_eps)[:, 0]
-        q = (h @ lp["q_proj"]).reshape(B, 1, H_loc, hd)
-        k = (h @ lp["k_proj"]).reshape(B, 1, KV_loc, hd)
-        v = (h @ lp["v_proj"]).reshape(B, 1, KV_loc, hd)
+        q = _mm(h, lp["q_proj"]).reshape(B, 1, H_loc, hd)
+        k = _mm(h, lp["k_proj"]).reshape(B, 1, KV_loc, hd)
+        v = _mm(h, lp["v_proj"]).reshape(B, 1, KV_loc, hd)
         q = apply_rope(q, sin, cos, position_ids=pos_ids)
         k = apply_rope(k, sin, cos, position_ids=pos_ids)
         if kv_scales is None:
@@ -418,12 +437,13 @@ def _tp_decode_step_gather(params, tok, cfg, k_pools, v_pools,
         # heads shard contiguously, so tiled all-gather on the head
         # axis rebuilds the exact single-device [B, H, hd] tensor
         attn = jax.lax.all_gather(attn, axis, axis=1, tiled=True)
-        x = x + attn.reshape(B, H * hd).astype(x.dtype) @ lp["o_proj"]
+        x = x + _mm(attn.reshape(B, H * hd).astype(x.dtype),
+                    lp["o_proj"])
         h = fused_rms_norm(x[:, None], lp["post_norm"].astype(x.dtype),
                            cfg.rms_norm_eps)[:, 0]
-        ff = fused_swiglu(h @ lp["gate_proj"], h @ lp["up_proj"])
+        ff = fused_swiglu(_mm(h, lp["gate_proj"]), _mm(h, lp["up_proj"]))
         ff = jax.lax.all_gather(ff, axis, axis=1, tiled=True)  # [B, F]
-        x = x + ff @ lp["down_proj"]
+        x = x + _mm(ff, lp["down_proj"])
         return x, (kp, vp)
 
     scan_xs = (params["layers"], k_pools, v_pools) if kv_scales is None \
@@ -441,20 +461,20 @@ def _tp_cached_layer(lp, x, sin, cos, cfg, kc, vc, pos, axis,
     writing the LOCAL slice of the dense cache (kc/vc [B, T, KV_loc,
     hd]). Same op sequence per shard; the collective placement decides
     how the residual stream is rebuilt (module docstring)."""
-    from ..inference.generation import _repeat_kv
+    from ..inference.generation import _mm, _repeat_kv
     from ..ops import rms_norm as fused_rms_norm, swiglu as fused_swiglu
     from ..ops.rope import apply_rope
 
     H, hd = cfg.num_attention_heads, cfg.head_dim
     b, s, _ = x.shape
     T = kc.shape[1]
-    H_loc = lp["q_proj"].shape[1] // hd
-    KV_loc = lp["k_proj"].shape[1] // hd
+    H_loc = _wshape(lp["q_proj"])[1] // hd
+    KV_loc = _wshape(lp["k_proj"])[1] // hd
     h = fused_rms_norm(x, lp["input_norm"].astype(x.dtype),
                        cfg.rms_norm_eps)
-    q = (h @ lp["q_proj"]).reshape(b, s, H_loc, hd)
-    k = (h @ lp["k_proj"]).reshape(b, s, KV_loc, hd)
-    v = (h @ lp["v_proj"]).reshape(b, s, KV_loc, hd)
+    q = _mm(h, lp["q_proj"]).reshape(b, s, H_loc, hd)
+    k = _mm(h, lp["k_proj"]).reshape(b, s, KV_loc, hd)
+    v = _mm(h, lp["v_proj"]).reshape(b, s, KV_loc, hd)
     q = apply_rope(q, sin, cos)
     k = apply_rope(k, sin, cos)
     kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype),
@@ -475,18 +495,18 @@ def _tp_cached_layer(lp, x, sin, cos, cfg, kc, vc, pos, axis,
     if collective == "gather":
         attn = jax.lax.all_gather(attn, axis, axis=2, tiled=True)
         attn = attn.astype(x.dtype).reshape(b, s, H * hd)
-        x = x + attn @ lp["o_proj"]
+        x = x + _mm(attn, lp["o_proj"])
     else:
         attn = attn.astype(x.dtype).reshape(b, s, H_loc * hd)
-        x = x + jax.lax.psum(attn @ lp["o_proj"], axis)
+        x = x + jax.lax.psum(_mm(attn, lp["o_proj"]), axis)
     h = fused_rms_norm(x, lp["post_norm"].astype(x.dtype),
                        cfg.rms_norm_eps)
-    ff = fused_swiglu(h @ lp["gate_proj"], h @ lp["up_proj"])
+    ff = fused_swiglu(_mm(h, lp["gate_proj"]), _mm(h, lp["up_proj"]))
     if collective == "gather":
         ff = jax.lax.all_gather(ff, axis, axis=2, tiled=True)
-        x = x + ff @ lp["down_proj"]
+        x = x + _mm(ff, lp["down_proj"])
     else:
-        x = x + jax.lax.psum(ff @ lp["down_proj"], axis)
+        x = x + jax.lax.psum(_mm(ff, lp["down_proj"]), axis)
     return x, kc, vc
 
 

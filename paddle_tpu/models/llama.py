@@ -142,7 +142,7 @@ def param_shardings(mesh: Mesh, cfg: LlamaConfig) -> Dict:
 
 
 def tp_param_specs(cfg: LlamaConfig, axis: str = "tp",
-                   collective: str = "psum") -> Dict:
+                   collective: str = "psum", params=None) -> Dict:
     """PartitionSpecs for SERVING tensor parallelism over a 1-D mesh:
     head-axis (Megatron) sharding of the per-layer projections, with
     everything the replicated residual stream touches kept replicated
@@ -155,7 +155,16 @@ def tp_param_specs(cfg: LlamaConfig, axis: str = "tp",
     REPLICATED and all-gathers the per-shard attention heads / MLP
     columns instead: every matmul then has exactly the single-device
     operands and shapes, which is what makes that mode's greedy output
-    bit-identical (inference/tp.py documents the contract)."""
+    bit-identical (inference/tp.py documents the contract).
+
+    ``params``: pass the actual tree when it may carry QUANTIZED
+    weight leaves (``{"qw8"|"qw4": q, "scale": s}`` —
+    quantization/ptq.py): the spec tree must mirror their dict
+    structure. The integer tile keeps the base weight's spec
+    (column sharding survives packing — int4 packs the hidden axis,
+    never the output columns of q/k/v/gate/up) and the
+    per-output-channel scales shard with the output columns (or stay
+    replicated for the row-sharded o/down projections)."""
     col = P(None, None, axis)                  # shard output columns
     row = P(None, axis, None) if collective == "psum" else P(None, None,
                                                              None)
@@ -173,6 +182,15 @@ def tp_param_specs(cfg: LlamaConfig, axis: str = "tp",
     }
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, None)
+    if params is not None:
+        layers = params.get("layers", {})
+        for k, w in layers.items():
+            if isinstance(w, dict):
+                base = specs["layers"][k]
+                qk = "qw8" if "qw8" in w else "qw4"
+                s_spec = P(None, axis) if base[-1] == axis \
+                    else P(None, None)
+                specs["layers"][k] = {qk: base, "scale": s_spec}
     return specs
 
 

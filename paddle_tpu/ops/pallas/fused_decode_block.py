@@ -57,6 +57,7 @@ __all__ = [
     "attn_block_ref", "mlp_block_ref", "decode_meta",
     "decode_meta_dims",
     "resolve_decode_blocks", "mlp_autotune_key", "attn_autotune_key",
+    "weight_dtype_of",
 ]
 
 GLOBAL_FLAGS.define(
@@ -72,14 +73,87 @@ _vmem_budget = fused_vmem_budget
 
 
 # ---------------------------------------------------------------------------
+# weight-quantization plumbing (r18): int8 / packed-int4 weight tiles
+# stream through VMEM and dequantize in-register — the scale applies in
+# the matmul EPILOGUE (per-OUTPUT-channel scales commute with the
+# contraction: x @ (q * s) == (x @ q) * s), so the integer tile is what
+# HBM moves and the interior stays f32
+# ---------------------------------------------------------------------------
+def _wq_parts(w):
+    """Array-or-quantized-leaf normalization -> (weights, scale, bits,
+    pack_axis). Quantized leaves are the PTQ harness's
+    ``{"qw8"|"qw4": q, "scale": s}`` dicts (quantization/ptq.py); the
+    output channel is always the last axis, and an int4 leaf packed
+    along its LAST axis (down_proj packs its output dim) is recognized
+    by the halved byte count vs the scale length."""
+    if isinstance(w, dict):
+        scale = w["scale"]
+        if "qw4" in w:
+            qw = w["qw4"]
+            axis = 1 if qw.shape[-1] * 2 == scale.shape[-1] else 0
+            return qw, scale, 4, axis
+        return w["qw8"], scale, 8, 0
+    return w, None, 0, 0
+
+
+def weight_dtype_of(*ws):
+    """The weight-dtype class string a set of weight leaves carries
+    ("int8" | "int4" | None for plain arrays) — feeds the dispatch
+    metas' ``weight_dtype`` key. Mixing modes across one block's
+    weights is rejected: the kernels stream all tiles of a block under
+    one bit width."""
+    bits = {_wq_parts(w)[2] for w in ws}
+    if len(bits) != 1:
+        raise ValueError(
+            "all block weights must share one weight-quant mode, got "
+            f"bit widths {sorted(bits)}")
+    b = bits.pop()
+    return {8: "int8", 4: "int4"}.get(b)
+
+
+def _kernel_weight(ref, bits, dt, axis=0):
+    """Load one weight tile at the model dtype ``dt``: plain tiles pass
+    through; int8 casts (|q| <= 127 is exact in bf16); packed int4
+    unpacks through :func:`quantization.quanters.unpack_int4` — the
+    SINGLE definition of the halves convention, shared with the
+    dequantize-then-matmul fallback, so the two routes can never
+    decode different weights. (It is jnp-traceable with
+    explicitly-typed shift amounts, so it lowers inside the kernel
+    body even when retraced outside the no_x64 window.)"""
+    w = ref[:]
+    if not bits:
+        return w
+    if bits == 4:
+        from ...quantization.quanters import unpack_int4
+        w = unpack_int4(w, axis=axis)
+    return w.astype(dt)
+
+
+def _weight_itemsize(meta) -> float:
+    """Bytes per weight element under the meta's weight-dtype class —
+    what the supports() VMEM math charges for weight tiles."""
+    wd = meta.get("weight_dtype")
+    if wd == "int8":
+        return 1.0
+    if wd == "int4":
+        return 0.5
+    return float(meta["itemsize"])
+
+
+# ---------------------------------------------------------------------------
 # attention-stage megakernel
 # ---------------------------------------------------------------------------
 def _attn_block_kernel(bt_ref, len_ref, x_ref, nw_ref, wq_ref, wk_ref,
                        wv_ref, wo_ref, sin_ref, cos_ref, *rest,
-                       scale, bs, kv, groups, eps, pp, quant, residual):
-    k_refs = rest[:pp]
-    v_refs = rest[pp:2 * pp]
-    i = 2 * pp
+                       scale, bs, kv, groups, eps, pp, quant, residual,
+                       wq_bits=0):
+    i = 0
+    if wq_bits:
+        sqw_ref, skw_ref, svw_ref, sow_ref = rest[:4]
+        i = 4
+    k_refs = rest[i:i + pp]
+    v_refs = rest[i + pp:i + 2 * pp]
+    i += 2 * pp
     if quant:
         ksc_ref, vsc_ref = rest[i:i + 2]
         i += 2
@@ -107,9 +181,18 @@ def _attn_block_kernel(bt_ref, len_ref, x_ref, nw_ref, wq_ref, wk_ref,
         xf = x_ref[:].astype(jnp.float32)                     # (1, D)
         ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
         h = (xf * jax.lax.rsqrt(ms + epsf)).astype(dt) * nw_ref[:]
-        q = jnp.dot(h, wq_ref[:], preferred_element_type=jnp.float32)
-        k = jnp.dot(h, wk_ref[:], preferred_element_type=jnp.float32)
-        v = jnp.dot(h, wv_ref[:], preferred_element_type=jnp.float32)
+
+        def proj(w_ref, s_ref):
+            # dequant rides in the matmul EPILOGUE: the integer tile
+            # feeds the MXU at model dtype and the per-output-channel
+            # f32 scale multiplies the f32 product row
+            t = jnp.dot(h, _kernel_weight(w_ref, wq_bits, dt),
+                        preferred_element_type=jnp.float32)
+            return t * s_ref[:] if wq_bits else t
+
+        q = proj(wq_ref, sqw_ref if wq_bits else None)
+        k = proj(wk_ref, skw_ref if wq_bits else None)
+        v = proj(wv_ref, svw_ref if wq_bits else None)
         sinr, cosr = sin_ref[:], cos_ref[:]                   # (1, hd2)
 
         def rope(t, n):
@@ -188,8 +271,11 @@ def _attn_block_kernel(bt_ref, len_ref, x_ref, nw_ref, wq_ref, wk_ref,
             pv_rows.append(pg * va[kvh:kvh + 1, :])           # (g, hd)
         acc_fin = acc_scr[:] * alpha + jnp.concatenate(pv_rows, axis=0)
         attn = (acc_fin / l_fin).astype(dt)                   # (H, hd)
-        o = jnp.dot(attn.reshape(1, -1), wo_ref[:],
+        o = jnp.dot(attn.reshape(1, -1),
+                    _kernel_weight(wo_ref, wq_bits, dt),
                     preferred_element_type=jnp.float32)
+        if wq_bits:
+            o = o * sow_ref[:]
         # residual=False returns the bare o-projection: the tensor-
         # parallel caller psums the per-shard partials across the head
         # axis FIRST and adds the (replicated) residual after
@@ -197,14 +283,20 @@ def _attn_block_kernel(bt_ref, len_ref, x_ref, nw_ref, wq_ref, wk_ref,
             else o.astype(dt)
 
 
-def attn_autotune_key(B, H, KV, hd, BS, MB, dtype, pool_dtype) -> str:
+def attn_autotune_key(B, H, KV, hd, BS, MB, dtype, pool_dtype,
+                      weight_dtype=None) -> str:
     """Persistent autotune-cache key for the fused attention kernel's
     pages-per-grid-step (single source of truth for sweep + read).
     ``pool_dtype`` keys the cache variant: an int8 pool moves half the
     page bytes and adds scale inputs, so it is a distinct shape class
-    (mirroring ``decode_meta``'s dispatch keying)."""
-    return (f"fused_attn_pages|"
-            f"{(B, H, KV, hd, BS, MB, str(dtype), str(pool_dtype))}")
+    (mirroring ``decode_meta``'s dispatch keying). ``weight_dtype``
+    ("int8"/"int4") appends the same way — quantized weight tiles move
+    1/2x-1/4x the bytes, a distinct pipelining class; None keeps the
+    historic fp key unchanged."""
+    base = (B, H, KV, hd, BS, MB, str(dtype), str(pool_dtype))
+    if weight_dtype:
+        base = base + (str(weight_dtype),)
+    return f"fused_attn_pages|{base}"
 
 
 def _tuned_pages(key_str, candidates, build, args):
@@ -239,6 +331,15 @@ def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
     B, D = x.shape
     N, BS, KV, hd = k_pool.shape
     MB = block_tables.shape[1]
+    # weight-quant normalization: quantized leaf dicts split into the
+    # integer tile + per-output-channel scale; the ORIGINAL leaves stay
+    # in the autotune args so the tuning recursion re-parses them
+    wq_in, wk_in, wv_in, wo_in = wq, wk, wv, wo
+    wq, sqw, bits, _ = _wq_parts(wq)
+    wk, skw, _, _ = _wq_parts(wk)
+    wv, svw, _, _ = _wq_parts(wv)
+    wo, sow, _, _ = _wq_parts(wo)
+    weight_dtype = weight_dtype_of(wq_in, wk_in, wv_in, wo_in)
     E = wq.shape[1]
     H = E // hd
     groups = H // KV
@@ -247,9 +348,10 @@ def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
 
     if pages_per_step is None:
         cands = [p for p in PAGE_STEP_CANDIDATES if p <= MB]
-        ck = attn_autotune_key(B, H, KV, hd, BS, MB, x.dtype, k_pool.dtype)
-        args = (x, nw, wq, wk, wv, wo, sin, cos, k_pool, v_pool,
-                block_tables, seq_lens)
+        ck = attn_autotune_key(B, H, KV, hd, BS, MB, x.dtype,
+                               k_pool.dtype, weight_dtype)
+        args = (x, nw, wq_in, wk_in, wv_in, wo_in, sin, cos, k_pool,
+                v_pool, block_tables, seq_lens)
 
         def build(pp_):
             return lambda *a: fused_attn_block_pallas(
@@ -271,18 +373,25 @@ def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
     in_specs = [
         pl.BlockSpec((1, D), row),                        # x
         pl.BlockSpec((1, D), const),                      # norm weight
-        pl.BlockSpec((D, E), const),                      # wq
-        pl.BlockSpec((D, KV * hd), const),                # wk
-        pl.BlockSpec((D, KV * hd), const),                # wv
-        pl.BlockSpec((E, D), const),                      # wo
+        # weight tiles ride at their STORED shapes (int4 halves the
+        # pack axis), resident per kernel invocation like the fp tiles
+        pl.BlockSpec(tuple(wq.shape), const),             # wq
+        pl.BlockSpec(tuple(wk.shape), const),             # wk
+        pl.BlockSpec(tuple(wv.shape), const),             # wv
+        pl.BlockSpec(tuple(wo.shape), const),             # wo
         pl.BlockSpec((1, hd // 2), row),                  # sin row
         pl.BlockSpec((1, hd // 2), row),                  # cos row
     ]
+    inputs = [x, nw.reshape(1, D), wq, wk, wv, wo, sin_b, cos_b]
+    if bits:
+        # per-output-channel f32 scales, one const row per projection
+        for s in (sqw, skw, svw, sow):
+            in_specs.append(pl.BlockSpec((1, s.shape[-1]), const))
+            inputs.append(jnp.asarray(s, jnp.float32).reshape(1, -1))
     in_specs += [pl.BlockSpec((1, BS, KV, hd), page_index(j))
                  for j in range(pp)]                      # k pages
     in_specs += [pl.BlockSpec((1, BS, KV, hd), page_index(j))
                  for j in range(pp)]                      # v pages
-    inputs = [x, nw.reshape(1, D), wq, wk, wv, wo, sin_b, cos_b]
     inputs += [k_pool] * pp + [v_pool] * pp
     if quant:
         in_specs += [pl.BlockSpec((1, KV), const)] * 2
@@ -292,7 +401,7 @@ def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
     xo, kn, vn = audited_pallas_call(
         functools.partial(_attn_block_kernel, scale=scale, bs=BS, kv=KV,
                           groups=groups, eps=eps, pp=pp, quant=quant,
-                          residual=residual),
+                          residual=residual, wq_bits=bits),
         name="decode_attn_block",
         num_scalar_prefetch=2,
         grid=(B, pl.cdiv(MB, pp)),
@@ -325,8 +434,12 @@ def fused_attn_block_pallas(x, nw, wq, wk, wv, wo, sin, cos,
 # ---------------------------------------------------------------------------
 # MLP-stage megakernel
 # ---------------------------------------------------------------------------
-def _mlp_block_kernel(x_ref, nw_ref, wg_ref, wu_ref, wd_ref, o_ref,
-                      h_scr, acc_scr, *, eps, residual):
+def _mlp_block_kernel(x_ref, nw_ref, wg_ref, wu_ref, wd_ref, *rest,
+                      eps, residual, wq_bits=0):
+    if wq_bits:
+        sg_ref, su_ref, sd_ref = rest[:3]
+        rest = rest[3:]
+    o_ref, h_scr, acc_scr = rest
     j = pl.program_id(0)
     dt = x_ref.dtype
 
@@ -341,13 +454,23 @@ def _mlp_block_kernel(x_ref, nw_ref, wg_ref, wu_ref, wd_ref, o_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
     h = h_scr[:]
-    g = jnp.dot(h, wg_ref[:],
-                preferred_element_type=jnp.float32).astype(dt)
-    u = jnp.dot(h, wu_ref[:],
-                preferred_element_type=jnp.float32).astype(dt)
+    # gate/up pack along the CONTRACTION dim (rows, axis 0), down along
+    # its OUTPUT dim (columns, axis 1) — the axis each F-tile fully
+    # covers; quantized scales apply in the f32 epilogue
+    g = jnp.dot(h, _kernel_weight(wg_ref, wq_bits, dt, axis=0),
+                preferred_element_type=jnp.float32)
+    u = jnp.dot(h, _kernel_weight(wu_ref, wq_bits, dt, axis=0),
+                preferred_element_type=jnp.float32)
+    if wq_bits:
+        g = g * sg_ref[:]
+        u = u * su_ref[:]
+    g, u = g.astype(dt), u.astype(dt)
     ff = jax.nn.silu(g) * u                       # swiglu, model dtype
-    acc_scr[:] = acc_scr[:] + jnp.dot(
-        ff, wd_ref[:], preferred_element_type=jnp.float32)
+    dn = jnp.dot(ff, _kernel_weight(wd_ref, wq_bits, dt, axis=1),
+                 preferred_element_type=jnp.float32)
+    if wq_bits:
+        dn = dn * sd_ref[:]
+    acc_scr[:] = acc_scr[:] + dn
 
     @pl.when(j == pl.num_programs(0) - 1)
     def _fin():
@@ -359,15 +482,22 @@ def _mlp_block_kernel(x_ref, nw_ref, wg_ref, wu_ref, wd_ref, o_ref,
 _MLP_BLOCK_CANDIDATES = (512, 256, 1024, 2048)
 
 
-def mlp_autotune_key(B, D, F, dtype, budget=None) -> str:
+def mlp_autotune_key(B, D, F, dtype, budget=None,
+                     weight_dtype=None) -> str:
     """Persistent autotune-cache key for the fused MLP kernel's
     intermediate-dim block size. The VMEM budget is part of the key:
     winners are stored as an INDEX into the budget-fitting candidate
     list, so a different ``PADDLE_TPU_FUSED_VMEM_BUDGET`` (which
     reshapes that list) must read a different cache entry — not decode
-    a stale index against the wrong candidates."""
+    a stale index against the wrong candidates. ``weight_dtype``
+    ("int8"/"int4") appends the quantized-weight shape class the same
+    way (it too reshapes the fitting list); None keeps the historic
+    fp key."""
     budget = _vmem_budget() if budget is None else int(budget)
-    return f"fused_mlp_block|{(B, D, F, str(dtype), budget)}"
+    base = (B, D, F, str(dtype), budget)
+    if weight_dtype:
+        base = base + (str(weight_dtype),)
+    return f"fused_mlp_block|{base}"
 
 
 def _mlp_candidates(F: int):
@@ -377,15 +507,23 @@ def _mlp_candidates(F: int):
     return cands or [F]
 
 
-def _mlp_vmem_need(B: int, D: int, itemsize: int, bf: int) -> int:
+def _mlp_vmem_need(B: int, D: int, itemsize: int, bf: int,
+                   w_itemsize: float = None) -> int:
     """Per-grid-step VMEM bytes at tile ``bf``: 3 weight tiles + the
-    x/h/acc activation rows + the g/u/ff intermediates."""
-    return 3 * D * bf * itemsize + B * D * (4 + 2 * itemsize) \
-        + 3 * B * bf * 4
+    x/h/acc activation rows + the g/u/ff intermediates.
+    ``w_itemsize``: bytes per weight ELEMENT (1 for int8, 0.5 for
+    packed int4 — which also adds the f32 scale rows); defaults to the
+    activation itemsize (plain fp weights)."""
+    if w_itemsize is None:
+        w_itemsize = itemsize
+    scales = (2 * bf + D) * 4 if w_itemsize != itemsize else 0
+    return int(3 * D * bf * w_itemsize) + scales \
+        + B * D * (4 + 2 * itemsize) + 3 * B * bf * 4
 
 
 def _mlp_fitting_candidates(B: int, D: int, F: int, itemsize: int,
-                            budget: int = None):
+                            budget: int = None,
+                            w_itemsize: float = None):
     """The divisor candidates that fit the VMEM budget. Dispatch
     (``_supports_mlp``), the traced default pick, and the autotune
     sweep all consume THIS list — a supported-and-dispatched kernel can
@@ -395,7 +533,7 @@ def _mlp_fitting_candidates(B: int, D: int, F: int, itemsize: int,
     input, not a hidden one the cache-key lint cannot see."""
     budget = _vmem_budget() if budget is None else int(budget)
     return [bf for bf in _mlp_candidates(F)
-            if _mlp_vmem_need(B, D, itemsize, bf) <= budget]
+            if _mlp_vmem_need(B, D, itemsize, bf, w_itemsize) <= budget]
 
 
 @no_x64
@@ -410,7 +548,15 @@ def fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=1e-6, block_f=None,
     partial — the caller all-reduces, then adds the residual).
     """
     B, D = x.shape
+    # weight-quant normalization (the attn wrapper's idiom): original
+    # leaves stay in the autotune args so the recursion re-parses them
+    wg_in, wu_in, wd_in = wg, wu, wd
+    wg, sg, bits, _ = _wq_parts(wg)
+    wu, su, _, _ = _wq_parts(wu)
+    wd, sd, _, _ = _wq_parts(wd)
+    weight_dtype = weight_dtype_of(wg_in, wu_in, wd_in)
     F = wg.shape[1]
+    w_it = {8: 1.0, 4: 0.5}.get(bits)
     if block_f is None:
         it = jnp.dtype(x.dtype).itemsize
         # ONE budget read per trace: the fitting list and the autotune
@@ -418,43 +564,64 @@ def fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=1e-6, block_f=None,
         budget = _vmem_budget()
         # budget-fitting tiles only; a forced call with nothing fitting
         # (tests, interpret) gets the smallest divisor tile
-        cands = _mlp_fitting_candidates(B, D, F, it, budget) \
+        cands = _mlp_fitting_candidates(B, D, F, it, budget, w_it) \
             or [min(_mlp_candidates(F))]
-        ck = mlp_autotune_key(B, D, F, x.dtype, budget)
+        ck = mlp_autotune_key(B, D, F, x.dtype, budget, weight_dtype)
 
         def build(bf):
             return lambda *a: fused_mlp_block_pallas(*a, eps=eps,
                                                      block_f=bf,
                                                      residual=residual)
 
-        block_f = _tuned_pages(ck, cands, build, (x, nw, wg, wu, wd))
+        block_f = _tuned_pages(ck, cands, build,
+                               (x, nw, wg_in, wu_in, wd_in))
     bf = int(block_f)
     if F % bf:
         # grid=(F // bf,) floor-drops a ragged tail block: a non-divisor
         # tile would silently never feed the last F % bf columns into
-        # the down-projection accumulator
+        # the down-projection accumulator. (int4 needs no extra tile
+        # constraint: the F axis is never the packed axis — gate/up
+        # pack rows (D), down packs columns (D), both fully covered by
+        # every F-tile.)
         raise ValueError(f"block_f={bf} must divide the intermediate "
                          f"dim F={F}")
 
     const = lambda j: (0, 0)                              # noqa: E731
+    # stored-shape tiles: int4 halves gate/up rows (pack axis 0 = the
+    # contraction dim, fully covered by every tile) and down COLUMNS
+    # (pack axis 1 = its output dim); the F-axis tiling is over the
+    # UNPACKED coordinate for gate/up and over wd's packed rows 1:1
+    gu_rows = wg.shape[0]
+    wd_cols = wd.shape[1]
+    bf_wd = bf                            # wd rows tile the F axis 1:1
+    in_specs = [pl.BlockSpec((B, D), const),
+                pl.BlockSpec((1, D), const),
+                pl.BlockSpec((gu_rows, bf), lambda j: (0, j)),
+                pl.BlockSpec((gu_rows, bf), lambda j: (0, j)),
+                pl.BlockSpec((bf_wd, wd_cols), lambda j: (j, 0))]
+    inputs = [x, nw.reshape(1, D), wg, wu, wd]
+    if bits:
+        in_specs += [pl.BlockSpec((1, bf), lambda j: (0, j)),
+                     pl.BlockSpec((1, bf), lambda j: (0, j)),
+                     pl.BlockSpec((1, D), const)]
+        inputs += [jnp.asarray(sg, jnp.float32).reshape(1, F),
+                   jnp.asarray(su, jnp.float32).reshape(1, F),
+                   jnp.asarray(sd, jnp.float32).reshape(1, D)]
     out = audited_pallas_call(
-        functools.partial(_mlp_block_kernel, eps=eps, residual=residual),
+        functools.partial(_mlp_block_kernel, eps=eps, residual=residual,
+                          wq_bits=bits),
         name="decode_mlp_block",
         # the output block is revisited every intermediate tile (down-
         # projection accumulated in scratch, written at the last tile)
         accum_outputs=(0,),
         grid=(F // bf,),
-        in_specs=[pl.BlockSpec((B, D), const),
-                  pl.BlockSpec((1, D), const),
-                  pl.BlockSpec((D, bf), lambda j: (0, j)),
-                  pl.BlockSpec((D, bf), lambda j: (0, j)),
-                  pl.BlockSpec((bf, D), lambda j: (j, 0))],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((B, D), const),
         out_shape=jax.ShapeDtypeStruct((B, D), x.dtype),
         scratch_shapes=[pltpu.VMEM((B, D), x.dtype),
                         pltpu.VMEM((B, D), jnp.float32)],
         interpret=_interpret(),
-    )(x, nw.reshape(1, D), wg, wu, wd)
+    )(*inputs)
     return out
 
 
@@ -471,7 +638,15 @@ def attn_block_ref(x, nw, wq, wk, wv, wo, sin, cos, k_pool, v_pool,
                                    paged_attention_decode_quant,
                                    write_to_pool, write_to_pool_quant)
     from ..rope import apply_rope
+    from ...quantization.quanters import maybe_dequantize
 
+    # quantized weight leaves take the DEQUANTIZE-THEN-MATMUL route
+    # here — the priority-0 fallback contract is bit-identical to that
+    # composition by construction
+    wq = maybe_dequantize(wq, x.dtype)
+    wk = maybe_dequantize(wk, x.dtype)
+    wv = maybe_dequantize(wv, x.dtype)
+    wo = maybe_dequantize(wo, x.dtype)
     B, D = x.shape
     _, _, KV, hd = k_pool.shape
     H = wq.shape[1] // hd
@@ -504,7 +679,11 @@ def attn_block_ref(x, nw, wq, wk, wv, wo, sin, cos, k_pool, v_pool,
 
 def mlp_block_ref(x, nw, wg, wu, wd, eps=1e-6, residual=True):
     from .. import rms_norm as fused_rms_norm, swiglu as fused_swiglu
+    from ...quantization.quanters import maybe_dequantize
 
+    wg = maybe_dequantize(wg, x.dtype)
+    wu = maybe_dequantize(wu, x.dtype)
+    wd = maybe_dequantize(wd, x.dtype)
     h = fused_rms_norm(x[:, None], nw, eps)[:, 0]
     ff = fused_swiglu(h @ wg, h @ wu)
     o = ff @ wd
@@ -515,7 +694,7 @@ def mlp_block_ref(x, nw, wg, wu, wd, eps=1e-6, residual=True):
 # registry: shape-class dispatch with the composition as fallback
 # ---------------------------------------------------------------------------
 def decode_meta_dims(B, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
-                     quant, tp=1) -> dict:
+                     quant, tp=1, weight_dtype=None) -> dict:
     """Static dispatch metadata from raw dims — the ONE builder of
     everything the ``supports`` predicates read. The serving/generate
     paths go through :func:`decode_meta`; eager sweeps (bench
@@ -537,6 +716,12 @@ def decode_meta_dims(B, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
         "pool_dtype": str(jnp.dtype(pool_dtype)),
         "quant": bool(quant), "interpret": bool(_interpret()),
         "tp": int(tp),
+        # the weight-dtype CLASS ("int8"/"int4" quantized trees, else
+        # the model dtype): it reshapes the VMEM math and the tile
+        # candidate lists, and it is static in the trace signature
+        # (the param tree's structure carries it)
+        "weight_dtype": str(weight_dtype) if weight_dtype
+        else str(dtype),
         # the budget is a real dispatch input (it reshapes supports()
         # and the block_f candidate list), so it rides in the meta —
         # visible to the DISPATCH_KEY_GAP lint like every other key
@@ -544,14 +729,28 @@ def decode_meta_dims(B, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
     }
 
 
-def decode_meta(cfg, B, BS, MB, pool_dtype, quant, tp=1) -> dict:
+def decode_meta(cfg, B, BS, MB, pool_dtype, quant, tp=1,
+                weight_dtype=None) -> dict:
     """Static dispatch metadata for one decode step — everything the
     ``supports`` predicates read. Built at trace time from static
     shapes only, so dispatch is deterministic per program."""
     return decode_meta_dims(B, cfg.hidden_size, cfg.num_attention_heads,
                             cfg.num_key_value_heads, cfg.head_dim,
                             cfg.intermediate_size, BS, MB, cfg.dtype,
-                            pool_dtype, quant, tp=tp)
+                            pool_dtype, quant, tp=tp,
+                            weight_dtype=weight_dtype)
+
+
+def _wq_even_reason(meta, dims):
+    """int4 packing pairs the two halves of the pack axis — every
+    packed dimension must be even. ``dims``: (name, value) pairs."""
+    if meta.get("weight_dtype") != "int4":
+        return None
+    for name, v in dims:
+        if v % 2:
+            return (f"packed-int4 weights need an even {name} "
+                    f"(got {v}): packing pairs the axis halves")
+    return None
 
 
 def _supports_attn(meta):
@@ -564,7 +763,14 @@ def _supports_attn(meta):
         return False, "H not a multiple of KV"
     D, H, KV = meta["D"], meta["H"], meta["KV"]
     it = meta["itemsize"]
-    weights = (2 * D * H * hd + 2 * D * KV * hd) * it
+    why = _wq_even_reason(meta, (("hidden_size", D),
+                                 ("H*head_dim", H * hd)))
+    if why:
+        return False, why
+    wit = _weight_itemsize(meta)
+    weights = int((2 * D * H * hd + 2 * D * KV * hd) * wit)
+    if wit != it:          # per-output-channel f32 scale rows
+        weights += (H * hd + 2 * KV * hd + D) * 4
     page = meta["BS"] * KV * hd * (1 if meta["quant"] else it)
     scratch = (2 * H * hd + 2 * KV * hd + 2 * H) * 4
     # page windows at the WORST-case autotune choice: the tuner may
@@ -584,8 +790,12 @@ def _supports_mlp(meta):
     if meta["interpret"]:
         return False, "interpret mode (off-TPU): composition is faster"
     D, F, B = meta["D"], meta["F"], meta["B"]
+    why = _wq_even_reason(meta, (("hidden_size", D),))
+    if why:
+        return False, why
     fits = _mlp_fitting_candidates(B, D, F, meta["itemsize"],
-                                   meta["vmem_budget"])
+                                   meta["vmem_budget"],
+                                   _weight_itemsize(meta))
     if fits:
         return True, f"fits VMEM at block_f={fits[0]}"
     return False, (f"no intermediate tile of F={F} fits the "
@@ -624,7 +834,7 @@ KERNELS.register("decode_mlp_block", "unfused", mlp_block_ref,
 # registry lint holds supports() to this declaration
 _DECODE_KEY_FIELDS = ("B", "D", "H", "KV", "hd", "F", "BS", "MB",
                       "dtype", "pool_dtype", "quant", "interpret",
-                      "tp", "vmem_budget")
+                      "tp", "weight_dtype", "vmem_budget")
 _DECODE_KEY_COVERS = {"itemsize": "dtype"}
 KERNELS.declare_cache_key("decode_attn_block", _DECODE_KEY_FIELDS,
                           covers=_DECODE_KEY_COVERS)

@@ -65,8 +65,10 @@ from ...core.flags import GLOBAL_FLAGS
 from ._util import (PAGE_STEP_CANDIDATES, audited_pallas_call,
                     fused_vmem_budget, interpret_mode as _interpret,
                     no_x64, online_softmax_page_update)
-from .fused_decode_block import (_mlp_fitting_candidates,
-                                 _mlp_pallas_variant, mlp_block_ref)
+from .fused_decode_block import (_kernel_weight, _mlp_fitting_candidates,
+                                 _mlp_pallas_variant, _weight_itemsize,
+                                 _wq_even_reason, _wq_parts,
+                                 mlp_block_ref, weight_dtype_of)
 from .registry import KERNELS
 
 __all__ = [
@@ -101,10 +103,14 @@ def _bq_candidates(P: int):
 def _prefill_attn_kernel(tab_ref, b_ref, x_ref, nw_ref, wq_ref, wk_ref,
                          wv_ref, wo_ref, sin_ref, cos_ref, *rest,
                          scale, bs, kv, groups, eps, pp, bq, nh, quant,
-                         residual):
-    k_refs = rest[:pp]
-    v_refs = rest[pp:2 * pp]
-    i = 2 * pp
+                         residual, wq_bits=0):
+    i = 0
+    if wq_bits:
+        sqw_ref, skw_ref, svw_ref, sow_ref = rest[:4]
+        i = 4
+    k_refs = rest[i:i + pp]
+    v_refs = rest[i + pp:i + 2 * pp]
+    i += 2 * pp
     if quant:
         ksc_ref, vsc_ref = rest[i:i + 2]
         i += 2
@@ -132,9 +138,18 @@ def _prefill_attn_kernel(tab_ref, b_ref, x_ref, nw_ref, wq_ref, wk_ref,
         xf = x_ref[:].astype(f32)                          # (P, D)
         ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
         h = (xf * jax.lax.rsqrt(ms + f32(eps))).astype(dt) * nw_ref[:]
-        q = jnp.dot(h, wq_ref[:], preferred_element_type=f32)
-        k = jnp.dot(h, wk_ref[:], preferred_element_type=f32)
-        v = jnp.dot(h, wv_ref[:], preferred_element_type=f32)
+
+        def proj(w_ref, s_ref):
+            # quantized tiles dequant in the matmul EPILOGUE: the
+            # per-output-channel f32 scale row multiplies the f32
+            # product (the fused_decode_block contract)
+            t = jnp.dot(h, _kernel_weight(w_ref, wq_bits, dt),
+                        preferred_element_type=f32)
+            return t * s_ref[:] if wq_bits else t
+
+        q = proj(wq_ref, sqw_ref if wq_bits else None)
+        k = proj(wk_ref, skw_ref if wq_bits else None)
+        v = proj(wv_ref, svw_ref if wq_bits else None)
         sinr, cosr = sin_ref[:], cos_ref[:]                # (P, hd2)
 
         def rope(t, n):
@@ -245,20 +260,29 @@ def _prefill_attn_kernel(tab_ref, b_ref, x_ref, nw_ref, wq_ref, wk_ref,
         rows = jnp.concatenate(
             [attn[h * bq:(h + 1) * bq, :] for h in range(H)],
             axis=1).astype(dt)                         # (bq, H*hd)
-        o = jnp.dot(rows, wo_ref[:], preferred_element_type=f32)
+        o = jnp.dot(rows, _kernel_weight(wo_ref, wq_bits, dt),
+                    preferred_element_type=f32)
+        if wq_bits:
+            o = o * sow_ref[:]
         xr = x_ref[pl.ds(qi * bq, bq), :]
         xo_ref[:] = (xr + o.astype(dt)) if residual else o.astype(dt)
 
 
 def prefill_attn_autotune_key(P, D, H, KV, hd, BS, MB, dtype,
-                              pool_dtype, budget=None) -> str:
+                              pool_dtype, budget=None,
+                              weight_dtype=None) -> str:
     """Persistent autotune key for the fused prefill attention kernel's
     (block_q, pages_per_step) pair. The VMEM budget is part of the key:
     winners are stored as an index into the budget-filtered candidate
-    list (the fused-MLP precedent)."""
+    list (the fused-MLP precedent). ``weight_dtype`` ("int8"/"int4")
+    appends the quantized-weight shape class; None keeps the historic
+    fp key."""
     budget = _vmem_budget() if budget is None else int(budget)
-    return (f"fused_prefill_attn|"
-            f"{(P, D, H, KV, hd, BS, MB, str(jnp.dtype(dtype)), str(jnp.dtype(pool_dtype)), budget)}")
+    base = (P, D, H, KV, hd, BS, MB, str(jnp.dtype(dtype)),
+            str(jnp.dtype(pool_dtype)), budget)
+    if weight_dtype:
+        base = base + (str(weight_dtype),)
+    return f"fused_prefill_attn|{base}"
 
 
 def _attn_scratch_bytes(P, H, KV, hd, bq, itemsize) -> int:
@@ -273,7 +297,10 @@ def _attn_vmem_need(meta, bq, pp) -> int:
     D, H, KV, hd = meta["D"], meta["H"], meta["KV"], meta["hd"]
     P, BS = meta["P"], meta["BS"]
     it = meta["itemsize"]
-    weights = (2 * D * H * hd + 2 * D * KV * hd) * it
+    wit = _weight_itemsize(meta)
+    weights = int((2 * D * H * hd + 2 * D * KV * hd) * wit)
+    if wit != it:          # per-output-channel f32 scale rows
+        weights += (H * hd + 2 * KV * hd + D) * 4
     page = BS * KV * hd * (1 if meta["quant"] else it)
     io = P * D * it + 2 * bq * D * it \
         + 2 * P * (hd // 2) * 4 + 2 * 2 * P * KV * hd * it
@@ -315,6 +342,14 @@ def fused_prefill_attn_pallas(x, nw, wq, wk, wv, wo, sin, cos,
     P, D = x.shape
     N, BS, KV, hd = k_pool.shape
     MB = table.shape[0]
+    # weight-quant normalization (the fused_decode_block idiom): the
+    # ORIGINAL leaves stay in the autotune args for the recursion
+    wq_in, wk_in, wv_in, wo_in = wq, wk, wv, wo
+    wq, sqw, bits, _ = _wq_parts(wq)
+    wk, skw, _, _ = _wq_parts(wk)
+    wv, svw, _, _ = _wq_parts(wv)
+    wo, sow, _, _ = _wq_parts(wo)
+    weight_dtype = weight_dtype_of(wq_in, wk_in, wv_in, wo_in)
     H = wq.shape[1] // hd
     groups = H // KV
     scale = 1.0 / math.sqrt(hd)
@@ -323,12 +358,14 @@ def fused_prefill_attn_pallas(x, nw, wq, wk, wv, wo, sin, cos,
     if block_q is None or pages_per_step is None:
         from .autotune import resolve_candidate
         meta = prefill_meta_dims(P, D, H, KV, hd, 4 * D, BS, MB,
-                                 x.dtype, k_pool.dtype, quant)
+                                 x.dtype, k_pool.dtype, quant,
+                                 weight_dtype=weight_dtype)
         cands = _attn_candidates(meta) \
             or [(min(_bq_candidates(P)), 1)]
         ck = prefill_attn_autotune_key(P, D, H, KV, hd, BS, MB,
                                        x.dtype, k_pool.dtype,
-                                       meta["vmem_budget"])
+                                       meta["vmem_budget"],
+                                       weight_dtype)
 
         def build(cfg_):
             bq_, pp_ = cfg_
@@ -338,8 +375,8 @@ def fused_prefill_attn_pallas(x, nw, wq, wk, wv, wo, sin, cos,
 
         block_q, pages_per_step = resolve_candidate(
             ck, cands, build,
-            (x, nw, wq, wk, wv, wo, sin, cos, k_pool, v_pool, table,
-             pos0, n_valid))
+            (x, nw, wq_in, wk_in, wv_in, wo_in, sin, cos, k_pool,
+             v_pool, table, pos0, n_valid))
     bq = max(1, min(int(block_q), P))
     if P % bq:
         raise ValueError(f"block_q={bq} must divide the chunk width "
@@ -366,20 +403,25 @@ def fused_prefill_attn_pallas(x, nw, wq, wk, wv, wo, sin, cos,
     in_specs = [
         pl.BlockSpec((P, D), const),                  # x (whole chunk)
         pl.BlockSpec((1, D), const),                  # norm weight
-        pl.BlockSpec((D, H * hd), const),             # wq
-        pl.BlockSpec((D, KV * hd), const),            # wk
-        pl.BlockSpec((D, KV * hd), const),            # wv
-        pl.BlockSpec((H * hd, D), const),             # wo
+        # weight tiles at their STORED shapes (int4 halves the rows)
+        pl.BlockSpec(tuple(wq.shape), const),         # wq
+        pl.BlockSpec(tuple(wk.shape), const),         # wk
+        pl.BlockSpec(tuple(wv.shape), const),         # wv
+        pl.BlockSpec(tuple(wo.shape), const),         # wo
         pl.BlockSpec((P, hd // 2), const),            # sin rows
         pl.BlockSpec((P, hd // 2), const),            # cos rows
     ]
+    inputs = [x, nw.reshape(1, D), wq, wk, wv, wo,
+              jnp.asarray(sin, jnp.float32),
+              jnp.asarray(cos, jnp.float32)]
+    if bits:
+        for s in (sqw, skw, svw, sow):
+            in_specs.append(pl.BlockSpec((1, s.shape[-1]), const))
+            inputs.append(jnp.asarray(s, jnp.float32).reshape(1, -1))
     in_specs += [pl.BlockSpec((1, BS, KV, hd), page_index(j))
                  for j in range(pp)]                  # k history pages
     in_specs += [pl.BlockSpec((1, BS, KV, hd), page_index(j))
                  for j in range(pp)]                  # v history pages
-    inputs = [x, nw.reshape(1, D), wq, wk, wv, wo,
-              jnp.asarray(sin, jnp.float32),
-              jnp.asarray(cos, jnp.float32)]
     inputs += [k_pool] * pp + [v_pool] * pp
     if quant:
         in_specs += [pl.BlockSpec((1, KV), const)] * 2
@@ -389,7 +431,8 @@ def fused_prefill_attn_pallas(x, nw, wq, wk, wv, wo, sin, cos,
     xo, kn, vn = audited_pallas_call(
         functools.partial(_prefill_attn_kernel, scale=scale, bs=BS,
                           kv=KV, groups=groups, eps=eps, pp=pp, bq=bq,
-                          nh=int(nh), quant=quant, residual=residual),
+                          nh=int(nh), quant=quant, residual=residual,
+                          wq_bits=bits),
         name="prefill_attn_block",
         num_scalar_prefetch=2,
         # the +1 grid step past the history pages folds the chunk's
@@ -441,7 +484,14 @@ def prefill_attn_block_ref(x, nw, wq, wk, wv, wo, sin, cos, k_pool,
     full pad FLOPs — ``n_valid`` rides only for signature parity."""
     from .. import rms_norm as fused_rms_norm
     from ..rope import apply_rope
+    from ...quantization.quanters import maybe_dequantize
 
+    # quantized leaves take the DEQUANTIZE-THEN-MATMUL route (the
+    # priority-0 fallback contract)
+    wq = maybe_dequantize(wq, x.dtype)
+    wk = maybe_dequantize(wk, x.dtype)
+    wv = maybe_dequantize(wv, x.dtype)
+    wo = maybe_dequantize(wo, x.dtype)
     P, D = x.shape
     N, BS, KV, hd = k_pool.shape
     MB = table.shape[0]
@@ -496,7 +546,7 @@ def prefill_mlp_block_ref(x, nw, wg, wu, wd, eps=1e-6, residual=True):
 # registry: shape-class dispatch with the composition as fallback
 # ---------------------------------------------------------------------------
 def prefill_meta_dims(P, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
-                      quant) -> dict:
+                      quant, weight_dtype=None) -> dict:
     """Static dispatch metadata for one prefill-chunk program — the ONE
     builder of everything the ``supports`` predicates read. ``P`` is
     the bucket width (chunk rows); the rest mirrors
@@ -508,18 +558,24 @@ def prefill_meta_dims(P, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
         "dtype": str(dtype), "itemsize": int(dtype.itemsize),
         "pool_dtype": str(jnp.dtype(pool_dtype)),
         "quant": bool(quant), "interpret": bool(_interpret()),
+        # the weight-dtype class (the fused_decode_block contract):
+        # static in the trace signature via the param tree's structure
+        "weight_dtype": str(weight_dtype) if weight_dtype
+        else str(dtype),
         "vmem_budget": int(_vmem_budget()),
     }
 
 
-def prefill_meta(cfg, P, BS, MB, pool_dtype, quant) -> dict:
+def prefill_meta(cfg, P, BS, MB, pool_dtype, quant,
+                 weight_dtype=None) -> dict:
     """Dispatch metadata from a model config + chunk geometry (built at
     trace time from static shapes only)."""
     return prefill_meta_dims(P, cfg.hidden_size,
                              cfg.num_attention_heads,
                              cfg.num_key_value_heads, cfg.head_dim,
                              cfg.intermediate_size, BS, MB, cfg.dtype,
-                             pool_dtype, quant)
+                             pool_dtype, quant,
+                             weight_dtype=weight_dtype)
 
 
 def _supports_prefill_attn(meta):
@@ -533,6 +589,11 @@ def _supports_prefill_attn(meta):
     if meta["P"] % 8 != 0:
         return False, (f"chunk width P={meta['P']} not a multiple of 8 "
                        "(sublane tiling)")
+    why = _wq_even_reason(meta, (("hidden_size", meta["D"]),
+                                 ("H*head_dim",
+                                  meta["H"] * meta["hd"])))
+    if why:
+        return False, why
     cands = _attn_candidates(meta)
     if not cands:
         need = _attn_vmem_need(meta, min(_bq_candidates(meta["P"])), 1)
@@ -546,8 +607,12 @@ def _supports_prefill_mlp(meta):
     if meta["interpret"]:
         return False, "interpret mode (off-TPU): composition is faster"
     P, D, F = meta["P"], meta["D"], meta["F"]
+    why = _wq_even_reason(meta, (("hidden_size", D),))
+    if why:
+        return False, why
     fits = _mlp_fitting_candidates(P, D, F, meta["itemsize"],
-                                   meta["vmem_budget"])
+                                   meta["vmem_budget"],
+                                   _weight_itemsize(meta))
     if fits:
         return True, f"fits VMEM at block_f={fits[0]}"
     return False, (f"no intermediate tile of F={F} fits the "
@@ -583,7 +648,7 @@ KERNELS.register("prefill_mlp_block", "unfused", prefill_mlp_block_ref,
 # override) — the registry lint holds supports() to this declaration
 _PREFILL_KEY_FIELDS = ("P", "D", "H", "KV", "hd", "F", "BS", "MB",
                        "dtype", "pool_dtype", "quant", "interpret",
-                       "vmem_budget")
+                       "weight_dtype", "vmem_budget")
 _PREFILL_KEY_COVERS = {"itemsize": "dtype"}
 KERNELS.declare_cache_key("prefill_attn_block", _PREFILL_KEY_FIELDS,
                           covers=_PREFILL_KEY_COVERS)

@@ -12,9 +12,13 @@ from .observers import (BaseObserver, AbsmaxObserver,
                         MovingAverageAbsmaxObserver,
                         PerChannelAbsmaxObserver, PercentileObserver)
 from .quanters import (fake_quant, FakeQuanterWithAbsMax, quantize_to_int8,
-                       int8_matmul)
+                       quantize_to_int4, pack_int4, unpack_int4,
+                       dequantize_weight, maybe_dequantize, int8_matmul)
 from .qat import (QAT, PTQ, QuantConfig, QuantedLinear, Int8Linear,
                   FP8Linear)
+from . import ptq
+from .ptq import (activation_absmax, ensure_quantized, quantize_leaf,
+                  quantize_weights, weight_hbm_bytes, weight_quant_mode)
 
 __all__ = [
     "QuantConfig", "QAT", "PTQ", "QuantedLinear", "Int8Linear",
@@ -22,5 +26,8 @@ __all__ = [
     "BaseObserver", "AbsmaxObserver", "MovingAverageAbsmaxObserver",
     "PerChannelAbsmaxObserver", "PercentileObserver",
     "fake_quant", "FakeQuanterWithAbsMax", "quantize_to_int8",
-    "int8_matmul",
+    "quantize_to_int4", "pack_int4", "unpack_int4",
+    "dequantize_weight", "maybe_dequantize", "int8_matmul",
+    "ptq", "quantize_weights", "quantize_leaf", "weight_quant_mode",
+    "ensure_quantized", "activation_absmax", "weight_hbm_bytes",
 ]

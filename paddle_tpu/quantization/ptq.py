@@ -1,0 +1,277 @@
+"""One-shot post-training weight quantization for the serving stack.
+
+The int8-KV one-shot idiom (ServingEngine calibrates static cache
+scales from the first admitted prompt) generalized to WEIGHTS: decode
+is memory-bound — every step re-streams the full weight set through
+HBM — so int8/int4 weights are a 2x/4x bandwidth multiplier on exactly
+the path the decode megakernels fused (reference: the quantization
+framework of SURVEY §2.5; python/paddle/quantization/ptq.py's
+calibrate-then-convert flow).
+
+Quantized param tree format (what the engines and the fused kernels
+consume): each of the seven per-layer projection weights in
+``params["layers"]`` is replaced by a leaf dict
+
+    {"qw8": int8 [L, in, out]}               (int8)  or
+    {"qw4": int8 packed, "scale": f32 [L, out]}      (int4)
+
+with per-LAYER per-OUTPUT-channel f32 scales — the output channel is
+always the last axis, so dequant commutes with the matmul
+(``x @ (q * s) == (x @ q) * s``) and the fused kernels apply the scale
+in the matmul epilogue. int4 packs two values per byte along the
+HIDDEN axis (the axis every kernel tile fully covers: the contraction
+dim for q/k/v/o/gate/up, the output dim for down_proj), halves — not
+interleaved pairs — so the in-register unpack is one concatenate.
+Embedding, norms and lm_head stay at the model dtype: they are a small
+fraction of decode HBM traffic and the logits path keeps full
+precision.
+
+Calibration is pure absmax by default (deterministic, no data), with
+optional activation-aware clipping: :func:`activation_absmax` runs ONE
+dense forward over a calibration prompt capturing each projection's
+input-channel absmax, and :func:`quantize_weights` then grid-searches
+a per-output-channel clip factor minimizing the activation-weighted
+quantization error (the AWQ observation: channels the activations
+actually exercise deserve the scale budget).
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .quanters import (_channel_quantize, pack_int4, quantize_to_int4,
+                       quantize_to_int8)
+
+__all__ = ["WQ_KEYS", "weight_quant_mode", "normalize_weight_quant",
+           "ensure_quantized", "quantize_weights", "quantize_leaf",
+           "activation_absmax", "weight_hbm_bytes"]
+
+#: the per-layer projection weights the PTQ harness quantizes, with the
+#: int4 pack axis of each STACKED [L, ...] array (the axis every fused
+#: kernel tile fully covers — see the module docstring)
+WQ_KEYS: Dict[str, int] = {
+    "q_proj": 1, "k_proj": 1, "v_proj": 1, "o_proj": 1,
+    "gate_proj": 1, "up_proj": 1, "down_proj": 2,
+}
+
+#: clip-factor grid for the activation-aware search (1.0 = plain absmax)
+_CLIP_GRID = (1.0, 0.95, 0.9, 0.85, 0.8, 0.7)
+
+
+def normalize_weight_quant(weight_quant) -> Optional[str]:
+    """Knob normalization: None/False -> None, 8/"int8" -> "int8",
+    4/"int4" -> "int4" — the one accepted vocabulary of every
+    ``weight_quant=`` argument."""
+    if weight_quant in (None, False, 0):
+        return None
+    if weight_quant in ("int8", 8, jnp.int8):
+        return "int8"
+    if weight_quant in ("int4", 4):
+        return "int4"
+    raise ValueError(
+        f"weight_quant must be None|int8|int4, got {weight_quant!r}")
+
+
+def weight_quant_mode(params) -> Optional[str]:
+    """The weight-quant mode a param tree carries (None | "int8" |
+    "int4"), read off the tree STRUCTURE — static at trace time, so
+    kernel dispatch metas can key on it."""
+    layers = params.get("layers") if isinstance(params, dict) else None
+    if not isinstance(layers, dict):
+        return None
+    for k in WQ_KEYS:
+        w = layers.get(k)
+        if isinstance(w, dict):
+            return "int4" if "qw4" in w else "int8"
+    return None
+
+
+def ensure_quantized(params, weight_quant):
+    """The engines' one entry point: -> (params, mode).
+
+    ``weight_quant`` None on a plain tree is a no-op; on a quantized
+    tree the carried mode is adopted. A set mode quantizes a plain
+    tree in one shot (host-side absmax) and validates an
+    already-quantized one — a tree quantized at int8 cannot silently
+    serve a requested int4 route."""
+    mode = normalize_weight_quant(weight_quant)
+    carried = weight_quant_mode(params)
+    if carried is not None:
+        if mode is not None and mode != carried:
+            raise ValueError(
+                f"params carry {carried} quantized weights but "
+                f"weight_quant={mode!r} was requested — requantize "
+                "from the original fp tree")
+        return params, carried
+    if mode is None:
+        return params, None
+    return quantize_weights(params, bits=8 if mode == "int8" else 4), \
+        mode
+
+
+def quantize_leaf(w, bits: int, pack_axis: int = 0) -> Dict:
+    """Quantize ONE weight array (2-D ``[in, out]`` or stacked
+    ``[L, in, out]``) to a quantized leaf dict — the building block
+    bench/tests use for hand-built kernel inputs. Per-output-channel
+    (last axis) f32 scales; int4 packs along ``pack_axis``."""
+    v = np.asarray(w, np.float32)
+    if bits == 8:
+        q, scale = _stacked_quantize(v, 127.0)
+        return {"qw8": jnp.asarray(q), "scale": jnp.asarray(scale)}
+    if bits != 4:
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    q, scale = _stacked_quantize(v, 7.0)
+    return {"qw4": jnp.asarray(pack_int4(q, axis=pack_axis)),
+            "scale": jnp.asarray(scale)}
+
+
+def _stacked_quantize(v: np.ndarray, qmax: float, clip=None):
+    """Symmetric per-(layer, output-channel) quantization of a 2-D or
+    leading-stacked array: scales reduce over the second-to-last axis
+    only (the contraction dim), keeping one f32 scale per output
+    channel per layer. ``clip`` optionally shrinks each channel's
+    absmax (the activation-aware search's knob)."""
+    absmax = np.abs(v).max(axis=-2)
+    if clip is not None:
+        absmax = absmax * clip
+    scale = (np.maximum(absmax, 1e-8) / qmax).astype(np.float32)
+    q = np.clip(np.round(v / scale[..., None, :]), -qmax, qmax) \
+        .astype(np.int8)
+    return q, scale
+
+
+def _clip_search(v: np.ndarray, qmax: float, act: np.ndarray):
+    """Per-output-channel clip-factor grid search minimizing the
+    activation-weighted quantization MSE. ``v`` [..., in, out]; ``act``
+    [in] input-channel absmax from the calibration prompt. Returns the
+    winning per-channel clip array shaped like the scale."""
+    a2 = (act.astype(np.float64) ** 2)[..., :, None]     # [in, 1]
+    best_err = None
+    best = np.ones(v.shape[:-2] + v.shape[-1:], np.float32)
+    for c in _CLIP_GRID:
+        q, scale = _stacked_quantize(v, qmax, clip=best * 0 + c)
+        deq = q.astype(np.float64) * scale[..., None, :]
+        err = ((v - deq) ** 2 * a2).sum(axis=-2)         # [..., out]
+        if best_err is None:
+            best_err = err
+        else:
+            win = err < best_err
+            best_err = np.where(win, err, best_err)
+            best = np.where(win, np.float32(c), best)
+    return best
+
+
+def quantize_weights(params: Dict, bits: int = 8,
+                     act_absmax: Optional[Dict] = None) -> Dict:
+    """One-shot PTQ of a llama-style param tree -> the quantized tree
+    (module-docstring format). Deterministic: the same fp tree always
+    produces byte-identical quantized arrays + scales.
+
+    ``act_absmax``: optional ``{key: [L, in] absmax}`` from
+    :func:`activation_absmax` — enables the per-channel clip search
+    (activation-aware absmax shrinking) for the keys it covers."""
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    if weight_quant_mode(params) is not None:
+        raise ValueError("params are already weight-quantized — "
+                         "requantize from the original fp tree")
+    qmax = 127.0 if bits == 8 else 7.0
+    out = dict(params)
+    layers = dict(params["layers"])
+    for key, pack_axis in WQ_KEYS.items():
+        w = layers.get(key)
+        if w is None:
+            continue
+        v = np.asarray(w, np.float32)
+        clip = None
+        if act_absmax is not None and key in act_absmax:
+            clip = _clip_search(v, qmax, np.asarray(act_absmax[key]))
+        q, scale = _stacked_quantize(v, qmax, clip=clip)
+        if bits == 8:
+            layers[key] = {"qw8": jnp.asarray(q),
+                           "scale": jnp.asarray(scale)}
+        else:
+            layers[key] = {"qw4": jnp.asarray(pack_int4(q, pack_axis)),
+                           "scale": jnp.asarray(scale)}
+    out["layers"] = layers
+    return out
+
+
+def activation_absmax(params: Dict, cfg, prompt) -> Dict:
+    """ONE dense fp forward over ``prompt`` capturing each projection's
+    input-channel absmax per layer — the "first prompt" of the
+    engines' int8-KV calibration idiom, pointed at weights. Returns
+    ``{key: np.ndarray [L, in]}`` for :func:`quantize_weights`'s
+    activation-aware clip search. Host-side and eager (runs once,
+    before any serving program exists)."""
+    from ..ops import rms_norm, swiglu
+    from ..ops.rope import apply_rope, build_rope_cache
+
+    toks = jnp.asarray(np.asarray(prompt, np.int32).reshape(1, -1))
+    S = toks.shape[1]
+    H, KV, hd = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                 cfg.head_dim)
+    sin, cos = build_rope_cache(S, cfg.head_dim, base=cfg.rope_theta)
+    x = jnp.take(params["embed_tokens"], toks, axis=0)
+    L = cfg.num_hidden_layers
+    keys = ("q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
+            "up_proj", "down_proj")
+    acc = {k: [] for k in keys}
+
+    def amax(t):
+        return np.asarray(jnp.max(jnp.abs(
+            t.astype(jnp.float32).reshape(-1, t.shape[-1])), axis=0))
+
+    for li in range(L):
+        lp = {k: v[li] for k, v in params["layers"].items()}
+        h = rms_norm(x, lp["input_norm"].astype(x.dtype),
+                     cfg.rms_norm_eps)
+        for k in ("q_proj", "k_proj", "v_proj"):
+            acc[k].append(amax(h))
+        b, s, _ = x.shape
+        q = (h @ lp["q_proj"]).reshape(b, s, H, hd)
+        k_ = (h @ lp["k_proj"]).reshape(b, s, KV, hd)
+        v_ = (h @ lp["v_proj"]).reshape(b, s, KV, hd)
+        q = apply_rope(q, sin, cos)
+        k_ = apply_rope(k_, sin, cos)
+        rep = H // KV
+        kk = jnp.repeat(k_, rep, axis=2)
+        vv = jnp.repeat(v_, rep, axis=2)
+        scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                            kk.astype(jnp.float32)) / math.sqrt(hd)
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        attn = jnp.einsum("bhst,bthd->bshd",
+                          jax.nn.softmax(scores, axis=-1),
+                          vv.astype(jnp.float32))
+        attn = attn.astype(x.dtype).reshape(b, s, H * hd)
+        acc["o_proj"].append(amax(attn))
+        x = x + attn @ lp["o_proj"]
+        h = rms_norm(x, lp["post_norm"].astype(x.dtype),
+                     cfg.rms_norm_eps)
+        acc["gate_proj"].append(amax(h))
+        acc["up_proj"].append(amax(h))
+        ff = swiglu(h @ lp["gate_proj"], h @ lp["up_proj"])
+        acc["down_proj"].append(amax(ff))
+        x = x + ff @ lp["down_proj"]
+    return {k: np.stack(v) for k, v in acc.items()}
+
+
+def weight_hbm_bytes(params: Dict) -> int:
+    """Bytes the per-layer projection weights (plus their scales)
+    stream through HBM each decode step — the serving_quant bench's
+    weight-bandwidth number."""
+    total = 0
+    layers = params.get("layers", {})
+    for k in WQ_KEYS:
+        w = layers.get(k)
+        if w is None:
+            continue
+        leaves = jax.tree_util.tree_leaves(w)
+        total += sum(int(np.prod(x.shape))
+                     * jnp.dtype(x.dtype).itemsize for x in leaves)
+    return total

@@ -15,7 +15,8 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, dispatch, to_value
 
 __all__ = ["fake_quant", "FakeQuanterWithAbsMax", "quantize_to_int8",
-           "int8_matmul"]
+           "quantize_to_int4", "pack_int4", "unpack_int4",
+           "dequantize_weight", "maybe_dequantize", "int8_matmul"]
 
 
 def _fake_quant_value(x, scale, qmax):
@@ -55,14 +56,102 @@ class FakeQuanterWithAbsMax:
         return fake_quant(x, self.observer.scale(), self.bits)
 
 
-def quantize_to_int8(w, axis: int = -1):
-    """Real per-channel int8 quantization → (w_int8, scale[float32])."""
-    v = np.asarray(to_value(w))
-    reduce_axes = tuple(i for i in range(v.ndim) if i != (axis % v.ndim))
-    absmax = np.abs(v).max(axis=reduce_axes, keepdims=True)
-    scale = np.maximum(absmax, 1e-8) / 127.0
-    q = np.clip(np.round(v / scale), -128, 127).astype(np.int8)
+def _channel_quantize(v: np.ndarray, axis: int, qmax: float):
+    """Shared symmetric per-channel quantizer body: FLAT f32 scales
+    along ``axis`` (the serving kernel contract — per-OUTPUT-channel,
+    no keepdims) and a symmetric [-qmax, qmax] integer range, so
+    ``dequant(q) = q * scale`` needs no zero point."""
+    ax = axis % v.ndim
+    reduce_axes = tuple(i for i in range(v.ndim) if i != ax)
+    absmax = np.abs(v).max(axis=reduce_axes)
+    scale = np.maximum(absmax, 1e-8) / qmax
+    sb = scale.reshape([-1 if i == ax else 1 for i in range(v.ndim)])
+    q = np.clip(np.round(v / sb), -qmax, qmax).astype(np.int8)
     return q, scale.astype(np.float32)
+
+
+def quantize_to_int8(w, axis: int = -1):
+    """Real per-channel int8 quantization → (w_int8, scale[float32]).
+
+    ``scale`` is FLAT along ``axis`` (per-output-channel for the
+    default ``axis=-1``) and the range is the symmetric [-127, 127] —
+    the fused dequant-matmul kernels' contract (their epilogue applies
+    ``* scale`` on the matmul result, which is only exact when the
+    scale is purely per-output-channel with no zero point)."""
+    return _channel_quantize(np.asarray(to_value(w), np.float32),
+                             axis, 127.0)
+
+
+def quantize_to_int4(w, axis: int = -1):
+    """Per-channel symmetric int4 quantization → (q[int8 in -7..7],
+    scale[float32] flat along ``axis``). The values ride UNPACKED in an
+    int8 array; :func:`pack_int4` packs two per byte for storage."""
+    return _channel_quantize(np.asarray(to_value(w), np.float32),
+                             axis, 7.0)
+
+
+def pack_int4(q, axis: int = 0) -> np.ndarray:
+    """Pack int4 values (int8 arrays in [-8, 7]) two per byte along
+    ``axis``: the FIRST half of the axis rides in the low nibble, the
+    SECOND half in the high nibble (``byte = (hi << 4) | (lo & 0xF)``).
+    Halves — not interleaved pairs — so the kernels' in-register unpack
+    is a single concatenate, never a relayout. The axis length must be
+    even."""
+    v = np.asarray(to_value(q), np.int8)
+    ax = axis % v.ndim
+    n = v.shape[ax]
+    if n % 2:
+        raise ValueError(f"pack_int4: axis {ax} length {n} is odd — "
+                         "int4 packing pairs the two axis halves")
+    lo, hi = np.split(v.astype(np.int32), 2, axis=ax)
+    return ((hi << 4) | (lo & 0xF)).astype(np.int8)
+
+
+def unpack_int4(packed, axis: int = 0):
+    """Inverse of :func:`pack_int4` → int8-valued int4 pairs, halves
+    concatenated back along ``axis``. jnp-traceable (arithmetic shifts
+    sign-extend both nibbles), so the unfused dequantize-then-matmul
+    fallback and the in-kernel unpack share THIS definition."""
+    p32 = jnp.asarray(packed).astype(jnp.int32)
+    # explicitly-typed shift amounts: under the global x64 flag a bare
+    # python literal promotes to i64 and the mixed-width shift fails
+    # verification (the ops/pallas no_x64 class)
+    c28 = jnp.full(p32.shape, 28, jnp.int32)
+    c4 = jnp.full(p32.shape, 4, jnp.int32)
+    lo = jax.lax.shift_right_arithmetic(
+        jax.lax.shift_left(p32, c28), c28)
+    hi = jax.lax.shift_right_arithmetic(p32, c4)
+    return jnp.concatenate([lo, hi], axis=axis).astype(jnp.int8)
+
+
+def dequantize_weight(w: dict, dtype=None):
+    """Dequantize one quantized weight leaf ``{"qw8"|"qw4": q,
+    "scale": s}`` back to a dense array — the priority-0
+    dequantize-then-matmul building block.
+
+    The scale is per-OUTPUT-channel and the output channel is always
+    the LAST axis; int4 packing is along the second-to-last axis (the
+    contraction dim) unless the byte count shows the last axis was
+    halved (down_proj packs its output axis, whose tiles the MLP
+    kernel's intermediate-dim grid never splits). ``dtype`` casts the
+    result (the model dtype); None keeps f32."""
+    scale = jnp.asarray(w["scale"], jnp.float32)
+    if "qw4" in w:
+        q = jnp.asarray(w["qw4"])
+        axis = -1 if q.shape[-1] * 2 == scale.shape[-1] else -2
+        q = unpack_int4(q, axis=axis)
+    else:
+        q = jnp.asarray(w["qw8"])
+    deq = q.astype(jnp.float32) * scale[..., None, :]
+    return deq if dtype is None else deq.astype(dtype)
+
+
+def maybe_dequantize(w, dtype):
+    """Array-or-quantized-leaf normalization: plain arrays pass
+    through; quantized leaves dequantize to ``dtype``. The ONE helper
+    every unfused matmul site uses, so the fallback route is
+    dequantize-then-matmul by construction everywhere."""
+    return dequantize_weight(w, dtype) if isinstance(w, dict) else w
 
 
 def int8_matmul(x_int8, w_int8, x_scale, w_scale):

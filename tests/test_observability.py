@@ -87,6 +87,10 @@ BASE_KEYS = {
     # r17: fused prefill-block dispatch report + the bucket-pad rows
     # fed to prefill chunks (the compute the ragged fused kernels skip)
     "prefill_variant", "prefill_pad_tokens",
+    # r18: weight-quantization dispatch report ({"mode": "off"} on fp
+    # engines; mode/weight_dtype/attn/mlp on weight_quant engines —
+    # trace-time snapshot, the decode_variant contract)
+    "weight_quant_variant",
 }
 OBS_KEYS = {"latency", "gauges", "retrace_warnings", "stall_dumps",
             "timeline_events", "timeline_dropped"}

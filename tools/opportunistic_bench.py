@@ -58,6 +58,7 @@ PACK = [
     ("serving_engine", 1200, 2),
     ("serving_prefix_cache", 1200, 2),
     ("serving_prefill", 1200, 2),
+    ("serving_quant", 1200, 2),
     # forced-host CPU: structure/parity evidence, cheap and tunnel-proof
     ("serving_tp", 900, 2),
     ("serving_disagg", 900, 2),
