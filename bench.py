@@ -769,6 +769,34 @@ def bench_serving_engine():
                   "unfused_decode_step_ms": unfused_ms,
                   **({"fused_decode_speedup": round(u50 / f50, 3)}
                      if f50 and u50 else {})}
+            # third arm: when auto dispatch serves the single-launch
+            # block kernel, pin the two-kernel composition so the
+            # capture carries block vs two-kernel vs unfused — guarded
+            # on the dispatched variant (pinning "block" where the
+            # combined windows exceed the scoped-VMEM envelope would
+            # just OOM the compile, and auto never runs it there)
+            if eng.decode_variant.get("block") == "pallas_block":
+                eng_2k = ServingEngine(params, cfg, capacity=cap,
+                                       block_size=16,
+                                       max_seq_len=ctx + gen_n,
+                                       cache_dtype=cdt,
+                                       prefill_buckets=(ctx,),
+                                       observability=True,
+                                       fused_decode="pallas")
+                eng_2k.submit(prompts[0],
+                              GenerationConfig(max_new_tokens=2,
+                                               greedy=True))
+                eng_2k.drain()   # compile outside the measured burst
+                two_ms = _burst_decode_ms(eng_2k)
+                ab["two_kernel_decode_step_ms"] = two_ms
+                b50, t50 = fused_ms.get("p50"), two_ms.get("p50")
+                if b50 and t50:
+                    ab["block_vs_two_kernel_speedup"] = \
+                        round(t50 / b50, 3)
+            else:
+                ab["block_arm"] = ("skipped: dispatch -> "
+                                   + str(eng.decode_variant
+                                         .get("block")))
         except Exception as e:  # noqa: BLE001 — A/B is evidence, not
             ab = {"error": f"{type(e).__name__}: {e}"[:200]}  # the bench
 
@@ -1764,7 +1792,8 @@ def bench_flash_tune():
     # compiler, sweeping a key no traced program ever reads). int8
     # pools are a distinct shape class with their own cache key.
     from paddle_tpu.ops.pallas.fused_decode_block import (
-        decode_meta_dims, fused_attn_block_pallas, fused_mlp_block_pallas)
+        decode_meta_dims, fused_attn_block_pallas,
+        fused_decode_block_pallas, fused_mlp_block_pallas)
     from paddle_tpu.ops.pallas.registry import KERNELS
     from paddle_tpu.ops.pallas.paged_attention import (
         paged_attention_decode_pallas)
@@ -1899,6 +1928,50 @@ def bench_flash_tune():
                            _ptq.quantize_leaf(wu, wq_bits),
                            _ptq.quantize_leaf(wd, wq_bits,
                                               pack_axis=1)))
+        # single-launch decode-block tunables: the combined kernel's
+        # (pages_per_step, block_f) is ONE joint autotune key — swept
+        # per MB page-count class AND per weight class (plain / int8 /
+        # int4 tiles are distinct cache keys via weight_dtype), guarded
+        # on registry dispatch like every sweep above (past the
+        # combined scoped-VMEM envelope the registry serves the
+        # two-kernel composition, so no traced program ever reads the
+        # block key — and the eager call would just VMEM-OOM)
+        pw_ = jnp.ones((D,), dt)
+        for MB in MBs:
+            Tb = BS * MB
+            kpb = jax.random.normal(ks[1], (B * MB, BS, KV, hd), dt)
+            vpb = jax.random.normal(ks[2], (B * MB, BS, KV, hd), dt)
+            btb = jnp.arange(B * MB, dtype=jnp.int32).reshape(B, MB)
+            slb = jnp.full((B,), Tb - 2, jnp.int32)
+            angb = (np.arange(Tb)[:, None]
+                    / (10000.0 ** (np.arange(0, hd, 2) / hd)))
+            sinb = jnp.asarray(np.sin(angb), dt)
+            cosb = jnp.asarray(np.cos(angb), dt)
+            for bwq_name in (None, "int8", "int4"):
+                m = decode_meta_dims(B, D, H, KV, hd, 4 * D, BS, MB,
+                                     dt, dt, False,
+                                     weight_dtype=bwq_name)
+                btag = (f"{B}x{H}x{KV}x{hd}x{jnp.dtype(dt).name}"
+                        f"{'x' + bwq_name + 'w' if bwq_name else ''}"
+                        f"xMB{MB}")
+                sel_name, _ = KERNELS.dispatch("decode_block_fused", m)
+                if sel_name != "pallas_block":
+                    decode_tuned[f"fused_block|{btag}"] = \
+                        f"skipped: dispatch -> {sel_name}"
+                    continue
+                if bwq_name:
+                    bits = 8 if bwq_name == "int8" else 4
+                    bw = [_ptq.quantize_leaf(w_, bits)
+                          for w_ in (wq, wk, wv, wo, wg, wu)]
+                    bw.append(_ptq.quantize_leaf(wd, bits,
+                                                 pack_axis=1))
+                else:
+                    bw = [wq, wk, wv, wo, wg, wu, wd]
+                _sweep(f"fused_block|{btag}",
+                       lambda: fused_decode_block_pallas(
+                           x, nw, bw[0], bw[1], bw[2], bw[3], pw_,
+                           bw[4], bw[5], bw[6], sinb, cosb, kpb,
+                           vpb, btb, slb)[0])
         # fused-prefill tunables ((block_q, pages_per_step) pairs) at
         # the serving bucket widths — the engine's chunk runners are
         # traced and only READ the table; dispatch-guarded like the
@@ -2265,6 +2338,46 @@ def bench_kernels():
                jax.jit(fused_mlp_block_pallas), jax.jit(mlp_block_ref),
                fx, fnw, qwg, qwu, qwd, tol=5e-2,
                bytes_moved=int(3 * FD * FF * wbytes) + 2 * FB * FD * 2)
+
+    # ---- single-launch decode block vs the two-kernel composition ------
+    # the WHOLE block in one launch (RMSNorm+QKV+RoPE+paged attn+o_proj
+    # +residual+RMSNorm+SwiGLU+residual, residual in f32 VMEM scratch)
+    # vs the priority-0 composed route — the exact two-stage sequence
+    # the registry would otherwise serve. Dispatch-guarded at the bench
+    # shape: past the combined scoped-VMEM envelope the compile would
+    # just VMEM-OOM, and no traced program runs the block kernel there
+    # anyway. Feeds the same kernel_bench_gate trajectory.
+    from paddle_tpu.ops.pallas.fused_decode_block import (
+        decode_block_composed, decode_meta_dims, fused_decode_block_pallas)
+    from paddle_tpu.ops.pallas.registry import KERNELS as _KERNELS
+    fpw = jnp.ones((FD,), jnp.bfloat16)
+    for blk_tag, blk_wq, blk_bits, blk_wb in (
+            ("", None, 0, 2.0), ("_w8", "int8", 8, 1.0),
+            ("_w4", "int4", 4, 0.5)):
+        bm = decode_meta_dims(FB, FD, FH, FKV, Fhd, FF, FBS, FMB,
+                              jnp.bfloat16, jnp.bfloat16, False,
+                              weight_dtype=blk_wq)
+        sel_name, _ = _KERNELS.dispatch("decode_block_fused", bm)
+        if sel_name != "pallas_block" and not interp:
+            res["cases"][f"decode_block_fused{blk_tag}"] = {
+                "skipped": f"dispatch -> {sel_name}"}
+            continue
+        if blk_wq:
+            bws = [_ptq.quantize_leaf(w_, blk_bits)
+                   for w_ in (fwq, fwk, fwv, fwo, fwg, fwu)]
+            bws.append(_ptq.quantize_leaf(fwd_, blk_bits, pack_axis=1))
+        else:
+            bws = [fwq, fwk, fwv, fwo, fwg, fwu, fwd_]
+        # all seven weight tiles once + the live KV pages + residual I/O
+        blk_bytes = int((2 * FD * FH * Fhd + 2 * FD * FKV * Fhd
+                         + 3 * FD * FF) * blk_wb) \
+            + fused_live * FBS * FKV * Fhd * 2 * 2 + 2 * FB * FD * 2
+        record(f"decode_block_fused{blk_tag}",
+               jax.jit(lambda *a: fused_decode_block_pallas(*a)[0]),
+               jax.jit(lambda *a: decode_block_composed(*a)[0]),
+               fx, fnw, bws[0], bws[1], bws[2], bws[3], fpw, bws[4],
+               bws[5], bws[6], fsin, fcos, fkp, fvp, ftab, flens,
+               tol=5e-2, bytes_moved=blk_bytes)
 
     # ---- fused prefill-block megakernel (ragged chunked prefill) -------
     # one transformer block's prefill chunk (warm mid-window start,

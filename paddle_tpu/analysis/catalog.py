@@ -29,6 +29,7 @@ __all__ = ["build_catalog", "build_demo_regression",
 CATALOG_PROGRAMS = ("train_step", "train_step_fused",
                     "fused_optimizer_step",
                     "serving_decode", "serving_decode_fused",
+                    "serving_decode_block",
                     "serving_decode_wq",
                     "serving_prefill_16", "serving_prefill_32",
                     "serving_prefill_fused",
@@ -142,6 +143,14 @@ def _serving_specs(register: bool):
                               fused_decode="pallas")
     fused = [s for s in fused_eng.program_specs(register=False)
              if s.name == "serving_decode_fused"]
+    # the SINGLE-LAUNCH decode-block program the same way: a forced
+    # fused_decode="block" engine pins the whole-block megakernel, so
+    # the audited jaxpr contains the single-launch kernel even on CPU
+    block_eng = ServingEngine(params, cfg, capacity=2, block_size=8,
+                              max_seq_len=64, prefill_buckets=(16,),
+                              fused_decode="block")
+    fused += [s for s in block_eng.program_specs(register=False)
+              if s.name == "serving_decode_block"]
     # the fused PREFILL chunk the same way: a forced-pallas-prefill
     # engine's bucket program, renamed to its catalog entry (the
     # audited jaxpr contains the prefill megakernels even on CPU)
@@ -331,7 +340,7 @@ def build_catalog(names: Optional[List[str]] = None,
     if "fused_optimizer_step" in wanted:
         specs.append(_fused_optimizer_spec(register))
     if wanted & {"serving_decode", "serving_decode_fused",
-                 "serving_decode_wq",
+                 "serving_decode_block", "serving_decode_wq",
                  "serving_prefill_16", "serving_prefill_32",
                  "serving_prefill_fused", "serving_page_copy"}:
         specs.extend(s for s in _serving_specs(register)

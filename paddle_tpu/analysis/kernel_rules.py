@@ -293,8 +293,22 @@ def _vmem_findings(spec, program, memo) -> List[Finding]:
     probed on BOTH the zero and the ramp sample tables — on the
     all-zero table every page fetch collapses to page 0 and a streamed
     pool operand would wrongly look like a resident constant. Σ must
-    fit the scoped-VMEM envelope."""
+    fit the scoped-VMEM envelope.
+
+    Combined multi-window launches (the single-launch decode block:
+    resident attention weights + streamed MLP tiles in ONE grid) are
+    additionally held to the dispatch-budget side of the
+    :func:`scoped_vmem_envelope` contract: the RESIDENT share alone
+    (1-window operands + scratch — what stays in VMEM for the whole
+    launch, unpipelined by construction) must fit the per-launch
+    dispatch budget, so a kernel cannot satisfy the envelope by
+    streaming its tiles while its resident set already exceeds what
+    its supports() predicate budgeted for weights. A launch with no
+    streamed operand keeps the historic contract — it is wholly
+    resident and the envelope alone bounds it."""
     need = 0
+    resident = 0
+    streams = False
     parts = []
     for kind, ops in (("in", spec.inputs), ("out", spec.outputs)):
         for i, op in enumerate(ops):
@@ -316,16 +330,23 @@ def _vmem_findings(spec, program, memo) -> List[Finding]:
                         distinct |= set(ramped.values())
                 windows = 2 if len(distinct) > 1 else 1
             need += windows * nbytes
+            if windows == 1:
+                resident += nbytes
+            else:
+                streams = True
             if windows * nbytes >= (64 << 10):
                 parts.append(f"{kind}{i}:{windows}x{nbytes >> 10}KiB")
     for shape, dtype, space in spec.scratch:
         if space == "smem":
             continue
-        need += int(np.prod(shape or (1,), dtype=np.int64)) \
+        sbytes = int(np.prod(shape or (1,), dtype=np.int64)) \
             * _itemsize(dtype)
+        need += sbytes
+        resident += sbytes
+    out: List[Finding] = []
     envelope = scoped_vmem_envelope(spec.vmem_budget)
     if need > envelope:
-        return [_finding(
+        out.append(_finding(
             program, "VMEM_OVERCOMMIT", "error",
             f"{spec.name}/windows",
             (f"{spec.name}: pipelined VMEM windows total "
@@ -337,8 +358,25 @@ def _vmem_findings(spec, program, memo) -> List[Finding]:
             {"kernel": spec.name, "need_bytes": need,
              "envelope_bytes": envelope,
              "fused_budget_bytes": spec.vmem_budget,
-             "windows": parts})]
-    return []
+             "windows": parts}))
+    if streams and spec.vmem_budget and resident > int(spec.vmem_budget):
+        # the dispatch-budget half of the envelope contract: the
+        # resident share (constant-index operands + scratch — held for
+        # the WHOLE launch, so pipelining cannot hide it) must fit the
+        # budget the kernel's supports() predicate dispatched against
+        out.append(_finding(
+            program, "VMEM_OVERCOMMIT", "error",
+            f"{spec.name}/resident",
+            (f"{spec.name}: resident VMEM share (constant windows + "
+             f"scratch) totals ~{resident >> 20}MiB > the "
+             f"{int(spec.vmem_budget) >> 20}MiB per-launch dispatch "
+             "budget — the launch-long resident set exceeds what the "
+             "dispatch predicate budgeted; stream the oversized "
+             "operand or shrink the resident tiles"),
+            {"kernel": spec.name, "resident_bytes": resident,
+             "fused_budget_bytes": spec.vmem_budget,
+             "windows": parts}))
+    return out
 
 
 def _scratch_findings(spec, program) -> List[Finding]:

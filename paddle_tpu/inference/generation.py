@@ -272,9 +272,9 @@ def _fused_mode(fused_decode):
         return False
     if fused_decode is True:
         return "auto"
-    if fused_decode in ("auto", "pallas", "ref"):
+    if fused_decode in ("auto", "pallas", "ref", "block"):
         return fused_decode
-    raise ValueError(f"fused_decode must be bool|auto|pallas|ref, "
+    raise ValueError(f"fused_decode must be bool|auto|pallas|ref|block, "
                      f"got {fused_decode!r}")
 
 
@@ -415,18 +415,22 @@ def _paged_chunk_runner(cfg, gen, quant=False, fused=False, sm=None,
     # thread-local pin at trace time, so a program traced inside a
     # KERNELS.force(...) block must not be replayed for unpinned calls
     if fused:
-        from ..ops.pallas.fused_decode_block import _vmem_budget
+        from ..ops.pallas.fused_decode_block import (_vmem_budget,
+                                                     scoped_vmem_budget)
         from ..ops.pallas.registry import KERNELS
         from ..ops.pallas._util import interpret_mode
         # every trace-time input that can reshape the program: the pin
         # stack (consulted by dispatch in "auto" mode only), the VMEM
         # budget (reshapes the supports predicates AND the fused MLP's
         # block_f candidate list, which forced "pallas" mode still
-        # reads) and the interpret override (flips pallas variants off
-        # in "auto", flips interpret compilation in forced modes)
+        # reads), the scoped envelope (reshapes the single-launch
+        # kernel's combined-window predicate + block_f pairs) and the
+        # interpret override (flips pallas variants off in "auto",
+        # flips interpret compilation in forced modes)
         pins = (KERNELS.forced_state() if fused in ("auto", True)
                 else ())
-        route = (pins, _vmem_budget(), bool(interpret_mode()))
+        route = (pins, _vmem_budget(), scoped_vmem_budget(),
+                 bool(interpret_mode()))
     else:
         route = ()
     ck = (dataclasses.astuple(cfg), dataclasses.astuple(gen),
@@ -550,20 +554,24 @@ def _fused_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
                        seq_lens, kv_scales=None, mode="auto"):
     """``_paged_decode_step`` through the fused decode-block kernels.
 
-    Per block, instead of ~6 separate programs: ONE fused attention
-    kernel (RMSNorm + QKV + RoPE + paged attention incl. the new token
-    + o_proj + residual), the pool append for the new token's K/V, and
-    ONE fused MLP kernel (RMSNorm + SwiGLU + residual). Variant choice
-    (Pallas megakernel vs the bit-identical unfused composition) comes
-    from the kernel registry at trace time; ``mode`` forwards to
+    Per block, instead of ~6 separate programs: either ONE single-launch
+    megakernel for the whole block (attn + MLP, the residual handoff in
+    VMEM — where ``decode_block_fused`` dispatches, or mode="block"
+    forces it) with the pool append in between left exactly where it is
+    today, or the two-stage route: ONE fused attention kernel (RMSNorm
+    + QKV + RoPE + paged attention incl. the new token + o_proj +
+    residual), the pool append for the new token's K/V, and ONE fused
+    MLP kernel (RMSNorm + SwiGLU + residual). Variant choice (Pallas
+    megakernel(s) vs the bit-identical unfused composition) comes from
+    the kernel registry at trace time; ``mode`` forwards to
     :func:`paddle_tpu.ops.pallas.fused_decode_block
-    .resolve_decode_blocks`. Signature and carried state match
+    .resolve_decode_step`. Signature and carried state match
     ``_paged_decode_step`` exactly, so callers swap freely.
     """
     from ..ops import rms_norm as fused_rms_norm
     from ..ops.paged_attention import write_to_pool, write_to_pool_quant
     from ..ops.pallas.fused_decode_block import (decode_meta,
-                                                 resolve_decode_blocks)
+                                                 resolve_decode_step)
 
     B = tok.shape[0]
     meta = decode_meta(cfg, B=B, BS=k_pools.shape[2],
@@ -571,7 +579,7 @@ def _fused_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
                        pool_dtype=k_pools.dtype,
                        quant=kv_scales is not None,
                        weight_dtype=_wq_mode(params))
-    attn_fn, mlp_fn, _ = resolve_decode_blocks(meta, mode)
+    block_fn, attn_fn, mlp_fn, _ = resolve_decode_step(meta, mode)
     x = jnp.take(params["embed_tokens"], tok, axis=0)        # [B, D]
     sin, cos = build_rope_cache(cfg.max_position_embeddings,
                                 cfg.head_dim, base=cfg.rope_theta)
@@ -583,10 +591,21 @@ def _fused_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
         else:
             lp, kp, vp, ksc, vsc = xs
             scales = (ksc, vsc)
-        x, k_new, v_new = attn_fn(
-            x, lp["input_norm"].astype(x.dtype), lp["q_proj"],
-            lp["k_proj"], lp["v_proj"], lp["o_proj"], sin, cos, kp, vp,
-            block_tables, seq_lens, scales, cfg.rms_norm_eps)
+        if block_fn is not None:
+            # one launch per block; the pool write stays with the
+            # caller (the megakernel's MLP phase reads no pool state,
+            # so writing after it is the same math as between stages)
+            x, k_new, v_new = block_fn(
+                x, lp["input_norm"].astype(x.dtype), lp["q_proj"],
+                lp["k_proj"], lp["v_proj"], lp["o_proj"],
+                lp["post_norm"].astype(x.dtype), lp["gate_proj"],
+                lp["up_proj"], lp["down_proj"], sin, cos, kp, vp,
+                block_tables, seq_lens, scales, cfg.rms_norm_eps)
+        else:
+            x, k_new, v_new = attn_fn(
+                x, lp["input_norm"].astype(x.dtype), lp["q_proj"],
+                lp["k_proj"], lp["v_proj"], lp["o_proj"], sin, cos, kp,
+                vp, block_tables, seq_lens, scales, cfg.rms_norm_eps)
         if scales is None:
             kp, vp = write_to_pool(kp, vp, block_tables, seq_lens,
                                    k_new.astype(kp.dtype),
@@ -594,8 +613,10 @@ def _fused_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
         else:
             kp, vp = write_to_pool_quant(kp, vp, block_tables, seq_lens,
                                          k_new, v_new, ksc, vsc)
-        x = mlp_fn(x, lp["post_norm"].astype(x.dtype), lp["gate_proj"],
-                   lp["up_proj"], lp["down_proj"], cfg.rms_norm_eps)
+        if block_fn is None:
+            x = mlp_fn(x, lp["post_norm"].astype(x.dtype),
+                       lp["gate_proj"], lp["up_proj"], lp["down_proj"],
+                       cfg.rms_norm_eps)
         return x, (kp, vp)
 
     scan_xs = (params["layers"], k_pools, v_pools) if kv_scales is None \
@@ -607,6 +628,23 @@ def _fused_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
     if head is None:
         head = params["embed_tokens"].T
     return x @ head, k_pools, v_pools
+
+
+def _decode_variant_name(cfg, B, BS, MB, pool_dtype, quant, fused,
+                         wq=None, tp=1):
+    """The kernel variant one decode step's trace would select — a
+    single attribution string for the decode_step timeline events
+    (mirroring the prefill chunk's ``variant`` stamp): "pallas_block"
+    (single-launch megakernel), "pallas_fused" (two-stage megakernels)
+    or "unfused" (the building-block composition)."""
+    if not fused:
+        return "unfused"
+    from ..ops.pallas.fused_decode_block import (decode_meta,
+                                                 resolve_decode_step)
+    meta = decode_meta(cfg, B=B, BS=BS, MB=MB, pool_dtype=pool_dtype,
+                       quant=quant, tp=tp, weight_dtype=wq)
+    block_fn, _, _, names = resolve_decode_step(meta, fused)
+    return names["block"] if block_fn is not None else names["attn"]
 
 
 _FUSED_PREFILL_CACHE: Dict = {}
@@ -877,6 +915,10 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
         obs.sample_gauges(_time.perf_counter(), {
             "pages_free": len(mgr.free),
             "pages_in_use": num_blocks - len(mgr.free)})
+        dv = _decode_variant_name(cfg, B, BS, MB, k_pools.dtype,
+                                  kv_scales is not None, fused,
+                                  wq=wq_mode,
+                                  tp=(sm.tp if sm is not None else 1))
     while left > 0:
         n = min(chunk, left)
         t0 = _time.perf_counter() if obs is not None else 0.0
@@ -887,7 +929,8 @@ def generate_paged(params: Dict, input_ids, cfg: _llama.LlamaConfig,
             dur = (_time.perf_counter() - t0) * 1e3
             obs.hist("decode_step_ms").observe(dur / n)
             obs.timeline.record("decode_step", dur_ms=dur,
-                                live_slots=B, tokens=int(n * B))
+                                live_slots=B, tokens=int(n * B),
+                                decode_variant=dv)
         chunks.append(toks.transpose(1, 0))  # [n, B] -> [B, n]
         left -= n
     toks = jnp.concatenate(chunks, axis=1)
@@ -1037,6 +1080,9 @@ def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
     k_pools, v_pools = store.k_pools, store.v_pools
     chunk = max(1, int(os.environ.get("PADDLE_TPU_DECODE_CHUNK", "32")))
     left = gen.max_new_tokens - 1
+    if obs is not None:
+        dv = _decode_variant_name(cfg, B, BS, MB, k_pools.dtype, False,
+                                  fused, wq=wq)
     while left > 0:
         n = min(chunk, left)
         if obs is not None:
@@ -1048,7 +1094,8 @@ def _generate_paged_prefix(params, input_ids, cfg, gen, block_size,
             dur = (_time.perf_counter() - t0) * 1e3
             obs.hist("decode_step_ms").observe(dur / n)
             obs.timeline.record("decode_step", dur_ms=dur,
-                                live_slots=B, tokens=int(n * B))
+                                live_slots=B, tokens=int(n * B),
+                                decode_variant=dv)
         chunks.append(toks.transpose(1, 0))
         left -= n
     store.k_pools, store.v_pools = k_pools, v_pools

@@ -251,6 +251,15 @@ class ServingEngine:
                     'collective="gather" — that placement runs the '
                     "exact unfused composition (its bit-parity "
                     'contract); use collective="psum" or drop the pin')
+            if _fused_mode(fused_decode) == "block":
+                # same never-silently-no-op rule: the single-launch
+                # block kernel is single-device (its supports() rejects
+                # tp != 1, and the sharded decode body runs the
+                # per-stage kernels)
+                raise ValueError(
+                    'fused_decode="block" is single-device: the '
+                    "single-launch decode-block kernel runs outside "
+                    "shard_map — drop the mesh or the pin")
             params = self._mesh.shard(
                 params, self._mesh.param_specs(cfg, params))
         self.params = params
@@ -738,15 +747,15 @@ class ServingEngine:
 
     def _resolve_variant(self) -> Dict:
         from ..ops.pallas.fused_decode_block import (decode_meta,
-                                                     resolve_decode_blocks)
+                                                     resolve_decode_step)
         from ..ops.pallas.fused_decode_block import decode_meta_dims
         sm = self._mesh
         if sm is not None and sm.collective == "gather":
             # the gather placement's bit-parity contract IS the
             # single-device op sequence — it always runs the exact
             # composition, whatever the fused knob says
-            return {"mode": str(self._fused), "attn": "unfused",
-                    "mlp": "unfused"}
+            return {"mode": str(self._fused), "block": "composed",
+                    "attn": "unfused", "mlp": "unfused"}
         cfg, tp = self.cfg, (1 if sm is None else sm.tp)
         if tp == 1:
             meta = decode_meta(cfg, B=self.capacity,
@@ -765,21 +774,24 @@ class ServingEngine:
                 cfg.intermediate_size // tp, self.block_size,
                 self.max_blocks, cfg.dtype, self._k_pools.dtype,
                 self._quant, tp=tp, weight_dtype=self._wq)
-        _, _, names = resolve_decode_blocks(meta, self._fused)
+        _, _, _, names = resolve_decode_step(meta, self._fused)
         return {"mode": str(self._fused), **names}
 
     @property
     def decode_variant(self) -> Dict:
         """Which decode-block implementation this engine's decode
-        program runs: ``{"mode": ..., "attn": ..., "mlp": ...}``.
-        Captured when the decode program TRACES (dispatch is consulted
-        at trace time), so later env changes — the VMEM budget, a
-        ``KERNELS.force`` pin around a ``metrics()`` call — cannot make
-        the report drift from the compiled program. Before the first
-        decode step it reports what dispatch would pick now."""
+        program runs: ``{"mode": ..., "block": ..., "attn": ...,
+        "mlp": ...}`` — "block" is the single-launch megakernel's slot
+        ("pallas_block" when it serves the step, "composed" when the
+        two-stage route does). Captured when the decode program TRACES
+        (dispatch is consulted at trace time), so later env changes —
+        the VMEM budget, a ``KERNELS.force`` pin around a ``metrics()``
+        call — cannot make the report drift from the compiled program.
+        Before the first decode step it reports what dispatch would
+        pick now."""
         if not self._fused:
-            return {"mode": "unfused", "attn": "unfused",
-                    "mlp": "unfused"}
+            return {"mode": "unfused", "block": "composed",
+                    "attn": "unfused", "mlp": "unfused"}
         if self._decode_variant is not None:
             return dict(self._decode_variant)
         return self._resolve_variant()
@@ -798,7 +810,8 @@ class ServingEngine:
             return {"mode": "off"}
         v = self.decode_variant
         return {"mode": self._wq, "weight_dtype": self._wq,
-                "attn": v["attn"], "mlp": v["mlp"]}
+                "block": v["block"], "attn": v["attn"],
+                "mlp": v["mlp"]}
 
     @property
     def idle(self) -> bool:
@@ -1387,8 +1400,15 @@ class ServingEngine:
             # latency without adding any device round-trip
             dur_ms = (time.perf_counter() - t0) * 1e3
             self._obs.hist("decode_step_ms").observe(dur_ms)
+            # per-variant attribution, mirroring the prefill chunk's
+            # ``variant`` stamp: which decode-block implementation
+            # served this step (tools/trace_summary.py --mode serving)
+            v = self.decode_variant
+            dv = v["block"] if v["block"] == "pallas_block" \
+                else v["attn"]
             self._obs.timeline.record("decode_step", dur_ms=dur_ms,
-                                      live_slots=len(live))
+                                      live_slots=len(live),
+                                      decode_variant=dv)
         for i in live:
             slot = self._slots[i]
             req = slot.req
@@ -1807,7 +1827,10 @@ class ServingEngine:
         axes = (sm.axis,) if sm is not None else ()
         tags = ("serving",) + (("tp",) if sm is not None else ())
         decode_name = ("serving_decode_fused"
-                       if self._fused in ("pallas",) else "serving_decode")
+                       if self._fused in ("pallas",)
+                       else "serving_decode_block"
+                       if self._fused in ("block",)
+                       else "serving_decode")
         # a forced-pallas-PREFILL engine registers its bucket programs
         # under their own name the same way (the audit gate covers the
         # fused chunk next to, not instead of, the default program)

@@ -327,6 +327,15 @@ def _tp_decode_step(params, tok, cfg, k_pools, v_pools, block_tables,
                                                  resolve_decode_blocks)
     from ..ops.rope import build_rope_cache
 
+    if fused == "block":
+        # the single-launch block kernel is single-device by contract
+        # (its supports() rejects tp != 1); a forced "block" under a
+        # mesh is a configuration error, not a silent fallback —
+        # checked before the axis-env lookup so the error fires even
+        # outside shard_map
+        raise ValueError("fused_decode='block' is single-device: "
+                         "tensor-parallel decode runs the per-stage "
+                         "kernels")
     # static axis-env lookup (jax_compat): NO collective may be emitted
     # here — the audited jaxpr carries exactly the declared collectives
     tp = int(axis_size(axis))

@@ -17,6 +17,18 @@ in VMEM between stages:
 - ``decode_mlp_block``: post-attention RMSNorm + gated MLP (SwiGLU)
   + residual, tiled over the intermediate dim so the weight working set
   fits VMEM at any model width (block size autotuned).
+- ``decode_block_fused``: the SINGLE-LAUNCH block kernel — both stages
+  above in ONE grid (attention page steps first, MLP intermediate
+  tiles after), with the attn->MLP residual held in f32 VMEM scratch
+  so it never round-trips HBM between the stages. Legal only where the
+  COMBINED weight windows (resident attention tiles + double-buffered
+  MLP tiles, at the worst-case pages-per-step and block_f candidates)
+  fit the scoped-VMEM envelope (``PADDLE_TPU_SCOPED_VMEM_BUDGET``,
+  default 16 MiB) — which the int8/int4 weight_dtype classes of PR 15
+  made true at the flagship serving shapes while plain bf16 flagship
+  weights still fall back to the two-kernel route above. Priority 0 is
+  the exact two-stage sequence (``decode_block_composed``), so every
+  fallback tier stays bit-identical to the route it replaces.
 
 The weights of one block ride resident in VMEM (constant-index blocks
 are fetched once per kernel invocation), so fusion is only legal where
@@ -54,10 +66,12 @@ from .registry import KERNELS
 
 __all__ = [
     "fused_attn_block_pallas", "fused_mlp_block_pallas",
+    "fused_decode_block_pallas", "decode_block_composed",
     "attn_block_ref", "mlp_block_ref", "decode_meta",
     "decode_meta_dims",
-    "resolve_decode_blocks", "mlp_autotune_key", "attn_autotune_key",
-    "weight_dtype_of",
+    "resolve_decode_blocks", "resolve_decode_step",
+    "mlp_autotune_key", "attn_autotune_key", "block_autotune_key",
+    "weight_dtype_of", "scoped_vmem_budget",
 ]
 
 GLOBAL_FLAGS.define(
@@ -70,6 +84,27 @@ GLOBAL_FLAGS.define(
 # the ONE budget knob, shared with fused_train/generation/the kernel
 # auditor — re-exported under the historic name for its import sites
 _vmem_budget = fused_vmem_budget
+
+#: the documented v5e scoped-VMEM OOM point (the kernel auditor's
+#: envelope constant, mirrored here so ops/ never imports analysis/)
+_SCOPED_VMEM_BYTES = 16 << 20
+
+
+def scoped_vmem_budget() -> int:
+    """The scoped-VMEM envelope the SINGLE-LAUNCH block kernel budgets
+    its combined windows against: ``PADDLE_TPU_SCOPED_VMEM_BUDGET``
+    (default 16 MiB — the whole per-core scoped window), raised to the
+    fused dispatch budget when an operator configures a larger one.
+    Same resolution as the kernel auditor's
+    :func:`paddle_tpu.analysis.kernel_rules.scoped_vmem_envelope`, so
+    a shape the dispatch predicate admits can never overcommit the
+    envelope the auditor enforces. Read per trace and carried in the
+    dispatch meta (``scoped_vmem_budget``) + the program-cache route
+    keys — a changed envelope must retrace, never replay."""
+    import os
+    env = int(os.environ.get("PADDLE_TPU_SCOPED_VMEM_BUDGET",
+                             _SCOPED_VMEM_BYTES))
+    return max(env, _vmem_budget())
 
 
 # ---------------------------------------------------------------------------
@@ -626,6 +661,429 @@ def fused_mlp_block_pallas(x, nw, wg, wu, wd, eps=1e-6, block_f=None,
 
 
 # ---------------------------------------------------------------------------
+# single-launch block megakernel: attn + MLP in ONE grid, the attn->MLP
+# residual resident in f32 VMEM scratch (never written to HBM)
+# ---------------------------------------------------------------------------
+def _block_fused_kernel(bt_ref, len_ref, x_ref, nw_ref, wq_ref, wk_ref,
+                        wv_ref, wo_ref, pw_ref, wg_ref, wu_ref, wd_ref,
+                        sin_ref, cos_ref, *rest, scale, bs, kv, groups,
+                        eps, pp, np_, nf, quant, wq_bits=0):
+    """One transformer block's decode step in a single launch.
+
+    Grid = (B, NP + NF): steps [0, NP) stream the live KV pages
+    (attention phase — the shared ``online_softmax_page_update`` body,
+    exactly as ``_attn_block_kernel``), step NP-1 closes attention
+    (new-token fold + o_proj) and hands the residual to step NP..NS-1,
+    the MLP intermediate tiles (exactly ``_mlp_block_kernel``'s math).
+    The handoff lives in ``r_scr`` (f32 [1, D] VMEM) — the one tensor
+    the two-kernel composition round-trips through HBM per block."""
+    i = 0
+    if wq_bits:
+        (sqw_ref, skw_ref, svw_ref, sow_ref,
+         sg_ref, su_ref, sd_ref) = rest[:7]
+        i = 7
+    k_refs = rest[i:i + pp]
+    v_refs = rest[i + pp:i + 2 * pp]
+    i += 2 * pp
+    if quant:
+        ksc_ref, vsc_ref = rest[i:i + 2]
+        i += 2
+    xo_ref, kn_ref, vn_ref = rest[i:i + 3]
+    (q_scr, ka_scr, va_scr, m_scr, l_scr, acc_scr,
+     r_scr, h_scr, f_scr) = rest[i + 3:]
+
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+    seq_len = len_ref[b]
+    dt = x_ref.dtype
+    hd = q_scr.shape[1]
+    hd2 = hd // 2
+    # explicitly-typed literals: the body can be retraced at LOWERING
+    # time outside the no_x64 window (see _attn_block_kernel)
+    f32 = jnp.float32
+    epsf = f32(eps)
+    scalef = f32(scale)
+
+    @pl.when(s == 0)
+    def _prologue():
+        # identical staging to _attn_block_kernel's prologue: RMSNorm,
+        # QKV projections (epilogue-scaled when weight-quantized), RoPE,
+        # new-token K/V out + attention-view scratch, m/l/acc init
+        xf = x_ref[:].astype(jnp.float32)                     # (1, D)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        h = (xf * jax.lax.rsqrt(ms + epsf)).astype(dt) * nw_ref[:]
+
+        def proj(w_ref, s_ref):
+            t = jnp.dot(h, _kernel_weight(w_ref, wq_bits, dt),
+                        preferred_element_type=jnp.float32)
+            return t * s_ref[:] if wq_bits else t
+
+        q = proj(wq_ref, sqw_ref if wq_bits else None)
+        k = proj(wk_ref, skw_ref if wq_bits else None)
+        v = proj(wv_ref, svw_ref if wq_bits else None)
+        sinr, cosr = sin_ref[:], cos_ref[:]                   # (1, hd2)
+
+        def rope(t, n):
+            t = t.astype(dt).astype(jnp.float32).reshape(n, hd)
+            t1, t2 = t[:, :hd2], t[:, hd2:]
+            return jnp.concatenate([t1 * cosr - t2 * sinr,
+                                    t2 * cosr + t1 * sinr], axis=-1)
+
+        qr = rope(q, kv * groups).astype(dt)                  # (H, hd)
+        kr = rope(k, kv).astype(dt)                           # (KV, hd)
+        vm = v.astype(dt).reshape(kv, hd)
+        kn_ref[0] = kr
+        vn_ref[0] = vm
+        q_scr[:] = qr.astype(jnp.float32)
+        if quant:
+            ks = ksc_ref[0][:, None]
+            vs = vsc_ref[0][:, None]
+            kq = jnp.clip(jnp.round(kr.astype(jnp.float32) / ks),
+                          f32(-127), f32(127))
+            vq = jnp.clip(jnp.round(vm.astype(jnp.float32) / vs),
+                          f32(-127), f32(127))
+            ka_scr[:] = kq * ks
+            va_scr[:] = vq * vs
+        else:
+            pool_dt = k_refs[0].dtype
+            ka_scr[:] = kr.astype(pool_dt).astype(jnp.float32)
+            va_scr[:] = vm.astype(pool_dt).astype(jnp.float32)
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # -- attention phase: stream the live pages. The predicate is
+    # automatically false for every MLP step (s >= NP implies
+    # pg*bs >= MB*bs > seq_len), so no phase guard is needed here
+    for j in range(pp):
+        pg = s.astype(jnp.int32) * jnp.int32(pp) + jnp.int32(j) \
+            if hasattr(s, "astype") else jnp.int32(s * pp + j)
+
+        @pl.when(pg * jnp.int32(bs) < seq_len)
+        def _page(k_ref=k_refs[j], v_ref=v_refs[j], pg=pg):
+            k = k_ref[0].astype(jnp.float32)                  # (BS, KV, hd)
+            v = v_ref[0].astype(jnp.float32)
+            if quant:
+                k = k * ksc_ref[0][None, :, None]
+                v = v * vsc_ref[0][None, :, None]
+            online_softmax_page_update(q_scr[:], k, v, pg, bs, seq_len,
+                                       scale, kv, groups,
+                                       m_scr, l_scr, acc_scr)
+
+    @pl.when(s == jnp.int32(np_ - 1))
+    def _attn_epilogue():
+        # close attention exactly as _attn_block_kernel's epilogue —
+        # but land the residual in f32 VMEM scratch instead of HBM,
+        # and run the post-attention RMSNorm right here so the MLP
+        # tiles only consume h_scr
+        q = q_scr[:]
+        ka = ka_scr[:]
+        va = va_scr[:]
+        s_rows = []
+        for kvh in range(kv):
+            qg = q[kvh * groups:(kvh + 1) * groups, :]
+            s_rows.append(jax.lax.dot_general(
+                qg, ka[kvh:kvh + 1, :], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))          # (g, 1)
+        s_new = jnp.concatenate(s_rows, axis=0) * scalef      # (H, 1)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, s_new)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_new - m_new)
+        l_fin = alpha * l_scr[:] + p
+        pv_rows = []
+        for kvh in range(kv):
+            pg = p[kvh * groups:(kvh + 1) * groups, :]
+            pv_rows.append(pg * va[kvh:kvh + 1, :])           # (g, hd)
+        acc_fin = acc_scr[:] * alpha + jnp.concatenate(pv_rows, axis=0)
+        attn = (acc_fin / l_fin).astype(dt)                   # (H, hd)
+        o = jnp.dot(attn.reshape(1, -1),
+                    _kernel_weight(wo_ref, wq_bits, dt),
+                    preferred_element_type=jnp.float32)
+        if wq_bits:
+            o = o * sow_ref[:]
+        # the residual-in-VMEM contract: the attn->MLP handoff stays
+        # f32 in scratch for the rest of the launch
+        resid = x_ref[:].astype(jnp.float32) + o              # (1, D)
+        r_scr[:] = resid
+        ms2 = jnp.mean(jnp.square(resid), axis=-1, keepdims=True)
+        h_scr[:] = (resid * jax.lax.rsqrt(ms2 + epsf)
+                    ).astype(dt) * pw_ref[:]
+        f_scr[:] = jnp.zeros_like(f_scr)
+
+    @pl.when(s >= jnp.int32(np_))
+    def _mlp_tile():
+        # one intermediate tile, _mlp_block_kernel's math verbatim
+        h = h_scr[:]
+        g = jnp.dot(h, _kernel_weight(wg_ref, wq_bits, dt, axis=0),
+                    preferred_element_type=jnp.float32)
+        u = jnp.dot(h, _kernel_weight(wu_ref, wq_bits, dt, axis=0),
+                    preferred_element_type=jnp.float32)
+        if wq_bits:
+            g = g * sg_ref[:]
+            u = u * su_ref[:]
+        g, u = g.astype(dt), u.astype(dt)
+        ff = jax.nn.silu(g) * u
+        dn = jnp.dot(ff, _kernel_weight(wd_ref, wq_bits, dt, axis=1),
+                     preferred_element_type=jnp.float32)
+        if wq_bits:
+            dn = dn * sd_ref[:]
+        f_scr[:] = f_scr[:] + dn
+
+    @pl.when(s == jnp.int32(np_ + nf - 1))
+    def _fin():
+        xo_ref[:] = (r_scr[:] + f_scr[:]).astype(dt)
+
+
+def block_autotune_key(B, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
+                       budget, weight_dtype=None) -> str:
+    """Persistent autotune-cache key for the single-launch block
+    kernel's JOINT (pages_per_step, block_f) tunable. The scoped
+    budget is part of the key (it reshapes the fitting block_f list,
+    and winners are stored as an index into the pair list — the
+    ``mlp_autotune_key`` contract); ``weight_dtype`` appends the
+    quantized-weight shape class the same way."""
+    base = (B, D, H, KV, hd, F, BS, MB, str(dtype), str(pool_dtype),
+            int(budget))
+    if weight_dtype:
+        base = base + (str(weight_dtype),)
+    return f"fused_block|{base}"
+
+
+def _block_vmem_need(meta, bf: int) -> int:
+    """Combined-window VMEM bytes for the single-launch kernel at MLP
+    tile ``bf``: BOTH weight window sets double-buffered (the resident
+    attention tiles + the streamed MLP tiles — the conservative charge
+    the ISSUE's dispatch contract names), the scale rows, the K/V page
+    windows at the WORST-case pages-per-step candidate, the activation
+    rows, and the f32 scratch (attention state + residual/h/MLP
+    accumulator)."""
+    D, H, KV, hd = meta["D"], meta["H"], meta["KV"], meta["hd"]
+    it = meta["itemsize"]
+    wit = _weight_itemsize(meta)
+    attn_w = int((2 * D * H * hd + 2 * D * KV * hd) * wit)
+    mlp_w = int(3 * D * bf * wit)
+    scales = 0
+    if wit != it:
+        scales = (H * hd + 2 * KV * hd + D) * 4   # attn scale rows
+        scales += (2 * bf + D) * 4                # mlp scale tiles
+    page = meta["BS"] * KV * hd * (1 if meta["quant"] else it)
+    pages = 4 * max(PAGE_STEP_CANDIDATES) * page
+    scratch = (2 * H * hd + 2 * KV * hd + 2 * H + 2 * D) * 4 \
+        + D * it
+    return 2 * (attn_w + mlp_w) + scales + pages + scratch + 4 * D * it
+
+
+def _block_fitting_candidates(meta):
+    """The MLP tile sizes whose COMBINED window set fits the scoped
+    envelope. Dispatch (``_supports_block``), the traced default pick
+    and the autotune sweep all consume THIS list (the
+    ``_mlp_fitting_candidates`` contract: a supported-and-dispatched
+    launch can never compile over the envelope its predicate
+    promised)."""
+    return [bf for bf in _mlp_candidates(meta["F"])
+            if _block_vmem_need(meta, bf) <= meta["scoped_vmem_budget"]]
+
+
+@no_x64
+def fused_decode_block_pallas(x, nw, wq, wk, wv, wo, pw, wg, wu, wd,
+                              sin, cos, k_pool, v_pool, block_tables,
+                              seq_lens, kv_scales=None, eps=1e-6,
+                              pages_per_step=None, block_f=None):
+    """ONE Pallas launch for a full decode block: RMSNorm + QKV + RoPE
+    + paged attention (new token folded from VMEM; the pool write stays
+    with the caller) + o_proj + residual + RMSNorm + SwiGLU + residual.
+
+    Arguments are the union of the two stage kernels': ``nw``/``pw``
+    are the input/post norm weights (at x.dtype), the seven projection
+    weights ride plain or as PTQ int8/int4 leaves (in-register dequant,
+    epilogue scales — the PR-15 idiom). Returns
+    (x_out [B, D], k_new [B, KV, hd], v_new [B, KV, hd]).
+
+    The attn->MLP residual lives in f32 VMEM scratch for the whole
+    launch — the two-kernel composition's one HBM round-trip per block
+    that this kernel exists to delete. (The f32 handoff means the
+    megakernel is a roundoff-level variant of the composition, not a
+    bit-identical one; bit-parity holds on every FALLBACK tier, which
+    runs the exact building-block sequence.)"""
+    B, D = x.shape
+    N, BS, KV, hd = k_pool.shape
+    MB = block_tables.shape[1]
+    # weight-quant normalization; ORIGINAL leaves stay in the autotune
+    # args so the tuning recursion re-parses them
+    originals = (wq, wk, wv, wo, wg, wu, wd)
+    wq, sqw, bits, _ = _wq_parts(wq)
+    wk, skw, _, _ = _wq_parts(wk)
+    wv, svw, _, _ = _wq_parts(wv)
+    wo, sow, _, _ = _wq_parts(wo)
+    wg, sg, _, _ = _wq_parts(wg)
+    wu, su, _, _ = _wq_parts(wu)
+    wd, sd, _, _ = _wq_parts(wd)
+    weight_dtype = weight_dtype_of(*originals)
+    E = wq.shape[1]
+    H = E // hd
+    groups = H // KV
+    F = wg.shape[1]
+    scale = 1.0 / math.sqrt(hd)
+    quant = kv_scales is not None
+
+    if pages_per_step is None or block_f is None:
+        budget = scoped_vmem_budget()
+        meta = decode_meta_dims(B, D, H, KV, hd, F, BS, MB, x.dtype,
+                                k_pool.dtype, quant,
+                                weight_dtype=weight_dtype)
+        bfs = _block_fitting_candidates(meta) \
+            or [min(_mlp_candidates(F))]
+        pps = [p for p in PAGE_STEP_CANDIDATES if p <= MB] or [1]
+        pairs = [(p, f) for p in pps for f in bfs]
+        ck = block_autotune_key(B, D, H, KV, hd, F, BS, MB, x.dtype,
+                                k_pool.dtype, budget, weight_dtype)
+        o_wq, o_wk, o_wv, o_wo, o_wg, o_wu, o_wd = originals
+        args = (x, nw, o_wq, o_wk, o_wv, o_wo, pw, o_wg, o_wu, o_wd,
+                sin, cos, k_pool, v_pool, block_tables, seq_lens)
+
+        def build(pair):
+            pp_, bf_ = pair
+            return lambda *a: fused_decode_block_pallas(
+                *a, kv_scales=kv_scales, eps=eps, pages_per_step=pp_,
+                block_f=bf_)[0]
+
+        pages_per_step, block_f = _tuned_pages(ck, pairs, build, args)
+    pp = max(1, min(int(pages_per_step), MB))
+    bf = int(block_f)
+    if F % bf:
+        # same floor-drop hazard as fused_mlp_block_pallas: a ragged
+        # tail tile would silently never reach the accumulator
+        raise ValueError(f"block_f={bf} must divide the intermediate "
+                         f"dim F={F}")
+    np_ = -(-MB // pp)                 # attention page steps
+    nf = F // bf                       # MLP intermediate tiles
+
+    sin_b = jnp.take(jnp.asarray(sin), seq_lens, axis=0)     # (B, hd2)
+    cos_b = jnp.take(jnp.asarray(cos), seq_lens, axis=0)
+
+    row = lambda b, s, bt, ln: (b, 0)                    # noqa: E731
+    const = lambda b, s, bt, ln: (0, 0)                  # noqa: E731
+
+    def _mlp_jf(s):
+        # clamped tile coordinate: parks on tile 0 through the
+        # attention phase (the fetched block is simply unused there),
+        # walks the F tiles across the MLP steps — all-int32 for the
+        # lowering-time retrace outside no_x64 (clamped_page_index's
+        # idiom, which the page specs below reuse verbatim)
+        return jnp.clip(s.astype(jnp.int32) - jnp.int32(np_),
+                        jnp.int32(0), jnp.int32(nf - 1))
+
+    mlp_col = lambda b, s, bt, ln: (0, _mlp_jf(s))       # noqa: E731
+    mlp_row = lambda b, s, bt, ln: (_mlp_jf(s), 0)       # noqa: E731
+
+    def page_index(j):
+        return clamped_page_index(BS, pp, j)
+
+    gu_rows = wg.shape[0]
+    wd_cols = wd.shape[1]
+    in_specs = [
+        pl.BlockSpec((1, D), row),                        # x
+        pl.BlockSpec((1, D), const),                      # input norm
+        pl.BlockSpec(tuple(wq.shape), const),             # wq
+        pl.BlockSpec(tuple(wk.shape), const),             # wk
+        pl.BlockSpec(tuple(wv.shape), const),             # wv
+        pl.BlockSpec(tuple(wo.shape), const),             # wo
+        pl.BlockSpec((1, D), const),                      # post norm
+        pl.BlockSpec((gu_rows, bf), mlp_col),             # wg tile
+        pl.BlockSpec((gu_rows, bf), mlp_col),             # wu tile
+        pl.BlockSpec((bf, wd_cols), mlp_row),             # wd tile
+        pl.BlockSpec((1, hd // 2), row),                  # sin row
+        pl.BlockSpec((1, hd // 2), row),                  # cos row
+    ]
+    inputs = [x, nw.reshape(1, D), wq, wk, wv, wo,
+              pw.reshape(1, D), wg, wu, wd, sin_b, cos_b]
+    if bits:
+        for s_ in (sqw, skw, svw, sow):
+            in_specs.append(pl.BlockSpec((1, s_.shape[-1]), const))
+            inputs.append(jnp.asarray(s_, jnp.float32).reshape(1, -1))
+        in_specs += [pl.BlockSpec((1, bf), mlp_col),
+                     pl.BlockSpec((1, bf), mlp_col),
+                     pl.BlockSpec((1, D), const)]
+        inputs += [jnp.asarray(sg, jnp.float32).reshape(1, F),
+                   jnp.asarray(su, jnp.float32).reshape(1, F),
+                   jnp.asarray(sd, jnp.float32).reshape(1, D)]
+    in_specs += [pl.BlockSpec((1, BS, KV, hd), page_index(j))
+                 for j in range(pp)]                      # k pages
+    in_specs += [pl.BlockSpec((1, BS, KV, hd), page_index(j))
+                 for j in range(pp)]                      # v pages
+    inputs += [k_pool] * pp + [v_pool] * pp
+    if quant:
+        in_specs += [pl.BlockSpec((1, KV), const)] * 2
+        inputs += [jnp.asarray(kv_scales[0], jnp.float32).reshape(1, KV),
+                   jnp.asarray(kv_scales[1], jnp.float32).reshape(1, KV)]
+
+    xo, kn, vn = audited_pallas_call(
+        functools.partial(_block_fused_kernel, scale=scale, bs=BS,
+                          kv=KV, groups=groups, eps=eps, pp=pp,
+                          np_=np_, nf=nf, quant=quant, wq_bits=bits),
+        name="decode_block_fused",
+        num_scalar_prefetch=2,
+        grid=(B, np_ + nf),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, D), row),
+            pl.BlockSpec((1, KV, hd), lambda b, s, bt, ln: (b, 0, 0)),
+            pl.BlockSpec((1, KV, hd), lambda b, s, bt, ln: (b, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((H, hd), jnp.float32),     # q
+            pltpu.VMEM((KV, hd), jnp.float32),    # new K (attn view)
+            pltpu.VMEM((KV, hd), jnp.float32),    # new V (attn view)
+            pltpu.VMEM((H, 1), jnp.float32),      # m
+            pltpu.VMEM((H, 1), jnp.float32),      # l
+            pltpu.VMEM((H, hd), jnp.float32),     # acc
+            pltpu.VMEM((1, D), jnp.float32),      # residual (f32, HBM-free)
+            pltpu.VMEM((1, D), x.dtype),          # post-norm h
+            pltpu.VMEM((1, D), jnp.float32),      # MLP accumulator
+        ],
+        # all three outputs are per-sequence blocks revisited across
+        # the combined grid (prologue/epilogue writes under pl.when)
+        accum_outputs=(0, 1, 2),
+        out_shape=[jax.ShapeDtypeStruct((B, D), x.dtype),
+                   jax.ShapeDtypeStruct((B, KV, hd), x.dtype),
+                   jax.ShapeDtypeStruct((B, KV, hd), x.dtype)],
+        interpret=_interpret(),
+    )(jnp.asarray(block_tables, jnp.int32),
+      jnp.asarray(seq_lens, jnp.int32), *inputs)
+    return xo, kn, vn
+
+
+def decode_block_composed(x, nw, wq, wk, wv, wo, pw, wg, wu, wd, sin,
+                          cos, k_pool, v_pool, block_tables, seq_lens,
+                          kv_scales=None, eps=1e-6):
+    """Priority-0 fallback for ``decode_block_fused``: the EXACT
+    two-stage sequence, each stage registry-dispatched — on TPU the two
+    stage megakernels, off-TPU / oversized the unfused composition —
+    so every fallback tier is bit-identical to the two-kernel route it
+    stands in for, by construction. The MLP stage reads no pool state,
+    so running it before the caller's pool write is the same math as
+    the interleaved two-kernel order."""
+    B, D = x.shape
+    _, BS, KV, hd = k_pool.shape
+    MB = block_tables.shape[1]
+    # stored q_proj/gate tiles keep their OUTPUT dim unpacked (int4
+    # packs rows for D-contracting tiles), so H/F read off the shapes
+    H = _wq_parts(wq)[0].shape[1] // hd
+    F = _wq_parts(wg)[0].shape[1]
+    meta = decode_meta_dims(B, D, H, KV, hd, F, BS, MB, x.dtype,
+                            k_pool.dtype, kv_scales is not None,
+                            weight_dtype=weight_dtype_of(
+                                wq, wk, wv, wo, wg, wu, wd))
+    attn_fn, mlp_fn, _ = resolve_decode_blocks(meta, "auto")
+    xo, k_new, v_new = attn_fn(x, nw, wq, wk, wv, wo, sin, cos,
+                               k_pool, v_pool, block_tables, seq_lens,
+                               kv_scales, eps)
+    xo = mlp_fn(xo, pw, wg, wu, wd, eps)
+    return xo, k_new, v_new
+
+
+# ---------------------------------------------------------------------------
 # unfused reference variants — the EXACT pre-fusion building-block
 # sequence, so dispatch falling back here is bit-identical to the
 # original ``_paged_decode_step`` math
@@ -726,6 +1184,11 @@ def decode_meta_dims(B, D, H, KV, hd, F, BS, MB, dtype, pool_dtype,
         # and the block_f candidate list), so it rides in the meta —
         # visible to the DISPATCH_KEY_GAP lint like every other key
         "vmem_budget": int(_vmem_budget()),
+        # the scoped envelope the SINGLE-LAUNCH kernel budgets its
+        # combined windows against (the per-stage kernels budget their
+        # weight-resident share against vmem_budget above); a dispatch
+        # input like the rest, so it rides in the meta and the route key
+        "scoped_vmem_budget": int(scoped_vmem_budget()),
     }
 
 
@@ -802,6 +1265,36 @@ def _supports_mlp(meta):
                    f"{meta['vmem_budget'] >> 20}MiB VMEM budget")
 
 
+def _supports_block(meta):
+    """Dispatch predicate for the SINGLE-LAUNCH block kernel. Stricter
+    than the per-stage predicates by construction: BOTH weight window
+    sets (resident attention tiles + double-buffered MLP tiles, at the
+    worst-case pages-per-step and block_f candidates) must fit the
+    scoped-VMEM envelope together — bf16 flagship shapes fail this and
+    fall back to the two-kernel route; int8/int4 weight classes fit."""
+    if meta["interpret"]:
+        return False, "interpret mode (off-TPU): composition is faster"
+    if meta.get("tp", 1) != 1:
+        return False, ("tensor-parallel decode runs the per-stage "
+                       "kernels inside shard_map")
+    hd = meta["hd"]
+    if hd % 8 != 0 or hd < 16:
+        return False, f"head_dim {hd} not a multiple of 8 (lane tiling)"
+    if meta["H"] % meta["KV"] != 0:
+        return False, "H not a multiple of KV"
+    why = _wq_even_reason(meta, (("hidden_size", meta["D"]),
+                                 ("H*head_dim", meta["H"] * hd)))
+    if why:
+        return False, why
+    fits = _block_fitting_candidates(meta)
+    if fits:
+        return True, (f"attn+MLP windows fit the scoped envelope at "
+                      f"block_f={fits[0]}")
+    budget = meta["scoped_vmem_budget"]
+    return False, (f"combined attn+MLP weight windows (double-buffered)"
+                   f" exceed the {budget >> 20}MiB scoped-VMEM envelope")
+
+
 def _attn_pallas_variant(x, nw, wq, wk, wv, wo, sin, cos, k_pool,
                          v_pool, block_tables, seq_lens,
                          kv_scales=None, eps=1e-6, residual=True):
@@ -816,6 +1309,15 @@ def _mlp_pallas_variant(x, nw, wg, wu, wd, eps=1e-6, residual=True):
                                   residual=residual)
 
 
+def _block_pallas_variant(x, nw, wq, wk, wv, wo, pw, wg, wu, wd, sin,
+                          cos, k_pool, v_pool, block_tables, seq_lens,
+                          kv_scales=None, eps=1e-6):
+    return fused_decode_block_pallas(x, nw, wq, wk, wv, wo, pw, wg, wu,
+                                     wd, sin, cos, k_pool, v_pool,
+                                     block_tables, seq_lens,
+                                     kv_scales=kv_scales, eps=eps)
+
+
 KERNELS.register("decode_attn_block", "pallas_fused",
                  _attn_pallas_variant, priority=10,
                  supports=_supports_attn, tags=("serving", "pallas"))
@@ -826,6 +1328,15 @@ KERNELS.register("decode_mlp_block", "pallas_fused", _mlp_pallas_variant,
                  tags=("serving", "pallas"))
 KERNELS.register("decode_mlp_block", "unfused", mlp_block_ref,
                  priority=0, tags=("serving",))
+# the single-launch op sits ABOVE the two-kernel composition: priority
+# 10 is the megakernel (gated by the combined-window predicate),
+# priority 0 re-runs the exact two-stage sequence — dispatch falling
+# back here IS the two-kernel route, bit-identically
+KERNELS.register("decode_block_fused", "pallas_block",
+                 _block_pallas_variant, priority=10,
+                 supports=_supports_block, tags=("serving", "pallas"))
+KERNELS.register("decode_block_fused", "composed", decode_block_composed,
+                 priority=0, tags=("serving",))
 # every decode_meta_dims key is either in the jitted decode program's
 # trace signature (the shape/dtype keys; tp via the sharded local
 # shapes + the mesh baked into the shard_map'd program) or in
@@ -834,11 +1345,14 @@ KERNELS.register("decode_mlp_block", "unfused", mlp_block_ref,
 # registry lint holds supports() to this declaration
 _DECODE_KEY_FIELDS = ("B", "D", "H", "KV", "hd", "F", "BS", "MB",
                       "dtype", "pool_dtype", "quant", "interpret",
-                      "tp", "weight_dtype", "vmem_budget")
+                      "tp", "weight_dtype", "vmem_budget",
+                      "scoped_vmem_budget")
 _DECODE_KEY_COVERS = {"itemsize": "dtype"}
 KERNELS.declare_cache_key("decode_attn_block", _DECODE_KEY_FIELDS,
                           covers=_DECODE_KEY_COVERS)
 KERNELS.declare_cache_key("decode_mlp_block", _DECODE_KEY_FIELDS,
+                          covers=_DECODE_KEY_COVERS)
+KERNELS.declare_cache_key("decode_block_fused", _DECODE_KEY_FIELDS,
                           covers=_DECODE_KEY_COVERS)
 
 
@@ -860,7 +1374,39 @@ def resolve_decode_blocks(meta: dict, mode="auto"):
         a_name = m_name = "unfused"
         a_fn = KERNELS.variant("decode_attn_block", a_name).fn
         m_fn = KERNELS.variant("decode_mlp_block", m_name).fn
+    elif mode == "block":
+        raise ValueError(
+            "fused_decode='block' selects the SINGLE-LAUNCH kernel — "
+            "resolve it through resolve_decode_step, not the two-stage "
+            "resolver")
     else:
         raise ValueError(
-            f"fused_decode mode must be auto|pallas|ref, got {mode!r}")
+            f"fused_decode mode must be auto|pallas|ref|block, "
+            f"got {mode!r}")
     return a_fn, m_fn, {"attn": a_name, "mlp": m_name}
+
+
+def resolve_decode_step(meta: dict, mode="auto"):
+    """Resolve ONE decode step's kernels, single-launch aware.
+
+    Returns ``(block_fn, attn_fn, mlp_fn, variants)``. When the
+    single-launch op wins — mode="block" forces it, auto modes dispatch
+    it through the registry (the combined-window predicate + any force
+    pin) — ``block_fn`` is the whole-block callable and the per-stage
+    fns are None. Otherwise ``block_fn`` is None and the per-stage pair
+    comes from :func:`resolve_decode_blocks` exactly as before, so
+    every non-block tier is bit-identical to the pre-block route. The
+    ``variants`` dict always carries all three keys ("block", "attn",
+    "mlp") — the observability schema reads them unconditionally."""
+    if mode == "block":
+        b_name = "pallas_block"
+        b_fn = KERNELS.variant("decode_block_fused", b_name).fn
+        return b_fn, None, None, {"block": b_name, "attn": b_name,
+                                  "mlp": b_name}
+    a_fn, m_fn, names = resolve_decode_blocks(meta, mode)
+    if mode in ("auto", True, None):
+        b_name, b_fn = KERNELS.dispatch("decode_block_fused", meta)
+        if b_name == "pallas_block":
+            return b_fn, None, None, {"block": b_name, "attn": b_name,
+                                      "mlp": b_name}
+    return None, a_fn, m_fn, {"block": "composed", **names}

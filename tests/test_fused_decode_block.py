@@ -169,6 +169,110 @@ def test_mlp_candidates_divide_evenly():
     assert fdb._mlp_candidates(100) == [100]   # no divisor candidate
 
 
+# ---------------------------------------------------------------------------
+# single-launch decode block (r20): kernel parity, dispatch contract,
+# mode="block" plumbing
+# ---------------------------------------------------------------------------
+def _block_case(rng, wq_bits=0, quant=False):
+    """Full-block args at the clamp-edge decode shapes: the attention
+    case above + post-norm and SwiGLU weights (ragged F), the weight
+    tree optionally PTQ-quantized (down_proj packs its F rows)."""
+    B, D, KV, groups, hd, BS, MB, F = 2, 32, 2, 1, 16, 8, 3, 96
+    args, scales = _attn_case(rng, B, D, KV, groups, hd, BS, MB,
+                              quant=quant)
+    (x, nw, wq, wk, wv, wo, sin, cos, kp, vp, bt, lens) = args
+    mk = lambda *s: jnp.asarray(rng.randn(*s) * 0.07,    # noqa: E731
+                                jnp.float32)
+    pw = jnp.asarray(rng.rand(D) + 0.5, jnp.float32)
+    wg, wu, wd = mk(D, F), mk(D, F), mk(F, D)
+    ws = (wq, wk, wv, wo, wg, wu, wd)
+    if wq_bits:
+        from paddle_tpu.quantization import ptq as _ptq
+        ws = tuple(_ptq.quantize_leaf(w, wq_bits)
+                   for w in (wq, wk, wv, wo, wg, wu)) \
+            + (_ptq.quantize_leaf(wd, wq_bits, pack_axis=1),)
+    return (x, nw, ws[0], ws[1], ws[2], ws[3], pw, ws[4], ws[5],
+            ws[6], sin, cos, kp, vp, bt, lens), scales
+
+
+@pytest.mark.parametrize("wq_bits", [0, 8, 4], ids=["fp", "w8", "w4"])
+def test_decode_block_single_launch_parity(wq_bits):
+    """The single-launch megakernel (forced, interpret) matches the
+    priority-0 composed route to fp32 roundoff — the attn->MLP residual
+    handoff through f32 VMEM scratch changes only op grouping. Plain,
+    int8 and packed-int4 weight trees."""
+    rng = np.random.RandomState(20 + wq_bits)
+    full, _ = _block_case(rng, wq_bits=wq_bits)
+    got = fdb.fused_decode_block_pallas(*full, pages_per_step=2,
+                                        block_f=32)
+    want = fdb.decode_block_composed(*full)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   atol=5e-5, rtol=1e-5)
+
+
+def test_decode_block_parity_int8_pool_and_tunable_invariance():
+    """int8 KV pool (dequant in VMEM, scales per head) and the joint
+    (pages_per_step, block_f) tunables: every choice is the same math
+    to fp32 roundoff."""
+    rng = np.random.RandomState(30)
+    full, scales = _block_case(rng, quant=True)
+    want = fdb.decode_block_composed(*full, kv_scales=scales)
+    for pp, bf in ((1, 96), (2, 32), (4, 48)):
+        got = fdb.fused_decode_block_pallas(*full, kv_scales=scales,
+                                            pages_per_step=pp,
+                                            block_f=bf)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       atol=5e-5, rtol=1e-5,
+                                       err_msg=f"pp={pp} bf={bf}")
+
+
+def test_block_dispatch_flagship_weight_quant_contract():
+    """The acceptance bar: at the flagship serving class the combined
+    bf16 attn+MLP windows exceed the scoped-VMEM envelope (two-kernel
+    composed route, reason naming the envelope), while int8/int4 weight
+    variants fit and dispatch the single-launch kernel."""
+    from paddle_tpu.ops.pallas.registry import KERNELS
+
+    def m(wq=None):
+        meta = fdb.decode_meta_dims(8, 1024, 16, 16, 64, 4096, 16, 24,
+                                    jnp.bfloat16, jnp.bfloat16, False,
+                                    weight_dtype=wq)
+        meta["interpret"] = False
+        return meta
+    assert KERNELS.dispatch("decode_block_fused", m())[0] == "composed"
+    assert KERNELS.dispatch("decode_block_fused",
+                            m("int8"))[0] == "pallas_block"
+    assert KERNELS.dispatch("decode_block_fused",
+                            m("int4"))[0] == "pallas_block"
+    rej = [r for r in KERNELS.explain("decode_block_fused", m())
+           if r["name"] == "pallas_block"][0]
+    assert not rej["supported"] and "envelope" in rej["reason"]
+
+
+def test_block_mode_resolver_contract():
+    """mode='block' pins the single-launch kernel through
+    resolve_decode_step; auto on CPU keeps the composed tier (per-stage
+    fns, bit parity); the two-stage resolver refuses 'block' with a
+    pointer at resolve_decode_step."""
+    meta = fdb.decode_meta(CFG, B=2, BS=4, MB=4,
+                           pool_dtype=jnp.float32, quant=False)
+    b_fn, a_fn, m_fn, names = fdb.resolve_decode_step(meta, "block")
+    assert b_fn is not None and a_fn is None and m_fn is None
+    assert names == {"block": "pallas_block", "attn": "pallas_block",
+                     "mlp": "pallas_block"}
+    b_fn, a_fn, m_fn, names = fdb.resolve_decode_step(meta, "auto")
+    assert b_fn is None and a_fn is not None and m_fn is not None
+    assert names == {"block": "composed", "attn": "unfused",
+                     "mlp": "unfused"}
+    with pytest.raises(ValueError, match="resolve_decode_step"):
+        fdb.resolve_decode_blocks(meta, "block")
+    with pytest.raises(ValueError, match="auto|pallas|ref|block"):
+        fdb.resolve_decode_step(meta, "bogus")
+    assert _fused_mode("block") == "block"
+
+
 def test_paged_decode_pages_per_step_invariant():
     """Satellite: the unfused paged-decode kernel's pages-per-step is an
     autotune candidate now — every choice must stay bit-identical."""
@@ -363,6 +467,7 @@ def test_engine_stream_fused_vs_unfused_bit_parity(params, cdt):
     assert all(n <= 1 for n in c["prefill_traces"].values()), c
     assert eng_f.metrics()["decode_variant"]["mode"] == "auto"
     assert eng_u.decode_variant == {"mode": "unfused",
+                                    "block": "composed",
                                     "attn": "unfused",
                                     "mlp": "unfused"}
 
@@ -374,6 +479,7 @@ def test_engine_forced_pallas_smoke(params):
     eng = _engine(params, capacity=2, prefill_buckets=(8,),
                   fused_decode="pallas")
     assert eng.decode_variant == {"mode": "pallas",
+                                  "block": "composed",
                                   "attn": "pallas_fused",
                                   "mlp": "pallas_fused"}
     assert any(s.name == "serving_decode_fused"
@@ -387,6 +493,47 @@ def test_engine_forced_pallas_smoke(params):
     assert eng.counters["decode_traces"] == 1
 
 
+def test_engine_forced_block_smoke(params):
+    """fused_decode='block' runs the single-launch decode program end
+    to end (interpret mode on CPU), names the serving_decode_block spec
+    for the audit gate, and its greedy tokens match the auto engine
+    (the composed tier the block kernel is a roundoff variant of)."""
+    eng = _engine(params, capacity=2, prefill_buckets=(8,),
+                  fused_decode="block")
+    assert eng.decode_variant == {"mode": "block",
+                                  "block": "pallas_block",
+                                  "attn": "pallas_block",
+                                  "mlp": "pallas_block"}
+    assert any(s.name == "serving_decode_block"
+               for s in eng.program_specs(register=False))
+    rng = np.random.RandomState(12)
+    prompts = [rng.randint(0, 97, (6,)).astype(np.int32)
+               for _ in range(2)]
+    g = GenerationConfig(max_new_tokens=3, greedy=True)
+    rs = [eng.submit(p, g) for p in prompts]
+    eng.drain()
+    assert all(r.done and len(r.tokens) == 3 for r in rs)
+    assert eng.counters["decode_traces"] == 1
+    eng_a = _engine(params, capacity=2, prefill_buckets=(8,))
+    rs_a = [eng_a.submit(p, g) for p in prompts]
+    eng_a.drain()
+    assert [r.tokens for r in rs] == [r.tokens for r in rs_a]
+
+
+def test_block_mode_is_single_device(params):
+    """The single-launch kernel runs outside shard_map: a mesh engine
+    pinned to 'block' is rejected at construction, and the TP decode
+    body refuses the mode before tracing anything."""
+    from paddle_tpu.inference import ServingMesh
+    from paddle_tpu.inference import tp as tp_mod
+    with pytest.raises(ValueError, match="single-device"):
+        _engine(params, mesh=ServingMesh.make(tp=2),
+                fused_decode="block")
+    with pytest.raises(ValueError, match="single-device"):
+        tp_mod._tp_decode_step(params, None, CFG, None, None, None,
+                               None, fused="block")
+
+
 def test_generate_paged_fused_flag_parity(params):
     rng = np.random.RandomState(9)
     prompts = jnp.asarray(rng.randint(0, 97, (2, 8)), jnp.int32)
@@ -395,6 +542,11 @@ def test_generate_paged_fused_flag_parity(params):
                                      fused_decode=False))
     fused = np.asarray(generate_paged(params, prompts, CFG, g))
     np.testing.assert_array_equal(base, fused)
+    # the forced single-launch route decodes the same greedy tokens
+    # (roundoff-level logits variant of the composition)
+    block = np.asarray(generate_paged(params, prompts, CFG, g,
+                                      fused_decode="block"))
+    np.testing.assert_array_equal(base, block)
     with pytest.raises(ValueError, match="fused_decode"):
         _fused_mode("bogus")
     assert _fused_mode(None) == "auto"       # flag defaults on

@@ -107,9 +107,9 @@ def test_catalog_captures_every_declared_kernel(catalog_reports):
         "layer_norm_fwd", "fused_adamw", "paged_attention_decode",
         "flash_attention_fwd", "flash_attention_bwd_dq",
         "flash_attention_bwd_dkv", "decode_attn_block",
-        "decode_mlp_block", "prefill_attn_block", "linear_ce_fwd",
-        "linear_ce_bwd_dx", "linear_ce_bwd_dh", "swiglu_fwd",
-        "swiglu_bwd"}
+        "decode_mlp_block", "decode_block_fused", "prefill_attn_block",
+        "linear_ce_fwd", "linear_ce_bwd_dx", "linear_ce_bwd_dh",
+        "swiglu_fwd", "swiglu_bwd"}
     captured = set()
     for r in catalog_reports:
         assert not any(f.code in ("COVERAGE_GAP", "TRACE_ERROR")
@@ -225,6 +225,32 @@ def test_rule_vmem_overcommit_window_model(monkeypatch):
     # an operator-raised fused budget raises the envelope with it
     monkeypatch.setenv("PADDLE_TPU_SCOPED_VMEM_BUDGET", str(32 << 20))
     assert check_launch(big()) == []
+
+
+def test_rule_vmem_resident_share_in_combined_launches():
+    """Combined multi-window launches (the single-launch decode block:
+    page operands streamed per grid step, the weight windows + scratch
+    resident for the whole launch) must ALSO fit their resident share
+    under the per-launch dispatch budget — the streamed double-buffer
+    envelope alone would let an oversized resident set sneak through.
+    All-resident launches keep the historic envelope-only contract
+    (the const spec in the window-model test above)."""
+    from paddle_tpu.analysis.kernel_rules import check_launch
+    const_w = _op((1024, 1024), (1024, 1024), lambda i: (0, 0))
+    streamed = _op((4096, 8), (1024, 8), lambda i: (i, 0))
+    spec = _spec((4,), [_op((4, 8), (1, 8), lambda i: (i, 0))],
+                 ins=[const_w, const_w, streamed],
+                 scratch=[((1024, 1024), "float32", "vmem")])
+    found = check_launch(spec)    # 2x4MiB const + 4MiB scratch > 10MiB
+    assert _codes(found) == ["VMEM_OVERCOMMIT"]
+    assert found[0].site == "synthetic/resident"
+    assert found[0].detail["resident_bytes"] == 12 << 20
+    # the same launch under a budget that holds its resident share
+    roomy = _spec((4,), [_op((4, 8), (1, 8), lambda i: (i, 0))],
+                  ins=[const_w, const_w, streamed],
+                  scratch=[((1024, 1024), "float32", "vmem")],
+                  budget=16 << 20)
+    assert check_launch(roomy) == []
 
 
 def test_rule_vmem_counts_prefetch_streamed_pages_double_buffered():
@@ -446,6 +472,21 @@ def _diff_decode_mlp_block():
     return run, ("decode_mlp_block",)
 
 
+def _diff_decode_block_fused():
+    # the single-launch block at the same clamp-edge decode shapes,
+    # plus the MLP half on a ragged (non-divisor-tile) intermediate
+    (x, nw, wq, wk, wv, wo, sin, cos, kp, vp, bt, ln) = _decode_inputs()
+    D, F = 32, 96
+    pw = jnp.abs(_f32(D)) + 0.5
+    wg, wu, wd = _f32(D, F), _f32(D, F), _f32(F, D)
+
+    def run(fn):
+        xo, kn, vn = fn(x, nw, wq, wk, wv, wo, pw, wg, wu, wd, sin,
+                        cos, kp, vp, bt, ln)
+        return xo, kn, vn
+    return run, ("decode_block_fused",)
+
+
 def _diff_prefill_attn_block():
     # warm mid-page start, ragged valid rows (13 of 16), odd page count
     P, D, H, KV, hd, BS, MB = 16, 32, 4, 2, 16, 8, 5
@@ -488,6 +529,7 @@ _DIFF_CASES = {
     "fused_adamw": _diff_fused_adamw,
     "decode_attn_block": _diff_decode_attn_block,
     "decode_mlp_block": _diff_decode_mlp_block,
+    "decode_block_fused": _diff_decode_block_fused,
     "prefill_attn_block": _diff_prefill_attn_block,
     "prefill_mlp_block": _diff_prefill_mlp_block,
 }
@@ -503,8 +545,11 @@ def test_differential_sweep_covers_every_registered_op():
 def test_pallas_variant_matches_fallback_at_boundary_shapes(op):
     build = _DIFF_CASES[op]
     run, (op_name,) = build()
-    with KERNELS.force(op_name, "pallas_fused"):
-        got = run(KERNELS.variant(op_name, "pallas_fused").fn)
+    # the highest-priority variant is the Pallas one ("pallas_fused"
+    # for the per-stage ops, "pallas_block" for the single-launch op)
+    pname = KERNELS.variants(op_name)[0].name
+    with KERNELS.force(op_name, pname):
+        got = run(KERNELS.variant(op_name, pname).fn)
     want = run(KERNELS.variants(op_name)[-1].fn)      # priority-0
     np.testing.assert_allclose(np.asarray(_flat(got), np.float32),
                                np.asarray(_flat(want), np.float32),

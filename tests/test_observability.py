@@ -115,7 +115,14 @@ def test_metrics_schema_frozen_disabled(params):
     by accident."""
     eng = _engine(params)
     _run_stream(eng)
-    assert set(eng.metrics().keys()) == BASE_KEYS
+    m = eng.metrics()
+    assert set(m.keys()) == BASE_KEYS
+    # r20: decode_variant gained the single-launch "block" slot beside
+    # the per-stage names — extended, not loosened
+    assert set(m["decode_variant"].keys()) == {"mode", "block", "attn",
+                                               "mlp"}
+    assert m["decode_variant"]["block"] in ("pallas_block", "composed")
+    assert m["weight_quant_variant"] == {"mode": "off"}
 
 
 def test_metrics_schema_frozen_enabled(params):
@@ -123,6 +130,9 @@ def test_metrics_schema_frozen_enabled(params):
     _run_stream(eng)
     m = eng.metrics()
     assert set(m.keys()) == BASE_KEYS | OBS_KEYS
+    assert set(m["decode_variant"].keys()) == {"mode", "block", "attn",
+                                               "mlp"}
+    assert m["decode_variant"]["block"] in ("pallas_block", "composed")
     assert set(m["latency"].keys()) == LATENCY_KEYS
     for name, snap in m["latency"].items():
         assert set(snap.keys()) == HIST_KEYS, name
@@ -422,11 +432,20 @@ def test_enabled_stream_parity_traces_and_exports(params, tmp_path):
     # JSONL: meta + events + 30 request records; trace_summary parses it
     jsonl_path = tmp_path / "tl.jsonl"
     eng.write_timeline(str(jsonl_path))
-    kinds = [json.loads(ln)["kind"]
-             for ln in jsonl_path.read_text().splitlines()]
+    recs = [json.loads(ln)
+            for ln in jsonl_path.read_text().splitlines()]
+    kinds = [r["kind"] for r in recs]
     assert kinds[0] == "meta"
     assert kinds.count("request") == 30
     assert kinds.count("event") > 30
+    # r20: every decode_step event carries its serving variant so
+    # trace_summary can attribute decode time per implementation
+    dsteps = [r for r in recs
+              if r["kind"] == "event" and r["name"] == "decode_step"]
+    assert dsteps
+    assert all(r.get("decode_variant") in ("pallas_block",
+                                           "pallas_fused", "unfused")
+               for r in dsteps)
     import sys
     sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
                                     "tools"))
@@ -438,6 +457,14 @@ def test_enabled_stream_parity_traces_and_exports(params, tmp_path):
     summary = trace_summary.summarize(meta, events, requests, top=5)
     assert summary["requests"] == 30
     assert "decode_step" in summary["phases"]
+    # r20 per-variant decode attribution: one bucket per variant seen,
+    # counts covering every stamped decode_step event
+    dec = summary["decode"]["variants"]
+    assert set(dec) <= {"pallas_block", "pallas_fused", "unfused"}
+    assert sum(v["count"] for v in dec.values()) == len(dsteps)
+    for v in dec.values():
+        assert set(v.keys()) == {"count", "total_ms", "max_ms",
+                                 "mean_ms"}
     assert len(summary["slowest_steps"]) == 5
     r = summary["request_latency"]["ttft_ms"]
     assert r["p50"] <= r["p95"] <= r["p99"] <= r["max"]

@@ -108,7 +108,38 @@ def summarize(meta, events, requests, top=10):
     pre = summarize_prefill(events)
     if pre is not None:
         out["prefill"] = pre
+    dec = summarize_decode(events)
+    if dec is not None:
+        out["decode"] = dec
     return out
+
+
+def summarize_decode(events):
+    """The decode section: per-variant step attribution from the
+    ``decode_variant`` field the engines stamp on each decode_step
+    event ("pallas_block" = single-launch block megakernel,
+    "pallas_fused" = the two-kernel attn+MLP route, "unfused" = the
+    composition) — so a capture says WHICH decode kernel its steps ran,
+    mirroring the prefill ``variant`` attribution above. Returns None
+    when no decode_step event carries the stamp (pre-r20 timelines
+    keep their old summary shape)."""
+    steps = [ev for ev in events if ev.get("name") == "decode_step"
+             and ev.get("decode_variant") is not None]
+    if not steps:
+        return None
+    per = {}
+    for ev in steps:
+        v = per.setdefault(str(ev["decode_variant"]), {
+            "count": 0, "total_ms": 0.0, "max_ms": 0.0})
+        v["count"] += 1
+        d = ev.get("dur_ms") or 0.0
+        v["total_ms"] += d
+        v["max_ms"] = max(v["max_ms"], d)
+    for v in per.values():
+        v["mean_ms"] = round(v["total_ms"] / v["count"], 3)
+        v["total_ms"] = round(v["total_ms"], 3)
+        v["max_ms"] = round(v["max_ms"], 3)
+    return {"variants": per}
 
 
 def summarize_prefill(events):
@@ -248,6 +279,16 @@ def render(summary):
             lines.append(f"{bk:<10}{b['count']:>8}{b['mean_ms']:>10}"
                          f"{b['max_ms']:>10}{b['valid_tokens']:>11}"
                          f"{b['pad_tokens']:>9}{b['occupancy']:>7}")
+    dec = summary.get("decode")
+    if dec:
+        lines.append("")
+        lines.append("decode variants:")
+        lines.append(f"{'variant':<16}{'steps':>8}{'total ms':>12}"
+                     f"{'mean ms':>10}{'max ms':>10}")
+        for name, v in sorted(dec["variants"].items(),
+                              key=lambda kv: -kv[1]["total_ms"]):
+            lines.append(f"{name:<16}{v['count']:>8}{v['total_ms']:>12}"
+                         f"{v['mean_ms']:>10}{v['max_ms']:>10}")
     sched = summary.get("scheduler")
     if sched:
         lines.append("")
