@@ -34,18 +34,68 @@ import time
 
 import numpy as np
 
-PEAK_HBM_BW = {  # bytes/sec per chip, by TPU generation
-    "v6e": 1640e9, "v5p": 2765e9, "v5e": 819e9, "v5litepod": 819e9,
-    "v4": 1228e9,
-}
-
-
 def _peak_bw():
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e").lower()
-    for k, v in PEAK_HBM_BW.items():
-        if gen.startswith(k):
-            return v
-    return 819e9
+    # the shared peak table (honors PADDLE_TPU_PEAK_HBM_BW +
+    # PALLAS_AXON_TPU_GEN): the bench bw_frac and the roofline
+    # observatory's achieved_bw_frac must divide by the SAME denominator
+    from paddle_tpu.observability.compile import device_peak_hbm_bw
+    return device_peak_hbm_bw()[0]
+
+
+def _repro_meta():
+    """Reproducibility stamp next to the timing rows: two banked bench
+    runs are only comparable when the toolchain and the kernel-shaping
+    knobs match — jax/jaxlib versions, the scoped-VMEM budget the fused
+    dispatch predicates honor, and whether an autotune winners table
+    was live (its block shapes move the timed kernels)."""
+    import jax
+    import jaxlib
+    from paddle_tpu.ops.pallas._util import fused_vmem_budget
+    meta = {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "fused_vmem_budget_env": os.environ.get(
+            "PADDLE_TPU_FUSED_VMEM_BUDGET"),
+        "fused_vmem_budget": fused_vmem_budget(),
+    }
+    try:
+        from paddle_tpu.ops.pallas.autotune import _cache
+        path = _cache._path
+        if os.path.exists(path):
+            with open(path) as f:
+                meta["autotune_entries"] = len(json.load(f))
+            meta["autotune_table"] = path
+        else:
+            meta["autotune_entries"] = 0
+            meta["autotune_table"] = None
+    except Exception:  # noqa: BLE001 — a corrupt table is "unknown"
+        meta["autotune_entries"] = None
+    return meta
+
+
+def _roofline_report():
+    """Trace-only roofline rows for EVERY registered kernel at the
+    catalog shapes (jax.eval_shape under launch capture — no device
+    needed): each ALL_KERNEL_NAMES entry gets modeled bytes, FLOPs,
+    intensity and its memory/compute bound. The bench cases above time
+    whatever the platform can run; this table is the complete model,
+    so a kernel missing here IS the regression signal."""
+    from paddle_tpu.analysis.kernel_catalog import (ALL_KERNEL_NAMES,
+                                                    capture_case,
+                                                    kernel_cases)
+    from paddle_tpu.observability.roofline import (kernel_cost,
+                                                   peak_snapshot)
+    rows, memo = {}, {}
+    for case in kernel_cases():
+        specs, err = capture_case(case)
+        if err is not None:
+            continue
+        for spec in specs:
+            if spec.name not in rows:
+                rows[spec.name] = kernel_cost(spec, memo=memo)
+    return {"kernels": rows,
+            "missing": sorted(set(ALL_KERNEL_NAMES) - set(rows)),
+            **peak_snapshot()}
 
 
 def _timed_host_synced(fn, steps, warn_sink=None):
@@ -187,6 +237,28 @@ def _kernel_gate(out):
             gate["stderr"] = (p.stderr or "")[-400:]
             print(f"[bench] kernel gate failed (rc={p.returncode}): "
                   f"{(p.stderr or '').strip()[-200:]}", file=sys.stderr)
+        # roofline arm of the same gate (BENCH_ROOFLINE=0 opts out with
+        # the report itself): achieved-bandwidth regressions, same
+        # SKIP-on-no-reference semantics
+        if os.environ.get("BENCH_ROOFLINE", "1").lower() \
+                not in ("0", "false"):
+            pr = subprocess.run(
+                [sys.executable, tool, "--capture", cap_path,
+                 "--roofline", "--json", res_path, "--quiet"],
+                capture_output=True, text=True, timeout=120)
+            roof = {"rc": pr.returncode}
+            try:
+                with open(res_path) as f:
+                    roof.update(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                pass
+            if pr.returncode != 0:
+                roof["stderr"] = (pr.stderr or "")[-400:]
+                print(f"[bench] roofline gate failed "
+                      f"(rc={pr.returncode}): "
+                      f"{(pr.stderr or '').strip()[-200:]}",
+                      file=sys.stderr)
+            gate["roofline"] = roof
         out["kernel_gate"] = gate
     except Exception as e:  # noqa: BLE001 — gate is evidence, not bench
         out["kernel_gate"] = {"error": f"{type(e).__name__}: {e}"[:200]}
@@ -2080,12 +2152,23 @@ def bench_kernels():
         paged_attention_decode_pallas)
     from paddle_tpu.ops.pallas.fused_adamw import fused_adamw
     from paddle_tpu.ops.pallas.norms import (layer_norm_pallas,
+                                             residual_rms_norm_pallas,
+                                             residual_rms_norm_ref,
                                              rms_norm_pallas)
 
     interp = interpret_mode()
     res = {"interpret": bool(interp),
-           "platform": jax.devices()[0].platform, "cases": {}}
+           "platform": jax.devices()[0].platform,
+           "repro": _repro_meta(), "cases": {}}
     key = jax.random.PRNGKey(0)
+
+    roofline_on = os.environ.get("BENCH_ROOFLINE", "1").lower() \
+        not in ("0", "false")
+    if roofline_on:
+        from paddle_tpu.analysis.kernel_catalog import modeled_flops
+        from paddle_tpu.analysis.kernel_rules import modeled_launch_bytes
+        from paddle_tpu.observability.roofline import roofline_point
+        from paddle_tpu.ops.pallas._util import capture_kernel_launches
 
     def timed(fn, *args, steps=20):
         out = jax.block_until_ready(fn(*args))  # compile
@@ -2104,12 +2187,24 @@ def bench_kernels():
         CUDA library kernel behind the reference's
         phi/kernels/gpu/flash_attn_kernel.cu:517 is ~60% MFU class)."""
         try:
+            # roofline pricing rides the SAME traced program, captured
+            # via eval_shape BEFORE the first real call (jit caching
+            # would skip tracing afterwards) — modeled bytes/FLOPs from
+            # the cost model, not the hand bytes_moved estimates
+            rspecs = []
+            if roofline_on:
+                try:
+                    with capture_kernel_launches() as rspecs:
+                        jax.eval_shape(pallas_fn, *args)
+                except Exception:  # noqa: BLE001 — pricing is optional
+                    rspecs = []
             got = np.asarray(jax.block_until_ready(pallas_fn(*args)),
                              np.float32)
             want = np.asarray(jax.block_until_ready(ref_fn(*args)),
                               np.float32)
             err = float(np.max(np.abs(got - want)))
             case = {"max_err": round(err, 5), "ok": err < tol}
+            us_p = None
             if not interp:
                 us_p = timed(pallas_fn, *args)
                 us_x = timed(ref_fn, *args)
@@ -2120,6 +2215,19 @@ def bench_kernels():
                 if bytes_moved is not None:
                     case["bw_frac"] = round(
                         bytes_moved / (us_p * 1e-6) / _peak_bw(), 4)
+            if rspecs:
+                memo = {}
+                b = sum(modeled_launch_bytes(s, memo)["total_bytes"]
+                        for s in rspecs)
+                fl = [modeled_flops(s) for s in rspecs]
+                f = sum(x for x in fl if x) if any(fl) else None
+                rp = roofline_point(b, f, time_us=us_p)
+                case.update(
+                    bytes_modeled=int(b), flops_modeled=f,
+                    intensity=rp["intensity"], bound=rp["bound"],
+                    achieved_bw_frac=rp["achieved_bw_frac"],
+                    achieved_flops_frac=rp["achieved_flops_frac"],
+                    kernel_launches=sorted({s.name for s in rspecs}))
             res["cases"][name] = case
         except Exception as e:  # noqa: BLE001 — record, keep going
             import re
@@ -2466,6 +2574,24 @@ def bench_kernels():
     record("layer_norm", jax.jit(layer_norm_pallas), jax.jit(ref_ln),
            X, LW, LB, tol=6.5e-2, bytes_moved=X.size * 2 * 2)
 
+    # ---- fused residual-add + RMSNorm (decoder-block epilogue) ---------
+    # both outputs (new residual stream y AND normed h) concatenated so
+    # neither side can dead-code-eliminate half the kernel
+    RD = jax.random.normal(qk[0], X.shape, jnp.bfloat16) * 0.1
+
+    def _res_cat(fn):
+        def run(d, x, w):
+            y, h = fn(d, x, w)
+            return jnp.concatenate([y.astype(jnp.float32).ravel(),
+                                    h.astype(jnp.float32).ravel()])
+        return run
+
+    # reads delta+x, writes y+h — 4 bf16 row streams
+    record("residual_rms_norm",
+           jax.jit(_res_cat(residual_rms_norm_pallas)),
+           jax.jit(_res_cat(residual_rms_norm_ref)),
+           RD, X, W, tol=3e-2, bytes_moved=X.size * 2 * 4)
+
     # ---- fused training kernels (Liger-style hot path) -----------------
     # each case times the full fwd+bwd the trainer runs (grads
     # concatenated into ONE array so both variants must compute every
@@ -2539,6 +2665,13 @@ def bench_kernels():
            jax.jit(lambda x, w, g: _rms_bwd_cat(
                *_rms_bwd_ref(1e-6, (x, w), g))),
            nx, nw, ng, tol=2e-2, bytes_moved=nx.size * 4 * 4)
+
+    # ---- roofline observatory report (BENCH_ROOFLINE=0 opts out) -------
+    if roofline_on:
+        try:
+            res["roofline"] = _roofline_report()
+        except Exception as e:  # noqa: BLE001 — the report must not
+            res["roofline"] = {"error": str(e)[:200]}  # sink the bench
 
     n_ok = sum(1 for c in res["cases"].values() if c.get("ok"))
     res.update(metric="pallas_kernels_ok", value=n_ok,

@@ -34,7 +34,8 @@ from .rules import Finding
 
 __all__ = ["KernelCase", "kernel_cases", "capture_case", "audit_kernels",
            "audit_kernel_registry", "build_demo_kernel_regression",
-           "ALL_KERNEL_NAMES", "KERNEL_CASE_NAMES"]
+           "ALL_KERNEL_NAMES", "KERNEL_CASE_NAMES", "FLOP_FORMULAS",
+           "modeled_flops", "flop_formula_findings"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -480,6 +481,217 @@ ALL_KERNEL_NAMES = frozenset(
     k for c in kernel_cases() for k in c.kernels)
 
 
+# -- modeled FLOPs (the roofline numerator) -----------------------------
+# One formula per audited launch name, evaluated on the CAPTURED
+# KernelLaunchSpec, so the model prices the geometry that actually
+# launched (quantized weight tiles keep their output dim, so the dense
+# matmul FLOPs extract unchanged from the packed shapes). Conventions:
+# a matmul [m,k]x[k,n] is 2mkn; softmax/norm elementwise work is
+# charged at small documented constants; causal halving in flash
+# attention and live-page raggedness in paged attention are
+# DELIBERATELY ignored — the model is the max-traffic full-table
+# bound, matching the bytes model's full-sample page walk.
+
+
+def _prod(shape) -> int:
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def _pool_dims(spec):
+    """(page_size, head_dim) from the first 4-d (N, BS, KV, hd) KV-pool
+    operand of a paged kernel."""
+    for op in spec.inputs:
+        if len(op.shape) == 4:
+            return int(op.shape[1]), int(op.shape[3])
+    raise ValueError(f"{spec.name}: no 4-d KV-pool operand")
+
+
+def _flops_rms_fwd(spec):
+    # square + mean-reduce + rsqrt-scale + weight mul ≈ 4 flops/elem
+    return 4.0 * _prod(spec.inputs[0].shape)
+
+
+def _flops_rms_bwd(spec):
+    # recompute the norm (4) + dx chain rule (~5) + dw accumulate (1)
+    return 10.0 * _prod(spec.inputs[0].shape)
+
+
+def _flops_res_rms_fwd(spec):
+    # the residual add (1) + the rms_norm_fwd epilogue (4)
+    return 5.0 * _prod(spec.inputs[0].shape)
+
+
+def _flops_layer_norm_fwd(spec):
+    # mean + centered variance + rsqrt-scale + affine ≈ 6 flops/elem
+    return 6.0 * _prod(spec.inputs[0].shape)
+
+
+def _flops_adamw(spec):
+    # moment updates (6) + bias correction + decoupled decay + step (6)
+    return 12.0 * _prod(spec.inputs[0].shape)
+
+
+def _flops_paged_decode(spec):
+    B, H, hd = (int(s) for s in spec.inputs[0].shape)
+    MB = int(spec.prefetch[0][0][1])
+    BS, _ = _pool_dims(spec)
+    # q·K (2) + p·V (2) over the full block table per head
+    return 4.0 * B * H * hd * MB * BS
+
+
+def _flops_decode_attn_block(spec):
+    B, D = (int(s) for s in spec.inputs[0].shape)
+    Hhd = int(spec.inputs[2].shape[1])
+    KVhd = int(spec.inputs[3].shape[1])
+    MB = int(spec.prefetch[0][0][1])
+    BS, _ = _pool_dims(spec)
+    # norm (4/elem) + q/k/v/o projections (2mkn each) + full-table
+    # attention (4 per head-dim element per key position)
+    return B * (4.0 * D + 2.0 * D * Hhd + 4.0 * D * KVhd
+                + 2.0 * Hhd * D + 4.0 * Hhd * MB * BS)
+
+
+def _flops_decode_mlp_block(spec):
+    B, D = (int(s) for s in spec.inputs[0].shape)
+    F = int(spec.inputs[2].shape[1])
+    # norm + gate/up/down matmuls + silu·mul epilogue (~4/f-elem)
+    return B * (4.0 * D + 6.0 * D * F + 4.0 * F)
+
+
+def _flops_decode_block_fused(spec):
+    B, D = (int(s) for s in spec.inputs[0].shape)
+    Hhd = int(spec.inputs[2].shape[1])
+    KVhd = int(spec.inputs[3].shape[1])
+    F = int(spec.inputs[7].shape[1])
+    MB = int(spec.prefetch[0][0][1])
+    BS, _ = _pool_dims(spec)
+    # the attn-block sum + the mlp-block sum (two norms: 4D each)
+    return B * (8.0 * D + 2.0 * D * Hhd + 4.0 * D * KVhd
+                + 2.0 * Hhd * D + 4.0 * Hhd * MB * BS
+                + 6.0 * D * F + 4.0 * F)
+
+
+def _flops_prefill_attn_block(spec):
+    P, D = (int(s) for s in spec.inputs[0].shape)
+    Hhd = int(spec.inputs[2].shape[1])
+    KVhd = int(spec.inputs[3].shape[1])
+    MB = int(spec.prefetch[0][0][0])
+    BS, _ = _pool_dims(spec)
+    # norm + projections + pool-direct flash over the FULL paged
+    # history (causal masking inside the window is ignored)
+    return (4.0 * P * D + 2.0 * P * D * Hhd + 4.0 * P * D * KVhd
+            + 2.0 * P * Hhd * D + 4.0 * P * Hhd * MB * BS)
+
+
+def _flash_dims(spec):
+    bh, sq, d = (int(s) for s in spec.inputs[0].shape)
+    sk = int(spec.inputs[1].shape[1])
+    return bh, sq, sk, d
+
+
+def _flops_flash_fwd(spec):
+    bh, sq, sk, d = _flash_dims(spec)
+    # qk^T (2) + p·v (2); causal halving deliberately ignored
+    return 4.0 * bh * sq * sk * d
+
+
+def _flops_flash_bwd_dq(spec):
+    bh, sq, sk, d = _flash_dims(spec)
+    # recompute s (2) + dp = do·v^T (2) + dq = ds·k (2)
+    return 6.0 * bh * sq * sk * d
+
+
+def _flops_flash_bwd_dkv(spec):
+    bh, sq, sk, d = _flash_dims(spec)
+    # recompute s (2) + dp (2) + dv = p^T·do (2) + dk = ds^T·q (2)
+    return 8.0 * bh * sq * sk * d
+
+
+def _ce_dims(spec):
+    T, D = (int(s) for s in spec.inputs[0].shape)
+    V = int(spec.inputs[1].shape[1])
+    return T, D, V
+
+
+def _flops_ce_fwd(spec):
+    T, D, V = _ce_dims(spec)
+    # logits matmul (2TDV) + online-lse exp/accumulate (~3/logit)
+    return 2.0 * T * D * V + 3.0 * T * V
+
+
+def _flops_ce_bwd(spec):
+    T, D, V = _ce_dims(spec)
+    # recompute logits (2TDV) + coef matmul for dx / dhead (2TDV)
+    return 4.0 * T * D * V
+
+
+def _flops_swiglu_fwd(spec):
+    # silu (≈4: sigmoid + mul) + gate·up mul
+    return 5.0 * _prod(spec.inputs[0].shape)
+
+
+def _flops_swiglu_bwd(spec):
+    # recompute silu/sigmoid chain + both input grads
+    return 10.0 * _prod(spec.inputs[0].shape)
+
+
+#: launch name -> FLOPs formula over the captured spec. The coverage
+#: contract: every ALL_KERNEL_NAMES member must have an entry —
+#: :func:`flop_formula_findings` turns a gap into a gate finding.
+FLOP_FORMULAS: Dict[str, Callable] = {
+    "rms_norm_fwd": _flops_rms_fwd,
+    "rms_norm_bwd": _flops_rms_bwd,
+    "residual_rms_norm_fwd": _flops_res_rms_fwd,
+    "layer_norm_fwd": _flops_layer_norm_fwd,
+    "fused_adamw": _flops_adamw,
+    "paged_attention_decode": _flops_paged_decode,
+    "decode_attn_block": _flops_decode_attn_block,
+    "decode_mlp_block": _flops_decode_mlp_block,
+    "decode_block_fused": _flops_decode_block_fused,
+    "prefill_attn_block": _flops_prefill_attn_block,
+    "flash_attention_fwd": _flops_flash_fwd,
+    "flash_attention_bwd_dq": _flops_flash_bwd_dq,
+    "flash_attention_bwd_dkv": _flops_flash_bwd_dkv,
+    "linear_ce_fwd": _flops_ce_fwd,
+    "linear_ce_bwd_dx": _flops_ce_bwd,
+    "linear_ce_bwd_dh": _flops_ce_bwd,
+    "swiglu_fwd": _flops_swiglu_fwd,
+    "swiglu_bwd": _flops_swiglu_bwd,
+}
+
+
+def modeled_flops(spec) -> Optional[float]:
+    """Modeled FLOPs for one captured launch, or None when the kernel
+    has no registered formula (a FLOP_FORMULA_GAP finding, not a
+    silent zero)."""
+    fn = FLOP_FORMULAS.get(spec.name)
+    if fn is None:
+        return None
+    return float(fn(spec))
+
+
+def flop_formula_findings() -> List[Finding]:
+    """COVERAGE_GAP-style findings for audited kernels with no flop
+    formula — the no-silent-caps rule applied to the roofline
+    numerator: a kernel the catalog audits but the cost model cannot
+    price would silently fall out of every roofline report."""
+    out = []
+    for name in sorted(ALL_KERNEL_NAMES - set(FLOP_FORMULAS)):
+        out.append(Finding(
+            rule="kernel_auditor", code="FLOP_FORMULA_GAP",
+            severity="error", program="flop_formulas", site=name,
+            message=(f"audited kernel {name!r} has no registered flop "
+                     "formula in kernel_catalog.FLOP_FORMULAS — its "
+                     "roofline row would silently report no model; "
+                     "register a formula next to its cases"),
+            detail={"kernel": name,
+                    "registered": sorted(FLOP_FORMULAS)}))
+    return out
+
+
 def capture_case(case: KernelCase):
     """Trace one case under launch capture. Returns (specs, error)."""
     import jax
@@ -611,6 +823,14 @@ def audit_kernels(names: Optional[List[str]] = None,
     reports = [audit_case(c) for c in cases]
     if registry_lint:
         reports.append(audit_kernel_registry())
+        # the roofline cost model's coverage half rides the same gate:
+        # an audited kernel without a flop formula is a finding, so
+        # FLOP_FORMULAS can never silently lag ALL_KERNEL_NAMES
+        flops_report = AuditReport(program="flop_formulas",
+                                   rules_run=["flop_formulas"])
+        flops_report.findings.extend(flop_formula_findings())
+        flops_report.meta["registered"] = sorted(FLOP_FORMULAS)
+        reports.append(flops_report)
     return reports
 
 

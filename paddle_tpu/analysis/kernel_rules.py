@@ -59,7 +59,7 @@ import numpy as np
 from .rules import Finding
 
 __all__ = ["KERNEL_RULE_CODES", "check_launch", "dispatch_key_rule",
-           "scoped_vmem_envelope"]
+           "scoped_vmem_envelope", "modeled_launch_bytes"]
 
 KERNEL_RULE_CODES = ("GRID_FLOOR_DROP", "OOB_BLOCK", "WRITE_RACE",
                      "VMEM_OVERCOMMIT", "SCRATCH_MISMATCH",
@@ -105,7 +105,34 @@ def _cdiv(a: int, b: int) -> int:
     return -(-a // b)
 
 
-def _prefetch_samples(spec, ramp: bool = False) -> List[np.ndarray]:
+class _ClampedTable:
+    """ndarray stand-in whose ``__getitem__`` clamps every integer
+    index component into the array's extent. The ``full`` prefetch
+    sample (below) fills sequence lengths with huge values so a
+    length-clamped page walk (``clamped_page_index``: ``idx =
+    min(step, (len-1)//BS)``) advances a FRESH table entry per grid
+    step instead of collapsing onto entry 0 — but that same huge
+    length lets the computed table index run past the table extent on
+    ragged last steps, which would IndexError on a bare ndarray. The
+    clamp keeps the dereference legal without changing the property
+    being probed (does the fetched coordinate CHANGE step to step)."""
+
+    def __init__(self, arr):
+        self._arr = arr
+        self.shape = arr.shape
+        self.dtype = arr.dtype
+
+    def __getitem__(self, idx):
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        clamped = tuple(
+            min(max(int(i), 0), self._arr.shape[d] - 1)
+            for d, i in enumerate(idx))
+        return self._arr[clamped]
+
+
+def _prefetch_samples(spec, ramp: bool = False,
+                      full: bool = False) -> List:
     """Stand-ins for the scalar-prefetch operands. The default is
     zero-filled: a zero table is always a VALID table (page 0 exists
     whenever the pool is non-empty), so bounds proven on it are proofs
@@ -116,14 +143,25 @@ def _prefetch_samples(spec, ramp: bool = False) -> List[np.ndarray]:
     (on the all-zero table every page fetch collapses to page 0 and a
     streamed, double-buffered operand would masquerade as a resident
     constant block); {0, 1} stays in range for any table whose target
-    extent is >= 2, and the ramp is never used for bounds findings."""
+    extent is >= 2, and the ramp is never used for bounds findings.
+    ``full=True`` fills ints with ``arange + 2**20`` wrapped in a
+    :class:`_ClampedTable` — used ONLY by the bytes model: huge
+    sequence lengths defeat the length clamp in data-dependent page
+    maps so every grid step walks a fresh table entry (the
+    max-traffic table), and distinct table entries make each fetch a
+    distinct page. Never used for bounds findings either."""
     out = []
     for shape, dtype in spec.prefetch:
         try:
             dt = np.dtype(dtype)
         except TypeError:
             dt = np.int32
-        if ramp and np.issubdtype(dt, np.integer):
+        if full and np.issubdtype(dt, np.integer):
+            n = int(np.prod(shape or (1,), dtype=np.int64))
+            arr = (np.arange(n, dtype=np.int64)
+                   + (1 << 20)).astype(dt).reshape(shape)
+            out.append(_ClampedTable(arr))
+        elif ramp and np.issubdtype(dt, np.integer):
             n = int(np.prod(shape or (1,), dtype=np.int64))
             out.append((np.arange(n, dtype=dt) % 2).reshape(shape))
         else:
@@ -131,8 +169,8 @@ def _prefetch_samples(spec, ramp: bool = False) -> List[np.ndarray]:
     return out
 
 
-def _operand_coords(spec, op, _memo=None,
-                    ramp: bool = False) -> Optional[Dict[Tuple, Tuple]]:
+def _operand_coords(spec, op, _memo=None, ramp: bool = False,
+                    full: bool = False) -> Optional[Dict[Tuple, Tuple]]:
     """grid point -> block coordinates for one operand, evaluated
     concretely over the FULL grid. None for whole-array operands
     (memory-space specs: no index map, no blocking). ``_memo`` (keyed
@@ -140,10 +178,10 @@ def _operand_coords(spec, op, _memo=None,
     walk of the grid per operand, not one per rule."""
     if op.block_shape is None or op.index_map is None:
         return None
-    key = (id(op), ramp)
+    key = (id(op), ramp, full)
     if _memo is not None and key in _memo:
         return _memo[key]
-    samples = _prefetch_samples(spec, ramp=ramp)
+    samples = _prefetch_samples(spec, ramp=ramp, full=full)
     coords: Dict[Tuple, Tuple] = {}
     for point in itertools.product(*(range(g) for g in spec.grid)):
         # np.int32 grid indices: the all-int32 index maps (e.g. the
@@ -377,6 +415,99 @@ def _vmem_findings(spec, program, memo) -> List[Finding]:
              "fused_budget_bytes": spec.vmem_budget,
              "windows": parts}))
     return out
+
+
+# -- HBM traffic model (roofline numerator) -----------------------------
+
+
+def _transition_count(coords) -> int:
+    """Block fetches for one operand under Mosaic's revisit elision:
+    one for the first grid step plus one per consecutive-step
+    coordinate CHANGE. ``coords`` preserves the ``itertools.product``
+    walk order, which is the sequential TPU grid order, so a block
+    that only changes on the outer grid dim is charged once per outer
+    step — exactly the pipeline's refetch behaviour. A constant-index
+    (resident) operand degenerates to 1."""
+    it = iter(coords.values())
+    try:
+        prev = next(it)
+    except StopIteration:
+        return 1
+    n = 1
+    for c in it:
+        if c != prev:
+            n += 1
+            prev = c
+    return n
+
+
+def _operand_fetches(spec, op, memo) -> Optional[int]:
+    """Modeled HBM block fetches for one operand, or None for a
+    whole-array operand. Static maps are counted on the zero sample;
+    data-dependent (scalar-prefetch-dereferencing) maps are ALSO
+    probed on the ``full`` clamped sample — huge lengths + distinct
+    table entries — and the max taken, because on the zero sample a
+    page walk collapses onto page 0 and would masquerade as resident
+    (the same failure mode the VMEM window model's ramp re-probe
+    guards against, but here the 0/1 ramp still underestimates: the
+    model must charge one fetch per DISTINCT page, not per parity
+    flip)."""
+    coords = _operand_coords(spec, op, memo)
+    if coords is None:
+        return None
+    fetches = _transition_count(coords)
+    if spec.num_scalar_prefetch:
+        full = _operand_coords(spec, op, memo, full=True)
+        if full:
+            fetches = max(fetches, _transition_count(full))
+    return fetches
+
+
+def modeled_launch_bytes(spec, memo: Optional[Dict] = None) -> Dict:
+    """Modeled HBM traffic for one captured launch.
+
+    The same window walk the ``VMEM_OVERCOMMIT`` rule does, summed
+    over the full grid instead of maxed over one step: every blocked
+    operand is charged ``block_bytes ×`` its :func:`_operand_fetches`
+    transition count (streamed operands pay once per revisit-elided
+    refetch, resident constant-index operands pay exactly once),
+    whole-array operands are charged their array bytes once, SMEM
+    operands and scratch charge nothing (scalars / VMEM-only). The
+    model deliberately ignores accumulator read-modify-write traffic
+    (revisited output blocks stay in VMEM between visits — that is
+    what ``accum_outputs`` declares) and assumes a perfect pipeline
+    (no redundant refetch of an unchanged window).
+
+    Returns ``{"total_bytes", "read_bytes", "written_bytes",
+    "operands": [{"operand", "fetches", "bytes"} ...]}``.
+    """
+    if memo is None:
+        memo = {}
+    read = written = 0
+    detail = []
+    for kind, ops in (("in", spec.inputs), ("out", spec.outputs)):
+        for i, op in enumerate(ops):
+            if op.space == "smem":
+                continue
+            fetches = _operand_fetches(spec, op, memo)
+            if fetches is None:
+                fetches = 1
+                nbytes = int(np.prod(op.shape or (1,),
+                                     dtype=np.int64)) \
+                    * _itemsize(op.dtype)
+            else:
+                block = _norm_block(op.block_shape)
+                nbytes = fetches \
+                    * int(np.prod(block, dtype=np.int64)) \
+                    * _itemsize(op.dtype)
+            if kind == "in":
+                read += nbytes
+            else:
+                written += nbytes
+            detail.append({"operand": f"{kind}{i}",
+                           "fetches": fetches, "bytes": nbytes})
+    return {"total_bytes": read + written, "read_bytes": read,
+            "written_bytes": written, "operands": detail}
 
 
 def _scratch_findings(spec, program) -> List[Finding]:

@@ -678,6 +678,10 @@ class DisaggregatedEngine:
         c["scheduler"] = sched
         c["groups"] = {"prefill": self.prefill.metrics(),
                        "decode": self.decode.metrics()}
+        # decode-variant roofline attribution belongs to the group
+        # that runs decode steps (both groups also carry their own
+        # under c["groups"])
+        c["roofline"] = self.decode._roofline_metrics()
         if self._obs is not None:
             obs = self._obs
             c["latency"] = obs.latency_snapshot()
@@ -741,8 +745,11 @@ class DisaggregatedEngine:
         return self._obs
 
     def export_trace(self, path: str) -> str:
+        from ..observability.roofline import roofline_chrome_events
         return self._require_obs().export_chrome(
-            path, process_name="paddle_tpu disagg serving")
+            path, process_name="paddle_tpu disagg serving",
+            extra_events=roofline_chrome_events(
+                self.decode._roofline_metrics()))
 
     def write_timeline(self, path: str) -> str:
         return self._require_obs().write_jsonl(
@@ -750,7 +757,9 @@ class DisaggregatedEngine:
                           "disaggregated": True,
                           "capacity": self.capacity,
                           "prefill_slots": self.prefill_slots,
-                          "block_size": self.block_size})
+                          "block_size": self.block_size,
+                          "roofline":
+                              self.decode._roofline_metrics()})
 
     # -- static program audit -----------------------------------------
     def program_specs(self, register: bool = True):

@@ -75,6 +75,14 @@ FLEET_HISTOGRAMS = FLEET_SHARED_HISTOGRAMS + ("step_ms",)
 _POLICIES = ("prefix", "least_loaded", "round_robin")
 
 
+def _replica_roofline(engine) -> Dict[str, object]:
+    # a DisaggregatedEngine replica prices its decode GROUP's arms;
+    # every other engine kind models its own
+    if hasattr(engine, "_roofline_metrics"):
+        return engine._roofline_metrics()
+    return engine.decode._roofline_metrics()
+
+
 class _Replica:
     """Router-side handle: the engine plus its cached tree summary."""
 
@@ -380,6 +388,10 @@ class ServingFleet:
             },
             "offload": off,
             "replicas": rm,
+            # per-replica decode-variant roofline attribution (a mixed
+            # fleet's replicas price against different dims/quant)
+            "roofline": {r.name: _replica_roofline(r.engine)
+                         for r in self._replicas},
         }
         if self._obs is not None:
             obs = self._obs
@@ -427,14 +439,31 @@ class ServingFleet:
         return self._obs
 
     def export_trace(self, path: str) -> str:
+        from ..observability.roofline import roofline_chrome_events
+        events = []
+        for r in self._replicas:
+            report = _replica_roofline(r.engine)
+            report = {"variants": {
+                f"{r.name}:{k}": v
+                for k, v in report["variants"].items()}}
+            events.extend(roofline_chrome_events(report))
         return self._require_obs().export_chrome(
-            path, process_name="paddle_tpu serving fleet")
+            path, process_name="paddle_tpu serving fleet",
+            extra_events=events)
 
     def write_timeline(self, path: str) -> str:
+        # the summary tooling reads header["roofline"]["variants"]:
+        # report the FIRST replica's arm model there (fleets are
+        # homogeneous in practice) and the full per-replica map beside
+        roof = {r.name: _replica_roofline(r.engine)
+                for r in self._replicas}
+        first = self._replicas[0].name if self._replicas else None
         return self._require_obs().write_jsonl(
             path, header={"mode": "serving", "fleet": True,
                           "policy": self.policy,
-                          "replicas": [r.name for r in self._replicas]})
+                          "replicas": [r.name for r in self._replicas],
+                          "roofline": roof.get(first),
+                          "roofline_replicas": roof})
 
     # -- static program audit -----------------------------------------
     def program_specs(self, register: bool = True):

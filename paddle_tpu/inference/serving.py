@@ -813,6 +813,59 @@ class ServingEngine:
                 "block": v["block"], "attn": v["attn"],
                 "mlp": v["mlp"]}
 
+    def _active_arm(self) -> str:
+        """Which roofline arm the live decode step runs: the
+        single-launch block kernel, the two-kernel fused composition,
+        or the unfused reference."""
+        v = self.decode_variant
+        if v.get("block") == "pallas_block":
+            return "pallas_block"
+        if str(v.get("attn", "")).startswith("pallas"):
+            return "pallas_fused"
+        return "unfused"
+
+    def _roofline_metrics(self) -> Dict:
+        """Per-decode-variant modeled HBM bytes/step + the
+        bandwidth-bound step-time floor (``observability/roofline``'s
+        closed-form arm model × layers + the lm-head read), with the
+        achieved-bandwidth fraction filled for the ACTIVE arm when a
+        measured ``decode_step_ms`` distribution exists. Pure host
+        arithmetic on the engine's static dims, computed on demand —
+        the disabled-observability hot path still allocates nothing."""
+        import jax.numpy as jnp
+
+        from ..observability.roofline import (decode_roofline,
+                                              decode_step_bytes)
+
+        cfg = self.cfg
+        tp = 1 if self._mesh is None else self._mesh.tp
+        act = jnp.dtype(cfg.dtype).itemsize
+        pool = jnp.dtype(self._k_pools.dtype).itemsize
+        wbytes = {"int8": 1.0, "int4": 0.5}.get(self._wq or "",
+                                                float(act))
+        L = cfg.num_hidden_layers
+        per_layer = decode_step_bytes(
+            self.capacity, cfg.hidden_size,
+            cfg.num_attention_heads // tp,
+            cfg.num_key_value_heads // tp, cfg.head_dim,
+            cfg.intermediate_size // tp, self.block_size,
+            self.max_blocks, act_itemsize=act, weight_itemsize=wbytes,
+            pool_itemsize=pool)
+        head = cfg.vocab_size * cfg.hidden_size * act
+        step_bytes = {k: int(v * L + head)
+                      for k, v in per_layer.items()}
+        active = self._active_arm()
+        measured = {}
+        if self._obs is not None:
+            snap = self._obs.registry.histogram(
+                "decode_step_ms").snapshot()
+            if snap["count"]:
+                measured[active] = snap["mean"] * 1e3
+        r = decode_roofline(step_bytes, measured_us=measured)
+        r["active"] = active
+        r["layers"] = L
+        return r
+
     @property
     def idle(self) -> bool:
         return not self._queue and all(
@@ -929,6 +982,7 @@ class ServingEngine:
         c["decode_variant"] = self.decode_variant
         c["prefill_variant"] = self.prefill_variant
         c["weight_quant_variant"] = self.weight_quant_variant
+        c["roofline"] = self._roofline_metrics()
         c["scheduler"] = self._scheduler_metrics()
         if self._pcache is not None:
             c["prefix_cache"] = self._pcache.metrics()
@@ -1016,16 +1070,23 @@ class ServingEngine:
 
     def export_trace(self, path: str) -> str:
         """Write the request-lifecycle chrome trace (+ gauge counter
-        tracks) to ``path`` — open in Perfetto / chrome://tracing."""
-        return self._require_obs().export_chrome(path)
+        tracks + the per-arm roofline annotation track) to ``path`` —
+        open in Perfetto / chrome://tracing."""
+        from ..observability.roofline import roofline_chrome_events
+        return self._require_obs().export_chrome(
+            path,
+            extra_events=roofline_chrome_events(self._roofline_metrics()))
 
     def write_timeline(self, path: str) -> str:
         """Write the structured per-phase JSONL (events + per-request
-        records) to ``path`` — input for tools/trace_summary.py."""
+        records) to ``path`` — input for tools/trace_summary.py. The
+        meta header carries the per-arm roofline model so the summary
+        can print measured step time against the bandwidth floor."""
         return self._require_obs().write_jsonl(
             path, header={"capacity": self.capacity,
                           "num_blocks": self.num_blocks,
-                          "block_size": self.block_size})
+                          "block_size": self.block_size,
+                          "roofline": self._roofline_metrics()})
 
     # -- scheduling ---------------------------------------------------
     def _temp_of(self, gen: GenerationConfig) -> float:

@@ -24,8 +24,10 @@ from collections import deque
 from typing import Dict, Optional, Sequence
 
 from .compile import (CompileWatcher, HostGapDetector, device_peak_flops,
-                      live_hbm_bytes)
+                      device_peak_hbm_bw, live_hbm_bytes)
 from .metrics import Gauge, Histogram, MetricsRegistry
+from .roofline import (capture_kernel_costs, decode_roofline,
+                       decode_step_bytes, kernel_cost, roofline_point)
 from .stall import dump_path_for, dump_stall
 from .timeline import Timeline, TimelineEvent
 from .watchdog import RetraceWatchdog
@@ -33,7 +35,9 @@ from .watchdog import RetraceWatchdog
 __all__ = ["Observability", "MetricsRegistry", "Histogram", "Gauge",
            "Timeline", "TimelineEvent", "RetraceWatchdog", "dump_stall",
            "CompileWatcher", "HostGapDetector", "device_peak_flops",
-           "live_hbm_bytes", "LATENCY_HISTOGRAMS", "TRAIN_HISTOGRAMS"]
+           "device_peak_hbm_bw", "live_hbm_bytes", "kernel_cost",
+           "roofline_point", "capture_kernel_costs", "decode_step_bytes",
+           "decode_roofline", "LATENCY_HISTOGRAMS", "TRAIN_HISTOGRAMS"]
 
 # the latency histograms every engine window reports (schema-stable:
 # tests freeze this set — extend deliberately, never ad hoc)
@@ -173,13 +177,15 @@ class Observability:
                 for name, g in sorted(self.registry.gauges.items())}
 
     def export_chrome(self, path: str,
-                      process_name: str = "paddle_tpu serving") -> str:
+                      process_name: str = "paddle_tpu serving",
+                      extra_events=None) -> str:
         extra = None
         if self._flight is not None:
             extra = self._flight.to_host_events()
         return self.timeline.export_chrome(
             path, gauges=self.registry.gauges,
-            process_name=process_name, extra_host_events=extra)
+            process_name=process_name, extra_host_events=extra,
+            extra_events=extra_events)
 
     def write_jsonl(self, path: str, header: Optional[Dict] = None
                     ) -> str:

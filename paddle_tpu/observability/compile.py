@@ -30,34 +30,60 @@ import warnings
 from typing import Dict, Optional
 
 __all__ = ["CompileWatcher", "HostGapDetector", "device_peak_flops",
-           "live_hbm_bytes"]
+           "device_peak_hbm_bw", "live_hbm_bytes"]
 
-# nominal peak dense-matmul FLOPs/s per chip by TPU generation (bf16).
-# The ONE peak table — bench.py's formula MFU delegates here, so the
-# two MFU fields in a capture can never disagree on the denominator
+# nominal per-chip peaks by TPU generation: dense-matmul FLOPs/s (bf16)
+# and HBM bandwidth (bytes/s). The ONE peak table pair — bench.py's
+# formula MFU and its bandwidth-utilisation column both delegate here,
+# so no two roofline denominators in a capture can ever disagree. The
+# DEFAULTS (v5e: 197 TFLOP/s, 819 GB/s) live in the tables too, keyed
+# by _DEFAULT_GEN, so the labelled-default contract reads the same
+# numbers the generation match does.
+_DEFAULT_GEN = "v5e"
 _PEAK_FLOPS = {"v4": 275e12, "v5e": 197e12, "v5litepod": 197e12,
                "v5p": 459e12, "v6e": 918e12}
+_PEAK_HBM_BW = {"v4": 1228e9, "v5e": 819e9, "v5litepod": 819e9,
+                "v5p": 2765e9, "v6e": 1640e9}
 
 
-def device_peak_flops(default: float = 197e12):
-    """Best-effort peak FLOPs/s per chip: ``(value, source)``.
-
-    Order: ``PADDLE_TPU_PEAK_FLOPS`` env override (exact hardware known
-    to the operator) > ``PALLAS_AXON_TPU_GEN`` generation table > the
-    v5e default. The source string rides into ``metrics()`` so an MFU
-    computed against an *assumed* peak is labelled as such.
-    """
-    env = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
+def _device_peak(table, env_var):
+    """Shared peak-lookup contract: ``(value, source)`` in the order
+    env override (exact hardware known to the operator) >
+    ``PALLAS_AXON_TPU_GEN`` generation table > the labelled v5e
+    default. The source string rides into ``metrics()`` and the
+    roofline reports so a fraction computed against an *assumed* peak
+    is labelled as such."""
+    env = os.environ.get(env_var)
     if env:
         try:
             return float(env), "env"
         except ValueError:
             pass
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    for k, v in _PEAK_FLOPS.items():
+    for k, v in table.items():
         if gen.startswith(k):
             return v, f"gen:{k}"
-    return default, "default:v5e"
+    return table[_DEFAULT_GEN], f"default:{_DEFAULT_GEN}"
+
+
+def device_peak_flops(default: float = None):
+    """Best-effort peak FLOPs/s per chip: ``(value, source)``.
+
+    Order: ``PADDLE_TPU_PEAK_FLOPS`` env override >
+    ``PALLAS_AXON_TPU_GEN`` generation table > the labelled v5e
+    default (``default``, when given, overrides the table default —
+    the historic signature)."""
+    val, source = _device_peak(_PEAK_FLOPS, "PADDLE_TPU_PEAK_FLOPS")
+    if default is not None and source.startswith("default"):
+        return float(default), source
+    return val, source
+
+
+def device_peak_hbm_bw():
+    """Best-effort peak HBM bandwidth per chip in bytes/s:
+    ``(value, source)``. Same contract as :func:`device_peak_flops`
+    with the ``PADDLE_TPU_PEAK_HBM_BW`` env override."""
+    return _device_peak(_PEAK_HBM_BW, "PADDLE_TPU_PEAK_HBM_BW")
 
 
 def live_hbm_bytes(device=None) -> Optional[int]:

@@ -123,14 +123,16 @@ class Timeline:
 
     def export_chrome(self, path: str, gauges: Optional[Dict] = None,
                       process_name: str = "paddle_tpu serving",
-                      extra_host_events=None) -> str:
+                      extra_host_events=None,
+                      extra_events: Optional[List[Dict]] = None) -> str:
         """Write a chrome-trace json of the ring (plus gauge series as
         counter tracks, plus any pre-built ``extra_host_events`` spans —
-        e.g. the flight recorder's per-rank collective tracks) via the
-        profiler's shared trace writer."""
+        e.g. the flight recorder's per-rank collective tracks — plus
+        raw ``extra_events`` chrome dicts, e.g. the per-kernel roofline
+        annotation track) via the profiler's shared trace writer."""
         from ..profiler.profiler import write_chrome_trace
 
-        extra = []
+        extra = list(extra_events or ())
         for name, g in (gauges or {}).items():
             for t, v in g.series:
                 if t is None:

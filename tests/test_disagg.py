@@ -524,6 +524,8 @@ DISAGG_BASE_KEYS = {
     "wall_time_s", "tokens_generated", "tokens_per_sec",
     "ttft_ms_mean", "ttft_ms_max", "handoff_ms_mean", "handoff_ms_max",
     "scheduler", "groups",
+    # r21: roofline observatory, delegated to the decode group's engine
+    "roofline",
 }
 DISAGG_OBS_KEYS = {"latency", "retrace_warnings", "stall_dumps",
                    "timeline_events", "timeline_dropped",

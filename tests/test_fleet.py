@@ -381,6 +381,8 @@ FLEET_BASE_KEYS = {
     "tokens_generated", "tokens_per_sec", "wall_time_s", "fleet_steps",
     "drain_truncations", "ttft_ms_mean", "ttft_ms_max", "routing",
     "offload", "replicas",
+    # r21: per-replica roofline observatory reports
+    "roofline",
 }
 FLEET_OBS_KEYS = {"latency", "gauges", "retrace_warnings",
                   "stall_dumps", "timeline_events", "timeline_dropped"}

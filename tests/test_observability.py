@@ -91,6 +91,9 @@ BASE_KEYS = {
     # engines; mode/weight_dtype/attn/mlp on weight_quant engines —
     # trace-time snapshot, the decode_variant contract)
     "weight_quant_variant",
+    # r21: roofline observatory (per-variant modeled bytes/step + the
+    # bandwidth-bound step-time floor; present in BOTH obs modes)
+    "roofline",
 }
 OBS_KEYS = {"latency", "gauges", "retrace_warnings", "stall_dumps",
             "timeline_events", "timeline_dropped"}
@@ -170,6 +173,38 @@ def test_metrics_schema_frozen_tp(params):
     assert m2["collectives"]["calls"]["psum@tp"] > 0
     for hist in m2["collectives"]["latency_ms"].values():
         assert set(hist.keys()) == HIST_KEYS
+
+
+@pytest.mark.roofline
+def test_metrics_roofline_schema(params):
+    """The roofline sub-dict (r21) is schema-stable in BOTH obs modes:
+    per-arm modeled bytes/step + the bandwidth-bound step-time floor,
+    the labelled peak pair, the active dispatch arm and layer count."""
+    for obs in (False, True):
+        eng = _engine(params, observability=obs)
+        _run_stream(eng)
+        roof = eng.metrics()["roofline"]
+        assert set(roof.keys()) == {"variants", "peak_hbm_bw",
+                                    "peak_source", "active", "layers"}
+        assert set(roof["variants"].keys()) == {"pallas_block",
+                                                "pallas_fused",
+                                                "unfused"}
+        for row in roof["variants"].values():
+            assert set(row.keys()) == {"bytes_per_step",
+                                       "step_us_at_peak_bw",
+                                       "achieved_bw_frac"}
+            assert row["bytes_per_step"] > 0
+            assert row["step_us_at_peak_bw"] > 0
+        assert roof["active"] in roof["variants"]
+        assert roof["layers"] >= 1
+        # the single-launch arm re-streams MLP tiles per batch row, so
+        # its modeled step traffic can never undercut the two-kernel arm
+        assert roof["variants"]["pallas_block"]["bytes_per_step"] >= \
+            roof["variants"]["pallas_fused"]["bytes_per_step"]
+        # only the obs-enabled engine has a measured mean to attribute
+        if obs:
+            act = roof["variants"][roof["active"]]
+            assert act["achieved_bw_frac"] is not None
 
 
 def test_gauges_sampled_each_step(params):
@@ -462,9 +497,15 @@ def test_enabled_stream_parity_traces_and_exports(params, tmp_path):
     dec = summary["decode"]["variants"]
     assert set(dec) <= {"pallas_block", "pallas_fused", "unfused"}
     assert sum(v["count"] for v in dec.values()) == len(dsteps)
+    # r21: arms the meta roofline header models also carry modeled
+    # bytes/step + the peak-BW step-time floor (and the measured/floor
+    # ratio when the mean is nonzero)
     for v in dec.values():
-        assert set(v.keys()) == {"count", "total_ms", "max_ms",
-                                 "mean_ms"}
+        assert {"count", "total_ms", "max_ms", "mean_ms",
+                "bytes_per_step_modeled",
+                "step_us_at_peak_bw"} <= set(v.keys())
+        assert v["bytes_per_step_modeled"] > 0
+        assert v["step_us_at_peak_bw"] > 0
     assert len(summary["slowest_steps"]) == 5
     r = summary["request_latency"]["ttft_ms"]
     assert r["p50"] <= r["p95"] <= r["p99"] <= r["max"]

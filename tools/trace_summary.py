@@ -43,9 +43,24 @@ def _percentile(sorted_vals, q):
     return sorted_vals[i]
 
 
+class TimelineError(Exception):
+    """A timeline file the summary cannot work from — reported as ONE
+    line on stderr with a nonzero exit, never a traceback (the CLI is
+    scripted after bench runs; a stack trace in the log helps no
+    one)."""
+
+
 def load(path):
     meta, events, requests = {}, [], []
-    with open(path) as f:
+    try:
+        f = open(path)
+    except OSError as e:
+        raise TimelineError(
+            f"cannot read timeline file {path!r}: "
+            f"{e.strerror or e}")
+    malformed = 0
+    parsed = 0
+    with f:
         for ln, line in enumerate(f, 1):
             line = line.strip()
             if not line:
@@ -53,16 +68,28 @@ def load(path):
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                malformed += 1
                 print(f"warning: skipping malformed line {ln}",
                       file=sys.stderr)
                 continue
             kind = rec.get("kind")
             if kind == "meta":
                 meta = rec
+                parsed += 1
             elif kind == "event":
                 events.append(rec)
+                parsed += 1
             elif kind == "request":
                 requests.append(rec)
+                parsed += 1
+    if parsed == 0:
+        if malformed:
+            raise TimelineError(
+                f"{path}: no parseable timeline records "
+                f"({malformed} malformed line(s) — truncated JSONL?)")
+        raise TimelineError(
+            f"{path}: empty timeline file (no meta/event/request "
+            "records)")
     return meta, events, requests
 
 
@@ -108,13 +135,13 @@ def summarize(meta, events, requests, top=10):
     pre = summarize_prefill(events)
     if pre is not None:
         out["prefill"] = pre
-    dec = summarize_decode(events)
+    dec = summarize_decode(events, meta)
     if dec is not None:
         out["decode"] = dec
     return out
 
 
-def summarize_decode(events):
+def summarize_decode(events, meta=None):
     """The decode section: per-variant step attribution from the
     ``decode_variant`` field the engines stamp on each decode_step
     event ("pallas_block" = single-launch block megakernel,
@@ -139,7 +166,27 @@ def summarize_decode(events):
         v["mean_ms"] = round(v["total_ms"] / v["count"], 3)
         v["total_ms"] = round(v["total_ms"], 3)
         v["max_ms"] = round(v["max_ms"], 3)
-    return {"variants": per}
+    # roofline attribution (r21): the meta header carries the engine's
+    # per-arm modeled bytes/step and the bandwidth-bound step-time
+    # floor — pair each measured arm with its floor so the summary
+    # prints "% of roofline", not just raw microseconds
+    roof = (meta or {}).get("roofline") or {}
+    rvars = roof.get("variants") or {}
+    for name, v in per.items():
+        r = rvars.get(name)
+        if not r:
+            continue
+        v["bytes_per_step_modeled"] = r.get("bytes_per_step")
+        v["step_us_at_peak_bw"] = r.get("step_us_at_peak_bw")
+        floor_us = r.get("step_us_at_peak_bw")
+        mean_us = v["mean_ms"] * 1e3
+        if floor_us and mean_us > 0:
+            v["roofline_frac"] = float(f"{floor_us / mean_us:.4g}")
+    out = {"variants": per}
+    if rvars:
+        out["peak_hbm_bw"] = roof.get("peak_hbm_bw")
+        out["peak_source"] = roof.get("peak_source")
+    return out
 
 
 def summarize_prefill(events):
@@ -289,6 +336,24 @@ def render(summary):
                               key=lambda kv: -kv[1]["total_ms"]):
             lines.append(f"{name:<16}{v['count']:>8}{v['total_ms']:>12}"
                          f"{v['mean_ms']:>10}{v['max_ms']:>10}")
+        roofed = [(n, v) for n, v in sorted(dec["variants"].items())
+                  if v.get("step_us_at_peak_bw")]
+        if roofed:
+            src = (dec.get("peak_source") or {}).get("hbm_bw", "?")
+            lines.append(f"roofline (peak HBM BW "
+                         f"{dec.get('peak_hbm_bw', 0) / 1e9:.0f} GB/s, "
+                         f"{src}):")
+            for name, v in roofed:
+                mean_us = v["mean_ms"] * 1e3
+                frac = v.get("roofline_frac")
+                # %.1f would print interpret-scale fractions as 0.0%
+                pct = f"{frac * 100:.3g}%" if frac is not None else "?"
+                lines.append(
+                    f"  {name}: {mean_us:.1f} us measured, "
+                    f"{v['step_us_at_peak_bw']} us at peak BW "
+                    f"-> {pct} of roofline "
+                    f"({v.get('bytes_per_step_modeled', 0)} modeled "
+                    "bytes/step)")
     sched = summary.get("scheduler")
     if sched:
         lines.append("")
@@ -427,7 +492,11 @@ def main(argv=None):
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
     args = ap.parse_args(argv)
-    meta, events, requests = load(args.path)
+    try:
+        meta, events, requests = load(args.path)
+    except TimelineError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     mode = args.mode
     if mode == "auto":
         mode = meta.get("mode", "serving")
