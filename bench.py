@@ -760,9 +760,13 @@ def bench_serving_engine():
     g = GenerationConfig(max_new_tokens=gen_n, greedy=True)
 
     # -- continuous batching (compile warmup outside the timed window) --
+    # BENCH_TELEMETRY=0 opts out of the continuous telemetry plane
+    # (series sampling + burn-rate/anomaly alerting over the run)
+    tel = os.environ.get("BENCH_TELEMETRY", "1") != "0"
     eng = ServingEngine(params, cfg, capacity=cap, block_size=16,
                         max_seq_len=ctx + gen_n, cache_dtype=cdt,
-                        prefill_buckets=(ctx,), observability=True)
+                        prefill_buckets=(ctx,), observability=True,
+                        telemetry=tel)
     eng.submit(prompts[0], GenerationConfig(max_new_tokens=2,
                                             greedy=True))
     eng.drain()
@@ -806,6 +810,15 @@ def bench_serving_engine():
         eng.write_timeline(tl_path)
     except OSError:
         tl_path = None
+    # bank the telemetry series/alert log next to the timeline
+    # (tools/telemetry_summary.py reads it)
+    tel_path = None
+    tel_alerts = None
+    if tel and eng.telemetry is not None:
+        tel_alerts = m["telemetry"]["alerts"]
+        tel_path = eng.telemetry.write_jsonl(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_SERVING_TELEMETRY.jsonl"))
 
     # -- fused-vs-unfused decode A/B (BENCH_SERVE_AB=0 opts out): the
     # same full-capacity burst through the (already warm) fused-decode
@@ -896,6 +909,9 @@ def bench_serving_engine():
                if audit_findings is not None else {}),
             **({"decode_ab": ab} if ab is not None else {}),
             **({"timeline_jsonl": tl_path} if tl_path else {}),
+            **({"telemetry_alerts": tel_alerts}
+               if tel_alerts is not None else {}),
+            **({"telemetry_jsonl": tel_path} if tel_path else {}),
             "requests": R, "capacity": cap, "ctx": ctx, "gen": gen_n,
             "arrival_rate_hz": rate,
             **({"cache_dtype": cdt} if cdt else {})}
@@ -1538,7 +1554,10 @@ def bench_serving_fleet():
             eng.submit(np.concatenate([prompts[0][:pref], wtail])
                        .astype(np.int32), warm)
             eng.drain()
-        fleet = ServingFleet(reps, policy=policy, observability=True)
+        # BENCH_TELEMETRY=0 opts out of the continuous telemetry plane
+        tel = os.environ.get("BENCH_TELEMETRY", "1") != "0"
+        fleet = ServingFleet(reps, policy=policy, observability=True,
+                             telemetry=tel)
         fleet.reset_metrics()
         t0, i = time.perf_counter(), 0
         reqs = []
@@ -1550,6 +1569,12 @@ def bench_serving_fleet():
             if not fleet.step() and i < R:
                 time.sleep(min(max(arrivals[i] - now, 0.0), 0.01))
         wall = time.perf_counter() - t0
+        if fleet.telemetry is not None and policy == "prefix":
+            # bank the per-replica series/alert log for the headline
+            # policy (tools/telemetry_summary.py reads it)
+            fleet.telemetry.write_jsonl(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "BENCH_FLEET_TELEMETRY.jsonl"))
         return fleet.metrics(), wall, [r.output_ids for r in reqs]
 
     def run_mono():
@@ -1611,6 +1636,8 @@ def bench_serving_fleet():
             "cache_hit_ratio": cache_hit_ratio(pfx_m),
             "diverted": pfx_m["routing"]["diverted"],
             "offload": pfx_m["offload"]},
+        **({"telemetry_alerts": pfx_m["telemetry"]["alerts"]}
+           if "telemetry" in pfx_m else {}),
         "round_robin": {
             **side(rr_m, rr_wall),
             "warm_hit_ratio": rr_m["routing"]["warm_hit_ratio"],

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..observability import (CompileWatcher, HostGapDetector,
                              Observability, TRAIN_HISTOGRAMS,
+                             TelemetryConfig, TelemetryPlane,
                              live_hbm_bytes)
 
 __all__ = ["MeshConfig", "make_mesh", "TrainState", "Trainer"]
@@ -183,7 +184,8 @@ class Trainer:
                  moment_dtype=None,
                  observability=False,
                  host_gap_factor: float = 4.0,
-                 host_gap_min_ms: float = 50.0):
+                 host_gap_min_ms: float = 50.0,
+                 telemetry=False):
         """loss_fn(params, *batch) -> scalar. param_specs: pytree of
         PartitionSpec matching params.
 
@@ -239,7 +241,10 @@ class Trainer:
         self.counters = {"steps": 0, "samples": 0, "tokens": 0}
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
-        if observability:
+        # telemetry implies observability (alerts land timeline events
+        # and stall dumps, both owned by the harness)
+        _tcfg = TelemetryConfig.coerce(telemetry)
+        if observability or _tcfg is not None:
             self._obs = (observability
                          if isinstance(observability, Observability)
                          else Observability(histograms=TRAIN_HISTOGRAMS))
@@ -255,6 +260,14 @@ class Trainer:
             self._compile = None
             self._gap = None
             self._compiled_cache = None
+        # continuous telemetry plane (r22): samples metrics() on a
+        # step cadence; None when disabled
+        self._telemetry = None
+        if _tcfg is not None:
+            self._telemetry = TelemetryPlane(
+                _tcfg, on_alert=self._telemetry_alert)
+            self._telemetry.register("trainer", self.metrics,
+                                     counters=self.counters)
 
     # -- state init ----------------------------------------------------------
     @staticmethod
@@ -526,7 +539,10 @@ class Trainer:
                 or self._step_fused != _fused_train_key():
             self._build()
         if self._obs is not None:
-            return self._step_observed(state, batch)
+            out = self._step_observed(state, batch)
+            if self._telemetry is not None:
+                self._telemetry.on_step()
+            return out
         if self._t_first is None:
             self._t_first = time.perf_counter()
         batch = tuple(self._stage_batch(b) for b in batch)
@@ -830,7 +846,34 @@ class Trainer:
                         obs.registry.histograms.items())
                     if name.startswith("collective_")
                     and name.endswith("_ms")}}
+        if self._telemetry is not None:
+            c["telemetry"] = self._telemetry.snapshot()
         return c
+
+    @property
+    def telemetry(self) -> Optional[TelemetryPlane]:
+        """The continuous telemetry plane, or None when disabled."""
+        return self._telemetry
+
+    def _telemetry_alert(self, alert: Dict):
+        """Stamp an ``alert`` timeline event; page-severity alerts also
+        land a flight-recorder dump (the trainer has no scheduler, so
+        the dump carries the throughput counters instead)."""
+        obs = self._obs
+        if obs is None:
+            return
+        obs.timeline.record(
+            "alert", rule=alert.get("rule"),
+            severity=alert.get("severity"), metric=alert.get("metric"),
+            value=alert.get("value"), threshold=alert.get("threshold"))
+        if (alert.get("severity") == "page"
+                and self._telemetry.config.page_dumps):
+            obs.stall_dump(
+                f"telemetry alert: {alert.get('rule')} on "
+                f"{alert.get('metric')}",
+                {"counters": {k: self.counters[k]
+                              for k in self._COUNTER_KEYS}},
+                metrics={"alert": alert})
 
     def reset_metrics(self):
         """Zero the throughput window (e.g. after compile warmup).

@@ -86,7 +86,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..observability import Observability
+from ..observability import (Observability, TelemetryConfig,
+                             TelemetryPlane)
 from .generation import GenerationConfig
 from .serving import (Request, ServingEngine, _collectives_snapshot,
                       _drain_loop)
@@ -197,7 +198,7 @@ class DisaggregatedEngine:
                  observability=False,
                  fused_decode=None, fused_prefill=None,
                  weight_quant=None,
-                 aging_s: Optional[float] = None):
+                 aging_s: Optional[float] = None, telemetry=False):
         pre_mesh, dec_mesh = self._resolve_groups(
             prefill_devices, decode_devices, mesh, prefill_tp,
             collective)
@@ -215,7 +216,10 @@ class DisaggregatedEngine:
             "kv_bytes_transferred": 0, "requests_submitted": 0,
             "drain_truncations": 0,
         }
-        if observability:
+        # telemetry implies observability (alerts land timeline events
+        # and stall dumps, both owned by the harness)
+        _tcfg = TelemetryConfig.coerce(telemetry)
+        if observability or _tcfg is not None:
             self._obs = (observability
                          if isinstance(observability, Observability)
                          else Observability(histograms=DISAGG_HISTOGRAMS))
@@ -268,6 +272,24 @@ class DisaggregatedEngine:
             self.prefill._obs.request_records = self._obs.request_records
             self.decode._obs.request_records = self._obs.request_records
             self._share_histograms()
+        # continuous telemetry plane (r22): the orchestrator rollup
+        # plus each group's engine under a `group` label, so a decode-
+        # side regression is attributable without un-merging the rollup
+        self._telemetry = None
+        if _tcfg is not None:
+            self._telemetry = TelemetryPlane(
+                _tcfg, on_alert=self._telemetry_alert)
+            self._telemetry.register("disagg_engine", self.metrics,
+                                     counters=self.counters,
+                                     skip=("groups",))
+            self._telemetry.register(
+                "disagg_group", self.prefill.metrics,
+                labels={"group": "prefill"},
+                counters=self.prefill.counters, skip=("groups",))
+            self._telemetry.register(
+                "disagg_group", self.decode.metrics,
+                labels={"group": "decode"},
+                counters=self.decode.counters, skip=("groups",))
 
         self.block_size = BS
         self.max_seq_len = msl
@@ -359,6 +381,8 @@ class DisaggregatedEngine:
             if obs is not None:
                 obs.hist("step_ms").observe(
                     (time.perf_counter() - t0) * 1e3)
+        if self._telemetry is not None:
+            self._telemetry.on_step()
         return did
 
     @property
@@ -695,7 +719,32 @@ class DisaggregatedEngine:
             if self._flight is not None:
                 c["collectives"] = _collectives_snapshot(self.counters,
                                                          obs)
+        if self._telemetry is not None:
+            c["telemetry"] = self._telemetry.snapshot()
         return c
+
+    @property
+    def telemetry(self) -> Optional[TelemetryPlane]:
+        """The continuous telemetry plane, or None when disabled."""
+        return self._telemetry
+
+    def _telemetry_alert(self, alert: Dict):
+        """Stamp an ``alert`` timeline event; page-severity alerts also
+        land a flight-recorder dump with the whole-engine scheduler
+        snapshot (both groups + handoff queue)."""
+        obs = self._obs
+        if obs is None:
+            return
+        obs.timeline.record(
+            "alert", rule=alert.get("rule"),
+            severity=alert.get("severity"), metric=alert.get("metric"),
+            value=alert.get("value"), threshold=alert.get("threshold"))
+        if (alert.get("severity") == "page"
+                and self._telemetry.config.page_dumps):
+            obs.stall_dump(
+                f"telemetry alert: {alert.get('rule')} on "
+                f"{alert.get('metric')}", self.scheduler_snapshot(),
+                metrics={"alert": alert})
 
     def reset_metrics(self):
         """Restart the measurement window on the orchestrator AND both

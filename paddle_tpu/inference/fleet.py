@@ -57,7 +57,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..observability import Observability
+from ..observability import (Observability, TelemetryConfig,
+                             TelemetryPlane)
 from .generation import GenerationConfig
 from .serving import Request, _drain_loop
 
@@ -127,7 +128,7 @@ class ServingFleet:
 
     def __init__(self, replicas, policy: str = "prefix",
                  max_queue_depth: Optional[int] = None,
-                 observability=False):
+                 observability=False, telemetry=False):
         if not replicas:
             raise ValueError("ServingFleet needs at least one replica")
         self._replicas: List[_Replica] = []
@@ -160,7 +161,10 @@ class ServingFleet:
         self._t_first = self._t_last = None
         self._metrics_reset_t = None
         self.last_drain_truncated = False
-        if observability:
+        # telemetry implies observability (alerts land timeline events
+        # and stall dumps, both owned by the harness)
+        _tcfg = TelemetryConfig.coerce(telemetry)
+        if observability or _tcfg is not None:
             self._obs = (observability
                          if isinstance(observability, Observability)
                          else Observability(histograms=FLEET_HISTOGRAMS))
@@ -168,6 +172,23 @@ class ServingFleet:
             self._share_histograms()
         else:
             self._obs = None
+        # continuous telemetry plane (r22): the fleet rollup plus every
+        # replica's engine under a `replica` label — burn-rate and
+        # anomaly rules then judge each replica separately, so ONE
+        # misbehaving replica pages without drowning in the rollup
+        self._telemetry = None
+        if _tcfg is not None:
+            self._telemetry = TelemetryPlane(
+                _tcfg, on_alert=self._telemetry_alert)
+            self._telemetry.register("fleet", self.metrics,
+                                     counters=self.counters,
+                                     skip=("replicas",))
+            for rep in self._replicas:
+                self._telemetry.register(
+                    "fleet_replica", rep.engine.metrics,
+                    labels={"replica": rep.name},
+                    counters=getattr(rep.engine, "counters", None),
+                    skip=("replicas", "groups"))
 
     def _share_histograms(self):
         """Point every observability-enabled replica's request-level
@@ -304,6 +325,8 @@ class ServingFleet:
             obs.sample_gauges(now, {
                 f"queue_depth[{r.name}]": r.engine.queue_depth
                 for r in self._replicas})
+        if self._telemetry is not None:
+            self._telemetry.on_step()
         return did
 
     @property
@@ -405,7 +428,33 @@ class ServingFleet:
                                 + obs.stall_dumps_suppressed)
             m["timeline_events"] = len(obs.timeline)
             m["timeline_dropped"] = obs.timeline.dropped
+        if self._telemetry is not None:
+            m["telemetry"] = self._telemetry.snapshot()
         return m
+
+    @property
+    def telemetry(self) -> Optional[TelemetryPlane]:
+        """The continuous telemetry plane, or None when disabled."""
+        return self._telemetry
+
+    def _telemetry_alert(self, alert: Dict):
+        """Stamp an ``alert`` timeline event (replica attribution rides
+        in the alert's labels); page-severity alerts also land a
+        flight-recorder dump with the fleet scheduler snapshot."""
+        obs = self._obs
+        if obs is None:
+            return
+        obs.timeline.record(
+            "alert", rule=alert.get("rule"),
+            severity=alert.get("severity"), metric=alert.get("metric"),
+            replica=(alert.get("labels") or {}).get("replica"),
+            value=alert.get("value"), threshold=alert.get("threshold"))
+        if (alert.get("severity") == "page"
+                and self._telemetry.config.page_dumps):
+            obs.stall_dump(
+                f"telemetry alert: {alert.get('rule')} on "
+                f"{alert.get('metric')}", self.scheduler_snapshot(),
+                metrics={"alert": alert})
 
     def reset_metrics(self):
         """Restart the measurement window on the router AND every

@@ -69,7 +69,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.jax_compat import shard_map_norep
-from ..observability import Observability
+from ..observability import Observability, TelemetryConfig, TelemetryPlane
 from ..ops.paged_attention import (BlockManager, dequant_cache,
                                    quant_cache)
 from .admission import AdmissionQueue
@@ -211,7 +211,7 @@ class ServingEngine:
                  prefix_cache: bool = False, kv_offload=False,
                  observability=False, fused_decode=None, mesh=None,
                  fused_prefill=None, weight_quant=None,
-                 aging_s: Optional[float] = None):
+                 aging_s: Optional[float] = None, telemetry=False):
         # tensor parallelism (inference/tp.py): a ServingMesh shards
         # the KV pools, projections and per-slot attention along the
         # head axis; programs wrap in shard_map. None = single device.
@@ -463,8 +463,11 @@ class ServingEngine:
         # observability: None when disabled — every hook below is a
         # single `is not None` check, so the disabled hot loop allocates
         # NO event objects and issues NO extra device syncs (the per-
-        # step d2h token read in _run_decode stays the only sync point)
-        if observability:
+        # step d2h token read in _run_decode stays the only sync point).
+        # telemetry implies observability: the plane's alerts land
+        # timeline events and stall dumps, both owned by the harness.
+        _tcfg = TelemetryConfig.coerce(telemetry)
+        if observability or _tcfg is not None:
             self._obs = (observability
                          if isinstance(observability, Observability)
                          else Observability())
@@ -491,6 +494,16 @@ class ServingEngine:
             self._flight = self._obs.bind_flight_recorder(rec)
             self._coll_decode = tuple(self._mesh.collective_inventory(
                 cfg, B=self.capacity))
+        # continuous telemetry plane (r22): samples this engine's
+        # metrics() on a step cadence into bounded time-series with
+        # burn-rate/anomaly alerting. None when disabled — the hot loop
+        # pays one `is not None` check, nothing else.
+        self._telemetry = None
+        if _tcfg is not None:
+            self._telemetry = TelemetryPlane(
+                _tcfg, on_alert=self._telemetry_alert)
+            self._telemetry.register("serving_engine", self.metrics,
+                                     counters=self.counters)
 
     def _record_collectives(self, inventory):
         """Open one CommTask per declared collective class; returns the
@@ -704,6 +717,8 @@ class ServingEngine:
             self._t_last = time.perf_counter()
         if obs is not None:
             self._observe_step(t0, did)
+        if self._telemetry is not None:
+            self._telemetry.on_step()
         return did or expired > 0
 
     def _observe_step(self, t0: float, did: bool):
@@ -945,6 +960,31 @@ class ServingEngine:
             snap["prefix_cache"] = self._pcache.metrics()
         return snap
 
+    @property
+    def telemetry(self) -> Optional[TelemetryPlane]:
+        """The continuous telemetry plane, or None when disabled."""
+        return self._telemetry
+
+    def _telemetry_alert(self, alert: Dict):
+        """Plane alert callback: stamp an ``alert`` timeline event; a
+        page-severity alert additionally self-documents through the
+        flight-recorder stall-dump machinery (scheduler snapshot + the
+        alert that fired)."""
+        obs = self._obs
+        if obs is None:
+            return
+        obs.timeline.record(
+            "alert", rule=alert.get("rule"),
+            severity=alert.get("severity"), metric=alert.get("metric"),
+            value=alert.get("value"), threshold=alert.get("threshold"))
+        if (alert.get("severity") == "page"
+                and self._telemetry is not None
+                and self._telemetry.config.page_dumps):
+            obs.stall_dump(
+                f"telemetry alert: {alert.get('rule')} on "
+                f"{alert.get('metric')}", self.scheduler_snapshot(),
+                metrics={"alert": alert})
+
     def metrics(self) -> Dict:
         # the flight recorder parks raw collective_calls/bytes counters
         # in the adopted dict; they surface ONLY under the structured
@@ -986,6 +1026,8 @@ class ServingEngine:
         c["scheduler"] = self._scheduler_metrics()
         if self._pcache is not None:
             c["prefix_cache"] = self._pcache.metrics()
+        if self._telemetry is not None:
+            c["telemetry"] = self._telemetry.snapshot()
         if self._obs is not None:
             obs = self._obs
             c["latency"] = obs.latency_snapshot()
@@ -1018,6 +1060,9 @@ class ServingEngine:
         n, ok = self._slo
         return {"per_class": per,
                 "slo_attainment": (round(ok / n, 4) if n else None),
+                # the raw attainment counters: the telemetry plane's
+                # burn-rate windows difference these across samples
+                "slo_seen": int(n), "slo_attained": int(ok),
                 "queue_depth": len(self._queue)}
 
     def reset_metrics(self):

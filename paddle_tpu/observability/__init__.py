@@ -29,6 +29,8 @@ from .metrics import Gauge, Histogram, MetricsRegistry
 from .roofline import (capture_kernel_costs, decode_roofline,
                        decode_step_bytes, kernel_cost, roofline_point)
 from .stall import dump_path_for, dump_stall
+from .telemetry import (TelemetryConfig, TelemetryPlane, flatten_metrics,
+                        lint_exposition, render_exposition)
 from .timeline import Timeline, TimelineEvent
 from .watchdog import RetraceWatchdog
 
@@ -37,7 +39,9 @@ __all__ = ["Observability", "MetricsRegistry", "Histogram", "Gauge",
            "CompileWatcher", "HostGapDetector", "device_peak_flops",
            "device_peak_hbm_bw", "live_hbm_bytes", "kernel_cost",
            "roofline_point", "capture_kernel_costs", "decode_step_bytes",
-           "decode_roofline", "LATENCY_HISTOGRAMS", "TRAIN_HISTOGRAMS"]
+           "decode_roofline", "LATENCY_HISTOGRAMS", "TRAIN_HISTOGRAMS",
+           "TelemetryConfig", "TelemetryPlane", "flatten_metrics",
+           "render_exposition", "lint_exposition"]
 
 # the latency histograms every engine window reports (schema-stable:
 # tests freeze this set — extend deliberately, never ad hoc)

@@ -31,7 +31,9 @@ EVENT_NAMES = ("submit", "admit", "prefill_chunk", "first_token",
                "decode_step", "finish", "drain_truncated", "stall",
                "retrace", "prefix_evict",
                # training/multichip events (r9)
-               "train_step", "compile", "host_gap", "collective")
+               "train_step", "compile", "host_gap", "collective",
+               # fleet routing (r18) and telemetry alerts (r22)
+               "route", "alert")
 
 
 class TimelineEvent:

@@ -375,6 +375,7 @@ def test_priority_overtakes_queue_not_running_equals(params):
     assert high.first_token_t < low.first_token_t
     sched = eng.metrics()["scheduler"]
     assert set(sched.keys()) == {"per_class", "slo_attainment",
+                                 "slo_seen", "slo_attained",
                                  "queue_depth"}
     assert sched["per_class"]["0"]["admitted"] == 1
     assert sched["per_class"]["2"]["admitted"] == 2
@@ -537,19 +538,31 @@ DISAGG_LATENCY_KEYS = {"ttft_ms", "tpot_ms", "queue_wait_ms", "e2e_ms",
 def test_disagg_metrics_schema_frozen(params):
     """The disagg metric key set is a CONTRACT (bench output +
     trace_summary): extend deliberately, never by accident."""
+    from paddle_tpu.observability import TelemetryConfig
     eng = _disagg(params, prefill_buckets=(16,))
     _mixed_stream(eng, n=4)
-    assert set(eng.metrics().keys()) == DISAGG_BASE_KEYS
-    eng = _disagg(params, observability=True, prefill_buckets=(16,))
+    m0 = eng.metrics()
+    assert set(m0.keys()) == DISAGG_BASE_KEYS
+    assert "telemetry" not in m0          # disabled = key absent (r22)
+    eng = _disagg(params, observability=True, prefill_buckets=(16,),
+                  telemetry=TelemetryConfig(sample_every=2,
+                                            detectors=()))
     _mixed_stream(eng, n=4)
     m = eng.metrics()
-    assert set(m.keys()) == DISAGG_BASE_KEYS | DISAGG_OBS_KEYS
+    # telemetry (r22) adds exactly the telemetry sub-dict, itself a
+    # frozen sub-schema with group-labelled per-worker series
+    assert set(m.keys()) == \
+        DISAGG_BASE_KEYS | DISAGG_OBS_KEYS | {"telemetry"}
+    assert set(m["telemetry"].keys()) == {"samples", "series",
+                                          "alerts", "rules"}
+    assert m["telemetry"]["samples"] >= 1
     assert set(m["latency"].keys()) == DISAGG_LATENCY_KEYS
     assert m["latency"]["ttft_ms"]["count"] == 4   # shared histograms
     assert m["latency"]["tpot_ms"]["count"] == 4
     assert set(m["groups"].keys()) == {"prefill", "decode"}
     sched = m["scheduler"]
     assert set(sched.keys()) == {"per_class", "slo_attainment",
+                                 "slo_seen", "slo_attained",
                                  "queue_depth", "preemptions",
                                  "requeues", "deadline_expired",
                                  "handoff_queue_depth"}

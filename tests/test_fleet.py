@@ -398,18 +398,30 @@ OFFLOAD_KEYS = {"spilled_pages", "restored_pages", "readopted_pages",
 def test_fleet_metrics_schema_frozen(params):
     """The fleet metric key set is a CONTRACT (bench output): extend
     deliberately, never by accident — enabled AND disabled."""
+    from paddle_tpu.observability import TelemetryConfig
     fleet = ServingFleet([_engine(params), _engine(params)])
     _stream(fleet, n=4)
     m = fleet.metrics()
     assert set(m.keys()) == FLEET_BASE_KEYS
+    assert "telemetry" not in m           # disabled = key absent (r22)
     assert set(m["routing"].keys()) == ROUTING_KEYS
     assert set(m["offload"].keys()) == OFFLOAD_KEYS
     fleet = ServingFleet(
         [_engine(params, observability=True),
-         _engine(params, observability=True)], observability=True)
+         _engine(params, observability=True)], observability=True,
+        telemetry=TelemetryConfig(sample_every=2, detectors=()))
     _stream(fleet, n=4)
     m = fleet.metrics()
-    assert set(m.keys()) == FLEET_BASE_KEYS | FLEET_OBS_KEYS
+    # telemetry (r22) adds exactly the telemetry sub-dict: the fleet
+    # rollup plus every replica's series under a `replica` label
+    assert set(m.keys()) == \
+        FLEET_BASE_KEYS | FLEET_OBS_KEYS | {"telemetry"}
+    assert set(m["telemetry"].keys()) == {"samples", "series",
+                                          "alerts", "rules"}
+    assert m["telemetry"]["samples"] >= 1
+    tel = fleet.telemetry
+    reps = {dict(s.labels).get("replica") for s in tel.series()}
+    assert {"replica0", "replica1"} <= reps
     assert set(m["latency"].keys()) == FLEET_LATENCY_KEYS
     assert m["latency"]["ttft_ms"]["count"] == 4
     assert m["latency"]["tpot_ms"]["count"] == 4
@@ -439,6 +451,25 @@ def test_fleet_timeline_route_events(params, tmp_path):
     assert len(routes) == 4
     assert all("replica" in ev and "matched_tokens" in ev
                for ev in routes)
+    # trace_summary's serving mode renders a fleet routing section
+    # from the route events (r22 satellite)
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        from trace_summary import load, render, summarize
+    finally:
+        sys.path.pop(0)
+    meta, events, requests = load(path)
+    summary = summarize(meta, events, requests)
+    rt = summary["routing"]
+    assert rt["requests"] == 4
+    assert rt["warm"] + rt["cold"] == 4
+    assert rt["warm_hit_ratio"] == pytest.approx(rt["warm"] / 4)
+    assert set(rt["per_replica"]) <= {"replica0", "replica1"}
+    assert sum(d["routed"] for d in rt["per_replica"].values()) == 4
+    assert "fleet routing:" in render(summary)
 
 
 # -- audit wiring ------------------------------------------------------

@@ -18,7 +18,8 @@ from paddle_tpu.models import llama
 from paddle_tpu.inference import (GenerationConfig, ServingEngine,
                                   generate)
 from paddle_tpu.observability import (Histogram, Observability,
-                                      RetraceWatchdog)
+                                      RetraceWatchdog, TelemetryConfig,
+                                      TelemetryPlane)
 from paddle_tpu.observability import timeline as timeline_mod
 
 CFG = llama.LlamaConfig(vocab_size=97, hidden_size=64,
@@ -143,11 +144,26 @@ def test_metrics_schema_frozen_enabled(params):
     t = m["latency"]["ttft_ms"]
     assert t["count"] == 4
     assert t["p50"] <= t["p95"] <= t["p99"] <= t["max"]
-    # prefix-cache engines add exactly the prefix_cache sub-dict
-    eng2 = _engine(params, prefix_cache=True, observability=True)
+    # prefix-cache engines add exactly the prefix_cache sub-dict;
+    # telemetry (r22) adds exactly the telemetry sub-dict, itself a
+    # frozen sub-schema
+    eng2 = _engine(params, prefix_cache=True, observability=True,
+                   telemetry=TelemetryConfig(sample_every=2,
+                                             detectors=()))
     _run_stream(eng2)
-    assert set(eng2.metrics().keys()) == \
-        BASE_KEYS | OBS_KEYS | {"prefix_cache"}
+    m2 = eng2.metrics()
+    assert set(m2.keys()) == \
+        BASE_KEYS | OBS_KEYS | {"prefix_cache", "telemetry"}
+    assert set(m2["telemetry"].keys()) == {"samples", "series",
+                                           "alerts", "rules"}
+    assert set(m2["telemetry"]["alerts"].keys()) == {"page", "ticket"}
+    assert m2["telemetry"]["samples"] >= 1
+    assert m2["telemetry"]["series"] > 0
+    # the scheduler section carries the raw SLO counters the burn-rate
+    # windows difference (r22)
+    assert set(m2["scheduler"].keys()) == {
+        "per_class", "slo_attainment", "slo_seen", "slo_attained",
+        "queue_depth"}
 
 
 def test_metrics_schema_frozen_tp(params):
@@ -402,12 +418,15 @@ def test_disabled_mode_allocates_no_event_objects(params, monkeypatch):
         raise AssertionError("event object allocated in disabled mode")
     monkeypatch.setattr(timeline_mod.TimelineEvent, "__init__", boom)
     monkeypatch.setattr(Observability, "__init__", boom)
+    monkeypatch.setattr(TelemetryPlane, "__init__", boom)
     eng = _engine(params)
     assert eng.observability is None
+    assert eng.telemetry is None
     rs = _run_stream(eng, n=3, seed=13)
     assert all(r.done for r in rs)
     m = eng.metrics()
     assert "latency" not in m and "gauges" not in m
+    assert "telemetry" not in m
     with pytest.raises(RuntimeError, match="disabled"):
         eng.export_trace("/tmp/never.json")
 

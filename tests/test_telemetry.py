@@ -1,0 +1,522 @@
+"""Continuous telemetry plane (r22): time-series sampling over the
+``metrics()`` protocol, counter->rate derivation, OpenMetrics
+exposition + lint, deterministic fake-clock SLO burn-rate alerting,
+robust (median+MAD) anomaly detectors wired into the timeline ring and
+the flight-recorder stall dumps, JSONL banking with rotation, and
+``tools/telemetry_summary.py``."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama
+from paddle_tpu.inference.serving import ServingEngine
+from paddle_tpu.inference.generation import GenerationConfig
+from paddle_tpu.observability import (Observability, TelemetryConfig,
+                                      TelemetryPlane, flatten_metrics,
+                                      lint_exposition,
+                                      render_exposition)
+
+pytestmark = pytest.mark.telemetry
+
+CFG = llama.LlamaConfig(vocab_size=97, hidden_size=64,
+                        intermediate_size=128, num_hidden_layers=2,
+                        num_attention_heads=4, num_key_value_heads=2,
+                        max_position_embeddings=128, dtype=jnp.float32,
+                        remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def _engine(params, **kw):
+    kw.setdefault("capacity", 2)
+    kw.setdefault("block_size", 4)
+    kw.setdefault("prefill_buckets", (8, 16))
+    kw.setdefault("max_seq_len", 64)
+    return ServingEngine(params, CFG, **kw)
+
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _plane(clock, **kw):
+    kw.setdefault("sample_every", 1)
+    kw.setdefault("detectors", ())
+    kw.setdefault("clock", clock)
+    alerts = []
+    plane = TelemetryPlane(TelemetryConfig(**kw),
+                           on_alert=alerts.append)
+    return plane, alerts
+
+
+# -- flattening --------------------------------------------------------
+
+def test_flatten_paths_labels_and_leaf_filtering():
+    tree = {
+        "tokens": 7, "ratio": 0.5, "name": "fused",   # str dropped
+        "flag": True,                                  # bool dropped
+        "nan": float("nan"),                           # non-finite drop
+        "nested": {"a": {"b": 1}},
+        "scheduler": {"per_class": {"0": {"admitted": 3}},
+                      "queue_depth": 2},
+        "routing": {"per_replica": {"r0": {"queue_depth": 1}}},
+        "telemetry": {"samples": 9},                   # always skipped
+        "groups": {"x": 1},
+    }
+    rows = flatten_metrics(tree, skip=("groups",))
+    got = {(p, labels): v for p, labels, v in rows}
+    assert got[("tokens", ())] == 7.0
+    assert got[("ratio", ())] == 0.5
+    assert got[("nested.a.b", ())] == 1.0
+    assert got[("scheduler.queue_depth", ())] == 2.0
+    # per_class / per_replica keys lift into labels, path keeps segment
+    assert got[("scheduler.per_class.admitted",
+                (("cls", "0"),))] == 3.0
+    assert got[("routing.per_replica.queue_depth",
+                (("replica", "r0"),))] == 1.0
+    paths = {p for p, _, _ in rows}
+    assert not any(p.startswith(("telemetry", "groups", "name",
+                                 "flag", "nan")) for p in paths)
+
+
+# -- sampling + counter->rate ------------------------------------------
+
+def test_counter_rate_derivation_and_reset_skip():
+    clk = _FakeClock()
+    plane, _ = _plane(clk)
+    src = {"tokens": 0, "depth": 5}
+    plane.register("eng", lambda: dict(src), counters={"tokens": 0})
+    for dt, tok in ((0.0, 0), (1.0, 10), (1.0, 30), (2.0, 30)):
+        clk.t += dt
+        src["tokens"] = tok
+        plane.sample()
+    series = {s.path: s for s in plane.series()}
+    assert series["tokens"].kind == "counter"
+    assert series["depth"].kind == "gauge"       # not in counters dict
+    rates = series["tokens_per_s"].values()
+    assert rates == [10.0, 20.0, 0.0]
+    # counter reset (reset_metrics): negative delta derives NO rate
+    src["tokens"] = 0
+    clk.t += 1.0
+    plane.sample()
+    assert series["tokens_per_s"].values() == [10.0, 20.0, 0.0]
+    # series are bounded deques
+    assert series["tokens"].samples.maxlen == \
+        plane.config.series_capacity
+
+
+def test_on_step_cadence():
+    clk = _FakeClock()
+    plane, _ = _plane(clk, sample_every=4)
+    plane.register("x", lambda: {"v": 1})
+    for _ in range(9):
+        clk.t += 1.0
+        plane.on_step()
+    assert plane.snapshot()["samples"] == 2
+
+
+# -- OpenMetrics exposition + lint -------------------------------------
+
+def test_exposition_lint_clean_and_hostile_keys_sanitized():
+    clk = _FakeClock()
+    plane, _ = _plane(clk, namespace="paddle_tpu")
+    # a hostile metric key (the r9 collective idiom) must sanitize,
+    # not ship an unscrapeable exposition
+    plane.register("eng", lambda: {
+        "collective_psum@tp_ms": 1.5,
+        "latency": {"ttft_ms": {"p95": 3.25}},
+        "requests": 4,
+    }, labels={"replica": "r0"}, counters={"requests": 0})
+    clk.t = 1.0
+    plane.sample()
+    clk.t = 2.0
+    plane.sample()
+    text = plane.expose()
+    assert lint_exposition(text) == []
+    assert "paddle_tpu_collective_psum_tp_ms" in text
+    assert "@" not in text.replace("# HELP", "").split("# EOF")[0] \
+        .replace("collective_psum@tp_ms", "")
+    assert 'component="eng"' in text and 'replica="r0"' in text
+    assert "paddle_tpu_requests_total" in text     # counter suffix
+    assert text.rstrip().endswith("# EOF")
+
+
+def test_lint_catches_broken_expositions():
+    assert lint_exposition("") != []               # no EOF
+    bad_name = ("# HELP bad@name x\n# TYPE bad@name gauge\n"
+                "bad@name 1\n# EOF\n")
+    assert any("invalid metric name" in p
+               for p in lint_exposition(bad_name))
+    untyped = "orphan_metric 1\n# EOF\n"
+    assert any("before TYPE" in p for p in lint_exposition(untyped))
+    uncounted = ("# HELP c_thing x\n# TYPE c_thing counter\n"
+                 "c_thing 1\n# EOF\n")
+    assert any("_total" in p for p in lint_exposition(uncounted))
+    bad_label = ('# HELP m x\n# TYPE m gauge\nm{bad-label="1"} 1\n'
+                 "# EOF\n")
+    assert any("invalid label name" in p
+               for p in lint_exposition(bad_label))
+
+
+def test_render_exposition_escapes_label_values():
+    clk = _FakeClock()
+    plane, _ = _plane(clk)
+    plane.register("eng", lambda: {"v": 1},
+                   labels={"cls": 'quo"te\\back'})
+    plane.sample()
+    text = render_exposition(plane.series())
+    assert lint_exposition(text) == []
+    assert '\\"' in text and "\\\\" in text
+
+
+# -- SLO burn-rate alerting (deterministic fake clock) -----------------
+
+def _slo_source():
+    return {"scheduler": {"slo_seen": 0, "slo_attained": 0}}
+
+
+def test_burn_rate_silent_on_clean_and_idle_streams():
+    clk = _FakeClock()
+    plane, alerts = _plane(clk, burn_fast_window=2, burn_slow_window=4)
+    src = _slo_source()
+    plane.register("eng", lambda: json.loads(json.dumps(src)))
+    for _ in range(8):                      # perfect attainment
+        clk.t += 1.0
+        src["scheduler"]["slo_seen"] += 10
+        src["scheduler"]["slo_attained"] += 10
+        plane.sample()
+    for _ in range(8):                      # idle: no deadline traffic
+        clk.t += 1.0
+        plane.sample()
+    assert alerts == []
+    assert plane.snapshot()["alerts"] == {"page": 0, "ticket": 0}
+
+
+def test_burn_rate_page_on_hard_degradation():
+    clk = _FakeClock()
+    plane, alerts = _plane(clk, burn_fast_window=2, burn_slow_window=4,
+                           slo_target=0.99, page_burn_rate=14.4)
+    src = _slo_source()
+    plane.register("eng", lambda: json.loads(json.dumps(src)))
+    for _ in range(4):                      # clean baseline
+        clk.t += 1.0
+        src["scheduler"]["slo_seen"] += 10
+        src["scheduler"]["slo_attained"] += 10
+        plane.sample()
+    for _ in range(4):                      # 100% misses: burn = 100
+        clk.t += 1.0
+        src["scheduler"]["slo_seen"] += 10
+        plane.sample()
+    pages = [a for a in alerts if a["severity"] == "page"]
+    assert pages and pages[0]["rule"] == "slo_burn_rate"
+    assert pages[0]["value"] >= 14.4
+    assert pages[0]["threshold"] == 14.4
+    # cooldown: one fire, not one per sample
+    assert len(pages) == 1
+
+
+def test_burn_rate_ticket_on_slow_burn():
+    clk = _FakeClock()
+    plane, alerts = _plane(clk, burn_fast_window=2, burn_slow_window=4,
+                           slo_target=0.99)
+    src = _slo_source()
+    plane.register("eng", lambda: json.loads(json.dumps(src)))
+    for _ in range(8):                      # steady 5% misses: burn 5
+        clk.t += 1.0
+        src["scheduler"]["slo_seen"] += 100
+        src["scheduler"]["slo_attained"] += 95
+        plane.sample()
+    sevs = {a["severity"] for a in alerts}
+    assert sevs == {"ticket"}
+    assert all(3.0 <= a["value"] < 14.4 for a in alerts)
+
+
+# -- anomaly detectors -------------------------------------------------
+
+def test_drift_detector_fires_on_p95_jump_not_on_jitter():
+    clk = _FakeClock()
+    det = ({"rule": "drift_up", "path": "latency.decode_step_ms.p95",
+            "severity": "ticket"},)
+    plane, alerts = _plane(clk, detectors=det, anomaly_min_samples=6)
+    src = {"latency": {"decode_step_ms": {"p95": 1.0}}}
+    plane.register("eng", lambda: json.loads(json.dumps(src)))
+    vals = [1.0, 1.05, 0.95, 1.1, 1.0, 1.02, 0.98, 1.04]
+    for v in vals:                          # jitter: stays silent
+        clk.t += 1.0
+        src["latency"]["decode_step_ms"]["p95"] = v
+        plane.sample()
+    assert alerts == []
+    clk.t += 1.0                            # 10x drift: fires
+    src["latency"]["decode_step_ms"]["p95"] = 10.0
+    plane.sample()
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a["rule"] == "drift_up"
+    assert a["metric"] == "latency.decode_step_ms.p95"
+    assert a["value"] == 10.0 and a["threshold"] < 10.0
+
+
+def test_growth_collapse_and_storm_detectors():
+    clk = _FakeClock()
+    det = (
+        {"rule": "growth", "path": "scheduler.queue_depth",
+         "severity": "ticket", "min_samples": 5},
+        {"rule": "collapse", "path": "tokens_per_sec",
+         "severity": "page", "min_samples": 5},
+        {"rule": "storm", "path": "preemptions_per_s",
+         "severity": "page", "min_samples": 5},
+    )
+    plane, alerts = _plane(clk, detectors=det, anomaly_min_samples=5)
+    src = {"scheduler": {"queue_depth": 0}, "tokens_per_sec": 100.0,
+           "preemptions": 0}
+    plane.register("eng", lambda: dict(src, scheduler=dict(
+        src["scheduler"])), counters={"preemptions": 0})
+    for i in range(8):                      # healthy steady state
+        clk.t += 1.0
+        plane.sample()
+    assert alerts == []
+    for i in range(6):                      # queue grows monotonically
+        clk.t += 1.0
+        src["scheduler"]["queue_depth"] += 2
+        plane.sample()
+    assert any(a["rule"] == "growth" for a in alerts)
+    clk.t += 1.0                            # tokens/s collapses
+    src["tokens_per_sec"] = 10.0
+    plane.sample()
+    assert any(a["rule"] == "collapse" and a["severity"] == "page"
+               for a in alerts)
+    clk.t += 1.0                            # preemption storm
+    src["preemptions"] += 50
+    plane.sample()
+    assert any(a["rule"] == "storm" for a in alerts)
+
+
+# -- config coercion ---------------------------------------------------
+
+def test_config_coercion():
+    assert TelemetryConfig.coerce(False) is None
+    assert TelemetryConfig.coerce(None) is None
+    assert isinstance(TelemetryConfig.coerce(True), TelemetryConfig)
+    cfg = TelemetryConfig(sample_every=3)
+    assert TelemetryConfig.coerce(cfg) is cfg
+    with pytest.raises(TypeError, match="TelemetryConfig"):
+        TelemetryConfig.coerce(7)
+
+
+# -- JSONL banking + rotation ------------------------------------------
+
+def test_jsonl_bank_rotation(tmp_path):
+    clk = _FakeClock()
+    path = str(tmp_path / "tel.jsonl")
+    plane, _ = _plane(clk, jsonl_path=path, jsonl_max_bytes=600,
+                      jsonl_backups=2)
+    plane.register("eng", lambda: {"v": 1, "w": 2.5})
+    for _ in range(40):
+        clk.t += 1.0
+        plane.sample()
+    assert os.path.exists(path) and os.path.exists(path + ".1")
+    for p in (path, path + ".1"):
+        lines = [json.loads(ln) for ln in open(p)]
+        assert lines, p                     # every file parses
+        assert lines[0]["kind"] == "telemetry_meta"
+        assert all(ln["kind"] in ("telemetry_meta", "sample", "alert")
+                   for ln in lines)
+
+
+def test_write_jsonl_one_shot(tmp_path):
+    clk = _FakeClock()
+    plane, _ = _plane(clk)
+    plane.register("eng", lambda: {"v": 3})
+    clk.t = 1.0
+    plane.sample()
+    p = str(tmp_path / "dump.jsonl")
+    assert plane.write_jsonl(p) == p
+    lines = [json.loads(ln) for ln in open(p)]
+    assert lines[0]["kind"] == "telemetry_meta"
+    assert lines[0]["schema"] == 1
+    assert lines[1]["kind"] == "sample"
+    assert lines[1]["values"]["v{component=eng}"] == 3
+
+
+# -- tools/telemetry_summary.py ----------------------------------------
+
+def _summary_mod():
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    try:
+        import telemetry_summary
+    finally:
+        sys.path.pop(0)
+    return telemetry_summary
+
+
+def test_telemetry_summary_renders_series_and_alerts(tmp_path, capsys):
+    ts = _summary_mod()
+    clk = _FakeClock()
+    plane, _ = _plane(clk, burn_fast_window=2, burn_slow_window=4)
+    src = _slo_source()
+    plane.register("eng", lambda: json.loads(json.dumps(src)))
+    for i in range(6):
+        clk.t += 1.0
+        src["scheduler"]["slo_seen"] += 10
+        src["scheduler"]["slo_attained"] += 10 if i < 3 else 0
+        plane.sample()
+    p = str(tmp_path / "tel.jsonl")
+    plane.write_jsonl(p)
+    assert ts.main([p]) == 0
+    out = capsys.readouterr().out
+    assert "telemetry:" in out
+    assert "scheduler.slo_seen" in out
+    assert "slo_burn_rate" in out           # the alert log renders
+    assert any(ch in out for ch in ts.BLOCKS)   # sparkline present
+    assert ts.main([p, "--json"]) == 0
+    js = json.loads(capsys.readouterr().out)
+    assert js["alerts"] and js["series"]
+
+
+def test_telemetry_summary_exit_2_on_broken_files(tmp_path, capsys):
+    ts = _summary_mod()
+    assert ts.main([str(tmp_path / "missing.jsonl")]) == 2
+    assert "error:" in capsys.readouterr().err
+    trunc = tmp_path / "trunc.jsonl"
+    trunc.write_text('{"kind": "sample", "values": {"x"\n')
+    assert ts.main([str(trunc)]) == 2
+    err = capsys.readouterr().err
+    assert "truncated" in err and err.count("error:") == 1
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert ts.main([str(empty)]) == 2
+    assert "empty telemetry file" in capsys.readouterr().err
+
+
+# -- engine integration ------------------------------------------------
+
+def test_engine_stream_parity_exposition_and_clean_silence(
+        params, tmp_path, capsys):
+    """Acceptance: a 30-request stream with telemetry on produces a
+    lint-clean OpenMetrics exposition and a parseable JSONL series
+    log, greedy outputs stay bit-identical to the telemetry=False
+    engine, and the clean stream raises no alert."""
+    def run(telemetry):
+        eng = _engine(params, capacity=3, telemetry=telemetry)
+        rng = np.random.RandomState(14)
+        reqs = []
+        pending = [(rng.randint(0, 97, (int(rng.randint(3, 17)),))
+                    .astype(np.int32),
+                    GenerationConfig(max_new_tokens=int(
+                        rng.randint(2, 7)), greedy=True))
+                   for _ in range(30)]
+        while pending or not eng.idle:
+            for _ in range(min(len(pending),
+                               1 + int(rng.randint(0, 3)))):
+                p, g = pending.pop(0)
+                reqs.append(eng.submit(p, g))
+            eng.step()
+        return eng, [np.asarray(r.output_ids) for r in reqs]
+
+    tel_cfg = TelemetryConfig(sample_every=2, detectors=())
+    eng_t, out_t = run(tel_cfg)
+    eng_p, out_p = run(False)
+    assert all(np.array_equal(a, b) for a, b in zip(out_t, out_p))
+    assert eng_p.telemetry is None
+
+    tp = eng_t.telemetry
+    snap = eng_t.metrics()["telemetry"]
+    assert snap["samples"] >= 10 and snap["series"] > 20
+    assert snap["alerts"] == {"page": 0, "ticket": 0}   # clean stream
+    text = tp.expose()
+    assert lint_exposition(text) == []
+    assert "paddle_tpu_tokens_generated_total" in text
+    p = str(tmp_path / "tel.jsonl")
+    assert tp.write_jsonl(p) == p
+    ts = _summary_mod()
+    assert ts.main([p]) == 0
+    out = capsys.readouterr().out
+    assert "alerts: none" in out
+    # exposition file writer is atomic and re-readable
+    ep = str(tmp_path / "metrics.prom")
+    assert tp.write_exposition(ep) == ep
+    assert lint_exposition(open(ep).read()) == []
+
+
+def test_engine_degradation_pages_timeline_and_stall_dump(
+        params, tmp_path):
+    """Acceptance: injected SLO degradation (deadline-expired burst
+    after a clean baseline) raises a burn-rate page that lands an
+    ``alert`` timeline event AND a flight-recorder dump naming the
+    alert."""
+    obs = Observability(stall_dump_path=str(tmp_path / "stall.json"))
+    cfg = TelemetryConfig(sample_every=1, detectors=(),
+                          burn_fast_window=2, burn_slow_window=4)
+    eng = _engine(params, observability=obs, telemetry=cfg)
+    rng = np.random.RandomState(3)
+    g = GenerationConfig(max_new_tokens=4, greedy=True)
+    for _ in range(2):                      # clean baseline
+        eng.submit(rng.randint(0, 97, (5,)).astype(np.int32), g)
+    eng.drain()
+    assert eng.telemetry.snapshot()["alerts"] == {"page": 0,
+                                                  "ticket": 0}
+    for _ in range(6):                      # degradation: all expire
+        eng.submit(rng.randint(0, 97, (5,)).astype(np.int32), g,
+                   deadline_s=0.0)
+    eng.drain()
+    snap = eng.metrics()["telemetry"]
+    assert snap["alerts"]["page"] >= 1
+    assert snap["rules"].get("slo_burn_rate", 0) >= 1
+    evs = [e for e in obs.timeline.events() if e.name == "alert"]
+    assert evs and evs[0].meta["rule"] == "slo_burn_rate"
+    assert evs[0].meta["severity"] == "page"
+    dumps = [p for _, p in obs.stall_dumps if p]
+    assert dumps
+    report = json.load(open(dumps[0]))
+    assert "telemetry alert: slo_burn_rate" in report["reason"]
+    alert = report["metrics"]["alert"]
+    assert alert["metric"] == "scheduler.slo_burn_rate"
+    assert alert["value"] >= 14.4
+    assert "queued" in report["scheduler"]  # scheduler snapshot rode
+
+
+def test_trainer_telemetry_smoke():
+    """Trainer wiring: the plane samples train metrics() on the step
+    cadence and the frozen schema gains exactly the telemetry key."""
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.distributed.trainer import (MeshConfig, Trainer,
+                                                make_mesh)
+    from paddle_tpu.models.llama import (LlamaConfig, init_params,
+                                         loss_fn)
+    from paddle_tpu.models.llama import param_shardings
+    cfg = LlamaConfig(vocab_size=97, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=32, dtype=jnp.float32,
+                      remat=False)
+    mesh = make_mesh(MeshConfig(), devices=jax.devices()[:1])
+    tr = Trainer(lambda p, t, l: loss_fn(p, t, l, cfg), mesh,
+                 param_shardings(mesh, cfg), data_spec=P(), lr=1e-3,
+                 telemetry=TelemetryConfig(sample_every=1,
+                                           detectors=()))
+    assert tr.observability is not None     # telemetry implies obs
+    state = tr.init_state(init_params(cfg, jax.random.key(0),
+                                      dtype=jnp.float32))
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 97, (2, 8)), jnp.int32)
+    labels = jnp.asarray(np.roll(np.asarray(toks), -1, -1))
+    for _ in range(3):
+        state, _ = tr.step(state, toks, labels)
+    m = tr.metrics()
+    assert m["telemetry"]["samples"] == 3
+    series = {s.path for s in tr.telemetry.series()}
+    assert "tokens_per_sec" in series and "steps" in series
+    assert "steps_per_s" in series          # counter rate derived

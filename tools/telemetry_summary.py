@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Summarize a telemetry-plane JSONL (TelemetryPlane.write_jsonl or the
+incremental ``jsonl_path`` bank).
+
+Prints, without needing a Prometheus stack:
+
+- the run header (namespace, sample cadence, sample/series counts,
+  registered sources),
+- one line per series: sample count, min / mean / max / last value and
+  a unicode sparkline of the recent trend — the "did tokens/s sag over
+  the window?" question answered from a file,
+- the alert log: every burn-rate / anomaly fire with its rule,
+  severity, metric, trigger value and threshold.
+
+Usage:  python tools/telemetry_summary.py TELEMETRY.jsonl
+            [--metric SUBSTR] [--top 40] [--json]
+
+Exits 2 with a one-line error on a missing / empty / truncated file
+(the trace_summary idiom — this CLI is scripted after bench runs).
+"""
+import argparse
+import json
+import os
+import sys
+
+BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+class TelemetryError(Exception):
+    """A telemetry file the summary cannot work from — reported as ONE
+    line on stderr with a nonzero exit, never a traceback."""
+
+
+def load(path):
+    meta, samples, alerts = {}, [], []
+    try:
+        f = open(path)
+    except OSError as e:
+        raise TelemetryError(
+            f"cannot read telemetry file {path!r}: {e.strerror or e}")
+    malformed = parsed = 0
+    with f:
+        for ln, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                malformed += 1
+                print(f"warning: skipping malformed line {ln}",
+                      file=sys.stderr)
+                continue
+            kind = rec.get("kind")
+            if kind == "telemetry_meta":
+                meta = rec
+                parsed += 1
+            elif kind == "sample":
+                samples.append(rec)
+                parsed += 1
+            elif kind == "alert":
+                alerts.append(rec)
+                parsed += 1
+    if parsed == 0:
+        if malformed:
+            raise TelemetryError(
+                f"{path}: no parseable telemetry records "
+                f"({malformed} malformed line(s) — truncated JSONL?)")
+        raise TelemetryError(
+            f"{path}: empty telemetry file (no meta/sample/alert "
+            "records)")
+    return meta, samples, alerts
+
+
+def sparkline(vals, width=32):
+    vals = vals[-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi - lo <= 0:
+        return BLOCKS[3] * len(vals)
+    return "".join(BLOCKS[min(len(BLOCKS) - 1,
+                              int((v - lo) / (hi - lo)
+                                  * len(BLOCKS)))]
+                   for v in vals)
+
+
+def summarize(meta, samples, alerts, metric=None, top=40):
+    series = {}
+    for rec in samples:
+        for sid, v in (rec.get("values") or {}).items():
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            series.setdefault(sid, []).append(float(v))
+    if metric:
+        series = {k: v for k, v in series.items() if metric in k}
+    rows = []
+    for sid in sorted(series):
+        vals = series[sid]
+        rows.append({"series": sid, "count": len(vals),
+                     "min": round(min(vals), 4),
+                     "mean": round(sum(vals) / len(vals), 4),
+                     "max": round(max(vals), 4),
+                     "last": round(vals[-1], 4),
+                     "trend": sparkline(vals)})
+    omitted = max(0, len(rows) - top) if top else 0
+    if top:
+        # keep the busiest series when capping — a capped listing of
+        # all-zero constants would hide the interesting traces
+        rows.sort(key=lambda r: (-r["count"], r["series"]))
+        rows = sorted(rows[:top], key=lambda r: r["series"])
+    return {"meta": {k: meta.get(k) for k in
+                     ("namespace", "schema", "sample_every", "samples",
+                      "series", "sources") if k in meta},
+            "samples": len(samples),
+            "series": rows, "series_omitted": omitted,
+            "alerts": alerts}
+
+
+def render(summary):
+    m = summary["meta"]
+    lines = [f"telemetry: {summary['samples']} samples, "
+             f"{len(summary['series'])} series shown "
+             f"({summary['series_omitted']} omitted), "
+             f"sources {m.get('sources', '?')}, "
+             f"sample_every={m.get('sample_every', '?')}"]
+    if summary["series"]:
+        w = max(len(r["series"]) for r in summary["series"])
+        lines.append("")
+        lines.append(f"{'series':<{w + 2}}{'n':>5}{'min':>12}"
+                     f"{'mean':>12}{'max':>12}{'last':>12}  trend")
+        for r in summary["series"]:
+            lines.append(f"{r['series']:<{w + 2}}{r['count']:>5}"
+                         f"{r['min']:>12}{r['mean']:>12}{r['max']:>12}"
+                         f"{r['last']:>12}  {r['trend']}")
+    alerts = summary["alerts"]
+    lines.append("")
+    if not alerts:
+        lines.append("alerts: none")
+    else:
+        lines.append(f"alerts: {len(alerts)}")
+        for a in alerts:
+            lbl = ",".join(f"{k}={v}" for k, v in
+                           sorted((a.get("labels") or {}).items()))
+            lines.append(
+                f"  [{a.get('severity', '?'):<6}] step "
+                f"{a.get('step', '?')} {a.get('rule', '?')} on "
+                f"{a.get('metric', '?')}{{{lbl}}}: value "
+                f"{a.get('value')} vs threshold {a.get('threshold')}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="telemetry JSONL file")
+    ap.add_argument("--metric", default=None,
+                    help="only series whose id contains this substring")
+    ap.add_argument("--top", type=int, default=40,
+                    help="max series to list (default 40, 0 = all)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+    try:
+        meta, samples, alerts = load(args.path)
+    except TelemetryError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    summary = summarize(meta, samples, alerts, metric=args.metric,
+                        top=args.top)
+    try:
+        print(json.dumps(summary, indent=1) if args.json
+              else render(summary))
+    except BrokenPipeError:        # `... | head` closed stdout early
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

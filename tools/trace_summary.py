@@ -132,6 +132,9 @@ def summarize(meta, events, requests, top=10):
     sched = summarize_scheduler(events, live)
     if sched is not None:
         out["scheduler"] = sched
+    rt = summarize_routing(events)
+    if rt is not None:
+        out["routing"] = rt
     pre = summarize_prefill(events)
     if pre is not None:
         out["prefill"] = pre
@@ -282,6 +285,35 @@ def summarize_scheduler(events, requests):
     return out
 
 
+def summarize_routing(events):
+    """The fleet routing section: warm/cold/diverted counts, warm-hit
+    ratio, and each replica's share of the routed requests. Returns
+    None when the timeline carries no ``route`` events — single-engine
+    timelines keep their old summary shape."""
+    routes = [ev for ev in events if ev.get("name") == "route"]
+    if not routes:
+        return None
+    per = {}
+    warm = diverted = 0
+    for ev in routes:
+        rep = str(ev.get("replica"))
+        d = per.setdefault(rep, {"routed": 0, "warm": 0, "diverted": 0})
+        d["routed"] += 1
+        if ev.get("matched_tokens", 0):
+            d["warm"] += 1
+            warm += 1
+        if ev.get("diverted"):
+            d["diverted"] += 1
+            diverted += 1
+    n = len(routes)
+    for d in per.values():
+        d["share"] = round(d["routed"] / n, 4)
+    return {"requests": n, "warm": warm, "cold": n - warm,
+            "diverted": diverted,
+            "warm_hit_ratio": round(warm / n, 4),
+            "per_replica": {k: per[k] for k in sorted(per)}}
+
+
 def render(summary):
     lines = []
     m = summary["meta"]
@@ -378,6 +410,19 @@ def render(summary):
                 f"{h.get('extract_ms_mean', 0.0)} / put "
                 f"{h.get('put_ms_mean', 0.0)} / insert "
                 f"{h.get('insert_ms_mean', 0.0)})")
+    rt = summary.get("routing")
+    if rt:
+        lines.append("")
+        lines.append(
+            f"fleet routing: {rt['requests']} requests, "
+            f"warm {rt['warm']} / cold {rt['cold']} "
+            f"(warm-hit {rt['warm_hit_ratio']}), "
+            f"{rt['diverted']} diverted")
+        lines.append(f"{'replica':<18}{'routed':>8}{'share':>9}"
+                     f"{'warm':>7}{'diverted':>10}")
+        for name, d in rt["per_replica"].items():
+            lines.append(f"{name:<18}{d['routed']:>8}{d['share']:>9}"
+                         f"{d['warm']:>7}{d['diverted']:>10}")
     return "\n".join(lines)
 
 
