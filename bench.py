@@ -201,6 +201,53 @@ def _kernel_audit(out):
                 pass
 
 
+def _lifecycle_audit(out):
+    """Pre-serving lifecycle model-checker gate (BENCH_LIFECYCLE=0 opts
+    out): run tools/lifecycle_audit.py as the real CLI against the
+    committed LIFECYCLE_BASELINE.json — exhaustive small-scope
+    exploration of the page/slot/COW/spill/handoff state machine. A
+    scheduler-state-machine regression (page leak, refcount drift,
+    deadlock) fails the audit BEFORE the bench spends windows timing
+    the serving configs. Like the other audits, a failure marks the
+    capture (``lifecycle_audit.rc``); it never kills the bench."""
+    if os.environ.get("BENCH_LIFECYCLE", "1") == "0":
+        return
+    import tempfile
+    tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "lifecycle_audit.py")
+    res_path = None
+    try:
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            res_path = f.name
+        # pin the child to CPU: the model checker is pure host-side
+        # Python (BlockManager/PrefixCache/AdmissionQueue); a TPU
+        # backend init would contend with the bench's chip for nothing
+        p = subprocess.run(
+            [sys.executable, tool, "--json", res_path, "--quiet"],
+            capture_output=True, text=True, timeout=600,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        audit = {"rc": p.returncode}
+        try:
+            with open(res_path) as f:
+                audit["summary"] = json.load(f).get("summary", {})
+        except (OSError, json.JSONDecodeError):
+            pass
+        if p.returncode != 0:
+            audit["stderr"] = (p.stderr or "")[-400:]
+            print(f"[bench] lifecycle audit failed (rc={p.returncode}): "
+                  f"{(p.stderr or '').strip()[-200:]}", file=sys.stderr)
+        out["lifecycle_audit"] = audit
+    except Exception as e:  # noqa: BLE001 — audit is evidence, not bench
+        out["lifecycle_audit"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    finally:
+        if res_path:
+            try:
+                os.unlink(res_path)
+            except OSError:
+                pass
+
+
 def _kernel_gate(out):
     """Post-window per-kernel regression gate (BENCH_KERNEL_GATE=0 opts
     out): diff the fresh ``kernels`` capture against the banked BENCH
@@ -3184,6 +3231,8 @@ def main():
                      "resnet_breakdown", "ppyoloe", "llama_ladder"):
             if name == "kernels":
                 _kernel_audit(out)   # pre-window geometry audit
+            if name == "serving_engine":
+                _lifecycle_audit(out)  # pre-serving state-machine gate
             out[name] = run_cfg(name, 2700 if name == "llama_ladder"
                                 else extra_t)
             if name == "kernels":

@@ -25,6 +25,10 @@ from .registry import (REGISTRY, ProgramRegistry, ProgramSpec,
                        abstract_signature, register_program)
 from .kernel_rules import (KERNEL_RULE_CODES, check_launch,
                            dispatch_key_rule)
+from .lifecycle import (DEMO_SCOPES as LIFECYCLE_DEMO_SCOPES,
+                        SCOPES as LIFECYCLE_SCOPES, ExploreResult,
+                        ReqSpec, Scope, explore, fuzz, make_world,
+                        replay_trace)
 from .rules import (ALL_RULES, Finding, collective_consistency_rule,
                     constant_bloat_rule, donation_rule,
                     dtype_promotion_rule, retrace_hazard_rule)
@@ -39,4 +43,6 @@ __all__ = [
     "donation_rule", "retrace_hazard_rule", "collective_consistency_rule",
     "constant_bloat_rule", "load_baseline", "publish_findings",
     "register_program", "write_baseline",
+    "ExploreResult", "LIFECYCLE_DEMO_SCOPES", "LIFECYCLE_SCOPES",
+    "ReqSpec", "Scope", "explore", "fuzz", "make_world", "replay_trace",
 ]

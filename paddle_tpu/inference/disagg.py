@@ -198,7 +198,13 @@ class DisaggregatedEngine:
                  observability=False,
                  fused_decode=None, fused_prefill=None,
                  weight_quant=None,
-                 aging_s: Optional[float] = None, telemetry=False):
+                 aging_s: Optional[float] = None, telemetry=False,
+                 clock=None):
+        # injectable scheduler clock, threaded through BOTH group
+        # workers (serving.py's seam): one fake clock drives every
+        # submit_t/deadline/aging decision deterministically for tests
+        # and the lifecycle model checker. None = wall clock.
+        self._clock = clock if clock is not None else time.perf_counter
         pre_mesh, dec_mesh = self._resolve_groups(
             prefill_devices, decode_devices, mesh, prefill_tp,
             collective)
@@ -251,7 +257,7 @@ class DisaggregatedEngine:
             seed=seed, prefix_cache=prefix_cache, kv_offload=kv_offload,
             observability=pre_obs,
             fused_decode=False, fused_prefill=fused_prefill,
-            mesh=pre_mesh, aging_s=aging_s,
+            mesh=pre_mesh, aging_s=aging_s, clock=clock,
             on_complete=self._on_prefilled,
             on_chunk=self._on_prefill_chunk)
         self.decode = ServingEngine(
@@ -260,7 +266,7 @@ class DisaggregatedEngine:
             cache_dtype=cache_dtype, prefill_buckets=prefill_buckets,
             seed=seed + 1, prefix_cache=False, observability=dec_obs,
             fused_decode=fused_decode, fused_prefill=fused_prefill,
-            mesh=dec_mesh, aging_s=aging_s)
+            mesh=dec_mesh, aging_s=aging_s, clock=clock)
         if self._obs is not None:
             # one timeline ring + one request-record log for the whole
             # engine: both workers' events (submit/admit/prefill_chunk/
@@ -370,17 +376,17 @@ class DisaggregatedEngine:
         step over all live slots) — the two groups' device work streams
         run concurrently, which is the whole point."""
         obs = self._obs
-        t0 = time.perf_counter() if obs is not None else 0.0
+        t0 = self._clock() if obs is not None else 0.0
         if self._t_first is None:
-            self._t_first = time.perf_counter()
+            self._t_first = self._clock()
         did = self._run_handoffs()
         did = self.prefill.step() or did
         did = self.decode.step() or did
         if did:
-            self._t_last = time.perf_counter()
+            self._t_last = self._clock()
             if obs is not None:
                 obs.hist("step_ms").observe(
-                    (time.perf_counter() - t0) * 1e3)
+                    (self._clock() - t0) * 1e3)
         if self._telemetry is not None:
             self._telemetry.on_step()
         return did
@@ -565,7 +571,7 @@ class DisaggregatedEngine:
             self._extract_fn, self._insert_fn = self._build_handoff_fns()
         if self._quant and dec._kv_scales is None:
             self._sync_scales()
-        t0 = time.perf_counter()
+        t0 = self._clock()
         total = int(req.prompt.size) + int(req.gen.max_new_tokens)
         # decode-side allocation IS the page-table translation: the
         # request's table on this group is a fresh set of physical
@@ -592,11 +598,11 @@ class DisaggregatedEngine:
                 str(jnp.dtype(pre._k_pools.dtype)))
         kpag, vpag = self._extract_fn(pre._k_pools, pre._v_pools,
                                       pre._mesh.replicate(src_idx))
-        t1 = time.perf_counter()
+        t1 = self._clock()
         sh = dec._mesh.sharding(dec._mesh.pool_spec)
         kpag = jax.device_put(kpag, sh)
         vpag = jax.device_put(vpag, sh)
-        t2 = time.perf_counter()
+        t2 = self._clock()
         if job.final:
             pre.mgr.release(req.req_id)
         return {"job": job, "kpag": kpag, "vpag": vpag,
@@ -621,7 +627,7 @@ class DisaggregatedEngine:
         dec._k_pools, dec._v_pools = self._insert_fn(
             dec._k_pools, dec._v_pools,
             dec._mesh.replicate(st["dst_idx"]), st["kpag"], st["vpag"])
-        t3 = time.perf_counter()
+        t3 = self._clock()
         if st["task"] is not None:
             self._flight.end(st["task"])
         self.counters["kv_bytes_transferred"] += st["nbytes"]
@@ -756,7 +762,7 @@ class DisaggregatedEngine:
             self.counters[k] = 0
         self._hand_stats = [0, 0.0, 0.0]
         self._t_first = self._t_last = None
-        self._metrics_reset_t = time.perf_counter()
+        self._metrics_reset_t = self._clock()
         self._requests = [r for r in self._requests if not r.done]
         if self._flight is not None:
             self.counters.pop("collective_calls", None)

@@ -225,6 +225,110 @@ class PrefixCache:
         m["host_pages"] = self._host_pages
         return m
 
+    def check(self, raise_on_violation: bool = True):
+        """Structural + accounting invariant sweep over the radix tree
+        and its BlockManager — the single definition shared by the
+        lifecycle model checker (analysis/lifecycle.py) and the
+        engines' opt-in per-step self-check
+        (``PADDLE_TPU_CHECK_INVARIANTS=1``). Returns violation strings
+        (empty = clean); raises when ``raise_on_violation``. Runs the
+        manager's own check first, then the cross-structure checks only
+        the tree can do:
+
+        - node structure: ``parent.children`` keyed by the child's
+          EXACT token tuple (the upgrade-in-place rekey contract),
+          parent back-pointers consistent, token runs 1..block_size,
+          partial tails (< block_size tokens) leaf-only;
+        - residency: every non-root node is resident (page set, host
+          None) XOR spilled (page None, host payload kept matchable);
+          resident pages valid, never free-listed, refcount >= 1 (the
+          tree's own reference), and distinct across nodes;
+        - host tier: ``host_pages`` equals the spilled-node count,
+          never exceeds the budget, and the accounting identity
+          spilled == restored + readopted + host_evicted + host_pages
+          stays closed;
+        - refcount EQUALITY: every page's refcount equals its table
+          references plus its tree references — no reference is ever
+          leaked or double-counted anywhere in the serving stack.
+        """
+        problems = self.mgr.check(raise_on_violation=False)
+        tree_refs: Dict[int, int] = {}
+        n_spilled = 0
+        for parent in [self.root] + list(self._walk()):
+            for key, ch in parent.children.items():
+                if key != ch.tokens:
+                    problems.append(
+                        f"child keyed {key} but holds tokens "
+                        f"{ch.tokens} (rekey bug: keyed delete misses)")
+                if ch.parent is not parent:
+                    problems.append(
+                        f"node {ch.tokens} parent pointer broken")
+        for nd in self._walk():
+            nt = len(nd.tokens)
+            if not (1 <= nt <= self.bs):
+                problems.append(
+                    f"node has {nt} tokens (must be 1..{self.bs})")
+            if nt < self.bs and nd.children:
+                problems.append(
+                    f"partial tail {nd.tokens} has children (partials "
+                    "are COW-only leaves)")
+            if (nd.page is None) == (nd.host is None):
+                problems.append(
+                    f"node {nd.tokens} is neither cleanly resident nor "
+                    f"spilled (page={nd.page}, host set="
+                    f"{nd.host is not None})")
+            if nd.page is not None:
+                if not (0 <= nd.page < self.mgr.num_blocks):
+                    problems.append(
+                        f"node {nd.tokens} holds invalid page {nd.page}")
+                    continue
+                tree_refs[nd.page] = tree_refs.get(nd.page, 0) + 1
+                if tree_refs[nd.page] > 1:
+                    problems.append(
+                        f"page {nd.page} owned by two tree nodes")
+                if int(self.mgr.refcount[nd.page]) < 1:
+                    problems.append(
+                        f"resident node {nd.tokens} page {nd.page} has "
+                        f"refcount {int(self.mgr.refcount[nd.page])}")
+            elif nd.host is not None:
+                n_spilled += 1
+        if n_spilled != self._host_pages:
+            problems.append(
+                f"host_pages counter {self._host_pages} != "
+                f"{n_spilled} spilled nodes in the tree")
+        if self.host_budget is not None \
+                and self._host_pages > self.host_budget:
+            problems.append(
+                f"host tier over budget: {self._host_pages} > "
+                f"{self.host_budget}")
+        st = self.stats
+        if st["spilled_pages"] != (st["restored_pages"]
+                                   + st["readopted_pages"]
+                                   + st["host_evicted_pages"]
+                                   + self._host_pages):
+            problems.append(
+                "offload accounting broken: spilled "
+                f"{st['spilled_pages']} != restored "
+                f"{st['restored_pages']} + readopted "
+                f"{st['readopted_pages']} + host_evicted "
+                f"{st['host_evicted_pages']} + host {self._host_pages}")
+        table_refs = np.zeros(self.mgr.num_blocks, np.int64)
+        for table in self.mgr.tables.values():
+            for p in table:
+                if 0 <= p < self.mgr.num_blocks:
+                    table_refs[p] += 1
+        for p in range(self.mgr.num_blocks):
+            expect = int(table_refs[p]) + tree_refs.get(p, 0)
+            if int(self.mgr.refcount[p]) != expect:
+                problems.append(
+                    f"page {p} refcount {int(self.mgr.refcount[p])} != "
+                    f"{int(table_refs[p])} table + "
+                    f"{tree_refs.get(p, 0)} tree references")
+        if problems and raise_on_violation:
+            raise RuntimeError(
+                "PrefixCache.check failed:\n  " + "\n  ".join(problems))
+        return problems
+
     def summary(self) -> Dict[int, int]:
         """The fleet router's tree summary: ``{prefix_hash: n_tokens}``
         for every page-aligned cached path (resident AND spilled — a

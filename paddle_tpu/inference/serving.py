@@ -211,13 +211,28 @@ class ServingEngine:
                  prefix_cache: bool = False, kv_offload=False,
                  observability=False, fused_decode=None, mesh=None,
                  fused_prefill=None, weight_quant=None,
-                 aging_s: Optional[float] = None, telemetry=False):
+                 aging_s: Optional[float] = None, telemetry=False,
+                 clock=None):
         # tensor parallelism (inference/tp.py): a ServingMesh shards
         # the KV pools, projections and per-slot attention along the
         # head axis; programs wrap in shard_map. None = single device.
         # Accepts a ServingMesh, a 1-D jax Mesh, or an int tp degree.
         from ..quantization.ptq import ensure_quantized
         from .tp import normalize_mesh
+        # injectable scheduler clock (the admission queue's idiom, now
+        # engine-wide): every scheduling timestamp — submit_t, expiry,
+        # aging, admit/finish times — reads THIS callable, so tests and
+        # the lifecycle model checker (analysis/lifecycle.py) can drive
+        # admission deadlines and aging deterministically. None = wall
+        # clock (time.perf_counter), behavior unchanged.
+        self._clock = clock if clock is not None else time.perf_counter
+        # opt-in per-step structural self-check: the lifecycle model
+        # checker's manager+cache invariant set (BlockManager.check /
+        # PrefixCache.check) asserted after every step. Off by default
+        # (it walks the tree and the page pool each step).
+        import os as _os_env
+        self._check_inv = _os_env.environ.get(
+            "PADDLE_TPU_CHECK_INVARIANTS", "") == "1"
         # weight quantization (quantization/ptq.py): "int8"/"int4"
         # quantizes a plain fp tree in ONE shot (host-side per-channel
         # absmax — the int8-KV first-prompt idiom, pointed at weights);
@@ -398,7 +413,8 @@ class ServingEngine:
         # aging for starvation-freedom. Default submissions (one class,
         # no deadline, no aging) pop in exact FIFO order — the PR-1
         # contract unchanged.
-        self._queue = AdmissionQueue(aging_s=aging_s)
+        self._queue = AdmissionQueue(aging_s=aging_s,
+                                     clock=self._clock)
         # per-class queue-wait running stats + SLO attainment counters,
         # updated O(1) at admit/expire so metrics() never scans the
         # request list per class: cls -> [admitted, wait_ms_sum,
@@ -569,7 +585,7 @@ class ServingEngine:
             (self._offload_extract_fn,
              self._offload_insert_fn) = self._make_offload_fns()
         W = self._offload_window
-        t0 = time.perf_counter()
+        t0 = self._clock()
         payloads = []
         for w0 in range(0, len(pages), W):
             win = list(pages[w0:w0 + W])
@@ -584,7 +600,7 @@ class ServingEngine:
                                  np.ascontiguousarray(vw_np[:, j])))
         self.counters["kv_spill_bytes"] += self._page_nbytes * len(pages)
         if self._obs is not None and pages:
-            dur = (time.perf_counter() - t0) * 1e3
+            dur = (self._clock() - t0) * 1e3
             per = dur / len(pages)
             for _ in pages:      # one observation per PAGE (the
                 self._obs.hist("spill_ms").observe(per)   # count
@@ -607,7 +623,7 @@ class ServingEngine:
              self._offload_insert_fn) = self._make_offload_fns()
         W = self._offload_window
         ps = self._k_pools.shape           # [L, N, BS, KV, hd]
-        t0 = time.perf_counter()
+        t0 = self._clock()
         for w0 in range(0, len(dsts), W):
             win_p = payloads[w0:w0 + W]
             win_d = list(dsts[w0:w0 + W])
@@ -630,7 +646,7 @@ class ServingEngine:
         self.counters["kv_restore_bytes"] += \
             self._page_nbytes * len(dsts)
         if self._obs is not None and dsts:
-            dur = (time.perf_counter() - t0) * 1e3
+            dur = (self._clock() - t0) * 1e3
             per = dur / len(dsts)
             for _ in dsts:
                 self._obs.hist("restore_ms").observe(per)
@@ -677,7 +693,7 @@ class ServingEngine:
         if deadline_s is None:
             deadline_s = getattr(gen, "deadline_s", None)
         req = Request(self._next_id, prompt, gen,
-                      submit_t=time.perf_counter(),
+                      submit_t=self._clock(),
                       priority=int(priority), deadline_s=deadline_s)
         need = -(-self._alloc_tokens(req) // self.block_size)
         if need > self.num_blocks - 1:          # minus the scratch page
@@ -707,18 +723,24 @@ class ServingEngine:
         count as scheduler progress (a drain() whose last step only
         expires a request must finish cleanly, not report starvation)."""
         obs = self._obs
-        t0 = time.perf_counter() if obs is not None else 0.0
+        t0 = self._clock() if obs is not None else 0.0
         if self._t_first is None:
-            self._t_first = time.perf_counter()
+            self._t_first = self._clock()
         expired = self._admit()
         did = self._run_prefill()
         did = self._run_decode() or did
         if did:
-            self._t_last = time.perf_counter()
+            self._t_last = self._clock()
         if obs is not None:
             self._observe_step(t0, did)
         if self._telemetry is not None:
             self._telemetry.on_step()
+        if self._check_inv:
+            # PADDLE_TPU_CHECK_INVARIANTS=1: assert the lifecycle
+            # checker's manager+cache invariant set after every step
+            self.mgr.check()
+            if self._pcache is not None:
+                self._pcache.check()
         return did or expired > 0
 
     def _observe_step(self, t0: float, did: bool):
@@ -726,7 +748,7 @@ class ServingEngine:
         Pure host bookkeeping — reads only host mirrors, never the
         device."""
         obs = self._obs
-        now = time.perf_counter()
+        now = self._clock()
         free = len(self.mgr.free)
         vals = {
             "pages_free": free,
@@ -1087,7 +1109,7 @@ class ServingEngine:
             for k in self._pcache.stats:
                 self._pcache.stats[k] = 0
         self._t_first = self._t_last = None
-        self._metrics_reset_t = time.perf_counter()
+        self._metrics_reset_t = self._clock()
         self._requests = [r for r in self._requests if not r.done]
         if self._flight is not None:
             # the recorder's call/byte counters live in the adopted
@@ -1147,7 +1169,7 @@ class ServingEngine:
     def _admit(self) -> int:
         """Admit from the queue until blocked; returns the number of
         deadline expiries (scheduler progress the caller must count)."""
-        now = time.perf_counter()
+        now = self._clock()
         expired = self._queue.pop_expired(now)
         for entry in expired:
             self._expire(entry.item, now)
@@ -1249,7 +1271,7 @@ class ServingEngine:
             # admit_t is the FIRST admission (queue-wait semantics);
             # a resume keeps it so per-request records report the
             # original admission wait, not the requeue wait
-            req.admit_t = time.perf_counter()
+            req.admit_t = self._clock()
             wait_ms = (req.admit_t - req.submit_t) * 1e3
             st = self._sched_cls.setdefault(req.priority, [0, 0.0, 0.0])
             st[0] += 1
@@ -1262,7 +1284,7 @@ class ServingEngine:
             if self._obs is not None:
                 self._obs.hist("queue_wait_ms").observe(wait_ms)
         if self._obs is not None:
-            wait_ms = (time.perf_counter() - req.submit_t) * 1e3
+            wait_ms = (self._clock() - req.submit_t) * 1e3
             self._obs.timeline.record(
                 "admit" if first else "resume", req.req_id,
                 slot=slot_id, queue_wait_ms=round(wait_ms, 3),
@@ -1372,7 +1394,7 @@ class ServingEngine:
                                           else "ref")
             toks = np.zeros((1, P), np.int32)
             toks[0, :n] = req.prompt[pos0:pos0 + n]
-            t0 = time.perf_counter() if self._obs is not None else 0.0
+            t0 = self._clock() if self._obs is not None else 0.0
             if self._flight is not None:
                 inv = self._coll_prefill.get(P)
                 if inv is None:
@@ -1399,7 +1421,7 @@ class ServingEngine:
             if self._obs is not None:
                 # host dispatch time only (the chunk completes async on
                 # device; forcing it here would ADD a sync to the loop)
-                dur_ms = (time.perf_counter() - t0) * 1e3
+                dur_ms = (self._clock() - t0) * 1e3
                 self._obs.hist("prefill_chunk_ms").observe(dur_ms)
                 self._obs.timeline.record(
                     "prefill_chunk", req.req_id, dur_ms=dur_ms,
@@ -1413,7 +1435,7 @@ class ServingEngine:
                 self._on_prefill_chunk(slot_id)
             if slot.prefill_pos == S:
                 first = int(np.asarray(tok))
-                req.first_token_t = time.perf_counter()
+                req.first_token_t = self._clock()
                 req.ttft = req.first_token_t - req.submit_t
                 req.tokens.append(first)
                 if self._obs is not None:
@@ -1490,7 +1512,7 @@ class ServingEngine:
             self._d_tables = self._upload(self._h_tables.copy())
             self._d_temps = self._upload(self._h_temps.copy())
             self._dirty = False
-        t0 = time.perf_counter() if self._obs is not None else 0.0
+        t0 = self._clock() if self._obs is not None else 0.0
         tasks = self._record_collectives(self._coll_decode)
         (self._d_tok, self._d_seq, self._d_key, self._k_pools,
          self._v_pools) = self._decode_fn(
@@ -1504,7 +1526,7 @@ class ServingEngine:
             # dispatch-to-sync wall time: the d2h read above already
             # synchronizes every step, so this measures real step
             # latency without adding any device round-trip
-            dur_ms = (time.perf_counter() - t0) * 1e3
+            dur_ms = (self._clock() - t0) * 1e3
             self._obs.hist("decode_step_ms").observe(dur_ms)
             # per-variant attribution, mirroring the prefill chunk's
             # ``variant`` stamp: which decode-block implementation
@@ -1533,7 +1555,7 @@ class ServingEngine:
         slot = self._slots[slot_id]
         req = slot.req
         req.done = True
-        req.finish_t = time.perf_counter()
+        req.finish_t = self._clock()
         if self._obs is not None:
             n_gen = len(req.tokens)
             tpot_ms = (((req.finish_t - req.first_token_t)

@@ -335,3 +335,63 @@ class BlockManager:
             t = self.tables.get(sid, [])
             out[i, :len(t)] = t
         return out
+
+    def check(self, raise_on_violation: bool = True):
+        """Cheap structural invariant sweep over the allocator — the
+        single definition shared by the lifecycle model checker
+        (analysis/lifecycle.py) and the engines' opt-in per-step
+        self-check (``PADDLE_TPU_CHECK_INVARIANTS=1``). Returns the
+        list of violation strings (empty = clean); raises instead when
+        ``raise_on_violation``.
+
+        Checked here (manager-local; the cross-structure refcount
+        EQUALITY needs the radix tree and lives in
+        ``PrefixCache.check``):
+
+        - refcounts never negative; free-list pages have refcount 0;
+        - no duplicate or out-of-range free-list entries;
+        - page conservation: every page is either free or referenced
+          (refcount > 0) — no page is ever lost;
+        - every table entry is a valid page id with refcount >= the
+          number of table references to it (a table can never hold
+          more references than the refcount records).
+        """
+        problems = []
+        seen_free = set()
+        for p in self.free:
+            if not (0 <= p < self.num_blocks):
+                problems.append(f"free list holds invalid page {p}")
+                continue
+            if p in seen_free:
+                problems.append(f"page {p} appears twice in free list")
+            seen_free.add(p)
+            if int(self.refcount[p]) != 0:
+                problems.append(
+                    f"free page {p} has refcount "
+                    f"{int(self.refcount[p])} (must be 0)")
+        table_refs = np.zeros(self.num_blocks, np.int64)
+        for sid, table in self.tables.items():
+            for p in table:
+                if not (0 <= p < self.num_blocks):
+                    problems.append(
+                        f"table {sid} holds invalid page {p}")
+                    continue
+                table_refs[p] += 1
+        for p in range(self.num_blocks):
+            rc = int(self.refcount[p])
+            if rc < 0:
+                problems.append(f"page {p} refcount negative ({rc})")
+            if rc == 0 and p not in seen_free:
+                problems.append(
+                    f"page {p} leaked: refcount 0 but not in free list")
+            if rc > 0 and p in seen_free:
+                problems.append(
+                    f"page {p} in free list with refcount {rc}")
+            if rc < int(table_refs[p]):
+                problems.append(
+                    f"page {p} refcount {rc} < {int(table_refs[p])} "
+                    "table references (tables over-share the page)")
+        if problems and raise_on_violation:
+            raise RuntimeError(
+                "BlockManager.check failed:\n  " + "\n  ".join(problems))
+        return problems

@@ -1,0 +1,285 @@
+"""Lifecycle model checker: the shared invariant hooks on the real
+allocator/cache classes, exhaustive small-scope exploration of the
+page/slot/COW/spill/handoff state machine, the two demo-regression
+bugs, fuzz determinism, counterexample replay, the CLI gate contract,
+and the bench pre-step wiring."""
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.lifecycle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "tools", "lifecycle_audit.py")
+COMMITTED_BASELINE = os.path.join(REPO, "LIFECYCLE_BASELINE.json")
+
+from paddle_tpu.analysis import lifecycle as lc            # noqa: E402
+from paddle_tpu.inference.prefix_cache import PrefixCache  # noqa: E402
+from paddle_tpu.ops.paged_attention import BlockManager    # noqa: E402
+
+
+def _run(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, CLI, *args],
+                          capture_output=True, text=True, env=env,
+                          timeout=600)
+
+
+# -- satellite: shared .check() invariant hooks on the REAL classes ---
+
+def test_blockmanager_check_clean_through_lifecycle():
+    mgr = BlockManager(num_blocks=6, block_size=2, max_blocks_per_seq=8)
+    mgr.allocate(1, 3)
+    mgr.attach(2, mgr.tables[1][:1])            # share page, incref
+    assert mgr.check() == []
+    mgr.release(1)
+    mgr.release(2)
+    assert mgr.check() == []
+    assert len(mgr.free) == 6
+
+
+def test_blockmanager_check_detects_seeded_corruption():
+    mgr = BlockManager(num_blocks=6, block_size=2, max_blocks_per_seq=8)
+    mgr.allocate(1, 4)
+    p = mgr.tables[1][0]
+    mgr.refcount[p] = 0          # table still references p: leak + over-share
+    problems = mgr.check(raise_on_violation=False)
+    assert any("leaked" in m for m in problems)
+    assert any("table references" in m for m in problems)
+    with pytest.raises(RuntimeError, match="BlockManager.check failed"):
+        mgr.check()
+    # duplicate free-list entry is its own violation class
+    mgr2 = BlockManager(num_blocks=4, block_size=2, max_blocks_per_seq=8)
+    mgr2.free.append(mgr2.free[-1])
+    assert any("twice" in m
+               for m in mgr2.check(raise_on_violation=False))
+
+
+def test_blockmanager_refcount_never_negative():
+    mgr = BlockManager(num_blocks=4, block_size=2, max_blocks_per_seq=8)
+    page = mgr.alloc_page()
+    assert mgr.decref(page) is True
+    with pytest.raises(RuntimeError, match="negative"):
+        mgr.decref(page)
+
+
+def test_prefix_cache_check_clean_and_corrupt():
+    mgr = BlockManager(num_blocks=8, block_size=2, max_blocks_per_seq=8)
+    pc = PrefixCache(mgr, block_size=2, copy_page=lambda s, d: None)
+    mgr.allocate(1, 4)
+    pc.insert((1, 2, 3, 4), mgr.tables[1])
+    mgr.release(1)                       # tree keeps the pages alive
+    assert pc.check() == []
+    pc._host_pages += 1                  # seed an offload-counter drift
+    problems = pc.check(raise_on_violation=False)
+    assert any("host_pages counter" in m for m in problems)
+    with pytest.raises(RuntimeError, match="PrefixCache.check failed"):
+        pc.check()
+
+
+def test_engine_per_step_selfcheck_env_hook(monkeypatch):
+    """PADDLE_TPU_CHECK_INVARIANTS=1 arms the engines' per-step
+    mgr/pcache .check() — a clean drain must not raise."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.inference.generation import GenerationConfig
+    from paddle_tpu.inference.serving import ServingEngine
+    from paddle_tpu.models import llama
+    monkeypatch.setenv("PADDLE_TPU_CHECK_INVARIANTS", "1")
+    cfg = llama.LlamaConfig(vocab_size=61, hidden_size=32,
+                            intermediate_size=64, num_hidden_layers=1,
+                            num_attention_heads=2, num_key_value_heads=2,
+                            max_position_embeddings=64,
+                            dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ServingEngine(params, cfg, capacity=2, block_size=4,
+                        prefill_buckets=(16,), max_seq_len=32)
+    assert eng._check_inv is True
+    ids = np.random.RandomState(0).randint(0, 61, (5,)).astype(np.int32)
+    req = eng.submit(ids, GenerationConfig(max_new_tokens=4, greedy=True))
+    eng.drain()
+    assert req.output_ids is not None
+
+
+# -- the model itself -------------------------------------------------
+
+def test_make_world_rejects_request_that_cannot_fit():
+    sc = lc.Scope(name="too_big",
+                  requests=(lc.ReqSpec((1, 2, 3, 4, 5, 6), max_new=2),),
+                  capacity=1, num_blocks=3, block_size=2)
+    with pytest.raises(ValueError, match="trivial deadlock"):
+        lc.make_world(sc)
+
+
+@pytest.mark.parametrize("name", sorted(lc.SCOPES))
+def test_catalog_scope_explores_clean_reduced(name):
+    """Every committed scope stays invariant-clean. Fast tier: a
+    truncated prefix of the state space; the slow tier + CLI gate run
+    the exhaustive catalog."""
+    res = lc.explore(lc.SCOPES[name], max_states=2000)
+    assert res.report.findings == []
+    assert res.states > 50
+    assert res.report.meta["mode"] in ("colocated", "disagg")
+
+
+@pytest.mark.slow
+def test_exhaustive_catalog_meets_scale_budget():
+    """Acceptance bound: the full catalog explores >= 10^4 distinct
+    states, untruncated, clean, in under 60 s."""
+    total_states = total_wall = 0
+    for sc in lc.SCOPES.values():
+        res = lc.explore(sc)
+        assert res.report.findings == [], sc.name
+        assert not res.truncated, sc.name
+        total_states += res.states
+        total_wall += res.wall_s
+    assert total_states >= 10_000
+    assert total_wall < 60.0
+
+
+def test_demo_starved_head_deadlocks_with_short_trace():
+    sc = lc.DEMO_SCOPES["demo_starved_head"]
+    res = lc.explore(sc)
+    codes = {f.code for f in res.report.findings}
+    assert "DEADLOCK" in codes
+    f = next(f for f in res.report.findings if f.code == "DEADLOCK")
+    assert len(f.detail["trace"]) <= 25
+    assert f.detail["injected_bug"] == "starved_head"
+    # replay: the trace lands in a wedged state — requests still
+    # pending, but no enabled action makes progress
+    world, problems = lc.replay_trace(sc, f.detail["trace"])
+    assert problems == []            # deadlock is a progress property
+    assert world.pending()
+    for action in world.actions():
+        child = copy.deepcopy(world)
+        changed, _ = child.apply(action)
+        assert not changed, f"action {action} escaped the deadlock"
+
+
+def test_demo_abort_leak_found_and_replayable():
+    sc = lc.DEMO_SCOPES["demo_abort_leak"]
+    res = lc.explore(sc)
+    f = next(f for f in res.report.findings if f.code == "ABORT_LEAK")
+    assert len(f.detail["trace"]) <= 25
+    assert f.fingerprint.startswith(
+        "lifecycle_demo_abort_leak::lifecycle::ABORT_LEAK::")
+    assert f.severity == "error" and f.rule == "lifecycle"
+    world, problems = lc.replay_trace(sc, f.detail["trace"])
+    assert any(code == "ABORT_LEAK" for code, _, _ in problems)
+
+
+def test_fuzz_is_deterministic_byte_for_byte():
+    sc = lc.SCOPES["coloc_nocache"]
+    a = lc.fuzz(sc, 20, seed=11)
+    b = lc.fuzz(sc, 20, seed=11)
+    assert a.transitions == b.transitions
+    assert [f.detail for f in a.report.findings] \
+        == [f.detail for f in b.report.findings]
+    assert a.report.findings == [] and b.report.findings == []
+
+
+# -- the gate: committed baseline + CLI contract ----------------------
+
+def test_committed_baseline_holds_zero_findings():
+    with open(COMMITTED_BASELINE) as fh:
+        doc = json.load(fh)
+    assert doc["findings"] == {}
+    assert doc["version"] == 1
+
+
+def test_cli_gate_clean_vs_committed_baseline():
+    p = _run("--max-states", "1500", "--quiet")
+    assert p.returncode == 0, p.stderr
+
+
+def test_cli_fuzz_mode_clean():
+    p = _run("--fuzz", "5", "--seed", "3", "--scope", "coloc_spill")
+    assert p.returncode == 0, p.stderr
+    assert "walk(s)" in p.stdout
+
+
+def test_cli_demo_regression_fails_gate_with_traces(tmp_path):
+    doc_path = str(tmp_path / "doc.json")
+    p = _run("--scope", "demo_starved_head", "--scope",
+             "demo_abort_leak", "--demo-regression", "--json", doc_path)
+    assert p.returncode == 2, p.stdout + p.stderr
+    assert "GATE FAILED" in p.stderr
+    with open(doc_path) as fh:
+        doc = json.load(fh)
+    by_code = {f["code"] for r in doc["programs"].values()
+               for f in r["findings"]}
+    assert {"DEADLOCK", "ABORT_LEAK"} <= by_code
+    for r in doc["programs"].values():
+        for f in r["findings"]:
+            assert len(f["detail"]["trace"]) <= 25
+
+
+def test_cli_dump_dir_writes_flight_recorder_counterexample(tmp_path):
+    d = str(tmp_path / "ce")
+    p = _run("--scope", "demo_abort_leak", "--demo-regression",
+             "--no-baseline", "--dump-dir", d)
+    assert p.returncode == 2
+    dumps = sorted(os.listdir(d))
+    assert dumps and dumps[0] == "lifecycle_ce_0.json"
+    with open(os.path.join(d, dumps[0])) as fh:
+        dump = json.load(fh)
+    assert dump["reason"].startswith("lifecycle:")
+    assert dump["fingerprint"].startswith("lifecycle_demo_abort_leak::")
+    assert dump["injected_bug"] == "abort_leak"
+    assert dump["timeline_tail"]          # one entry per trace action
+    assert all(e["event"] == "action" for e in dump["timeline_tail"])
+
+
+def test_cli_refusal_and_bad_invocation_exit_3():
+    p = _run("--write-baseline", "--demo-regression")
+    assert p.returncode == 3 and "refusing" in p.stderr
+    p = _run("--write-baseline", "--scope", "coloc_spill")
+    assert p.returncode == 3 and "refusing" in p.stderr
+    p = _run("--scope", "no_such_scope")
+    assert p.returncode == 3 and "unknown scope" in p.stderr
+
+
+def test_cli_list_names_catalog_and_demos():
+    p = _run("--list")
+    assert p.returncode == 0
+    for name in list(lc.SCOPES) + list(lc.DEMO_SCOPES):
+        assert name in p.stdout
+    assert "demo" in p.stdout and "bug=" in p.stdout
+
+
+# -- chaining: program-audit --all and the bench pre-step -------------
+
+def test_bench_lifecycle_pre_step_opt_out(monkeypatch):
+    sys.path.insert(0, REPO)
+    import bench
+    monkeypatch.setenv("BENCH_LIFECYCLE", "0")
+    out = {}
+    bench._lifecycle_audit(out)
+    assert out == {}                     # opt-out leaves no marker
+
+
+@pytest.mark.slow
+def test_bench_lifecycle_pre_step_banks_rc(monkeypatch):
+    sys.path.insert(0, REPO)
+    import bench
+    monkeypatch.delenv("BENCH_LIFECYCLE", raising=False)
+    out = {}
+    bench._lifecycle_audit(out)
+    assert out["lifecycle_audit"]["rc"] == 0
+    assert out["lifecycle_audit"]["summary"]["findings"] == 0
+
+
+@pytest.mark.slow
+def test_program_audit_all_chains_lifecycle_gate():
+    tool = os.path.join(REPO, "tools", "program_audit.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run([sys.executable, tool, "--all"],
+                       capture_output=True, text=True, env=env,
+                       timeout=1200)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "[lifecycle]" in p.stdout     # the chained gate actually ran

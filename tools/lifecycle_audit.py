@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Lifecycle model-checker gate over the serving state machine.
+
+Exhaustively explores the committed scope catalog
+(``paddle_tpu.analysis.lifecycle.SCOPES``) — every interleaving of
+submit/admit/prefill/decode/finish/preempt/expire/evict/spill/restore/
+handoff/abort actions at small scopes, driving the REAL BlockManager /
+PrefixCache / AdmissionQueue — and diffs the findings against the
+committed baseline. NEW findings (not in the baseline) fail the gate
+with exit code 2 and print a BFS-shortest, replayable counterexample
+trace; the committed catalog is expected to hold 0 findings.
+
+Usage:
+  python tools/lifecycle_audit.py                      # gate vs LIFECYCLE_BASELINE.json
+  python tools/lifecycle_audit.py --json out.json      # bank the full findings doc
+  python tools/lifecycle_audit.py --write-baseline     # freeze current findings
+  python tools/lifecycle_audit.py --scope coloc_prefix --scope disagg
+  python tools/lifecycle_audit.py --list               # scope catalog + demo scopes
+  python tools/lifecycle_audit.py --demo-regression    # re-inject the pre-fix r15
+                                                       # starvation deadlock and the
+                                                       # skipped-decref abort leak
+                                                       # (gate must FAIL on both)
+  python tools/lifecycle_audit.py --fuzz 200 --seed 7  # deterministic random walks
+                                                       # instead of exhaustive BFS
+  python tools/lifecycle_audit.py --dump-dir /tmp/lc   # counterexample traces as
+                                                       # flight-recorder JSON dumps
+
+Exit codes: 0 clean (no new findings), 2 new findings (or a demo
+regression reproduced — the expected CI self-check failure), 3 bad
+invocation, broken baseline, or a demo scope that FAILED to reproduce
+its injected bug (the checker itself regressed).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+DEFAULT_BASELINE = os.path.join(_REPO, "LIFECYCLE_BASELINE.json")
+
+
+def _dump_finding(f, dump_dir: str, idx: int) -> str:
+    """One counterexample through the flight-recorder stall-dump
+    format: the trace rides as the timeline tail (one entry per
+    action), the end-state summary as the scheduler snapshot."""
+    from paddle_tpu.observability.stall import dump_stall
+    detail = f.detail
+    tail = [{"event": "action", "step": i, "action": a, "label": lbl}
+            for i, (a, lbl) in enumerate(zip(detail.get("trace", ()),
+                                             detail.get("labels", ())))]
+    path = os.path.join(dump_dir, f"lifecycle_ce_{idx}.json")
+    return dump_stall(
+        reason=f"lifecycle:{f.code}",
+        scheduler=detail.get("state", {}),
+        timeline_tail=tail, path=path,
+        extra={"fingerprint": f.fingerprint, "message": f.message,
+               "scope": detail.get("scope"),
+               "injected_bug": detail.get("injected_bug")})
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON (default: repo "
+                         "LIFECYCLE_BASELINE.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the diff: report findings, exit 2 on any")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="freeze current findings as the baseline and "
+                         "exit 0")
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the full findings document to PATH")
+    ap.add_argument("--scope", action="append", default=None,
+                    help="explore only these catalog scopes (repeatable)")
+    ap.add_argument("--list", action="store_true",
+                    help="print scope names (catalog + demo) and exit")
+    ap.add_argument("--demo-regression", action="store_true",
+                    help="also explore the two injected-bug demo scopes "
+                         "— the gate must fail on each (CI self-check)")
+    ap.add_argument("--fuzz", type=int, metavar="N", default=0,
+                    help="run N deterministic random walks per scope "
+                         "instead of exhaustive BFS")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="fuzz seed (failing traces replay "
+                         "byte-for-byte from the same seed)")
+    ap.add_argument("--max-states", type=int, default=None,
+                    help="override every scope's explored-state cap")
+    ap.add_argument("--max-depth", type=int, default=None,
+                    help="override every scope's BFS depth cap")
+    ap.add_argument("--dump-dir", metavar="DIR", default=None,
+                    help="write each counterexample as a flight-"
+                         "recorder JSON dump under DIR")
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.analysis import lifecycle as lc
+    from paddle_tpu.analysis import (diff_findings, findings_to_json,
+                                     load_baseline, write_baseline)
+
+    if args.list:
+        for name, sc in lc.SCOPES.items():
+            print(f"{name}: {sc.note}")
+        for name, sc in lc.DEMO_SCOPES.items():
+            print(f"{name} [demo, bug={sc.bug}]: {sc.note}")
+        return 0
+
+    if args.write_baseline and args.demo_regression:
+        print("[lifecycle] refusing --write-baseline with "
+              "--demo-regression: the injected bugs must never become "
+              "accepted findings", file=sys.stderr)
+        return 3
+    if args.write_baseline and args.scope \
+            and args.baseline == DEFAULT_BASELINE:
+        print("[lifecycle] refusing --write-baseline for a --scope "
+              "subset over the shared baseline — explore the full "
+              "catalog, or point --baseline at a scratch file",
+              file=sys.stderr)
+        return 3
+
+    names = args.scope or list(lc.SCOPES)
+    unknown = [n for n in names
+               if n not in lc.SCOPES and n not in lc.DEMO_SCOPES]
+    if unknown:
+        print(f"[lifecycle] unknown scope(s): {', '.join(unknown)} "
+              f"(see --list)", file=sys.stderr)
+        return 3
+    scopes = [lc.SCOPES.get(n) or lc.DEMO_SCOPES[n] for n in names]
+    demo_names = set()
+    if args.demo_regression:
+        for n, sc in lc.DEMO_SCOPES.items():
+            if n not in names:
+                scopes.append(sc)
+            demo_names.add(n)
+
+    say = (lambda *a: None) if args.quiet else print
+    reports, results = [], []
+    for sc in scopes:
+        if args.fuzz > 0:
+            res = lc.fuzz(sc, args.fuzz, seed=args.seed)
+            say(f"[lifecycle] {sc.name}: {args.fuzz} walk(s), "
+                f"{res.transitions} transitions, "
+                f"{len(res.report.findings)} finding(s), "
+                f"{res.wall_s:.1f}s")
+        else:
+            res = lc.explore(sc, max_states=args.max_states,
+                             max_depth=args.max_depth)
+            say(f"[lifecycle] {sc.name}: {res.states} states, "
+                f"{res.transitions} transitions"
+                f"{' (truncated)' if res.truncated else ''}, "
+                f"{len(res.report.findings)} finding(s), "
+                f"{res.wall_s:.1f}s")
+        reports.append(res.report)
+        results.append(res)
+        for f in res.report.findings:
+            say(f"  error   lifecycle/{f.code} @ {f.site}")
+            say(f"          {f.message}")
+            say(f"          trace ({len(f.detail['trace'])} actions): "
+                f"{f.detail['labels']}")
+
+    # CI self-check: a demo scope that no longer reproduces its
+    # injected bug means the CHECKER regressed, not the code under test
+    if args.demo_regression:
+        for res in results:
+            bug = res.report.meta.get("injected_bug")
+            if bug and not res.report.findings:
+                print(f"[lifecycle] SELF-CHECK FAILED: demo scope "
+                      f"{res.report.program} (bug={bug}) produced no "
+                      "finding — the checker lost its teeth",
+                      file=sys.stderr)
+                return 3
+
+    doc = findings_to_json(reports)
+    if args.json:
+        with open(args.json, "w") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+    if args.dump_dir:
+        os.makedirs(args.dump_dir, exist_ok=True)
+        i = 0
+        for r in reports:
+            for f in r.findings:
+                p = _dump_finding(f, args.dump_dir, i)
+                say(f"[lifecycle] counterexample dumped: {p}")
+                i += 1
+
+    if args.write_baseline:
+        write_baseline(reports, args.baseline)
+        say(f"[lifecycle] baseline written: {args.baseline} "
+            f"({doc['summary']['findings']} accepted finding(s))")
+        return 0
+
+    if args.no_baseline:
+        n = doc["summary"]["findings"]
+        say(f"[lifecycle] {n} finding(s), no baseline diff")
+        return 2 if n else 0
+
+    try:
+        baseline = load_baseline(args.baseline)
+    except FileNotFoundError:
+        say(f"[lifecycle] no baseline at {args.baseline} — treating "
+            "every finding as new (write one with --write-baseline)")
+        baseline = {"findings": {}}
+    except ValueError as e:
+        print(f"[lifecycle] BROKEN BASELINE: {e}", file=sys.stderr)
+        return 3
+
+    new, fixed = diff_findings(reports, baseline)
+    for fp in fixed:
+        say(f"[lifecycle] fixed vs baseline: {fp}")
+    if new:
+        print(f"[lifecycle] GATE FAILED: {len(new)} new finding(s) vs "
+              f"{args.baseline}:", file=sys.stderr)
+        for f in new:
+            print(f"  error   {f.fingerprint}\n"
+                  f"          {f.message}\n"
+                  f"          trace: {f.detail.get('trace')}",
+                  file=sys.stderr)
+        return 2
+    say(f"[lifecycle] gate clean: {doc['summary']['findings']} "
+        f"finding(s), all accepted by baseline ({len(fixed)} fixed)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -62,8 +62,10 @@ def main(argv=None) -> int:
     ap.add_argument("--all", action="store_true", dest="all_audits",
                     help="also run the kernel-geometry audit "
                          "(tools/kernel_audit.py) vs "
-                         "KERNEL_AUDIT_BASELINE.json; exits with the "
-                         "worst of the two gates")
+                         "KERNEL_AUDIT_BASELINE.json and the lifecycle "
+                         "model-checker gate (tools/lifecycle_audit.py) "
+                         "vs LIFECYCLE_BASELINE.json; exits with the "
+                         "worst of the three gates")
     ap.add_argument("--quiet", action="store_true")
     args = ap.parse_args(argv)
 
@@ -99,24 +101,25 @@ def main(argv=None) -> int:
         return 3
 
     def finish(rc: int) -> int:
-        """--all: chain the kernel-geometry gate; worst exit wins."""
+        """--all: chain the kernel-geometry and lifecycle gates; worst
+        exit wins."""
         if not args.all_audits:
             return rc
         import importlib.util
-        spec = importlib.util.spec_from_file_location(
-            "kernel_audit",
-            os.path.join(_REPO, "tools", "kernel_audit.py"))
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        # NOT --write-baseline: --all promises to RUN the kernel gate,
-        # never to silently freeze its current findings into
-        # KERNEL_AUDIT_BASELINE.json while refreshing the program one
+        # NOT --write-baseline: --all promises to RUN the chained
+        # gates, never to silently freeze their current findings into
+        # their baselines while refreshing the program one
         kargs = []
         for flag in ("no_baseline", "demo_regression", "quiet"):
             if getattr(args, flag):
                 kargs.append("--" + flag.replace("_", "-"))
-        krc = mod.main(kargs)
-        return max(rc, krc)
+        for tool in ("kernel_audit", "lifecycle_audit"):
+            spec = importlib.util.spec_from_file_location(
+                tool, os.path.join(_REPO, "tools", tool + ".py"))
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            rc = max(rc, mod.main(list(kargs)))
+        return rc
 
     try:
         specs = build_catalog(names=args.program)
